@@ -1,0 +1,59 @@
+"""Test bootstrap.
+
+JAX is forced onto a virtual 8-device CPU platform *before any jax import*
+so multi-chip sharding tests (workload harness, SURVEY.md §3.5) run without
+TPU hardware. The libtpu SDK probes (@tpu tests) don't go through JAX, so
+this is safe for them too.
+"""
+
+import os
+import sys
+
+# Force (not setdefault): the dev host presets JAX_PLATFORMS=axon (a real
+# TPU tunnel), but tests must be hermetic and run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        _xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+import pytest  # noqa: E402
+
+
+def _has_tpu() -> bool:
+    try:
+        from libtpu.sdk import tpumonitoring
+
+        return bool(tpumonitoring.list_supported_metrics())
+    except Exception:
+        return False
+
+
+def pytest_collection_modifyitems(config, items):
+    if _has_tpu():
+        return
+    skip = pytest.mark.skip(reason="no libtpu/TPU available on this host")
+    for item in items:
+        if "tpu" in item.keywords:
+            item.add_marker(skip)
+
+
+@pytest.fixture
+def scrape():
+    """Return a helper that GETs a URL path and returns (status, text)."""
+    import urllib.request
+    import urllib.error
+
+    def _get(url: str) -> tuple[int, str]:
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as err:
+            return err.code, err.read().decode()
+
+    return _get

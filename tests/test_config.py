@@ -1,0 +1,58 @@
+import pytest
+
+from tpumon.config import Config
+
+
+def test_defaults_match_baseline_targets():
+    cfg = Config()
+    assert cfg.interval == 1.0  # 1 Hz (BASELINE.md)
+    assert cfg.port == 9400
+    assert cfg.backend == "auto"
+
+
+def test_env_first(monkeypatch):
+    monkeypatch.setenv("TPUMON_PORT", "9999")
+    monkeypatch.setenv("TPUMON_INTERVAL", "0.5")
+    monkeypatch.setenv("TPUMON_BACKEND", "stub")
+    monkeypatch.setenv("TPUMON_METRIC_DENY", "tcp_min_rtt, tcp_delivery_rate")
+    cfg = Config.from_env()
+    assert cfg.port == 9999
+    assert cfg.interval == 0.5
+    assert cfg.backend == "stub"
+    assert cfg.metric_deny == ("tcp_min_rtt", "tcp_delivery_rate")
+
+
+def test_cli_overrides_env(monkeypatch):
+    monkeypatch.setenv("TPUMON_PORT", "9999")
+    cfg = Config.load(["--port", "1234", "--backend", "fake"])
+    assert cfg.port == 1234
+    assert cfg.backend == "fake"
+
+
+def test_allow_deny_filtering():
+    cfg = Config(metric_allow=("duty_cycle_pct", "hbm_capacity_usage"),
+                 metric_deny=("hbm_capacity_usage",))
+    assert cfg.metric_enabled("duty_cycle_pct")
+    assert not cfg.metric_enabled("hbm_capacity_usage")  # deny wins
+    assert not cfg.metric_enabled("tensorcore_util")  # not in allow
+
+    open_cfg = Config()
+    assert open_cfg.metric_enabled("anything")
+
+
+def test_env_bool(monkeypatch):
+    monkeypatch.setenv("TPUMON_ICI_PER_LINK", "false")
+    assert Config.from_env().ici_per_link is False
+    monkeypatch.setenv("TPUMON_ICI_PER_LINK", "1")
+    assert Config.from_env().ici_per_link is True
+
+
+def test_malformed_numeric_env_falls_back_to_default(monkeypatch):
+    """K8s env like TPUMON_PORT='' must not CrashLoopBackOff the pod."""
+    monkeypatch.setenv("TPUMON_PORT", "")
+    monkeypatch.setenv("TPUMON_INTERVAL", "one-second")
+    monkeypatch.setenv("TPUMON_GRPC_TIMEOUT", " ")
+    cfg = Config.from_env()
+    assert cfg.port == 9400
+    assert cfg.interval == 1.0
+    assert cfg.grpc_timeout == 2.0

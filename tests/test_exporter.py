@@ -1,0 +1,253 @@
+"""End-to-end exporter tests: poll → cache → live HTTP scrape.
+
+Covers the M0 slice (stub backend, BASELINE config 1) and the fake-backend
+exposition golden checks (SURVEY.md §4.3).
+"""
+
+import threading
+
+import pytest
+from prometheus_client.parser import text_string_to_metric_families
+
+from tpumon.backends.base import BackendError
+from tpumon.backends.fake import FakeTpuBackend
+from tpumon.backends.stub import StubBackend
+from tpumon.config import Config
+from tpumon.exporter.server import build_exporter
+
+
+@pytest.fixture
+def exporter_for():
+    built = []
+
+    def _build(backend, **cfg_kwargs):
+        cfg = Config(port=0, addr="127.0.0.1", interval=30.0, **cfg_kwargs)
+        exp = build_exporter(cfg, backend)
+        exp.start()
+        built.append(exp)
+        return exp
+
+    yield _build
+    for exp in built:
+        exp.close()
+
+
+def _families(text):
+    return {f.name: f for f in text_string_to_metric_families(text)}
+
+
+def test_stub_exporter_config1(exporter_for, scrape):
+    """BASELINE config 1: CPU-only stub — /metrics + device_count=0."""
+    exp = exporter_for(StubBackend())
+    status, text = scrape(exp.server.url + "/metrics")
+    assert status == 200
+    fams = _families(text)
+    count = fams["accelerator_device_count"]
+    assert count.samples[0].value == 0
+    assert count.samples[0].labels["accelerator"] == "none"
+    assert "exporter_scrape_duration_seconds" in fams
+    assert "collector_errors" in fams  # counter family (parser strips _total)
+    # No device families on a deviceless node.
+    assert "accelerator_duty_cycle_percent" not in fams
+
+
+def test_healthz(exporter_for, scrape):
+    exp = exporter_for(StubBackend())
+    status, body = scrape(exp.server.url + "/healthz")
+    assert status == 200 and body == "ok\n"
+    status, _ = scrape(exp.server.url + "/nope")
+    assert status == 404
+
+
+def test_fake_v5e_full_families(exporter_for, scrape):
+    exp = exporter_for(FakeTpuBackend.preset("v5e-16"))
+    status, text = scrape(exp.server.url + "/metrics")
+    assert status == 200
+    fams = _families(text)
+
+    expected = {
+        "accelerator_device_count",
+        "accelerator_core_count",
+        "accelerator_info",
+        "accelerator_duty_cycle_percent",
+        "accelerator_core_utilization_percent",
+        "accelerator_memory_total_bytes",
+        "accelerator_memory_used_bytes",
+        "accelerator_throttle_score",
+        "accelerator_interconnect_link_health",
+        "accelerator_queue_size",
+        "accelerator_op_latency_microseconds",
+        "accelerator_collective_latency_microseconds",
+        "accelerator_dcn_transfer_latency_microseconds",
+        "accelerator_h2d_transfer_latency_microseconds",
+        "accelerator_d2h_transfer_latency_microseconds",
+        "accelerator_network_min_rtt_microseconds",
+        "accelerator_network_delivery_rate_mbps",
+        "exporter_metric_coverage_ratio",
+    }
+    missing = expected - set(fams)
+    assert not missing, f"missing families: {missing}"
+
+    # Label schema: every accelerator_* sample carries the base identity.
+    duty = fams["accelerator_duty_cycle_percent"]
+    assert len(duty.samples) == 4  # v5e-16 host: 4 chips
+    for s in duty.samples:
+        assert s.labels["slice"] == "fake-v5e-16"
+        assert s.labels["accelerator"] == "v5litepod-16"
+        assert "chip" in s.labels
+
+    cov = fams["exporter_metric_coverage_ratio"]
+    assert cov.samples[0].value == 1.0  # 14/14 — the BASELINE target
+
+    mem = fams["accelerator_memory_total_bytes"]
+    assert all(s.value == 17179869184 for s in mem.samples)
+
+
+def test_detached_runtime_absent_not_zero(exporter_for, scrape):
+    """SURVEY §2.2 caveat: empty vector → family absent, never 0."""
+    be = FakeTpuBackend.preset("v4-8", attached=False)
+    exp = exporter_for(be)
+    _, text = scrape(exp.server.url + "/metrics")
+    fams = _families(text)
+    assert "accelerator_duty_cycle_percent" not in fams
+    # Identity still present: the node is known even when idle.
+    assert fams["accelerator_device_count"].samples[0].value == 4
+
+    # Runtime attaches → data appears on the next poll.
+    be.attached = True
+    exp.poller.poll_once()
+    _, text = scrape(exp.server.url + "/metrics")
+    assert "accelerator_duty_cycle_percent" in _families(text)
+
+
+def test_backend_failures_counted_never_fatal(exporter_for, scrape):
+    be = FakeTpuBackend.preset(
+        "v4-8", fail_metrics=("duty_cycle_pct", "hbm_capacity_usage")
+    )
+    exp = exporter_for(be)
+    status, text = scrape(exp.server.url + "/metrics")
+    assert status == 200
+    fams = _families(text)
+    assert "accelerator_duty_cycle_percent" not in fams
+    assert "accelerator_core_utilization_percent" in fams  # others survive
+    errs = {
+        s.labels["kind"]: s.value
+        for s in fams["collector_errors"].samples
+        if s.name == "collector_errors_total"
+    }
+    assert errs.get("backend", 0) >= 2
+
+
+def test_scrape_reads_cache_not_backend(exporter_for, scrape):
+    """SURVEY §3.2: the scrape path MUST NOT call the device backend."""
+    be = FakeTpuBackend.preset("v4-8")
+    exp = exporter_for(be)
+
+    calls = {"n": 0}
+    orig = be.sample
+
+    def counting_sample(name):
+        calls["n"] += 1
+        return orig(name)
+
+    be.sample = counting_sample
+    for _ in range(5):
+        status, _ = scrape(exp.server.url + "/metrics")
+        assert status == 200
+    assert calls["n"] == 0
+
+
+def test_metric_deny_list(exporter_for, scrape):
+    exp = exporter_for(
+        FakeTpuBackend.preset("v4-8"), metric_deny=("tcp_min_rtt",)
+    )
+    _, text = scrape(exp.server.url + "/metrics")
+    fams = _families(text)
+    assert "accelerator_network_min_rtt_microseconds" not in fams
+    assert "accelerator_network_delivery_rate_mbps" in fams
+
+
+def test_concurrent_scrapes_during_polling(exporter_for, scrape):
+    """Race check (SURVEY §5.2): hammer /metrics while the poller republishes."""
+    be = FakeTpuBackend.preset("v5p-64")
+    exp = exporter_for(be)
+    errors = []
+
+    def hammer():
+        for _ in range(20):
+            try:
+                status, text = scrape(exp.server.url + "/metrics")
+                assert status == 200
+                assert "accelerator_device_count" in text
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for _ in range(25):
+        be.advance()
+        exp.poller.poll_once()
+    for t in threads:
+        t.join()
+    assert not errors
+
+
+def test_list_metrics_failure_reports_zero_coverage(exporter_for, scrape):
+    """A failed enumeration is 0% coverage, not a vacuous 100%."""
+    be = FakeTpuBackend.preset("v4-8")
+
+    def broken_list():
+        raise RuntimeError("device library wedged")
+
+    be.list_metrics = broken_list
+    exp = exporter_for(be)
+    _, text = scrape(exp.server.url + "/metrics")
+    fams = _families(text)
+    assert fams["exporter_metric_coverage_ratio"].samples[0].value == 0.0
+    # Identity families still served; exporter survives the outage.
+    assert fams["accelerator_device_count"].samples[0].value == 4
+
+
+def test_ici_per_link_disabled_skips_device_query(exporter_for):
+    be = FakeTpuBackend.preset("v5p-64")
+    sampled = []
+    orig = be.sample
+    be.sample = lambda name: (sampled.append(name), orig(name))[1]
+    exp = exporter_for(be, ici_per_link=False)
+    exp.poller.poll_once()
+    assert "ici_link_health" not in sampled
+    assert "duty_cycle_pct" in sampled
+
+
+def test_core_state_family_from_fake(exporter_for, scrape):
+    """tpuz-analogue core-state gauge (SURVEY §2.2) flows end-to-end."""
+    exp = exporter_for(FakeTpuBackend.preset("v4-8"))
+    _, text = scrape(exp.server.url + "/metrics")
+    fams = _families(text)
+    states = fams["accelerator_core_state"]
+    assert len(states.samples) == 8  # v4-8: 4 chips × 2 cores
+    for s in states.samples:
+        assert s.value == 1.0
+        assert s.labels["state"] in ("RUNNING", "HALTED")
+
+
+def test_backend_info_version_delegates(exporter_for, scrape):
+    exp = exporter_for(FakeTpuBackend.preset("v4-8"))
+    _, text = scrape(exp.server.url + "/metrics")
+    fams = _families(text)
+    info = fams["exporter_backend_info"].samples[0]
+    assert info.labels["backend"] == "fake"
+    assert info.labels["version"].startswith("fake-")
+
+
+def test_server_close_before_start_does_not_hang():
+    import time as _time
+
+    from tpumon.exporter.server import Exporter
+
+    cfg = Config(port=0, addr="127.0.0.1")
+    exp = Exporter(cfg, StubBackend())
+    t0 = _time.monotonic()
+    exp.close()  # never started: must return, not deadlock
+    assert _time.monotonic() - t0 < 2.0

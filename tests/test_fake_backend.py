@@ -1,0 +1,63 @@
+import pytest
+
+from tpumon.backends.base import BackendError, RawMetric
+from tpumon.backends.fake import LIBTPU_METRICS, TOPOLOGIES, FakeTpuBackend
+from tpumon.parsing import parse
+from tpumon.schema import SPECS_BY_SOURCE
+
+
+@pytest.mark.parametrize("preset", sorted(TOPOLOGIES))
+def test_presets_build(preset):
+    be = FakeTpuBackend.preset(preset)
+    topo = be.topology()
+    p = TOPOLOGIES[preset]
+    assert topo.num_chips == p.chips_per_host
+    assert topo.num_hosts == p.num_hosts
+    assert be.list_metrics() == LIBTPU_METRICS
+
+
+def test_all_fake_data_parses_cleanly():
+    """The fake must emit exactly the wire formats the parser understands."""
+    be = FakeTpuBackend.preset("v5p-64")
+    for name in be.list_metrics():
+        raw = be.sample(name)
+        assert not raw.empty
+        res = parse(raw, SPECS_BY_SOURCE[name])
+        assert res.errors == 0, (name, raw.data[:3])
+        assert res.points
+
+
+def test_deterministic_and_advances():
+    a = FakeTpuBackend.preset("v4-8", seed=7)
+    b = FakeTpuBackend.preset("v4-8", seed=7)
+    assert a.sample("duty_cycle_pct") == b.sample("duty_cycle_pct")
+    before = a.sample("duty_cycle_pct")
+    a.advance()
+    assert a.sample("duty_cycle_pct") != before
+
+
+def test_detached_returns_empty_vectors():
+    be = FakeTpuBackend.preset("v4-8", attached=False)
+    for name in be.list_metrics():
+        assert be.sample(name).empty
+
+
+def test_failure_injection():
+    be = FakeTpuBackend.preset("v4-8", fail_metrics=("duty_cycle_pct",))
+    with pytest.raises(BackendError):
+        be.sample("duty_cycle_pct")
+    assert not be.sample("tensorcore_util").empty
+
+
+def test_malformed_injection_counted_by_parser():
+    be = FakeTpuBackend.preset("v4-8", malformed_metrics=("duty_cycle_pct",))
+    raw = be.sample("duty_cycle_pct")
+    res = parse(raw, SPECS_BY_SOURCE["duty_cycle_pct"])
+    assert res.errors >= 1
+    assert res.points  # good entries still parse
+
+
+def test_zero_chip_preset_is_detached():
+    be = FakeTpuBackend.preset("none")
+    assert be.topology().num_chips == 0
+    assert be.sample("duty_cycle_pct").empty

@@ -1,0 +1,34 @@
+"""gRPC monitoring backend (SURVEY.md §3.3) — needs libtpu, so @tpu."""
+
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+
+def test_grpc_backend_delegates_and_probes():
+    from tpumon.backends.grpc_backend import GrpcMonitoringBackend
+
+    be = GrpcMonitoringBackend(addr="localhost:8431", timeout=0.5)
+    try:
+        assert len(be.list_metrics()) >= 14
+        raw = be.sample("duty_cycle_pct")
+        assert isinstance(raw.data, tuple)
+        # Idle host: the runtime monitoring service is down → unreachable,
+        # and that must be a clean False, not an exception (SURVEY §2.2).
+        assert be.service_reachable() in (True, False)
+    finally:
+        be.close()
+
+
+def test_nvml_backend_absent_raises_cleanly():
+    from tpumon.backends.base import BackendError
+    from tpumon.backends.nvml_backend import NvmlBackend
+
+    try:
+        import pynvml  # noqa: F401
+
+        pytest.skip("pynvml installed; absence path not testable")
+    except ImportError:
+        pass
+    with pytest.raises(BackendError, match="pynvml"):
+        NvmlBackend()

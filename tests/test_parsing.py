@@ -1,0 +1,165 @@
+"""Parser tests against the documented libtpu wire formats.
+
+Every example string below is taken verbatim from the live
+``get_metric(...).description()`` probes recorded in SURVEY.md §2.2.
+"""
+
+import pytest
+from hypothesis import given, strategies as st
+
+from tpumon.backends.base import RawMetric
+from tpumon.parsing import parse
+from tpumon.schema import SPECS_BY_SOURCE, STATS
+
+
+def _parse(name, data):
+    return parse(RawMetric(name, tuple(data)), SPECS_BY_SOURCE[name])
+
+
+def test_per_chip_duty_cycle():
+    res = _parse("duty_cycle_pct", ["0.00", "20.00", "0.00", "0.00"])
+    assert res.errors == 0
+    assert [p.value for p in res.points] == [0.0, 20.0, 0.0, 0.0]
+    assert res.points[1].labels == {"chip": "1"}
+
+
+def test_per_chip_hbm_bytes():
+    res = _parse("hbm_capacity_total", ["33550229504", "33550229504"])
+    assert res.points[0].value == 33550229504
+    assert res.points[0].labels == {"chip": "0"}
+
+
+def test_per_core_tensorcore_util():
+    res = _parse("tensorcore_util", ["0.00", "20.00"])
+    assert res.points[1].labels == {"core": "1"}
+
+
+def test_ici_link_health_keyed():
+    res = _parse(
+        "ici_link_health",
+        ["tray1.chip3.ici0.int: 0", "tray1.chip3.ici1.int: 10"],
+    )
+    assert res.errors == 0
+    assert res.points[0].value == 0
+    assert res.points[0].labels == {
+        "link": "tray1.chip3.ici0.int",
+        "tray": "1",
+        "chip": "3",
+        "port": "0",
+        "dir": "int",
+    }
+    assert res.points[1].value == 10
+
+
+def test_hlo_queue_size_keyed():
+    res = _parse(
+        "hlo_queue_size",
+        ["tensorcore_0: 0", "tensorcore_1: 10", "tensorcore_2: 20"],
+    )
+    assert [p.value for p in res.points] == [0, 10, 20]
+    assert res.points[1].labels == {"core": "1"}
+
+
+def test_pctl_buffer_transfer_row_per_string():
+    res = _parse(
+        "buffer_transfer_latency",
+        ["8MB+, 100.00, 200.00, 300.00, 400.00, 500.00"],
+    )
+    assert res.errors == 0
+    assert len(res.points) == 5
+    stats = {p.labels["stat"]: p.value for p in res.points}
+    assert stats == {"mean": 100.0, "p50": 200.0, "p90": 300.0,
+                     "p95": 400.0, "p999": 500.0}
+    assert all(p.labels["buffer_size"] == "8MB+" for p in res.points)
+
+
+def test_pctl_flat_token_layout():
+    # Alternative layout: the vector is flat tokens, keys start rows.
+    res = _parse(
+        "buffer_transfer_latency",
+        ["0-8MB", "1.0", "2.0", "3.0", "4.0", "5.0",
+         "8MB+", "10.0", "20.0", "30.0", "40.0", "50.0"],
+    )
+    assert res.errors == 0
+    assert len(res.points) == 10
+    sizes = {p.labels["buffer_size"] for p in res.points}
+    assert sizes == {"0-8MB", "8MB+"}
+
+
+def test_pctl_collective_buffer_op_key():
+    res = _parse(
+        "collective_e2e_latency",
+        ["2MB+-ALL_REDUCE, 100.00, 200.00, 300.00, 400.00, 500.00"],
+    )
+    assert res.points[0].labels["buffer_size"] == "2MB+"
+    assert res.points[0].labels["op"] == "ALL_REDUCE"
+
+
+def test_pctl_hlo_execution_core_key():
+    res = _parse(
+        "hlo_execution_timing",
+        ["tensorcore_0, 100.00, 200.00, 300.00, 400.00, 500.00"],
+    )
+    assert res.points[0].labels["core"] == "0"
+    assert res.points[0].labels["stat"] == "mean"
+
+
+def test_pctl_plain_tcp():
+    res = _parse("tcp_min_rtt", ["100.00, 200.00, 300.00, 400.00, 500.00"])
+    assert res.errors == 0
+    assert [p.labels["stat"] for p in res.points] == list(STATS)
+
+    res2 = _parse("tcp_delivery_rate",
+                  ["100.00", "200.00", "300.00", "400.00", "500.00"])
+    assert len(res2.points) == 5
+
+
+def test_empty_vector_is_absent_not_zero():
+    # The 'runtime not attached' state observed live (SURVEY.md §2.2).
+    for name in SPECS_BY_SOURCE:
+        res = _parse(name, [])
+        assert res.points == ()
+        assert res.errors == 0
+
+
+def test_malformed_entries_skipped_and_counted():
+    res = _parse("duty_cycle_pct", ["1.5", "banana", "2.5"])
+    assert res.errors == 1
+    assert [p.value for p in res.points] == [1.5, 2.5]
+
+    res = _parse("ici_link_health", ["tray1.chip0.ici0.int: notanumber"])
+    assert res.errors == 1 and not res.points
+
+    # 1 unparseable token + 3 missing stats = 4 counted errors; the one
+    # good value still survives (short rows are corruption, not hidden).
+    res = _parse("buffer_transfer_latency", ["8MB+, x, 2.0"])
+    assert res.errors == 4
+    assert len(res.points) == 1
+
+
+def test_unrecognized_ici_key_keeps_full_link_label():
+    res = _parse("ici_link_health", ["weird-format-link: 3"])
+    assert res.points[0].labels["link"] == "weird-format-link"
+    assert res.points[0].labels["tray"] == ""
+
+
+@given(st.lists(st.text(max_size=30), max_size=40))
+def test_parser_never_raises_on_arbitrary_vectors(data):
+    for name, spec in SPECS_BY_SOURCE.items():
+        res = parse(RawMetric(name, tuple(data)), spec)
+        for p in res.points:
+            assert isinstance(p.value, float) or isinstance(p.value, int)
+
+
+@given(
+    st.lists(
+        st.floats(min_value=0, max_value=1e12, allow_nan=False), max_size=16
+    )
+)
+def test_per_chip_roundtrip(values):
+    data = [f"{v:.4f}" for v in values]
+    res = _parse("duty_cycle_pct", data)
+    assert res.errors == 0
+    assert len(res.points) == len(values)
+    for p, v in zip(res.points, values):
+        assert p.value == pytest.approx(v, abs=1e-4)

@@ -1,0 +1,37 @@
+from tpumon.backends.fake import LIBTPU_METRICS
+from tpumon.schema import LIBTPU_SPECS, SPECS_BY_FAMILY, SPECS_BY_SOURCE, coverage
+
+
+def test_all_14_libtpu_metrics_mapped():
+    """The BASELINE coverage target: every supported metric has a family."""
+    assert len(LIBTPU_METRICS) == 14
+    for name in LIBTPU_METRICS:
+        assert name in SPECS_BY_SOURCE, f"unmapped libtpu metric: {name}"
+    assert coverage(LIBTPU_METRICS) == 1.0
+
+
+def test_family_names_unique_and_unified():
+    assert len(SPECS_BY_FAMILY) == len(LIBTPU_SPECS)
+    for spec in LIBTPU_SPECS:
+        assert spec.family.startswith("accelerator_"), spec.family
+        # Vendor-neutral: no 'tpu'/'gpu' in the unified family names
+        # (BASELINE.json config 5: one schema for a mixed pool).
+        assert "tpu" not in spec.family
+        assert "gpu" not in spec.family
+        assert "nvlink" not in spec.family
+
+
+def test_coverage_math():
+    assert coverage(()) == 1.0
+    assert coverage(("duty_cycle_pct",)) == 1.0
+    assert coverage(("duty_cycle_pct", "brand_new_metric")) == 0.5
+
+
+def test_stat_label_only_on_pctl_shapes():
+    from tpumon.schema import Shape
+
+    for spec in LIBTPU_SPECS:
+        if spec.shape in (Shape.PCTL_KEYED, Shape.PCTL_PLAIN):
+            assert "stat" in spec.labels
+        else:
+            assert "stat" not in spec.labels

@@ -1,0 +1,43 @@
+"""Discovery sidecar tests (SURVEY.md §3.4)."""
+
+import json
+
+from tpumon.discovery.sidecar import _TopologyCollector, main, write_topology
+from tpumon.discovery.topology import Chip, Topology
+
+TOPO = Topology(
+    accelerator_type="v5p-64",
+    slice_name="pool-b",
+    hostname="h0",
+    worker_id=1,
+    num_hosts=16,
+    chips=(Chip(index=0, coords=(1, 2, 3), num_cores=2, device_id="pool-b/1/0"),),
+)
+
+
+def test_write_topology_atomic(tmp_path):
+    out = tmp_path / "nested" / "topology.json"
+    write_topology(TOPO, str(out))
+    data = json.loads(out.read_text())
+    assert data["slice_name"] == "pool-b"
+    assert data["chips"][0]["coords"] == [1, 2, 3]
+    assert Topology.from_json(out.read_text()) == TOPO
+
+
+def test_sidecar_once_end_to_end(tmp_path):
+    src = tmp_path / "in.json"
+    src.write_text(TOPO.to_json())
+    out = tmp_path / "run" / "topology.json"
+    rc = main(["--once", "--topology-file", str(src), "--topology-out", str(out)])
+    assert rc == 0
+    assert Topology.from_json(out.read_text()) == TOPO
+
+
+def test_topology_collector_families():
+    coll = _TopologyCollector()
+    coll.update(TOPO)
+    fams = {f.name: f for f in coll.collect()}
+    assert fams["accelerator_device_count"].samples[0].value == 1
+    info = fams["accelerator_info"].samples[0]
+    assert info.labels["coords"] == "1,2,3"
+    assert info.labels["device_id"] == "pool-b/1/0"

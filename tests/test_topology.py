@@ -1,0 +1,74 @@
+import json
+
+from tpumon.discovery.topology import Chip, Topology, discover
+
+
+def test_json_roundtrip(tmp_path):
+    topo = Topology(
+        accelerator_type="v5litepod-16",
+        slice_name="pool-a",
+        hostname="host-0",
+        worker_id=2,
+        num_hosts=4,
+        chips=(
+            Chip(index=0, coords=(0, 0, 2), num_cores=1, device_id="pool-a/2/0"),
+            Chip(index=1, coords=(1, 0, 2), num_cores=1, device_id="pool-a/2/1"),
+        ),
+    )
+    back = Topology.from_json(topo.to_json())
+    assert back == topo
+
+    p = tmp_path / "topo.json"
+    p.write_text(topo.to_json())
+    assert discover(topology_file=str(p)) == topo
+
+
+def test_gke_env_discovery(monkeypatch):
+    monkeypatch.setenv("TPU_WORKER_ID", "3")
+    monkeypatch.setenv(
+        "TPU_WORKER_HOSTNAMES", "tp-0.pool,tp-1.pool,tp-2.pool,tp-3.pool"
+    )
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-16")
+    monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "2,2,1")
+    topo = discover()
+    assert topo.worker_id == 3
+    assert topo.num_hosts == 4
+    assert topo.num_chips == 4
+    assert topo.accelerator_type == "v5litepod-16"
+    assert topo.num_cores == 4  # v5e: 1 core per chip
+    assert topo.chips[0].device_id.endswith("/3/0")
+
+
+def test_v4_cores_per_chip(monkeypatch):
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-8")
+    monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "2,2,1")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    topo = discover()
+    assert topo.num_chips == 4
+    assert topo.num_cores == 8  # v4: 2 TensorCores per chip
+
+
+def test_zero_devices_stub_mode(monkeypatch):
+    # No TPU env and no accelerator visible → zero chips, never raises.
+    import tpumon.discovery.topology as topo_mod
+
+    monkeypatch.setattr(topo_mod, "_jax_chip_count", lambda: (0, "none"))
+    for var in (
+        "TPU_WORKER_ID",
+        "TPU_WORKER_HOSTNAMES",
+        "TPU_ACCELERATOR_TYPE",
+        "TPU_CHIPS_PER_HOST_BOUNDS",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    topo = discover()
+    assert topo.num_chips == 0
+    assert topo.accelerator_type == "none"
+    assert topo.base_labels()["accelerator"] == "none"
+
+
+def test_bad_topology_file_falls_back(tmp_path, monkeypatch):
+    monkeypatch.delenv("TPU_CHIPS_PER_HOST_BOUNDS", raising=False)
+    p = tmp_path / "bad.json"
+    p.write_text("{not json")
+    topo = discover(topology_file=str(p))
+    assert topo is not None  # fell through to env/jax discovery

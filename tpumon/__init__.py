@@ -1,0 +1,36 @@
+"""tpumon — TPU-native Kubernetes accelerator-telemetry framework.
+
+A ground-up TPU-first re-design of the capabilities of the
+``ma2331550908/k8s-gpu-monitor`` GPU exporter stack (see SURVEY.md — the
+reference mount was empty, so the blueprint is SURVEY.md's reconstruction
+from driver metadata plus live libtpu probes):
+
+- **Device backend** (L1): ``libtpu.sdk.tpumonitoring`` / ``slice`` / ``tpuz``
+  adapters replace NVML/DCGM; a gRPC monitoring client covers the
+  DCGM-hostengine-analogue path.
+- **Discovery** (L2): TPU slice topology (host/chip/core + coords) replaces
+  PCIe-BDF identity.
+- **Exporter core** (L3): poll loop + sample cache + ``/metrics`` with a
+  unified ``accelerator_*`` schema shared across TPU and GPU.
+- **Scrape plane / deployment / dashboards** (L4-L6): Prometheus exposition,
+  K8s DaemonSet manifests, Grafana dashboards incl. ICI fabric heatmaps.
+
+Layer map and component inventory: SURVEY.md §1-§2.
+"""
+
+__version__ = "0.1.0"
+
+from tpumon.config import Config
+from tpumon.backends import create_backend
+from tpumon.backends.base import Backend, RawMetric
+from tpumon.discovery.topology import Topology, discover
+
+__all__ = [
+    "Config",
+    "create_backend",
+    "Backend",
+    "RawMetric",
+    "Topology",
+    "discover",
+    "__version__",
+]

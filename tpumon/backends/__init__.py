@@ -1,0 +1,65 @@
+"""Backend factory (SURVEY.md §1 L1, §5.6 backend selection).
+
+``auto`` resolution order: libtpu SDK importable and reporting a device →
+libtpu; otherwise stub. The gRPC, fake, and NVML-compat backends are explicit
+opt-ins (``--backend grpc|fake|nvml``).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tpumon.backends.base import Backend, BackendError, RawMetric
+from tpumon.config import Config
+
+log = logging.getLogger(__name__)
+
+__all__ = ["Backend", "BackendError", "RawMetric", "create_backend"]
+
+
+def create_backend(cfg: Config) -> Backend:
+    kind = cfg.backend
+    if kind == "auto":
+        kind = _autodetect()
+        log.info("backend auto-detected: %s", kind)
+
+    if kind == "stub":
+        from tpumon.backends.stub import StubBackend
+
+        return StubBackend()
+    if kind == "libtpu":
+        from tpumon.backends.libtpu_backend import LibtpuBackend
+
+        return LibtpuBackend(topology_file=cfg.topology_file)
+    if kind == "grpc":
+        from tpumon.backends.grpc_backend import GrpcMonitoringBackend
+
+        return GrpcMonitoringBackend(
+            addr=cfg.grpc_addr,
+            timeout=cfg.grpc_timeout,
+            topology_file=cfg.topology_file,
+        )
+    if kind == "fake":
+        from tpumon.backends.fake import FakeTpuBackend
+
+        return FakeTpuBackend.preset(cfg.fake_topology)
+    if kind == "nvml":
+        from tpumon.backends.nvml_backend import NvmlBackend
+
+        return NvmlBackend()
+    raise ValueError(f"unknown backend {kind!r}")
+
+
+def _autodetect() -> str:
+    try:
+        from libtpu.sdk import tpumonitoring  # noqa: F401
+
+        from tpumon.discovery.topology import discover
+
+        if discover().num_chips > 0:
+            return "libtpu"
+        log.info("libtpu importable but no chips discovered; using stub")
+        return "stub"
+    except Exception as exc:
+        log.info("libtpu unavailable (%s); using stub", exc)
+        return "stub"

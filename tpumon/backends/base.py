@@ -1,0 +1,73 @@
+"""Device-backend protocol (SURVEY.md §1 L1).
+
+The reference genre talks to NVML/DCGM; every TPU path here goes through this
+protocol instead, so the exporter core (L3) never imports libtpu directly and
+the fake backend is a drop-in (SURVEY.md §4.1).
+
+Semantics distilled from the live probes (SURVEY.md §2.2):
+
+- ``sample()`` returns the metric's raw per-chip/per-row **string vector**
+  exactly as the device library reports it; parsing lives in
+  :mod:`tpumon.parsing`, not in backends.
+- An **empty vector means "no sample"** (the libtpu monitoring service only
+  populates data while a runtime/workload is attached). It is NOT zero and
+  must surface as an absent metric.
+- Backend errors raise :class:`BackendError`; the poll loop converts them to
+  ``collector_errors_total`` increments and keeps serving (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Protocol, runtime_checkable
+
+from tpumon.discovery.topology import Topology
+
+
+class BackendError(RuntimeError):
+    """A device query failed; the sample is dropped, the server lives on."""
+
+
+@dataclass(frozen=True)
+class RawMetric:
+    """One raw sample of one device metric.
+
+    ``data`` is the untouched string vector from the device library
+    (e.g. ``("0.00", "20.00")`` or ``("tray1.chip3.ici0.int: 0",)``).
+    Empty tuple == runtime detached / no data, never zero.
+    """
+
+    name: str
+    data: tuple[str, ...]
+
+    @property
+    def empty(self) -> bool:
+        return len(self.data) == 0
+
+
+@runtime_checkable
+class Backend(Protocol):
+    """What every device backend (libtpu, grpc, fake, stub, nvml) implements."""
+
+    #: Short name used in logs and the exporter_backend_info gauge.
+    name: str
+
+    def list_metrics(self) -> tuple[str, ...]:
+        """Device-library metric names this backend can sample."""
+        ...
+
+    def sample(self, name: str) -> RawMetric:
+        """Query one metric. Raises BackendError on device failure."""
+        ...
+
+    def topology(self) -> Topology:
+        """Accelerator identity for label construction."""
+        ...
+
+    def version(self) -> str:
+        """Version of the underlying device library (for backend_info)."""
+        ...
+
+    def close(self) -> None:
+        """Release device handles (idempotent)."""
+        ...

@@ -1,0 +1,243 @@
+"""FakeTpuBackend — a first-class test double (SURVEY.md §4.1).
+
+Emits data in the exact libtpu wire formats captured live in SURVEY.md §2.2
+(per-chip string vectors, ``key: value`` strings, comma-joined percentile
+rows), over the topology ladder of BASELINE.json configs 1-4:
+
+- ``none``   — 0 chips (CPU-only node)
+- ``v4-8``   — single host, 4 chips × 2 cores
+- ``v5e-16`` — 4 hosts × 4 chips × 1 core
+- ``v5p-64`` — 16 hosts × 4 chips × 2 cores
+
+Failure modes are explicit knobs because they were observed for real:
+
+- ``attached=False`` → every metric returns an **empty vector**, the
+  'runtime not attached' state the live probe hit (§2.2) — absent, not zero.
+- ``fail_metrics`` → those metrics raise BackendError (libtpu call failure).
+- ``malformed_metrics`` → those metrics emit garbage entries, which the
+  parser must skip-and-count (SURVEY.md §4.2).
+
+Data is deterministic in ``(seed, step, metric, chip)`` so golden tests are
+stable; call :meth:`advance` to move time forward.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+from tpumon.backends.base import BackendError, RawMetric
+from tpumon.discovery.topology import Chip, Topology
+
+#: All 14 libtpu 0.0.34 runtime metrics (SURVEY.md §2.2, live probe).
+LIBTPU_METRICS: tuple[str, ...] = (
+    "tensorcore_util",
+    "ici_link_health",
+    "tpu_throttle_score",
+    "duty_cycle_pct",
+    "buffer_transfer_latency",
+    "collective_e2e_latency",
+    "hbm_capacity_total",
+    "hbm_capacity_usage",
+    "hlo_execution_timing",
+    "hlo_queue_size",
+    "tcp_min_rtt",
+    "tcp_delivery_rate",
+    "host_to_device_transfer_latency",
+    "device_to_host_transfer_latency",
+)
+
+_COLLECTIVES = ("ALL_REDUCE", "ALL_GATHER", "REDUCE_SCATTER", "ALL_TO_ALL")
+_BUFFER_SIZES = ("0-8MB", "8MB+")
+_ICI_PORTS = 4
+
+
+@dataclass(frozen=True)
+class Preset:
+    accelerator_type: str
+    num_hosts: int
+    chips_per_host: int
+    cores_per_chip: int
+    hbm_bytes: int
+
+
+TOPOLOGIES: dict[str, Preset] = {
+    "none": Preset("none", 1, 0, 0, 0),
+    "v4-8": Preset("v4-8", 1, 4, 2, 34_359_738_368),
+    "v5e-16": Preset("v5litepod-16", 4, 4, 1, 17_179_869_184),
+    "v5p-64": Preset("v5p-64", 16, 4, 2, 103_079_215_104),
+}
+
+
+def _noise(seed: int, step: int, *key: object) -> float:
+    """Deterministic uniform [0, 1) from a hash — stable across runs."""
+    payload = f"{seed}|{step}|{'|'.join(str(k) for k in key)}".encode()
+    digest = hashlib.blake2b(payload, digest_size=8).digest()
+    return int.from_bytes(digest, "big") / 2**64
+
+
+class FakeTpuBackend:
+    name = "fake"
+
+    def __init__(
+        self,
+        topology: Topology,
+        *,
+        hbm_bytes: int = 17_179_869_184,
+        attached: bool = True,
+        seed: int = 0,
+        fail_metrics: tuple[str, ...] = (),
+        malformed_metrics: tuple[str, ...] = (),
+    ) -> None:
+        self._topology = topology
+        self._hbm = hbm_bytes
+        self.attached = attached
+        self._seed = seed
+        self._step = 0
+        self.fail_metrics = set(fail_metrics)
+        self.malformed_metrics = set(malformed_metrics)
+
+    # -- construction -----------------------------------------------------
+
+    @classmethod
+    def preset(
+        cls, name: str, *, worker_id: int = 0, hostname: str | None = None, **kwargs
+    ) -> "FakeTpuBackend":
+        try:
+            p = TOPOLOGIES[name]
+        except KeyError:
+            raise ValueError(
+                f"unknown fake topology {name!r}; choose from {sorted(TOPOLOGIES)}"
+            ) from None
+        slice_name = f"fake-{name}"
+        host = hostname or f"{slice_name}-w{worker_id}"
+        chips = tuple(
+            Chip(
+                index=i,
+                coords=(i % 2, (i // 2) % 2, worker_id),
+                num_cores=p.cores_per_chip,
+                device_id=f"{slice_name}/{worker_id}/{i}",
+            )
+            for i in range(p.chips_per_host)
+        )
+        topo = Topology(
+            accelerator_type=p.accelerator_type,
+            slice_name=slice_name,
+            hostname=host,
+            worker_id=worker_id,
+            num_hosts=p.num_hosts,
+            chips=chips,
+        )
+        return cls(topo, hbm_bytes=p.hbm_bytes, **kwargs)
+
+    # -- time -------------------------------------------------------------
+
+    def advance(self, steps: int = 1) -> None:
+        self._step += steps
+
+    # -- Backend protocol -------------------------------------------------
+
+    def list_metrics(self) -> tuple[str, ...]:
+        return LIBTPU_METRICS
+
+    def topology(self) -> Topology:
+        return self._topology
+
+    def version(self) -> str:
+        from tpumon import __version__
+
+        return f"fake-{__version__}"
+
+    def core_states(self) -> dict[str, str]:
+        """tpuz-analogue per-core state (SURVEY.md §2.2)."""
+        if not self.attached or self._topology.num_chips == 0:
+            return {}
+        return {
+            str(c): ("RUNNING" if self._u("state", c) < 0.95 else "HALTED")
+            for c in range(self._topology.num_cores)
+        }
+
+    def close(self) -> None:
+        pass
+
+    def sample(self, name: str) -> RawMetric:
+        if name in self.fail_metrics:
+            raise BackendError(f"injected failure for {name}")
+        if name not in LIBTPU_METRICS:
+            raise BackendError(f"unsupported metric {name}")
+        if not self.attached or self._topology.num_chips == 0:
+            return RawMetric(name, ())
+        data = self._generate(name)
+        if name in self.malformed_metrics:
+            data = ("not-a-number",) + data[1:] + ("trailing: garbage: x",)
+        return RawMetric(name, data)
+
+    # -- wire-format generation -------------------------------------------
+
+    def _u(self, *key: object) -> float:
+        return _noise(self._seed, self._step, *key)
+
+    def _generate(self, name: str) -> tuple[str, ...]:
+        topo = self._topology
+        chips = range(topo.num_chips)
+        cores = range(topo.num_cores)
+
+        if name == "duty_cycle_pct":
+            return tuple(f"{100 * self._u('duty', c):.2f}" for c in chips)
+        if name == "tensorcore_util":
+            return tuple(f"{100 * self._u('tc', c):.2f}" for c in cores)
+        if name == "hbm_capacity_total":
+            return tuple(str(self._hbm) for _ in chips)
+        if name == "hbm_capacity_usage":
+            return tuple(
+                str(int(self._hbm * 0.9 * self._u("hbm", c))) for c in chips
+            )
+        if name == "tpu_throttle_score":
+            return tuple(
+                str(int(10 * max(0.0, self._u("thr", c) - 0.9) * 10)) for c in chips
+            )
+        if name == "ici_link_health":
+            out = []
+            for c in chips:
+                tray = c // 4 + 1
+                for port in range(_ICI_PORTS):
+                    health = 0 if self._u("ici", c, port) < 0.97 else 10
+                    out.append(f"tray{tray}.chip{c}.ici{port}.int: {health}")
+            return tuple(out)
+        if name == "hlo_queue_size":
+            return tuple(
+                f"tensorcore_{c}: {int(32 * self._u('queue', c))}" for c in cores
+            )
+        if name == "hlo_execution_timing":
+            return tuple(self._pctl_row(f"tensorcore_{c}", "hlo", 500.0) for c in cores)
+        if name == "collective_e2e_latency":
+            return tuple(
+                self._pctl_row(f"{size}-{op}", f"coll-{op}", 800.0)
+                for size in _BUFFER_SIZES
+                for op in _COLLECTIVES
+            )
+        if name in (
+            "buffer_transfer_latency",
+            "host_to_device_transfer_latency",
+            "device_to_host_transfer_latency",
+        ):
+            return tuple(
+                self._pctl_row(size, name, 300.0) for size in _BUFFER_SIZES
+            )
+        if name == "tcp_min_rtt":
+            return (self._pctl_row(None, "rtt", 150.0),)
+        if name == "tcp_delivery_rate":
+            return (self._pctl_row(None, "rate", 4000.0),)
+        raise AssertionError(name)
+
+    def _pctl_row(self, key: str | None, salt: str, scale: float) -> str:
+        base = scale * (0.5 + self._u(salt, key))
+        vals = [
+            base,
+            base * 1.1,
+            base * 1.8,
+            base * 2.2,
+            base * 3.5,
+        ]  # mean, p50, p90, p95, p999
+        row = ", ".join(f"{v:.2f}" for v in vals)
+        return f"{key}, {row}" if key is not None else row

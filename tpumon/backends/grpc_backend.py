@@ -1,0 +1,85 @@
+"""gRPC monitoring backend — the DCGM-hostengine-analogue path (SURVEY.md §3.3).
+
+The libtpu runtime hosts a local monitoring gRPC service (observed live on
+127.0.0.1:8431 — ``tpuz.get_core_state_summary`` dials it and gets
+``Connection refused`` when no runtime is attached, SURVEY.md §2.2). Its
+proto surface is not shipped in this environment, so this backend:
+
+1. Probes channel reachability itself (``service_reachable`` → the
+   ``exporter_grpc_service_up`` signal and /healthz detail), and
+2. Delegates metric reads to the libtpu SDK, which is a client of the same
+   service — keeping coverage accounting honest (SURVEY.md §7 hard part (c):
+   'degrade gracefully to the SDK path') while still exercising the
+   process-boundary the DCGM path implies.
+
+When the protos become available, ``sample`` can switch to direct stubs
+without touching the exporter core (same Backend protocol).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tpumon.backends.base import BackendError, RawMetric
+from tpumon.backends.libtpu_backend import LibtpuBackend
+from tpumon.discovery.topology import Topology
+
+log = logging.getLogger(__name__)
+
+
+class GrpcMonitoringBackend:
+    name = "grpc"
+
+    def __init__(
+        self,
+        addr: str = "localhost:8431",
+        timeout: float = 2.0,
+        topology_file: str | None = None,
+    ) -> None:
+        self.addr = addr
+        self.timeout = timeout
+        self._channel = None
+        try:
+            import grpc
+
+            self._grpc = grpc
+            self._channel = grpc.insecure_channel(addr)
+        except Exception as exc:
+            log.warning("grpcio unavailable (%s); reachability checks off", exc)
+            self._grpc = None
+        # The SDK rides the same service; it is the metric transport.
+        self._delegate = LibtpuBackend(topology_file)
+
+    def service_reachable(self) -> bool:
+        """True iff the runtime monitoring service accepts connections."""
+        if self._channel is None:
+            return False
+        try:
+            fut = self._grpc.channel_ready_future(self._channel)
+            fut.result(timeout=self.timeout)
+            return True
+        except Exception:
+            return False
+
+    def list_metrics(self) -> tuple[str, ...]:
+        return self._delegate.list_metrics()
+
+    def sample(self, name: str) -> RawMetric:
+        return self._delegate.sample(name)
+
+    def topology(self) -> Topology:
+        return self._delegate.topology()
+
+    def version(self) -> str:
+        return self._delegate.version()
+
+    def close(self) -> None:
+        if self._channel is not None:
+            try:
+                self._channel.close()
+            except Exception:
+                pass
+        self._delegate.close()
+
+
+__all__ = ["GrpcMonitoringBackend", "BackendError"]

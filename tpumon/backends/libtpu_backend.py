@@ -1,0 +1,82 @@
+"""libtpu device backend — the NVML-replacement path (SURVEY.md §1 L1, §2.3).
+
+Adapter over ``libtpu.sdk.tpumonitoring`` (runtime metrics),
+``libtpu.sdk.slice`` (chip coordinates, consumed via discovery), and
+``libtpu.sdk.tpuz`` (core state). Where the reference genre does ctypes FFI
+into ``libnvidia-ml.so``, this consumes the libtpu wheel's shipped SDK over
+its native ``.so`` (surface verified live on libtpu 0.0.34, SURVEY.md §2.2).
+
+Operational facts encoded here, all observed live:
+
+- ``get_metric(name).data()`` returns ``[]`` for every metric when no
+  runtime/workload is attached to the TPU — that is a valid "no sample"
+  state, not an error and not zero.
+- ``slice.get_chip_coordinates()`` can raise ``RuntimeError`` on hosts whose
+  hostname carries no worker index; discovery treats coords as optional.
+- ``tpuz.get_core_state_summary()`` dials the local monitoring gRPC port
+  (127.0.0.1:8431) and raises when the runtime is down; the core-state
+  collector degrades to absent.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tpumon.backends.base import BackendError, RawMetric
+from tpumon.discovery.topology import Topology, discover
+
+log = logging.getLogger(__name__)
+
+
+class LibtpuBackend:
+    name = "libtpu"
+
+    def __init__(self, topology_file: str | None = None) -> None:
+        try:
+            from libtpu.sdk import tpumonitoring
+        except Exception as exc:  # ImportError or native-load failure
+            raise BackendError(f"libtpu SDK unavailable: {exc}") from exc
+        self._mon = tpumonitoring
+        self._topology = discover(topology_file)
+
+    def list_metrics(self) -> tuple[str, ...]:
+        try:
+            return tuple(self._mon.list_supported_metrics())
+        except Exception as exc:
+            raise BackendError(f"list_supported_metrics failed: {exc}") from exc
+
+    def sample(self, name: str) -> RawMetric:
+        try:
+            data = self._mon.get_metric(name).data()
+        except Exception as exc:
+            raise BackendError(f"get_metric({name}) failed: {exc}") from exc
+        if data is None:
+            return RawMetric(name, ())
+        return RawMetric(name, tuple(str(entry) for entry in data))
+
+    def core_states(self) -> dict[str, str]:
+        """Per-core state via tpuz; empty dict when the runtime is down."""
+        try:
+            from libtpu.sdk import tpuz
+
+            summary = tpuz.get_core_state_summary()
+        except Exception as exc:
+            log.debug("core state unavailable: %s", exc)
+            return {}
+        if isinstance(summary, dict):
+            return {str(k): str(v) for k, v in summary.items()}
+        return {"summary": str(summary)}
+
+    def topology(self) -> Topology:
+        return self._topology
+
+    def version(self) -> str:
+        try:
+            import importlib.metadata as md
+
+            return md.version("libtpu")
+        except Exception:
+            return "unknown"
+
+    def close(self) -> None:
+        pass

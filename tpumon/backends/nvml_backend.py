@@ -1,0 +1,140 @@
+"""NVML schema-compat backend (BASELINE.json config 5: mixed GPU+TPU pool).
+
+A thin GPU path so one DaemonSet + one Grafana dashboard serves a mixed
+node pool: NVML device queries are re-emitted in the **libtpu wire formats**
+(per-device string vectors) under the same source-metric names, so the
+existing parser and the unified ``accelerator_*`` schema apply unchanged:
+
+- GPU utilization      → ``duty_cycle_pct``   → accelerator_duty_cycle_percent
+- SM occupancy proxy   → ``tensorcore_util``  → accelerator_core_utilization_percent
+- framebuffer total    → ``hbm_capacity_total`` → accelerator_memory_total_bytes
+- framebuffer used     → ``hbm_capacity_usage`` → accelerator_memory_used_bytes
+- clock-throttle state → ``tpu_throttle_score`` → accelerator_throttle_score
+
+``pynvml`` is not part of this image; the backend is import-gated and
+raises BackendError at construction when NVML is absent (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+
+from tpumon.backends.base import BackendError, RawMetric
+from tpumon.discovery.topology import Chip, Topology
+
+log = logging.getLogger(__name__)
+
+#: libtpu-style source names this backend can emit (subset of the schema).
+NVML_METRICS: tuple[str, ...] = (
+    "duty_cycle_pct",
+    "tensorcore_util",
+    "hbm_capacity_total",
+    "hbm_capacity_usage",
+    "tpu_throttle_score",
+)
+
+
+class NvmlBackend:
+    name = "nvml"
+
+    def __init__(self) -> None:
+        try:
+            import pynvml
+        except ImportError as exc:
+            raise BackendError(
+                "pynvml not installed — the nvml backend only applies to "
+                "GPU nodes of a mixed pool"
+            ) from exc
+        try:
+            pynvml.nvmlInit()
+        except Exception as exc:
+            raise BackendError(f"nvmlInit failed: {exc}") from exc
+        self._nv = pynvml
+        self._handles = []
+        count = pynvml.nvmlDeviceGetCount()
+        for i in range(count):
+            self._handles.append(pynvml.nvmlDeviceGetHandleByIndex(i))
+
+    def list_metrics(self) -> tuple[str, ...]:
+        return NVML_METRICS
+
+    def sample(self, name: str) -> RawMetric:
+        nv = self._nv
+        try:
+            if name == "duty_cycle_pct":
+                data = tuple(
+                    f"{nv.nvmlDeviceGetUtilizationRates(h).gpu:.2f}"
+                    for h in self._handles
+                )
+            elif name == "tensorcore_util":
+                data = tuple(
+                    f"{nv.nvmlDeviceGetUtilizationRates(h).gpu:.2f}"
+                    for h in self._handles
+                )
+            elif name == "hbm_capacity_total":
+                data = tuple(
+                    str(nv.nvmlDeviceGetMemoryInfo(h).total) for h in self._handles
+                )
+            elif name == "hbm_capacity_usage":
+                data = tuple(
+                    str(nv.nvmlDeviceGetMemoryInfo(h).used) for h in self._handles
+                )
+            elif name == "tpu_throttle_score":
+                data = tuple(
+                    str(self._throttle_score(h)) for h in self._handles
+                )
+            else:
+                raise BackendError(f"unsupported metric {name}")
+        except BackendError:
+            raise
+        except Exception as exc:
+            raise BackendError(f"NVML query {name} failed: {exc}") from exc
+        return RawMetric(name, data)
+
+    def _throttle_score(self, handle) -> int:
+        """Map NVML clock-throttle reasons onto the 0-10 throttle scale."""
+        nv = self._nv
+        try:
+            reasons = nv.nvmlDeviceGetCurrentClocksThrottleReasons(handle)
+        except Exception:
+            return 0
+        benign = getattr(nv, "nvmlClocksThrottleReasonGpuIdle", 0) | getattr(
+            nv, "nvmlClocksThrottleReasonApplicationsClocksSetting", 0
+        )
+        return 10 if (reasons & ~benign) else 0
+
+    def topology(self) -> Topology:
+        nv = self._nv
+        chips = []
+        for i, h in enumerate(self._handles):
+            uuid = ""
+            try:
+                raw = nv.nvmlDeviceGetUUID(h)
+                uuid = raw.decode() if isinstance(raw, bytes) else str(raw)
+            except Exception:
+                pass
+            chips.append(Chip(index=i, num_cores=1, device_id=uuid))
+        try:
+            raw_name = nv.nvmlDeviceGetName(self._handles[0]) if chips else "gpu"
+            accel = raw_name.decode() if isinstance(raw_name, bytes) else str(raw_name)
+        except Exception:
+            accel = "gpu"
+        return Topology(
+            accelerator_type=accel,
+            hostname=socket.gethostname(),
+            chips=tuple(chips),
+        )
+
+    def version(self) -> str:
+        try:
+            raw = self._nv.nvmlSystemGetDriverVersion()
+            return raw.decode() if isinstance(raw, bytes) else str(raw)
+        except Exception:
+            return "unknown"
+
+    def close(self) -> None:
+        try:
+            self._nv.nvmlShutdown()
+        except Exception:
+            pass

@@ -1,0 +1,39 @@
+"""Stub backend: zero accelerators (BASELINE.json config 1).
+
+The exporter must run on CPU-only nodes of a mixed pool and expose
+``accelerator_device_count 0`` plus its self-telemetry, never crashing for
+lack of a device (SURVEY.md §3.1 'fallback: zero devices → stub mode').
+"""
+
+from __future__ import annotations
+
+import socket
+
+from tpumon.backends.base import RawMetric
+from tpumon.discovery.topology import Topology
+
+
+class StubBackend:
+    name = "stub"
+
+    def __init__(self, topology: Topology | None = None) -> None:
+        self._topology = topology or Topology(
+            accelerator_type="none", hostname=socket.gethostname(), chips=()
+        )
+
+    def list_metrics(self) -> tuple[str, ...]:
+        return ()
+
+    def sample(self, name: str) -> RawMetric:
+        return RawMetric(name, ())
+
+    def topology(self) -> Topology:
+        return self._topology
+
+    def version(self) -> str:
+        from tpumon import __version__
+
+        return __version__
+
+    def close(self) -> None:
+        pass

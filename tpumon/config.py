@@ -1,0 +1,153 @@
+"""Env-first configuration with argparse overrides.
+
+K8s-native precedence (SURVEY.md §5.6): every knob is an ``TPUMON_*``
+environment variable (the natural way to configure a DaemonSet pod via
+``env:`` / ConfigMap), and every knob has a CLI flag that wins over the
+environment. Defaults are the 1 Hz / :9400 targets from BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+from dataclasses import dataclass
+
+
+ENV_PREFIX = "TPUMON_"
+
+#: Backends selectable via --backend / TPUMON_BACKEND.
+#: "auto" picks libtpu when importable and devices are present, else stub.
+BACKEND_CHOICES = ("auto", "libtpu", "grpc", "fake", "stub", "nvml")
+
+
+def _env(name: str, default: str | None = None) -> str | None:
+    return os.environ.get(ENV_PREFIX + name, default)
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = _env(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        # Malformed env must never CrashLoopBackOff the DaemonSet.
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = _env(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    raw = _env(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _split_csv(raw: str | None) -> tuple[str, ...]:
+    if not raw:
+        return ()
+    return tuple(p.strip() for p in raw.split(",") if p.strip())
+
+
+@dataclass(frozen=True)
+class Config:
+    """Immutable run configuration for the exporter and sidecar."""
+
+    #: TCP port for the Prometheus /metrics endpoint.
+    port: int = 9400
+    #: Bind address for the HTTP server.
+    addr: str = "0.0.0.0"
+    #: Poll interval in seconds (1.0 == the 1 Hz BASELINE target).
+    interval: float = 1.0
+    #: Which device backend to use (see BACKEND_CHOICES).
+    backend: str = "auto"
+    #: Allow-list of libtpu metric names; empty = all supported.
+    metric_allow: tuple[str, ...] = ()
+    #: Deny-list of libtpu metric names; applied after the allow-list.
+    metric_deny: tuple[str, ...] = ()
+    #: Optional JSON file overriding discovered topology (tests, air-gapped).
+    topology_file: str | None = None
+    #: Fake backend topology preset (see tpumon.backends.fake.TOPOLOGIES).
+    fake_topology: str = "v5e-16"
+    #: gRPC monitoring service address (libtpu runtime default port).
+    grpc_addr: str = "localhost:8431"
+    #: gRPC request timeout in seconds.
+    grpc_timeout: float = 2.0
+    #: Emit per-link ICI gauges (can be high-cardinality on big slices).
+    ici_per_link: bool = True
+    #: Log level name.
+    log_level: str = "INFO"
+    #: Path where the discovery sidecar writes topology JSON.
+    topology_out: str = "/var/run/tpumon/topology.json"
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        base = cls()
+        return cls(
+            port=_env_int("PORT", base.port),
+            addr=_env("ADDR", base.addr) or base.addr,
+            interval=_env_float("INTERVAL", base.interval),
+            backend=_env("BACKEND", base.backend) or base.backend,
+            metric_allow=_split_csv(_env("METRIC_ALLOW")),
+            metric_deny=_split_csv(_env("METRIC_DENY")),
+            topology_file=_env("TOPOLOGY_FILE"),
+            fake_topology=_env("FAKE_TOPOLOGY", base.fake_topology)
+            or base.fake_topology,
+            grpc_addr=_env("GRPC_ADDR", base.grpc_addr) or base.grpc_addr,
+            grpc_timeout=_env_float("GRPC_TIMEOUT", base.grpc_timeout),
+            ici_per_link=_env_bool("ICI_PER_LINK", base.ici_per_link),
+            log_level=_env("LOG_LEVEL", base.log_level) or base.log_level,
+            topology_out=_env("TOPOLOGY_OUT", base.topology_out)
+            or base.topology_out,
+        )
+
+    @classmethod
+    def add_args(cls, parser: argparse.ArgumentParser) -> None:
+        g = parser.add_argument_group("tpumon")
+        g.add_argument("--port", type=int, help="HTTP port for /metrics")
+        g.add_argument("--addr", help="bind address")
+        g.add_argument("--interval", type=float, help="poll interval seconds")
+        g.add_argument("--backend", choices=BACKEND_CHOICES, help="device backend")
+        g.add_argument("--metric-allow", help="CSV allow-list of metric names")
+        g.add_argument("--metric-deny", help="CSV deny-list of metric names")
+        g.add_argument("--topology-file", help="JSON topology override")
+        g.add_argument("--fake-topology", help="fake backend topology preset")
+        g.add_argument("--grpc-addr", help="monitoring gRPC address")
+        g.add_argument("--grpc-timeout", type=float, help="gRPC timeout seconds")
+        g.add_argument("--log-level", help="log level")
+        g.add_argument("--topology-out", help="sidecar topology JSON path")
+
+    def with_args(self, args: argparse.Namespace) -> "Config":
+        updates: dict = {}
+        for f in dataclasses.fields(self):
+            cli_name = f.name.replace("-", "_")
+            val = getattr(args, cli_name, None)
+            if val is None:
+                continue
+            if f.name in ("metric_allow", "metric_deny") and isinstance(val, str):
+                val = _split_csv(val)
+            updates[f.name] = val
+        return dataclasses.replace(self, **updates)
+
+    @classmethod
+    def load(cls, argv: list[str] | None = None) -> "Config":
+        """Environment first, CLI flags override (SURVEY.md §5.6)."""
+        parser = argparse.ArgumentParser(prog="tpumon")
+        cls.add_args(parser)
+        args = parser.parse_args(argv)
+        return cls.from_env().with_args(args)
+
+    def metric_enabled(self, name: str) -> bool:
+        if self.metric_allow and name not in self.metric_allow:
+            return False
+        return name not in self.metric_deny

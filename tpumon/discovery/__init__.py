@@ -1,0 +1,3 @@
+from tpumon.discovery.topology import Chip, Topology, discover
+
+__all__ = ["Chip", "Topology", "discover"]

@@ -1,0 +1,124 @@
+"""Device-discovery sidecar (SURVEY.md §3.4, §1 L2).
+
+The TPU-native replacement for the reference genre's PCIe-BDF discovery
+sidecar: discovers slice topology (host/chip/core + coords), writes it as
+JSON to a shared volume for other containers in the pod, and exposes an
+``accelerator_info`` identity gauge on its own ``/metrics``.
+
+Runs alongside the exporter in the DaemonSet pod (deploy/daemonset.yaml).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+import time
+
+from prometheus_client.registry import CollectorRegistry
+
+from tpumon.config import Config
+from tpumon.discovery.topology import Topology, discover
+from tpumon.exporter.collector import topology_families
+
+log = logging.getLogger(__name__)
+
+
+class _TopologyCollector:
+    """Prometheus collector over the most recent discovery result.
+
+    Reuses the exporter's identity-family construction so the sidecar and
+    exporter can never drift on schema/labels.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._topology = Topology()
+
+    def update(self, topology: Topology) -> None:
+        with self._lock:
+            self._topology = topology
+
+    def collect(self):
+        with self._lock:
+            topo = self._topology
+        yield from topology_families(topo)
+
+
+def write_topology(topology: Topology, path: str) -> None:
+    """Atomically write topology JSON for pod-mates (shared emptyDir)."""
+    directory = os.path.dirname(path) or "."
+    os.makedirs(directory, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(topology.to_json())
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="tpumon-discovery")
+    Config.add_args(parser)
+    parser.add_argument(
+        "--once", action="store_true", help="discover, write JSON, exit"
+    )
+    parser.add_argument(
+        "--refresh",
+        type=float,
+        default=60.0,
+        help="re-discovery interval seconds (topology rarely changes)",
+    )
+    args = parser.parse_args(argv)
+    cfg = Config.from_env().with_args(args)
+    logging.basicConfig(
+        level=getattr(logging, cfg.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+
+    topo = discover(cfg.topology_file)
+    write_topology(topo, cfg.topology_out)
+    log.info(
+        "discovered %d chips (%s) → %s",
+        topo.num_chips,
+        topo.accelerator_type,
+        cfg.topology_out,
+    )
+    if args.once:
+        return 0
+
+    collector = _TopologyCollector()
+    collector.update(topo)
+    registry = CollectorRegistry()
+    registry.register(collector)
+
+    from tpumon.exporter.server import ExporterServer, _make_app
+    from tpumon.exporter.telemetry import SelfTelemetry
+
+    # Same registry that is served, so the sidecar's own scrape-duration
+    # and liveness gauges are actually visible to Prometheus.
+    telemetry = SelfTelemetry(registry)
+    telemetry.last_poll.set(time.time())
+    app = _make_app(registry, telemetry, lambda: (True, "ok\n"))
+    server = ExporterServer(app, cfg.addr, cfg.port)
+    server.start()
+    log.info("discovery sidecar serving %s/metrics", server.url)
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    try:
+        while not stop.wait(timeout=args.refresh):
+            topo = discover(cfg.topology_file)
+            collector.update(topo)
+            write_topology(topo, cfg.topology_out)
+            telemetry.last_poll.set(time.time())
+    finally:
+        server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

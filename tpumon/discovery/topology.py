@@ -1,0 +1,239 @@
+"""TPU slice-topology discovery (SURVEY.md §1 L2, §3.4).
+
+Replaces the reference genre's PCIe-BDF device identity with TPU-native
+identity: slice / host / chip / core plus physical chip coordinates.
+
+Sources, in precedence order:
+
+1. An explicit topology JSON file (``--topology-file``) — used by tests and
+   air-gapped deployments.
+2. GKE TPU environment variables (``TPU_WORKER_ID``,
+   ``TPU_WORKER_HOSTNAMES``, ``TPU_ACCELERATOR_TYPE``, ``TPU_CHIPS_PER_HOST_BOUNDS``
+   / ``TPU_HOST_BOUNDS``) — present in pods on ``google.com/tpu`` node pools.
+3. ``libtpu.sdk.slice.get_chip_coordinates()`` for physical coords — the
+   live probe in SURVEY.md §2.2 shows this raises ``RuntimeError`` when the
+   hostname carries no worker index, so it is strictly best-effort.
+4. JAX local device enumeration (chip count + platform), when importable.
+5. Zero devices → the exporter runs in stub mode (BASELINE.json config 1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import socket
+from dataclasses import dataclass
+
+log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class Chip:
+    """One accelerator chip on this host."""
+
+    index: int
+    #: Physical coordinates in the slice mesh (x, y, z), if known.
+    coords: tuple[int, int, int] | None = None
+    #: Number of compute cores (TensorCores) on the chip.
+    num_cores: int = 1
+    #: Stable device identifier (TPU: "slice/host/chip"; GPU path: UUID).
+    device_id: str = ""
+
+
+@dataclass(frozen=True)
+class Topology:
+    """Identity of the accelerators visible to this exporter process."""
+
+    #: e.g. "v5litepod-16", "v5p-64", "v4-8"; "none" when no accelerator.
+    accelerator_type: str = "none"
+    #: Logical slice/pool name (GKE: from TPU_WORKER_HOSTNAMES prefix).
+    slice_name: str = "default"
+    hostname: str = ""
+    #: This host's worker index within the slice.
+    worker_id: int = 0
+    num_hosts: int = 1
+    chips: tuple[Chip, ...] = ()
+
+    @property
+    def num_chips(self) -> int:
+        return len(self.chips)
+
+    @property
+    def num_cores(self) -> int:
+        return sum(c.num_cores for c in self.chips)
+
+    def base_labels(self) -> dict[str, str]:
+        """Labels shared by every sample from this host (SURVEY.md §1 L3)."""
+        return {
+            "slice": self.slice_name,
+            "host": self.hostname,
+            "worker": str(self.worker_id),
+            "accelerator": self.accelerator_type,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, raw: str) -> "Topology":
+        obj = json.loads(raw)
+        chips = tuple(
+            Chip(
+                index=c["index"],
+                coords=tuple(c["coords"]) if c.get("coords") else None,
+                num_cores=c.get("num_cores", 1),
+                device_id=c.get("device_id", ""),
+            )
+            for c in obj.get("chips", ())
+        )
+        return cls(
+            accelerator_type=obj.get("accelerator_type", "none"),
+            slice_name=obj.get("slice_name", "default"),
+            hostname=obj.get("hostname", ""),
+            worker_id=obj.get("worker_id", 0),
+            num_hosts=obj.get("num_hosts", 1),
+            chips=chips,
+        )
+
+
+def _cores_per_chip(accelerator_type: str) -> int:
+    """TPU generations differ: v4/v5p chips expose 2 TensorCores, v5e/v6e 1."""
+    t = accelerator_type.lower()
+    if "v5lite" in t or "v5e" in t or "v6e" in t:
+        return 1
+    if t.startswith(("v4", "v5p", "v3", "v2")):
+        return 2
+    return 1
+
+
+def _from_file(path: str) -> Topology | None:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return Topology.from_json(fh.read())
+    except (OSError, ValueError, KeyError) as exc:
+        log.warning("topology file %s unusable: %s", path, exc)
+        return None
+
+
+def _gke_env() -> dict[str, str]:
+    keys = (
+        "TPU_WORKER_ID",
+        "TPU_WORKER_HOSTNAMES",
+        "TPU_ACCELERATOR_TYPE",
+        "TPU_CHIPS_PER_HOST_BOUNDS",
+        "TPU_HOST_BOUNDS",
+        "TPU_SKIP_MDS_QUERY",
+    )
+    return {k: os.environ[k] for k in keys if k in os.environ}
+
+
+def _chips_from_bounds(bounds: str) -> int:
+    # "2,2,1" -> 4 chips on this host.
+    try:
+        n = 1
+        for part in bounds.split(","):
+            n *= int(part)
+        return max(n, 0)
+    except ValueError:
+        return 0
+
+
+def _libtpu_coords(num_chips: int) -> list[tuple[int, int, int] | None]:
+    """Best-effort physical coords via libtpu.sdk.slice (SURVEY.md §3.4)."""
+    try:
+        from libtpu.sdk import slice as tpu_slice  # type: ignore
+
+        cc = tpu_slice.get_chip_coordinates()
+        coords = getattr(cc, "coordinates", None) or list(cc)  # duck-typed
+        out: list[tuple[int, int, int] | None] = []
+        for c in coords[:num_chips]:
+            tup = tuple(int(v) for v in c)
+            out.append((tup + (0, 0, 0))[:3])  # pad to 3-D
+        while len(out) < num_chips:
+            out.append(None)
+        return out
+    except Exception as exc:  # RuntimeError observed live on 1-host (§2.2)
+        log.debug("chip coordinates unavailable: %s", exc)
+        return [None] * num_chips
+
+
+def _jax_chip_count() -> tuple[int, str]:
+    """Fallback enumeration via JAX local devices. Returns (chips, platform)."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+        platform = devices[0].platform if devices else "none"
+        if platform != "tpu":
+            return 0, platform
+        chip_ids = {getattr(d, "id", i) for i, d in enumerate(devices)}
+        return len(chip_ids), platform
+    except Exception as exc:
+        log.debug("jax enumeration unavailable: %s", exc)
+        return 0, "none"
+
+
+def discover(topology_file: str | None = None) -> Topology:
+    """Build the host's Topology from the best available source."""
+    if topology_file:
+        topo = _from_file(topology_file)
+        if topo is not None:
+            return topo
+
+    hostname = socket.gethostname()
+    env = _gke_env()
+
+    accel = env.get("TPU_ACCELERATOR_TYPE", "")
+    try:
+        worker_id = int(env.get("TPU_WORKER_ID", "0") or 0)
+    except ValueError:
+        # e.g. TPU_WORKER_ID="worker-0": keep the digits, else 0 — discovery
+        # must never crash the exporter over a malformed env var.
+        digits = "".join(ch for ch in env.get("TPU_WORKER_ID", "") if ch.isdigit())
+        worker_id = int(digits) if digits else 0
+    worker_hosts = [
+        h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h.strip()
+    ]
+    num_hosts = max(len(worker_hosts), 1)
+    slice_name = os.environ.get(
+        "TPUMON_SLICE_NAME",
+        (worker_hosts[0].split(".")[0].rsplit("-", 1)[0] if worker_hosts else "default"),
+    )
+
+    num_chips = _chips_from_bounds(env.get("TPU_CHIPS_PER_HOST_BOUNDS", ""))
+    if num_chips == 0:
+        num_chips, platform = _jax_chip_count()
+        if num_chips and not accel:
+            accel = f"tpu-{platform}"
+
+    if num_chips == 0:
+        return Topology(
+            accelerator_type="none",
+            slice_name=slice_name,
+            hostname=hostname,
+            worker_id=worker_id,
+            num_hosts=num_hosts,
+            chips=(),
+        )
+
+    cores = _cores_per_chip(accel)
+    coords = _libtpu_coords(num_chips)
+    chips = tuple(
+        Chip(
+            index=i,
+            coords=coords[i],
+            num_cores=cores,
+            device_id=f"{slice_name}/{worker_id}/{i}",
+        )
+        for i in range(num_chips)
+    )
+    return Topology(
+        accelerator_type=accel or "tpu",
+        slice_name=slice_name,
+        hostname=hostname,
+        worker_id=worker_id,
+        num_hosts=num_hosts,
+        chips=chips,
+    )

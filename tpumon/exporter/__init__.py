@@ -1,0 +1,11 @@
+from tpumon.exporter.collector import CachedCollector, Poller, SampleCache, build_families
+from tpumon.exporter.server import ExporterServer, build_exporter
+
+__all__ = [
+    "CachedCollector",
+    "Poller",
+    "SampleCache",
+    "build_families",
+    "ExporterServer",
+    "build_exporter",
+]

@@ -1,0 +1,278 @@
+"""Poll loop, sample cache, and the cached Prometheus collector.
+
+The design rule distilled from the p99-scrape-latency headline
+(SURVEY.md §3.2): **device queries live only in the poll loop; the scrape
+path reads an immutable cached snapshot**. The two threads share exactly one
+reference, swapped atomically under a lock (SURVEY.md §5.2).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+from prometheus_client.core import GaugeMetricFamily
+from prometheus_client.metrics_core import Metric
+
+from tpumon.backends.base import Backend, BackendError
+from tpumon.config import Config
+from tpumon.exporter.telemetry import SelfTelemetry
+from tpumon.parsing import parse
+from tpumon.schema import coverage, spec_for
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class PollStats:
+    backend_errors: int = 0
+    parse_errors: int = 0
+    families: int = 0
+    points: int = 0
+    unmapped: tuple[str, ...] = ()
+    coverage: float = 1.0
+
+
+class SampleCache:
+    """Atomic snapshot holder shared by the poller and HTTP threads."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._snapshot: tuple[Metric, ...] = ()
+
+    def publish(self, families: list[Metric]) -> None:
+        snap = tuple(families)
+        with self._lock:
+            self._snapshot = snap
+
+    def snapshot(self) -> tuple[Metric, ...]:
+        with self._lock:
+            return self._snapshot
+
+
+class CachedCollector:
+    """prometheus_client custom collector that only reads the cache.
+
+    Registered into the CollectorRegistry; ``collect()`` MUST NOT touch the
+    device backend (SURVEY.md §3.2 'MUST NOT call libtpu').
+    """
+
+    def __init__(self, cache: SampleCache) -> None:
+        self._cache = cache
+
+    def collect(self):
+        return self._cache.snapshot()
+
+
+def topology_families(topo) -> list[Metric]:
+    """Identity families for a topology — shared by exporter and sidecar."""
+    base = topo.base_labels()
+    return _topology_families(topo, tuple(base), tuple(base.values()))
+
+
+def _topology_families(topo, base_keys, base_vals) -> list[Metric]:
+    count = GaugeMetricFamily(
+        "accelerator_device_count",
+        "Number of accelerator chips visible to this exporter "
+        "(0 on CPU-only nodes — BASELINE config 1).",
+        labels=base_keys,
+    )
+    count.add_metric(base_vals, topo.num_chips)
+
+    cores = GaugeMetricFamily(
+        "accelerator_core_count",
+        "Number of accelerator compute cores visible to this exporter.",
+        labels=base_keys,
+    )
+    cores.add_metric(base_vals, topo.num_cores)
+
+    hosts = GaugeMetricFamily(
+        "accelerator_slice_host_count",
+        "Number of hosts in this accelerator slice.",
+        labels=base_keys,
+    )
+    hosts.add_metric(base_vals, topo.num_hosts)
+
+    info = GaugeMetricFamily(
+        "accelerator_info",
+        "Per-chip identity: slice/host/chip plus physical coords — the "
+        "TPU-native replacement for PCIe-BDF identity (SURVEY.md §3.4).",
+        labels=base_keys + ("chip", "coords", "device_id", "cores"),
+    )
+    for chip in topo.chips:
+        coords = ",".join(str(c) for c in chip.coords) if chip.coords else ""
+        info.add_metric(
+            base_vals
+            + (str(chip.index), coords, chip.device_id, str(chip.num_cores)),
+            1.0,
+        )
+    return [count, cores, hosts, info]
+
+
+def build_families(backend: Backend, cfg: Config) -> tuple[list[Metric], PollStats]:
+    """One poll cycle: query every enabled metric, parse, build families.
+
+    Runs only on the poller thread. Every failure mode degrades to a
+    dropped sample plus a counter increment (SURVEY.md §5.3).
+    """
+    stats = PollStats()
+    topo = backend.topology()
+    base = topo.base_labels()
+    base_keys = tuple(base)
+    base_vals = tuple(base.values())
+    families: list[Metric] = _topology_families(topo, base_keys, base_vals)
+
+    list_failed = False
+    try:
+        supported = tuple(backend.list_metrics())
+    except Exception as exc:
+        log.warning("list_metrics failed: %s", exc)
+        stats.backend_errors += 1
+        supported = ()
+        list_failed = True
+
+    # A failed enumeration is 0% coverage, not a vacuous 100%: an alert on
+    # the coverage gauge must fire during exactly this outage.
+    stats.coverage = 0.0 if list_failed else coverage(supported)
+    unmapped = []
+
+    for name in supported:
+        if not cfg.metric_enabled(name):
+            continue
+        if name == "ici_link_health" and not cfg.ici_per_link:
+            continue  # skip before the device query, not after
+        spec = spec_for(name)
+        if spec is None:
+            unmapped.append(name)
+            continue
+        try:
+            raw = backend.sample(name)
+        except BackendError as exc:
+            log.debug("sample(%s) failed: %s", name, exc)
+            stats.backend_errors += 1
+            continue
+        except Exception as exc:  # never let a device bug kill the poller
+            log.warning("sample(%s) raised unexpectedly: %s", name, exc)
+            stats.backend_errors += 1
+            continue
+
+        result = parse(raw, spec)
+        stats.parse_errors += result.errors
+        if result.empty:
+            # Runtime-detached / no data: family absent, not zero
+            # (SURVEY.md §2.2 caveat).
+            continue
+
+        fam = GaugeMetricFamily(
+            spec.family, spec.help, labels=base_keys + spec.label_keys
+        )
+        for point in result.points:
+            fam.add_metric(
+                base_vals
+                + tuple(point.labels.get(k, "") for k in spec.label_keys),
+                point.value,
+            )
+        families.append(fam)
+        stats.points += len(result.points)
+
+    # Per-core state via the tpuz surface (SURVEY.md §2.2) — optional on the
+    # protocol; degrades to absent when the runtime is down.
+    core_states = getattr(backend, "core_states", None)
+    if core_states is not None:
+        try:
+            states = core_states()
+        except Exception as exc:
+            log.debug("core_states failed: %s", exc)
+            states = {}
+        if states:
+            fam = GaugeMetricFamily(
+                "accelerator_core_state",
+                "Per-core runtime state reported by the device monitoring "
+                "service (value is 1; state in the label).",
+                labels=base_keys + ("core", "state"),
+            )
+            for core, state in states.items():
+                fam.add_metric(base_vals + (str(core), str(state)), 1.0)
+            families.append(fam)
+
+    stats.unmapped = tuple(unmapped)
+    stats.families = len(families)
+    if unmapped:
+        log.debug("unmapped device metrics (coverage gap): %s", unmapped)
+    return families, stats
+
+
+class Poller:
+    """The 1 Hz poll thread (SURVEY.md §3.1-3.2)."""
+
+    def __init__(
+        self,
+        backend: Backend,
+        cfg: Config,
+        cache: SampleCache,
+        telemetry: SelfTelemetry,
+    ) -> None:
+        self._backend = backend
+        self._cfg = cfg
+        self._cache = cache
+        self._telemetry = telemetry
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="tpumon-poller", daemon=True
+        )
+        self.last_stats: PollStats = PollStats()
+
+    def poll_once(self) -> PollStats:
+        t0 = time.monotonic()
+        # Backends with a time dimension (the fake) advance one step per
+        # poll cycle so live data evolves; real backends don't define this.
+        advance = getattr(self._backend, "advance", None)
+        if advance is not None:
+            advance()
+        families, stats = build_families(self._backend, self._cfg)
+        self._cache.publish(families)
+        elapsed = time.monotonic() - t0
+
+        t = self._telemetry
+        t.poll_duration.observe(elapsed)
+        if stats.backend_errors:
+            t.poll_errors.labels(kind="backend").inc(stats.backend_errors)
+        if stats.parse_errors:
+            t.poll_errors.labels(kind="parse").inc(stats.parse_errors)
+        t.polls.inc()
+        t.last_poll.set(time.time())
+        t.poll_lag.set(max(0.0, elapsed - self._cfg.interval))
+        t.coverage.set(stats.coverage)
+        self.last_stats = stats
+        return stats
+
+    def start(self) -> None:
+        # Prime the cache synchronously so the first scrape is never empty.
+        self.poll_once()
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+
+    def _run(self) -> None:
+        interval = self._cfg.interval
+        next_tick = time.monotonic() + interval
+        while not self._stop.is_set():
+            delay = next_tick - time.monotonic()
+            if delay > 0 and self._stop.wait(timeout=delay):
+                break
+            next_tick += interval
+            try:
+                self.poll_once()
+            except Exception:
+                # Last-ditch guard: the poller thread must never die.
+                log.exception("poll cycle failed")
+                self._telemetry.poll_errors.labels(kind="backend").inc()
+            # If we overran badly, resynchronize rather than burst-poll.
+            now = time.monotonic()
+            if next_tick < now:
+                next_tick = now + interval

@@ -1,0 +1,46 @@
+"""DaemonSet container entrypoint (SURVEY.md §3.1).
+
+``python -m tpumon.exporter.main`` (or the ``tpumon-exporter`` console
+script): load config → pick backend → prime cache → serve until SIGTERM.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+import sys
+
+from tpumon.config import Config
+from tpumon.exporter.server import build_exporter
+
+log = logging.getLogger(__name__)
+
+
+def main(argv: list[str] | None = None) -> int:
+    cfg = Config.load(argv)
+    logging.basicConfig(
+        level=getattr(logging, cfg.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+
+    exporter = build_exporter(cfg)
+    stop = threading.Event()
+
+    def _signal(signum, frame) -> None:
+        log.info("received signal %s, shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _signal)
+    signal.signal(signal.SIGINT, _signal)
+
+    exporter.start()
+    try:
+        stop.wait()
+    finally:
+        exporter.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

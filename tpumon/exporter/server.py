@@ -1,0 +1,164 @@
+"""HTTP scrape plane (SURVEY.md §1 L4, §3.2).
+
+A threading WSGI server exposing ``/metrics`` (Prometheus text exposition)
+plus ``/healthz`` (K8s liveness: fails when the poll loop stalls). Scrape
+timing is measured by middleware around the exposition app and feeds the
+``exporter_scrape_duration_seconds`` headline histogram.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+from socketserver import ThreadingMixIn
+from wsgiref.simple_server import WSGIRequestHandler, WSGIServer, make_server
+
+from prometheus_client import exposition
+from prometheus_client.registry import CollectorRegistry
+
+from tpumon.backends.base import Backend
+from tpumon.config import Config
+from tpumon.exporter.collector import CachedCollector, Poller, SampleCache
+from tpumon.exporter.telemetry import SelfTelemetry
+
+log = logging.getLogger(__name__)
+
+#: /healthz fails if no poll completed within this many intervals.
+HEALTH_STALE_INTERVALS = 5.0
+
+
+class _Handler(WSGIRequestHandler):
+    def log_message(self, *args) -> None:  # keep scrape noise out of logs
+        pass
+
+
+class _ThreadingWSGIServer(ThreadingMixIn, WSGIServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    address_family = socket.AF_INET
+
+
+def _make_app(registry: CollectorRegistry, telemetry: SelfTelemetry, health):
+    metrics_app = exposition.make_wsgi_app(registry)
+
+    def app(environ, start_response):
+        path = environ.get("PATH_INFO", "/")
+        if path in ("/healthz", "/livez", "/readyz"):
+            ok, detail = health()
+            status = "200 OK" if ok else "503 Service Unavailable"
+            body = detail.encode()
+            start_response(
+                status,
+                [
+                    ("Content-Type", "text/plain; charset=utf-8"),
+                    ("Content-Length", str(len(body))),
+                ],
+            )
+            return [body]
+        if path in ("/metrics", "/"):
+            t0 = time.perf_counter()
+            try:
+                return metrics_app(environ, start_response)
+            finally:
+                telemetry.scrape_duration.observe(time.perf_counter() - t0)
+        body = b"not found; try /metrics or /healthz\n"
+        start_response(
+            "404 Not Found",
+            [
+                ("Content-Type", "text/plain; charset=utf-8"),
+                ("Content-Length", str(len(body))),
+            ],
+        )
+        return [body]
+
+    return app
+
+
+class ExporterServer:
+    """Owns the WSGI server thread; ``port`` is resolved after bind
+    (port 0 → ephemeral, used heavily by tests)."""
+
+    def __init__(self, app, addr: str, port: int) -> None:
+        self._httpd = make_server(
+            addr, port, app, server_class=_ThreadingWSGIServer, handler_class=_Handler
+        )
+        self.addr = addr
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="tpumon-http",
+            daemon=True,
+        )
+        self._started = False
+
+    @property
+    def url(self) -> str:
+        host = "127.0.0.1" if self.addr in ("0.0.0.0", "") else self.addr
+        return f"http://{host}:{self.port}"
+
+    def start(self) -> None:
+        self._thread.start()
+        self._started = True
+
+    def close(self) -> None:
+        # shutdown() waits on an event only serve_forever() sets; calling it
+        # on a never-started server would deadlock the failure path.
+        if self._started:
+            self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class Exporter:
+    """Fully wired exporter: backend + poller + registry + HTTP server."""
+
+    def __init__(self, cfg: Config, backend: Backend) -> None:
+        self.cfg = cfg
+        self.backend = backend
+        self.registry = CollectorRegistry()
+        self.telemetry = SelfTelemetry(self.registry)
+        self.cache = SampleCache()
+        self.registry.register(CachedCollector(self.cache))
+        self.poller = Poller(backend, cfg, self.cache, self.telemetry)
+        version_fn = getattr(backend, "version", None)
+        self.telemetry.backend_info.labels(
+            backend=backend.name,
+            version=version_fn() if version_fn else "unknown",
+        ).set(1)
+        app = _make_app(self.registry, self.telemetry, self._health)
+        self.server = ExporterServer(app, cfg.addr, cfg.port)
+
+    def _health(self) -> tuple[bool, str]:
+        last = self.telemetry.last_poll._value.get()
+        if last == 0:
+            return False, "no poll completed yet\n"
+        age = time.time() - last
+        budget = self.cfg.interval * HEALTH_STALE_INTERVALS
+        if age > budget:
+            return False, f"poll loop stale: last poll {age:.1f}s ago\n"
+        return True, "ok\n"
+
+    def start(self) -> None:
+        self.poller.start()
+        self.server.start()
+        log.info(
+            "exporter serving %s/metrics (backend=%s, interval=%.2fs)",
+            self.server.url,
+            self.backend.name,
+            self.cfg.interval,
+        )
+
+    def close(self) -> None:
+        self.server.close()
+        self.poller.stop()
+        self.backend.close()
+
+
+def build_exporter(cfg: Config, backend: Backend | None = None) -> Exporter:
+    if backend is None:
+        from tpumon.backends import create_backend
+
+        backend = create_backend(cfg)
+    return Exporter(cfg, backend)

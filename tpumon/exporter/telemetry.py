@@ -1,0 +1,86 @@
+"""Exporter self-telemetry (SURVEY.md §5.1).
+
+``exporter_scrape_duration_seconds`` is the BASELINE headline metric
+(p99 scrape latency, BASELINE.json:2); buckets are sub-millisecond-heavy
+because the scrape path only reads a cached snapshot (SURVEY.md §3.2) and
+should land far under the 1 Hz poll budget.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import Counter, Gauge, Histogram
+from prometheus_client.registry import CollectorRegistry
+
+SCRAPE_BUCKETS = (
+    0.0001,
+    0.00025,
+    0.0005,
+    0.001,
+    0.0025,
+    0.005,
+    0.01,
+    0.025,
+    0.05,
+    0.1,
+    0.25,
+    0.5,
+    1.0,
+)
+
+POLL_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+
+
+class SelfTelemetry:
+    """All exporter-about-itself metrics, bound to one registry."""
+
+    def __init__(self, registry: CollectorRegistry) -> None:
+        self.scrape_duration = Histogram(
+            "exporter_scrape_duration_seconds",
+            "Wall time to render one /metrics exposition (headline p99).",
+            buckets=SCRAPE_BUCKETS,
+            registry=registry,
+        )
+        self.poll_duration = Histogram(
+            "exporter_poll_duration_seconds",
+            "Wall time of one device poll cycle across all metric families.",
+            buckets=POLL_BUCKETS,
+            registry=registry,
+        )
+        self.poll_errors = Counter(
+            "collector_errors_total",
+            "Device-query or parse failures, by kind; samples are dropped, "
+            "the exporter never crashes on these (SURVEY.md §5.3).",
+            labelnames=("kind",),
+            registry=registry,
+        )
+        self.polls = Counter(
+            "collector_polls_total",
+            "Completed poll cycles.",
+            registry=registry,
+        )
+        self.last_poll = Gauge(
+            "collector_last_poll_timestamp_seconds",
+            "Unix time of the last completed poll cycle (liveness signal).",
+            registry=registry,
+        )
+        self.poll_lag = Gauge(
+            "collector_poll_lag_seconds",
+            "How far the last cycle overran the configured interval "
+            "(0 when keeping up).",
+            registry=registry,
+        )
+        self.coverage = Gauge(
+            "exporter_metric_coverage_ratio",
+            "Mapped fraction of the device library's supported metrics "
+            "(BASELINE ≥0.95 target).",
+            registry=registry,
+        )
+        self.backend_info = Gauge(
+            "exporter_backend_info",
+            "Static info about the active device backend (value is 1).",
+            labelnames=("backend", "version"),
+            registry=registry,
+        )
+        # Pre-create both error kinds so the families exist from scrape #1.
+        self.poll_errors.labels(kind="backend")
+        self.poll_errors.labels(kind="parse")

@@ -1,0 +1,206 @@
+"""Raw device-metric string vectors → normalized sample points.
+
+The libtpu monitoring SDK reports every metric as a list of strings whose
+internal format varies per metric (wire formats captured live in
+SURVEY.md §2.2 and encoded as :class:`tpumon.schema.Shape`). This module is
+the single place those strings are interpreted; backends stay dumb pipes and
+the exporter core consumes typed :class:`Point` objects.
+
+Robustness contract (SURVEY.md §4.2): malformed entries are *skipped and
+counted*, never raised — a garbled row from the device library must not take
+down the exporter. Hypothesis tests fuzz this module directly.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from tpumon.backends.base import RawMetric
+from tpumon.schema import STATS, FamilySpec, KeyKind, Shape
+
+_ICI_LINK_RE = re.compile(
+    r"^tray(?P<tray>\d+)\.chip(?P<chip>\d+)\.ici(?P<port>\d+)\.(?P<dir>\w+)$"
+)
+_CORE_RE = re.compile(r"^(?:tensorcore[_-]?)?(?P<core>\d+)$")
+
+
+@dataclass(frozen=True)
+class Point:
+    """One labeled numeric sample destined for a Prometheus family."""
+
+    value: float
+    labels: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class ParseResult:
+    points: tuple[Point, ...]
+    #: Number of entries that could not be interpreted (skipped, counted).
+    errors: int = 0
+
+    @property
+    def empty(self) -> bool:
+        return not self.points
+
+
+def _to_float(token: str) -> float | None:
+    try:
+        return float(token.strip())
+    except (ValueError, AttributeError):
+        return None
+
+
+def _core_label(key: str) -> str:
+    """Normalize 'tensorcore_3' / 'tensorcore-3' / '3' → '3'."""
+    m = _CORE_RE.match(key.strip())
+    return m.group("core") if m else key.strip()
+
+
+def _key_labels(kind: KeyKind, key: str) -> dict[str, str] | None:
+    key = key.strip()
+    if kind is KeyKind.BUFFER_SIZE:
+        return {"buffer_size": key}
+    if kind is KeyKind.CORE:
+        return {"core": _core_label(key)}
+    if kind is KeyKind.BUFFER_OP:
+        # "2MB+-ALL_REDUCE" → buffer "2MB+", op "ALL_REDUCE". The op name is
+        # [A-Z_]+ so rsplit on the last '-' before an op-shaped suffix.
+        m = re.match(r"^(?P<buf>.+?)-(?P<op>[A-Za-z_]+)$", key)
+        if m:
+            return {"buffer_size": m.group("buf"), "op": m.group("op")}
+        return {"buffer_size": key, "op": "UNKNOWN"}
+    if kind is KeyKind.ICI_LINK:
+        labels = {"link": key}
+        m = _ICI_LINK_RE.match(key)
+        if m:
+            labels.update(
+                tray=m.group("tray"),
+                chip=m.group("chip"),
+                port=m.group("port"),
+                dir=m.group("dir"),
+            )
+        else:
+            labels.update(tray="", chip="", port="", dir="")
+        return labels
+    return {}
+
+
+def _indexed(raw: RawMetric, label_key: str) -> ParseResult:
+    points: list[Point] = []
+    errors = 0
+    for idx, entry in enumerate(raw.data):
+        val = _to_float(entry)
+        if val is None:
+            errors += 1
+            continue
+        points.append(Point(val, {label_key: str(idx)}))
+    return ParseResult(tuple(points), errors)
+
+
+def _keyed(raw: RawMetric, kind: KeyKind) -> ParseResult:
+    points: list[Point] = []
+    errors = 0
+    for idx, entry in enumerate(raw.data):
+        key, sep, value = entry.partition(":")
+        if sep:
+            val = _to_float(value)
+            labels = _key_labels(kind, key)
+        else:
+            # Bare numeric fallback observed nowhere yet but cheap to allow:
+            # treat position as the key.
+            val = _to_float(entry)
+            labels = (
+                {"core": str(idx)}
+                if kind is KeyKind.CORE
+                else {"link": str(idx), "tray": "", "chip": "", "port": "", "dir": ""}
+            )
+        if val is None or labels is None:
+            errors += 1
+            continue
+        points.append(Point(val, labels))
+    return ParseResult(tuple(points), errors)
+
+
+def _rows(raw: RawMetric, keyed: bool) -> tuple[list[list[str]], int]:
+    """Group the raw vector into percentile rows.
+
+    Two layouts occur in the wild and both are accepted:
+
+    - one comma-joined string per row: ``["8MB+, 1.0, 2.0, 3.0, 4.0, 5.0"]``
+    - a flat token list: ``["8MB+", "1.0", ..., "16MB+", "1.1", ...]`` where
+      a non-numeric token starts a new row (keyed shapes), or fixed-width
+      chunks of ``len(STATS)`` (plain shape).
+    """
+    errors = 0
+    if any("," in entry for entry in raw.data):
+        rows = [
+            [tok.strip() for tok in entry.split(",") if tok.strip()]
+            for entry in raw.data
+        ]
+        return [r for r in rows if r], errors
+
+    tokens = [entry.strip() for entry in raw.data if entry.strip()]
+    if not keyed:
+        width = len(STATS)
+        return [tokens[i : i + width] for i in range(0, len(tokens), width)], errors
+
+    rows: list[list[str]] = []
+    current: list[str] | None = None
+    for tok in tokens:
+        if _to_float(tok) is None:  # key token starts a row
+            current = [tok]
+            rows.append(current)
+        elif current is None:
+            errors += 1  # value before any key
+        else:
+            current.append(tok)
+    return rows, errors
+
+
+def _pctl(raw: RawMetric, kind: KeyKind) -> ParseResult:
+    keyed = kind is not KeyKind.NONE
+    rows, errors = _rows(raw, keyed)
+    points: list[Point] = []
+    for row in rows:
+        if keyed:
+            if len(row) < 2:
+                errors += 1
+                continue
+            key, values = row[0], row[1:]
+            base = _key_labels(kind, key)
+        else:
+            key, values = "", row
+            base = {}
+        if base is None:
+            errors += 1
+            continue
+        for stat, tok in zip(STATS, values):
+            val = _to_float(tok)
+            if val is None:
+                errors += 1
+                continue
+            points.append(Point(val, {**base, "stat": stat}))
+        # A short or long row is corruption either way: count, don't hide.
+        errors += abs(len(values) - len(STATS))
+    return ParseResult(tuple(points), errors)
+
+
+def parse(raw: RawMetric, spec: FamilySpec) -> ParseResult:
+    """Interpret one raw metric sample according to its schema spec.
+
+    An empty vector is the libtpu 'runtime not attached' state
+    (SURVEY.md §2.2) and yields zero points with zero errors — the family
+    is simply absent from this scrape.
+    """
+    if raw.empty:
+        return ParseResult(())
+    if spec.shape is Shape.PER_CHIP:
+        return _indexed(raw, "chip")
+    if spec.shape is Shape.PER_CORE:
+        return _indexed(raw, "core")
+    if spec.shape is Shape.KEYED:
+        return _keyed(raw, spec.key_kind)
+    if spec.shape in (Shape.PCTL_KEYED, Shape.PCTL_PLAIN):
+        return _pctl(raw, spec.key_kind)
+    raise AssertionError(f"unhandled shape {spec.shape}")

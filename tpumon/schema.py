@@ -1,0 +1,210 @@
+"""Unified ``accelerator_*`` metric schema (SURVEY.md §1 L3, §5.5).
+
+One schema serves a mixed GPU+TPU node pool (BASELINE.json config 5): each
+device-library metric (libtpu today, NVML-compat in
+:mod:`tpumon.backends.nvml_backend`) maps to a vendor-neutral Prometheus
+family, so one Grafana dashboard covers both. The wire formats encoded in
+``shape`` were captured verbatim from live
+``libtpu.sdk.tpumonitoring.get_metric(...).description()`` probes on
+libtpu 0.0.34 (SURVEY.md §2.2).
+
+Shapes:
+
+- ``PER_CHIP`` — one numeric string per chip: ``["0.00", "20.00", ...]``
+- ``PER_CORE`` — one numeric string per TensorCore
+- ``KEYED`` — ``"key: value"`` strings, e.g. ``"tray1.chip3.ici0.int: 0"``
+  (ICI links) or ``"tensorcore_0: 10"`` (HLO queue)
+- ``PCTL_KEYED`` — rows ``key, mean, p50, p90, p95, p999``; the key is a
+  buffer size (``8MB+``), ``bufsize-COLLECTIVE`` pair, or a core id
+- ``PCTL_PLAIN`` — a single ``mean, p50, p90, p95, p999`` row
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+
+class Shape(enum.Enum):
+    PER_CHIP = "per_chip"
+    PER_CORE = "per_core"
+    KEYED = "keyed"
+    PCTL_KEYED = "pctl_keyed"
+    PCTL_PLAIN = "pctl_plain"
+
+
+class KeyKind(enum.Enum):
+    """How a KEYED/PCTL_KEYED row key translates into labels."""
+
+    NONE = "none"
+    BUFFER_SIZE = "buffer_size"  # "8MB+"
+    BUFFER_OP = "buffer_op"  # "2MB+-ALL_REDUCE"
+    CORE = "core"  # "tensorcore_0"
+    ICI_LINK = "ici_link"  # "tray1.chip3.ici0.int"
+
+
+#: Percentile column names for PCTL_* shapes, in wire order.
+STATS: tuple[str, ...] = ("mean", "p50", "p90", "p95", "p999")
+
+
+@dataclass(frozen=True)
+class FamilySpec:
+    """One device metric → one Prometheus family."""
+
+    #: Device-library metric name (libtpu.sdk.tpumonitoring name).
+    source: str
+    #: Prometheus family name in the unified accelerator_* namespace.
+    family: str
+    shape: Shape
+    help: str
+    key_kind: KeyKind = KeyKind.NONE
+    #: Metric-specific label keys, beyond the host-level base labels.
+    labels: tuple[str, ...] = ()
+
+    @property
+    def label_keys(self) -> tuple[str, ...]:
+        return self.labels
+
+
+#: The 14 libtpu runtime metrics of libtpu 0.0.34 → unified families.
+#: Coverage denominator for the ≥95% BASELINE target (BASELINE.md).
+LIBTPU_SPECS: tuple[FamilySpec, ...] = (
+    FamilySpec(
+        "duty_cycle_pct",
+        "accelerator_duty_cycle_percent",
+        Shape.PER_CHIP,
+        "Percent of the sample period the accelerator was executing "
+        "(TPU duty cycle; GPU SM-activity analogue).",
+        labels=("chip",),
+    ),
+    FamilySpec(
+        "tensorcore_util",
+        "accelerator_core_utilization_percent",
+        Shape.PER_CORE,
+        "Per-core compute utilization percent (TPU TensorCore; GPU SM-util "
+        "analogue).",
+        labels=("core",),
+    ),
+    FamilySpec(
+        "hbm_capacity_total",
+        "accelerator_memory_total_bytes",
+        Shape.PER_CHIP,
+        "Total device memory per chip in bytes (TPU HBM; GPU framebuffer "
+        "analogue).",
+        labels=("chip",),
+    ),
+    FamilySpec(
+        "hbm_capacity_usage",
+        "accelerator_memory_used_bytes",
+        Shape.PER_CHIP,
+        "Allocated device memory per chip in bytes.",
+        labels=("chip",),
+    ),
+    FamilySpec(
+        "tpu_throttle_score",
+        "accelerator_throttle_score",
+        Shape.PER_CHIP,
+        "Device throttling score: 0 = none, 1-10 = throttled by 10-100% "
+        "(GPU thermal/power-throttle analogue).",
+        labels=("chip",),
+    ),
+    FamilySpec(
+        "ici_link_health",
+        "accelerator_interconnect_link_health",
+        Shape.KEYED,
+        "Interconnect link health: 0 healthy, 1-5 transient, 6-9 persistent "
+        "minor, 10 unusable (TPU ICI; GPU NVLink-error analogue).",
+        key_kind=KeyKind.ICI_LINK,
+        labels=("link", "tray", "chip", "port", "dir"),
+    ),
+    FamilySpec(
+        "hlo_queue_size",
+        "accelerator_queue_size",
+        Shape.KEYED,
+        "Enqueued-but-not-dequeued device programs per core (HLO queue "
+        "depth).",
+        key_kind=KeyKind.CORE,
+        labels=("core",),
+    ),
+    FamilySpec(
+        "hlo_execution_timing",
+        "accelerator_op_latency_microseconds",
+        Shape.PCTL_KEYED,
+        "Device program (HLO) enqueue-to-dequeue latency percentiles per "
+        "core, microseconds.",
+        key_kind=KeyKind.CORE,
+        labels=("core", "stat"),
+    ),
+    FamilySpec(
+        "collective_e2e_latency",
+        "accelerator_collective_latency_microseconds",
+        Shape.PCTL_KEYED,
+        "End-to-end collective-operation latency percentiles by buffer size "
+        "and collective type, microseconds (rides ICI intra-slice).",
+        key_kind=KeyKind.BUFFER_OP,
+        labels=("buffer_size", "op", "stat"),
+    ),
+    FamilySpec(
+        "buffer_transfer_latency",
+        "accelerator_dcn_transfer_latency_microseconds",
+        Shape.PCTL_KEYED,
+        "Cross-slice (DCN) buffer-transfer latency percentiles by buffer "
+        "size, microseconds.",
+        key_kind=KeyKind.BUFFER_SIZE,
+        labels=("buffer_size", "stat"),
+    ),
+    FamilySpec(
+        "host_to_device_transfer_latency",
+        "accelerator_h2d_transfer_latency_microseconds",
+        Shape.PCTL_KEYED,
+        "Host-to-device transfer latency percentiles by buffer size, "
+        "microseconds.",
+        key_kind=KeyKind.BUFFER_SIZE,
+        labels=("buffer_size", "stat"),
+    ),
+    FamilySpec(
+        "device_to_host_transfer_latency",
+        "accelerator_d2h_transfer_latency_microseconds",
+        Shape.PCTL_KEYED,
+        "Device-to-host transfer latency percentiles by buffer size, "
+        "microseconds.",
+        key_kind=KeyKind.BUFFER_SIZE,
+        labels=("buffer_size", "stat"),
+    ),
+    FamilySpec(
+        "tcp_min_rtt",
+        "accelerator_network_min_rtt_microseconds",
+        Shape.PCTL_PLAIN,
+        "Minimum TCP round-trip-time percentiles on the DCN path, "
+        "microseconds.",
+        labels=("stat",),
+    ),
+    FamilySpec(
+        "tcp_delivery_rate",
+        "accelerator_network_delivery_rate_mbps",
+        Shape.PCTL_PLAIN,
+        "TCP delivery-rate percentiles on the DCN path, Mbps.",
+        labels=("stat",),
+    ),
+)
+
+SPECS_BY_SOURCE: dict[str, FamilySpec] = {s.source: s for s in LIBTPU_SPECS}
+SPECS_BY_FAMILY: dict[str, FamilySpec] = {s.family: s for s in LIBTPU_SPECS}
+
+
+def spec_for(source: str) -> FamilySpec | None:
+    return SPECS_BY_SOURCE.get(source)
+
+
+def coverage(supported: tuple[str, ...] | list[str]) -> float:
+    """Fraction of the device library's supported metrics we map.
+
+    This is the BASELINE headline 'libtpu metric coverage (%)': the
+    denominator is whatever ``list_supported_metrics()`` reports at runtime,
+    so new libtpu releases that add metrics lower the score until specs are
+    added here.
+    """
+    if not supported:
+        return 1.0
+    mapped = sum(1 for name in supported if name in SPECS_BY_SOURCE)
+    return mapped / len(supported)

@@ -1,0 +1,70 @@
+"""Benchmark: exporter p99 scrape latency (the BASELINE headline metric).
+
+Measures the full HTTP scrape path (client → WSGI server → cached
+exposition) against a v5p-64-host fake backend — the largest per-host
+topology in the BASELINE config ladder, with all 14 metric families plus
+per-link ICI gauges populated — while the 1 Hz poller runs concurrently,
+exactly as in production. The poll loop and scrape path share only the
+atomic snapshot (SURVEY.md §3.2), so this is the number Prometheus sees.
+
+vs_baseline: the reference publishes no numbers (BASELINE.md: "published":
+{}), so the anchor is the 10 ms p99 scrape budget typical of the
+DCGM-exporter genre the reference belongs to; vs_baseline = 10ms / p99
+(>1 means faster than the genre budget).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.request
+
+GENRE_P99_BUDGET_MS = 10.0
+SCRAPES = 500
+
+
+def main() -> int:
+    from tpumon.backends.fake import FakeTpuBackend
+    from tpumon.config import Config
+    from tpumon.exporter.server import build_exporter
+
+    backend = FakeTpuBackend.preset("v5p-64")
+    cfg = Config(port=0, addr="127.0.0.1", interval=1.0)
+    exporter = build_exporter(cfg, backend)
+    exporter.start()
+    url = exporter.server.url + "/metrics"
+
+    try:
+        # Warm the connection path and confirm the page is fully populated.
+        body = urllib.request.urlopen(url, timeout=10).read()
+        assert b"accelerator_duty_cycle_percent" in body, "families missing"
+
+        samples_ms = []
+        for _ in range(SCRAPES):
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                resp.read()
+            samples_ms.append((time.perf_counter() - t0) * 1e3)
+
+        samples_ms.sort()
+        p99 = samples_ms[int(len(samples_ms) * 0.99) - 1]
+        print(
+            json.dumps(
+                {
+                    "metric": "exporter_p99_scrape_latency",
+                    "value": round(p99, 3),
+                    "unit": "ms",
+                    "vs_baseline": round(GENRE_P99_BUDGET_MS / p99, 2),
+                }
+            )
+        )
+        return 0
+    finally:
+        exporter.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,14 +9,23 @@ this is safe for them too.
 import os
 import sys
 
-# Force (not setdefault): the dev host presets JAX_PLATFORMS=axon (a real
-# TPU tunnel), but tests must be hermetic and run on the virtual CPU mesh.
-os.environ["JAX_PLATFORMS"] = "cpu"
+# Force the virtual CPU mesh. NOTE (probed live): this jax build ignores the
+# JAX_PLATFORMS env var when the axon TPU plugin is present — only the config
+# API sticks, and it must run before the backend initializes, hence here.
 _xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _xla_flags:
     os.environ["XLA_FLAGS"] = (
         _xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+try:
+    import jax  # noqa: E402  (must come after XLA_FLAGS is set)
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    # Exporter-only environments have no jax; only the workload tests
+    # need it and they import it themselves (and will error there).
+    pass
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:

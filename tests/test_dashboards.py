@@ -1,0 +1,100 @@
+"""Grafana dashboard validation (SURVEY.md §1 L6).
+
+Offline structural checks: JSON parses, panels are well-formed, and — the
+one that bites in practice — every metric name referenced in a PromQL expr
+actually exists in the exporter's schema (family drift breaks dashboards
+silently otherwise).
+"""
+
+import json
+import os
+import re
+
+import pytest
+
+DASH_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "dashboards")
+
+#: Families the exporter can serve (schema + identity + self-telemetry +
+#: workload-side counters).
+def _known_metric_names():
+    from tpumon.schema import LIBTPU_SPECS
+
+    names = {s.family for s in LIBTPU_SPECS}
+    names |= {
+        "accelerator_device_count",
+        "accelerator_core_count",
+        "accelerator_slice_host_count",
+        "accelerator_info",
+        "accelerator_core_state",
+        "exporter_scrape_duration_seconds",
+        "exporter_poll_duration_seconds",
+        "exporter_metric_coverage_ratio",
+        "exporter_backend_info",
+        "collector_errors_total",
+        "collector_polls_total",
+        "collector_last_poll_timestamp_seconds",
+        "collector_poll_lag_seconds",
+        "workload_collective_ops_total",
+        "workload_hlo_log_events_total",
+    }
+    # Histogram exposition suffixes.
+    names |= {
+        n + suffix
+        for n in list(names)
+        if n.endswith("_seconds")
+        for suffix in ("_bucket", "_sum", "_count")
+    }
+    return names
+
+
+_METRIC_RE = re.compile(r"\b(?:accelerator|exporter|collector|workload)_[a-z0-9_]+")
+
+
+def _dashboards():
+    for name in sorted(os.listdir(DASH_DIR)):
+        if name.endswith(".json"):
+            with open(os.path.join(DASH_DIR, name), encoding="utf-8") as fh:
+                yield name, json.load(fh)
+
+
+def test_dashboards_exist():
+    names = [n for n, _ in _dashboards()]
+    assert "ici-fabric.json" in names  # the BASELINE-mandated fabric heatmap
+    assert len(names) >= 3
+
+
+@pytest.mark.parametrize("name,dash", list(_dashboards()))
+def test_dashboard_structure(name, dash):
+    assert dash["title"]
+    assert dash["uid"].startswith("tpumon-")
+    assert dash["schemaVersion"] >= 30
+    assert dash["panels"], name
+    ids = [p["id"] for p in dash["panels"]]
+    assert len(ids) == len(set(ids)), "duplicate panel ids"
+    for panel in dash["panels"]:
+        assert panel["type"], panel["title"]
+        assert panel["gridPos"]["w"] <= 24
+        for target in panel.get("targets", ()):
+            assert target["expr"].strip()
+
+
+@pytest.mark.parametrize("name,dash", list(_dashboards()))
+def test_promql_references_known_families(name, dash):
+    known = _known_metric_names()
+    for panel in dash["panels"]:
+        for target in panel.get("targets", ()):
+            for ref in _METRIC_RE.findall(target["expr"]):
+                assert ref in known, (
+                    f"{name} panel {panel['title']!r} references unknown "
+                    f"metric {ref!r}"
+                )
+
+
+def test_ici_heatmap_panel_present():
+    dash = dict(_dashboards())["ici-fabric.json"]
+    heatmaps = [p for p in dash["panels"] if p["type"] == "heatmap"]
+    assert any(
+        "accelerator_interconnect_link_health" in t["expr"]
+        for p in heatmaps
+        for t in p["targets"]
+    ), "ICI fabric heatmap must plot link health"

@@ -251,3 +251,22 @@ def test_server_close_before_start_does_not_hang():
     t0 = _time.monotonic()
     exp.close()  # never started: must return, not deadlock
     assert _time.monotonic() - t0 < 2.0
+
+
+def test_gzip_negotiation(exporter_for, scrape):
+    import gzip as gz
+    import urllib.request
+
+    exp = exporter_for(FakeTpuBackend.preset("v5e-16"))
+    req = urllib.request.Request(
+        exp.server.url + "/metrics", headers={"Accept-Encoding": "gzip"}
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.headers["Content-Encoding"] == "gzip"
+        raw = resp.read()
+    text = gz.decompress(raw).decode()
+    assert "accelerator_duty_cycle_percent" in text
+    # And without the header: identity encoding.
+    status, plain = scrape(exp.server.url + "/metrics")
+    assert status == 200 and "accelerator_duty_cycle_percent" in plain
+    assert len(raw) < len(plain) / 3  # compression actually bites

@@ -1,0 +1,52 @@
+from tpumon.workload.hlo_counters import CountersCollector, HloOpCounters
+
+
+def test_observe_counts_collectives():
+    c = HloOpCounters()
+    c.observe("%all-reduce.1 = f32[] all-reduce(...), replica_groups={}")
+    c.observe("fused all-gather then reduce-scatter on ici")
+    c.observe("nothing interesting here")
+    counts, events = c.snapshot()
+    assert events == 3
+    assert counts["all-reduce"] == 2  # op name + instruction name
+    assert counts["all-gather"] == 1
+    assert counts["reduce-scatter"] == 1
+
+
+def test_callback_never_raises():
+    c = HloOpCounters()
+
+    class Unstringable:
+        def __str__(self):
+            raise RuntimeError("boom")
+
+    c._callback(Unstringable())  # must swallow
+    _, events = c.snapshot()
+    assert events == 0
+
+
+def test_collector_families():
+    c = HloOpCounters()
+    c.observe("all-to-all all-to-all collective-permute")
+    fams = {f.name: f for f in CountersCollector(c).collect()}
+    ops = {
+        s.labels["op"]: s.value
+        for s in fams["workload_collective_ops"].samples
+        if s.labels
+    }
+    assert ops == {"all-to-all": 2.0, "collective-permute": 1.0}
+    [ev] = [
+        s
+        for s in fams["workload_hlo_log_events"].samples
+        if s.name.endswith("_total")
+    ]
+    assert ev.value == 1.0
+
+
+def test_start_stop_graceful_without_tpu():
+    # On hosts without libtpu this returns False; with libtpu it registers.
+    c = HloOpCounters()
+    hooked = c.start()
+    assert hooked in (True, False)
+    c.stop()
+    c.stop()  # idempotent

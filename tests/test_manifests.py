@@ -1,0 +1,125 @@
+"""K8s manifest validation (SURVEY.md §4.4).
+
+``kubectl --dry-run`` is unavailable offline, so manifests are validated
+structurally: YAML parses, the shapes agree with each other (ports,
+selectors, probe paths, shared volumes), and the TPU-native constraints
+hold (no NVIDIA anything, TPU nodeSelector/toleration present).
+"""
+
+import importlib.util
+import os
+
+import yaml
+
+DEPLOY = os.path.join(os.path.dirname(os.path.dirname(__file__)), "deploy")
+
+
+def _load(name):
+    with open(os.path.join(DEPLOY, name), encoding="utf-8") as fh:
+        return [d for d in yaml.safe_load_all(fh) if d]
+
+
+def _containers(ds):
+    return {c["name"]: c for c in ds["spec"]["template"]["spec"]["containers"]}
+
+
+def _env(container):
+    return {e["name"]: e.get("value") for e in container.get("env", ())}
+
+
+def test_all_manifests_parse():
+    for name in os.listdir(DEPLOY):
+        if name.endswith(".yaml"):
+            assert _load(name), name
+
+
+def test_daemonset_shape():
+    (ds,) = _load("daemonset.yaml")
+    assert ds["kind"] == "DaemonSet"
+    pod = ds["spec"]["template"]
+    containers = _containers(ds)
+    assert set(containers) == {"exporter", "discovery"}
+
+    exporter = containers["exporter"]
+    env = _env(exporter)
+    assert env["TPUMON_INTERVAL"] == "1.0"  # the 1 Hz BASELINE target
+    assert env["TPUMON_BACKEND"] == "auto"
+
+    # Scrape annotations agree with the container port.
+    ann = pod["metadata"]["annotations"]
+    port = exporter["ports"][0]["containerPort"]
+    assert ann["prometheus.io/port"] == str(port) == env["TPUMON_PORT"]
+
+    # Liveness hits the stall-detecting /healthz, readiness the cache path.
+    assert exporter["livenessProbe"]["httpGet"]["path"] == "/healthz"
+    assert exporter["readinessProbe"]["httpGet"]["path"] == "/metrics"
+
+    # TPU scheduling: tolerate the TPU taint; select nodes by label
+    # PRESENCE (operator Exists) — the label's value is the accelerator
+    # type string and varies per pool, so a value match would select none.
+    spec = pod["spec"]
+    tol_keys = {t["key"] for t in spec["tolerations"]}
+    assert "google.com/tpu" in tol_keys
+    terms = spec["affinity"]["nodeAffinity"][
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    ]["nodeSelectorTerms"]
+    exprs = [e for t in terms for e in t["matchExpressions"]]
+    assert any(
+        e["key"] == "cloud.google.com/gke-tpu-accelerator"
+        and e["operator"] == "Exists"
+        and "values" not in e
+        for e in exprs
+    )
+    assert "nodeSelector" not in spec
+
+
+def test_topology_volume_shared_between_containers():
+    (ds,) = _load("daemonset.yaml")
+    containers = _containers(ds)
+    sidecar_out = _env(containers["discovery"])["TPUMON_TOPOLOGY_OUT"]
+    exporter_in = _env(containers["exporter"])["TPUMON_TOPOLOGY_FILE"]
+    assert sidecar_out == exporter_in
+    for c in containers.values():
+        mounts = {m["mountPath"] for m in c["volumeMounts"]}
+        assert any(sidecar_out.startswith(m) for m in mounts), c["name"]
+    vols = {v["name"] for v in ds["spec"]["template"]["spec"]["volumes"]}
+    assert "topology" in vols
+
+
+def test_no_nvidia_anywhere():
+    """BASELINE.json:5 — no NVIDIA driver/userspace in image or manifests."""
+    for name in os.listdir(DEPLOY):
+        path = os.path.join(DEPLOY, name)
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read().lower()
+        for needle in ("nvidia", "cuda", "dcgm", "nvml.so", "libnvidia"):
+            # Allowed only in comments explaining the constraint.
+            for line in text.splitlines():
+                if needle in line:
+                    assert line.lstrip().startswith("#"), (name, line)
+
+
+def test_service_selector_matches_daemonset():
+    (svc,) = _load("service.yaml")
+    (ds,) = _load("daemonset.yaml")
+    sel = svc["spec"]["selector"]
+    pod_labels = ds["spec"]["template"]["metadata"]["labels"]
+    for k, v in sel.items():
+        assert pod_labels.get(k) == v
+    svc_ports = {p["name"] for p in svc["spec"]["ports"]}
+    assert {"metrics", "disc-metrics"} <= svc_ports
+
+
+def test_kustomization_files_exist():
+    (kust,) = _load("kustomization.yaml")
+    for res in kust["resources"]:
+        assert os.path.exists(os.path.join(DEPLOY, res)), res
+
+
+def test_container_entrypoints_are_importable():
+    """The commands the manifests run must resolve to real modules."""
+    (ds,) = _load("daemonset.yaml")
+    for c in _containers(ds).values():
+        assert c["command"][0] == "python" and c["command"][1] == "-m"
+        module = c["command"][2]
+        assert importlib.util.find_spec(module) is not None, module

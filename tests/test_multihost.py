@@ -1,0 +1,92 @@
+"""Multi-host DaemonSet simulation without a cluster (SURVEY.md §4.4).
+
+One exporter per fake host on localhost ports — exactly what a DaemonSet
+over a v5e-16 slice (4 hosts × 4 chips) looks like to Prometheus — plus a
+mini-scraper asserting the union of labels covers every host and chip.
+"""
+
+import pytest
+from prometheus_client.parser import text_string_to_metric_families
+
+from tpumon.backends.fake import FakeTpuBackend
+from tpumon.config import Config
+from tpumon.exporter.server import build_exporter
+
+HOSTS = 4
+
+
+@pytest.fixture
+def fleet():
+    exporters = []
+    for worker in range(HOSTS):
+        be = FakeTpuBackend.preset("v5e-16", worker_id=worker, seed=worker)
+        exp = build_exporter(Config(port=0, addr="127.0.0.1", interval=30.0), be)
+        exp.start()
+        exporters.append(exp)
+    yield exporters
+    for exp in exporters:
+        exp.close()
+
+
+def _scrape_fleet(fleet, scrape):
+    per_host = []
+    for exp in fleet:
+        status, text = scrape(exp.server.url + "/metrics")
+        assert status == 200
+        per_host.append(list(text_string_to_metric_families(text)))
+    return per_host
+
+
+def test_union_covers_all_hosts_and_chips(fleet, scrape):
+    per_host = _scrape_fleet(fleet, scrape)
+
+    workers = set()
+    chip_ids = set()
+    slices = set()
+    for fams in per_host:
+        for fam in fams:
+            if fam.name == "accelerator_duty_cycle_percent":
+                for s in fam.samples:
+                    workers.add(s.labels["worker"])
+                    chip_ids.add((s.labels["worker"], s.labels["chip"]))
+                    slices.add(s.labels["slice"])
+
+    assert workers == {str(i) for i in range(HOSTS)}
+    assert len(chip_ids) == 16  # v5e-16: every chip covered exactly once
+    assert slices == {"fake-v5e-16"}  # one slice identity across the fleet
+
+
+def test_hosts_report_independent_data(fleet, scrape):
+    per_host = _scrape_fleet(fleet, scrape)
+    values = []
+    for fams in per_host:
+        for fam in fams:
+            if fam.name == "accelerator_duty_cycle_percent":
+                values.append(tuple(s.value for s in fam.samples))
+    assert len(set(values)) == HOSTS  # different seeds → different data
+
+
+def test_one_host_down_rest_serve(fleet, scrape):
+    fleet[1].close()
+    up = [fleet[0], fleet[2], fleet[3]]
+    per_host = _scrape_fleet(up, scrape)
+    workers = {
+        s.labels["worker"]
+        for fams in per_host
+        for fam in fams
+        if fam.name == "accelerator_device_count"
+        for s in fam.samples
+    }
+    assert workers == {"0", "2", "3"}
+
+
+def test_slice_host_count_consistent(fleet, scrape):
+    per_host = _scrape_fleet(fleet, scrape)
+    counts = {
+        s.value
+        for fams in per_host
+        for fam in fams
+        if fam.name == "accelerator_slice_host_count"
+        for s in fam.samples
+    }
+    assert counts == {4.0}

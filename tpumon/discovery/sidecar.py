@@ -94,14 +94,14 @@ def main(argv: list[str] | None = None) -> int:
     registry = CollectorRegistry()
     registry.register(collector)
 
-    from tpumon.exporter.server import ExporterServer, _make_app
+    from tpumon.exporter.server import ExporterServer, _make_app, registry_renderer
     from tpumon.exporter.telemetry import SelfTelemetry
 
     # Same registry that is served, so the sidecar's own scrape-duration
     # and liveness gauges are actually visible to Prometheus.
     telemetry = SelfTelemetry(registry)
     telemetry.last_poll.set(time.time())
-    app = _make_app(registry, telemetry, lambda: (True, "ok\n"))
+    app = _make_app(registry_renderer(registry), telemetry, lambda: (True, "ok\n"))
     server = ExporterServer(app, cfg.addr, cfg.port)
     server.start()
     log.info("discovery sidecar serving %s/metrics", server.url)

@@ -35,28 +35,56 @@ class PollStats:
     coverage: float = 1.0
 
 
+class _FamiliesShim:
+    """Duck-typed registry: exposition renders anything with .collect()."""
+
+    def __init__(self, families: tuple[Metric, ...]) -> None:
+        self._families = families
+
+    def collect(self):
+        return self._families
+
+
 class SampleCache:
-    """Atomic snapshot holder shared by the poller and HTTP threads."""
+    """Atomic snapshot holder shared by the poller and HTTP threads.
+
+    Holds both the family objects (for the registry/debug path) and the
+    **pre-rendered text exposition**: rendering happens once per poll
+    (1 Hz), so a scrape is a cached-bytes write instead of an O(samples)
+    serialization — this is most of the p99 scrape-latency headline.
+    """
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._snapshot: tuple[Metric, ...] = ()
+        self._rendered: bytes = b""
 
     def publish(self, families: list[Metric]) -> None:
+        from prometheus_client.exposition import generate_latest
+
         snap = tuple(families)
+        rendered = generate_latest(_FamiliesShim(snap))
         with self._lock:
             self._snapshot = snap
+            self._rendered = rendered
 
     def snapshot(self) -> tuple[Metric, ...]:
         with self._lock:
             return self._snapshot
 
+    def rendered(self) -> bytes:
+        with self._lock:
+            return self._rendered
+
 
 class CachedCollector:
-    """prometheus_client custom collector that only reads the cache.
+    """Optional adapter for embedding tpumon in an existing registry.
 
-    Registered into the CollectorRegistry; ``collect()`` MUST NOT touch the
-    device backend (SURVEY.md §3.2 'MUST NOT call libtpu').
+    The standalone exporter does NOT use this — it serves the pre-rendered
+    bytes from SampleCache directly. Library users who already run a
+    prometheus_client registry can ``registry.register(CachedCollector(
+    exporter.cache))`` instead; ``collect()`` still only reads the cache,
+    never the device backend (SURVEY.md §3.2).
     """
 
     def __init__(self, cache: SampleCache) -> None:

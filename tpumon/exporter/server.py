@@ -8,6 +8,7 @@ timing is measured by middleware around the exposition app and feeds the
 
 from __future__ import annotations
 
+import gzip
 import logging
 import socket
 import threading
@@ -20,7 +21,7 @@ from prometheus_client.registry import CollectorRegistry
 
 from tpumon.backends.base import Backend
 from tpumon.config import Config
-from tpumon.exporter.collector import CachedCollector, Poller, SampleCache
+from tpumon.exporter.collector import Poller, SampleCache
 from tpumon.exporter.telemetry import SelfTelemetry
 
 log = logging.getLogger(__name__)
@@ -40,8 +41,14 @@ class _ThreadingWSGIServer(ThreadingMixIn, WSGIServer):
     address_family = socket.AF_INET
 
 
-def _make_app(registry: CollectorRegistry, telemetry: SelfTelemetry, health):
-    metrics_app = exposition.make_wsgi_app(registry)
+#: Prometheus text exposition format 0.0.4.
+_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _make_app(render_body, telemetry: SelfTelemetry, health):
+    """WSGI app. ``render_body() -> bytes`` produces the /metrics payload;
+    the exporter passes cached-bytes + self-telemetry concatenation, the
+    sidecar a plain registry render."""
 
     def app(environ, start_response):
         path = environ.get("PATH_INFO", "/")
@@ -60,7 +67,17 @@ def _make_app(registry: CollectorRegistry, telemetry: SelfTelemetry, health):
         if path in ("/metrics", "/"):
             t0 = time.perf_counter()
             try:
-                return metrics_app(environ, start_response)
+                body = render_body()
+                headers = [("Content-Type", _CONTENT_TYPE)]
+                # Prometheus sends Accept-Encoding: gzip on every scrape;
+                # at 1 Hz × full families the ~10x shrink matters on the
+                # pod network. level 1: ~0.2 ms for a ~35 KB page.
+                if "gzip" in environ.get("HTTP_ACCEPT_ENCODING", ""):
+                    body = gzip.compress(body, compresslevel=1)
+                    headers.append(("Content-Encoding", "gzip"))
+                headers.append(("Content-Length", str(len(body))))
+                start_response("200 OK", headers)
+                return [body]
             finally:
                 telemetry.scrape_duration.observe(time.perf_counter() - t0)
         body = b"not found; try /metrics or /healthz\n"
@@ -74,6 +91,10 @@ def _make_app(registry: CollectorRegistry, telemetry: SelfTelemetry, health):
         return [body]
 
     return app
+
+
+def registry_renderer(registry: CollectorRegistry):
+    return lambda: exposition.generate_latest(registry)
 
 
 class ExporterServer:
@@ -117,17 +138,25 @@ class Exporter:
     def __init__(self, cfg: Config, backend: Backend) -> None:
         self.cfg = cfg
         self.backend = backend
+        # Self-telemetry lives in its own registry: the device families are
+        # pre-rendered once per poll (SampleCache), so a scrape serves
+        # cached bytes + this small registry's render.
         self.registry = CollectorRegistry()
         self.telemetry = SelfTelemetry(self.registry)
         self.cache = SampleCache()
-        self.registry.register(CachedCollector(self.cache))
         self.poller = Poller(backend, cfg, self.cache, self.telemetry)
         version_fn = getattr(backend, "version", None)
         self.telemetry.backend_info.labels(
             backend=backend.name,
             version=version_fn() if version_fn else "unknown",
         ).set(1)
-        app = _make_app(self.registry, self.telemetry, self._health)
+
+        def render() -> bytes:
+            return self.cache.rendered() + exposition.generate_latest(
+                self.registry
+            )
+
+        app = _make_app(render, self.telemetry, self._health)
         self.server = ExporterServer(app, cfg.addr, cfg.port)
 
     def _health(self) -> tuple[bool, str]:

@@ -1,0 +1,9 @@
+"""Workload harness (SURVEY.md §2.4, §3.5).
+
+The monitor's measurement target: a compact JAX/pjit Llama-style training
+step that generates real MXU work and ICI collective traffic so the
+``collective_e2e_latency`` / ``ici_link_health`` / ``hlo_*`` metric
+families light up in benchmarks and on dashboards. This is deliberately a
+*workload generator*, not a training framework — the reference genre is a
+telemetry stack and implements no parallelism of its own; it observes it.
+"""

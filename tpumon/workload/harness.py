@@ -1,0 +1,208 @@
+"""Training harness: the ICI-traffic generator (SURVEY.md §3.5).
+
+One jitted SPMD train step (next-token cross-entropy + Adam) over a dp×tp
+mesh. Run it while the exporter polls from another process and the
+collective / duty-cycle / HBM families go non-empty — the process boundary
+is the point: the monitor must see traffic it did not generate.
+
+CLI:  python -m tpumon.workload.harness --steps 20 --dp 1 --tp 1
+      (add --metrics-port to expose in-process collective-op counters)
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpumon.workload.models.llama import LlamaConfig, forward, init_params
+from tpumon.workload.parallel.mesh import (
+    batch_spec,
+    make_mesh,
+    param_specs,
+    shard_tree,
+)
+
+log = logging.getLogger(__name__)
+
+
+def loss_fn(params, tokens, cfg: LlamaConfig):
+    """Next-token cross-entropy; inputs [B, S], targets are the shift-by-1."""
+    logits = forward(params, tokens[:, :-1], cfg)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def make_train_step(cfg: LlamaConfig, optimizer):
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+@dataclasses.dataclass
+class RunResult:
+    losses: list[float]
+    steps_per_sec: float
+    dp: int
+    tp: int
+
+
+def run(
+    cfg: LlamaConfig,
+    *,
+    steps: int = 10,
+    batch: int = 8,
+    seq: int | None = None,
+    dp: int = 1,
+    tp: int = 1,
+    seed: int = 0,
+    mesh=None,
+) -> RunResult:
+    """Build, shard, and run the train step; returns losses + throughput."""
+    seq = seq or cfg.max_seq
+    key = jax.random.PRNGKey(seed)
+    k_params, k_data = jax.random.split(key)
+
+    params = init_params(cfg, k_params)
+    optimizer = optax.adamw(1e-3)
+    train_step = make_train_step(cfg, optimizer)
+    tokens = jax.random.randint(k_data, (batch, seq + 1), 0, cfg.vocab, jnp.int32)
+
+    if mesh is None and dp * tp > 1:
+        mesh = make_mesh(dp, tp)
+
+    if mesh is not None:
+        # Shard params FIRST; optimizer.init on sharded params then makes the
+        # Adam moments inherit the same layout (no replicated moment memory).
+        params = shard_tree(params, param_specs(), mesh)
+        tokens = jax.device_put(tokens, NamedSharding(mesh, batch_spec()))
+    opt_state = optimizer.init(params)
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+
+    # Warmup/compile outside the timed window.
+    params, opt_state, loss = step(params, opt_state, tokens)
+    loss.block_until_ready()
+    losses = [float(loss)]
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    loss.block_until_ready()
+    elapsed = time.perf_counter() - t0
+    losses.append(float(loss))
+    return RunResult(
+        losses=losses,
+        steps_per_sec=steps / elapsed if elapsed > 0 else float("inf"),
+        dp=dp,
+        tp=tp,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="tpumon-workload")
+    parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--seq", type=int, default=None)
+    parser.add_argument("--preset", choices=("tiny", "small"), default="tiny")
+    parser.add_argument("--dp", type=int, default=1)
+    parser.add_argument("--tp", type=int, default=1)
+    parser.add_argument(
+        "--metrics-port",
+        type=int,
+        default=0,
+        help="expose workload-side collective-op counters (0 = off)",
+    )
+    parser.add_argument(
+        "--platform",
+        choices=("auto", "cpu"),
+        default="auto",
+        help="force the jax platform; 'cpu' gives a virtual device mesh "
+        "sized dp*tp (the JAX_PLATFORMS env var is ignored when a TPU "
+        "plugin is present, so this must be a flag)",
+    )
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, format="%(levelname)s %(message)s")
+
+    if args.platform == "cpu":
+        import os
+
+        n = max(args.dp * args.tp, 1)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+        jax.config.update("jax_platforms", "cpu")
+
+    cfg = LlamaConfig.tiny() if args.preset == "tiny" else LlamaConfig.small()
+
+    from tpumon.workload.hlo_counters import CountersCollector, HloOpCounters
+
+    counters = HloOpCounters()
+    hooked = counters.start()
+    server = None
+    if args.metrics_port:
+        from prometheus_client.registry import CollectorRegistry
+
+        from tpumon.exporter.server import (
+            ExporterServer,
+            _make_app,
+            registry_renderer,
+        )
+        from tpumon.exporter.telemetry import SelfTelemetry
+
+        registry = CollectorRegistry()
+        registry.register(CountersCollector(counters))
+        telemetry = SelfTelemetry(registry)
+        telemetry.last_poll.set(time.time())
+        server = ExporterServer(
+            _make_app(registry_renderer(registry), telemetry, lambda: (True, "ok\n")),
+            "0.0.0.0",
+            args.metrics_port,
+        )
+        server.start()
+        log.info("workload counters at %s/metrics", server.url)
+
+    try:
+        result = run(
+            cfg,
+            steps=args.steps,
+            batch=args.batch,
+            seq=args.seq,
+            dp=args.dp,
+            tp=args.tp,
+        )
+        log.info(
+            "loss %.4f → %.4f | %.2f steps/s | mesh dp=%d tp=%d | devices=%s",
+            result.losses[0],
+            result.losses[-1],
+            result.steps_per_sec,
+            result.dp,
+            result.tp,
+            jax.devices()[0].platform,
+        )
+        if hooked:
+            counts, events = counters.snapshot()
+            log.info("hlo events=%d collectives=%s", events, counts or "{}")
+    finally:
+        counters.stop()
+        if server is not None:
+            server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,129 @@
+"""In-process XLA collective-op counters via the libtpu HLO logger
+(SURVEY.md §3.5; BASELINE config 4 'XLA collective-op counters').
+
+``libtpu.sdk.logger.register_hlo_logger(cb)`` (signature probed live on
+libtpu 0.0.34) delivers HLO log events to the *workload* process — these
+counters therefore live workload-side; the node exporter observes the
+fabric from outside via ``collective_e2e_latency``/``ici_link_health``.
+The harness can expose them on its own /metrics port so Prometheus sees
+both views of the same traffic.
+
+The event payload format is undocumented, so extraction is defensive:
+stringify everything, regex for collective-op names, never raise from the
+callback (it runs inside the runtime).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+from collections import Counter
+
+log = logging.getLogger(__name__)
+
+#: XLA collective HLO op names worth counting (ICI traffic generators).
+COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute"
+    r"|collective-broadcast|send|recv)\b"
+)
+
+
+class HloOpCounters:
+    """Counts collective-op mentions in HLO logger events. Thread-safe."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: Counter[str] = Counter()
+        self._events = 0
+        self._ids = None
+
+    # -- registration ------------------------------------------------------
+
+    def start(self) -> bool:
+        """Register with the libtpu HLO logger; False if unavailable."""
+        try:
+            from libtpu.sdk import logger as tpu_logger
+
+            self._ids = tpu_logger.register_hlo_logger(self._callback)
+            return True
+        except Exception as exc:
+            log.debug("HLO logger unavailable: %s", exc)
+            return False
+
+    def stop(self) -> None:
+        if self._ids is None:
+            return
+        try:
+            from libtpu.sdk import logger as tpu_logger
+
+            tpu_logger.unregister_hlo_logger(self._ids)
+        except Exception as exc:
+            log.debug("HLO logger unregister failed: %s", exc)
+        self._ids = None
+
+    def __enter__(self) -> "HloOpCounters":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- event path --------------------------------------------------------
+
+    def _callback(self, *args, **kwargs) -> None:
+        # Runs inside the TPU runtime: must never raise.
+        try:
+            text = " ".join(str(a) for a in args)
+            if kwargs:
+                text += " " + " ".join(f"{k}={v}" for k, v in kwargs.items())
+            self.observe(text)
+        except Exception:
+            pass
+
+    def observe(self, text: str) -> None:
+        """Count collective mentions in one event (public for tests)."""
+        ops = COLLECTIVE_RE.findall(text.lower())
+        with self._lock:
+            self._events += 1
+            for op in ops:
+                self._counts[op] += 1
+
+    # -- read side ---------------------------------------------------------
+
+    def snapshot(self) -> tuple[dict[str, int], int]:
+        with self._lock:
+            return dict(self._counts), self._events
+
+
+def counters_families(counters: HloOpCounters):
+    """Prometheus families for a workload-side /metrics endpoint."""
+    from prometheus_client.core import CounterMetricFamily
+
+    counts, events = counters.snapshot()
+    fam = CounterMetricFamily(
+        "workload_collective_ops_total",
+        "XLA collective HLO ops observed by the in-process libtpu HLO "
+        "logger, by op.",
+        labels=("op",),
+    )
+    for op, n in sorted(counts.items()):
+        fam.add_metric((op,), n)
+    yield fam
+
+    ev = CounterMetricFamily(
+        "workload_hlo_log_events_total",
+        "Total HLO logger events received in-process.",
+    )
+    ev.add_metric((), events)
+    yield ev
+
+
+class CountersCollector:
+    """Registry adapter: ``registry.register(CountersCollector(c))``."""
+
+    def __init__(self, counters: HloOpCounters) -> None:
+        self._counters = counters
+
+    def collect(self):
+        return counters_families(self._counters)

@@ -1,0 +1,3 @@
+from tpumon.workload.models.llama import LlamaConfig, forward, init_params
+
+__all__ = ["LlamaConfig", "forward", "init_params"]

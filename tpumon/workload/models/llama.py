@@ -1,0 +1,133 @@
+"""Compact Llama-style decoder, TPU-first.
+
+Pure-functional JAX (params as a pytree, no framework state) so the whole
+train step jits into one XLA program:
+
+- matmuls in **bfloat16** with float32 accumulation (MXU-native);
+- static shapes everywhere; the layer stack is a ``lax.scan`` over stacked
+  per-layer params, so XLA compiles ONE layer body regardless of depth;
+- grouped-query attention + SwiGLU, mirroring the Llama-3 shape the
+  BASELINE config 4 workload names ("JAX Llama-3-8B pretrain");
+- tensor-parallel-friendly layout: head and FFN dims lead the sharded axes
+  (see tpumon.workload.parallel.mesh for the PartitionSpecs).
+
+Used by the ICI-traffic harness and as the graft-entry flagship model; the
+'tiny' preset keeps single-chip compile fast while the sharding logic is
+identical at any size.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from tpumon.workload.ops.core import apply_rope, rms_norm, rope_freqs
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab: int = 512
+    dim: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    n_kv_heads: int = 2
+    ffn_dim: int = 256
+    max_seq: int = 128
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @classmethod
+    def tiny(cls) -> "LlamaConfig":
+        return cls()
+
+    @classmethod
+    def small(cls) -> "LlamaConfig":
+        return cls(
+            vocab=8192, dim=512, n_layers=8, n_heads=8, n_kv_heads=4,
+            ffn_dim=1408, max_seq=512,
+        )
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
+    """Per-layer weights stacked on a leading layer axis (for lax.scan)."""
+    k_embed, k_layers, k_out = jax.random.split(key, 3)
+    init = jax.nn.initializers.normal(0.02)
+    L, D, F = cfg.n_layers, cfg.dim, cfg.ffn_dim
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    ks = jax.random.split(k_layers, 7)
+
+    def stacked(key, shape):
+        return init(key, (L, *shape), jnp.float32)
+
+    return {
+        "embed": init(k_embed, (cfg.vocab, D), jnp.float32),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), jnp.float32),
+            "wq": stacked(ks[0], (D, H * HD)),
+            "wk": stacked(ks[1], (D, KV * HD)),
+            "wv": stacked(ks[2], (D, KV * HD)),
+            "wo": stacked(ks[3], (H * HD, D)),
+            "mlp_norm": jnp.ones((L, D), jnp.float32),
+            "w_gate": stacked(ks[4], (D, F)),
+            "w_up": stacked(ks[5], (D, F)),
+            "w_down": stacked(ks[6], (F, D)),
+        },
+        "final_norm": jnp.ones((D,), jnp.float32),
+        "unembed": init(k_out, (D, cfg.vocab), jnp.float32),
+    }
+
+
+def _attention(x, layer, cfg: LlamaConfig, freqs, mask):
+    B, S, D = x.shape
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    q = (x @ layer["wq"].astype(cfg.dtype)).reshape(B, S, H, HD)
+    k = (x @ layer["wk"].astype(cfg.dtype)).reshape(B, S, KV, HD)
+    v = (x @ layer["wv"].astype(cfg.dtype)).reshape(B, S, KV, HD)
+
+    q = apply_rope(q, freqs[:S])
+    k = apply_rope(k, freqs[:S])
+
+    # Grouped-query: repeat KV heads up to H (cheap reshape-broadcast; XLA
+    # folds it into the einsum rather than materializing).
+    rep = H // KV
+    k = jnp.repeat(k, rep, axis=2)
+    v = jnp.repeat(v, rep, axis=2)
+
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(HD)) + mask[:S, :S]
+    probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, H * HD)
+    return out @ layer["wo"].astype(cfg.dtype)
+
+
+def _mlp(x, layer, cfg: LlamaConfig):
+    gate = x @ layer["w_gate"].astype(cfg.dtype)
+    up = x @ layer["w_up"].astype(cfg.dtype)
+    return (jax.nn.silu(gate) * up) @ layer["w_down"].astype(cfg.dtype)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig) -> jnp.ndarray:
+    """tokens [B, S] int32 → logits [B, S, vocab] float32."""
+    B, S = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    freqs = rope_freqs(cfg.head_dim, cfg.max_seq)
+    mask = jnp.triu(jnp.full((cfg.max_seq, cfg.max_seq), -1e9, jnp.float32), k=1)
+
+    def block(carry, layer):
+        h = carry
+        h = h + _attention(rms_norm(h, layer["attn_norm"]), layer, cfg, freqs, mask)
+        h = h + _mlp(rms_norm(h, layer["mlp_norm"]), layer, cfg)
+        return h, None
+
+    # One compiled layer body for any depth — lax.scan over stacked params.
+    x, _ = jax.lax.scan(block, x, params["layers"])
+    x = rms_norm(x, params["final_norm"])
+    return (x @ params["unembed"].astype(cfg.dtype)).astype(jnp.float32)

@@ -1,0 +1,3 @@
+from tpumon.workload.ops.core import apply_rope, rms_norm, rope_freqs
+
+__all__ = ["apply_rope", "rms_norm", "rope_freqs"]

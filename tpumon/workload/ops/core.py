@@ -1,0 +1,36 @@
+"""Functional core ops for the workload model.
+
+Written XLA-first: pure functions over static shapes, fusable elementwise
+chains, no data-dependent Python control flow — everything here traces once
+under ``jit`` and fuses into the surrounding matmuls (HBM-bandwidth rule:
+elementwise work rides the MXU ops' memory traffic).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """RMSNorm in float32 accumulation, cast back to the input dtype."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * scale * weight).astype(dtype)
+
+
+def rope_freqs(head_dim: int, max_seq: int, theta: float = 10000.0) -> jnp.ndarray:
+    """Precompute rotary-embedding angles [max_seq, head_dim // 2]."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    return jnp.outer(t, inv)
+
+
+def apply_rope(x: jnp.ndarray, freqs: jnp.ndarray) -> jnp.ndarray:
+    """Rotate pairs of channels; x is [B, S, H, D], freqs [S, D//2]."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos = jnp.cos(freqs)[None, :, None, :]
+    sin = jnp.sin(freqs)[None, :, None, :]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,8 @@
+from tpumon.workload.parallel.mesh import (
+    batch_spec,
+    make_mesh,
+    param_specs,
+    shard_tree,
+)
+
+__all__ = ["batch_spec", "make_mesh", "param_specs", "shard_tree"]

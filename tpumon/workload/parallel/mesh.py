@@ -1,0 +1,68 @@
+"""Device mesh + shardings for the workload harness (SURVEY.md §3.5).
+
+Idiomatic JAX SPMD: pick a Mesh, annotate shardings with PartitionSpecs,
+let XLA insert the collectives — the all-reduces (data axis) and
+all-gathers/reduce-scatters (model axis) this generates over ICI are
+exactly the traffic ``collective_e2e_latency`` / ``ici_link_health``
+measure.
+
+Axes:
+
+- ``data``  — batch (DP): gradients all-reduce across it.
+- ``model`` — Megatron-style tensor parallelism: attention heads and FFN
+  hidden dim are column-sharded (…, "model"), output projections
+  row-sharded ("model", …), vocab sharded in embed/unembed.
+
+Layer weights are stacked on a leading layer axis (lax.scan), so every
+per-layer spec carries a leading ``None``.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(dp: int, tp: int, devices=None) -> Mesh:
+    """A dp×tp mesh over the given (default: all) devices."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if dp * tp > len(devices):
+        raise ValueError(
+            f"mesh {dp}x{tp} needs {dp * tp} devices, have {len(devices)}"
+        )
+    grid = np.asarray(devices[: dp * tp]).reshape(dp, tp)
+    return Mesh(grid, axis_names=("data", "model"))
+
+
+def param_specs() -> dict:
+    """PartitionSpec tree matching models.llama.init_params' structure."""
+    return {
+        "embed": P("model", None),  # vocab-sharded embedding
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, "model"),
+            "wk": P(None, None, "model"),
+            "wv": P(None, None, "model"),
+            "wo": P(None, "model", None),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, None, "model"),
+            "w_up": P(None, None, "model"),
+            "w_down": P(None, "model", None),
+        },
+        "final_norm": P(None),
+        "unembed": P(None, "model"),
+    }
+
+
+def batch_spec() -> P:
+    return P("data", None)
+
+
+def shard_tree(tree, specs, mesh: Mesh):
+    """device_put a pytree according to a matching PartitionSpec tree."""
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        tree,
+        specs,
+    )

@@ -1,0 +1,15 @@
+{{- define "tpumon.name" -}}
+{{- .Chart.Name | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "tpumon.labels" -}}
+app.kubernetes.io/name: {{ include "tpumon.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end -}}
+
+{{- define "tpumon.selectorLabels" -}}
+app.kubernetes.io/name: {{ include "tpumon.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+{{- end -}}

@@ -1,0 +1,142 @@
+"""Chip→pod attribution tests against a real gRPC server on a unix socket
+(the same transport shape as the kubelet pod-resources API)."""
+
+import concurrent.futures
+
+import grpc
+import pytest
+
+from tpumon.attribution import PodAttribution, PodResourcesClient
+from tpumon.attribution import podresources_pb2 as pb
+
+
+def _canned_response():
+    resp = pb.ListPodResourcesResponse()
+    pod = resp.pod_resources.add()
+    pod.name = "llama-train-0"
+    pod.namespace = "ml"
+    container = pod.containers.add()
+    container.name = "train"
+    dev = container.devices.add()
+    dev.resource_name = "google.com/tpu"
+    dev.device_ids.extend(["0", "1", "2", "3"])
+    # A non-accelerator device that must be filtered out.
+    other = container.devices.add()
+    other.resource_name = "example.com/nic"
+    other.device_ids.append("eth1")
+    # A GPU pod on the same (mixed) node.
+    gpod = resp.pod_resources.add()
+    gpod.name = "cuda-infer-1"
+    gpod.namespace = "serving"
+    gcont = gpod.containers.add()
+    gcont.name = "infer"
+    gdev = gcont.devices.add()
+    gdev.resource_name = "nvidia.com/gpu"
+    gdev.device_ids.append("GPU-abc")
+    return resp
+
+
+@pytest.fixture
+def kubelet_sock(tmp_path):
+    handler = grpc.method_handlers_generic_handler(
+        "v1.PodResourcesLister",
+        {
+            "List": grpc.unary_unary_rpc_method_handler(
+                lambda request, context: _canned_response(),
+                request_deserializer=pb.ListPodResourcesRequest.FromString,
+                response_serializer=pb.ListPodResourcesResponse.SerializeToString,
+            )
+        },
+    )
+    server = grpc.server(concurrent.futures.ThreadPoolExecutor(max_workers=2))
+    server.add_generic_rpc_handlers((handler,))
+    addr = f"unix://{tmp_path}/kubelet.sock"
+    server.add_insecure_port(addr)
+    server.start()
+    yield addr
+    server.stop(grace=None)
+
+
+def test_list_devices(kubelet_sock):
+    client = PodResourcesClient(kubelet_sock, timeout=5.0)
+    try:
+        devices = client.list_devices()
+    finally:
+        client.close()
+    assert len(devices) == 5  # 4 TPU chips + 1 GPU; NIC filtered
+    tpu = [d for d in devices if d.resource == "google.com/tpu"]
+    assert {d.device_id for d in tpu} == {"0", "1", "2", "3"}
+    assert tpu[0].pod == "llama-train-0"
+    assert tpu[0].namespace == "ml"
+    gpu = [d for d in devices if d.resource == "nvidia.com/gpu"]
+    assert gpu[0].pod == "cuda-infer-1"
+
+
+def test_attribution_family(kubelet_sock):
+    attribution = PodAttribution(PodResourcesClient(kubelet_sock, timeout=5.0))
+    fams = list(attribution.families(("slice",), ("s1",)))
+    assert len(fams) == 1
+    fam = fams[0]
+    assert fam.name == "accelerator_pod_info"
+    assert len(fam.samples) == 5
+    sample = fam.samples[0]
+    assert sample.labels["slice"] == "s1"
+    assert sample.labels["chip"] in {"0", "1", "2", "3"}
+    assert sample.labels["pod"] == "llama-train-0"
+
+
+def test_no_socket_degrades_fast_and_backs_off():
+    import time
+
+    client = PodResourcesClient("unix:///nonexistent/kubelet.sock", timeout=0.5)
+    assert client.list_devices() is None  # failure, not 'no pods'
+    attribution = PodAttribution(client)
+    t0 = time.perf_counter()
+    assert list(attribution.families((), ())) == []
+    first = time.perf_counter() - t0
+    assert first < 2.0
+    # Backed off: the next poll must not pay the connection attempt.
+    t0 = time.perf_counter()
+    assert list(attribution.families((), ())) == []
+    assert time.perf_counter() - t0 < 0.01
+
+
+def test_healthy_empty_list_does_not_back_off():
+    class EmptyClient:
+        calls = 0
+
+        def list_devices(self):
+            self.calls += 1
+            return []  # healthy node, no accelerator pods yet
+
+    client = EmptyClient()
+    attribution = PodAttribution(client)
+    assert list(attribution.families((), ())) == []
+    assert list(attribution.families((), ())) == []
+    assert client.calls == 2  # polled every cycle, no backoff
+
+
+def test_exporter_serves_pod_info(kubelet_sock, scrape):
+    from prometheus_client.parser import text_string_to_metric_families
+
+    from tpumon.backends.fake import FakeTpuBackend
+    from tpumon.config import Config
+    from tpumon.exporter.server import build_exporter
+
+    cfg = Config(
+        port=0,
+        addr="127.0.0.1",
+        interval=30.0,
+        pod_attribution=True,
+        kubelet_socket=kubelet_sock,
+    )
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v4-8"))
+    exp.start()
+    try:
+        _, text = scrape(exp.server.url + "/metrics")
+        fams = {f.name: f for f in text_string_to_metric_families(text)}
+        info = fams["accelerator_pod_info"]
+        pods = {s.labels["pod"] for s in info.samples}
+        assert pods == {"llama-train-0", "cuda-infer-1"}
+    finally:
+        exp.close()

@@ -1,0 +1,79 @@
+"""Helm chart structural validation (no helm binary offline).
+
+Values/Chart parse as YAML; template env-var names match the Config
+schema; the chart's bundled dashboards are byte-identical to the canonical
+dashboards/ (they must not drift).
+"""
+
+import os
+import re
+
+import yaml
+
+ROOT = os.path.dirname(os.path.dirname(__file__))
+CHART = os.path.join(ROOT, "charts", "tpumon")
+
+
+def test_chart_and_values_parse():
+    with open(os.path.join(CHART, "Chart.yaml"), encoding="utf-8") as fh:
+        chart = yaml.safe_load(fh)
+    assert chart["name"] == "tpumon"
+    with open(os.path.join(CHART, "values.yaml"), encoding="utf-8") as fh:
+        values = yaml.safe_load(fh)
+    assert values["exporter"]["interval"] == "1.0"
+    assert values["exporter"]["backend"] == "auto"
+
+
+def test_dashboard_copies_match_canonical():
+    """Chart and kustomize copies must stay byte-identical to dashboards/
+    (helm can't read outside its chart; kustomize can't read ../)."""
+    canon = os.path.join(ROOT, "dashboards")
+    canon_files = {f for f in os.listdir(canon) if f.endswith(".json")}
+    for copy_dir in (
+        os.path.join(CHART, "dashboards"),
+        os.path.join(ROOT, "deploy", "dashboards"),
+    ):
+        copy_files = {f for f in os.listdir(copy_dir) if f.endswith(".json")}
+        assert canon_files == copy_files, copy_dir
+        for name in canon_files:
+            with open(os.path.join(canon, name), "rb") as a, open(
+                os.path.join(copy_dir, name), "rb"
+            ) as b:
+                assert a.read() == b.read(), f"{copy_dir}/{name} drifted"
+
+
+def test_template_env_vars_exist_in_config():
+    """Every TPUMON_* env the chart sets must be a real Config knob."""
+    from tpumon.config import Config
+
+    known = {
+        "TPUMON_" + f.upper()
+        for f in Config.__dataclass_fields__  # type: ignore[attr-defined]
+    }
+    with open(
+        os.path.join(CHART, "templates", "daemonset.yaml"), encoding="utf-8"
+    ) as fh:
+        text = fh.read()
+    for env in re.findall(r"TPUMON_[A-Z_]+", text):
+        assert env in known, f"chart sets unknown env {env}"
+
+
+def test_templates_reference_defined_values():
+    """Every .Values.x.y used in templates exists in values.yaml."""
+    with open(os.path.join(CHART, "values.yaml"), encoding="utf-8") as fh:
+        values = yaml.safe_load(fh)
+
+    def lookup(path):
+        node = values
+        for part in path.split("."):
+            if not isinstance(node, dict) or part not in node:
+                return False
+            node = node[part]
+        return True
+
+    tpl_dir = os.path.join(CHART, "templates")
+    for name in os.listdir(tpl_dir):
+        with open(os.path.join(tpl_dir, name), encoding="utf-8") as fh:
+            text = fh.read()
+        for ref in set(re.findall(r"\.Values\.([A-Za-z0-9_.]+)", text)):
+            assert lookup(ref), f"{name} references undefined values key {ref}"

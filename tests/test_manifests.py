@@ -88,7 +88,12 @@ def test_topology_volume_shared_between_containers():
 
 def test_no_nvidia_anywhere():
     """BASELINE.json:5 — no NVIDIA driver/userspace in image or manifests."""
-    for name in os.listdir(DEPLOY):
+    names = [
+        n
+        for n in os.listdir(DEPLOY)
+        if os.path.isfile(os.path.join(DEPLOY, n))
+    ]
+    for name in names:
         path = os.path.join(DEPLOY, name)
         with open(path, encoding="utf-8") as fh:
             text = fh.read().lower()

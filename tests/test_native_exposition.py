@@ -1,0 +1,115 @@
+"""Native exposition renderer: build, equivalence, fallback, speed."""
+
+import pytest
+from prometheus_client.parser import text_string_to_metric_families
+
+from tpumon._native import (
+    _flatten,
+    _python_render,
+    native_available,
+    render_families,
+)
+from tpumon.backends.fake import FakeTpuBackend
+from tpumon.config import Config
+from tpumon.exporter.collector import build_families
+
+
+def _device_families():
+    families, _ = build_families(FakeTpuBackend.preset("v5p-64"), Config())
+    return tuple(families)
+
+
+def test_native_builds_on_this_host():
+    # gcc is present here; elsewhere fallback is exercised instead.
+    assert native_available()
+
+
+def test_native_output_semantically_equals_python():
+    fams = _device_families()
+    native = render_families(fams)
+    python = _python_render(fams)
+
+    def parse(text):
+        out = {}
+        for fam in text_string_to_metric_families(text):
+            for s in fam.samples:
+                out[(s.name, tuple(sorted(s.labels.items())))] = s.value
+        return out
+
+    a, b = parse(native.decode()), parse(python.decode())
+    assert a == b
+    assert len(a) > 100  # v5p-64 page is fully populated
+
+
+def test_escaping():
+    from prometheus_client.core import GaugeMetricFamily
+
+    fam = GaugeMetricFamily(
+        "weird_metric",
+        'help with \\ backslash and\nnewline',
+        labels=("label",),
+    )
+    fam.add_metric(('value with "quotes" \\ and\nnewline',), 1.5)
+    text = render_families((fam,)).decode()
+    parsed = list(text_string_to_metric_families(text))
+    assert parsed[0].samples[0].labels["label"] == (
+        'value with "quotes" \\ and\nnewline'
+    )
+    assert parsed[0].documentation == 'help with \\ backslash and\nnewline'
+
+
+def test_flatten_rejects_suffixed_samples():
+    from prometheus_client.core import CounterMetricFamily
+
+    fam = CounterMetricFamily("requests", "doc")
+    fam.add_metric((), 1.0)  # sample name becomes requests_total
+    assert _flatten((fam,)) is None
+    # render_families still works via the fallback renderer.
+    assert b"requests_total" in render_families((fam,))
+
+
+def test_env_kill_switch(monkeypatch):
+    import tpumon._native as native
+
+    monkeypatch.setattr(native, "_tried", False)
+    monkeypatch.setattr(native, "_ext", None)
+    monkeypatch.setenv("TPUMON_NO_NATIVE", "1")
+    assert not native.native_available()
+    fams = _device_families()
+    assert b"accelerator_duty_cycle_percent" in native.render_families(fams)
+    monkeypatch.setattr(native, "_tried", False)
+
+
+@pytest.mark.slow
+def test_native_is_faster():
+    import time
+
+    fams = _device_families()
+    if not native_available():
+        pytest.skip("no compiler")
+
+    def timeit(fn, n=50):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn(fams)
+        return (time.perf_counter() - t0) / n
+
+    native_t = timeit(render_families)
+    python_t = timeit(_python_render)
+    # Not a strict bound (CI noise), but native should win clearly.
+    assert native_t < python_t, (native_t, python_t)
+
+
+def test_nonfinite_values_canonical():
+    from prometheus_client.core import GaugeMetricFamily
+
+    fam = GaugeMetricFamily("edge_metric", "doc", labels=("k",))
+    fam.add_metric(("inf",), float("inf"))
+    fam.add_metric(("ninf",), float("-inf"))
+    fam.add_metric(("nan",), float("nan"))
+    if not native_available():
+        pytest.skip("no compiler")
+    text = render_families((fam,)).decode()
+    assert 'edge_metric{k="inf"} +Inf' in text
+    assert 'edge_metric{k="ninf"} -Inf' in text
+    assert 'edge_metric{k="nan"} NaN' in text

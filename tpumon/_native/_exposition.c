@@ -1,0 +1,181 @@
+/* Fast Prometheus text-exposition renderer (CPython C extension).
+ *
+ * The exporter renders the full device-metric page once per poll cycle
+ * (tpumon/exporter/collector.py SampleCache.publish). This module moves
+ * the label-escaping / string-assembly / float-formatting hot loop to C;
+ * tpumon/_native/__init__.py builds it on demand and falls back to the
+ * prometheus_client renderer when no compiler is available, so the
+ * extension is an optimization, never a dependency.
+ *
+ * Input (prepared by tpumon/_native/__init__.py from metric families):
+ *   families: list of (name: str, help: str, typ: str, samples: list)
+ *   sample:   (label_keys: tuple[str, ...], label_values: tuple[str, ...],
+ *              value: float)
+ * Output: bytes in text format 0.0.4 (same grammar prometheus_client
+ * emits; float formatting via PyOS_double_to_string repr mode so values
+ * round-trip identically to the Python renderer).
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <string.h>
+
+typedef struct {
+    char *buf;
+    Py_ssize_t len;
+    Py_ssize_t cap;
+} strbuf;
+
+static int sb_reserve(strbuf *sb, Py_ssize_t extra) {
+    if (sb->len + extra <= sb->cap) return 0;
+    Py_ssize_t ncap = sb->cap ? sb->cap : 4096;
+    while (ncap < sb->len + extra) ncap *= 2;
+    char *nbuf = PyMem_Realloc(sb->buf, ncap);
+    if (!nbuf) return -1;
+    sb->buf = nbuf;
+    sb->cap = ncap;
+    return 0;
+}
+
+static int sb_put(strbuf *sb, const char *data, Py_ssize_t n) {
+    if (sb_reserve(sb, n) < 0) return -1;
+    memcpy(sb->buf + sb->len, data, n);
+    sb->len += n;
+    return 0;
+}
+
+static int sb_putc(strbuf *sb, char c) { return sb_put(sb, &c, 1); }
+
+/* Escape for HELP text: backslash and newline. */
+static int sb_put_escaped_help(strbuf *sb, const char *s, Py_ssize_t n) {
+    for (Py_ssize_t i = 0; i < n; i++) {
+        char c = s[i];
+        if (c == '\\') { if (sb_put(sb, "\\\\", 2) < 0) return -1; }
+        else if (c == '\n') { if (sb_put(sb, "\\n", 2) < 0) return -1; }
+        else if (sb_putc(sb, c) < 0) return -1;
+    }
+    return 0;
+}
+
+/* Escape for label values: backslash, double-quote, newline. */
+static int sb_put_escaped_label(strbuf *sb, const char *s, Py_ssize_t n) {
+    for (Py_ssize_t i = 0; i < n; i++) {
+        char c = s[i];
+        if (c == '\\') { if (sb_put(sb, "\\\\", 2) < 0) return -1; }
+        else if (c == '"') { if (sb_put(sb, "\\\"", 2) < 0) return -1; }
+        else if (c == '\n') { if (sb_put(sb, "\\n", 2) < 0) return -1; }
+        else if (sb_putc(sb, c) < 0) return -1;
+    }
+    return 0;
+}
+
+static int sb_put_pystr(strbuf *sb, PyObject *obj,
+                        int (*putter)(strbuf *, const char *, Py_ssize_t)) {
+    Py_ssize_t n;
+    const char *s = PyUnicode_AsUTF8AndSize(obj, &n);
+    if (!s) return -1;
+    return putter(sb, s, n);
+}
+
+static int sb_put_raw_pystr(strbuf *sb, PyObject *obj) {
+    Py_ssize_t n;
+    const char *s = PyUnicode_AsUTF8AndSize(obj, &n);
+    if (!s) return -1;
+    return sb_put(sb, s, n);
+}
+
+static PyObject *render(PyObject *self, PyObject *families) {
+    (void)self;
+    if (!PyList_Check(families)) {
+        PyErr_SetString(PyExc_TypeError, "families must be a list");
+        return NULL;
+    }
+    strbuf sb = {NULL, 0, 0};
+
+    Py_ssize_t nfam = PyList_GET_SIZE(families);
+    for (Py_ssize_t f = 0; f < nfam; f++) {
+        PyObject *fam = PyList_GET_ITEM(families, f);
+        PyObject *name, *help, *typ, *samples;
+        if (!PyArg_ParseTuple(fam, "OOOO", &name, &help, &typ, &samples))
+            goto fail;
+
+        if (sb_put(&sb, "# HELP ", 7) < 0) goto fail;
+        if (sb_put_raw_pystr(&sb, name) < 0) goto fail;
+        if (sb_putc(&sb, ' ') < 0) goto fail;
+        if (sb_put_pystr(&sb, help, sb_put_escaped_help) < 0) goto fail;
+        if (sb_put(&sb, "\n# TYPE ", 8) < 0) goto fail;
+        if (sb_put_raw_pystr(&sb, name) < 0) goto fail;
+        if (sb_putc(&sb, ' ') < 0) goto fail;
+        if (sb_put_raw_pystr(&sb, typ) < 0) goto fail;
+        if (sb_putc(&sb, '\n') < 0) goto fail;
+
+        Py_ssize_t nsamp = PyList_GET_SIZE(samples);
+        for (Py_ssize_t i = 0; i < nsamp; i++) {
+            PyObject *samp = PyList_GET_ITEM(samples, i);
+            PyObject *keys, *vals;
+            double value;
+            if (!PyArg_ParseTuple(samp, "OOd", &keys, &vals, &value))
+                goto fail;
+
+            if (sb_put_raw_pystr(&sb, name) < 0) goto fail;
+            Py_ssize_t nlab = PyTuple_GET_SIZE(keys);
+            if (nlab > 0) {
+                if (sb_putc(&sb, '{') < 0) goto fail;
+                for (Py_ssize_t k = 0; k < nlab; k++) {
+                    if (k && sb_putc(&sb, ',') < 0) goto fail;
+                    if (sb_put_raw_pystr(&sb, PyTuple_GET_ITEM(keys, k)) < 0)
+                        goto fail;
+                    if (sb_put(&sb, "=\"", 2) < 0) goto fail;
+                    if (sb_put_pystr(&sb, PyTuple_GET_ITEM(vals, k),
+                                     sb_put_escaped_label) < 0)
+                        goto fail;
+                    if (sb_putc(&sb, '"') < 0) goto fail;
+                }
+                if (sb_putc(&sb, '}') < 0) goto fail;
+            }
+            if (sb_putc(&sb, ' ') < 0) goto fail;
+
+            /* Non-finite values use the canonical Prometheus spellings;
+             * finite ones use repr-mode doubles (round-trip exact). */
+            if (Py_IS_NAN(value)) {
+                if (sb_put(&sb, "NaN", 3) < 0) goto fail;
+            } else if (Py_IS_INFINITY(value)) {
+                if (sb_put(&sb, value > 0 ? "+Inf" : "-Inf", 4) < 0) goto fail;
+            } else {
+                char *num = PyOS_double_to_string(value, 'r', 0,
+                                                  Py_DTSF_ADD_DOT_0, NULL);
+                if (!num) goto fail;
+                int rc = sb_put(&sb, num, (Py_ssize_t)strlen(num));
+                PyMem_Free(num);
+                if (rc < 0) goto fail;
+            }
+            if (sb_putc(&sb, '\n') < 0) goto fail;
+        }
+    }
+
+    PyObject *out = PyBytes_FromStringAndSize(sb.buf, sb.len);
+    PyMem_Free(sb.buf);
+    return out;
+
+fail:
+    PyMem_Free(sb.buf);
+    if (!PyErr_Occurred())
+        PyErr_SetString(PyExc_RuntimeError, "exposition render failed");
+    return NULL;
+}
+
+static PyMethodDef methods[] = {
+    {"render", render, METH_O,
+     "render(families) -> bytes — Prometheus text exposition 0.0.4"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_exposition",
+    "Native Prometheus text-exposition renderer", -1, methods,
+    NULL, NULL, NULL, NULL,
+};
+
+PyMODINIT_FUNC PyInit__exposition(void) {
+    return PyModule_Create(&moduledef);
+}

@@ -1,0 +1,3 @@
+from tpumon.attribution.client import PodAttribution, PodResourcesClient
+
+__all__ = ["PodAttribution", "PodResourcesClient"]

@@ -1,0 +1,160 @@
+"""Chip→pod attribution via the kubelet pod-resources API
+(SURVEY.md §7 hard part (d)).
+
+The reference genre's DCGM path attributes GPU metrics to processes via
+driver accounting; there is no TPU equivalent, so tpumon maps **device IDs
+to pods** the Kubernetes-native way: the kubelet's pod-resources gRPC
+service (`unix:///var/lib/kubelet/pod-resources/kubelet.sock`, stable v1
+API) lists which ``google.com/tpu`` device IDs each container was
+allocated. Joined with discovery's chip inventory this yields the
+``accelerator_pod_info{namespace,pod,container,chip}`` family that lets
+Grafana slice every per-chip gauge by workload.
+
+grpc_tools is not installed here, so the client uses grpcio's generic
+``unary_unary`` with protoc-generated message classes
+(``podresources_pb2.py``, regenerated from ``podresources.proto``).
+Failure of any kind degrades to "no attribution" — the exporter's device
+metrics never depend on this path.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+log = logging.getLogger(__name__)
+
+KUBELET_SOCKET = "unix:///var/lib/kubelet/pod-resources/kubelet.sock"
+_METHOD = "/v1.PodResourcesLister/List"
+
+#: Resource names treated as accelerator devices, in the unified schema
+#: spirit: TPU and GPU pools attribute identically.
+ACCELERATOR_RESOURCES = ("google.com/tpu", "nvidia.com/gpu")
+
+
+@dataclass(frozen=True)
+class PodDevice:
+    namespace: str
+    pod: str
+    container: str
+    resource: str
+    device_id: str
+
+
+class PodResourcesClient:
+    """Thin client over the kubelet pod-resources List RPC."""
+
+    def __init__(self, socket_addr: str = KUBELET_SOCKET, timeout: float = 2.0):
+        self.addr = socket_addr
+        self.timeout = timeout
+        self._channel = None
+        self._call = None
+
+    def _ensure(self) -> bool:
+        if self._call is not None:
+            return True
+        try:
+            import grpc
+        except ImportError as exc:
+            # The feature was enabled but can't work at all — say so once,
+            # above DEBUG (it would otherwise vanish silently).
+            log.warning("pod attribution disabled: grpcio not installed (%s)", exc)
+            return False
+        try:
+            from tpumon.attribution import podresources_pb2 as pb
+
+            self._channel = grpc.insecure_channel(self.addr)
+            self._call = self._channel.unary_unary(
+                _METHOD,
+                request_serializer=pb.ListPodResourcesRequest.SerializeToString,
+                response_deserializer=pb.ListPodResourcesResponse.FromString,
+            )
+            self._pb = pb
+            return True
+        except Exception as exc:
+            log.debug("pod-resources client unavailable: %s", exc)
+            return False
+
+    def list_devices(self) -> list[PodDevice] | None:
+        """Accelerator device allocations; None on FAILURE (socket down,
+        grpcio missing), [] when the node genuinely has no accelerator
+        pods — callers must treat the two differently."""
+        if not self._ensure():
+            return None
+        try:
+            resp = self._call(
+                self._pb.ListPodResourcesRequest(), timeout=self.timeout
+            )
+        except Exception as exc:
+            log.debug("pod-resources List failed: %s", exc)
+            return None
+        out: list[PodDevice] = []
+        for pod in resp.pod_resources:
+            for container in pod.containers:
+                for dev in container.devices:
+                    if dev.resource_name not in ACCELERATOR_RESOURCES:
+                        continue
+                    for device_id in dev.device_ids:
+                        out.append(
+                            PodDevice(
+                                namespace=pod.namespace,
+                                pod=pod.name,
+                                container=container.name,
+                                resource=dev.resource_name,
+                                device_id=str(device_id),
+                            )
+                        )
+        return out
+
+    def close(self) -> None:
+        if self._channel is not None:
+            try:
+                self._channel.close()
+            except Exception:
+                pass
+            self._channel = None
+            self._call = None
+
+
+class PodAttribution:
+    """Builds the accelerator_pod_info family for the poll loop.
+
+    Backs off after failures: off-cluster there is no kubelet socket, and
+    the 1 Hz poll budget must not pay a connection attempt every cycle.
+    """
+
+    FAILURE_BACKOFF_S = 60.0
+
+    def __init__(self, client: PodResourcesClient | None = None) -> None:
+        self.client = client or PodResourcesClient()
+        self._next_try = 0.0
+
+    def families(self, base_keys: tuple, base_vals: tuple):
+        import time
+
+        from prometheus_client.core import GaugeMetricFamily
+
+        now = time.monotonic()
+        if now < self._next_try:
+            return
+        devices = self.client.list_devices()
+        if devices is None:  # failure → back off
+            self._next_try = now + self.FAILURE_BACKOFF_S
+            return
+        self._next_try = 0.0
+        if not devices:  # healthy but no accelerator pods: keep polling
+            return
+        fam = GaugeMetricFamily(
+            "accelerator_pod_info",
+            "Accelerator devices allocated to pods (kubelet pod-resources "
+            "API); joins per-chip gauges to workloads. Value is 1.",
+            labels=base_keys
+            + ("namespace", "pod", "container", "resource", "chip"),
+        )
+        for d in devices:
+            fam.add_metric(
+                base_vals
+                + (d.namespace, d.pod, d.container, d.resource, d.device_id),
+                1.0,
+            )
+        yield fam

@@ -85,6 +85,11 @@ class Config:
     grpc_timeout: float = 2.0
     #: Emit per-link ICI gauges (can be high-cardinality on big slices).
     ici_per_link: bool = True
+    #: Chip→pod attribution via the kubelet pod-resources API; degrades
+    #: silently to absent off-cluster.
+    pod_attribution: bool = True
+    #: kubelet pod-resources gRPC socket.
+    kubelet_socket: str = "unix:///var/lib/kubelet/pod-resources/kubelet.sock"
     #: Log level name.
     log_level: str = "INFO"
     #: Path where the discovery sidecar writes topology JSON.
@@ -106,6 +111,9 @@ class Config:
             grpc_addr=_env("GRPC_ADDR", base.grpc_addr) or base.grpc_addr,
             grpc_timeout=_env_float("GRPC_TIMEOUT", base.grpc_timeout),
             ici_per_link=_env_bool("ICI_PER_LINK", base.ici_per_link),
+            pod_attribution=_env_bool("POD_ATTRIBUTION", base.pod_attribution),
+            kubelet_socket=_env("KUBELET_SOCKET", base.kubelet_socket)
+            or base.kubelet_socket,
             log_level=_env("LOG_LEVEL", base.log_level) or base.log_level,
             topology_out=_env("TOPOLOGY_OUT", base.topology_out)
             or base.topology_out,
@@ -125,6 +133,7 @@ class Config:
         g.add_argument("--grpc-addr", help="monitoring gRPC address")
         g.add_argument("--grpc-timeout", type=float, help="gRPC timeout seconds")
         g.add_argument("--log-level", help="log level")
+        g.add_argument("--kubelet-socket", help="pod-resources gRPC socket")
         g.add_argument("--topology-out", help="sidecar topology JSON path")
 
     def with_args(self, args: argparse.Namespace) -> "Config":

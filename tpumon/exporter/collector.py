@@ -35,16 +35,6 @@ class PollStats:
     coverage: float = 1.0
 
 
-class _FamiliesShim:
-    """Duck-typed registry: exposition renders anything with .collect()."""
-
-    def __init__(self, families: tuple[Metric, ...]) -> None:
-        self._families = families
-
-    def collect(self):
-        return self._families
-
-
 class SampleCache:
     """Atomic snapshot holder shared by the poller and HTTP threads.
 
@@ -60,10 +50,10 @@ class SampleCache:
         self._rendered: bytes = b""
 
     def publish(self, families: list[Metric]) -> None:
-        from prometheus_client.exposition import generate_latest
+        from tpumon._native import render_families
 
         snap = tuple(families)
-        rendered = generate_latest(_FamiliesShim(snap))
+        rendered = render_families(snap)
         with self._lock:
             self._snapshot = snap
             self._rendered = rendered
@@ -139,7 +129,9 @@ def _topology_families(topo, base_keys, base_vals) -> list[Metric]:
     return [count, cores, hosts, info]
 
 
-def build_families(backend: Backend, cfg: Config) -> tuple[list[Metric], PollStats]:
+def build_families(
+    backend: Backend, cfg: Config, attribution=None
+) -> tuple[list[Metric], PollStats]:
     """One poll cycle: query every enabled metric, parse, build families.
 
     Runs only on the poller thread. Every failure mode degrades to a
@@ -225,6 +217,14 @@ def build_families(backend: Backend, cfg: Config) -> tuple[list[Metric], PollSta
                 fam.add_metric(base_vals + (str(core), str(state)), 1.0)
             families.append(fam)
 
+    # Chip→pod attribution (kubelet pod-resources API, SURVEY §7(d)):
+    # optional, never fatal, absent off-cluster.
+    if attribution is not None:
+        try:
+            families.extend(attribution.families(base_keys, base_vals))
+        except Exception as exc:
+            log.debug("pod attribution failed: %s", exc)
+
     stats.unmapped = tuple(unmapped)
     stats.families = len(families)
     if unmapped:
@@ -241,11 +241,13 @@ class Poller:
         cfg: Config,
         cache: SampleCache,
         telemetry: SelfTelemetry,
+        attribution=None,
     ) -> None:
         self._backend = backend
         self._cfg = cfg
         self._cache = cache
         self._telemetry = telemetry
+        self._attribution = attribution
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="tpumon-poller", daemon=True
@@ -259,7 +261,9 @@ class Poller:
         advance = getattr(self._backend, "advance", None)
         if advance is not None:
             advance()
-        families, stats = build_families(self._backend, self._cfg)
+        families, stats = build_families(
+            self._backend, self._cfg, self._attribution
+        )
         self._cache.publish(families)
         elapsed = time.monotonic() - t0
 

@@ -144,7 +144,21 @@ class Exporter:
         self.registry = CollectorRegistry()
         self.telemetry = SelfTelemetry(self.registry)
         self.cache = SampleCache()
-        self.poller = Poller(backend, cfg, self.cache, self.telemetry)
+        # Start the native-renderer build off the poll path; renders use
+        # the Python fallback until it's ready.
+        from tpumon import _native
+
+        _native.prewarm_async()
+        attribution = None
+        if cfg.pod_attribution:
+            from tpumon.attribution import PodAttribution, PodResourcesClient
+
+            attribution = PodAttribution(
+                PodResourcesClient(cfg.kubelet_socket, cfg.grpc_timeout)
+            )
+        self.poller = Poller(
+            backend, cfg, self.cache, self.telemetry, attribution
+        )
         version_fn = getattr(backend, "version", None)
         self.telemetry.backend_info.labels(
             backend=backend.name,

@@ -14,29 +14,12 @@ import pytest
 
 DASH_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "dashboards")
 
-#: Families the exporter can serve (schema + identity + self-telemetry +
-#: workload-side counters).
+#: Families the exporter can serve — sourced from the canonical registry
+#: (tpumon/families.py) so dashboards/docs/code can't drift apart.
 def _known_metric_names():
-    from tpumon.schema import LIBTPU_SPECS
+    from tpumon.families import all_family_names
 
-    names = {s.family for s in LIBTPU_SPECS}
-    names |= {
-        "accelerator_device_count",
-        "accelerator_core_count",
-        "accelerator_slice_host_count",
-        "accelerator_info",
-        "accelerator_core_state",
-        "exporter_scrape_duration_seconds",
-        "exporter_poll_duration_seconds",
-        "exporter_metric_coverage_ratio",
-        "exporter_backend_info",
-        "collector_errors_total",
-        "collector_polls_total",
-        "collector_last_poll_timestamp_seconds",
-        "collector_poll_lag_seconds",
-        "workload_collective_ops_total",
-        "workload_hlo_log_events_total",
-    }
+    names = all_family_names()
     # Histogram exposition suffixes.
     names |= {
         n + suffix

@@ -1,0 +1,44 @@
+"""docs/METRICS.md must stay generated from the schema."""
+
+from tpumon.tools.gen_metrics_doc import main
+
+
+def test_metrics_doc_not_stale():
+    assert main(["--check"]) == 0
+
+
+def test_registry_matches_live_scrape():
+    """tpumon/families.py must describe what the exporter actually emits."""
+    from prometheus_client.parser import text_string_to_metric_families
+
+    from tpumon._native import _python_render
+    from tpumon.backends.fake import FakeTpuBackend
+    from tpumon.config import Config
+    from tpumon.exporter.collector import build_families
+    from tpumon.families import IDENTITY_FAMILIES, all_family_names
+    from tpumon.schema import LIBTPU_SPECS
+
+    families, _ = build_families(FakeTpuBackend.preset("v5p-64"), Config())
+    served = set()
+    labels_by_family = {}
+    for fam in text_string_to_metric_families(_python_render(tuple(families)).decode()):
+        served.add(fam.name)
+        for s in fam.samples:
+            labels_by_family.setdefault(fam.name, set()).update(s.labels)
+
+    # Everything served is registered.
+    unknown = served - all_family_names()
+    assert not unknown, f"served families missing from tpumon/families.py: {unknown}"
+
+    # Everything the fake can produce is served (pod_info needs a kubelet).
+    expected = {s.family for s in LIBTPU_SPECS} | (
+        set(IDENTITY_FAMILIES) - {"accelerator_pod_info"}
+    )
+    missing = expected - served
+    assert not missing, f"registered families not served: {missing}"
+
+    # Registered extra labels match reality for identity families.
+    base = {"slice", "host", "worker", "accelerator"}
+    for name, (_, extra) in IDENTITY_FAMILIES.items():
+        if name in labels_by_family:
+            assert labels_by_family[name] == base | set(extra), name

@@ -129,7 +129,30 @@ class PodAttribution:
         self.client = client or PodResourcesClient()
         self._next_try = 0.0
 
-    def families(self, base_keys: tuple, base_vals: tuple):
+    @staticmethod
+    def _chip_label(device_id: str, topology) -> str:
+        """Map a kubelet device ID onto the exporter's chip index label.
+
+        Device metrics label chips by 0-based index (tpumon/parsing.py);
+        kubelet device IDs are plugin-defined — bare indices on GKE TPU
+        node pools, UUIDs for NVIDIA GPUs. Match against the discovered
+        chip inventory first, then accept bare indices; otherwise the
+        chip label is empty (the raw ID stays in ``device_id``) so joins
+        fail visibly rather than silently matching nothing.
+        """
+        if topology is not None:
+            for chip in topology.chips:
+                if chip.device_id and chip.device_id == device_id:
+                    return str(chip.index)
+            if device_id.isdigit() and int(device_id) < max(
+                topology.num_chips, 1
+            ):
+                return device_id
+        elif device_id.isdigit():
+            return device_id
+        return ""
+
+    def families(self, base_keys: tuple, base_vals: tuple, topology=None):
         import time
 
         from prometheus_client.core import GaugeMetricFamily
@@ -147,14 +170,22 @@ class PodAttribution:
         fam = GaugeMetricFamily(
             "accelerator_pod_info",
             "Accelerator devices allocated to pods (kubelet pod-resources "
-            "API); joins per-chip gauges to workloads. Value is 1.",
+            "API); `chip` matches the device metrics' chip index for "
+            "joins, `device_id` keeps the raw kubelet ID. Value is 1.",
             labels=base_keys
-            + ("namespace", "pod", "container", "resource", "chip"),
+            + ("namespace", "pod", "container", "resource", "chip", "device_id"),
         )
         for d in devices:
             fam.add_metric(
                 base_vals
-                + (d.namespace, d.pod, d.container, d.resource, d.device_id),
+                + (
+                    d.namespace,
+                    d.pod,
+                    d.container,
+                    d.resource,
+                    self._chip_label(d.device_id, topology),
+                    d.device_id,
+                ),
                 1.0,
             )
         yield fam

@@ -51,14 +51,16 @@ def create_backend(cfg: Config) -> Backend:
 
 
 def _autodetect() -> str:
+    # Decide on the monitoring SDK itself, not on chip discovery: the
+    # metrics surface keeps working even when the compute runtime is
+    # wedged or detached (observed live), and discovery may then report
+    # zero chips.
     try:
-        from libtpu.sdk import tpumonitoring  # noqa: F401
+        from libtpu.sdk import tpumonitoring
 
-        from tpumon.discovery.topology import discover
-
-        if discover().num_chips > 0:
+        if tpumonitoring.list_supported_metrics():
             return "libtpu"
-        log.info("libtpu importable but no chips discovered; using stub")
+        log.info("libtpu reports no supported metrics; using stub")
         return "stub"
     except Exception as exc:
         log.info("libtpu unavailable (%s); using stub", exc)

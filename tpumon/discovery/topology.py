@@ -159,20 +159,76 @@ def _libtpu_coords(num_chips: int) -> list[tuple[int, int, int] | None]:
         return [None] * num_chips
 
 
-def _jax_chip_count() -> tuple[int, str]:
-    """Fallback enumeration via JAX local devices. Returns (chips, platform)."""
-    try:
-        import jax
+def _dev_accel_count() -> int:
+    """Count /dev/accel* device nodes (present on real TPU VMs/nodes)."""
+    import glob
 
-        devices = jax.local_devices()
-        platform = devices[0].platform if devices else "none"
-        if platform != "tpu":
-            return 0, platform
-        chip_ids = {getattr(d, "id", i) for i, d in enumerate(devices)}
-        return len(chip_ids), platform
-    except Exception as exc:
-        log.debug("jax enumeration unavailable: %s", exc)
+    return len(glob.glob("/dev/accel*"))
+
+
+#: How long the JAX-based fallback may take before discovery gives up.
+#: Initializing JAX attaches to the TPU runtime, which can HANG when the
+#: runtime is wedged (observed live on this host) — a monitoring agent
+#: must degrade to stub mode instead of hanging at startup.
+JAX_DISCOVERY_TIMEOUT_S = 15.0
+
+
+#: Single shared probe state: at most ONE jax-enumeration thread ever
+#: exists per process. The sidecar re-runs discover() every refresh
+#: interval; without this, a wedged runtime would stack a new permanently
+#: hung thread (and re-pay the 15s stall) every cycle.
+_jax_probe_lock = None
+_jax_probe_thread = None
+_jax_probe_result: list[tuple[int, str]] = []
+
+
+def _jax_chip_count() -> tuple[int, str]:
+    """Fallback enumeration via JAX local devices, bounded by a timeout.
+
+    The probe runs in a single daemon thread shared across calls; on
+    timeout, discovery reports zero chips (stub mode) immediately and
+    later calls pick up the result if the probe ever completes.
+    """
+    import threading
+
+    global _jax_probe_lock, _jax_probe_thread
+    if _jax_probe_lock is None:
+        _jax_probe_lock = threading.Lock()
+
+    def probe() -> None:
+        try:
+            import jax
+
+            devices = jax.local_devices()
+            platform = devices[0].platform if devices else "none"
+            if platform != "tpu":
+                _jax_probe_result.append((0, platform))
+                return
+            chip_ids = {getattr(d, "id", i) for i, d in enumerate(devices)}
+            _jax_probe_result.append((len(chip_ids), platform))
+        except Exception as exc:
+            log.debug("jax enumeration unavailable: %s", exc)
+            _jax_probe_result.append((0, "none"))
+
+    with _jax_probe_lock:
+        if _jax_probe_result:
+            return _jax_probe_result[0]
+        if _jax_probe_thread is None:
+            _jax_probe_thread = threading.Thread(
+                target=probe, name="tpumon-jax-discover", daemon=True
+            )
+            _jax_probe_thread.start()
+        thread = _jax_probe_thread
+
+    thread.join(timeout=JAX_DISCOVERY_TIMEOUT_S)
+    if not _jax_probe_result:
+        log.warning(
+            "jax device enumeration timed out after %.0fs (TPU runtime "
+            "wedged?); continuing with zero chips",
+            JAX_DISCOVERY_TIMEOUT_S,
+        )
         return 0, "none"
+    return _jax_probe_result[0]
 
 
 def discover(topology_file: str | None = None) -> Topology:
@@ -203,6 +259,12 @@ def discover(topology_file: str | None = None) -> Topology:
     )
 
     num_chips = _chips_from_bounds(env.get("TPU_CHIPS_PER_HOST_BOUNDS", ""))
+    if num_chips == 0:
+        # Cheap and hang-proof before the JAX fallback: real TPU nodes
+        # expose /dev/accel* device nodes.
+        num_chips = _dev_accel_count()
+        if num_chips and not accel:
+            accel = "tpu"
     if num_chips == 0:
         num_chips, platform = _jax_chip_count()
         if num_chips and not accel:

@@ -1,0 +1,113 @@
+"""Operational self-test CLI — the `dcgmi discovery` analogue.
+
+``python -m tpumon.doctor [--backend ...]`` prints what the exporter
+would see on this node: backend resolution, topology identity, per-metric
+sample status (ok / empty=runtime-detached / error), coverage vs the ≥95%
+BASELINE target, and pod-attribution availability. Exit code 0 when
+coverage meets the target (or the node is a deviceless stub), 1 otherwise
+— usable as an init-container sanity gate.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from tpumon.backends import create_backend
+from tpumon.backends.base import BackendError
+from tpumon.config import Config
+from tpumon.parsing import parse
+from tpumon.schema import coverage, spec_for
+
+COVERAGE_TARGET = 0.95
+
+
+def run(cfg: Config, out=sys.stdout) -> int:
+    def p(line: str = "") -> None:
+        print(line, file=out)
+
+    try:
+        backend = create_backend(cfg)
+    except BackendError as exc:
+        p(f"backend: FAILED to initialize ({exc})")
+        return 1
+
+    try:
+        version_fn = getattr(backend, "version", None)
+        p(f"backend: {backend.name} (version {version_fn() if version_fn else '?'})")
+
+        topo = backend.topology()
+        p(
+            f"topology: {topo.accelerator_type} | slice={topo.slice_name} "
+            f"host={topo.hostname} worker={topo.worker_id}/{topo.num_hosts} "
+            f"chips={topo.num_chips} cores={topo.num_cores}"
+        )
+        for chip in topo.chips:
+            coords = ",".join(str(c) for c in chip.coords) if chip.coords else "?"
+            p(f"  chip {chip.index}: coords=({coords}) id={chip.device_id}")
+
+        try:
+            supported = backend.list_metrics()
+        except Exception as exc:
+            p(f"metrics: enumeration FAILED ({exc})")
+            return 1
+
+        p(f"\nmetrics ({len(supported)} supported):")
+        attached = False
+        for name in supported:
+            spec = spec_for(name)
+            if spec is None:
+                p(f"  {name:34s} -> UNMAPPED (coverage gap)")
+                continue
+            try:
+                raw = backend.sample(name)
+            except Exception as exc:
+                p(f"  {name:34s} -> ERROR: {exc}")
+                continue
+            if raw.empty:
+                p(f"  {name:34s} -> {spec.family} (no data: runtime detached)")
+                continue
+            result = parse(raw, spec)
+            attached = True
+            p(
+                f"  {name:34s} -> {spec.family} "
+                f"({len(result.points)} points"
+                + (f", {result.errors} parse errors" if result.errors else "")
+                + ")"
+            )
+
+        cov = coverage(supported)
+        p(f"\ncoverage: {cov:.1%} (target >= {COVERAGE_TARGET:.0%})")
+        if supported and not attached:
+            p(
+                "note: all metrics empty — no runtime/workload attached to "
+                "the accelerator (expected on idle nodes; SURVEY.md §2.2)"
+            )
+
+        from tpumon.attribution import PodResourcesClient
+
+        client = PodResourcesClient(cfg.kubelet_socket, cfg.grpc_timeout)
+        devices = client.list_devices()
+        client.close()
+        if devices is None:
+            p("pod attribution: unavailable (no kubelet socket / grpcio)")
+        else:
+            p(f"pod attribution: OK ({len(devices)} accelerator allocations)")
+
+        if topo.num_chips == 0 and not supported:
+            p("\nverdict: OK (deviceless node, stub mode)")
+            return 0
+        if cov >= COVERAGE_TARGET:
+            p("\nverdict: OK")
+            return 0
+        p("\nverdict: COVERAGE BELOW TARGET")
+        return 1
+    finally:
+        backend.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    return run(Config.load(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

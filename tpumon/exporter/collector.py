@@ -221,7 +221,7 @@ def build_families(
     # optional, never fatal, absent off-cluster.
     if attribution is not None:
         try:
-            families.extend(attribution.families(base_keys, base_vals))
+            families.extend(attribution.families(base_keys, base_vals, topo))
         except Exception as exc:
             log.debug("pod attribution failed: %s", exc)
 

@@ -14,7 +14,12 @@ import socket
 import threading
 import time
 from socketserver import ThreadingMixIn
-from wsgiref.simple_server import WSGIRequestHandler, WSGIServer, make_server
+from wsgiref.simple_server import (
+    ServerHandler,
+    WSGIRequestHandler,
+    WSGIServer,
+    make_server,
+)
 
 from prometheus_client import exposition
 from prometheus_client.registry import CollectorRegistry
@@ -31,6 +36,49 @@ HEALTH_STALE_INTERVALS = 5.0
 
 
 class _Handler(WSGIRequestHandler):
+    """HTTP/1.1 keep-alive so Prometheus reuses its scrape connection.
+
+    Plain wsgiref serves ONE request per connection (its ``handle`` never
+    loops) and stamps HTTP/1.0 status lines regardless of
+    ``protocol_version`` — so this re-implements ``handle`` as the
+    standard BaseHTTPRequestHandler loop and forces the handler's HTTP
+    version. Every response carries an exact Content-Length (see
+    ``_make_app``), which persistent connections require.
+    """
+
+    protocol_version = "HTTP/1.1"
+
+    def handle(self) -> None:
+        self.close_connection = True
+        self.handle_one_request()
+        while not self.close_connection:
+            self.handle_one_request()
+
+    def handle_one_request(self) -> None:
+        self.raw_requestline = self.rfile.readline(65537)
+        if len(self.raw_requestline) > 65536:
+            self.requestline = ""
+            self.request_version = ""
+            self.command = ""
+            self.send_error(414)
+            self.close_connection = True
+            return
+        if not self.raw_requestline:
+            self.close_connection = True
+            return
+        if not self.parse_request():  # sets close_connection itself
+            return
+        handler = ServerHandler(
+            self.rfile,
+            self.wfile,
+            self.get_stderr(),
+            self.get_environ(),
+            multithread=True,
+        )
+        handler.http_version = "1.1"
+        handler.request_handler = self
+        handler.run(self.server.get_app())
+
     def log_message(self, *args) -> None:  # keep scrape noise out of logs
         pass
 

@@ -1,0 +1,94 @@
+"""Canonical registry of every Prometheus family tpumon can serve.
+
+Single source of truth consumed by the metrics-reference generator
+(tpumon/tools/gen_metrics_doc.py), the dashboard PromQL validator
+(tests/test_dashboards.py), and a live-scrape coherence test — so the
+docs, dashboards, and code cannot drift apart silently. The device
+families themselves live in tpumon/schema.py (LIBTPU_SPECS); this module
+covers everything else the exporter and harness emit.
+"""
+
+from __future__ import annotations
+
+#: family -> (description, extra labels beyond the base identity labels)
+IDENTITY_FAMILIES: dict[str, tuple[str, tuple[str, ...]]] = {
+    "accelerator_device_count": (
+        "Chips visible to this exporter (0 on CPU-only nodes)",
+        (),
+    ),
+    "accelerator_core_count": (
+        "Compute cores visible to this exporter",
+        (),
+    ),
+    "accelerator_slice_host_count": (
+        "Hosts in this accelerator slice",
+        (),
+    ),
+    "accelerator_info": (
+        "Per-chip identity incl. physical coords (PCIe-BDF replacement)",
+        ("chip", "coords", "device_id", "cores"),
+    ),
+    "accelerator_core_state": (
+        "Per-core runtime state from the device monitoring service",
+        ("core", "state"),
+    ),
+    "accelerator_pod_info": (
+        "Accelerator devices allocated to pods (kubelet pod-resources API)",
+        ("namespace", "pod", "container", "resource", "chip", "device_id"),
+    ),
+}
+
+#: family -> (prometheus type, description)
+SELF_FAMILIES: dict[str, tuple[str, str]] = {
+    "exporter_scrape_duration_seconds": (
+        "histogram",
+        "Wall time to render one /metrics exposition (headline p99)",
+    ),
+    "exporter_poll_duration_seconds": (
+        "histogram",
+        "Wall time of one device poll cycle",
+    ),
+    "exporter_metric_coverage_ratio": (
+        "gauge",
+        "Mapped fraction of the device library's supported metrics "
+        "(target ≥0.95; 0.0 during enumeration outages)",
+    ),
+    "exporter_backend_info": (
+        "gauge",
+        "Active backend name + device-library version",
+    ),
+    "collector_errors_total": (
+        "counter",
+        "Device-query / parse failures by kind (samples dropped, never fatal)",
+    ),
+    "collector_polls_total": ("counter", "Completed poll cycles"),
+    "collector_last_poll_timestamp_seconds": (
+        "gauge",
+        "Unix time of the last completed poll (liveness)",
+    ),
+    "collector_poll_lag_seconds": (
+        "gauge",
+        "Overrun of the configured interval (0 when keeping up)",
+    ),
+}
+
+#: family -> description (workload-side harness --metrics-port)
+WORKLOAD_FAMILIES: dict[str, str] = {
+    "workload_collective_ops_total": (
+        "XLA collective HLO ops seen by the in-process libtpu HLO logger, by op"
+    ),
+    "workload_hlo_log_events_total": (
+        "Total HLO logger events received in-process"
+    ),
+}
+
+
+def all_family_names() -> set[str]:
+    from tpumon.schema import LIBTPU_SPECS
+
+    return (
+        {s.family for s in LIBTPU_SPECS}
+        | set(IDENTITY_FAMILIES)
+        | set(SELF_FAMILIES)
+        | set(WORKLOAD_FAMILIES)
+    )

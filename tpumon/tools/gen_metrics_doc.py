@@ -1,0 +1,118 @@
+"""Generate docs/METRICS.md from the schema — the single source of truth.
+
+``python -m tpumon.tools.gen_metrics_doc [--check]``: writes the metrics
+reference; with ``--check`` exits 1 if the committed file is stale
+(used by tests so the doc can never drift from tpumon/schema.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from tpumon.families import (
+    IDENTITY_FAMILIES,
+    SELF_FAMILIES,
+    WORKLOAD_FAMILIES,
+)
+from tpumon.schema import LIBTPU_SPECS
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+OUT = os.path.join(REPO, "docs", "METRICS.md")
+
+BASE_LABELS = "`slice`, `host`, `worker`, `accelerator`"
+
+IDENTITY = [
+    (name, desc, ", ".join(f"`{l}`" for l in labels))
+    for name, (desc, labels) in IDENTITY_FAMILIES.items()
+]
+SELF = [(name, typ, desc) for name, (typ, desc) in SELF_FAMILIES.items()]
+WORKLOAD = list(WORKLOAD_FAMILIES.items())
+
+
+def render() -> str:
+    lines = [
+        "# tpumon metrics reference",
+        "",
+        "Generated from `tpumon/schema.py` by `python -m tpumon.tools.gen_metrics_doc`",
+        "— do not edit by hand (a test regenerates and compares).",
+        "",
+        f"Every `accelerator_*` sample carries the host-identity base labels: {BASE_LABELS}.",
+        "",
+        "## Device metrics (unified `accelerator_*` schema)",
+        "",
+        "One vendor-neutral family per device-library metric; the libtpu column",
+        "is the source on TPU nodes, the NVML-compat backend feeds the same",
+        "families on GPU nodes of a mixed pool. **Absent ≠ zero**: when no",
+        "runtime is attached, the family is absent for that scrape.",
+        "",
+        "| Prometheus family | libtpu source | extra labels | description |",
+        "|---|---|---|---|",
+    ]
+    for spec in LIBTPU_SPECS:
+        labels = ", ".join(f"`{l}`" for l in spec.labels) or "—"
+        lines.append(
+            f"| `{spec.family}` | `{spec.source}` | {labels} | {spec.help} |"
+        )
+
+    lines += [
+        "",
+        "Percentile families carry `stat` ∈ {mean, p50, p90, p95, p999}.",
+        "",
+        "## Identity & attribution",
+        "",
+        "| family | description | extra labels |",
+        "|---|---|---|",
+    ]
+    for name, desc, labels in IDENTITY:
+        lines.append(f"| `{name}` | {desc} | {labels or '—'} |")
+
+    lines += [
+        "",
+        "## Exporter self-telemetry",
+        "",
+        "| family | type | description |",
+        "|---|---|---|",
+    ]
+    for name, typ, desc in SELF:
+        lines.append(f"| `{name}` | {typ} | {desc} |")
+
+    lines += [
+        "",
+        "## Workload-side counters (harness `--metrics-port`)",
+        "",
+        "| family | description |",
+        "|---|---|",
+    ]
+    for name, desc in WORKLOAD:
+        lines.append(f"| `{name}` | {desc} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--check", action="store_true")
+    args = parser.parse_args(argv)
+    content = render()
+    if args.check:
+        try:
+            with open(OUT, encoding="utf-8") as fh:
+                current = fh.read()
+        except OSError:
+            current = ""
+        if current != content:
+            print("docs/METRICS.md is stale; regenerate with "
+                  "python -m tpumon.tools.gen_metrics_doc", file=sys.stderr)
+            return 1
+        return 0
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w", encoding="utf-8") as fh:
+        fh.write(content)
+    print(f"wrote {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

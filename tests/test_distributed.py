@@ -1,0 +1,78 @@
+"""Multi-host (jax.distributed) harness path — SURVEY §3.5's multi-host
+boundary, simulated as 2 OS processes × 4 virtual CPU devices forming one
+8-device mesh with cross-process collectives."""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_train_step():
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # harness sets its own device count
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "tpumon.workload.harness",
+                "--steps",
+                "2",
+                "--dp",
+                "4",
+                "--tp",
+                "2",
+                "--platform",
+                "cpu",
+                "--coordinator",
+                f"127.0.0.1:{port}",
+                "--num-processes",
+                "2",
+                "--process-id",
+                str(i),
+            ],
+            cwd=REPO,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+            assert p.returncode == 0, out[-2000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+
+    losses = []
+    for out in outs:
+        assert re.search(r"distributed: process \d/2, 4 local / 8 global", out), out[-1500:]
+        m = re.search(r"loss ([\d.]+) → ([\d.]+)", out)
+        assert m, out[-1500:]
+        losses.append((float(m.group(1)), float(m.group(2))))
+
+    # Both processes computed the same global step: losses must agree.
+    assert losses[0] == pytest.approx(losses[1], rel=1e-6)
+    assert losses[0][1] < losses[0][0]  # and training still descends

@@ -94,9 +94,10 @@ _CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 def _make_app(render_body, telemetry: SelfTelemetry, health):
-    """WSGI app. ``render_body() -> bytes`` produces the /metrics payload;
-    the exporter passes cached-bytes + self-telemetry concatenation, the
-    sidecar a plain registry render."""
+    """WSGI app. ``render_body(want_gzip: bool) -> bytes`` produces the
+    /metrics payload (already gzip-encoded when asked); the exporter
+    passes cached-bytes + self-telemetry concatenation, the sidecar a
+    plain registry render."""
 
     def app(environ, start_response):
         path = environ.get("PATH_INFO", "/")
@@ -115,13 +116,13 @@ def _make_app(render_body, telemetry: SelfTelemetry, health):
         if path in ("/metrics", "/"):
             t0 = time.perf_counter()
             try:
-                body = render_body()
-                headers = [("Content-Type", _CONTENT_TYPE)]
                 # Prometheus sends Accept-Encoding: gzip on every scrape;
                 # at 1 Hz × full families the ~10x shrink matters on the
-                # pod network. level 1: ~0.2 ms for a ~35 KB page.
-                if "gzip" in environ.get("HTTP_ACCEPT_ENCODING", ""):
-                    body = gzip.compress(body, compresslevel=1)
+                # pod network.
+                want_gzip = "gzip" in environ.get("HTTP_ACCEPT_ENCODING", "")
+                body = render_body(want_gzip)
+                headers = [("Content-Type", _CONTENT_TYPE)]
+                if want_gzip:
                     headers.append(("Content-Encoding", "gzip"))
                 headers.append(("Content-Length", str(len(body))))
                 start_response("200 OK", headers)
@@ -142,7 +143,11 @@ def _make_app(render_body, telemetry: SelfTelemetry, health):
 
 
 def registry_renderer(registry: CollectorRegistry):
-    return lambda: exposition.generate_latest(registry)
+    def render(want_gzip: bool) -> bytes:
+        body = exposition.generate_latest(registry)
+        return gzip.compress(body, compresslevel=1) if want_gzip else body
+
+    return render
 
 
 class ExporterServer:
@@ -213,10 +218,16 @@ class Exporter:
             version=version_fn() if version_fn else "unknown",
         ).set(1)
 
-        def render() -> bytes:
-            return self.cache.rendered() + exposition.generate_latest(
+        def render(want_gzip: bool) -> bytes:
+            # Single gzip member per response: multi-member concatenation
+            # of a cached compressed part would be RFC-legal but silently
+            # truncates on one-shot zlib decoders (browsers, naive
+            # scrapers); level-1 over ~35 KB costs ~0.3 ms, a price worth
+            # universal correctness.
+            body = self.cache.rendered() + exposition.generate_latest(
                 self.registry
             )
+            return gzip.compress(body, compresslevel=1) if want_gzip else body
 
         app = _make_app(render, self.telemetry, self._health)
         self.server = ExporterServer(app, cfg.addr, cfg.port)

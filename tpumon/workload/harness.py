@@ -88,7 +88,7 @@ def run(
         # Shard params FIRST; optimizer.init on sharded params then makes the
         # Adam moments inherit the same layout (no replicated moment memory).
         params = shard_tree(params, param_specs(), mesh)
-        tokens = jax.device_put(tokens, NamedSharding(mesh, batch_spec()))
+        tokens = shard_tree(tokens, batch_spec(), mesh)
     opt_state = optimizer.init(params)
     step = jax.jit(train_step, donate_argnums=(0, 1))
 
@@ -133,19 +133,63 @@ def main(argv: list[str] | None = None) -> int:
         "sized dp*tp (the JAX_PLATFORMS env var is ignored when a TPU "
         "plugin is present, so this must be a flag)",
     )
+    parser.add_argument(
+        "--coordinator",
+        default=None,
+        help="jax.distributed coordinator address (host:port) — enables "
+        "the multi-host path (SURVEY §3.5); pair with --num-processes "
+        "and --process-id",
+    )
+    parser.add_argument("--num-processes", type=int, default=1)
+    parser.add_argument(
+        "--process-id",
+        type=int,
+        default=None,
+        help="this process's index; defaults to $TPU_WORKER_ID or 0",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, format="%(levelname)s %(message)s")
+
+    num_processes = args.num_processes if args.coordinator else 1
+    total = max(args.dp * args.tp, 1)
+    if total % max(num_processes, 1):
+        parser.error(
+            f"--dp*--tp ({total}) must be divisible by --num-processes "
+            f"({num_processes})"
+        )
+    if args.num_processes > 1 and not args.coordinator:
+        parser.error("--num-processes > 1 requires --coordinator")
 
     if args.platform == "cpu":
         import os
 
-        n = max(args.dp * args.tp, 1)
+        # Each process owns its share of the dp*tp global mesh.
+        n = total // max(num_processes, 1)
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count={n}"
+                flags + f" --xla_force_host_platform_device_count={max(n, 1)}"
             ).strip()
         jax.config.update("jax_platforms", "cpu")
+
+    if args.coordinator:
+        import os
+
+        process_id = args.process_id
+        if process_id is None:
+            process_id = int(os.environ.get("TPU_WORKER_ID", "0") or 0)
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=process_id,
+        )
+        log.info(
+            "distributed: process %d/%d, %d local / %d global devices",
+            process_id,
+            args.num_processes,
+            len(jax.local_devices()),
+            len(jax.devices()),
+        )
 
     cfg = LlamaConfig.tiny() if args.preset == "tiny" else LlamaConfig.small()
 

@@ -60,9 +60,21 @@ def batch_spec() -> P:
 
 
 def shard_tree(tree, specs, mesh: Mesh):
-    """device_put a pytree according to a matching PartitionSpec tree."""
-    return jax.tree.map(
-        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
-        tree,
-        specs,
-    )
+    """Shard a pytree according to a matching PartitionSpec tree.
+
+    Single-process: plain device_put. Multi-process (jax.distributed —
+    the SURVEY §3.5 multi-host boundary): every process holds the full
+    host array (identical PRNG seed), and make_array_from_callback hands
+    each process exactly its addressable shards of the global Array.
+    """
+
+    def put(x, spec):
+        sharding = NamedSharding(mesh, spec)
+        if jax.process_count() > 1:
+            arr = np.asarray(x)
+            return jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx: arr[idx]
+            )
+        return jax.device_put(x, sharding)
+
+    return jax.tree.map(put, tree, specs)

@@ -1,0 +1,114 @@
+"""Mixed-pool GPU path (BASELINE config 5): the NVML-compat backend must
+feed the SAME unified families as the TPU path, end-to-end through a live
+scrape. pynvml isn't installed here, so a fake module stands in — which is
+exactly how GPU-exporter genre tests work (SURVEY.md §4 'monkeypatching
+the NVML module with a fake')."""
+
+import sys
+import types
+
+import pytest
+from prometheus_client.parser import text_string_to_metric_families
+
+from tpumon.config import Config
+from tpumon.exporter.server import build_exporter
+
+
+class _Util:
+    gpu = 73.0
+
+
+class _Mem:
+    total = 25_769_803_776  # 24 GiB
+    used = 12_884_901_888
+
+
+def _fake_pynvml():
+    mod = types.ModuleType("pynvml")
+    handles = [object(), object()]
+
+    mod.nvmlInit = lambda: None
+    mod.nvmlShutdown = lambda: None
+    mod.nvmlDeviceGetCount = lambda: 2
+    mod.nvmlDeviceGetHandleByIndex = lambda i: handles[i]
+    mod.nvmlDeviceGetUtilizationRates = lambda h: _Util()
+    mod.nvmlDeviceGetMemoryInfo = lambda h: _Mem()
+    mod.nvmlDeviceGetUUID = lambda h: f"GPU-fake-{handles.index(h)}".encode()
+    mod.nvmlDeviceGetName = lambda h: b"FakeGPU-80GB"
+    mod.nvmlDeviceGetCurrentClocksThrottleReasons = lambda h: (
+        0x1 if handles.index(h) == 1 else 0
+    )
+    mod.nvmlClocksThrottleReasonGpuIdle = 0x0  # treat bit 0x1 as real throttle
+    mod.nvmlClocksThrottleReasonApplicationsClocksSetting = 0x0
+    return mod
+
+
+@pytest.fixture
+def fake_pynvml(monkeypatch):
+    monkeypatch.setitem(sys.modules, "pynvml", _fake_pynvml())
+
+
+def test_nvml_backend_unified_families(fake_pynvml, scrape):
+    from tpumon.backends.nvml_backend import NvmlBackend
+
+    exp = build_exporter(
+        Config(port=0, addr="127.0.0.1", interval=30.0), NvmlBackend()
+    )
+    exp.start()
+    try:
+        status, text = scrape(exp.server.url + "/metrics")
+        assert status == 200
+        fams = {f.name: f for f in text_string_to_metric_families(text)}
+
+        # Same unified families as the TPU path — one dashboard, one pool.
+        duty = fams["accelerator_duty_cycle_percent"]
+        assert len(duty.samples) == 2
+        assert all(s.value == 73.0 for s in duty.samples)
+        assert duty.samples[0].labels["accelerator"] == "FakeGPU-80GB"
+
+        mem = fams["accelerator_memory_total_bytes"]
+        assert all(s.value == 25_769_803_776 for s in mem.samples)
+
+        throttle = {
+            s.labels["chip"]: s.value
+            for s in fams["accelerator_throttle_score"].samples
+        }
+        assert throttle == {"0": 0.0, "1": 10.0}
+
+        info = fams["accelerator_info"]
+        ids = {s.labels["device_id"] for s in info.samples}
+        assert ids == {"GPU-fake-0", "GPU-fake-1"}
+
+        # Coverage accounting stays honest: all 5 NVML-side metrics map.
+        assert fams["exporter_metric_coverage_ratio"].samples[0].value == 1.0
+    finally:
+        exp.close()
+
+
+def test_nvml_failure_degrades(fake_pynvml, scrape):
+    import pynvml
+
+    from tpumon.backends.nvml_backend import NvmlBackend
+
+    backend = NvmlBackend()
+
+    def boom(h):
+        raise RuntimeError("XID error")
+
+    pynvml.nvmlDeviceGetMemoryInfo = boom
+    exp = build_exporter(Config(port=0, addr="127.0.0.1", interval=30.0), backend)
+    exp.start()
+    try:
+        status, text = scrape(exp.server.url + "/metrics")
+        assert status == 200
+        fams = {f.name: f for f in text_string_to_metric_families(text)}
+        assert "accelerator_memory_total_bytes" not in fams
+        assert "accelerator_duty_cycle_percent" in fams  # others survive
+        errs = {
+            s.labels["kind"]: s.value
+            for s in fams["collector_errors"].samples
+            if s.name == "collector_errors_total"
+        }
+        assert errs.get("backend", 0) >= 2  # total + usage both failed
+    finally:
+        exp.close()

@@ -1,0 +1,103 @@
+"""Soak/flap stress (SURVEY §5.2-5.3): hammer /metrics from several
+threads while the backend flaps between attached / detached / failing /
+malformed every poll. The exporter must serve 200s throughout, never leak
+state between modes, and count (not raise) every injected fault."""
+
+import random
+import threading
+
+import pytest
+from prometheus_client.parser import text_string_to_metric_families
+
+from tpumon.backends.fake import LIBTPU_METRICS, FakeTpuBackend
+from tpumon.config import Config
+from tpumon.exporter.server import build_exporter
+
+pytestmark = pytest.mark.slow
+
+
+def test_flapping_backend_under_concurrent_scrapes(scrape):
+    be = FakeTpuBackend.preset("v5e-16", seed=42)
+    exp = build_exporter(Config(port=0, addr="127.0.0.1", interval=30.0), be)
+    exp.start()
+    url = exp.server.url + "/metrics"
+    stop = threading.Event()
+    failures: list = []
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                status, text = scrape(url)
+                assert status == 200
+                # Identity must survive every mode.
+                assert "accelerator_device_count" in text
+            except Exception as exc:  # pragma: no cover
+                failures.append(exc)
+                return
+
+    threads = [threading.Thread(target=hammer) for _ in range(6)]
+    for t in threads:
+        t.start()
+
+    rng = random.Random(7)
+    try:
+        for cycle in range(120):
+            mode = rng.choice(("ok", "detached", "fail", "malformed"))
+            be.attached = mode != "detached"
+            be.fail_metrics = (
+                set(rng.sample(LIBTPU_METRICS, 3)) if mode == "fail" else set()
+            )
+            be.malformed_metrics = (
+                set(rng.sample(LIBTPU_METRICS, 2)) if mode == "malformed" else set()
+            )
+            be.advance()
+            exp.poller.poll_once()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        exp.close()
+
+    assert not failures, failures[:3]
+
+    # After the storm: a healthy poll serves a complete page again.
+    be.attached = True
+    be.fail_metrics = set()
+    be.malformed_metrics = set()
+    exp2 = build_exporter(Config(port=0, addr="127.0.0.1", interval=30.0), be)
+    exp2.start()
+    try:
+        _, text = scrape(exp2.server.url + "/metrics")
+        fams = {f.name for f in text_string_to_metric_families(text)}
+        assert "accelerator_duty_cycle_percent" in fams
+        assert "accelerator_collective_latency_microseconds" in fams
+    finally:
+        exp2.close()
+
+
+def test_poller_thread_survives_poisoned_backend():
+    """Even an exception from deep inside a poll cycle must not kill the
+    poll loop (SURVEY §5.3: never crash the server)."""
+    import time
+
+    be = FakeTpuBackend.preset("v4-8")
+    exp = build_exporter(Config(port=0, addr="127.0.0.1", interval=0.05), be)
+    exp.start()
+    try:
+        # Poison topology itself — worse than a metric failure.
+        calls = {"n": 0}
+        orig = be.topology
+
+        def sometimes_boom():
+            calls["n"] += 1
+            if calls["n"] % 2:
+                raise RuntimeError("device driver reset")
+            return orig()
+
+        be.topology = sometimes_boom
+        time.sleep(0.5)
+        polls_before = exp.telemetry.polls._value.get()
+        time.sleep(0.5)
+        assert exp.telemetry.polls._value.get() > polls_before  # still polling
+    finally:
+        exp.close()

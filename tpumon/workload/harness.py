@@ -23,28 +23,73 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpumon.workload.models.llama import LlamaConfig, forward, init_params
+from tpumon.workload.models.moe import MoeConfig
+from tpumon.workload.models.moe import forward as moe_forward
+from tpumon.workload.models.moe import init_params as moe_init_params
 from tpumon.workload.parallel.mesh import (
     batch_spec,
+    make_act_sharder,
+    make_expert_sharder,
     make_mesh,
+    moe_param_specs,
     param_specs,
     shard_tree,
 )
+from tpumon.workload.parallel.pipeline import (
+    make_pipelined_forward,
+    pipeline_param_specs,
+)
+from tpumon.workload.parallel.ring import make_ring_attn
 
 log = logging.getLogger(__name__)
 
 
-def loss_fn(params, tokens, cfg: LlamaConfig):
-    """Next-token cross-entropy; inputs [B, S], targets are the shift-by-1."""
-    logits = forward(params, tokens[:, :-1], cfg)
+AUX_LOSS_WEIGHT = 0.01  # GShard load-balancing loss weight (MoE only)
+
+
+def loss_fn(
+    params,
+    tokens,
+    cfg,
+    attn_impl=None,
+    shard_acts=None,
+    shard_experts=None,
+    forward_fn=None,
+):
+    """Next-token cross-entropy; inputs [B, S], targets are the shift-by-1.
+
+    Accepts LlamaConfig or MoeConfig; the MoE path adds the weighted
+    load-balancing auxiliary loss. ``forward_fn`` overrides the model
+    forward entirely (the pipelined-forward path, parallel.pipeline).
+    """
+    if forward_fn is not None:
+        logits = forward_fn(params, tokens[:, :-1])
+        aux = 0.0
+    elif isinstance(cfg, MoeConfig):
+        logits, aux = moe_forward(
+            params, tokens[:, :-1], cfg, attn_impl, shard_acts, shard_experts
+        )
+    else:
+        logits = forward(params, tokens[:, :-1], cfg, attn_impl, shard_acts)
+        aux = 0.0
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    return jnp.mean(nll)
+    return jnp.mean(nll) + AUX_LOSS_WEIGHT * aux
 
 
-def make_train_step(cfg: LlamaConfig, optimizer):
+def make_train_step(
+    cfg,
+    optimizer,
+    attn_impl=None,
+    shard_acts=None,
+    shard_experts=None,
+    forward_fn=None,
+):
     def train_step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, tokens, cfg, attn_impl, shard_acts, shard_experts, forward_fn
+        )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
@@ -58,36 +103,80 @@ class RunResult:
     steps_per_sec: float
     dp: int
     tp: int
+    sp: int = 1
+    pp: int = 1
+    ep: int = 1
 
 
 def run(
-    cfg: LlamaConfig,
+    cfg,
     *,
     steps: int = 10,
     batch: int = 8,
     seq: int | None = None,
     dp: int = 1,
     tp: int = 1,
+    sp: int = 1,
+    pp: int = 1,
+    ep: int = 1,
+    microbatches: int = 2,
     seed: int = 0,
     mesh=None,
 ) -> RunResult:
-    """Build, shard, and run the train step; returns losses + throughput."""
+    """Build, shard, and run the train step; returns losses + throughput.
+
+    ``cfg`` is a LlamaConfig (dense) or MoeConfig (mixture-of-experts).
+    ``sp > 1`` turns on sequence/context parallelism: ring attention over
+    the mesh's ``seq`` axis (parallel.ring) plus a persistent
+    batch×seq-sharded residual stream. ``ep > 1`` shards MoE expert banks
+    over the ``expert`` axis so dispatch/combine become all-to-alls.
+    """
+    is_moe = isinstance(cfg, MoeConfig)
+    if ep > 1 and not is_moe:
+        raise ValueError("ep > 1 requires a MoeConfig")
+    if pp > 1 and (is_moe or tp > 1 or sp > 1):
+        raise ValueError("pp composes with dp only (dense model, tp=sp=1)")
     seq = seq or cfg.max_seq
     key = jax.random.PRNGKey(seed)
     k_params, k_data = jax.random.split(key)
 
-    params = init_params(cfg, k_params)
+    params = (moe_init_params if is_moe else init_params)(cfg, k_params)
     optimizer = optax.adamw(1e-3)
-    train_step = make_train_step(cfg, optimizer)
     tokens = jax.random.randint(k_data, (batch, seq + 1), 0, cfg.vocab, jnp.int32)
 
-    if mesh is None and dp * tp > 1:
-        mesh = make_mesh(dp, tp)
+    if mesh is None and dp * tp * sp * pp * ep > 1:
+        mesh = make_mesh(dp, tp, sp, pp, ep)
+
+    attn_impl = shard_acts = shard_experts = forward_fn = None
+    if sp > 1:
+        if mesh is None:
+            raise ValueError("sp > 1 requires a mesh")
+        if seq % sp:
+            raise ValueError(f"seq ({seq}) must divide by sp ({sp})")
+        attn_impl = make_ring_attn(
+            mesh, head_axis="model" if tp > 1 else None
+        )
+        shard_acts = make_act_sharder(mesh, sp=True)
+    if is_moe and mesh is not None:
+        shard_experts = make_expert_sharder(mesh)
+        if shard_acts is None:
+            shard_acts = make_act_sharder(mesh)
+    if pp > 1:
+        forward_fn = make_pipelined_forward(mesh, cfg, microbatches=microbatches)
+    train_step = make_train_step(
+        cfg, optimizer, attn_impl, shard_acts, shard_experts, forward_fn
+    )
 
     if mesh is not None:
         # Shard params FIRST; optimizer.init on sharded params then makes the
         # Adam moments inherit the same layout (no replicated moment memory).
-        params = shard_tree(params, param_specs(), mesh)
+        if pp > 1:
+            specs = pipeline_param_specs()
+        elif is_moe:
+            specs = moe_param_specs()
+        else:
+            specs = param_specs()
+        params = shard_tree(params, specs, mesh)
         tokens = shard_tree(tokens, batch_spec(), mesh)
     opt_state = optimizer.init(params)
     step = jax.jit(train_step, donate_argnums=(0, 1))
@@ -108,6 +197,9 @@ def run(
         steps_per_sec=steps / elapsed if elapsed > 0 else float("inf"),
         dp=dp,
         tp=tp,
+        sp=sp,
+        pp=pp,
+        ep=ep,
     )
 
 
@@ -117,8 +209,40 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--batch", type=int, default=8)
     parser.add_argument("--seq", type=int, default=None)
     parser.add_argument("--preset", choices=("tiny", "small"), default="tiny")
+    parser.add_argument(
+        "--model",
+        choices=("llama", "moe"),
+        default="llama",
+        help="dense Llama-style decoder or mixture-of-experts (EP-capable)",
+    )
     parser.add_argument("--dp", type=int, default=1)
     parser.add_argument("--tp", type=int, default=1)
+    parser.add_argument(
+        "--sp",
+        type=int,
+        default=1,
+        help="sequence/context parallelism: ring attention over this many "
+        "devices on the mesh's seq axis",
+    )
+    parser.add_argument(
+        "--pp",
+        type=int,
+        default=1,
+        help="pipeline parallelism: GPipe stages over the mesh's stage axis",
+    )
+    parser.add_argument(
+        "--microbatches",
+        type=int,
+        default=2,
+        help="microbatches per step on the pipeline-parallel path",
+    )
+    parser.add_argument(
+        "--ep",
+        type=int,
+        default=1,
+        help="expert parallelism: shard MoE expert banks over this many "
+        "devices (requires --model moe)",
+    )
     parser.add_argument(
         "--metrics-port",
         type=int,
@@ -151,10 +275,10 @@ def main(argv: list[str] | None = None) -> int:
     logging.basicConfig(level=logging.INFO, format="%(levelname)s %(message)s")
 
     num_processes = args.num_processes if args.coordinator else 1
-    total = max(args.dp * args.tp, 1)
+    total = max(args.dp * args.tp * args.sp * args.pp * args.ep, 1)
     if total % max(num_processes, 1):
         parser.error(
-            f"--dp*--tp ({total}) must be divisible by --num-processes "
+            f"--dp*--tp*--sp*--pp*--ep ({total}) must be divisible by --num-processes "
             f"({num_processes})"
         )
     if args.num_processes > 1 and not args.coordinator:
@@ -191,7 +315,19 @@ def main(argv: list[str] | None = None) -> int:
             len(jax.devices()),
         )
 
-    cfg = LlamaConfig.tiny() if args.preset == "tiny" else LlamaConfig.small()
+    if args.model == "moe":
+        if args.preset != "tiny":
+            log.warning("--model moe only has a tiny preset; ignoring --preset %s",
+                        args.preset)
+        cfg = MoeConfig.tiny()
+    else:
+        cfg = LlamaConfig.tiny() if args.preset == "tiny" else LlamaConfig.small()
+    if args.pp > 1 and cfg.n_layers % args.pp:
+        # Pipeline stages need a whole number of layers each; round up so
+        # the CLI works as a traffic generator at any --pp.
+        n = ((cfg.n_layers + args.pp - 1) // args.pp) * args.pp
+        log.info("rounding n_layers %d → %d for pp=%d", cfg.n_layers, n, args.pp)
+        cfg = dataclasses.replace(cfg, n_layers=n)
 
     from tpumon.workload.hlo_counters import CountersCollector, HloOpCounters
 
@@ -228,14 +364,21 @@ def main(argv: list[str] | None = None) -> int:
             seq=args.seq,
             dp=args.dp,
             tp=args.tp,
+            sp=args.sp,
+            pp=args.pp,
+            ep=args.ep,
+            microbatches=args.microbatches,
         )
         log.info(
-            "loss %.4f → %.4f | %.2f steps/s | mesh dp=%d tp=%d | devices=%s",
+            "loss %.4f → %.4f | %.2f steps/s | mesh dp=%d tp=%d sp=%d pp=%d ep=%d | devices=%s",
             result.losses[0],
             result.losses[-1],
             result.steps_per_sec,
             result.dp,
             result.tp,
+            result.sp,
+            result.pp,
+            result.ep,
             jax.devices()[0].platform,
         )
         if hooked:

@@ -83,7 +83,7 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
     }
 
 
-def _attention(x, layer, cfg: LlamaConfig, freqs, mask):
+def _attention(x, layer, cfg: LlamaConfig, freqs, mask, attn_impl=None):
     B, S, D = x.shape
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
@@ -100,10 +100,17 @@ def _attention(x, layer, cfg: LlamaConfig, freqs, mask):
     k = jnp.repeat(k, rep, axis=2)
     v = jnp.repeat(v, rep, axis=2)
 
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
-    scores = scores / jnp.sqrt(jnp.float32(HD)) + mask[:S, :S]
-    probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, H * HD)
+    if attn_impl is not None:
+        # Pluggable causal attention [B,S,H,D]→[B,S,H,D] — ring attention
+        # (parallel.ring) or a pallas flash kernel (ops.flash_attention).
+        out = attn_impl(q, k, v).reshape(B, S, H * HD)
+    else:
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        )
+        scores = scores / jnp.sqrt(jnp.float32(HD)) + mask[:S, :S]
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, H * HD)
     return out @ layer["wo"].astype(cfg.dtype)
 
 
@@ -113,18 +120,37 @@ def _mlp(x, layer, cfg: LlamaConfig):
     return (jax.nn.silu(gate) * up) @ layer["w_down"].astype(cfg.dtype)
 
 
-@partial(jax.jit, static_argnames=("cfg",))
-def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig) -> jnp.ndarray:
-    """tokens [B, S] int32 → logits [B, S, vocab] float32."""
+@partial(jax.jit, static_argnames=("cfg", "attn_impl", "shard_acts"))
+def forward(
+    params: dict,
+    tokens: jnp.ndarray,
+    cfg: LlamaConfig,
+    attn_impl=None,
+    shard_acts=None,
+) -> jnp.ndarray:
+    """tokens [B, S] int32 → logits [B, S, vocab] float32.
+
+    ``attn_impl`` swaps the attention core (ring attention for sequence
+    parallelism, pallas flash attention); ``shard_acts`` is an optional
+    x→x sharding constraint applied to the residual stream so sequence-
+    parallel layouts persist between layers instead of round-tripping
+    through a replicated view.
+    """
     B, S = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
+    if shard_acts is not None:
+        x = shard_acts(x)
     freqs = rope_freqs(cfg.head_dim, cfg.max_seq)
     mask = jnp.triu(jnp.full((cfg.max_seq, cfg.max_seq), -1e9, jnp.float32), k=1)
 
     def block(carry, layer):
         h = carry
-        h = h + _attention(rms_norm(h, layer["attn_norm"]), layer, cfg, freqs, mask)
+        h = h + _attention(
+            rms_norm(h, layer["attn_norm"]), layer, cfg, freqs, mask, attn_impl
+        )
         h = h + _mlp(rms_norm(h, layer["mlp_norm"]), layer, cfg)
+        if shard_acts is not None:
+            h = shard_acts(h)
         return h, None
 
     # One compiled layer body for any depth — lax.scan over stacked params.

@@ -1,0 +1,199 @@
+"""Mixture-of-Experts decoder with expert parallelism, TPU-first.
+
+GShard/Switch-style MoE built the XLA way: routing, dispatch, and combine
+are dense einsums over a STATIC expert-capacity axis — no gather/scatter,
+no dynamic shapes — so the whole layer tiles onto the MXU and the
+dispatch/combine contractions lower to all-to-alls when expert weights are
+sharded over the mesh's ``expert`` axis (tpumon.workload.parallel.mesh).
+Those all-to-alls are the EP traffic the monitor's collective counters and
+``ici_link_health`` observe (SURVEY.md §2.4).
+
+Routing is top-k with renormalized gates and per-(batch-row, expert)
+capacity; overflow tokens are dropped (their combine weight is zero), the
+standard static-shape trade. The GShard auxiliary load-balancing loss is
+returned alongside the logits so the harness can keep experts from
+collapsing.
+
+Attention reuses the Llama block (models.llama) including its pluggable
+``attn_impl``, so EP composes with ring-attention SP and tensor parallelism.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from tpumon.workload.models import llama as _llama
+from tpumon.workload.ops.core import rms_norm, rope_freqs
+
+
+@dataclass(frozen=True)
+class MoeConfig:
+    vocab: int = 512
+    dim: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    n_kv_heads: int = 2
+    ffn_dim: int = 256
+    max_seq: int = 128
+    n_experts: int = 4
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @classmethod
+    def tiny(cls) -> "MoeConfig":
+        return cls()
+
+    def capacity(self, seq: int) -> int:
+        """Static per-(batch-row, expert) token capacity."""
+        return max(
+            1, math.ceil(self.top_k * seq * self.capacity_factor / self.n_experts)
+        )
+
+
+def init_params(cfg: MoeConfig, key: jax.Array) -> dict:
+    """Llama-shaped attention + per-layer expert banks on a leading E axis."""
+    k_embed, k_attn, k_moe, k_out = jax.random.split(key, 4)
+    init = jax.nn.initializers.normal(0.02)
+    L, D, F, E = cfg.n_layers, cfg.dim, cfg.ffn_dim, cfg.n_experts
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    ka = jax.random.split(k_attn, 4)
+    km = jax.random.split(k_moe, 4)
+
+    return {
+        "embed": init(k_embed, (cfg.vocab, D), jnp.float32),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), jnp.float32),
+            "wq": init(ka[0], (L, D, H * HD), jnp.float32),
+            "wk": init(ka[1], (L, D, KV * HD), jnp.float32),
+            "wv": init(ka[2], (L, D, KV * HD), jnp.float32),
+            "wo": init(ka[3], (L, H * HD, D), jnp.float32),
+            "mlp_norm": jnp.ones((L, D), jnp.float32),
+            "router": init(km[0], (L, D, E), jnp.float32),
+            "w_gate": init(km[1], (L, E, D, F), jnp.float32),
+            "w_up": init(km[2], (L, E, D, F), jnp.float32),
+            "w_down": init(km[3], (L, E, F, D), jnp.float32),
+        },
+        "final_norm": jnp.ones((D,), jnp.float32),
+        "unembed": init(k_out, (D, cfg.vocab), jnp.float32),
+    }
+
+
+def _route(probs: jnp.ndarray, top_k: int, capacity: int):
+    """probs [B,S,E] → (dispatch [B,S,E,C] bool-ish, combine [B,S,E,C]).
+
+    Slot-by-slot top-k (k is tiny and static, so the Python loop unrolls
+    into k fused one-hot/cumsum passes), with a running per-expert fill
+    count so slot j respects the tokens slot j-1 already placed.
+    """
+    B, S, E = probs.shape
+    p = probs
+    gates, onehots = [], []
+    for _ in range(top_k):
+        g = jnp.max(p, axis=-1)
+        e = jnp.argmax(p, axis=-1)
+        oh = jax.nn.one_hot(e, E, dtype=probs.dtype)  # [B,S,E]
+        gates.append(g)
+        onehots.append(oh)
+        p = p * (1.0 - oh)  # mask the chosen expert for the next slot
+
+    denom = sum(gates) + 1e-9  # renormalize gate mass over the k slots
+    fill = jnp.zeros((B, 1, E), probs.dtype)
+    dispatch = jnp.zeros((B, S, E, capacity), probs.dtype)
+    combine = jnp.zeros((B, S, E, capacity), probs.dtype)
+    for g, oh in zip(gates, onehots):
+        # Position of each token in its chosen expert's buffer: exclusive
+        # cumsum over the sequence plus what earlier slots already placed.
+        pos_e = jnp.cumsum(oh, axis=1) - oh + fill  # [B,S,E]
+        pos = jnp.sum(pos_e * oh, axis=-1).astype(jnp.int32)  # [B,S]
+        keep = (pos < capacity) & (jnp.sum(oh, axis=-1) > 0)
+        pos_oh = jax.nn.one_hot(
+            jnp.minimum(pos, capacity - 1), capacity, dtype=probs.dtype
+        )  # [B,S,C]
+        d = oh[..., None] * pos_oh[:, :, None, :] * keep[..., None, None]
+        dispatch = dispatch + d
+        combine = combine + (g / denom)[..., None, None] * d
+        fill = fill + jnp.sum(oh, axis=1, keepdims=True)
+    return dispatch, combine
+
+
+def _moe_mlp(x, layer, cfg: MoeConfig, shard_experts=None):
+    """x [B,S,D] → (out [B,S,D], aux load-balancing loss scalar)."""
+    B, S, D = x.shape
+    E, C = cfg.n_experts, cfg.capacity(S)
+
+    logits = jnp.einsum(
+        "bsd,de->bse", x.astype(jnp.float32), layer["router"],
+        preferred_element_type=jnp.float32,
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    dispatch, combine = _route(probs, cfg.top_k, C)
+
+    # GShard aux loss: E * Σ_e mean-fraction-routed(e) · mean-prob(e).
+    frac = jnp.mean(jnp.sum(dispatch, axis=-1), axis=(0, 1))  # [E]
+    aux = jnp.float32(E) * jnp.sum(frac / cfg.top_k * jnp.mean(probs, axis=(0, 1)))
+
+    # Dispatch: [B,S,E,C] × [B,S,D] → [E,B,C,D]; with experts sharded over
+    # the mesh's expert axis this contraction IS the all-to-all.
+    xin = jnp.einsum(
+        "bsec,bsd->ebcd", dispatch.astype(cfg.dtype), x,
+        preferred_element_type=cfg.dtype,
+    )
+    if shard_experts is not None:
+        xin = shard_experts(xin)
+    gate = jnp.einsum("ebcd,edf->ebcf", xin, layer["w_gate"].astype(cfg.dtype))
+    up = jnp.einsum("ebcd,edf->ebcf", xin, layer["w_up"].astype(cfg.dtype))
+    y = jnp.einsum(
+        "ebcf,efd->ebcd", jax.nn.silu(gate) * up,
+        layer["w_down"].astype(cfg.dtype),
+    )
+    out = jnp.einsum(
+        "bsec,ebcd->bsd", combine.astype(cfg.dtype), y,
+        preferred_element_type=cfg.dtype,
+    )
+    return out, aux
+
+
+@partial(jax.jit, static_argnames=("cfg", "attn_impl", "shard_acts", "shard_experts"))
+def forward(
+    params: dict,
+    tokens: jnp.ndarray,
+    cfg: MoeConfig,
+    attn_impl=None,
+    shard_acts=None,
+    shard_experts=None,
+):
+    """tokens [B,S] → (logits [B,S,vocab] f32, aux loss scalar f32)."""
+    B, S = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    if shard_acts is not None:
+        x = shard_acts(x)
+    freqs = rope_freqs(cfg.head_dim, cfg.max_seq)
+    mask = jnp.triu(jnp.full((cfg.max_seq, cfg.max_seq), -1e9, jnp.float32), k=1)
+
+    def block(carry, layer):
+        h, aux = carry
+        h = h + _llama._attention(
+            rms_norm(h, layer["attn_norm"]), layer, cfg, freqs, mask, attn_impl
+        )
+        moe_out, layer_aux = _moe_mlp(
+            rms_norm(h, layer["mlp_norm"]), layer, cfg, shard_experts
+        )
+        h = h + moe_out
+        if shard_acts is not None:
+            h = shard_acts(h)
+        return (h, aux + layer_aux), None
+
+    (x, aux), _ = jax.lax.scan(block, (x, jnp.float32(0.0)), params["layers"])
+    x = rms_norm(x, params["final_norm"])
+    logits = (x @ params["unembed"].astype(cfg.dtype)).astype(jnp.float32)
+    return logits, aux / cfg.n_layers

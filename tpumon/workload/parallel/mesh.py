@@ -1,17 +1,28 @@
 """Device mesh + shardings for the workload harness (SURVEY.md §3.5).
 
 Idiomatic JAX SPMD: pick a Mesh, annotate shardings with PartitionSpecs,
-let XLA insert the collectives — the all-reduces (data axis) and
-all-gathers/reduce-scatters (model axis) this generates over ICI are
-exactly the traffic ``collective_e2e_latency`` / ``ici_link_health``
+let XLA insert the collectives — the all-reduces (data axis), all-gathers /
+reduce-scatters (model axis), neighbor ppermutes (seq axis, ring
+attention), and all-to-alls (expert axis, MoE dispatch) this generates over
+ICI are exactly the traffic ``collective_e2e_latency`` / ``ici_link_health``
 measure.
 
-Axes:
+Axes (outermost → innermost; the most latency-sensitive collectives ride
+the innermost, fastest ICI dimension):
 
-- ``data``  — batch (DP): gradients all-reduce across it.
-- ``model`` — Megatron-style tensor parallelism: attention heads and FFN
-  hidden dim are column-sharded (…, "model"), output projections
-  row-sharded ("model", …), vocab sharded in embed/unembed.
+- ``data``   — batch (DP): gradients all-reduce across it.
+- ``stage``  — pipeline parallelism (PP): layers split into stages,
+  activations hop stage→stage via ppermute (see parallel.pipeline).
+- ``expert`` — expert parallelism (EP): MoE expert weights sharded,
+  token dispatch/combine become all-to-alls (see models.moe).
+- ``seq``    — sequence/context parallelism (SP): ring attention rotates
+  K/V blocks around this axis (see parallel.ring).
+- ``model``  — Megatron-style tensor parallelism: attention heads and FFN
+  hidden dim column-sharded (…, "model"), output projections row-sharded
+  ("model", …), vocab sharded in embed/unembed.
+
+Unused axes are kept at size 1 so every PartitionSpec in the tree is valid
+on every mesh shape.
 
 Layer weights are stacked on a leading layer axis (lax.scan), so every
 per-layer spec carries a leading ``None``.
@@ -23,16 +34,27 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+AXES = ("data", "stage", "expert", "seq", "model")
 
-def make_mesh(dp: int, tp: int, devices=None) -> Mesh:
-    """A dp×tp mesh over the given (default: all) devices."""
+
+def make_mesh(
+    dp: int,
+    tp: int,
+    sp: int = 1,
+    pp: int = 1,
+    ep: int = 1,
+    devices=None,
+) -> Mesh:
+    """A dp×pp×ep×sp×tp mesh over the given (default: all) devices."""
     devices = list(jax.devices()) if devices is None else list(devices)
-    if dp * tp > len(devices):
+    total = dp * tp * sp * pp * ep
+    if total > len(devices):
         raise ValueError(
-            f"mesh {dp}x{tp} needs {dp * tp} devices, have {len(devices)}"
+            f"mesh dp={dp} pp={pp} ep={ep} sp={sp} tp={tp} needs {total} "
+            f"devices, have {len(devices)}"
         )
-    grid = np.asarray(devices[: dp * tp]).reshape(dp, tp)
-    return Mesh(grid, axis_names=("data", "model"))
+    grid = np.asarray(devices[:total]).reshape(dp, pp, ep, sp, tp)
+    return Mesh(grid, axis_names=AXES)
 
 
 def param_specs() -> dict:
@@ -55,8 +77,59 @@ def param_specs() -> dict:
     }
 
 
+def moe_param_specs() -> dict:
+    """PartitionSpec tree matching models.moe.init_params' structure.
+
+    Expert banks are sharded over the ``expert`` axis (EP) AND the
+    ``model`` axis (TP within each expert) — dispatch/combine einsums
+    against data-sharded activations then lower to all-to-alls.
+    """
+    return {
+        "embed": P("model", None),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, "model"),
+            "wk": P(None, None, "model"),
+            "wv": P(None, None, "model"),
+            "wo": P(None, "model", None),
+            "mlp_norm": P(None, None),
+            "router": P(None, None, None),
+            "w_gate": P(None, "expert", None, "model"),
+            "w_up": P(None, "expert", None, "model"),
+            "w_down": P(None, "expert", "model", None),
+        },
+        "final_norm": P(None),
+        "unembed": P(None, "model"),
+    }
+
+
+def make_expert_sharder(mesh: Mesh):
+    """[E, B, C, D] expert-major activations → experts over 'expert' axis."""
+    return _make_sharder(mesh, P("expert", "data", None, None))
+
+
 def batch_spec() -> P:
+    """Token sharding: batch over the data axis."""
     return P("data", None)
+
+
+def activation_spec(sp: bool = False) -> P:
+    """[B, S, D] activations: batch over data, seq over seq (SP)."""
+    return P("data", "seq", None) if sp else P("data", None, None)
+
+
+def make_act_sharder(mesh: Mesh, sp: bool = False):
+    """x → x constrained to the activation sharding (for use under jit)."""
+    return _make_sharder(mesh, activation_spec(sp))
+
+
+def _make_sharder(mesh: Mesh, spec: P):
+    sharding = NamedSharding(mesh, spec)
+
+    def constrain(x):
+        return jax.lax.with_sharding_constraint(x, sharding)
+
+    return constrain
 
 
 def shard_tree(tree, specs, mesh: Mesh):

@@ -1,0 +1,141 @@
+"""Pipeline parallelism: GPipe schedule over the mesh's ``stage`` axis.
+
+Layer-stacked weights are sharded on their leading (layer) axis, so each
+device holds ``n_layers / pp`` contiguous layers. Microbatches march
+through the stages with one ``lax.ppermute`` hop per schedule tick — the
+neighbor-to-neighbor ICI traffic a pipeline-parallel trainer actually
+produces, which is what the monitor's ``ici_link_health`` /
+``collective_e2e_latency`` panels display (SURVEY.md §2.4).
+
+Written the XLA way:
+
+- the schedule is a ``lax.scan`` over ``microbatches + pp - 1`` ticks
+  (bubble included), so it is reverse-differentiable and the SAME code
+  path runs forward and backward — no hand-scheduled 1F1B state machine;
+- stages compute on zero-padding during bubble ticks (branchless; a
+  ``where`` on the stage index selects real inputs), trading a few wasted
+  FLOPs for a single fused program with static shapes;
+- the finished microbatches live on the last stage; one masked ``psum``
+  over the stage axis replicates them back (the gradient of that psum is
+  the identity into the last stage, so backward stays cheap).
+
+Composes with DP (batch over ``data``); run with tp=1 — tensor-parallel
+weight shards inside a stage body would need manual collectives that
+XLA already inserts on the non-pipelined path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpumon.workload.models import llama as _llama
+from tpumon.workload.ops.core import rms_norm, rope_freqs
+
+
+def _stage_layer_specs() -> dict:
+    """Per-layer param specs with the leading (layer) axis on ``stage``."""
+    return {
+        "attn_norm": P("stage", None),
+        "wq": P("stage", None, None),
+        "wk": P("stage", None, None),
+        "wv": P("stage", None, None),
+        "wo": P("stage", None, None),
+        "mlp_norm": P("stage", None),
+        "w_gate": P("stage", None, None),
+        "w_up": P("stage", None, None),
+        "w_down": P("stage", None, None),
+    }
+
+
+def pipeline_param_specs() -> dict:
+    """Full param-tree specs for the pipelined model (layers → stages)."""
+    return {
+        "embed": P("model", None),
+        "layers": _stage_layer_specs(),
+        "final_norm": P(None),
+        "unembed": P(None, "model"),
+    }
+
+
+def _stage_body(layers_local, x, cfg, freqs, mask):
+    """Run this stage's layer block on one microbatch [mb, S, D]."""
+
+    def block(h, layer):
+        h = h + _llama._attention(
+            rms_norm(h, layer["attn_norm"]), layer, cfg, freqs, mask
+        )
+        h = h + _llama._mlp(rms_norm(h, layer["mlp_norm"]), layer, cfg)
+        return h, None
+
+    h, _ = jax.lax.scan(block, x, layers_local)
+    return h
+
+
+def make_pipelined_forward(mesh: Mesh, cfg, *, microbatches: int = 2):
+    """logits = f(params, tokens): GPipe over the mesh's ``stage`` axis.
+
+    params is the models.llama tree sharded with pipeline_param_specs();
+    tokens [B, S] with B divisible by data-shards × microbatches.
+    """
+    pp = mesh.shape["stage"]
+    if cfg.n_layers % pp:
+        raise ValueError(f"n_layers ({cfg.n_layers}) must divide by pp ({pp})")
+
+    spec_x = P("data", None, None)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(_stage_layer_specs(), spec_x),
+        out_specs=spec_x,
+        check_vma=False,
+    )
+    def pipe(layers_local, x):
+        stage = jax.lax.axis_index("stage")
+        b_loc, S, D = x.shape
+        M = microbatches
+        mb = b_loc // M
+        freqs = rope_freqs(cfg.head_dim, cfg.max_seq)
+        mask = jnp.triu(
+            jnp.full((cfg.max_seq, cfg.max_seq), -1e9, jnp.float32), k=1
+        )
+
+        inps = x.reshape(M, mb, S, D)
+        bubble = jnp.zeros((pp - 1, mb, S, D), x.dtype)
+        xs = jnp.concatenate([inps, bubble], axis=0)  # [M + pp - 1, ...]
+
+        fwd = [(i, i + 1) for i in range(pp - 1)]  # stage i → i+1
+
+        def tick(x_cur, inp_t):
+            x_in = jnp.where(stage == 0, inp_t, x_cur)
+            y = _stage_body(layers_local, x_in, cfg, freqs, mask)
+            # Hop to the next stage; stage 0 receives zeros (it always
+            # reads from the schedule, never from the wire).
+            x_next = jax.lax.ppermute(y, "stage", fwd)
+            return x_next, y
+
+        _, ys = jax.lax.scan(tick, jnp.zeros((mb, S, D), x.dtype), xs)
+
+        # Microbatch m finishes on the last stage at tick m + pp - 1.
+        outs = ys[pp - 1 :]
+        outs = jnp.where(stage == pp - 1, outs, jnp.zeros_like(outs))
+        outs = jax.lax.psum(outs, "stage")
+        return outs.reshape(b_loc, S, D)
+
+    def forward(params, tokens):
+        per_shard = tokens.shape[0] // mesh.shape["data"]
+        if per_shard % microbatches:
+            raise ValueError(
+                f"per-data-shard batch ({per_shard}) must divide by "
+                f"microbatches ({microbatches})"
+            )
+        x = params["embed"].astype(cfg.dtype)[tokens]
+        x = pipe(params["layers"], x)
+        x = rms_norm(x, params["final_norm"])
+        return (x @ params["unembed"].astype(cfg.dtype)).astype(jnp.float32)
+
+    return forward

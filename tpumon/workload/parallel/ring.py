@@ -1,0 +1,150 @@
+"""Ring attention: sequence/context parallelism over an ICI ring.
+
+Long-context story for the workload harness (SURVEY.md §2.4/§5.7): the
+sequence axis is sharded over the mesh's ``seq`` axis and K/V blocks rotate
+around the ring with ``lax.ppermute`` while each device accumulates its
+queries' attention with an online (flash-style) softmax. Every hop is a
+neighbor-exchange on ICI — exactly the traffic ``ici_link_health`` /
+``collective_e2e_latency`` measure, and the communication pattern scales to
+sequence lengths no single chip's HBM could hold.
+
+Numerics: accumulation is float32 throughout (running max ``m``, running
+denominator ``l``, weighted-value accumulator ``o``); blocks that are fully
+causally masked contribute exp(-BIG) ≈ 0 rather than NaN-producing -inf.
+
+Composes under ``jit``: callers wrap :func:`ring_attention` in a
+``shard_map`` over the mesh (see :func:`make_ring_attn`) and XLA overlaps
+the ppermute with the per-block einsums.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+# Finite stand-in for -inf: masked logits become exp(x - m) == 0 without
+# ever forming inf - inf when an entire block is masked out.
+_NEG_BIG = -1e30
+
+
+def _block_attn(q32, k, v, mask, m, l, o, scale):
+    """One online-softmax accumulation step against a single K/V block.
+
+    q32 [B,S,H,D] f32; k/v [B,Skv,H,D]; mask [S,Skv] bool (True = attend);
+    m/l [B,H,S] f32 running max/denominator; o [B,H,S,D] f32 accumulator.
+    """
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q32, k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    s = jnp.where(mask[None, None, :, :], s, _NEG_BIG)
+
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    alpha = jnp.exp(m - m_new)  # rescale factor for previous accumulators
+    p = jnp.exp(s - m_new[..., None])
+    l = l * alpha + jnp.sum(p, axis=-1)
+    o = o * alpha[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l, o
+
+
+def ring_attention_local(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    *,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Per-shard body: runs INSIDE shard_map, q/k/v are the local seq blocks.
+
+    q, k, v: [B, S_local, H, D] with the global sequence sharded over
+    ``axis_name``. KV heads must already be repeated up to the Q head count
+    (grouped-query expansion happens before the ring so every hop moves the
+    exact bytes attention will read).
+    """
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.float32(D))
+
+    q32 = q.astype(jnp.float32)
+    pos = jnp.arange(S, dtype=jnp.int32)
+    q_pos = my * S + pos  # global positions of the local queries
+
+    m = jnp.full((B, H, S), _NEG_BIG, jnp.float32)
+    l = jnp.zeros((B, H, S), jnp.float32)
+    o = jnp.zeros((B, H, S, D), jnp.float32)
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(i, carry):
+        m, l, o, k, v = carry
+        # After i hops this device holds the block that started on (my - i).
+        src = (my - i) % n
+        kv_pos = src * S + pos
+        if causal:
+            mask = q_pos[:, None] >= kv_pos[None, :]
+        else:
+            mask = jnp.ones((S, S), bool)
+        m, l, o = _block_attn(q32, k, v, mask, m, l, o, scale)
+        # Rotate K/V one hop; the final rotation returns blocks to their
+        # owners, keeping the loop body uniform for lax.fori_loop.
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        return m, l, o, k, v
+
+    m, l, o, k, v = jax.lax.fori_loop(0, n, step, (m, l, o, k, v))
+    out = o / l[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B,S,H,D]
+
+
+def make_ring_attn(
+    mesh: Mesh, *, data_axis="data", seq_axis="seq", head_axis=None, causal=True
+):
+    """An attention callable q,k,v → out with the sequence axis ring-sharded.
+
+    Returned fn takes global [B, S, H, D] arrays under jit; shard_map splits
+    batch over ``data_axis`` and sequence over ``seq_axis``. Pass
+    ``head_axis="model"`` to compose with tensor parallelism: heads are
+    independent in attention, so sharding them over the model axis keeps
+    the TP layout through the ring with zero extra communication.
+    """
+    spec = P(data_axis, seq_axis, head_axis, None)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    def attn(q, k, v):
+        return ring_attention_local(q, k, v, seq_axis, causal=causal)
+
+    return attn
+
+
+def reference_attention(q, k, v, *, causal=True):
+    """Dense O(S²) attention, same layout — numerics oracle for tests."""
+    B, S, H, D = q.shape
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk",
+        q.astype(jnp.float32),
+        k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ) / jnp.sqrt(jnp.float32(D))
+    if causal:
+        pos = jnp.arange(S)
+        s = jnp.where(pos[:, None] >= pos[None, :], s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)

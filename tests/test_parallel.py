@@ -67,6 +67,32 @@ class TestRingAttention:
         ref = reference_attention(q, k, v)
         assert float(jnp.max(jnp.abs(out - ref))) < 1e-5
 
+    def test_grouped_query_kv_stays_narrow_on_ring(self):
+        """K/V enter the ring with KV heads; expansion is local per hop."""
+        mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "seq"))
+        q, _, _ = _qkv(jax.random.PRNGKey(4), B=2, S=64, H=4)
+        kk, kv = jax.random.split(jax.random.PRNGKey(5))
+        k = jax.random.normal(kk, (2, 64, 2, 16), jnp.float32)
+        v = jax.random.normal(kv, (2, 64, 2, 16), jnp.float32)
+        out = jax.jit(make_ring_attn(mesh))(q, k, v)
+        ref = reference_attention(
+            q, jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2)
+        )
+        assert float(jnp.max(jnp.abs(out - ref))) < 1e-5
+
+    def test_tp_wider_than_kv_heads_pre_expands(self):
+        """tp=4 > KV=2: k/v are pre-expanded to H so the model axis shards."""
+        mesh = make_mesh(1, 4, 2)  # tp=4, sp=2
+        q, _, _ = _qkv(jax.random.PRNGKey(6), B=2, S=32, H=4)
+        kk, kv = jax.random.split(jax.random.PRNGKey(7))
+        k = jax.random.normal(kk, (2, 32, 2, 16), jnp.float32)
+        v = jax.random.normal(kv, (2, 32, 2, 16), jnp.float32)
+        out = jax.jit(make_ring_attn(mesh, head_axis="model"))(q, k, v)
+        ref = reference_attention(
+            q, jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2)
+        )
+        assert float(jnp.max(jnp.abs(out - ref))) < 1e-5
+
 
 class TestMoe:
     def test_single_expert_equals_dense_mlp(self):

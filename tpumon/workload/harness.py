@@ -122,6 +122,7 @@ def run(
     microbatches: int = 2,
     seed: int = 0,
     mesh=None,
+    attn: str = "xla",
 ) -> RunResult:
     """Build, shard, and run the train step; returns losses + throughput.
 
@@ -130,6 +131,10 @@ def run(
     the mesh's ``seq`` axis (parallel.ring) plus a persistent
     batch×seq-sharded residual stream. ``ep > 1`` shards MoE expert banks
     over the ``expert`` axis so dispatch/combine become all-to-alls.
+    ``attn="flash"`` swaps the attention core for the pallas flash kernel
+    (ops.flash_attention); it composes with dp/tp/ep but not with sp > 1
+    (ring attention owns the attention impl) or pp > 1 (the pipelined
+    forward owns the model body).
     """
     is_moe = isinstance(cfg, MoeConfig)
     if ep > 1 and not is_moe:
@@ -148,6 +153,18 @@ def run(
         mesh = make_mesh(dp, tp, sp, pp, ep)
 
     attn_impl = shard_acts = shard_experts = forward_fn = None
+    if attn == "flash":
+        if sp > 1:
+            raise ValueError("attn='flash' does not compose with sp > 1 "
+                             "(ring attention owns the attention impl)")
+        if pp > 1:
+            raise ValueError("attn='flash' does not compose with pp > 1 "
+                             "(the pipelined forward owns the model body)")
+        from tpumon.workload.ops.flash_attention import make_flash_attn
+
+        attn_impl = make_flash_attn()
+    elif attn != "xla":
+        raise ValueError(f"unknown attn impl: {attn!r}")
     if sp > 1:
         if mesh is None:
             raise ValueError("sp > 1 requires a mesh")
@@ -242,6 +259,13 @@ def main(argv: list[str] | None = None) -> int:
         default=1,
         help="expert parallelism: shard MoE expert banks over this many "
         "devices (requires --model moe)",
+    )
+    parser.add_argument(
+        "--attn",
+        choices=("xla", "flash"),
+        default="xla",
+        help="attention core: XLA einsums or the pallas flash kernel "
+        "(ops.flash_attention; interpreted off-TPU)",
     )
     parser.add_argument(
         "--metrics-port",
@@ -368,6 +392,7 @@ def main(argv: list[str] | None = None) -> int:
             pp=args.pp,
             ep=args.ep,
             microbatches=args.microbatches,
+            attn=args.attn,
         )
         log.info(
             "loss %.4f → %.4f | %.2f steps/s | mesh dp=%d tp=%d sp=%d pp=%d ep=%d | devices=%s",

@@ -94,17 +94,18 @@ def _attention(x, layer, cfg: LlamaConfig, freqs, mask, attn_impl=None):
     q = apply_rope(q, freqs[:S])
     k = apply_rope(k, freqs[:S])
 
-    # Grouped-query: repeat KV heads up to H (cheap reshape-broadcast; XLA
-    # folds it into the einsum rather than materializing).
-    rep = H // KV
-    k = jnp.repeat(k, rep, axis=2)
-    v = jnp.repeat(v, rep, axis=2)
-
     if attn_impl is not None:
-        # Pluggable causal attention [B,S,H,D]→[B,S,H,D] — ring attention
-        # (parallel.ring) or a pallas flash kernel (ops.flash_attention).
+        # Pluggable causal attention q [B,S,H,D], k/v [B,S,KV,D] → [B,S,H,D].
+        # K/V keep their grouped-query head count; each impl resolves the
+        # sharing itself (pallas flash via index maps, ring attention by a
+        # local repeat after the hop — fewer bytes on the ICI ring).
         out = attn_impl(q, k, v).reshape(B, S, H * HD)
     else:
+        # Grouped-query: repeat KV heads up to H (cheap reshape-broadcast;
+        # XLA folds it into the einsum rather than materializing).
+        rep = H // KV
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
         scores = jnp.einsum(
             "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
         )

@@ -1,0 +1,403 @@
+"""Pallas flash attention — the workload model's hot op, TPU-first.
+
+The attention core is where the O(S²) FLOPs and HBM traffic live, so it is
+the one op worth a hand kernel (everything else in the model fuses fine
+under XLA). Design, per the TPU kernel playbook:
+
+- **Online softmax, one pass:** the kernel never materializes the [S, S]
+  score matrix. Each q-block keeps float32 running max ``m``, denominator
+  ``l``, and a weighted-value accumulator in registers while it streams
+  k-blocks from VMEM — O(S) memory instead of O(S²).
+- **MXU-shaped matmuls:** both einsums are ``jax.lax.dot_general`` with
+  ``preferred_element_type=float32``; probabilities are cast back to the
+  value dtype (bfloat16 in the workload) so the second matmul rides the
+  MXU at bf16 throughput with f32 accumulation.
+- **Grouped-query without the repeat:** the grid is (batch, q_heads,
+  q_blocks) and the K/V BlockSpec index-map sends q-head ``h`` to kv-head
+  ``h * KV // H`` — GQA sharing happens in the index map, so the repeated
+  K/V copies the XLA path materializes (models/llama.py `jnp.repeat`)
+  never exist.
+- **Causal skipping:** the k-block loop for q-block ``i`` runs only to the
+  diagonal (`lax.fori_loop` with a traced bound), halving work; the
+  diagonal block is masked with a 2D ``broadcasted_iota`` compare.
+
+Gradients: ``flash_attention`` carries a ``jax.custom_vjp``. The forward
+is this kernel; the backward recomputes attention blockwise from the saved
+(q, k, v) — flash-style O(S) memory — via three more Pallas kernels in
+this module (dq over q-blocks; dk/dv over k-blocks with the GQA group
+reduced inside the kernel).
+
+Runs compiled on TPU and in interpreter mode elsewhere (auto-detected), so
+the same code path is exercised by the CPU test mesh and the real chip.
+SURVEY.md §2.4: the workload exists to drive MXU/ICI traffic for the
+monitor; this kernel is what makes the MXU side of that traffic realistic.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# Finite stand-in for -inf (same constant as parallel.ring): masked logits
+# underflow to exp(x - m) == 0 without ever forming inf - inf.
+_NEG_BIG = -1e30
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# Mosaic tiling: the last block dim must be a multiple of 128 (or the full
+# array dim) and the second-to-last a multiple of 8 (or full). Row-wise
+# softmax state (lse, Δ) is therefore carried lane-broadcast at this width —
+# the same convention as jax.experimental.pallas.ops.tpu.flash_attention.
+_LANES = 128
+
+
+def _pick_block(size: int, requested: int) -> int:
+    """Largest divisor of ``size`` ≤ requested that keeps blocks tileable.
+
+    Prefers multiples of 8 (the f32 sublane); a full-size block is always
+    legal, so fall back to that when no aligned divisor exists.
+    """
+    for b in range(min(requested, size), 0, -1):
+        if size % b == 0 and (b % 8 == 0 or b == size):
+            return b
+    return size
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_q,
+                block_k, n_kb, causal):
+    """One (batch, head, q-block) program: stream k-blocks, online softmax."""
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [block_q, D]
+    D = q.shape[-1]
+
+    row = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]  # [block_k, D]
+        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        if causal:
+            col = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(row >= col, s, _NEG_BIG)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l, acc * alpha + pv
+
+    if causal:
+        # Last k-block that overlaps the causal triangle of this q-block.
+        hi = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
+        hi = jnp.minimum(hi, n_kb)
+    else:
+        hi = n_kb
+    m0 = jnp.full((block_q, 1), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    a0 = jnp.zeros((block_q, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, a0))
+
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+    # log-sum-exp per row (the flash backward's softmax residual),
+    # lane-broadcast so the block stays tileable.
+    lse_ref[0, 0] = jnp.broadcast_to(m + jnp.log(l), (block_q, _LANES))
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    """q [B,H,S,D], k/v [B,KV,S,D] → (out [B,H,S,D], lse [B,H,S] f32)."""
+    B, H, S, D = q.shape
+    KV = k.shape[1]
+    block_q = _pick_block(S, block_q)
+    block_k = _pick_block(S, block_k)
+    n_kb = S // block_k
+    scale = 1.0 / (D ** 0.5)
+
+    kv_spec = pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, (h * KV) // H, 0, 0))
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k,
+            n_kb=n_kb, causal=causal,
+        ),
+        grid=(B, H, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            kv_spec,
+            kv_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, _LANES), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+#
+# Standard flash decomposition. With P = softmax(QKᵀ·scale) (row lse saved),
+# dP = dO Vᵀ, Δ_i = Σ_j dO_ij O_ij (per row), dS = P ∘ (dP − Δ):
+#   dQ = scale · dS K          (kernel over q-blocks, streams k-blocks)
+#   dK = scale · dSᵀ Q,  dV = Pᵀ dO   (kernel over k-blocks, streams q-blocks,
+#                                      summing the GQA head group in-kernel)
+
+
+def _recompute_p(q, k, lse_blk, scale, row, col, causal):
+    """P block [block_q, block_k] in f32 from saved lse [block_q, 1]."""
+    s = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if causal:
+        s = jnp.where(row >= col, s, _NEG_BIG)
+    return jnp.exp(s - lse_blk)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, block_q, block_k, n_kb, causal):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, :1]    # lane-broadcast → [block_q, 1]
+    delta = delta_ref[0, 0][:, :1]
+    D = q.shape[-1]
+    row = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(kb, dq):
+        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        col = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        p = _recompute_p(q, k, lse, scale, row, col, causal)
+        dp = jax.lax.dot_general(
+            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, k, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        hi = jnp.minimum(
+            jax.lax.div((qi + 1) * block_q + block_k - 1, block_k), n_kb
+        )
+    else:
+        hi = n_kb
+    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, D), jnp.float32))
+    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale, block_q, block_k, n_qb, group,
+                causal):
+    """One (batch, kv-head, k-block) program.
+
+    Streams q-blocks and the ``group`` q-heads sharing this kv-head,
+    accumulating dK/dV for the block — the GQA head-group sum happens here
+    instead of in a scatter-add epilogue.
+    """
+    ki = pl.program_id(2)
+    k = k_ref[0, 0].astype(jnp.float32)  # [block_k, D]
+    v = v_ref[0, 0].astype(jnp.float32)
+    D = k.shape[-1]
+    col = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+
+    def q_body(qb, carry):
+        dk, dv, g = carry
+        q = q_ref[0, g, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, g, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, g, pl.ds(qb * block_q, block_q), :][:, :1]
+        delta = delta_ref[0, g, pl.ds(qb * block_q, block_q), :][:, :1]
+        row = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        p = _recompute_p(q, k, scale=scale, lse_blk=lse, row=row, col=col,
+                         causal=causal)
+        dv = dv + jax.lax.dot_general(
+            p, do, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(
+            ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv, g
+
+    def g_body(g, carry):
+        dk, dv = carry
+        if causal:
+            # First q-block that reaches this k-block's causal triangle.
+            lo = jax.lax.div(ki * block_k, block_q)
+        else:
+            lo = 0
+        dk, dv, _ = jax.lax.fori_loop(lo, n_qb, q_body, (dk, dv, g))
+        return dk, dv
+
+    z = jnp.zeros((block_k, D), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, group, g_body, (z, z))
+    dk_ref[0, 0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret):
+    B, H, S, D = q.shape
+    KV = k.shape[1]
+    group = H // KV
+    block_q = _pick_block(S, block_q)
+    block_k = _pick_block(S, block_k)
+    scale = 1.0 / (D ** 0.5)
+
+    # Δ_i = Σ_d dO·O per row — tiny elementwise reduce; XLA fuses it.
+    # Lane-broadcast to _LANES like lse so its blocks stay tileable.
+    delta = jnp.broadcast_to(
+        jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                axis=-1, keepdims=True),
+        (B, H, S, _LANES),
+    )
+
+    kv_spec = pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, (h * KV) // H, 0, 0))
+    q_blk = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0))
+    row_blk = pl.BlockSpec((1, 1, block_q, _LANES), lambda b, h, i: (b, h, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, block_q=block_q, block_k=block_k,
+            n_kb=S // block_k, causal=causal,
+        ),
+        grid=(B, H, S // block_q),
+        in_specs=[q_blk, kv_spec, kv_spec, q_blk, row_blk, row_blk],
+        out_specs=q_blk,
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dK/dV: one program per (batch, kv-head, k-block); q/do/lse/delta come
+    # in as the whole ``group`` q-head band so the GQA sum stays in-kernel.
+    band = pl.BlockSpec((1, group, S, D), lambda b, h, i: (b, h, 0, 0))
+    band_row = pl.BlockSpec((1, group, S, _LANES), lambda b, h, i: (b, h, 0, 0))
+    k_blk = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i: (b, h, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, block_q=block_q, block_k=block_k,
+            n_qb=S // block_q, group=group, causal=causal,
+        ),
+        grid=(B, KV, S // block_k),
+        in_specs=[band, k_blk, k_blk, band, band_row, band_row],
+        out_specs=[k_blk, k_blk],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, KV, S, D), k.dtype),
+            jax.ShapeDtypeStruct((B, KV, S, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k, interpret)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Flash attention over [B, S, H, D] tensors (model layout).
+
+    K/V may carry fewer heads than Q (grouped-query); sharing is resolved
+    in the kernel's index maps, never materialized. Differentiable (custom
+    VJP, flash-style recompute backward). ``interpret=None`` auto-selects
+    interpreter mode off-TPU so the CPU test mesh runs the same code.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    B, S, H, D = q.shape
+    KV = k.shape[2]
+    if H % KV:
+        raise ValueError(f"q heads ({H}) must be a multiple of kv heads ({KV})")
+    # Kernel layout is [B, heads, S, D].
+    qT = q.transpose(0, 2, 1, 3)
+    kT = k.transpose(0, 2, 1, 3)
+    vT = v.transpose(0, 2, 1, 3)
+    out = _flash(qT, kT, vT, causal, block_q, block_k, interpret)
+    return out.transpose(0, 2, 1, 3)
+
+
+def make_flash_attn(*, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool | None = None):
+    """``attn_impl`` factory for models.llama.forward / models.moe.forward."""
+
+    def attn(q, k, v):
+        return flash_attention(
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+            interpret=interpret,
+        )
+
+    return attn

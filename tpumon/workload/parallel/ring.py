@@ -63,14 +63,16 @@ def ring_attention_local(
 ) -> jnp.ndarray:
     """Per-shard body: runs INSIDE shard_map, q/k/v are the local seq blocks.
 
-    q, k, v: [B, S_local, H, D] with the global sequence sharded over
-    ``axis_name``. KV heads must already be repeated up to the Q head count
-    (grouped-query expansion happens before the ring so every hop moves the
-    exact bytes attention will read).
+    q [B, S_local, H, D]; k/v [B, S_local, KV, D] with the global sequence
+    sharded over ``axis_name``. K/V may carry fewer (grouped-query) heads —
+    the ring carries and ppermutes the KV-headed blocks and each device
+    expands the block it just received right before its local attention
+    step, so only KV-head bytes ever cross the ICI ring.
     """
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     B, S, H, D = q.shape
+    rep = H // k.shape[2]
     scale = 1.0 / jnp.sqrt(jnp.float32(D))
 
     q32 = q.astype(jnp.float32)
@@ -92,7 +94,10 @@ def ring_attention_local(
             mask = q_pos[:, None] >= kv_pos[None, :]
         else:
             mask = jnp.ones((S, S), bool)
-        m, l, o = _block_attn(q32, k, v, mask, m, l, o, scale)
+        # Grouped-query expansion is local: the hop moved KV heads only.
+        kh = jnp.repeat(k, rep, axis=2) if rep > 1 else k
+        vh = jnp.repeat(v, rep, axis=2) if rep > 1 else v
+        m, l, o = _block_attn(q32, kh, vh, mask, m, l, o, scale)
         # Rotate K/V one hop; the final rotation returns blocks to their
         # owners, keeping the loop body uniform for lax.fori_loop.
         k = jax.lax.ppermute(k, axis_name, perm)
@@ -109,23 +114,31 @@ def make_ring_attn(
 ):
     """An attention callable q,k,v → out with the sequence axis ring-sharded.
 
-    Returned fn takes global [B, S, H, D] arrays under jit; shard_map splits
-    batch over ``data_axis`` and sequence over ``seq_axis``. Pass
-    ``head_axis="model"`` to compose with tensor parallelism: heads are
-    independent in attention, so sharding them over the model axis keeps
-    the TP layout through the ring with zero extra communication.
+    Returned fn takes q [B, S, H, D] and (possibly grouped-query) k/v
+    [B, S, KV, D] under jit; shard_map splits batch over ``data_axis`` and
+    sequence over ``seq_axis``. Pass ``head_axis="model"`` to compose with
+    tensor parallelism: heads are independent in attention, so sharding
+    them over the model axis keeps the TP layout through the ring with
+    zero extra communication. K/V stay KV-headed on the ring (expansion is
+    local, after each hop) unless the model axis doesn't divide KV — then
+    they are pre-expanded to H so any tp ≤ H still shards.
     """
     spec = P(data_axis, seq_axis, head_axis, None)
-
-    @partial(
+    local = partial(ring_attention_local, axis_name=seq_axis, causal=causal)
+    sharded = partial(
         jax.shard_map,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
         check_vma=False,
-    )
+    )(lambda q, k, v: local(q, k, v))
+
     def attn(q, k, v):
-        return ring_attention_local(q, k, v, seq_axis, causal=causal)
+        H, KV = q.shape[2], k.shape[2]
+        if head_axis is not None and KV % mesh.shape[head_axis]:
+            k = jnp.repeat(k, H // KV, axis=2)
+            v = jnp.repeat(v, H // KV, axis=2)
+        return sharded(q, k, v)
 
     return attn
 

@@ -1,0 +1,230 @@
+"""Sample-history engine: native/Python parity, eviction, /history API.
+
+The engine is the DCGM field-cache analogue (SURVEY.md §2.1): a bounded
+per-series 1 Hz ring the /history endpoint and `tpumon smi` read.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from tpumon import history as hist
+from tpumon.history import History, PyEngine, make_engine, series_key
+
+
+def engines(max_age=600.0, max_samples=4096):
+    out = [("python", PyEngine(max_age, max_samples))]
+    if hist.native_available():
+        out.append(("native", make_engine(max_age, max_samples, native=True)))
+    return out
+
+
+def test_native_builds_here():
+    # g++ is part of this image; the native engine must actually build.
+    assert hist.native_available()
+
+
+@pytest.mark.parametrize("name,eng", engines())
+def test_record_query_roundtrip(name, eng):
+    eng.record_batch(10.0, [("a", 1.0), ("b", 2.0)])
+    eng.record_batch(11.0, [("a", 3.0)])
+    assert eng.query("a") == [(10.0, 1.0), (11.0, 3.0)]
+    assert eng.query("a", since=10.5) == [(11.0, 3.0)]
+    assert eng.query("b") == [(10.0, 2.0)]
+    assert eng.query("missing") == []
+    assert eng.keys() == ["a", "b"]
+    assert eng.stats() == (2, 3)
+
+
+@pytest.mark.parametrize("name,eng", engines(max_age=5.0))
+def test_age_eviction(name, eng):
+    eng.record_batch(0.0, [("a", 1.0)])
+    eng.record_batch(10.0, [("a", 2.0)])  # t=0 sample is > 5s old now
+    assert eng.query("a") == [(10.0, 2.0)]
+
+
+@pytest.mark.parametrize("name,eng", engines(max_samples=3))
+def test_sample_cap_eviction(name, eng):
+    for i in range(10):
+        eng.record_batch(float(i), [("a", float(i))])
+    assert eng.query("a") == [(7.0, 7.0), (8.0, 8.0), (9.0, 9.0)]
+
+
+@pytest.mark.parametrize("name,eng", engines())
+def test_summarize(name, eng):
+    for i in range(10):
+        eng.record_batch(float(i), [("a", float(i * 2))])
+    s = eng.summarize("a", 100.0, 9.0)
+    assert s["count"] == 10
+    assert s["min"] == 0.0 and s["max"] == 18.0
+    assert s["avg"] == pytest.approx(9.0)
+    assert s["first"] == 0.0 and s["last"] == 18.0
+    assert s["rate"] == pytest.approx(2.0)  # 18 over 9 seconds
+    # Narrow window sees only the tail.
+    s = eng.summarize("a", 2.5, 9.0)
+    assert s["count"] == 3
+    assert s["min"] == 14.0
+    assert eng.summarize("missing", 10.0, 9.0) is None
+    # Window excludes everything -> None.
+    assert eng.summarize("a", 0.5, 100.0) is None
+
+
+@pytest.mark.parametrize("name,eng", engines())
+def test_summarize_all_omits_out_of_window(name, eng):
+    eng.record_batch(0.0, [("old", 1.0)])
+    eng.record_batch(100.0, [("new", 2.0)])
+    out = eng.summarize_all(10.0, 100.0)
+    assert set(out) == {"new"}
+    assert out["new"]["last"] == 2.0
+
+
+@pytest.mark.parametrize("name,eng", engines(max_age=50.0))
+def test_dead_series_sweep(name, eng):
+    eng.record_batch(0.0, [("dead", 1.0)])
+    # The sweep runs every 256 record calls; all fresh records are far
+    # past the dead series' horizon.
+    for i in range(257):
+        eng.record_batch(1000.0 + i, [("live", 1.0)])
+    assert eng.keys() == ["live"]
+
+
+@pytest.mark.skipif(not hist.native_available(), reason="no compiler")
+def test_native_python_parity():
+    nat = make_engine(100.0, 64, native=True)
+    py = PyEngine(100.0, 64)
+    pts = [
+        (float(t), [(f"s{i}", (t * 7 + i) % 13 / 3.0) for i in range(5)])
+        for t in range(300)
+    ]
+    for ts, items in pts:
+        nat.record_batch(ts, items)
+        py.record_batch(ts, items)
+    assert nat.keys() == py.keys()
+    assert nat.stats() == py.stats()
+    for k in nat.keys():
+        assert nat.query(k) == pytest.approx(py.query(k))
+        ns, ps = nat.summarize(k, 37.0, 299.0), py.summarize(k, 37.0, 299.0)
+        assert set(ns) == set(ps)
+        for field in ns:
+            assert ns[field] == pytest.approx(ps[field]), field
+    assert nat.summarize_all(37.0, 299.0).keys() == py.summarize_all(
+        37.0, 299.0
+    ).keys()
+
+
+@pytest.mark.parametrize("name,eng", engines())
+def test_engine_thread_hammer(name, eng):
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        try:
+            while not stop.is_set():
+                for k in eng.keys():
+                    eng.query(k)
+                    eng.summarize(k, 10.0, 1e9)
+                eng.summarize_all(10.0, 1e9)
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for i in range(2000):
+        eng.record_batch(float(i), [(f"k{i % 17}", float(i))])
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors
+
+
+def test_series_key():
+    assert series_key("f", {}) == "f"
+    assert series_key("f", {"b": "2", "a": "1"}) == 'f{a="1",b="2"}'
+
+
+def test_record_families_filters(fake_exporter=None):
+    from prometheus_client.core import GaugeMetricFamily
+
+    h = History(native=False)
+    fam = GaugeMetricFamily(
+        "accelerator_duty_cycle_percent", "d", labels=("host", "chip")
+    )
+    fam.add_metric(("h0", "0"), 12.5)
+    info = GaugeMetricFamily("accelerator_info", "i", labels=("host", "chip"))
+    info.add_metric(("h0", "0"), 1.0)
+    h.record_families(100.0, [fam, info], base_keys=("host",))
+    assert h.keys() == ['accelerator_duty_cycle_percent{chip="0"}']
+    assert h.query('accelerator_duty_cycle_percent{chip="0"}') == [(100.0, 12.5)]
+
+
+@pytest.fixture
+def exporter():
+    from tpumon.backends.fake import FakeTpuBackend
+    from tpumon.config import Config
+    from tpumon.exporter.server import build_exporter
+
+    cfg = Config(port=0, addr="127.0.0.1", backend="fake", interval=30.0,
+                 pod_attribution=False)
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v5e-16"))
+    exp.start()
+    yield exp
+    exp.close()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read()
+
+
+def test_history_endpoint(exporter):
+    exporter.poller.poll_once()
+    status, body = _get(exporter.server.url + "/history")
+    assert status == 200
+    doc = json.loads(body)
+    assert doc["window"] == exporter.cfg.history_window
+    assert doc["series"], "history should hold series after two polls"
+    key, summary = next(iter(doc["series"].items()))
+    assert summary["count"] >= 1
+    assert {"min", "max", "avg", "last", "rate"} <= set(summary)
+
+    # Per-series raw points.
+    q = urllib.parse.urlencode({"series": key})
+    status, body = _get(exporter.server.url + "/history?" + q)
+    assert status == 200
+    doc = json.loads(body)
+    assert doc["series"] == key
+    assert doc["points"] and len(doc["points"][0]) == 2
+
+
+def test_history_endpoint_bad_window(exporter):
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(exporter.server.url + "/history?window=bogus")
+    assert ei.value.code == 400
+
+
+def test_history_disabled():
+    import urllib.error
+
+    from tpumon.backends.fake import FakeTpuBackend
+    from tpumon.config import Config
+    from tpumon.exporter.server import build_exporter
+
+    cfg = Config(port=0, addr="127.0.0.1", backend="fake", interval=30.0,
+                 pod_attribution=False, history_window=0.0)
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v5e-16"))
+    assert exp.history is None
+    exp.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(exp.server.url + "/history")
+        assert ei.value.code == 404
+    finally:
+        exp.close()

@@ -1,0 +1,87 @@
+"""`tpumon smi` — operator status CLI (nvidia-smi/tpu-smi analogue)."""
+
+from __future__ import annotations
+
+import io
+import json
+
+import pytest
+
+from tpumon.backends.fake import FakeTpuBackend
+from tpumon.backends.stub import StubBackend
+from tpumon.config import Config
+from tpumon.exporter.server import build_exporter
+from tpumon import smi
+
+
+@pytest.fixture
+def exporter():
+    cfg = Config(port=0, addr="127.0.0.1", interval=30.0, pod_attribution=False)
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v5e-16"))
+    exp.start()
+    exp.poller.poll_once()  # two samples so trends have a window
+    yield exp
+    exp.close()
+
+
+def test_snapshot_from_url_and_render(exporter):
+    snap = smi.snapshot_from_url(exporter.server.url, 5.0, 60.0)
+    assert len(snap["chips"]) == 4
+    chip0 = snap["chips"]["0"]
+    assert "duty_pct" in chip0 and "hbm_used" in chip0 and "coords" in chip0
+    assert "duty_trend" in chip0  # /history reachable -> trends merged
+    assert snap["identity"]["slice"] == "fake-v5e-16"
+    assert snap["ici"]["total"] > 0
+
+    out = io.StringIO()
+    smi.render(snap, out)
+    text = out.getvalue()
+    assert "tpumon smi — " in text
+    assert "slice=fake-v5e-16" in text
+    assert "Duty min/avg/max" in text
+    assert "ici links:" in text
+    assert "core util:" in text
+    # One row per chip.
+    assert sum(1 for line in text.splitlines() if line.startswith("|  ")) >= 4
+
+
+def test_main_url_mode(exporter, capsys):
+    rc = smi.main(["--url", exporter.server.url])
+    assert rc == 0
+    assert "tpumon smi — " in capsys.readouterr().out
+
+
+def test_main_json_mode(exporter, capsys):
+    rc = smi.main(["--url", exporter.server.url, "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["chips"]["0"]["duty_pct"] >= 0
+
+
+def test_unreachable_url_is_error_not_traceback(capsys):
+    rc = smi.main(["--url", "http://127.0.0.1:1", "--timeout", "0.5"])
+    assert rc == 1
+    assert "cannot reach exporter" in capsys.readouterr().err
+
+
+def test_standalone_backend_mode():
+    cfg = Config(backend="fake", fake_topology="v4-8", pod_attribution=False)
+    snap = smi.snapshot_from_backend(cfg)
+    assert snap["chips"]
+    assert snap["coverage"] == 1.0
+    out = io.StringIO()
+    smi.render(snap, out)
+    assert "tpumon smi — " in out.getvalue()
+
+
+def test_stub_render():
+    from tpumon._native import render_families
+    from tpumon.exporter.collector import build_families
+
+    cfg = Config(backend="stub", pod_attribution=False)
+    families, _ = build_families(StubBackend(), cfg)
+    snap = smi.snapshot_from_text(render_families(families).decode())
+    assert snap["device_count"] == 0
+    out = io.StringIO()
+    smi.render(snap, out)
+    assert "no accelerator devices" in out.getvalue()

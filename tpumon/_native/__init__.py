@@ -31,26 +31,32 @@ _ext = None
 _tried = False
 
 
-def _compile() -> str | None:
-    """Compile _exposition.c into build/; returns the .so path or None.
+def compile_extension(stem: str) -> str | None:
+    """Compile ``tpumon/_native/<stem>.c|.cc`` into build/; .so path or None.
 
+    Shared by every native component (exposition renderer, history engine).
     EVERYTHING is inside the try: on a readOnlyRootFilesystem container the
     very first makedirs raises, and that must mean 'use the fallback',
-    never a crash.
+    never a crash. ``.cc`` sources use the C++ driver (CXX env override,
+    else g++); ``.c`` sources use sysconfig's CC.
     """
     try:
         os.makedirs(_BUILD_DIR, exist_ok=True)
         suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-        so_path = os.path.join(_BUILD_DIR, "_exposition" + suffix)
-        src = os.path.join(_HERE, "_exposition.c")
+        so_path = os.path.join(_BUILD_DIR, stem + suffix)
+        c_src = os.path.join(_HERE, stem + ".c")
+        src = c_src if os.path.exists(c_src) else os.path.join(_HERE, stem + ".cc")
         if os.path.exists(so_path) and os.path.getmtime(so_path) >= os.path.getmtime(
             src
         ):
             return so_path
-        cc = sysconfig.get_config_var("CC") or "cc"
+        if src.endswith(".cc"):
+            compiler = [os.environ.get("CXX") or "g++", "-std=c++17"]
+        else:
+            compiler = (sysconfig.get_config_var("CC") or "cc").split()
         include = sysconfig.get_path("include")
         cmd = [
-            *cc.split(),
+            *compiler,
             "-O2",
             "-fPIC",
             "-shared",
@@ -65,22 +71,26 @@ def _compile() -> str | None:
         return so_path
     except Exception as exc:
         detail = getattr(exc, "stderr", "") or str(exc)
-        log.info("native exposition build unavailable: %s", str(detail).strip()[:200])
+        log.info("native %s build unavailable: %s", stem, str(detail).strip()[:200])
         return None
 
 
-def _import_so(so_path: str):
+def load_extension(stem: str):
+    """compile_extension + import; returns the module or None."""
+    so_path = compile_extension(stem)
+    if so_path is None:
+        return None
     import importlib.util
 
     try:
         spec = importlib.util.spec_from_file_location(
-            "tpumon._native._exposition", so_path
+            f"tpumon._native.{stem}", so_path
         )
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         return mod
     except Exception as exc:
-        log.info("native exposition load failed: %s", exc)
+        log.info("native %s load failed: %s", stem, exc)
         return None
 
 
@@ -91,9 +101,7 @@ def _load():
     _tried = True
     if os.environ.get("TPUMON_NO_NATIVE"):
         return None
-    so_path = _compile()
-    if so_path is not None:
-        _ext = _import_so(so_path)
+    _ext = load_extension("_exposition")
     return _ext
 
 
@@ -112,9 +120,7 @@ def prewarm_async() -> None:
 
     def _bg():
         global _ext
-        so_path = _compile()
-        if so_path is not None:
-            _ext = _import_so(so_path)
+        _ext = load_extension("_exposition")
 
     threading.Thread(target=_bg, name="tpumon-native-build", daemon=True).start()
 

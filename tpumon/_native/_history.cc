@@ -1,0 +1,340 @@
+/* In-process sample-history engine — the DCGM hostengine/field-cache
+ * analogue (SURVEY.md §2.1 "DCGM engine" row; dcgmi field watches keep a
+ * bounded per-field sample cache with max-keep-age/max-keep-samples
+ * semantics).
+ *
+ * The exporter polls at 1 Hz but Prometheus typically scrapes at 15-60 s,
+ * so transients (duty-cycle spikes, throttle events, ICI link flaps)
+ * alias away.  This engine is the 1 Hz flight recorder: each poll cycle
+ * appends every sample point to a bounded per-series ring, and the
+ * /history endpoint + `tpumon smi` read windowed summaries
+ * (min/max/avg/last/rate) or raw points back out.
+ *
+ * C++ because this is runtime infrastructure, not compute: the hot call
+ * is record_batch() on the poll thread (hundreds of points on a v5p-64
+ * host), and queries come from HTTP threads concurrently — a
+ * std::recursive_mutex guards the map independently of the GIL so a
+ * mid-query allocation that triggers GC re-entry can never corrupt or
+ * deadlock the structure.  Python fallback with identical semantics lives
+ * in tpumon/history.py for no-compiler environments.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <algorithm>
+#include <cmath>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+namespace {
+
+struct Sample {
+  double ts;
+  double value;
+};
+
+struct Series {
+  std::deque<Sample> samples;
+};
+
+struct EngineState {
+  double max_age = 600.0;
+  Py_ssize_t max_samples = 4096;
+  std::unordered_map<std::string, Series> series;
+  unsigned long record_calls = 0;
+  std::recursive_mutex mu;
+};
+
+struct EngineObject {
+  PyObject_HEAD
+  EngineState *state;
+};
+
+int Engine_init(PyObject *self, PyObject *args, PyObject *kwds) {
+  static const char *kwlist[] = {"max_age", "max_samples", nullptr};
+  double max_age = 600.0;
+  Py_ssize_t max_samples = 4096;
+  if (!PyArg_ParseTupleAndKeywords(args, kwds, "|dn",
+                                   const_cast<char **>(kwlist), &max_age,
+                                   &max_samples))
+    return -1;
+  if (max_age <= 0 || max_samples <= 0) {
+    PyErr_SetString(PyExc_ValueError, "max_age and max_samples must be > 0");
+    return -1;
+  }
+  EngineObject *e = reinterpret_cast<EngineObject *>(self);
+  delete e->state;
+  e->state = new EngineState();
+  e->state->max_age = max_age;
+  e->state->max_samples = max_samples;
+  return 0;
+}
+
+void Engine_dealloc(PyObject *self) {
+  EngineObject *e = reinterpret_cast<EngineObject *>(self);
+  delete e->state;
+  e->state = nullptr;
+  PyTypeObject *tp = Py_TYPE(self);
+  tp->tp_free(self);
+  Py_DECREF(tp);
+}
+
+void evict(Series &s, double now, const EngineState &st) {
+  const double horizon = now - st.max_age;
+  while (!s.samples.empty() &&
+         (s.samples.front().ts < horizon ||
+          static_cast<Py_ssize_t>(s.samples.size()) > st.max_samples))
+    s.samples.pop_front();
+}
+
+/* record_batch(ts, items): items is a sequence of (key: str, value: float).
+ * Every 256 calls, series whose newest sample has aged out are dropped so
+ * label churn (pods coming and going) cannot grow the map unboundedly. */
+PyObject *Engine_record_batch(PyObject *self, PyObject *args) {
+  double ts;
+  PyObject *items;
+  if (!PyArg_ParseTuple(args, "dO", &ts, &items)) return nullptr;
+  PyObject *fast = PySequence_Fast(items, "items must be a sequence");
+  if (fast == nullptr) return nullptr;
+
+  EngineState *st = reinterpret_cast<EngineObject *>(self)->state;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  {
+    std::lock_guard<std::recursive_mutex> lock(st->mu);
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject *item = PySequence_Fast_GET_ITEM(fast, i);
+      if (!PyTuple_Check(item) || PyTuple_GET_SIZE(item) != 2) {
+        Py_DECREF(fast);
+        PyErr_SetString(PyExc_TypeError, "items must be (str, float) tuples");
+        return nullptr;
+      }
+      PyObject *key_obj = PyTuple_GET_ITEM(item, 0);
+      Py_ssize_t key_len = 0;
+      const char *key = PyUnicode_AsUTF8AndSize(key_obj, &key_len);
+      if (key == nullptr) {
+        Py_DECREF(fast);
+        return nullptr;
+      }
+      double value = PyFloat_AsDouble(PyTuple_GET_ITEM(item, 1));
+      if (value == -1.0 && PyErr_Occurred()) {
+        Py_DECREF(fast);
+        return nullptr;
+      }
+      Series &s = st->series[std::string(key, key_len)];
+      s.samples.push_back({ts, value});
+      evict(s, ts, *st);
+    }
+    if (++st->record_calls % 256 == 0) {
+      const double horizon = ts - st->max_age;
+      for (auto it = st->series.begin(); it != st->series.end();) {
+        if (it->second.samples.empty() ||
+            it->second.samples.back().ts < horizon)
+          it = st->series.erase(it);
+        else
+          ++it;
+      }
+    }
+  }
+  Py_DECREF(fast);
+  Py_RETURN_NONE;
+}
+
+/* query(key, since=0.0) -> list[(ts, value)] (empty for unknown key). */
+PyObject *Engine_query(PyObject *self, PyObject *args, PyObject *kwds) {
+  static const char *kwlist[] = {"key", "since", nullptr};
+  const char *key;
+  double since = 0.0;
+  if (!PyArg_ParseTupleAndKeywords(args, kwds, "s|d",
+                                   const_cast<char **>(kwlist), &key, &since))
+    return nullptr;
+  EngineState *st = reinterpret_cast<EngineObject *>(self)->state;
+
+  /* Copy matching samples out under the lock, build Python objects after:
+   * object allocation can trigger GC and arbitrary re-entry. */
+  std::deque<Sample> copy;
+  {
+    std::lock_guard<std::recursive_mutex> lock(st->mu);
+    auto it = st->series.find(key);
+    if (it != st->series.end()) {
+      for (const Sample &s : it->second.samples)
+        if (s.ts >= since) copy.push_back(s);
+    }
+  }
+  PyObject *out = PyList_New(static_cast<Py_ssize_t>(copy.size()));
+  if (out == nullptr) return nullptr;
+  Py_ssize_t i = 0;
+  for (const Sample &s : copy) {
+    PyObject *pair = Py_BuildValue("(dd)", s.ts, s.value);
+    if (pair == nullptr) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, i++, pair);
+  }
+  return out;
+}
+
+PyObject *summary_dict(const std::deque<Sample> &samples, double lo) {
+  double mn = 0, mx = 0, sum = 0, first = 0, last = 0;
+  double first_ts = 0, last_ts = 0;
+  long count = 0;
+  for (const Sample &s : samples) {
+    if (s.ts < lo) continue;
+    if (count == 0) {
+      mn = mx = first = s.value;
+      first_ts = s.ts;
+    } else {
+      mn = std::min(mn, s.value);
+      mx = std::max(mx, s.value);
+    }
+    last = s.value;
+    last_ts = s.ts;
+    sum += s.value;
+    count++;
+  }
+  if (count == 0) Py_RETURN_NONE;
+  double dt = last_ts - first_ts;
+  double rate = dt > 0 ? (last - first) / dt : 0.0;
+  return Py_BuildValue(
+      "{s:l,s:d,s:d,s:d,s:d,s:d,s:d,s:d,s:d}", "count", count, "min", mn,
+      "max", mx, "avg", sum / count, "first", first, "last", last, "first_ts",
+      first_ts, "last_ts", last_ts, "rate", rate);
+}
+
+/* summarize(key, window, now) -> dict | None */
+PyObject *Engine_summarize(PyObject *self, PyObject *args) {
+  const char *key;
+  double window, now;
+  if (!PyArg_ParseTuple(args, "sdd", &key, &window, &now)) return nullptr;
+  EngineState *st = reinterpret_cast<EngineObject *>(self)->state;
+  std::deque<Sample> copy;
+  {
+    std::lock_guard<std::recursive_mutex> lock(st->mu);
+    auto it = st->series.find(key);
+    if (it == st->series.end()) Py_RETURN_NONE;
+    copy = it->second.samples;
+  }
+  return summary_dict(copy, now - window);
+}
+
+/* summarize_all(window, now) -> {key: dict} (series with no samples in the
+ * window are omitted). */
+PyObject *Engine_summarize_all(PyObject *self, PyObject *args) {
+  double window, now;
+  if (!PyArg_ParseTuple(args, "dd", &window, &now)) return nullptr;
+  EngineState *st = reinterpret_cast<EngineObject *>(self)->state;
+  std::unordered_map<std::string, std::deque<Sample>> copy;
+  {
+    std::lock_guard<std::recursive_mutex> lock(st->mu);
+    for (const auto &kv : st->series) copy[kv.first] = kv.second.samples;
+  }
+  PyObject *out = PyDict_New();
+  if (out == nullptr) return nullptr;
+  for (const auto &kv : copy) {
+    PyObject *summary = summary_dict(kv.second, now - window);
+    if (summary == nullptr) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    if (summary == Py_None) {
+      Py_DECREF(summary);
+      continue;
+    }
+    int rc = PyDict_SetItemString(out, kv.first.c_str(), summary);
+    Py_DECREF(summary);
+    if (rc < 0) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+  }
+  return out;
+}
+
+PyObject *Engine_keys(PyObject *self, PyObject *) {
+  EngineState *st = reinterpret_cast<EngineObject *>(self)->state;
+  std::deque<std::string> copy;
+  {
+    std::lock_guard<std::recursive_mutex> lock(st->mu);
+    for (const auto &kv : st->series) copy.push_back(kv.first);
+  }
+  std::sort(copy.begin(), copy.end());
+  PyObject *out = PyList_New(static_cast<Py_ssize_t>(copy.size()));
+  if (out == nullptr) return nullptr;
+  Py_ssize_t i = 0;
+  for (const std::string &k : copy) {
+    PyObject *s = PyUnicode_FromStringAndSize(k.data(), k.size());
+    if (s == nullptr) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, i++, s);
+  }
+  return out;
+}
+
+/* stats() -> (n_series, n_samples) */
+PyObject *Engine_stats(PyObject *self, PyObject *) {
+  EngineState *st = reinterpret_cast<EngineObject *>(self)->state;
+  size_t n_series, n_samples = 0;
+  {
+    std::lock_guard<std::recursive_mutex> lock(st->mu);
+    n_series = st->series.size();
+    for (const auto &kv : st->series) n_samples += kv.second.samples.size();
+  }
+  return Py_BuildValue("(nn)", static_cast<Py_ssize_t>(n_series),
+                       static_cast<Py_ssize_t>(n_samples));
+}
+
+PyMethodDef Engine_methods[] = {
+    {"record_batch", Engine_record_batch, METH_VARARGS,
+     "record_batch(ts, [(key, value), ...])"},
+    {"query", reinterpret_cast<PyCFunction>(Engine_query),
+     METH_VARARGS | METH_KEYWORDS, "query(key, since=0.0) -> [(ts, value)]"},
+    {"summarize", Engine_summarize, METH_VARARGS,
+     "summarize(key, window, now) -> dict | None"},
+    {"summarize_all", Engine_summarize_all, METH_VARARGS,
+     "summarize_all(window, now) -> {key: dict}"},
+    {"keys", Engine_keys, METH_NOARGS, "keys() -> [str]"},
+    {"stats", Engine_stats, METH_NOARGS, "stats() -> (n_series, n_samples)"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyType_Slot Engine_slots[] = {
+    {Py_tp_init, reinterpret_cast<void *>(Engine_init)},
+    {Py_tp_dealloc, reinterpret_cast<void *>(Engine_dealloc)},
+    {Py_tp_methods, Engine_methods},
+    {Py_tp_doc,
+     const_cast<char *>("Bounded per-series sample-history ring "
+                        "(max_age seconds, max_samples per series).")},
+    {0, nullptr},
+};
+
+PyType_Spec Engine_spec = {
+    "tpumon._native._history.Engine",
+    sizeof(EngineObject),
+    0,
+    Py_TPFLAGS_DEFAULT,
+    Engine_slots,
+};
+
+PyModuleDef history_module = {
+    PyModuleDef_HEAD_INIT, "_history",
+    "Native sample-history engine (DCGM field-cache analogue).", -1,
+    nullptr,
+};
+
+}  // namespace
+
+extern "C" PyMODINIT_FUNC PyInit__history(void) {
+  PyObject *mod = PyModule_Create(&history_module);
+  if (mod == nullptr) return nullptr;
+  PyObject *engine_type = PyType_FromSpec(&Engine_spec);
+  if (engine_type == nullptr || PyModule_AddObject(mod, "Engine", engine_type) < 0) {
+    Py_XDECREF(engine_type);
+    Py_DECREF(mod);
+    return nullptr;
+  }
+  return mod;
+}

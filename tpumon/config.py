@@ -90,6 +90,11 @@ class Config:
     pod_attribution: bool = True
     #: kubelet pod-resources gRPC socket.
     kubelet_socket: str = "unix:///var/lib/kubelet/pod-resources/kubelet.sock"
+    #: Sample-history window in seconds (the 1 Hz flight recorder backing
+    #: /history and `tpumon smi`); 0 disables recording.
+    history_window: float = 600.0
+    #: Per-series sample cap for the history engine.
+    history_max_samples: int = 4096
     #: Log level name.
     log_level: str = "INFO"
     #: Path where the discovery sidecar writes topology JSON.
@@ -112,6 +117,10 @@ class Config:
             grpc_timeout=_env_float("GRPC_TIMEOUT", base.grpc_timeout),
             ici_per_link=_env_bool("ICI_PER_LINK", base.ici_per_link),
             pod_attribution=_env_bool("POD_ATTRIBUTION", base.pod_attribution),
+            history_window=_env_float("HISTORY_WINDOW", base.history_window),
+            history_max_samples=_env_int(
+                "HISTORY_MAX_SAMPLES", base.history_max_samples
+            ),
             kubelet_socket=_env("KUBELET_SOCKET", base.kubelet_socket)
             or base.kubelet_socket,
             log_level=_env("LOG_LEVEL", base.log_level) or base.log_level,
@@ -132,6 +141,16 @@ class Config:
         g.add_argument("--fake-topology", help="fake backend topology preset")
         g.add_argument("--grpc-addr", help="monitoring gRPC address")
         g.add_argument("--grpc-timeout", type=float, help="gRPC timeout seconds")
+        g.add_argument(
+            "--history-window",
+            type=float,
+            help="sample-history window seconds (0 disables /history)",
+        )
+        g.add_argument(
+            "--history-max-samples",
+            type=int,
+            help="per-series sample cap for the history engine",
+        )
         g.add_argument("--log-level", help="log level")
         g.add_argument("--kubelet-socket", help="pod-resources gRPC socket")
         g.add_argument("--topology-out", help="sidecar topology JSON path")

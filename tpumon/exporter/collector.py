@@ -33,6 +33,9 @@ class PollStats:
     points: int = 0
     unmapped: tuple[str, ...] = ()
     coverage: float = 1.0
+    #: Node-constant base label keys this cycle (history recording strips
+    #: them from series identity).
+    base_keys: tuple[str, ...] = ()
 
 
 class SampleCache:
@@ -141,6 +144,7 @@ def build_families(
     topo = backend.topology()
     base = topo.base_labels()
     base_keys = tuple(base)
+    stats.base_keys = base_keys
     base_vals = tuple(base.values())
     families: list[Metric] = _topology_families(topo, base_keys, base_vals)
 
@@ -242,12 +246,14 @@ class Poller:
         cache: SampleCache,
         telemetry: SelfTelemetry,
         attribution=None,
+        history=None,
     ) -> None:
         self._backend = backend
         self._cfg = cfg
         self._cache = cache
         self._telemetry = telemetry
         self._attribution = attribution
+        self._history = history
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="tpumon-poller", daemon=True
@@ -265,6 +271,15 @@ class Poller:
             self._backend, self._cfg, self._attribution
         )
         self._cache.publish(families)
+        if self._history is not None:
+            # Flight recorder (DCGM field-cache analogue): keep the 1 Hz
+            # series Prometheus's 15-60 s scrape interval aliases away.
+            try:
+                self._history.record_families(
+                    time.time(), families, stats.base_keys
+                )
+            except Exception:
+                log.exception("history record failed")
         elapsed = time.monotonic() - t0
 
         t = self._telemetry

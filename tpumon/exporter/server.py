@@ -93,14 +93,27 @@ class _ThreadingWSGIServer(ThreadingMixIn, WSGIServer):
 _CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
-def _make_app(render_body, telemetry: SelfTelemetry, health):
+def _make_app(render_body, telemetry: SelfTelemetry, health, history=None):
     """WSGI app. ``render_body(want_gzip: bool) -> bytes`` produces the
     /metrics payload (already gzip-encoded when asked); the exporter
     passes cached-bytes + self-telemetry concatenation, the sidecar a
-    plain registry render."""
+    plain registry render. ``history`` (a tpumon.history.History) enables
+    the /history JSON endpoint."""
 
     def app(environ, start_response):
         path = environ.get("PATH_INFO", "/")
+        if path == "/history" and history is not None:
+            body, status = _history_response(
+                history, environ.get("QUERY_STRING", "")
+            )
+            start_response(
+                status,
+                [
+                    ("Content-Type", "application/json; charset=utf-8"),
+                    ("Content-Length", str(len(body))),
+                ],
+            )
+            return [body]
         if path in ("/healthz", "/livez", "/readyz"):
             ok, detail = health()
             status = "200 OK" if ok else "503 Service Unavailable"
@@ -140,6 +153,50 @@ def _make_app(render_body, telemetry: SelfTelemetry, health):
         return [body]
 
     return app
+
+
+def _history_response(history, query_string: str) -> tuple[bytes, str]:
+    """The /history JSON API (off the scrape hot path).
+
+    - ``GET /history`` → windowed summaries for every live series:
+      ``{"window": s, "now": ts, "native": bool, "series": {key: {count,
+      min, max, avg, first, last, first_ts, last_ts, rate}}}``
+    - ``GET /history?window=30`` → same with a custom window.
+    - ``GET /history?series=<key>[&since=<ts>]`` → raw 1 Hz points for one
+      series: ``{"series": key, "points": [[ts, value], ...]}``. The key
+      is the exact string from the summary view (URL-encoded).
+    """
+    import json
+    from urllib.parse import parse_qs
+
+    params = parse_qs(query_string)
+    now = time.time()
+    key = params.get("series", [None])[0]
+    if key is not None:
+        try:
+            since = float(params.get("since", ["0"])[0])
+        except ValueError:
+            return b'{"error": "bad since"}\n', "400 Bad Request"
+        points = history.query(key, since)
+        body = json.dumps(
+            {"series": key, "now": now, "points": [[t, v] for t, v in points]}
+        ).encode() + b"\n"
+        return body, "200 OK"
+    try:
+        window = float(params.get("window", [str(history.max_age)])[0])
+    except ValueError:
+        return b'{"error": "bad window"}\n', "400 Bad Request"
+    summaries = history.summarize_all(window, now)
+    body = json.dumps(
+        {
+            "window": window,
+            "now": now,
+            "native": history.is_native,
+            "series": summaries,
+        },
+        sort_keys=True,
+    ).encode() + b"\n"
+    return body, "200 OK"
 
 
 def registry_renderer(registry: CollectorRegistry):
@@ -209,8 +266,21 @@ class Exporter:
             attribution = PodAttribution(
                 PodResourcesClient(cfg.kubelet_socket, cfg.grpc_timeout)
             )
+        self.history = None
+        if cfg.history_window > 0:
+            from tpumon.history import History
+
+            # Malformed knobs degrade to the default, never CrashLoopBackOff
+            # (same stance as config._env_int).
+            max_samples = cfg.history_max_samples
+            if max_samples <= 0:
+                max_samples = type(cfg)().history_max_samples
+            self.history = History(
+                max_age=cfg.history_window, max_samples=max_samples
+            )
         self.poller = Poller(
-            backend, cfg, self.cache, self.telemetry, attribution
+            backend, cfg, self.cache, self.telemetry, attribution,
+            history=self.history,
         )
         version_fn = getattr(backend, "version", None)
         self.telemetry.backend_info.labels(
@@ -229,7 +299,7 @@ class Exporter:
             )
             return gzip.compress(body, compresslevel=1) if want_gzip else body
 
-        app = _make_app(render, self.telemetry, self._health)
+        app = _make_app(render, self.telemetry, self._health, self.history)
         self.server = ExporterServer(app, cfg.addr, cfg.port)
 
     def _health(self) -> tuple[bool, str]:

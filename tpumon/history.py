@@ -1,0 +1,218 @@
+"""1 Hz sample-history flight recorder (DCGM field-cache analogue).
+
+The exporter polls the device backend at 1 Hz, but Prometheus typically
+scrapes every 15-60 s — transients like duty-cycle spikes, throttle events,
+and ICI link flaps alias away between scrapes (SURVEY.md §2.1 "DCGM
+engine" row: dcgm field watches keep exactly this kind of bounded
+per-field sample cache). :class:`History` records every poll cycle's
+points into a bounded per-series ring and serves windowed summaries
+(min/max/avg/last/rate) and raw points back out via the exporter's
+``/history`` endpoint and the ``tpumon smi`` CLI.
+
+The engine is native C++ (``tpumon/_native/_history.cc``), compiled
+on demand like the exposition renderer; a pure-Python implementation with
+identical semantics (:class:`PyEngine`) backs no-compiler environments.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from collections import deque
+
+log = logging.getLogger(__name__)
+
+_native_engine_cls = None
+_tried = False
+
+
+def _load_native():
+    """Build-on-demand via the shared tpumon._native pipeline; any failure
+    (readOnlyRootFilesystem, no compiler) means "use the fallback"."""
+    global _native_engine_cls, _tried
+    if _tried:
+        return _native_engine_cls
+    _tried = True
+    if os.environ.get("TPUMON_NO_NATIVE"):
+        return None
+    from tpumon._native import load_extension
+
+    mod = load_extension("_history")
+    if mod is not None:
+        _native_engine_cls = mod.Engine
+    return _native_engine_cls
+
+
+def _summary(samples, lo: float):
+    vals = [(ts, v) for ts, v in samples if ts >= lo]
+    if not vals:
+        return None
+    values = [v for _, v in vals]
+    first_ts, first = vals[0]
+    last_ts, last = vals[-1]
+    dt = last_ts - first_ts
+    return {
+        "count": len(vals),
+        "min": min(values),
+        "max": max(values),
+        "avg": sum(values) / len(values),
+        "first": first,
+        "last": last,
+        "first_ts": first_ts,
+        "last_ts": last_ts,
+        "rate": (last - first) / dt if dt > 0 else 0.0,
+    }
+
+
+class PyEngine:
+    """Pure-Python engine, semantics identical to the C++ one (tested
+    against it sample-for-sample in tests/test_history.py)."""
+
+    def __init__(self, max_age: float = 600.0, max_samples: int = 4096) -> None:
+        if max_age <= 0 or max_samples <= 0:
+            raise ValueError("max_age and max_samples must be > 0")
+        self._max_age = max_age
+        self._max_samples = max_samples
+        self._series: dict[str, deque] = {}
+        self._record_calls = 0
+        self._lock = threading.Lock()
+
+    def record_batch(self, ts: float, items) -> None:
+        with self._lock:
+            for key, value in items:
+                s = self._series.setdefault(key, deque())
+                s.append((ts, float(value)))
+                horizon = ts - self._max_age
+                while s and (s[0][0] < horizon or len(s) > self._max_samples):
+                    s.popleft()
+            self._record_calls += 1
+            if self._record_calls % 256 == 0:
+                horizon = ts - self._max_age
+                dead = [
+                    k
+                    for k, s in self._series.items()
+                    if not s or s[-1][0] < horizon
+                ]
+                for k in dead:
+                    del self._series[k]
+
+    def query(self, key: str, since: float = 0.0):
+        with self._lock:
+            s = self._series.get(key, ())
+            return [(ts, v) for ts, v in s if ts >= since]
+
+    def summarize(self, key: str, window: float, now: float):
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                return None
+            samples = list(s)
+        return _summary(samples, now - window)
+
+    def summarize_all(self, window: float, now: float):
+        with self._lock:
+            copy = {k: list(s) for k, s in self._series.items()}
+        out = {}
+        for k, samples in copy.items():
+            summ = _summary(samples, now - window)
+            if summ is not None:
+                out[k] = summ
+        return out
+
+    def keys(self):
+        with self._lock:
+            return sorted(self._series)
+
+    def stats(self):
+        with self._lock:
+            return (
+                len(self._series),
+                sum(len(s) for s in self._series.values()),
+            )
+
+
+def make_engine(max_age: float = 600.0, max_samples: int = 4096, native=None):
+    """Engine factory: native C++ when buildable, PyEngine otherwise.
+
+    ``native=True`` forces the C++ engine (raises when unavailable),
+    ``native=False`` forces the fallback; ``None`` picks automatically.
+    """
+    if native is False:
+        return PyEngine(max_age, max_samples)
+    cls = _load_native()
+    if cls is None:
+        if native is True:
+            raise RuntimeError("native history engine unavailable")
+        return PyEngine(max_age, max_samples)
+    return cls(max_age, max_samples)
+
+
+def native_available() -> bool:
+    return _load_native() is not None
+
+
+def series_key(family: str, labels: dict[str, str]) -> str:
+    """Stable series identity: ``family{k="v",...}`` with sorted keys —
+    matches the Prometheus sample identity minus the node-constant base
+    labels, so /history keys read like the /metrics page."""
+    if not labels:
+        return family
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{family}{{{inner}}}"
+
+
+#: Families whose samples are identity/enum rows (value is always 1 or a
+#: label carries the signal) — no point recording them as time series.
+SKIP_FAMILIES = frozenset(
+    {"accelerator_info", "accelerator_core_state", "accelerator_pod_info"}
+)
+
+
+class History:
+    """The recorder wired into the poll loop.
+
+    ``record_families`` extracts (key, value) points from the poll cycle's
+    metric families, dropping node-constant base labels from the key and
+    skipping identity families.
+    """
+
+    def __init__(
+        self,
+        max_age: float = 600.0,
+        max_samples: int = 4096,
+        native=None,
+    ) -> None:
+        self.engine = make_engine(max_age, max_samples, native)
+        self.max_age = max_age
+
+    @property
+    def is_native(self) -> bool:
+        return not isinstance(self.engine, PyEngine)
+
+    def record_families(self, ts: float, families, base_keys=()) -> None:
+        base = set(base_keys)
+        items = []
+        for fam in families:
+            if fam.name in SKIP_FAMILIES:
+                continue
+            for s in fam.samples:
+                labels = {k: v for k, v in s.labels.items() if k not in base}
+                items.append((series_key(s.name, labels), float(s.value)))
+        if items:
+            self.engine.record_batch(ts, items)
+
+    def query(self, key: str, since: float = 0.0):
+        return self.engine.query(key, since)
+
+    def summarize_all(self, window: float, now: float):
+        return self.engine.summarize_all(window, now)
+
+    def summarize(self, key: str, window: float, now: float):
+        return self.engine.summarize(key, window, now)
+
+    def keys(self):
+        return self.engine.keys()
+
+    def stats(self):
+        return self.engine.stats()

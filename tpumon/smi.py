@@ -1,0 +1,312 @@
+"""`tpumon smi` — the nvidia-smi / tpu-smi analogue for this stack.
+
+GPU-monitor stacks of the reference genre ship an operator CLI that prints
+a per-device status table (nvidia-smi; `dcgmi dmon`). This is the
+TPU-native equivalent: one table per chip (duty cycle, HBM, throttle,
+queue depth), core utilization, ICI link health, and — when a running
+exporter's /history endpoint is reachable — 60 s min/avg/max trends from
+the 1 Hz flight recorder (tpumon.history), which a plain scrape cannot
+show.
+
+Two data sources:
+
+- ``--url http://node:9400`` scrapes a running exporter (/metrics for
+  current values + identity, /history for trends). This is the normal
+  operator path: the CLI never touches the device, so it is safe on a
+  node whose runtime is busy.
+- ``--backend fake|libtpu|stub|...`` builds a backend in-process and
+  polls it once (no exporter required; used by the doctor flow and
+  air-gapped debugging).
+
+``--watch N`` refreshes every N seconds; ``--json`` emits the machine
+-readable form of the same snapshot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+from prometheus_client.parser import text_string_to_metric_families
+
+# Families rendered into the table, keyed by their per-chip label.
+_F_DUTY = "accelerator_duty_cycle_percent"
+_F_HBM_USED = "accelerator_memory_used_bytes"
+_F_HBM_TOTAL = "accelerator_memory_total_bytes"
+_F_THROTTLE = "accelerator_throttle_score"
+_F_CORE_UTIL = "accelerator_core_utilization_percent"
+_F_ICI = "accelerator_interconnect_link_health"
+_F_INFO = "accelerator_info"
+_F_COUNT = "accelerator_device_count"
+_F_COVERAGE = "exporter_metric_coverage_ratio"
+
+
+def _fetch(url: str, timeout: float) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def _human_bytes(n: float) -> str:
+    for unit in ("B", "Ki", "Mi", "Gi", "Ti"):
+        if abs(n) < 1024 or unit == "Ti":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n:.0f}B"
+        n /= 1024
+    return f"{n:.1f}Ti"
+
+
+def snapshot_from_text(text: str) -> dict:
+    """Parse a /metrics page into the structured snapshot smi renders."""
+    fams = {f.name: f for f in text_string_to_metric_families(text)}
+
+    snap: dict = {
+        "identity": {},
+        "chips": {},
+        "cores": {},
+        "ici": {"healthy": 0, "total": 0, "worst": None},
+        "coverage": None,
+        "device_count": None,
+    }
+
+    info = fams.get(_F_INFO)
+    if info is not None and info.samples:
+        s0 = info.samples[0]
+        for key in ("slice", "host", "accelerator", "worker"):
+            if key in s0.labels:
+                snap["identity"][key] = s0.labels[key]
+        for s in info.samples:
+            chip = s.labels.get("chip", "?")
+            snap["chips"].setdefault(chip, {})["coords"] = s.labels.get(
+                "coords", ""
+            )
+
+    count = fams.get(_F_COUNT)
+    if count is not None and count.samples:
+        snap["device_count"] = int(count.samples[0].value)
+
+    cov = fams.get(_F_COVERAGE)
+    if cov is not None and cov.samples:
+        snap["coverage"] = cov.samples[0].value
+
+    per_chip = {
+        _F_DUTY: "duty_pct",
+        _F_HBM_USED: "hbm_used",
+        _F_HBM_TOTAL: "hbm_total",
+        _F_THROTTLE: "throttle",
+    }
+    for fam_name, field in per_chip.items():
+        fam = fams.get(fam_name)
+        if fam is None:
+            continue
+        for s in fam.samples:
+            chip = s.labels.get("chip", "?")
+            snap["chips"].setdefault(chip, {})[field] = s.value
+
+    util = fams.get(_F_CORE_UTIL)
+    if util is not None:
+        for s in util.samples:
+            snap["cores"][s.labels.get("core", "?")] = s.value
+
+    ici = fams.get(_F_ICI)
+    if ici is not None:
+        worst = None
+        healthy = total = 0
+        for s in ici.samples:
+            total += 1
+            if s.value == 0:
+                healthy += 1
+            if worst is None or s.value > worst[1]:
+                worst = (s.labels.get("link", "?"), s.value)
+        snap["ici"] = {
+            "healthy": healthy,
+            "total": total,
+            "worst": worst if worst and worst[1] > 0 else None,
+        }
+    return snap
+
+
+def attach_trends(snap: dict, history_doc: dict, window: float) -> None:
+    """Merge /history summaries into the snapshot (per-chip duty trend)."""
+    series = history_doc.get("series", {})
+    for chip, row in snap["chips"].items():
+        key = f'{_F_DUTY}{{chip="{chip}"}}'
+        summ = series.get(key)
+        if summ:
+            row["duty_trend"] = {
+                "min": summ["min"],
+                "avg": summ["avg"],
+                "max": summ["max"],
+                "count": summ["count"],
+            }
+    snap["trend_window"] = window
+
+
+def snapshot_from_url(url: str, timeout: float, window: float) -> dict:
+    text = _fetch(url.rstrip("/") + "/metrics", timeout)
+    snap = snapshot_from_text(text)
+    try:
+        doc = json.loads(
+            _fetch(url.rstrip("/") + f"/history?window={window}", timeout)
+        )
+        attach_trends(snap, doc, window)
+    except (urllib.error.URLError, urllib.error.HTTPError, ValueError):
+        pass  # older exporter or history disabled — table still renders
+    return snap
+
+
+def snapshot_from_backend(cfg) -> dict:
+    """Standalone mode: build a backend, poll once, parse its exposition."""
+    from tpumon._native import render_families
+    from tpumon.backends import create_backend
+    from tpumon.exporter.collector import build_families
+
+    backend = create_backend(cfg)
+    try:
+        families, stats = build_families(backend, cfg)
+        snap = snapshot_from_text(render_families(families).decode())
+        snap["coverage"] = stats.coverage
+        return snap
+    finally:
+        backend.close()
+
+
+def render(snap: dict, out=None) -> None:
+    out = out if out is not None else sys.stdout
+
+    def p(line: str = "") -> None:
+        print(line, file=out)
+
+    ident = snap["identity"]
+    head = " ".join(f"{k}={v}" for k, v in ident.items())
+    cov = snap.get("coverage")
+    cov_s = f" coverage={cov * 100:.0f}%" if cov is not None else ""
+    p(f"tpumon smi — {head or 'no identity (runtime detached?)'}{cov_s}")
+    ts = snap.get("ts", time.time())
+    p(time.strftime("%a %b %d %H:%M:%S %Y", time.localtime(ts)))
+
+    if snap.get("device_count") == 0:
+        p("no accelerator devices on this node (stub)")
+        return
+
+    has_trend = any("duty_trend" in c for c in snap["chips"].values())
+    cols = "| Chip | Coords    | Duty%  | HBM used/total     | Thr |"
+    if has_trend:
+        cols += f" Duty min/avg/max ({snap.get('trend_window', 60):.0f}s) |"
+    sep = "+" + "-" * (len(cols) - 2) + "+"
+    p(sep)
+    p(cols)
+    p(sep)
+    for chip in sorted(snap["chips"], key=lambda c: (len(c), c)):
+        row = snap["chips"][chip]
+        duty = row.get("duty_pct")
+        duty_s = f"{duty:5.1f}" if duty is not None else "    -"
+        used, total = row.get("hbm_used"), row.get("hbm_total")
+        hbm_s = (
+            f"{_human_bytes(used)}/{_human_bytes(total)}"
+            if used is not None and total is not None
+            else "-"
+        )
+        thr = row.get("throttle")
+        thr_s = f"{thr:3.0f}" if thr is not None else "  -"
+        line = (
+            f"| {chip:>4} | {row.get('coords', ''):<9} | {duty_s}  |"
+            f" {hbm_s:<18} | {thr_s} |"
+        )
+        if has_trend:
+            t = row.get("duty_trend")
+            trend_s = (
+                f"{t['min']:5.1f}/{t['avg']:5.1f}/{t['max']:5.1f}"
+                if t
+                else "-"
+            )
+            line += f" {trend_s:<22} |"
+        p(line)
+    p(sep)
+
+    if snap["cores"]:
+        parts = [
+            f"{core}={snap['cores'][core]:.0f}%"
+            for core in sorted(snap["cores"], key=lambda c: (len(c), c))
+        ]
+        p("core util: " + " ".join(parts))
+    ici = snap["ici"]
+    if ici["total"]:
+        line = f"ici links: {ici['healthy']}/{ici['total']} healthy"
+        if ici["worst"]:
+            line += f" (worst: {ici['worst'][0]} score={ici['worst'][1]:.0f})"
+        p(line)
+
+
+def main(argv: list[str] | None = None, out=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpumon smi", description="per-chip accelerator status table"
+    )
+    parser.add_argument(
+        "--url",
+        help="running exporter base URL; without --url or --backend, "
+        "http://localhost:9400 is probed and an in-process backend is the "
+        "fallback",
+    )
+    parser.add_argument(
+        "--watch", type=float, metavar="SEC", help="refresh every SEC seconds"
+    )
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    parser.add_argument(
+        "--window", type=float, default=60.0, help="trend window seconds"
+    )
+    parser.add_argument("--timeout", type=float, default=5.0)
+    from tpumon.config import Config
+
+    Config.add_args(parser)
+    args = parser.parse_args(argv)
+    out = out if out is not None else sys.stdout
+
+    def one_snapshot() -> dict:
+        if args.url:
+            snap = snapshot_from_url(args.url, args.timeout, args.window)
+        elif args.backend:
+            # An explicit --backend always means in-process, even when a
+            # local exporter happens to be listening.
+            cfg = Config.from_env().with_args(args)
+            snap = snapshot_from_backend(cfg)
+        else:
+            # Try the conventional local exporter first; else in-process.
+            try:
+                snap = snapshot_from_url(
+                    "http://localhost:9400", args.timeout, args.window
+                )
+            except (urllib.error.URLError, OSError):
+                cfg = Config.from_env().with_args(args)
+                snap = snapshot_from_backend(cfg)
+        snap["ts"] = time.time()
+        return snap
+
+    def emit(snap: dict) -> None:
+        if args.json:
+            print(json.dumps(snap, sort_keys=True), file=out)
+        else:
+            render(snap, out)
+
+    try:
+        if args.watch:
+            while True:
+                snap = one_snapshot()
+                if not args.json and out is sys.stdout:
+                    print("\x1b[2J\x1b[H", end="", file=out)
+                emit(snap)
+                time.sleep(args.watch)
+        else:
+            emit(one_snapshot())
+    except KeyboardInterrupt:
+        return 0
+    except (urllib.error.URLError, OSError) as exc:
+        print(f"tpumon smi: cannot reach exporter: {exc}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,69 @@
+"""Workload checkpoint/resume (SURVEY.md §5.4).
+
+The monitor itself is stateless; the *workload* harness checkpoints so
+long traffic-generation runs survive preemption. The contract: an
+interrupted-and-resumed run replays the exact per-step losses of an
+uninterrupted one (same seed-keyed data, bitwise-restored train state).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpumon.workload.harness import run
+from tpumon.workload.models.llama import LlamaConfig
+
+
+def _tiny_run(tmpdir, steps, every=0):
+    return run(
+        LlamaConfig.tiny(),
+        steps=steps,
+        batch=2,
+        seq=32,
+        checkpoint_dir=str(tmpdir) if tmpdir is not None else None,
+        checkpoint_every=every,
+    )
+
+
+def test_resume_replays_uninterrupted_losses(tmp_path):
+    full = _tiny_run(tmp_path / "full", steps=6)
+    assert len(full.losses) == 6
+    assert full.start_step == 0
+
+    # "Preempted" run: 3 steps, checkpoint saved at the end.
+    part = _tiny_run(tmp_path / "resume", steps=3)
+    assert part.losses == pytest.approx(full.losses[:3], rel=1e-6)
+
+    # Resume in a fresh call: picks up at step 3, replays steps 3-5.
+    cont = _tiny_run(tmp_path / "resume", steps=6)
+    assert cont.start_step == 3
+    assert len(cont.losses) == 3
+    assert cont.losses == pytest.approx(full.losses[3:], rel=1e-6)
+
+
+def test_periodic_saves_and_noop_resume(tmp_path):
+    r = _tiny_run(tmp_path / "ckpt", steps=4, every=2)
+    assert len(r.losses) == 4
+
+    # Fully-covered run: nothing left to execute, no crash.
+    again = _tiny_run(tmp_path / "ckpt", steps=4)
+    assert again.start_step == 4
+    assert again.losses == []
+
+
+def test_resume_on_sharded_mesh(tmp_path):
+    """Restored arrays must inherit the dp×tp mesh shardings."""
+    import jax
+
+    from tpumon.workload.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    mesh = make_mesh(2, 2, devices=jax.devices()[:4])
+    kw = dict(batch=4, seq=32, dp=2, tp=2, mesh=mesh)
+
+    full = run(LlamaConfig.tiny(), steps=4, checkpoint_dir=str(tmp_path / "f"), **kw)
+    run(LlamaConfig.tiny(), steps=2, checkpoint_dir=str(tmp_path / "r"), **kw)
+    cont = run(LlamaConfig.tiny(), steps=4, checkpoint_dir=str(tmp_path / "r"), **kw)
+    assert cont.start_step == 2
+    assert cont.losses == pytest.approx(full.losses[2:], rel=1e-6)

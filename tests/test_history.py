@@ -202,6 +202,31 @@ def test_history_endpoint(exporter):
     assert doc["points"] and len(doc["points"][0]) == 2
 
 
+def test_history_response_nan_safe():
+    """NaN device samples and non-finite params must yield strict JSON."""
+    import math
+
+    from tpumon.exporter.server import _history_response
+
+    import time as _time
+
+    h = History(native=False)
+    h.engine.record_batch(_time.time(), [("weird", float("nan")), ("ok", 1.0)])
+    body, status = _history_response(h, "window=60")
+    assert status.startswith("200")
+    doc = json.loads(body.decode())  # strict parser: NaN token would raise
+    assert doc["series"]["weird"]["last"] is None
+    assert doc["series"]["ok"]["last"] == 1.0
+    # Non-finite window/since are rejected, not echoed.
+    assert _history_response(h, "window=inf")[1].startswith("400")
+    assert _history_response(h, "window=nan")[1].startswith("400")
+    assert _history_response(h, "series=ok&since=nan")[1].startswith("400")
+    body, status = _history_response(h, "series=weird")
+    assert status.startswith("200")
+    pts = json.loads(body.decode())["points"]
+    assert pts[0][1] is None and math.isnan(h.query("weird")[0][1])
+
+
 def test_history_endpoint_bad_window(exporter):
     import urllib.error
 

@@ -71,13 +71,12 @@ def test_flatten_rejects_suffixed_samples():
 def test_env_kill_switch(monkeypatch):
     import tpumon._native as native
 
-    monkeypatch.setattr(native, "_tried", False)
-    monkeypatch.setattr(native, "_ext", None)
+    monkeypatch.setattr(native, "_modules", {})
     monkeypatch.setenv("TPUMON_NO_NATIVE", "1")
     assert not native.native_available()
     fams = _device_families()
     assert b"accelerator_duty_cycle_percent" in native.render_families(fams)
-    monkeypatch.setattr(native, "_tried", False)
+    monkeypatch.setattr(native, "_modules", {})
 
 
 @pytest.mark.slow

@@ -27,8 +27,9 @@ log = logging.getLogger(__name__)
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _BUILD_DIR = os.path.join(_HERE, "build")
-_ext = None
-_tried = False
+#: stem -> module | None. A None entry means "tried (or build in flight),
+#: use the fallback"; load_extension(force=True) overwrites it.
+_modules: dict[str, object | None] = {}
 
 
 def compile_extension(stem: str) -> str | None:
@@ -51,7 +52,7 @@ def compile_extension(stem: str) -> str | None:
         ):
             return so_path
         if src.endswith(".cc"):
-            compiler = [os.environ.get("CXX") or "g++", "-std=c++17"]
+            compiler = [*(os.environ.get("CXX") or "g++").split(), "-std=c++17"]
         else:
             compiler = (sysconfig.get_config_var("CC") or "cc").split()
         include = sysconfig.get_path("include")
@@ -75,58 +76,55 @@ def compile_extension(stem: str) -> str | None:
         return None
 
 
-def load_extension(stem: str):
-    """compile_extension + import; returns the module or None."""
-    so_path = compile_extension(stem)
-    if so_path is None:
-        return None
-    import importlib.util
+def load_extension(stem: str, force: bool = False):
+    """Memoized compile + import for any native component.
 
-    try:
-        spec = importlib.util.spec_from_file_location(
-            f"tpumon._native.{stem}", so_path
-        )
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-        return mod
-    except Exception as exc:
-        log.info("native %s load failed: %s", stem, exc)
-        return None
-
-
-def _load():
-    global _ext, _tried
-    if _tried:
-        return _ext
-    _tried = True
+    One place owns the TPUMON_NO_NATIVE kill-switch and the per-stem
+    cache so every component (exposition renderer, history engine, the
+    next one) is a one-line call site. Returns the module or None.
+    """
+    if not force and stem in _modules:
+        return _modules[stem]
     if os.environ.get("TPUMON_NO_NATIVE"):
+        _modules[stem] = None
         return None
-    _ext = load_extension("_exposition")
-    return _ext
+    mod = None
+    so_path = compile_extension(stem)
+    if so_path is not None:
+        import importlib.util
+
+        try:
+            spec = importlib.util.spec_from_file_location(
+                f"tpumon._native.{stem}", so_path
+            )
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        except Exception as exc:
+            log.info("native %s load failed: %s", stem, exc)
+            mod = None
+    _modules[stem] = mod
+    return mod
 
 
 def prewarm_async() -> None:
-    """Kick the compile/load off the poll path: mark 'tried' immediately
-    (renders fall back to Python meanwhile) and finish loading in a
-    daemon thread. Called at Exporter construction."""
-    global _tried
-    if _tried:
+    """Kick the compile/load off the poll path: mark the renderer as
+    unavailable immediately (renders fall back to Python meanwhile) and
+    finish loading in a daemon thread. Called at Exporter construction."""
+    if "_exposition" in _modules or os.environ.get("TPUMON_NO_NATIVE"):
         return
-    _tried = True
-    if os.environ.get("TPUMON_NO_NATIVE"):
-        return
+    _modules["_exposition"] = None
 
     import threading
 
-    def _bg():
-        global _ext
-        _ext = load_extension("_exposition")
-
-    threading.Thread(target=_bg, name="tpumon-native-build", daemon=True).start()
+    threading.Thread(
+        target=lambda: load_extension("_exposition", force=True),
+        name="tpumon-native-build",
+        daemon=True,
+    ).start()
 
 
 def native_available() -> bool:
-    return _load() is not None
+    return load_extension("_exposition") is not None
 
 
 def _flatten(families) -> list | None:
@@ -165,7 +163,7 @@ def _python_render(families) -> bytes:
 
 def render_families(families) -> bytes:
     """Render metric families to text exposition, native when possible."""
-    ext = _load()
+    ext = load_extension("_exposition")
     if ext is None:
         return _python_render(families)
     flat = _flatten(families)

@@ -167,35 +167,55 @@ def _history_response(history, query_string: str) -> tuple[bytes, str]:
       is the exact string from the summary view (URL-encoded).
     """
     import json
+    import math
     from urllib.parse import parse_qs
+
+    def _finite(raw: str) -> float | None:
+        try:
+            v = float(raw)
+        except ValueError:
+            return None
+        return v if math.isfinite(v) else None
+
+    def _dump(doc) -> bytes:
+        # RFC-strict JSON: device anomalies can produce NaN samples, and
+        # json.dumps would happily emit the non-RFC `NaN` token that jq /
+        # JSON.parse reject. Map non-finite floats to null instead.
+        def clean(o):
+            if isinstance(o, float) and not math.isfinite(o):
+                return None
+            if isinstance(o, dict):
+                return {k: clean(v) for k, v in o.items()}
+            if isinstance(o, (list, tuple)):
+                return [clean(v) for v in o]
+            return o
+
+        return json.dumps(clean(doc), sort_keys=True, allow_nan=False).encode() + b"\n"
 
     params = parse_qs(query_string)
     now = time.time()
     key = params.get("series", [None])[0]
     if key is not None:
-        try:
-            since = float(params.get("since", ["0"])[0])
-        except ValueError:
+        since = _finite(params.get("since", ["0"])[0])
+        if since is None:
             return b'{"error": "bad since"}\n', "400 Bad Request"
         points = history.query(key, since)
-        body = json.dumps(
+        body = _dump(
             {"series": key, "now": now, "points": [[t, v] for t, v in points]}
-        ).encode() + b"\n"
+        )
         return body, "200 OK"
-    try:
-        window = float(params.get("window", [str(history.max_age)])[0])
-    except ValueError:
+    window = _finite(params.get("window", [str(history.max_age)])[0])
+    if window is None:
         return b'{"error": "bad window"}\n', "400 Bad Request"
     summaries = history.summarize_all(window, now)
-    body = json.dumps(
+    body = _dump(
         {
             "window": window,
             "now": now,
             "native": history.is_native,
             "series": summaries,
-        },
-        sort_keys=True,
-    ).encode() + b"\n"
+        }
+    )
     return body, "200 OK"
 
 

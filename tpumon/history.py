@@ -17,31 +17,18 @@ identical semantics (:class:`PyEngine`) backs no-compiler environments.
 from __future__ import annotations
 
 import logging
-import os
 import threading
 from collections import deque
 
 log = logging.getLogger(__name__)
 
-_native_engine_cls = None
-_tried = False
-
-
 def _load_native():
-    """Build-on-demand via the shared tpumon._native pipeline; any failure
-    (readOnlyRootFilesystem, no compiler) means "use the fallback"."""
-    global _native_engine_cls, _tried
-    if _tried:
-        return _native_engine_cls
-    _tried = True
-    if os.environ.get("TPUMON_NO_NATIVE"):
-        return None
+    """Build-on-demand via the shared tpumon._native pipeline (which owns
+    memoization and the TPUMON_NO_NATIVE kill-switch); any failure means
+    "use the fallback"."""
     from tpumon._native import load_extension
 
-    mod = load_extension("_history")
-    if mod is not None:
-        _native_engine_cls = mod.Engine
-    return _native_engine_cls
+    return getattr(load_extension("_history"), "Engine", None)
 
 
 def _summary(samples, lo: float):

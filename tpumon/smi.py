@@ -264,6 +264,12 @@ def main(argv: list[str] | None = None, out=None) -> int:
     args = parser.parse_args(argv)
     out = out if out is not None else sys.stdout
 
+    # The data source is chosen once and sticks: under --watch a transient
+    # exporter outage must not silently switch a URL view to an in-process
+    # device backend (and per-tick create_backend/close churn is exactly
+    # the device touching this CLI promises to avoid).
+    source: dict = {"mode": None}
+
     def one_snapshot() -> dict:
         if args.url:
             snap = snapshot_from_url(args.url, args.timeout, args.window)
@@ -272,15 +278,24 @@ def main(argv: list[str] | None = None, out=None) -> int:
             # local exporter happens to be listening.
             cfg = Config.from_env().with_args(args)
             snap = snapshot_from_backend(cfg)
+        elif source["mode"] == "url":
+            snap = snapshot_from_url(
+                "http://localhost:9400", args.timeout, args.window
+            )
+        elif source["mode"] == "backend":
+            snap = snapshot_from_backend(source["cfg"])
         else:
-            # Try the conventional local exporter first; else in-process.
+            # First snapshot: probe the conventional local exporter, fall
+            # back to in-process, and remember the choice.
             try:
                 snap = snapshot_from_url(
                     "http://localhost:9400", args.timeout, args.window
                 )
+                source["mode"] = "url"
             except (urllib.error.URLError, OSError):
-                cfg = Config.from_env().with_args(args)
-                snap = snapshot_from_backend(cfg)
+                source["cfg"] = Config.from_env().with_args(args)
+                snap = snapshot_from_backend(source["cfg"])
+                source["mode"] = "backend"
         snap["ts"] = time.time()
         return snap
 
@@ -293,7 +308,16 @@ def main(argv: list[str] | None = None, out=None) -> int:
     try:
         if args.watch:
             while True:
-                snap = one_snapshot()
+                # A watch survives transient errors (exporter pod restart,
+                # one timed-out scrape) — render the error, keep polling.
+                try:
+                    snap = one_snapshot()
+                except (urllib.error.URLError, OSError) as exc:
+                    if not args.json and out is sys.stdout:
+                        print("\x1b[2J\x1b[H", end="", file=out)
+                    print(f"tpumon smi: fetch failed: {exc}", file=sys.stderr)
+                    time.sleep(args.watch)
+                    continue
                 if not args.json and out is sys.stdout:
                     print("\x1b[2J\x1b[H", end="", file=out)
                 emit(snap)

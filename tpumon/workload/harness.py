@@ -106,6 +106,8 @@ class RunResult:
     sp: int = 1
     pp: int = 1
     ep: int = 1
+    #: First global step this run executed (> 0 after a checkpoint resume).
+    start_step: int = 0
 
 
 def run(
@@ -123,6 +125,8 @@ def run(
     seed: int = 0,
     mesh=None,
     attn: str = "xla",
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 0,
 ) -> RunResult:
     """Build, shard, and run the train step; returns losses + throughput.
 
@@ -135,6 +139,14 @@ def run(
     (ops.flash_attention); it composes with dp/tp/ep but not with sp > 1
     (ring attention owns the attention impl) or pp > 1 (the pipelined
     forward owns the model body).
+
+    ``checkpoint_dir`` turns on orbax checkpoint/resume (SURVEY.md §5.4 —
+    the monitor itself is stateless; the *workload* checkpoints so long
+    traffic-generation runs survive preemption): the latest step in the
+    directory is restored on entry, params+opt state are saved every
+    ``checkpoint_every`` steps (0 = only at the end), and a resumed run
+    replays the exact losses of an uninterrupted one (same data keyed by
+    seed, bitwise-restored state; asserted in tests/test_checkpoint.py).
     """
     is_moe = isinstance(cfg, MoeConfig)
     if ep > 1 and not is_moe:
@@ -198,6 +210,12 @@ def run(
     opt_state = optimizer.init(params)
     step = jax.jit(train_step, donate_argnums=(0, 1))
 
+    if checkpoint_dir is not None:
+        return _run_checkpointed(
+            step, params, opt_state, tokens, steps, checkpoint_dir,
+            checkpoint_every, mesh, dp=dp, tp=tp, sp=sp, pp=pp, ep=ep,
+        )
+
     # Warmup/compile outside the timed window.
     params, opt_state, loss = step(params, opt_state, tokens)
     loss.block_until_ready()
@@ -218,6 +236,102 @@ def run(
         pp=pp,
         ep=ep,
     )
+
+
+def _run_checkpointed(
+    step, params, opt_state, tokens, steps, checkpoint_dir, checkpoint_every,
+    mesh=None, **axes,
+) -> RunResult:
+    """Checkpoint/resume driver around the jitted train step.
+
+    Separate from the fast path on purpose: it records a loss per step
+    (host sync each iteration) and touches disk, so the pure
+    traffic-generator loop keeps its pipelined, sync-free timing.
+    Restore uses the freshly initialized (and mesh-sharded) train state as
+    the template, so restored arrays inherit the correct shardings on any
+    dp/tp/sp/pp/ep mesh.
+    """
+    import os
+
+    import orbax.checkpoint as ocp
+
+    mngr = ocp.CheckpointManager(
+        os.path.abspath(checkpoint_dir),
+        options=ocp.CheckpointManagerOptions(
+            max_to_keep=2, enable_async_checkpointing=False
+        ),
+    )
+    try:
+        start_step = 0
+        latest = mngr.latest_step()
+        if latest is not None:
+            restored = mngr.restore(
+                latest,
+                args=ocp.args.StandardRestore(
+                    {"params": params, "opt_state": opt_state}
+                ),
+            )
+            if mesh is not None:
+                # Orbax commits restored arrays to their template's devices.
+                # Template scalars (Adam step count) were uncommitted
+                # single-device arrays — promote them to mesh-replicated so
+                # they are compatible with the mesh-sharded params in one
+                # jitted computation.
+                from jax.sharding import (
+                    NamedSharding,
+                    PartitionSpec,
+                    SingleDeviceSharding,
+                )
+
+                replicated = NamedSharding(mesh, PartitionSpec())
+                restored = jax.tree.map(
+                    lambda x: jax.device_put(x, replicated)
+                    if isinstance(x.sharding, SingleDeviceSharding)
+                    else x,
+                    restored,
+                )
+            params, opt_state = restored["params"], restored["opt_state"]
+            start_step = latest
+            log.info("resumed from %s at step %d", checkpoint_dir, latest)
+
+        losses: list[float] = []
+        timed = 0.0
+        timed_steps = 0
+        saved_at = start_step if latest is not None else -1
+        for i in range(start_step, steps):
+            t0 = time.perf_counter()
+            params, opt_state, loss = step(params, opt_state, tokens)
+            losses.append(float(loss))  # blocks; keeps loss-per-step record
+            if i > start_step:  # first iteration pays compile
+                timed += time.perf_counter() - t0
+                timed_steps += 1
+            done = i + 1
+            if (checkpoint_every and done % checkpoint_every == 0) or done == steps:
+                if done != saved_at:
+                    mngr.save(
+                        done,
+                        args=ocp.args.StandardSave(
+                            {"params": params, "opt_state": opt_state}
+                        ),
+                    )
+                    saved_at = done
+        mngr.wait_until_finished()
+        if not losses:
+            log.info(
+                "checkpoint at %s already covers %d steps; nothing to run",
+                checkpoint_dir,
+                steps,
+            )
+        return RunResult(
+            losses=losses,
+            # 0.0 (not inf) when no step ran outside the compile window —
+            # consumers treat it as "no throughput measured".
+            steps_per_sec=timed_steps / timed if timed > 0 else 0.0,
+            start_step=start_step,
+            **axes,
+        )
+    finally:
+        mngr.close()
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -266,6 +380,18 @@ def main(argv: list[str] | None = None) -> int:
         default="xla",
         help="attention core: XLA einsums or the pallas flash kernel "
         "(ops.flash_attention; interpreted off-TPU)",
+    )
+    parser.add_argument(
+        "--checkpoint-dir",
+        default=None,
+        help="orbax checkpoint directory; resumes from the latest step "
+        "found there (SURVEY §5.4 — workload-side checkpoint/resume)",
+    )
+    parser.add_argument(
+        "--checkpoint-every",
+        type=int,
+        default=0,
+        help="save every N steps (0 = only at the end of the run)",
     )
     parser.add_argument(
         "--metrics-port",
@@ -393,11 +519,13 @@ def main(argv: list[str] | None = None) -> int:
             ep=args.ep,
             microbatches=args.microbatches,
             attn=args.attn,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
         )
         log.info(
             "loss %.4f → %.4f | %.2f steps/s | mesh dp=%d tp=%d sp=%d pp=%d ep=%d | devices=%s",
-            result.losses[0],
-            result.losses[-1],
+            result.losses[0] if result.losses else float("nan"),
+            result.losses[-1] if result.losses else float("nan"),
             result.steps_per_sec,
             result.dp,
             result.tp,

@@ -7,14 +7,31 @@ from tpumon.doctor import run
 
 
 def test_doctor_fake_ok():
+    from tpumon.backends.fake import FakeTpuBackend
+
     out = io.StringIO()
-    rc = run(Config(backend="fake", fake_topology="v4-8"), out=out)
+    # Healthy fabric (no link flaps) so the crit gate stays quiet.
+    backend = FakeTpuBackend.preset("v4-8", ici_flake=0.0)
+    rc = run(Config(backend="fake"), out=out, backend=backend)
     text = out.getvalue()
     assert rc == 0
     assert "backend: fake" in text
     assert "coverage: 100.0%" in text
     assert "verdict: OK" in text
     assert "duty_cycle_pct" in text
+
+
+def test_doctor_crit_health_gates_exit():
+    from tpumon.backends.fake import FakeTpuBackend
+
+    out = io.StringIO()
+    # Every link flapping guarantees a crit ICI finding.
+    backend = FakeTpuBackend.preset("v4-8", ici_flake=1.0)
+    rc = run(Config(backend="fake"), out=out, backend=backend)
+    text = out.getvalue()
+    assert rc == 1
+    assert "device health: CRIT" in text
+    assert "verdict: DEVICE HEALTH CRITICAL" in text
 
 
 def test_doctor_stub_deviceless_ok():

@@ -1,0 +1,141 @@
+"""Device-health evaluation (dcgmi `health -c` analogue) + /health/devices."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from tpumon import health
+from tpumon.backends.fake import FakeTpuBackend
+from tpumon.config import Config
+from tpumon.exporter.server import build_exporter
+
+
+def snap(chips=None, ici_links=None, coverage=None):
+    return {
+        "identity": {},
+        "chips": chips or {},
+        "cores": {},
+        "ici": {"links": ici_links or {}, "healthy": 0, "total": 0, "worst": None},
+        "coverage": coverage,
+        "device_count": len(chips) if chips else 0,
+    }
+
+
+def codes(findings):
+    return [(f.severity, f.code) for f in findings]
+
+
+def test_healthy_snapshot_no_findings():
+    s = snap(
+        chips={"0": {"throttle": 0.0, "hbm_used": 1e9, "hbm_total": 16e9}},
+        ici_links={"a": 0.0},
+        coverage=1.0,
+    )
+    assert health.evaluate(s) == []
+    assert health.overall([]) == health.OK
+
+
+def test_throttle_thresholds():
+    warn = snap(chips={"0": {"throttle": 1.0}})
+    crit = snap(chips={"0": {"throttle": 7.0}})
+    assert codes(health.evaluate(warn)) == [("warn", "throttle")]
+    assert codes(health.evaluate(crit)) == [("crit", "throttle")]
+
+
+def test_hbm_pressure_thresholds():
+    warn = snap(chips={"0": {"hbm_used": 9.3e9, "hbm_total": 10e9}})
+    crit = snap(chips={"0": {"hbm_used": 9.9e9, "hbm_total": 10e9}})
+    ok = snap(chips={"0": {"hbm_used": 5e9, "hbm_total": 10e9}})
+    assert codes(health.evaluate(warn)) == [("warn", "hbm_pressure")]
+    assert codes(health.evaluate(crit)) == [("crit", "hbm_pressure")]
+    assert health.evaluate(ok) == []
+
+
+def test_ici_link_grades():
+    s = snap(ici_links={"t": 3.0, "p": 7.0, "u": 10.0, "h": 0.0})
+    got = codes(health.evaluate(s))
+    assert got.count(("crit", "ici_link")) == 2  # persistent + unusable
+    assert got.count(("warn", "ici_link")) == 1  # transient
+    assert health.overall(health.evaluate(s)) == health.CRIT
+
+
+def test_coverage_finding_and_sort_order():
+    s = snap(chips={"0": {"throttle": 9.0}}, coverage=0.5)
+    findings = health.evaluate(s)
+    # Most severe first.
+    assert findings[0].code == "throttle" and findings[0].severity == "crit"
+    assert ("warn", "coverage") in codes(findings)
+
+
+def test_absent_data_is_not_a_finding():
+    # Runtime detached: no chips metrics, no ici, no coverage info.
+    assert health.evaluate(snap()) == []
+
+
+def test_report_shape():
+    doc = health.report(snap(chips={"0": {"throttle": 2.0}}, coverage=1.0))
+    assert doc["status"] == "warn"
+    assert doc["findings"][0]["code"] == "throttle"
+    assert doc["chips"] == 1
+
+
+@pytest.fixture
+def exporter():
+    cfg = Config(port=0, addr="127.0.0.1", interval=30.0, pod_attribution=False)
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v5e-16"))
+    exp.start()
+    yield exp
+    exp.close()
+
+
+def test_health_devices_endpoint(exporter):
+    # The fake topology's deterministic noise may include degraded ICI
+    # links, so any status is legitimate — but the HTTP code must agree
+    # with it (crit -> 503, else 200) and the doc must be self-consistent.
+    try:
+        with urllib.request.urlopen(
+            exporter.server.url + "/health/devices", timeout=10
+        ) as resp:
+            code, doc = resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        code, doc = err.code, json.loads(err.read())
+    assert (code == 503) == (doc["status"] == "crit")
+    assert doc["chips"] == 4
+    assert doc["coverage"] == 1.0
+    sevs = {f["severity"] for f in doc["findings"]}
+    assert (doc["status"] == "ok") == (not sevs)
+    if doc["status"] != "ok":
+        assert doc["status"] in sevs
+
+
+def test_doctor_prints_health():
+    import io
+
+    from tpumon.doctor import run as doctor_run
+
+    cfg = Config(backend="fake", pod_attribution=False)
+    buf = io.StringIO()
+    backend = FakeTpuBackend.preset("v5e-16", ici_flake=0.0)
+    rc = doctor_run(cfg, out=buf, backend=backend)
+    out = buf.getvalue()
+    assert "device health:" in out
+    assert rc == 0
+
+
+def test_smi_renders_health_line():
+    import io
+
+    from tpumon import smi
+
+    s = snap(
+        chips={"0": {"throttle": 7.0, "coords": "0,0,0"}},
+        coverage=1.0,
+    )
+    s["device_count"] = 1
+    out = io.StringIO()
+    smi.render(s, out)
+    text = out.getvalue()
+    assert "health: CRIT" in text and "throttled" in text

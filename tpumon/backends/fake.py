@@ -88,6 +88,7 @@ class FakeTpuBackend:
         seed: int = 0,
         fail_metrics: tuple[str, ...] = (),
         malformed_metrics: tuple[str, ...] = (),
+        ici_flake: float = 0.03,
     ) -> None:
         self._topology = topology
         self._hbm = hbm_bytes
@@ -96,6 +97,9 @@ class FakeTpuBackend:
         self._step = 0
         self.fail_metrics = set(fail_metrics)
         self.malformed_metrics = set(malformed_metrics)
+        #: Per-step probability that a given ICI link reports unusable (10).
+        #: 0.0 gives an always-healthy fabric (doctor/health OK-path tests).
+        self.ici_flake = ici_flake
 
     # -- construction -----------------------------------------------------
 
@@ -201,7 +205,7 @@ class FakeTpuBackend:
             for c in chips:
                 tray = c // 4 + 1
                 for port in range(_ICI_PORTS):
-                    health = 0 if self._u("ici", c, port) < 0.97 else 10
+                    health = 0 if self._u("ici", c, port) < 1 - self.ici_flake else 10
                     out.append(f"tray{tray}.chip{c}.ici{port}.int: {health}")
             return tuple(out)
         if name == "hlo_queue_size":

@@ -3,9 +3,10 @@
 ``python -m tpumon.doctor [--backend ...]`` prints what the exporter
 would see on this node: backend resolution, topology identity, per-metric
 sample status (ok / empty=runtime-detached / error), coverage vs the ≥95%
-BASELINE target, and pod-attribution availability. Exit code 0 when
-coverage meets the target (or the node is a deviceless stub), 1 otherwise
-— usable as an init-container sanity gate.
+BASELINE target, device-health verdicts (tpumon.health), and
+pod-attribution availability. Exit code 0 when coverage meets the target
+(or the node is a deviceless stub) AND no device-health check is crit;
+1 otherwise — usable as an init-container sanity gate.
 """
 
 from __future__ import annotations
@@ -21,12 +22,38 @@ from tpumon.schema import coverage, spec_for
 COVERAGE_TARGET = 0.95
 
 
-def run(cfg: Config, out=sys.stdout) -> int:
+class _CachedBackend:
+    """Memoizes sample() results (including failures) so the health
+    snapshot reuses the per-metric loop's device queries instead of
+    hitting the runtime a second time."""
+
+    def __init__(self, backend) -> None:
+        self._backend = backend
+        self._samples: dict[str, tuple[bool, object]] = {}
+
+    def sample(self, name: str):
+        if name not in self._samples:
+            try:
+                self._samples[name] = (True, self._backend.sample(name))
+            except Exception as exc:
+                self._samples[name] = (False, exc)
+        ok, value = self._samples[name]
+        if not ok:
+            raise value
+        return value
+
+    def __getattr__(self, attr):
+        return getattr(self._backend, attr)
+
+
+def run(cfg: Config, out=sys.stdout, backend=None) -> int:
+    """``backend`` overrides creation from cfg (tests, embedding)."""
+
     def p(line: str = "") -> None:
         print(line, file=out)
 
     try:
-        backend = create_backend(cfg)
+        backend = _CachedBackend(backend or create_backend(cfg))
     except BackendError as exc:
         p(f"backend: FAILED to initialize ({exc})")
         return 1
@@ -83,6 +110,23 @@ def run(cfg: Config, out=sys.stdout) -> int:
                 "the accelerator (expected on idle nodes; SURVEY.md §2.2)"
             )
 
+        # Device-health verdicts (the dcgmi `health -c` analogue): evaluate
+        # the same snapshot shape the exporter's /health/devices serves.
+        # The _CachedBackend makes this reuse the loop's samples — zero
+        # extra device queries.
+        from tpumon import health as health_mod
+        from tpumon.exporter.collector import build_families
+        from tpumon.smi import snapshot_from_families
+
+        families, stats = build_families(backend, cfg)
+        snap = snapshot_from_families(families)
+        snap["coverage"] = stats.coverage
+        findings = health_mod.evaluate(snap)
+        health_status = health_mod.overall(findings)
+        p(f"\ndevice health: {health_status.upper()}")
+        for f in findings:
+            p(f"  [{f.severity}] {f.message}")
+
         from tpumon.attribution import PodResourcesClient
 
         client = PodResourcesClient(cfg.kubelet_socket, cfg.grpc_timeout)
@@ -96,6 +140,9 @@ def run(cfg: Config, out=sys.stdout) -> int:
         if topo.num_chips == 0 and not supported:
             p("\nverdict: OK (deviceless node, stub mode)")
             return 0
+        if health_status == health_mod.CRIT:
+            p("\nverdict: DEVICE HEALTH CRITICAL")
+            return 1
         if cov >= COVERAGE_TARGET:
             p("\nverdict: OK")
             return 0

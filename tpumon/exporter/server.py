@@ -93,15 +93,36 @@ class _ThreadingWSGIServer(ThreadingMixIn, WSGIServer):
 _CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
-def _make_app(render_body, telemetry: SelfTelemetry, health, history=None):
+def _make_app(
+    render_body, telemetry: SelfTelemetry, health, history=None,
+    device_health=None,
+):
     """WSGI app. ``render_body(want_gzip: bool) -> bytes`` produces the
     /metrics payload (already gzip-encoded when asked); the exporter
     passes cached-bytes + self-telemetry concatenation, the sidecar a
     plain registry render. ``history`` (a tpumon.history.History) enables
-    the /history JSON endpoint."""
+    the /history JSON endpoint; ``device_health`` (a () -> dict callable)
+    enables /health/devices (the dcgmi-health analogue)."""
 
     def app(environ, start_response):
         path = environ.get("PATH_INFO", "/")
+        if path == "/health/devices" and device_health is not None:
+            import json
+
+            doc = device_health()
+            body = json.dumps(doc, sort_keys=True).encode() + b"\n"
+            status = (
+                "200 OK" if doc.get("status") != "crit"
+                else "503 Service Unavailable"
+            )
+            start_response(
+                status,
+                [
+                    ("Content-Type", "application/json; charset=utf-8"),
+                    ("Content-Length", str(len(body))),
+                ],
+            )
+            return [body]
         if path == "/history" and history is not None:
             body, status = _history_response(
                 history, environ.get("QUERY_STRING", "")
@@ -319,8 +340,25 @@ class Exporter:
             )
             return gzip.compress(body, compresslevel=1) if want_gzip else body
 
-        app = _make_app(render, self.telemetry, self._health, self.history)
+        app = _make_app(
+            render, self.telemetry, self._health, self.history,
+            self._device_health,
+        )
         self.server = ExporterServer(app, cfg.addr, cfg.port)
+
+    def _device_health(self) -> dict:
+        """The /health/devices body: evaluate the cached family snapshot.
+
+        Reads the poll cycle's family objects straight from SampleCache
+        (no text render/parse roundtrip) and never touches the device
+        backend.
+        """
+        from tpumon import health as health_mod
+        from tpumon.smi import snapshot_from_families
+
+        snap = snapshot_from_families(self.cache.snapshot())
+        snap["coverage"] = self.poller.last_stats.coverage
+        return health_mod.report(snap)
 
     def _health(self) -> tuple[bool, str]:
         last = self.telemetry.last_poll._value.get()

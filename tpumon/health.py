@@ -1,0 +1,144 @@
+"""Device-health evaluation — the `dcgmi health -c` analogue.
+
+The DCGM hostengine of the reference genre (SURVEY.md §2.1) exposes a
+health-watch API that turns raw counters into pass/warn/fail verdicts
+(thermal violations, NVLink errors, retired pages). The TPU-native
+equivalent evaluates the monitor's own unified families:
+
+- ``tpu_throttle_score``: 0 none, 1-10 throttled by 10-100% (schema.py)
+- ``ici_link_health``: 0 healthy, 1-5 transient, 6-9 persistent minor,
+  10 unusable (schema.py)
+- HBM occupancy ratio per chip
+- exporter metric coverage vs the ≥95% BASELINE target
+
+Consumers: ``tpumon.doctor`` (prints findings, gates exit code),
+the exporter's ``/health/devices`` JSON endpoint (K8s-scriptable), and
+``tpumon smi`` (one summary line). All of them evaluate the *same parsed
+snapshot* (tpumon.smi.snapshot_from_text), so verdicts cannot drift
+between surfaces.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass
+
+OK = "ok"
+WARN = "warn"
+CRIT = "crit"
+
+_SEV_ORDER = {OK: 0, WARN: 1, CRIT: 2}
+
+#: Thresholds (module-level so operators can monkeypatch/configure).
+THROTTLE_WARN = 1.0  # any throttling at all
+THROTTLE_CRIT = 5.0  # throttled by >= 50%
+ICI_TRANSIENT_MIN = 1.0  # 1-5: transient errors
+ICI_PERSISTENT_MIN = 6.0  # 6-9: persistent minor
+ICI_UNUSABLE = 10.0
+HBM_WARN_RATIO = 0.92
+HBM_CRIT_RATIO = 0.98
+COVERAGE_TARGET = 0.95
+
+
+@dataclass(frozen=True)
+class Finding:
+    severity: str  # ok | warn | crit
+    code: str  # stable machine id, e.g. "throttle", "ici_link"
+    message: str
+    chip: str | None = None
+
+
+def evaluate(snap: dict) -> list[Finding]:
+    """Evaluate a parsed snapshot (tpumon.smi.snapshot_from_text shape).
+
+    Returns findings sorted most-severe first; an empty list means every
+    check passed with data present. Missing families (runtime detached)
+    produce no findings — absence is "no data", never "healthy" or
+    "broken" (SURVEY.md §2.2 absent-not-zero).
+    """
+    findings: list[Finding] = []
+
+    for chip in sorted(snap.get("chips", {})):
+        row = snap["chips"][chip]
+        thr = row.get("throttle")
+        if thr is not None and thr >= THROTTLE_WARN:
+            sev = CRIT if thr >= THROTTLE_CRIT else WARN
+            findings.append(
+                Finding(
+                    sev,
+                    "throttle",
+                    f"chip {chip} throttled (score {thr:.0f}/10 ≈ "
+                    f"{thr * 10:.0f}% slowdown)",
+                    chip=chip,
+                )
+            )
+        used, total = row.get("hbm_used"), row.get("hbm_total")
+        if used is not None and total:
+            ratio = used / total
+            if ratio >= HBM_WARN_RATIO:
+                sev = CRIT if ratio >= HBM_CRIT_RATIO else WARN
+                findings.append(
+                    Finding(
+                        sev,
+                        "hbm_pressure",
+                        f"chip {chip} HBM {ratio * 100:.1f}% full",
+                        chip=chip,
+                    )
+                )
+
+    ici = snap.get("ici") or {}
+    links = ici.get("links") or {}
+    for link, score in sorted(links.items()):
+        if score >= ICI_UNUSABLE:
+            findings.append(
+                Finding(CRIT, "ici_link", f"ICI link {link} unusable (10)")
+            )
+        elif score >= ICI_PERSISTENT_MIN:
+            findings.append(
+                Finding(
+                    CRIT,
+                    "ici_link",
+                    f"ICI link {link} persistent errors (score {score:.0f})",
+                )
+            )
+        elif score >= ICI_TRANSIENT_MIN:
+            findings.append(
+                Finding(
+                    WARN,
+                    "ici_link",
+                    f"ICI link {link} transient errors (score {score:.0f})",
+                )
+            )
+
+    cov = snap.get("coverage")
+    if cov is not None and cov < COVERAGE_TARGET:
+        findings.append(
+            Finding(
+                WARN,
+                "coverage",
+                f"metric coverage {cov * 100:.0f}% below the "
+                f"{COVERAGE_TARGET * 100:.0f}% target",
+            )
+        )
+
+    findings.sort(key=lambda f: -_SEV_ORDER[f.severity])
+    return findings
+
+
+def overall(findings: list[Finding]) -> str:
+    """Worst severity across findings; `ok` when none."""
+    worst = OK
+    for f in findings:
+        if _SEV_ORDER[f.severity] > _SEV_ORDER[worst]:
+            worst = f.severity
+    return worst
+
+
+def report(snap: dict) -> dict:
+    """JSON-ready verdict document (the /health/devices body)."""
+    findings = evaluate(snap)
+    return {
+        "status": overall(findings),
+        "findings": [asdict(f) for f in findings],
+        "chips": len(snap.get("chips", {})),
+        "coverage": snap.get("coverage"),
+    }

@@ -60,7 +60,18 @@ def _human_bytes(n: float) -> str:
 
 def snapshot_from_text(text: str) -> dict:
     """Parse a /metrics page into the structured snapshot smi renders."""
-    fams = {f.name: f for f in text_string_to_metric_families(text)}
+    return snapshot_from_families(text_string_to_metric_families(text))
+
+
+def snapshot_from_families(families) -> dict:
+    """Build the snapshot from metric-family objects directly.
+
+    Works on both parser output and prometheus_client core families (the
+    exporter's poll-cycle output) — same ``.name``/``.samples`` shape — so
+    in-process consumers (/health/devices, doctor) skip the text
+    render+parse roundtrip.
+    """
+    fams = {f.name: f for f in families}
 
     snap: dict = {
         "identity": {},
@@ -114,8 +125,10 @@ def snapshot_from_text(text: str) -> dict:
     if ici is not None:
         worst = None
         healthy = total = 0
+        links: dict[str, float] = {}
         for s in ici.samples:
             total += 1
+            links[s.labels.get("link", "?")] = s.value
             if s.value == 0:
                 healthy += 1
             if worst is None or s.value > worst[1]:
@@ -124,6 +137,7 @@ def snapshot_from_text(text: str) -> dict:
             "healthy": healthy,
             "total": total,
             "worst": worst if worst and worst[1] > 0 else None,
+            "links": links,
         }
     return snap
 
@@ -157,20 +171,27 @@ def snapshot_from_url(url: str, timeout: float, window: float) -> dict:
     return snap
 
 
-def snapshot_from_backend(cfg) -> dict:
-    """Standalone mode: build a backend, poll once, parse its exposition."""
-    from tpumon._native import render_families
+def snapshot_from_backend(cfg, backend=None) -> dict:
+    """Standalone mode: poll a backend once and snapshot the families.
+
+    ``backend=None`` creates one from cfg and closes it afterwards; pass a
+    live backend to reuse it across --watch ticks (no per-tick device
+    re-initialization).
+    """
     from tpumon.backends import create_backend
     from tpumon.exporter.collector import build_families
 
-    backend = create_backend(cfg)
+    owned = backend is None
+    if owned:
+        backend = create_backend(cfg)
     try:
         families, stats = build_families(backend, cfg)
-        snap = snapshot_from_text(render_families(families).decode())
+        snap = snapshot_from_families(families)
         snap["coverage"] = stats.coverage
         return snap
     finally:
-        backend.close()
+        if owned:
+            backend.close()
 
 
 def render(snap: dict, out=None) -> None:
@@ -239,6 +260,17 @@ def render(snap: dict, out=None) -> None:
             line += f" (worst: {ici['worst'][0]} score={ici['worst'][1]:.0f})"
         p(line)
 
+    from tpumon import health as _health
+
+    findings = _health.evaluate(snap)
+    status = _health.overall(findings)
+    if findings:
+        top = findings[0]
+        extra = f" (+{len(findings) - 1} more)" if len(findings) > 1 else ""
+        p(f"health: {status.upper()} — {top.message}{extra}")
+    else:
+        p("health: OK")
+
 
 def main(argv: list[str] | None = None, out=None) -> int:
     parser = argparse.ArgumentParser(
@@ -266,9 +298,18 @@ def main(argv: list[str] | None = None, out=None) -> int:
 
     # The data source is chosen once and sticks: under --watch a transient
     # exporter outage must not silently switch a URL view to an in-process
-    # device backend (and per-tick create_backend/close churn is exactly
-    # the device touching this CLI promises to avoid).
-    source: dict = {"mode": None}
+    # device backend, and a pinned backend is created ONCE and reused
+    # across ticks (per-tick create/close would re-init the device runtime
+    # every second — the touching this CLI promises to avoid).
+    source: dict = {"mode": None, "backend": None, "cfg": None}
+
+    def pinned_backend():
+        if source["backend"] is None:
+            from tpumon.backends import create_backend
+
+            source["cfg"] = Config.from_env().with_args(args)
+            source["backend"] = create_backend(source["cfg"])
+        return source["backend"]
 
     def one_snapshot() -> dict:
         if args.url:
@@ -276,14 +317,15 @@ def main(argv: list[str] | None = None, out=None) -> int:
         elif args.backend:
             # An explicit --backend always means in-process, even when a
             # local exporter happens to be listening.
-            cfg = Config.from_env().with_args(args)
-            snap = snapshot_from_backend(cfg)
+            backend = pinned_backend()
+            snap = snapshot_from_backend(source["cfg"], backend)
         elif source["mode"] == "url":
             snap = snapshot_from_url(
                 "http://localhost:9400", args.timeout, args.window
             )
         elif source["mode"] == "backend":
-            snap = snapshot_from_backend(source["cfg"])
+            backend = pinned_backend()
+            snap = snapshot_from_backend(source["cfg"], backend)
         else:
             # First snapshot: probe the conventional local exporter, fall
             # back to in-process, and remember the choice.
@@ -293,8 +335,8 @@ def main(argv: list[str] | None = None, out=None) -> int:
                 )
                 source["mode"] = "url"
             except (urllib.error.URLError, OSError):
-                source["cfg"] = Config.from_env().with_args(args)
-                snap = snapshot_from_backend(source["cfg"])
+                backend = pinned_backend()
+                snap = snapshot_from_backend(source["cfg"], backend)
                 source["mode"] = "backend"
         snap["ts"] = time.time()
         return snap
@@ -305,6 +347,19 @@ def main(argv: list[str] | None = None, out=None) -> int:
         else:
             render(snap, out)
 
+    import http.client
+
+    # Everything a dying exporter can throw mid-request: connect failures
+    # (URLError/OSError), torn connections mid-body (IncompleteRead and
+    # friends are HTTPException, not OSError), truncated exposition text
+    # (parser ValueError).
+    fetch_errors = (
+        urllib.error.URLError,
+        OSError,
+        http.client.HTTPException,
+        ValueError,
+    )
+
     try:
         if args.watch:
             while True:
@@ -312,7 +367,7 @@ def main(argv: list[str] | None = None, out=None) -> int:
                 # one timed-out scrape) — render the error, keep polling.
                 try:
                     snap = one_snapshot()
-                except (urllib.error.URLError, OSError) as exc:
+                except fetch_errors as exc:
                     if not args.json and out is sys.stdout:
                         print("\x1b[2J\x1b[H", end="", file=out)
                     print(f"tpumon smi: fetch failed: {exc}", file=sys.stderr)
@@ -326,9 +381,12 @@ def main(argv: list[str] | None = None, out=None) -> int:
             emit(one_snapshot())
     except KeyboardInterrupt:
         return 0
-    except (urllib.error.URLError, OSError) as exc:
+    except fetch_errors as exc:
         print(f"tpumon smi: cannot reach exporter: {exc}", file=sys.stderr)
         return 1
+    finally:
+        if source["backend"] is not None:
+            source["backend"].close()
     return 0
 
 

@@ -111,6 +111,27 @@ def test_health_devices_endpoint(exporter):
         assert doc["status"] in sevs
 
 
+def test_health_families_in_scrape():
+    """The verdicts are scrapeable so PromQL alerts fire on them."""
+    import re
+
+    cfg = Config(port=0, addr="127.0.0.1", interval=30.0, pod_attribution=False)
+    # All links flapping: findings are guaranteed, status must be crit.
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v5e-16", ici_flake=1.0))
+    exp.start()
+    try:
+        with urllib.request.urlopen(
+            exp.server.url + "/metrics", timeout=10
+        ) as resp:
+            text = resp.read().decode()
+    finally:
+        exp.close()
+    m = re.search(r"accelerator_health_status\{[^}]*\} (\d+\.\d+)", text)
+    assert m and float(m.group(1)) == 2.0
+    assert 'code="ici_link"' in text and 'severity="crit"' in text
+    # /health/devices agrees (same per-poll verdict, served from cache).
+
+
 def test_doctor_prints_health():
     import io
 

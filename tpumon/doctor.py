@@ -47,11 +47,13 @@ class _CachedBackend:
 
 
 def run(cfg: Config, out=sys.stdout, backend=None) -> int:
-    """``backend`` overrides creation from cfg (tests, embedding)."""
+    """``backend`` overrides creation from cfg (tests, embedding); a
+    caller-supplied backend is NOT closed — the caller owns it."""
 
     def p(line: str = "") -> None:
         print(line, file=out)
 
+    owned = backend is None
     try:
         backend = _CachedBackend(backend or create_backend(cfg))
     except BackendError as exc:
@@ -149,7 +151,8 @@ def run(cfg: Config, out=sys.stdout, backend=None) -> int:
         p("\nverdict: COVERAGE BELOW TARGET")
         return 1
     finally:
-        backend.close()
+        if owned:
+            backend.close()
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -36,6 +36,9 @@ class PollStats:
     #: Node-constant base label keys this cycle (history recording strips
     #: them from series identity).
     base_keys: tuple[str, ...] = ()
+    #: Per-cycle device-health report (the /health/devices body), so the
+    #: endpoint serves the poll's verdict instead of re-evaluating.
+    health: dict | None = None
 
 
 class SampleCache:
@@ -220,6 +223,42 @@ def build_families(
             for core, state in states.items():
                 fam.add_metric(base_vals + (str(core), str(state)), 1.0)
             families.append(fam)
+
+    # Derived health verdicts as scrapeable families (dcgmi-health
+    # analogue): alerts can fire on the verdict without re-encoding the
+    # thresholds in PromQL. Same evaluator as /health/devices and doctor;
+    # names/help/labels come from the HEALTH_FAMILIES registry so docs and
+    # exposition cannot drift.
+    from collections import Counter
+
+    from tpumon import health as health_mod
+    from tpumon.families import HEALTH_FAMILIES
+    from tpumon.smi import snapshot_from_families
+
+    snap = snapshot_from_families(families)
+    snap["coverage"] = stats.coverage
+    findings = health_mod.evaluate(snap)
+    stats.health = health_mod.report(snap, findings)
+
+    status_help, status_labels = HEALTH_FAMILIES["accelerator_health_status"]
+    status = GaugeMetricFamily(
+        "accelerator_health_status", status_help, labels=base_keys + status_labels
+    )
+    status.add_metric(
+        base_vals, float(health_mod.severity_value(health_mod.overall(findings)))
+    )
+    families.append(status)
+    if findings:
+        counts = Counter((f.severity, f.code) for f in findings)
+        find_help, find_labels = HEALTH_FAMILIES["accelerator_health_findings"]
+        fam = GaugeMetricFamily(
+            "accelerator_health_findings",
+            find_help,
+            labels=base_keys + find_labels,
+        )
+        for (sev, code), n in sorted(counts.items()):
+            fam.add_metric(base_vals + (sev, code), float(n))
+        families.append(fam)
 
     # Chip→pod attribution (kubelet pod-resources API, SURVEY §7(d)):
     # optional, never fatal, absent off-cluster.

@@ -38,6 +38,21 @@ IDENTITY_FAMILIES: dict[str, tuple[str, tuple[str, ...]]] = {
     ),
 }
 
+#: family -> (description, extra labels) — derived by the exporter from
+#: device families each poll (tpumon/health.py thresholds), so alerts can
+#: fire on verdicts without re-encoding thresholds in PromQL.
+HEALTH_FAMILIES: dict[str, tuple[str, tuple[str, ...]]] = {
+    "accelerator_health_status": (
+        "Node device-health verdict: 0 ok, 1 warn, 2 crit "
+        "(dcgmi health -c analogue; thresholds in tpumon/health.py)",
+        (),
+    ),
+    "accelerator_health_findings": (
+        "Active device-health findings by severity and check code",
+        ("severity", "code"),
+    ),
+}
+
 #: family -> (prometheus type, description)
 SELF_FAMILIES: dict[str, tuple[str, str]] = {
     "exporter_scrape_duration_seconds": (
@@ -89,6 +104,7 @@ def all_family_names() -> set[str]:
     return (
         {s.family for s in LIBTPU_SPECS}
         | set(IDENTITY_FAMILIES)
+        | set(HEALTH_FAMILIES)
         | set(SELF_FAMILIES)
         | set(WORKLOAD_FAMILIES)
     )

@@ -124,6 +124,11 @@ def evaluate(snap: dict) -> list[Finding]:
     return findings
 
 
+def severity_value(severity: str) -> int:
+    """Numeric form for the accelerator_health_status gauge (0/1/2)."""
+    return _SEV_ORDER[severity]
+
+
 def overall(findings: list[Finding]) -> str:
     """Worst severity across findings; `ok` when none."""
     worst = OK
@@ -133,9 +138,14 @@ def overall(findings: list[Finding]) -> str:
     return worst
 
 
-def report(snap: dict) -> dict:
-    """JSON-ready verdict document (the /health/devices body)."""
-    findings = evaluate(snap)
+def report(snap: dict, findings: list[Finding] | None = None) -> dict:
+    """JSON-ready verdict document (the /health/devices body).
+
+    Pass ``findings`` to reuse an evaluation already done on this snap
+    (the poll cycle computes one for the metric families).
+    """
+    if findings is None:
+        findings = evaluate(snap)
     return {
         "status": overall(findings),
         "findings": [asdict(f) for f in findings],

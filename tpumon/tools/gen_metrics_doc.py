@@ -12,6 +12,7 @@ import os
 import sys
 
 from tpumon.families import (
+    HEALTH_FAMILIES,
     IDENTITY_FAMILIES,
     SELF_FAMILIES,
     WORKLOAD_FAMILIES,
@@ -67,6 +68,21 @@ def render() -> str:
     ]
     for name, desc, labels in IDENTITY:
         lines.append(f"| `{name}` | {desc} | {labels or '—'} |")
+
+    lines += [
+        "",
+        "## Derived device health (dcgmi `health -c` analogue)",
+        "",
+        "Computed by the exporter each poll from the device families above",
+        "(thresholds in `tpumon/health.py`); the same verdicts back",
+        "`/health/devices`, `tpumon doctor`, and `tpumon smi`.",
+        "",
+        "| family | description | extra labels |",
+        "|---|---|---|",
+    ]
+    for name, (desc, labels) in HEALTH_FAMILIES.items():
+        label_s = ", ".join(f"`{l}`" for l in labels) or "—"
+        lines.append(f"| `{name}` | {desc} | {label_s} |")
 
     lines += [
         "",

@@ -4,8 +4,11 @@ Measures the full HTTP scrape path (client → WSGI server → cached
 exposition) against a v5p-64-host fake backend — the largest per-host
 topology in the BASELINE config ladder, with all 14 metric families plus
 per-link ICI gauges populated — while the 1 Hz poller runs concurrently,
-exactly as in production. The poll loop and scrape path share only the
-atomic snapshot (SURVEY.md §3.2), so this is the number Prometheus sees.
+exactly as in production. The client holds ONE persistent HTTP/1.1
+connection, as Prometheus does between scrapes of the same target; this
+is the path that exposed (and now guards) the Nagle/delayed-ACK stall.
+The poll loop and scrape path share only the atomic snapshot
+(SURVEY.md §3.2), so this is the number Prometheus sees.
 
 vs_baseline: the reference publishes no numbers (BASELINE.md: "published":
 {}), so the anchor is the 10 ms p99 scrape budget typical of the
@@ -17,10 +20,10 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 from __future__ import annotations
 
+import http.client
 import json
 import sys
 import time
-import urllib.request
 
 GENRE_P99_BUDGET_MS = 10.0
 SCRAPES = 500
@@ -31,22 +34,33 @@ def main() -> int:
     from tpumon.config import Config
     from tpumon.exporter.server import build_exporter
 
+    # Mirror the daemon entrypoint's scrape-tail tuning (exporter/main.py);
+    # the bench embeds the exporter instead of spawning the CLI.
+    sys.setswitchinterval(min(sys.getswitchinterval(), 0.001))
+
     backend = FakeTpuBackend.preset("v5p-64")
     cfg = Config(port=0, addr="127.0.0.1", interval=1.0)
     exporter = build_exporter(cfg, backend)
     exporter.start()
-    url = exporter.server.url + "/metrics"
+
+    conn = http.client.HTTPConnection(
+        "127.0.0.1", exporter.server.port, timeout=10
+    )
+
+    def scrape() -> bytes:
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        return resp.read()
 
     try:
         # Warm the connection path and confirm the page is fully populated.
-        body = urllib.request.urlopen(url, timeout=10).read()
+        body = scrape()
         assert b"accelerator_duty_cycle_percent" in body, "families missing"
 
         samples_ms = []
         for _ in range(SCRAPES):
             t0 = time.perf_counter()
-            with urllib.request.urlopen(url, timeout=10) as resp:
-                resp.read()
+            scrape()
             samples_ms.append((time.perf_counter() - t0) * 1e3)
 
         samples_ms.sort()
@@ -63,6 +77,7 @@ def main() -> int:
         )
         return 0
     finally:
+        conn.close()
         exporter.close()
 
 

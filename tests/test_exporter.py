@@ -270,3 +270,31 @@ def test_gzip_negotiation(exporter_for, scrape):
     status, plain = scrape(exp.server.url + "/metrics")
     assert status == 200 and "accelerator_duty_cycle_percent" in plain
     assert len(raw) < len(plain) / 3  # compression actually bites
+
+
+def test_keepalive_reuse_and_no_nagle_stall(exporter_for):
+    """Prometheus holds one persistent connection per target; repeated
+    scrapes on it must not hit the Nagle/delayed-ACK interaction (a
+    regression there shows up as ~40 ms per scrape — measured before
+    disable_nagle_algorithm was set — so the 20 ms budget trips it
+    reliably while staying far above CI noise)."""
+    import http.client
+    import time as _time
+
+    exp = exporter_for(FakeTpuBackend.preset("v5e-16"))
+    conn = http.client.HTTPConnection("127.0.0.1", exp.server.port, timeout=10)
+    try:
+        samples = []
+        for _ in range(30):
+            t0 = _time.perf_counter()
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            body = resp.read()
+            samples.append(_time.perf_counter() - t0)
+            assert resp.status == 200
+            assert b"accelerator_duty_cycle_percent" in body
+        samples.sort()
+        p90 = samples[26]
+        assert p90 < 0.020, f"keep-alive scrape p90 {p90 * 1e3:.1f} ms"
+    finally:
+        conn.close()

@@ -112,22 +112,19 @@ def run(cfg: Config, out=sys.stdout, backend=None) -> int:
                 "the accelerator (expected on idle nodes; SURVEY.md §2.2)"
             )
 
-        # Device-health verdicts (the dcgmi `health -c` analogue): evaluate
-        # the same snapshot shape the exporter's /health/devices serves.
-        # The _CachedBackend makes this reuse the loop's samples — zero
-        # extra device queries.
+        # Device-health verdicts (the dcgmi `health -c` analogue): the
+        # poll cycle computes the report (PollStats.health) — the exact
+        # doc /health/devices serves — and the _CachedBackend makes it
+        # reuse the loop's samples, so zero extra device queries.
         from tpumon import health as health_mod
         from tpumon.exporter.collector import build_families
-        from tpumon.smi import snapshot_from_families
 
-        families, stats = build_families(backend, cfg)
-        snap = snapshot_from_families(families)
-        snap["coverage"] = stats.coverage
-        findings = health_mod.evaluate(snap)
-        health_status = health_mod.overall(findings)
+        _, stats = build_families(backend, cfg)
+        health_doc = stats.health or {"status": health_mod.OK, "findings": []}
+        health_status = health_doc["status"]
         p(f"\ndevice health: {health_status.upper()}")
-        for f in findings:
-            p(f"  [{f.severity}] {f.message}")
+        for f in health_doc["findings"]:
+            p(f"  [{f['severity']}] {f['message']}")
 
         from tpumon.attribution import PodResourcesClient
 

@@ -39,6 +39,10 @@ class PollStats:
     #: Per-cycle device-health report (the /health/devices body), so the
     #: endpoint serves the poll's verdict instead of re-evaluating.
     health: dict | None = None
+    #: Per-cycle parsed snapshot (tpumon.smi shape, coverage included) —
+    #: consumers (smi standalone mode, doctor) reuse it instead of
+    #: re-walking the families.
+    snapshot: dict | None = None
 
 
 class SampleCache:
@@ -239,13 +243,14 @@ def build_families(
     snap["coverage"] = stats.coverage
     findings = health_mod.evaluate(snap)
     stats.health = health_mod.report(snap, findings)
+    stats.snapshot = snap
 
     status_help, status_labels = HEALTH_FAMILIES["accelerator_health_status"]
     status = GaugeMetricFamily(
         "accelerator_health_status", status_help, labels=base_keys + status_labels
     )
     status.add_metric(
-        base_vals, float(health_mod.severity_value(health_mod.overall(findings)))
+        base_vals, float(health_mod.severity_value(stats.health["status"]))
     )
     families.append(status)
     if findings:

@@ -24,6 +24,16 @@ def main(argv: list[str] | None = None) -> int:
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
 
+    # Scrape-tail control, daemon-only (embedders keep their own setting):
+    # the poll cycle holds the GIL in ~ms chunks each second, and CPython's
+    # default 5 ms switch interval lets it stall a scrape thread the full
+    # 5 ms (measured in bench.py). Opt out with TPUMON_KEEP_SWITCH_INTERVAL.
+    import os
+    import sys as _sys
+
+    if not os.environ.get("TPUMON_KEEP_SWITCH_INTERVAL"):
+        _sys.setswitchinterval(min(_sys.getswitchinterval(), 0.001))
+
     exporter = build_exporter(cfg)
     stop = threading.Event()
 

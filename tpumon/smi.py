@@ -186,9 +186,9 @@ def snapshot_from_backend(cfg, backend=None) -> dict:
         backend = create_backend(cfg)
     try:
         families, stats = build_families(backend, cfg)
-        snap = snapshot_from_families(families)
-        snap["coverage"] = stats.coverage
-        return snap
+        # build_families already parsed this cycle's snapshot (with
+        # coverage set) for the health families — reuse it.
+        return stats.snapshot or snapshot_from_families(families)
     finally:
         if owned:
             backend.close()

@@ -85,3 +85,74 @@ def test_stub_render():
     out = io.StringIO()
     smi.render(snap, out)
     assert "no accelerator devices" in out.getvalue()
+
+
+@pytest.fixture
+def two_exporters():
+    from tpumon.exporter.server import build_exporter as _build
+
+    exps = []
+    for worker in (0, 1):
+        cfg = Config(port=0, addr="127.0.0.1", interval=30.0,
+                     pod_attribution=False)
+        exp = _build(cfg, FakeTpuBackend.preset("v5e-16", worker_id=worker))
+        exp.start()
+        exps.append(exp)
+    yield exps
+    for exp in exps:
+        exp.close()
+
+
+def test_fleet_view(two_exporters, capsys):
+    urls = [e.server.url for e in two_exporters]
+    rc = smi.main(["--url", urls[0], "--url", urls[1]])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "fleet: 2/2 hosts up, 8 chips" in text
+    assert "fake-v5e-16-w0" in text and "fake-v5e-16-w1" in text
+    assert "fleet health:" in text
+    assert "ici links:" in text and "across fleet" in text
+
+
+def test_fleet_view_with_down_host(two_exporters, capsys):
+    urls = [two_exporters[0].server.url, "http://127.0.0.1:1"]
+    rc = smi.main(["--url", urls[0], "--url", urls[1], "--timeout", "0.5"])
+    assert rc == 0  # a down node renders, it does not kill the view
+    text = capsys.readouterr().out
+    assert "fleet: 1/2 hosts up" in text
+    assert "UNREACHABLE" in text
+    assert "fleet health: CRIT" in text
+
+
+def test_fleet_json(two_exporters, capsys):
+    urls = [e.server.url for e in two_exporters]
+    rc = smi.main(["--url", urls[0], "--url", urls[1], "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert len(doc["fleet"]) == 2
+    assert all("chips" in s for s in doc["fleet"])
+
+
+def test_fleet_stub_host_row(two_exporters, capsys):
+    from tpumon.backends.stub import StubBackend
+    from tpumon.exporter.server import build_exporter as _build
+
+    cfg = Config(port=0, addr="127.0.0.1", interval=30.0, pod_attribution=False)
+    stub = _build(cfg, StubBackend())
+    stub.start()
+    try:
+        rc = smi.main(
+            ["--url", two_exporters[0].server.url, "--url", stub.server.url]
+        )
+    finally:
+        stub.close()
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "(stub: no accelerator devices)" in text
+
+
+def test_fleet_window_in_header(two_exporters, capsys):
+    urls = [e.server.url for e in two_exporters]
+    rc = smi.main(["--url", urls[0], "--url", urls[1], "--window", "30"])
+    assert rc == 0
+    assert "(30s)" in capsys.readouterr().out

@@ -194,6 +194,104 @@ def snapshot_from_backend(cfg, backend=None) -> dict:
             backend.close()
 
 
+def _chip_cells(chip: str, row: dict, has_trend: bool) -> str:
+    """The per-chip table cells shared by single-host and fleet views."""
+    duty = row.get("duty_pct")
+    duty_s = f"{duty:5.1f}" if duty is not None else "    -"
+    used, total = row.get("hbm_used"), row.get("hbm_total")
+    hbm_s = (
+        f"{_human_bytes(used)}/{_human_bytes(total)}"
+        if used is not None and total is not None
+        else "-"
+    )
+    thr = row.get("throttle")
+    thr_s = f"{thr:3.0f}" if thr is not None else "  -"
+    line = (
+        f" {chip:>4} | {row.get('coords', ''):<9} | {duty_s}  |"
+        f" {hbm_s:<18} | {thr_s} |"
+    )
+    if has_trend:
+        t = row.get("duty_trend")
+        trend_s = (
+            f"{t['min']:5.1f}/{t['avg']:5.1f}/{t['max']:5.1f}" if t else "-"
+        )
+        line += f" {trend_s:<22} |"
+    return line
+
+
+def render_fleet(snaps: list[dict], out=None) -> None:
+    """Merged view over several exporters (one per DaemonSet host).
+
+    Snapshots carrying an ``error`` key render as unreachable rows —
+    a down node must be visible, not silently missing.
+    """
+    out = out if out is not None else sys.stdout
+
+    def p(line: str = "") -> None:
+        print(line, file=out)
+
+    ok = [s for s in snaps if "error" not in s]
+    slices = sorted(
+        {s["identity"].get("slice", "?") for s in ok if s.get("identity")}
+    )
+    chips = sum(len(s.get("chips", {})) for s in ok)
+    p(
+        f"tpumon smi — fleet: {len(ok)}/{len(snaps)} hosts up, "
+        f"{chips} chips | slice(s): {', '.join(slices) or '?'}"
+    )
+    p(time.strftime("%a %b %d %H:%M:%S %Y"))
+
+    has_trend = any(
+        "duty_trend" in c for s in ok for c in s.get("chips", {}).values()
+    )
+    window = max((s.get("trend_window", 60) for s in ok), default=60)
+    cols = "| Host            | Chip | Coords    | Duty%  | HBM used/total     | Thr |"
+    if has_trend:
+        cols += f" Duty min/avg/max ({window:.0f}s) |"
+    sep = "+" + "-" * (len(cols) - 2) + "+"
+    p(sep)
+    p(cols)
+    p(sep)
+
+    from tpumon import health as _health
+
+    worst = _health.OK
+    healthy = total_links = 0
+    worst_link = None
+    for snap in sorted(
+        snaps, key=lambda s: s.get("identity", {}).get("host", s.get("url", ""))
+    ):
+        host = snap.get("identity", {}).get("host") or snap.get("url", "?")
+        if "error" in snap:
+            p(f"| {host:<15} | UNREACHABLE: {snap['error']}")
+            worst = _health.CRIT
+            continue
+        if snap.get("device_count") == 0:
+            # A CPU-only/stub node is up but deviceless — it must be
+            # distinguishable from a host the operator forgot to pass.
+            p(f"| {host:<15} | (stub: no accelerator devices)")
+            continue
+        for chip in sorted(snap.get("chips", {}), key=lambda c: (len(c), c)):
+            p(f"| {host:<15} |" + _chip_cells(chip, snap["chips"][chip], has_trend))
+        ici = snap.get("ici") or {}
+        healthy += ici.get("healthy", 0)
+        total_links += ici.get("total", 0)
+        w = ici.get("worst")
+        if w and (worst_link is None or w[1] > worst_link[1]):
+            worst_link = (f"{host}:{w[0]}", w[1])
+        findings = _health.evaluate(snap)
+        status = _health.overall(findings)
+        if _health.severity_value(status) > _health.severity_value(worst):
+            worst = status
+    p(sep)
+    if total_links:
+        line = f"ici links: {healthy}/{total_links} healthy across fleet"
+        if worst_link:
+            line += f" (worst: {worst_link[0]} score={worst_link[1]:.0f})"
+        p(line)
+    p(f"fleet health: {worst.upper()}")
+
+
 def render(snap: dict, out=None) -> None:
     out = out if out is not None else sys.stdout
 
@@ -221,30 +319,7 @@ def render(snap: dict, out=None) -> None:
     p(cols)
     p(sep)
     for chip in sorted(snap["chips"], key=lambda c: (len(c), c)):
-        row = snap["chips"][chip]
-        duty = row.get("duty_pct")
-        duty_s = f"{duty:5.1f}" if duty is not None else "    -"
-        used, total = row.get("hbm_used"), row.get("hbm_total")
-        hbm_s = (
-            f"{_human_bytes(used)}/{_human_bytes(total)}"
-            if used is not None and total is not None
-            else "-"
-        )
-        thr = row.get("throttle")
-        thr_s = f"{thr:3.0f}" if thr is not None else "  -"
-        line = (
-            f"| {chip:>4} | {row.get('coords', ''):<9} | {duty_s}  |"
-            f" {hbm_s:<18} | {thr_s} |"
-        )
-        if has_trend:
-            t = row.get("duty_trend")
-            trend_s = (
-                f"{t['min']:5.1f}/{t['avg']:5.1f}/{t['max']:5.1f}"
-                if t
-                else "-"
-            )
-            line += f" {trend_s:<22} |"
-        p(line)
+        p("|" + _chip_cells(chip, snap["chips"][chip], has_trend))
     p(sep)
 
     if snap["cores"]:
@@ -278,9 +353,10 @@ def main(argv: list[str] | None = None, out=None) -> int:
     )
     parser.add_argument(
         "--url",
-        help="running exporter base URL; without --url or --backend, "
-        "http://localhost:9400 is probed and an in-process backend is the "
-        "fallback",
+        action="append",
+        help="running exporter base URL; repeat for a merged fleet view "
+        "across hosts. Without --url or --backend, http://localhost:9400 "
+        "is probed and an in-process backend is the fallback",
     )
     parser.add_argument(
         "--watch", type=float, metavar="SEC", help="refresh every SEC seconds"
@@ -311,9 +387,26 @@ def main(argv: list[str] | None = None, out=None) -> int:
             source["backend"] = create_backend(source["cfg"])
         return source["backend"]
 
+    def fleet_snapshot(urls: list[str]) -> dict:
+        # Concurrent fetch: one refresh costs one timeout, not one per
+        # down host (a 16-host view with dead nodes must not stall N×).
+        from concurrent.futures import ThreadPoolExecutor
+
+        def fetch(url: str) -> dict:
+            try:
+                return snapshot_from_url(url, args.timeout, args.window)
+            except fetch_errors as exc:
+                return {"url": url, "error": str(exc)}
+
+        with ThreadPoolExecutor(max_workers=min(len(urls), 16)) as pool:
+            snaps = list(pool.map(fetch, urls))
+        return {"fleet": snaps, "ts": time.time()}
+
     def one_snapshot() -> dict:
+        if args.url and len(args.url) > 1:
+            return fleet_snapshot(args.url)
         if args.url:
-            snap = snapshot_from_url(args.url, args.timeout, args.window)
+            snap = snapshot_from_url(args.url[0], args.timeout, args.window)
         elif args.backend:
             # An explicit --backend always means in-process, even when a
             # local exporter happens to be listening.
@@ -344,6 +437,8 @@ def main(argv: list[str] | None = None, out=None) -> int:
     def emit(snap: dict) -> None:
         if args.json:
             print(json.dumps(snap, sort_keys=True), file=out)
+        elif "fleet" in snap:
+            render_fleet(snap["fleet"], out)
         else:
             render(snap, out)
 

@@ -50,6 +50,10 @@ class GrpcMonitoringBackend:
         # The SDK rides the same service; it is the metric transport.
         self._delegate = LibtpuBackend(topology_file)
 
+    def grpc_available(self) -> bool:
+        """False when grpcio itself is missing (vs the service being down)."""
+        return self._channel is not None
+
     def service_reachable(self) -> bool:
         """True iff the runtime monitoring service accepts connections."""
         if self._channel is None:
@@ -60,6 +64,16 @@ class GrpcMonitoringBackend:
             return True
         except Exception:
             return False
+
+    def services(self) -> list[str] | None:
+        """Names of the gRPC services the endpoint exposes, via hand-rolled
+        server reflection (tpumon.backends.reflection — no protos shipped).
+        None when unreachable or reflection is not spoken."""
+        if self._channel is None:
+            return None
+        from tpumon.backends.reflection import list_services
+
+        return list_services(self._channel, self.timeout)
 
     def list_metrics(self) -> tuple[str, ...]:
         return self._delegate.list_metrics()

@@ -1,0 +1,137 @@
+"""Hand-rolled gRPC server-reflection client (list-services only).
+
+The cloud-TPU runtime hosts its monitoring gRPC service locally
+(127.0.0.1:8431, SURVEY.md §2.2) but its protos are not shipped in this
+environment — and neither is ``grpcio-reflection``. The reflection
+protocol itself, though, is tiny for the one call we need: a
+bidi-streaming ``ServerReflectionInfo`` where the request sets
+``list_services`` (field 7) and the response carries
+``list_services_response.service[].name`` (fields 6 → 1 → 1). This module
+encodes/decodes exactly that with a ~40-line varint codec — the same
+no-proto approach as ``tpumon/attribution/podresources_pb2.py``.
+
+Used by the grpc backend and doctor to report *which* services the
+runtime's monitoring endpoint actually exposes, turning the boolean
+"port open" probe into real service discovery.
+
+Wire reference (public grpc reflection.proto, v1alpha):
+
+    ServerReflectionRequest  { host=1; ... list_services=7; }
+    ServerReflectionResponse { ... list_services_response=6; error_response=7 }
+    ListServiceResponse      { repeated ServiceResponse service=1; }
+    ServiceResponse          { name=1; }
+"""
+
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger(__name__)
+
+REFLECTION_METHOD = (
+    "/grpc.reflection.v1alpha.ServerReflection/ServerReflectionInfo"
+)
+
+
+def _encode_varint(value: int) -> bytes:
+    out = bytearray()
+    while True:
+        bits = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return bytes(out)
+
+
+def _decode_varint(data: bytes, pos: int) -> tuple[int, int]:
+    result = shift = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("truncated varint")
+        byte = data[pos]
+        pos += 1
+        result |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long")
+
+
+def _len_field(field: int, payload: bytes) -> bytes:
+    return _encode_varint((field << 3) | 2) + _encode_varint(len(payload)) + payload
+
+
+def _iter_fields(data: bytes):
+    """Yield (field_number, wire_type, value, end_pos) over a message."""
+    pos = 0
+    while pos < len(data):
+        tag, pos = _decode_varint(data, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:  # varint
+            value, pos = _decode_varint(data, pos)
+        elif wire == 2:  # length-delimited
+            length, pos = _decode_varint(data, pos)
+            if pos + length > len(data):
+                raise ValueError("truncated field")
+            value = data[pos : pos + length]
+            pos += length
+        elif wire == 5:  # fixed32
+            if pos + 4 > len(data):
+                raise ValueError("truncated fixed32")
+            value, pos = data[pos : pos + 4], pos + 4
+        elif wire == 1:  # fixed64
+            if pos + 8 > len(data):
+                raise ValueError("truncated fixed64")
+            value, pos = data[pos : pos + 8], pos + 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, value
+
+
+def encode_list_services_request() -> bytes:
+    """ServerReflectionRequest{list_services: "*"} (field 7, string)."""
+    return _len_field(7, b"*")
+
+
+def decode_list_services_response(data: bytes) -> list[str]:
+    """ServerReflectionResponse → service names; [] when the response is an
+    error_response or carries no list (both are well-formed protocol
+    outcomes, not parse failures)."""
+    names: list[str] = []
+    for field, wire, value in _iter_fields(data):
+        if field == 6 and wire == 2:  # list_services_response
+            for f2, w2, svc in _iter_fields(value):
+                if f2 == 1 and w2 == 2:  # ServiceResponse
+                    for f3, w3, name in _iter_fields(svc):
+                        if f3 == 1 and w3 == 2:  # name
+                            names.append(name.decode("utf-8", "replace"))
+    return names
+
+
+def list_services(channel, timeout: float = 2.0) -> list[str] | None:
+    """Enumerate services via reflection; None when the server doesn't
+    speak reflection / is unreachable (callers fall back to the boolean
+    channel probe)."""
+    try:
+        call = channel.stream_stream(
+            REFLECTION_METHOD,
+            request_serializer=None,  # raw bytes in
+            response_deserializer=None,  # raw bytes out
+        )
+        responses = call(
+            iter([encode_list_services_request()]), timeout=timeout
+        )
+        try:
+            for raw in responses:
+                return sorted(decode_list_services_response(raw))
+            return []
+        finally:
+            # One response is all we take; cancel the bidi stream instead
+            # of leaving it open until GC (matters for per-poll callers).
+            responses.cancel()
+    except Exception as exc:
+        log.debug("reflection list_services failed: %s", exc)
+        return None

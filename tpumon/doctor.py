@@ -128,6 +128,25 @@ def run(cfg: Config, out=sys.stdout, backend=None) -> int:
 
         from tpumon.attribution import PodResourcesClient
 
+        # Runtime monitoring gRPC endpoint: reachability + (when the
+        # server speaks reflection) the actual service names.
+        reachable_fn = getattr(backend, "service_reachable", None)
+        if reachable_fn is not None:
+            prefix = f"monitoring grpc ({getattr(backend, 'addr', '?')}): "
+            available_fn = getattr(backend, "grpc_available", None)
+            if available_fn is not None and not available_fn():
+                # Missing Python dep, NOT a runtime problem — don't send
+                # the operator off to debug the TPU.
+                p(prefix + "cannot probe (grpcio unavailable)")
+            elif reachable_fn():
+                line = prefix + "reachable"
+                services = getattr(backend, "services", lambda: None)()
+                if services:
+                    line += " — services: " + ", ".join(services)
+                p(line)
+            else:
+                p(prefix + "unreachable (no runtime attached)")
+
         client = PodResourcesClient(cfg.kubelet_socket, cfg.grpc_timeout)
         devices = client.list_devices()
         client.close()

@@ -1,0 +1,142 @@
+"""Exporter-hosted gRPC metrics service (SURVEY §1 L4 gRPC streaming path).
+
+Get returns the same exposition page the HTTP scrape serves; Watch pushes
+one page per poll cycle; reflection advertises tpumon.v1.Metrics — all
+proto-free, raw-bytes protobuf framing.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from tpumon.backends.fake import FakeTpuBackend
+from tpumon.config import Config
+from tpumon.exporter import grpc_service
+from tpumon.exporter.server import build_exporter
+
+
+@pytest.fixture
+def exporter():
+    cfg = Config(
+        port=0, addr="127.0.0.1", interval=30.0, pod_attribution=False,
+        grpc_serve_port=0,
+    )
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v5e-16"))
+    exp.start()
+    assert exp.grpc_server is not None
+    yield exp
+    exp.close()
+
+
+def test_page_response_roundtrip():
+    page = b"# HELP x\nx 1.0\n"
+    raw = grpc_service.encode_page_response(page, 42)
+    assert grpc_service.decode_page_response(raw) == (page, 42)
+
+
+def test_get_serves_exposition(exporter):
+    addr = f"127.0.0.1:{exporter.grpc_server.port}"
+    page, version = grpc_service.fetch_page(addr)
+    assert b"accelerator_duty_cycle_percent" in page
+    assert b"exporter_metric_coverage_ratio" in page
+    assert version >= 1
+    # Same content class as the HTTP path (modulo scrape-time self-telemetry).
+    assert b"accelerator_device_count" in exporter.render_page()
+
+
+def test_watch_pushes_per_poll(exporter):
+    addr = f"127.0.0.1:{exporter.grpc_server.port}"
+    results = []
+    got = threading.Event()
+
+    def consume():
+        channel = grpc.insecure_channel(addr)
+        call = channel.unary_stream(
+            grpc_service.METHOD_WATCH,
+            request_serializer=None,
+            response_deserializer=None,
+        )
+        stream = call(b"", timeout=30)
+        try:
+            for raw in stream:
+                results.append(grpc_service.decode_page_response(raw))
+                got.set()
+                if len(results) >= 2:
+                    break
+        finally:
+            stream.cancel()
+            channel.close()
+
+    t = threading.Thread(target=consume)
+    t.start()
+    # Wait for the initial push so the stream is attached BEFORE the next
+    # poll — otherwise the push for that poll races stream setup.
+    assert got.wait(timeout=15), "no initial Watch push"
+    got.clear()
+    exporter.poller.poll_once()
+    t.join(timeout=15)
+    assert not t.is_alive()
+    assert len(results) == 2
+    (page1, v1), (page2, v2) = results
+    assert v2 > v1
+    assert b"accelerator_duty_cycle_percent" in page1
+    # The fake advances per poll, so consecutive pushes differ.
+    assert page1 != page2
+
+
+def test_watch_pages_helper_initial_push(exporter):
+    addr = f"127.0.0.1:{exporter.grpc_server.port}"
+    pages = grpc_service.watch_pages(addr, max_messages=1, timeout=15)
+    assert len(pages) == 1
+    page, version = pages[0]
+    assert b"accelerator_duty_cycle_percent" in page and version >= 1
+
+
+def test_reflection_lists_metrics_service(exporter):
+    from tpumon.backends.reflection import list_services
+
+    addr = f"127.0.0.1:{exporter.grpc_server.port}"
+    channel = grpc.insecure_channel(addr)
+    try:
+        services = list_services(channel, timeout=5.0)
+    finally:
+        channel.close()
+    assert services is not None
+    assert "tpumon.v1.Metrics" in services
+
+
+def test_disabled_by_default():
+    cfg = Config(port=0, addr="127.0.0.1", interval=30.0, pod_attribution=False)
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v5e-16"))
+    exp.start()
+    try:
+        assert exp.grpc_server is None
+    finally:
+        exp.close()
+
+
+def test_bind_failure_raises_and_exporter_survives():
+    """A taken port must surface a warning, not a silent dead service."""
+    import socket
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    taken = sock.getsockname()[1]
+    try:
+        cfg = Config(
+            port=0, addr="127.0.0.1", interval=30.0, pod_attribution=False,
+            grpc_serve_port=taken,
+        )
+        exp = build_exporter(cfg, FakeTpuBackend.preset("v5e-16"))
+        try:
+            # Exporter construction caught the bind failure; HTTP plane up.
+            assert exp.grpc_server is None
+            exp.start()
+        finally:
+            exp.close()
+    finally:
+        sock.close()

@@ -83,6 +83,9 @@ class Config:
     grpc_addr: str = "localhost:8431"
     #: gRPC request timeout in seconds.
     grpc_timeout: float = 2.0
+    #: Serve the exporter's own gRPC metrics service (Get/Watch +
+    #: reflection) on this port; -1 disables, 0 binds an ephemeral port.
+    grpc_serve_port: int = -1
     #: Emit per-link ICI gauges (can be high-cardinality on big slices).
     ici_per_link: bool = True
     #: Chip→pod attribution via the kubelet pod-resources API; degrades
@@ -115,6 +118,7 @@ class Config:
             or base.fake_topology,
             grpc_addr=_env("GRPC_ADDR", base.grpc_addr) or base.grpc_addr,
             grpc_timeout=_env_float("GRPC_TIMEOUT", base.grpc_timeout),
+            grpc_serve_port=_env_int("GRPC_SERVE_PORT", base.grpc_serve_port),
             ici_per_link=_env_bool("ICI_PER_LINK", base.ici_per_link),
             pod_attribution=_env_bool("POD_ATTRIBUTION", base.pod_attribution),
             history_window=_env_float("HISTORY_WINDOW", base.history_window),
@@ -141,6 +145,12 @@ class Config:
         g.add_argument("--fake-topology", help="fake backend topology preset")
         g.add_argument("--grpc-addr", help="monitoring gRPC address")
         g.add_argument("--grpc-timeout", type=float, help="gRPC timeout seconds")
+        g.add_argument(
+            "--grpc-serve-port",
+            type=int,
+            help="serve the gRPC metrics service (Get/Watch) on this port "
+            "(-1 disables, 0 ephemeral)",
+        )
         g.add_argument(
             "--history-window",
             type=float,

@@ -55,18 +55,24 @@ class SampleCache:
     """
 
     def __init__(self) -> None:
+        # One lock guards page, snapshot, AND version (the Condition wraps
+        # it), so a page can never tear from the version it's labeled with.
         self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
         self._snapshot: tuple[Metric, ...] = ()
         self._rendered: bytes = b""
+        self._version = 0
 
     def publish(self, families: list[Metric]) -> None:
         from tpumon._native import render_families
 
         snap = tuple(families)
         rendered = render_families(snap)
-        with self._lock:
+        with self._cond:
             self._snapshot = snap
             self._rendered = rendered
+            self._version += 1
+            self._cond.notify_all()
 
     def snapshot(self) -> tuple[Metric, ...]:
         with self._lock:
@@ -75,6 +81,23 @@ class SampleCache:
     def rendered(self) -> bytes:
         with self._lock:
             return self._rendered
+
+    def rendered_with_version(self) -> tuple[bytes, int]:
+        """Atomic (page, version) pair — change-detection safe."""
+        with self._lock:
+            return self._rendered, self._version
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def wait_newer(self, version: int, timeout: float) -> int:
+        """Block until a publish newer than ``version`` lands (or timeout);
+        returns the current version either way."""
+        with self._cond:
+            self._cond.wait_for(lambda: self._version > version, timeout)
+            return self._version
 
 
 class CachedCollector:

@@ -1,0 +1,186 @@
+"""Optional gRPC metrics service hosted by the exporter (SURVEY.md §1 L4:
+"optional gRPC streaming from the cloud-tpu monitoring service").
+
+The DCGM-hostengine analogue serves its field cache over a native RPC
+endpoint; tpumon's equivalent serves the SAME pre-rendered exposition the
+HTTP scrape path uses, over two proto-free methods:
+
+- ``/tpumon.v1.Metrics/Get``   (unary)            — current page
+- ``/tpumon.v1.Metrics/Watch`` (server-streaming) — current page, then one
+  message per poll cycle (1 Hz push: a gRPC consumer sees every poll,
+  where a Prometheus pull sees one in 15-60 s)
+
+Wire format: requests are empty messages; responses are a minimal
+protobuf ``PageResponse { bytes page = 1; uint64 version = 2; }`` built
+with the same hand varint codec as tpumon/backends/reflection.py (no
+.proto files shipped or needed). The server also answers server
+reflection, so ``grpcurl``-style discovery and the tpumon grpc backend's
+``services()`` both see ``tpumon.v1.Metrics``.
+
+Enabled with ``--grpc-serve-port`` / ``TPUMON_GRPC_SERVE_PORT``:
+``-1`` (the default) disables the service, ``0`` binds an ephemeral port
+(tests), any other value is the listening port.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tpumon.backends.reflection import (
+    REFLECTION_METHOD,
+    _encode_varint,
+    _iter_fields,
+    _len_field,
+)
+
+log = logging.getLogger(__name__)
+
+SERVICE_NAME = "tpumon.v1.Metrics"
+METHOD_GET = f"/{SERVICE_NAME}/Get"
+METHOD_WATCH = f"/{SERVICE_NAME}/Watch"
+
+#: Watch wakes up at least this often to notice a cancelled stream even
+#: when the poller has stalled.
+_WATCH_IDLE_TIMEOUT = 5.0
+
+
+def encode_page_response(page: bytes, version: int) -> bytes:
+    """PageResponse{bytes page=1; uint64 version=2}."""
+    return _len_field(1, page) + _encode_varint((2 << 3) | 0) + _encode_varint(
+        version
+    )
+
+
+def decode_page_response(data: bytes) -> tuple[bytes, int]:
+    """Inverse of encode_page_response (used by clients and tests)."""
+    page, version = b"", 0
+    for field, wire, value in _iter_fields(data):
+        if field == 1 and wire == 2:
+            page = value
+        elif field == 2 and wire == 0:
+            version = value
+    return page, version
+
+
+class MetricsGrpcServer:
+    """Wraps a grpcio server with generic (bytes-level) handlers.
+
+    ``render_with_version`` returns an atomic (full page, cache version)
+    pair (cached device families + self-telemetry); ``cache`` provides
+    wait_newer for the Watch push loop.
+    """
+
+    def __init__(self, render_with_version, cache, addr: str, port: int) -> None:
+        import grpc
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._render_with_version = render_with_version
+        self._cache = cache
+
+        def get(request: bytes, context):
+            page, version = self._render_with_version()
+            return encode_page_response(page, version)
+
+        def watch(request: bytes, context):
+            version = 0
+            while context.is_active():
+                newer = cache.wait_newer(version, _WATCH_IDLE_TIMEOUT)
+                if newer == version:
+                    continue  # idle timeout: re-check liveness, don't spin
+                page, version = self._render_with_version()
+                yield encode_page_response(page, version)
+
+        def reflect(request_iterator, context):
+            # list_services is the only query we answer; everything else
+            # gets an error_response (field 7) per the protocol.
+            for req in request_iterator:
+                is_list = any(
+                    field == 7 for field, _, _ in _iter_fields(req)
+                )
+                if is_list:
+                    services = b"".join(
+                        _len_field(1, _len_field(1, name.encode()))
+                        for name in (
+                            SERVICE_NAME,
+                            "grpc.reflection.v1alpha.ServerReflection",
+                        )
+                    )
+                    yield _len_field(6, services)
+                else:
+                    yield _len_field(7, _len_field(2, b"only list_services"))
+
+        metrics_handler = grpc.method_handlers_generic_handler(
+            SERVICE_NAME,
+            {
+                "Get": grpc.unary_unary_rpc_method_handler(
+                    get, request_deserializer=None, response_serializer=None
+                ),
+                "Watch": grpc.unary_stream_rpc_method_handler(
+                    watch, request_deserializer=None, response_serializer=None
+                ),
+            },
+        )
+        reflection_handler = grpc.method_handlers_generic_handler(
+            "grpc.reflection.v1alpha.ServerReflection",
+            {
+                "ServerReflectionInfo": grpc.stream_stream_rpc_method_handler(
+                    reflect,
+                    request_deserializer=None,
+                    response_serializer=None,
+                )
+            },
+        )
+        # Each Watch stream parks its generator on a worker thread for the
+        # stream's lifetime — size the pool for watchers plus headroom so
+        # Get/reflection are not starved by a few long-lived consumers.
+        self._server = grpc.server(ThreadPoolExecutor(max_workers=16))
+        self._server.add_generic_rpc_handlers(
+            (metrics_handler, reflection_handler)
+        )
+        self.port = self._server.add_insecure_port(f"{addr}:{port}")
+        if self.port == 0:
+            # grpc reports bind failure by returning port 0, not raising.
+            self._server.stop(grace=None)
+            raise RuntimeError(f"could not bind grpc metrics service to {addr}:{port}")
+        self._server.start()
+        log.info("grpc metrics service on %s:%d (%s)", addr, self.port, SERVICE_NAME)
+
+    def close(self) -> None:
+        self._server.stop(grace=0.5)
+
+
+def fetch_page(addr: str, timeout: float = 5.0) -> tuple[bytes, int]:
+    """Client helper: one unary Get against a MetricsGrpcServer."""
+    import grpc
+
+    channel = grpc.insecure_channel(addr)
+    try:
+        call = channel.unary_unary(
+            METHOD_GET, request_serializer=None, response_deserializer=None
+        )
+        return decode_page_response(call(b"", timeout=timeout))
+    finally:
+        channel.close()
+
+
+def watch_pages(addr: str, max_messages: int, timeout: float = 30.0):
+    """Client helper: collect up to ``max_messages`` Watch pushes."""
+    import grpc
+
+    channel = grpc.insecure_channel(addr)
+    try:
+        call = channel.unary_stream(
+            METHOD_WATCH, request_serializer=None, response_deserializer=None
+        )
+        stream = call(b"", timeout=timeout)
+        out = []
+        try:
+            for raw in stream:
+                out.append(decode_page_response(raw))
+                if len(out) >= max_messages:
+                    break
+        finally:
+            stream.cancel()
+        return out
+    finally:
+        channel.close()

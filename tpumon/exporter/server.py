@@ -345,11 +345,34 @@ class Exporter:
             )
             return gzip.compress(body, compresslevel=1) if want_gzip else body
 
+        #: Full-page renderer (device cache + self-telemetry).
+        self.render_page = lambda: render(False)
+
+        def render_with_version() -> tuple[bytes, int]:
+            # Atomic pair: the device page and the version it carries come
+            # from one cache read, so gRPC change-detection can't tear.
+            dev, version = self.cache.rendered_with_version()
+            return dev + exposition.generate_latest(self.registry), version
+
+        self.render_with_version = render_with_version
         app = _make_app(
             render, self.telemetry, self._health, self.history,
             self._device_health,
         )
         self.server = ExporterServer(app, cfg.addr, cfg.port)
+        self.grpc_server = None
+        if cfg.grpc_serve_port >= 0:  # -1 disables; 0 = ephemeral (tests)
+            try:
+                from tpumon.exporter.grpc_service import MetricsGrpcServer
+
+                self.grpc_server = MetricsGrpcServer(
+                    self.render_with_version, self.cache, cfg.addr,
+                    cfg.grpc_serve_port,
+                )
+            except Exception as exc:
+                # grpcio missing or bind failure must not take down the
+                # HTTP scrape plane.
+                log.warning("grpc metrics service unavailable: %s", exc)
 
     def _device_health(self) -> dict:
         """The /health/devices body: the verdict the poll cycle already
@@ -382,6 +405,8 @@ class Exporter:
         )
 
     def close(self) -> None:
+        if self.grpc_server is not None:
+            self.grpc_server.close()
         self.server.close()
         self.poller.stop()
         self.backend.close()

@@ -22,9 +22,12 @@ def test_registry_matches_live_scrape():
     served = set()
     labels_by_family = {}
     for fam in text_string_to_metric_families(_python_render(tuple(families)).decode()):
-        served.add(fam.name)
+        # The parser normalizes counters to their base name; the registry
+        # (and the wire) use the text-exposition _total name.
+        name = fam.name + "_total" if fam.type == "counter" else fam.name
+        served.add(name)
         for s in fam.samples:
-            labels_by_family.setdefault(fam.name, set()).update(s.labels)
+            labels_by_family.setdefault(name, set()).update(s.labels)
 
     # Everything served is registered.
     unknown = served - all_family_names()

@@ -140,3 +140,17 @@ def test_bind_failure_raises_and_exporter_survives():
             exp.close()
     finally:
         sock.close()
+
+
+def test_grpc_vs_grpc_bind_conflict_detected(exporter):
+    """so_reuseport=0: a second exporter on the same gRPC port must fail
+    its gRPC bind (not silently split traffic with the first)."""
+    cfg = Config(
+        port=0, addr="127.0.0.1", interval=30.0, pod_attribution=False,
+        grpc_serve_port=exporter.grpc_server.port,
+    )
+    second = build_exporter(cfg, FakeTpuBackend.preset("v5e-16"))
+    try:
+        assert second.grpc_server is None
+    finally:
+        second.close()

@@ -160,3 +160,21 @@ def test_smi_renders_health_line():
     smi.render(s, out)
     text = out.getvalue()
     assert "health: CRIT" in text and "throttled" in text
+
+
+def test_queue_stall_detection():
+    s = snap(chips={"0": {"duty_pct": 0.2}})
+    s["queues"] = {"0": 12.0, "1": 2.0}
+    findings = health.evaluate(s)
+    assert codes(findings) == [("warn", "queue_stall")]
+    assert "core 0" in findings[0].message
+
+    # Busy device: deep queues are normal backpressure, not a stall.
+    busy = snap(chips={"0": {"duty_pct": 80.0}})
+    busy["queues"] = {"0": 12.0}
+    assert health.evaluate(busy) == []
+
+    # No duty data at all -> cannot conclude a stall (absent != idle).
+    unknown = snap()
+    unknown["queues"] = {"0": 12.0}
+    assert health.evaluate(unknown) == []

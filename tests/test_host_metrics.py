@@ -1,0 +1,81 @@
+"""Host-context telemetry families (psutil-backed)."""
+
+from __future__ import annotations
+
+import urllib.request
+
+import pytest
+
+from tpumon.backends.fake import FakeTpuBackend
+from tpumon.config import Config
+from tpumon.exporter.host import HOST_FAMILIES, host_families
+from tpumon.exporter.server import build_exporter
+
+
+def test_host_families_build():
+    fams = host_families(("host",), ("h0",))
+    names = {f.name for f in fams}
+    assert "host_cpu_percent" in names
+    assert "host_memory_total_bytes" in names
+    net = next(f for f in fams if f.name == "host_network_bytes")
+    dirs = {s.labels["dir"] for s in net.samples}
+    assert dirs == {"tx", "rx"}
+    total = next(f for f in fams if f.name == "host_memory_total_bytes")
+    assert total.samples[0].value > 0
+    for f in fams:
+        for s in f.samples:
+            assert s.labels["host"] == "h0"
+
+
+def test_registry_covers_host_families():
+    from tpumon.families import all_family_names
+
+    assert set(HOST_FAMILIES) <= all_family_names()
+
+
+@pytest.mark.parametrize("enabled", [True, False])
+def test_host_metrics_in_scrape(enabled):
+    cfg = Config(
+        port=0, addr="127.0.0.1", interval=30.0, pod_attribution=False,
+        host_metrics=enabled,
+    )
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v5e-16"))
+    exp.start()
+    try:
+        with urllib.request.urlopen(
+            exp.server.url + "/metrics", timeout=10
+        ) as resp:
+            text = resp.read().decode()
+    finally:
+        exp.close()
+    assert ("host_cpu_percent{" in text) == enabled
+    assert ("host_network_bytes_total{" in text) == enabled
+
+
+def test_counter_stays_on_native_render_path():
+    """The _total suffix path must not knock the page off the C renderer.
+
+    Byte-identity is not asserted: large values render repr-style
+    natively vs Go-style scientific in prometheus_client (documented
+    equivalence in tpumon/_native) — so compare parsed samples instead.
+    """
+    from prometheus_client.parser import text_string_to_metric_families
+
+    from tpumon import _native
+
+    fams = host_families(("host",), ("h0",))
+    if not _native.native_available():
+        pytest.skip("no compiler")
+    assert _native._flatten(fams) is not None, "must stay on the native path"
+
+    def parsed(raw):
+        return {
+            (s.name, tuple(sorted(s.labels.items()))): s.value
+            for f in text_string_to_metric_families(raw.decode())
+            for s in f.samples
+        }
+
+    native = parsed(_native.render_families(fams))
+    fallback = parsed(_native._python_render(fams))
+    assert native == fallback
+    assert any(name == "host_network_bytes_total" for name, _ in native)

@@ -58,14 +58,24 @@ def test_escaping():
     assert parsed[0].documentation == 'help with \\ backslash and\nnewline'
 
 
-def test_flatten_rejects_suffixed_samples():
+def test_flatten_accepts_counters_rejects_other_suffixes():
+    import time
+
     from prometheus_client.core import CounterMetricFamily
 
+    # Counters are first-class now: rendered under their _total name.
     fam = CounterMetricFamily("requests", "doc")
     fam.add_metric((), 1.0)  # sample name becomes requests_total
-    assert _flatten((fam,)) is None
-    # render_families still works via the fallback renderer.
-    assert b"requests_total" in render_families((fam,))
+    flat = _flatten((fam,))
+    assert flat is not None and flat[0][0] == "requests_total"
+    assert b"requests_total 1.0" in render_families((fam,))
+
+    # But a counter with a _created sibling sample needs the general
+    # renderer (two sample names in one family).
+    created = CounterMetricFamily("requests", "doc", created=time.time())
+    created.add_metric((), 1.0, created=time.time())
+    assert _flatten((created,)) is None
+    assert b"requests_total" in render_families((created,))
 
 
 def test_env_kill_switch(monkeypatch):

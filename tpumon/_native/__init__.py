@@ -130,16 +130,24 @@ def native_available() -> bool:
 def _flatten(families) -> list | None:
     """Metric-family objects → the plain structure the C renderer takes.
 
-    Returns None when a family needs the general renderer (samples whose
-    name differs from the family's, e.g. histogram/_total suffixes —
-    the exporter's poll loop only produces plain gauges, so this is a
-    safety valve, not a hot path).
+    Returns None when a family needs the general renderer (histogram
+    suffixes, sample timestamps, exemplars — the exporter's poll loop
+    only produces plain gauges and counters, so this is a safety valve,
+    not a hot path). Counters render under their text-format ``_total``
+    exposition name, matching prometheus_client byte-for-byte.
     """
     out = []
     for fam in families:
+        # Text exposition 0.0.4 names counters '<family>_total' in
+        # HELP/TYPE and on every sample line.
+        expo_name = fam.name + "_total" if fam.type == "counter" else fam.name
         samples = []
         for s in fam.samples:
-            if s.name != fam.name:
+            if s.name != expo_name:
+                return None
+            if getattr(s, "timestamp", None) is not None or getattr(
+                s, "exemplar", None
+            ):
                 return None
             # Sort label keys to match prometheus_client's renderer, so
             # native and fallback output are byte-identical.
@@ -147,7 +155,7 @@ def _flatten(families) -> list | None:
             keys = tuple(k for k, _ in items)
             vals = tuple(str(v) for _, v in items)
             samples.append((keys, vals, float(s.value)))
-        out.append((fam.name, fam.documentation, fam.type, samples))
+        out.append((expo_name, fam.documentation, fam.type, samples))
     return out
 
 

@@ -88,6 +88,9 @@ class Config:
     grpc_serve_port: int = -1
     #: Emit per-link ICI gauges (can be high-cardinality on big slices).
     ici_per_link: bool = True
+    #: Emit host context gauges (CPU/mem/load/net via psutil) next to the
+    #: device families for accelerator-symptom diagnosis.
+    host_metrics: bool = True
     #: Chip→pod attribution via the kubelet pod-resources API; degrades
     #: silently to absent off-cluster.
     pod_attribution: bool = True
@@ -120,6 +123,7 @@ class Config:
             grpc_timeout=_env_float("GRPC_TIMEOUT", base.grpc_timeout),
             grpc_serve_port=_env_int("GRPC_SERVE_PORT", base.grpc_serve_port),
             ici_per_link=_env_bool("ICI_PER_LINK", base.ici_per_link),
+            host_metrics=_env_bool("HOST_METRICS", base.host_metrics),
             pod_attribution=_env_bool("POD_ATTRIBUTION", base.pod_attribution),
             history_window=_env_float("HISTORY_WINDOW", base.history_window),
             history_max_samples=_env_int(

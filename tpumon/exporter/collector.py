@@ -87,11 +87,6 @@ class SampleCache:
         with self._lock:
             return self._rendered, self._version
 
-    @property
-    def version(self) -> int:
-        with self._lock:
-            return self._version
-
     def wait_newer(self, version: int, timeout: float) -> int:
         """Block until a publish newer than ``version`` lands (or timeout);
         returns the current version either way."""
@@ -250,6 +245,13 @@ def build_families(
             for core, state in states.items():
                 fam.add_metric(base_vals + (str(core), str(state)), 1.0)
             families.append(fam)
+
+    # Host context gauges (CPU/mem/load/net): the host-side-telemetry
+    # companion signals for diagnosing accelerator symptoms.
+    if cfg.host_metrics:
+        from tpumon.exporter.host import host_families
+
+        families.extend(host_families(base_keys, base_vals))
 
     # Derived health verdicts as scrapeable families (dcgmi-health
     # analogue): alerts can fire on the verdict without re-encoding the

@@ -27,7 +27,6 @@ from __future__ import annotations
 import logging
 
 from tpumon.backends.reflection import (
-    REFLECTION_METHOD,
     _encode_varint,
     _iter_fields,
     _len_field,
@@ -42,6 +41,12 @@ METHOD_WATCH = f"/{SERVICE_NAME}/Watch"
 #: Watch wakes up at least this often to notice a cancelled stream even
 #: when the poller has stalled.
 _WATCH_IDLE_TIMEOUT = 5.0
+
+#: Concurrent Watch streams admitted. Each stream parks a worker thread
+#: for its lifetime; capping below the pool size reserves workers so
+#: Get/reflection stay responsive no matter how many watchers connect.
+_MAX_WATCHERS = 12
+_POOL_WORKERS = 16
 
 
 def encode_page_response(page: bytes, version: int) -> bytes:
@@ -71,24 +76,35 @@ class MetricsGrpcServer:
     """
 
     def __init__(self, render_with_version, cache, addr: str, port: int) -> None:
+        import threading
+
         import grpc
         from concurrent.futures import ThreadPoolExecutor
 
         self._render_with_version = render_with_version
         self._cache = cache
+        watcher_slots = threading.BoundedSemaphore(_MAX_WATCHERS)
 
         def get(request: bytes, context):
             page, version = self._render_with_version()
             return encode_page_response(page, version)
 
         def watch(request: bytes, context):
-            version = 0
-            while context.is_active():
-                newer = cache.wait_newer(version, _WATCH_IDLE_TIMEOUT)
-                if newer == version:
-                    continue  # idle timeout: re-check liveness, don't spin
-                page, version = self._render_with_version()
-                yield encode_page_response(page, version)
+            if not watcher_slots.acquire(blocking=False):
+                context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    f"watcher limit ({_MAX_WATCHERS}) reached",
+                )
+            try:
+                version = 0
+                while context.is_active():
+                    newer = cache.wait_newer(version, _WATCH_IDLE_TIMEOUT)
+                    if newer == version:
+                        continue  # idle timeout: re-check liveness
+                    page, version = self._render_with_version()
+                    yield encode_page_response(page, version)
+            finally:
+                watcher_slots.release()
 
         def reflect(request_iterator, context):
             # list_services is the only query we answer; everything else
@@ -130,10 +146,15 @@ class MetricsGrpcServer:
                 )
             },
         )
-        # Each Watch stream parks its generator on a worker thread for the
-        # stream's lifetime — size the pool for watchers plus headroom so
-        # Get/reflection are not starved by a few long-lived consumers.
-        self._server = grpc.server(ThreadPoolExecutor(max_workers=16))
+        # Pool sized above the watcher cap so Get/reflection always have
+        # free workers. so_reuseport=0: without it a second server binds
+        # the SAME port successfully on Linux and the kernel round-robins
+        # clients between processes — the bind-conflict check below would
+        # never fire.
+        self._server = grpc.server(
+            ThreadPoolExecutor(max_workers=_POOL_WORKERS),
+            options=(("grpc.so_reuseport", 0),),
+        )
         self._server.add_generic_rpc_handlers(
             (metrics_handler, reflection_handler)
         )

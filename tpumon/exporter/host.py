@@ -1,0 +1,108 @@
+"""Host-side telemetry families (accelerator-diagnosis context).
+
+Per the host-side-telemetry literature on diagnosing accelerator
+performance from the host (CPU steal starving the input pipeline, memory
+pressure evicting the page cache, NIC saturation delaying DCN
+transfers), the DaemonSet exports a small set of host gauges next to the
+device families. This is deliberately NOT a node-exporter replacement —
+just the handful of signals that explain accelerator symptoms, carrying
+the same base identity labels so one PromQL join correlates them with
+per-chip metrics.
+
+psutil-backed; when psutil is missing every family is absent (the usual
+absent-not-zero stance), and the exporter keeps running.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
+
+log = logging.getLogger(__name__)
+
+#: family -> (kind, description, extra labels)
+HOST_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
+    "host_cpu_percent": (
+        "gauge",
+        "Host CPU utilization percent (all cores averaged) — input-pipeline "
+        "starvation context for accelerator duty dips",
+        (),
+    ),
+    "host_memory_used_bytes": (
+        "gauge",
+        "Host memory in use, bytes",
+        (),
+    ),
+    "host_memory_total_bytes": (
+        "gauge",
+        "Host memory total, bytes",
+        (),
+    ),
+    "host_load1": (
+        "gauge",
+        "1-minute load average",
+        (),
+    ),
+    "host_network_bytes_total": (
+        "counter",
+        "Host network bytes since boot by direction — DCN saturation "
+        "context for transfer-latency spikes",
+        ("dir",),
+    ),
+}
+
+
+def host_families(base_keys: tuple[str, ...], base_vals: tuple[str, ...]):
+    """Build the host gauge/counter families; [] when psutil is missing."""
+    try:
+        import psutil
+    except Exception:  # pragma: no cover - psutil is installed here
+        return []
+
+    out = []
+    try:
+        cpu = GaugeMetricFamily(
+            "host_cpu_percent",
+            HOST_FAMILIES["host_cpu_percent"][1],
+            labels=base_keys,
+        )
+        # interval=None: non-blocking delta since the previous poll cycle.
+        cpu.add_metric(base_vals, psutil.cpu_percent(interval=None))
+        out.append(cpu)
+
+        vm = psutil.virtual_memory()
+        used = GaugeMetricFamily(
+            "host_memory_used_bytes",
+            HOST_FAMILIES["host_memory_used_bytes"][1],
+            labels=base_keys,
+        )
+        used.add_metric(base_vals, float(vm.total - vm.available))
+        out.append(used)
+        total = GaugeMetricFamily(
+            "host_memory_total_bytes",
+            HOST_FAMILIES["host_memory_total_bytes"][1],
+            labels=base_keys,
+        )
+        total.add_metric(base_vals, float(vm.total))
+        out.append(total)
+
+        load1 = GaugeMetricFamily(
+            "host_load1", HOST_FAMILIES["host_load1"][1], labels=base_keys
+        )
+        load1.add_metric(base_vals, float(psutil.getloadavg()[0]))
+        out.append(load1)
+
+        nio = psutil.net_io_counters()
+        net = CounterMetricFamily(
+            "host_network_bytes",
+            HOST_FAMILIES["host_network_bytes_total"][1],
+            labels=base_keys + ("dir",),
+        )
+        net.add_metric(base_vals + ("tx",), float(nio.bytes_sent))
+        net.add_metric(base_vals + ("rx",), float(nio.bytes_recv))
+        out.append(net)
+    except Exception as exc:
+        # Any psutil hiccup degrades to fewer families, never a dead poll.
+        log.debug("host telemetry partial failure: %s", exc)
+    return out

@@ -98,6 +98,13 @@ WORKLOAD_FAMILIES: dict[str, str] = {
 }
 
 
+def host_family_rows() -> dict[str, tuple[str, str, tuple[str, ...]]]:
+    """Host-context families (declared next to their builder)."""
+    from tpumon.exporter.host import HOST_FAMILIES
+
+    return HOST_FAMILIES
+
+
 def all_family_names() -> set[str]:
     from tpumon.schema import LIBTPU_SPECS
 
@@ -107,4 +114,5 @@ def all_family_names() -> set[str]:
         | set(HEALTH_FAMILIES)
         | set(SELF_FAMILIES)
         | set(WORKLOAD_FAMILIES)
+        | set(host_family_rows())
     )

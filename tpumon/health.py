@@ -37,6 +37,12 @@ ICI_UNUSABLE = 10.0
 HBM_WARN_RATIO = 0.92
 HBM_CRIT_RATIO = 0.98
 COVERAGE_TARGET = 0.95
+#: Programs enqueued on a core while the whole device shows ~no compute —
+#: the wedged-runtime signature (work is queued but nothing executes).
+#: One poll can be a transient; the Prometheus alert adds a `for:`
+#: duration on top of this instantaneous check.
+QUEUE_STALL_DEPTH = 8.0
+QUEUE_STALL_DUTY_PCT = 1.0
 
 
 @dataclass(frozen=True)
@@ -108,6 +114,29 @@ def evaluate(snap: dict) -> list[Finding]:
                     f"ICI link {link} transient errors (score {score:.0f})",
                 )
             )
+
+    # Stall signature: deep HLO queues while the device does no work (the
+    # eACGM-style anomaly pairing of a load signal with a progress signal).
+    queues = snap.get("queues") or {}
+    if queues:
+        duties = [
+            row.get("duty_pct")
+            for row in snap.get("chips", {}).values()
+            if row.get("duty_pct") is not None
+        ]
+        device_idle = bool(duties) and max(duties) <= QUEUE_STALL_DUTY_PCT
+        if device_idle:
+            for core, depth in sorted(queues.items()):
+                if depth >= QUEUE_STALL_DEPTH:
+                    findings.append(
+                        Finding(
+                            WARN,
+                            "queue_stall",
+                            f"core {core} has {depth:.0f} programs queued "
+                            "while the device shows no compute "
+                            "(possible wedged runtime)",
+                        )
+                    )
 
     cov = snap.get("coverage")
     if cov is not None and cov < COVERAGE_TARGET:

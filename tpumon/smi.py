@@ -39,6 +39,7 @@ _F_HBM_USED = "accelerator_memory_used_bytes"
 _F_HBM_TOTAL = "accelerator_memory_total_bytes"
 _F_THROTTLE = "accelerator_throttle_score"
 _F_CORE_UTIL = "accelerator_core_utilization_percent"
+_F_QUEUE = "accelerator_queue_size"
 _F_ICI = "accelerator_interconnect_link_health"
 _F_INFO = "accelerator_info"
 _F_COUNT = "accelerator_device_count"
@@ -120,6 +121,12 @@ def snapshot_from_families(families) -> dict:
     if util is not None:
         for s in util.samples:
             snap["cores"][s.labels.get("core", "?")] = s.value
+
+    queue = fams.get(_F_QUEUE)
+    if queue is not None:
+        snap["queues"] = {
+            s.labels.get("core", "?"): s.value for s in queue.samples
+        }
 
     ici = fams.get(_F_ICI)
     if ici is not None:

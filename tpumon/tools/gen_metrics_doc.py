@@ -84,6 +84,23 @@ def render() -> str:
         label_s = ", ".join(f"`{l}`" for l in labels) or "—"
         lines.append(f"| `{name}` | {desc} | {label_s} |")
 
+    from tpumon.families import host_family_rows
+
+    lines += [
+        "",
+        "## Host context (accelerator-diagnosis companion signals)",
+        "",
+        "psutil-backed; absent when psutil is unavailable or",
+        "`TPUMON_HOST_METRICS=0`. Same base labels as the device families so",
+        "one PromQL join correlates host and chip symptoms.",
+        "",
+        "| family | type | description | extra labels |",
+        "|---|---|---|---|",
+    ]
+    for name, (kind, desc, labels) in host_family_rows().items():
+        label_s = ", ".join(f"`{l}`" for l in labels) or "—"
+        lines.append(f"| `{name}` | {kind} | {desc} | {label_s} |")
+
     lines += [
         "",
         "## Exporter self-telemetry",

@@ -1,11 +1,610 @@
-"""gRPC monitoring backend (SURVEY.md §3.3) — needs libtpu, so @tpu."""
+"""gRPC monitoring backend (SURVEY.md §3.3) — the DCGM-engine analogue.
+
+The heart of these tests is a **fake runtime monitoring server**: a real
+grpcio server speaking server reflection (list_services +
+file_containing_symbol) and a cloud-TPU-shaped ``RuntimeMetricService``,
+whose schema exists only as a ``descriptor_pb2.FileDescriptorProto``
+authored here — never as installed protos. The backend under test must
+discover the schema via reflection, build dynamic stubs, and read
+metrics over them (tpumon.backends.dynamic_stub), proving SURVEY §3.3's
+"subscribe/poll runtime metrics proto → merge into the same registry →
+dedupe with the SDK path" end to end with zero pre-shared protos.
+"""
 
 import pytest
 
-pytestmark = pytest.mark.tpu
+pytest.importorskip("grpc")
+
+from tpumon.backends.base import BackendError, RawMetric
+from tpumon.discovery.topology import Chip, Topology
+
+SERVICE = "tpu.monitoring.runtime.RuntimeMetricService"
+PKG = "tpu.monitoring.runtime"
 
 
-def test_grpc_backend_delegates_and_probes():
+# ---------------------------------------------------------------------------
+# Schema authoring: the test owns the service's FileDescriptorProto.
+# ---------------------------------------------------------------------------
+
+
+def _runtime_service_fdp():
+    from google.protobuf import descriptor_pb2
+
+    F = descriptor_pb2.FieldDescriptorProto
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = "tpu_metric_service_test.proto"
+    fdp.package = PKG
+    fdp.syntax = "proto3"
+
+    def msg(name):
+        m = fdp.message_type.add()
+        m.name = name
+        return m
+
+    def field(m, name, number, ftype, repeated=False, type_name=None):
+        f = m.field.add()
+        f.name = name
+        f.number = number
+        f.type = ftype
+        f.label = F.LABEL_REPEATED if repeated else F.LABEL_OPTIONAL
+        if type_name:
+            f.type_name = f".{PKG}.{type_name}"
+        return f
+
+    req = msg("MetricRequest")
+    field(req, "metric_name", 1, F.TYPE_STRING)
+
+    attrv = msg("AttrValue")
+    field(attrv, "int_attr", 1, F.TYPE_INT64)
+    field(attrv, "string_attr", 2, F.TYPE_STRING)
+
+    attr = msg("Attribute")
+    field(attr, "key", 1, F.TYPE_STRING)
+    field(attr, "value", 2, F.TYPE_MESSAGE, type_name="AttrValue")
+
+    gauge = msg("Gauge")
+    field(gauge, "as_int", 1, F.TYPE_INT64)
+    field(gauge, "as_double", 2, F.TYPE_DOUBLE)
+
+    metric = msg("Metric")
+    field(metric, "attribute", 1, F.TYPE_MESSAGE, repeated=True, type_name="Attribute")
+    field(metric, "gauge", 2, F.TYPE_MESSAGE, type_name="Gauge")
+
+    tpumetric = msg("TPUMetric")
+    field(tpumetric, "name", 1, F.TYPE_STRING)
+    field(tpumetric, "metrics", 2, F.TYPE_MESSAGE, repeated=True, type_name="Metric")
+
+    resp = msg("MetricResponse")
+    field(resp, "metric", 1, F.TYPE_MESSAGE, type_name="TPUMetric")
+
+    msg("ListSupportedMetricsRequest")
+
+    sm = msg("SupportedMetric")
+    field(sm, "metric_name", 1, F.TYPE_STRING)
+
+    lresp = msg("ListSupportedMetricsResponse")
+    field(lresp, "supported_metric", 1, F.TYPE_MESSAGE, repeated=True,
+          type_name="SupportedMetric")
+
+    svc = fdp.service.add()
+    svc.name = "RuntimeMetricService"
+    m1 = svc.method.add()
+    m1.name = "GetRuntimeMetric"
+    m1.input_type = f".{PKG}.MetricRequest"
+    m1.output_type = f".{PKG}.MetricResponse"
+    m2 = svc.method.add()
+    m2.name = "ListSupportedMetrics"
+    m2.input_type = f".{PKG}.ListSupportedMetricsRequest"
+    m2.output_type = f".{PKG}.ListSupportedMetricsResponse"
+    return fdp
+
+
+class FakeMonitoringServer:
+    """grpcio server: reflection + RuntimeMetricService over authored
+    descriptors. ``metrics`` maps server-side metric name → list of
+    (attrs dict, value) records."""
+
+    def __init__(self, metrics: dict) -> None:
+        import grpc
+        from concurrent.futures import ThreadPoolExecutor
+
+        from google.protobuf import message_factory
+
+        from tpumon.backends.dynamic_stub import build_pool
+        from tpumon.backends.reflection import _iter_fields, _len_field
+
+        self.metrics = metrics
+        self._fdp = _runtime_service_fdp()
+        fdp_bytes = self._fdp.SerializeToString()
+        pool = build_pool([fdp_bytes])
+        cls = lambda name: message_factory.GetMessageClass(  # noqa: E731
+            pool.FindMessageTypeByName(f"{PKG}.{name}")
+        )
+        MetricRequest = cls("MetricRequest")
+        MetricResponse = cls("MetricResponse")
+        ListResponse = cls("ListSupportedMetricsResponse")
+        self.get_calls = 0
+        self.reflection_calls = 0
+
+        def get_runtime_metric(request, context):
+            self.get_calls += 1
+            resp = MetricResponse()
+            records = self.metrics.get(request.metric_name)
+            if records is None:
+                return resp  # unknown metric → empty response, not error
+            tm = resp.metric
+            tm.name = request.metric_name
+            for attrs, value in records:
+                m = tm.metrics.add()
+                for k, v in attrs.items():
+                    a = m.attribute.add()
+                    a.key = k
+                    if isinstance(v, str):
+                        a.value.string_attr = v
+                    else:
+                        a.value.int_attr = int(v)
+                m.gauge.as_double = float(value)
+            return resp
+
+        def list_supported(request, context):
+            resp = ListResponse()
+            for name in sorted(self.metrics):
+                resp.supported_metric.add().metric_name = name
+            return resp
+
+        def reflect(request_iterator, context):
+            for req in request_iterator:
+                self.reflection_calls += 1
+                fields = {f: v for f, _, v in _iter_fields(req)}
+                if 7 in fields:  # list_services
+                    services = _len_field(1, _len_field(1, SERVICE.encode()))
+                    yield _len_field(6, services)
+                elif 6 in fields:  # file_containing_symbol
+                    symbol = fields[6].decode()
+                    if symbol.startswith(PKG):
+                        yield _len_field(4, _len_field(1, fdp_bytes))
+                    else:
+                        yield _len_field(7, _len_field(2, b"unknown symbol"))
+                else:
+                    yield _len_field(7, _len_field(2, b"unsupported query"))
+
+        svc_handler = grpc.method_handlers_generic_handler(
+            SERVICE,
+            {
+                "GetRuntimeMetric": grpc.unary_unary_rpc_method_handler(
+                    get_runtime_metric,
+                    request_deserializer=MetricRequest.FromString,
+                    response_serializer=lambda m: m.SerializeToString(),
+                ),
+                "ListSupportedMetrics": grpc.unary_unary_rpc_method_handler(
+                    list_supported,
+                    request_deserializer=lambda b: cls(
+                        "ListSupportedMetricsRequest"
+                    ).FromString(b),
+                    response_serializer=lambda m: m.SerializeToString(),
+                ),
+            },
+        )
+        refl_handler = grpc.method_handlers_generic_handler(
+            "grpc.reflection.v1alpha.ServerReflection",
+            {
+                "ServerReflectionInfo": grpc.stream_stream_rpc_method_handler(
+                    reflect, request_deserializer=None, response_serializer=None
+                )
+            },
+        )
+        self._server = grpc.server(ThreadPoolExecutor(max_workers=4))
+        self._server.add_generic_rpc_handlers((svc_handler, refl_handler))
+        self.port = self._server.add_insecure_port("127.0.0.1:0")
+        self._server.start()
+        self.addr = f"127.0.0.1:{self.port}"
+
+    def close(self) -> None:
+        self._server.stop(grace=0.2)
+
+
+CANNED = {
+    # SDK-style name served directly (PER_CHIP shape, device-id attrs).
+    "duty_cycle_pct": [
+        ({"device-id": 1}, 30.0),
+        ({"device-id": 0}, 20.0),
+    ],
+    # Runtime-style name → alias maps it onto hbm_capacity_usage.
+    "tpu.runtime.hbm.memory.usage.bytes": [
+        ({"device-id": 0}, 1024.0),
+        ({"device-id": 1}, 2048.0),
+    ],
+    "tpu.runtime.hbm.memory.total.bytes": [
+        ({"device-id": 0}, 4096.0),
+        ({"device-id": 1}, 4096.0),
+    ],
+    # Keyed shape: string attribute becomes the row key.
+    "ici_link_health": [
+        ({"link-id": "tray1.chip0.ici0.int"}, 0.0),
+        ({"link-id": "tray1.chip0.ici1.ext"}, 3.0),
+    ],
+}
+
+
+@pytest.fixture
+def fake_server():
+    server = FakeMonitoringServer(dict(CANNED))
+    yield server
+    server.close()
+
+
+@pytest.fixture
+def no_sdk(monkeypatch):
+    """Make the libtpu SDK unavailable, forcing grpc-only mode."""
+
+    class _Absent:
+        def __init__(self, *a, **k):
+            raise BackendError("libtpu SDK monkeypatched away")
+
+    monkeypatch.setattr(
+        "tpumon.backends.libtpu_backend.LibtpuBackend", _Absent
+    )
+
+
+@pytest.fixture
+def topo_file(tmp_path):
+    topo = Topology(
+        accelerator_type="v5litepod-4",
+        slice_name="testslice",
+        hostname="host0",
+        chips=(Chip(0), Chip(1)),
+    )
+    p = tmp_path / "topo.json"
+    p.write_text(topo.to_json())
+    return str(p)
+
+
+class FakeSdk:
+    """Stand-in LibtpuBackend for the merge/dedupe tests."""
+
+    name = "libtpu"
+
+    def __init__(self, topology_file=None):
+        self._topo = Topology(hostname="sdkhost", chips=(Chip(0),))
+
+    def list_metrics(self):
+        return ("duty_cycle_pct", "tensorcore_util")
+
+    def sample(self, name):
+        return RawMetric(name, ("5.00",))
+
+    def core_states(self):
+        return {}
+
+    def topology(self):
+        return self._topo
+
+    def version(self):
+        return "fake-sdk"
+
+    def close(self):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Reflection descriptor fetch + dynamic stub, standalone.
+# ---------------------------------------------------------------------------
+
+
+def test_file_containing_symbol_roundtrip(fake_server):
+    import grpc
+
+    from tpumon.backends.reflection import file_containing_symbol
+
+    channel = grpc.insecure_channel(fake_server.addr)
+    try:
+        blobs = file_containing_symbol(channel, SERVICE, timeout=5.0)
+        assert blobs, "expected at least the defining file"
+        from google.protobuf import descriptor_pb2
+
+        fdp = descriptor_pb2.FileDescriptorProto()
+        fdp.ParseFromString(blobs[0])
+        assert fdp.package == PKG
+        assert fdp.service[0].name == "RuntimeMetricService"
+        # Unknown symbol: well-formed error_response → [].
+        assert file_containing_symbol(channel, "no.such.Service", 5.0) == []
+    finally:
+        channel.close()
+
+
+def test_dynamic_stub_calls_typed_methods(fake_server):
+    import grpc
+
+    from tpumon.backends.dynamic_stub import build_stub, message_records
+
+    channel = grpc.insecure_channel(fake_server.addr)
+    try:
+        stub = build_stub(channel, SERVICE, timeout=5.0)
+        assert set(stub.methods) == {"GetRuntimeMetric", "ListSupportedMetrics"}
+
+        resp = stub.call("ListSupportedMetrics", timeout=5.0)
+        names = {a["metric_name"] for a, _ in message_records(resp)}
+        assert names == set(CANNED)
+
+        resp = stub.call(
+            "GetRuntimeMetric", timeout=5.0, metric_name="duty_cycle_pct"
+        )
+        records = message_records(resp)
+        assert ({"device-id": 0}, 20.0) in records
+        assert ({"device-id": 1}, 30.0) in records
+    finally:
+        channel.close()
+
+
+def test_build_stub_unreachable_raises():
+    import grpc
+
+    from tpumon.backends.dynamic_stub import StubBuildError, build_stub
+
+    channel = grpc.insecure_channel("127.0.0.1:1")  # nothing listens
+    try:
+        with pytest.raises(StubBuildError):
+            build_stub(channel, SERVICE, timeout=0.3)
+    finally:
+        channel.close()
+
+
+# ---------------------------------------------------------------------------
+# The backend: grpc-only mode (SDK absent — the VERDICT r1 done-criterion).
+# ---------------------------------------------------------------------------
+
+
+def test_grpc_only_mode_reads_metrics_over_grpc(fake_server, no_sdk, topo_file):
+    from tpumon.backends.grpc_backend import GrpcMonitoringBackend
+
+    be = GrpcMonitoringBackend(
+        addr=fake_server.addr, timeout=5.0, topology_file=topo_file
+    )
+    try:
+        names = be.list_metrics()
+        # Alias folded runtime-style names into the unified namespace.
+        assert "hbm_capacity_usage" in names
+        assert "hbm_capacity_total" in names
+        assert "duty_cycle_pct" in names
+        assert "tpu.runtime.hbm.memory.usage.bytes" not in names
+        assert all(src == "grpc" for src in be.sources().values())
+
+        # PER_CHIP: device-id attrs sort the rows into chip order.
+        raw = be.sample("duty_cycle_pct")
+        assert raw.data == ("20.0", "30.0")
+
+        raw = be.sample("hbm_capacity_usage")
+        assert raw.data == ("1024.0", "2048.0")
+
+        # KEYED: string attr becomes the "key: value" row form.
+        raw = be.sample("ici_link_health")
+        assert "tray1.chip0.ici0.int: 0.0" in raw.data
+        assert "tray1.chip0.ici1.ext: 3.0" in raw.data
+
+        # Topology came from the file, not the SDK.
+        assert be.topology().slice_name == "testslice"
+        assert fake_server.get_calls >= 3
+    finally:
+        be.close()
+
+
+def test_grpc_only_unknown_metric_is_absent_not_error(fake_server, no_sdk, topo_file):
+    from tpumon.backends.grpc_backend import GrpcMonitoringBackend
+
+    be = GrpcMonitoringBackend(
+        addr=fake_server.addr, timeout=5.0, topology_file=topo_file
+    )
+    try:
+        be.list_metrics()
+        # Server answers an empty MetricResponse → SURVEY §2.2
+        # absent-not-zero, same as the SDK's runtime-detached state.
+        raw = be._grpc_sample("duty_cycle_pct")
+        assert not raw.empty
+        del fake_server.metrics["duty_cycle_pct"]
+        raw = be._grpc_sample("duty_cycle_pct")
+        assert raw.empty
+    finally:
+        be.close()
+
+
+def test_grpc_only_no_server_raises_backend_error(no_sdk, topo_file):
+    from tpumon.backends.grpc_backend import GrpcMonitoringBackend
+
+    be = GrpcMonitoringBackend(
+        addr="127.0.0.1:1", timeout=0.3, topology_file=topo_file
+    )
+    try:
+        with pytest.raises(BackendError):
+            be.list_metrics()
+        with pytest.raises(BackendError):
+            be.sample("duty_cycle_pct")
+    finally:
+        be.close()
+
+
+def test_stub_build_failure_is_throttled(no_sdk, topo_file):
+    from tpumon.backends import grpc_backend as mod
+
+    be = mod.GrpcMonitoringBackend(
+        addr="127.0.0.1:1", timeout=0.3, topology_file=topo_file
+    )
+    try:
+        assert be._ensure_stub() is None
+        first_failure = be._stub_failed_at
+        assert first_failure is not None
+        # Within the retry window the backend must not re-dial reflection.
+        assert be._ensure_stub() is None
+        assert be._stub_failed_at == first_failure
+    finally:
+        be.close()
+
+
+def test_records_to_rows_id_attr_wins_over_aux_strings():
+    """An id-named int attribute keeps PER_CHIP routing even when the
+    runtime attaches auxiliary string attributes (units etc.)."""
+    from tpumon.backends.grpc_backend import _records_to_rows
+
+    rows = _records_to_rows(
+        [
+            ({"device-id": 1, "unit": "percent"}, 30.0),
+            ({"device-id": 0, "unit": "percent"}, 20.0),
+        ]
+    )
+    assert rows == ("20.0", "30.0")
+
+
+def test_record_list_depth_beats_declaration_order():
+    """A shallow trailing repeated field (warnings) must not shadow the
+    deeper record list (metric.metrics)."""
+    from google.protobuf import descriptor_pb2, message_factory
+
+    from tpumon.backends.dynamic_stub import build_pool, message_records
+
+    F = descriptor_pb2.FieldDescriptorProto
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = "depth_test.proto"
+    fdp.package = "depthtest"
+    fdp.syntax = "proto3"
+
+    rec = fdp.message_type.add()
+    rec.name = "Rec"
+    f = rec.field.add()
+    f.name, f.number, f.type, f.label = "gauge_value", 1, F.TYPE_DOUBLE, 1
+
+    warn = fdp.message_type.add()
+    warn.name = "Warning"
+    f = warn.field.add()
+    f.name, f.number, f.type, f.label = "text", 1, F.TYPE_STRING, 1
+
+    inner = fdp.message_type.add()
+    inner.name = "Inner"
+    f = inner.field.add()
+    f.name, f.number, f.type, f.label = "metrics", 1, F.TYPE_MESSAGE, 3
+    f.type_name = ".depthtest.Rec"
+
+    outer = fdp.message_type.add()
+    outer.name = "Resp"
+    f = outer.field.add()
+    f.name, f.number, f.type, f.label = "metric", 1, F.TYPE_MESSAGE, 1
+    f.type_name = ".depthtest.Inner"
+    f = outer.field.add()
+    f.name, f.number, f.type, f.label = "warnings", 2, F.TYPE_MESSAGE, 3
+    f.type_name = ".depthtest.Warning"
+
+    pool = build_pool([fdp.SerializeToString()])
+    Resp = message_factory.GetMessageClass(
+        pool.FindMessageTypeByName("depthtest.Resp")
+    )
+    msg = Resp()
+    msg.metric.metrics.add().gauge_value = 42.0
+    msg.warnings.add().text = "transient"
+    records = message_records(msg)
+    assert records == [({}, 42.0)]
+
+
+def test_stub_dropped_after_consecutive_call_failures(fake_server, no_sdk, topo_file):
+    """A schema change under a live exporter (runtime restart) must not
+    permanently kill the grpc transport: after N consecutive call
+    failures the cached stub is dropped for a throttled rebuild."""
+    from tpumon.backends import grpc_backend as mod
+    from tpumon.backends.grpc_backend import GrpcMonitoringBackend
+
+    be = GrpcMonitoringBackend(
+        addr=fake_server.addr, timeout=5.0, topology_file=topo_file
+    )
+    try:
+        be.list_metrics()
+        assert be._stub is not None
+
+        class _Boom:
+            def __call__(self, *a, **k):
+                raise RuntimeError("UNIMPLEMENTED: schema changed")
+
+        for m in be._stub.methods.values():
+            m._callable = _Boom()
+        for _ in range(mod._STUB_FAILURE_LIMIT):
+            with pytest.raises(BackendError):
+                be._grpc_sample("duty_cycle_pct")
+        assert be._stub is None  # dropped for rebuild
+        assert be._stub_failed_at is not None  # rebuild is throttled
+    finally:
+        be.close()
+
+
+# ---------------------------------------------------------------------------
+# Merge + dedupe with the SDK path (SURVEY §3.3).
+# ---------------------------------------------------------------------------
+
+
+def test_merge_dedupe_sdk_primary_grpc_fills_gaps(fake_server, monkeypatch):
+    monkeypatch.setattr(
+        "tpumon.backends.libtpu_backend.LibtpuBackend", FakeSdk
+    )
+    from tpumon.backends.grpc_backend import GrpcMonitoringBackend
+
+    be = GrpcMonitoringBackend(addr=fake_server.addr, timeout=5.0)
+    try:
+        names = be.list_metrics()
+        # Each unified name exactly once (the dedupe contract).
+        assert len(names) == len(set(names))
+        sources = be.sources()
+        # duty_cycle_pct is in BOTH lists → SDK wins (primary transport).
+        assert sources["duty_cycle_pct"] == "sdk"
+        assert sources["tensorcore_util"] == "sdk"
+        # The service-only metrics route over gRPC.
+        assert sources["hbm_capacity_usage"] == "grpc"
+        assert sources["ici_link_health"] == "grpc"
+
+        assert be.sample("duty_cycle_pct").data == ("5.00",)  # FakeSdk row
+        assert be.sample("hbm_capacity_usage").data == ("1024.0", "2048.0")
+    finally:
+        be.close()
+
+
+def test_merged_backend_builds_unified_families(fake_server, monkeypatch):
+    """End to end: both transports land in the same registry under the
+    unified accelerator_* schema, each family once (SURVEY §3.3)."""
+    monkeypatch.setattr(
+        "tpumon.backends.libtpu_backend.LibtpuBackend", FakeSdk
+    )
+    from tpumon.backends.grpc_backend import GrpcMonitoringBackend
+    from tpumon.config import Config
+    from tpumon.exporter.collector import build_families
+
+    be = GrpcMonitoringBackend(addr=fake_server.addr, timeout=5.0)
+    try:
+        families, stats = build_families(be, Config(host_metrics=False))
+        by_name = {}
+        for fam in families:
+            assert fam.name not in by_name, f"family {fam.name} duplicated"
+            by_name[fam.name] = fam
+        # SDK-sourced family:
+        assert "accelerator_duty_cycle_percent" in by_name
+        # gRPC-sourced families (alias + keyed):
+        assert "accelerator_memory_used_bytes" in by_name
+        assert "accelerator_interconnect_link_health" in by_name
+        used = by_name["accelerator_memory_used_bytes"].samples
+        assert sorted(s.value for s in used) == [1024.0, 2048.0]
+    finally:
+        be.close()
+
+
+def test_grpc_service_config_knob(monkeypatch):
+    monkeypatch.setenv("TPUMON_GRPC_SERVICE", "my.custom.MetricService")
+    from tpumon.config import Config
+
+    assert Config.from_env().grpc_service == "my.custom.MetricService"
+    cfg = Config.load(["--grpc-service", "cli.wins.Service"])
+    assert cfg.grpc_service == "cli.wins.Service"
+
+
+# ---------------------------------------------------------------------------
+# Real-device path (unchanged contract: probe + SDK delegation on-host).
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tpu
+def test_grpc_backend_on_host_delegates_and_probes():
     from tpumon.backends.grpc_backend import GrpcMonitoringBackend
 
     be = GrpcMonitoringBackend(addr="localhost:8431", timeout=0.5)
@@ -16,12 +615,13 @@ def test_grpc_backend_delegates_and_probes():
         # Idle host: the runtime monitoring service is down → unreachable,
         # and that must be a clean False, not an exception (SURVEY §2.2).
         assert be.service_reachable() in (True, False)
+        # Every SDK metric routes sdk; gRPC adds nothing on an idle host.
+        assert set(be.sources().values()) <= {"sdk", "grpc"}
     finally:
         be.close()
 
 
 def test_nvml_backend_absent_raises_cleanly():
-    from tpumon.backends.base import BackendError
     from tpumon.backends.nvml_backend import NvmlBackend
 
     try:

@@ -127,8 +127,6 @@ def test_grpc_backend_services_method(reflection_server, monkeypatch):
     from tpumon.backends.grpc_backend import GrpcMonitoringBackend
 
     # Avoid the real libtpu delegate: patch LibtpuBackend constructor use.
-    import tpumon.backends.grpc_backend as gb
-
     class _StubDelegate:
         def __init__(self, *a, **k):
             pass
@@ -136,7 +134,9 @@ def test_grpc_backend_services_method(reflection_server, monkeypatch):
         def close(self):
             pass
 
-    monkeypatch.setattr(gb, "LibtpuBackend", _StubDelegate)
+    monkeypatch.setattr(
+        "tpumon.backends.libtpu_backend.LibtpuBackend", _StubDelegate
+    )
     backend = GrpcMonitoringBackend(addr=reflection_server, timeout=5.0)
     try:
         assert backend.service_reachable()

@@ -38,6 +38,7 @@ def create_backend(cfg: Config) -> Backend:
             addr=cfg.grpc_addr,
             timeout=cfg.grpc_timeout,
             topology_file=cfg.topology_file,
+            service=cfg.grpc_service,
         )
     if kind == "fake":
         from tpumon.backends.fake import FakeTpuBackend

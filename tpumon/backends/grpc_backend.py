@@ -3,28 +3,110 @@
 The libtpu runtime hosts a local monitoring gRPC service (observed live on
 127.0.0.1:8431 — ``tpuz.get_core_state_summary`` dials it and gets
 ``Connection refused`` when no runtime is attached, SURVEY.md §2.2). Its
-proto surface is not shipped in this environment, so this backend:
+protos are not shipped in this environment, so the metric transport is
+built **from the server's own schema at runtime** (SURVEY.md §7 hard part
+(c), solved rather than sidestepped):
 
-1. Probes channel reachability itself (``service_reachable`` → the
-   ``exporter_grpc_service_up`` signal and /healthz detail), and
-2. Delegates metric reads to the libtpu SDK, which is a client of the same
-   service — keeping coverage accounting honest (SURVEY.md §7 hard part (c):
-   'degrade gracefully to the SDK path') while still exercising the
-   process-boundary the DCGM path implies.
+1. reflection ``file_containing_symbol`` fetches the service's serialized
+   descriptors (:mod:`tpumon.backends.reflection`);
+2. :mod:`tpumon.backends.dynamic_stub` assembles them into a descriptor
+   pool and materializes callable unary stubs;
+3. metric enumeration and reads go over those stubs, with responses
+   flattened generically into the SDK's per-row string-vector form.
 
-When the protos become available, ``sample`` can switch to direct stubs
-without touching the exporter core (same Backend protocol).
+Merge-and-dedupe with the SDK path (SURVEY.md §3.3 "merge into the same
+registry … dedupe so coverage counts each metric once"): the libtpu SDK —
+itself a client of this same service — remains the primary source for
+every metric it lists; the gRPC stub serves metrics the SDK does *not*
+list (the "SDK surface lags the service" case) and becomes the sole
+transport when the SDK is absent entirely. ``sources()`` exposes the
+per-metric routing for doctor/coverage accounting, and each unified name
+appears exactly once in ``list_metrics()``.
+
+When neither reflection nor the SDK is available the backend degrades to
+the documented delegation-only behavior (reachability probing still works,
+sampling raises BackendError).
 """
 
 from __future__ import annotations
 
 import logging
+import time
 
 from tpumon.backends.base import BackendError, RawMetric
-from tpumon.backends.libtpu_backend import LibtpuBackend
-from tpumon.discovery.topology import Topology
+from tpumon.discovery.topology import Topology, discover
 
 log = logging.getLogger(__name__)
+
+#: Full name of the runtime monitoring service to resolve via reflection.
+#: The cloud-TPU runtime's public surface (tpu-info genre) names it
+#: ``tpu.monitoring.runtime.RuntimeMetricService``; overridable for other
+#: runtimes/tests via TPUMON_GRPC_SERVICE / --grpc-service.
+DEFAULT_SERVICE = "tpu.monitoring.runtime.RuntimeMetricService"
+
+#: Best-effort aliases: runtime gRPC metric names → libtpu SDK names, so
+#: the same unified ``accelerator_*`` family is produced whichever
+#: transport served the sample (dedupe requires one namespace).
+GRPC_METRIC_ALIASES: dict[str, str] = {
+    "tpu.runtime.hbm.memory.total.bytes": "hbm_capacity_total",
+    "tpu.runtime.hbm.memory.usage.bytes": "hbm_capacity_usage",
+    "tpu.runtime.tensorcore.dutycycle.percent": "duty_cycle_pct",
+}
+
+#: After a stub build fails, wait this long before re-dialing reflection
+#: (the 1 Hz poll loop calls list_metrics every second; a dead runtime
+#: must not eat a reflection round-trip per poll).
+_STUB_RETRY_SECONDS = 30.0
+
+#: Consecutive stub-call failures after which the cached stub is dropped
+#: and rebuilt from reflection — a runtime restart can change the schema
+#: out from under a long-running exporter, and a stale stub would
+#: otherwise fail every poll for the life of the process.
+_STUB_FAILURE_LIMIT = 3
+
+
+def _records_to_rows(records) -> tuple[str, ...]:
+    """(attrs, value) records → the SDK's per-row string vector.
+
+    - records carrying one integer-like attribute (device/chip/core id)
+      sort by it and emit plain value strings — the PER_CHIP/PER_CORE
+      wire shape;
+    - records carrying a string attribute emit ``"key: value"`` — the
+      KEYED wire shape;
+    - a bare single record emits just the value.
+
+    Records with no numeric value are dropped (a metric row without a
+    measurement carries nothing for the parser).
+    """
+    id_hints = ("device", "chip", "core", "index", "id")
+    rows: list[tuple[object, str]] = []
+    for attrs, value in records:
+        if value is None:
+            continue
+        int_attrs = [
+            (k, v)
+            for k, v in attrs.items()
+            if isinstance(v, int) and not isinstance(v, bool)
+        ]
+        # An id-named integer attribute wins even when auxiliary string
+        # attributes (units, descriptions) ride along — otherwise a
+        # PER_CHIP metric would mis-render as "percent: 20.0" keyed rows.
+        id_attrs = [
+            (k, v)
+            for k, v in int_attrs
+            if any(h in k.lower() for h in id_hints)
+        ]
+        str_attrs = [(k, v) for k, v in attrs.items() if isinstance(v, str) and v]
+        if len(id_attrs) == 1:
+            rows.append((id_attrs[0][1], str(value)))
+        elif len(int_attrs) == 1 and not str_attrs:
+            rows.append((int_attrs[0][1], str(value)))
+        elif str_attrs:
+            rows.append((str_attrs[0][1], f"{str_attrs[0][1]}: {value}"))
+        else:
+            rows.append((len(rows), str(value)))
+    rows.sort(key=lambda r: (isinstance(r[0], str), r[0]))
+    return tuple(text for _, text in rows)
 
 
 class GrpcMonitoringBackend:
@@ -35,10 +117,21 @@ class GrpcMonitoringBackend:
         addr: str = "localhost:8431",
         timeout: float = 2.0,
         topology_file: str | None = None,
+        service: str = DEFAULT_SERVICE,
     ) -> None:
         self.addr = addr
         self.timeout = timeout
+        self.service = service
+        self._topology_file = topology_file
         self._channel = None
+        self._stub = None
+        self._stub_failed_at: float | None = None
+        self._stub_call_failures = 0
+        self._list_method: str | None = None
+        self._get_method: str | None = None
+        self._sources: dict[str, str] = {}
+        #: unified SDK-style name → the server's own metric name.
+        self._grpc_names: dict[str, str] = {}
         try:
             import grpc
 
@@ -47,8 +140,19 @@ class GrpcMonitoringBackend:
         except Exception as exc:
             log.warning("grpcio unavailable (%s); reachability checks off", exc)
             self._grpc = None
-        # The SDK rides the same service; it is the metric transport.
-        self._delegate = LibtpuBackend(topology_file)
+        # The SDK rides the same service; it stays the primary transport
+        # for every metric it lists (merge/dedupe contract above). Its
+        # absence switches the backend to grpc-only mode, not failure.
+        self._delegate = None
+        self._topology: Topology | None = None
+        try:
+            from tpumon.backends.libtpu_backend import LibtpuBackend
+
+            self._delegate = LibtpuBackend(topology_file)
+        except BackendError as exc:
+            log.info("libtpu SDK unavailable (%s); grpc-only mode", exc)
+
+    # -- probes -----------------------------------------------------------
 
     def grpc_available(self) -> bool:
         """False when grpcio itself is missing (vs the service being down)."""
@@ -75,17 +179,195 @@ class GrpcMonitoringBackend:
 
         return list_services(self._channel, self.timeout)
 
+    # -- dynamic stub -----------------------------------------------------
+
+    def _ensure_stub(self):
+        """Build (or return) the reflection-derived stub; None when the
+        service/schema is unavailable (retry throttled to avoid a
+        reflection dial per 1 Hz poll)."""
+        if self._stub is not None:
+            return self._stub
+        if self._channel is None:
+            return None
+        now = time.monotonic()
+        if (
+            self._stub_failed_at is not None
+            and now - self._stub_failed_at < _STUB_RETRY_SECONDS
+        ):
+            return None
+        from tpumon.backends.dynamic_stub import StubBuildError, build_stub
+
+        try:
+            stub = build_stub(self._channel, self.service, self.timeout)
+        except StubBuildError as exc:
+            log.debug("monitoring stub unavailable: %s", exc)
+            self._stub_failed_at = now
+            return None
+        self._list_method = self._pick_method(stub, want_list=True)
+        self._get_method = self._pick_method(stub, want_list=False)
+        if self._get_method is None:
+            log.warning(
+                "service %s has no metric-read method (methods: %s)",
+                self.service,
+                sorted(stub.methods),
+            )
+            self._stub_failed_at = now
+            return None
+        self._stub = stub
+        self._stub_failed_at = None
+        self._stub_call_failures = 0
+        log.info(
+            "monitoring stub built from reflection: %s (list=%s get=%s)",
+            self.service,
+            self._list_method,
+            self._get_method,
+        )
+        return stub
+
+    def _note_stub_call(self, ok: bool) -> None:
+        """Track consecutive stub-call failures; drop the cached stub
+        after _STUB_FAILURE_LIMIT so the (throttled) rebuild path can
+        re-resolve a schema that changed under us (runtime restart)."""
+        if ok:
+            self._stub_call_failures = 0
+            return
+        self._stub_call_failures += 1
+        if self._stub is not None and (
+            self._stub_call_failures >= _STUB_FAILURE_LIMIT
+        ):
+            log.warning(
+                "dropping monitoring stub after %d consecutive call "
+                "failures; will rebuild from reflection",
+                self._stub_call_failures,
+            )
+            self._stub = None
+            self._stub_failed_at = time.monotonic()
+            self._stub_call_failures = 0
+
+    @staticmethod
+    def _pick_method(stub, want_list: bool) -> str | None:
+        for name in sorted(stub.methods):
+            lname = name.lower()
+            if "metric" not in lname:
+                continue
+            if want_list == ("list" in lname or "supported" in lname):
+                return name
+        return None
+
+    @staticmethod
+    def _request_name_field(method) -> str | None:
+        """The request field carrying the metric name: ``metric_name``
+        preferred, else the first string field."""
+        desc = method.request_class.DESCRIPTOR
+        for field in desc.fields:
+            if field.name == "metric_name":
+                return field.name
+        for field in desc.fields:
+            if field.type == field.TYPE_STRING:
+                return field.name
+        return None
+
+    def _grpc_list(self) -> dict[str, str]:
+        """Enumerate the service's metrics → {unified name: server name}."""
+        stub = self._ensure_stub()
+        if stub is None or self._list_method is None:
+            return {}
+        from tpumon.backends.dynamic_stub import message_records
+
+        try:
+            resp = stub.call(self._list_method, timeout=self.timeout)
+        except Exception as exc:
+            log.debug("grpc %s failed: %s", self._list_method, exc)
+            self._note_stub_call(ok=False)
+            return {}
+        self._note_stub_call(ok=True)
+        names: dict[str, str] = {}
+        for attrs, _ in message_records(resp):
+            name = next(
+                (v for v in attrs.values() if isinstance(v, str) and v), None
+            )
+            if name:
+                names[GRPC_METRIC_ALIASES.get(name, name)] = name
+        return names
+
+    def _grpc_sample(self, unified: str) -> RawMetric:
+        stub = self._ensure_stub()
+        if stub is None or self._get_method is None:
+            raise BackendError(
+                f"monitoring service stub unavailable for {unified}"
+            )
+        from tpumon.backends.dynamic_stub import message_records
+
+        server_name = self._grpc_names.get(unified, unified)
+        method = stub.methods[self._get_method]
+        name_field = self._request_name_field(method)
+        fields = {name_field: server_name} if name_field else {}
+        try:
+            resp = stub.call(self._get_method, timeout=self.timeout, **fields)
+        except Exception as exc:
+            self._note_stub_call(ok=False)
+            raise BackendError(
+                f"grpc {self._get_method}({server_name}) failed: {exc}"
+            ) from exc
+        self._note_stub_call(ok=True)
+        return RawMetric(unified, _records_to_rows(message_records(resp)))
+
+    # -- Backend protocol -------------------------------------------------
+
     def list_metrics(self) -> tuple[str, ...]:
-        return self._delegate.list_metrics()
+        """Union of SDK metrics and gRPC-only metrics, each name once.
+
+        SDK names keep SDK routing (primary path); names only the service
+        lists route to the stub. Routing is exposed via :meth:`sources`.
+        """
+        sdk_names: tuple[str, ...] = ()
+        if self._delegate is not None:
+            sdk_names = self._delegate.list_metrics()
+        grpc_names = self._grpc_list()
+        self._grpc_names = grpc_names
+        sources = {name: "sdk" for name in sdk_names}
+        merged = list(sdk_names)
+        for name in grpc_names:
+            if name not in sources:
+                sources[name] = "grpc"
+                merged.append(name)
+        self._sources = sources
+        if not merged and self._delegate is None:
+            raise BackendError(
+                "no metric source: libtpu SDK absent and monitoring "
+                f"service at {self.addr} unavailable"
+            )
+        return tuple(merged)
+
+    def sources(self) -> dict[str, str]:
+        """Per-metric transport routing from the last list_metrics():
+        unified name → 'sdk' | 'grpc' (the dedupe accounting surface)."""
+        return dict(self._sources)
 
     def sample(self, name: str) -> RawMetric:
-        return self._delegate.sample(name)
+        source = self._sources.get(name)
+        if source == "grpc":
+            return self._grpc_sample(name)
+        if source == "sdk" or self._delegate is not None:
+            return self._delegate.sample(name)
+        return self._grpc_sample(name)
+
+    def core_states(self) -> dict[str, str]:
+        if self._delegate is not None:
+            return self._delegate.core_states()
+        return {}
 
     def topology(self) -> Topology:
-        return self._delegate.topology()
+        if self._delegate is not None:
+            return self._delegate.topology()
+        if self._topology is None:
+            self._topology = discover(self._topology_file)
+        return self._topology
 
     def version(self) -> str:
-        return self._delegate.version()
+        if self._delegate is not None:
+            return self._delegate.version()
+        return f"grpc:{self.service}"
 
     def close(self) -> None:
         if self._channel is not None:
@@ -93,7 +375,13 @@ class GrpcMonitoringBackend:
                 self._channel.close()
             except Exception:
                 pass
-        self._delegate.close()
+        if self._delegate is not None:
+            self._delegate.close()
 
 
-__all__ = ["GrpcMonitoringBackend", "BackendError"]
+__all__ = [
+    "GrpcMonitoringBackend",
+    "BackendError",
+    "DEFAULT_SERVICE",
+    "GRPC_METRIC_ALIASES",
+]

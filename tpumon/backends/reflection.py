@@ -1,23 +1,31 @@
-"""Hand-rolled gRPC server-reflection client (list-services only).
+"""Hand-rolled gRPC server-reflection client.
 
 The cloud-TPU runtime hosts its monitoring gRPC service locally
 (127.0.0.1:8431, SURVEY.md §2.2) but its protos are not shipped in this
 environment — and neither is ``grpcio-reflection``. The reflection
-protocol itself, though, is tiny for the one call we need: a
-bidi-streaming ``ServerReflectionInfo`` where the request sets
-``list_services`` (field 7) and the response carries
-``list_services_response.service[].name`` (fields 6 → 1 → 1). This module
-encodes/decodes exactly that with a ~40-line varint codec — the same
-no-proto approach as ``tpumon/attribution/podresources_pb2.py``.
+protocol itself, though, is tiny for the two calls we need, both over the
+bidi-streaming ``ServerReflectionInfo`` method:
 
-Used by the grpc backend and doctor to report *which* services the
-runtime's monitoring endpoint actually exposes, turning the boolean
-"port open" probe into real service discovery.
+- ``list_services`` (request field 7) → service names
+  (``list_services_response.service[].name``, fields 6 → 1 → 1);
+- ``file_containing_symbol`` (request field 6) → the serialized
+  ``FileDescriptorProto`` set defining a symbol
+  (``file_descriptor_response.file_descriptor_proto``, fields 4 → 1) —
+  the input :mod:`tpumon.backends.dynamic_stub` turns into callable
+  method stubs at runtime, which is how the grpc backend reads metrics
+  from a service whose protos were never installed (SURVEY.md §3.3,
+  §7 hard part (c)).
+
+This module encodes/decodes exactly that with a ~40-line varint codec —
+the same no-proto approach as ``tpumon/attribution/podresources_pb2.py``.
 
 Wire reference (public grpc reflection.proto, v1alpha):
 
-    ServerReflectionRequest  { host=1; ... list_services=7; }
-    ServerReflectionResponse { ... list_services_response=6; error_response=7 }
+    ServerReflectionRequest  { host=1; file_containing_symbol=6;
+                               list_services=7; }
+    ServerReflectionResponse { file_descriptor_response=4;
+                               list_services_response=6; error_response=7 }
+    FileDescriptorResponse   { repeated bytes file_descriptor_proto=1; }
     ListServiceResponse      { repeated ServiceResponse service=1; }
     ServiceResponse          { name=1; }
 """
@@ -94,6 +102,58 @@ def _iter_fields(data: bytes):
 def encode_list_services_request() -> bytes:
     """ServerReflectionRequest{list_services: "*"} (field 7, string)."""
     return _len_field(7, b"*")
+
+
+def encode_file_containing_symbol_request(symbol: str) -> bytes:
+    """ServerReflectionRequest{file_containing_symbol: symbol} (field 6)."""
+    return _len_field(6, symbol.encode("utf-8"))
+
+
+def decode_file_descriptor_response(data: bytes) -> list[bytes]:
+    """ServerReflectionResponse → serialized FileDescriptorProto blobs.
+
+    [] when the response is an error_response (unknown symbol) or carries
+    no descriptors — both well-formed protocol outcomes.
+    """
+    blobs: list[bytes] = []
+    for field, wire, value in _iter_fields(data):
+        if field == 4 and wire == 2:  # file_descriptor_response
+            for f2, w2, fdp in _iter_fields(value):
+                if f2 == 1 and w2 == 2:  # file_descriptor_proto (bytes)
+                    blobs.append(fdp)
+    return blobs
+
+
+def file_containing_symbol(
+    channel, symbol: str, timeout: float = 2.0
+) -> list[bytes] | None:
+    """Fetch the FileDescriptorProto set defining ``symbol`` (a service or
+    message full name) via reflection.
+
+    Returns the serialized blobs (the defining file plus any transitive
+    dependencies the server chooses to include), [] when the server
+    answered but doesn't know the symbol, None when the server is
+    unreachable / doesn't speak reflection.
+    """
+    try:
+        call = channel.stream_stream(
+            REFLECTION_METHOD,
+            request_serializer=None,
+            response_deserializer=None,
+        )
+        responses = call(
+            iter([encode_file_containing_symbol_request(symbol)]),
+            timeout=timeout,
+        )
+        try:
+            for raw in responses:
+                return decode_file_descriptor_response(raw)
+            return []
+        finally:
+            responses.cancel()
+    except Exception as exc:
+        log.debug("reflection file_containing_symbol(%s) failed: %s", symbol, exc)
+        return None
 
 
 def decode_list_services_response(data: bytes) -> list[str]:

@@ -83,6 +83,9 @@ class Config:
     grpc_addr: str = "localhost:8431"
     #: gRPC request timeout in seconds.
     grpc_timeout: float = 2.0
+    #: Full name of the runtime monitoring gRPC service to resolve via
+    #: server reflection (the dynamic-stub metric transport).
+    grpc_service: str = "tpu.monitoring.runtime.RuntimeMetricService"
     #: Serve the exporter's own gRPC metrics service (Get/Watch +
     #: reflection) on this port; -1 disables, 0 binds an ephemeral port.
     grpc_serve_port: int = -1
@@ -121,6 +124,8 @@ class Config:
             or base.fake_topology,
             grpc_addr=_env("GRPC_ADDR", base.grpc_addr) or base.grpc_addr,
             grpc_timeout=_env_float("GRPC_TIMEOUT", base.grpc_timeout),
+            grpc_service=_env("GRPC_SERVICE", base.grpc_service)
+            or base.grpc_service,
             grpc_serve_port=_env_int("GRPC_SERVE_PORT", base.grpc_serve_port),
             ici_per_link=_env_bool("ICI_PER_LINK", base.ici_per_link),
             host_metrics=_env_bool("HOST_METRICS", base.host_metrics),
@@ -149,6 +154,10 @@ class Config:
         g.add_argument("--fake-topology", help="fake backend topology preset")
         g.add_argument("--grpc-addr", help="monitoring gRPC address")
         g.add_argument("--grpc-timeout", type=float, help="gRPC timeout seconds")
+        g.add_argument(
+            "--grpc-service",
+            help="monitoring gRPC service full name (resolved via reflection)",
+        )
         g.add_argument(
             "--grpc-serve-port",
             type=int,

@@ -104,6 +104,21 @@ def run(cfg: Config, out=sys.stdout, backend=None) -> int:
                 + ")"
             )
 
+        # Merged-transport accounting (grpc backend, SURVEY §3.3): each
+        # unified metric counts once, routed to exactly one transport.
+        sources_fn = getattr(backend, "sources", None)
+        if sources_fn is not None:
+            from collections import Counter
+
+            routes = Counter(sources_fn().values())
+            if routes:
+                p(
+                    "transport routing: "
+                    + ", ".join(
+                        f"{n} via {src}" for src, n in sorted(routes.items())
+                    )
+                )
+
         cov = coverage(supported)
         p(f"\ncoverage: {cov:.1%} (target >= {COVERAGE_TARGET:.0%})")
         if supported and not attached:

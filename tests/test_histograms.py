@@ -1,0 +1,176 @@
+"""1 Hz utilization histograms (BASELINE config 3 "per-chip MXU
+duty-cycle + tensorcore_util histograms")."""
+
+import pytest
+from prometheus_client.parser import text_string_to_metric_families
+
+from tpumon.config import Config
+from tpumon.exporter.collector import build_families
+from tpumon.exporter.histograms import (
+    DISTRIBUTION_SOURCES,
+    PERCENT_BUCKETS,
+    PollHistograms,
+)
+from tpumon.parsing import Point
+
+BASE_KEYS = ("slice", "host")
+BASE_VALS = ("s0", "h0")
+
+
+def _family(hist, name):
+    fams = {f.name: f for f in hist.families(BASE_KEYS, BASE_VALS)}
+    return fams.get(name)
+
+
+def test_empty_state_produces_no_families():
+    assert PollHistograms().families(BASE_KEYS, BASE_VALS) == []
+
+
+def test_buckets_cumulative_and_sum():
+    hist = PollHistograms()
+    # Three polls for chip 0: idle, mid, pegged.
+    for v in (0.0, 60.0, 100.0):
+        hist.observe("duty_cycle_pct", [Point(v, {"chip": "0"})])
+    fam = _family(hist, "accelerator_duty_cycle_distribution_percent")
+    assert fam is not None
+    samples = {(s.name, s.labels.get("le")): s.value for s in fam.samples}
+    suffix = "accelerator_duty_cycle_distribution_percent"
+    # 0.0 ≤ 1 → first bucket; 60 ≤ 75; 100 only ≤ +Inf.
+    assert samples[(f"{suffix}_bucket", "1.0")] == 1.0
+    assert samples[(f"{suffix}_bucket", "50.0")] == 1.0
+    assert samples[(f"{suffix}_bucket", "75.0")] == 2.0
+    assert samples[(f"{suffix}_bucket", "99.0")] == 2.0
+    assert samples[(f"{suffix}_bucket", "+Inf")] == 3.0
+    assert samples[(f"{suffix}_count", None)] == 3.0
+    assert samples[(f"{suffix}_sum", None)] == 160.0
+
+
+def test_series_keyed_by_chip_label():
+    hist = PollHistograms()
+    hist.observe(
+        "duty_cycle_pct",
+        [Point(10.0, {"chip": "0"}), Point(80.0, {"chip": "1"})],
+    )
+    fam = _family(hist, "accelerator_duty_cycle_distribution_percent")
+    counts = {
+        s.labels["chip"]: s.value
+        for s in fam.samples
+        if s.name.endswith("_count")
+    }
+    assert counts == {"0": 1.0, "1": 1.0}
+    # Base labels ride along on every sample.
+    assert all(s.labels["slice"] == "s0" for s in fam.samples)
+
+
+def test_non_distribution_sources_ignored():
+    hist = PollHistograms()
+    hist.observe("hbm_capacity_usage", [Point(123.0, {"chip": "0"})])
+    assert hist.families(BASE_KEYS, BASE_VALS) == []
+
+
+def test_tensorcore_util_keyed_by_core():
+    hist = PollHistograms()
+    hist.observe("tensorcore_util", [Point(42.0, {"core": "3"})])
+    fam = _family(hist, "accelerator_core_utilization_distribution_percent")
+    assert fam is not None
+    assert any(s.labels.get("core") == "3" for s in fam.samples)
+
+
+def test_bucket_bounds_are_inclusive():
+    hist = PollHistograms()
+    for bound in PERCENT_BUCKETS[:-1]:
+        hist.observe("duty_cycle_pct", [Point(bound, {"chip": "0"})])
+    fam = _family(hist, "accelerator_duty_cycle_distribution_percent")
+    by_le = {
+        s.labels["le"]: s.value for s in fam.samples if s.name.endswith("_bucket")
+    }
+    # Each exact-boundary value lands in its own bucket → cumulative
+    # counts step by exactly one per bucket.
+    expected = 0.0
+    for bound in PERCENT_BUCKETS[:-1]:
+        expected += 1.0
+        from prometheus_client.utils import floatToGoString
+
+        assert by_le[floatToGoString(bound)] == expected
+
+
+def test_build_families_accumulates_across_polls():
+    """The poll loop feeds the histograms; state survives poll cycles
+    (unlike the per-cycle gauge families)."""
+    from tpumon.backends.fake import FakeTpuBackend
+
+    backend = FakeTpuBackend.preset("v4-8")
+    hist = PollHistograms()
+    cfg = Config(host_metrics=False)
+    for _ in range(3):
+        backend.advance()
+        families, _ = build_families(backend, cfg, histograms=hist)
+    by_name = {f.name: f for f in families}
+    fam = by_name.get("accelerator_duty_cycle_distribution_percent")
+    assert fam is not None
+    counts = [s for s in fam.samples if s.name.endswith("_count")]
+    assert counts and all(s.value == 3.0 for s in counts)
+
+
+def test_registry_lists_distribution_families():
+    from tpumon.families import all_family_names, distribution_family_rows
+
+    rows = distribution_family_rows()
+    assert set(rows) == {
+        fam for fam, _, _ in DISTRIBUTION_SOURCES.values()
+    }
+    assert set(rows) <= all_family_names()
+    for _, (help_text, labels) in rows.items():
+        assert "le" in labels
+
+
+def test_exporter_scrape_serves_histograms(scrape):
+    """Golden check on the real scrape surface: _bucket/_count/_sum with
+    correct labels, cumulative over polls."""
+    from tpumon.backends.fake import FakeTpuBackend
+    from tpumon.exporter.server import build_exporter
+
+    cfg = Config(port=0, backend="fake", host_metrics=False,
+                 pod_attribution=False, history_window=0)
+    exporter = build_exporter(cfg, FakeTpuBackend.preset("v5e-16"))
+    try:
+        exporter.poller.poll_once()
+        exporter.poller.poll_once()
+        exporter.server.start()
+        status, text = scrape(exporter.server.url + "/metrics")
+        assert status == 200
+        fams = {
+            f.name: f for f in text_string_to_metric_families(text)
+        }
+        fam = fams["accelerator_duty_cycle_distribution_percent"]
+        assert fam.type == "histogram"
+        buckets = [s for s in fam.samples if s.name.endswith("_bucket")]
+        counts = [s for s in fam.samples if s.name.endswith("_count")]
+        assert buckets and counts
+        assert all(s.labels["le"] for s in buckets)
+        # Two explicit polls (the poller thread never started, so no
+        # priming poll) = 2 observations per chip.
+        assert all(s.value == 2.0 for s in counts)
+        assert "accelerator_core_utilization_distribution_percent" in fams
+    finally:
+        exporter.close()
+
+
+def test_histograms_disabled_by_config(scrape):
+    from tpumon.backends.fake import FakeTpuBackend
+    from tpumon.exporter.server import build_exporter
+
+    cfg = Config(port=0, backend="fake", host_metrics=False,
+                 pod_attribution=False, history_window=0, histograms=False)
+    exporter = build_exporter(cfg, FakeTpuBackend.preset("v5e-16"))
+    try:
+        exporter.server.start()
+        _, text = scrape(exporter.server.url + "/metrics")
+        assert "distribution_percent" not in text
+    finally:
+        exporter.close()
+
+
+def test_histograms_env_knob(monkeypatch):
+    monkeypatch.setenv("TPUMON_HISTOGRAMS", "false")
+    assert Config.from_env().histograms is False

@@ -78,6 +78,54 @@ def test_flatten_accepts_counters_rejects_other_suffixes():
     assert b"requests_total" in render_families((created,))
 
 
+def test_histograms_flatten_and_render_byte_identical():
+    """Histogram families stay on the native path (VERDICT r1 item 2:
+    previously _flatten bailed to Python on any histogram)."""
+    from tpumon.exporter.histograms import PollHistograms
+    from tpumon.parsing import Point
+
+    hist = PollHistograms()
+    for v in (0.0, 33.0, 97.5, 100.0):
+        hist.observe(
+            "duty_cycle_pct",
+            [Point(v, {"chip": "0"}), Point(100.0 - v, {"chip": "1"})],
+        )
+    hist.observe("tensorcore_util", [Point(55.0, {"core": "0"})])
+    fams = tuple(hist.families(("slice",), ("s0",)))
+    assert fams, "histograms should have state"
+    assert _flatten(fams) is not None, "histograms must stay native"
+    if native_available():
+        assert render_families(fams) == _python_render(fams)
+    page = _python_render(fams).decode()
+    assert '_bucket{chip="0",le="+Inf",slice="s0"}' in page
+    assert "_count{" in page and "_sum{" in page
+
+
+def test_full_poll_page_with_histograms_stays_native():
+    from tpumon.backends.fake import FakeTpuBackend
+    from tpumon.exporter.histograms import PollHistograms
+
+    hist = PollHistograms()
+    backend = FakeTpuBackend.preset("v5p-64")
+    families, _ = build_families(backend, Config(), histograms=hist)
+    assert _flatten(tuple(families)) is not None
+    if native_available():
+        # Semantic equality (not byte): large HBM values render Go-style
+        # in the Python renderer, Python-repr in native — documented
+        # equivalence, values parse identical.
+        def parse(text):
+            out = {}
+            for fam in text_string_to_metric_families(text):
+                for s in fam.samples:
+                    out[(s.name, tuple(sorted(s.labels.items())))] = s.value
+            return out
+
+        native = parse(render_families(tuple(families)).decode())
+        python = parse(_python_render(tuple(families)).decode())
+        assert native == python
+        assert any("_bucket" in name for name, _ in native)
+
+
 def test_env_kill_switch(monkeypatch):
     import tpumon._native as native
 

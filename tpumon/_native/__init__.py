@@ -130,20 +130,30 @@ def native_available() -> bool:
 def _flatten(families) -> list | None:
     """Metric-family objects → the plain structure the C renderer takes.
 
-    Returns None when a family needs the general renderer (histogram
-    suffixes, sample timestamps, exemplars — the exporter's poll loop
-    only produces plain gauges and counters, so this is a safety valve,
-    not a hot path). Counters render under their text-format ``_total``
-    exposition name, matching prometheus_client byte-for-byte.
+    Gauges, counters, and histograms (the three types the poll loop
+    produces) all flatten; anything else — or samples carrying
+    timestamps/exemplars — returns None and the general prometheus_client
+    renderer takes over. Counters render under their text-format
+    ``_total`` exposition name and histogram samples under their
+    ``_bucket``/``_count``/``_sum`` names, matching prometheus_client
+    byte-for-byte.
     """
     out = []
     for fam in families:
         # Text exposition 0.0.4 names counters '<family>_total' in
         # HELP/TYPE and on every sample line.
         expo_name = fam.name + "_total" if fam.type == "counter" else fam.name
+        if fam.type == "histogram":
+            allowed = {
+                fam.name + "_bucket",
+                fam.name + "_count",
+                fam.name + "_sum",
+            }
+        else:
+            allowed = {expo_name}
         samples = []
         for s in fam.samples:
-            if s.name != expo_name:
+            if s.name not in allowed:
                 return None
             if getattr(s, "timestamp", None) is not None or getattr(
                 s, "exemplar", None
@@ -154,7 +164,7 @@ def _flatten(families) -> list | None:
             items = sorted(s.labels.items())
             keys = tuple(k for k, _ in items)
             vals = tuple(str(v) for _, v in items)
-            samples.append((keys, vals, float(s.value)))
+            samples.append((s.name, keys, vals, float(s.value)))
         out.append((expo_name, fam.documentation, fam.type, samples))
     return out
 

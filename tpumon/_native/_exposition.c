@@ -9,8 +9,10 @@
  *
  * Input (prepared by tpumon/_native/__init__.py from metric families):
  *   families: list of (name: str, help: str, typ: str, samples: list)
- *   sample:   (label_keys: tuple[str, ...], label_values: tuple[str, ...],
- *              value: float)
+ *   sample:   (sample_name: str, label_keys: tuple[str, ...],
+ *              label_values: tuple[str, ...], value: float)
+ * The per-sample name supports histogram families, whose samples render
+ * under <family>_bucket/_count/_sum rather than the family name.
  * Output: bytes in text format 0.0.4 (same grammar prometheus_client
  * emits; float formatting via PyOS_double_to_string repr mode so values
  * round-trip identically to the Python renderer).
@@ -112,12 +114,12 @@ static PyObject *render(PyObject *self, PyObject *families) {
         Py_ssize_t nsamp = PyList_GET_SIZE(samples);
         for (Py_ssize_t i = 0; i < nsamp; i++) {
             PyObject *samp = PyList_GET_ITEM(samples, i);
-            PyObject *keys, *vals;
+            PyObject *sname, *keys, *vals;
             double value;
-            if (!PyArg_ParseTuple(samp, "OOd", &keys, &vals, &value))
+            if (!PyArg_ParseTuple(samp, "OOOd", &sname, &keys, &vals, &value))
                 goto fail;
 
-            if (sb_put_raw_pystr(&sb, name) < 0) goto fail;
+            if (sb_put_raw_pystr(&sb, sname) < 0) goto fail;
             Py_ssize_t nlab = PyTuple_GET_SIZE(keys);
             if (nlab > 0) {
                 if (sb_putc(&sb, '{') < 0) goto fail;

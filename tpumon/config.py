@@ -94,6 +94,10 @@ class Config:
     #: Emit host context gauges (CPU/mem/load/net via psutil) next to the
     #: device families for accelerator-symptom diagnosis.
     host_metrics: bool = True
+    #: Emit cumulative duty-cycle / core-utilization histograms fed by the
+    #: 1 Hz poll loop (BASELINE config 3), recovering the between-scrape
+    #: distribution inside the scrape itself.
+    histograms: bool = True
     #: Chip→pod attribution via the kubelet pod-resources API; degrades
     #: silently to absent off-cluster.
     pod_attribution: bool = True
@@ -129,6 +133,7 @@ class Config:
             grpc_serve_port=_env_int("GRPC_SERVE_PORT", base.grpc_serve_port),
             ici_per_link=_env_bool("ICI_PER_LINK", base.ici_per_link),
             host_metrics=_env_bool("HOST_METRICS", base.host_metrics),
+            histograms=_env_bool("HISTOGRAMS", base.histograms),
             pod_attribution=_env_bool("POD_ATTRIBUTION", base.pod_attribution),
             history_window=_env_float("HISTORY_WINDOW", base.history_window),
             history_max_samples=_env_int(

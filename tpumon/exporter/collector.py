@@ -158,12 +158,14 @@ def _topology_families(topo, base_keys, base_vals) -> list[Metric]:
 
 
 def build_families(
-    backend: Backend, cfg: Config, attribution=None
+    backend: Backend, cfg: Config, attribution=None, histograms=None
 ) -> tuple[list[Metric], PollStats]:
     """One poll cycle: query every enabled metric, parse, build families.
 
     Runs only on the poller thread. Every failure mode degrades to a
     dropped sample plus a counter increment (SURVEY.md §5.3).
+    ``histograms`` (a PollHistograms) accumulates the 1 Hz utilization
+    distribution across polls — state outlives this call.
     """
     stats = PollStats()
     topo = backend.topology()
@@ -213,6 +215,10 @@ def build_families(
             # Runtime-detached / no data: family absent, not zero
             # (SURVEY.md §2.2 caveat).
             continue
+        if histograms is not None:
+            # Cumulative distribution of the 1 Hz series (BASELINE
+            # config 3 "histograms"); no-op for non-distribution sources.
+            histograms.observe(name, result.points)
 
         fam = GaugeMetricFamily(
             spec.family, spec.help, labels=base_keys + spec.label_keys
@@ -225,6 +231,9 @@ def build_families(
             )
         families.append(fam)
         stats.points += len(result.points)
+
+    if histograms is not None:
+        families.extend(histograms.families(base_keys, base_vals))
 
     # Per-core state via the tpuz surface (SURVEY.md §2.2) — optional on the
     # protocol; degrades to absent when the runtime is down.
@@ -316,6 +325,7 @@ class Poller:
         telemetry: SelfTelemetry,
         attribution=None,
         history=None,
+        histograms=None,
     ) -> None:
         self._backend = backend
         self._cfg = cfg
@@ -323,6 +333,7 @@ class Poller:
         self._telemetry = telemetry
         self._attribution = attribution
         self._history = history
+        self._histograms = histograms
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="tpumon-poller", daemon=True
@@ -337,7 +348,7 @@ class Poller:
         if advance is not None:
             advance()
         families, stats = build_families(
-            self._backend, self._cfg, self._attribution
+            self._backend, self._cfg, self._attribution, self._histograms
         )
         self._cache.publish(families)
         if self._history is not None:

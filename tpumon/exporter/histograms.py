@@ -1,0 +1,104 @@
+"""Cumulative Prometheus histograms fed by the 1 Hz poll loop.
+
+BASELINE config 3 asks for "per-chip MXU duty-cycle + tensorcore_util
+*histograms*" (BASELINE.json:8; SURVEY.md §1 L3 "gauges/histograms").
+The gauges alone alias away everything between Prometheus scrapes: at a
+15-60 s scrape interval, 14-59 of every 60 one-hertz samples are never
+seen. These histograms close that gap inside the scrape itself — every
+poll observes the current per-chip/per-core utilization into cumulative
+buckets, so the *distribution* of the 1 Hz series is recoverable from
+any scrape interval (`histogram_quantile` over `_bucket` rates), without
+the non-Prometheus /history side channel.
+
+State lives on the poller thread only (observe() is called from
+build_families, families() from the same poll cycle); the rendered
+output is published through the same atomic SampleCache as everything
+else, so no extra locking is needed.
+"""
+
+from __future__ import annotations
+
+from prometheus_client.core import HistogramMetricFamily
+from prometheus_client.utils import floatToGoString
+
+#: Utilization-percent buckets: fine at the idle end (is the chip doing
+#: anything?) and the saturated end (is it pegged?), coarse in between.
+PERCENT_BUCKETS: tuple[float, ...] = (
+    1.0, 5.0, 10.0, 25.0, 50.0, 75.0, 90.0, 95.0, 99.0, float("inf"),
+)
+
+#: device metric source -> (histogram family, help, per-point label key).
+#: The label key must match what tpumon.parsing emits for the source's
+#: shape (PER_CHIP -> "chip", PER_CORE -> "core").
+DISTRIBUTION_SOURCES: dict[str, tuple[str, str, str]] = {
+    "duty_cycle_pct": (
+        "accelerator_duty_cycle_distribution_percent",
+        "Distribution of the 1 Hz per-chip duty-cycle samples since "
+        "exporter start (cumulative buckets; recovers what the gauge "
+        "aliases away between scrapes).",
+        "chip",
+    ),
+    "tensorcore_util": (
+        "accelerator_core_utilization_distribution_percent",
+        "Distribution of the 1 Hz per-core TensorCore-utilization samples "
+        "since exporter start (cumulative buckets).",
+        "core",
+    ),
+}
+
+
+class PollHistograms:
+    """Cumulative per-series buckets for the distribution sources."""
+
+    def __init__(self, buckets: tuple[float, ...] = PERCENT_BUCKETS) -> None:
+        if buckets[-1] != float("inf"):
+            buckets = buckets + (float("inf"),)
+        self._buckets = buckets
+        self._les = tuple(floatToGoString(b) for b in buckets)
+        #: (source, label value) -> [per-bucket counts..., sum]
+        self._state: dict[tuple[str, str], list[float]] = {}
+
+    def observe(self, source: str, points) -> None:
+        """Fold one poll cycle's parsed points into the buckets."""
+        spec = DISTRIBUTION_SOURCES.get(source)
+        if spec is None:
+            return
+        label_key = spec[2]
+        for point in points:
+            series = (source, point.labels.get(label_key, ""))
+            state = self._state.get(series)
+            if state is None:
+                state = [0.0] * (len(self._buckets) + 1)
+                self._state[series] = state
+            for idx, bound in enumerate(self._buckets):
+                if point.value <= bound:
+                    state[idx] += 1.0
+                    break
+            state[-1] += point.value
+
+    def families(self, base_keys, base_vals) -> list:
+        """Histogram families for everything observed so far."""
+        out = []
+        for source, (family, help_text, label_key) in DISTRIBUTION_SOURCES.items():
+            series = sorted(
+                (label, state)
+                for (src, label), state in self._state.items()
+                if src == source
+            )
+            if not series:
+                continue
+            fam = HistogramMetricFamily(
+                family, help_text, labels=base_keys + (label_key,)
+            )
+            for label, state in series:
+                cumulative = 0.0
+                buckets = []
+                for le, count in zip(self._les, state[:-1]):
+                    cumulative += count
+                    buckets.append((le, cumulative))
+                fam.add_metric(base_vals + (label,), buckets, state[-1])
+            out.append(fam)
+        return out
+
+
+__all__ = ["PollHistograms", "DISTRIBUTION_SOURCES", "PERCENT_BUCKETS"]

@@ -324,9 +324,14 @@ class Exporter:
             self.history = History(
                 max_age=cfg.history_window, max_samples=max_samples
             )
+        self.histograms = None
+        if cfg.histograms:
+            from tpumon.exporter.histograms import PollHistograms
+
+            self.histograms = PollHistograms()
         self.poller = Poller(
             backend, cfg, self.cache, self.telemetry, attribution,
-            history=self.history,
+            history=self.history, histograms=self.histograms,
         )
         version_fn = getattr(backend, "version", None)
         self.telemetry.backend_info.labels(

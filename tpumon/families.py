@@ -105,6 +105,17 @@ def host_family_rows() -> dict[str, tuple[str, str, tuple[str, ...]]]:
     return HOST_FAMILIES
 
 
+def distribution_family_rows() -> dict[str, tuple[str, tuple[str, ...]]]:
+    """Cumulative 1 Hz utilization histograms (declared next to their
+    builder, tpumon/exporter/histograms.py, so names can't drift)."""
+    from tpumon.exporter.histograms import DISTRIBUTION_SOURCES
+
+    return {
+        family: (help_text, (label_key, "le"))
+        for family, help_text, label_key in DISTRIBUTION_SOURCES.values()
+    }
+
+
 def all_family_names() -> set[str]:
     from tpumon.schema import LIBTPU_SPECS
 
@@ -112,6 +123,7 @@ def all_family_names() -> set[str]:
         {s.family for s in LIBTPU_SPECS}
         | set(IDENTITY_FAMILIES)
         | set(HEALTH_FAMILIES)
+        | set(distribution_family_rows())
         | set(SELF_FAMILIES)
         | set(WORKLOAD_FAMILIES)
         | set(host_family_rows())

@@ -17,14 +17,18 @@ DASH_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "dashboards"
 #: Families the exporter can serve — sourced from the canonical registry
 #: (tpumon/families.py) so dashboards/docs/code can't drift apart.
 def _known_metric_names():
-    from tpumon.families import all_family_names
+    from tpumon.families import all_family_names, distribution_family_rows
 
     names = all_family_names()
-    # Histogram exposition suffixes.
+    # Histogram exposition suffixes: self-telemetry duration histograms
+    # (by _seconds convention) and the 1 Hz distribution histograms (by
+    # registry type).
+    histogram_names = {
+        n for n in names if n.endswith("_seconds")
+    } | set(distribution_family_rows())
     names |= {
         n + suffix
-        for n in list(names)
-        if n.endswith("_seconds")
+        for n in histogram_names
         for suffix in ("_bucket", "_sum", "_count")
     }
     return names
@@ -81,3 +85,39 @@ def test_ici_heatmap_panel_present():
         for p in heatmaps
         for t in p["targets"]
     ), "ICI fabric heatmap must plot link health"
+
+
+def test_ici_fabric_has_pod_level_joins():
+    """BASELINE.json:5 names 'pod-level ICI fabric heatmaps' as a
+    deliverable: the fabric dashboard must join device families against
+    the kubelet pod-attribution family, including in a heatmap panel."""
+    dash = dict(_dashboards())["ici-fabric.json"]
+    joined = [
+        p
+        for p in dash["panels"]
+        for t in p.get("targets", ())
+        if "accelerator_pod_info" in t["expr"]
+        and "group_left" in t["expr"]
+        and _METRIC_RE.search(t["expr"].split("*")[0])
+    ]
+    assert joined, "no pod-joined expressions in ici-fabric.json"
+    assert any(p["type"] == "heatmap" for p in joined), (
+        "pod-level fabric heatmap panel missing"
+    )
+
+
+def test_distribution_families_have_quantile_panels():
+    """The 1 Hz distribution histograms must be reachable by operators:
+    at least one dashboard panel runs histogram_quantile over each."""
+    from tpumon.families import distribution_family_rows
+
+    exprs = [
+        t["expr"]
+        for _, dash in _dashboards()
+        for p in dash["panels"]
+        for t in p.get("targets", ())
+    ]
+    for family in distribution_family_rows():
+        assert any(
+            "histogram_quantile" in e and family + "_bucket" in e for e in exprs
+        ), f"no histogram_quantile panel over {family}"

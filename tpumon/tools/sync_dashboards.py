@@ -1,0 +1,89 @@
+"""Sync the canonical dashboards into the deploy/ and Helm-chart copies.
+
+``dashboards/`` is the single authored source (SURVEY.md §1 L6). Kustomize's
+configMapGenerator and Helm's ``.Files.Glob`` each require the JSON bodies
+inside their own tree and neither follows symlinks out of it, so the bundled
+copies are *generated*, not hand-synced:
+
+    python -m tpumon.tools.sync_dashboards          # regenerate copies
+    python -m tpumon.tools.sync_dashboards --check  # exit 1 if any drifted
+
+The --check mode backs tests/test_helm_chart.py's identity test, so a stale
+copy fails CI with the regeneration command in the message.
+"""
+
+from __future__ import annotations
+
+import argparse
+import filecmp
+import os
+import shutil
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+CANON = os.path.join(REPO, "dashboards")
+COPIES = (
+    os.path.join(REPO, "deploy", "dashboards"),
+    os.path.join(REPO, "charts", "tpumon", "dashboards"),
+)
+
+
+def canonical_files() -> list[str]:
+    return sorted(
+        n for n in os.listdir(CANON) if n.endswith(".json")
+    )
+
+
+def check() -> list[str]:
+    """Return human-readable drift findings (empty = in sync)."""
+    problems = []
+    names = canonical_files()
+    for copy in COPIES:
+        have = sorted(
+            n for n in os.listdir(copy) if n.endswith(".json")
+        ) if os.path.isdir(copy) else []
+        for name in names:
+            src = os.path.join(CANON, name)
+            dst = os.path.join(copy, name)
+            if not os.path.exists(dst):
+                problems.append(f"{dst}: missing")
+            elif not filecmp.cmp(src, dst, shallow=False):
+                problems.append(f"{dst}: differs from canonical")
+        for name in set(have) - set(names):
+            problems.append(f"{os.path.join(copy, name)}: orphan (no canonical source)")
+    return problems
+
+
+def sync() -> None:
+    names = canonical_files()
+    for copy in COPIES:
+        os.makedirs(copy, exist_ok=True)
+        for name in names:
+            shutil.copyfile(os.path.join(CANON, name), os.path.join(copy, name))
+        for name in os.listdir(copy):
+            if name.endswith(".json") and name not in names:
+                os.remove(os.path.join(copy, name))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--check", action="store_true")
+    args = parser.parse_args(argv)
+    if args.check:
+        problems = check()
+        if problems:
+            for p in problems:
+                print(p, file=sys.stderr)
+            print(
+                "regenerate with: python -m tpumon.tools.sync_dashboards",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
+    sync()
+    print(f"synced {len(canonical_files())} dashboards into {len(COPIES)} copies")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -632,3 +632,233 @@ def test_nvml_backend_absent_raises_cleanly():
         pass
     with pytest.raises(BackendError, match="pynvml"):
         NvmlBackend()
+
+
+# ---------------------------------------------------------------------------
+# Row conversion: device-id preservation (positional relabeling is only
+# safe for dense 0..n-1 ids) and composite-id ordering.
+# ---------------------------------------------------------------------------
+
+
+def test_records_to_rows_sparse_ids_dropped(caplog):
+    """Positional relabeling downstream would attribute chip 1's sample
+    to chip 0 if chip 0 is detached — drop the cycle instead."""
+    import logging
+
+    from tpumon.backends.grpc_backend import _records_to_rows
+
+    with caplog.at_level(logging.WARNING, logger="tpumon.backends.grpc_backend"):
+        rows = _records_to_rows(
+            [
+                ({"device-id": 1}, 30.0),
+                ({"device-id": 2}, 40.0),
+                ({"device-id": 3}, 50.0),
+            ],
+            metric="duty_cycle_pct",
+        )
+    assert rows == ()
+    assert any("non-contiguous" in r.message for r in caplog.records)
+
+
+def test_records_to_rows_duplicate_ids_dropped():
+    from tpumon.backends.grpc_backend import _records_to_rows
+
+    assert _records_to_rows(
+        [({"device-id": 0}, 1.0), ({"device-id": 0}, 2.0)]
+    ) == ()
+
+
+def test_records_to_rows_composite_ids_device_major():
+    """(device-id, core-id) records sort device-major by hint ranking,
+    not by the server's field order or send order."""
+    from tpumon.backends.grpc_backend import _records_to_rows
+
+    rows = _records_to_rows(
+        [
+            ({"core-id": 1, "device-id": 1}, 4.0),
+            ({"core-id": 0, "device-id": 1}, 3.0),
+            ({"core-id": 1, "device-id": 0}, 2.0),
+            ({"core-id": 0, "device-id": 0}, 1.0),
+        ]
+    )
+    assert rows == ("1.0", "2.0", "3.0", "4.0")
+
+
+def test_pick_metric_name_prefers_name_key():
+    """A unit/description string declared before the name must not become
+    the metric's identity."""
+    from tpumon.backends.grpc_backend import _pick_metric_name
+
+    assert (
+        _pick_metric_name({"unit": "percent", "metric_name": "duty_cycle_pct"})
+        == "duty_cycle_pct"
+    )
+    # Fallback: no name-ish key at all → first non-empty string.
+    assert _pick_metric_name({"value_kind": "gauge"}) == "gauge"
+    assert _pick_metric_name({"count": 3}) is None
+
+
+# ---------------------------------------------------------------------------
+# Alias-table guard: a server spelling that the alias table missed must
+# not double-count a metric the SDK already serves (SURVEY §3.3).
+# ---------------------------------------------------------------------------
+
+
+def test_suspect_rename_variants():
+    from tpumon.backends.grpc_backend import suspect_rename
+
+    sdk = (
+        "duty_cycle_pct",
+        "tensorcore_util",
+        "hbm_capacity_total",
+        "hbm_capacity_usage",
+    )
+    # Spelling variants of SDK metrics are flagged...
+    assert (
+        suspect_rename("tpu.runtime.tensorcore.dutycycle.percent", sdk)
+        == "duty_cycle_pct"
+    )
+    assert (
+        suspect_rename("tpu.runtime.hbm.memory.total.bytes", sdk)
+        == "hbm_capacity_total"
+    )
+    # ...qualifier siblings are NOT merged (usage != total)...
+    assert (
+        suspect_rename("tpu.runtime.hbm.memory.usage.bytes", sdk)
+        == "hbm_capacity_usage"
+    )
+    assert suspect_rename("hbm_capacity_free", sdk) is None
+    # ...and genuinely new metrics pass through.
+    assert suspect_rename("tpu.runtime.power.draw.watts", sdk) is None
+    assert suspect_rename("megascale.dcn.transfer.latency", sdk) is None
+
+
+def test_rename_suppressed_in_merged_list(monkeypatch):
+    """A server metric the alias table missed, whose tokens match an SDK
+    metric, is suppressed from the merged list (counted once) and
+    surfaced via suspected_renames() for doctor."""
+    monkeypatch.setattr(
+        "tpumon.backends.libtpu_backend.LibtpuBackend", FakeSdk
+    )
+    server = FakeMonitoringServer(
+        {
+            "tpu.runtime.device.duty.cycle": [({"device-id": 0}, 20.0)],
+            "tpu.runtime.hbm.memory.total.bytes": [({"device-id": 0}, 4096.0)],
+        }
+    )
+    from tpumon.backends.grpc_backend import GrpcMonitoringBackend
+
+    be = GrpcMonitoringBackend(addr=server.addr, timeout=5.0)
+    try:
+        names = be.list_metrics()
+        # duty_cycle_pct appears exactly once (SDK), the unaliased
+        # server spelling is suppressed as a suspected rename.
+        assert names.count("duty_cycle_pct") == 1
+        assert "tpu.runtime.device.duty.cycle" not in names
+        assert be.suspected_renames() == {
+            "tpu.runtime.device.duty.cycle": "duty_cycle_pct"
+        }
+        # hbm total has NO SDK counterpart in FakeSdk's list → it is a
+        # real gap-filler and must still be served via its alias.
+        assert be.sources()["hbm_capacity_total"] == "grpc"
+    finally:
+        be.close()
+        server.close()
+
+
+def test_doctor_warns_on_suspected_rename(monkeypatch):
+    import io
+
+    monkeypatch.setattr(
+        "tpumon.backends.libtpu_backend.LibtpuBackend", FakeSdk
+    )
+    server = FakeMonitoringServer(
+        {"tpu.runtime.device.duty.cycle": [({"device-id": 0}, 20.0)]}
+    )
+    from tpumon import doctor
+    from tpumon.backends.grpc_backend import GrpcMonitoringBackend
+    from tpumon.config import Config
+
+    be = GrpcMonitoringBackend(addr=server.addr, timeout=5.0)
+    out = io.StringIO()
+    try:
+        doctor.run(Config(), out=out, backend=be)
+    finally:
+        be.close()
+        server.close()
+    text = out.getvalue()
+    assert "suspected" in text or "looks like" in text
+    assert "tpu.runtime.device.duty.cycle" in text
+
+
+def test_build_pool_tolerates_duplicate_files():
+    """The same file arriving in two reflection responses is benign, and
+    the benign-vs-error split must not depend on protobuf's exception
+    wording (it asks the pool via FindFileByName instead)."""
+    from tpumon.backends.dynamic_stub import build_pool
+
+    blob = _runtime_service_fdp().SerializeToString()
+    pool = build_pool([blob, blob])
+    assert pool.FindFileByName("tpu_metric_service_test.proto")
+
+
+def test_build_pool_conflicting_redefinition_raises():
+    """A *different* schema under the same type names is a real error and
+    must still surface as StubBuildError."""
+    import pytest as _pytest
+    from google.protobuf import descriptor_pb2
+
+    from tpumon.backends.dynamic_stub import StubBuildError, build_pool
+
+    F = descriptor_pb2.FieldDescriptorProto
+    a = descriptor_pb2.FileDescriptorProto()
+    a.name = "clash_a.proto"
+    a.package = "clash"
+    a.syntax = "proto3"
+    m = a.message_type.add()
+    m.name = "Thing"
+    f = m.field.add()
+    f.name, f.number, f.type, f.label = "x", 1, F.TYPE_STRING, 1
+
+    b = descriptor_pb2.FileDescriptorProto()
+    b.CopyFrom(a)
+    b.name = "clash_b.proto"  # different file, same package.Thing symbol
+
+    with _pytest.raises(StubBuildError):
+        build_pool([a.SerializeToString(), b.SerializeToString()])
+
+
+def test_pick_metric_name_ignores_namespace_key():
+    from tpumon.backends.grpc_backend import _pick_metric_name
+
+    assert (
+        _pick_metric_name(
+            {"namespace": "tpu.runtime", "metric_name": "duty_cycle_pct"}
+        )
+        == "duty_cycle_pct"
+    )
+    assert (
+        _pick_metric_name({"display_name": "Duty Cycle"}) == "Duty Cycle"
+    )
+
+
+def test_records_to_rows_sparse_composite_ids_dropped():
+    """Per-core rows missing a whole device must not shift later devices'
+    cores onto earlier positions."""
+    from tpumon.backends.grpc_backend import _records_to_rows
+
+    # device 0 detached; only device 1 reports cores 0..1.
+    assert _records_to_rows(
+        [
+            ({"device-id": 1, "core-id": 0}, 1.0),
+            ({"device-id": 1, "core-id": 1}, 2.0),
+        ]
+    ) == ()
+    # ragged core sets across devices are equally unattributable.
+    assert _records_to_rows(
+        [
+            ({"device-id": 0, "core-id": 0}, 1.0),
+            ({"device-id": 1, "core-id": 0}, 2.0),
+            ({"device-id": 1, "core-id": 1}, 3.0),
+        ]
+    ) == ()

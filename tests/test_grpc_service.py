@@ -154,3 +154,36 @@ def test_grpc_vs_grpc_bind_conflict_detected(exporter):
         assert second.grpc_server is None
     finally:
         second.close()
+
+
+def test_reflection_error_response_carries_error_code(exporter):
+    """Unsupported reflection queries must return a spec-conformant
+    ErrorResponse: error_code (field 1, UNIMPLEMENTED=12) + message —
+    clients branch on the code, not on message text."""
+    from tpumon.backends.reflection import (
+        _iter_fields,
+        encode_file_containing_symbol_request,
+    )
+
+    addr = f"127.0.0.1:{exporter.grpc_server.port}"
+    channel = grpc.insecure_channel(addr)
+    try:
+        stream = channel.stream_stream(
+            "/grpc.reflection.v1alpha.ServerReflection/ServerReflectionInfo",
+            request_serializer=None,
+            response_deserializer=None,
+        )
+        replies = list(
+            stream(iter([encode_file_containing_symbol_request("nope")]), timeout=5)
+        )
+    finally:
+        channel.close()
+    assert len(replies) == 1
+    error_payload = None
+    for field, wire, value in _iter_fields(replies[0]):
+        if field == 7 and wire == 2:
+            error_payload = value
+    assert error_payload is not None, "expected error_response (field 7)"
+    fields = {f: v for f, _, v in _iter_fields(error_payload)}
+    assert fields.get(1) == 12, "error_code must be UNIMPLEMENTED (12)"
+    assert b"list_services" in fields.get(2, b"")

@@ -174,3 +174,23 @@ def test_histograms_disabled_by_config(scrape):
 def test_histograms_env_knob(monkeypatch):
     monkeypatch.setenv("TPUMON_HISTOGRAMS", "false")
     assert Config.from_env().histograms is False
+
+
+def test_nan_sample_does_not_poison_sum():
+    """A NaN point (parsing accepts 'nan') must be dropped: it lands in
+    no bucket but would poison _sum for the exporter's lifetime."""
+    import math
+
+    from tpumon.exporter.histograms import PollHistograms
+    from tpumon.parsing import Point
+
+    h = PollHistograms()
+    h.observe("duty_cycle_pct", [Point(float("nan"), {"chip": "0"})])
+    h.observe("duty_cycle_pct", [Point(50.0, {"chip": "0"})])
+    fams = h.families((), ())
+    (fam,) = [f for f in fams if "duty_cycle" in f.name]
+    count = next(s.value for s in fam.samples if s.name.endswith("_count"))
+    total = next(s.value for s in fam.samples if s.name.endswith("_sum"))
+    assert count == 1.0
+    assert total == 50.0
+    assert not math.isnan(total)

@@ -253,3 +253,57 @@ def test_history_disabled():
         assert ei.value.code == 404
     finally:
         exp.close()
+
+
+def test_async_native_upgrade_replays_samples(monkeypatch):
+    """History(native=None) must return instantly on PyEngine (the C++
+    compile must never sit inside Exporter.__init__) and, when the
+    native engine arrives, carry every already-recorded sample over."""
+    import threading
+
+    from tpumon import history as history_mod
+
+    release = threading.Event()
+    init_thread = threading.current_thread()
+
+    def slow_load():
+        assert threading.current_thread() is not init_thread, (
+            "native load must not run on the constructing thread"
+        )
+        release.wait(timeout=10)
+        return history_mod.PyEngine  # stands in for the C++ Engine class
+
+    monkeypatch.setattr(history_mod, "_load_native", slow_load)
+    h = history_mod.History(max_age=600.0, max_samples=64)
+    first_engine = h.engine
+    # Records land while the "build" is still running.
+    h.engine.record_batch(100.0, [("k", 1.0)])
+    h.engine.record_batch(101.0, [("k", 2.0)])
+    release.set()
+    for _ in range(100):
+        if h.engine is not first_engine:
+            break
+        import time
+
+        time.sleep(0.05)
+    assert h.engine is not first_engine, "engine never upgraded"
+    assert h.query("k") == [(100.0, 1.0), (101.0, 2.0)]
+
+
+def test_native_engine_reinit_resets_in_place():
+    """Re-running __init__ must reset the engine without freeing state
+    another thread could hold (the old code deleted the mutex)."""
+    import pytest as _pytest
+
+    from tpumon.history import make_engine
+
+    try:
+        eng = make_engine(native=True)
+    except RuntimeError:
+        _pytest.skip("no compiler for the native engine")
+    eng.record_batch(1.0, [("k", 1.0)])
+    assert eng.query("k")
+    eng.__init__(max_age=5.0, max_samples=8)
+    assert eng.query("k") == []
+    eng.record_batch(2.0, [("k", 3.0)])
+    assert eng.query("k") == [(2.0, 3.0)]

@@ -12,7 +12,21 @@ from tpumon.exporter.host import HOST_FAMILIES, host_families
 from tpumon.exporter.server import build_exporter
 
 
+def test_first_cpu_sample_is_absent_not_zero():
+    """psutil.cpu_percent(interval=None) returns a meaningless value on
+    its first call in a process; the family must be absent that cycle
+    (absent ≠ zero), then present from the second cycle on."""
+    from tpumon.exporter import host as host_mod
+
+    host_mod._cpu_primed.clear()
+    first = {f.name for f in host_families(("host",), ("h0",))}
+    assert "host_cpu_percent" not in first
+    second = {f.name for f in host_families(("host",), ("h0",))}
+    assert "host_cpu_percent" in second
+
+
 def test_host_families_build():
+    fams = host_families(("host",), ("h0",))  # primes cpu_percent
     fams = host_families(("host",), ("h0",))
     names = {f.name for f in fams}
     assert "host_cpu_percent" in names
@@ -42,6 +56,8 @@ def test_host_metrics_in_scrape(enabled):
     exp = build_exporter(cfg, FakeTpuBackend.preset("v5e-16"))
     exp.start()
     try:
+        # cpu_percent needs one priming cycle before its family appears.
+        exp.poller.poll_once()
         with urllib.request.urlopen(
             exp.server.url + "/metrics", timeout=10
         ) as resp:

@@ -156,3 +156,25 @@ def test_fleet_window_in_header(two_exporters, capsys):
     rc = smi.main(["--url", urls[0], "--url", urls[1], "--window", "30"])
     assert rc == 0
     assert "(30s)" in capsys.readouterr().out
+
+
+def test_non_exporter_listener_falls_back_to_backend(monkeypatch, capsys):
+    """A non-exporter service on 9400 (torn body, non-exposition text)
+    must degrade the sourceless probe to the in-process backend, not
+    crash smi — HTTPException/ValueError are in the probe's net."""
+    import http.client
+
+    from tpumon import smi
+
+    monkeypatch.setenv("TPUMON_BACKEND", "fake")
+    probed = {}
+
+    def torn(url, timeout, window):
+        probed["url"] = url
+        raise http.client.IncompleteRead(b"")
+
+    monkeypatch.setattr(smi, "snapshot_from_url", torn)
+    assert smi.main([]) == 0
+    assert probed["url"].startswith("http://localhost:9400")
+    out = capsys.readouterr().out
+    assert "chip" in out.lower() or "accelerator" in out.lower()

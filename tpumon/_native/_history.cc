@@ -65,10 +65,21 @@ int Engine_init(PyObject *self, PyObject *args, PyObject *kwds) {
     return -1;
   }
   EngineObject *e = reinterpret_cast<EngineObject *>(self);
-  delete e->state;
-  e->state = new EngineState();
-  e->state->max_age = max_age;
-  e->state->max_samples = max_samples;
+  if (e->state == nullptr) {
+    /* tp_alloc zero-fills, so first __init__ sees nullptr. */
+    e->state = new EngineState();
+    e->state->max_age = max_age;
+    e->state->max_samples = max_samples;
+  } else {
+    /* Re-running __init__ must not delete a state whose mutex another
+     * thread may hold (use-after-free): keep the pointer stable and
+     * reset the contents under that same mutex instead. */
+    std::lock_guard<std::recursive_mutex> lock(e->state->mu);
+    e->state->series.clear();
+    e->state->record_calls = 0;
+    e->state->max_age = max_age;
+    e->state->max_samples = max_samples;
+  }
   return 0;
 }
 

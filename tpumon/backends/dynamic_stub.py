@@ -66,12 +66,20 @@ def build_pool(fdp_blobs: list[bytes]):
                 progressed = True
                 continue
             if all(dep in added for dep in fdp.dependency):
+                # Skip files already registered (e.g. well-known types a
+                # server echoes back) by asking the pool directly, rather
+                # than substring-matching the exception text — protobuf's
+                # duplicate-registration wording varies across versions
+                # and C++/pure-Python implementations.
+                already = True
                 try:
-                    pool.Add(fdp)
-                except Exception as exc:
-                    # Duplicate registration (e.g. well-known types) is
-                    # fine; anything else is a real schema problem.
-                    if "duplicate" not in str(exc).lower():
+                    pool.FindFileByName(fdp.name)
+                except KeyError:
+                    already = False
+                if not already:
+                    try:
+                        pool.Add(fdp)
+                    except Exception as exc:
                         raise StubBuildError(
                             f"descriptor {fdp.name} rejected: {exc}"
                         ) from exc

@@ -53,6 +53,101 @@ GRPC_METRIC_ALIASES: dict[str, str] = {
     "tpu.runtime.tensorcore.dutycycle.percent": "duty_cycle_pct",
 }
 
+#: Tokens with no metric-identity content: vendor/namespace prefixes and
+#: units. Dropped before comparing a server name to an SDK name.
+_NOISE_TOKENS = frozenset(
+    {
+        "tpu", "runtime", "metric", "metrics", "bytes", "percent", "pct",
+        "ratio", "microseconds", "usec", "us", "ms", "seconds", "sec",
+    }
+)
+
+#: Known fused spellings → their split tokens, so "dutycycle.percent"
+#: and "duty_cycle_pct" land on the same token set.
+_COMPOUND_TOKENS: dict[str, tuple[str, ...]] = {
+    "dutycycle": ("duty", "cycle"),
+    "linkhealth": ("link", "health"),
+    "linkbandwidth": ("link", "bandwidth"),
+    "minrtt": ("min", "rtt"),
+    "deliveryrate": ("delivery", "rate"),
+    "queuesize": ("queue", "size"),
+}
+
+
+def _semantic_tokens(name: str) -> frozenset:
+    import re
+
+    out: set[str] = set()
+    for tok in re.split(r"[._\-/: ]+", name.lower()):
+        if not tok or tok in _NOISE_TOKENS:
+            continue
+        out.update(_COMPOUND_TOKENS.get(tok, (tok,)))
+    return frozenset(out)
+
+
+#: Qualifier tokens that distinguish sibling metrics of one family
+#: (hbm_capacity_usage vs hbm_capacity_total). A rename suspicion
+#: requires both names to carry the SAME qualifiers — shared family
+#: tokens alone (hbm+capacity) must never merge siblings.
+_QUALIFIER_TOKENS = frozenset(
+    {
+        "total", "usage", "used", "free", "min", "max",
+        "read", "write", "rx", "tx", "in", "out", "send", "recv",
+    }
+)
+
+
+def suspect_rename(server_name: str, sdk_names) -> str | None:
+    """The SDK metric ``server_name`` most plausibly renames, or None.
+
+    Guard for the alias table being a best-effort guess
+    (GRPC_METRIC_ALIASES): when the real service spells a metric
+    differently than the guess, the raw name would otherwise enter the
+    merged list **next to** the SDK name for the same physical metric —
+    double-counting it in coverage accounting. Two shared semantic
+    tokens (e.g. ``hbm``+``total`` for
+    ``tpu.runtime.hbm.memory.total.bytes`` vs ``hbm_capacity_total``)
+    with identical qualifier tokens mark the pair as the same metric;
+    the SDK name wins, and doctor surfaces the suspicion (SURVEY §3.3
+    "coverage counts each metric once").
+    """
+    server_tokens = _semantic_tokens(server_name)
+    best: str | None = None
+    best_overlap = 1  # require >= 2 shared tokens
+    for sdk in sdk_names:
+        sdk_tokens = _semantic_tokens(sdk)
+        if (server_tokens & _QUALIFIER_TOKENS) != (
+            sdk_tokens & _QUALIFIER_TOKENS
+        ):
+            continue  # usage vs total etc.: siblings, not renames
+        overlap = len(server_tokens & sdk_tokens)
+        if overlap > best_overlap:
+            best, best_overlap = sdk, overlap
+    return best
+
+
+def _pick_metric_name(attrs: dict) -> str | None:
+    """The metric name carried by one list-response record.
+
+    Prefer a field whose key says it's the name (``metric_name``,
+    ``name``, then any ``*name`` suffix — NOT bare substring matching,
+    which would adopt ``namespace``): records can carry other string
+    fields (unit, description) declared *before* the name, and "first
+    non-empty string" would silently adopt one of those as the metric's
+    identity — sampling that identity then returns empty forever.
+    """
+    for k, v in attrs.items():
+        if k.lower() in ("metric_name", "name") and isinstance(v, str) and v:
+            return v
+    for k, v in attrs.items():
+        if k.lower().endswith("name") and isinstance(v, str) and v:
+            return v
+    for v in attrs.values():
+        if isinstance(v, str) and v:
+            return v
+    return None
+
+
 #: After a stub build fails, wait this long before re-dialing reflection
 #: (the 1 Hz poll loop calls list_metrics every second; a dead runtime
 #: must not eat a reflection round-trip per poll).
@@ -65,12 +160,50 @@ _STUB_RETRY_SECONDS = 30.0
 _STUB_FAILURE_LIMIT = 3
 
 
-def _records_to_rows(records) -> tuple[str, ...]:
+#: Id-attribute ordering for composite keys: a (device, core) pair must
+#: sort device-major regardless of attribute spelling or field order.
+_ID_HINTS = ("device", "chip", "core", "index", "id")
+
+
+def _id_rank(key: str) -> int:
+    lkey = key.lower()
+    for rank, hint in enumerate(_ID_HINTS):
+        if hint in lkey:
+            return rank
+    return len(_ID_HINTS)
+
+
+def _composite_ids_dense(keys: list[tuple]) -> bool:
+    """True iff composite (major, ..., minor) id tuples tile a dense,
+    duplicate-free grid: majors are 0..k-1 and each major carries the
+    same dense minor set — the only layout positional relabeling can
+    attribute correctly."""
+    if len(set(keys)) != len(keys):
+        return False
+    majors: dict = {}
+    for key in keys:
+        majors.setdefault(key[0], []).append(key[1:])
+    if sorted(majors) != list(range(len(majors))):
+        return False
+    minor_sets = [tuple(sorted(v)) for v in majors.values()]
+    if len(set(minor_sets)) != 1:
+        return False
+    minors = minor_sets[0]
+    if len(minors[0]) == 1:
+        return [m[0] for m in minors] == list(range(len(minors)))
+    return _composite_ids_dense(list(minors))
+
+
+def _records_to_rows(records, metric: str = "") -> tuple[str, ...]:
     """(attrs, value) records → the SDK's per-row string vector.
 
-    - records carrying one integer-like attribute (device/chip/core id)
-      sort by it and emit plain value strings — the PER_CHIP/PER_CORE
-      wire shape;
+    - records carrying integer id attributes (device/chip/core) sort by
+      the id (device-major for composite ids) and emit plain value
+      strings — the PER_CHIP/PER_CORE wire shape. The downstream parser
+      labels these **by list position**, so dense ids ``0..n-1`` are
+      validated: a sparse id set (chip 0 detached, 1..3 reporting) is
+      dropped with a warning rather than silently re-attributed to the
+      wrong chips;
     - records carrying a string attribute emit ``"key: value"`` — the
       KEYED wire shape;
     - a bare single record emits just the value.
@@ -78,8 +211,9 @@ def _records_to_rows(records) -> tuple[str, ...]:
     Records with no numeric value are dropped (a metric row without a
     measurement carries nothing for the parser).
     """
-    id_hints = ("device", "chip", "core", "index", "id")
     rows: list[tuple[object, str]] = []
+    single_ids: list[int] = []
+    composite_ids: list[tuple] = []
     for attrs, value in records:
         if value is None:
             continue
@@ -91,21 +225,54 @@ def _records_to_rows(records) -> tuple[str, ...]:
         # An id-named integer attribute wins even when auxiliary string
         # attributes (units, descriptions) ride along — otherwise a
         # PER_CHIP metric would mis-render as "percent: 20.0" keyed rows.
-        id_attrs = [
-            (k, v)
-            for k, v in int_attrs
-            if any(h in k.lower() for h in id_hints)
-        ]
+        id_attrs = sorted(
+            (
+                (k, v)
+                for k, v in int_attrs
+                if any(h in k.lower() for h in _ID_HINTS)
+            ),
+            key=lambda kv: (_id_rank(kv[0]), kv[0]),
+        )
         str_attrs = [(k, v) for k, v in attrs.items() if isinstance(v, str) and v]
         if len(id_attrs) == 1:
-            rows.append((id_attrs[0][1], str(value)))
+            single_ids.append(id_attrs[0][1])
+            rows.append(((0, (id_attrs[0][1],)), str(value)))
+        elif len(id_attrs) > 1:
+            # Per-core shape (device-id + core-id): device-major order by
+            # the hint ranking above, not server send-order.
+            key = tuple(v for _, v in id_attrs)
+            composite_ids.append(key)
+            rows.append(((0, key), str(value)))
         elif len(int_attrs) == 1 and not str_attrs:
-            rows.append((int_attrs[0][1], str(value)))
+            single_ids.append(int_attrs[0][1])
+            rows.append(((0, (int_attrs[0][1],)), str(value)))
         elif str_attrs:
-            rows.append((str_attrs[0][1], f"{str_attrs[0][1]}: {value}"))
+            rows.append(((1, str_attrs[0][1]), f"{str_attrs[0][1]}: {value}"))
         else:
-            rows.append((len(rows), str(value)))
-    rows.sort(key=lambda r: (isinstance(r[0], str), r[0]))
+            rows.append(((2, len(rows)), str(value)))
+    # Positional relabeling downstream is only safe when the ids are
+    # exactly 0..n-1: anything else would attribute samples to the wrong
+    # device. Drop (absent ≠ wrong) and say so.
+    if single_ids and sorted(single_ids) != list(range(len(single_ids))):
+        log.warning(
+            "%s: monitoring service returned non-contiguous device ids %s; "
+            "dropping samples to avoid misattributing them by position",
+            metric or "metric",
+            sorted(single_ids),
+        )
+        return ()
+    if composite_ids and not _composite_ids_dense(composite_ids):
+        # Same hazard as above for (device, core) rows: the flattened
+        # list is relabeled positionally downstream, so every device must
+        # be present with a dense 0..k-1 core set.
+        log.warning(
+            "%s: monitoring service returned sparse/duplicate composite "
+            "ids %s; dropping samples to avoid misattributing them",
+            metric or "metric",
+            sorted(composite_ids),
+        )
+        return ()
+    rows.sort(key=lambda r: r[0])
     return tuple(text for _, text in rows)
 
 
@@ -130,6 +297,7 @@ class GrpcMonitoringBackend:
         self._list_method: str | None = None
         self._get_method: str | None = None
         self._sources: dict[str, str] = {}
+        self._suspected_renames: dict[str, str] = {}
         #: unified SDK-style name → the server's own metric name.
         self._grpc_names: dict[str, str] = {}
         try:
@@ -283,9 +451,7 @@ class GrpcMonitoringBackend:
         self._note_stub_call(ok=True)
         names: dict[str, str] = {}
         for attrs, _ in message_records(resp):
-            name = next(
-                (v for v in attrs.values() if isinstance(v, str) and v), None
-            )
+            name = _pick_metric_name(attrs)
             if name:
                 names[GRPC_METRIC_ALIASES.get(name, name)] = name
         return names
@@ -310,7 +476,9 @@ class GrpcMonitoringBackend:
                 f"grpc {self._get_method}({server_name}) failed: {exc}"
             ) from exc
         self._note_stub_call(ok=True)
-        return RawMetric(unified, _records_to_rows(message_records(resp)))
+        return RawMetric(
+            unified, _records_to_rows(message_records(resp), metric=unified)
+        )
 
     # -- Backend protocol -------------------------------------------------
 
@@ -327,11 +495,27 @@ class GrpcMonitoringBackend:
         self._grpc_names = grpc_names
         sources = {name: "sdk" for name in sdk_names}
         merged = list(sdk_names)
+        suspected: dict[str, str] = {}
         for name in grpc_names:
-            if name not in sources:
-                sources[name] = "grpc"
-                merged.append(name)
+            if name in sources:
+                continue  # aliased/exact dedupe: SDK stays primary
+            match = suspect_rename(grpc_names[name], sdk_names)
+            if match is not None:
+                # Likely the same physical metric under the server's own
+                # spelling: counting it again would inflate coverage and
+                # serve one measurement under two families. Route nothing;
+                # remember the suspicion for doctor.
+                suspected[grpc_names[name]] = match
+                continue
+            sources[name] = "grpc"
+            merged.append(name)
         self._sources = sources
+        self._suspected_renames = suspected
+        if suspected:
+            log.info(
+                "grpc metrics suppressed as suspected SDK renames: %s",
+                ", ".join(f"{g}→{s}" for g, s in sorted(suspected.items())),
+            )
         if not merged and self._delegate is None:
             raise BackendError(
                 "no metric source: libtpu SDK absent and monitoring "
@@ -343,6 +527,12 @@ class GrpcMonitoringBackend:
         """Per-metric transport routing from the last list_metrics():
         unified name → 'sdk' | 'grpc' (the dedupe accounting surface)."""
         return dict(self._sources)
+
+    def suspected_renames(self) -> dict[str, str]:
+        """Server metric names suppressed from the merged list because
+        they look like renamed SDK metrics (server name → SDK name), from
+        the last list_metrics(). Doctor warns on these."""
+        return dict(self._suspected_renames)
 
     def sample(self, name: str) -> RawMetric:
         source = self._sources.get(name)
@@ -384,4 +574,5 @@ __all__ = [
     "BackendError",
     "DEFAULT_SERVICE",
     "GRPC_METRIC_ALIASES",
+    "suspect_rename",
 ]

@@ -16,10 +16,9 @@ import sys
 from tpumon.backends import create_backend
 from tpumon.backends.base import BackendError
 from tpumon.config import Config
+from tpumon.health import COVERAGE_TARGET
 from tpumon.parsing import parse
 from tpumon.schema import coverage, spec_for
-
-COVERAGE_TARGET = 0.95
 
 
 class _CachedBackend:
@@ -118,9 +117,25 @@ def run(cfg: Config, out=sys.stdout, backend=None) -> int:
                         f"{n} via {src}" for src, n in sorted(routes.items())
                     )
                 )
+        renames_fn = getattr(backend, "suspected_renames", None)
+        if renames_fn is not None:
+            for server_name, sdk_name in sorted(renames_fn().items()):
+                p(
+                    f"WARNING: service metric {server_name!r} looks like "
+                    f"SDK metric {sdk_name!r} renamed — suppressed from "
+                    "the merged list so coverage counts it once; add it "
+                    "to GRPC_METRIC_ALIASES if the mapping is confirmed"
+                )
 
+        # Env-aware target: the same TPUMON_HEALTH_COVERAGE_TARGET knob
+        # the health evaluator honors (doctor gates CI/init containers,
+        # so its verdict must match the configured contract, not the
+        # compiled default).
+        from tpumon.health import env_thresholds
+
+        target = env_thresholds().coverage_target
         cov = coverage(supported)
-        p(f"\ncoverage: {cov:.1%} (target >= {COVERAGE_TARGET:.0%})")
+        p(f"\ncoverage: {cov:.1%} (target >= {target:.0%})")
         if supported and not attached:
             p(
                 "note: all metrics empty — no runtime/workload attached to "
@@ -176,7 +191,7 @@ def run(cfg: Config, out=sys.stdout, backend=None) -> int:
         if health_status == health_mod.CRIT:
             p("\nverdict: DEVICE HEALTH CRITICAL")
             return 1
-        if cov >= COVERAGE_TARGET:
+        if cov >= target:
             p("\nverdict: OK")
             return 0
         p("\nverdict: COVERAGE BELOW TARGET")

@@ -123,7 +123,16 @@ class MetricsGrpcServer:
                     )
                     yield _len_field(6, services)
                 else:
-                    yield _len_field(7, _len_field(2, b"only list_services"))
+                    # ErrorResponse { int32 error_code = 1; string
+                    # error_message = 2 } — code 12 = UNIMPLEMENTED, so
+                    # spec-conformant clients branch on the code instead
+                    # of parsing the message text.
+                    unimplemented = (
+                        _encode_varint((1 << 3) | 0)
+                        + _encode_varint(12)
+                        + _len_field(2, b"only list_services")
+                    )
+                    yield _len_field(7, unimplemented)
 
         metrics_handler = grpc.method_handlers_generic_handler(
             SERVICE_NAME,

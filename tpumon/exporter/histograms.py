@@ -65,6 +65,11 @@ class PollHistograms:
             return
         label_key = spec[2]
         for point in points:
+            if point.value != point.value:
+                # NaN (parsing's _to_float accepts "nan"): it would land
+                # in no bucket but poison _sum for the exporter's
+                # lifetime — drop it, same stance as any garbled row.
+                continue
             series = (source, point.labels.get(label_key, ""))
             state = self._state.get(series)
             if state is None:

@@ -21,6 +21,10 @@ from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
 
 log = logging.getLogger(__name__)
 
+#: Process-wide first-call marker for psutil.cpu_percent priming (dict so
+#: tests can reset it without poking a module global rebinding).
+_cpu_primed: dict[str, bool] = {}
+
 #: family -> (kind, description, extra labels)
 HOST_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
     "host_cpu_percent": (
@@ -62,14 +66,22 @@ def host_families(base_keys: tuple[str, ...], base_vals: tuple[str, ...]):
 
     out = []
     try:
-        cpu = GaugeMetricFamily(
-            "host_cpu_percent",
-            HOST_FAMILIES["host_cpu_percent"][1],
-            labels=base_keys,
-        )
-        # interval=None: non-blocking delta since the previous poll cycle.
-        cpu.add_metric(base_vals, psutil.cpu_percent(interval=None))
-        out.append(cpu)
+        # interval=None is a non-blocking delta since the *previous* call,
+        # so the first call in a process has no interval and psutil
+        # documents its return as meaningless (it reports 0.0). Prime on
+        # the first cycle and leave the family absent (absent ≠ zero)
+        # rather than publishing a fake idle sample.
+        cpu_pct = psutil.cpu_percent(interval=None)
+        primed = _cpu_primed.get("done", False)
+        _cpu_primed["done"] = True
+        if primed:
+            cpu = GaugeMetricFamily(
+                "host_cpu_percent",
+                HOST_FAMILIES["host_cpu_percent"][1],
+                labels=base_keys,
+            )
+            cpu.add_metric(base_vals, cpu_pct)
+            out.append(cpu)
 
         vm = psutil.virtual_memory()
         used = GaugeMetricFamily(

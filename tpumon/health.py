@@ -20,7 +20,11 @@ between surfaces.
 
 from __future__ import annotations
 
-from dataclasses import asdict, dataclass
+import logging
+import os
+from dataclasses import asdict, dataclass, fields
+
+log = logging.getLogger(__name__)
 
 OK = "ok"
 WARN = "warn"
@@ -28,21 +32,70 @@ CRIT = "crit"
 
 _SEV_ORDER = {OK: 0, WARN: 1, CRIT: 2}
 
-#: Thresholds (module-level so operators can monkeypatch/configure).
-THROTTLE_WARN = 1.0  # any throttling at all
-THROTTLE_CRIT = 5.0  # throttled by >= 50%
-ICI_TRANSIENT_MIN = 1.0  # 1-5: transient errors
-ICI_PERSISTENT_MIN = 6.0  # 6-9: persistent minor
-ICI_UNUSABLE = 10.0
-HBM_WARN_RATIO = 0.92
-HBM_CRIT_RATIO = 0.98
+#: The single definition of the BASELINE ≥95% coverage target — doctor,
+#: the health evaluator, and the alert-rule drift test all import this.
 COVERAGE_TARGET = 0.95
-#: Programs enqueued on a core while the whole device shows ~no compute —
-#: the wedged-runtime signature (work is queued but nothing executes).
-#: One poll can be a transient; the Prometheus alert adds a `for:`
-#: duration on top of this instantaneous check.
-QUEUE_STALL_DEPTH = 8.0
-QUEUE_STALL_DUTY_PCT = 1.0
+
+
+@dataclass(frozen=True)
+class Thresholds:
+    """Health-check thresholds, overridable per deployment.
+
+    A DaemonSet operator cannot monkeypatch module constants; every field
+    here is settable via ``TPUMON_HEALTH_<FIELD>`` (e.g.
+    ``TPUMON_HEALTH_HBM_WARN_RATIO=0.85``). A malformed value logs and
+    keeps the default — same never-crash stance as tpumon.config.
+    """
+
+    throttle_warn: float = 1.0  # any throttling at all
+    throttle_crit: float = 5.0  # throttled by >= 50%
+    ici_transient_min: float = 1.0  # 1-5: transient errors
+    ici_persistent_min: float = 6.0  # 6-9: persistent minor
+    ici_unusable: float = 10.0
+    hbm_warn_ratio: float = 0.92
+    hbm_crit_ratio: float = 0.98
+    coverage_target: float = COVERAGE_TARGET
+    #: Programs enqueued on a core while the whole device shows ~no
+    #: compute — the wedged-runtime signature (work is queued but nothing
+    #: executes). One poll can be a transient; the Prometheus alert adds
+    #: a `for:` duration on top of this instantaneous check.
+    queue_stall_depth: float = 8.0
+    queue_stall_duty_pct: float = 1.0
+
+    @classmethod
+    def from_env(cls, environ=None) -> "Thresholds":
+        env = os.environ if environ is None else environ
+        kwargs = {}
+        for f in fields(cls):
+            raw = env.get("TPUMON_HEALTH_" + f.name.upper())
+            if raw is None:
+                continue
+            try:
+                kwargs[f.name] = float(raw)
+            except ValueError:
+                log.warning(
+                    "ignoring malformed TPUMON_HEALTH_%s=%r",
+                    f.name.upper(), raw,
+                )
+        return cls(**kwargs)
+
+
+#: (env-values key, parsed Thresholds) — evaluate() runs at 1 Hz in the
+#: poll loop, so the env is re-parsed (and a malformed value re-warned)
+#: only when a TPUMON_HEALTH_* value actually changes, not per call.
+_env_cache: tuple | None = None
+
+
+def env_thresholds() -> Thresholds:
+    """Process-env-backed thresholds, parsed once per distinct env state."""
+    global _env_cache
+    key = tuple(
+        os.environ.get("TPUMON_HEALTH_" + f.name.upper())
+        for f in fields(Thresholds)
+    )
+    if _env_cache is None or _env_cache[0] != key:
+        _env_cache = (key, Thresholds.from_env())
+    return _env_cache[1]
 
 
 @dataclass(frozen=True)
@@ -53,21 +106,26 @@ class Finding:
     chip: str | None = None
 
 
-def evaluate(snap: dict) -> list[Finding]:
+def evaluate(snap: dict, thresholds: Thresholds | None = None) -> list[Finding]:
     """Evaluate a parsed snapshot (tpumon.smi.snapshot_from_text shape).
 
     Returns findings sorted most-severe first; an empty list means every
     check passed with data present. Missing families (runtime detached)
     produce no findings — absence is "no data", never "healthy" or
     "broken" (SURVEY.md §2.2 absent-not-zero).
+
+    ``thresholds`` defaults to :meth:`Thresholds.from_env`, so a
+    DaemonSet's ``TPUMON_HEALTH_*`` env vars flow into every consumer
+    (exporter poll loop, /health/devices, doctor, smi) without plumbing.
     """
+    t = thresholds if thresholds is not None else env_thresholds()
     findings: list[Finding] = []
 
     for chip in sorted(snap.get("chips", {})):
         row = snap["chips"][chip]
         thr = row.get("throttle")
-        if thr is not None and thr >= THROTTLE_WARN:
-            sev = CRIT if thr >= THROTTLE_CRIT else WARN
+        if thr is not None and thr >= t.throttle_warn:
+            sev = CRIT if thr >= t.throttle_crit else WARN
             findings.append(
                 Finding(
                     sev,
@@ -80,8 +138,8 @@ def evaluate(snap: dict) -> list[Finding]:
         used, total = row.get("hbm_used"), row.get("hbm_total")
         if used is not None and total:
             ratio = used / total
-            if ratio >= HBM_WARN_RATIO:
-                sev = CRIT if ratio >= HBM_CRIT_RATIO else WARN
+            if ratio >= t.hbm_warn_ratio:
+                sev = CRIT if ratio >= t.hbm_crit_ratio else WARN
                 findings.append(
                     Finding(
                         sev,
@@ -94,11 +152,11 @@ def evaluate(snap: dict) -> list[Finding]:
     ici = snap.get("ici") or {}
     links = ici.get("links") or {}
     for link, score in sorted(links.items()):
-        if score >= ICI_UNUSABLE:
+        if score >= t.ici_unusable:
             findings.append(
                 Finding(CRIT, "ici_link", f"ICI link {link} unusable (10)")
             )
-        elif score >= ICI_PERSISTENT_MIN:
+        elif score >= t.ici_persistent_min:
             findings.append(
                 Finding(
                     CRIT,
@@ -106,7 +164,7 @@ def evaluate(snap: dict) -> list[Finding]:
                     f"ICI link {link} persistent errors (score {score:.0f})",
                 )
             )
-        elif score >= ICI_TRANSIENT_MIN:
+        elif score >= t.ici_transient_min:
             findings.append(
                 Finding(
                     WARN,
@@ -124,10 +182,10 @@ def evaluate(snap: dict) -> list[Finding]:
             for row in snap.get("chips", {}).values()
             if row.get("duty_pct") is not None
         ]
-        device_idle = bool(duties) and max(duties) <= QUEUE_STALL_DUTY_PCT
+        device_idle = bool(duties) and max(duties) <= t.queue_stall_duty_pct
         if device_idle:
             for core, depth in sorted(queues.items()):
-                if depth >= QUEUE_STALL_DEPTH:
+                if depth >= t.queue_stall_depth:
                     findings.append(
                         Finding(
                             WARN,
@@ -139,13 +197,13 @@ def evaluate(snap: dict) -> list[Finding]:
                     )
 
     cov = snap.get("coverage")
-    if cov is not None and cov < COVERAGE_TARGET:
+    if cov is not None and cov < t.coverage_target:
         findings.append(
             Finding(
                 WARN,
                 "coverage",
                 f"metric coverage {cov * 100:.0f}% below the "
-                f"{COVERAGE_TARGET * 100:.0f}% target",
+                f"{t.coverage_target * 100:.0f}% target",
             )
         )
 

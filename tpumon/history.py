@@ -162,6 +162,16 @@ class History:
     ``record_families`` extracts (key, value) points from the poll cycle's
     metric families, dropping node-constant base labels from the key and
     skipping identity families.
+
+    With ``native=None`` (the default, used by the exporter) construction
+    is instant: recording starts on the pure-Python engine and a daemon
+    thread builds/loads the C++ engine — a compile that can take tens of
+    seconds must never sit inside ``Exporter.__init__``, where it would
+    hold off the first poll and the readiness probe. When the native
+    engine arrives, the samples accumulated meanwhile are replayed into
+    it and the engines are swapped under a lock, so no poll cycle is
+    lost across the upgrade. ``native=True``/``False`` stay synchronous
+    (tests and benchmarks pin the engine deliberately).
     """
 
     def __init__(
@@ -170,8 +180,40 @@ class History:
         max_samples: int = 4096,
         native=None,
     ) -> None:
-        self.engine = make_engine(max_age, max_samples, native)
         self.max_age = max_age
+        self._swap_lock = threading.Lock()
+        if native is None:
+            self.engine = PyEngine(max_age, max_samples)
+            threading.Thread(
+                target=self._upgrade_to_native,
+                args=(max_age, max_samples),
+                name="tpumon-history-build",
+                daemon=True,
+            ).start()
+        else:
+            self.engine = make_engine(max_age, max_samples, native)
+
+    def _upgrade_to_native(self, max_age: float, max_samples: int) -> None:
+        try:
+            cls = _load_native()  # may compile; runs off the poll path
+        except Exception as exc:  # pragma: no cover - load_extension guards
+            log.info("native history engine unavailable: %s", exc)
+            return
+        if cls is None:
+            return
+        fresh = cls(max_age, max_samples)
+        with self._swap_lock:
+            old = self.engine
+            # Replay everything recorded during the build. Per-series
+            # timestamps are in order, which is all the engines' pruning
+            # assumes; the lock keeps record_families from writing to the
+            # old engine mid-replay.
+            for key in old.keys():
+                for ts, value in old.query(key):
+                    fresh.record_batch(ts, ((key, value),))
+            self.engine = fresh
+        log.info("history engine upgraded to native (replayed %d series)",
+                 len(old.keys()))
 
     @property
     def is_native(self) -> bool:
@@ -187,7 +229,8 @@ class History:
                 labels = {k: v for k, v in s.labels.items() if k not in base}
                 items.append((series_key(s.name, labels), float(s.value)))
         if items:
-            self.engine.record_batch(ts, items)
+            with self._swap_lock:
+                self.engine.record_batch(ts, items)
 
     def query(self, key: str, since: float = 0.0):
         return self.engine.query(key, since)

@@ -386,6 +386,22 @@ def main(argv: list[str] | None = None, out=None) -> int:
     # every second — the touching this CLI promises to avoid).
     source: dict = {"mode": None, "backend": None, "cfg": None}
 
+    import http.client
+
+    # Everything a dying — or simply non-exporter — listener can throw
+    # mid-request: connect failures (URLError/OSError), torn connections
+    # mid-body (IncompleteRead and friends are HTTPException, not OSError),
+    # non-exposition response text (parser ValueError). Shared by the
+    # fleet fetcher, the first-snapshot probe, and the watch loop, so an
+    # unrelated service on 9400 degrades to the in-process fallback
+    # instead of crashing smi.
+    fetch_errors = (
+        urllib.error.URLError,
+        OSError,
+        http.client.HTTPException,
+        ValueError,
+    )
+
     def pinned_backend():
         if source["backend"] is None:
             from tpumon.backends import create_backend
@@ -434,7 +450,7 @@ def main(argv: list[str] | None = None, out=None) -> int:
                     "http://localhost:9400", args.timeout, args.window
                 )
                 source["mode"] = "url"
-            except (urllib.error.URLError, OSError):
+            except fetch_errors:
                 backend = pinned_backend()
                 snap = snapshot_from_backend(source["cfg"], backend)
                 source["mode"] = "backend"
@@ -448,19 +464,6 @@ def main(argv: list[str] | None = None, out=None) -> int:
             render_fleet(snap["fleet"], out)
         else:
             render(snap, out)
-
-    import http.client
-
-    # Everything a dying exporter can throw mid-request: connect failures
-    # (URLError/OSError), torn connections mid-body (IncompleteRead and
-    # friends are HTTPException, not OSError), truncated exposition text
-    # (parser ValueError).
-    fetch_errors = (
-        urllib.error.URLError,
-        OSError,
-        http.client.HTTPException,
-        ValueError,
-    )
 
     try:
         if args.watch:

@@ -151,8 +151,13 @@ def run(
     is_moe = isinstance(cfg, MoeConfig)
     if ep > 1 and not is_moe:
         raise ValueError("ep > 1 requires a MoeConfig")
-    if pp > 1 and (is_moe or tp > 1 or sp > 1):
-        raise ValueError("pp composes with dp only (dense model, tp=sp=1)")
+    if pp > 1 and (is_moe or sp > 1):
+        # Design decision (tested in test_parallel.py): pp composes with
+        # dp and tp (Megatron shards inside stage bodies) but not with
+        # ring-attention sp — the pipelined forward owns the attention
+        # impl — and not with MoE, whose all-to-all dispatch would need
+        # its own manual collectives inside the stage shard_map.
+        raise ValueError("pp composes with dp/tp only (dense model, sp=1)")
     seq = seq or cfg.max_seq
     key = jax.random.PRNGKey(seed)
     k_params, k_data = jax.random.split(key)

@@ -19,13 +19,19 @@ Written the XLA way:
   over the stage axis replicates them back (the gradient of that psum is
   the identity into the last stage, so backward stays cheap).
 
-Composes with DP (batch over ``data``); run with tp=1 — tensor-parallel
-weight shards inside a stage body would need manual collectives that
-XLA already inserts on the non-pipelined path.
+Composes with DP (batch over ``data``) and TP (Megatron column/row shards
+*inside* each stage body): the whole pipe runs in one ``shard_map``, so
+the all-reduces XLA inserts automatically on the non-pipelined path are
+written out manually here — one ``psum`` over ``model`` after the
+row-sharded ``wo`` and ``w_down`` projections, the classic Megatron "g"
+collective. Head counts are divided per model shard (a local
+LlamaConfig), so attention runs on its head slice and GQA grouping is
+preserved (``n_heads/tp ÷ n_kv_heads/tp`` = the global ratio).
 """
 
 from __future__ import annotations
 
+import dataclasses
 from functools import partial
 
 import jax
@@ -37,17 +43,18 @@ from tpumon.workload.ops.core import rms_norm, rope_freqs
 
 
 def _stage_layer_specs() -> dict:
-    """Per-layer param specs with the leading (layer) axis on ``stage``."""
+    """Per-layer param specs: leading (layer) axis on ``stage``, Megatron
+    column/row sharding on ``model`` (no-op at tp=1)."""
     return {
         "attn_norm": P("stage", None),
-        "wq": P("stage", None, None),
-        "wk": P("stage", None, None),
-        "wv": P("stage", None, None),
-        "wo": P("stage", None, None),
+        "wq": P("stage", None, "model"),
+        "wk": P("stage", None, "model"),
+        "wv": P("stage", None, "model"),
+        "wo": P("stage", "model", None),
         "mlp_norm": P("stage", None),
-        "w_gate": P("stage", None, None),
-        "w_up": P("stage", None, None),
-        "w_down": P("stage", None, None),
+        "w_gate": P("stage", None, "model"),
+        "w_up": P("stage", None, "model"),
+        "w_down": P("stage", "model", None),
     }
 
 
@@ -61,14 +68,26 @@ def pipeline_param_specs() -> dict:
     }
 
 
-def _stage_body(layers_local, x, cfg, freqs, mask):
-    """Run this stage's layer block on one microbatch [mb, S, D]."""
+def _stage_body(layers_local, x, cfg, freqs, mask, tp):
+    """Run this stage's layer block on one microbatch [mb, S, D].
+
+    ``cfg`` carries *per-model-shard* head counts (see
+    make_pipelined_forward); with tp > 1 the row-sharded output
+    projections produce partial sums, reduced with an explicit psum over
+    ``model`` — inside shard_map, Megatron's collectives are manual.
+    """
 
     def block(h, layer):
-        h = h + _llama._attention(
+        a = _llama._attention(
             rms_norm(h, layer["attn_norm"]), layer, cfg, freqs, mask
         )
-        h = h + _llama._mlp(rms_norm(h, layer["mlp_norm"]), layer, cfg)
+        if tp > 1:
+            a = jax.lax.psum(a, "model")
+        h = h + a
+        m = _llama._mlp(rms_norm(h, layer["mlp_norm"]), layer, cfg)
+        if tp > 1:
+            m = jax.lax.psum(m, "model")
+        h = h + m
         return h, None
 
     h, _ = jax.lax.scan(block, x, layers_local)
@@ -82,8 +101,27 @@ def make_pipelined_forward(mesh: Mesh, cfg, *, microbatches: int = 2):
     tokens [B, S] with B divisible by data-shards × microbatches.
     """
     pp = mesh.shape["stage"]
+    tp = mesh.shape["model"]
     if cfg.n_layers % pp:
         raise ValueError(f"n_layers ({cfg.n_layers}) must divide by pp ({pp})")
+    if cfg.n_heads % tp or cfg.n_kv_heads % tp:
+        raise ValueError(
+            f"n_heads ({cfg.n_heads}) and n_kv_heads ({cfg.n_kv_heads}) "
+            f"must divide by tp ({tp})"
+        )
+    # Per-shard view of the model: each model shard owns n_heads/tp query
+    # heads (dim scales with it, so head_dim is unchanged). At tp=1 this
+    # is cfg itself.
+    local_cfg = (
+        dataclasses.replace(
+            cfg,
+            dim=cfg.dim // tp,
+            n_heads=cfg.n_heads // tp,
+            n_kv_heads=cfg.n_kv_heads // tp,
+        )
+        if tp > 1
+        else cfg
+    )
 
     spec_x = P("data", None, None)
 
@@ -112,7 +150,7 @@ def make_pipelined_forward(mesh: Mesh, cfg, *, microbatches: int = 2):
 
         def tick(x_cur, inp_t):
             x_in = jnp.where(stage == 0, inp_t, x_cur)
-            y = _stage_body(layers_local, x_in, cfg, freqs, mask)
+            y = _stage_body(layers_local, x_in, local_cfg, freqs, mask, tp)
             # Hop to the next stage; stage 0 receives zeros (it always
             # reads from the schedule, never from the wire).
             x_next = jax.lax.ppermute(y, "stage", fwd)

@@ -150,3 +150,33 @@ def test_harness_flash_rejects_pp():
 
     with pytest.raises(ValueError, match="flash"):
         run(LlamaConfig.tiny(), steps=1, batch=2, seq=32, pp=2, attn="flash")
+
+
+@pytest.mark.tpu
+def test_flash_vs_xla_bench_on_real_chip():
+    """SURVEY §6 'measure and record': the flash-vs-XLA comparison runs
+    on the real chip and yields finite timings for both impls. Runs in a
+    subprocess because conftest pins this process's jax to the CPU mesh.
+    The measured numbers live in BASELINE.md."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tpumon.workload.bench_attention",
+            "--seq", "512", "--iters", "2", "--inner", "8",
+        ],
+        capture_output=True, text=True, timeout=560, cwd=repo, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = [json.loads(line) for line in proc.stdout.splitlines() if line.strip()]
+    impls = {r["impl"] for r in rows}
+    assert impls == {"xla", "flash"}
+    for r in rows:
+        assert r["platform"] == "tpu", r
+        assert 0 < r["fwd_ms"] < 10_000
+        assert 0 < r["fwd_bwd_ms"] < 10_000

@@ -86,3 +86,75 @@ def test_graft_entry_dryrun_multichip():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)  # conftest already pinned cpu + 8 devices
+
+
+class TestFlopsAccounting:
+    """MFU accounting (SURVEY §6 'measure and record')."""
+
+    def test_dense_forward_flops_exact(self):
+        from tpumon.workload.flops import forward_flops
+        from tpumon.workload.models.llama import LlamaConfig
+
+        cfg = LlamaConfig()  # D=128 H=4 KV=2 HD=32 F=256 L=2 V=512
+        B, S = 2, 16
+        qkvo = 2 * B * S * 128 * (4 * 32) * 2 + 2 * B * S * 128 * (2 * 32) * 2
+        attn = 2 * B * S * S * 4 * 32 * 2
+        ffn = 6 * B * S * 128 * 256
+        unembed = 2 * B * S * 128 * 512
+        assert forward_flops(cfg, B, S) == 2 * (qkvo + attn + ffn) + unembed
+
+    def test_train_is_three_forwards(self):
+        from tpumon.workload.flops import forward_flops, train_flops_per_step
+        from tpumon.workload.models.llama import LlamaConfig
+
+        cfg = LlamaConfig()
+        assert train_flops_per_step(cfg, 2, 16) == 3 * forward_flops(cfg, 2, 16)
+
+    def test_moe_counts_topk_experts(self):
+        from tpumon.workload.flops import forward_flops
+        from tpumon.workload.models.moe import MoeConfig
+
+        cfg = MoeConfig.tiny()
+        one = forward_flops(cfg, 1, 8)
+        # Doubling top_k adds exactly L * 6BSDF more FLOPs.
+        import dataclasses
+
+        two = forward_flops(cfg, 1, 8)
+        cfg2 = dataclasses.replace(cfg, top_k=cfg.top_k + 1)
+        more = forward_flops(cfg2, 1, 8)
+        assert more - one == cfg.n_layers * 6 * 1 * 8 * cfg.dim * cfg.ffn_dim
+        assert two == one
+
+    def test_peak_lookup_prefix_and_unknown(self):
+        from tpumon.workload.flops import peak_flops_per_chip
+
+        class Dev:
+            def __init__(self, kind):
+                self.device_kind = kind
+
+        assert peak_flops_per_chip(Dev("TPU v5 lite")) == 197e12
+        assert peak_flops_per_chip(Dev("TPU v5 lite0")) == 197e12
+        assert peak_flops_per_chip(Dev("cpu")) is None
+
+    def test_run_reports_mfu_fields(self):
+        """CPU devices have no published peak → mfu None, flops counted."""
+        from tpumon.workload.harness import run
+        from tpumon.workload.models.llama import LlamaConfig
+
+        r = run(LlamaConfig.tiny(), steps=1, batch=2, seq=16)
+        assert r.model_flops_per_step > 0
+        assert r.mfu is None  # tests run on the cpu platform
+
+    def test_mfu_math(self):
+        from tpumon.workload.flops import mfu, train_flops_per_step
+        from tpumon.workload.models.llama import LlamaConfig
+
+        class Dev:
+            device_kind = "TPU v5 lite"
+
+        cfg = LlamaConfig.tiny()
+        got = mfu(cfg, 8, 128, 10.0, [Dev(), Dev()])
+        want = train_flops_per_step(cfg, 8, 128) * 10.0 / (2 * 197e12)
+        assert abs(got - want) < 1e-18
+        assert mfu(cfg, 8, 128, 0.0, [Dev()]) is None
+        assert mfu(cfg, 8, 128, float("inf"), [Dev()]) is None

@@ -1,0 +1,177 @@
+"""Flash-vs-XLA attention benchmark (SURVEY.md §6 "measure and record").
+
+Times the pallas flash-attention kernel (ops.flash_attention) against the
+XLA einsum attention path (the models.llama default) on whatever platform
+jax resolves — the real TPU when present, interpret-mode CPU otherwise —
+and prints one JSON line per (impl, seq) with forward and forward+backward
+wall times. The numbers land in BASELINE.md; an honest regression is a
+result, not a failure.
+
+Run:  python -m tpumon.workload.bench_attention --seq 512 1024 2048
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def xla_attention(q, k, v, mask):
+    """The models.llama einsum path, isolated (GQA repeat + masked
+    softmax), kept numerically identical to models.llama._attention."""
+    import jax
+    import jax.numpy as jnp
+
+    H = q.shape[2]
+    KV = k.shape[2]
+    rep = H // KV
+    k = jnp.repeat(k, rep, axis=2)
+    v = jnp.repeat(v, rep, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(q.shape[-1])) + mask
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _chain(fn, inner: int):
+    """Repeat ``fn`` ``inner`` times inside ONE dispatch, serially chained.
+
+    This host reaches its TPU through a tunnel whose per-dispatch
+    round-trip (~70 ms, measured) swamps sub-millisecond kernels, so the
+    kernel is iterated inside a single ``lax.scan`` and the wall time
+    divided by ``inner``. Each iteration's q input carries a vanishing
+    contribution from the previous output — a real data dependency, so
+    XLA can neither hoist the loop-invariant computation out of the scan
+    nor overlap iterations.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def run(q, k, v):
+        def body(carry, _):
+            out = fn(q + carry, k, v)
+            lead = out[0] if isinstance(out, tuple) else out
+            feed = (lead.ravel()[0] * jnp.asarray(1e-8, lead.dtype)).astype(
+                q.dtype
+            )
+            return feed, ()
+        feed, _ = jax.lax.scan(
+            body, jnp.zeros((), q.dtype), None, length=inner
+        )
+        return feed
+
+    return jax.jit(run)
+
+
+def _time(fn, *args, iters: int, inner: int = 1) -> float:
+    """Median wall seconds per inner call after a compile+warmup call."""
+    import jax
+
+    timed = _chain(fn, inner) if inner > 1 else fn
+    out = timed(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = timed(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2] / inner
+
+
+def bench(
+    batch: int = 4,
+    heads: int = 8,
+    kv_heads: int = 4,
+    head_dim: int = 128,
+    seqs: tuple[int, ...] = (512, 1024, 2048),
+    iters: int = 10,
+    inner: int | None = None,
+    out=sys.stdout,
+) -> list[dict]:
+    import jax
+    import jax.numpy as jnp
+
+    from tpumon.workload.ops.flash_attention import make_flash_attn
+
+    platform = jax.devices()[0].platform
+    kind = getattr(jax.devices()[0], "device_kind", platform)
+    if inner is None:
+        # Amortize the dispatch round-trip on real hardware; interpret
+        # mode (CPU) is slow enough per call that inner=1 is right.
+        inner = 16 if platform == "tpu" else 1
+    flash = make_flash_attn()
+    results = []
+    for seq in seqs:
+        kq, kk, kv_ = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(kq, (batch, seq, heads, head_dim), jnp.bfloat16)
+        k = jax.random.normal(kk, (batch, seq, kv_heads, head_dim), jnp.bfloat16)
+        v = jax.random.normal(kv_, (batch, seq, kv_heads, head_dim), jnp.bfloat16)
+        mask = jnp.triu(jnp.full((seq, seq), -1e9, jnp.float32), k=1)
+
+        impls = {
+            "xla": jax.jit(lambda q, k, v: xla_attention(q, k, v, mask)),
+            "flash": jax.jit(lambda q, k, v: flash(q, k, v)),
+        }
+
+        def train_of(fwd):
+            def loss(q, k, v):
+                return jnp.sum(fwd(q, k, v).astype(jnp.float32))
+
+            return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+        # Attention matmul FLOPs (scores + probs·V), fwd; bwd adds 2×.
+        attn_flops = 2 * 2 * batch * seq * seq * heads * head_dim
+        for name, fwd in impls.items():
+            fwd_s = _time(fwd, q, k, v, iters=iters, inner=inner)
+            bwd_s = _time(train_of(fwd), q, k, v, iters=iters, inner=inner)
+            row = {
+                "impl": name,
+                "platform": platform,
+                "device_kind": kind,
+                "batch": batch,
+                "heads": heads,
+                "kv_heads": kv_heads,
+                "head_dim": head_dim,
+                "seq": seq,
+                "inner": inner,
+                "fwd_ms": round(fwd_s * 1e3, 3),
+                "fwd_bwd_ms": round(bwd_s * 1e3, 3),
+                "fwd_tflops": round(attn_flops / fwd_s / 1e12, 2),
+            }
+            results.append(row)
+            print(json.dumps(row), file=out, flush=True)
+    return results
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="bench_attention")
+    parser.add_argument("--batch", type=int, default=4)
+    parser.add_argument("--heads", type=int, default=8)
+    parser.add_argument("--kv-heads", type=int, default=4)
+    parser.add_argument("--head-dim", type=int, default=128)
+    parser.add_argument("--seq", type=int, nargs="+", default=[512, 1024, 2048])
+    parser.add_argument("--iters", type=int, default=10)
+    parser.add_argument(
+        "--inner", type=int, default=None,
+        help="kernel iterations chained inside one dispatch (default: 16 "
+        "on TPU to amortize dispatch latency, 1 elsewhere)",
+    )
+    args = parser.parse_args(argv)
+    bench(
+        batch=args.batch,
+        heads=args.heads,
+        kv_heads=args.kv_heads,
+        head_dim=args.head_dim,
+        seqs=tuple(args.seq),
+        iters=args.iters,
+        inner=args.inner,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

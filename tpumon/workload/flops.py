@@ -1,0 +1,98 @@
+"""Model-FLOPs accounting → MFU (SURVEY.md §6 "measure and record").
+
+``train_flops_per_step`` counts the matmul FLOPs one optimizer step
+actually executes in this repo's models (models.llama / models.moe) —
+not a 6·N·D approximation: attention scores/weighted-sum are counted at
+the full S×S the additive-mask implementation really computes, GQA's
+narrow KV projections are counted at KV heads, and the MoE FFN is scaled
+by top_k routed experts. Backward is the standard 2× forward, so train =
+3× forward.
+
+``peak_flops_per_chip`` maps ``jax.Device.device_kind`` to the chip's
+published peak dense bf16 FLOP/s; MFU = model FLOPs/s ÷ (peak × chips).
+Unknown kinds (CPU hosts, future chips) return None and MFU is reported
+as None rather than a number computed against a made-up peak.
+"""
+
+from __future__ import annotations
+
+#: device_kind (as reported by jax) → peak dense bf16 FLOP/s per chip.
+#: Public spec-sheet numbers: v4 275 TF, v5e 197 TF, v5p 459 TF,
+#: v6e (Trillium) 918 TF.
+PEAK_BF16_FLOPS: dict[str, float] = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def peak_flops_per_chip(device) -> float | None:
+    """Peak dense bf16 FLOP/s for a jax.Device, or None when unknown."""
+    kind = getattr(device, "device_kind", "")
+    if kind in PEAK_BF16_FLOPS:
+        return PEAK_BF16_FLOPS[kind]
+    # Prefix match tolerates suffixed kinds ("TPU v5 lite0" style).
+    for known, peak in PEAK_BF16_FLOPS.items():
+        if kind.startswith(known):
+            return peak
+    return None
+
+
+def forward_flops(cfg, batch: int, seq: int) -> float:
+    """Matmul FLOPs of one forward pass of models.llama / models.moe.
+
+    Counts 2·m·n·k per matmul as executed: dense QKV/O projections (GQA
+    narrow K/V), full-S² attention einsums (the additive-mask
+    implementation computes the whole matrix), SwiGLU FFN (top_k-scaled
+    + router for MoE), and the unembed projection.
+    """
+    B, S = batch, seq
+    D = cfg.dim
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    F = cfg.ffn_dim
+    L = cfg.n_layers
+
+    qkvo = 2 * B * S * D * (H * HD) * 2 + 2 * B * S * D * (KV * HD) * 2
+    attn = 2 * B * S * S * H * HD * 2  # scores + probs·V
+    n_experts_active = getattr(cfg, "top_k", None)
+    if n_experts_active is not None:  # MoE: routed SwiGLU + router
+        ffn = 6 * B * S * D * F * n_experts_active
+        ffn += 2 * B * S * D * cfg.n_experts  # router logits
+    else:
+        ffn = 6 * B * S * D * F
+    unembed = 2 * B * S * D * cfg.vocab
+    return float(L * (qkvo + attn + ffn) + unembed)
+
+
+def train_flops_per_step(cfg, batch: int, seq: int) -> float:
+    """One optimizer step: forward + backward (2× forward) = 3× forward."""
+    return 3.0 * forward_flops(cfg, batch, seq)
+
+
+def mfu(
+    cfg, batch: int, seq: int, steps_per_sec: float, devices
+) -> float | None:
+    """Model FLOPs utilization in [0, 1], or None when the devices' peak
+    is unknown (CPU dryruns) or throughput wasn't measured."""
+    import math
+
+    if not steps_per_sec or steps_per_sec <= 0 or not math.isfinite(steps_per_sec):
+        return None
+    peaks = [peak_flops_per_chip(d) for d in devices]
+    if not peaks or any(p is None for p in peaks):
+        return None
+    model_flops = train_flops_per_step(cfg, batch, seq) * steps_per_sec
+    return model_flops / sum(peaks)
+
+
+__all__ = [
+    "PEAK_BF16_FLOPS",
+    "peak_flops_per_chip",
+    "forward_flops",
+    "train_flops_per_step",
+    "mfu",
+]

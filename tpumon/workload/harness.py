@@ -108,6 +108,11 @@ class RunResult:
     ep: int = 1
     #: First global step this run executed (> 0 after a checkpoint resume).
     start_step: int = 0
+    #: Model FLOPs per optimizer step (tpumon.workload.flops accounting).
+    model_flops_per_step: float = 0.0
+    #: Model FLOPs utilization vs the devices' peak bf16 (SURVEY §6);
+    #: None when the device peak is unknown (CPU) or throughput absent.
+    mfu: float | None = None
 
 
 def run(
@@ -218,7 +223,8 @@ def run(
     if checkpoint_dir is not None:
         return _run_checkpointed(
             step, params, opt_state, tokens, steps, checkpoint_dir,
-            checkpoint_every, mesh, dp=dp, tp=tp, sp=sp, pp=pp, ep=ep,
+            checkpoint_every, mesh, cfg=cfg, batch=batch, seq=seq,
+            dp=dp, tp=tp, sp=sp, pp=pp, ep=ep,
         )
 
     # Warmup/compile outside the timed window.
@@ -232,20 +238,28 @@ def run(
     loss.block_until_ready()
     elapsed = time.perf_counter() - t0
     losses.append(float(loss))
+    steps_per_sec = steps / elapsed if elapsed > 0 else float("inf")
+    from tpumon.workload import flops as flops_mod
+
+    run_devices = list(mesh.devices.flat) if mesh is not None else [
+        jax.devices()[0]
+    ]
     return RunResult(
         losses=losses,
-        steps_per_sec=steps / elapsed if elapsed > 0 else float("inf"),
+        steps_per_sec=steps_per_sec,
         dp=dp,
         tp=tp,
         sp=sp,
         pp=pp,
         ep=ep,
+        model_flops_per_step=flops_mod.train_flops_per_step(cfg, batch, seq),
+        mfu=flops_mod.mfu(cfg, batch, seq, steps_per_sec, run_devices),
     )
 
 
 def _run_checkpointed(
     step, params, opt_state, tokens, steps, checkpoint_dir, checkpoint_every,
-    mesh=None, **axes,
+    mesh=None, cfg=None, batch=0, seq=0, **axes,
 ) -> RunResult:
     """Checkpoint/resume driver around the jitted train step.
 
@@ -327,12 +341,26 @@ def _run_checkpointed(
                 checkpoint_dir,
                 steps,
             )
+        from tpumon.workload import flops as flops_mod
+
+        steps_per_sec = timed_steps / timed if timed > 0 else 0.0
+        run_devices = list(mesh.devices.flat) if mesh is not None else [
+            jax.devices()[0]
+        ]
         return RunResult(
             losses=losses,
             # 0.0 (not inf) when no step ran outside the compile window —
             # consumers treat it as "no throughput measured".
-            steps_per_sec=timed_steps / timed if timed > 0 else 0.0,
+            steps_per_sec=steps_per_sec,
             start_step=start_step,
+            model_flops_per_step=(
+                flops_mod.train_flops_per_step(cfg, batch, seq) if cfg else 0.0
+            ),
+            mfu=(
+                flops_mod.mfu(cfg, batch, seq, steps_per_sec, run_devices)
+                if cfg
+                else None
+            ),
             **axes,
         )
     finally:
@@ -528,10 +556,13 @@ def main(argv: list[str] | None = None) -> int:
             checkpoint_every=args.checkpoint_every,
         )
         log.info(
-            "loss %.4f → %.4f | %.2f steps/s | mesh dp=%d tp=%d sp=%d pp=%d ep=%d | devices=%s",
+            "loss %.4f → %.4f | %.2f steps/s | %.1f GFLOP/step | MFU %s | "
+            "mesh dp=%d tp=%d sp=%d pp=%d ep=%d | devices=%s",
             result.losses[0] if result.losses else float("nan"),
             result.losses[-1] if result.losses else float("nan"),
             result.steps_per_sec,
+            result.model_flops_per_step / 1e9,
+            f"{result.mfu:.2%}" if result.mfu is not None else "n/a (no peak)",
             result.dp,
             result.tp,
             result.sp,

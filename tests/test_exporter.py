@@ -272,6 +272,43 @@ def test_gzip_negotiation(exporter_for, scrape):
     assert len(raw) < len(plain) / 3  # compression actually bites
 
 
+def test_scrape_latency_budget(exporter_for):
+    """The p99 regression gate for the BASELINE headline metric.
+
+    r1→r3 drifted 0.641→0.965 ms before the self-telemetry render moved
+    off the scrape path (server._SelfTelemetryPage); with it, p99 measures
+    ~0.35 ms on this host. The 2 ms budget is ~6x headroom — loose enough
+    for CI scheduler noise (one retry damps the rest), tight enough that
+    reintroducing a per-scrape O(registry) render (~+0.6 ms plus GIL
+    contention) trips it."""
+    import http.client
+    import time as _time
+
+    exp = exporter_for(FakeTpuBackend.preset("v5p-64"))
+
+    def measure() -> float:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", exp.server.port, timeout=10
+        )
+        try:
+            samples = []
+            for _ in range(300):
+                t0 = _time.perf_counter()
+                conn.request("GET", "/metrics")
+                resp = conn.getresponse()
+                resp.read()
+                samples.append(_time.perf_counter() - t0)
+            samples.sort()
+            return samples[int(len(samples) * 0.99) - 1]
+        finally:
+            conn.close()
+
+    p99 = measure()
+    if p99 >= 0.002:  # one retry: absorb a CI scheduling hiccup
+        p99 = measure()
+    assert p99 < 0.002, f"scrape p99 {p99 * 1e3:.2f} ms over 2 ms budget"
+
+
 def test_keepalive_reuse_and_no_nagle_stall(exporter_for):
     """Prometheus holds one persistent connection per target; repeated
     scrapes on it must not hit the Nagle/delayed-ACK interaction (a

@@ -165,13 +165,27 @@ def test_flash_vs_xla_bench_on_real_chip():
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
-    proc = subprocess.run(
-        [
-            sys.executable, "-m", "tpumon.workload.bench_attention",
-            "--seq", "512", "--iters", "2", "--inner", "8",
-        ],
-        capture_output=True, text=True, timeout=560, cwd=repo, env=env,
-    )
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "tpumon.workload.bench_attention",
+                "--seq", "512", "--iters", "2", "--inner", "8",
+            ],
+            capture_output=True, text=True, timeout=560, cwd=repo, env=env,
+        )
+    except subprocess.TimeoutExpired as exc:
+        # The libtpu monitoring SDK (what @tpu gates on) and the XLA
+        # compute tunnel are independent surfaces; observed live: the SDK
+        # answers while jax.devices() hangs >9 min in the tunnel. But
+        # only a silent hang is the environment fault — output means
+        # device init SUCCEEDED and the bench itself wedged mid-run,
+        # which is a code regression this gate exists to catch.
+        if exc.stdout:
+            pytest.fail(
+                "bench_attention hung after producing output (not a "
+                f"device-init hang): {exc.stdout[-1000:]}"
+            )
+        pytest.skip("TPU compute tunnel unavailable (jax device init hung)")
     assert proc.returncode == 0, proc.stderr[-2000:]
     rows = [json.loads(line) for line in proc.stdout.splitlines() if line.strip()]
     impls = {r["impl"] for r in rows}

@@ -11,6 +11,9 @@ metrics over them (tpumon.backends.dynamic_stub), proving SURVEY §3.3's
 dedupe with the SDK path" end to end with zero pre-shared protos.
 """
 
+import threading
+import time
+
 import pytest
 
 pytest.importorskip("grpc")
@@ -96,6 +99,11 @@ def _runtime_service_fdp():
     m2.name = "ListSupportedMetrics"
     m2.input_type = f".{PKG}.ListSupportedMetricsRequest"
     m2.output_type = f".{PKG}.ListSupportedMetricsResponse"
+    m3 = svc.method.add()
+    m3.name = "WatchRuntimeMetric"
+    m3.input_type = f".{PKG}.MetricRequest"
+    m3.output_type = f".{PKG}.MetricResponse"
+    m3.server_streaming = True
     return fdp
 
 
@@ -124,16 +132,21 @@ class FakeMonitoringServer:
         MetricResponse = cls("MetricResponse")
         ListResponse = cls("ListSupportedMetricsResponse")
         self.get_calls = 0
+        self.watch_calls = 0
         self.reflection_calls = 0
+        # Watch plumbing: streams push ONLY on explicit push() calls, so
+        # tests that never push stay deterministically on the unary path.
+        self._watch_versions: dict = {}
+        self._watch_cond = threading.Condition()
+        self._watch_closed = False
 
-        def get_runtime_metric(request, context):
-            self.get_calls += 1
+        def metric_response(name):
             resp = MetricResponse()
-            records = self.metrics.get(request.metric_name)
+            records = self.metrics.get(name)
             if records is None:
                 return resp  # unknown metric → empty response, not error
             tm = resp.metric
-            tm.name = request.metric_name
+            tm.name = name
             for attrs, value in records:
                 m = tm.metrics.add()
                 for k, v in attrs.items():
@@ -145,6 +158,28 @@ class FakeMonitoringServer:
                         a.value.int_attr = int(v)
                 m.gauge.as_double = float(value)
             return resp
+
+        def get_runtime_metric(request, context):
+            self.get_calls += 1
+            return metric_response(request.metric_name)
+
+        def watch_runtime_metric(request, context):
+            self.watch_calls += 1
+            name = request.metric_name
+            # Start from 0, not the current version: a push() that lands
+            # between the client opening the stream and the server
+            # dispatching this handler must still be delivered, or a
+            # push-then-wait test deadlocks on a lost update.
+            last = 0
+            while context.is_active() and not self._watch_closed:
+                with self._watch_cond:
+                    cur = self._watch_versions.get(name, 0)
+                    if cur == last:
+                        self._watch_cond.wait(timeout=0.05)
+                        cur = self._watch_versions.get(name, 0)
+                if cur != last:
+                    last = cur
+                    yield metric_response(name)
 
         def list_supported(request, context):
             resp = ListResponse()
@@ -183,6 +218,11 @@ class FakeMonitoringServer:
                     ).FromString(b),
                     response_serializer=lambda m: m.SerializeToString(),
                 ),
+                "WatchRuntimeMetric": grpc.unary_stream_rpc_method_handler(
+                    watch_runtime_metric,
+                    request_deserializer=MetricRequest.FromString,
+                    response_serializer=lambda m: m.SerializeToString(),
+                ),
             },
         )
         refl_handler = grpc.method_handlers_generic_handler(
@@ -193,13 +233,30 @@ class FakeMonitoringServer:
                 )
             },
         )
-        self._server = grpc.server(ThreadPoolExecutor(max_workers=4))
+        # Each open watch stream parks one worker for its lifetime (the
+        # backend opens one per gRPC-routed metric); size the pool so
+        # unary calls always have headroom.
+        self._server = grpc.server(ThreadPoolExecutor(max_workers=16))
         self._server.add_generic_rpc_handlers((svc_handler, refl_handler))
         self.port = self._server.add_insecure_port("127.0.0.1:0")
         self._server.start()
         self.addr = f"127.0.0.1:{self.port}"
 
+    def push(self, name, records) -> None:
+        """Publish new records for ``name`` to every open watch stream."""
+        self.metrics[name] = records
+        with self._watch_cond:
+            self._watch_versions[name] = self._watch_versions.get(name, 0) + 1
+            self._watch_cond.notify_all()
+
+    def end_watches(self) -> None:
+        """Cleanly complete every open watch stream (server-side death)."""
+        self._watch_closed = True
+        with self._watch_cond:
+            self._watch_cond.notify_all()
+
     def close(self) -> None:
+        self.end_watches()
         self._server.stop(grace=0.2)
 
 
@@ -500,6 +557,142 @@ def test_record_list_depth_beats_declaration_order():
     msg.warnings.add().text = "transient"
     records = message_records(msg)
     assert records == [({}, 42.0)]
+
+
+def _wait_until(predicate, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def test_dynamic_stub_materializes_streaming_methods(fake_server):
+    """Server-streaming methods land in stub.stream_methods and
+    open_stream yields decoded responses as the server pushes."""
+    import grpc
+
+    from tpumon.backends.dynamic_stub import build_stub, message_records
+
+    channel = grpc.insecure_channel(fake_server.addr)
+    try:
+        stub = build_stub(channel, SERVICE, timeout=5.0)
+        # Streaming methods no longer skipped — but kept out of the
+        # unary namespace.
+        assert set(stub.stream_methods) == {"WatchRuntimeMetric"}
+        assert "WatchRuntimeMetric" not in stub.methods
+
+        # Deadline so a lost push fails the test instead of hanging CI.
+        call = stub.open_stream(
+            "WatchRuntimeMetric", timeout=10, metric_name="duty_cycle_pct"
+        )
+        try:
+            fake_server.push(
+                "duty_cycle_pct", [({"device-id": 0}, 55.0)]
+            )
+            resp = next(iter(call))
+            records = message_records(resp)
+            assert records == [({"device-id": 0}, 55.0)]
+        finally:
+            call.cancel()
+    finally:
+        channel.close()
+
+
+def test_watch_stream_feeds_samples_with_unary_fallback(
+    fake_server, no_sdk, topo_file
+):
+    """SURVEY §3.3 'subscribe/poll': the backend prefers push-fed
+    samples once the watch warms up, and the unary path carries the
+    ticks before (and between) pushes — same unified families either
+    way, dedupe intact."""
+    from tpumon.backends.grpc_backend import GrpcMonitoringBackend
+
+    be = GrpcMonitoringBackend(
+        addr=fake_server.addr, timeout=5.0, topology_file=topo_file
+    )
+    try:
+        names = be.list_metrics()
+        assert "duty_cycle_pct" in names
+
+        # Tick 1: stream just opened, nothing pushed yet → unary serves.
+        raw = be.sample("duty_cycle_pct")
+        assert raw.data == ("20.0", "30.0")
+        unary_calls = fake_server.get_calls
+        assert unary_calls >= 1
+        assert _wait_until(lambda: fake_server.watch_calls >= 1)
+
+        # Push a new value; the reader thread lands it in the cache.
+        fake_server.push(
+            "duty_cycle_pct",
+            [({"device-id": 0}, 77.0), ({"device-id": 1}, 88.0)],
+        )
+        assert _wait_until(
+            lambda: be._watches["duty_cycle_pct"].fresh_rows(10.0)
+            is not None
+        )
+
+        # Tick 2: served from the stream — same row shape, no new unary.
+        raw = be.sample("duty_cycle_pct")
+        assert raw.data == ("77.0", "88.0")
+        assert fake_server.get_calls == unary_calls
+    finally:
+        be.close()
+
+
+def test_watch_stream_death_falls_back_to_unary(
+    fake_server, no_sdk, topo_file
+):
+    """A completed/killed watch stream degrades to the unary poll after
+    the freshness window — absent-not-wrong, never an error."""
+    from tpumon.backends.grpc_backend import GrpcMonitoringBackend
+
+    be = GrpcMonitoringBackend(
+        addr=fake_server.addr, timeout=5.0, topology_file=topo_file
+    )
+    try:
+        be.stream_fresh_seconds = 0.3
+        be.list_metrics()
+        be.sample("duty_cycle_pct")  # opens the watch
+        fake_server.push("duty_cycle_pct", [({"device-id": 0}, 50.0)])
+        assert _wait_until(
+            lambda: be._watches["duty_cycle_pct"].fresh_rows(10.0)
+            is not None
+        )
+
+        # Server completes every stream; pushed rows age past freshness.
+        fake_server.end_watches()
+        time.sleep(0.4)
+        fake_server.metrics["duty_cycle_pct"] = [({"device-id": 0}, 61.0)]
+        before = fake_server.get_calls
+        raw = be.sample("duty_cycle_pct")
+        assert raw.data == ("61.0",)
+        assert fake_server.get_calls == before + 1
+    finally:
+        be.close()
+
+
+def test_watch_pruned_when_metric_delisted(fake_server, no_sdk, topo_file):
+    """A metric leaving the enumeration must close its watch — else the
+    reader thread and server stream leak for the life of the process."""
+    from tpumon.backends.grpc_backend import GrpcMonitoringBackend
+
+    be = GrpcMonitoringBackend(
+        addr=fake_server.addr, timeout=5.0, topology_file=topo_file
+    )
+    try:
+        be.list_metrics()
+        be.sample("duty_cycle_pct")  # lazily opens the watch
+        assert "duty_cycle_pct" in be._watches
+        watch = be._watches["duty_cycle_pct"]
+
+        del fake_server.metrics["duty_cycle_pct"]
+        be.list_metrics()
+        assert "duty_cycle_pct" not in be._watches
+        assert watch._closed
+    finally:
+        be.close()
 
 
 def test_stub_dropped_after_consecutive_call_failures(fake_server, no_sdk, topo_file):

@@ -104,11 +104,15 @@ def build_pool(fdp_blobs: list[bytes]):
 class DynamicServiceStub:
     """Callable method stubs for one gRPC service, built from reflection.
 
-    ``stub.methods`` maps method name → :class:`DynamicMethod`;
+    ``stub.methods`` maps unary method name → :class:`DynamicMethod`;
     ``stub.call(name, timeout=..., **fields)`` constructs the request
     message from keyword fields and returns the decoded response message.
-    Only unary-unary methods are materialized (the monitoring surface is
-    unary; streaming methods are listed but not callable).
+    Server-streaming methods land in ``stub.stream_methods`` (→
+    :class:`DynamicStreamMethod`); ``stub.open_stream(name, **fields)``
+    starts one and returns the live gRPC call — an iterator of decoded
+    responses that also supports ``cancel()``. Client-streaming methods
+    are skipped (nothing on the monitoring surface sends request
+    streams).
     """
 
     def __init__(self, channel, service_name: str, pool) -> None:
@@ -122,15 +126,28 @@ class DynamicServiceStub:
             ) from exc
         self.service_name = service_name
         self.methods: dict[str, DynamicMethod] = {}
+        self.stream_methods: dict[str, DynamicStreamMethod] = {}
         for method in svc.methods:
             req_cls = message_factory.GetMessageClass(method.input_type)
             resp_cls = message_factory.GetMessageClass(method.output_type)
-            if method.client_streaming or method.server_streaming:
+            if method.client_streaming:
                 log.debug(
-                    "skipping streaming method %s/%s", service_name, method.name
+                    "skipping client-streaming method %s/%s",
+                    service_name,
+                    method.name,
                 )
                 continue
             path = f"/{service_name}/{method.name}"
+            if method.server_streaming:
+                callable_ = channel.unary_stream(
+                    path,
+                    request_serializer=lambda msg: msg.SerializeToString(),
+                    response_deserializer=resp_cls.FromString,
+                )
+                self.stream_methods[method.name] = DynamicStreamMethod(
+                    method.name, req_cls, resp_cls, callable_
+                )
+                continue
             callable_ = channel.unary_unary(
                 path,
                 request_serializer=lambda msg: msg.SerializeToString(),
@@ -149,6 +166,19 @@ class DynamicServiceStub:
             )
         return method(timeout=timeout, **fields)
 
+    def open_stream(self, method_name: str, timeout=None, **fields):
+        """Start a server-streaming call; returns the live gRPC call
+        (iterator of decoded responses, ``cancel()``-able). ``timeout``
+        None means the stream lives until cancelled or the server ends
+        it — the right default for a long-lived metric watch."""
+        method = self.stream_methods.get(method_name)
+        if method is None:
+            raise StubBuildError(
+                f"{self.service_name} has no server-streaming method "
+                f"{method_name!r} (has: {sorted(self.stream_methods)})"
+            )
+        return method(timeout=timeout, **fields)
+
 
 class DynamicMethod:
     def __init__(self, name: str, req_cls, resp_cls, callable_) -> None:
@@ -160,6 +190,16 @@ class DynamicMethod:
     def __call__(self, timeout: float = 2.0, **fields):
         req = self.request_class(**fields)
         return self._callable(req, timeout=timeout)
+
+
+class DynamicStreamMethod(DynamicMethod):
+    """A server-streaming method; calling it returns the live call object
+    (iterator of decoded responses; supports ``cancel()``). Only the
+    timeout default differs from the unary base: None, because a metric
+    watch lives until cancelled or the server ends it."""
+
+    def __call__(self, timeout=None, **fields):
+        return super().__call__(timeout=timeout, **fields)
 
 
 def build_stub(
@@ -333,6 +373,7 @@ __all__ = [
     "StubBuildError",
     "DynamicServiceStub",
     "DynamicMethod",
+    "DynamicStreamMethod",
     "build_pool",
     "build_stub",
     "message_records",

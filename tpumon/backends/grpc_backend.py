@@ -31,6 +31,7 @@ sampling raises BackendError).
 from __future__ import annotations
 
 import logging
+import threading
 import time
 
 from tpumon.backends.base import BackendError, RawMetric
@@ -152,6 +153,17 @@ def _pick_metric_name(attrs: dict) -> str | None:
 #: (the 1 Hz poll loop calls list_metrics every second; a dead runtime
 #: must not eat a reflection round-trip per poll).
 _STUB_RETRY_SECONDS = 30.0
+
+#: A streamed sample older than this is stale: the watch is presumed
+#: wedged and the tick falls back to the unary poll. Ten 1 Hz pushes of
+#: silence is decisive, yet short enough that a consumer sees at most a
+#: brief gap in push-fed data.
+_STREAM_FRESH_SECONDS = 10.0
+
+#: After a watch stream dies, wait this long before re-opening it (unary
+#: fallback carries the metric meanwhile) — same throttling rationale as
+#: _STUB_RETRY_SECONDS, scaled to a cheaper operation.
+_STREAM_RETRY_SECONDS = 15.0
 
 #: Consecutive stub-call failures after which the cached stub is dropped
 #: and rebuilt from reflection — a runtime restart can change the schema
@@ -276,6 +288,98 @@ def _records_to_rows(records, metric: str = "") -> tuple[str, ...]:
     return tuple(text for _, text in rows)
 
 
+class _MetricWatch:
+    """Latest-sample cache for one metric's server-streaming watch.
+
+    The SURVEY §3.3 "subscribe" half: a reader thread drains the
+    runtime's push stream and keeps only the newest converted row
+    vector; the 1 Hz poll serves that cached sample when fresh and falls
+    back to the unary read otherwise. Mirrors the exporter's own
+    ``grpc_service.py`` Watch from the consumer side: push when the
+    stream is healthy, poll when it is not, same families either way.
+    """
+
+    def __init__(self, metric: str, server_name: str, open_call, convert) -> None:
+        self.metric = metric
+        #: The server-side spelling this watch subscribed with; a rename
+        #: in a later enumeration invalidates the subscription.
+        self.server_name = server_name
+        self._open_call = open_call  # () -> live gRPC stream call
+        self._convert = convert  # response message -> row tuple
+        self._lock = threading.Lock()
+        self._rows: tuple[str, ...] | None = None
+        self._at = 0.0
+        self._call = None
+        self._thread: threading.Thread | None = None
+        self._died_at: float | None = None
+        self._closed = False
+
+    def fresh_rows(self, window: float) -> tuple[str, ...] | None:
+        """The newest streamed rows if pushed within ``window`` seconds."""
+        with self._lock:
+            if (
+                self._rows is not None
+                and time.monotonic() - self._at <= window
+            ):
+                return self._rows
+        return None
+
+    def ensure_running(self) -> None:
+        """Open the stream (throttled after a death); no-op when live."""
+        with self._lock:
+            if self._closed:
+                return
+            if self._thread is not None and self._thread.is_alive():
+                return
+            now = time.monotonic()
+            if (
+                self._died_at is not None
+                and now - self._died_at < _STREAM_RETRY_SECONDS
+            ):
+                return
+            try:
+                call = self._open_call()
+            except Exception as exc:
+                log.debug("watch(%s) failed to open: %s", self.metric, exc)
+                self._died_at = now
+                return
+            self._call = call
+            self._thread = threading.Thread(
+                target=self._run,
+                args=(call,),
+                name=f"tpumon-watch-{self.metric}",
+                daemon=True,
+            )
+            self._thread.start()
+
+    def _run(self, call) -> None:
+        try:
+            for resp in call:
+                rows = self._convert(resp)
+                with self._lock:
+                    self._rows = rows
+                    self._at = time.monotonic()
+        except Exception as exc:
+            if not self._closed:
+                log.debug("watch(%s) stream ended: %s", self.metric, exc)
+        finally:
+            with self._lock:
+                # Server-completed streams land here too: a clean end
+                # still means "no more pushes", so throttle the reopen.
+                if not self._closed:
+                    self._died_at = time.monotonic()
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            call = self._call
+        if call is not None:
+            try:
+                call.cancel()
+            except Exception:
+                pass
+
+
 class GrpcMonitoringBackend:
     name = "grpc"
 
@@ -296,6 +400,11 @@ class GrpcMonitoringBackend:
         self._stub_call_failures = 0
         self._list_method: str | None = None
         self._get_method: str | None = None
+        self._watch_method: str | None = None
+        self._watches: dict[str, _MetricWatch] = {}
+        #: Instance-level staleness window so tests (and unusual poll
+        #: intervals) can tune it without reaching into module globals.
+        self.stream_fresh_seconds = _STREAM_FRESH_SECONDS
         self._sources: dict[str, str] = {}
         self._suspected_renames: dict[str, str] = {}
         #: unified SDK-style name → the server's own metric name.
@@ -373,6 +482,7 @@ class GrpcMonitoringBackend:
             return None
         self._list_method = self._pick_method(stub, want_list=True)
         self._get_method = self._pick_method(stub, want_list=False)
+        self._watch_method = self._pick_watch_method(stub)
         if self._get_method is None:
             log.warning(
                 "service %s has no metric-read method (methods: %s)",
@@ -385,10 +495,12 @@ class GrpcMonitoringBackend:
         self._stub_failed_at = None
         self._stub_call_failures = 0
         log.info(
-            "monitoring stub built from reflection: %s (list=%s get=%s)",
+            "monitoring stub built from reflection: %s (list=%s get=%s "
+            "watch=%s)",
             self.service,
             self._list_method,
             self._get_method,
+            self._watch_method,
         )
         return stub
 
@@ -411,6 +523,9 @@ class GrpcMonitoringBackend:
             self._stub = None
             self._stub_failed_at = time.monotonic()
             self._stub_call_failures = 0
+            # Watches hold method callables from the dropped stub; a
+            # schema change would leave them decoding stale shapes.
+            self._close_watches()
 
     @staticmethod
     def _pick_method(stub, want_list: bool) -> str | None:
@@ -421,6 +536,56 @@ class GrpcMonitoringBackend:
             if want_list == ("list" in lname or "supported" in lname):
                 return name
         return None
+
+    @staticmethod
+    def _pick_watch_method(stub) -> str | None:
+        """A server-streaming metric-read method, if the service has one.
+
+        Prefer an explicit subscribe spelling; otherwise any streaming
+        method about metrics — the monitoring genre has exactly one.
+        """
+        hints = ("watch", "stream", "subscribe", "monitor")
+        candidates = [
+            n for n in sorted(stub.stream_methods) if "metric" in n.lower()
+        ]
+        for name in candidates:
+            if any(h in name.lower() for h in hints):
+                return name
+        return candidates[0] if candidates else None
+
+    def _close_watches(self) -> None:
+        watches, self._watches = self._watches, {}
+        for watch in watches.values():
+            watch.close()
+
+    def _watch_rows(
+        self, stub, unified: str, server_name: str
+    ) -> tuple[str, ...] | None:
+        """Fresh push-fed rows for ``unified``, or None (→ unary poll).
+
+        Lazily opens the watch on first request for the metric; the
+        stream warms up in the background while unary carries the tick.
+        """
+        from tpumon.backends.dynamic_stub import message_records
+
+        watch = self._watches.get(unified)
+        if watch is None:
+            method = stub.stream_methods[self._watch_method]
+            name_field = self._request_name_field(method)
+            fields = {name_field: server_name} if name_field else {}
+
+            def open_call():
+                return stub.open_stream(self._watch_method, **fields)
+
+            def convert(resp) -> tuple[str, ...]:
+                return _records_to_rows(
+                    message_records(resp), metric=unified
+                )
+
+            watch = _MetricWatch(unified, server_name, open_call, convert)
+            self._watches[unified] = watch
+        watch.ensure_running()
+        return watch.fresh_rows(self.stream_fresh_seconds)
 
     @staticmethod
     def _request_name_field(method) -> str | None:
@@ -465,6 +630,10 @@ class GrpcMonitoringBackend:
         from tpumon.backends.dynamic_stub import message_records
 
         server_name = self._grpc_names.get(unified, unified)
+        if self._watch_method is not None:
+            rows = self._watch_rows(stub, unified, server_name)
+            if rows is not None:
+                return RawMetric(unified, rows)
         method = stub.methods[self._get_method]
         name_field = self._request_name_field(method)
         fields = {name_field: server_name} if name_field else {}
@@ -511,6 +680,16 @@ class GrpcMonitoringBackend:
             merged.append(name)
         self._sources = sources
         self._suspected_renames = suspected
+        # Reconcile watches against the fresh enumeration: a metric that
+        # left the grpc routing (delisted, or rerouted to the SDK) or
+        # changed its server-side spelling would otherwise leak a parked
+        # reader thread + open server stream for the life of the process.
+        for name, watch in list(self._watches.items()):
+            if (
+                sources.get(name) != "grpc"
+                or grpc_names.get(name, name) != watch.server_name
+            ):
+                self._watches.pop(name).close()
         if suspected:
             log.info(
                 "grpc metrics suppressed as suspected SDK renames: %s",
@@ -560,6 +739,7 @@ class GrpcMonitoringBackend:
         return f"grpc:{self.service}"
 
     def close(self) -> None:
+        self._close_watches()
         if self._channel is not None:
             try:
                 self._channel.close()

@@ -339,6 +339,9 @@ class Poller:
             target=self._run, name="tpumon-poller", daemon=True
         )
         self.last_stats: PollStats = PollStats()
+        #: Optional post-cycle hook, called after the telemetry gauges are
+        #: updated (the exporter refreshes its self-telemetry render here).
+        self.on_cycle = None
 
     def poll_once(self) -> PollStats:
         t0 = time.monotonic()
@@ -373,6 +376,8 @@ class Poller:
         t.poll_lag.set(max(0.0, elapsed - self._cfg.interval))
         t.coverage.set(stats.coverage)
         self.last_stats = stats
+        if self.on_cycle is not None:
+            self.on_cycle()
         return stats
 
     def start(self) -> None:
@@ -399,6 +404,14 @@ class Poller:
                 # Last-ditch guard: the poller thread must never die.
                 log.exception("poll cycle failed")
                 self._telemetry.poll_errors.labels(kind="backend").inc()
+                if self.on_cycle is not None:
+                    # poll_once died before its own on_cycle: re-render
+                    # anyway so the error counter is scrapeable now, not
+                    # one scrape-interval late.
+                    try:
+                        self.on_cycle()
+                    except Exception:
+                        log.exception("on_cycle hook failed")
             # If we overran badly, resynchronize rather than burst-poll.
             now = time.monotonic()
             if next_tick < now:

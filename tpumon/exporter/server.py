@@ -100,14 +100,16 @@ _CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 def _make_app(
     render_body, telemetry: SelfTelemetry, health, history=None,
-    device_health=None,
+    device_health=None, post_scrape=None,
 ):
     """WSGI app. ``render_body(want_gzip: bool) -> bytes`` produces the
     /metrics payload (already gzip-encoded when asked); the exporter
     passes cached-bytes + self-telemetry concatenation, the sidecar a
     plain registry render. ``history`` (a tpumon.history.History) enables
     the /history JSON endpoint; ``device_health`` (a () -> dict callable)
-    enables /health/devices (the dcgmi-health analogue)."""
+    enables /health/devices (the dcgmi-health analogue). ``post_scrape``
+    (if set) runs after the duration observation — the exporter uses it
+    to poke the off-path self-telemetry renderer."""
 
     def app(environ, start_response):
         path = environ.get("PATH_INFO", "/")
@@ -168,6 +170,8 @@ def _make_app(
                 return [body]
             finally:
                 telemetry.scrape_duration.observe(time.perf_counter() - t0)
+                if post_scrape is not None:
+                    post_scrape()
         body = b"not found; try /metrics or /healthz\n"
         start_response(
             "404 Not Found",
@@ -251,6 +255,84 @@ def registry_renderer(registry: CollectorRegistry):
         return gzip.compress(body, compresslevel=1) if want_gzip else body
 
     return render
+
+
+class _SelfTelemetryPage:
+    """Cached render of the self-telemetry registry, refreshed OFF the
+    scrape latency path.
+
+    ``generate_latest`` over the self-telemetry registry costs ~0.3 ms
+    (measured: median 0.26 ms, p99 0.46 ms on this host) — the dominant
+    app-level cost of a scrape once the device page is pre-rendered bytes,
+    and the driver of the r1→r3 p99 drift (0.641→0.965 ms). A scrape's own
+    duration observation was never visible in its own response (the
+    histogram is observed *after* rendering), so serving a render that is
+    at most MIN_REFRESH_SPACING old loses nothing a monitoring consumer
+    can see.
+
+    Refresh triggers: ``poke()`` after each scrape (the refresher thread
+    renders, off the latency path) and a synchronous ``refresh()`` from
+    the poll cycle (so a poll's gauge updates are scrapeable the moment
+    ``poll_once`` returns — tests rely on that determinism). Renders are
+    serialized under a render mutex so the two callers cannot publish
+    out of order; the scrape path takes only the publish lock, which a
+    render holds just for the byte-swap.
+    """
+
+    #: Minimum spacing between poke-triggered renders. Back-to-back
+    #: scrapes otherwise contend with their own telemetry render for the
+    #: GIL (measured: p99 0.81 ms with per-scrape renders vs 0.33 ms
+    #: without); Prometheus scrapes are >=1 s apart, so 250 ms staleness
+    #: is invisible while bursts (soak tests, fan-in scrapers) coalesce.
+    MIN_REFRESH_SPACING = 0.25
+
+    def __init__(self, registry: CollectorRegistry) -> None:
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._render_lock = threading.Lock()
+        self._bytes = exposition.generate_latest(registry)
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="tpumon-selftel", daemon=True
+        )
+        self._thread.start()
+
+    def latest(self) -> bytes:
+        with self._lock:
+            return self._bytes
+
+    def refresh(self) -> None:
+        """One re-render (~0.3 ms), safe from any thread: the render
+        mutex makes render+publish atomic w.r.t. other renderers, so a
+        later render can never be overwritten by an earlier one."""
+        with self._render_lock:
+            body = exposition.generate_latest(self._registry)
+            with self._lock:
+                self._bytes = body
+
+    def poke(self) -> None:
+        self._wake.set()
+
+    def _run(self) -> None:
+        while True:
+            self._wake.wait()
+            if self._stop.is_set():
+                return
+            self._wake.clear()
+            try:
+                self.refresh()
+            except Exception:  # never let a render bug kill the refresher
+                log.exception("self-telemetry render failed")
+            # Coalesce bursts: all pokes during the pause fold into one
+            # render when it ends.
+            if self._stop.wait(self.MIN_REFRESH_SPACING):
+                return
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=2.0)
 
 
 class ExporterServer:
@@ -339,15 +421,19 @@ class Exporter:
             version=version_fn() if version_fn else "unknown",
         ).set(1)
 
+        # Self-telemetry render cache: both page halves are now cached
+        # bytes on the scrape path (device page per poll, self-telemetry
+        # per scrape/poll via the off-path refresher).
+        self._selfpage = _SelfTelemetryPage(self.registry)
+        self.poller.on_cycle = self._selfpage.refresh
+
         def render(want_gzip: bool) -> bytes:
             # Single gzip member per response: multi-member concatenation
             # of a cached compressed part would be RFC-legal but silently
             # truncates on one-shot zlib decoders (browsers, naive
             # scrapers); level-1 over ~35 KB costs ~0.3 ms, a price worth
             # universal correctness.
-            body = self.cache.rendered() + exposition.generate_latest(
-                self.registry
-            )
+            body = self.cache.rendered() + self._selfpage.latest()
             return gzip.compress(body, compresslevel=1) if want_gzip else body
 
         #: Full-page renderer (device cache + self-telemetry).
@@ -357,12 +443,12 @@ class Exporter:
             # Atomic pair: the device page and the version it carries come
             # from one cache read, so gRPC change-detection can't tear.
             dev, version = self.cache.rendered_with_version()
-            return dev + exposition.generate_latest(self.registry), version
+            return dev + self._selfpage.latest(), version
 
         self.render_with_version = render_with_version
         app = _make_app(
             render, self.telemetry, self._health, self.history,
-            self._device_health,
+            self._device_health, post_scrape=self._selfpage.poke,
         )
         self.server = ExporterServer(app, cfg.addr, cfg.port)
         self.grpc_server = None
@@ -414,6 +500,7 @@ class Exporter:
             self.grpc_server.close()
         self.server.close()
         self.poller.stop()
+        self._selfpage.close()
         self.backend.close()
 
 

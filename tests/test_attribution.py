@@ -85,6 +85,80 @@ def test_attribution_family(kubelet_sock):
     assert sample.labels["pod"] == "llama-train-0"
 
 
+class TestChipLabelRealWorldIdFormats:
+    """Fixtures encoding the device-ID formats real kubelets hand out, so
+    a mismatch with `_chip_label`'s assumptions fails here first — not
+    silently on a production node.
+
+    - GKE TPU node pools (`google.com/tpu` device plugin): bare 0-based
+      index strings ("0".."7").
+    - NVIDIA device plugin (`nvidia.com/gpu`): GPU UUIDs
+      ("GPU-<uuid>"), MIG instances ("MIG-GPU-<uuid>/gi/ci").
+    - tpumon's own discovery inventory: "<slice>/<worker>/<index>"
+      (discovery/topology.py), matched by exact equality.
+    """
+
+    @staticmethod
+    def _topo(n=4, with_ids=False):
+        from tpumon.discovery.topology import Chip, Topology
+
+        chips = tuple(
+            Chip(i, device_id=f"myslice/0/{i}" if with_ids else "")
+            for i in range(n)
+        )
+        return Topology(
+            accelerator_type="v5litepod-4",
+            slice_name="myslice",
+            hostname="h0",
+            chips=chips,
+        )
+
+    def test_gke_tpu_bare_index_ids(self):
+        """google.com/tpu plugin IDs are bare indices within range."""
+        topo = self._topo(4)
+        for i in range(4):
+            assert PodAttribution._chip_label(str(i), topo) == str(i)
+
+    def test_gke_tpu_out_of_range_index_degrades_visibly(self):
+        """An index the inventory doesn't have must yield an empty chip
+        label (join fails visibly), never a fabricated index."""
+        topo = self._topo(4)
+        assert PodAttribution._chip_label("7", topo) == ""
+
+    def test_inventory_device_id_exact_match_wins(self):
+        """Discovery-format IDs map through the chip inventory even
+        though they are not bare indices."""
+        topo = self._topo(4, with_ids=True)
+        assert PodAttribution._chip_label("myslice/0/2", topo) == "2"
+
+    def test_nvidia_gpu_uuid_without_inventory_degrades(self):
+        """NVIDIA UUIDs don't parse as indices: empty chip label, raw ID
+        preserved in the device_id label by the caller."""
+        topo = self._topo(4)
+        uuid = "GPU-8f6d0f8c-4a2b-11ee-be56-0242ac120002"
+        assert PodAttribution._chip_label(uuid, topo) == ""
+        mig = "MIG-GPU-8f6d0f8c-4a2b-11ee-be56-0242ac120002/1/0"
+        assert PodAttribution._chip_label(mig, topo) == ""
+
+    def test_nvidia_gpu_uuid_with_inventory_maps(self):
+        """When the NVML backend's topology carries GPU UUIDs as chip
+        device_ids, the UUID joins to its chip index."""
+        from tpumon.discovery.topology import Chip, Topology
+
+        uuid = "GPU-8f6d0f8c-4a2b-11ee-be56-0242ac120002"
+        topo = Topology(
+            accelerator_type="gpu",
+            slice_name="node",
+            hostname="h0",
+            chips=(Chip(0, device_id="GPU-other"), Chip(1, device_id=uuid)),
+        )
+        assert PodAttribution._chip_label(uuid, topo) == "1"
+
+    def test_no_topology_accepts_bare_index_only(self):
+        assert PodAttribution._chip_label("3", None) == "3"
+        assert PodAttribution._chip_label("GPU-abc", None) == ""
+
+
 def test_no_socket_degrades_fast_and_backs_off():
     import time
 

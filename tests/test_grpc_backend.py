@@ -782,6 +782,157 @@ def test_merged_backend_builds_unified_families(fake_server, monkeypatch):
         be.close()
 
 
+# ---------------------------------------------------------------------------
+# Golden fixture: the production runtime-metric spellings (VERDICT r3 #4).
+# ---------------------------------------------------------------------------
+
+#: The cloud-TPU runtime monitoring service's public metric spellings, as
+#: surfaced by the tpu-info genre of tooling. This transcript is the seam
+#: between tpumon's alias/rename guesswork and the real service: if the
+#: production service spells a metric one of these ways and the routing
+#: below regresses, THIS test fails — not a node in a GKE pool.
+TPU_INFO_SPELLINGS = (
+    "tpu.runtime.hbm.memory.total.bytes",
+    "tpu.runtime.hbm.memory.usage.bytes",
+    "tpu.runtime.tensorcore.dutycycle.percent",
+    "tpu.runtime.uptime.seconds",
+)
+
+
+def test_production_spellings_golden_routing(monkeypatch, topo_file):
+    """End-to-end against a server speaking the PRODUCTION spellings with
+    the full 14-metric SDK present: every physical metric must appear in
+    the merged list exactly once, aliased spellings must route to their
+    SDK names (never raw beside them), and an SDK-less server metric
+    (uptime) must pass through grpc-routed — the misroute matrix the
+    GRPC_METRIC_ALIASES guess could get wrong."""
+    from tpumon import schema
+    from tpumon.backends.grpc_backend import (
+        GRPC_METRIC_ALIASES,
+        GrpcMonitoringBackend,
+    )
+    from tpumon.discovery.topology import Chip, Topology
+
+    sdk_names = tuple(sp.source for sp in schema.LIBTPU_SPECS)
+    assert len(sdk_names) == 14  # the live-probed denominator (SURVEY §2.2)
+
+    class FakeSdk:
+        def __init__(self, *a, **k):
+            pass
+
+        def list_metrics(self):
+            return sdk_names
+
+        def sample(self, name):
+            return RawMetric(name, ("1.0",))
+
+        def core_states(self):
+            return {}
+
+        def topology(self):
+            return Topology(
+                accelerator_type="v5p",
+                slice_name="golden",
+                hostname="h0",
+                chips=(Chip(0),),
+            )
+
+        def version(self):
+            return "fake-sdk"
+
+        def close(self):
+            pass
+
+    monkeypatch.setattr(
+        "tpumon.backends.libtpu_backend.LibtpuBackend", FakeSdk
+    )
+    server = FakeMonitoringServer(
+        {name: [({"device-id": 0}, 1.0)] for name in TPU_INFO_SPELLINGS}
+    )
+    be = GrpcMonitoringBackend(addr=server.addr, timeout=5.0)
+    try:
+        merged = be.list_metrics()
+        sources = be.sources()
+
+        # Each name exactly once — the dedupe contract.
+        assert len(merged) == len(set(merged))
+
+        # Aliased production spellings route onto their SDK names; the
+        # raw spelling must never ride beside the SDK name.
+        for server_name, sdk_name in GRPC_METRIC_ALIASES.items():
+            assert sdk_name in merged
+            assert server_name not in merged
+            assert sources[sdk_name] == "sdk"
+
+        # The spelling set and the alias table must actually intersect —
+        # a renamed alias table would vacuously pass the loop above.
+        assert set(GRPC_METRIC_ALIASES) <= set(TPU_INFO_SPELLINGS)
+
+        # Uptime has no SDK analogue: grpc-routed, not suppressed.
+        assert "tpu.runtime.uptime.seconds" in merged
+        assert sources["tpu.runtime.uptime.seconds"] == "grpc"
+        assert be.suspected_renames() == {}
+    finally:
+        be.close()
+        server.close()
+
+
+def test_drifted_production_spelling_suppressed_not_double_counted(
+    monkeypatch, topo_file
+):
+    """A plausible future drift of a production spelling (memory.USED vs
+    memory.USAGE) that the alias table misses must be suppressed as a
+    suspected rename of the SDK metric — the alternative is serving one
+    physical measurement under two families and inflating coverage."""
+    from tpumon import schema
+    from tpumon.backends.grpc_backend import GrpcMonitoringBackend
+
+    sdk_names = tuple(sp.source for sp in schema.LIBTPU_SPECS)
+
+    class FakeSdk:
+        def __init__(self, *a, **k):
+            pass
+
+        def list_metrics(self):
+            return sdk_names
+
+        def sample(self, name):
+            return RawMetric(name, ("1.0",))
+
+        def core_states(self):
+            return {}
+
+        def topology(self):
+            from tpumon.discovery.topology import Chip, Topology
+
+            return Topology(
+                accelerator_type="v5p",
+                slice_name="golden",
+                hostname="h0",
+                chips=(Chip(0),),
+            )
+
+        def version(self):
+            return "fake-sdk"
+
+        def close(self):
+            pass
+
+    monkeypatch.setattr(
+        "tpumon.backends.libtpu_backend.LibtpuBackend", FakeSdk
+    )
+    drifted = "tpu.runtime.hbm.memory.used.bytes"
+    server = FakeMonitoringServer({drifted: [({"device-id": 0}, 1.0)]})
+    be = GrpcMonitoringBackend(addr=server.addr, timeout=5.0)
+    try:
+        merged = be.list_metrics()
+        assert drifted not in merged
+        assert be.suspected_renames() == {drifted: "hbm_capacity_usage"}
+    finally:
+        be.close()
+        server.close()
+
+
 def test_grpc_service_config_knob(monkeypatch):
     monkeypatch.setenv("TPUMON_GRPC_SERVICE", "my.custom.MetricService")
     from tpumon.config import Config

@@ -43,6 +43,77 @@ def test_collector_families():
     assert ev.value == 1.0
 
 
+def test_latency_bytes_extraction():
+    """Events carrying duration/size figures feed the per-op aggregates,
+    normalized to µs / bytes across unit spellings."""
+    c = HloOpCounters()
+    c.observe("all-reduce done, duration_us=12.5 bytes_accessed=4096")
+    c.observe("all-reduce took 3 ms size: 2KiB")
+    c.observe("collective-permute latency: 250 ns")
+    c.observe("all-gather replica_groups={}")  # no figures: counts only
+    d = c.detailed_snapshot()
+    assert d["counts"]["all-reduce"] == 2
+    assert abs(d["latency_us"]["all-reduce"] - (12.5 + 3000.0)) < 1e-6
+    assert d["latency_samples"]["all-reduce"] == 2
+    assert abs(d["latency_us"]["collective-permute"] - 0.25) < 1e-9
+    assert d["bytes"]["all-reduce"] == 4096 + 2048
+    assert d["bytes_samples"]["all-reduce"] == 2
+    assert "all-gather" not in d["latency_us"]  # absent, not zero
+
+
+def test_multi_op_event_attributes_figures_once():
+    """A fusion line naming several ops must not multiply the duration."""
+    c = HloOpCounters()
+    c.observe("fused all-gather then reduce-scatter, duration_us=10")
+    d = c.detailed_snapshot()
+    assert d["latency_us"] == {"all-gather": 10.0}
+    assert "reduce-scatter" not in d["latency_us"]
+    assert d["counts"]["reduce-scatter"] == 1  # still counted
+
+
+def test_no_figures_without_collective():
+    """Durations in non-collective events are ignored (nothing to
+    attribute them to)."""
+    c = HloOpCounters()
+    c.observe("fusion.3 elapsed 14 us")
+    d = c.detailed_snapshot()
+    assert d["latency_us"] == {} and d["bytes"] == {}
+
+
+def test_embedded_time_words_not_durations():
+    """'uptime 120 s' / 'lifetime 30s' must not read as latencies: the
+    keyword match requires a word boundary."""
+    c = HloOpCounters()
+    c.observe("all-reduce channel uptime 120 s")
+    c.observe("all-gather buffer lifetime 30 s")
+    d = c.detailed_snapshot()
+    assert d["latency_us"] == {}
+
+
+def test_collector_latency_families():
+    c = HloOpCounters()
+    c.observe("all-to-all duration_us=7 payload=1MB")
+    fams = {f.name: f for f in CountersCollector(c).collect()}
+    lat = {
+        s.labels["op"]: s.value
+        for s in fams["workload_collective_op_latency_microseconds"].samples
+        if s.labels
+    }
+    assert lat == {"all-to-all": 7.0}
+    by = {
+        s.labels["op"]: s.value
+        for s in fams["workload_collective_op_bytes"].samples
+        if s.labels
+    }
+    assert by == {"all-to-all": 1e6}
+    # Families absent (not zero-valued) when nothing was extracted.
+    c2 = HloOpCounters()
+    c2.observe("all-reduce with no figures")
+    names = {f.name for f in CountersCollector(c2).collect()}
+    assert "workload_collective_op_latency_microseconds" not in names
+    assert "workload_collective_op_bytes" not in names
+
+
 def test_start_stop_graceful_without_tpu():
     # On hosts without libtpu this returns False; with libtpu it registers.
     c = HloOpCounters()

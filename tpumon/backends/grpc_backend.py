@@ -75,6 +75,16 @@ _COMPOUND_TOKENS: dict[str, tuple[str, ...]] = {
 }
 
 
+#: Spelling variants normalized to one token, so qualifier comparison
+#: doesn't treat "…memory.used.bytes" and "…usage…" as different
+#: siblings when they name the same measurement.
+_SYNONYMS: dict[str, str] = {
+    "used": "usage",
+    "util": "utilization",
+    "utilisation": "utilization",
+}
+
+
 def _semantic_tokens(name: str) -> frozenset:
     import re
 
@@ -82,7 +92,8 @@ def _semantic_tokens(name: str) -> frozenset:
     for tok in re.split(r"[._\-/: ]+", name.lower()):
         if not tok or tok in _NOISE_TOKENS:
             continue
-        out.update(_COMPOUND_TOKENS.get(tok, (tok,)))
+        for t in _COMPOUND_TOKENS.get(tok, (tok,)):
+            out.add(_SYNONYMS.get(t, t))
     return frozenset(out)
 
 
@@ -92,7 +103,9 @@ def _semantic_tokens(name: str) -> frozenset:
 #: tokens alone (hbm+capacity) must never merge siblings.
 _QUALIFIER_TOKENS = frozenset(
     {
-        "total", "usage", "used", "free", "min", "max",
+        # "used" is absent on purpose: _SYNONYMS rewrites it to "usage"
+        # before any qualifier comparison happens.
+        "total", "usage", "free", "min", "max",
         "read", "write", "rx", "tx", "in", "out", "send", "recv",
     }
 )

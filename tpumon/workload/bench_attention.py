@@ -160,7 +160,19 @@ def main(argv=None) -> int:
         help="kernel iterations chained inside one dispatch (default: 16 "
         "on TPU to amortize dispatch latency, 1 elsewhere)",
     )
+    parser.add_argument(
+        "--platform",
+        choices=("auto", "cpu"),
+        default="auto",
+        help="force the jax platform; 'cpu' avoids a wedged TPU tunnel "
+        "(the JAX_PLATFORMS env var is ignored when a TPU plugin is "
+        "present, so this must be a flag — same caveat as the harness)",
+    )
     args = parser.parse_args(argv)
+    if args.platform == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     bench(
         batch=args.batch,
         heads=args.heads,

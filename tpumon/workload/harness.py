@@ -127,6 +127,7 @@ def run(
     pp: int = 1,
     ep: int = 1,
     microbatches: int = 2,
+    interleave: int = 1,
     seed: int = 0,
     mesh=None,
     attn: str = "xla",
@@ -143,7 +144,9 @@ def run(
     ``attn="flash"`` swaps the attention core for the pallas flash kernel
     (ops.flash_attention); it composes with dp/tp/ep but not with sp > 1
     (ring attention owns the attention impl) or pp > 1 (the pipelined
-    forward owns the model body).
+    forward owns the model body). ``pp > 1`` composes with dp/tp/sp;
+    ``interleave > 1`` selects the circular (interleaved) pipeline
+    schedule — bubble ÷ interleave (parallel.pipeline).
 
     ``checkpoint_dir`` turns on orbax checkpoint/resume (SURVEY.md §5.4 —
     the monitor itself is stateless; the *workload* checkpoints so long
@@ -156,13 +159,13 @@ def run(
     is_moe = isinstance(cfg, MoeConfig)
     if ep > 1 and not is_moe:
         raise ValueError("ep > 1 requires a MoeConfig")
-    if pp > 1 and (is_moe or sp > 1):
+    if pp > 1 and is_moe:
         # Design decision (tested in test_parallel.py): pp composes with
-        # dp and tp (Megatron shards inside stage bodies) but not with
-        # ring-attention sp — the pipelined forward owns the attention
-        # impl — and not with MoE, whose all-to-all dispatch would need
-        # its own manual collectives inside the stage shard_map.
-        raise ValueError("pp composes with dp/tp only (dense model, sp=1)")
+        # dp, tp (Megatron shards inside stage bodies), and sp (the K/V
+        # ring runs inside the stage body) but not with MoE, whose
+        # all-to-all dispatch would need its own manual collectives
+        # inside the stage shard_map.
+        raise ValueError("pp composes with dp/tp/sp only (dense model)")
     seq = seq or cfg.max_seq
     key = jax.random.PRNGKey(seed)
     k_params, k_data = jax.random.split(key)
@@ -192,16 +195,22 @@ def run(
             raise ValueError("sp > 1 requires a mesh")
         if seq % sp:
             raise ValueError(f"seq ({seq}) must divide by sp ({sp})")
-        attn_impl = make_ring_attn(
-            mesh, head_axis="model" if tp > 1 else None
-        )
-        shard_acts = make_act_sharder(mesh, sp=True)
+        if pp == 1:
+            # Under pp the pipelined forward owns the attention impl AND
+            # the activation layout (its shard_map specs), so both stay
+            # unset on that path.
+            attn_impl = make_ring_attn(
+                mesh, head_axis="model" if tp > 1 else None
+            )
+            shard_acts = make_act_sharder(mesh, sp=True)
     if is_moe and mesh is not None:
         shard_experts = make_expert_sharder(mesh)
         if shard_acts is None:
             shard_acts = make_act_sharder(mesh)
     if pp > 1:
-        forward_fn = make_pipelined_forward(mesh, cfg, microbatches=microbatches)
+        forward_fn = make_pipelined_forward(
+            mesh, cfg, microbatches=microbatches, interleave=interleave
+        )
     train_step = make_train_step(
         cfg, optimizer, attn_impl, shard_acts, shard_experts, forward_fn
     )
@@ -401,6 +410,14 @@ def main(argv: list[str] | None = None) -> int:
         help="microbatches per step on the pipeline-parallel path",
     )
     parser.add_argument(
+        "--interleave",
+        type=int,
+        default=1,
+        help="virtual pipeline stages per device (circular/interleaved "
+        "schedule; 1 = GPipe). Requires n_layers %% (pp*interleave) == 0 "
+        "and microbatches %% pp == 0",
+    )
+    parser.add_argument(
         "--ep",
         type=int,
         default=1,
@@ -505,11 +522,16 @@ def main(argv: list[str] | None = None) -> int:
         cfg = MoeConfig.tiny()
     else:
         cfg = LlamaConfig.tiny() if args.preset == "tiny" else LlamaConfig.small()
-    if args.pp > 1 and cfg.n_layers % args.pp:
-        # Pipeline stages need a whole number of layers each; round up so
-        # the CLI works as a traffic generator at any --pp.
-        n = ((cfg.n_layers + args.pp - 1) // args.pp) * args.pp
-        log.info("rounding n_layers %d → %d for pp=%d", cfg.n_layers, n, args.pp)
+    groups = args.pp * args.interleave
+    if args.pp > 1 and cfg.n_layers % groups:
+        # Pipeline stages need a whole number of layers per (virtual)
+        # stage; round up so the CLI works as a traffic generator at any
+        # --pp/--interleave.
+        n = ((cfg.n_layers + groups - 1) // groups) * groups
+        log.info(
+            "rounding n_layers %d → %d for pp=%d interleave=%d",
+            cfg.n_layers, n, args.pp, args.interleave,
+        )
         cfg = dataclasses.replace(cfg, n_layers=n)
 
     from tpumon.workload.hlo_counters import CountersCollector, HloOpCounters
@@ -551,6 +573,7 @@ def main(argv: list[str] | None = None) -> int:
             pp=args.pp,
             ep=args.ep,
             microbatches=args.microbatches,
+            interleave=args.interleave,
             attn=args.attn,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,

@@ -9,8 +9,14 @@ The harness can expose them on its own /metrics port so Prometheus sees
 both views of the same traffic.
 
 The event payload format is undocumented, so extraction is defensive:
-stringify everything, regex for collective-op names, never raise from the
-callback (it runs inside the runtime).
+stringify everything, regex for collective-op names plus per-op
+latency/bytes figures when the payload carries them (duration_us=…,
+took 3 ms, bytes_accessed=…, 2KiB, …), never raise from the callback
+(it runs inside the runtime). Timing/size extraction makes the
+workload-side view quantitatively correlatable with the exporter's
+``accelerator_collective_latency_microseconds`` (BASELINE config 4
+pairs link bandwidth with these counters): both describe the same
+fabric traffic, one from inside the process, one from the node.
 """
 
 from __future__ import annotations
@@ -28,6 +34,48 @@ COLLECTIVE_RE = re.compile(
     r"|collective-broadcast|send|recv)\b"
 )
 
+#: Duration figures in event text, any of the spellings observed across
+#: XLA/runtime log genres. Two shapes — unit after the value
+#: (``took 3 ms``, ``latency: 250ns``) and unit embedded in the key
+#: (``duration_us=12.5``, ``time_ns: 40``). Unit is required either way —
+#: a bare number after "time" is as likely a timestamp as a duration.
+_DURATION_VALUNIT_RE = re.compile(
+    r"\b(?:duration|latency|elapsed|took|time)[_\s:=]*?[\s:=]"
+    r"(\d+(?:\.\d+)?)\s*(ns|us|µs|usec|microseconds?|ms|msec|"
+    r"milliseconds?|s|sec|seconds?)\b",
+    re.IGNORECASE,
+)
+
+_DURATION_KEYUNIT_RE = re.compile(
+    r"\b(?:duration|latency|elapsed|time)_(ns|us|usec|ms|msec|s|sec)"
+    r"\s*[:=]\s*(\d+(?:\.\d+)?)",
+    re.IGNORECASE,
+)
+
+_DURATION_US = {
+    "ns": 1e-3,
+    "us": 1.0, "µs": 1.0, "usec": 1.0, "microsecond": 1.0,
+    "microseconds": 1.0,
+    "ms": 1e3, "msec": 1e3, "millisecond": 1e3, "milliseconds": 1e3,
+    "s": 1e6, "sec": 1e6, "second": 1e6, "seconds": 1e6,
+}
+
+#: Byte figures: ``bytes_accessed=4096``, ``size: 2KiB``, ``payload=1MB``.
+#: The unit suffix is optional (default: bytes).
+_BYTES_RE = re.compile(
+    r"(?:bytes(?:_accessed|_transferred|_sent|_received)?|"
+    r"size(?:_bytes|_in_bytes)?|payload)[_\s:=]*"
+    r"(\d+(?:\.\d+)?)\s*(kib|kb|mib|mb|gib|gb|b)?\b",
+    re.IGNORECASE,
+)
+
+_BYTES_MULT = {
+    None: 1.0, "": 1.0, "b": 1.0,
+    "kb": 1e3, "kib": 1024.0,
+    "mb": 1e6, "mib": 1024.0**2,
+    "gb": 1e9, "gib": 1024.0**3,
+}
+
 
 class HloOpCounters:
     """Counts collective-op mentions in HLO logger events. Thread-safe."""
@@ -35,6 +83,14 @@ class HloOpCounters:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counts: Counter[str] = Counter()
+        # Per-op extracted figures (absent until an event carries one):
+        # summed latency (µs) + how many events contributed (the honest
+        # denominator for averages — most events carry no timing), and
+        # summed bytes likewise.
+        self._latency_us: Counter[str] = Counter()
+        self._latency_samples: Counter[str] = Counter()
+        self._bytes: Counter[str] = Counter()
+        self._bytes_samples: Counter[str] = Counter()
         self._events = 0
         self._ids = None
 
@@ -82,12 +138,40 @@ class HloOpCounters:
             pass
 
     def observe(self, text: str) -> None:
-        """Count collective mentions in one event (public for tests)."""
-        ops = COLLECTIVE_RE.findall(text.lower())
+        """Count collective mentions in one event (public for tests);
+        extract per-op latency/bytes when the payload carries them.
+
+        A single event's figures are attributed to its FIRST collective
+        mention: an event naming several ops (a fusion log line) has no
+        per-op breakdown to honor, and attributing one duration to every
+        mentioned op would multiply the measured time.
+        """
+        lower = text.lower()
+        ops = COLLECTIVE_RE.findall(lower)
+        dur_us = 0.0
+        n_dur = 0
+        nbytes = 0.0
+        n_bytes = 0
+        if ops:
+            for value, unit in _DURATION_VALUNIT_RE.findall(lower):
+                dur_us += float(value) * _DURATION_US[unit]
+                n_dur += 1
+            for unit, value in _DURATION_KEYUNIT_RE.findall(lower):
+                dur_us += float(value) * _DURATION_US[unit]
+                n_dur += 1
+            for value, unit in _BYTES_RE.findall(lower):
+                nbytes += float(value) * _BYTES_MULT[unit or None]
+                n_bytes += 1
         with self._lock:
             self._events += 1
             for op in ops:
                 self._counts[op] += 1
+            if ops and n_dur:
+                self._latency_us[ops[0]] += dur_us
+                self._latency_samples[ops[0]] += 1
+            if ops and n_bytes:
+                self._bytes[ops[0]] += nbytes
+                self._bytes_samples[ops[0]] += 1
 
     # -- read side ---------------------------------------------------------
 
@@ -95,12 +179,30 @@ class HloOpCounters:
         with self._lock:
             return dict(self._counts), self._events
 
+    def detailed_snapshot(self) -> dict:
+        """Counts plus the extracted per-op latency/bytes aggregates."""
+        with self._lock:
+            return {
+                "counts": dict(self._counts),
+                "events": self._events,
+                "latency_us": dict(self._latency_us),
+                "latency_samples": dict(self._latency_samples),
+                "bytes": dict(self._bytes),
+                "bytes_samples": dict(self._bytes_samples),
+            }
+
 
 def counters_families(counters: HloOpCounters):
-    """Prometheus families for a workload-side /metrics endpoint."""
+    """Prometheus families for a workload-side /metrics endpoint.
+
+    One snapshot serves the whole scrape: counts and latency figures
+    taken under separate lock acquisitions could disagree (a scrape
+    showing more latency samples than op counts breaks avg queries).
+    """
     from prometheus_client.core import CounterMetricFamily
 
-    counts, events = counters.snapshot()
+    detail = counters.detailed_snapshot()
+    counts, events = detail["counts"], detail["events"]
     fam = CounterMetricFamily(
         "workload_collective_ops_total",
         "XLA collective HLO ops observed by the in-process libtpu HLO "
@@ -117,6 +219,36 @@ def counters_families(counters: HloOpCounters):
     )
     ev.add_metric((), events)
     yield ev
+
+    if detail["latency_us"]:
+        lat = CounterMetricFamily(
+            "workload_collective_op_latency_microseconds_total",
+            "Summed per-op latency extracted from HLO logger events "
+            "(absent until an event carries a duration figure; correlate "
+            "with accelerator_collective_latency_microseconds).",
+            labels=("op",),
+        )
+        samples = CounterMetricFamily(
+            "workload_collective_op_latency_samples_total",
+            "Events that carried a duration figure, by op — the honest "
+            "denominator for average-latency queries.",
+            labels=("op",),
+        )
+        for op, us in sorted(detail["latency_us"].items()):
+            lat.add_metric((op,), us)
+            samples.add_metric((op,), detail["latency_samples"][op])
+        yield lat
+        yield samples
+    if detail["bytes"]:
+        by = CounterMetricFamily(
+            "workload_collective_op_bytes_total",
+            "Summed per-op payload bytes extracted from HLO logger "
+            "events (absent until an event carries a size figure).",
+            labels=("op",),
+        )
+        for op, n in sorted(detail["bytes"].items()):
+            by.add_metric((op,), n)
+        yield by
 
 
 class CountersCollector:

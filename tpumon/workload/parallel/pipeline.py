@@ -1,17 +1,33 @@
-"""Pipeline parallelism: GPipe schedule over the mesh's ``stage`` axis.
+"""Pipeline parallelism over the mesh's ``stage`` axis: GPipe and the
+circular/interleaved schedule, one scan.
 
 Layer-stacked weights are sharded on their leading (layer) axis, so each
-device holds ``n_layers / pp`` contiguous layers. Microbatches march
-through the stages with one ``lax.ppermute`` hop per schedule tick — the
+device holds ``n_layers / pp`` layers. Microbatches march through the
+stages with one ``lax.ppermute`` hop per schedule tick — the
 neighbor-to-neighbor ICI traffic a pipeline-parallel trainer actually
 produces, which is what the monitor's ``ici_link_health`` /
 ``collective_e2e_latency`` panels display (SURVEY.md §2.4).
 
+Two schedules, selected by ``interleave``:
+
+- ``interleave=1`` — GPipe: each device holds one contiguous block of
+  layers; bubble fraction ``(pp-1)/(M+pp-1)`` for M microbatches.
+- ``interleave=v>1`` — the circular (Megatron-interleaved-style)
+  schedule: each device holds ``v`` non-adjacent layer chunks ("virtual
+  stages"); microbatches loop around the stage ring ``v`` times, so the
+  bubble shrinks to ``(pp-1)/(M·v+pp-1)`` — the same pipeline-depth win
+  the 1F1B/interleaved schedules buy on GPU stacks. The backward pass is
+  not a hand-scheduled state machine: the schedule is a ``lax.scan``,
+  XLA's autodiff reverses it tick-for-tick (backward naturally runs the
+  interleaved schedule mirrored), and ``remat=True`` bounds the stashed
+  activations by recomputing stage bodies — together covering what 1F1B
+  exists to do (small bubble, bounded activation memory) in compiler
+  terms instead of runtime-scheduler terms.
+
 Written the XLA way:
 
-- the schedule is a ``lax.scan`` over ``microbatches + pp - 1`` ticks
-  (bubble included), so it is reverse-differentiable and the SAME code
-  path runs forward and backward — no hand-scheduled 1F1B state machine;
+- the schedule is a ``lax.scan`` over ticks (bubble included), so it is
+  reverse-differentiable and the SAME code path runs forward and backward;
 - stages compute on zero-padding during bubble ticks (branchless; a
   ``where`` on the stage index selects real inputs), trading a few wasted
   FLOPs for a single fused program with static shapes;
@@ -19,14 +35,19 @@ Written the XLA way:
   over the stage axis replicates them back (the gradient of that psum is
   the identity into the last stage, so backward stays cheap).
 
-Composes with DP (batch over ``data``) and TP (Megatron column/row shards
+Composes with DP (batch over ``data``), TP (Megatron column/row shards
+*inside* each stage body), and SP (ring attention over the ``seq`` axis
 *inside* each stage body): the whole pipe runs in one ``shard_map``, so
-the all-reduces XLA inserts automatically on the non-pipelined path are
+the collectives XLA inserts automatically on the non-pipelined path are
 written out manually here — one ``psum`` over ``model`` after the
-row-sharded ``wo`` and ``w_down`` projections, the classic Megatron "g"
-collective. Head counts are divided per model shard (a local
+row-sharded ``wo`` and ``w_down`` projections (the classic Megatron "g"
+collective), and the K/V ``ppermute`` ring over ``seq``
+(parallel.ring.ring_attention_local, which is built to run inside an
+enclosing shard_map). Head counts are divided per model shard (a local
 LlamaConfig), so attention runs on its head slice and GQA grouping is
-preserved (``n_heads/tp ÷ n_kv_heads/tp`` = the global ratio).
+preserved (``n_heads/tp ÷ n_kv_heads/tp`` = the global ratio); RoPE on a
+sequence shard uses globally-offset positions (the shard's
+``axis_index("seq") · S_local`` base).
 """
 
 from __future__ import annotations
@@ -36,10 +57,12 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpumon.workload.models import llama as _llama
 from tpumon.workload.ops.core import rms_norm, rope_freqs
+from tpumon.workload.parallel.ring import ring_attention_local
 
 
 def _stage_layer_specs() -> dict:
@@ -68,18 +91,21 @@ def pipeline_param_specs() -> dict:
     }
 
 
-def _stage_body(layers_local, x, cfg, freqs, mask, tp):
+def _stage_body(layers_local, x, cfg, freqs, mask, tp, attn_impl=None):
     """Run this stage's layer block on one microbatch [mb, S, D].
 
     ``cfg`` carries *per-model-shard* head counts (see
     make_pipelined_forward); with tp > 1 the row-sharded output
     projections produce partial sums, reduced with an explicit psum over
     ``model`` — inside shard_map, Megatron's collectives are manual.
+    ``attn_impl`` swaps the attention core (ring attention when the seq
+    axis is live).
     """
 
     def block(h, layer):
         a = _llama._attention(
-            rms_norm(h, layer["attn_norm"]), layer, cfg, freqs, mask
+            rms_norm(h, layer["attn_norm"]), layer, cfg, freqs, mask,
+            attn_impl,
         )
         if tp > 1:
             a = jax.lax.psum(a, "model")
@@ -94,16 +120,57 @@ def _stage_body(layers_local, x, cfg, freqs, mask, tp):
     return h
 
 
-def make_pipelined_forward(mesh: Mesh, cfg, *, microbatches: int = 2):
-    """logits = f(params, tokens): GPipe over the mesh's ``stage`` axis.
+def _schedule(microbatches: int, pp: int, v: int) -> tuple[np.ndarray, np.ndarray, int]:
+    """Static tick schedule: (in_ticks, out_ticks, total_ticks).
+
+    Microbatches flow in rounds of ``pp``; within a round each microbatch
+    traverses all ``v`` chunks (one full ring lap per chunk) before the
+    next round enters. Microbatch ``m`` enters stage 0 chunk 0 at tick
+    ``(m//pp)·pp·v + m%pp`` and leaves stage pp-1 chunk v-1 ``(v-1)·pp +
+    (pp-1)`` ticks later. At v=1 this degenerates to exactly GPipe:
+    in at ``m``, out at ``m + pp - 1``.
+    """
+    m = np.arange(microbatches)
+    in_ticks = (m // pp) * pp * v + (m % pp)
+    out_ticks = in_ticks + (v - 1) * pp + (pp - 1)
+    return in_ticks, out_ticks, int(out_ticks[-1]) + 1
+
+
+def make_pipelined_forward(
+    mesh: Mesh,
+    cfg,
+    *,
+    microbatches: int = 2,
+    interleave: int = 1,
+    remat: bool = False,
+):
+    """logits = f(params, tokens): pipeline over the mesh's ``stage`` axis.
 
     params is the models.llama tree sharded with pipeline_param_specs();
     tokens [B, S] with B divisible by data-shards × microbatches.
+    ``interleave=v`` selects the circular schedule (v layer chunks per
+    stage, bubble ÷ v); ``remat=True`` recomputes stage bodies in the
+    backward pass, bounding stashed activations (the memory half of the
+    1F1B story). When the mesh's ``seq`` axis is >1, activations are
+    sequence-sharded and attention runs as a K/V ring inside the stage
+    body (SP×PP composition).
     """
     pp = mesh.shape["stage"]
     tp = mesh.shape["model"]
-    if cfg.n_layers % pp:
-        raise ValueError(f"n_layers ({cfg.n_layers}) must divide by pp ({pp})")
+    spn = mesh.shape["seq"]
+    v = interleave
+    if v < 1:
+        raise ValueError(f"interleave must be >= 1, got {v}")
+    if cfg.n_layers % (pp * v):
+        raise ValueError(
+            f"n_layers ({cfg.n_layers}) must divide by pp*interleave "
+            f"({pp}*{v})"
+        )
+    if v > 1 and microbatches % pp:
+        raise ValueError(
+            f"the circular schedule feeds microbatches in rounds of pp: "
+            f"microbatches ({microbatches}) must divide by pp ({pp})"
+        )
     if cfg.n_heads % tp or cfg.n_kv_heads % tp:
         raise ValueError(
             f"n_heads ({cfg.n_heads}) and n_kv_heads ({cfg.n_kv_heads}) "
@@ -122,8 +189,9 @@ def make_pipelined_forward(mesh: Mesh, cfg, *, microbatches: int = 2):
         if tp > 1
         else cfg
     )
-
-    spec_x = P("data", None, None)
+    sp = spn > 1
+    spec_x = P("data", "seq", None) if sp else P("data", None, None)
+    in_ticks, out_ticks, total_ticks = _schedule(microbatches, pp, v)
 
     @partial(
         jax.shard_map,
@@ -137,32 +205,99 @@ def make_pipelined_forward(mesh: Mesh, cfg, *, microbatches: int = 2):
         b_loc, S, D = x.shape
         M = microbatches
         mb = b_loc // M
-        freqs = rope_freqs(cfg.head_dim, cfg.max_seq)
-        mask = jnp.triu(
-            jnp.full((cfg.max_seq, cfg.max_seq), -1e9, jnp.float32), k=1
+        freqs_full = rope_freqs(cfg.head_dim, cfg.max_seq)
+        if sp:
+            # RoPE positions are global: offset this shard's block.
+            six = jax.lax.axis_index("seq")
+            freqs = jax.lax.dynamic_slice_in_dim(freqs_full, six * S, S)
+            mask = None  # ring attention masks by global position itself
+            attn_impl = lambda q, k, v_: ring_attention_local(  # noqa: E731
+                q, k, v_, "seq"
+            )
+        else:
+            freqs = freqs_full
+            mask = jnp.triu(
+                jnp.full((cfg.max_seq, cfg.max_seq), -1e9, jnp.float32), k=1
+            )
+            attn_impl = None
+
+        # Local layer stack [v·lpg, ...] → v chunks of lpg layers. Storage
+        # is schedule-ordered (see forward()): local chunk c = rows
+        # [c·lpg, (c+1)·lpg).
+        chunks = jax.tree.map(
+            lambda a: a.reshape(v, a.shape[0] // v, *a.shape[1:]),
+            layers_local,
         )
 
         inps = x.reshape(M, mb, S, D)
-        bubble = jnp.zeros((pp - 1, mb, S, D), x.dtype)
-        xs = jnp.concatenate([inps, bubble], axis=0)  # [M + pp - 1, ...]
+        xs = (
+            jnp.zeros((total_ticks, mb, S, D), x.dtype)
+            .at[jnp.asarray(in_ticks)]
+            .set(inps)
+        )
+        # Full ring: the pp-1 → 0 wrap carries a microbatch into its next
+        # chunk (circular schedule). At v=1 stage 0 always reads the
+        # schedule, so the wrap hop is dead weight XLA keeps overlapped.
+        ring = [(i, (i + 1) % pp) for i in range(pp)]
+        period = pp * v
 
-        fwd = [(i, i + 1) for i in range(pp - 1)]  # stage i → i+1
+        def run_body(chunk, x_in, freqs, mask):
+            return _stage_body(
+                chunk, x_in, local_cfg, freqs, mask, tp, attn_impl
+            )
 
-        def tick(x_cur, inp_t):
-            x_in = jnp.where(stage == 0, inp_t, x_cur)
-            y = _stage_body(layers_local, x_in, local_cfg, freqs, mask, tp)
-            # Hop to the next stage; stage 0 receives zeros (it always
-            # reads from the schedule, never from the wire).
-            x_next = jax.lax.ppermute(y, "stage", fwd)
+        body = jax.checkpoint(run_body) if remat else run_body
+
+        def tick(x_cur, xt):
+            inp_t, t = xt
+            u = t - stage  # this stage's logical time (u<0 → bubble)
+            c = jnp.floor_divide(u, pp) % v  # chunk index; in [0, v)
+            # Stage 0 reads the schedule on chunk-0 ticks (fresh
+            # microbatch), the ring wrap otherwise. Other stages always
+            # read their left neighbor.
+            take_fresh = (stage == 0) & (jnp.mod(u, period) < pp)
+            x_in = jnp.where(take_fresh, inp_t, x_cur)
+            chunk = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, c, axis=0, keepdims=False
+                ),
+                chunks,
+            )
+            y = body(chunk, x_in, freqs, mask)
+            x_next = jax.lax.ppermute(y, "stage", ring)
             return x_next, y
 
-        _, ys = jax.lax.scan(tick, jnp.zeros((mb, S, D), x.dtype), xs)
+        _, ys = jax.lax.scan(
+            tick,
+            jnp.zeros((mb, S, D), x.dtype),
+            (xs, jnp.arange(total_ticks)),
+        )
 
-        # Microbatch m finishes on the last stage at tick m + pp - 1.
-        outs = ys[pp - 1 :]
+        # Microbatch m finishes on the last stage (chunk v-1) at its
+        # statically known out-tick.
+        outs = ys[jnp.asarray(out_ticks)]
         outs = jnp.where(stage == pp - 1, outs, jnp.zeros_like(outs))
         outs = jax.lax.psum(outs, "stage")
         return outs.reshape(b_loc, S, D)
+
+    lpg = cfg.n_layers // (pp * v)
+    if v > 1:
+        # Schedule-order the layer stack: model block j (executed j-th)
+        # lives on stage j%pp as its chunk j//pp, and stage shards are
+        # contiguous — so storage position (s, c) holds model block
+        # c·pp+s. Identity at v=1. Done under jit each step: a weight
+        # gather XLA lowers into the resharding; negligible at
+        # traffic-generator scale, and keeping checkpoints in model
+        # order is worth it.
+        order = np.concatenate(
+            [
+                np.arange(lpg) + (c * pp + s) * lpg
+                for s in range(pp)
+                for c in range(v)
+            ]
+        )
+    else:
+        order = None
 
     def forward(params, tokens):
         per_shard = tokens.shape[0] // mesh.shape["data"]
@@ -171,8 +306,16 @@ def make_pipelined_forward(mesh: Mesh, cfg, *, microbatches: int = 2):
                 f"per-data-shard batch ({per_shard}) must divide by "
                 f"microbatches ({microbatches})"
             )
+        if sp and tokens.shape[1] % spn:
+            raise ValueError(
+                f"seq ({tokens.shape[1]}) must divide by the mesh seq "
+                f"axis ({spn})"
+            )
+        layers = params["layers"]
+        if order is not None:
+            layers = jax.tree.map(lambda a: a[order], layers)
         x = params["embed"].astype(cfg.dtype)[tokens]
-        x = pipe(params["layers"], x)
+        x = pipe(layers, x)
         x = rms_norm(x, params["final_norm"])
         return (x @ params["unembed"].astype(cfg.dtype)).astype(jnp.float32)
 

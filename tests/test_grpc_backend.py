@@ -673,6 +673,63 @@ def test_watch_stream_death_falls_back_to_unary(
         be.close()
 
 
+def test_watch_disabled_pins_unary(fake_server, no_sdk, topo_file):
+    """watch=False (TPUMON_GRPC_WATCH=0): every read is a unary poll and
+    no stream is ever opened — the ops escape hatch."""
+    from tpumon.backends.grpc_backend import GrpcMonitoringBackend
+
+    be = GrpcMonitoringBackend(
+        addr=fake_server.addr, timeout=5.0, topology_file=topo_file,
+        watch=False,
+    )
+    try:
+        be.list_metrics()
+        fake_server.push("duty_cycle_pct", [({"device-id": 0}, 50.0)])
+        for _ in range(3):
+            be.sample("duty_cycle_pct")
+        assert fake_server.watch_calls == 0
+        assert be._watches == {}
+        assert fake_server.get_calls >= 3
+    finally:
+        be.close()
+
+
+def test_grpc_watch_config_knob(monkeypatch):
+    monkeypatch.setenv("TPUMON_GRPC_WATCH", "0")
+    from tpumon.config import Config
+
+    assert Config.from_env().grpc_watch is False
+    assert Config().grpc_watch is True
+
+
+def test_watch_states_surface(fake_server, no_sdk, topo_file):
+    """doctor's push/poll surface: streaming when fresh rows serve the
+    poll, open-idle before the first push, down after stream death."""
+    from tpumon.backends.grpc_backend import GrpcMonitoringBackend
+
+    be = GrpcMonitoringBackend(
+        addr=fake_server.addr, timeout=5.0, topology_file=topo_file
+    )
+    try:
+        be.list_metrics()
+        assert be.watch_states() == {}  # no watches before first sample
+        be.sample("duty_cycle_pct")
+        assert _wait_until(
+            lambda: be.watch_states().get("duty_cycle_pct") == "open-idle"
+        )
+        fake_server.push("duty_cycle_pct", [({"device-id": 0}, 50.0)])
+        assert _wait_until(
+            lambda: be.watch_states().get("duty_cycle_pct") == "streaming"
+        )
+        be.stream_fresh_seconds = 0.0  # everything is instantly stale
+        fake_server.end_watches()
+        assert _wait_until(
+            lambda: be.watch_states().get("duty_cycle_pct") == "down"
+        )
+    finally:
+        be.close()
+
+
 def test_watch_pruned_when_metric_delisted(fake_server, no_sdk, topo_file):
     """A metric leaving the enumeration must close its watch — else the
     reader thread and server stream leak for the life of the process."""

@@ -231,6 +231,23 @@ class TestPipeline:
         out = fwd(sharded, tokens)
         assert float(jnp.max(jnp.abs(out - ref))) == 0.0
 
+    def test_interleaved_multi_round_matches_dense(self):
+        """M = 2·pp: two rounds of microbatches flow through the circular
+        schedule back-to-back (the round-entry timing is where an
+        off-by-one in the tick schedule would land)."""
+        cfg = llama.LlamaConfig(n_layers=4)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab, jnp.int32
+        )
+        ref = llama.forward(params, tokens, cfg)
+        mesh = make_mesh(2, 1, 1, 2)
+        sharded = shard_tree(params, pipeline_param_specs(), mesh)
+        fwd = jax.jit(
+            make_pipelined_forward(mesh, cfg, microbatches=4, interleave=2)
+        )
+        assert float(jnp.max(jnp.abs(fwd(sharded, tokens) - ref))) == 0.0
+
     def test_interleave_requires_round_microbatches(self):
         cfg = llama.LlamaConfig(n_layers=4)
         mesh = make_mesh(1, 1, 1, 2)

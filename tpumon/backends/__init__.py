@@ -39,6 +39,7 @@ def create_backend(cfg: Config) -> Backend:
             timeout=cfg.grpc_timeout,
             topology_file=cfg.topology_file,
             service=cfg.grpc_service,
+            watch=cfg.grpc_watch,
         )
     if kind == "fake":
         from tpumon.backends.fake import FakeTpuBackend

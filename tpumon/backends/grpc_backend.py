@@ -402,10 +402,15 @@ class GrpcMonitoringBackend:
         timeout: float = 2.0,
         topology_file: str | None = None,
         service: str = DEFAULT_SERVICE,
+        watch: bool = True,
     ) -> None:
         self.addr = addr
         self.timeout = timeout
         self.service = service
+        #: Subscribe to a server-streaming watch method when the service
+        #: has one; False pins every read to the unary poll (ops escape
+        #: hatch, TPUMON_GRPC_WATCH=0).
+        self.watch = watch
         self._topology_file = topology_file
         self._channel = None
         self._stub = None
@@ -495,7 +500,9 @@ class GrpcMonitoringBackend:
             return None
         self._list_method = self._pick_method(stub, want_list=True)
         self._get_method = self._pick_method(stub, want_list=False)
-        self._watch_method = self._pick_watch_method(stub)
+        self._watch_method = (
+            self._pick_watch_method(stub) if self.watch else None
+        )
         if self._get_method is None:
             log.warning(
                 "service %s has no metric-read method (methods: %s)",
@@ -725,6 +732,22 @@ class GrpcMonitoringBackend:
         they look like renamed SDK metrics (server name → SDK name), from
         the last list_metrics(). Doctor warns on these."""
         return dict(self._suspected_renames)
+
+    def watch_states(self) -> dict[str, str]:
+        """Per-metric watch-stream state (doctor's push/poll surface):
+        'streaming' = fresh push-fed rows are serving the poll;
+        'open-idle' = stream up but nothing pushed inside the freshness
+        window (unary fallback carries the metric);
+        'down' = stream dead, reopen throttled (unary fallback)."""
+        out: dict[str, str] = {}
+        for name, watch in self._watches.items():
+            if watch.fresh_rows(self.stream_fresh_seconds) is not None:
+                out[name] = "streaming"
+            elif watch._thread is not None and watch._thread.is_alive():
+                out[name] = "open-idle"
+            else:
+                out[name] = "down"
+        return out
 
     def sample(self, name: str) -> RawMetric:
         source = self._sources.get(name)

@@ -89,6 +89,10 @@ class Config:
     #: Serve the exporter's own gRPC metrics service (Get/Watch +
     #: reflection) on this port; -1 disables, 0 binds an ephemeral port.
     grpc_serve_port: int = -1
+    #: Subscribe to the runtime service's server-streaming watch method
+    #: when it has one (push-fed samples, unary fallback). Disable if a
+    #: runtime's stream implementation misbehaves — polling always works.
+    grpc_watch: bool = True
     #: Emit per-link ICI gauges (can be high-cardinality on big slices).
     ici_per_link: bool = True
     #: Emit host context gauges (CPU/mem/load/net via psutil) next to the
@@ -131,6 +135,7 @@ class Config:
             grpc_service=_env("GRPC_SERVICE", base.grpc_service)
             or base.grpc_service,
             grpc_serve_port=_env_int("GRPC_SERVE_PORT", base.grpc_serve_port),
+            grpc_watch=_env_bool("GRPC_WATCH", base.grpc_watch),
             ici_per_link=_env_bool("ICI_PER_LINK", base.ici_per_link),
             host_metrics=_env_bool("HOST_METRICS", base.host_metrics),
             histograms=_env_bool("HISTOGRAMS", base.histograms),

@@ -12,6 +12,7 @@ pod-attribution availability. Exit code 0 when coverage meets the target
 from __future__ import annotations
 
 import sys
+from collections import Counter
 
 from tpumon.backends import create_backend
 from tpumon.backends.base import BackendError
@@ -107,14 +108,22 @@ def run(cfg: Config, out=sys.stdout, backend=None) -> int:
         # unified metric counts once, routed to exactly one transport.
         sources_fn = getattr(backend, "sources", None)
         if sources_fn is not None:
-            from collections import Counter
-
             routes = Counter(sources_fn().values())
             if routes:
                 p(
                     "transport routing: "
                     + ", ".join(
                         f"{n} via {src}" for src, n in sorted(routes.items())
+                    )
+                )
+        watch_fn = getattr(backend, "watch_states", None)
+        if watch_fn is not None:
+            states = Counter(watch_fn().values())
+            if states:
+                p(
+                    "watch streams: "
+                    + ", ".join(
+                        f"{n} {state}" for state, n in sorted(states.items())
                     )
                 )
         renames_fn = getattr(backend, "suspected_renames", None)

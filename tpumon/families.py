@@ -95,6 +95,19 @@ WORKLOAD_FAMILIES: dict[str, str] = {
     "workload_hlo_log_events_total": (
         "Total HLO logger events received in-process"
     ),
+    "workload_collective_op_latency_microseconds_total": (
+        "Summed per-op latency extracted from HLO logger events (absent "
+        "until an event carries a duration figure; correlate with "
+        "accelerator_collective_latency_microseconds)"
+    ),
+    "workload_collective_op_latency_samples_total": (
+        "Events that carried a duration figure, by op — the denominator "
+        "for average-latency queries"
+    ),
+    "workload_collective_op_bytes_total": (
+        "Summed per-op payload bytes extracted from HLO logger events "
+        "(absent until an event carries a size figure)"
+    ),
 }
 
 

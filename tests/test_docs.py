@@ -45,10 +45,15 @@ def test_registry_matches_live_scrape():
     unknown = served - all_family_names()
     assert not unknown, f"served families missing from tpumon/families.py: {unknown}"
 
-    # Everything the fake can produce is served (pod_info needs a kubelet).
+    # Everything the fake can produce is served (pod_info needs a
+    # kubelet; watch streams need the grpc backend's runtime service,
+    # covered by tests/test_grpc_backend.py::test_watch_streams_family_scrapeable).
     expected = (
         {s.family for s in LIBTPU_SPECS}
-        | (set(IDENTITY_FAMILIES) - {"accelerator_pod_info"})
+        | (
+            set(IDENTITY_FAMILIES)
+            - {"accelerator_pod_info", "accelerator_monitor_watch_streams"}
+        )
         | set(distribution_family_rows())
     )
     missing = expected - served

@@ -730,6 +730,43 @@ def test_watch_states_surface(fake_server, no_sdk, topo_file):
         be.close()
 
 
+def test_watch_streams_family_scrapeable(fake_server, no_sdk, topo_file):
+    """The transport state lands in the exposition as
+    accelerator_monitor_watch_streams{state=...} once watches exist."""
+    from tpumon.backends.grpc_backend import GrpcMonitoringBackend
+    from tpumon.config import Config
+    from tpumon.exporter.collector import build_families
+
+    be = GrpcMonitoringBackend(
+        addr=fake_server.addr, timeout=5.0, topology_file=topo_file
+    )
+    try:
+        cfg = Config(host_metrics=False)
+        # The first poll's sampling opens the watches lazily, so the
+        # family is present from poll #1 (all open-idle before a push).
+        families, _ = build_families(be, cfg)
+        fam = next(
+            f for f in families
+            if f.name == "accelerator_monitor_watch_streams"
+        )
+        assert {s.labels["state"] for s in fam.samples} == {"open-idle"}
+
+        fake_server.push("duty_cycle_pct", [({"device-id": 0}, 50.0)])
+        assert _wait_until(
+            lambda: be.watch_states().get("duty_cycle_pct") == "streaming"
+        )
+        families, _ = build_families(be, cfg)
+        fam = next(
+            f for f in families
+            if f.name == "accelerator_monitor_watch_streams"
+        )
+        by_state = {s.labels["state"]: s.value for s in fam.samples}
+        assert by_state.get("streaming") == 1.0
+        assert sum(by_state.values()) == len(be.watch_states())
+    finally:
+        be.close()
+
+
 def test_watch_pruned_when_metric_delisted(fake_server, no_sdk, topo_file):
     """A metric leaving the enumeration must close its watch — else the
     reader thread and server stream leak for the life of the process."""

@@ -255,6 +255,35 @@ def build_families(
                 fam.add_metric(base_vals + (str(core), str(state)), 1.0)
             families.append(fam)
 
+    # Transport state of the runtime monitoring watch streams (grpc
+    # backend only): scrapeable so "pushes stopped, polling carries it"
+    # is a dashboard fact, not a doctor-only one.
+    watch_states_fn = getattr(backend, "watch_states", None)
+    if watch_states_fn is not None:
+        try:
+            watch_states = watch_states_fn()
+        except Exception as exc:
+            log.debug("watch_states failed: %s", exc)
+            watch_states = {}
+        if watch_states:
+            from collections import Counter as _Counter
+
+            from tpumon.families import IDENTITY_FAMILIES
+
+            help_text, extra = IDENTITY_FAMILIES[
+                "accelerator_monitor_watch_streams"
+            ]
+            fam = GaugeMetricFamily(
+                "accelerator_monitor_watch_streams",
+                help_text,
+                labels=base_keys + extra,
+            )
+            for state, n in sorted(
+                _Counter(watch_states.values()).items()
+            ):
+                fam.add_metric(base_vals + (state,), float(n))
+            families.append(fam)
+
     # Host context gauges (CPU/mem/load/net): the host-side-telemetry
     # companion signals for diagnosing accelerator symptoms.
     if cfg.host_metrics:

@@ -36,6 +36,12 @@ IDENTITY_FAMILIES: dict[str, tuple[str, tuple[str, ...]]] = {
         "Accelerator devices allocated to pods (kubelet pod-resources API)",
         ("namespace", "pod", "container", "resource", "chip", "device_id"),
     ),
+    "accelerator_monitor_watch_streams": (
+        "Runtime monitoring watch streams by state (streaming / "
+        "open-idle / down); absent unless the grpc backend has opened "
+        "watches. Unary polling carries any non-streaming metric",
+        ("state",),
+    ),
 }
 
 #: family -> (description, extra labels) — derived by the exporter from

@@ -128,6 +128,7 @@ def run(
     ep: int = 1,
     microbatches: int = 2,
     interleave: int = 1,
+    sp_layout: str = "contiguous",
     seed: int = 0,
     mesh=None,
     attn: str = "xla",
@@ -195,12 +196,27 @@ def run(
             raise ValueError("sp > 1 requires a mesh")
         if seq % sp:
             raise ValueError(f"seq ({seq}) must divide by sp ({sp})")
+        if sp_layout not in ("contiguous", "zigzag"):
+            raise ValueError(f"unknown sp_layout: {sp_layout!r}")
+        if sp_layout == "zigzag":
+            if pp > 1:
+                raise ValueError(
+                    "sp_layout='zigzag' does not compose with pp > 1 "
+                    "(the pipelined forward's internal ring is contiguous)"
+                )
+            if seq % (2 * sp):
+                raise ValueError(
+                    f"zigzag needs an even local shard: seq ({seq}) must "
+                    f"divide by 2*sp ({2 * sp})"
+                )
         if pp == 1:
             # Under pp the pipelined forward owns the attention impl AND
             # the activation layout (its shard_map specs), so both stay
-            # unset on that path.
+            # unset on that path (and its internal ring is contiguous).
             attn_impl = make_ring_attn(
-                mesh, head_axis="model" if tp > 1 else None
+                mesh,
+                head_axis="model" if tp > 1 else None,
+                zigzag=sp_layout == "zigzag",
             )
             shard_acts = make_act_sharder(mesh, sp=True)
     if is_moe and mesh is not None:
@@ -398,6 +414,14 @@ def main(argv: list[str] | None = None) -> int:
         "devices on the mesh's seq axis",
     )
     parser.add_argument(
+        "--sp-layout",
+        choices=("contiguous", "zigzag"),
+        default="contiguous",
+        help="sequence-shard layout for ring attention: zigzag balances "
+        "the causal workload and halves attention FLOPs "
+        "(parallel.ring.zigzag_ring_attention_local)",
+    )
+    parser.add_argument(
         "--pp",
         type=int,
         default=1,
@@ -574,6 +598,7 @@ def main(argv: list[str] | None = None) -> int:
             ep=args.ep,
             microbatches=args.microbatches,
             interleave=args.interleave,
+            sp_layout=args.sp_layout,
             attn=args.attn,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,

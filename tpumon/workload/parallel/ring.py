@@ -109,8 +109,169 @@ def ring_attention_local(
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B,S,H,D]
 
 
+def _zigzag_perms(n: int) -> tuple[list, list, list, list]:
+    """Static ppermute pair lists for contiguous↔zigzag redistribution.
+
+    Stripe g (of 2n stripes) lives contiguously on device g//2; zigzag
+    places it on device g (lo slot) when g < n, else device 2n-1-g (hi
+    slot). One ppermute can deliver at most one array per device, so the
+    exchange rides two: ``fwd_even`` carries each device's even stripe
+    (its first half, stripe 2d), ``fwd_odd`` the odd one. Each is a
+    permutation (destinations 2d / 2n-1-2d and 2d+1 / 2n-2-2d are
+    pairwise distinct), and the inverses are the reversed pairs.
+    """
+    fwd_even = []
+    fwd_odd = []
+    for d in range(n):
+        g_even, g_odd = 2 * d, 2 * d + 1
+        fwd_even.append((d, g_even if g_even < n else 2 * n - 1 - g_even))
+        fwd_odd.append((d, g_odd if g_odd < n else 2 * n - 1 - g_odd))
+    inv_even = [(dst, src) for src, dst in fwd_even]
+    inv_odd = [(dst, src) for src, dst in fwd_odd]
+    return fwd_even, fwd_odd, inv_even, inv_odd
+
+
+def _to_zigzag(x, axis_name: str):
+    """Contiguous local block [B, 2s, ...] → zigzag block [stripe_d;
+    stripe_{2n-1-d}]. Runs inside shard_map; two neighbor ppermutes."""
+    n = jax.lax.axis_size(axis_name)
+    d = jax.lax.axis_index(axis_name)
+    fwd_even, fwd_odd, _, _ = _zigzag_perms(n)
+    s = x.shape[1] // 2
+    recv_even = jax.lax.ppermute(x[:, :s], axis_name, fwd_even)
+    recv_odd = jax.lax.ppermute(x[:, s:], axis_name, fwd_odd)
+    # Device d's lo slot holds stripe d — delivered by the even carrier
+    # iff d is even; the hi slot holds stripe 2n-1-d, even iff d is odd.
+    even_here = (d % 2) == 0
+    lo = jnp.where(even_here, recv_even, recv_odd)
+    hi = jnp.where(even_here, recv_odd, recv_even)
+    return jnp.concatenate([lo, hi], axis=1)
+
+
+def _from_zigzag(x, axis_name: str):
+    """Inverse of :func:`_to_zigzag` (zigzag block → contiguous block)."""
+    n = jax.lax.axis_size(axis_name)
+    d = jax.lax.axis_index(axis_name)
+    _, _, inv_even, inv_odd = _zigzag_perms(n)
+    s = x.shape[1] // 2
+    lo, hi = x[:, :s], x[:, s:]
+    # The even-stripe carrier needs this device's even stripe: stripe d
+    # (lo slot) when d is even, stripe 2n-1-d (hi slot) when d is odd.
+    even_here = (d % 2) == 0
+    send_even = jnp.where(even_here, lo, hi)
+    send_odd = jnp.where(even_here, hi, lo)
+    recv_first = jax.lax.ppermute(send_even, axis_name, inv_even)
+    recv_second = jax.lax.ppermute(send_odd, axis_name, inv_odd)
+    return jnp.concatenate([recv_first, recv_second], axis=1)
+
+
+def zigzag_ring_attention_local(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+) -> jnp.ndarray:
+    """Causal ring attention over ZIGZAG-laid-out sequence shards.
+
+    With contiguous shards, causal ring attention computes every arriving
+    K/V block branchlessly and masks the future ones away — half the
+    attention FLOPs are spent on fully-masked work, and the *useful* work
+    is maximally imbalanced (device n-1 needs n blocks, device 0 one).
+    The zigzag layout (device d holds stripes d and 2n-1-d of 2n) makes
+    every off-diagonal hop need exactly TWO fully-unmasked stripe pairs:
+
+    - arriving block older than ours (o < d): our lo and hi stripes both
+      attend the sender's lo stripe in full;
+    - arriving block newer (o > d): only our hi stripe attends — the
+      sender's lo and hi stripes, both in full.
+
+    So each hop runs two stripe-size attention steps with no mask at all
+    (half the branchless-contiguous FLOPs), identical on every device.
+    The self block (step 0) pays one causally-masked local pass. Wire
+    cost is unchanged: one K/V block rotates per hop.
+
+    q [B, 2s, H, D], k/v [B, 2s, KV, D] in zigzag layout (use
+    :func:`_to_zigzag` / :func:`_from_zigzag` to redistribute).
+    """
+    n = jax.lax.axis_size(axis_name)
+    d = jax.lax.axis_index(axis_name)
+    B, S2, H, Dh = q.shape
+    s = S2 // 2
+    rep = H // k.shape[2]
+    scale = 1.0 / jnp.sqrt(jnp.float32(Dh))
+
+    q32 = q.astype(jnp.float32)
+    pos = jnp.arange(s, dtype=jnp.int32)
+    pos_lo = d * s + pos
+    pos_hi = (2 * n - 1 - d) * s + pos
+    q_pos = jnp.concatenate([pos_lo, pos_hi])
+
+    def expand(b):
+        return jnp.repeat(b, rep, axis=2) if rep > 1 else b
+
+    # Step 0: the local block attends itself, causally, at global
+    # positions (the only masked compute in the whole schedule).
+    m = jnp.full((B, H, S2), _NEG_BIG, jnp.float32)
+    l = jnp.zeros((B, H, S2), jnp.float32)
+    o = jnp.zeros((B, H, S2, Dh), jnp.float32)
+    self_mask = q_pos[:, None] >= q_pos[None, :]
+    m, l, o = _block_attn(q32, expand(k), expand(v), self_mask, m, l, o, scale)
+
+    # Split accumulators per query stripe for the unmasked hop updates.
+    m_lo, m_hi = m[..., :s], m[..., s:]
+    l_lo, l_hi = l[..., :s], l[..., s:]
+    o_lo, o_hi = o[..., :s, :], o[..., s:, :]
+    q_lo32, q_hi32 = q32[:, :s], q32[:, s:]
+    full = jnp.ones((s, s), bool)
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(i, carry):
+        m_lo, l_lo, o_lo, m_hi, l_hi, o_hi, k, v = carry
+        # Rotate first: at iteration i we hold the block that started on
+        # device (d - i) mod n.
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        src = (d - i) % n
+        older = src < d  # sender's lo stripe is older than both of ours
+        k_lo, k_hi = expand(k[:, :s]), expand(k[:, s:])
+        v_lo, v_hi = expand(v[:, :s]), expand(v[:, s:])
+
+        # Slot 1: (lo if older else hi) × sender's lo — always unmasked.
+        q1 = jnp.where(older, q_lo32, q_hi32)
+        m1 = jnp.where(older, m_lo, m_hi)
+        l1 = jnp.where(older, l_lo, l_hi)
+        o1 = jnp.where(older, o_lo, o_hi)
+        m1, l1, o1 = _block_attn(q1, k_lo, v_lo, full, m1, l1, o1, scale)
+        m_lo = jnp.where(older, m1, m_lo)
+        l_lo = jnp.where(older, l1, l_lo)
+        o_lo = jnp.where(older, o1, o_lo)
+        m_hi = jnp.where(older, m_hi, m1)
+        l_hi = jnp.where(older, l_hi, l1)
+        o_hi = jnp.where(older, o_hi, o1)
+
+        # Slot 2: hi × (sender's lo if older else sender's hi) — always
+        # unmasked (an older sender's lo is older than our hi; a newer
+        # sender's hi stripe 2n-1-src is still older than ours 2n-1-d).
+        k2 = jnp.where(older, k_lo, k_hi)
+        v2 = jnp.where(older, v_lo, v_hi)
+        m_hi, l_hi, o_hi = _block_attn(
+            q_hi32, k2, v2, full, m_hi, l_hi, o_hi, scale
+        )
+        return m_lo, l_lo, o_lo, m_hi, l_hi, o_hi, k, v
+
+    m_lo, l_lo, o_lo, m_hi, l_hi, o_hi, k, v = jax.lax.fori_loop(
+        1, n, step, (m_lo, l_lo, o_lo, m_hi, l_hi, o_hi, k, v)
+    )
+    l_full = jnp.concatenate([l_lo, l_hi], axis=-1)
+    o_full = jnp.concatenate([o_lo, o_hi], axis=-2)
+    out = o_full / l_full[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
 def make_ring_attn(
-    mesh: Mesh, *, data_axis="data", seq_axis="seq", head_axis=None, causal=True
+    mesh: Mesh, *, data_axis="data", seq_axis="seq", head_axis=None, causal=True,
+    zigzag=False,
 ):
     """An attention callable q,k,v → out with the sequence axis ring-sharded.
 
@@ -122,16 +283,42 @@ def make_ring_attn(
     zero extra communication. K/V stay KV-headed on the ring (expansion is
     local, after each hop) unless the model axis doesn't divide KV — then
     they are pre-expanded to H so any tp ≤ H still shards.
+
+    ``zigzag=True`` (causal only) redistributes each shard into the
+    balanced zigzag stripe layout before the ring and back after —
+    halving the attention FLOPs (see zigzag_ring_attention_local). The
+    redistribution costs eight stripe-size ppermutes per call (two each
+    for q/k/v in, two for the output back), all neighbor-or-near ICI
+    hops; worth it as soon as S²-attention dominates, i.e. at the long
+    contexts sequence parallelism exists for. Activations outside
+    attention stay contiguous, so RoPE/positions and the residual stream
+    are untouched.
     """
+    if zigzag and not causal:
+        raise ValueError(
+            "zigzag layout only pays off for causal attention (non-causal "
+            "ring attention has no masked compute to eliminate)"
+        )
     spec = P(data_axis, seq_axis, head_axis, None)
-    local = partial(ring_attention_local, axis_name=seq_axis, causal=causal)
+    if zigzag:
+        def local(q, k, v):
+            q = _to_zigzag(q, seq_axis)
+            k = _to_zigzag(k, seq_axis)
+            v = _to_zigzag(v, seq_axis)
+            out = zigzag_ring_attention_local(q, k, v, seq_axis)
+            return _from_zigzag(out, seq_axis)
+    else:
+        def local(q, k, v):
+            return ring_attention_local(
+                q, k, v, axis_name=seq_axis, causal=causal
+            )
     sharded = partial(
         jax.shard_map,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
         check_vma=False,
-    )(lambda q, k, v: local(q, k, v))
+    )(local)
 
     def attn(q, k, v):
         H, KV = q.shape[2], k.shape[2]

@@ -158,3 +158,18 @@ class TestFlopsAccounting:
         assert abs(got - want) < 1e-18
         assert mfu(cfg, 8, 128, 0.0, [Dev()]) is None
         assert mfu(cfg, 8, 128, float("inf"), [Dev()]) is None
+
+
+class TestBenchRing:
+    def test_bench_ring_smoke(self, capsys):
+        """Both layouts produce timing rows on a tiny in-process mesh."""
+        from tpumon.workload.bench_ring import bench
+
+        rows = bench(
+            sp=2, batch=4, heads=2, kv_heads=1, head_dim=8,
+            seqs=(16,), iters=1,
+        )
+        assert {r["layout"] for r in rows} == {"contiguous", "zigzag"}
+        for r in rows:
+            assert r["fwd_ms"] > 0 and r["fwd_bwd_ms"] > 0
+            assert r["sp"] == 2

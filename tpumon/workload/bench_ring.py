@@ -1,0 +1,154 @@
+"""Ring-attention layout benchmark: contiguous vs zigzag (SURVEY.md §6).
+
+Times one causal ring-attention forward (and forward+backward) per
+sequence length on a dp×sp mesh, for both sequence-shard layouts. The
+zigzag layout computes exactly half the stripe pairs the branchless
+contiguous ring does (parallel.ring.zigzag_ring_attention_local), at the
+price of eight stripe-size ppermutes per call — so it should win once
+S²-attention compute dominates the redistribution, which is the regime
+sequence parallelism exists for. The numbers land in BASELINE.md; an
+honest crossover point (below which contiguous wins) is a result.
+
+Run:  python -m tpumon.workload.bench_ring --sp 4 --seq 1024 2048 4096
+      (add --platform cpu off-TPU; the mesh is dp×sp over all devices)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+# One timing harness for all workload benches: bench_attention's timer at
+# inner=1 is exactly the warmup+median loop this bench needs, and a fix to
+# the methodology there must apply here too.
+from tpumon.workload.bench_attention import _time
+
+
+def bench(
+    sp: int = 4,
+    batch: int = 2,
+    heads: int = 8,
+    kv_heads: int = 4,
+    head_dim: int = 128,
+    seqs: tuple[int, ...] = (1024, 2048, 4096),
+    iters: int = 5,
+    out=sys.stdout,
+) -> list[dict]:
+    import jax
+    import jax.numpy as jnp
+
+    from tpumon.workload.parallel.mesh import make_mesh
+    from tpumon.workload.parallel.ring import make_ring_attn
+
+    n = len(jax.devices())
+    if n % sp:
+        raise SystemExit(f"device count {n} must divide by sp {sp}")
+    dp = n // sp
+    # Fail at the CLI boundary with the real constraint, not deep inside
+    # shard_map: batch splits over the data axis, and the zigzag leg
+    # needs an even per-device sequence shard.
+    if batch % dp:
+        raise SystemExit(
+            f"batch ({batch}) must divide by dp ({dp} = {n} devices / "
+            f"sp {sp}); pass --batch {dp} or reduce --sp"
+        )
+    bad = [s for s in seqs if s % (2 * sp)]
+    if bad:
+        raise SystemExit(
+            f"seq values {bad} must divide by 2*sp ({2 * sp}) for the "
+            "zigzag layout's lo/hi stripes"
+        )
+    mesh = make_mesh(dp, 1, sp)
+    platform = jax.devices()[0].platform
+    results = []
+    for seq in seqs:
+        kq, kk, kv_ = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(kq, (batch, seq, heads, head_dim), jnp.bfloat16)
+        k = jax.random.normal(
+            kk, (batch, seq, kv_heads, head_dim), jnp.bfloat16
+        )
+        v = jax.random.normal(
+            kv_, (batch, seq, kv_heads, head_dim), jnp.bfloat16
+        )
+        for layout in ("contiguous", "zigzag"):
+            attn = make_ring_attn(mesh, zigzag=layout == "zigzag")
+            fwd = jax.jit(attn)
+
+            def loss(q, k, v):
+                return jnp.sum(attn(q, k, v).astype(jnp.float32))
+
+            bwd = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            fwd_s = _time(fwd, q, k, v, iters=iters)
+            bwd_s = _time(bwd, q, k, v, iters=iters)
+            row = {
+                "layout": layout,
+                "platform": platform,
+                "dp": dp,
+                "sp": sp,
+                "batch": batch,
+                "heads": heads,
+                "kv_heads": kv_heads,
+                "head_dim": head_dim,
+                "seq": seq,
+                "fwd_ms": round(fwd_s * 1e3, 3),
+                "fwd_bwd_ms": round(bwd_s * 1e3, 3),
+            }
+            results.append(row)
+            print(json.dumps(row), file=out, flush=True)
+    return results
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="bench_ring")
+    parser.add_argument("--sp", type=int, default=4)
+    parser.add_argument("--batch", type=int, default=2)
+    parser.add_argument("--heads", type=int, default=8)
+    parser.add_argument("--kv-heads", type=int, default=4)
+    parser.add_argument("--head-dim", type=int, default=128)
+    parser.add_argument(
+        "--seq", type=int, nargs="+", default=[1024, 2048, 4096]
+    )
+    parser.add_argument("--iters", type=int, default=5)
+    parser.add_argument(
+        "--platform",
+        choices=("auto", "cpu"),
+        default="auto",
+        help="force the jax platform; 'cpu' sizes a virtual device mesh "
+        "and avoids a wedged TPU tunnel (flag, not env — the "
+        "JAX_PLATFORMS env var is ignored when a TPU plugin is present)",
+    )
+    parser.add_argument(
+        "--devices",
+        type=int,
+        default=8,
+        help="virtual device count when --platform cpu",
+    )
+    args = parser.parse_args(argv)
+    if args.platform == "cpu":
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={args.devices}"
+            ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    bench(
+        sp=args.sp,
+        batch=args.batch,
+        heads=args.heads,
+        kv_heads=args.kv_heads,
+        head_dim=args.head_dim,
+        seqs=tuple(args.seq),
+        iters=args.iters,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -170,9 +170,9 @@ def main(argv=None) -> int:
     )
     args = parser.parse_args(argv)
     if args.platform == "cpu":
-        import jax
+        from tpumon.workload.platform import force_cpu_devices
 
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu_devices(1)
     bench(
         batch=args.batch,
         heads=args.heads,

@@ -44,19 +44,19 @@ def bench(
 
     n = len(jax.devices())
     if n % sp:
-        raise SystemExit(f"device count {n} must divide by sp {sp}")
+        raise ValueError(f"device count {n} must divide by sp {sp}")
     dp = n // sp
-    # Fail at the CLI boundary with the real constraint, not deep inside
+    # Fail at the API boundary with the real constraint, not deep inside
     # shard_map: batch splits over the data axis, and the zigzag leg
     # needs an even per-device sequence shard.
     if batch % dp:
-        raise SystemExit(
+        raise ValueError(
             f"batch ({batch}) must divide by dp ({dp} = {n} devices / "
             f"sp {sp}); pass --batch {dp} or reduce --sp"
         )
     bad = [s for s in seqs if s % (2 * sp)]
     if bad:
-        raise SystemExit(
+        raise ValueError(
             f"seq values {bad} must divide by 2*sp ({2 * sp}) for the "
             "zigzag layout's lo/hi stripes"
         )
@@ -127,26 +127,21 @@ def main(argv=None) -> int:
     )
     args = parser.parse_args(argv)
     if args.platform == "cpu":
-        import os
+        from tpumon.workload.platform import force_cpu_devices
 
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags
-                + f" --xla_force_host_platform_device_count={args.devices}"
-            ).strip()
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-    bench(
-        sp=args.sp,
-        batch=args.batch,
-        heads=args.heads,
-        kv_heads=args.kv_heads,
-        head_dim=args.head_dim,
-        seqs=tuple(args.seq),
-        iters=args.iters,
-    )
+        force_cpu_devices(args.devices)
+    try:
+        bench(
+            sp=args.sp,
+            batch=args.batch,
+            heads=args.heads,
+            kv_heads=args.kv_heads,
+            head_dim=args.head_dim,
+            seqs=tuple(args.seq),
+            iters=args.iters,
+        )
+    except ValueError as exc:
+        parser.error(str(exc))
     return 0
 
 

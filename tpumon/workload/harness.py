@@ -509,16 +509,10 @@ def main(argv: list[str] | None = None) -> int:
         parser.error("--num-processes > 1 requires --coordinator")
 
     if args.platform == "cpu":
-        import os
+        from tpumon.workload.platform import force_cpu_devices
 
         # Each process owns its share of the dp*tp global mesh.
-        n = total // max(num_processes, 1)
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count={max(n, 1)}"
-            ).strip()
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu_devices(total // max(num_processes, 1))
 
     if args.coordinator:
         import os

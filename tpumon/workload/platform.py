@@ -1,0 +1,31 @@
+"""Platform forcing for the workload CLIs.
+
+NOTE (probed live on this jax build): with the axon TPU plugin
+installed, the ``JAX_PLATFORMS`` *env var* is ignored — only the config
+API sticks, and only before the backend initializes. Every workload CLI
+therefore exposes ``--platform cpu`` as a flag and routes through this
+one helper, so the workaround lives in exactly one place
+(tests/conftest.py keeps its own copy because it must run before this
+package is importable under a fresh interpreter).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_devices(n: int) -> None:
+    """Pin jax to a virtual ``n``-device CPU platform.
+
+    Must run before jax's backend initializes in this process; sets the
+    host-platform device count via XLA_FLAGS (idempotent: an existing
+    count in the env wins, matching the conftest behavior).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={max(n, 1)}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -173,3 +173,43 @@ class TestBenchRing:
         for r in rows:
             assert r["fwd_ms"] > 0 and r["fwd_bwd_ms"] > 0
             assert r["sp"] == 2
+
+
+class TestLlama3Shape:
+    def test_llama3_8b_param_count_matches_published(self):
+        """The config-4 workload shape is the real Llama-3-8B: its param
+        count must land on the published 8.03B."""
+        from tpumon.workload.models.llama import LlamaConfig
+
+        cfg = LlamaConfig.llama3_8b()
+        D, F, L, V = cfg.dim, cfg.ffn_dim, cfg.n_layers, cfg.vocab
+        H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        per_layer = D * H * HD + 2 * D * KV * HD + H * HD * D + 3 * D * F
+        params = V * D + L * per_layer + D * V
+        assert abs(params / 1e9 - 8.03) < 0.01
+
+    def test_llama3_8b_flops_vs_6n_rule(self):
+        """train_flops_per_step at the 8B shape = 6·N·tokens plus the S²
+        attention term — between 1.0x and 1.35x of the 6N rule at seq
+        8192, catching both a dropped matmul and a double-count."""
+        from tpumon.workload.flops import train_flops_per_step
+        from tpumon.workload.models.llama import LlamaConfig
+
+        cfg = LlamaConfig.llama3_8b()
+        params = 8.03e9
+        tokens = 1 * 8192
+        got = train_flops_per_step(cfg, 1, 8192)
+        ratio = got / (6 * params * tokens)
+        assert 1.0 < ratio < 1.35, ratio
+
+    def test_llama3_8b_shards_on_v5p_meshes(self):
+        """The 8B shape divides cleanly over the sharding axes a v5p-64
+        pool would use (tp×(sp|pp)×dp): heads, KV heads, layers."""
+        from tpumon.workload.models.llama import LlamaConfig
+
+        cfg = LlamaConfig.llama3_8b()
+        for tp in (2, 4, 8):
+            assert cfg.n_heads % tp == 0 and cfg.n_kv_heads % tp == 0
+        for pp in (2, 4, 8):
+            assert cfg.n_layers % pp == 0
+        assert cfg.max_seq % 16 == 0  # zigzag at sp=8: 2*sp stripes

@@ -26,6 +26,30 @@ import sys
 from tpumon.workload.bench_attention import _time
 
 
+def _validate(n: int, sp: int, batch: int, seqs: tuple[int, ...]) -> int:
+    """Check mesh/shape divisibility up front; returns dp.
+
+    Raises ValueError with the real constraint instead of letting the
+    run die deep inside shard_map: batch splits over the data axis, and
+    the zigzag leg needs an even per-device sequence shard.
+    """
+    if n % sp:
+        raise ValueError(f"device count {n} must divide by sp {sp}")
+    dp = n // sp
+    if batch % dp:
+        raise ValueError(
+            f"batch ({batch}) must divide by dp ({dp} = {n} devices / "
+            f"sp {sp}); pass --batch {dp} or reduce --sp"
+        )
+    bad = [s for s in seqs if s % (2 * sp)]
+    if bad:
+        raise ValueError(
+            f"seq values {bad} must divide by 2*sp ({2 * sp}) for the "
+            "zigzag layout's lo/hi stripes"
+        )
+    return dp
+
+
 def bench(
     sp: int = 4,
     batch: int = 2,
@@ -43,23 +67,7 @@ def bench(
     from tpumon.workload.parallel.ring import make_ring_attn
 
     n = len(jax.devices())
-    if n % sp:
-        raise ValueError(f"device count {n} must divide by sp {sp}")
-    dp = n // sp
-    # Fail at the API boundary with the real constraint, not deep inside
-    # shard_map: batch splits over the data axis, and the zigzag leg
-    # needs an even per-device sequence shard.
-    if batch % dp:
-        raise ValueError(
-            f"batch ({batch}) must divide by dp ({dp} = {n} devices / "
-            f"sp {sp}); pass --batch {dp} or reduce --sp"
-        )
-    bad = [s for s in seqs if s % (2 * sp)]
-    if bad:
-        raise ValueError(
-            f"seq values {bad} must divide by 2*sp ({2 * sp}) for the "
-            "zigzag layout's lo/hi stripes"
-        )
+    dp = _validate(n, sp, batch, seqs)
     mesh = make_mesh(dp, 1, sp)
     platform = jax.devices()[0].platform
     results = []
@@ -130,18 +138,24 @@ def main(argv=None) -> int:
         from tpumon.workload.platform import force_cpu_devices
 
         force_cpu_devices(args.devices)
+    import jax
+
     try:
-        bench(
-            sp=args.sp,
-            batch=args.batch,
-            heads=args.heads,
-            kv_heads=args.kv_heads,
-            head_dim=args.head_dim,
-            seqs=tuple(args.seq),
-            iters=args.iters,
-        )
+        # Pre-flight only: a ValueError out of the benchmark itself is a
+        # real bug and must keep its traceback, not masquerade as a
+        # usage error.
+        _validate(len(jax.devices()), args.sp, args.batch, tuple(args.seq))
     except ValueError as exc:
         parser.error(str(exc))
+    bench(
+        sp=args.sp,
+        batch=args.batch,
+        heads=args.heads,
+        kv_heads=args.kv_heads,
+        head_dim=args.head_dim,
+        seqs=tuple(args.seq),
+        iters=args.iters,
+    )
     return 0
 
 

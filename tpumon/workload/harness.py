@@ -397,7 +397,14 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--steps", type=int, default=10)
     parser.add_argument("--batch", type=int, default=8)
     parser.add_argument("--seq", type=int, default=None)
-    parser.add_argument("--preset", choices=("tiny", "small"), default="tiny")
+    parser.add_argument(
+        "--preset",
+        choices=("tiny", "small", "llama3-8b"),
+        default="tiny",
+        help="model size: tiny/small for dev hosts; llama3-8b is the "
+        "BASELINE config-4 pretrain shape (needs a real pod + a mesh, "
+        "e.g. --dp 4 --tp 8 --sp 2 on v5p-64)",
+    )
     parser.add_argument(
         "--model",
         choices=("llama", "moe"),
@@ -539,7 +546,11 @@ def main(argv: list[str] | None = None) -> int:
                         args.preset)
         cfg = MoeConfig.tiny()
     else:
-        cfg = LlamaConfig.tiny() if args.preset == "tiny" else LlamaConfig.small()
+        cfg = {
+            "tiny": LlamaConfig.tiny,
+            "small": LlamaConfig.small,
+            "llama3-8b": LlamaConfig.llama3_8b,
+        }[args.preset]()
     groups = args.pp * args.interleave
     if args.pp > 1 and cfg.n_layers % groups:
         # Pipeline stages need a whole number of layers per (virtual)

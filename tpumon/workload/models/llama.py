@@ -53,6 +53,20 @@ class LlamaConfig:
             ffn_dim=1408, max_seq=512,
         )
 
+    @classmethod
+    def llama3_8b(cls) -> "LlamaConfig":
+        """The BASELINE config-4 workload shape ("JAX Llama-3-8B
+        pretrain"): Llama-3-8B's published architecture — 32 layers,
+        4096 dim, 32 query / 8 KV heads (GQA 4:1), 14336 SwiGLU hidden,
+        128k vocab. Too large to *run* on this dev host; it exists so
+        mesh planning, FLOPs/MFU accounting, and sharding specs are
+        exercised at the real shape (tests/test_workload.py pins the
+        FLOPs math against the 6·N/token rule at this size)."""
+        return cls(
+            vocab=128256, dim=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, ffn_dim=14336, max_seq=8192,
+        )
+
 
 def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
     """Per-layer weights stacked on a leading layer axis (for lax.scan)."""

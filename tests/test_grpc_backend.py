@@ -131,7 +131,10 @@ class FakeMonitoringServer:
         MetricRequest = cls("MetricRequest")
         MetricResponse = cls("MetricResponse")
         ListResponse = cls("ListSupportedMetricsResponse")
+        from collections import Counter
+
         self.get_calls = 0
+        self.get_calls_by_name: Counter = Counter()
         self.watch_calls = 0
         self.reflection_calls = 0
         # Watch plumbing: streams push ONLY on explicit push() calls, so
@@ -161,6 +164,7 @@ class FakeMonitoringServer:
 
         def get_runtime_metric(request, context):
             self.get_calls += 1
+            self.get_calls_by_name[request.metric_name] += 1
             return metric_response(request.metric_name)
 
         def watch_runtime_metric(request, context):
@@ -1025,6 +1029,60 @@ def test_drifted_production_spelling_suppressed_not_double_counted(
     finally:
         be.close()
         server.close()
+
+
+def test_full_exporter_over_grpc_backend_e2e(
+    fake_server, no_sdk, topo_file, scrape
+):
+    """The whole pipeline at once: fake runtime service → grpc backend
+    (watch + unary) → poller → cache → live HTTP scrape. A pushed value
+    must reach /metrics on the next poll, served from the stream."""
+    from tpumon.backends.grpc_backend import GrpcMonitoringBackend
+    from tpumon.config import Config
+    from tpumon.exporter.server import build_exporter
+
+    be = GrpcMonitoringBackend(
+        addr=fake_server.addr, timeout=5.0, topology_file=topo_file
+    )
+    cfg = Config(
+        port=0, addr="127.0.0.1", interval=30.0, backend="grpc",
+        host_metrics=False,
+    )
+    exporter = build_exporter(cfg, be)
+    exporter.start()
+    try:
+        status, text = scrape(exporter.server.url + "/metrics")
+        assert status == 200
+        assert "accelerator_duty_cycle_percent" in text
+        assert 'slice="testslice"' in text  # topology from the file
+
+        fake_server.push(
+            "duty_cycle_pct",
+            [({"device-id": 0}, 71.0), ({"device-id": 1}, 72.0)],
+        )
+        assert _wait_until(
+            lambda: be._watches["duty_cycle_pct"].fresh_rows(10.0)
+            is not None
+        )
+        duty_unary_before = fake_server.get_calls_by_name["duty_cycle_pct"]
+        other_unary_before = fake_server.get_calls_by_name["ici_link_health"]
+        exporter.poller.poll_once()
+        _, text = scrape(exporter.server.url + "/metrics")
+        assert "71.0" in text and "72.0" in text
+        # The pushed metric came off the stream — zero new unary calls
+        # for it — while a non-streaming metric still polled unary.
+        assert (
+            fake_server.get_calls_by_name["duty_cycle_pct"]
+            == duty_unary_before
+        )
+        assert (
+            fake_server.get_calls_by_name["ici_link_health"]
+            == other_unary_before + 1
+        )
+        assert 'accelerator_monitor_watch_streams{' in text
+        assert 'state="streaming"' in text
+    finally:
+        exporter.close()
 
 
 def test_grpc_service_config_knob(monkeypatch):

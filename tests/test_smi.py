@@ -178,3 +178,33 @@ def test_non_exporter_listener_falls_back_to_backend(monkeypatch, capsys):
     assert probed["url"].startswith("http://localhost:9400")
     out = capsys.readouterr().out
     assert "chip" in out.lower() or "accelerator" in out.lower()
+
+
+def test_watch_transport_line_rendered():
+    """The push/poll transport state (grpc backend) shows in the status
+    output; absent on SDK-only nodes (no line, no crash)."""
+    import io
+
+    from tpumon import smi
+
+    text = (
+        "# TYPE accelerator_device_count gauge\n"
+        'accelerator_device_count{slice="s",host="h"} 2.0\n'
+        "# TYPE accelerator_monitor_watch_streams gauge\n"
+        'accelerator_monitor_watch_streams{slice="s",host="h",state="streaming"} 3.0\n'
+        'accelerator_monitor_watch_streams{slice="s",host="h",state="down"} 1.0\n'
+    )
+    snap = smi.snapshot_from_text(text)
+    assert snap["watch_streams"] == {"streaming": 3, "down": 1}
+    buf = io.StringIO()
+    smi.render(snap, out=buf)
+    out = buf.getvalue()
+    assert "monitoring transport: 1 down, 3 streaming" in out
+
+    plain = smi.snapshot_from_text(
+        'accelerator_device_count{slice="s"} 2.0\n'
+    )
+    assert "watch_streams" not in plain
+    buf = io.StringIO()
+    smi.render(plain, out=buf)
+    assert "monitoring transport" not in buf.getvalue()

@@ -44,6 +44,7 @@ _F_ICI = "accelerator_interconnect_link_health"
 _F_INFO = "accelerator_info"
 _F_COUNT = "accelerator_device_count"
 _F_COVERAGE = "exporter_metric_coverage_ratio"
+_F_WATCH = "accelerator_monitor_watch_streams"
 
 
 def _fetch(url: str, timeout: float) -> str:
@@ -102,6 +103,14 @@ def snapshot_from_families(families) -> dict:
     cov = fams.get(_F_COVERAGE)
     if cov is not None and cov.samples:
         snap["coverage"] = cov.samples[0].value
+
+    watch = fams.get(_F_WATCH)
+    if watch is not None and watch.samples:
+        # Push/poll transport state (grpc backend only — absent
+        # elsewhere, and the renderers skip an absent key).
+        snap["watch_streams"] = {
+            s.labels.get("state", "?"): int(s.value) for s in watch.samples
+        }
 
     per_chip = {
         _F_DUTY: "duty_pct",
@@ -341,6 +350,15 @@ def render(snap: dict, out=None) -> None:
         if ici["worst"]:
             line += f" (worst: {ici['worst'][0]} score={ici['worst'][1]:.0f})"
         p(line)
+    streams = snap.get("watch_streams")
+    if streams:
+        p(
+            "monitoring transport: "
+            + ", ".join(
+                f"{n} {state}" for state, n in sorted(streams.items())
+            )
+            + " (non-streaming metrics ride the unary poll)"
+        )
 
     from tpumon import health as _health
 

@@ -445,6 +445,17 @@ class TestHarnessComposition:
         )
         assert r.losses[-1] < r.losses[0]
 
+    def test_moe_ep_sp_zigzag_trains(self):
+        """Zigzag ring under the MoE model (ep×sp×dp): the layout is
+        attention-internal, so expert dispatch is untouched."""
+        from tpumon.workload.harness import run
+
+        r = run(
+            moe.MoeConfig.tiny(), steps=1, batch=4, seq=32, dp=2, sp=2,
+            ep=2, sp_layout="zigzag",
+        )
+        assert r.losses[-1] < r.losses[0]
+
     def test_invalid_compositions_rejected(self):
         from tpumon.workload.harness import run
 

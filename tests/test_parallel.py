@@ -445,6 +445,42 @@ class TestHarnessComposition:
         )
         assert r.losses[-1] < r.losses[0]
 
+    def test_grad_accum_matches_full_batch(self):
+        """Equal chunks: accumulated mean-of-chunk gradients equals the
+        full-batch gradient, so losses match the unaccumulated run."""
+        from tpumon.workload.harness import run
+
+        cfg = llama.LlamaConfig.tiny()
+        full = run(cfg, steps=1, batch=8, seq=32, seed=3)
+        acc = run(cfg, steps=1, batch=8, seq=32, seed=3, grad_accum=4)
+        assert abs(full.losses[0] - acc.losses[0]) < 1e-3
+        assert abs(full.losses[-1] - acc.losses[-1]) < 1e-3
+
+    def test_grad_accum_on_mesh_trains(self):
+        from tpumon.workload.harness import run
+
+        r = run(
+            llama.LlamaConfig.tiny(), steps=1, batch=8, seq=32, dp=2,
+            tp=2, grad_accum=2,
+        )
+        assert r.losses[-1] < r.losses[0]
+
+    def test_grad_accum_rejections(self):
+        from tpumon.workload.harness import run
+
+        with pytest.raises(ValueError, match="not pp"):
+            run(
+                llama.LlamaConfig(n_layers=4), steps=1, batch=4, seq=32,
+                pp=2, grad_accum=2,
+            )
+        with pytest.raises(ValueError, match="grad_accum"):
+            run(
+                llama.LlamaConfig.tiny(), steps=1, batch=6, seq=32,
+                grad_accum=4,
+            )
+        with pytest.raises(ValueError, match=">= 1"):
+            run(llama.LlamaConfig.tiny(), steps=1, grad_accum=0)
+
     def test_moe_ep_sp_zigzag_trains(self):
         """Zigzag ring under the MoE model (ep×sp×dp): the layout is
         attention-internal, so expert dispatch is untouched."""

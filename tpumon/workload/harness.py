@@ -85,11 +85,49 @@ def make_train_step(
     shard_acts=None,
     shard_experts=None,
     forward_fn=None,
+    grad_accum: int = 1,
 ):
-    def train_step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(
-            params, tokens, cfg, attn_impl, shard_acts, shard_experts, forward_fn
+    """One jitted optimizer step; ``grad_accum > 1`` splits the batch
+    into that many chunks and accumulates gradients over a ``lax.scan``
+    before the single optimizer update — the standard pretrain pattern
+    for batch sizes beyond activation memory. Equal chunks mean the
+    accumulated mean-of-chunk-gradients equals the full-batch gradient,
+    so the math is unchanged; what changes is the *cadence* of the
+    gradient collectives the monitor observes (one burst per chunk
+    instead of one per step)."""
+
+    def grad_of(params, tokens):
+        return jax.value_and_grad(loss_fn)(
+            params, tokens, cfg, attn_impl, shard_acts, shard_experts,
+            forward_fn,
         )
+
+    def train_step(params, opt_state, tokens):
+        if grad_accum == 1:
+            loss, grads = grad_of(params, tokens)
+        else:
+            B = tokens.shape[0]
+            # Strided chunking: chunk a takes rows {a, a+A, a+2A, ...},
+            # so every chunk stays balanced across the dp shards (tokens
+            # are batch-sharded on axis 0). A contiguous reshape would
+            # put chunk 0 entirely on the first shards and force GSPMD
+            # to insert reshard traffic real per-shard microbatch
+            # loaders never emit.
+            chunks = tokens.reshape(
+                B // grad_accum, grad_accum, -1
+            ).swapaxes(0, 1)
+
+            def acc(carry, chunk):
+                loss, grads = grad_of(params, chunk)
+                return (
+                    jax.tree.map(jnp.add, carry[0], grads),
+                    carry[1] + loss,
+                ), None
+
+            zero = jax.tree.map(jnp.zeros_like, params)
+            (gsum, lsum), _ = jax.lax.scan(acc, (zero, 0.0), chunks)
+            grads = jax.tree.map(lambda g: g / grad_accum, gsum)
+            loss = lsum / grad_accum
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
@@ -129,6 +167,7 @@ def run(
     microbatches: int = 2,
     interleave: int = 1,
     sp_layout: str = "contiguous",
+    grad_accum: int = 1,
     seed: int = 0,
     mesh=None,
     attn: str = "xla",
@@ -223,12 +262,27 @@ def run(
         shard_experts = make_expert_sharder(mesh)
         if shard_acts is None:
             shard_acts = make_act_sharder(mesh)
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+    if grad_accum > 1:
+        if pp > 1:
+            # The pipelined forward already microbatches inside its
+            # schedule; stacking a second accumulation loop on top would
+            # obscure which knob produced which traffic.
+            raise ValueError("grad_accum composes with dp/tp/sp/ep, not pp")
+        per_shard = batch // max(dp, 1)
+        if per_shard % grad_accum:
+            raise ValueError(
+                f"per-data-shard batch ({per_shard}) must divide by "
+                f"grad_accum ({grad_accum})"
+            )
     if pp > 1:
         forward_fn = make_pipelined_forward(
             mesh, cfg, microbatches=microbatches, interleave=interleave
         )
     train_step = make_train_step(
-        cfg, optimizer, attn_impl, shard_acts, shard_experts, forward_fn
+        cfg, optimizer, attn_impl, shard_acts, shard_experts, forward_fn,
+        grad_accum=grad_accum,
     )
 
     if mesh is not None:
@@ -441,6 +495,13 @@ def main(argv: list[str] | None = None) -> int:
         help="microbatches per step on the pipeline-parallel path",
     )
     parser.add_argument(
+        "--grad-accum",
+        type=int,
+        default=1,
+        help="gradient-accumulation chunks per optimizer step (composes "
+        "with dp/tp/sp/ep; pp has its own microbatching)",
+    )
+    parser.add_argument(
         "--interleave",
         type=int,
         default=1,
@@ -604,6 +665,7 @@ def main(argv: list[str] | None = None) -> int:
             microbatches=args.microbatches,
             interleave=args.interleave,
             sp_layout=args.sp_layout,
+            grad_accum=args.grad_accum,
             attn=args.attn,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,

@@ -162,14 +162,17 @@ class TestFlopsAccounting:
 
 class TestBenchRing:
     def test_bench_ring_smoke(self, capsys):
-        """Both layouts produce timing rows on a tiny in-process mesh."""
+        """All three configurations produce timing rows on a tiny
+        in-process mesh (flash runs interpreted here)."""
         from tpumon.workload.bench_ring import bench
 
         rows = bench(
             sp=2, batch=4, heads=2, kv_heads=1, head_dim=8,
             seqs=(16,), iters=1,
         )
-        assert {r["layout"] for r in rows} == {"contiguous", "zigzag"}
+        assert {r["layout"] for r in rows} == {
+            "contiguous", "zigzag", "zigzag-flash",
+        }
         for r in rows:
             assert r["fwd_ms"] > 0 and r["fwd_bwd_ms"] > 0
             assert r["sp"] == 2

@@ -1,13 +1,16 @@
 """Ring-attention layout benchmark: contiguous vs zigzag (SURVEY.md §6).
 
 Times one causal ring-attention forward (and forward+backward) per
-sequence length on a dp×sp mesh, for both sequence-shard layouts. The
-zigzag layout computes exactly half the stripe pairs the branchless
-contiguous ring does (parallel.ring.zigzag_ring_attention_local), at the
-price of eight stripe-size ppermutes per call — so it should win once
-S²-attention compute dominates the redistribution, which is the regime
-sequence parallelism exists for. The numbers land in BASELINE.md; an
-honest crossover point (below which contiguous wins) is a result.
+sequence length on a dp×sp mesh, for three configurations: the
+branchless contiguous ring, the zigzag layout (which computes exactly
+half the stripe pairs — parallel.ring.zigzag_ring_attention_local, at
+the price of eight stripe-size ppermutes per call), and the zigzag ring
+with the pallas flash kernel running every stripe pair
+(zigzag_ring_flash_local; interpret mode off-TPU, so only its TPU
+numbers are about speed). Zigzag should win once S²-attention compute
+dominates the redistribution, which is the regime sequence parallelism
+exists for. The numbers land in BASELINE.md; an honest crossover point
+(below which contiguous wins) is a result.
 
 Run:  python -m tpumon.workload.bench_ring --sp 4 --seq 1024 2048 4096
       (add --platform cpu off-TPU; the mesh is dp×sp over all devices)
@@ -80,8 +83,12 @@ def bench(
         v = jax.random.normal(
             kv_, (batch, seq, kv_heads, head_dim), jnp.bfloat16
         )
-        for layout in ("contiguous", "zigzag"):
-            attn = make_ring_attn(mesh, zigzag=layout == "zigzag")
+        for layout in ("contiguous", "zigzag", "zigzag-flash"):
+            attn = make_ring_attn(
+                mesh,
+                zigzag=layout.startswith("zigzag"),
+                flash=layout == "zigzag-flash",
+            )
             fwd = jax.jit(attn)
 
             def loss(q, k, v):

@@ -182,8 +182,10 @@ def run(
     batch×seq-sharded residual stream. ``ep > 1`` shards MoE expert banks
     over the ``expert`` axis so dispatch/combine become all-to-alls.
     ``attn="flash"`` swaps the attention core for the pallas flash kernel
-    (ops.flash_attention); it composes with dp/tp/ep but not with sp > 1
-    (ring attention owns the attention impl) or pp > 1 (the pipelined
+    (ops.flash_attention); it composes with dp/tp/ep, and with sp > 1
+    under ``sp_layout="zigzag"`` (the ring runs the kernel per stripe
+    pair — parallel.ring.zigzag_ring_flash_local), but not with
+    contiguous sp (device-dependent hop masks) or pp > 1 (the pipelined
     forward owns the model body). ``pp > 1`` composes with dp/tp/sp;
     ``interleave > 1`` selects the circular (interleaved) pipeline
     schedule — bubble ÷ interleave (parallel.pipeline).
@@ -219,15 +221,21 @@ def run(
 
     attn_impl = shard_acts = shard_experts = forward_fn = None
     if attn == "flash":
-        if sp > 1:
-            raise ValueError("attn='flash' does not compose with sp > 1 "
-                             "(ring attention owns the attention impl)")
         if pp > 1:
             raise ValueError("attn='flash' does not compose with pp > 1 "
                              "(the pipelined forward owns the model body)")
-        from tpumon.workload.ops.flash_attention import make_flash_attn
+        if sp > 1 and sp_layout != "zigzag":
+            raise ValueError(
+                "attn='flash' composes with sp > 1 only under "
+                "sp_layout='zigzag' (the flash kernel needs static masks; "
+                "zigzag is the layout that makes every ring hop statically "
+                "unmasked)"
+            )
+        if sp == 1:
+            from tpumon.workload.ops.flash_attention import make_flash_attn
 
-        attn_impl = make_flash_attn()
+            attn_impl = make_flash_attn()
+        # sp > 1: the ring construction below owns the impl (flash=True).
     elif attn != "xla":
         raise ValueError(f"unknown attn impl: {attn!r}")
     if sp > 1:
@@ -256,6 +264,7 @@ def run(
                 mesh,
                 head_axis="model" if tp > 1 else None,
                 zigzag=sp_layout == "zigzag",
+                flash=attn == "flash",
             )
             shard_acts = make_act_sharder(mesh, sp=True)
     if is_moe and mesh is not None:

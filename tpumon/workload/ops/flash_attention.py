@@ -129,15 +129,26 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_q,
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    """q [B,H,S,D], k/v [B,KV,S,D] → (out [B,H,S,D], lse [B,H,S] f32)."""
+    """q [B,H,S,D], k/v [B,KV,Sk,D] → (out [B,H,S,D], lse [B,H,S,LANES] f32).
+
+    Sk may differ from S only when ``causal=False`` (rectangular
+    attention — the blockwise/ring composition attends one q stripe to a
+    different-length key stripe); causal masking is only meaningful when
+    query and key positions share an origin, i.e. Sk == S.
+    """
     B, H, S, D = q.shape
-    KV = k.shape[1]
+    KV, Sk = k.shape[1], k.shape[2]
+    if causal and Sk != S:
+        raise ValueError(
+            f"causal flash attention needs matching seq lengths (q {S}, "
+            f"k {Sk}); rectangular attention must be causal=False"
+        )
     block_q = _pick_block(S, block_q)
-    block_k = _pick_block(S, block_k)
-    n_kb = S // block_k
+    block_k = _pick_block(Sk, block_k)
+    n_kb = Sk // block_k
     scale = 1.0 / (D ** 0.5)
 
-    kv_spec = pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, (h * KV) // H, 0, 0))
+    kv_spec = pl.BlockSpec((1, 1, Sk, D), lambda b, h, i: (b, (h * KV) // H, 0, 0))
     out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k,
@@ -282,29 +293,35 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret):
+def _flash_bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret,
+               g_lse=None):
     B, H, S, D = q.shape
-    KV = k.shape[1]
+    KV, Sk = k.shape[1], k.shape[2]
     group = H // KV
     block_q = _pick_block(S, block_q)
-    block_k = _pick_block(S, block_k)
+    block_k = _pick_block(Sk, block_k)
     scale = 1.0 / (D ** 0.5)
 
     # Δ_i = Σ_d dO·O per row — tiny elementwise reduce; XLA fuses it.
     # Lane-broadcast to _LANES like lse so its blocks stay tileable.
-    delta = jnp.broadcast_to(
-        jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                axis=-1, keepdims=True),
-        (B, H, S, _LANES),
+    # When the caller also differentiates the lse output (blockwise/ring
+    # composition), its cotangent folds in right here: dS = P∘(dP − Δ) +
+    # g_lse·P = P∘(dP − (Δ − g_lse)), so the kernels never change.
+    delta_rows = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32),
+        axis=-1, keepdims=True,
     )
+    if g_lse is not None:
+        delta_rows = delta_rows - g_lse.astype(jnp.float32)[..., None]
+    delta = jnp.broadcast_to(delta_rows, (B, H, S, _LANES))
 
-    kv_spec = pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, (h * KV) // H, 0, 0))
+    kv_spec = pl.BlockSpec((1, 1, Sk, D), lambda b, h, i: (b, (h * KV) // H, 0, 0))
     q_blk = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0))
     row_blk = pl.BlockSpec((1, 1, block_q, _LANES), lambda b, h, i: (b, h, i, 0))
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, block_q=block_q, block_k=block_k,
-            n_kb=S // block_k, causal=causal,
+            n_kb=Sk // block_k, causal=causal,
         ),
         grid=(B, H, S // block_q),
         in_specs=[q_blk, kv_spec, kv_spec, q_blk, row_blk, row_blk],
@@ -323,12 +340,12 @@ def _flash_bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret):
             _dkv_kernel, scale=scale, block_q=block_q, block_k=block_k,
             n_qb=S // block_q, group=group, causal=causal,
         ),
-        grid=(B, KV, S // block_k),
+        grid=(B, KV, Sk // block_k),
         in_specs=[band, k_blk, k_blk, band, band_row, band_row],
         out_specs=[k_blk, k_blk],
         out_shape=[
-            jax.ShapeDtypeStruct((B, KV, S, D), k.dtype),
-            jax.ShapeDtypeStruct((B, KV, S, D), v.dtype),
+            jax.ShapeDtypeStruct((B, KV, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((B, KV, Sk, D), v.dtype),
         ],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
@@ -341,22 +358,26 @@ def _flash_bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
-    return out
-
-
-def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_lse(q, k, v, causal, block_q, block_k, interpret):
     out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+    return out, lse[..., 0]
 
 
-def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
+def _flash_lse_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return (out, lse[..., 0]), (q, k, v, out, lse)
+
+
+def _flash_lse_vjp_bwd(causal, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
-    return _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k, interpret)
+    g_out, g_lse = g
+    return _flash_bwd(
+        q, k, v, out, lse, g_out, causal, block_q, block_k, interpret,
+        g_lse=g_lse,
+    )
 
 
-_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 
 def flash_attention(
@@ -376,18 +397,52 @@ def flash_attention(
     VJP, flash-style recompute backward). ``interpret=None`` auto-selects
     interpreter mode off-TPU so the CPU test mesh runs the same code.
     """
+    # One custom-vjp path serves both public entry points: with lse
+    # unused its cotangent is zero and the backward's Δ fold is a no-op.
+    out, _ = flash_attention_with_lse(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return out
+
+
+def flash_attention_with_lse(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Flash attention returning ``(out [B,S,H,D], lse [B,H,S] f32)``.
+
+    The per-row log-sum-exp is what makes flash partials *composable*:
+    two results over the same queries but different keys merge exactly as
+
+        lse = logaddexp(lse_a, lse_b)
+        out = out_a·e^{lse_a−lse} + out_b·e^{lse_b−lse}
+
+    which is how parallel.ring's zigzag ring runs this kernel per K/V
+    block and still matches dense attention bit-for-tolerance. Both
+    outputs are differentiable: the lse cotangent folds into the
+    backward's Δ term (see _flash_bwd), so the gradient kernels are the
+    same three used by :func:`flash_attention`.
+    """
     if interpret is None:
         interpret = _interpret_default()
     B, S, H, D = q.shape
     KV = k.shape[2]
     if H % KV:
         raise ValueError(f"q heads ({H}) must be a multiple of kv heads ({KV})")
-    # Kernel layout is [B, heads, S, D].
-    qT = q.transpose(0, 2, 1, 3)
-    kT = k.transpose(0, 2, 1, 3)
-    vT = v.transpose(0, 2, 1, 3)
-    out = _flash(qT, kT, vT, causal, block_q, block_k, interpret)
-    return out.transpose(0, 2, 1, 3)
+    out, lse = _flash_lse(
+        q.transpose(0, 2, 1, 3),
+        k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        causal, block_q, block_k, interpret,
+    )
+    return out.transpose(0, 2, 1, 3), lse
 
 
 def make_flash_attn(*, causal: bool = True, block_q: int = 128,

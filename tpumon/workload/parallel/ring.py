@@ -269,9 +269,117 @@ def zigzag_ring_attention_local(
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
+def _merge_partials(o_a, lse_a, o_b, lse_b):
+    """Merge two normalized flash partials over the same query stripe.
+
+    ``o`` is model-layout [B, s, H, D] (float32), ``lse`` is [B, H, s].
+    Exact softmax combination: the partial with the larger log-sum-exp
+    dominates, the other is rescaled — the same online-softmax algebra as
+    inside the kernel, applied between kernel calls.
+    """
+    lse = jnp.logaddexp(lse_a, lse_b)
+    w_a = jnp.transpose(jnp.exp(lse_a - lse), (0, 2, 1))[..., None]
+    w_b = jnp.transpose(jnp.exp(lse_b - lse), (0, 2, 1))[..., None]
+    return o_a * w_a + o_b * w_b, lse
+
+
+def zigzag_ring_flash_local(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    *,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Causal zigzag ring attention with the pallas flash kernel inside.
+
+    Same schedule as :func:`zigzag_ring_attention_local`, but every stripe
+    pair runs :func:`ops.flash_attention.flash_attention_with_lse` instead
+    of the XLA online-softmax block, and partial results merge via
+    :func:`_merge_partials`. The zigzag layout is what makes this
+    composition possible at all: a flash kernel wants a *static* mask
+    (causal or none, baked into its grid schedule), and zigzag is exactly
+    the layout under which every cross-hop stripe pair is statically
+    unmasked — only the hop-0 self block needs the causal triangle, which
+    decomposes into three static calls:
+
+    - lo × lo, causal (the triangle is offset-invariant);
+    - hi × hi, causal;
+    - hi × lo, full (stripe 2n−1−d is always newer than stripe d).
+
+    Hops 1..n−1 are the two fully-unmasked slot updates of the zigzag
+    schedule, each one flash call. The ring still moves one KV-headed
+    block per hop (GQA expansion happens inside the kernel's index maps —
+    it is never materialized, unlike the XLA path's ``jnp.repeat``).
+
+    q [B, 2s, H, D], k/v [B, 2s, KV, D] in zigzag layout.
+    """
+    from tpumon.workload.ops.flash_attention import flash_attention_with_lse
+
+    n = jax.lax.axis_size(axis_name)
+    d = jax.lax.axis_index(axis_name)
+    s = q.shape[1] // 2
+
+    def flash(q_, k_, v_, causal):
+        o, lse = flash_attention_with_lse(
+            q_, k_, v_, causal=causal, block_q=block_q, block_k=block_k,
+            interpret=interpret,
+        )
+        return o.astype(jnp.float32), lse
+
+    q_lo, q_hi = q[:, :s], q[:, s:]
+
+    # Hop 0: the self block, as three statically-masked kernel calls.
+    o_lo, lse_lo = flash(q_lo, k[:, :s], v[:, :s], True)
+    o_hh, lse_hh = flash(q_hi, k[:, s:], v[:, s:], True)
+    o_hl, lse_hl = flash(q_hi, k[:, :s], v[:, :s], False)
+    o_hi, lse_hi = _merge_partials(o_hh, lse_hh, o_hl, lse_hl)
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(i, carry):
+        o_lo, lse_lo, o_hi, lse_hi, k, v = carry
+        # Rotate first: at iteration i we hold the block that started on
+        # device (d - i) mod n.
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        src = (d - i) % n
+        older = src < d  # sender's lo stripe is older than both of ours
+        k_lo, k_hi = k[:, :s], k[:, s:]
+        v_lo, v_hi = v[:, :s], v[:, s:]
+
+        # Slot 1: (lo if older else hi) × sender's lo — always unmasked.
+        # Select the target accumulator pair, run ONE merge, select back
+        # (same pattern as zigzag_ring_attention_local's slot 1).
+        q1 = jnp.where(older, q_lo, q_hi)
+        o_t = jnp.where(older, o_lo, o_hi)
+        lse_t = jnp.where(older, lse_lo, lse_hi)
+        o1, lse1 = flash(q1, k_lo, v_lo, False)
+        o_t, lse_t = _merge_partials(o_t, lse_t, o1, lse1)
+        o_lo = jnp.where(older, o_t, o_lo)
+        lse_lo = jnp.where(older, lse_t, lse_lo)
+        o_hi = jnp.where(older, o_hi, o_t)
+        lse_hi = jnp.where(older, lse_hi, lse_t)
+
+        # Slot 2: hi × (sender's lo if older else sender's hi) — always
+        # unmasked (same argument as zigzag_ring_attention_local).
+        k2 = jnp.where(older, k_lo, k_hi)
+        v2 = jnp.where(older, v_lo, v_hi)
+        o2, lse2 = flash(q_hi, k2, v2, False)
+        o_hi, lse_hi = _merge_partials(o_hi, lse_hi, o2, lse2)
+        return o_lo, lse_lo, o_hi, lse_hi, k, v
+
+    o_lo, lse_lo, o_hi, lse_hi, k, v = jax.lax.fori_loop(
+        1, n, step, (o_lo, lse_lo, o_hi, lse_hi, k, v)
+    )
+    return jnp.concatenate([o_lo, o_hi], axis=1).astype(q.dtype)
+
+
 def make_ring_attn(
     mesh: Mesh, *, data_axis="data", seq_axis="seq", head_axis=None, causal=True,
-    zigzag=False,
+    zigzag=False, flash=False, block_q=128, block_k=128, interpret=None,
 ):
     """An attention callable q,k,v → out with the sequence axis ring-sharded.
 
@@ -293,11 +401,24 @@ def make_ring_attn(
     contexts sequence parallelism exists for. Activations outside
     attention stay contiguous, so RoPE/positions and the residual stream
     are untouched.
+
+    ``flash=True`` (zigzag only) runs the pallas flash kernel for every
+    local stripe pair instead of the XLA online-softmax block
+    (:func:`zigzag_ring_flash_local`) — ring over ICI outside, MXU-tiled
+    kernel inside. ``block_q``/``block_k``/``interpret`` pass through to
+    the kernel.
     """
     if zigzag and not causal:
         raise ValueError(
             "zigzag layout only pays off for causal attention (non-causal "
             "ring attention has no masked compute to eliminate)"
+        )
+    if flash and not zigzag:
+        raise ValueError(
+            "flash=True requires zigzag=True: the pallas kernel wants "
+            "static masks, and only the zigzag layout makes every ring "
+            "hop statically unmasked (contiguous hops are masked by a "
+            "device-dependent amount)"
         )
     spec = P(data_axis, seq_axis, head_axis, None)
     if zigzag:
@@ -305,7 +426,13 @@ def make_ring_attn(
             q = _to_zigzag(q, seq_axis)
             k = _to_zigzag(k, seq_axis)
             v = _to_zigzag(v, seq_axis)
-            out = zigzag_ring_attention_local(q, k, v, seq_axis)
+            if flash:
+                out = zigzag_ring_flash_local(
+                    q, k, v, seq_axis,
+                    block_q=block_q, block_k=block_k, interpret=interpret,
+                )
+            else:
+                out = zigzag_ring_attention_local(q, k, v, seq_axis)
             return _from_zigzag(out, seq_axis)
     else:
         def local(q, k, v):

@@ -178,6 +178,72 @@ class TestBenchRing:
             assert r["sp"] == 2
 
 
+class TestWorkloadStats:
+    """Live run telemetry for the harness /metrics port (workload.stats)."""
+
+    def _families(self, stats):
+        from tpumon.workload.stats import stats_families
+
+        return {f.name: f for f in stats_families(stats)}
+
+    def test_windowed_math_and_families(self):
+        from tpumon.workload.stats import WorkloadStats
+
+        stats = WorkloadStats()
+        stats.configure(
+            flops_per_step=1e12, tokens_per_step=4096,
+            peak_flops_total=100e12, axes={"dp": 2, "tp": 2},
+        )
+        stats.record(loss=3.5, steps=20, seconds=0.5)  # 40 steps/s
+        fams = self._families(stats)
+        snap = stats.snapshot()
+        assert snap["steps_per_second"] == pytest.approx(40.0)
+        assert snap["mfu"] == pytest.approx(0.4)  # 40 TF/s of 100 TF peak
+        assert snap["tokens_per_second"] == pytest.approx(40 * 4096)
+        assert fams["workload_steps"].samples[0].value == 20
+        assert fams["workload_mfu_ratio"].samples[0].value == pytest.approx(0.4)
+        mesh = fams["workload_mesh_info"].samples[0]
+        assert mesh.labels == {
+            "dp": "2", "tp": "2", "sp": "1", "pp": "1", "ep": "1"
+        }
+
+    def test_unknown_peak_omits_mfu(self):
+        """CPU runs have no published peak: MFU must be absent, never a
+        number against a made-up denominator (same rule as flops.mfu)."""
+        from tpumon.workload.stats import WorkloadStats
+
+        stats = WorkloadStats()
+        stats.configure(
+            flops_per_step=1e12, tokens_per_step=64,
+            peak_flops_total=None, axes={},
+        )
+        stats.record(loss=1.0, steps=10, seconds=1.0)
+        fams = self._families(stats)
+        assert "workload_mfu_ratio" not in fams
+        assert "workload_steps_per_second" in fams
+
+    def test_before_first_window_only_static_families(self):
+        from tpumon.workload.stats import WorkloadStats
+
+        stats = WorkloadStats()
+        fams = self._families(stats)
+        assert set(fams) == {"workload_steps"}  # counter reads 0
+
+    def test_run_records_windows(self):
+        """The harness records exact windowed throughput without changing
+        its results; CPU run ⇒ MFU absent but rate present."""
+        from tpumon.workload.stats import WorkloadStats
+
+        stats = WorkloadStats()
+        r = run(CFG, steps=5, batch=2, seq=32, stats=stats, stats_every=2)
+        snap = stats.snapshot()
+        assert snap["steps_total"] == 5  # windows 2+2+1
+        assert snap["last_loss"] == pytest.approx(r.losses[-1], abs=1e-5)
+        assert snap["steps_per_second"] > 0
+        assert snap["mfu"] is None
+        assert snap["axes"] == {"dp": 1, "tp": 1, "sp": 1, "pp": 1, "ep": 1}
+
+
 class TestLlama3Shape:
     def test_llama3_8b_param_count_matches_published(self):
         """The config-4 workload shape is the real Llama-3-8B: its param

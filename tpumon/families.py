@@ -114,6 +114,32 @@ WORKLOAD_FAMILIES: dict[str, str] = {
         "Summed per-op payload bytes extracted from HLO logger events "
         "(absent until an event carries a size figure)"
     ),
+    "workload_steps_total": (
+        "Optimizer steps completed by the harness train loop"
+    ),
+    "workload_mesh_info": (
+        "Parallelism degrees (dp/tp/sp/pp/ep labels) of the running "
+        "workload's mesh"
+    ),
+    "workload_loss": (
+        "Training loss at the most recent recorded window boundary"
+    ),
+    "workload_steps_per_second": (
+        "Optimizer steps per second over the most recent window (the "
+        "train loop syncs once per window, staying pipelined between)"
+    ),
+    "workload_tokens_per_second": (
+        "Training tokens per second over the most recent window"
+    ),
+    "workload_model_flops_per_step": (
+        "Model FLOPs one optimizer step executes (exact per-matmul "
+        "accounting, tpumon.workload.flops)"
+    ),
+    "workload_mfu_ratio": (
+        "Live model FLOPs utilization vs the devices' published bf16 "
+        "peak (absent when the peak is unknown; correlate with "
+        "accelerator_duty_cycle_percent)"
+    ),
 }
 
 

@@ -173,6 +173,8 @@ def run(
     attn: str = "xla",
     checkpoint_dir: str | None = None,
     checkpoint_every: int = 0,
+    stats=None,
+    stats_every: int = 20,
 ) -> RunResult:
     """Build, shard, and run the train step; returns losses + throughput.
 
@@ -197,6 +199,12 @@ def run(
     ``checkpoint_every`` steps (0 = only at the end), and a resumed run
     replays the exact losses of an uninterrupted one (same data keyed by
     seed, bitwise-restored state; asserted in tests/test_checkpoint.py).
+
+    ``stats`` (a workload.stats.WorkloadStats) turns on live telemetry
+    for the /metrics port: every ``stats_every`` steps the loop blocks on
+    the latest loss and records the window's exact steps/s (the dispatch
+    pipeline stays full between windows — one sync per window, not per
+    step, so the generated traffic keeps its shape).
     """
     is_moe = isinstance(cfg, MoeConfig)
     if ep > 1 and not is_moe:
@@ -308,11 +316,28 @@ def run(
     opt_state = optimizer.init(params)
     step = jax.jit(train_step, donate_argnums=(0, 1))
 
+    from tpumon.workload import flops as flops_mod
+
+    run_devices = list(mesh.devices.flat) if mesh is not None else [
+        jax.devices()[0]
+    ]
+    if stats is not None:
+        peaks = [flops_mod.peak_flops_per_chip(d) for d in run_devices]
+        stats.configure(
+            flops_per_step=flops_mod.train_flops_per_step(cfg, batch, seq),
+            tokens_per_step=batch * seq,
+            peak_flops_total=(
+                sum(peaks) if peaks and all(p is not None for p in peaks)
+                else None
+            ),
+            axes={"dp": dp, "tp": tp, "sp": sp, "pp": pp, "ep": ep},
+        )
+
     if checkpoint_dir is not None:
         return _run_checkpointed(
             step, params, opt_state, tokens, steps, checkpoint_dir,
             checkpoint_every, mesh, cfg=cfg, batch=batch, seq=seq,
-            dp=dp, tp=tp, sp=sp, pp=pp, ep=ep,
+            stats=stats, dp=dp, tp=tp, sp=sp, pp=pp, ep=ep,
         )
 
     # Warmup/compile outside the timed window.
@@ -321,17 +346,22 @@ def run(
     losses = [float(loss)]
 
     t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = step(params, opt_state, tokens)
+    if stats is None:
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, tokens)
+    else:
+        window_t0, done = t0, 0
+        for i in range(1, steps + 1):
+            params, opt_state, loss = step(params, opt_state, tokens)
+            if i % max(stats_every, 1) == 0 or i == steps:
+                loss.block_until_ready()  # one sync per window
+                now = time.perf_counter()
+                stats.record(float(loss), i - done, now - window_t0)
+                window_t0, done = now, i
     loss.block_until_ready()
     elapsed = time.perf_counter() - t0
     losses.append(float(loss))
     steps_per_sec = steps / elapsed if elapsed > 0 else float("inf")
-    from tpumon.workload import flops as flops_mod
-
-    run_devices = list(mesh.devices.flat) if mesh is not None else [
-        jax.devices()[0]
-    ]
     return RunResult(
         losses=losses,
         steps_per_sec=steps_per_sec,
@@ -347,7 +377,7 @@ def run(
 
 def _run_checkpointed(
     step, params, opt_state, tokens, steps, checkpoint_dir, checkpoint_every,
-    mesh=None, cfg=None, batch=0, seq=0, **axes,
+    mesh=None, cfg=None, batch=0, seq=0, stats=None, **axes,
 ) -> RunResult:
     """Checkpoint/resume driver around the jitted train step.
 
@@ -409,9 +439,16 @@ def _run_checkpointed(
             t0 = time.perf_counter()
             params, opt_state, loss = step(params, opt_state, tokens)
             losses.append(float(loss))  # blocks; keeps loss-per-step record
+            dt = time.perf_counter() - t0
             if i > start_step:  # first iteration pays compile
-                timed += time.perf_counter() - t0
+                timed += dt
                 timed_steps += 1
+                if stats is not None:
+                    # This path already syncs per step; record each as a
+                    # window (compile-paying first iteration excluded, same
+                    # as the `timed` accounting — a ~60s compile would
+                    # otherwise publish a near-zero steps/s and MFU).
+                    stats.record(losses[-1], 1, dt)
             done = i + 1
             if (checkpoint_every and done % checkpoint_every == 0) or done == steps:
                 if done != saved_at:
@@ -638,6 +675,7 @@ def main(argv: list[str] | None = None) -> int:
     counters = HloOpCounters()
     hooked = counters.start()
     server = None
+    stats = None
     if args.metrics_port:
         from prometheus_client.registry import CollectorRegistry
 
@@ -648,8 +686,12 @@ def main(argv: list[str] | None = None) -> int:
         )
         from tpumon.exporter.telemetry import SelfTelemetry
 
+        from tpumon.workload.stats import StatsCollector, WorkloadStats
+
         registry = CollectorRegistry()
         registry.register(CountersCollector(counters))
+        stats = WorkloadStats()
+        registry.register(StatsCollector(stats))
         telemetry = SelfTelemetry(registry)
         telemetry.last_poll.set(time.time())
         server = ExporterServer(
@@ -678,6 +720,7 @@ def main(argv: list[str] | None = None) -> int:
             attn=args.attn,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,
+            stats=stats,
         )
         log.info(
             "loss %.4f → %.4f | %.2f steps/s | %.1f GFLOP/step | MFU %s | "

@@ -1,0 +1,172 @@
+"""Live workload throughput/MFU telemetry for the harness /metrics port.
+
+The harness already exposes collective-op counters (hlo_counters); this
+module adds the *throughput* side: steps, loss, windowed steps/s, and
+live MFU — so one Grafana view can correlate the workload's own model
+FLOPs utilization with the chip-side ``accelerator_duty_cycle_percent``
+the node exporter scrapes (SURVEY.md §3.5: the monitor observes traffic
+it did not generate; the workload publishes what it *meant* to drive).
+
+Sampling discipline: the harness's fast loop is pipelined — it enqueues
+steps without host syncs, which is what makes its traffic realistic. So
+stats are recorded on a *window* boundary (every ``stats_every`` steps
+the loop blocks on the latest loss and records the window), not per
+step: one sync per window keeps the dispatch pipeline full between
+samples and makes the windowed steps/s exact rather than estimated from
+dispatch cadence.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class WorkloadStats:
+    """Thread-safe run telemetry shared between the train loop (writer)
+    and a Prometheus collector on the metrics port (reader)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._steps_total = 0
+        self._last_loss: float | None = None
+        self._window_rate: float | None = None
+        self._flops_per_step = 0.0
+        self._tokens_per_step = 0
+        self._peak_flops_total: float | None = None
+        self._axes: dict[str, int] = {}
+
+    def configure(
+        self,
+        *,
+        flops_per_step: float,
+        tokens_per_step: int,
+        peak_flops_total: float | None,
+        axes: dict[str, int],
+    ) -> None:
+        """Static run facts, set once the model/mesh are known.
+
+        ``peak_flops_total`` is the summed published bf16 peak of the run's
+        devices, or None when unknown (CPU dryruns) — MFU is then absent
+        from the exposition rather than computed against a made-up peak
+        (same rule as workload.flops.mfu).
+        """
+        with self._lock:
+            self._flops_per_step = float(flops_per_step)
+            self._tokens_per_step = int(tokens_per_step)
+            self._peak_flops_total = peak_flops_total
+            self._axes = dict(axes)
+
+    def record(self, loss: float, steps: int, seconds: float) -> None:
+        """One window: ``steps`` optimizer steps took ``seconds`` wall."""
+        with self._lock:
+            self._steps_total += int(steps)
+            self._last_loss = float(loss)
+            if steps > 0 and seconds > 0:
+                self._window_rate = steps / seconds
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            rate = self._window_rate
+            mfu = None
+            if (
+                rate is not None
+                and self._peak_flops_total
+                and self._flops_per_step
+            ):
+                mfu = self._flops_per_step * rate / self._peak_flops_total
+            return {
+                "steps_total": self._steps_total,
+                "last_loss": self._last_loss,
+                "steps_per_second": rate,
+                "tokens_per_second": (
+                    rate * self._tokens_per_step if rate is not None else None
+                ),
+                "model_flops_per_step": self._flops_per_step,
+                "mfu": mfu,
+                "axes": dict(self._axes),
+            }
+
+
+def stats_families(stats: WorkloadStats):
+    """Prometheus families for the harness /metrics endpoint. One
+    snapshot serves the whole scrape (coherent steps/rate/mfu)."""
+    from prometheus_client.core import (
+        CounterMetricFamily,
+        GaugeMetricFamily,
+    )
+
+    snap = stats.snapshot()
+
+    steps = CounterMetricFamily(
+        "workload_steps_total",
+        "Optimizer steps completed by the harness train loop.",
+    )
+    steps.add_metric((), snap["steps_total"])
+    yield steps
+
+    if snap["axes"]:
+        mesh = GaugeMetricFamily(
+            "workload_mesh_info",
+            "Parallelism degrees of the running workload's mesh.",
+            labels=("dp", "tp", "sp", "pp", "ep"),
+        )
+        mesh.add_metric(
+            tuple(str(snap["axes"].get(a, 1)) for a in ("dp", "tp", "sp", "pp", "ep")),
+            1,
+        )
+        yield mesh
+
+    if snap["last_loss"] is not None:
+        loss = GaugeMetricFamily(
+            "workload_loss",
+            "Training loss at the most recent recorded window boundary.",
+        )
+        loss.add_metric((), snap["last_loss"])
+        yield loss
+
+    if snap["steps_per_second"] is not None:
+        rate = GaugeMetricFamily(
+            "workload_steps_per_second",
+            "Optimizer steps per second over the most recent window "
+            "(windowed host sync; the loop stays pipelined between windows).",
+        )
+        rate.add_metric((), snap["steps_per_second"])
+        yield rate
+
+    if snap["tokens_per_second"] is not None:
+        toks = GaugeMetricFamily(
+            "workload_tokens_per_second",
+            "Training tokens per second over the most recent window.",
+        )
+        toks.add_metric((), snap["tokens_per_second"])
+        yield toks
+
+    if snap["model_flops_per_step"]:
+        fl = GaugeMetricFamily(
+            "workload_model_flops_per_step",
+            "Model FLOPs one optimizer step executes "
+            "(tpumon.workload.flops exact per-matmul accounting).",
+        )
+        fl.add_metric((), snap["model_flops_per_step"])
+        yield fl
+
+    if snap["mfu"] is not None:
+        mfu = GaugeMetricFamily(
+            "workload_mfu_ratio",
+            "Live model FLOPs utilization vs the devices' published bf16 "
+            "peak, over the most recent window (absent when the peak is "
+            "unknown, e.g. CPU; correlate with "
+            "accelerator_duty_cycle_percent).",
+        )
+        mfu.add_metric((), snap["mfu"])
+        yield mfu
+
+
+class StatsCollector:
+    """Registry adapter: ``registry.register(StatsCollector(stats))``."""
+
+    def __init__(self, stats: WorkloadStats) -> None:
+        self._stats = stats
+
+    def collect(self):
+        return stats_families(self._stats)

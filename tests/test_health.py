@@ -251,6 +251,50 @@ def test_alert_rule_coverage_threshold_matches_constant():
         assert float(m.group(1)) == COVERAGE_TARGET
 
 
+def test_alert_rules_reference_known_families():
+    """Every metric name any alert expr references must exist in the
+    canonical family registry — the same no-silent-drift rule the
+    dashboard PromQL validator enforces (tests/test_dashboards.py)."""
+    import os
+    import re
+
+    import yaml
+
+    from tpumon.families import all_family_names, distribution_family_rows
+
+    names = all_family_names()
+    histogram_names = {
+        n for n in names if n.endswith("_seconds")
+    } | set(distribution_family_rows())
+    names |= {
+        n + suffix
+        for n in histogram_names
+        for suffix in ("_bucket", "_sum", "_count")
+    }
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(__file__)),
+        "deploy",
+        "prometheus-rules.yaml",
+    )
+    with open(path, encoding="utf-8") as fh:
+        doc = yaml.safe_load(fh)
+    metric_re = re.compile(
+        r"\b(?:accelerator|exporter|collector|workload)_[a-z0-9_]+"
+    )
+    rules = [
+        rule
+        for group in doc["spec"]["groups"]
+        for rule in group["rules"]
+    ]
+    assert len(rules) >= 13
+    for rule in rules:
+        for ref in metric_re.findall(rule["expr"]):
+            assert ref in names, (
+                f"alert {rule['alert']} references unknown metric {ref!r}"
+            )
+
+
 def test_env_thresholds_cached_until_env_changes(monkeypatch):
     """evaluate() runs at 1 Hz; the env is re-parsed only when a
     TPUMON_HEALTH_* value changes (no per-poll warning spam)."""

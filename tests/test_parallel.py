@@ -537,6 +537,18 @@ class TestHarnessComposition:
         )
         assert r.losses[-1] < r.losses[0]
 
+    def test_moe_ep_sp_zigzag_flash_trains(self):
+        """Flash-in-ring under the MoE model: the kernel is
+        attention-internal, expert all-to-alls untouched — ep×sp×flash
+        compose."""
+        from tpumon.workload.harness import run
+
+        r = run(
+            moe.MoeConfig.tiny(), steps=1, batch=4, seq=32, dp=2, sp=2,
+            ep=2, sp_layout="zigzag", attn="flash",
+        )
+        assert r.losses[-1] < r.losses[0]
+
     def test_invalid_compositions_rejected(self):
         from tpumon.workload.harness import run
 

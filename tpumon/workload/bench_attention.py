@@ -14,13 +14,20 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 import time
 
 
-def xla_attention(q, k, v, mask):
+def xla_attention(q, k, v):
     """The models.llama einsum path, isolated (GQA repeat + masked
-    softmax), kept numerically identical to models.llama._attention."""
+    softmax), kept numerically identical to models.llama._attention.
+
+    The causal mask is built IN-GRAPH from iota, not closed over as a
+    host array: a materialized [S, S] f32 mask at seq 8192 is a 268 MB
+    program constant — large enough to be rejected by remote-compile
+    transports (observed live: HTTP 413 from the axon tunnel).
+    """
     import jax
     import jax.numpy as jnp
 
@@ -30,7 +37,11 @@ def xla_attention(q, k, v, mask):
     k = jnp.repeat(k, rep, axis=2)
     v = jnp.repeat(v, rep, axis=2)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
-    scores = scores / jnp.sqrt(jnp.float32(q.shape[-1])) + mask
+    scores = scores / jnp.sqrt(jnp.float32(q.shape[-1]))
+    pos = jnp.arange(q.shape[1])
+    scores = jnp.where(
+        pos[None, None, :, None] >= pos[None, None, None, :], scores, -1e9
+    )
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -66,18 +77,53 @@ def _chain(fn, inner: int):
 
 
 def _time(fn, *args, iters: int, inner: int = 1) -> float:
-    """Median wall seconds per inner call after a compile+warmup call."""
+    """Median wall seconds per inner call after a compile+warmup call.
+
+    Two measurement defenses, both needed on remote-dispatch transports
+    (established empirically against the axon tunnel at seq 8192, where a
+    naive repeat-same-operands + block_until_ready loop read 0.003 ms per
+    iteration for a kernel that really takes ~30 ms):
+
+    - **The barrier is a host read** (``jax.device_get``), not
+      ``block_until_ready``: through the tunnel, block_until_ready can
+      resolve before device execution completes, silently timing dispatch
+      instead of compute. A host read of the result cannot return early —
+      and with ``inner > 1`` the chained output is a scalar, so the
+      forced transfer adds nothing to the measurement.
+    - **Every timing iteration uses a distinct first operand** (tiny
+      additive perturbation, same shape/dtype so nothing recompiles): the
+      transport can serve a repeated (executable, operands) pair from its
+      resolved-result cache.
+
+    With both in place, timings match an inline-dependency construction
+    to within 2% and scale as S² across 1k→8k, as attention must.
+
+    Each perturbed operand is built just before its iteration and dropped
+    after it (never all iters at once — at seq 8192 ten pinned 64 MB
+    copies would add real HBM pressure to a bench that probes the OOM
+    boundary), and the barrier fetches only the FIRST output leaf: one
+    materialized output proves the executable ran, and with ``inner > 1``
+    that leaf is the chained scalar, so the transfer is free. At
+    ``inner == 1`` (CPU interpret mode) the fetch is a host-local copy,
+    negligible against interpret-mode kernel times.
+    """
     import jax
+    import jax.numpy as jnp
 
     timed = _chain(fn, inner) if inner > 1 else fn
-    out = timed(*args)
-    jax.block_until_ready(out)
+
+    def read(out):
+        return jax.device_get(jax.tree.leaves(out)[0])
+
+    read(timed(*args))  # compile + warmup
     times = []
-    for _ in range(iters):
+    for i in range(iters):
+        va = (args[0] + jnp.asarray((i + 1) * 1e-3, args[0].dtype),) + args[1:]
+        jax.block_until_ready(va[0])
         t0 = time.perf_counter()
-        out = timed(*args)
-        jax.block_until_ready(out)
+        read(timed(*va))
         times.append(time.perf_counter() - t0)
+        del va
     times.sort()
     return times[len(times) // 2] / inner
 
@@ -110,10 +156,9 @@ def bench(
         q = jax.random.normal(kq, (batch, seq, heads, head_dim), jnp.bfloat16)
         k = jax.random.normal(kk, (batch, seq, kv_heads, head_dim), jnp.bfloat16)
         v = jax.random.normal(kv_, (batch, seq, kv_heads, head_dim), jnp.bfloat16)
-        mask = jnp.triu(jnp.full((seq, seq), -1e9, jnp.float32), k=1)
 
         impls = {
-            "xla": jax.jit(lambda q, k, v: xla_attention(q, k, v, mask)),
+            "xla": jax.jit(xla_attention),
             "flash": jax.jit(lambda q, k, v: flash(q, k, v)),
         }
 
@@ -126,9 +171,7 @@ def bench(
         # Attention matmul FLOPs (scores + probs·V), fwd; bwd adds 2×.
         attn_flops = 2 * 2 * batch * seq * seq * heads * head_dim
         for name, fwd in impls.items():
-            fwd_s = _time(fwd, q, k, v, iters=iters, inner=inner)
-            bwd_s = _time(train_of(fwd), q, k, v, iters=iters, inner=inner)
-            row = {
+            base = {
                 "impl": name,
                 "platform": platform,
                 "device_kind": kind,
@@ -138,10 +181,31 @@ def bench(
                 "head_dim": head_dim,
                 "seq": seq,
                 "inner": inner,
-                "fwd_ms": round(fwd_s * 1e3, 3),
-                "fwd_bwd_ms": round(bwd_s * 1e3, 3),
-                "fwd_tflops": round(attn_flops / fwd_s / 1e12, 2),
             }
+            row = dict(base)
+            try:
+                # Forward first and recorded immediately: backward needs
+                # strictly more memory, so at the OOM boundary the fwd
+                # number survives beside the bwd error.
+                fwd_s = _time(fwd, q, k, v, iters=iters, inner=inner)
+                row.update(
+                    fwd_ms=round(fwd_s * 1e3, 3),
+                    fwd_tflops=round(attn_flops / fwd_s / 1e12, 2),
+                )
+                bwd_s = _time(train_of(fwd), q, k, v, iters=iters, inner=inner)
+                row.update(fwd_bwd_ms=round(bwd_s * 1e3, 3))
+            except Exception as exc:
+                # An impl failing at a size the other handles IS the
+                # benchmark's most interesting output (observed live: the
+                # XLA path's [B, H, S, S] f32 scores OOM a 16 GB v5e at
+                # seq 8192 while the flash kernel runs) — report and keep
+                # measuring the other impl.
+                msg = str(exc)
+                m = re.search(r"Ran out of memory[^\n]{0,160}", msg)
+                row.update(
+                    error=(m.group(0) if m else msg.strip().split("\n")[0][:200]),
+                    oom=bool(m or "memory" in msg.lower()),
+                )
             results.append(row)
             print(json.dumps(row), file=out, flush=True)
     return results

@@ -354,13 +354,16 @@ def run(
         for i in range(1, steps + 1):
             params, opt_state, loss = step(params, opt_state, tokens)
             if i % max(stats_every, 1) == 0 or i == steps:
-                loss.block_until_ready()  # one sync per window
+                lv = float(loss)  # one host-read sync per window
                 now = time.perf_counter()
-                stats.record(float(loss), i - done, now - window_t0)
+                stats.record(lv, i - done, now - window_t0)
                 window_t0, done = now, i
-    loss.block_until_ready()
+    # The barrier is a host read, not block_until_ready: on remote-
+    # dispatch transports (axon tunnel) block_until_ready can resolve
+    # ~5% before execution completes (measured); float() cannot.
+    final_loss = float(loss)
     elapsed = time.perf_counter() - t0
-    losses.append(float(loss))
+    losses.append(final_loss)
     steps_per_sec = steps / elapsed if elapsed > 0 else float("inf")
     return RunResult(
         losses=losses,

@@ -253,25 +253,16 @@ def test_alert_rule_coverage_threshold_matches_constant():
 
 def test_alert_rules_reference_known_families():
     """Every metric name any alert expr references must exist in the
-    canonical family registry — the same no-silent-drift rule the
-    dashboard PromQL validator enforces (tests/test_dashboards.py)."""
+    canonical family registry — enforced with the SAME helper the
+    dashboard PromQL validator uses (tests/test_dashboards.py), so a new
+    histogram convention or prefix extends both validators at once."""
     import os
-    import re
 
     import yaml
 
-    from tpumon.families import all_family_names, distribution_family_rows
+    from test_dashboards import _METRIC_RE, _known_metric_names
 
-    names = all_family_names()
-    histogram_names = {
-        n for n in names if n.endswith("_seconds")
-    } | set(distribution_family_rows())
-    names |= {
-        n + suffix
-        for n in histogram_names
-        for suffix in ("_bucket", "_sum", "_count")
-    }
-
+    names = _known_metric_names()
     path = os.path.join(
         os.path.dirname(os.path.dirname(__file__)),
         "deploy",
@@ -279,9 +270,6 @@ def test_alert_rules_reference_known_families():
     )
     with open(path, encoding="utf-8") as fh:
         doc = yaml.safe_load(fh)
-    metric_re = re.compile(
-        r"\b(?:accelerator|exporter|collector|workload)_[a-z0-9_]+"
-    )
     rules = [
         rule
         for group in doc["spec"]["groups"]
@@ -289,7 +277,7 @@ def test_alert_rules_reference_known_families():
     ]
     assert len(rules) >= 13
     for rule in rules:
-        for ref in metric_re.findall(rule["expr"]):
+        for ref in _METRIC_RE.findall(rule["expr"]):
             assert ref in names, (
                 f"alert {rule['alert']} references unknown metric {ref!r}"
             )

@@ -64,6 +64,94 @@ def test_unreachable_url_is_error_not_traceback(capsys):
     assert "cannot reach exporter" in capsys.readouterr().err
 
 
+class TestWorkloadView:
+    """--workload: the inside-the-process complement of the chip table."""
+
+    @pytest.fixture
+    def workload_server(self):
+        """A real metrics endpoint fed by the actual stats/counters
+        collectors, so the parser is tested against the genuine
+        exposition — not a hand-written fixture that could drift."""
+        from prometheus_client.registry import CollectorRegistry
+
+        from tpumon.exporter.server import (
+            ExporterServer,
+            _make_app,
+            registry_renderer,
+        )
+        from tpumon.exporter.telemetry import SelfTelemetry
+        from tpumon.workload.hlo_counters import (
+            CountersCollector,
+            HloOpCounters,
+        )
+        from tpumon.workload.stats import StatsCollector, WorkloadStats
+
+        counters = HloOpCounters()
+        counters.observe("all-reduce duration_us=12")
+        counters.observe("all-gather")
+        stats = WorkloadStats()
+        stats.configure(
+            flops_per_step=1e12, tokens_per_step=2048,
+            peak_flops_total=100e12, axes={"dp": 2, "tp": 2},
+        )
+        stats.record(loss=1.25, steps=40, seconds=1.0)
+        registry = CollectorRegistry()
+        registry.register(CountersCollector(counters))
+        registry.register(StatsCollector(stats))
+        telemetry = SelfTelemetry(registry)
+        import time as _time
+
+        telemetry.last_poll.set(_time.time())
+        server = ExporterServer(
+            _make_app(registry_renderer(registry), telemetry, lambda: (True, "ok\n")),
+            "127.0.0.1",
+            0,
+        )
+        server.start()
+        yield server
+        server.close()
+
+    def test_parse_real_exposition(self, workload_server):
+        text = smi._fetch(workload_server.url + "/metrics", 5.0)
+        wl = smi.workload_snapshot_from_text(text)
+        assert wl["steps_total"] == 40
+        assert wl["loss"] == pytest.approx(1.25)
+        assert wl["steps_per_sec"] == pytest.approx(40.0)
+        assert wl["mfu"] == pytest.approx(0.4)
+        assert wl["mesh"] == {"dp": 2, "tp": 2, "sp": 1, "pp": 1, "ep": 1}
+        assert wl["collectives"] == {"all-reduce": 1, "all-gather": 1}
+
+    def test_rendered_beside_chip_table(self, exporter, workload_server, capsys):
+        rc = smi.main(
+            ["--url", exporter.server.url, "--workload", workload_server.url]
+        )
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "workload: step 40" in text
+        assert "MFU 40.0%" in text
+        assert "mesh[dp=2 tp=2]" in text
+        assert "workload collectives:" in text
+
+    def test_dead_workload_does_not_kill_chip_table(self, exporter, capsys):
+        rc = smi.main(
+            ["--url", exporter.server.url, "--workload", "http://127.0.0.1:1",
+             "--timeout", "0.5"]
+        )
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "tpumon smi — " in text  # chip table intact
+        assert "workload:" in text and "unreachable" in text
+
+    def test_json_includes_workload(self, exporter, workload_server, capsys):
+        rc = smi.main(
+            ["--url", exporter.server.url, "--workload", workload_server.url,
+             "--json"]
+        )
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["workload"]["steps_total"] == 40
+
+
 def test_standalone_backend_mode():
     cfg = Config(backend="fake", fake_topology="v4-8", pod_attribution=False)
     snap = smi.snapshot_from_backend(cfg)

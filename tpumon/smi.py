@@ -158,6 +158,70 @@ def snapshot_from_families(families) -> dict:
     return snap
 
 
+def workload_snapshot_from_text(text: str) -> dict:
+    """Parse a workload /metrics page (harness --metrics-port) into the
+    summary smi renders: throughput, loss, MFU, mesh, collective counts."""
+    fams = {f.name: f for f in text_string_to_metric_families(text)}
+    snap: dict = {}
+
+    def scalar(name, key, cast=float):
+        fam = fams.get(name)
+        if fam is not None and fam.samples:
+            snap[key] = cast(fam.samples[0].value)
+
+    scalar("workload_steps", "steps_total", int)
+    scalar("workload_loss", "loss")
+    scalar("workload_steps_per_second", "steps_per_sec")
+    scalar("workload_tokens_per_second", "tokens_per_sec")
+    scalar("workload_mfu_ratio", "mfu")
+    mesh = fams.get("workload_mesh_info")
+    if mesh is not None and mesh.samples:
+        snap["mesh"] = {
+            k: int(v)
+            for k, v in mesh.samples[0].labels.items()
+            if k in ("dp", "tp", "sp", "pp", "ep")
+        }
+    ops = fams.get("workload_collective_ops")
+    if ops is not None:
+        snap["collectives"] = {
+            s.labels.get("op", "?"): int(s.value) for s in ops.samples
+        }
+    return snap
+
+
+def render_workload(wl: dict, p) -> None:
+    """Append the workload summary lines to a rendered snapshot."""
+    if "error" in wl:
+        p(f"workload: {wl.get('url', '?')} unreachable ({wl['error']})")
+        return
+    parts = []
+    if "steps_total" in wl:
+        parts.append(f"step {wl['steps_total']}")
+    if "loss" in wl:
+        parts.append(f"loss {wl['loss']:.4g}")
+    if "steps_per_sec" in wl:
+        parts.append(f"{wl['steps_per_sec']:.2f} steps/s")
+    if "tokens_per_sec" in wl:
+        parts.append(f"{wl['tokens_per_sec']:.0f} tok/s")
+    if "mfu" in wl:
+        parts.append(f"MFU {wl['mfu']:.1%}")
+    if "mesh" in wl:
+        axes = " ".join(
+            f"{k}={v}" for k, v in wl["mesh"].items() if v and v > 1
+        )
+        parts.append(f"mesh[{axes}]" if axes else "mesh[single]")
+    if parts:
+        p("workload: " + "  ".join(parts))
+    if wl.get("collectives"):
+        top = sorted(
+            wl["collectives"].items(), key=lambda kv: -kv[1]
+        )[:4]
+        p(
+            "workload collectives: "
+            + " ".join(f"{op}={n}" for op, n in top)
+        )
+
+
 def attach_trends(snap: dict, history_doc: dict, window: float) -> None:
     """Merge /history summaries into the snapshot (per-chip duty trend)."""
     series = history_doc.get("series", {})
@@ -371,6 +435,9 @@ def render(snap: dict, out=None) -> None:
     else:
         p("health: OK")
 
+    if "workload" in snap:
+        render_workload(snap["workload"], p)
+
 
 def main(argv: list[str] | None = None, out=None) -> int:
     parser = argparse.ArgumentParser(
@@ -387,6 +454,13 @@ def main(argv: list[str] | None = None, out=None) -> int:
         "--watch", type=float, metavar="SEC", help="refresh every SEC seconds"
     )
     parser.add_argument("--json", action="store_true", help="machine-readable output")
+    parser.add_argument(
+        "--workload",
+        metavar="URL",
+        help="a running workload's metrics URL (harness --metrics-port): "
+        "appends steps/s, loss, MFU, and collective counts to the view — "
+        "the inside-the-process complement of the chip table",
+    )
     parser.add_argument(
         "--window", type=float, default=60.0, help="trend window seconds"
     )
@@ -443,9 +517,23 @@ def main(argv: list[str] | None = None, out=None) -> int:
             snaps = list(pool.map(fetch, urls))
         return {"fleet": snaps, "ts": time.time()}
 
+    def attach_workload(snap: dict) -> None:
+        if not args.workload:
+            return
+        # Best-effort side fetch: a dead workload process must not take
+        # the chip table down with it.
+        try:
+            snap["workload"] = workload_snapshot_from_text(
+                _fetch(args.workload.rstrip("/") + "/metrics", args.timeout)
+            )
+        except fetch_errors as exc:
+            snap["workload"] = {"url": args.workload, "error": str(exc)}
+
     def one_snapshot() -> dict:
         if args.url and len(args.url) > 1:
-            return fleet_snapshot(args.url)
+            snap = fleet_snapshot(args.url)
+            attach_workload(snap)
+            return snap
         if args.url:
             snap = snapshot_from_url(args.url[0], args.timeout, args.window)
         elif args.backend:
@@ -472,6 +560,7 @@ def main(argv: list[str] | None = None, out=None) -> int:
                 backend = pinned_backend()
                 snap = snapshot_from_backend(source["cfg"], backend)
                 source["mode"] = "backend"
+        attach_workload(snap)
         snap["ts"] = time.time()
         return snap
 
@@ -480,6 +569,8 @@ def main(argv: list[str] | None = None, out=None) -> int:
             print(json.dumps(snap, sort_keys=True), file=out)
         elif "fleet" in snap:
             render_fleet(snap["fleet"], out)
+            if "workload" in snap:
+                render_workload(snap["workload"], lambda l="": print(l, file=out))
         else:
             render(snap, out)
 

@@ -101,19 +101,29 @@ def _time(fn, *args, iters: int, inner: int = 1) -> float:
     Each perturbed operand is built just before its iteration and dropped
     after it (never all iters at once — at seq 8192 ten pinned 64 MB
     copies would add real HBM pressure to a bench that probes the OOM
-    boundary), and the barrier fetches only the FIRST output leaf: one
-    materialized output proves the executable ran, and with ``inner > 1``
-    that leaf is the chained scalar, so the transfer is free. At
-    ``inner == 1`` (CPU interpret mode) the fetch is a host-local copy,
-    negligible against interpret-mode kernel times.
+    boundary), and the timed function always returns a SCALAR so the
+    barrier's host read transfers nothing: the chain already yields one
+    at ``inner > 1``; at ``inner == 1`` the outputs are summed in-graph
+    (a reduction XLA cannot dead-code-eliminate — returning a single
+    *element* instead would let it skip most of the computation).
     """
     import jax
     import jax.numpy as jnp
 
-    timed = _chain(fn, inner) if inner > 1 else fn
+    if inner > 1:
+        timed = _chain(fn, inner)
+    else:
+        def timed(*a, _fn=fn):
+            out = _fn(*a)
+            return sum(
+                jnp.sum(leaf.astype(jnp.float32))
+                for leaf in jax.tree.leaves(out)
+            )
+
+        timed = jax.jit(timed)
 
     def read(out):
-        return jax.device_get(jax.tree.leaves(out)[0])
+        return jax.device_get(out)
 
     read(timed(*args))  # compile + warmup
     times = []

@@ -37,6 +37,36 @@ def test_dashboard_copies_match_canonical():
     )
 
 
+def test_prometheusrule_template_matches_deploy_rules():
+    """The chart's PrometheusRule is generated from
+    deploy/prometheus-rules.yaml; after simulating Helm's rendering of
+    the gate/metadata/escapes, the alert set must be identical — chart
+    installs alert exactly like kustomize installs."""
+    with open(
+        os.path.join(CHART, "templates", "prometheusrule.yaml"),
+        encoding="utf-8",
+    ) as fh:
+        tpl = fh.read()
+    # Simulate Helm: drop the gate lines, un-escape the literal braces,
+    # substitute the metadata includes with plain scalars.
+    rendered = []
+    for line in tpl.splitlines():
+        if line.lstrip().startswith("{{-"):
+            # Keep a blank line so a folded scalar right before
+            # {{- end }} keeps its clip-chomped trailing newline.
+            rendered.append("")
+            continue
+        line = line.replace('{{ "{{" }}', "{{").replace('{{ "}}" }}', "}}")
+        line = re.sub(r"\{\{ include [^}]+\}\}", "tpumon", line)
+        rendered.append(line)
+    doc = yaml.safe_load("\n".join(rendered))
+    with open(
+        os.path.join(ROOT, "deploy", "prometheus-rules.yaml"), encoding="utf-8"
+    ) as fh:
+        deploy = yaml.safe_load(fh)
+    assert doc["spec"] == deploy["spec"]
+
+
 def test_template_env_vars_exist_in_config():
     """Every TPUMON_* env the chart sets must be a real Config knob."""
     from tpumon.config import Config

@@ -229,6 +229,60 @@ class TestWorkloadStats:
         fams = self._families(stats)
         assert set(fams) == {"workload_steps"}  # counter reads 0
 
+    def test_concurrent_record_and_collect(self):
+        """SURVEY §5.2 discipline: the train loop writes while the
+        metrics server collects — hammer both sides and require every
+        scrape to be internally coherent (monotonic steps, mfu computed
+        from the same snapshot's rate)."""
+        import threading
+
+        from tpumon.workload.stats import WorkloadStats, stats_families
+
+        stats = WorkloadStats()
+        stats.configure(
+            flops_per_step=1e12, tokens_per_step=1024,
+            peak_flops_total=100e12, axes={"dp": 2},
+        )
+        stop = threading.Event()
+        errors: list = []
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                stats.record(loss=float(i), steps=1, seconds=0.01)
+
+        def reader():
+            last_steps = 0
+            while not stop.is_set():
+                try:
+                    fams = {f.name: f for f in stats_families(stats)}
+                    steps = fams["workload_steps"].samples[0].value
+                    assert steps >= last_steps, "step counter went backwards"
+                    last_steps = steps
+                    if "workload_mfu_ratio" in fams and "workload_steps_per_second" in fams:
+                        mfu = fams["workload_mfu_ratio"].samples[0].value
+                        rate = fams["workload_steps_per_second"].samples[0].value
+                        assert abs(mfu - 1e12 * rate / 100e12) < 1e-9, (
+                            "mfu and rate from different snapshots"
+                        )
+                except Exception as exc:  # surfaces in the main thread
+                    errors.append(exc)
+                    return
+
+        threads = [threading.Thread(target=writer)] + [
+            threading.Thread(target=reader) for _ in range(3)
+        ]
+        for t in threads:
+            t.start()
+        import time as _t
+
+        _t.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert not errors, errors[0]
+
     def test_run_records_windows(self):
         """The harness records exact windowed throughput without changing
         its results; CPU run ⇒ MFU absent but rate present."""

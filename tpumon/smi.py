@@ -517,23 +517,42 @@ def main(argv: list[str] | None = None, out=None) -> int:
             snaps = list(pool.map(fetch, urls))
         return {"fleet": snaps, "ts": time.time()}
 
-    def attach_workload(snap: dict) -> None:
-        if not args.workload:
-            return
+    def fetch_workload() -> dict:
         # Best-effort side fetch: a dead workload process must not take
         # the chip table down with it.
         try:
-            snap["workload"] = workload_snapshot_from_text(
+            return workload_snapshot_from_text(
                 _fetch(args.workload.rstrip("/") + "/metrics", args.timeout)
             )
         except fetch_errors as exc:
-            snap["workload"] = {"url": args.workload, "error": str(exc)}
+            return {"url": args.workload, "error": str(exc)}
 
     def one_snapshot() -> dict:
+        # The workload fetch rides a side thread so a dead endpoint costs
+        # the refresh ONE timeout total, overlapped with the chip fetch —
+        # the same invariant the fleet pool keeps for down hosts.
+        wl_box: dict = {}
+        wl_thread = None
+        if args.workload:
+            import threading
+
+            wl_thread = threading.Thread(
+                target=lambda: wl_box.update(wl=fetch_workload())
+            )
+            wl_thread.start()
+        try:
+            snap = _chip_snapshot()
+        finally:
+            if wl_thread is not None:
+                wl_thread.join()
+        if wl_thread is not None:
+            snap["workload"] = wl_box["wl"]
+        snap["ts"] = time.time()
+        return snap
+
+    def _chip_snapshot() -> dict:
         if args.url and len(args.url) > 1:
-            snap = fleet_snapshot(args.url)
-            attach_workload(snap)
-            return snap
+            return fleet_snapshot(args.url)
         if args.url:
             snap = snapshot_from_url(args.url[0], args.timeout, args.window)
         elif args.backend:
@@ -560,8 +579,6 @@ def main(argv: list[str] | None = None, out=None) -> int:
                 backend = pinned_backend()
                 snap = snapshot_from_backend(source["cfg"], backend)
                 source["mode"] = "backend"
-        attach_workload(snap)
-        snap["ts"] = time.time()
         return snap
 
     def emit(snap: dict) -> None:

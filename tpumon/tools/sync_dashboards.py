@@ -8,6 +8,12 @@ copies are *generated*, not hand-synced:
     python -m tpumon.tools.sync_dashboards          # regenerate copies
     python -m tpumon.tools.sync_dashboards --check  # exit 1 if any drifted
 
+The same applies to the alert rules: ``deploy/prometheus-rules.yaml`` is the
+single authored source, and the Helm chart's PrometheusRule template is
+generated from its ``spec:`` block verbatim (wrapped in release metadata and
+a ``prometheusRules.enabled`` gate), so chart installs alert identically to
+kustomize installs.
+
 The --check mode backs tests/test_helm_chart.py's identity test, so a stale
 copy fails CI with the regeneration command in the message.
 """
@@ -26,6 +32,41 @@ COPIES = (
     os.path.join(REPO, "deploy", "dashboards"),
     os.path.join(REPO, "charts", "tpumon", "dashboards"),
 )
+
+RULES_SRC = os.path.join(REPO, "deploy", "prometheus-rules.yaml")
+RULES_TEMPLATE = os.path.join(
+    REPO, "charts", "tpumon", "templates", "prometheusrule.yaml"
+)
+
+
+def render_rules_template() -> str:
+    """The chart's PrometheusRule: deploy/prometheus-rules.yaml's spec
+    block verbatim under Helm-templated metadata."""
+    with open(RULES_SRC, encoding="utf-8") as fh:
+        text = fh.read()
+    marker = "\nspec:\n"
+    at = text.index(marker)
+    spec_body = text[at + len(marker):]
+    # The rules' own {{ $labels.x }} is PROMETHEUS templating; escape it
+    # so Helm renders the braces literally instead of erroring on $labels.
+    spec_body = spec_body.replace("{{", "\x00L").replace("}}", "\x00R")
+    spec_body = spec_body.replace("\x00L", '{{ "{{" }}').replace(
+        "\x00R", '{{ "}}" }}'
+    )
+    return (
+        "{{- if .Values.prometheusRules.enabled }}\n"
+        "# GENERATED from deploy/prometheus-rules.yaml — do not edit.\n"
+        "# Regenerate with: python -m tpumon.tools.sync_dashboards\n"
+        "apiVersion: monitoring.coreos.com/v1\n"
+        "kind: PrometheusRule\n"
+        "metadata:\n"
+        "  name: {{ include \"tpumon.name\" . }}\n"
+        "  labels:\n"
+        "    {{- include \"tpumon.labels\" . | nindent 4 }}\n"
+        "spec:\n"
+        + spec_body
+        + "{{- end }}\n"
+    )
 
 
 def canonical_files() -> list[str]:
@@ -51,6 +92,15 @@ def check() -> list[str]:
                 problems.append(f"{dst}: differs from canonical")
         for name in set(have) - set(names):
             problems.append(f"{os.path.join(copy, name)}: orphan (no canonical source)")
+    want = render_rules_template()
+    if not os.path.exists(RULES_TEMPLATE):
+        problems.append(f"{RULES_TEMPLATE}: missing")
+    else:
+        with open(RULES_TEMPLATE, encoding="utf-8") as fh:
+            if fh.read() != want:
+                problems.append(
+                    f"{RULES_TEMPLATE}: differs from deploy/prometheus-rules.yaml"
+                )
     return problems
 
 
@@ -63,6 +113,8 @@ def sync() -> None:
         for name in os.listdir(copy):
             if name.endswith(".json") and name not in names:
                 os.remove(os.path.join(copy, name))
+    with open(RULES_TEMPLATE, "w", encoding="utf-8") as fh:
+        fh.write(render_rules_template())
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -146,6 +146,8 @@ def bench(
     seqs: tuple[int, ...] = (512, 1024, 2048),
     iters: int = 10,
     inner: int | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
     out=sys.stdout,
 ) -> list[dict]:
     import jax
@@ -159,7 +161,7 @@ def bench(
         # Amortize the dispatch round-trip on real hardware; interpret
         # mode (CPU) is slow enough per call that inner=1 is right.
         inner = 16 if platform == "tpu" else 1
-    flash = make_flash_attn()
+    flash = make_flash_attn(block_q=block_q, block_k=block_k)
     results = []
     for seq in seqs:
         kq, kk, kv_ = jax.random.split(jax.random.PRNGKey(0), 3)
@@ -192,6 +194,8 @@ def bench(
                 "seq": seq,
                 "inner": inner,
             }
+            if name == "flash":
+                base["block_q"], base["block_k"] = block_q, block_k
             row = dict(base)
             try:
                 # Forward first and recorded immediately: backward needs
@@ -235,6 +239,15 @@ def main(argv=None) -> int:
         "on TPU to amortize dispatch latency, 1 elsewhere)",
     )
     parser.add_argument(
+        "--block-q", type=int, default=128,
+        help="flash kernel q-block rows (tiling experiments; rows record "
+        "the values used)",
+    )
+    parser.add_argument(
+        "--block-k", type=int, default=128,
+        help="flash kernel k-block rows",
+    )
+    parser.add_argument(
         "--platform",
         choices=("auto", "cpu"),
         default="auto",
@@ -255,6 +268,8 @@ def main(argv=None) -> int:
         seqs=tuple(args.seq),
         iters=args.iters,
         inner=args.inner,
+        block_q=args.block_q,
+        block_k=args.block_k,
     )
     return 0
 

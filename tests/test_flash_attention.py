@@ -240,6 +240,41 @@ def test_harness_flash_rejects_pp():
         run(LlamaConfig.tiny(), steps=1, batch=2, seq=32, pp=2, attn="flash")
 
 
+def test_bench_reports_impl_failure_as_row(monkeypatch):
+    """An impl that cannot run at a size (the observed live case: XLA
+    OOMs a 16 GB chip at seq 8192) must yield an error row — with the
+    already-measured forward kept when only backward fails — and the
+    bench must keep going, not die."""
+    import io
+
+    from tpumon.workload import bench_attention as ba
+
+    calls = {"n": 0}
+
+    def failing_time(fn, *args, iters, inner=1):
+        calls["n"] += 1
+        if calls["n"] == 2:  # xla bwd: fwd measured, bwd OOMs
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: Ran out of memory in memory space "
+                "hbm. Used 16.12G of 15.75G hbm."
+            )
+        return 1e-3
+
+    monkeypatch.setattr(ba, "_time", failing_time)
+    rows = ba.bench(
+        batch=1, heads=2, kv_heads=1, head_dim=8, seqs=(16,), iters=1,
+        out=io.StringIO(),
+    )
+    assert len(rows) == 2  # both impls reported
+    xla = next(r for r in rows if r["impl"] == "xla")
+    flash = next(r for r in rows if r["impl"] == "flash")
+    assert xla["oom"] is True and "Ran out of memory" in xla["error"]
+    assert xla["fwd_ms"] == 1.0  # measured forward survives the bwd OOM
+    assert "fwd_bwd_ms" not in xla
+    assert flash["fwd_bwd_ms"] == pytest.approx(1.0)
+    assert "error" not in flash
+
+
 @pytest.mark.tpu
 def test_flash_vs_xla_bench_on_real_chip():
     """SURVEY §6 'measure and record': the flash-vs-XLA comparison runs

@@ -250,7 +250,11 @@ class TestWorkloadStats:
             i = 0
             while not stop.is_set():
                 i += 1
-                stats.record(loss=float(i), steps=1, seconds=0.01)
+                try:
+                    stats.record(loss=float(i), steps=1, seconds=0.01)
+                except Exception as exc:  # must FAIL the test, not die silent
+                    errors.append(exc)
+                    return
 
         def reader():
             last_steps = 0

@@ -528,25 +528,26 @@ def main(argv: list[str] | None = None, out=None) -> int:
             return {"url": args.workload, "error": str(exc)}
 
     def one_snapshot() -> dict:
-        # The workload fetch rides a side thread so a dead endpoint costs
-        # the refresh ONE timeout total, overlapped with the chip fetch —
-        # the same invariant the fleet pool keeps for down hosts.
-        wl_box: dict = {}
-        wl_thread = None
-        if args.workload:
-            import threading
+        # The workload fetch rides a future so a dead endpoint costs the
+        # refresh ONE timeout total, overlapped with the chip fetch — the
+        # same invariant (and the same concurrent.futures machinery) the
+        # fleet pool keeps for down hosts. A future, not a bare thread:
+        # an exception outside fetch_workload's curated catches re-raises
+        # here with its real traceback instead of dying in the thread.
+        from concurrent.futures import ThreadPoolExecutor
 
-            wl_thread = threading.Thread(
-                target=lambda: wl_box.update(wl=fetch_workload())
-            )
-            wl_thread.start()
+        wl_future = None
+        pool = None
+        if args.workload:
+            pool = ThreadPoolExecutor(max_workers=1)
+            wl_future = pool.submit(fetch_workload)
         try:
             snap = _chip_snapshot()
+            if wl_future is not None:
+                snap["workload"] = wl_future.result()
         finally:
-            if wl_thread is not None:
-                wl_thread.join()
-        if wl_thread is not None:
-            snap["workload"] = wl_box["wl"]
+            if pool is not None:
+                pool.shutdown(wait=False)
         snap["ts"] = time.time()
         return snap
 

@@ -7,6 +7,25 @@ def test_metrics_doc_not_stale():
     assert main(["--check"]) == 0
 
 
+def test_migration_guide_references_known_families():
+    """docs/MIGRATING.md's metric map must reference only families the
+    registry knows — the same no-drift rule as dashboards and alerts."""
+    import os
+
+    from test_dashboards import _METRIC_RE, _known_metric_names
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(__file__)), "docs", "MIGRATING.md"
+    )
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    names = _known_metric_names()
+    refs = set(_METRIC_RE.findall(text))
+    assert len(refs) >= 12  # the mapping table is the point of the doc
+    for ref in refs:
+        assert ref in names, f"MIGRATING.md references unknown family {ref!r}"
+
+
 def test_registry_matches_live_scrape():
     """tpumon/families.py must describe what the exporter actually emits.
 

@@ -277,7 +277,14 @@ def test_alert_rules_reference_known_families():
     ]
     assert len(rules) >= 13
     for rule in rules:
-        for ref in _METRIC_RE.findall(rule["expr"]):
+        # Annotations too: a runbook description pointing operators at a
+        # misspelled family is the same silent drift as a broken expr
+        # (caught live: an annotation said accelerator_hlo_queue_size
+        # where the family is accelerator_queue_size).
+        text = rule["expr"] + " " + " ".join(
+            str(v) for v in rule.get("annotations", {}).values()
+        )
+        for ref in _METRIC_RE.findall(text):
             assert ref in names, (
                 f"alert {rule['alert']} references unknown metric {ref!r}"
             )

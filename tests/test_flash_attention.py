@@ -240,6 +240,39 @@ def test_harness_flash_rejects_pp():
         run(LlamaConfig.tiny(), steps=1, batch=2, seq=32, pp=2, attn="flash")
 
 
+def test_sweep_blocks_smoke():
+    """The tiling-sweep mode emits one row per DISTINCT effective
+    (block_q, block_k) with both timings (interpret mode here)."""
+    import io
+
+    from tpumon.workload.bench_attention import sweep_blocks
+
+    rows = sweep_blocks(
+        batch=1, heads=2, kv_heads=1, head_dim=8, seqs=(16,), iters=1,
+        blocks=(8, 16), out=io.StringIO(),
+    )
+    assert len(rows) == 4
+    for r in rows:
+        assert r["fwd_ms"] > 0 and r["fwd_bwd_ms"] > 0
+        assert r["effective_block_q"] == r["block_q"]  # no clamping here
+        assert r["heads"] == 2 and r["head_dim"] == 8  # self-describing
+
+
+def test_sweep_blocks_dedupes_clamped_tilings():
+    """Oversized requested blocks all clamp to the sequence length; the
+    sweep must time that kernel once, not once per label."""
+    import io
+
+    from tpumon.workload.bench_attention import sweep_blocks
+
+    rows = sweep_blocks(
+        batch=1, heads=2, kv_heads=1, head_dim=8, seqs=(16,), iters=1,
+        blocks=(128, 512), out=io.StringIO(),
+    )
+    assert len(rows) == 1
+    assert (rows[0]["effective_block_q"], rows[0]["effective_block_k"]) == (16, 16)
+
+
 def test_bench_reports_impl_failure_as_row(monkeypatch):
     """An impl that cannot run at a size (the observed live case: XLA
     OOMs a 16 GB chip at seq 8192) must yield an error row — with the

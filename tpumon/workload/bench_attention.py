@@ -138,6 +138,38 @@ def _time(fn, *args, iters: int, inner: int = 1) -> float:
     return times[len(times) // 2] / inner
 
 
+def _timed_row(base: dict, fwd, bwd, q, k, v, *, iters, inner, attn_flops,
+               results, out) -> None:
+    """Time one impl (fwd then fwd+bwd) into a result row; an impl that
+    cannot run at this configuration yields an error row instead — with
+    the already-measured forward kept when only backward fails (backward
+    needs strictly more memory, so that is the OOM boundary's shape).
+    Shared by the flash-vs-XLA bench and the tiling sweep so the timing
+    protocol and error classification cannot drift between modes."""
+    row = dict(base)
+    try:
+        fwd_s = _time(fwd, q, k, v, iters=iters, inner=inner)
+        row.update(
+            fwd_ms=round(fwd_s * 1e3, 3),
+            fwd_tflops=round(attn_flops / fwd_s / 1e12, 2),
+        )
+        bwd_s = _time(bwd, q, k, v, iters=iters, inner=inner)
+        row.update(fwd_bwd_ms=round(bwd_s * 1e3, 3))
+    except Exception as exc:
+        # An impl failing at a size another configuration handles IS the
+        # benchmark's most interesting output (observed live: the XLA
+        # path's [B, H, S, S] f32 scores OOM a 16 GB v5e at seq 8192
+        # while the flash kernel runs) — report and keep measuring.
+        msg = str(exc)
+        m = re.search(r"Ran out of memory[^\n]{0,160}", msg)
+        row.update(
+            error=(m.group(0) if m else msg.strip().split("\n")[0][:200]),
+            oom=bool(m or "memory" in msg.lower()),
+        )
+    results.append(row)
+    print(json.dumps(row), file=out, flush=True)
+
+
 def bench(
     batch: int = 4,
     heads: int = 8,
@@ -196,32 +228,88 @@ def bench(
             }
             if name == "flash":
                 base["block_q"], base["block_k"] = block_q, block_k
-            row = dict(base)
-            try:
-                # Forward first and recorded immediately: backward needs
-                # strictly more memory, so at the OOM boundary the fwd
-                # number survives beside the bwd error.
-                fwd_s = _time(fwd, q, k, v, iters=iters, inner=inner)
-                row.update(
-                    fwd_ms=round(fwd_s * 1e3, 3),
-                    fwd_tflops=round(attn_flops / fwd_s / 1e12, 2),
+            _timed_row(
+                base, fwd, train_of(fwd), q, k, v, iters=iters, inner=inner,
+                attn_flops=attn_flops, results=results, out=out,
+            )
+    return results
+
+
+def sweep_blocks(
+    batch: int = 4,
+    heads: int = 8,
+    kv_heads: int = 4,
+    head_dim: int = 128,
+    seqs: tuple[int, ...] = (4096,),
+    iters: int = 3,
+    inner: int | None = None,
+    blocks: tuple[int, ...] = (128, 256, 512),
+    out=sys.stdout,
+) -> list[dict]:
+    """Flash-kernel tiling sweep: one row per (seq, block_q, block_k).
+
+    Reproduces the BASELINE.md tiling table with one command:
+    ``python -m tpumon.workload.bench_attention --sweep-blocks --seq 4096``.
+    Forward and forward+backward both timed; a tiling that OOMs or fails
+    to compile reports an error row like the main bench. Rows record the
+    EFFECTIVE block sizes after ``_pick_block`` clamping (alongside the
+    requested ones) and tilings that clamp to an already-timed effective
+    pair are skipped — at seq 64 the whole {128,256,512}² grid is one
+    (64, 64) kernel, and timing it nine times under nine labels would
+    make the table a fiction.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tpumon.workload.ops.flash_attention import _pick_block, flash_attention
+
+    platform = jax.devices()[0].platform
+    kind = getattr(jax.devices()[0], "device_kind", platform)
+    if inner is None:
+        inner = 16 if platform == "tpu" else 1
+    results = []
+    for seq in seqs:
+        kq, kk, kv_ = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(kq, (batch, seq, heads, head_dim), jnp.bfloat16)
+        k = jax.random.normal(kk, (batch, seq, kv_heads, head_dim), jnp.bfloat16)
+        v = jax.random.normal(kv_, (batch, seq, kv_heads, head_dim), jnp.bfloat16)
+        attn_flops = 2 * 2 * batch * seq * seq * heads * head_dim
+        seen: set = set()
+        for bq in blocks:
+            for bk in blocks:
+                eff = (_pick_block(seq, bq), _pick_block(seq, bk))
+                if eff in seen:
+                    continue
+                seen.add(eff)
+                fwd = jax.jit(
+                    lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                        q, k, v, block_q=bq, block_k=bk
+                    )
                 )
-                bwd_s = _time(train_of(fwd), q, k, v, iters=iters, inner=inner)
-                row.update(fwd_bwd_ms=round(bwd_s * 1e3, 3))
-            except Exception as exc:
-                # An impl failing at a size the other handles IS the
-                # benchmark's most interesting output (observed live: the
-                # XLA path's [B, H, S, S] f32 scores OOM a 16 GB v5e at
-                # seq 8192 while the flash kernel runs) — report and keep
-                # measuring the other impl.
-                msg = str(exc)
-                m = re.search(r"Ran out of memory[^\n]{0,160}", msg)
-                row.update(
-                    error=(m.group(0) if m else msg.strip().split("\n")[0][:200]),
-                    oom=bool(m or "memory" in msg.lower()),
+
+                def loss(q, k, v, fwd=fwd):
+                    return jnp.sum(fwd(q, k, v).astype(jnp.float32))
+
+                bwd = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+                base = {
+                    "impl": "flash",
+                    "platform": platform,
+                    "device_kind": kind,
+                    "batch": batch,
+                    "heads": heads,
+                    "kv_heads": kv_heads,
+                    "head_dim": head_dim,
+                    "seq": seq,
+                    "block_q": bq,
+                    "block_k": bk,
+                    "effective_block_q": eff[0],
+                    "effective_block_k": eff[1],
+                    "inner": inner,
+                }
+                _timed_row(
+                    base, fwd, bwd, q, k, v, iters=iters, inner=inner,
+                    attn_flops=attn_flops, results=results, out=out,
                 )
-            results.append(row)
-            print(json.dumps(row), file=out, flush=True)
     return results
 
 
@@ -248,6 +336,12 @@ def main(argv=None) -> int:
         help="flash kernel k-block rows",
     )
     parser.add_argument(
+        "--sweep-blocks", action="store_true",
+        help="tiling sweep mode: time the flash kernel at every "
+        "(block_q, block_k) in {128,256,512}^2 per --seq instead of the "
+        "flash-vs-XLA comparison (reproduces BASELINE.md's tiling table)",
+    )
+    parser.add_argument(
         "--platform",
         choices=("auto", "cpu"),
         default="auto",
@@ -260,6 +354,17 @@ def main(argv=None) -> int:
         from tpumon.workload.platform import force_cpu_devices
 
         force_cpu_devices(1)
+    if args.sweep_blocks:
+        sweep_blocks(
+            batch=args.batch,
+            heads=args.heads,
+            kv_heads=args.kv_heads,
+            head_dim=args.head_dim,
+            seqs=tuple(args.seq),
+            iters=args.iters,
+            inner=args.inner,
+        )
+        return 0
     bench(
         batch=args.batch,
         heads=args.heads,

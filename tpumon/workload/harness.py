@@ -591,6 +591,15 @@ def main(argv: list[str] | None = None) -> int:
         help="expose workload-side collective-op counters (0 = off)",
     )
     parser.add_argument(
+        "--stats-every",
+        type=int,
+        default=20,
+        help="steps per live-telemetry window (one host sync per window; "
+        "only meaningful with --metrics-port, and ignored with "
+        "--checkpoint-dir, whose loop records losses per step by design "
+        "so stats windows are per-step there)",
+    )
+    parser.add_argument(
         "--platform",
         choices=("auto", "cpu"),
         default="auto",
@@ -724,6 +733,7 @@ def main(argv: list[str] | None = None) -> int:
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,
             stats=stats,
+            stats_every=args.stats_every,
         )
         log.info(
             "loss %.4f → %.4f | %.2f steps/s | %.1f GFLOP/step | MFU %s | "

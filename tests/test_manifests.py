@@ -121,6 +121,28 @@ def test_kustomization_files_exist():
         assert os.path.exists(os.path.join(DEPLOY, res)), res
 
 
+def test_kustomization_ships_every_dashboard():
+    """The configMapGenerator must enumerate every canonical dashboard —
+    a new dashboard that lands in dashboards/ but not here silently
+    never reaches Grafana on kustomize installs (caught live with
+    workload-overview.json)."""
+    (kust,) = _load("kustomization.yaml")
+    gen = next(
+        g for g in kust["configMapGenerator"] if g["name"] == "tpumon-dashboards"
+    )
+    listed = {os.path.basename(f) for f in gen["files"]}
+    canonical = {
+        n
+        for n in os.listdir(
+            os.path.join(os.path.dirname(DEPLOY), "dashboards")
+        )
+        if n.endswith(".json")
+    }
+    assert listed == canonical, (
+        f"kustomization dashboards {listed} != canonical {canonical}"
+    )
+
+
 def test_container_entrypoints_are_importable():
     """The commands the manifests run must resolve to real modules."""
     (ds,) = _load("daemonset.yaml")

@@ -170,6 +170,39 @@ def _timed_row(base: dict, fwd, bwd, q, k, v, *, iters, inner, attn_flops,
     print(json.dumps(row), file=out, flush=True)
 
 
+def _bench_setup(batch, heads, kv_heads, head_dim, seq, inner):
+    """Shared per-seq setup for both bench modes: platform/inner
+    resolution, deterministic q/k/v, and the attention FLOPs count
+    (scores + probs·V matmuls; bwd adds 2×) — in one place so the two
+    modes' numbers cannot desynchronize."""
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform
+    kind = getattr(jax.devices()[0], "device_kind", platform)
+    if inner is None:
+        # Amortize the dispatch round-trip on real hardware; interpret
+        # mode (CPU) is slow enough per call that inner=1 is right.
+        inner = 16 if platform == "tpu" else 1
+    kq, kk, kv_ = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (batch, seq, heads, head_dim), jnp.bfloat16)
+    k = jax.random.normal(kk, (batch, seq, kv_heads, head_dim), jnp.bfloat16)
+    v = jax.random.normal(kv_, (batch, seq, kv_heads, head_dim), jnp.bfloat16)
+    attn_flops = 2 * 2 * batch * seq * seq * heads * head_dim
+    return platform, kind, inner, q, k, v, attn_flops
+
+
+def _train_of(fwd):
+    """fwd → jitted grad of a scalar loss over it (the timed bwd path)."""
+    import jax
+    import jax.numpy as jnp
+
+    def loss(q, k, v):
+        return jnp.sum(fwd(q, k, v).astype(jnp.float32))
+
+    return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+
 def bench(
     batch: int = 4,
     heads: int = 8,
@@ -183,37 +216,19 @@ def bench(
     out=sys.stdout,
 ) -> list[dict]:
     import jax
-    import jax.numpy as jnp
 
     from tpumon.workload.ops.flash_attention import make_flash_attn
 
-    platform = jax.devices()[0].platform
-    kind = getattr(jax.devices()[0], "device_kind", platform)
-    if inner is None:
-        # Amortize the dispatch round-trip on real hardware; interpret
-        # mode (CPU) is slow enough per call that inner=1 is right.
-        inner = 16 if platform == "tpu" else 1
     flash = make_flash_attn(block_q=block_q, block_k=block_k)
     results = []
     for seq in seqs:
-        kq, kk, kv_ = jax.random.split(jax.random.PRNGKey(0), 3)
-        q = jax.random.normal(kq, (batch, seq, heads, head_dim), jnp.bfloat16)
-        k = jax.random.normal(kk, (batch, seq, kv_heads, head_dim), jnp.bfloat16)
-        v = jax.random.normal(kv_, (batch, seq, kv_heads, head_dim), jnp.bfloat16)
-
+        platform, kind, seq_inner, q, k, v, attn_flops = _bench_setup(
+            batch, heads, kv_heads, head_dim, seq, inner
+        )
         impls = {
             "xla": jax.jit(xla_attention),
             "flash": jax.jit(lambda q, k, v: flash(q, k, v)),
         }
-
-        def train_of(fwd):
-            def loss(q, k, v):
-                return jnp.sum(fwd(q, k, v).astype(jnp.float32))
-
-            return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-
-        # Attention matmul FLOPs (scores + probs·V), fwd; bwd adds 2×.
-        attn_flops = 2 * 2 * batch * seq * seq * heads * head_dim
         for name, fwd in impls.items():
             base = {
                 "impl": name,
@@ -224,13 +239,14 @@ def bench(
                 "kv_heads": kv_heads,
                 "head_dim": head_dim,
                 "seq": seq,
-                "inner": inner,
+                "inner": seq_inner,
             }
             if name == "flash":
                 base["block_q"], base["block_k"] = block_q, block_k
             _timed_row(
-                base, fwd, train_of(fwd), q, k, v, iters=iters, inner=inner,
-                attn_flops=attn_flops, results=results, out=out,
+                base, fwd, _train_of(fwd), q, k, v, iters=iters,
+                inner=seq_inner, attn_flops=attn_flops, results=results,
+                out=out,
             )
     return results
 
@@ -259,21 +275,14 @@ def sweep_blocks(
     make the table a fiction.
     """
     import jax
-    import jax.numpy as jnp
 
-    from tpumon.workload.ops.flash_attention import _pick_block, flash_attention
+    from tpumon.workload.ops.flash_attention import _pick_block, make_flash_attn
 
-    platform = jax.devices()[0].platform
-    kind = getattr(jax.devices()[0], "device_kind", platform)
-    if inner is None:
-        inner = 16 if platform == "tpu" else 1
     results = []
     for seq in seqs:
-        kq, kk, kv_ = jax.random.split(jax.random.PRNGKey(0), 3)
-        q = jax.random.normal(kq, (batch, seq, heads, head_dim), jnp.bfloat16)
-        k = jax.random.normal(kk, (batch, seq, kv_heads, head_dim), jnp.bfloat16)
-        v = jax.random.normal(kv_, (batch, seq, kv_heads, head_dim), jnp.bfloat16)
-        attn_flops = 2 * 2 * batch * seq * seq * heads * head_dim
+        platform, kind, seq_inner, q, k, v, attn_flops = _bench_setup(
+            batch, heads, kv_heads, head_dim, seq, inner
+        )
         seen: set = set()
         for bq in blocks:
             for bk in blocks:
@@ -281,16 +290,8 @@ def sweep_blocks(
                 if eff in seen:
                     continue
                 seen.add(eff)
-                fwd = jax.jit(
-                    lambda q, k, v, bq=bq, bk=bk: flash_attention(
-                        q, k, v, block_q=bq, block_k=bk
-                    )
-                )
-
-                def loss(q, k, v, fwd=fwd):
-                    return jnp.sum(fwd(q, k, v).astype(jnp.float32))
-
-                bwd = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+                tiled = make_flash_attn(block_q=bq, block_k=bk)
+                fwd = jax.jit(lambda q, k, v, f=tiled: f(q, k, v))
                 base = {
                     "impl": "flash",
                     "platform": platform,
@@ -304,11 +305,12 @@ def sweep_blocks(
                     "block_k": bk,
                     "effective_block_q": eff[0],
                     "effective_block_k": eff[1],
-                    "inner": inner,
+                    "inner": seq_inner,
                 }
                 _timed_row(
-                    base, fwd, bwd, q, k, v, iters=iters, inner=inner,
-                    attn_flops=attn_flops, results=results, out=out,
+                    base, fwd, _train_of(fwd), q, k, v, iters=iters,
+                    inner=seq_inner, attn_flops=attn_flops, results=results,
+                    out=out,
                 )
     return results
 

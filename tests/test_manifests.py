@@ -126,18 +126,15 @@ def test_kustomization_ships_every_dashboard():
     a new dashboard that lands in dashboards/ but not here silently
     never reaches Grafana on kustomize installs (caught live with
     workload-overview.json)."""
+    from tpumon.tools.sync_dashboards import CANON, canonical_files
+
     (kust,) = _load("kustomization.yaml")
     gen = next(
         g for g in kust["configMapGenerator"] if g["name"] == "tpumon-dashboards"
     )
     listed = {os.path.basename(f) for f in gen["files"]}
-    canonical = {
-        n
-        for n in os.listdir(
-            os.path.join(os.path.dirname(DEPLOY), "dashboards")
-        )
-        if n.endswith(".json")
-    }
+    assert os.path.isdir(CANON)
+    canonical = set(canonical_files())
     assert listed == canonical, (
         f"kustomization dashboards {listed} != canonical {canonical}"
     )

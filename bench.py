@@ -4,35 +4,221 @@ Measures the full HTTP scrape path (client → WSGI server → cached
 exposition) against a v5p-64-host fake backend — the largest per-host
 topology in the BASELINE config ladder, with all 14 metric families plus
 per-link ICI gauges populated — while the 1 Hz poller runs concurrently,
-exactly as in production. The client holds ONE persistent HTTP/1.1
-connection, as Prometheus does between scrapes of the same target; this
-is the path that exposed (and now guards) the Nagle/delayed-ACK stall.
+exactly as in production. Two clients time the same server:
+
+- **http.client, one persistent HTTP/1.1 connection** (as Prometheus
+  holds between scrapes of the same target): the headline ``value``.
+  This is the driver-comparable number — it includes Python-client
+  overhead on the measuring side, so it is an upper bound on what a
+  production Go scraper sees.
+- **A raw socket speaking minimal HTTP/1.1** on the same keep-alive
+  pattern: ``raw_socket_p50_ms``/``raw_socket_p99_ms``. With the client
+  reduced to sendall+recv, this isolates the server-side cost; round 4
+  measured roughly half the http.client figure here.
+
 The poll loop and scrape path share only the atomic snapshot
-(SURVEY.md §3.2), so this is the number Prometheus sees.
+(SURVEY.md §3.2), so these are the numbers Prometheus sees. Both paths
+exercise the Nagle/delayed-ACK guard (persistent connections).
+
+The record also carries ``compiled_kernel_validated`` — whether this
+session actually executed the pallas flash kernel compiled on a real
+TPU (probed in a subprocess with a hard timeout, because a wedged
+device tunnel hangs ``jax.devices()`` forever). A round whose suite was
+green only because the TPU tests skipped is thereby visible in
+BENCH_r*.json instead of silently indistinguishable from a validated
+one (VERDICT r4 weakness 3).
 
 vs_baseline: the reference publishes no numbers (BASELINE.md: "published":
 {}), so the anchor is the 10 ms p99 scrape budget typical of the
 DCGM-exporter genre the reference belongs to; vs_baseline = 10ms / p99
 (>1 means faster than the genre budget).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 from __future__ import annotations
 
-import http.client
 import json
+import os
+import socket
+import subprocess
 import sys
 import time
 
 GENRE_P99_BUDGET_MS = 10.0
 SCRAPES = 500
 
+# Executed on the real chip in a subprocess: GQA shapes at seq 4096 so
+# default_blocks resolves to the PRODUCTION tuned tiles (256x512, not
+# the conservative 128x128 fallback a short probe would exercise), with
+# a gradient call so all three backward kernels compile and run too.
+# Values are forced back to the host, so "validated" means the kernels
+# executed, not just traced; the platform assert keeps a CPU fallback
+# from counting as validation.
+_KERNEL_PROBE_CODE = """
+import jax, jax.numpy as jnp
+from tpumon.workload.ops.flash_attention import flash_attention
+dev = jax.devices()[0]
+assert dev.platform == "tpu", f"not a TPU: {dev.platform}"
+kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+q = jax.random.normal(kq, (1, 4096, 4, 128), jnp.bfloat16)
+k = jax.random.normal(kk, (1, 4096, 2, 128), jnp.bfloat16)
+v = jax.random.normal(kv, (1, 4096, 2, 128), jnp.bfloat16)
+
+def loss(q, k, v):
+    return jnp.sum(flash_attention(q, k, v).astype(jnp.float32))
+
+val, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+val = float(val)
+assert val == val, "non-finite kernel output"
+for g in grads:
+    gs = float(jnp.sum(jnp.abs(g.astype(jnp.float32))))
+    assert gs == gs and gs > 0, f"bad gradient: {gs}"
+print(f"KERNEL_OK {getattr(dev, 'device_kind', dev.platform)}")
+"""
+
+
+def _percentiles(samples_ms: list[float]) -> tuple[float, float]:
+    s = sorted(samples_ms)
+    return (
+        s[len(s) // 2],
+        s[max(int(len(s) * 0.99) - 1, 0)],
+    )
+
+
+def measure_http_client(port: int, scrapes: int = SCRAPES) -> tuple[float, float]:
+    """(p50, p99) ms over one persistent http.client connection."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", "/metrics")
+        body = conn.getresponse().read()  # warm + sanity
+        assert b"accelerator_duty_cycle_percent" in body, "families missing"
+        samples = []
+        for _ in range(scrapes):
+            t0 = time.perf_counter()
+            conn.request("GET", "/metrics")
+            conn.getresponse().read()
+            samples.append((time.perf_counter() - t0) * 1e3)
+    finally:
+        conn.close()
+    return _percentiles(samples)
+
+
+def measure_raw_socket(port: int, scrapes: int = SCRAPES) -> tuple[float, float]:
+    """(p50, p99) ms with a minimal raw-socket HTTP/1.1 keep-alive client.
+
+    sendall + recv-until-content-length is as close to zero client
+    overhead as Python gets, so this approximates the server-side cost a
+    compiled-language scraper would see.
+    """
+    req = (
+        b"GET /metrics HTTP/1.1\r\n"
+        b"Host: 127.0.0.1\r\n"
+        b"Connection: keep-alive\r\n\r\n"
+    )
+    sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def recv_or_die() -> bytes:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("server closed the keep-alive connection")
+        return chunk
+
+    def scrape() -> bytes:
+        sock.sendall(req)
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            buf += recv_or_die()
+        head, body = buf.split(b"\r\n\r\n", 1)
+        length = None
+        for line in head.split(b"\r\n"):
+            if line.lower().startswith(b"content-length:"):
+                length = int(line.split(b":", 1)[1])
+        assert length is not None, "server must send Content-Length"
+        while len(body) < length:
+            body += recv_or_die()
+        return body
+
+    try:
+        body = scrape()  # warm + sanity
+        assert b"accelerator_duty_cycle_percent" in body, "families missing"
+        samples = []
+        for _ in range(scrapes):
+            t0 = time.perf_counter()
+            scrape()
+            samples.append((time.perf_counter() - t0) * 1e3)
+    finally:
+        sock.close()
+    return _percentiles(samples)
+
+
+def probe_compiled_kernel(timeout_s: float = 300.0) -> dict:
+    """Run the flash kernel compiled on the real TPU, in a subprocess.
+
+    Subprocess + hard timeout because the failure mode being guarded
+    against is a device tunnel that hangs ``jax.devices()`` forever
+    (observed live, round 4) — an in-process probe would wedge the whole
+    bench. Returns {"validated": bool, "detail": str}.
+    Set TPUMON_BENCH_KERNEL_PROBE=0 to skip (recorded as not validated).
+    """
+    if os.environ.get("TPUMON_BENCH_KERNEL_PROBE", "1") == "0":
+        return {"validated": False, "detail": "probe disabled by env"}
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _KERNEL_PROBE_CODE],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return {
+            "validated": False,
+            "detail": f"probe timed out after {timeout_s:.0f}s "
+            "(device init hang — the round-4 wedge signature)",
+        }
+    if proc.returncode == 0 and "KERNEL_OK" in proc.stdout:
+        kind = proc.stdout.strip().split("KERNEL_OK", 1)[1].strip()
+        return {"validated": True, "detail": f"flash kernel executed on {kind}"}
+    tail = (proc.stderr or proc.stdout).strip().split("\n")
+    return {"validated": False, "detail": tail[-1][:200] if tail else "probe failed"}
+
+
+def build_record(
+    http_p50: float,
+    http_p99: float,
+    raw_p50: float,
+    raw_p99: float,
+    kernel: dict,
+) -> dict:
+    """The one-line BENCH record. ``value`` is the client-inclusive p99 —
+    the conservative, driver-comparable headline; the raw-socket fields
+    carry the server-side breakdown (VERDICT r4 weakness 1)."""
+    return {
+        "metric": "exporter_p99_scrape_latency",
+        "value": round(http_p99, 3),
+        "unit": "ms",
+        "vs_baseline": round(GENRE_P99_BUDGET_MS / http_p99, 2),
+        "client_p50_ms": round(http_p50, 3),
+        "raw_socket_p50_ms": round(raw_p50, 3),
+        "raw_socket_p99_ms": round(raw_p99, 3),
+        "compiled_kernel_validated": kernel["validated"],
+        "compiled_kernel_detail": kernel["detail"],
+    }
+
 
 def main() -> int:
     from tpumon.backends.fake import FakeTpuBackend
     from tpumon.config import Config
     from tpumon.exporter.server import build_exporter
+
+    # The kernel probe first: it shares nothing with the exporter bench,
+    # and running it before the latency loops keeps its subprocess from
+    # competing with the timed scrapes for CPU.
+    kernel = probe_compiled_kernel()
 
     # Mirror the daemon entrypoint's scrape-tail tuning (exporter/main.py);
     # the bench embeds the exporter instead of spawning the CLI.
@@ -42,43 +228,14 @@ def main() -> int:
     cfg = Config(port=0, addr="127.0.0.1", interval=1.0)
     exporter = build_exporter(cfg, backend)
     exporter.start()
-
-    conn = http.client.HTTPConnection(
-        "127.0.0.1", exporter.server.port, timeout=10
-    )
-
-    def scrape() -> bytes:
-        conn.request("GET", "/metrics")
-        resp = conn.getresponse()
-        return resp.read()
-
     try:
-        # Warm the connection path and confirm the page is fully populated.
-        body = scrape()
-        assert b"accelerator_duty_cycle_percent" in body, "families missing"
-
-        samples_ms = []
-        for _ in range(SCRAPES):
-            t0 = time.perf_counter()
-            scrape()
-            samples_ms.append((time.perf_counter() - t0) * 1e3)
-
-        samples_ms.sort()
-        p99 = samples_ms[int(len(samples_ms) * 0.99) - 1]
-        print(
-            json.dumps(
-                {
-                    "metric": "exporter_p99_scrape_latency",
-                    "value": round(p99, 3),
-                    "unit": "ms",
-                    "vs_baseline": round(GENRE_P99_BUDGET_MS / p99, 2),
-                }
-            )
-        )
-        return 0
+        http_p50, http_p99 = measure_http_client(exporter.server.port)
+        raw_p50, raw_p99 = measure_raw_socket(exporter.server.port)
     finally:
-        conn.close()
         exporter.close()
+
+    print(json.dumps(build_record(http_p50, http_p99, raw_p50, raw_p99, kernel)))
+    return 0
 
 
 if __name__ == "__main__":

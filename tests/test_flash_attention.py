@@ -45,6 +45,30 @@ def test_forward_matches_reference(causal, B, S, H, KV, D, bq, bk):
     assert jnp.allclose(out, ref, atol=1e-5, rtol=1e-5)
 
 
+def test_default_blocks_chooser():
+    """The tuned tile table (BASELINE.md tiling sweep): long sequences get
+    the measured-fastest 256×512 tiles; short ones keep the conservative
+    128×128. Pins the lookup so a table edit that silently reverts the
+    2.5× win fails here."""
+    from tpumon.workload.ops.flash_attention import default_blocks
+
+    assert default_blocks(4096, 4096) == (256, 512)
+    assert default_blocks(8192, 8192) == (256, 512)
+    assert default_blocks(4096, 1024) == (256, 512)  # keyed on seq_k
+    assert default_blocks(512, 512) == (128, 128)
+    assert default_blocks(64, 64) == (128, 128)
+
+
+def test_tuned_defaults_still_match_reference():
+    """block_q/block_k=None routes through the tuned chooser and clamps
+    to legal divisors — numerics unchanged at any size."""
+    q, k, v = _qkv(jax.random.PRNGKey(3), 1, 128, 4, 2, 16)
+    out = flash_attention(q, k, v)  # tuned defaults
+    kr, vr = _expand(k, v, 4)
+    ref = reference_attention(q, kr, vr, causal=True)
+    assert jnp.allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
 def test_bfloat16_forward():
     q, k, v = _qkv(jax.random.PRNGKey(1), 2, 64, 4, 2, 32, jnp.bfloat16)
     out = flash_attention(q, k, v, block_q=32, block_k=32)

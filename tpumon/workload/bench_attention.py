@@ -211,13 +211,17 @@ def bench(
     seqs: tuple[int, ...] = (512, 1024, 2048),
     iters: int = 10,
     inner: int | None = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
     out=sys.stdout,
 ) -> list[dict]:
     import jax
 
-    from tpumon.workload.ops.flash_attention import make_flash_attn
+    from tpumon.workload.ops.flash_attention import (
+        _pick_block,
+        default_blocks,
+        make_flash_attn,
+    )
 
     flash = make_flash_attn(block_q=block_q, block_k=block_k)
     results = []
@@ -225,6 +229,13 @@ def bench(
         platform, kind, seq_inner, q, k, v, attn_flops = _bench_setup(
             batch, heads, kv_heads, head_dim, seq, inner
         )
+        # Rows record the blocks actually used: explicit overrides, else
+        # the tuned per-seq table — in either case clamped exactly as the
+        # kernel clamps them (_pick_block), so a seq the block doesn't
+        # divide is never attributed to tiles that didn't run.
+        tuned = default_blocks(seq, seq)
+        row_bq = _pick_block(seq, block_q if block_q is not None else tuned[0])
+        row_bk = _pick_block(seq, block_k if block_k is not None else tuned[1])
         impls = {
             "xla": jax.jit(xla_attention),
             "flash": jax.jit(lambda q, k, v: flash(q, k, v)),
@@ -242,7 +253,7 @@ def bench(
                 "inner": seq_inner,
             }
             if name == "flash":
-                base["block_q"], base["block_k"] = block_q, block_k
+                base["block_q"], base["block_k"] = row_bq, row_bk
             _timed_row(
                 base, fwd, _train_of(fwd), q, k, v, iters=iters,
                 inner=seq_inner, attn_flops=attn_flops, results=results,
@@ -329,13 +340,14 @@ def main(argv=None) -> int:
         "on TPU to amortize dispatch latency, 1 elsewhere)",
     )
     parser.add_argument(
-        "--block-q", type=int, default=128,
-        help="flash kernel q-block rows (tiling experiments; rows record "
-        "the values used)",
+        "--block-q", type=int, default=None,
+        help="flash kernel q-block rows (default: the measured tuned "
+        "table, ops.flash_attention.default_blocks; rows record the "
+        "values used)",
     )
     parser.add_argument(
-        "--block-k", type=int, default=128,
-        help="flash kernel k-block rows",
+        "--block-k", type=int, default=None,
+        help="flash kernel k-block rows (default: tuned table)",
     )
     parser.add_argument(
         "--sweep-blocks", action="store_true",

@@ -69,6 +69,34 @@ def _pick_block(size: int, requested: int) -> int:
     return size
 
 
+#: Tuned tile table from the measured TPU v5 lite sweep (BASELINE.md
+#: "Flash kernel tiling sweep"): larger k-blocks dominate — fewer grid
+#: iterations and larger MXU tiles per dot (256×512 ran the seq-4096
+#: forward 2.5× faster than 128×128). Rows are (min seq_k, (block_q,
+#: block_k)), first match wins; sizes the table doesn't cover keep the
+#: conservative 128×128 (always VMEM-safe).
+#: One row today (the r4 sweep measured seq 4096 forward only); per-seq
+#: rows get added as the fwd+bwd sweep across 1k–8k lands on hardware.
+_TUNED_BLOCKS: tuple[tuple[int, tuple[int, int]], ...] = (
+    (1024, (256, 512)),
+)
+
+
+def default_blocks(seq_q: int, seq_k: int) -> tuple[int, int]:
+    """Tuned (block_q, block_k) for this problem size.
+
+    Looked up from :data:`_TUNED_BLOCKS` by the key-side length (the
+    k-block loop is where the sweep showed the win); callers passing
+    explicit blocks bypass this entirely. ``_pick_block`` still clamps
+    the choice to divisors of the actual lengths, so small or ragged
+    shapes (ring stripes, rectangular composition) stay legal.
+    """
+    for min_k, blocks in _TUNED_BLOCKS:
+        if seq_k >= min_k:
+            return blocks
+    return (128, 128)
+
+
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
@@ -386,8 +414,8 @@ def flash_attention(
     v: jnp.ndarray,
     *,
     causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
     """Flash attention over [B, S, H, D] tensors (model layout).
@@ -396,6 +424,9 @@ def flash_attention(
     in the kernel's index maps, never materialized. Differentiable (custom
     VJP, flash-style recompute backward). ``interpret=None`` auto-selects
     interpreter mode off-TPU so the CPU test mesh runs the same code.
+    ``block_q``/``block_k`` default to the measured tuned tiles for the
+    problem size (:func:`default_blocks`); pass explicit values to
+    override (tiling experiments, VMEM-constrained compositions).
     """
     # One custom-vjp path serves both public entry points: with lse
     # unused its cotangent is zero and the backward's Δ fold is a no-op.
@@ -412,8 +443,8 @@ def flash_attention_with_lse(
     v: jnp.ndarray,
     *,
     causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Flash attention returning ``(out [B,S,H,D], lse [B,H,S] f32)``.
@@ -436,6 +467,10 @@ def flash_attention_with_lse(
     KV = k.shape[2]
     if H % KV:
         raise ValueError(f"q heads ({H}) must be a multiple of kv heads ({KV})")
+    if block_q is None or block_k is None:
+        tuned_q, tuned_k = default_blocks(S, k.shape[1])
+        block_q = tuned_q if block_q is None else block_q
+        block_k = tuned_k if block_k is None else block_k
     out, lse = _flash_lse(
         q.transpose(0, 2, 1, 3),
         k.transpose(0, 2, 1, 3),
@@ -445,9 +480,11 @@ def flash_attention_with_lse(
     return out.transpose(0, 2, 1, 3), lse
 
 
-def make_flash_attn(*, causal: bool = True, block_q: int = 128,
-                    block_k: int = 128, interpret: bool | None = None):
-    """``attn_impl`` factory for models.llama.forward / models.moe.forward."""
+def make_flash_attn(*, causal: bool = True, block_q: int | None = None,
+                    block_k: int | None = None, interpret: bool | None = None):
+    """``attn_impl`` factory for models.llama.forward / models.moe.forward.
+
+    Blocks default to the measured tuned tiles (:func:`default_blocks`)."""
 
     def attn(q, k, v):
         return flash_attention(

@@ -289,8 +289,8 @@ def zigzag_ring_flash_local(
     v: jnp.ndarray,
     axis_name: str,
     *,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
     """Causal zigzag ring attention with the pallas flash kernel inside.
@@ -379,7 +379,7 @@ def zigzag_ring_flash_local(
 
 def make_ring_attn(
     mesh: Mesh, *, data_axis="data", seq_axis="seq", head_axis=None, causal=True,
-    zigzag=False, flash=False, block_q=128, block_k=128, interpret=None,
+    zigzag=False, flash=False, block_q=None, block_k=None, interpret=None,
 ):
     """An attention callable q,k,v → out with the sequence axis ring-sharded.
 

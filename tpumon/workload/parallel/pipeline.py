@@ -62,7 +62,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from tpumon.workload.models import llama as _llama
 from tpumon.workload.ops.core import rms_norm, rope_freqs
-from tpumon.workload.parallel.ring import ring_attention_local
+from tpumon.workload.parallel.ring import (
+    _from_zigzag,
+    _to_zigzag,
+    ring_attention_local,
+    zigzag_ring_attention_local,
+)
 
 
 def _stage_layer_specs() -> dict:
@@ -143,6 +148,7 @@ def make_pipelined_forward(
     microbatches: int = 2,
     interleave: int = 1,
     remat: bool = False,
+    sp_layout: str = "contiguous",
 ):
     """logits = f(params, tokens): pipeline over the mesh's ``stage`` axis.
 
@@ -153,7 +159,13 @@ def make_pipelined_forward(
     backward pass, bounding stashed activations (the memory half of the
     1F1B story). When the mesh's ``seq`` axis is >1, activations are
     sequence-sharded and attention runs as a K/V ring inside the stage
-    body (SP×PP composition).
+    body (SP×PP composition); ``sp_layout="zigzag"`` runs that ring over
+    the balanced zigzag stripe layout instead (half the attention FLOPs —
+    parallel.ring.zigzag_ring_attention_local). The redistribution is
+    attention-internal (zigzag in, ring, contiguous out), so the stage
+    schedule, RoPE offsets, and residual stream are untouched — the same
+    transparency that lets zigzag compose with dp/tp/ep on the
+    non-pipelined path.
     """
     pp = mesh.shape["stage"]
     tp = mesh.shape["model"]
@@ -161,6 +173,8 @@ def make_pipelined_forward(
     v = interleave
     if v < 1:
         raise ValueError(f"interleave must be >= 1, got {v}")
+    if sp_layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown sp_layout: {sp_layout!r}")
     if cfg.n_layers % (pp * v):
         raise ValueError(
             f"n_layers ({cfg.n_layers}) must divide by pp*interleave "
@@ -208,12 +222,22 @@ def make_pipelined_forward(
         freqs_full = rope_freqs(cfg.head_dim, cfg.max_seq)
         if sp:
             # RoPE positions are global: offset this shard's block.
+            # (Zigzag redistribution happens inside the attention call,
+            # after RoPE — activations stay contiguous at stage level.)
             six = jax.lax.axis_index("seq")
             freqs = jax.lax.dynamic_slice_in_dim(freqs_full, six * S, S)
             mask = None  # ring attention masks by global position itself
-            attn_impl = lambda q, k, v_: ring_attention_local(  # noqa: E731
-                q, k, v_, "seq"
-            )
+            if sp_layout == "zigzag":
+                def attn_impl(q, k, v_):
+                    q = _to_zigzag(q, "seq")
+                    k = _to_zigzag(k, "seq")
+                    v_ = _to_zigzag(v_, "seq")
+                    out = zigzag_ring_attention_local(q, k, v_, "seq")
+                    return _from_zigzag(out, "seq")
+            else:
+                attn_impl = lambda q, k, v_: ring_attention_local(  # noqa: E731
+                    q, k, v_, "seq"
+                )
         else:
             freqs = freqs_full
             mask = jnp.triu(
@@ -310,6 +334,11 @@ def make_pipelined_forward(
             raise ValueError(
                 f"seq ({tokens.shape[1]}) must divide by the mesh seq "
                 f"axis ({spn})"
+            )
+        if sp and sp_layout == "zigzag" and tokens.shape[1] % (2 * spn):
+            raise ValueError(
+                f"zigzag needs an even local shard: seq "
+                f"({tokens.shape[1]}) must divide by 2*sp ({2 * spn})"
             )
         layers = params["layers"]
         if order is not None:

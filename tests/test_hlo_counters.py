@@ -1,4 +1,26 @@
+import json
+
 from tpumon.workload.hlo_counters import CountersCollector, HloOpCounters
+
+
+def test_raw_dump_captures_callback_text(tmp_path):
+    """The fixture-harvest mode: every callback event's stringified text
+    (exactly what observe() parses) lands as one JSON line, capped at
+    raw_limit, and counting is unaffected."""
+    path = tmp_path / "events.jsonl"
+    c = HloOpCounters(raw_path=str(path), raw_limit=2)
+    c._callback("all-reduce", duration_us=3)
+    c._callback("all-gather on ici")
+    c._callback("beyond the cap")
+    c.stop()
+
+    lines = path.read_text().strip().split("\n")
+    assert len(lines) == 2  # capped
+    assert json.loads(lines[0]) == "all-reduce duration_us=3"
+    assert json.loads(lines[1]) == "all-gather on ici"
+    counts, events = c.snapshot()
+    assert events == 3  # the cap limits the dump, not the counters
+    assert counts["all-reduce"] == 1
 
 
 def test_observe_counts_collectives():

@@ -49,6 +49,79 @@ def test_sharded_matches_single_device():
     assert sharded.losses[-1] == pytest.approx(single.losses[-1], rel=2e-3)
 
 
+def test_remat_matches_no_remat():
+    """jax.checkpoint around the layer body is a pure memory/FLOPs trade:
+    losses must be identical to the unrematerialized run."""
+    plain = run(CFG, steps=2, batch=4, seq=32)
+    remat = run(CFG, steps=2, batch=4, seq=32, remat=True)
+    assert remat.losses[-1] == pytest.approx(plain.losses[-1], rel=1e-5)
+
+
+def test_remat_composes_with_mesh():
+    r = run(CFG, steps=1, batch=4, seq=32, dp=2, tp=2, remat=True)
+    assert r.losses[-1] < r.losses[0]
+
+
+def test_remat_rejects_moe():
+    from tpumon.workload.models.moe import MoeConfig
+
+    with pytest.raises(ValueError, match="dense"):
+        run(MoeConfig.tiny(), steps=1, batch=2, seq=32, remat=True)
+
+
+def test_seq_beyond_max_seq_extends_rope():
+    """Long-context runs past the preset's nominal window: the RoPE table
+    extends to the requested length (exact, not extrapolated) and the
+    causality property holds at the extended positions."""
+    import dataclasses
+
+    S = 2 * CFG.max_seq
+    long_cfg = dataclasses.replace(CFG, max_seq=S)
+
+    # Causality beyond the original window: flipping a token after the
+    # old max_seq boundary must not change logits before it (a wrong
+    # extension — e.g. positions reused modulo max_seq, or a mask sized
+    # to the old window — breaks exactly here).
+    params = init_params(long_cfg, jax.random.PRNGKey(0))
+    flip = CFG.max_seq + 10
+    t1 = jnp.zeros((1, S), jnp.int32)
+    t2 = t1.at[0, flip].set(5)
+    l1 = forward(params, t1, long_cfg)
+    l2 = forward(params, t2, long_cfg)
+    assert jnp.allclose(l1[0, :flip], l2[0, :flip], atol=1e-3)
+    assert not jnp.allclose(l1[0, flip:], l2[0, flip:], atol=1e-3)
+
+    # The harness's auto-extension must equal a natively-long config.
+    r = run(CFG, steps=1, batch=2, seq=S)
+    native = run(long_cfg, steps=1, batch=2, seq=S)
+    assert r.losses == native.losses
+
+
+def test_medium_preset_is_chip_sized():
+    """The medium preset targets a single 16 GB chip at seq 4096: ~0.67 B
+    params (f32 + Adam moments ≈ 8 GB), every matmul MXU-sized."""
+    from tpumon.workload.flops import train_flops_per_step
+
+    cfg = LlamaConfig.medium()
+    n_params = (
+        2 * cfg.vocab * cfg.dim  # embed + unembed
+        + cfg.n_layers
+        * (
+            cfg.dim * cfg.n_heads * cfg.head_dim * 2  # wq, wo
+            + cfg.dim * cfg.n_kv_heads * cfg.head_dim * 2  # wk, wv
+            + 3 * cfg.dim * cfg.ffn_dim  # gate, up, down
+            + 2 * cfg.dim  # norms
+        )
+        + cfg.dim
+    )
+    assert 0.5e9 < n_params < 1.0e9
+    assert n_params * 12 < 10e9  # f32 params + 2 Adam moments fit HBM
+    assert cfg.max_seq == 4096
+    assert cfg.dim >= 2048
+    assert cfg.n_heads % cfg.n_kv_heads == 0  # GQA
+    assert train_flops_per_step(cfg, 1, 4096) > 1e13  # MXU-filling steps
+
+
 def test_param_specs_cover_tree():
     params = init_params(CFG, jax.random.PRNGKey(0))
     specs = param_specs()

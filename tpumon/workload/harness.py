@@ -14,6 +14,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import logging
+import os
 import sys
 import time
 
@@ -55,12 +56,14 @@ def loss_fn(
     shard_acts=None,
     shard_experts=None,
     forward_fn=None,
+    remat=False,
 ):
     """Next-token cross-entropy; inputs [B, S], targets are the shift-by-1.
 
     Accepts LlamaConfig or MoeConfig; the MoE path adds the weighted
     load-balancing auxiliary loss. ``forward_fn`` overrides the model
     forward entirely (the pipelined-forward path, parallel.pipeline).
+    ``remat`` recomputes dense-model layer activations in the backward.
     """
     if forward_fn is not None:
         logits = forward_fn(params, tokens[:, :-1])
@@ -70,7 +73,9 @@ def loss_fn(
             params, tokens[:, :-1], cfg, attn_impl, shard_acts, shard_experts
         )
     else:
-        logits = forward(params, tokens[:, :-1], cfg, attn_impl, shard_acts)
+        logits = forward(
+            params, tokens[:, :-1], cfg, attn_impl, shard_acts, remat
+        )
         aux = 0.0
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
@@ -86,6 +91,8 @@ def make_train_step(
     shard_experts=None,
     forward_fn=None,
     grad_accum: int = 1,
+    remat: bool = False,
+    with_grad_norm: bool = False,
 ):
     """One jitted optimizer step; ``grad_accum > 1`` splits the batch
     into that many chunks and accumulates gradients over a ``lax.scan``
@@ -99,7 +106,7 @@ def make_train_step(
     def grad_of(params, tokens):
         return jax.value_and_grad(loss_fn)(
             params, tokens, cfg, attn_impl, shard_acts, shard_experts,
-            forward_fn,
+            forward_fn, remat,
         )
 
     def train_step(params, opt_state, tokens):
@@ -128,9 +135,19 @@ def make_train_step(
             (gsum, lsum), _ = jax.lax.scan(acc, (zero, 0.0), chunks)
             grads = jax.tree.map(lambda g: g / grad_accum, gsum)
             loss = lsum / grad_accum
+        # Global gradient L2 norm, returned alongside the loss: a second,
+        # independent parity signal for the multi-chip dryrun (a sharding
+        # bug that barely moves the loss — e.g. one mis-scaled psum —
+        # shows up at full strength in the gradients). Opt-in: the
+        # whole-tree reduction would tax every benchmark step's HBM
+        # bandwidth, so throughput runs return NaN instead.
+        gnorm = (
+            optax.global_norm(grads) if with_grad_norm
+            else jnp.float32(float("nan"))
+        )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        return params, opt_state, loss, gnorm
 
     return train_step
 
@@ -151,6 +168,9 @@ class RunResult:
     #: Model FLOPs utilization vs the devices' peak bf16 (SURVEY §6);
     #: None when the device peak is unknown (CPU) or throughput absent.
     mfu: float | None = None
+    #: Global gradient L2 norm at the final step (the dryrun's second
+    #: dense-parity signal alongside the loss).
+    grad_norm: float | None = None
 
 
 def run(
@@ -168,6 +188,8 @@ def run(
     interleave: int = 1,
     sp_layout: str = "contiguous",
     grad_accum: int = 1,
+    remat: bool = False,
+    with_grad_norm: bool = False,
     seed: int = 0,
     mesh=None,
     attn: str = "xla",
@@ -188,9 +210,13 @@ def run(
     under ``sp_layout="zigzag"`` (the ring runs the kernel per stripe
     pair — parallel.ring.zigzag_ring_flash_local), but not with
     contiguous sp (device-dependent hop masks) or pp > 1 (the pipelined
-    forward owns the model body). ``pp > 1`` composes with dp/tp/sp;
+    forward owns the model body). ``pp > 1`` composes with dp/tp/sp —
+    under either sp layout: ``sp_layout="zigzag"`` runs the balanced
+    zigzag ring inside the pipeline stage bodies too.
     ``interleave > 1`` selects the circular (interleaved) pipeline
-    schedule — bubble ÷ interleave (parallel.pipeline).
+    schedule — bubble ÷ interleave (parallel.pipeline). ``remat=True``
+    recomputes layer activations in the backward (dense and pipelined
+    paths) — O(1)-layers activation memory for ~⅓ extra forward FLOPs.
 
     ``checkpoint_dir`` turns on orbax checkpoint/resume (SURVEY.md §5.4 —
     the monitor itself is stateless; the *workload* checkpoints so long
@@ -217,6 +243,11 @@ def run(
         # inside the stage shard_map.
         raise ValueError("pp composes with dp/tp/sp only (dense model)")
     seq = seq or cfg.max_seq
+    if seq > cfg.max_seq:
+        # Long-context runs beyond the preset's nominal window: extend the
+        # RoPE table to the requested length (positions are computed from
+        # max_seq at trace time, so this is exact, not extrapolation).
+        cfg = dataclasses.replace(cfg, max_seq=seq)
     key = jax.random.PRNGKey(seed)
     k_params, k_data = jax.random.split(key)
 
@@ -253,17 +284,11 @@ def run(
             raise ValueError(f"seq ({seq}) must divide by sp ({sp})")
         if sp_layout not in ("contiguous", "zigzag"):
             raise ValueError(f"unknown sp_layout: {sp_layout!r}")
-        if sp_layout == "zigzag":
-            if pp > 1:
-                raise ValueError(
-                    "sp_layout='zigzag' does not compose with pp > 1 "
-                    "(the pipelined forward's internal ring is contiguous)"
-                )
-            if seq % (2 * sp):
-                raise ValueError(
-                    f"zigzag needs an even local shard: seq ({seq}) must "
-                    f"divide by 2*sp ({2 * sp})"
-                )
+        if sp_layout == "zigzag" and seq % (2 * sp):
+            raise ValueError(
+                f"zigzag needs an even local shard: seq ({seq}) must "
+                f"divide by 2*sp ({2 * sp})"
+            )
         if pp == 1:
             # Under pp the pipelined forward owns the attention impl AND
             # the activation layout (its shard_map specs), so both stay
@@ -293,13 +318,20 @@ def run(
                 f"per-data-shard batch ({per_shard}) must divide by "
                 f"grad_accum ({grad_accum})"
             )
+    if remat and is_moe:
+        raise ValueError(
+            "remat supports the dense model (and the pipelined forward's "
+            "own remat flag); the MoE forward does not take it"
+        )
     if pp > 1:
         forward_fn = make_pipelined_forward(
-            mesh, cfg, microbatches=microbatches, interleave=interleave
+            mesh, cfg, microbatches=microbatches, interleave=interleave,
+            sp_layout=sp_layout, remat=remat,
         )
     train_step = make_train_step(
         cfg, optimizer, attn_impl, shard_acts, shard_experts, forward_fn,
-        grad_accum=grad_accum,
+        grad_accum=grad_accum, remat=remat and pp == 1,
+        with_grad_norm=with_grad_norm,
     )
 
     if mesh is not None:
@@ -341,18 +373,18 @@ def run(
         )
 
     # Warmup/compile outside the timed window.
-    params, opt_state, loss = step(params, opt_state, tokens)
+    params, opt_state, loss, gnorm = step(params, opt_state, tokens)
     loss.block_until_ready()
     losses = [float(loss)]
 
     t0 = time.perf_counter()
     if stats is None:
         for _ in range(steps):
-            params, opt_state, loss = step(params, opt_state, tokens)
+            params, opt_state, loss, gnorm = step(params, opt_state, tokens)
     else:
         window_t0, done = t0, 0
         for i in range(1, steps + 1):
-            params, opt_state, loss = step(params, opt_state, tokens)
+            params, opt_state, loss, gnorm = step(params, opt_state, tokens)
             if i % max(stats_every, 1) == 0 or i == steps:
                 lv = float(loss)  # one host-read sync per window
                 now = time.perf_counter()
@@ -375,6 +407,9 @@ def run(
         ep=ep,
         model_flops_per_step=flops_mod.train_flops_per_step(cfg, batch, seq),
         mfu=flops_mod.mfu(cfg, batch, seq, steps_per_sec, run_devices),
+        # After the loss sync — no extra stall; NaN (norm not requested)
+        # maps to None.
+        grad_norm=(float(gnorm) if with_grad_norm else None),
     )
 
 
@@ -438,9 +473,10 @@ def _run_checkpointed(
         timed = 0.0
         timed_steps = 0
         saved_at = start_step if latest is not None else -1
+        gnorm = None
         for i in range(start_step, steps):
             t0 = time.perf_counter()
-            params, opt_state, loss = step(params, opt_state, tokens)
+            params, opt_state, loss, gnorm = step(params, opt_state, tokens)
             losses.append(float(loss))  # blocks; keeps loss-per-step record
             dt = time.perf_counter() - t0
             if i > start_step:  # first iteration pays compile
@@ -489,6 +525,12 @@ def _run_checkpointed(
                 if cfg
                 else None
             ),
+            # NaN = norm not requested (make_train_step's opt-in).
+            grad_norm=(
+                float(gnorm)
+                if gnorm is not None and float(gnorm) == float(gnorm)
+                else None
+            ),
             **axes,
         )
     finally:
@@ -502,11 +544,13 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--seq", type=int, default=None)
     parser.add_argument(
         "--preset",
-        choices=("tiny", "small", "llama3-8b"),
+        choices=("tiny", "small", "medium", "llama3-8b"),
         default="tiny",
-        help="model size: tiny/small for dev hosts; llama3-8b is the "
-        "BASELINE config-4 pretrain shape (needs a real pod + a mesh, "
-        "e.g. --dp 4 --tp 8 --sp 2 on v5p-64)",
+        help="model size: tiny/small for dev hosts; medium (~0.67B) fills "
+        "a single 16 GB chip at seq 4096 (pair with --attn flash and "
+        "--grad-accum); llama3-8b is the BASELINE config-4 pretrain "
+        "shape (needs a real pod + a mesh, e.g. --dp 4 --tp 8 --sp 2 "
+        "on v5p-64)",
     )
     parser.add_argument(
         "--model",
@@ -551,6 +595,13 @@ def main(argv: list[str] | None = None) -> int:
         "with dp/tp/sp/ep; pp has its own microbatching)",
     )
     parser.add_argument(
+        "--remat",
+        action="store_true",
+        help="recompute layer activations in the backward pass "
+        "(jax.checkpoint): activation memory O(1) layers for ~1/3 extra "
+        "forward FLOPs — lets chip-sized presets train at long seq",
+    )
+    parser.add_argument(
         "--interleave",
         type=int,
         default=1,
@@ -583,6 +634,14 @@ def main(argv: list[str] | None = None) -> int:
         type=int,
         default=0,
         help="save every N steps (0 = only at the end of the run)",
+    )
+    parser.add_argument(
+        "--hlo-raw-dump",
+        default=None,
+        help="capture raw HLO-logger event strings (one JSON line each) "
+        "to this file — the fixture-harvest mode for pinning "
+        "hlo_counters' regexes against real runtime payloads "
+        "(env: TPUMON_HLO_RAW_DUMP)",
     )
     parser.add_argument(
         "--metrics-port",
@@ -641,8 +700,6 @@ def main(argv: list[str] | None = None) -> int:
         force_cpu_devices(total // max(num_processes, 1))
 
     if args.coordinator:
-        import os
-
         process_id = args.process_id
         if process_id is None:
             process_id = int(os.environ.get("TPU_WORKER_ID", "0") or 0)
@@ -668,6 +725,7 @@ def main(argv: list[str] | None = None) -> int:
         cfg = {
             "tiny": LlamaConfig.tiny,
             "small": LlamaConfig.small,
+            "medium": LlamaConfig.medium,
             "llama3-8b": LlamaConfig.llama3_8b,
         }[args.preset]()
     groups = args.pp * args.interleave
@@ -684,7 +742,8 @@ def main(argv: list[str] | None = None) -> int:
 
     from tpumon.workload.hlo_counters import CountersCollector, HloOpCounters
 
-    counters = HloOpCounters()
+    raw_dump = args.hlo_raw_dump or os.environ.get("TPUMON_HLO_RAW_DUMP")
+    counters = HloOpCounters(raw_path=raw_dump or None)
     hooked = counters.start()
     server = None
     stats = None
@@ -729,6 +788,7 @@ def main(argv: list[str] | None = None) -> int:
             interleave=args.interleave,
             sp_layout=args.sp_layout,
             grad_accum=args.grad_accum,
+            remat=args.remat,
             attn=args.attn,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,

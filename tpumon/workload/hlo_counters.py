@@ -21,6 +21,7 @@ fabric traffic, one from inside the process, one from the node.
 
 from __future__ import annotations
 
+import json
 import logging
 import re
 import threading
@@ -78,9 +79,16 @@ _BYTES_MULT = {
 
 
 class HloOpCounters:
-    """Counts collective-op mentions in HLO logger events. Thread-safe."""
+    """Counts collective-op mentions in HLO logger events. Thread-safe.
 
-    def __init__(self) -> None:
+    ``raw_path`` dumps each event's stringified text (exactly what
+    :meth:`observe` parses) as one JSON string per line, capped at
+    ``raw_limit`` events — the capture mode that turns a real runtime's
+    undocumented payloads into a pinned test fixture
+    (tests/fixtures/hlo_logger_*.jsonl).
+    """
+
+    def __init__(self, raw_path: str | None = None, raw_limit: int = 4096) -> None:
         self._lock = threading.Lock()
         self._counts: Counter[str] = Counter()
         # Per-op extracted figures (absent until an event carries one):
@@ -93,6 +101,10 @@ class HloOpCounters:
         self._bytes_samples: Counter[str] = Counter()
         self._events = 0
         self._ids = None
+        self._raw_path = raw_path
+        self._raw_limit = raw_limit
+        self._raw_file = None
+        self._raw_count = 0
 
     # -- registration ------------------------------------------------------
 
@@ -108,6 +120,16 @@ class HloOpCounters:
             return False
 
     def stop(self) -> None:
+        # Disable capture BEFORE closing: a late in-flight callback must
+        # not reopen the file through _dump_raw after we close it.
+        with self._lock:
+            self._raw_path = None
+            if self._raw_file is not None:
+                try:
+                    self._raw_file.close()
+                except OSError:
+                    pass
+                self._raw_file = None
         if self._ids is None:
             return
         try:
@@ -133,9 +155,32 @@ class HloOpCounters:
             text = " ".join(str(a) for a in args)
             if kwargs:
                 text += " " + " ".join(f"{k}={v}" for k, v in kwargs.items())
+            if self._raw_path is not None:
+                # Guarded separately: a broken capture path (unwritable
+                # file) must not silently disable the counting below.
+                try:
+                    self._dump_raw(text)
+                except OSError as exc:
+                    log.warning("HLO raw capture disabled: %s", exc)
+                    self._raw_path = None
             self.observe(text)
         except Exception:
             pass
+
+    def _dump_raw(self, text: str) -> None:
+        """Write one JSON-encoded event line to the capture file
+        (truncated on this instance's first write — a fixture must not
+        mix events from different runs)."""
+        with self._lock:
+            # Recheck under the lock: stop() may have disabled capture
+            # between the callback's unlocked check and here.
+            if self._raw_path is None or self._raw_count >= self._raw_limit:
+                return
+            if self._raw_file is None:
+                self._raw_file = open(self._raw_path, "w")
+            self._raw_file.write(json.dumps(text) + "\n")
+            self._raw_file.flush()
+            self._raw_count += 1
 
     def observe(self, text: str) -> None:
         """Count collective mentions in one event (public for tests);

@@ -54,6 +54,22 @@ class LlamaConfig:
         )
 
     @classmethod
+    def medium(cls) -> "LlamaConfig":
+        """Chip-sized preset: ~0.67 B params (embed 134 M + 12 layers ×
+        45 M), sized so f32 params + Adam moments (~8 GB) plus bf16
+        activations fill most of a single 16 GB-HBM chip at seq 4096 —
+        the shape for a *sweet-spot* single-chip MFU measurement, where
+        every layer matmul is MXU-sized (2048×2048 and larger), unlike
+        ``small`` whose dim-512 matmuls underfill the systolic array.
+        Pair with ``--attn flash`` (the XLA path's [B,H,S,S] scores add
+        ~2 GB per batch row at seq 4096) and ``--grad-accum`` to fit
+        batch sizes beyond activation memory."""
+        return cls(
+            vocab=32768, dim=2048, n_layers=12, n_heads=16,
+            n_kv_heads=4, ffn_dim=5632, max_seq=4096,
+        )
+
+    @classmethod
     def llama3_8b(cls) -> "LlamaConfig":
         """The BASELINE config-4 workload shape ("JAX Llama-3-8B
         pretrain"): Llama-3-8B's published architecture — 32 layers,
@@ -135,13 +151,14 @@ def _mlp(x, layer, cfg: LlamaConfig):
     return (jax.nn.silu(gate) * up) @ layer["w_down"].astype(cfg.dtype)
 
 
-@partial(jax.jit, static_argnames=("cfg", "attn_impl", "shard_acts"))
+@partial(jax.jit, static_argnames=("cfg", "attn_impl", "shard_acts", "remat"))
 def forward(
     params: dict,
     tokens: jnp.ndarray,
     cfg: LlamaConfig,
     attn_impl=None,
     shard_acts=None,
+    remat: bool = False,
 ) -> jnp.ndarray:
     """tokens [B, S] int32 → logits [B, S, vocab] float32.
 
@@ -149,7 +166,12 @@ def forward(
     parallelism, pallas flash attention); ``shard_acts`` is an optional
     x→x sharding constraint applied to the residual stream so sequence-
     parallel layouts persist between layers instead of round-tripping
-    through a replicated view.
+    through a replicated view. ``remat=True`` wraps the layer body in
+    ``jax.checkpoint`` so the backward pass recomputes each layer's
+    activations instead of stashing them — activation memory drops from
+    O(n_layers) to O(1) layers for ~⅓ extra forward FLOPs, the standard
+    HBM-for-FLOPs trade that lets chip-sized models train at long
+    sequence lengths on one chip.
     """
     B, S = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
@@ -169,6 +191,8 @@ def forward(
         return h, None
 
     # One compiled layer body for any depth — lax.scan over stacked params.
-    x, _ = jax.lax.scan(block, x, params["layers"])
+    x, _ = jax.lax.scan(
+        jax.checkpoint(block) if remat else block, x, params["layers"]
+    )
     x = rms_norm(x, params["final_norm"])
     return (x @ params["unembed"].astype(cfg.dtype)).astype(jnp.float32)

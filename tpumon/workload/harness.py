@@ -38,6 +38,7 @@ from tpumon.workload.parallel.mesh import (
 )
 from tpumon.workload.parallel.pipeline import (
     make_pipelined_forward,
+    moe_pipeline_param_specs,
     pipeline_param_specs,
 )
 from tpumon.workload.parallel.ring import make_ring_attn
@@ -66,8 +67,10 @@ def loss_fn(
     ``remat`` recomputes dense-model layer activations in the backward.
     """
     if forward_fn is not None:
-        logits = forward_fn(params, tokens[:, :-1])
-        aux = 0.0
+        out = forward_fn(params, tokens[:, :-1])
+        # The pipelined MoE forward returns (logits, aux) like the
+        # unpipelined MoE model; the dense pipeline returns logits only.
+        logits, aux = out if isinstance(out, tuple) else (out, 0.0)
     elif isinstance(cfg, MoeConfig):
         logits, aux = moe_forward(
             params, tokens[:, :-1], cfg, attn_impl, shard_acts, shard_experts
@@ -212,7 +215,9 @@ def run(
     contiguous sp (device-dependent hop masks) or pp > 1 (the pipelined
     forward owns the model body). ``pp > 1`` composes with dp/tp/sp —
     under either sp layout: ``sp_layout="zigzag"`` runs the balanced
-    zigzag ring inside the pipeline stage bodies too.
+    zigzag ring inside the pipeline stage bodies too — and with MoE as
+    dp×pp×ep (expert banks sharded inside stage bodies; tp/sp stay 1
+    on that path).
     ``interleave > 1`` selects the circular (interleaved) pipeline
     schedule — bubble ÷ interleave (parallel.pipeline). ``remat=True``
     recomputes layer activations in the backward (dense and pipelined
@@ -235,13 +240,11 @@ def run(
     is_moe = isinstance(cfg, MoeConfig)
     if ep > 1 and not is_moe:
         raise ValueError("ep > 1 requires a MoeConfig")
-    if pp > 1 and is_moe:
-        # Design decision (tested in test_parallel.py): pp composes with
-        # dp, tp (Megatron shards inside stage bodies), and sp (the K/V
-        # ring runs inside the stage body) but not with MoE, whose
-        # all-to-all dispatch would need its own manual collectives
-        # inside the stage shard_map.
-        raise ValueError("pp composes with dp/tp/sp only (dense model)")
+    if pp > 1 and is_moe and (tp > 1 or sp > 1):
+        # pp×MoE runs dp×pp×ep (expert banks sharded inside stage
+        # bodies, psum-over-expert combine — parallel.pipeline); the
+        # manual stage collectives don't cover tp/sp with MoE.
+        raise ValueError("pp with MoE composes with dp/ep only (tp=1, sp=1)")
     seq = seq or cfg.max_seq
     if seq > cfg.max_seq:
         # Long-context runs beyond the preset's nominal window: extend the
@@ -300,7 +303,10 @@ def run(
                 flash=attn == "flash",
             )
             shard_acts = make_act_sharder(mesh, sp=True)
-    if is_moe and mesh is not None:
+    if is_moe and mesh is not None and pp == 1:
+        # Under pp the pipelined forward owns expert sharding (manual
+        # collectives in the stage bodies); these GSPMD constraints are
+        # for the unpipelined MoE path only.
         shard_experts = make_expert_sharder(mesh)
         if shard_acts is None:
             shard_acts = make_act_sharder(mesh)
@@ -318,10 +324,10 @@ def run(
                 f"per-data-shard batch ({per_shard}) must divide by "
                 f"grad_accum ({grad_accum})"
             )
-    if remat and is_moe:
+    if remat and is_moe and pp == 1:
         raise ValueError(
-            "remat supports the dense model (and the pipelined forward's "
-            "own remat flag); the MoE forward does not take it"
+            "remat supports the dense model and the pipelined forward "
+            "(either model); the unpipelined MoE forward does not take it"
         )
     if pp > 1:
         forward_fn = make_pipelined_forward(
@@ -338,7 +344,10 @@ def run(
         # Shard params FIRST; optimizer.init on sharded params then makes the
         # Adam moments inherit the same layout (no replicated moment memory).
         if pp > 1:
-            specs = pipeline_param_specs()
+            specs = (
+                moe_pipeline_param_specs() if is_moe
+                else pipeline_param_specs()
+            )
         elif is_moe:
             specs = moe_param_specs()
         else:

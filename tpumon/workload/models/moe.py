@@ -126,24 +126,33 @@ def _route(probs: jnp.ndarray, top_k: int, capacity: int):
     return dispatch, combine
 
 
-def _moe_mlp(x, layer, cfg: MoeConfig, shard_experts=None):
-    """x [B,S,D] → (out [B,S,D], aux load-balancing loss scalar)."""
-    B, S, D = x.shape
-    E, C = cfg.n_experts, cfg.capacity(S)
+def route_tokens(x, layer, cfg: MoeConfig):
+    """Router + top-k routing for one layer: x [B,S,D] →
+    (dispatch [B,S,E,C], combine [B,S,E,C], probs [B,S,E] f32).
 
+    Shared by the GSPMD MoE forward below and the pipelined stage body
+    (parallel.pipeline._moe_mlp_local), so the routing math cannot drift
+    between the two paths the dense-parity checks compare.
+    """
     logits = jnp.einsum(
         "bsd,de->bse", x.astype(jnp.float32), layer["router"],
         preferred_element_type=jnp.float32,
     )
     probs = jax.nn.softmax(logits, axis=-1)
-    dispatch, combine = _route(probs, cfg.top_k, C)
+    dispatch, combine = _route(probs, cfg.top_k, cfg.capacity(x.shape[1]))
+    return dispatch, combine, probs
 
-    # GShard aux loss: E * Σ_e mean-fraction-routed(e) · mean-prob(e).
-    frac = jnp.mean(jnp.sum(dispatch, axis=-1), axis=(0, 1))  # [E]
-    aux = jnp.float32(E) * jnp.sum(frac / cfg.top_k * jnp.mean(probs, axis=(0, 1)))
 
-    # Dispatch: [B,S,E,C] × [B,S,D] → [E,B,C,D]; with experts sharded over
-    # the mesh's expert axis this contraction IS the all-to-all.
+def expert_ffn(x, dispatch, combine, layer, cfg: MoeConfig, shard_experts=None):
+    """Dispatch → expert SwiGLU → combine, as dense einsums over the
+    static capacity axis: x [B,S,D] with dispatch/combine [B,S,E',C] and
+    expert banks [E',D,F] → out [B,S,D].
+
+    E' may be the full expert count (GSPMD path: sharding the banks over
+    the mesh's ``expert`` axis makes the dispatch contraction the
+    all-to-all) or a local slice (pipelined path: the caller slices and
+    psums). Shared between both so the expert math cannot drift.
+    """
     xin = jnp.einsum(
         "bsec,bsd->ebcd", dispatch.astype(cfg.dtype), x,
         preferred_element_type=cfg.dtype,
@@ -156,10 +165,22 @@ def _moe_mlp(x, layer, cfg: MoeConfig, shard_experts=None):
         "ebcf,efd->ebcd", jax.nn.silu(gate) * up,
         layer["w_down"].astype(cfg.dtype),
     )
-    out = jnp.einsum(
+    return jnp.einsum(
         "bsec,ebcd->bsd", combine.astype(cfg.dtype), y,
         preferred_element_type=cfg.dtype,
     )
+
+
+def _moe_mlp(x, layer, cfg: MoeConfig, shard_experts=None):
+    """x [B,S,D] → (out [B,S,D], aux load-balancing loss scalar)."""
+    E = cfg.n_experts
+    dispatch, combine, probs = route_tokens(x, layer, cfg)
+
+    # GShard aux loss: E * Σ_e mean-fraction-routed(e) · mean-prob(e).
+    frac = jnp.mean(jnp.sum(dispatch, axis=-1), axis=(0, 1))  # [E]
+    aux = jnp.float32(E) * jnp.sum(frac / cfg.top_k * jnp.mean(probs, axis=(0, 1)))
+
+    out = expert_ffn(x, dispatch, combine, layer, cfg, shard_experts)
     return out, aux
 
 

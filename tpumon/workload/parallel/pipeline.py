@@ -36,8 +36,12 @@ Written the XLA way:
   the identity into the last stage, so backward stays cheap).
 
 Composes with DP (batch over ``data``), TP (Megatron column/row shards
-*inside* each stage body), and SP (ring attention over the ``seq`` axis
-*inside* each stage body): the whole pipe runs in one ``shard_map``, so
+*inside* each stage body), SP (ring attention over the ``seq`` axis
+*inside* each stage body — contiguous or zigzag layout), and MoE EP
+(expert banks sharded over ``expert`` inside each stage body with a
+psum-over-expert combine; see :func:`_moe_mlp_local` for why the
+aux-loss statistics ride token SUMS across microbatch ticks): the whole
+pipe runs in one ``shard_map``, so
 the collectives XLA inserts automatically on the non-pipelined path are
 written out manually here — one ``psum`` over ``model`` after the
 row-sharded ``wo`` and ``w_down`` projections (the classic Megatron "g"
@@ -94,6 +98,81 @@ def pipeline_param_specs() -> dict:
         "final_norm": P(None),
         "unembed": P(None, "model"),
     }
+
+
+def _moe_stage_layer_specs() -> dict:
+    """MoE per-layer specs under pp: layer axis on ``stage``, expert banks
+    additionally sharded over ``expert`` (pp×MoE supports tp=1 — the
+    attention projections stay unsharded)."""
+    return {
+        "attn_norm": P("stage", None),
+        "wq": P("stage", None, None),
+        "wk": P("stage", None, None),
+        "wv": P("stage", None, None),
+        "wo": P("stage", None, None),
+        "mlp_norm": P("stage", None),
+        "router": P("stage", None, None),
+        "w_gate": P("stage", "expert", None, None),
+        "w_up": P("stage", "expert", None, None),
+        "w_down": P("stage", "expert", None, None),
+    }
+
+
+def moe_pipeline_param_specs() -> dict:
+    """Full param-tree specs for the pipelined MoE model."""
+    return {
+        "embed": P("model", None),
+        "layers": _moe_stage_layer_specs(),
+        "final_norm": P(None),
+        "unembed": P(None, "model"),
+    }
+
+
+def _moe_mlp_local(x, layer, cfg):
+    """One MoE FFN inside the stage shard_map: expert banks are sharded
+    over ``expert`` (this layer's slice is [E/ep, D, F]); activations and
+    routing are expert-replicated, so each shard computes its experts'
+    partial output and one psum over ``expert`` combines — EP's memory
+    win with an all-reduce combine (the monitored EP collective on this
+    path), chosen over token all-to-alls because the dispatch tensors
+    are already local to every shard.
+
+    Returns (out [B,S,D], (frac_sum [E], prob_sum [E])): per-expert TOKEN
+    SUMS, not means — sums are linear across microbatches, so the caller
+    can accumulate them over schedule ticks and compute the GShard aux
+    loss on the full batch exactly as the unpipelined model does
+    (means-of-means would diverge from dense parity).
+    """
+    from tpumon.workload.models.moe import expert_ffn, route_tokens
+
+    dispatch, combine, probs = route_tokens(x, layer, cfg)
+    frac_sum = jnp.sum(dispatch, axis=(0, 1, 3))  # routed tokens per expert
+    prob_sum = jnp.sum(probs, axis=(0, 1))
+
+    ep = jax.lax.axis_size("expert")
+    e_loc = cfg.n_experts // ep
+    start = jax.lax.axis_index("expert") * e_loc
+    disp = jax.lax.dynamic_slice_in_dim(dispatch, start, e_loc, axis=2)
+    comb = jax.lax.dynamic_slice_in_dim(combine, start, e_loc, axis=2)
+
+    out = expert_ffn(x, disp, comb, layer, cfg)
+    return jax.lax.psum(out, "expert"), (frac_sum, prob_sum)
+
+
+def _moe_stage_body(layers_local, x, cfg, freqs, mask):
+    """MoE counterpart of :func:`_stage_body`: returns per-layer aux-loss
+    statistics [lpg, E] alongside the activations."""
+
+    def block(h, layer):
+        h = h + _llama._attention(
+            rms_norm(h, layer["attn_norm"]), layer, cfg, freqs, mask, None
+        )
+        out, stats = _moe_mlp_local(
+            rms_norm(h, layer["mlp_norm"]), layer, cfg
+        )
+        return h + out, stats
+
+    return jax.lax.scan(block, x, layers_local)
 
 
 def _stage_body(layers_local, x, cfg, freqs, mask, tp, attn_impl=None):
@@ -170,11 +249,24 @@ def make_pipelined_forward(
     pp = mesh.shape["stage"]
     tp = mesh.shape["model"]
     spn = mesh.shape["seq"]
+    is_moe = hasattr(cfg, "n_experts")
     v = interleave
     if v < 1:
         raise ValueError(f"interleave must be >= 1, got {v}")
     if sp_layout not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown sp_layout: {sp_layout!r}")
+    if is_moe and (tp > 1 or spn > 1):
+        raise ValueError(
+            "pp×MoE composes with dp and ep only: the stage body's manual "
+            "expert collectives assume unsharded heads (tp=1) and "
+            "full-sequence routing (sp=1 — the capacity cumsum runs over "
+            "the whole sequence)"
+        )
+    if is_moe and cfg.n_experts % mesh.shape["expert"]:
+        raise ValueError(
+            f"n_experts ({cfg.n_experts}) must divide by the mesh expert "
+            f"axis ({mesh.shape['expert']})"
+        )
     if cfg.n_layers % (pp * v):
         raise ValueError(
             f"n_layers ({cfg.n_layers}) must divide by pp*interleave "
@@ -207,11 +299,22 @@ def make_pipelined_forward(
     spec_x = P("data", "seq", None) if sp else P("data", None, None)
     in_ticks, out_ticks, total_ticks = _schedule(microbatches, pp, v)
 
+    # Per-layer aux-loss statistics leave the shard_map per (data shard,
+    # stage): local [1, v, lpg, E] → global [dp, pp·v, lpg, E]; the
+    # caller sums data shards and computes the GShard aux on full-batch
+    # token sums (dense-parity exact — see _moe_mlp_local).
+    spec_stats = P("data", "stage", None, None)
+
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(_stage_layer_specs(), spec_x),
-        out_specs=spec_x,
+        in_specs=(
+            (_moe_stage_layer_specs() if is_moe else _stage_layer_specs()),
+            spec_x,
+        ),
+        out_specs=(
+            (spec_x, (spec_stats, spec_stats)) if is_moe else spec_x
+        ),
         check_vma=False,
     )
     def pipe(layers_local, x):
@@ -265,10 +368,15 @@ def make_pipelined_forward(
         ring = [(i, (i + 1) % pp) for i in range(pp)]
         period = pp * v
 
-        def run_body(chunk, x_in, freqs, mask):
-            return _stage_body(
-                chunk, x_in, local_cfg, freqs, mask, tp, attn_impl
-            )
+        if is_moe:
+            def run_body(chunk, x_in, freqs, mask):
+                return _moe_stage_body(chunk, x_in, local_cfg, freqs, mask)
+        else:
+            def run_body(chunk, x_in, freqs, mask):
+                y = _stage_body(
+                    chunk, x_in, local_cfg, freqs, mask, tp, attn_impl
+                )
+                return y, None
 
         body = jax.checkpoint(run_body) if remat else run_body
 
@@ -287,22 +395,48 @@ def make_pipelined_forward(
                 ),
                 chunks,
             )
-            y = body(chunk, x_in, freqs, mask)
+            y, stats = body(chunk, x_in, freqs, mask)
             x_next = jax.lax.ppermute(y, "stage", ring)
-            return x_next, y
+            if not is_moe:
+                return x_next, y
+            # Aux statistics count only REAL ticks (bubble ticks route
+            # zero-padding — uniform router probs would poison the sums),
+            # scattered to this tick's chunk row so each (chunk, layer)
+            # slot accumulates exactly its own microbatches. Microbatch
+            # index from the schedule algebra: u ticks into the stage,
+            # rounds of pp·v, pp microbatches per round, one chunk lap
+            # per round segment.
+            m_idx = jnp.floor_divide(u, period) * pp + jnp.mod(u, pp)
+            real = (u >= 0) & (m_idx < M)
+            c_hot = jax.nn.one_hot(c, v, dtype=jnp.float32)
+            stats = jax.tree.map(
+                lambda s: jnp.where(real, 1.0, 0.0)
+                * c_hot[:, None, None]
+                * s[None],  # [v, lpg, E]
+                stats,
+            )
+            return x_next, (y, stats)
 
         _, ys = jax.lax.scan(
             tick,
             jnp.zeros((mb, S, D), x.dtype),
             (xs, jnp.arange(total_ticks)),
         )
+        if is_moe:
+            ys, tick_stats = ys
+            # Sum over ticks → this stage's [v, lpg, E] token sums, with
+            # the leading size-1 data axis the out_spec stacks over.
+            stats = jax.tree.map(
+                lambda s: jnp.sum(s, axis=0)[None], tick_stats
+            )
 
         # Microbatch m finishes on the last stage (chunk v-1) at its
         # statically known out-tick.
         outs = ys[jnp.asarray(out_ticks)]
         outs = jnp.where(stage == pp - 1, outs, jnp.zeros_like(outs))
         outs = jax.lax.psum(outs, "stage")
-        return outs.reshape(b_loc, S, D)
+        outs = outs.reshape(b_loc, S, D)
+        return (outs, stats) if is_moe else outs
 
     lpg = cfg.n_layers // (pp * v)
     if v > 1:
@@ -344,8 +478,22 @@ def make_pipelined_forward(
         if order is not None:
             layers = jax.tree.map(lambda a: a[order], layers)
         x = params["embed"].astype(cfg.dtype)[tokens]
-        x = pipe(layers, x)
+        if is_moe:
+            x, (frac, prob) = pipe(layers, x)
+            # Token sums: [dp, pp·v, lpg, E] → per-layer [n_layers, E]
+            # (row order is schedule order — irrelevant under the layer
+            # sum). GShard aux per layer from full-batch means, averaged
+            # over layers: identical to models.moe.forward.
+            n_tok = tokens.shape[0] * tokens.shape[1]
+            f = jnp.sum(frac, axis=0).reshape(-1, cfg.n_experts) / n_tok
+            p = jnp.sum(prob, axis=0).reshape(-1, cfg.n_experts) / n_tok
+            aux = jnp.float32(cfg.n_experts) * jnp.sum(f / cfg.top_k * p)
+            aux = aux / cfg.n_layers
+        else:
+            x = pipe(layers, x)
+            aux = None
         x = rms_norm(x, params["final_norm"])
-        return (x @ params["unembed"].astype(cfg.dtype)).astype(jnp.float32)
+        logits = (x @ params["unembed"].astype(cfg.dtype)).astype(jnp.float32)
+        return (logits, aux) if is_moe else logits
 
     return forward

@@ -62,6 +62,32 @@ def test_remat_composes_with_mesh():
     assert r.losses[-1] < r.losses[0]
 
 
+def test_loss_chunk_matches_unchunked():
+    """The fused chunked unembed+cross-entropy is the same math as the
+    full-logits loss (only summation order differs), and it composes
+    with remat and a dp×tp mesh."""
+    plain = run(CFG, steps=2, batch=4, seq=32)
+    chunked = run(CFG, steps=2, batch=4, seq=32, loss_chunk=16)
+    assert chunked.losses[-1] == pytest.approx(plain.losses[-1], abs=1e-3)
+    meshy = run(
+        CFG, steps=1, batch=4, seq=32, loss_chunk=16, remat=True, dp=2, tp=2
+    )
+    assert meshy.losses[0] == pytest.approx(plain.losses[0], abs=1e-3)
+
+
+def test_loss_chunk_rejections():
+    from tpumon.workload.models.moe import MoeConfig
+
+    with pytest.raises(ValueError, match="dense"):
+        run(MoeConfig.tiny(), steps=1, batch=2, seq=32, loss_chunk=16)
+    with pytest.raises(ValueError, match="divide"):
+        run(CFG, steps=1, batch=2, seq=32, loss_chunk=24)
+    with pytest.raises(ValueError, match=">= 1"):
+        run(CFG, steps=1, batch=2, seq=32, loss_chunk=-16)
+    with pytest.raises(ValueError, match="dp/tp"):
+        run(CFG, steps=1, batch=4, seq=32, loss_chunk=16, dp=2, sp=2)
+
+
 def test_remat_rejects_moe():
     from tpumon.workload.models.moe import MoeConfig
 

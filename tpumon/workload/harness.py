@@ -49,6 +49,36 @@ log = logging.getLogger(__name__)
 AUX_LOSS_WEIGHT = 0.01  # GShard load-balancing loss weight (MoE only)
 
 
+def _chunked_nll(x, unembed_w, targets, chunk, dtype):
+    """Mean next-token NLL with the unembed fused into the loss, one
+    sequence chunk at a time: x [B,S,D] (final-norm hidden), targets
+    [B,S] → scalar f32.
+
+    The full [B, S, vocab] float32 logits tensor — several GB for
+    chip-sized presets at long seq — never materializes: each scan
+    iteration projects one chunk, reduces it to its NLL sum, and
+    ``jax.checkpoint`` makes the backward recompute the chunk's logits
+    instead of stashing them, so loss-path memory is O(B·chunk·vocab).
+    Mathematically identical to the unchunked loss (same log_softmax per
+    token; only the summation order differs).
+    """
+    B, S, D = x.shape
+    n = S // chunk
+    xs = x.reshape(B, n, chunk, D).swapaxes(0, 1)  # [n, B, chunk, D]
+    ts = targets.reshape(B, n, chunk).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def body(total, xt):
+        xc, tc = xt
+        logits = (xc @ unembed_w.astype(dtype)).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, tc[..., None], axis=-1)
+        return total + jnp.sum(nll), None
+
+    total, _ = jax.lax.scan(body, jnp.float32(0.0), (xs, ts))
+    return total / (B * S)
+
+
 def loss_fn(
     params,
     tokens,
@@ -58,6 +88,7 @@ def loss_fn(
     shard_experts=None,
     forward_fn=None,
     remat=False,
+    loss_chunk=0,
 ):
     """Next-token cross-entropy; inputs [B, S], targets are the shift-by-1.
 
@@ -65,7 +96,10 @@ def loss_fn(
     load-balancing auxiliary loss. ``forward_fn`` overrides the model
     forward entirely (the pipelined-forward path, parallel.pipeline).
     ``remat`` recomputes dense-model layer activations in the backward.
+    ``loss_chunk`` (dense model only) fuses the unembed projection into
+    the loss in sequence chunks of that many tokens (:func:`_chunked_nll`).
     """
+    targets = tokens[:, 1:]
     if forward_fn is not None:
         out = forward_fn(params, tokens[:, :-1])
         # The pipelined MoE forward returns (logits, aux) like the
@@ -76,11 +110,18 @@ def loss_fn(
             params, tokens[:, :-1], cfg, attn_impl, shard_acts, shard_experts
         )
     else:
+        if loss_chunk:
+            x = forward(
+                params, tokens[:, :-1], cfg, attn_impl, shard_acts, remat,
+                unembed=False,
+            )
+            return _chunked_nll(
+                x, params["unembed"], targets, loss_chunk, cfg.dtype
+            )
         logits = forward(
             params, tokens[:, :-1], cfg, attn_impl, shard_acts, remat
         )
         aux = 0.0
-    targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
     return jnp.mean(nll) + AUX_LOSS_WEIGHT * aux
@@ -96,6 +137,7 @@ def make_train_step(
     grad_accum: int = 1,
     remat: bool = False,
     with_grad_norm: bool = False,
+    loss_chunk: int = 0,
 ):
     """One jitted optimizer step; ``grad_accum > 1`` splits the batch
     into that many chunks and accumulates gradients over a ``lax.scan``
@@ -109,7 +151,7 @@ def make_train_step(
     def grad_of(params, tokens):
         return jax.value_and_grad(loss_fn)(
             params, tokens, cfg, attn_impl, shard_acts, shard_experts,
-            forward_fn, remat,
+            forward_fn, remat, loss_chunk,
         )
 
     def train_step(params, opt_state, tokens):
@@ -193,6 +235,7 @@ def run(
     grad_accum: int = 1,
     remat: bool = False,
     with_grad_norm: bool = False,
+    loss_chunk: int = 0,
     seed: int = 0,
     mesh=None,
     attn: str = "xla",
@@ -329,6 +372,19 @@ def run(
             "remat supports the dense model and the pipelined forward "
             "(either model); the unpipelined MoE forward does not take it"
         )
+    if loss_chunk:
+        if loss_chunk < 1:
+            raise ValueError(f"loss_chunk must be >= 1, got {loss_chunk}")
+        if is_moe or pp > 1 or sp > 1:
+            raise ValueError(
+                "loss_chunk fuses the dense model's unembed into the "
+                "loss; it composes with dp/tp (not MoE, pp, or sp — the "
+                "seq-chunk reshape would fight the seq sharding)"
+            )
+        if seq % loss_chunk:
+            raise ValueError(
+                f"seq ({seq}) must divide by loss_chunk ({loss_chunk})"
+            )
     if pp > 1:
         forward_fn = make_pipelined_forward(
             mesh, cfg, microbatches=microbatches, interleave=interleave,
@@ -337,7 +393,7 @@ def run(
     train_step = make_train_step(
         cfg, optimizer, attn_impl, shard_acts, shard_experts, forward_fn,
         grad_accum=grad_accum, remat=remat and pp == 1,
-        with_grad_norm=with_grad_norm,
+        with_grad_norm=with_grad_norm, loss_chunk=loss_chunk,
     )
 
     if mesh is not None:
@@ -611,6 +667,15 @@ def main(argv: list[str] | None = None) -> int:
         "forward FLOPs — lets chip-sized presets train at long seq",
     )
     parser.add_argument(
+        "--loss-chunk",
+        type=int,
+        default=0,
+        help="fuse the unembed projection into the loss in sequence "
+        "chunks of this many tokens (0 = off): the [B,S,vocab] f32 "
+        "logits never materialize — several GB back at chip-sized "
+        "presets. Dense model, dp/tp only",
+    )
+    parser.add_argument(
         "--interleave",
         type=int,
         default=1,
@@ -798,6 +863,7 @@ def main(argv: list[str] | None = None) -> int:
             sp_layout=args.sp_layout,
             grad_accum=args.grad_accum,
             remat=args.remat,
+            loss_chunk=args.loss_chunk,
             attn=args.attn,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,

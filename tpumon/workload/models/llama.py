@@ -151,7 +151,10 @@ def _mlp(x, layer, cfg: LlamaConfig):
     return (jax.nn.silu(gate) * up) @ layer["w_down"].astype(cfg.dtype)
 
 
-@partial(jax.jit, static_argnames=("cfg", "attn_impl", "shard_acts", "remat"))
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "attn_impl", "shard_acts", "remat", "unembed"),
+)
 def forward(
     params: dict,
     tokens: jnp.ndarray,
@@ -159,8 +162,15 @@ def forward(
     attn_impl=None,
     shard_acts=None,
     remat: bool = False,
+    unembed: bool = True,
 ) -> jnp.ndarray:
     """tokens [B, S] int32 → logits [B, S, vocab] float32.
+
+    ``unembed=False`` returns the final-norm hidden states [B, S, dim]
+    (cfg.dtype) instead — the entry point for losses that fuse the
+    unembed projection with the cross-entropy in chunks so the full
+    [B, S, vocab] float32 logits tensor never materializes
+    (harness.loss_fn's ``loss_chunk``).
 
     ``attn_impl`` swaps the attention core (ring attention for sequence
     parallelism, pallas flash attention); ``shard_acts`` is an optional
@@ -195,4 +205,6 @@ def forward(
         jax.checkpoint(block) if remat else block, x, params["layers"]
     )
     x = rms_norm(x, params["final_norm"])
+    if not unembed:
+        return x
     return (x @ params["unembed"].astype(cfg.dtype)).astype(jnp.float32)

@@ -289,12 +289,39 @@ def test_harness_flash_sp_zigzag_losses_match_dense():
         assert abs(a - b) < 5e-3, (dense.losses, ring.losses)
 
 
-def test_harness_flash_rejects_pp():
+def test_harness_flash_composes_with_pp():
+    """The pallas kernel runs inside pipeline stage bodies: plain flash
+    when each stage sees the full sequence, flash-in-zigzag-ring under
+    pp×sp. Loss parity vs the dense single-device run for both."""
     from tpumon.workload.harness import run
     from tpumon.workload.models.llama import LlamaConfig
 
-    with pytest.raises(ValueError, match="flash"):
-        run(LlamaConfig.tiny(), steps=1, batch=2, seq=32, pp=2, attn="flash")
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    cfg = LlamaConfig(n_layers=4)
+    dense = run(cfg, steps=1, batch=4, seq=64)
+    pp_flash = run(
+        cfg, steps=1, batch=4, seq=64, dp=2, pp=2, tp=2, microbatches=2,
+        attn="flash",
+    )
+    assert abs(dense.losses[-1] - pp_flash.losses[-1]) < 5e-3
+    pp_sp_flash = run(
+        cfg, steps=1, batch=4, seq=64, dp=2, pp=2, sp=2, microbatches=2,
+        sp_layout="zigzag", attn="flash",
+    )
+    assert abs(dense.losses[-1] - pp_sp_flash.losses[-1]) < 5e-3
+
+
+def test_harness_flash_pp_rejects_contiguous_sp():
+    """Same static-mask constraint inside the pipe as outside it."""
+    from tpumon.workload.harness import run
+    from tpumon.workload.models.llama import LlamaConfig
+
+    with pytest.raises(ValueError, match="zigzag"):
+        run(
+            LlamaConfig(n_layers=4), steps=1, batch=4, seq=64, dp=2,
+            pp=2, sp=2, microbatches=2, attn="flash",
+        )
 
 
 def test_sweep_blocks_smoke():

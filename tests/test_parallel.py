@@ -487,6 +487,20 @@ class TestPipeline:
         )
         assert abs(dense.losses[-1] - pp.losses[-1]) < 0.01
 
+    def test_pp_ep_moe_flash_trains_with_dense_parity(self):
+        """The pallas kernel inside MoE pipeline stage bodies (pp×ep×
+        flash): the attention core swap must be invisible to the expert
+        math — loss parity vs the unpipelined dense MoE run."""
+        from tpumon.workload.harness import run
+
+        cfg = moe.MoeConfig.tiny()
+        dense = run(cfg, steps=1, batch=4, seq=32)
+        ppf = run(
+            cfg, steps=1, batch=4, seq=32, dp=2, pp=2, ep=2,
+            microbatches=2, attn="flash",
+        )
+        assert abs(dense.losses[-1] - ppf.losses[-1]) < 0.01
+
     def test_pp_ep_moe_interleaved_aux_parity(self):
         """The circular schedule's aux-stat scatter (v>1: the m_idx /
         chunk-one-hot accounting) must reproduce the dense aux exactly —

@@ -252,11 +252,11 @@ def run(
     batch×seq-sharded residual stream. ``ep > 1`` shards MoE expert banks
     over the ``expert`` axis so dispatch/combine become all-to-alls.
     ``attn="flash"`` swaps the attention core for the pallas flash kernel
-    (ops.flash_attention); it composes with dp/tp/ep, and with sp > 1
+    (ops.flash_attention); it composes with dp/tp/ep/pp, and with sp > 1
     under ``sp_layout="zigzag"`` (the ring runs the kernel per stripe
-    pair — parallel.ring.zigzag_ring_flash_local), but not with
-    contiguous sp (device-dependent hop masks) or pp > 1 (the pipelined
-    forward owns the model body). ``pp > 1`` composes with dp/tp/sp —
+    pair — parallel.ring.zigzag_ring_flash_local; inside pipeline stage
+    bodies too), but not with contiguous sp (device-dependent hop
+    masks). ``pp > 1`` composes with dp/tp/sp —
     under either sp layout: ``sp_layout="zigzag"`` runs the balanced
     zigzag ring inside the pipeline stage bodies too — and with MoE as
     dp×pp×ep (expert banks sharded inside stage bodies; tp/sp stay 1
@@ -306,9 +306,6 @@ def run(
 
     attn_impl = shard_acts = shard_experts = forward_fn = None
     if attn == "flash":
-        if pp > 1:
-            raise ValueError("attn='flash' does not compose with pp > 1 "
-                             "(the pipelined forward owns the model body)")
         if sp > 1 and sp_layout != "zigzag":
             raise ValueError(
                 "attn='flash' composes with sp > 1 only under "
@@ -316,11 +313,12 @@ def run(
                 "zigzag is the layout that makes every ring hop statically "
                 "unmasked)"
             )
-        if sp == 1:
+        if sp == 1 and pp == 1:
+            # Under pp the pipelined forward builds its own kernel impl;
+            # under sp the ring construction below owns it (flash=True).
             from tpumon.workload.ops.flash_attention import make_flash_attn
 
             attn_impl = make_flash_attn()
-        # sp > 1: the ring construction below owns the impl (flash=True).
     elif attn != "xla":
         raise ValueError(f"unknown attn impl: {attn!r}")
     if sp > 1:
@@ -338,7 +336,8 @@ def run(
         if pp == 1:
             # Under pp the pipelined forward owns the attention impl AND
             # the activation layout (its shard_map specs), so both stay
-            # unset on that path (and its internal ring is contiguous).
+            # unset on that path — attn/sp_layout are passed through to
+            # make_pipelined_forward instead.
             attn_impl = make_ring_attn(
                 mesh,
                 head_axis="model" if tp > 1 else None,
@@ -388,7 +387,7 @@ def run(
     if pp > 1:
         forward_fn = make_pipelined_forward(
             mesh, cfg, microbatches=microbatches, interleave=interleave,
-            sp_layout=sp_layout, remat=remat,
+            sp_layout=sp_layout, remat=remat, attn=attn,
         )
     train_step = make_train_step(
         cfg, optimizer, attn_impl, shard_acts, shard_experts, forward_fn,

@@ -71,6 +71,7 @@ from tpumon.workload.parallel.ring import (
     _to_zigzag,
     ring_attention_local,
     zigzag_ring_attention_local,
+    zigzag_ring_flash_local,
 )
 
 
@@ -159,13 +160,14 @@ def _moe_mlp_local(x, layer, cfg):
     return jax.lax.psum(out, "expert"), (frac_sum, prob_sum)
 
 
-def _moe_stage_body(layers_local, x, cfg, freqs, mask):
+def _moe_stage_body(layers_local, x, cfg, freqs, mask, attn_impl=None):
     """MoE counterpart of :func:`_stage_body`: returns per-layer aux-loss
     statistics [lpg, E] alongside the activations."""
 
     def block(h, layer):
         h = h + _llama._attention(
-            rms_norm(h, layer["attn_norm"]), layer, cfg, freqs, mask, None
+            rms_norm(h, layer["attn_norm"]), layer, cfg, freqs, mask,
+            attn_impl,
         )
         out, stats = _moe_mlp_local(
             rms_norm(h, layer["mlp_norm"]), layer, cfg
@@ -228,6 +230,7 @@ def make_pipelined_forward(
     interleave: int = 1,
     remat: bool = False,
     sp_layout: str = "contiguous",
+    attn: str = "xla",
 ):
     """logits = f(params, tokens): pipeline over the mesh's ``stage`` axis.
 
@@ -245,6 +248,13 @@ def make_pipelined_forward(
     schedule, RoPE offsets, and residual stream are untouched — the same
     transparency that lets zigzag compose with dp/tp/ep on the
     non-pipelined path.
+
+    ``attn="flash"`` swaps the stage bodies' attention core for the
+    pallas flash kernel: plain :func:`ops.flash_attention` when the seq
+    axis is 1 (each stage sees the full sequence), the
+    flash-inside-zigzag ring under ``sp_layout="zigzag"``. Contiguous sp
+    keeps the XLA online-softmax ring (device-dependent hop masks — the
+    same reason the non-pipelined path rejects that pairing).
     """
     pp = mesh.shape["stage"]
     tp = mesh.shape["model"]
@@ -255,6 +265,14 @@ def make_pipelined_forward(
         raise ValueError(f"interleave must be >= 1, got {v}")
     if sp_layout not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown sp_layout: {sp_layout!r}")
+    if attn not in ("xla", "flash"):
+        raise ValueError(f"unknown attn impl: {attn!r}")
+    if attn == "flash" and spn > 1 and sp_layout != "zigzag":
+        raise ValueError(
+            "attn='flash' under pp composes with sp only in the zigzag "
+            "layout (contiguous ring hops carry device-dependent masks "
+            "the static-mask kernel cannot express)"
+        )
     if is_moe and (tp > 1 or spn > 1):
         raise ValueError(
             "pp×MoE composes with dp and ep only: the stage body's manual "
@@ -331,12 +349,18 @@ def make_pipelined_forward(
             freqs = jax.lax.dynamic_slice_in_dim(freqs_full, six * S, S)
             mask = None  # ring attention masks by global position itself
             if sp_layout == "zigzag":
+                # NOTE: bound to its own name — `ring` below is the
+                # ppermute pair list, and closures capture by reference.
+                zz_ring = (
+                    zigzag_ring_flash_local if attn == "flash"
+                    else zigzag_ring_attention_local
+                )
+
                 def attn_impl(q, k, v_):
                     q = _to_zigzag(q, "seq")
                     k = _to_zigzag(k, "seq")
                     v_ = _to_zigzag(v_, "seq")
-                    out = zigzag_ring_attention_local(q, k, v_, "seq")
-                    return _from_zigzag(out, "seq")
+                    return _from_zigzag(zz_ring(q, k, v_, "seq"), "seq")
             else:
                 attn_impl = lambda q, k, v_: ring_attention_local(  # noqa: E731
                     q, k, v_, "seq"
@@ -346,7 +370,14 @@ def make_pipelined_forward(
             mask = jnp.triu(
                 jnp.full((cfg.max_seq, cfg.max_seq), -1e9, jnp.float32), k=1
             )
-            attn_impl = None
+            if attn == "flash":
+                from tpumon.workload.ops.flash_attention import make_flash_attn
+
+                # Each stage sees the full sequence: the pallas kernel
+                # drops in as-is (GQA via its index maps, tuned tiles).
+                attn_impl = make_flash_attn()
+            else:
+                attn_impl = None
 
         # Local layer stack [v·lpg, ...] → v chunks of lpg layers. Storage
         # is schedule-ordered (see forward()): local chunk c = rows
@@ -370,7 +401,9 @@ def make_pipelined_forward(
 
         if is_moe:
             def run_body(chunk, x_in, freqs, mask):
-                return _moe_stage_body(chunk, x_in, local_cfg, freqs, mask)
+                return _moe_stage_body(
+                    chunk, x_in, local_cfg, freqs, mask, attn_impl
+                )
         else:
             def run_body(chunk, x_in, freqs, mask):
                 y = _stage_body(

@@ -261,8 +261,8 @@ class TestFlopsAccounting:
 
 class TestBenchRing:
     def test_bench_ring_smoke(self, capsys):
-        """All three configurations produce timing rows on a tiny
-        in-process mesh (flash runs interpreted here)."""
+        """All four layout-kernel configurations produce timing rows on
+        a tiny in-process mesh (flash runs interpreted here)."""
         from tpumon.workload.bench_ring import bench
 
         rows = bench(
@@ -270,7 +270,7 @@ class TestBenchRing:
             seqs=(16,), iters=1,
         )
         assert {r["layout"] for r in rows} == {
-            "contiguous", "zigzag", "zigzag-flash",
+            "contiguous", "contiguous-flash", "zigzag", "zigzag-flash",
         }
         for r in rows:
             assert r["fwd_ms"] > 0 and r["fwd_bwd_ms"] > 0

@@ -1,13 +1,16 @@
 """Ring-attention layout benchmark: contiguous vs zigzag (SURVEY.md §6).
 
 Times one causal ring-attention forward (and forward+backward) per
-sequence length on a dp×sp mesh, for three configurations: the
-branchless contiguous ring, the zigzag layout (which computes exactly
-half the stripe pairs — parallel.ring.zigzag_ring_attention_local, at
-the price of eight stripe-size ppermutes per call), and the zigzag ring
-with the pallas flash kernel running every stripe pair
-(zigzag_ring_flash_local; interpret mode off-TPU, so only its TPU
-numbers are about speed). Zigzag should win once S²-attention compute
+sequence length on a dp×sp mesh, for four configurations: the
+branchless contiguous ring, the contiguous ring with the flash kernel
+(ring_flash_local — same useful FLOPs as zigzag but hop-imbalanced; the
+bench measures that claimed trade-off), the zigzag layout (which
+computes exactly half the stripe pairs —
+parallel.ring.zigzag_ring_attention_local, at the price of eight
+stripe-size ppermutes per call), and the zigzag ring with the pallas
+flash kernel running every stripe pair (zigzag_ring_flash_local;
+interpret mode off-TPU, so only its TPU numbers are about speed).
+Zigzag should win once S²-attention compute
 dominates the redistribution, which is the regime sequence parallelism
 exists for. The numbers land in BASELINE.md; an honest crossover point
 (below which contiguous wins) is a result.
@@ -83,11 +86,13 @@ def bench(
         v = jax.random.normal(
             kv_, (batch, seq, kv_heads, head_dim), jnp.bfloat16
         )
-        for layout in ("contiguous", "zigzag", "zigzag-flash"):
+        for layout in (
+            "contiguous", "contiguous-flash", "zigzag", "zigzag-flash"
+        ):
             attn = make_ring_attn(
                 mesh,
                 zigzag=layout.startswith("zigzag"),
-                flash=layout == "zigzag-flash",
+                flash=layout.endswith("flash"),
             )
             fwd = jax.jit(attn)
 

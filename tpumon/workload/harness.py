@@ -252,11 +252,11 @@ def run(
     batch×seq-sharded residual stream. ``ep > 1`` shards MoE expert banks
     over the ``expert`` axis so dispatch/combine become all-to-alls.
     ``attn="flash"`` swaps the attention core for the pallas flash kernel
-    (ops.flash_attention); it composes with dp/tp/ep/pp, and with sp > 1
-    under ``sp_layout="zigzag"`` (the ring runs the kernel per stripe
-    pair — parallel.ring.zigzag_ring_flash_local; inside pipeline stage
-    bodies too), but not with contiguous sp (device-dependent hop
-    masks). ``pp > 1`` composes with dp/tp/sp —
+    (ops.flash_attention); it composes with every axis and layout:
+    dp/tp/ep/pp, zigzag sp (the ring runs the kernel per stripe pair —
+    parallel.ring.zigzag_ring_flash_local), and contiguous sp (each hop
+    is one of three static mask cases — parallel.ring.ring_flash_local);
+    inside pipeline stage bodies too. ``pp > 1`` composes with dp/tp/sp —
     under either sp layout: ``sp_layout="zigzag"`` runs the balanced
     zigzag ring inside the pipeline stage bodies too — and with MoE as
     dp×pp×ep (expert banks sharded inside stage bodies; tp/sp stay 1
@@ -306,13 +306,6 @@ def run(
 
     attn_impl = shard_acts = shard_experts = forward_fn = None
     if attn == "flash":
-        if sp > 1 and sp_layout != "zigzag":
-            raise ValueError(
-                "attn='flash' composes with sp > 1 only under "
-                "sp_layout='zigzag' (the flash kernel needs static masks; "
-                "zigzag is the layout that makes every ring hop statically "
-                "unmasked)"
-            )
         if sp == 1 and pp == 1:
             # Under pp the pipelined forward builds its own kernel impl;
             # under sp the ring construction below owns it (flash=True).

@@ -70,6 +70,7 @@ from tpumon.workload.parallel.ring import (
     _from_zigzag,
     _to_zigzag,
     ring_attention_local,
+    ring_flash_local,
     zigzag_ring_attention_local,
     zigzag_ring_flash_local,
 )
@@ -252,9 +253,10 @@ def make_pipelined_forward(
     ``attn="flash"`` swaps the stage bodies' attention core for the
     pallas flash kernel: plain :func:`ops.flash_attention` when the seq
     axis is 1 (each stage sees the full sequence), the
-    flash-inside-zigzag ring under ``sp_layout="zigzag"``. Contiguous sp
-    keeps the XLA online-softmax ring (device-dependent hop masks — the
-    same reason the non-pipelined path rejects that pairing).
+    flash-inside-ring composition under sp — zigzag stripe pairs
+    (:func:`parallel.ring.zigzag_ring_flash_local`) or the contiguous
+    layout's three-static-case hops
+    (:func:`parallel.ring.ring_flash_local`).
     """
     pp = mesh.shape["stage"]
     tp = mesh.shape["model"]
@@ -267,12 +269,6 @@ def make_pipelined_forward(
         raise ValueError(f"unknown sp_layout: {sp_layout!r}")
     if attn not in ("xla", "flash"):
         raise ValueError(f"unknown attn impl: {attn!r}")
-    if attn == "flash" and spn > 1 and sp_layout != "zigzag":
-        raise ValueError(
-            "attn='flash' under pp composes with sp only in the zigzag "
-            "layout (contiguous ring hops carry device-dependent masks "
-            "the static-mask kernel cannot express)"
-        )
     if is_moe and (tp > 1 or spn > 1):
         raise ValueError(
             "pp×MoE composes with dp and ep only: the stage body's manual "
@@ -361,6 +357,10 @@ def make_pipelined_forward(
                     k = _to_zigzag(k, "seq")
                     v_ = _to_zigzag(v_, "seq")
                     return _from_zigzag(zz_ring(q, k, v_, "seq"), "seq")
+            elif attn == "flash":
+                attn_impl = lambda q, k, v_: ring_flash_local(  # noqa: E731
+                    q, k, v_, "seq"
+                )
             else:
                 attn_impl = lambda q, k, v_: ring_attention_local(  # noqa: E731
                     q, k, v_, "seq"

@@ -269,6 +269,23 @@ def zigzag_ring_attention_local(
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
+def _make_flash_partial(block_q, block_k, interpret):
+    """The (q, k, v, causal) → (o f32, lse) kernel call both flash rings
+    share: one definition so the partial-output convention (f32
+    accumulator layout + composable lse) cannot drift between the
+    contiguous and zigzag schedules."""
+    from tpumon.workload.ops.flash_attention import flash_attention_with_lse
+
+    def flash(q, k, v, causal):
+        o, lse = flash_attention_with_lse(
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+            interpret=interpret,
+        )
+        return o.astype(jnp.float32), lse
+
+    return flash
+
+
 def _merge_partials(o_a, lse_a, o_b, lse_b):
     """Merge two normalized flash partials over the same query stripe.
 
@@ -316,18 +333,10 @@ def zigzag_ring_flash_local(
 
     q [B, 2s, H, D], k/v [B, 2s, KV, D] in zigzag layout.
     """
-    from tpumon.workload.ops.flash_attention import flash_attention_with_lse
-
     n = jax.lax.axis_size(axis_name)
     d = jax.lax.axis_index(axis_name)
     s = q.shape[1] // 2
-
-    def flash(q_, k_, v_, causal):
-        o, lse = flash_attention_with_lse(
-            q_, k_, v_, causal=causal, block_q=block_q, block_k=block_k,
-            interpret=interpret,
-        )
-        return o.astype(jnp.float32), lse
+    flash = _make_flash_partial(block_q, block_k, interpret)
 
     q_lo, q_hi = q[:, :s], q[:, s:]
 
@@ -377,6 +386,67 @@ def zigzag_ring_flash_local(
     return jnp.concatenate([o_lo, o_hi], axis=1).astype(q.dtype)
 
 
+def ring_flash_local(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    *,
+    causal: bool = True,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Ring attention over CONTIGUOUS sequence shards with the pallas
+    flash kernel per hop.
+
+    The kernel wants a static mask, and under the contiguous layout each
+    hop's mask is one of exactly three static cases, selected by the
+    (traced) source index:
+
+    - ``src == d`` (hop 0): the local block attends itself causally —
+      one ``causal=True`` kernel call;
+    - ``src < d``: the arriving block is entirely older — one unmasked
+      call, merged into the accumulator via the composable log-sum-exp
+      (:func:`_merge_partials`);
+    - ``src > d``: entirely newer — fully masked, so a ``lax.cond``
+      skips the kernel (the device idles that hop instead of computing
+      masked work, which is the contiguous layout's load imbalance —
+      the zigzag layout exists to fix that, not this).
+
+    Same wire cost as the XLA contiguous ring (one KV-headed block per
+    hop; GQA expansion stays inside the kernel's index maps). With
+    ``causal=False`` every hop attends in full and the cond disappears.
+    """
+    n = jax.lax.axis_size(axis_name)
+    d = jax.lax.axis_index(axis_name)
+    flash = _make_flash_partial(block_q, block_k, interpret)
+
+    o, lse = flash(q, k, v, causal)  # hop 0: the self block
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(i, carry):
+        o, lse, k, v = carry
+        # Rotate first: at iteration i we hold the block from (d - i).
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        src = (d - i) % n
+
+        def attend(args):
+            o, lse = args
+            o2, lse2 = flash(q, k, v, False)
+            return _merge_partials(o, lse, o2, lse2)
+
+        if causal:
+            o, lse = jax.lax.cond(src < d, attend, lambda a: a, (o, lse))
+        else:
+            o, lse = attend((o, lse))
+        return o, lse, k, v
+
+    o, lse, k, v = jax.lax.fori_loop(1, n, step, (o, lse, k, v))
+    return o.astype(q.dtype)
+
+
 def make_ring_attn(
     mesh: Mesh, *, data_axis="data", seq_axis="seq", head_axis=None, causal=True,
     zigzag=False, flash=False, block_q=None, block_k=None, interpret=None,
@@ -402,23 +472,19 @@ def make_ring_attn(
     attention stay contiguous, so RoPE/positions and the residual stream
     are untouched.
 
-    ``flash=True`` (zigzag only) runs the pallas flash kernel for every
-    local stripe pair instead of the XLA online-softmax block
-    (:func:`zigzag_ring_flash_local`) — ring over ICI outside, MXU-tiled
-    kernel inside. ``block_q``/``block_k``/``interpret`` pass through to
-    the kernel.
+    ``flash=True`` runs the pallas flash kernel instead of the XLA
+    online-softmax block — ring over ICI outside, MXU-tiled kernel
+    inside. Under zigzag, every stripe pair is one kernel call
+    (:func:`zigzag_ring_flash_local`); under the contiguous layout each
+    hop is one of three static cases selected per device
+    (:func:`ring_flash_local` — same FLOPs as zigzag, the contiguous
+    layout's usual load imbalance). ``block_q``/``block_k``/
+    ``interpret`` pass through to the kernel.
     """
     if zigzag and not causal:
         raise ValueError(
             "zigzag layout only pays off for causal attention (non-causal "
             "ring attention has no masked compute to eliminate)"
-        )
-    if flash and not zigzag:
-        raise ValueError(
-            "flash=True requires zigzag=True: the pallas kernel wants "
-            "static masks, and only the zigzag layout makes every ring "
-            "hop statically unmasked (contiguous hops are masked by a "
-            "device-dependent amount)"
         )
     spec = P(data_axis, seq_axis, head_axis, None)
     if zigzag:
@@ -434,6 +500,12 @@ def make_ring_attn(
             else:
                 out = zigzag_ring_attention_local(q, k, v, seq_axis)
             return _from_zigzag(out, seq_axis)
+    elif flash:
+        def local(q, k, v):
+            return ring_flash_local(
+                q, k, v, seq_axis, causal=causal,
+                block_q=block_q, block_k=block_k, interpret=interpret,
+            )
     else:
         def local(q, k, v):
             return ring_attention_local(

@@ -519,6 +519,21 @@ class TestPipeline:
         )
         assert abs(dense.losses[-1] - pp.losses[-1]) < 0.01
 
+    def test_pp_ep_tp_moe_trains_with_dense_parity(self):
+        """Megatron shards inside MoE pipeline stages (pp×ep×tp): expert
+        banks column/row-split over model, combined in one fused psum
+        over (expert, model) — loss parity vs the unpipelined dense
+        run."""
+        from tpumon.workload.harness import run
+
+        cfg = moe.MoeConfig.tiny()
+        dense = run(cfg, steps=1, batch=4, seq=32)
+        t = run(
+            cfg, steps=1, batch=4, seq=32, dp=1, pp=2, ep=2, tp=2,
+            microbatches=2,
+        )
+        assert abs(dense.losses[-1] - t.losses[-1]) < 0.01
+
     def test_pp_ep_moe_flash_trains_with_dense_parity(self):
         """The pallas kernel inside MoE pipeline stage bodies (pp×ep×
         flash): the attention core swap must be invisible to the expert
@@ -745,13 +760,10 @@ class TestHarnessComposition:
 
         with pytest.raises(ValueError, match="MoeConfig"):
             run(llama.LlamaConfig.tiny(), steps=1, ep=2)
-        # pp×MoE runs dp×pp×ep; the manual stage collectives don't cover
-        # tp/sp with MoE — must refuse, not silently mis-shard.
-        with pytest.raises(ValueError, match="dp/ep only"):
-            run(
-                moe.MoeConfig.tiny(), steps=1, batch=4, seq=32, pp=2, tp=2,
-            )
-        with pytest.raises(ValueError, match="dp/ep only"):
+        # pp×MoE runs dp×pp×ep×tp; sp stays out (routing's capacity
+        # cumsum needs the whole sequence) — must refuse, not silently
+        # mis-shard.
+        with pytest.raises(ValueError, match="sp=1"):
             run(
                 moe.MoeConfig.tiny(), steps=1, batch=4, seq=32, pp=2, sp=2,
             )

@@ -259,8 +259,8 @@ def run(
     inside pipeline stage bodies too. ``pp > 1`` composes with dp/tp/sp —
     under either sp layout: ``sp_layout="zigzag"`` runs the balanced
     zigzag ring inside the pipeline stage bodies too — and with MoE as
-    dp×pp×ep (expert banks sharded inside stage bodies; tp/sp stay 1
-    on that path).
+    dp×pp×ep×tp (expert banks sharded over expert and Megatron-split
+    over model inside stage bodies; sp stays 1 on that path).
     ``interleave > 1`` selects the circular (interleaved) pipeline
     schedule — bubble ÷ interleave (parallel.pipeline). ``remat=True``
     recomputes layer activations in the backward (dense and pipelined
@@ -283,11 +283,11 @@ def run(
     is_moe = isinstance(cfg, MoeConfig)
     if ep > 1 and not is_moe:
         raise ValueError("ep > 1 requires a MoeConfig")
-    if pp > 1 and is_moe and (tp > 1 or sp > 1):
-        # pp×MoE runs dp×pp×ep (expert banks sharded inside stage
-        # bodies, psum-over-expert combine — parallel.pipeline); the
-        # manual stage collectives don't cover tp/sp with MoE.
-        raise ValueError("pp with MoE composes with dp/ep only (tp=1, sp=1)")
+    if pp > 1 and is_moe and sp > 1:
+        # pp×MoE runs dp×pp×ep×tp (expert banks sharded over expert AND
+        # Megatron-split over model inside stage bodies); sp stays out —
+        # routing's capacity cumsum needs the whole sequence.
+        raise ValueError("pp with MoE composes with dp/ep/tp only (sp=1)")
     seq = seq or cfg.max_seq
     if seq > cfg.max_seq:
         # Long-context runs beyond the preset's nominal window: extend the

@@ -37,10 +37,11 @@ Written the XLA way:
 
 Composes with DP (batch over ``data``), TP (Megatron column/row shards
 *inside* each stage body), SP (ring attention over the ``seq`` axis
-*inside* each stage body — contiguous or zigzag layout), and MoE EP
-(expert banks sharded over ``expert`` inside each stage body with a
-psum-over-expert combine; see :func:`_moe_mlp_local` for why the
-aux-loss statistics ride token SUMS across microbatch ticks): the whole
+*inside* each stage body — contiguous or zigzag layout), and MoE EP×TP
+(expert banks sharded over ``expert`` AND Megatron column/row-split
+over ``model`` inside each stage body, combined in one fused psum over
+both axes; see :func:`_moe_mlp_local` for why the aux-loss statistics
+ride token SUMS across microbatch ticks): the whole
 pipe runs in one ``shard_map``, so
 the collectives XLA inserts automatically on the non-pipelined path are
 written out manually here — one ``psum`` over ``model`` after the
@@ -103,20 +104,21 @@ def pipeline_param_specs() -> dict:
 
 
 def _moe_stage_layer_specs() -> dict:
-    """MoE per-layer specs under pp: layer axis on ``stage``, expert banks
-    additionally sharded over ``expert`` (pp×MoE supports tp=1 — the
-    attention projections stay unsharded)."""
+    """MoE per-layer specs under pp: layer axis on ``stage``, expert
+    banks sharded over ``expert`` AND Megatron column/row-sharded over
+    ``model`` (no-op at tp=1); attention projections shard over
+    ``model`` exactly like the dense stage specs."""
     return {
         "attn_norm": P("stage", None),
-        "wq": P("stage", None, None),
-        "wk": P("stage", None, None),
-        "wv": P("stage", None, None),
-        "wo": P("stage", None, None),
+        "wq": P("stage", None, "model"),
+        "wk": P("stage", None, "model"),
+        "wv": P("stage", None, "model"),
+        "wo": P("stage", "model", None),
         "mlp_norm": P("stage", None),
         "router": P("stage", None, None),
-        "w_gate": P("stage", "expert", None, None),
-        "w_up": P("stage", "expert", None, None),
-        "w_down": P("stage", "expert", None, None),
+        "w_gate": P("stage", "expert", None, "model"),
+        "w_up": P("stage", "expert", None, "model"),
+        "w_down": P("stage", "expert", "model", None),
     }
 
 
@@ -130,14 +132,17 @@ def moe_pipeline_param_specs() -> dict:
     }
 
 
-def _moe_mlp_local(x, layer, cfg):
+def _moe_mlp_local(x, layer, cfg, tp=1):
     """One MoE FFN inside the stage shard_map: expert banks are sharded
-    over ``expert`` (this layer's slice is [E/ep, D, F]); activations and
-    routing are expert-replicated, so each shard computes its experts'
-    partial output and one psum over ``expert`` combines — EP's memory
-    win with an all-reduce combine (the monitored EP collective on this
+    over ``expert`` (this layer's slice is [E/ep, D, F/tp]); activations
+    and routing are expert- and model-replicated, so each shard computes
+    its experts' (column-sliced) partial output and one psum over the
+    ``expert`` (+ ``model``, at tp > 1) axes combines — EP's memory win
+    with an all-reduce combine (the monitored EP collective on this
     path), chosen over token all-to-alls because the dispatch tensors
-    are already local to every shard.
+    are already local to every shard. The F axis sharding is the classic
+    Megatron column(gate/up)/row(down) split, so the tp partial sums
+    fold into the same psum.
 
     Returns (out [B,S,D], (frac_sum [E], prob_sum [E])): per-expert TOKEN
     SUMS, not means — sums are linear across microbatches, so the caller
@@ -158,20 +163,31 @@ def _moe_mlp_local(x, layer, cfg):
     comb = jax.lax.dynamic_slice_in_dim(combine, start, e_loc, axis=2)
 
     out = expert_ffn(x, disp, comb, layer, cfg)
-    return jax.lax.psum(out, "expert"), (frac_sum, prob_sum)
+    axes = ("expert", "model") if tp > 1 else ("expert",)
+    return jax.lax.psum(out, axes), (frac_sum, prob_sum)
 
 
-def _moe_stage_body(layers_local, x, cfg, freqs, mask, attn_impl=None):
+def _attn_sublayer(h, layer, cfg, freqs, mask, tp, attn_impl):
+    """Attention + residual for one stage-body layer: the Megatron psum
+    after the row-sharded ``wo`` lives here, shared by the dense and MoE
+    stage bodies so the tp collective cannot drift between them."""
+    a = _llama._attention(
+        rms_norm(h, layer["attn_norm"]), layer, cfg, freqs, mask, attn_impl
+    )
+    if tp > 1:
+        a = jax.lax.psum(a, "model")
+    return h + a
+
+
+def _moe_stage_body(layers_local, x, cfg, freqs, mask, tp, attn_impl=None):
     """MoE counterpart of :func:`_stage_body`: returns per-layer aux-loss
-    statistics [lpg, E] alongside the activations."""
+    statistics [lpg, E] alongside the activations. ``cfg`` carries
+    per-model-shard head counts at tp > 1."""
 
     def block(h, layer):
-        h = h + _llama._attention(
-            rms_norm(h, layer["attn_norm"]), layer, cfg, freqs, mask,
-            attn_impl,
-        )
+        h = _attn_sublayer(h, layer, cfg, freqs, mask, tp, attn_impl)
         out, stats = _moe_mlp_local(
-            rms_norm(h, layer["mlp_norm"]), layer, cfg
+            rms_norm(h, layer["mlp_norm"]), layer, cfg, tp
         )
         return h + out, stats
 
@@ -190,13 +206,7 @@ def _stage_body(layers_local, x, cfg, freqs, mask, tp, attn_impl=None):
     """
 
     def block(h, layer):
-        a = _llama._attention(
-            rms_norm(h, layer["attn_norm"]), layer, cfg, freqs, mask,
-            attn_impl,
-        )
-        if tp > 1:
-            a = jax.lax.psum(a, "model")
-        h = h + a
+        h = _attn_sublayer(h, layer, cfg, freqs, mask, tp, attn_impl)
         m = _llama._mlp(rms_norm(h, layer["mlp_norm"]), layer, cfg)
         if tp > 1:
             m = jax.lax.psum(m, "model")
@@ -269,12 +279,11 @@ def make_pipelined_forward(
         raise ValueError(f"unknown sp_layout: {sp_layout!r}")
     if attn not in ("xla", "flash"):
         raise ValueError(f"unknown attn impl: {attn!r}")
-    if is_moe and (tp > 1 or spn > 1):
+    if is_moe and spn > 1:
         raise ValueError(
-            "pp×MoE composes with dp and ep only: the stage body's manual "
-            "expert collectives assume unsharded heads (tp=1) and "
-            "full-sequence routing (sp=1 — the capacity cumsum runs over "
-            "the whole sequence)"
+            "pp×MoE composes with dp/ep/tp, not sp: routing's capacity "
+            "cumsum runs over the whole sequence, which a seq-sharded "
+            "stage body cannot compute locally"
         )
     if is_moe and cfg.n_experts % mesh.shape["expert"]:
         raise ValueError(
@@ -402,7 +411,7 @@ def make_pipelined_forward(
         if is_moe:
             def run_body(chunk, x_in, freqs, mask):
                 return _moe_stage_body(
-                    chunk, x_in, local_cfg, freqs, mask, attn_impl
+                    chunk, x_in, local_cfg, freqs, mask, tp, attn_impl
                 )
         else:
             def run_body(chunk, x_in, freqs, mask):

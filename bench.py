@@ -79,11 +79,12 @@ print(f"KERNEL_OK {getattr(dev, 'device_kind', dev.platform)}")
 
 
 def _percentiles(samples_ms: list[float]) -> tuple[float, float]:
+    """(p50, p99) via the shared nearest-rank formula, so BENCH and soak
+    records stay directly comparable (tpumon.tools.measure)."""
+    from tpumon.tools.measure import quantile
+
     s = sorted(samples_ms)
-    return (
-        s[len(s) // 2],
-        s[max(int(len(s) * 0.99) - 1, 0)],
-    )
+    return (quantile(s, 0.5), quantile(s, 0.99))
 
 
 def measure_http_client(port: int, scrapes: int = SCRAPES) -> tuple[float, float]:
@@ -92,9 +93,11 @@ def measure_http_client(port: int, scrapes: int = SCRAPES) -> tuple[float, float
 
     conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
     try:
+        from tpumon.tools.measure import PAGE_SENTINEL
+
         conn.request("GET", "/metrics")
         body = conn.getresponse().read()  # warm + sanity
-        assert b"accelerator_duty_cycle_percent" in body, "families missing"
+        assert PAGE_SENTINEL in body, "families missing"
         samples = []
         for _ in range(scrapes):
             t0 = time.perf_counter()
@@ -143,8 +146,10 @@ def measure_raw_socket(port: int, scrapes: int = SCRAPES) -> tuple[float, float]
         return body
 
     try:
+        from tpumon.tools.measure import PAGE_SENTINEL
+
         body = scrape()  # warm + sanity
-        assert b"accelerator_duty_cycle_percent" in body, "families missing"
+        assert PAGE_SENTINEL in body, "families missing"
         samples = []
         for _ in range(scrapes):
             t0 = time.perf_counter()

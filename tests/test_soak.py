@@ -101,3 +101,18 @@ def test_poller_thread_survives_poisoned_backend():
         assert exp.telemetry.polls._value.get() > polls_before  # still polling
     finally:
         exp.close()
+
+
+def test_soak_tool_smoke():
+    """The wall-clock soak tool (tpumon.tools.soak) completes a short
+    window and reports a coherent record: real scrapes, clean pages,
+    zero collector errors, poll cycles advancing."""
+    from tpumon.tools.soak import soak
+
+    rec = soak(duration_s=3.0, scrape_every_s=0.2, topology="v4-8",
+               interval=0.2)
+    assert rec["scrapes"] >= 10
+    assert rec["bad_pages"] == 0
+    assert rec["p50_ms"] > 0 and rec["max_ms"] >= rec["p99_ms"] >= rec["p50_ms"]
+    assert rec["collector_errors"] == {"backend": 0.0, "parse": 0.0}
+    assert rec["poll_cycles"] and rec["poll_cycles"] > 1

@@ -1,0 +1,18 @@
+"""Shared measurement helpers for the latency bench and the soak tool.
+
+One definition of the quantile formula and the page-sanity sentinel so
+BENCH_r*.json and soak records stay directly comparable (two drifting
+copies would make their p99 figures subtly different statistics).
+"""
+
+from __future__ import annotations
+
+#: A family guaranteed present on any fake-topology exposition page; its
+#: absence means the scrape returned a truncated or wrong page.
+PAGE_SENTINEL = b"accelerator_duty_cycle_percent"
+
+
+def quantile(sorted_samples: list[float], q: float) -> float:
+    """Nearest-rank quantile over an ascending-sorted non-empty list."""
+    n = len(sorted_samples)
+    return sorted_samples[min(max(int(n * q) - 1, 0), n - 1)]

@@ -32,24 +32,19 @@ def soak(
     scrape_every_s: float = 1.0,
     topology: str = "v5p-64",
     interval: float = 1.0,
+    backend: str = "fake",
 ) -> dict:
+    """``backend="fake"`` soaks the synthetic v5p topology (the bench's
+    configuration); any other value is a Config backend selection —
+    ``auto``/``libtpu`` soak the REAL monitoring SDK on a TPU host,
+    which answers even when the compute tunnel is wedged (the two
+    surfaces are independent; observed live in rounds 4 and 5)."""
     from tpumon.backends.fake import FakeTpuBackend
     from tpumon.config import Config
     from tpumon.exporter.server import build_exporter
 
     if duration_s <= 0:
         raise ValueError(f"duration must be > 0 seconds, got {duration_s}")
-
-    # Mirror the daemon entrypoint's scrape-tail tuning, same opt-out
-    # (exporter/main.py): without it the poll cycle can hold a scrape
-    # thread for the default 5 ms GIL switch interval — measured p99
-    # 13 ms untuned vs 6.6 ms tuned over 45-minute soaks on the v5p-64
-    # fake topology. Applied here (not at import) and restored on exit,
-    # so neither importers nor embedding test processes keep the
-    # mutated interpreter setting.
-    prev_switch = sys.getswitchinterval()
-    if not os.environ.get("TPUMON_KEEP_SWITCH_INTERVAL"):
-        sys.setswitchinterval(min(prev_switch, 0.001))
 
     try:
         import psutil
@@ -58,27 +53,52 @@ def soak(
     except ImportError:  # RSS tracking is auxiliary; degrade like host.py
         rss_of = None
 
-    backend = FakeTpuBackend.preset(topology)
-    exporter = build_exporter(
-        Config(port=0, addr="127.0.0.1", interval=interval), backend
-    )
-    exporter.start()
-    conn = http.client.HTTPConnection(
-        "127.0.0.1", exporter.server.port, timeout=10
-    )
+    # Everything that can fail on bad arguments happens BEFORE the
+    # switch-interval mutation below, so an invalid topology/backend
+    # leaves the caller's interpreter settings untouched.
+    if backend == "fake":
+        cfg = Config(port=0, addr="127.0.0.1", interval=interval)
+        exporter = build_exporter(cfg, FakeTpuBackend.preset(topology))
+    else:
+        cfg = Config(
+            port=0, addr="127.0.0.1", interval=interval, backend=backend
+        )
+        exporter = build_exporter(cfg)  # create_backend resolves it
 
+    # On a real idle host the data families are absent by design (runtime
+    # detached — SURVEY §2.2), so page integrity is judged by an identity
+    # family that must always be present instead.
+    sentinel = (
+        PAGE_SENTINEL if backend == "fake" else b"accelerator_device_count"
+    )
     lat_ms: list[float] = []
     rss: list[float] = []
     bad_pages = 0
-    t0 = time.time()
-    next_at = t0
+    conn = None
+    # Mirror the daemon entrypoint's scrape-tail tuning, same opt-out
+    # (exporter/main.py): without it the poll cycle can hold a scrape
+    # thread for the default 5 ms GIL switch interval — measured p99
+    # 13 ms untuned vs 6.6 ms tuned over 45-minute soaks on the v5p-64
+    # fake topology. Applied here (not at import) and restored in the
+    # finally below alongside exporter shutdown, so neither importers
+    # nor embedding test processes keep the mutated setting even when
+    # startup or the soak loop fails.
+    prev_switch = sys.getswitchinterval()
     try:
+        if not os.environ.get("TPUMON_KEEP_SWITCH_INTERVAL"):
+            sys.setswitchinterval(min(prev_switch, 0.001))
+        exporter.start()
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", exporter.server.port, timeout=10
+        )
+        t0 = time.time()
+        next_at = t0
         while time.time() - t0 < duration_s:
             s = time.perf_counter()
             conn.request("GET", "/metrics")
             body = conn.getresponse().read()
             lat_ms.append((time.perf_counter() - s) * 1e3)
-            if PAGE_SENTINEL not in body:
+            if sentinel not in body:
                 bad_pages += 1
             if rss_of is not None and len(lat_ms) % 300 == 1:
                 rss.append(round(rss_of().rss / 1e6, 1))
@@ -92,7 +112,8 @@ def soak(
             r'^collector_errors_total\{kind="(\w+)"\} (\S+)', page, re.M
         )
     finally:
-        conn.close()
+        if conn is not None:
+            conn.close()
         exporter.close()
         sys.setswitchinterval(prev_switch)
 
@@ -116,14 +137,23 @@ def main(argv=None) -> int:
     parser.add_argument("--duration", type=float, default=2700.0,
                         help="soak length in seconds (default 45 min)")
     parser.add_argument("--scrape-every", type=float, default=1.0)
-    parser.add_argument("--topology", default="v5p-64")
+    parser.add_argument("--topology", default="v5p-64",
+                        help="fake-backend topology preset")
     parser.add_argument("--interval", type=float, default=1.0,
                         help="exporter poll interval")
+    from tpumon.config import BACKEND_CHOICES
+
+    parser.add_argument("--backend", default="fake",
+                        choices=BACKEND_CHOICES,
+                        help="'fake' (synthetic --topology preset) or a "
+                        "real backend selection — 'auto'/'libtpu' soak "
+                        "the real monitoring SDK on a TPU host")
     args = parser.parse_args(argv)
     if args.duration <= 0:
         parser.error("--duration must be > 0")
     print(json.dumps(soak(
-        args.duration, args.scrape_every, args.topology, args.interval
+        args.duration, args.scrape_every, args.topology, args.interval,
+        args.backend,
     )))
     return 0
 

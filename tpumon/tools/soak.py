@@ -119,6 +119,10 @@ def soak(
 
     lat_ms.sort()
     return {
+        # The *resolved* backend, not the requested one: --backend auto
+        # can fall back to stub, and soak evidence must say which SDK it
+        # actually exercised.
+        "backend": exporter.backend.name,
         "scrapes": len(lat_ms),
         "duration_s": round(time.time() - t0, 1),
         "p50_ms": round(quantile(lat_ms, 0.5), 3),

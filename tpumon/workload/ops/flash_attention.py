@@ -263,34 +263,43 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale, block_q, block_k, n_qb, group,
-                causal):
-    """One (batch, kv-head, k-block) program.
+                dk_ref, dv_ref, *, scale, block_q, block_k, causal):
+    """One (batch, kv-head, k-block, q-head-in-group, q-block) program.
 
-    Streams q-blocks and the ``group`` q-heads sharing this kv-head,
-    accumulating dK/dV for the block — the GQA head-group sum happens here
-    instead of in a scatter-add epilogue.
+    The GQA group sum and the q-block stream live in the *grid*, not in
+    in-kernel loops over VMEM-resident whole-sequence bands: dk/dv output
+    blocks are revisited across the two inner grid dims (their index map
+    ignores g and qb), so Mosaic keeps the f32 accumulator resident in
+    VMEM and this kernel only ever holds O(block_q·D + block_k·D) —
+    the whole-band layout needed group·S·(2D+2·_LANES·2) bytes and
+    vmem-OOM'd at medium-preset shapes (48.5M vs the 16M scoped limit,
+    observed live on TPU v5 lite at group=4, S=4096).
     """
     ki = pl.program_id(2)
-    k = k_ref[0, 0].astype(jnp.float32)  # [block_k, D]
-    v = v_ref[0, 0].astype(jnp.float32)
-    D = k.shape[-1]
-    col = ki * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1
-    )
+    g = pl.program_id(3)
+    qb = pl.program_id(4)
 
-    def q_body(qb, carry):
-        dk, dv, g = carry
-        q = q_ref[0, g, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, g, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, g, pl.ds(qb * block_q, block_q), :][:, :1]
-        delta = delta_ref[0, g, pl.ds(qb * block_q, block_q), :][:, :1]
+    @pl.when(jnp.logical_and(g == 0, qb == 0))
+    def _init():
+        dk_ref[...] = jnp.zeros(dk_ref.shape, dk_ref.dtype)
+        dv_ref[...] = jnp.zeros(dv_ref.shape, dv_ref.dtype)
+
+    def compute():
+        k = k_ref[0, 0].astype(jnp.float32)  # [block_k, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0].astype(jnp.float32)  # [block_q, D]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]    # lane-broadcast → [block_q, 1]
+        delta = delta_ref[0, 0][:, :1]
         row = qb * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
         )
+        col = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
         p = _recompute_p(q, k, scale=scale, lse_blk=lse, row=row, col=col,
                          causal=causal)
-        dv = dv + jax.lax.dot_general(
+        dv_ref[0, 0] += jax.lax.dot_general(
             p, do, dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -298,27 +307,21 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do, v, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta)
-        dk = dk + jax.lax.dot_general(
+        # dK = scale·dSᵀQ; scale folded into dS so the accumulator needs
+        # no epilogue pass (output blocks flush when the k-block advances).
+        ds = p * (dp - delta) * scale
+        dk_ref[0, 0] += jax.lax.dot_general(
             ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return dk, dv, g
 
-    def g_body(g, carry):
-        dk, dv = carry
-        if causal:
-            # First q-block that reaches this k-block's causal triangle.
-            lo = jax.lax.div(ki * block_k, block_q)
-        else:
-            lo = 0
-        dk, dv, _ = jax.lax.fori_loop(lo, n_qb, q_body, (dk, dv, g))
-        return dk, dv
-
-    z = jnp.zeros((block_k, D), jnp.float32)
-    dk, dv = jax.lax.fori_loop(0, group, g_body, (z, z))
-    dk_ref[0, 0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+    if causal:
+        # Skip q-blocks strictly above this k-block's causal triangle
+        # (max row of qb < min col of ki ⇒ fully masked). Their grid
+        # steps still fetch blocks, but pay no FLOPs.
+        pl.when((qb + 1) * block_q - 1 >= ki * block_k)(compute)
+    else:
+        compute()
 
 
 def _flash_bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret,
@@ -358,26 +361,48 @@ def _flash_bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret,
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
-    # dK/dV: one program per (batch, kv-head, k-block); q/do/lse/delta come
-    # in as the whole ``group`` q-head band so the GQA sum stays in-kernel.
-    band = pl.BlockSpec((1, group, S, D), lambda b, h, i: (b, h, 0, 0))
-    band_row = pl.BlockSpec((1, group, S, _LANES), lambda b, h, i: (b, h, 0, 0))
-    k_blk = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i: (b, h, i, 0))
+    # dK/dV: grid (batch, kv-head, k-block, q-head-in-group, q-block).
+    # The dk/dv index maps ignore the two inner dims, so the f32
+    # accumulator block stays VMEM-resident across the GQA group and the
+    # q-block stream — O(block) VMEM (the whole-band layout OOM'd the
+    # 16M scoped limit at medium shapes; see _dkv_kernel docstring).
+    if causal:
+        # Clamp masked q-block steps (qb strictly above the k-block's
+        # causal triangle) onto the first active block: their index map
+        # then re-references the already-resident block, so the skipped
+        # steps pay no DMA either (the kernel already skips their FLOPs).
+        def _qj(i, j):
+            return jnp.maximum(j, (i * block_k) // block_q)
+    else:
+        def _qj(i, j):
+            return j
+
+    q_by_g = pl.BlockSpec(
+        (1, 1, block_q, D),
+        lambda b, h, i, g, j: (b, h * group + g, _qj(i, j), 0),
+    )
+    row_by_g = pl.BlockSpec(
+        (1, 1, block_q, _LANES),
+        lambda b, h, i, g, j: (b, h * group + g, _qj(i, j), 0),
+    )
+    kv_blk = pl.BlockSpec(
+        (1, 1, block_k, D), lambda b, h, i, g, j: (b, h, i, 0)
+    )
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, block_q=block_q, block_k=block_k,
-            n_qb=S // block_q, group=group, causal=causal,
+            causal=causal,
         ),
-        grid=(B, KV, Sk // block_k),
-        in_specs=[band, k_blk, k_blk, band, band_row, band_row],
-        out_specs=[k_blk, k_blk],
+        grid=(B, KV, Sk // block_k, group, S // block_q),
+        in_specs=[q_by_g, kv_blk, kv_blk, q_by_g, row_by_g, row_by_g],
+        out_specs=[kv_blk, kv_blk],
         out_shape=[
-            jax.ShapeDtypeStruct((B, KV, Sk, D), k.dtype),
-            jax.ShapeDtypeStruct((B, KV, Sk, D), v.dtype),
+            jax.ShapeDtypeStruct((B, KV, Sk, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, KV, Sk, D), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
-    return dq, dk, dv
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -17,6 +17,16 @@ workload-side view quantitatively correlatable with the exporter's
 ``accelerator_collective_latency_microseconds`` (BASELINE config 4
 pairs link bandwidth with these counters): both describe the same
 fabric traffic, one from inside the process, one from the node.
+
+Live capture attempt (2026-07-31, ``harness --hlo-raw-dump`` training
+on this host's real TPU v5 lite): registration succeeds but **zero
+events are delivered** — on a dev host whose chip is reached through
+the axon dispatch tunnel, the runtime (and its logger) lives off-host,
+exactly like ``tpumonitoring.get_metric(...).data() == []`` on the same
+host (BASELINE.md config 4 note). The regex fixtures in
+``tests/test_hlo_counters.py`` therefore remain the spec for the
+payload shapes until a run on a GKE TPU VM (runtime on-host) can dump
+real payloads via ``--hlo-raw-dump``.
 """
 
 from __future__ import annotations

@@ -40,6 +40,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 # Finite stand-in for -inf (same constant as parallel.ring): masked logits
 # underflow to exp(x - m) == 0 without ever forming inf - inf.
@@ -77,22 +78,37 @@ def _pick_block(size: int, requested: int) -> int:
 #: conservative 128×128 (always VMEM-safe).
 #: The full fwd+bwd sweep across seq 1k–8k (2026-07-31, TPU v5 lite)
 #: measured 256×512 best or within noise of best at every length
-#: ≥ 1024 — one row covers them all (seq 4096 fwd 5.53 ms vs 10.77 at
-#: 128×128; seq 8192 fwd+bwd 15.6 vs 51.0).
+#: ≥ 1024 — one row covers that whole resident-layout regime (seq 4096
+#: fwd 5.53 ms vs 10.77 at 128×128; seq 8192 fwd+bwd 15.6 vs 51.0).
 _TUNED_BLOCKS: tuple[tuple[int, tuple[int, int]], ...] = (
     (1024, (256, 512)),
 )
 
+#: In the streamed regime (K/V bands no longer VMEM-resident — see
+#: _kv_fits_resident) much larger k-blocks win: 512×2048 runs the
+#: seq-16384 forward 2.2× faster than 256×512 (23.0 vs 50.2 ms) and
+#: sustains 214 full-S² TFLOP/s at seq 32768; 4096-wide k-blocks OOM
+#: the backward's scoped VMEM. These tiles were measured only with the
+#: streamed layout, so the chooser keys on the *layout*, not on seq_k
+#: alone (seq 16384 at head_dim 64 stays resident and keeps 256×512).
+_STREAMED_BLOCKS: tuple[int, int] = (512, 2048)
 
-def default_blocks(seq_q: int, seq_k: int) -> tuple[int, int]:
+
+def default_blocks(
+    seq_q: int, seq_k: int, head_dim: int = 128, itemsize: int = 2
+) -> tuple[int, int]:
     """Tuned (block_q, block_k) for this problem size.
 
-    Looked up from :data:`_TUNED_BLOCKS` by the key-side length (the
-    k-block loop is where the sweep showed the win); callers passing
-    explicit blocks bypass this entirely. ``_pick_block`` still clamps
-    the choice to divisors of the actual lengths, so small or ragged
-    shapes (ring stripes, rectangular composition) stay legal.
+    Looked up by the key-side length (the k-block loop is where the
+    sweep showed the win) within the kernel layout the shape selects —
+    ``head_dim``/``itemsize`` determine whether the K/V bands stay
+    VMEM-resident (defaults match the benchmarked GQA shapes). Callers
+    passing explicit blocks bypass this entirely. ``_pick_block`` still
+    clamps the choice to divisors of the actual lengths, so small or
+    ragged shapes (ring stripes, rectangular composition) stay legal.
     """
+    if not _kv_fits_resident(seq_k, head_dim, itemsize):
+        return _STREAMED_BLOCKS
     for min_k, blocks in _TUNED_BLOCKS:
         if seq_k >= min_k:
             return blocks
@@ -104,42 +120,97 @@ def default_blocks(seq_q: int, seq_k: int) -> tuple[int, int]:
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_q,
-                block_k, n_kb, causal):
-    """One (batch, head, q-block) program: stream k-blocks, online softmax."""
+# Two forward/dq kernel layouts, selected per problem size (measured on
+# TPU v5 lite, BASELINE.md "resident vs streamed"):
+#
+# - **resident**: whole [Sk, D] K/V bands live in VMEM per (batch, head)
+#   program; the k-block loop streams from VMEM. Fastest — K/V is
+#   fetched from HBM exactly once per (b, h) — but the bands
+#   (2 arrays × 2 DMA buffers × Sk × D × 2 B) outgrow the ~16 MB scoped
+#   VMEM limit around Sk ≈ 10 k at head_dim 128.
+# - **streamed**: k-blocks advance through the innermost grid dim with
+#   the softmax state in persistent scratch; O(block) VMEM at any Sk,
+#   but each q-block re-fetches its K/V stripe from HBM (measured 2.2×
+#   slower at seq 8192, entirely accounted by the extra HBM traffic).
+#
+# The crossover is purely a VMEM-capacity cliff, so selection is by
+# band size, not by timing.
+_RESIDENT_KV_BYTES = 10 * 2 ** 20
+
+
+def _kv_fits_resident(Sk: int, D: int, itemsize: int) -> bool:
+    """Whether the resident layout's K/V bands (two arrays, double-
+    buffered) fit the scoped-VMEM budget."""
+    return 2 * 2 * Sk * D * itemsize <= _RESIDENT_KV_BYTES
+
+
+def _causal_kj(block_q, block_k, causal):
+    """Streamed-layout k-block index clamp.
+
+    For causal problems, grid steps whose k-block lies fully above the
+    diagonal re-reference the diagonal block (already resident — no
+    DMA); the kernels skip the same steps' FLOPs with the matching
+    ``pl.when((qi + 1) * block_q - 1 >= kj * block_k)`` guard. One
+    helper serves the forward and dq call sites so the clamp and the
+    skip cannot drift apart."""
+    if not causal:
+        return lambda i, j: j
+    return lambda i, j: jnp.minimum(j, (i * block_q + block_q - 1) // block_k)
+
+
+def _online_softmax_step(q, k, v, qi_row, kb_col, m, l, acc, *, block_q,
+                         block_k, causal):
+    """One k-block update of the online-softmax state — the single home
+    of the numerically sensitive core (masking constant, exp rescaling,
+    accumulation dtypes) shared by the resident and streamed forward
+    kernels.
+
+    ``q`` is pre-scaled f32 [block_q, D]; ``k``/``v`` raw blocks;
+    ``qi_row``/``kb_col`` the block-origin row/col offsets (ignored when
+    not causal); ``(m, l)`` f32 [block_q, 1]; ``acc`` f32 [block_q, D].
+    """
+    s = jax.lax.dot_general(
+        q, k.astype(jnp.float32),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [block_q, block_k]
+    if causal:
+        row = qi_row + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        col = kb_col + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(row >= col, s, _NEG_BIG)
+
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l_new, acc * alpha + pv
+
+
+def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
+                         block_q, block_k, n_kb, causal):
+    """One (batch, head, q-block) program: k-blocks stream from the
+    VMEM-resident K/V band, softmax state carried in registers."""
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32) * scale  # [block_q, D]
     D = q.shape[-1]
-
-    row = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0
-    )
 
     def body(kb, carry):
         m, l, acc = carry
         k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]  # [block_k, D]
         v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            q, k.astype(jnp.float32),
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [block_q, block_k]
-        if causal:
-            col = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(row >= col, s, _NEG_BIG)
-
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        return _online_softmax_step(
+            q, k, v, qi * block_q, kb * block_k, m, l, acc,
+            block_q=block_q, block_k=block_k, causal=causal,
         )
-        return m_new, l, acc * alpha + pv
 
     if causal:
         # Last k-block that overlaps the causal triangle of this q-block.
@@ -158,13 +229,64 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_q,
     lse_ref[0, 0] = jnp.broadcast_to(m + jnp.log(l), (block_q, _LANES))
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _fwd_kernel_streamed(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                         acc_ref, *, scale, block_q, block_k, n_kb, causal):
+    """One (batch, head, q-block, k-block) program: online softmax with the
+    k-block stream in the *grid* and the running (m, l, acc) state in VMEM
+    scratch, which persists across grid steps on TPU.
+
+    Streaming k-blocks through the grid instead of holding the whole
+    [Sk, D] K/V in VMEM caps this kernel's footprint at O(block) for any
+    sequence length — the resident layout's bands outgrow the 16 M
+    scoped-vmem limit at seq 16384 (2×8 MB of K/V double-buffered).
+    """
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[...] = jnp.full(m_ref.shape, _NEG_BIG, jnp.float32)
+        l_ref[...] = jnp.zeros(l_ref.shape, jnp.float32)
+        acc_ref[...] = jnp.zeros(acc_ref.shape, jnp.float32)
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale  # [block_q, D]
+        m = m_ref[...][:, :1]  # lane-broadcast scratch → [block_q, 1]
+        l = l_ref[...][:, :1]
+        m_new, l_new, acc_new = _online_softmax_step(
+            q, k_ref[0, 0], v_ref[0, 0], qi * block_q, kj * block_k,
+            m, l, acc_ref[...],
+            block_q=block_q, block_k=block_k, causal=causal,
+        )
+        acc_ref[...] = acc_new
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    if causal:
+        # k-blocks fully above the diagonal contribute nothing: skip
+        # their FLOPs here; their DMA is skipped by the index-map clamp
+        # in _flash_fwd (they re-reference the diagonal block).
+        pl.when(qi * block_q + block_q - 1 >= kj * block_k)(compute)
+    else:
+        compute()
+
+    @pl.when(kj == n_kb - 1)
+    def _done():
+        l = l_ref[...][:, :1]
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        # log-sum-exp per row (the flash backward's softmax residual),
+        # lane-broadcast so the block stays tileable.
+        lse_ref[0, 0] = m_ref[...] + jnp.log(l_ref[...])
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, resident=None):
     """q [B,H,S,D], k/v [B,KV,Sk,D] → (out [B,H,S,D], lse [B,H,S,LANES] f32).
 
     Sk may differ from S only when ``causal=False`` (rectangular
     attention — the blockwise/ring composition attends one q stripe to a
     different-length key stripe); causal masking is only meaningful when
     query and key positions share an origin, i.e. Sk == S.
+    ``resident=None`` auto-selects the kernel layout by K/V band size.
     """
     B, H, S, D = q.shape
     KV, Sk = k.shape[1], k.shape[2]
@@ -177,26 +299,61 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     block_k = _pick_block(Sk, block_k)
     n_kb = Sk // block_k
     scale = 1.0 / (D ** 0.5)
+    if resident is None:
+        resident = _kv_fits_resident(Sk, D, k.dtype.itemsize)
 
-    kv_spec = pl.BlockSpec((1, 1, Sk, D), lambda b, h, i: (b, (h * KV) // H, 0, 0))
+    q_spec3 = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0))
+    out_shape = [
+        jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        jax.ShapeDtypeStruct((B, H, S, _LANES), jnp.float32),
+    ]
+    if resident:
+        kv_band = pl.BlockSpec(
+            (1, 1, Sk, D), lambda b, h, i: (b, (h * KV) // H, 0, 0)
+        )
+        return pl.pallas_call(
+            functools.partial(
+                _fwd_kernel_resident, scale=scale, block_q=block_q,
+                block_k=block_k, n_kb=n_kb, causal=causal,
+            ),
+            grid=(B, H, S // block_q),
+            in_specs=[q_spec3, kv_band, kv_band],
+            out_specs=[
+                q_spec3,
+                pl.BlockSpec(
+                    (1, 1, block_q, _LANES), lambda b, h, i: (b, h, i, 0)
+                ),
+            ],
+            out_shape=out_shape,
+            interpret=interpret,
+        )(q, k, v)
+
+    # Streamed: k-blocks in the innermost grid dim, softmax state in
+    # persistent scratch (causal DMA clamp: _causal_kj).
+    _kj = _causal_kj(block_q, block_k, causal)
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_k, D),
+        lambda b, h, i, j: (b, (h * KV) // H, _kj(i, j), 0),
+    )
+    q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
     out, lse = pl.pallas_call(
         functools.partial(
-            _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k,
-            n_kb=n_kb, causal=causal,
+            _fwd_kernel_streamed, scale=scale, block_q=block_q,
+            block_k=block_k, n_kb=n_kb, causal=causal,
         ),
-        grid=(B, H, S // block_q),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            kv_spec,
-            kv_spec,
-        ],
+        grid=(B, H, S // block_q, n_kb),
+        in_specs=[q_spec, kv_spec, kv_spec],
         out_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, _LANES), lambda b, h, i: (b, h, i, 0)),
+            q_spec,
+            pl.BlockSpec(
+                (1, 1, block_q, _LANES), lambda b, h, i, j: (b, h, i, 0)
+            ),
         ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, S, _LANES), jnp.float32),
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # denominator l
+            pltpu.VMEM((block_q, D), jnp.float32),       # weighted acc
         ],
         interpret=interpret,
     )(q, k, v)
@@ -225,8 +382,11 @@ def _recompute_p(q, k, lse_blk, scale, row, col, causal):
     return jnp.exp(s - lse_blk)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               scale, block_q, block_k, n_kb, causal):
+def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dq_ref, *, scale, block_q, block_k, n_kb, causal):
+    """One (batch, head, q-block) program; k-blocks stream from the
+    VMEM-resident K/V band (fast path, Sk-bounded — see the layout note
+    above _kv_fits_resident)."""
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
@@ -262,6 +422,51 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         hi = n_kb
     dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, D), jnp.float32))
     dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dq_kernel_streamed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dq_ref, *, scale, block_q, block_k, causal):
+    """One (batch, head, q-block, k-block) program; dq accumulates in the
+    revisited f32 output block (its index map ignores the k dim), so
+    VMEM holds O(block) for any Sk — same restructure as _dkv_kernel."""
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_ref[...] = jnp.zeros(dq_ref.shape, dq_ref.dtype)
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]    # lane-broadcast → [block_q, 1]
+        delta = delta_ref[0, 0][:, :1]
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        row = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        col = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        p = _recompute_p(q, k, lse, scale, row, col, causal)
+        dp = jax.lax.dot_general(
+            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # dQ = scale·dS K with scale folded into dS (no epilogue pass).
+        ds = p * (dp - delta) * scale
+        dq_ref[0, 0] += jax.lax.dot_general(
+            ds, k, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        # Masked k-blocks skip FLOPs here and DMA via the index-map
+        # clamp in _flash_bwd.
+        pl.when(qi * block_q + block_q - 1 >= kj * block_k)(compute)
+    else:
+        compute()
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -327,7 +532,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret,
-               g_lse=None):
+               g_lse=None, resident=None):
     B, H, S, D = q.shape
     KV, Sk = k.shape[1], k.shape[2]
     group = H // KV
@@ -348,20 +553,57 @@ def _flash_bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret,
         delta_rows = delta_rows - g_lse.astype(jnp.float32)[..., None]
     delta = jnp.broadcast_to(delta_rows, (B, H, S, _LANES))
 
-    kv_spec = pl.BlockSpec((1, 1, Sk, D), lambda b, h, i: (b, (h * KV) // H, 0, 0))
-    q_blk = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0))
-    row_blk = pl.BlockSpec((1, 1, block_q, _LANES), lambda b, h, i: (b, h, i, 0))
-    dq = pl.pallas_call(
-        functools.partial(
-            _dq_kernel, scale=scale, block_q=block_q, block_k=block_k,
-            n_kb=Sk // block_k, causal=causal,
-        ),
-        grid=(B, H, S // block_q),
-        in_specs=[q_blk, kv_spec, kv_spec, q_blk, row_blk, row_blk],
-        out_specs=q_blk,
-        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    # dQ: resident fast path when the K/V bands fit VMEM, else k-blocks
+    # stream through the innermost grid dim (same clamp trick as the
+    # forward: causally masked steps re-reference the diagonal k-block,
+    # paying neither FLOPs nor DMA).
+    if resident is None:
+        resident = _kv_fits_resident(Sk, D, k.dtype.itemsize)
+    if resident:
+        kv_band = pl.BlockSpec(
+            (1, 1, Sk, D), lambda b, h, i: (b, (h * KV) // H, 0, 0)
+        )
+        q_blk3 = pl.BlockSpec(
+            (1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)
+        )
+        row_blk3 = pl.BlockSpec(
+            (1, 1, block_q, _LANES), lambda b, h, i: (b, h, i, 0)
+        )
+        dq = pl.pallas_call(
+            functools.partial(
+                _dq_kernel_resident, scale=scale, block_q=block_q,
+                block_k=block_k, n_kb=Sk // block_k, causal=causal,
+            ),
+            grid=(B, H, S // block_q),
+            in_specs=[q_blk3, kv_band, kv_band, q_blk3, row_blk3, row_blk3],
+            out_specs=q_blk3,
+            out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+            interpret=interpret,
+        )(q, k, v, do, lse, delta)
+    else:
+        _kj = _causal_kj(block_q, block_k, causal)
+        kv_spec = pl.BlockSpec(
+            (1, 1, block_k, D),
+            lambda b, h, i, j: (b, (h * KV) // H, _kj(i, j), 0),
+        )
+        q_blk = pl.BlockSpec(
+            (1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)
+        )
+        row_blk = pl.BlockSpec(
+            (1, 1, block_q, _LANES), lambda b, h, i, j: (b, h, i, 0)
+        )
+        dq = pl.pallas_call(
+            functools.partial(
+                _dq_kernel_streamed, scale=scale, block_q=block_q,
+                block_k=block_k, causal=causal,
+            ),
+            grid=(B, H, S // block_q, Sk // block_k),
+            in_specs=[q_blk, kv_spec, kv_spec, q_blk, row_blk, row_blk],
+            out_specs=q_blk,
+            out_shape=jax.ShapeDtypeStruct((B, H, S, D), jnp.float32),
+            interpret=interpret,
+        )(q, k, v, do, lse, delta)
+        dq = dq.astype(q.dtype)
 
     # dK/dV: grid (batch, kv-head, k-block, q-head-in-group, q-block).
     # The dk/dv index maps ignore the two inner dims, so the f32
@@ -412,23 +654,29 @@ def _flash_bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_lse(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, causal, block_q, block_k, interpret, resident):
+    out, lse = _flash_fwd(
+        q, k, v, causal, block_q, block_k, interpret, resident
+    )
     return out, lse[..., 0]
 
 
-def _flash_lse_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+def _flash_lse_vjp_fwd(q, k, v, causal, block_q, block_k, interpret,
+                       resident):
+    out, lse = _flash_fwd(
+        q, k, v, causal, block_q, block_k, interpret, resident
+    )
     return (out, lse[..., 0]), (q, k, v, out, lse)
 
 
-def _flash_lse_vjp_bwd(causal, block_q, block_k, interpret, res, g):
+def _flash_lse_vjp_bwd(causal, block_q, block_k, interpret, resident, res,
+                       g):
     q, k, v, out, lse = res
     g_out, g_lse = g
     return _flash_bwd(
         q, k, v, out, lse, g_out, causal, block_q, block_k, interpret,
-        g_lse=g_lse,
+        g_lse=g_lse, resident=resident,
     )
 
 
@@ -444,6 +692,7 @@ def flash_attention(
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
+    resident: bool | None = None,
 ) -> jnp.ndarray:
     """Flash attention over [B, S, H, D] tensors (model layout).
 
@@ -454,12 +703,16 @@ def flash_attention(
     ``block_q``/``block_k`` default to the measured tuned tiles for the
     problem size (:func:`default_blocks`); pass explicit values to
     override (tiling experiments, VMEM-constrained compositions).
+    ``resident=None`` auto-selects the forward/dq kernel layout —
+    VMEM-resident K/V bands (fast) when they fit, grid-streamed
+    k-blocks (any length) beyond — by band size; pass a bool to force
+    one (tests, experiments).
     """
     # One custom-vjp path serves both public entry points: with lse
     # unused its cotangent is zero and the backward's Δ fold is a no-op.
     out, _ = flash_attention_with_lse(
         q, k, v, causal=causal, block_q=block_q, block_k=block_k,
-        interpret=interpret,
+        interpret=interpret, resident=resident,
     )
     return out
 
@@ -473,6 +726,7 @@ def flash_attention_with_lse(
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
+    resident: bool | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Flash attention returning ``(out [B,S,H,D], lse [B,H,S] f32)``.
 
@@ -495,14 +749,16 @@ def flash_attention_with_lse(
     if H % KV:
         raise ValueError(f"q heads ({H}) must be a multiple of kv heads ({KV})")
     if block_q is None or block_k is None:
-        tuned_q, tuned_k = default_blocks(S, k.shape[1])
+        tuned_q, tuned_k = default_blocks(
+            S, k.shape[1], head_dim=D, itemsize=k.dtype.itemsize
+        )
         block_q = tuned_q if block_q is None else block_q
         block_k = tuned_k if block_k is None else block_k
     out, lse = _flash_lse(
         q.transpose(0, 2, 1, 3),
         k.transpose(0, 2, 1, 3),
         v.transpose(0, 2, 1, 3),
-        causal, block_q, block_k, interpret,
+        causal, block_q, block_k, interpret, resident,
     )
     return out.transpose(0, 2, 1, 3), lse
 

@@ -57,15 +57,16 @@ def test_default_blocks_chooser():
     assert default_blocks(4096, 1024) == (256, 512)  # keyed on seq_k
     assert default_blocks(512, 512) == (128, 128)
     assert default_blocks(64, 64) == (128, 128)
-    # Streamed regime (K/V bands no longer VMEM-resident): measured
-    # 2.2× win for 512×2048 at seq 16384, 214 TFLOP/s at 32768. The
-    # streamed tiles were only measured with the streamed layout, so
-    # the chooser keys on the layout: seq 16384 at head_dim 64 stays
-    # resident (8.4 MB bands) and keeps the resident-regime tiles.
-    assert default_blocks(16384, 16384) == (512, 2048)
-    assert default_blocks(32768, 32768) == (512, 2048)
+    # Streamed regime (K/V bands no longer VMEM-resident): the 5×5
+    # sweep at seq 16384 measured 1024×1024 fastest (45.7 ms fwd+bwd
+    # vs 71.4 for 256×512), 231 TFLOP/s at 32768. The streamed tiles
+    # were only measured with the streamed layout, so the chooser keys
+    # on the layout: seq 16384 at head_dim 64 stays resident (8.4 MB
+    # bands) and keeps the resident-regime tiles.
+    assert default_blocks(16384, 16384) == (1024, 1024)
+    assert default_blocks(32768, 32768) == (1024, 1024)
     assert default_blocks(16384, 16384, head_dim=64) == (256, 512)
-    assert default_blocks(8192, 8192, itemsize=4) == (512, 2048)  # f32 K/V
+    assert default_blocks(8192, 8192, itemsize=4) == (1024, 1024)  # f32 K/V
 
 
 def test_tuned_defaults_still_match_reference():

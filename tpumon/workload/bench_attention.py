@@ -287,16 +287,27 @@ def sweep_blocks(
     """
     import jax
 
-    from tpumon.workload.ops.flash_attention import _pick_block, make_flash_attn
+    from tpumon.workload.ops.flash_attention import (
+        _kv_fits_resident, _pick_block, make_flash_attn,
+    )
 
     results = []
     for seq in seqs:
         platform, kind, seq_inner, q, k, v, attn_flops = _bench_setup(
             batch, heads, kv_heads, head_dim, seq, inner
         )
+        # In the streamed-layout regime (K/V bands past the VMEM cliff)
+        # the measured winners are much larger tiles, so the sweep grid
+        # grows to cover them (BASELINE.md "single-chip long context":
+        # square 1024×1024 tiles ranked fastest at seq 16384, 1.6×
+        # over the resident-regime 256×512). Regime prediction uses the
+        # same itemsize the kernel's own layout selection sees.
+        seq_blocks = blocks
+        if not _kv_fits_resident(seq, head_dim, k.dtype.itemsize):
+            seq_blocks = tuple(blocks) + (1024, 2048)
         seen: set = set()
-        for bq in blocks:
-            for bk in blocks:
+        for bq in seq_blocks:
+            for bk in seq_blocks:
                 eff = (_pick_block(seq, bq), _pick_block(seq, bk))
                 if eff in seen:
                     continue

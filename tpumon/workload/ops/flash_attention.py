@@ -85,13 +85,14 @@ _TUNED_BLOCKS: tuple[tuple[int, tuple[int, int]], ...] = (
 )
 
 #: In the streamed regime (K/V bands no longer VMEM-resident — see
-#: _kv_fits_resident) much larger k-blocks win: 512×2048 runs the
-#: seq-16384 forward 2.2× faster than 256×512 (23.0 vs 50.2 ms) and
-#: sustains 214 full-S² TFLOP/s at seq 32768; 4096-wide k-blocks OOM
-#: the backward's scoped VMEM. These tiles were measured only with the
+#: _kv_fits_resident) much larger square tiles win: the full 5×5 sweep
+#: at seq 16384 measured 1024×1024 fastest (45.7 ms fwd+bwd vs 71.4
+#: for 256×512 and 49.2 for 512×2048), and it sustains 231 full-S²
+#: TFLOP/s at seq 32768; 2048-wide q- or 4096-wide k-blocks OOM the
+#: backward's scoped VMEM. These tiles were measured only with the
 #: streamed layout, so the chooser keys on the *layout*, not on seq_k
 #: alone (seq 16384 at head_dim 64 stays resident and keeps 256×512).
-_STREAMED_BLOCKS: tuple[int, int] = (512, 2048)
+_STREAMED_BLOCKS: tuple[int, int] = (1024, 1024)
 
 
 def default_blocks(

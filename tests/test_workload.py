@@ -88,11 +88,18 @@ def test_loss_chunk_rejections():
         run(CFG, steps=1, batch=4, seq=32, loss_chunk=16, dp=2, sp=2)
 
 
-def test_remat_rejects_moe():
+def test_moe_remat_matches_plain():
+    """remat on the unpipelined MoE forward is numerics-preserving: the
+    layer body is recomputed in the backward, not changed (it unlocked
+    the chip-scale MoE preset at seq 4096 on hardware — the
+    dispatch/combine tensors are the model's largest activations)."""
     from tpumon.workload.models.moe import MoeConfig
 
-    with pytest.raises(ValueError, match="dense"):
-        run(MoeConfig.tiny(), steps=1, batch=2, seq=32, remat=True)
+    cfg = MoeConfig.tiny()
+    plain = run(cfg, steps=3, batch=2, seq=32, seed=5)
+    remat = run(cfg, steps=3, batch=2, seq=32, seed=5, remat=True)
+    for a, b in zip(plain.losses, remat.losses):
+        assert abs(a - b) < 1e-5, (plain.losses, remat.losses)
 
 
 def test_seq_beyond_max_seq_extends_rope():

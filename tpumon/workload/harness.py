@@ -95,7 +95,8 @@ def loss_fn(
     Accepts LlamaConfig or MoeConfig; the MoE path adds the weighted
     load-balancing auxiliary loss. ``forward_fn`` overrides the model
     forward entirely (the pipelined-forward path, parallel.pipeline).
-    ``remat`` recomputes dense-model layer activations in the backward.
+    ``remat`` recomputes layer activations in the backward (dense and
+    unpipelined-MoE forwards; the pipelined forward takes it itself).
     ``loss_chunk`` (dense model only) fuses the unembed projection into
     the loss in sequence chunks of that many tokens (:func:`_chunked_nll`).
     """
@@ -107,7 +108,8 @@ def loss_fn(
         logits, aux = out if isinstance(out, tuple) else (out, 0.0)
     elif isinstance(cfg, MoeConfig):
         logits, aux = moe_forward(
-            params, tokens[:, :-1], cfg, attn_impl, shard_acts, shard_experts
+            params, tokens[:, :-1], cfg, attn_impl, shard_acts,
+            shard_experts, remat,
         )
     else:
         if loss_chunk:
@@ -359,11 +361,6 @@ def run(
                 f"per-data-shard batch ({per_shard}) must divide by "
                 f"grad_accum ({grad_accum})"
             )
-    if remat and is_moe and pp == 1:
-        raise ValueError(
-            "remat supports the dense model and the pipelined forward "
-            "(either model); the unpipelined MoE forward does not take it"
-        )
     if loss_chunk:
         if loss_chunk < 1:
             raise ValueError(f"loss_chunk must be >= 1, got {loss_chunk}")
@@ -783,10 +780,11 @@ def main(argv: list[str] | None = None) -> int:
         )
 
     if args.model == "moe":
-        if args.preset != "tiny":
-            log.warning("--model moe only has a tiny preset; ignoring --preset %s",
-                        args.preset)
-        cfg = MoeConfig.tiny()
+        moe_presets = {"tiny": MoeConfig.tiny, "small": MoeConfig.small}
+        if args.preset not in moe_presets:
+            log.warning("--model moe has tiny/small presets; ignoring "
+                        "--preset %s", args.preset)
+        cfg = moe_presets.get(args.preset, MoeConfig.tiny)()
     else:
         cfg = {
             "tiny": LlamaConfig.tiny,

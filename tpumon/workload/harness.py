@@ -715,6 +715,16 @@ def main(argv: list[str] | None = None) -> int:
         "devices (requires --model moe)",
     )
     parser.add_argument(
+        "--capacity-factor",
+        type=float,
+        default=None,
+        help="MoE expert-capacity factor (default: the preset's, 2.0): "
+        "per-expert buffer = top_k*seq*factor/n_experts tokens. Lower "
+        "shrinks the dispatch/combine tensors (the MoE model's largest "
+        "activations and einsums) at the cost of dropping overflow "
+        "tokens from unlucky routing",
+    )
+    parser.add_argument(
         "--attn",
         choices=("xla", "flash"),
         default="xla",
@@ -820,7 +830,13 @@ def main(argv: list[str] | None = None) -> int:
             log.warning("--model moe has tiny/small presets; ignoring "
                         "--preset %s", args.preset)
         cfg = moe_presets.get(args.preset, MoeConfig.tiny)()
+        if args.capacity_factor is not None:
+            cfg = dataclasses.replace(
+                cfg, capacity_factor=args.capacity_factor
+            )
     else:
+        if args.capacity_factor is not None:
+            parser.error("--capacity-factor requires --model moe")
         cfg = {
             "tiny": LlamaConfig.tiny,
             "small": LlamaConfig.small,

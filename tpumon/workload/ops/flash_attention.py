@@ -383,6 +383,27 @@ def _recompute_p(q, k, lse_blk, scale, row, col, causal):
     return jnp.exp(s - lse_blk)
 
 
+def _recompute_ds(q, k, v, do, lse, delta, qi_row, kb_col, *, block_q,
+                  block_k, scale, causal):
+    """(P, scale·dS) for one (q-block, k-block) pair — the shared core
+    of all three backward kernels: P recomputed from the saved lse,
+    dP = dO·Vᵀ, dS = P∘(dP−Δ), with ``scale`` folded in so no kernel
+    needs an epilogue pass. All operands f32 blocks; ``lse``/``delta``
+    are [block_q, 1] columns."""
+    row = qi_row + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    col = kb_col + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    p = _recompute_p(q, k, lse, scale, row, col, causal)
+    dp = jax.lax.dot_general(
+        do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return p, p * (dp - delta) * scale
+
+
 def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                         dq_ref, *, scale, block_q, block_k, n_kb, causal):
     """One (batch, head, q-block) program; k-blocks stream from the
@@ -394,22 +415,14 @@ def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     lse = lse_ref[0, 0][:, :1]    # lane-broadcast → [block_q, 1]
     delta = delta_ref[0, 0][:, :1]
     D = q.shape[-1]
-    row = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0
-    )
 
     def body(kb, dq):
         k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        col = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
+        _, ds = _recompute_ds(
+            q, k, v, do, lse, delta, qi * block_q, kb * block_k,
+            block_q=block_q, block_k=block_k, scale=scale, causal=causal,
         )
-        p = _recompute_p(q, k, lse, scale, row, col, causal)
-        dp = jax.lax.dot_general(
-            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta)
         return dq + jax.lax.dot_general(
             ds, k, dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -422,7 +435,7 @@ def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         hi = n_kb
     dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, D), jnp.float32))
-    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
 def _dq_kernel_streamed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -438,25 +451,15 @@ def _dq_kernel_streamed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[...] = jnp.zeros(dq_ref.shape, dq_ref.dtype)
 
     def compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, :1]    # lane-broadcast → [block_q, 1]
-        delta = delta_ref[0, 0][:, :1]
         k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        row = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
+        _, ds = _recompute_ds(
+            q_ref[0, 0].astype(jnp.float32), k,
+            v_ref[0, 0].astype(jnp.float32),
+            do_ref[0, 0].astype(jnp.float32),
+            lse_ref[0, 0][:, :1], delta_ref[0, 0][:, :1],
+            qi * block_q, kj * block_k,
+            block_q=block_q, block_k=block_k, scale=scale, causal=causal,
         )
-        col = kj * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        p = _recompute_p(q, k, lse, scale, row, col, causal)
-        dp = jax.lax.dot_general(
-            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        # dQ = scale·dS K with scale folded into dS (no epilogue pass).
-        ds = p * (dp - delta) * scale
         dq_ref[0, 0] += jax.lax.dot_general(
             ds, k, dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -493,31 +496,19 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[...] = jnp.zeros(dv_ref.shape, dv_ref.dtype)
 
     def compute():
-        k = k_ref[0, 0].astype(jnp.float32)  # [block_k, D]
-        v = v_ref[0, 0].astype(jnp.float32)
         q = q_ref[0, 0].astype(jnp.float32)  # [block_q, D]
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, :1]    # lane-broadcast → [block_q, 1]
-        delta = delta_ref[0, 0][:, :1]
-        row = qb * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
+        p, ds = _recompute_ds(
+            q, k_ref[0, 0].astype(jnp.float32),
+            v_ref[0, 0].astype(jnp.float32), do,
+            lse_ref[0, 0][:, :1], delta_ref[0, 0][:, :1],
+            qb * block_q, ki * block_k,
+            block_q=block_q, block_k=block_k, scale=scale, causal=causal,
         )
-        col = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        p = _recompute_p(q, k, scale=scale, lse_blk=lse, row=row, col=col,
-                         causal=causal)
         dv_ref[0, 0] += jax.lax.dot_general(
             p, do, dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        dp = jax.lax.dot_general(
-            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        # dK = scale·dSᵀQ; scale folded into dS so the accumulator needs
-        # no epilogue pass (output blocks flush when the k-block advances).
-        ds = p * (dp - delta) * scale
         dk_ref[0, 0] += jax.lax.dot_general(
             ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,

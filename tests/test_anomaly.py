@@ -1,0 +1,422 @@
+"""Streaming anomaly engine (tpumon.anomaly) — canned 1 Hz traces.
+
+Detector-level tests replay scripted snapshots (steady / spike / flap /
+drift / stall) straight through the engine and assert event onset/clear
+timestamps and severities; the exporter-level tests run scripted fake
+-backend traces end to end and pin the ISSUE acceptance criteria: a flap
+trace onsets AND clears within 3 poll cycles of the fabric changing, a
+steady 120-cycle trace produces zero events, and the detection pass adds
+no device-backend calls to any path (poll loop only).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpumon import health
+from tpumon.anomaly import AnomalyEngine, AnomalyThresholds
+from tpumon.backends.fake import FakeTpuBackend
+from tpumon.config import Config
+from tpumon.exporter.server import build_exporter
+
+#: Short warmup so traces stay readable; everything else at defaults.
+T = AnomalyThresholds(warmup=10)
+T0 = 1_000_000.0
+
+
+def _snap(duty=80.0, hbm=0.5, links=None, queues=None, rate=4000.0, chips=2):
+    """A parsed snapshot (tpumon.smi shape) for one poll cycle."""
+    return {
+        "chips": {
+            str(c): {
+                "duty_pct": duty,
+                "hbm_used": hbm * 100.0,
+                "hbm_total": 100.0,
+            }
+            for c in range(chips)
+        },
+        "ici": {"links": dict(links or {})},
+        "queues": dict(queues or {}),
+        "network": {"delivery_rate_mbps": rate},
+    }
+
+
+def _run(engine, traces):
+    """Feed (cycle_index, snapshot) pairs; returns the last cycle index."""
+    i = -1
+    for i, snap in enumerate(traces):
+        engine.observe(T0 + i, snap)
+    return i
+
+
+class TestDetectors:
+    def test_steady_trace_no_false_positives(self):
+        """120 cycles of a steady workload (small deterministic wiggle)
+        must produce zero events from every detector."""
+        eng = AnomalyEngine(thresholds=T)
+        _run(
+            eng,
+            (
+                _snap(
+                    duty=80.0 + (i % 5) * 0.5,
+                    hbm=0.5 + (i % 3) * 0.01,
+                    links={"tray1.chip0.ici0.int": 0.0},
+                    queues={"0": float(i % 4)},
+                    rate=4000.0 + (i % 7) * 25.0,
+                )
+                for i in range(120)
+            ),
+        )
+        assert eng.summary()["total"] == 0
+        assert eng.events() == []
+        assert eng.worst_severity() == health.OK
+
+    def test_duty_collapse_onset_severity_and_clear(self):
+        eng = AnomalyEngine(thresholds=T)
+        trace = [_snap(duty=80.0) for _ in range(30)]
+        trace += [_snap(duty=0.0) for _ in range(5)]  # collapse
+        trace += [_snap(duty=80.0) for _ in range(5)]  # recovery
+        _run(eng, trace)
+        evs = [e for e in eng.events() if e["detector"] == "duty_ewma"]
+        assert len(evs) == 2  # one per chip
+        for e in evs:
+            # Onset on the first collapsed cycle (index 30), clear on the
+            # first recovered cycle (index 35) — the frozen baseline makes
+            # both exact.
+            assert e["onset_ts"] == T0 + 30
+            assert e["clear_ts"] == T0 + 35
+            assert e["severity"] == health.CRIT  # 80 -> 0 is >> z_crit
+            assert "below its baseline" in e["message"]
+
+    def test_collapse_that_persists_stays_active(self):
+        """The frozen baseline must not absorb a regime change."""
+        eng = AnomalyEngine(thresholds=T)
+        trace = [_snap(duty=80.0) for _ in range(30)]
+        trace += [_snap(duty=0.0) for _ in range(60)]
+        _run(eng, trace)
+        active = [e for e in eng.active() if e["detector"] == "duty_ewma"]
+        assert len(active) == 2
+
+    def test_hbm_spike_detected(self):
+        eng = AnomalyEngine(thresholds=T)
+        trace = [_snap(hbm=0.5) for _ in range(30)]
+        trace += [_snap(hbm=0.97) for _ in range(3)]
+        _run(eng, trace)
+        evs = [e for e in eng.events() if e["detector"] == "hbm_ewma"]
+        assert evs and all(e["onset_ts"] == T0 + 30 for e in evs)
+        assert all("above its baseline" in e["message"] for e in evs)
+
+    def test_link_flap_onset_and_clear_within_3_cycles(self):
+        """The ISSUE acceptance timing, at the detector level: 3
+        transitions onset, 3 stable-healthy polls clear."""
+        eng = AnomalyEngine(thresholds=T)
+        link = "tray1.chip0.ici0.int"
+        trace = [_snap(links={link: 0.0}) for _ in range(12)]
+        flap_start = len(trace)
+        trace += [
+            _snap(links={link: 10.0 if i % 2 == 0 else 0.0})
+            for i in range(8)
+        ]
+        flap_end = len(trace)
+        trace += [_snap(links={link: 0.0}) for _ in range(6)]
+        _run(eng, trace)
+        (ev,) = [e for e in eng.events() if e["detector"] == "ici_flap"]
+        assert ev["device"] == f"link:{link}"
+        assert ev["onset_ts"] - (T0 + flap_start) <= 3
+        assert ev["clear_ts"] is not None
+        assert ev["clear_ts"] - (T0 + flap_end) <= 3
+
+    def test_stably_degraded_link_is_not_a_flap(self):
+        """A link that degrades and STAYS degraded is health.py's
+        business (stable grade), not a flap event."""
+        eng = AnomalyEngine(thresholds=T)
+        link = "tray1.chip0.ici0.int"
+        trace = [_snap(links={link: 0.0}) for _ in range(12)]
+        trace += [_snap(links={link: 7.0}) for _ in range(30)]
+        _run(eng, trace)
+        assert [e for e in eng.events() if e["detector"] == "ici_flap"] == []
+
+    def test_bandwidth_drift_cusum(self):
+        """Slow drift (~0.75%/cycle) that never crosses an instantaneous
+        threshold must still onset; a steady rate must not."""
+        eng = AnomalyEngine(thresholds=T)
+        trace = [_snap(rate=4000.0 + (i % 5) * 20.0) for i in range(30)]
+        trace += [_snap(rate=4000.0 - (i * 30.0)) for i in range(40)]
+        _run(eng, trace)
+        evs = [e for e in eng.events() if e["detector"] == "bw_cusum"]
+        assert len(evs) == 1
+        assert evs[0]["severity"] == health.WARN
+        assert "drifting down" in evs[0]["message"]
+        assert evs[0]["onset_ts"] > T0 + 30  # armed only after drift begins
+
+    def test_queue_stall_requires_consecutive_cycles(self):
+        eng = AnomalyEngine(thresholds=T)
+        trace = [_snap(duty=80.0, queues={"0": 2.0}) for _ in range(15)]
+        # Two suspicious cycles — below stall_cycles, no event...
+        trace += [_snap(duty=0.2, queues={"0": 20.0}) for _ in range(2)]
+        trace += [_snap(duty=80.0, queues={"0": 2.0}) for _ in range(3)]
+        _run(eng, trace)
+        assert [e for e in eng.events() if e["detector"] == "queue_stall"] == []
+        # ...a third consecutive one onsets.
+        stall_start = 20
+        for i in range(stall_start, stall_start + 5):
+            eng.observe(T0 + i, _snap(duty=0.2, queues={"0": 20.0}))
+        evs = [e for e in eng.events() if e["detector"] == "queue_stall"]
+        assert len(evs) == 1
+        assert evs[0]["onset_ts"] == T0 + stall_start + 2  # 3rd stalled poll
+        assert "wedged runtime" in evs[0]["message"]
+
+    def test_vanished_signal_clears_event(self):
+        """Runtime detach mid-event: the signal disappears from the
+        snapshot and the event must clear, not stay active forever."""
+        eng = AnomalyEngine(thresholds=T)
+        trace = [_snap(duty=80.0) for _ in range(30)]
+        trace += [_snap(duty=0.0) for _ in range(3)]
+        _run(eng, trace)
+        assert eng.summary()["active"] >= 1
+        eng.observe(T0 + 40, {"chips": {}, "ici": {}, "queues": {}})
+        assert eng.summary()["active"] == 0
+        assert all(e["clear_ts"] == T0 + 40 for e in eng.events())
+
+    def test_event_ring_bounded_per_device(self):
+        eng = AnomalyEngine(thresholds=T, max_events=4)
+        trace = [_snap(duty=80.0, chips=1) for _ in range(30)]
+        # 10 separate collapse/recover episodes on one chip.
+        for _ in range(10):
+            trace += [_snap(duty=0.0, chips=1)] * 2
+            trace += [_snap(duty=80.0, chips=1)] * 2
+        _run(eng, trace)
+        evs = eng.events()
+        assert len(evs) == 4  # ring bound, newest retained
+        assert evs == sorted(evs, key=lambda e: e["id"])
+        assert eng.summary()["total"] == 10  # counters keep full history
+
+    def test_active_event_survives_ring_churn(self):
+        """Rings bound retention of CLEARED history; an event that is
+        still active must appear in events() even after same-device churn
+        from another detector evicts it from the ring."""
+        eng = AnomalyEngine(thresholds=T, max_events=2)
+        trace = [_snap(duty=80.0, hbm=0.5, chips=1) for _ in range(30)]
+        # Persistent duty collapse on chip 0 (stays active)...
+        trace += [_snap(duty=0.0, hbm=0.5, chips=1)]
+        # ...then enough HBM flap episodes on the SAME device key to
+        # overflow a 2-slot ring.
+        for _ in range(4):
+            trace += [_snap(duty=0.0, hbm=0.97, chips=1)] * 2
+            trace += [_snap(duty=0.0, hbm=0.5, chips=1)] * 2
+        _run(eng, trace)
+        active_duty = [
+            e for e in eng.active() if e["detector"] == "duty_ewma"
+        ]
+        assert len(active_duty) == 1
+        listed = [e["id"] for e in eng.events()]
+        assert active_duty[0]["id"] in listed
+
+    def test_thresholds_from_env(self, monkeypatch):
+        monkeypatch.setenv("TPUMON_ANOMALY_Z_WARN", "9.5")
+        monkeypatch.setenv("TPUMON_ANOMALY_WARMUP", "bogus")
+        t = AnomalyThresholds.from_env()
+        assert t.z_warn == 9.5
+        assert t.warmup == AnomalyThresholds().warmup  # malformed -> default
+
+
+class SteadyBackend(FakeTpuBackend):
+    """Deterministic quiet node: constant duty/HBM/rate, healthy fabric."""
+
+    def _generate(self, name):
+        topo = self._topology
+        if name == "duty_cycle_pct":
+            return tuple("75.00" for _ in range(topo.num_chips))
+        if name == "hbm_capacity_usage":
+            return tuple(str(self._hbm // 2) for _ in range(topo.num_chips))
+        if name == "tpu_throttle_score":
+            return tuple("0" for _ in range(topo.num_chips))
+        if name == "hlo_queue_size":
+            return tuple(
+                f"tensorcore_{c}: 2" for c in range(topo.num_cores)
+            )
+        if name == "tcp_delivery_rate":
+            return ("4000.00, 4000.00, 4100.00, 4200.00, 4300.00",)
+        return super()._generate(name)
+
+
+class FlapBackend(SteadyBackend):
+    """Steady node whose chip-0/ici-0 link flaps during [start, stop)."""
+
+    flap_start = 5
+    flap_stop = 11
+
+    def _generate(self, name):
+        if name == "ici_link_health":
+            out = []
+            flapping = self.flap_start <= self._step < self.flap_stop
+            for c in range(self._topology.num_chips):
+                for port in range(4):
+                    score = (
+                        10
+                        if c == 0 and port == 0 and flapping
+                        and (self._step - self.flap_start) % 2 == 0
+                        else 0
+                    )
+                    out.append(f"tray{c // 4 + 1}.chip{c}.ici{port}.int: {score}")
+            return tuple(out)
+        return super()._generate(name)
+
+
+@pytest.fixture
+def exporter_for():
+    built = []
+
+    def _build(backend, **cfg_kwargs):
+        cfg_kwargs.setdefault("pod_attribution", False)
+        cfg = Config(port=0, addr="127.0.0.1", interval=30.0, **cfg_kwargs)
+        exp = build_exporter(cfg, backend)
+        exp.start()
+        built.append(exp)
+        return exp
+
+    yield _build
+    for exp in built:
+        exp.close()
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+class TestExporterIntegration:
+    def test_steady_120_cycle_trace_zero_events(self, exporter_for, scrape):
+        """Acceptance: a quiet node stays quiet for 120 poll cycles."""
+        exp = exporter_for(SteadyBackend.preset("v4-8", ici_flake=0.0))
+        for _ in range(120):
+            exp.poller.poll_once()
+        doc = _get_json(exp.server.url + "/anomalies")
+        assert doc["events"] == []
+        assert doc["active"] == 0 and doc["total"] == 0
+        assert doc["status"] == "ok"
+        assert doc["detectors"] == [
+            "duty_ewma", "hbm_ewma", "ici_flap", "bw_cusum", "queue_stall",
+        ]
+        # The armed-detector gauge is on the page even with zero events.
+        _, text = scrape(exp.server.url + "/metrics")
+        assert 'tpu_anomaly_detectors{' in text
+        assert "tpu_anomaly_active" not in text  # absent-not-zero
+
+    def test_flap_trace_deterministic_events(self, exporter_for, scrape):
+        """Acceptance: scripted fake-backend flap — onset and clear both
+        within 3 poll cycles of the fabric changing, deterministic list."""
+        be = FlapBackend.preset("v4-8", ici_flake=0.0)
+        exp = exporter_for(be)
+        onset_cycle = clear_cycle = None
+        for cycle in range(1, 21):
+            exp.poller.poll_once()
+            doc = _get_json(exp.server.url + "/anomalies")
+            flaps = [e for e in doc["events"] if e["detector"] == "ici_flap"]
+            if flaps and onset_cycle is None:
+                onset_cycle = cycle
+            if flaps and flaps[0]["clear_ts"] is not None and clear_cycle is None:
+                clear_cycle = cycle
+        assert onset_cycle is not None and clear_cycle is not None
+        # poll_once advances the fake one step before sampling, so cycle N
+        # serves step N; flapping spans steps [flap_start, flap_stop).
+        assert onset_cycle - FlapBackend.flap_start <= 3
+        assert clear_cycle - FlapBackend.flap_stop <= 3
+
+        doc = _get_json(exp.server.url + "/anomalies")
+        (ev,) = [e for e in doc["events"] if e["detector"] == "ici_flap"]
+        assert ev["device"] == "link:tray1.chip0.ici0.int"
+        assert ev["severity"] in (health.WARN, health.CRIT)
+        assert ev["window"], "triggering sample window missing"
+        assert ev["signal"].startswith(
+            "accelerator_interconnect_link_health{"
+        )
+        assert 'link="tray1.chip0.ici0.int"' in ev["signal"]
+        # Families flowed while active; totals persist after clear.
+        _, text = scrape(exp.server.url + "/metrics")
+        assert "tpu_anomaly_events_total" in text
+        assert 'detector="ici_flap"' in text
+
+    def test_since_replay(self, exporter_for):
+        be = FlapBackend.preset("v4-8", ici_flake=0.0)
+        exp = exporter_for(be)
+        for _ in range(20):
+            exp.poller.poll_once()
+        doc = _get_json(exp.server.url + "/anomalies")
+        (ev,) = doc["events"]
+        # Replay from just after the clear: the event still appears
+        # (updated at clear), and from far future: nothing.
+        replay = _get_json(
+            exp.server.url + f"/anomalies?since={ev['clear_ts']}"
+        )
+        assert [e["id"] for e in replay["events"]] == [ev["id"]]
+        future = _get_json(
+            exp.server.url + f"/anomalies?since={ev['clear_ts'] + 1}"
+        )
+        assert future["events"] == []
+
+    def test_bad_since_rejected(self, exporter_for):
+        exp = exporter_for(SteadyBackend.preset("v4-8", ici_flake=0.0))
+        for q in ("since=nan", "since=inf", "since=-1", "since=bogus"):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    exp.server.url + "/anomalies?" + q, timeout=10
+                )
+            assert err.value.code == 400
+
+    def test_detection_adds_no_device_calls(self, exporter_for):
+        """Acceptance: the detection pass is poll-loop-only AND free —
+        per-cycle device queries are identical with the engine on or off,
+        and scrapes never touch the backend (existing invariant)."""
+        counts = {}
+        for flag in (True, False):
+            be = SteadyBackend.preset("v4-8", ici_flake=0.0)
+            calls = []
+            orig = be.sample
+            be.sample = lambda name, _o=orig: (calls.append(name), _o(name))[1]
+            exp = exporter_for(be, anomaly=flag)
+            calls.clear()
+            for _ in range(5):
+                exp.poller.poll_once()
+            counts[flag] = list(calls)
+        assert counts[True] == counts[False]
+
+    def test_anomaly_disabled(self, exporter_for, scrape):
+        exp = exporter_for(
+            SteadyBackend.preset("v4-8", ici_flake=0.0), anomaly=False
+        )
+        exp.poller.poll_once()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(exp.server.url + "/anomalies", timeout=10)
+        assert err.value.code == 404
+        _, text = scrape(exp.server.url + "/metrics")
+        assert "tpu_anomaly" not in text
+
+    def test_history_window_negative_rejected(self, exporter_for):
+        """Satellite: /history's window param validates like since —
+        NaN/negative answer 400 instead of being silently coerced."""
+        exp = exporter_for(SteadyBackend.preset("v4-8", ici_flake=0.0))
+        for q in ("window=-1", "window=nan", "window=-inf"):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    exp.server.url + "/history?" + q, timeout=10
+                )
+            assert err.value.code == 400
+        # Valid windows still serve.
+        doc = _get_json(exp.server.url + "/history?window=60")
+        assert doc["window"] == 60.0
+
+    def test_smi_snapshot_carries_anomalies(self, exporter_for):
+        from tpumon.smi import snapshot_from_url
+
+        be = FlapBackend.preset("v4-8", ici_flake=0.0)
+        exp = exporter_for(be)
+        for _ in range(8):
+            exp.poller.poll_once()
+        snap = snapshot_from_url(exp.server.url, timeout=10, window=60)
+        anoms = snap.get("anomalies")
+        assert anoms is not None
+        assert anoms["active"] >= 1
+        assert anoms["worst"]["detector"] == "ici_flap"

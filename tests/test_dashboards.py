@@ -34,7 +34,12 @@ def _known_metric_names():
     return names
 
 
-_METRIC_RE = re.compile(r"\b(?:accelerator|exporter|collector|workload)_[a-z0-9_]+")
+# `tpu_anomaly` (not bare `tpu_`): libtpu SOURCE metric names like
+# tpu_throttle_score appear in docs and must not be mistaken for
+# Prometheus families.
+_METRIC_RE = re.compile(
+    r"\b(?:accelerator|exporter|collector|workload|tpu_anomaly)_[a-z0-9_]+"
+)
 
 
 def _dashboards():
@@ -104,6 +109,33 @@ def test_ici_fabric_has_pod_level_joins():
     assert any(p["type"] == "heatmap" for p in joined), (
         "pod-level fabric heatmap panel missing"
     )
+
+
+def test_anomaly_panel_and_annotations_present():
+    """The streaming-detector events (tpumon.anomaly) must be operator
+    -reachable on the slice overview: a panel over tpu_anomaly_active /
+    tpu_anomaly_events_total plus an annotation query marking onsets on
+    every time panel; annotation exprs ride the same known-family net."""
+    known = _known_metric_names()
+    dash = dict(_dashboards())["tpu-slice-overview.json"]
+    exprs = [
+        t["expr"]
+        for p in dash["panels"]
+        for t in p.get("targets", ())
+    ]
+    assert any("tpu_anomaly_active" in e for e in exprs)
+    assert any("tpu_anomaly_events_total" in e for e in exprs)
+    annotations = dash.get("annotations", {}).get("list", [])
+    anomaly_ann = [
+        a for a in annotations if "tpu_anomaly" in a.get("expr", "")
+    ]
+    assert anomaly_ann, "no anomaly annotation query on the slice overview"
+    for a in annotations:
+        for ref in _METRIC_RE.findall(a.get("expr", "")):
+            assert ref in known, (
+                f"annotation {a.get('name')!r} references unknown "
+                f"metric {ref!r}"
+            )
 
 
 def test_distribution_families_have_quantile_panels():

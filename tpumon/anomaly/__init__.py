@@ -1,0 +1,21 @@
+"""tpumon.anomaly — streaming anomaly detection over the 1 Hz poll stream.
+
+Node-local detection next to the collector (the placement argued by the
+host-side-telemetry line of work in PAPERS.md): Prometheus scrapes every
+15-60 s, so duty-cycle collapse, ICI link flaps, and throttle bursts alias
+away between scrapes; the History flight recorder captures them and this
+package *interprets* them, each poll cycle, without any extra device query.
+
+Entry points: :class:`AnomalyEngine` (wired by the exporter),
+:func:`tpumon.anomaly.detectors.default_detectors` (the shipped roster),
+``TPUMON_ANOMALY_*`` env thresholds (tpumon/anomaly/detectors.py).
+"""
+
+from tpumon.anomaly.detectors import (  # noqa: F401
+    DETECTOR_NAMES,
+    AnomalyThresholds,
+    Reading,
+    default_detectors,
+    env_thresholds,
+)
+from tpumon.anomaly.engine import AnomalyEngine, Event  # noqa: F401

@@ -1,0 +1,424 @@
+"""Streaming detectors over the 1 Hz poll snapshot (eACGM-style).
+
+Each detector consumes the per-cycle parsed snapshot the collector already
+builds (tpumon.smi.snapshot_from_families shape, via PollStats.snapshot) —
+never the device backend — and emits :class:`Reading` rows describing
+which signals look anomalous *right now*. The engine
+(tpumon/anomaly/engine.py) turns readings into onset/clear events.
+
+The four detectors map to the transient classes the 1 Hz flight recorder
+exists to catch (ISSUE/PAPERS: eACGM arxiv 2506.02007, host-side telemetry
+arxiv 2510.16946):
+
+- :class:`EwmaZDetector` — EWMA mean/variance z-score on per-chip duty
+  cycle and HBM occupancy (duty-cycle collapse, memory spikes).
+- :class:`CusumDriftDetector` — two-sided CUSUM on the interconnect
+  delivery rate (slow bandwidth drift a threshold never catches).
+- :class:`LinkFlapDetector` — counts healthy↔degraded transitions of each
+  ICI link inside a sliding window (flapping links score 1-5 and back,
+  which a 15-60 s Prometheus scrape aliases into "healthy").
+- :class:`QueueStallDetector` — the load/progress pairing from
+  tpumon/health.py:queue_stall, but streaming: deep HLO queues while the
+  device shows no compute for N consecutive polls.
+
+Thresholds follow the tpumon.health.Thresholds pattern: every field is a
+``TPUMON_ANOMALY_<FIELD>`` env var, malformed values log and keep the
+default, and the env is re-parsed only when it changes.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+from collections import deque
+from dataclasses import dataclass, fields
+
+from tpumon.health import CRIT, WARN
+
+log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class AnomalyThresholds:
+    """Detector tuning, overridable per deployment via TPUMON_ANOMALY_*."""
+
+    #: EWMA smoothing factor for the z-score and CUSUM baselines.
+    ewma_alpha: float = 0.1
+    #: Samples a signal must contribute before its detector arms.
+    warmup: float = 20.0
+    #: |z| that onsets a warn / escalates to crit / clears an event.
+    z_warn: float = 4.0
+    z_crit: float = 6.0
+    z_clear: float = 2.0
+    #: Std floors so a near-constant baseline can't make z explode on
+    #: measurement jitter (duty in percentage points, HBM in occupancy
+    #: ratio, bandwidth in Mbps).
+    duty_min_std: float = 2.0
+    hbm_min_std: float = 0.01
+    bw_min_std: float = 10.0
+    #: CUSUM slack and decision threshold, in baseline-std units.
+    cusum_k: float = 0.5
+    cusum_h: float = 8.0
+    #: Flap onset: this many healthy↔degraded transitions within
+    #: flap_window seconds; crit at 2x. Clear: flap_clear_cycles
+    #: consecutive stable-healthy polls.
+    flap_transitions: float = 3.0
+    flap_window: float = 60.0
+    flap_clear_cycles: float = 3.0
+    #: Stall: queue depth >= stall_depth while the whole device's duty
+    #: cycle <= stall_duty_pct, for stall_cycles consecutive polls.
+    stall_depth: float = 8.0
+    stall_duty_pct: float = 1.0
+    stall_cycles: float = 3.0
+    #: Seconds of 1 Hz history attached to an event at onset.
+    window_lookback: float = 30.0
+
+    @classmethod
+    def from_env(cls, environ=None) -> "AnomalyThresholds":
+        env = os.environ if environ is None else environ
+        kwargs = {}
+        for f in fields(cls):
+            raw = env.get("TPUMON_ANOMALY_" + f.name.upper())
+            if raw is None:
+                continue
+            try:
+                kwargs[f.name] = float(raw)
+            except ValueError:
+                log.warning(
+                    "ignoring malformed TPUMON_ANOMALY_%s=%r",
+                    f.name.upper(), raw,
+                )
+        return cls(**kwargs)
+
+
+#: (env-values key, parsed thresholds) — same 1 Hz re-parse-only-on-change
+#: cache as tpumon.health.env_thresholds.
+_env_cache: tuple | None = None
+
+
+def env_thresholds() -> AnomalyThresholds:
+    global _env_cache
+    key = tuple(
+        os.environ.get("TPUMON_ANOMALY_" + f.name.upper())
+        for f in fields(AnomalyThresholds)
+    )
+    if _env_cache is None or _env_cache[0] != key:
+        _env_cache = (key, AnomalyThresholds.from_env())
+    return _env_cache[1]
+
+
+@dataclass(frozen=True)
+class Reading:
+    """One detector's verdict on one signal for one poll cycle."""
+
+    #: Stable signal id within the detector, e.g. ``chip:0``.
+    signal: str
+    active: bool
+    severity: str  # tpumon.health WARN / CRIT
+    value: float
+    message: str
+    #: Prometheus family + label substrings locating the 1 Hz history
+    #: series the event's sample window is extracted from.
+    family: str
+    label_match: tuple[tuple[str, str], ...] = ()
+
+
+class _Ewma:
+    """Streaming mean/variance: the exponentially weighted pair."""
+
+    __slots__ = ("mean", "var", "n")
+
+    def __init__(self) -> None:
+        self.mean = 0.0
+        self.var = 0.0
+        self.n = 0
+
+    def update(self, x: float, alpha: float) -> None:
+        if self.n == 0:
+            self.mean = x
+        else:
+            d = x - self.mean
+            self.mean += alpha * d
+            self.var = (1.0 - alpha) * (self.var + alpha * d * d)
+        self.n += 1
+
+
+class EwmaZDetector:
+    """EWMA z-score on a per-chip scalar (duty cycle, HBM occupancy).
+
+    The baseline freezes while a signal is anomalous, so a genuine regime
+    change (duty-cycle collapse that *stays* collapsed) keeps its event
+    active until the signal actually recovers, instead of the baseline
+    absorbing the fault and silently self-clearing.
+    """
+
+    def __init__(
+        self, name: str, quantity: str, extract, family: str,
+        min_std_field: str, fmt=lambda v: f"{v:.1f}",
+    ) -> None:
+        self.name = name
+        self._quantity = quantity
+        self._extract = extract  # snap -> {chip: value}
+        self._family = family
+        self._min_std_field = min_std_field
+        self._fmt = fmt
+        self._state: dict[str, _Ewma] = {}
+        self._active: set[str] = set()
+
+    def observe(self, ts: float, snap: dict, t: AnomalyThresholds) -> list[Reading]:
+        out: list[Reading] = []
+        vals = self._extract(snap)
+        min_std = getattr(t, self._min_std_field)
+        for chip in sorted(vals):
+            x = vals[chip]
+            st = self._state.setdefault(chip, _Ewma())
+            if st.n >= t.warmup:
+                std = max(math.sqrt(max(st.var, 0.0)), min_std)
+                z = (x - st.mean) / std
+                was = chip in self._active
+                active = abs(z) >= (t.z_clear if was else t.z_warn)
+                sev = CRIT if abs(z) >= t.z_crit else WARN
+                side = "above" if z > 0 else "below"
+                out.append(
+                    Reading(
+                        f"chip:{chip}",
+                        active,
+                        sev,
+                        x,
+                        f"chip {chip} {self._quantity} {self._fmt(x)} is "
+                        f"{abs(z):.1f}σ {side} its baseline "
+                        f"{self._fmt(st.mean)}",
+                        self._family,
+                        (("chip", chip),),
+                    )
+                )
+                if active:
+                    self._active.add(chip)
+                    continue  # freeze the baseline while anomalous
+                self._active.discard(chip)
+            st.update(x, t.ewma_alpha)
+        return out
+
+
+def _duty_by_chip(snap: dict) -> dict[str, float]:
+    return {
+        chip: row["duty_pct"]
+        for chip, row in snap.get("chips", {}).items()
+        if row.get("duty_pct") is not None
+    }
+
+
+def _hbm_ratio_by_chip(snap: dict) -> dict[str, float]:
+    out = {}
+    for chip, row in snap.get("chips", {}).items():
+        used, total = row.get("hbm_used"), row.get("hbm_total")
+        if used is not None and total:
+            out[chip] = used / total
+    return out
+
+
+class CusumDriftDetector:
+    """Two-sided CUSUM on the interconnect (DCN) delivery rate.
+
+    Detects slow drift an instantaneous threshold never fires on: each
+    cycle accumulates the standardized deviation beyond a slack ``k``;
+    crossing ``h`` onsets. The baseline freezes while active (same
+    rationale as EwmaZDetector), and the sums decay once readings return
+    within the slack, clearing at ``h/2``.
+    """
+
+    name = "bw_cusum"
+    _family = "accelerator_network_delivery_rate_mbps"
+
+    def __init__(self) -> None:
+        self._ewma = _Ewma()
+        self._s_pos = 0.0
+        self._s_neg = 0.0
+        self._active = False
+
+    def observe(self, ts: float, snap: dict, t: AnomalyThresholds) -> list[Reading]:
+        rate = (snap.get("network") or {}).get("delivery_rate_mbps")
+        if rate is None:
+            return []
+        st = self._ewma
+        if st.n < t.warmup:
+            st.update(rate, t.ewma_alpha)
+            return []
+        std = max(math.sqrt(max(st.var, 0.0)), t.bw_min_std)
+        z = (rate - st.mean) / std
+        self._s_pos = max(0.0, self._s_pos + z - t.cusum_k)
+        self._s_neg = max(0.0, self._s_neg - z - t.cusum_k)
+        worst = max(self._s_pos, self._s_neg)
+        was = self._active
+        self._active = worst >= (t.cusum_h / 2.0 if was else t.cusum_h)
+        side = "down" if self._s_neg >= self._s_pos else "up"
+        reading = Reading(
+            "node",
+            self._active,
+            WARN,
+            rate,
+            f"interconnect delivery rate {rate:.0f} Mbps drifting {side} "
+            f"from baseline {st.mean:.0f} Mbps (CUSUM {worst:.1f}σ)",
+            self._family,
+            (("stat", "mean"),),
+        )
+        if not self._active:
+            st.update(rate, t.ewma_alpha)
+        return [reading]
+
+
+class LinkFlapDetector:
+    """Healthy↔degraded transition bursts on ICI links.
+
+    A link oscillating between score 0 and a transient-error score is
+    invisible to a 15-60 s scrape (it usually samples the healthy phase)
+    and distinct from a link that is *stably* degraded, which
+    tpumon/health.py already grades. Onset after ``flap_transitions``
+    boundary crossings inside ``flap_window`` seconds; clear after
+    ``flap_clear_cycles`` consecutive stable-healthy polls.
+    """
+
+    name = "ici_flap"
+    _family = "accelerator_interconnect_link_health"
+
+    def __init__(self) -> None:
+        self._last: dict[str, float] = {}
+        self._transitions: dict[str, deque] = {}
+        self._healthy_streak: dict[str, int] = {}
+        self._active: set[str] = set()
+
+    def observe(self, ts: float, snap: dict, t: AnomalyThresholds) -> list[Reading]:
+        links = (snap.get("ici") or {}).get("links") or {}
+        out: list[Reading] = []
+        for link in sorted(links):
+            score = links[link]
+            last = self._last.get(link)
+            trans = self._transitions.setdefault(link, deque())
+            if last is not None and (last == 0) != (score == 0):
+                trans.append(ts)
+            horizon = ts - t.flap_window
+            while trans and trans[0] < horizon:
+                trans.popleft()
+            if score == 0 and (last is None or last == 0):
+                self._healthy_streak[link] = self._healthy_streak.get(link, 0) + 1
+            else:
+                self._healthy_streak[link] = 0
+            self._last[link] = score
+
+            n = len(trans)
+            if link in self._active:
+                if self._healthy_streak[link] >= t.flap_clear_cycles:
+                    self._active.discard(link)
+                    trans.clear()  # a fresh burst must re-onset cleanly
+                    active = False
+                else:
+                    active = True
+            else:
+                active = n >= t.flap_transitions
+                if active:
+                    self._active.add(link)
+            sev = CRIT if n >= 2 * t.flap_transitions else WARN
+            if active or n > 0:
+                out.append(
+                    Reading(
+                        f"link:{link}",
+                        active,
+                        sev,
+                        score,
+                        f"ICI link {link} flapped {n} times in "
+                        f"{t.flap_window:.0f}s (now score {score:.0f})",
+                        self._family,
+                        (("link", link),),
+                    )
+                )
+        return out
+
+
+class QueueStallDetector:
+    """Deep HLO queues while the device shows no compute, streaming.
+
+    The same load/progress pairing as tpumon/health.py's instantaneous
+    ``queue_stall`` check, but requiring ``stall_cycles`` consecutive
+    polls before onsetting — the health finding flags one suspicious
+    cycle, this event means the runtime is actually wedged.
+    """
+
+    name = "queue_stall"
+    _family = "accelerator_queue_size"
+
+    def __init__(self) -> None:
+        self._streak: dict[str, int] = {}
+        self._active: set[str] = set()
+
+    def observe(self, ts: float, snap: dict, t: AnomalyThresholds) -> list[Reading]:
+        queues = snap.get("queues") or {}
+        if not queues:
+            return []
+        duties = [
+            row.get("duty_pct")
+            for row in snap.get("chips", {}).values()
+            if row.get("duty_pct") is not None
+        ]
+        device_idle = bool(duties) and max(duties) <= t.stall_duty_pct
+        out: list[Reading] = []
+        for core in sorted(queues):
+            depth = queues[core]
+            stalled = device_idle and depth >= t.stall_depth
+            streak = self._streak.get(core, 0) + 1 if stalled else 0
+            self._streak[core] = streak
+            was = core in self._active
+            active = streak >= t.stall_cycles
+            if active:
+                self._active.add(core)
+            else:
+                self._active.discard(core)
+            if active or was:
+                out.append(
+                    Reading(
+                        f"core:{core}",
+                        active,
+                        WARN,
+                        depth,
+                        f"core {core} has {depth:.0f} programs queued while "
+                        f"the device shows no compute for {streak} polls "
+                        "(wedged runtime)",
+                        self._family,
+                        (("core", core),),
+                    )
+                )
+            elif stalled:
+                out.append(
+                    Reading(
+                        f"core:{core}", False, WARN, depth,
+                        f"core {core} queue {depth:.0f} deep, device idle "
+                        f"({streak}/{t.stall_cycles:.0f} polls)",
+                        self._family,
+                        (("core", core),),
+                    )
+                )
+        return out
+
+
+def default_detectors() -> list:
+    """The shipped detector roster, in evaluation order."""
+    return [
+        EwmaZDetector(
+            "duty_ewma", "duty cycle", _duty_by_chip,
+            "accelerator_duty_cycle_percent", "duty_min_std",
+            fmt=lambda v: f"{v:.1f}%",
+        ),
+        EwmaZDetector(
+            "hbm_ewma", "HBM occupancy", _hbm_ratio_by_chip,
+            "accelerator_memory_used_bytes", "hbm_min_std",
+            fmt=lambda v: f"{v * 100:.1f}%",
+        ),
+        LinkFlapDetector(),
+        CusumDriftDetector(),
+        QueueStallDetector(),
+    ]
+
+
+DETECTOR_NAMES: tuple[str, ...] = (
+    "duty_ewma", "hbm_ewma", "ici_flap", "bw_cusum", "queue_stall",
+)

@@ -1,0 +1,302 @@
+"""The streaming anomaly engine wired into the poll loop.
+
+One :meth:`AnomalyEngine.observe` call per poll cycle, fed the parsed
+snapshot the collector already computed (PollStats.snapshot) — the
+detection pass adds **zero** device-backend calls, preserving the
+scrape-latency design rule in tpumon/exporter/collector.py. Events land
+in bounded per-device rings with onset/clear timestamps, severity (the
+shared tpumon.health ordering), and the triggering 1 Hz sample window
+extracted from the History flight recorder at onset.
+
+Surfaces:
+
+- metric families (``tpu_anomaly_detectors`` / ``tpu_anomaly_active`` /
+  ``tpu_anomaly_events_total``, registered in tpumon/families.py),
+  appended to the poll cycle's page by the Poller;
+- ``GET /anomalies`` on the exporter server (``?since=`` replay like
+  ``/history``);
+- one summary line each in ``tpumon doctor`` and ``tpumon smi``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import Counter, deque
+from dataclasses import dataclass, field
+
+from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
+
+from tpumon import health as health_mod
+from tpumon.anomaly.detectors import (
+    DETECTOR_NAMES,
+    AnomalyThresholds,
+    default_detectors,
+    env_thresholds,
+)
+
+log = logging.getLogger(__name__)
+
+__all__ = ["AnomalyEngine", "Event", "DETECTOR_NAMES"]
+
+
+@dataclass
+class Event:
+    """One anomaly, from onset until (and after) clear."""
+
+    id: int
+    detector: str
+    severity: str  # tpumon.health WARN / CRIT
+    device: str  # ring key, e.g. "chip:0", "link:tray1.chip0.ici1.int"
+    signal: str  # history series key ("" when history is disabled)
+    message: str
+    value: float
+    onset_ts: float
+    updated_ts: float
+    clear_ts: float | None = None
+    #: The triggering 1 Hz sample window, captured from History at onset.
+    window: list = field(default_factory=list)
+
+    @property
+    def active(self) -> bool:
+        return self.clear_ts is None
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "detector": self.detector,
+            "severity": self.severity,
+            "device": self.device,
+            "signal": self.signal,
+            "message": self.message,
+            "value": self.value,
+            "onset_ts": self.onset_ts,
+            "clear_ts": self.clear_ts,
+            "updated_ts": self.updated_ts,
+            "window": [[ts, v] for ts, v in self.window],
+        }
+
+
+def _find_series_key(history, family: str, label_match) -> str:
+    """Locate the exact history series key for (family, label subset).
+
+    History keys are ``family{k="v",...}`` with node-constant base labels
+    stripped (tpumon.history.series_key); detectors carry only the
+    distinguishing labels, so match by prefix + label substrings. Runs
+    once per event onset, never per cycle.
+    """
+    needles = [f'{k}="{v}"' for k, v in label_match]
+    for key in history.keys():
+        if not key.startswith(family):
+            continue
+        if key != family and key[len(family)] != "{":
+            continue  # family is a prefix of a longer family name
+        if all(n in key for n in needles):
+            return key
+    return ""
+
+
+class AnomalyEngine:
+    """Reconciles detector readings into onset/clear events.
+
+    Thread model: ``observe``/``cycle`` run on the poller thread only;
+    ``events``/``active``/``families``/``summary`` may be called from the
+    HTTP threads — all state is guarded by one lock, held for dict/deque
+    work only (no device or history-scan calls besides the O(series)
+    key lookup at onset).
+    """
+
+    def __init__(
+        self,
+        history=None,
+        max_events: int = 256,
+        detectors=None,
+        thresholds: AnomalyThresholds | None = None,
+    ) -> None:
+        self._history = history
+        self._max_events = max(1, int(max_events))
+        self._detectors = detectors if detectors is not None else default_detectors()
+        self._thresholds = thresholds
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._cycles = 0
+        #: (detector, signal) -> active Event
+        self._live: dict[tuple[str, str], Event] = {}
+        #: device -> bounded ring of Events (active ones included)
+        self._rings: dict[str, deque] = {}
+        #: monotonic onset counts by (detector, severity)
+        self._totals: Counter = Counter()
+
+    @property
+    def detector_names(self) -> tuple[str, ...]:
+        return tuple(d.name for d in self._detectors)
+
+    def _series_window(self, ts: float, family: str, label_match, t) -> tuple[str, list]:
+        if self._history is None:
+            return "", []
+        try:
+            key = _find_series_key(self._history, family, label_match)
+            if not key:
+                return "", []
+            return key, list(self._history.query(key, ts - t.window_lookback))
+        except Exception:  # a history hiccup must never kill detection
+            log.exception("anomaly window extraction failed")
+            return "", []
+
+    def observe(self, ts: float, snap: dict | None) -> None:
+        """Feed one poll cycle's parsed snapshot through every detector."""
+        if not snap:
+            return
+        t = self._thresholds if self._thresholds is not None else env_thresholds()
+        readings = []
+        for det in self._detectors:
+            try:
+                readings.extend((det.name, r) for r in det.observe(ts, snap, t))
+            except Exception:  # one broken detector must not stop the rest
+                log.exception("anomaly detector %s failed", det.name)
+
+        with self._lock:
+            self._cycles += 1
+            seen: set[tuple[str, str]] = set()
+            for det_name, r in readings:
+                key = (det_name, r.signal)
+                seen.add(key)
+                live = self._live.get(key)
+                if r.active and live is None:
+                    series, window = self._series_window(
+                        ts, r.family, r.label_match, t
+                    )
+                    self._seq += 1
+                    ev = Event(
+                        id=self._seq,
+                        detector=det_name,
+                        severity=r.severity,
+                        device=r.signal,
+                        signal=series,
+                        message=r.message,
+                        value=r.value,
+                        onset_ts=ts,
+                        updated_ts=ts,
+                        window=window,
+                    )
+                    self._live[key] = ev
+                    self._rings.setdefault(
+                        r.signal, deque(maxlen=self._max_events)
+                    ).append(ev)
+                    self._totals[(det_name, r.severity)] += 1
+                elif live is not None:
+                    if r.active:
+                        live.updated_ts = ts
+                        live.value = r.value
+                        live.message = r.message
+                        # Severity may escalate while active, never de-escalate.
+                        if health_mod.severity_value(
+                            r.severity
+                        ) > health_mod.severity_value(live.severity):
+                            live.severity = r.severity
+                    else:
+                        live.clear_ts = ts
+                        live.updated_ts = ts
+                        del self._live[key]
+            # A signal that stopped reporting entirely (runtime detached,
+            # link vanished) clears its event: absence is "no data", and
+            # an event nothing can refresh must not stay active forever.
+            for key in [k for k in self._live if k not in seen]:
+                ev = self._live.pop(key)
+                ev.clear_ts = ts
+                ev.updated_ts = ts
+
+    # -- poll-loop integration --------------------------------------------
+
+    def cycle(self, ts: float, stats) -> list:
+        """One Poller cycle: observe the snapshot, return the families to
+        append to this cycle's page."""
+        self.observe(ts, stats.snapshot)
+        return self.families(stats.base_keys, stats.base_vals)
+
+    def families(self, base_keys, base_vals) -> list:
+        # Names/help/labels come from the ANOMALY_FAMILIES registry so
+        # exposition, docs, and dashboard validation cannot drift — the
+        # same rule the collector follows for HEALTH_FAMILIES.
+        from tpumon.families import ANOMALY_FAMILIES
+
+        with self._lock:
+            active_counts = Counter(
+                (ev.detector, ev.severity) for ev in self._live.values()
+            )
+            totals = dict(self._totals)
+
+        labels = tuple(base_keys)
+
+        def fam(name, cls):
+            help_text, extra = ANOMALY_FAMILIES[name]
+            return cls(name, help_text, labels=labels + extra)
+
+        det = fam("tpu_anomaly_detectors", GaugeMetricFamily)
+        for d in self._detectors:
+            det.add_metric(tuple(base_vals) + (d.name,), 1.0)
+        out = [det]
+
+        if active_counts:
+            active = fam("tpu_anomaly_active", GaugeMetricFamily)
+            for (d, sev), n in sorted(active_counts.items()):
+                active.add_metric(tuple(base_vals) + (d, sev), float(n))
+            out.append(active)
+
+        if totals:
+            total = fam("tpu_anomaly_events_total", CounterMetricFamily)
+            for (d, sev), n in sorted(totals.items()):
+                total.add_metric(tuple(base_vals) + (d, sev), float(n))
+            out.append(total)
+        return out
+
+    # -- query surfaces ----------------------------------------------------
+
+    def events(self, since: float = 0.0) -> list[dict]:
+        """Retained events updated at/after ``since`` (onset or clear),
+        id-ordered — the /anomalies replay semantics, matching /history's
+        ``?since=``. Active events are always included even if churn on
+        the same device ring has evicted them (rings bound *retention of
+        cleared history*, never the live set the gauges report)."""
+        with self._lock:
+            by_id = {
+                ev.id: ev
+                for ring in self._rings.values()
+                for ev in ring
+                if ev.updated_ts >= since
+            }
+            for ev in self._live.values():
+                if ev.updated_ts >= since:
+                    by_id[ev.id] = ev
+            return [by_id[i].to_dict() for i in sorted(by_id)]
+
+    def active(self) -> list[dict]:
+        with self._lock:
+            return [
+                ev.to_dict()
+                for ev in sorted(self._live.values(), key=lambda e: e.id)
+            ]
+
+    def worst_severity(self) -> str:
+        """Shared health ordering over the active set (`ok` when clean)."""
+        with self._lock:
+            worst = health_mod.OK
+            for ev in self._live.values():
+                if health_mod.severity_value(
+                    ev.severity
+                ) > health_mod.severity_value(worst):
+                    worst = ev.severity
+            return worst
+
+    def summary(self) -> dict:
+        """The /anomalies envelope (events appended by the caller)."""
+        with self._lock:
+            total = sum(self._totals.values())
+            n_active = len(self._live)
+        return {
+            "detectors": list(self.detector_names),
+            "cycles": self._cycles,
+            "active": n_active,
+            "total": total,
+            "status": self.worst_severity(),
+        }

@@ -112,6 +112,13 @@ class Config:
     history_window: float = 600.0
     #: Per-series sample cap for the history engine.
     history_max_samples: int = 4096
+    #: Streaming anomaly detection over the 1 Hz poll stream
+    #: (tpumon.anomaly): tpu_anomaly_* families + /anomalies endpoint.
+    #: Detector thresholds are separate TPUMON_ANOMALY_<FIELD> env vars
+    #: (tpumon/anomaly/detectors.py).
+    anomaly: bool = True
+    #: Per-device retained-event cap for the anomaly engine's rings.
+    anomaly_events_max: int = 256
     #: Log level name.
     log_level: str = "INFO"
     #: Path where the discovery sidecar writes topology JSON.
@@ -143,6 +150,10 @@ class Config:
             history_window=_env_float("HISTORY_WINDOW", base.history_window),
             history_max_samples=_env_int(
                 "HISTORY_MAX_SAMPLES", base.history_max_samples
+            ),
+            anomaly=_env_bool("ANOMALY", base.anomaly),
+            anomaly_events_max=_env_int(
+                "ANOMALY_EVENTS_MAX", base.anomaly_events_max
             ),
             kubelet_socket=_env("KUBELET_SOCKET", base.kubelet_socket)
             or base.kubelet_socket,
@@ -183,6 +194,11 @@ class Config:
             "--history-max-samples",
             type=int,
             help="per-series sample cap for the history engine",
+        )
+        g.add_argument(
+            "--anomaly-events-max",
+            type=int,
+            help="per-device retained-event cap for the anomaly engine",
         )
         g.add_argument("--log-level", help="log level")
         g.add_argument("--kubelet-socket", help="pod-resources gRPC socket")

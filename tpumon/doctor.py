@@ -165,6 +165,22 @@ def run(cfg: Config, out=sys.stdout, backend=None) -> int:
         for f in health_doc["findings"]:
             p(f"  [{f['severity']}] {f['message']}")
 
+        # Streaming anomaly detection (tpumon.anomaly): doctor runs ONE
+        # poll cycle, and every detector needs warmup/streaks, so there is
+        # no verdict to print here — only the armed roster. Live verdicts
+        # (shared ok/warn/crit ordering) come from the running exporter:
+        # GET /anomalies, or the `tpumon smi` anomalies line.
+        if cfg.anomaly:
+            from tpumon.anomaly import DETECTOR_NAMES
+
+            p(
+                "anomaly detection: enabled (detectors: "
+                + ", ".join(DETECTOR_NAMES)
+                + "; verdicts stream from the exporter's GET /anomalies)"
+            )
+        else:
+            p("anomaly detection: disabled (TPUMON_ANOMALY=0)")
+
         from tpumon.attribution import PodResourcesClient
 
         # Runtime monitoring gRPC endpoint: reachability + (when the

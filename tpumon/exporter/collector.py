@@ -36,6 +36,9 @@ class PollStats:
     #: Node-constant base label keys this cycle (history recording strips
     #: them from series identity).
     base_keys: tuple[str, ...] = ()
+    #: ...and their values, so post-cycle consumers (the anomaly engine's
+    #: families) can label their own samples without re-querying topology.
+    base_vals: tuple[str, ...] = ()
     #: Per-cycle device-health report (the /health/devices body), so the
     #: endpoint serves the poll's verdict instead of re-evaluating.
     health: dict | None = None
@@ -173,6 +176,7 @@ def build_families(
     base_keys = tuple(base)
     stats.base_keys = base_keys
     base_vals = tuple(base.values())
+    stats.base_vals = base_vals
     families: list[Metric] = _topology_families(topo, base_keys, base_vals)
 
     list_failed = False
@@ -355,6 +359,7 @@ class Poller:
         attribution=None,
         history=None,
         histograms=None,
+        anomaly=None,
     ) -> None:
         self._backend = backend
         self._cfg = cfg
@@ -363,6 +368,7 @@ class Poller:
         self._attribution = attribution
         self._history = history
         self._histograms = histograms
+        self._anomaly = anomaly
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="tpumon-poller", daemon=True
@@ -382,16 +388,25 @@ class Poller:
         families, stats = build_families(
             self._backend, self._cfg, self._attribution, self._histograms
         )
-        self._cache.publish(families)
+        now = time.time()
         if self._history is not None:
             # Flight recorder (DCGM field-cache analogue): keep the 1 Hz
             # series Prometheus's 15-60 s scrape interval aliases away.
+            # Recorded BEFORE the anomaly pass so an event onsetting this
+            # cycle can extract a window that includes this cycle's sample.
             try:
-                self._history.record_families(
-                    time.time(), families, stats.base_keys
-                )
+                self._history.record_families(now, families, stats.base_keys)
             except Exception:
                 log.exception("history record failed")
+        if self._anomaly is not None:
+            # Streaming detection over the snapshot this cycle already
+            # parsed (tpumon.anomaly): zero extra device queries, and the
+            # tpu_anomaly_* families ride the same published page.
+            try:
+                families.extend(self._anomaly.cycle(now, stats))
+            except Exception:
+                log.exception("anomaly detection failed")
+        self._cache.publish(families)
         elapsed = time.monotonic() - t0
 
         t = self._telemetry

@@ -98,21 +98,69 @@ class _ThreadingWSGIServer(ThreadingMixIn, WSGIServer):
 _CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
+def _finite(raw: str) -> float | None:
+    """Shared query-param validation for /history and /anomalies: a
+    finite, non-negative float, else None (the endpoints answer 400
+    instead of silently coercing NaN/inf/negative time values)."""
+    import math
+
+    try:
+        v = float(raw)
+    except ValueError:
+        return None
+    return v if math.isfinite(v) and v >= 0 else None
+
+
+def _json_dump(doc) -> bytes:
+    """RFC-strict JSON body shared by /history and /anomalies: device
+    anomalies can produce NaN samples, and json.dumps would happily emit
+    the non-RFC `NaN` token that jq / JSON.parse reject. Map non-finite
+    floats to null instead."""
+    import json
+    import math
+
+    def clean(o):
+        if isinstance(o, float) and not math.isfinite(o):
+            return None
+        if isinstance(o, dict):
+            return {k: clean(v) for k, v in o.items()}
+        if isinstance(o, (list, tuple)):
+            return [clean(v) for v in o]
+        return o
+
+    return json.dumps(
+        clean(doc), sort_keys=True, allow_nan=False
+    ).encode() + b"\n"
+
+
 def _make_app(
     render_body, telemetry: SelfTelemetry, health, history=None,
-    device_health=None, post_scrape=None,
+    device_health=None, post_scrape=None, anomalies=None,
 ):
     """WSGI app. ``render_body(want_gzip: bool) -> bytes`` produces the
     /metrics payload (already gzip-encoded when asked); the exporter
     passes cached-bytes + self-telemetry concatenation, the sidecar a
     plain registry render. ``history`` (a tpumon.history.History) enables
     the /history JSON endpoint; ``device_health`` (a () -> dict callable)
-    enables /health/devices (the dcgmi-health analogue). ``post_scrape``
+    enables /health/devices (the dcgmi-health analogue); ``anomalies``
+    (a tpumon.anomaly.AnomalyEngine) enables /anomalies. ``post_scrape``
     (if set) runs after the duration observation — the exporter uses it
     to poke the off-path self-telemetry renderer."""
 
     def app(environ, start_response):
         path = environ.get("PATH_INFO", "/")
+        if path == "/anomalies" and anomalies is not None:
+            body, status = _anomalies_response(
+                anomalies, environ.get("QUERY_STRING", "")
+            )
+            start_response(
+                status,
+                [
+                    ("Content-Type", "application/json; charset=utf-8"),
+                    ("Content-Length", str(len(body))),
+                ],
+            )
+            return [body]
         if path == "/health/devices" and device_health is not None:
             import json
 
@@ -195,32 +243,11 @@ def _history_response(history, query_string: str) -> tuple[bytes, str]:
     - ``GET /history?series=<key>[&since=<ts>]`` → raw 1 Hz points for one
       series: ``{"series": key, "points": [[ts, value], ...]}``. The key
       is the exact string from the summary view (URL-encoded).
+
+    ``since`` and ``window`` share one validator (module-level
+    ``_finite``): NaN/inf/negative values are a 400, never coerced.
     """
-    import json
-    import math
     from urllib.parse import parse_qs
-
-    def _finite(raw: str) -> float | None:
-        try:
-            v = float(raw)
-        except ValueError:
-            return None
-        return v if math.isfinite(v) else None
-
-    def _dump(doc) -> bytes:
-        # RFC-strict JSON: device anomalies can produce NaN samples, and
-        # json.dumps would happily emit the non-RFC `NaN` token that jq /
-        # JSON.parse reject. Map non-finite floats to null instead.
-        def clean(o):
-            if isinstance(o, float) and not math.isfinite(o):
-                return None
-            if isinstance(o, dict):
-                return {k: clean(v) for k, v in o.items()}
-            if isinstance(o, (list, tuple)):
-                return [clean(v) for v in o]
-            return o
-
-        return json.dumps(clean(doc), sort_keys=True, allow_nan=False).encode() + b"\n"
 
     params = parse_qs(query_string)
     now = time.time()
@@ -230,7 +257,7 @@ def _history_response(history, query_string: str) -> tuple[bytes, str]:
         if since is None:
             return b'{"error": "bad since"}\n', "400 Bad Request"
         points = history.query(key, since)
-        body = _dump(
+        body = _json_dump(
             {"series": key, "now": now, "points": [[t, v] for t, v in points]}
         )
         return body, "200 OK"
@@ -238,7 +265,7 @@ def _history_response(history, query_string: str) -> tuple[bytes, str]:
     if window is None:
         return b'{"error": "bad window"}\n', "400 Bad Request"
     summaries = history.summarize_all(window, now)
-    body = _dump(
+    body = _json_dump(
         {
             "window": window,
             "now": now,
@@ -247,6 +274,30 @@ def _history_response(history, query_string: str) -> tuple[bytes, str]:
         }
     )
     return body, "200 OK"
+
+
+def _anomalies_response(engine, query_string: str) -> tuple[bytes, str]:
+    """The /anomalies JSON API (poll-thread state, no device calls).
+
+    - ``GET /anomalies`` → every retained event (bounded per-device
+      rings) plus the engine envelope: ``{"now": ts, "detectors": [...],
+      "cycles": n, "active": n, "total": n, "status": ok|warn|crit,
+      "events": [{id, detector, severity, device, signal, message,
+      value, onset_ts, clear_ts, updated_ts, window}, ...]}`` —
+      id-ordered, so replays are deterministic.
+    - ``GET /anomalies?since=<ts>`` → only events updated (onset OR
+      clear) at/after ``ts`` — the same replay semantics as /history.
+    """
+    from urllib.parse import parse_qs
+
+    params = parse_qs(query_string)
+    since = _finite(params.get("since", ["0"])[0])
+    if since is None:
+        return b'{"error": "bad since"}\n', "400 Bad Request"
+    doc = engine.summary()
+    doc["now"] = time.time()
+    doc["events"] = engine.events(since)
+    return _json_dump(doc), "200 OK"
 
 
 def registry_renderer(registry: CollectorRegistry):
@@ -411,9 +462,21 @@ class Exporter:
             from tpumon.exporter.histograms import PollHistograms
 
             self.histograms = PollHistograms()
+        self.anomaly = None
+        if cfg.anomaly:
+            from tpumon.anomaly import AnomalyEngine
+
+            # Same malformed-knob stance as history_max_samples above.
+            max_events = cfg.anomaly_events_max
+            if max_events <= 0:
+                max_events = type(cfg)().anomaly_events_max
+            self.anomaly = AnomalyEngine(
+                history=self.history, max_events=max_events
+            )
         self.poller = Poller(
             backend, cfg, self.cache, self.telemetry, attribution,
             history=self.history, histograms=self.histograms,
+            anomaly=self.anomaly,
         )
         version_fn = getattr(backend, "version", None)
         self.telemetry.backend_info.labels(
@@ -449,6 +512,7 @@ class Exporter:
         app = _make_app(
             render, self.telemetry, self._health, self.history,
             self._device_health, post_scrape=self._selfpage.poke,
+            anomalies=self.anomaly,
         )
         self.server = ExporterServer(app, cfg.addr, cfg.port)
         self.grpc_server = None

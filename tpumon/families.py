@@ -59,6 +59,28 @@ HEALTH_FAMILIES: dict[str, tuple[str, tuple[str, ...]]] = {
     ),
 }
 
+#: family -> (description, extra labels) — the streaming anomaly engine
+#: (tpumon/anomaly) fed by the poll loop; same severity vocabulary as the
+#: health families. `tpu_anomaly_active` is absent when nothing is
+#: anomalous (absent-not-zero); `tpu_anomaly_detectors` is always present
+#: while the engine is enabled, so "engine armed" is scrapeable.
+ANOMALY_FAMILIES: dict[str, tuple[str, tuple[str, ...]]] = {
+    "tpu_anomaly_detectors": (
+        "Streaming anomaly detectors armed on this node (1 per enabled "
+        "detector; tpumon/anomaly)",
+        ("detector",),
+    ),
+    "tpu_anomaly_active": (
+        "Currently active anomaly events by detector and severity "
+        "(absent when nothing is anomalous)",
+        ("detector", "severity"),
+    ),
+    "tpu_anomaly_events_total": (
+        "Anomaly event onsets since exporter start by detector and severity",
+        ("detector", "severity"),
+    ),
+}
+
 #: family -> (prometheus type, description)
 SELF_FAMILIES: dict[str, tuple[str, str]] = {
     "exporter_scrape_duration_seconds": (
@@ -168,6 +190,7 @@ def all_family_names() -> set[str]:
         {s.family for s in LIBTPU_SPECS}
         | set(IDENTITY_FAMILIES)
         | set(HEALTH_FAMILIES)
+        | set(ANOMALY_FAMILIES)
         | set(distribution_family_rows())
         | set(SELF_FAMILIES)
         | set(WORKLOAD_FAMILIES)

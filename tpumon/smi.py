@@ -45,6 +45,7 @@ _F_INFO = "accelerator_info"
 _F_COUNT = "accelerator_device_count"
 _F_COVERAGE = "exporter_metric_coverage_ratio"
 _F_WATCH = "accelerator_monitor_watch_streams"
+_F_NET_RATE = "accelerator_network_delivery_rate_mbps"
 
 
 def _fetch(url: str, timeout: float) -> str:
@@ -111,6 +112,15 @@ def snapshot_from_families(families) -> dict:
         snap["watch_streams"] = {
             s.labels.get("state", "?"): int(s.value) for s in watch.samples
         }
+
+    net = fams.get(_F_NET_RATE)
+    if net is not None:
+        # DCN-path bandwidth (mean percentile row): the load signal the
+        # anomaly engine's CUSUM drift detector consumes.
+        for s in net.samples:
+            if s.labels.get("stat") == "mean":
+                snap["network"] = {"delivery_rate_mbps": s.value}
+                break
 
     per_chip = {
         _F_DUTY: "duty_pct",
@@ -238,6 +248,26 @@ def attach_trends(snap: dict, history_doc: dict, window: float) -> None:
     snap["trend_window"] = window
 
 
+def attach_anomalies(snap: dict, doc: dict) -> None:
+    """Fold a /anomalies document into the snapshot summary form."""
+    events = doc.get("events") or []
+    active = [e for e in events if e.get("clear_ts") is None]
+    worst = None
+    from tpumon import health as _health
+
+    for e in active:
+        if worst is None or _health.severity_value(
+            e.get("severity", _health.WARN)
+        ) > _health.severity_value(worst.get("severity", _health.WARN)):
+            worst = e
+    snap["anomalies"] = {
+        "active": len(active),
+        "total": doc.get("total", len(events)),
+        "status": doc.get("status", "ok"),
+        "worst": worst,
+    }
+
+
 def snapshot_from_url(url: str, timeout: float, window: float) -> dict:
     text = _fetch(url.rstrip("/") + "/metrics", timeout)
     snap = snapshot_from_text(text)
@@ -248,6 +278,12 @@ def snapshot_from_url(url: str, timeout: float, window: float) -> dict:
         attach_trends(snap, doc, window)
     except (urllib.error.URLError, urllib.error.HTTPError, ValueError):
         pass  # older exporter or history disabled — table still renders
+    try:
+        attach_anomalies(
+            snap, json.loads(_fetch(url.rstrip("/") + "/anomalies", timeout))
+        )
+    except (urllib.error.URLError, urllib.error.HTTPError, ValueError):
+        pass  # older exporter or anomaly engine disabled
     return snap
 
 
@@ -434,6 +470,22 @@ def render(snap: dict, out=None) -> None:
         p(f"health: {status.upper()} — {top.message}{extra}")
     else:
         p("health: OK")
+
+    anoms = snap.get("anomalies")
+    if anoms:
+        # Streaming-detector verdict (tpumon.anomaly), same severity
+        # vocabulary as the health line above.
+        if anoms["active"] and anoms.get("worst"):
+            w = anoms["worst"]
+            more = (
+                f" (+{anoms['active'] - 1} more)" if anoms["active"] > 1 else ""
+            )
+            p(
+                f"anomalies: {anoms['status'].upper()} — "
+                f"[{w['detector']}] {w['message']}{more}"
+            )
+        else:
+            p(f"anomalies: none active ({anoms['total']} retained)")
 
     if "workload" in snap:
         render_workload(snap["workload"], p)

@@ -12,6 +12,7 @@ import os
 import sys
 
 from tpumon.families import (
+    ANOMALY_FAMILIES,
     HEALTH_FAMILIES,
     IDENTITY_FAMILIES,
     SELF_FAMILIES,
@@ -100,6 +101,24 @@ def render() -> str:
         "|---|---|---|",
     ]
     for name, (desc, labels) in HEALTH_FAMILIES.items():
+        label_s = ", ".join(f"`{l}`" for l in labels) or "—"
+        lines.append(f"| `{name}` | {desc} | {label_s} |")
+
+    lines += [
+        "",
+        "## Streaming anomaly detection (`tpumon.anomaly`)",
+        "",
+        "Streaming detectors (EWMA z-score, CUSUM drift, link-flap burst,",
+        "queue-stall pairing) fed by the 1 Hz poll loop — no extra device",
+        "queries. Events carry onset/clear timestamps and a 1 Hz sample",
+        "window, served via `GET /anomalies` (`?since=` replay). Enabled by",
+        "default; `TPUMON_ANOMALY=0` disables, `TPUMON_ANOMALY_<FIELD>`",
+        "tunes thresholds (`tpumon/anomaly/detectors.py`).",
+        "",
+        "| family | description | extra labels |",
+        "|---|---|---|",
+    ]
+    for name, (desc, labels) in ANOMALY_FAMILIES.items():
         label_s = ", ".join(f"`{l}`" for l in labels) or "—"
         lines.append(f"| `{name}` | {desc} | {label_s} |")
 

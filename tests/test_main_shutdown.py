@@ -1,0 +1,120 @@
+"""Entrypoint tests: the SIGTERM → stop.set() → exporter.close() path
+(previously untested) and the invalid-log-level warning satellite.
+
+``main()`` is driven on a worker thread with ``signal.signal`` patched
+to capture the handlers (the real call is main-thread-only), then the
+captured SIGTERM handler is invoked exactly as CPython's signal
+machinery would — so the test exercises main's own shutdown sequence,
+not a reimplementation of it.
+"""
+
+import logging
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import tpumon.exporter.main as main_mod
+from tpumon.backends.fake import FakeTpuBackend
+
+
+@pytest.fixture
+def driven_main(monkeypatch):
+    """Run main() in a thread against a fake backend; yields (handlers,
+    built-exporter getter, result dict); joins/terminates on teardown."""
+    # Keep the daemon's GIL switch-interval tuning out of the shared
+    # test process.
+    monkeypatch.setenv("TPUMON_KEEP_SWITCH_INTERVAL", "1")
+    handlers = {}
+    monkeypatch.setattr(
+        main_mod.signal,
+        "signal",
+        lambda signum, handler: handlers.setdefault(signum, handler),
+    )
+    built = {}
+    real_build = main_mod.build_exporter
+
+    def capturing_build(cfg, backend=None):
+        built["exp"] = real_build(cfg, FakeTpuBackend.preset("v4-8"))
+        return built["exp"]
+
+    monkeypatch.setattr(main_mod, "build_exporter", capturing_build)
+    result = {}
+    state = {"thread": None}
+
+    def start(argv):
+        thread = threading.Thread(
+            target=lambda: result.setdefault("rc", main_mod.main(argv)),
+            daemon=True,
+        )
+        state["thread"] = thread
+        thread.start()
+        deadline = time.monotonic() + 15
+        while "exp" not in built or not built["exp"].server._started:
+            assert time.monotonic() < deadline, "exporter never started"
+            time.sleep(0.01)
+        return built["exp"], handlers, result
+
+    yield start
+
+    thread = state["thread"]
+    if thread is not None and thread.is_alive():
+        # Belt and braces: never leak a serving exporter into other tests.
+        handler = handlers.get(signal.SIGTERM)
+        if handler is not None:
+            handler(signal.SIGTERM, None)
+        thread.join(timeout=10)
+
+
+def test_sigterm_stops_and_closes_exporter(driven_main):
+    exp, handlers, result = driven_main(
+        ["--backend", "fake", "--port", "0", "--addr", "127.0.0.1"]
+    )
+    # Serving while waiting on the stop event.
+    with urllib.request.urlopen(exp.server.url + "/healthz", timeout=5) as r:
+        assert r.status == 200
+    assert signal.SIGTERM in handlers and signal.SIGINT in handlers
+
+    handlers[signal.SIGTERM](signal.SIGTERM, None)
+
+    deadline = time.monotonic() + 10
+    while "rc" not in result:
+        assert time.monotonic() < deadline, "main() did not return on SIGTERM"
+        time.sleep(0.01)
+    assert result["rc"] == 0
+    # exporter.close() ran: poller stopped and the listener is gone.
+    assert not exp.poller._thread.is_alive()
+    with pytest.raises((urllib.error.URLError, OSError)):
+        urllib.request.urlopen(exp.server.url + "/healthz", timeout=2)
+
+
+def test_invalid_log_level_warns_once(driven_main, monkeypatch, caplog):
+    monkeypatch.setenv("TPUMON_LOG_LEVEL", "LOUD")
+    with caplog.at_level(logging.WARNING, logger="tpumon.exporter.main"):
+        exp, handlers, result = driven_main(
+            ["--backend", "fake", "--port", "0", "--addr", "127.0.0.1"]
+        )
+        handlers[signal.SIGTERM](signal.SIGTERM, None)
+    warnings = [
+        r.getMessage()
+        for r in caplog.records
+        if "TPUMON_LOG_LEVEL" in r.getMessage()
+    ]
+    assert len(warnings) == 1
+    assert "'LOUD'" in warnings[0]
+    assert "DEBUG, INFO, WARNING, ERROR, CRITICAL" in warnings[0]
+
+
+def test_resolve_log_level():
+    level, warning = main_mod._resolve_log_level("debug")
+    assert level == logging.DEBUG and warning is None
+    level, warning = main_mod._resolve_log_level("WARNING")
+    assert level == logging.WARNING and warning is None
+    # Attribute-shaped but not a level (getattr would return a function).
+    level, warning = main_mod._resolve_log_level("info_")
+    assert level == logging.INFO and warning is not None
+    level, warning = main_mod._resolve_log_level("warn_once")
+    assert level == logging.INFO and "warn_once" in warning

@@ -1,0 +1,305 @@
+"""Trace-plane tests (ISSUE 2): per-stage spans, slow-cycle flight
+capture, the /debug surface, stage self-metrics, and the trace-id
+-correlated JSON log formatter.
+
+The acceptance scenario is the forced-slow cycle: a fake backend with an
+injected delay in ONE stage must surface in /debug/traces/slow with that
+stage dominating its span tree.
+"""
+
+import json
+import logging
+import time
+
+import pytest
+from prometheus_client.parser import text_string_to_metric_families
+
+from tpumon.backends.fake import FakeTpuBackend
+from tpumon.config import Config
+from tpumon.exporter.server import build_exporter
+
+#: Injected one-stage delay (seconds) and the slow-promotion budget (ms):
+#: the delay alone blows the budget, everything else is sub-ms.
+DELAY_S = 0.08
+SLOW_MS = 40.0
+
+
+def _delayed_backend(metric: str = "duty_cycle_pct", delay: float = DELAY_S):
+    be = FakeTpuBackend.preset("v4-8")
+    orig = be.sample
+
+    def slow_sample(name):
+        if name == metric:
+            time.sleep(delay)
+        return orig(name)
+
+    be.sample = slow_sample
+    return be
+
+
+@pytest.fixture
+def exporter_for():
+    built = []
+
+    def _build(backend, **cfg_kwargs):
+        cfg_kwargs.setdefault("pod_attribution", False)
+        cfg = Config(port=0, addr="127.0.0.1", interval=30.0, **cfg_kwargs)
+        exp = build_exporter(cfg, backend)
+        exp.start()
+        built.append(exp)
+        return exp
+
+    yield _build
+    for exp in built:
+        exp.close()
+
+
+def _get_json(scrape, url):
+    status, text = scrape(url)
+    return status, (json.loads(text) if text.strip() else None)
+
+
+def test_slow_cycle_flight_capture(exporter_for, scrape):
+    """The acceptance criterion: an injected one-stage delay appears in
+    /debug/traces/slow with the delayed stage dominating its span tree,
+    and the trace retains the cycle's PollStats."""
+    exp = exporter_for(
+        _delayed_backend(), trace_slow_cycle_ms=SLOW_MS
+    )
+    status, doc = _get_json(scrape, exp.server.url + "/debug/traces/slow")
+    assert status == 200
+    assert doc["slow_cycle_ms"] == SLOW_MS
+    assert doc["traces"], "the primed (delayed) cycle must be promoted"
+    trace = doc["traces"][-1]
+    assert trace["slow"] is True
+    assert trace["duration_seconds"] >= DELAY_S
+
+    # The top-level stage the delay lives in dominates the cycle...
+    stages = {s["name"]: s for s in trace["spans"]}
+    build = stages["build_families"]
+    assert build["duration_seconds"] > 0.5 * trace["duration_seconds"]
+    # ...and inside it, the per-metric device-query span names the guilty
+    # metric and carries (at least) the injected delay.
+    children = {s["name"]: s for s in build.get("spans", ())}
+    query = children["query:duty_cycle_pct"]
+    assert query["duration_seconds"] >= DELAY_S * 0.9
+    dominant = max(
+        build["spans"], key=lambda s: s["duration_seconds"]
+    )
+    assert dominant["name"] == "query:duty_cycle_pct"
+
+    # Flight-recorder payload: the poll's stats ride the slow trace.
+    assert trace["stats"]["families"] > 0
+    assert trace["stats"]["points"] > 0
+    assert trace["stats"]["coverage"] == 1.0
+
+
+def test_traces_ring_and_since_replay(exporter_for, scrape):
+    exp = exporter_for(FakeTpuBackend.preset("v4-8"))
+    exp.poller.poll_once()
+    exp.poller.poll_once()
+    status, doc = _get_json(scrape, exp.server.url + "/debug/traces")
+    assert status == 200
+    assert doc["cycles"] == 3  # prime + two manual polls
+    assert len(doc["traces"]) == 3
+    # Distinct, monotonically increasing trace ids.
+    seqs = [t["seq"] for t in doc["traces"]]
+    assert seqs == sorted(seqs) and len(set(t["id"] for t in doc["traces"])) == 3
+    # Spans carry offsets within the cycle, durations, and ok status.
+    for t in doc["traces"]:
+        names = [s["name"] for s in t["spans"]]
+        assert "build_families" in names and "publish" in names
+        for s in t["spans"]:
+            assert s["status"] == "ok"
+            assert s["duration_seconds"] >= 0.0
+
+    # ?since= replay: the far future filters everything, 0 replays all,
+    # NaN/negative is a 400 (shared _finite validator).
+    _, doc = _get_json(
+        scrape, exp.server.url + f"/debug/traces?since={time.time() + 3600}"
+    )
+    assert doc["traces"] == []
+    status, _ = _get_json(scrape, exp.server.url + "/debug/traces?since=nan")
+    assert status == 400
+
+
+def test_trace_disabled_404s_and_skips_recording(exporter_for, scrape):
+    exp = exporter_for(FakeTpuBackend.preset("v4-8"), trace=False)
+    status, _ = scrape(exp.server.url + "/debug/traces")
+    assert status == 404
+    status, _ = scrape(exp.server.url + "/debug/traces/slow")
+    assert status == 404
+    assert exp.tracer is None
+    # /debug/vars is independent of the tracer: still served.
+    status, doc = _get_json(scrape, exp.server.url + "/debug/vars")
+    assert status == 200
+    assert "trace" not in doc
+
+
+def test_debug_vars_surface(exporter_for, scrape):
+    exp = exporter_for(FakeTpuBackend.preset("v4-8"))
+    status, doc = _get_json(scrape, exp.server.url + "/debug/vars")
+    assert status == 200
+    assert doc["backend"] == "fake"
+    assert doc["uptime_seconds"] >= 0
+    assert doc["config"]["interval"] == 30.0
+    assert doc["config"]["trace"] is True
+    assert doc["last_poll"]["families"] > 0
+    assert doc["trace"]["cycles"] >= 1
+    assert doc["trace"]["ring_capacity"] == 128
+    assert doc["history"]["series"] > 0
+    assert doc["anomaly"]["detectors"]
+    assert any("tpumon-poller" in name for name in doc["threads"])
+    assert isinstance(doc["gc"]["counts"], list)
+
+
+def test_stage_duration_metric_scrapeable(exporter_for, scrape):
+    """tpumon_trace_stage_duration_seconds{stage=...} rides the normal
+    self-telemetry page from the very first scrape."""
+    exp = exporter_for(FakeTpuBackend.preset("v4-8"))
+    _, text = scrape(exp.server.url + "/metrics")
+    fams = {f.name: f for f in text_string_to_metric_families(text)}
+    hist = fams["tpumon_trace_stage_duration_seconds"]
+    stages = {
+        s.labels["stage"]
+        for s in hist.samples
+        if s.name.endswith("_count")
+    }
+    assert {"build_families", "history_record", "anomaly", "publish"} <= stages
+    counts = {
+        s.labels["stage"]: s.value
+        for s in hist.samples
+        if s.name.endswith("_count")
+    }
+    assert counts["build_families"] >= 1  # the priming cycle observed
+
+
+def test_stage_error_counter_alertable(exporter_for, scrape):
+    """The satellite: swallowed history/anomaly failures count in
+    tpumon_poll_stage_errors_total instead of being log-only."""
+    exp = exporter_for(FakeTpuBackend.preset("v4-8"))
+
+    def boom(*a, **k):
+        raise RuntimeError("injected history failure")
+
+    exp.history.record_families = boom
+    exp.poller.poll_once()  # must survive
+    _, text = scrape(exp.server.url + "/metrics")
+    fams = {f.name: f for f in text_string_to_metric_families(text)}
+    errs = {
+        s.labels["stage"]: s.value
+        for s in fams["tpumon_poll_stage_errors"].samples
+        if s.name.endswith("_total")
+    }
+    assert errs["history_record"] >= 1
+    assert errs["anomaly"] == 0
+    # The span for the failed stage is marked, trace survives the cycle.
+    (last,) = exp.tracer.traces()[-1:]
+    history_span = next(
+        s for s in last["spans"] if s["name"] == "history_record"
+    )
+    assert history_span["status"] == "error"
+
+
+def test_smi_slowest_cycle_line(exporter_for, scrape):
+    """smi's trace surface: snapshot_from_url folds /debug/traces into a
+    slow_cycle summary and render prints the stage breakdown."""
+    import io
+
+    from tpumon.smi import render, snapshot_from_url
+
+    exp = exporter_for(_delayed_backend(), trace_slow_cycle_ms=SLOW_MS)
+    snap = snapshot_from_url(exp.server.url, timeout=10, window=60)
+    slow = snap["slow_cycle"]
+    assert slow["duration_seconds"] >= DELAY_S
+    assert slow["slow"] is True
+    assert slow["stages"][0][0] == "build_families"
+    out = io.StringIO()
+    render(snap, out)
+    text = out.getvalue()
+    assert "slowest recent cycle SLOW:" in text
+    assert "build_families" in text
+
+
+def test_doctor_stage_breakdown():
+    import io
+
+    from tpumon import doctor
+
+    out = io.StringIO()
+    # rc reflects device health (the fake v4-8 ships a deterministic bad
+    # ICI link), which is not under test here — only the breakdown is.
+    doctor.run(
+        Config(pod_attribution=False),
+        out=out,
+        backend=FakeTpuBackend.preset("v4-8"),
+    )
+    text = out.getvalue()
+    assert "poll stage breakdown (one cycle," in text
+    # Stage lines are duration-sorted spans of the real cycle.
+    assert "ms total):" in text and "health" in text
+
+
+def test_json_log_formatter_trace_id_correlation():
+    from tpumon.trace import JsonLogFormatter, Tracer
+
+    fmt = JsonLogFormatter()
+    rec = logging.LogRecord(
+        "tpumon.test", logging.WARNING, __file__, 1, "boom %s", ("x",), None
+    )
+    tracer = Tracer()
+    with tracer.cycle() as cycle:
+        inside = json.loads(fmt.format(rec))
+    outside = json.loads(fmt.format(rec))
+    assert inside["message"] == "boom x"
+    assert inside["level"] == "WARNING"
+    assert inside["trace_id"] == cycle.trace_id
+    assert "trace_id" not in outside
+
+
+def test_tracer_rings_bounded_and_error_cycles_recorded():
+    from tpumon.trace import Tracer, trace_span
+
+    tracer = Tracer(slow_cycle_ms=0.0, ring=4, slow_ring=2)
+    for i in range(10):
+        with tracer.cycle():
+            with trace_span(f"stage{i}"):
+                pass
+    counts = tracer.counts()
+    assert counts["cycles"] == 10
+    assert counts["ring"] == 4 and counts["slow"] == 2  # bounded
+    # slow_cycle_ms=0 promotes every cycle; rings keep the newest.
+    assert [t["seq"] for t in tracer.traces(slow=True)] == [9, 10]
+
+    # A cycle that raises is still recorded, marked error.
+    with pytest.raises(RuntimeError):
+        with tracer.cycle():
+            with trace_span("explode"):
+                raise RuntimeError("kaboom")
+    last = tracer.traces()[-1]
+    assert last["status"] == "error"
+    assert last["spans"][0]["status"] == "error"
+    assert "kaboom" in last["spans"][0]["detail"]
+
+
+def test_ambient_span_is_noop_without_cycle():
+    from tpumon.trace import current_trace_id, trace_span
+
+    assert current_trace_id() is None
+    with trace_span("orphan") as sp:
+        assert sp is None  # no open cycle on this thread: no-op
+
+
+def test_grpc_serving_span_feeds_stage_metric(exporter_for):
+    """The exporter's own gRPC Get runs outside any poll cycle, yet its
+    serving span must land in the stage-duration histogram."""
+    pytest.importorskip("grpc")
+    from tpumon.exporter.grpc_service import fetch_page
+
+    exp = exporter_for(FakeTpuBackend.preset("v4-8"), grpc_serve_port=0)
+    if exp.grpc_server is None:
+        pytest.skip("grpc service unavailable")
+    page, version = fetch_page(f"127.0.0.1:{exp.grpc_server.port}")
+    assert b"accelerator_device_count" in page and version >= 1
+    hist = exp.telemetry.trace_stage_duration.labels(stage="grpc_serve")
+    assert hist._sum.get() > 0.0

@@ -36,6 +36,7 @@ import time
 
 from tpumon.backends.base import BackendError, RawMetric
 from tpumon.discovery.topology import Topology, discover
+from tpumon.trace import trace_span
 
 log = logging.getLogger(__name__)
 
@@ -628,7 +629,11 @@ class GrpcMonitoringBackend:
         from tpumon.backends.dynamic_stub import message_records
 
         try:
-            resp = stub.call(self._list_method, timeout=self.timeout)
+            # Nested under the poll cycle's list_metrics span when the
+            # exporter's trace plane is on (tpumon.trace); no-op
+            # otherwise — doctor and ad-hoc callers pay nothing.
+            with trace_span(f"rpc:{self._list_method}", stage="backend_rpc"):
+                resp = stub.call(self._list_method, timeout=self.timeout)
         except Exception as exc:
             log.debug("grpc %s failed: %s", self._list_method, exc)
             self._note_stub_call(ok=False)
@@ -658,7 +663,12 @@ class GrpcMonitoringBackend:
         name_field = self._request_name_field(method)
         fields = {name_field: server_name} if name_field else {}
         try:
-            resp = stub.call(self._get_method, timeout=self.timeout, **fields)
+            with trace_span(
+                f"rpc:{self._get_method}:{server_name}", stage="backend_rpc"
+            ):
+                resp = stub.call(
+                    self._get_method, timeout=self.timeout, **fields
+                )
         except Exception as exc:
             self._note_stub_call(ok=False)
             raise BackendError(

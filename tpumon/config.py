@@ -119,8 +119,23 @@ class Config:
     anomaly: bool = True
     #: Per-device retained-event cap for the anomaly engine's rings.
     anomaly_events_max: int = 256
+    #: Internal trace plane (tpumon/trace): per-stage spans around every
+    #: poll-pipeline stage, served at /debug/traces (+/slow) and as the
+    #: tpumon_trace_stage_duration_seconds self-metric.
+    trace: bool = True
+    #: Poll cycles slower than this many milliseconds are promoted to the
+    #: slow-cycle flight recorder (/debug/traces/slow) with their full
+    #: span tree and PollStats.
+    trace_slow_cycle_ms: float = 250.0
+    #: Completed-cycle trace ring capacity (/debug/traces).
+    trace_ring: int = 128
+    #: Slow-cycle ring capacity (/debug/traces/slow).
+    trace_slow_ring: int = 32
     #: Log level name.
     log_level: str = "INFO"
+    #: Log output format: "text" (human) or "json" (one structured object
+    #: per line, trace-id correlated — tpumon/trace/logfmt.py).
+    log_format: str = "text"
     #: Path where the discovery sidecar writes topology JSON.
     topology_out: str = "/var/run/tpumon/topology.json"
 
@@ -155,6 +170,13 @@ class Config:
             anomaly_events_max=_env_int(
                 "ANOMALY_EVENTS_MAX", base.anomaly_events_max
             ),
+            trace=_env_bool("TRACE", base.trace),
+            trace_slow_cycle_ms=_env_float(
+                "TRACE_SLOW_CYCLE_MS", base.trace_slow_cycle_ms
+            ),
+            trace_ring=_env_int("TRACE_RING", base.trace_ring),
+            trace_slow_ring=_env_int("TRACE_SLOW_RING", base.trace_slow_ring),
+            log_format=_env("LOG_FORMAT", base.log_format) or base.log_format,
             kubelet_socket=_env("KUBELET_SOCKET", base.kubelet_socket)
             or base.kubelet_socket,
             log_level=_env("LOG_LEVEL", base.log_level) or base.log_level,
@@ -200,7 +222,19 @@ class Config:
             type=int,
             help="per-device retained-event cap for the anomaly engine",
         )
+        g.add_argument(
+            "--trace-slow-cycle-ms",
+            type=float,
+            help="promote poll cycles slower than this to the slow-cycle "
+            "trace ring (/debug/traces/slow)",
+        )
         g.add_argument("--log-level", help="log level")
+        g.add_argument(
+            "--log-format",
+            choices=("text", "json"),
+            help="log output format (json = structured, trace-id "
+            "correlated)",
+        )
         g.add_argument("--kubelet-socket", help="pod-resources gRPC socket")
         g.add_argument("--topology-out", help="sidecar topology JSON path")
 

@@ -157,13 +157,35 @@ def run(cfg: Config, out=sys.stdout, backend=None) -> int:
         # reuse the loop's samples, so zero extra device queries.
         from tpumon import health as health_mod
         from tpumon.exporter.collector import build_families
+        from tpumon.trace import Tracer
 
-        _, stats = build_families(backend, cfg)
+        # Trace the one cycle doctor runs (tpumon.trace) — the same span
+        # tree a live exporter serves at /debug/traces, printed below as
+        # the per-stage breakdown.
+        tracer = Tracer(slow_cycle_ms=float("inf"), ring=1)
+        with tracer.cycle():
+            _, stats = build_families(backend, cfg)
         health_doc = stats.health or {"status": health_mod.OK, "findings": []}
         health_status = health_doc["status"]
         p(f"\ndevice health: {health_status.upper()}")
         for f in health_doc["findings"]:
             p(f"  [{f['severity']}] {f['message']}")
+
+        # Slowest stages of that cycle, duration-sorted — the "which
+        # stage would eat a 1 Hz budget on this node" answer without a
+        # running exporter.
+        (trace_doc,) = tracer.traces() or ({"spans": (), "duration_seconds": 0.0},)
+        stages = sorted(
+            trace_doc["spans"],
+            key=lambda s: -s["duration_seconds"],
+        )
+        if stages:
+            p(
+                "\npoll stage breakdown (one cycle, "
+                f"{trace_doc['duration_seconds'] * 1e3:.1f} ms total):"
+            )
+            for s in stages[:6]:
+                p(f"  {s['name']:<28s} {s['duration_seconds'] * 1e3:8.2f} ms")
 
         # Streaming anomaly detection (tpumon.anomaly): doctor runs ONE
         # poll cycle, and every detector needs warmup/streaks, so there is

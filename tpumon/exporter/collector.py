@@ -21,6 +21,7 @@ from tpumon.config import Config
 from tpumon.exporter.telemetry import SelfTelemetry
 from tpumon.parsing import parse
 from tpumon.schema import coverage, spec_for
+from tpumon.trace import trace_span
 
 log = logging.getLogger(__name__)
 
@@ -70,7 +71,10 @@ class SampleCache:
         from tpumon._native import render_families
 
         snap = tuple(families)
-        rendered = render_families(snap)
+        # Child spans of the poller's "publish" stage: the exposition
+        # render is the O(samples) half, the swap is a lock + notify.
+        with trace_span("render"):
+            rendered = render_families(snap)
         with self._cond:
             self._snapshot = snap
             self._rendered = rendered
@@ -171,7 +175,8 @@ def build_families(
     distribution across polls — state outlives this call.
     """
     stats = PollStats()
-    topo = backend.topology()
+    with trace_span("topology"):
+        topo = backend.topology()
     base = topo.base_labels()
     base_keys = tuple(base)
     stats.base_keys = base_keys
@@ -181,7 +186,8 @@ def build_families(
 
     list_failed = False
     try:
-        supported = tuple(backend.list_metrics())
+        with trace_span("list_metrics"):
+            supported = tuple(backend.list_metrics())
     except Exception as exc:
         log.warning("list_metrics failed: %s", exc)
         stats.backend_errors += 1
@@ -203,7 +209,8 @@ def build_families(
             unmapped.append(name)
             continue
         try:
-            raw = backend.sample(name)
+            with trace_span(f"query:{name}"):
+                raw = backend.sample(name)
         except BackendError as exc:
             log.debug("sample(%s) failed: %s", name, exc)
             stats.backend_errors += 1
@@ -213,38 +220,42 @@ def build_families(
             stats.backend_errors += 1
             continue
 
-        result = parse(raw, spec)
-        stats.parse_errors += result.errors
-        if result.empty:
-            # Runtime-detached / no data: family absent, not zero
-            # (SURVEY.md §2.2 caveat).
-            continue
-        if histograms is not None:
-            # Cumulative distribution of the 1 Hz series (BASELINE
-            # config 3 "histograms"); no-op for non-distribution sources.
-            histograms.observe(name, result.points)
+        with trace_span(f"parse:{name}"):
+            result = parse(raw, spec)
+            stats.parse_errors += result.errors
+            if result.empty:
+                # Runtime-detached / no data: family absent, not zero
+                # (SURVEY.md §2.2 caveat).
+                continue
+            if histograms is not None:
+                # Cumulative distribution of the 1 Hz series (BASELINE
+                # config 3 "histograms"); no-op for non-distribution
+                # sources.
+                histograms.observe(name, result.points)
 
-        fam = GaugeMetricFamily(
-            spec.family, spec.help, labels=base_keys + spec.label_keys
-        )
-        for point in result.points:
-            fam.add_metric(
-                base_vals
-                + tuple(point.labels.get(k, "") for k in spec.label_keys),
-                point.value,
+            fam = GaugeMetricFamily(
+                spec.family, spec.help, labels=base_keys + spec.label_keys
             )
-        families.append(fam)
-        stats.points += len(result.points)
+            for point in result.points:
+                fam.add_metric(
+                    base_vals
+                    + tuple(point.labels.get(k, "") for k in spec.label_keys),
+                    point.value,
+                )
+            families.append(fam)
+            stats.points += len(result.points)
 
     if histograms is not None:
-        families.extend(histograms.families(base_keys, base_vals))
+        with trace_span("histograms"):
+            families.extend(histograms.families(base_keys, base_vals))
 
     # Per-core state via the tpuz surface (SURVEY.md §2.2) — optional on the
     # protocol; degrades to absent when the runtime is down.
     core_states = getattr(backend, "core_states", None)
     if core_states is not None:
         try:
-            states = core_states()
+            with trace_span("core_states"):
+                states = core_states()
         except Exception as exc:
             log.debug("core_states failed: %s", exc)
             states = {}
@@ -293,7 +304,8 @@ def build_families(
     if cfg.host_metrics:
         from tpumon.exporter.host import host_families
 
-        families.extend(host_families(base_keys, base_vals))
+        with trace_span("host_metrics"):
+            families.extend(host_families(base_keys, base_vals))
 
     # Derived health verdicts as scrapeable families (dcgmi-health
     # analogue): alerts can fire on the verdict without re-encoding the
@@ -306,37 +318,47 @@ def build_families(
     from tpumon.families import HEALTH_FAMILIES
     from tpumon.smi import snapshot_from_families
 
-    snap = snapshot_from_families(families)
-    snap["coverage"] = stats.coverage
-    findings = health_mod.evaluate(snap)
-    stats.health = health_mod.report(snap, findings)
-    stats.snapshot = snap
+    with trace_span("health"):
+        snap = snapshot_from_families(families)
+        snap["coverage"] = stats.coverage
+        findings = health_mod.evaluate(snap)
+        stats.health = health_mod.report(snap, findings)
+        stats.snapshot = snap
 
-    status_help, status_labels = HEALTH_FAMILIES["accelerator_health_status"]
-    status = GaugeMetricFamily(
-        "accelerator_health_status", status_help, labels=base_keys + status_labels
-    )
-    status.add_metric(
-        base_vals, float(health_mod.severity_value(stats.health["status"]))
-    )
-    families.append(status)
-    if findings:
-        counts = Counter((f.severity, f.code) for f in findings)
-        find_help, find_labels = HEALTH_FAMILIES["accelerator_health_findings"]
-        fam = GaugeMetricFamily(
-            "accelerator_health_findings",
-            find_help,
-            labels=base_keys + find_labels,
+        status_help, status_labels = HEALTH_FAMILIES[
+            "accelerator_health_status"
+        ]
+        status = GaugeMetricFamily(
+            "accelerator_health_status",
+            status_help,
+            labels=base_keys + status_labels,
         )
-        for (sev, code), n in sorted(counts.items()):
-            fam.add_metric(base_vals + (sev, code), float(n))
-        families.append(fam)
+        status.add_metric(
+            base_vals, float(health_mod.severity_value(stats.health["status"]))
+        )
+        families.append(status)
+        if findings:
+            counts = Counter((f.severity, f.code) for f in findings)
+            find_help, find_labels = HEALTH_FAMILIES[
+                "accelerator_health_findings"
+            ]
+            fam = GaugeMetricFamily(
+                "accelerator_health_findings",
+                find_help,
+                labels=base_keys + find_labels,
+            )
+            for (sev, code), n in sorted(counts.items()):
+                fam.add_metric(base_vals + (sev, code), float(n))
+            families.append(fam)
 
     # Chip→pod attribution (kubelet pod-resources API, SURVEY §7(d)):
     # optional, never fatal, absent off-cluster.
     if attribution is not None:
         try:
-            families.extend(attribution.families(base_keys, base_vals, topo))
+            with trace_span("attribution"):
+                families.extend(
+                    attribution.families(base_keys, base_vals, topo)
+                )
         except Exception as exc:
             log.debug("pod attribution failed: %s", exc)
 
@@ -360,6 +382,7 @@ class Poller:
         history=None,
         histograms=None,
         anomaly=None,
+        tracer=None,
     ) -> None:
         self._backend = backend
         self._cfg = cfg
@@ -369,6 +392,7 @@ class Poller:
         self._history = history
         self._histograms = histograms
         self._anomaly = anomaly
+        self._tracer = tracer
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="tpumon-poller", daemon=True
@@ -379,34 +403,63 @@ class Poller:
         self.on_cycle = None
 
     def poll_once(self) -> PollStats:
+        # The traced path wraps the cycle in a tpumon.trace span tree
+        # (recorded on this thread, rendered lazily on /debug reads);
+        # tracing disabled runs the identical pipeline bare.
+        if self._tracer is None:
+            return self._poll_cycle()
+        with self._tracer.cycle() as cycle:
+            stats = self._poll_cycle()
+            if cycle is not None:
+                cycle.set_stats(stats)
+            return stats
+
+    def _poll_cycle(self) -> PollStats:
         t0 = time.monotonic()
         # Backends with a time dimension (the fake) advance one step per
         # poll cycle so live data evolves; real backends don't define this.
         advance = getattr(self._backend, "advance", None)
         if advance is not None:
-            advance()
-        families, stats = build_families(
-            self._backend, self._cfg, self._attribution, self._histograms
-        )
+            with trace_span("advance"):
+                advance()
+        with trace_span("build_families"):
+            families, stats = build_families(
+                self._backend, self._cfg, self._attribution, self._histograms
+            )
         now = time.time()
         if self._history is not None:
             # Flight recorder (DCGM field-cache analogue): keep the 1 Hz
             # series Prometheus's 15-60 s scrape interval aliases away.
             # Recorded BEFORE the anomaly pass so an event onsetting this
             # cycle can extract a window that includes this cycle's sample.
-            try:
-                self._history.record_families(now, families, stats.base_keys)
-            except Exception:
-                log.exception("history record failed")
+            with trace_span("history_record") as sp:
+                try:
+                    self._history.record_families(
+                        now, families, stats.base_keys
+                    )
+                except Exception:
+                    log.exception("history record failed")
+                    if sp is not None:
+                        sp.status = "error"
+                    self._telemetry.poll_stage_errors.labels(
+                        stage="history_record"
+                    ).inc()
         if self._anomaly is not None:
             # Streaming detection over the snapshot this cycle already
             # parsed (tpumon.anomaly): zero extra device queries, and the
             # tpu_anomaly_* families ride the same published page.
-            try:
-                families.extend(self._anomaly.cycle(now, stats))
-            except Exception:
-                log.exception("anomaly detection failed")
-        self._cache.publish(families)
+            with trace_span("anomaly") as sp:
+                try:
+                    families.extend(self._anomaly.cycle(now, stats))
+                except Exception:
+                    log.exception("anomaly detection failed")
+                    if sp is not None:
+                        sp.status = "error"
+                    self._telemetry.poll_stage_errors.labels(
+                        stage="anomaly"
+                    ).inc()
+        with trace_span("publish"):
+            self._cache.publish(families)
         elapsed = time.monotonic() - t0
 
         t = self._telemetry

@@ -75,18 +75,30 @@ class MetricsGrpcServer:
     wait_newer for the Watch push loop.
     """
 
-    def __init__(self, render_with_version, cache, addr: str, port: int) -> None:
+    def __init__(
+        self, render_with_version, cache, addr: str, port: int, tracer=None
+    ) -> None:
         import threading
 
         import grpc
         from concurrent.futures import ThreadPoolExecutor
+        from contextlib import nullcontext
 
         self._render_with_version = render_with_version
         self._cache = cache
         watcher_slots = threading.BoundedSemaphore(_MAX_WATCHERS)
 
+        def serve_span(name: str):
+            # tpumon.trace serving spans: these run on gRPC worker
+            # threads (no poll cycle open), so they feed only the
+            # per-stage duration self-metric, never a cycle's span tree.
+            if tracer is None:
+                return nullcontext()
+            return tracer.span(name, stage="grpc_serve")
+
         def get(request: bytes, context):
-            page, version = self._render_with_version()
+            with serve_span("grpc_get"):
+                page, version = self._render_with_version()
             return encode_page_response(page, version)
 
         def watch(request: bytes, context):
@@ -101,7 +113,8 @@ class MetricsGrpcServer:
                     newer = cache.wait_newer(version, _WATCH_IDLE_TIMEOUT)
                     if newer == version:
                         continue  # idle timeout: re-check liveness
-                    page, version = self._render_with_version()
+                    with serve_span("grpc_watch_push"):
+                        page, version = self._render_with_version()
                     yield encode_page_response(page, version)
             finally:
                 watcher_slots.release()

@@ -16,13 +16,46 @@ from tpumon.exporter.server import build_exporter
 
 log = logging.getLogger(__name__)
 
+#: Level names main() accepts (the logging module's public set).
+_LOG_LEVELS = ("DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL")
+
+
+def _resolve_log_level(name: str) -> tuple[int, str | None]:
+    """Level name → numeric level, plus a warning message when the name
+    is invalid (returned rather than logged, because logging isn't
+    configured yet when this runs — the caller logs it right after
+    ``basicConfig``, once, instead of silently serving at INFO)."""
+    level = getattr(logging, name.upper(), None)
+    if isinstance(level, int):
+        return level, None
+    return logging.INFO, (
+        f"invalid TPUMON_LOG_LEVEL {name!r}; accepted: "
+        f"{', '.join(_LOG_LEVELS)} — falling back to INFO"
+    )
+
+
+def _configure_logging(cfg: Config) -> None:
+    level, level_warning = _resolve_log_level(cfg.log_level)
+    if cfg.log_format.strip().lower() == "json":
+        # Structured line-per-record JSON, trace-id correlated
+        # (tpumon/trace/logfmt.py) — opt-in via TPUMON_LOG_FORMAT=json.
+        from tpumon.trace import JsonLogFormatter
+
+        handler = logging.StreamHandler()
+        handler.setFormatter(JsonLogFormatter())
+        logging.basicConfig(level=level, handlers=[handler])
+    else:
+        logging.basicConfig(
+            level=level,
+            format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+        )
+    if level_warning is not None:
+        log.warning("%s", level_warning)
+
 
 def main(argv: list[str] | None = None) -> int:
     cfg = Config.load(argv)
-    logging.basicConfig(
-        level=getattr(logging, cfg.log_level.upper(), logging.INFO),
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
-    )
+    _configure_logging(cfg)
 
     # Scrape-tail control, daemon-only (embedders keep their own setting):
     # the poll cycle holds the GIL in ~ms chunks each second, and CPython's

@@ -135,7 +135,8 @@ def _json_dump(doc) -> bytes:
 
 def _make_app(
     render_body, telemetry: SelfTelemetry, health, history=None,
-    device_health=None, post_scrape=None, anomalies=None,
+    device_health=None, post_scrape=None, anomalies=None, tracer=None,
+    debug_vars=None,
 ):
     """WSGI app. ``render_body(want_gzip: bool) -> bytes`` produces the
     /metrics payload (already gzip-encoded when asked); the exporter
@@ -143,12 +144,37 @@ def _make_app(
     plain registry render. ``history`` (a tpumon.history.History) enables
     the /history JSON endpoint; ``device_health`` (a () -> dict callable)
     enables /health/devices (the dcgmi-health analogue); ``anomalies``
-    (a tpumon.anomaly.AnomalyEngine) enables /anomalies. ``post_scrape``
+    (a tpumon.anomaly.AnomalyEngine) enables /anomalies; ``tracer``
+    (a tpumon.trace.Tracer) enables /debug/traces[/slow] and
+    ``debug_vars`` (a () -> dict callable) /debug/vars. ``post_scrape``
     (if set) runs after the duration observation — the exporter uses it
     to poke the off-path self-telemetry renderer."""
 
     def app(environ, start_response):
         path = environ.get("PATH_INFO", "/")
+        if path in ("/debug/traces", "/debug/traces/slow") and tracer is not None:
+            body, status = _traces_response(
+                tracer, environ.get("QUERY_STRING", ""),
+                slow=path.endswith("/slow"),
+            )
+            start_response(
+                status,
+                [
+                    ("Content-Type", "application/json; charset=utf-8"),
+                    ("Content-Length", str(len(body))),
+                ],
+            )
+            return [body]
+        if path == "/debug/vars" and debug_vars is not None:
+            body = _json_dump(debug_vars())
+            start_response(
+                "200 OK",
+                [
+                    ("Content-Type", "application/json; charset=utf-8"),
+                    ("Content-Length", str(len(body))),
+                ],
+            )
+            return [body]
         if path == "/anomalies" and anomalies is not None:
             body, status = _anomalies_response(
                 anomalies, environ.get("QUERY_STRING", "")
@@ -220,7 +246,7 @@ def _make_app(
                 telemetry.scrape_duration.observe(time.perf_counter() - t0)
                 if post_scrape is not None:
                     post_scrape()
-        body = b"not found; try /metrics or /healthz\n"
+        body = b"not found; try /metrics, /healthz, or /debug/vars\n"
         start_response(
             "404 Not Found",
             [
@@ -274,6 +300,33 @@ def _history_response(history, query_string: str) -> tuple[bytes, str]:
         }
     )
     return body, "200 OK"
+
+
+def _traces_response(tracer, query_string: str, slow: bool) -> tuple[bytes, str]:
+    """The /debug/traces[/slow] JSON API (poll-thread state, rendered
+    lazily here — never on the scrape path).
+
+    - ``GET /debug/traces`` → the completed-cycle ring: per-cycle span
+      trees with trace id, stage names, monotonic start/duration, and
+      the PollStats scalars.
+    - ``GET /debug/traces/slow`` → only the cycles that overran the
+      TPUMON_TRACE_SLOW_CYCLE_MS budget — the exporter's own flight
+      recorder.
+    - ``?since=<ts>`` replays traces ending at/after ``ts`` — the same
+      replay semantics (and the same ``_finite`` validator) as /history
+      and /anomalies.
+    """
+    from urllib.parse import parse_qs
+
+    params = parse_qs(query_string)
+    since = _finite(params.get("since", ["0"])[0])
+    if since is None:
+        return b'{"error": "bad since"}\n', "400 Bad Request"
+    doc = tracer.counts()
+    doc["now"] = time.time()
+    doc["slow_cycle_ms"] = tracer.slow_cycle_ms
+    doc["traces"] = tracer.traces(slow=slow, since=since)
+    return _json_dump(doc), "200 OK"
 
 
 def _anomalies_response(engine, query_string: str) -> tuple[bytes, str]:
@@ -427,6 +480,7 @@ class Exporter:
     def __init__(self, cfg: Config, backend: Backend) -> None:
         self.cfg = cfg
         self.backend = backend
+        self._started_at = time.time()
         # Self-telemetry lives in its own registry: the device families are
         # pre-rendered once per poll (SampleCache), so a scrape serves
         # cached bytes + this small registry's render.
@@ -473,10 +527,33 @@ class Exporter:
             self.anomaly = AnomalyEngine(
                 history=self.history, max_events=max_events
             )
+        self.tracer = None
+        if cfg.trace:
+            from tpumon.trace import Tracer
+
+            defaults = type(cfg)()
+            slow_ms = cfg.trace_slow_cycle_ms
+            if slow_ms <= 0:  # malformed-knob stance, as history/anomaly
+                slow_ms = defaults.trace_slow_cycle_ms
+            ring = cfg.trace_ring if cfg.trace_ring > 0 else defaults.trace_ring
+            slow_ring = (
+                cfg.trace_slow_ring
+                if cfg.trace_slow_ring > 0
+                else defaults.trace_slow_ring
+            )
+            stage_hist = self.telemetry.trace_stage_duration
+
+            def observe_stage(stage: str, seconds: float) -> None:
+                stage_hist.labels(stage=stage).observe(seconds)
+
+            self.tracer = Tracer(
+                slow_cycle_ms=slow_ms, ring=ring, slow_ring=slow_ring,
+                observe=observe_stage,
+            )
         self.poller = Poller(
             backend, cfg, self.cache, self.telemetry, attribution,
             history=self.history, histograms=self.histograms,
-            anomaly=self.anomaly,
+            anomaly=self.anomaly, tracer=self.tracer,
         )
         version_fn = getattr(backend, "version", None)
         self.telemetry.backend_info.labels(
@@ -512,7 +589,8 @@ class Exporter:
         app = _make_app(
             render, self.telemetry, self._health, self.history,
             self._device_health, post_scrape=self._selfpage.poke,
-            anomalies=self.anomaly,
+            anomalies=self.anomaly, tracer=self.tracer,
+            debug_vars=self._debug_vars,
         )
         self.server = ExporterServer(app, cfg.addr, cfg.port)
         self.grpc_server = None
@@ -522,12 +600,56 @@ class Exporter:
 
                 self.grpc_server = MetricsGrpcServer(
                     self.render_with_version, self.cache, cfg.addr,
-                    cfg.grpc_serve_port,
+                    cfg.grpc_serve_port, tracer=self.tracer,
                 )
             except Exception as exc:
                 # grpcio missing or bind failure must not take down the
                 # HTTP scrape plane.
                 log.warning("grpc metrics service unavailable: %s", exc)
+
+    def _debug_vars(self) -> dict:
+        """The /debug/vars body (expvar analogue): process, config, and
+        subsystem occupancy — O(1) in-process reads only, no device
+        calls, nothing shared with the scrape path."""
+        import dataclasses
+        import gc
+        import os
+        import sys
+
+        stats = self.poller.last_stats
+        doc: dict = {
+            "now": time.time(),
+            "uptime_seconds": time.time() - self._started_at,
+            "pid": os.getpid(),
+            "python": sys.version.split()[0],
+            "backend": self.backend.name,
+            "config": dataclasses.asdict(self.cfg),
+            "gc": {"counts": gc.get_count(), "enabled": gc.isenabled()},
+            "threads": sorted(t.name for t in threading.enumerate()),
+            "cache_version": self.cache.rendered_with_version()[1],
+            "last_poll": {
+                "families": stats.families,
+                "points": stats.points,
+                "coverage": stats.coverage,
+                "backend_errors": stats.backend_errors,
+                "parse_errors": stats.parse_errors,
+            },
+        }
+        if self.tracer is not None:
+            doc["trace"] = {
+                "slow_cycle_ms": self.tracer.slow_cycle_ms,
+                **self.tracer.counts(),
+            }
+        if self.history is not None:
+            series, samples = self.history.stats()
+            doc["history"] = {
+                "series": series,
+                "samples": samples,
+                "native": self.history.is_native,
+            }
+        if self.anomaly is not None:
+            doc["anomaly"] = self.anomaly.summary()
+        return doc
 
     def _device_health(self) -> dict:
         """The /health/devices body: the verdict the poll cycle already

@@ -46,6 +46,24 @@ class SelfTelemetry:
             buckets=POLL_BUCKETS,
             registry=registry,
         )
+        self.trace_stage_duration = Histogram(
+            "tpumon_trace_stage_duration_seconds",
+            "Per-stage poll-pipeline span durations from the internal "
+            "trace plane (tpumon/trace); stage=publish covers the "
+            "exposition render, stage=backend_rpc the gRPC monitoring "
+            "RPCs, stage=grpc_serve the exporter's own Get/Watch.",
+            labelnames=("stage",),
+            buckets=POLL_BUCKETS,
+            registry=registry,
+        )
+        self.poll_stage_errors = Counter(
+            "tpumon_poll_stage_errors",
+            "Swallowed per-cycle stage failures (history record, anomaly "
+            "pass): the cycle survives but that stage's output is "
+            "missing — alertable instead of log-only.",
+            labelnames=("stage",),
+            registry=registry,
+        )
         self.poll_errors = Counter(
             "collector_errors_total",
             "Device-query or parse failures, by kind; samples are dropped, "
@@ -84,3 +102,9 @@ class SelfTelemetry:
         # Pre-create both error kinds so the families exist from scrape #1.
         self.poll_errors.labels(kind="backend")
         self.poll_errors.labels(kind="parse")
+        # Same for the trace-plane stages: the pipeline stages always run,
+        # so their series must exist before the first traced cycle lands.
+        for stage in ("build_families", "history_record", "anomaly", "publish"):
+            self.trace_stage_duration.labels(stage=stage)
+        self.poll_stage_errors.labels(stage="history_record")
+        self.poll_stage_errors.labels(stage="anomaly")

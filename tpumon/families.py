@@ -113,6 +113,18 @@ SELF_FAMILIES: dict[str, tuple[str, str]] = {
         "gauge",
         "Overrun of the configured interval (0 when keeping up)",
     ),
+    "tpumon_trace_stage_duration_seconds": (
+        "histogram",
+        "Per-stage poll-pipeline span durations from the internal trace "
+        "plane (tpumon/trace; stage ∈ pipeline stages plus backend_rpc "
+        "and grpc_serve — full span trees at /debug/traces)",
+    ),
+    "tpumon_poll_stage_errors_total": (
+        "counter",
+        "Swallowed per-cycle stage failures (history record, anomaly "
+        "pass) by stage — the cycle survives, the stage's output is "
+        "missing",
+    ),
 }
 
 #: family -> description (workload-side harness --metrics-port)

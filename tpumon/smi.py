@@ -268,6 +268,30 @@ def attach_anomalies(snap: dict, doc: dict) -> None:
     }
 
 
+def attach_slow_cycle(snap: dict, doc: dict) -> None:
+    """Fold a /debug/traces document into the snapshot: the slowest
+    retained poll cycle and its dominant stages (the trace plane's smi
+    surface — "which stage ate the budget" at a glance)."""
+    traces = doc.get("traces") or []
+    if not traces:
+        return
+    worst = max(traces, key=lambda t: t.get("duration_seconds", 0.0))
+    stages = sorted(
+        worst.get("spans") or [],
+        key=lambda s: -s.get("duration_seconds", 0.0),
+    )
+    snap["slow_cycle"] = {
+        "id": worst.get("id"),
+        "start_ts": worst.get("start_ts"),
+        "duration_seconds": worst.get("duration_seconds", 0.0),
+        "slow": bool(worst.get("slow")),
+        "stages": [
+            [s.get("name", "?"), s.get("duration_seconds", 0.0)]
+            for s in stages[:3]
+        ],
+    }
+
+
 def snapshot_from_url(url: str, timeout: float, window: float) -> dict:
     text = _fetch(url.rstrip("/") + "/metrics", timeout)
     snap = snapshot_from_text(text)
@@ -284,6 +308,13 @@ def snapshot_from_url(url: str, timeout: float, window: float) -> dict:
         )
     except (urllib.error.URLError, urllib.error.HTTPError, ValueError):
         pass  # older exporter or anomaly engine disabled
+    try:
+        attach_slow_cycle(
+            snap,
+            json.loads(_fetch(url.rstrip("/") + "/debug/traces", timeout)),
+        )
+    except (urllib.error.URLError, urllib.error.HTTPError, ValueError):
+        pass  # older exporter or trace plane disabled
     return snap
 
 
@@ -486,6 +517,20 @@ def render(snap: dict, out=None) -> None:
             )
         else:
             p(f"anomalies: none active ({anoms['total']} retained)")
+
+    slow = snap.get("slow_cycle")
+    if slow:
+        # Trace-plane summary (/debug/traces): the slowest retained poll
+        # cycle, stage-attributed.
+        stages = "  ".join(
+            f"{name} {dur * 1e3:.1f}ms" for name, dur in slow["stages"]
+        )
+        flag = " SLOW" if slow.get("slow") else ""
+        p(
+            f"slowest recent cycle{flag}: "
+            f"{slow['duration_seconds'] * 1e3:.1f} ms "
+            f"[trace {slow['id']}] — {stages}"
+        )
 
     if "workload" in snap:
         render_workload(snap["workload"], p)

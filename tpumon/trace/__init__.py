@@ -1,0 +1,34 @@
+"""Internal trace plane for the exporter itself (ISSUE 2).
+
+The rest of tpumon observes the accelerator; this package observes the
+monitor. A dependency-free span tracer wraps every stage of the poll
+pipeline in nested spans (per-cycle trace id, monotonic start/duration),
+keeps completed cycles in a bounded ring, and promotes cycles that
+overran the configured budget to a slow-cycle flight-recorder ring that
+retains the full span tree plus the poll's ``PollStats`` — so "the
+exporter is slow" becomes "stage X ate the budget in cycle Y" without a
+redeploy.
+
+Design rule inherited from the scrape-latency headline: **nothing here
+touches the scrape path**. Spans are recorded on the poll thread (and
+the gRPC serving threads for their own RPCs); traces render to JSON
+lazily, on ``/debug/traces`` reads only.
+"""
+
+from tpumon.trace.logfmt import JsonLogFormatter
+from tpumon.trace.tracer import (
+    CycleTrace,
+    Span,
+    Tracer,
+    current_trace_id,
+    trace_span,
+)
+
+__all__ = [
+    "CycleTrace",
+    "JsonLogFormatter",
+    "Span",
+    "Tracer",
+    "current_trace_id",
+    "trace_span",
+]

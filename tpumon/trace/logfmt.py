@@ -1,0 +1,33 @@
+"""Structured JSON logging, trace-id correlated (TPUMON_LOG_FORMAT=json).
+
+One JSON object per line on the standard logging stream: machine
+-parseable (jq / log pipelines), and every record emitted while a poll
+cycle is open on the logging thread carries that cycle's ``trace_id`` —
+so a "history record failed" log line pins to the exact span tree in
+``/debug/traces`` instead of "sometime around then".
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from tpumon.trace.tracer import current_trace_id
+
+
+class JsonLogFormatter(logging.Formatter):
+    """Line-per-record JSON; opt-in via TPUMON_LOG_FORMAT=json."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        doc: dict = {
+            "ts": round(record.created, 3),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        trace_id = current_trace_id()
+        if trace_id is not None:
+            doc["trace_id"] = trace_id
+        if record.exc_info:
+            doc["exc"] = self.formatException(record.exc_info)
+        return json.dumps(doc, ensure_ascii=False)

@@ -1,0 +1,245 @@
+"""Span tracer for the exporter's own pipeline — stdlib only.
+
+Thread model: a :class:`Tracer` is shared, but a *cycle* is thread-local.
+``Tracer.cycle()`` installs the tracer as the thread's ambient tracer;
+every :func:`trace_span` (or ``Tracer.span``) entered on that thread
+while the cycle is open nests into the current span — so code deep in
+the pipeline (``build_families`` internals, the gRPC backend's RPCs)
+traces itself without any plumbing, and the same code is a no-op on
+threads with no open cycle. ``Tracer.span`` called directly with no open
+cycle (the exporter's gRPC serving handlers) still feeds the per-stage
+duration metric, just without a tree to nest into.
+
+Completed cycles are appended to a bounded ring under a lock; after
+``_finish`` a :class:`CycleTrace` is immutable, so ``/debug`` readers
+render it to JSON outside the lock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+#: Budget above which a cycle is promoted to the slow ring (ms).
+DEFAULT_SLOW_CYCLE_MS = 250.0
+#: Completed-cycle ring capacity (/debug/traces).
+DEFAULT_RING = 128
+#: Slow-cycle flight-recorder ring capacity (/debug/traces/slow).
+DEFAULT_SLOW_RING = 32
+
+_tls = threading.local()
+
+
+def current_trace_id() -> str | None:
+    """Trace id of the cycle open on this thread (log correlation)."""
+    return getattr(_tls, "trace_id", None)
+
+
+@dataclass
+class Span:
+    """One timed stage; ``start`` is seconds since its cycle began."""
+
+    name: str
+    start: float = 0.0
+    duration: float = 0.0
+    status: str = "ok"
+    detail: str = ""
+    children: list["Span"] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        doc: dict = {
+            "name": self.name,
+            "start_seconds": round(self.start, 6),
+            "duration_seconds": round(self.duration, 6),
+            "status": self.status,
+        }
+        if self.detail:
+            doc["detail"] = self.detail
+        if self.children:
+            doc["spans"] = [c.to_dict() for c in self.children]
+        return doc
+
+
+@dataclass
+class CycleTrace:
+    """One poll cycle's span tree plus its identity and verdict."""
+
+    trace_id: str
+    seq: int
+    start_ts: float  # wall clock, for ?since= replay
+    root: Span
+    duration: float = 0.0
+    status: str = "ok"
+    slow: bool = False
+    #: Scalar PollStats summary, attached by the poller before the cycle
+    #: closes (the slow ring's flight-recorder payload).
+    stats: dict | None = None
+
+    def set_stats(self, stats) -> None:
+        """Attach a PollStats' scalar fields (never the parsed snapshot —
+        that is megabyte-scale and already served by /metrics)."""
+        self.stats = {
+            "backend_errors": stats.backend_errors,
+            "parse_errors": stats.parse_errors,
+            "families": stats.families,
+            "points": stats.points,
+            "coverage": stats.coverage,
+            "unmapped": list(stats.unmapped),
+        }
+
+    def to_dict(self) -> dict:
+        doc: dict = {
+            "id": self.trace_id,
+            "seq": self.seq,
+            "start_ts": self.start_ts,
+            "end_ts": self.start_ts + self.duration,
+            "duration_seconds": round(self.duration, 6),
+            "status": self.status,
+            "slow": self.slow,
+            "spans": [c.to_dict() for c in self.root.children],
+        }
+        if self.stats is not None:
+            doc["stats"] = self.stats
+        return doc
+
+
+class Tracer:
+    """Bounded-ring cycle recorder plus the ambient-span machinery.
+
+    ``observe`` (optional) is called as ``observe(stage, seconds)`` for
+    every span that maps to a stage bucket — top-level pipeline stages
+    under their own name, nested spans only when they pass an explicit
+    ``stage=`` (the gRPC RPC/serving spans) — feeding the
+    ``tpumon_trace_stage_duration_seconds`` self-metric without giving
+    per-metric span names label cardinality.
+    """
+
+    def __init__(
+        self,
+        slow_cycle_ms: float = DEFAULT_SLOW_CYCLE_MS,
+        ring: int = DEFAULT_RING,
+        slow_ring: int = DEFAULT_SLOW_RING,
+        observe=None,
+    ) -> None:
+        self.slow_cycle_ms = float(slow_cycle_ms)
+        self._observe = observe
+        self._lock = threading.Lock()
+        self._ring: deque[CycleTrace] = deque(maxlen=max(1, int(ring)))
+        self._slow: deque[CycleTrace] = deque(maxlen=max(1, int(slow_ring)))
+        self._seq = 0
+        self._cycles = 0
+
+    # -- recording (poll thread) ------------------------------------------
+
+    @contextmanager
+    def cycle(self):
+        """Open one traced cycle on this thread; yields the CycleTrace
+        (or None when a cycle is already open — the outer one wins)."""
+        if getattr(_tls, "tracer", None) is not None:
+            yield None
+            return
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        ct = CycleTrace(
+            trace_id=f"{seq:08x}",
+            seq=seq,
+            start_ts=time.time(),
+            root=Span("cycle"),
+        )
+        _tls.tracer = self
+        _tls.stack = [ct.root]
+        _tls.t0 = time.perf_counter()
+        _tls.trace_id = ct.trace_id
+        try:
+            yield ct
+        except BaseException as exc:
+            ct.status = ct.root.status = "error"
+            ct.root.detail = repr(exc)[:200]
+            raise
+        finally:
+            ct.duration = ct.root.duration = time.perf_counter() - _tls.t0
+            _tls.tracer = None
+            _tls.stack = None
+            _tls.trace_id = None
+            self._finish(ct)
+
+    def _finish(self, ct: CycleTrace) -> None:
+        ct.slow = ct.duration * 1000.0 >= self.slow_cycle_ms
+        with self._lock:
+            self._cycles += 1
+            self._ring.append(ct)
+            if ct.slow:
+                self._slow.append(ct)
+
+    @contextmanager
+    def span(self, name: str, stage: str | None = None):
+        """One timed span. Nests into this thread's open cycle when there
+        is one; otherwise tree-less (stage metric only)."""
+        stack = getattr(_tls, "stack", None)
+        in_cycle = stack is not None and getattr(_tls, "tracer", None) is self
+        t0 = time.perf_counter()
+        sp = Span(name, (t0 - _tls.t0) if in_cycle else 0.0)
+        if in_cycle:
+            stack[-1].children.append(sp)
+            stack.append(sp)
+        try:
+            yield sp
+        except BaseException as exc:
+            sp.status = "error"
+            sp.detail = repr(exc)[:200]
+            raise
+        finally:
+            sp.duration = time.perf_counter() - t0
+            top_level = False
+            if in_cycle:
+                stack.pop()
+                top_level = len(stack) == 1
+            bucket = stage if stage is not None else (
+                name if (top_level or not in_cycle) else None
+            )
+            if self._observe is not None and bucket:
+                try:
+                    self._observe(bucket, sp.duration)
+                except Exception:
+                    pass  # a metrics hiccup must never fail the stage
+
+    # -- query (HTTP threads) ---------------------------------------------
+
+    def traces(self, slow: bool = False, since: float = 0.0) -> list[dict]:
+        """Retained cycle traces ending at/after ``since`` (the /history
+        replay semantics), oldest first, rendered lazily."""
+        with self._lock:
+            items = list(self._slow if slow else self._ring)
+        return [
+            ct.to_dict()
+            for ct in items
+            if ct.start_ts + ct.duration >= since
+        ]
+
+    def counts(self) -> dict:
+        """Ring occupancy for /debug/vars and the trace envelopes."""
+        with self._lock:
+            return {
+                "cycles": self._cycles,
+                "ring": len(self._ring),
+                "ring_capacity": self._ring.maxlen,
+                "slow": len(self._slow),
+                "slow_capacity": self._slow.maxlen,
+            }
+
+
+@contextmanager
+def trace_span(name: str, stage: str | None = None):
+    """Ambient span: nests into this thread's open cycle, no-op (yields
+    None) when none — how pipeline internals trace themselves without a
+    tracer reference."""
+    tracer = getattr(_tls, "tracer", None)
+    if tracer is None:
+        yield None
+        return
+    with tracer.span(name, stage=stage) as sp:
+        yield sp

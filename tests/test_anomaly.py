@@ -138,6 +138,31 @@ class TestDetectors:
         _run(eng, trace)
         assert [e for e in eng.events() if e["detector"] == "ici_flap"] == []
 
+    def test_flap_clears_when_link_settles_degraded(self):
+        """The ROADMAP open item: a flap that ends in a STABLE degraded
+        state must clear within flap_clear_cycles (stability at any
+        score ends the flap — the stable degradation itself is
+        health.py's finding), instead of staying active forever as
+        'flapped 0 times in 60s'."""
+        eng = AnomalyEngine(thresholds=T)
+        link = "tray1.chip0.ici0.int"
+        trace = [_snap(links={link: 0.0}) for _ in range(12)]
+        trace += [
+            _snap(links={link: 10.0 if i % 2 == 0 else 0.0})
+            for i in range(8)
+        ]
+        settle_start = len(trace)
+        # The link settles into a constant degraded score — no more
+        # healthy↔degraded boundary crossings.
+        trace += [_snap(links={link: 7.0}) for _ in range(30)]
+        _run(eng, trace)
+        (ev,) = [e for e in eng.events() if e["detector"] == "ici_flap"]
+        assert ev["clear_ts"] is not None
+        assert ev["clear_ts"] - (T0 + settle_start) <= 3
+        assert not [
+            e for e in eng.active() if e["detector"] == "ici_flap"
+        ]
+
     def test_bandwidth_drift_cusum(self):
         """Slow drift (~0.75%/cycle) that never crosses an instantaneous
         threshold must still onset; a steady rate must not."""
@@ -168,17 +193,76 @@ class TestDetectors:
         assert evs[0]["onset_ts"] == T0 + stall_start + 2  # 3rd stalled poll
         assert "wedged runtime" in evs[0]["message"]
 
-    def test_vanished_signal_clears_event(self):
+    def test_vanished_signal_clears_event_after_debounce(self):
         """Runtime detach mid-event: the signal disappears from the
-        snapshot and the event must clear, not stay active forever."""
+        snapshot and the event must clear — but only after
+        absence_clear_cycles CONSECUTIVE absent cycles (a one-cycle gap
+        is a hiccup, not a detach)."""
         eng = AnomalyEngine(thresholds=T)
         trace = [_snap(duty=80.0) for _ in range(30)]
         trace += [_snap(duty=0.0) for _ in range(3)]
         _run(eng, trace)
         assert eng.summary()["active"] >= 1
-        eng.observe(T0 + 40, {"chips": {}, "ici": {}, "queues": {}})
+        empty = {"chips": {}, "ici": {}, "queues": {}}
+        eng.observe(T0 + 40, empty)
+        eng.observe(T0 + 41, empty)
+        assert eng.summary()["active"] >= 1  # debounce: not yet
+        eng.observe(T0 + 42, empty)  # 3rd consecutive absent cycle
         assert eng.summary()["active"] == 0
-        assert all(e["clear_ts"] == T0 + 40 for e in eng.events())
+        assert all(e["clear_ts"] == T0 + 42 for e in eng.events())
+
+    def test_one_cycle_absence_does_not_double_count(self):
+        """The PR-2-review bug: a one-cycle gap in a signal must NOT
+        clear + re-onset its active event (double-counting
+        tpu_anomaly_events_total and faking a clear on /anomalies)."""
+        eng = AnomalyEngine(thresholds=T)
+        trace = [_snap(duty=80.0, chips=1) for _ in range(30)]
+        trace += [_snap(duty=0.0, chips=1) for _ in range(3)]
+        _run(eng, trace)
+        assert eng.summary()["total"] == 1
+        # One absent cycle (empty snapshot), then the signal returns,
+        # still collapsed.
+        eng.observe(T0 + 40, {"chips": {}, "ici": {}, "queues": {}})
+        for i in range(5):
+            eng.observe(T0 + 41 + i, _snap(duty=0.0, chips=1))
+        assert eng.summary()["total"] == 1  # same event, not a re-onset
+        assert eng.summary()["active"] == 1
+        (ev,) = eng.active()
+        assert ev["clear_ts"] is None
+        assert ev["onset_ts"] == T0 + 30
+
+    def test_raised_detector_does_not_clear_its_events(self):
+        """A detector that raises for a cycle contributes nothing to
+        `seen`; its active events must survive untouched (not even the
+        absence debounce may advance)."""
+        eng = AnomalyEngine(thresholds=T)
+        trace = [_snap(duty=80.0, chips=1) for _ in range(30)]
+        trace += [_snap(duty=0.0, chips=1) for _ in range(3)]
+        _run(eng, trace)
+        assert eng.summary()["active"] == 1
+
+        duty_det = eng._detectors[0]
+        assert duty_det.name == "duty_ewma"
+        orig = duty_det.observe
+        calls = {"n": 0}
+
+        def boom(ts, snap, t):
+            calls["n"] += 1
+            raise RuntimeError("detector bug")
+
+        duty_det.observe = boom
+        try:
+            # Many raising cycles: way past absence_clear_cycles.
+            for i in range(6):
+                eng.observe(T0 + 40 + i, _snap(duty=0.0, chips=1))
+        finally:
+            duty_det.observe = orig
+        assert calls["n"] == 6
+        assert eng.summary()["active"] == 1  # survived every raise
+        assert eng.summary()["total"] == 1
+        # Detector recovers; the event continues (no re-onset).
+        eng.observe(T0 + 50, _snap(duty=0.0, chips=1))
+        assert eng.summary()["total"] == 1
 
     def test_event_ring_bounded_per_device(self):
         eng = AnomalyEngine(thresholds=T, max_events=4)

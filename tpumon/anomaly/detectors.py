@@ -71,6 +71,12 @@ class AnomalyThresholds:
     stall_depth: float = 8.0
     stall_duty_pct: float = 1.0
     stall_cycles: float = 3.0
+    #: Consecutive cycles a signal must be absent from every reading
+    #: before its active event clears. One absent cycle is routinely a
+    #: detector hiccup (or a raised exception), not a detach — clearing
+    #: on it double-counts tpu_anomaly_events_total when the signal
+    #: reappears next cycle.
+    absence_clear_cycles: float = 3.0
     #: Seconds of 1 Hz history attached to an event at onset.
     window_lookback: float = 30.0
 
@@ -276,7 +282,11 @@ class LinkFlapDetector:
     and distinct from a link that is *stably* degraded, which
     tpumon/health.py already grades. Onset after ``flap_transitions``
     boundary crossings inside ``flap_window`` seconds; clear after
-    ``flap_clear_cycles`` consecutive stable-healthy polls.
+    ``flap_clear_cycles`` consecutive *stable* polls — stable at any
+    score: a link that settles into a constant degraded state has
+    stopped flapping (that condition is health.py's to grade), and an
+    event nothing refreshes must not stay active forever reporting
+    "flapped 0 times".
     """
 
     name = "ici_flap"
@@ -285,7 +295,7 @@ class LinkFlapDetector:
     def __init__(self) -> None:
         self._last: dict[str, float] = {}
         self._transitions: dict[str, deque] = {}
-        self._healthy_streak: dict[str, int] = {}
+        self._stable_streak: dict[str, int] = {}
         self._active: set[str] = set()
 
     def observe(self, ts: float, snap: dict, t: AnomalyThresholds) -> list[Reading]:
@@ -297,18 +307,27 @@ class LinkFlapDetector:
             trans = self._transitions.setdefault(link, deque())
             if last is not None and (last == 0) != (score == 0):
                 trans.append(ts)
+                self._stable_streak[link] = 0
+            else:
+                # No healthy↔degraded boundary crossing this poll: the
+                # link is stable (healthy OR stably degraded — both end
+                # a flap).
+                self._stable_streak[link] = self._stable_streak.get(link, 0) + 1
             horizon = ts - t.flap_window
             while trans and trans[0] < horizon:
                 trans.popleft()
-            if score == 0 and (last is None or last == 0):
-                self._healthy_streak[link] = self._healthy_streak.get(link, 0) + 1
-            else:
-                self._healthy_streak[link] = 0
             self._last[link] = score
 
             n = len(trans)
-            if link in self._active:
-                if self._healthy_streak[link] >= t.flap_clear_cycles:
+            was_active = link in self._active
+            if was_active:
+                if self._stable_streak[link] >= t.flap_clear_cycles:
+                    # Clear-then-re-onset is per-burst counting BY DESIGN:
+                    # a flap slower than one crossing per flap_clear_cycles
+                    # polls emits one event per burst, and every re-onset
+                    # requires flap_transitions fresh crossings (the window
+                    # is wiped below). Raise flap_clear_cycles on fleets
+                    # where slow flaps are one incident, not many.
                     self._active.discard(link)
                     trans.clear()  # a fresh burst must re-onset cleanly
                     active = False
@@ -319,7 +338,9 @@ class LinkFlapDetector:
                 if active:
                     self._active.add(link)
             sev = CRIT if n >= 2 * t.flap_transitions else WARN
-            if active or n > 0:
+            # was_active: the clearing cycle must emit its inactive
+            # reading so the engine clears NOW, not via absence aging.
+            if active or was_active or n > 0:
                 out.append(
                     Reading(
                         f"link:{link}",

@@ -126,6 +126,9 @@ class AnomalyEngine:
         self._rings: dict[str, deque] = {}
         #: monotonic onset counts by (detector, severity)
         self._totals: Counter = Counter()
+        #: (detector, signal) -> consecutive cycles absent from readings
+        #: (absence-clear debounce; see observe()).
+        self._absent: Counter = Counter()
 
     @property
     def detector_names(self) -> tuple[str, ...]:
@@ -149,11 +152,17 @@ class AnomalyEngine:
             return
         t = self._thresholds if self._thresholds is not None else env_thresholds()
         readings = []
+        failed_detectors: set[str] = set()
         for det in self._detectors:
             try:
                 readings.extend((det.name, r) for r in det.observe(ts, snap, t))
             except Exception:  # one broken detector must not stop the rest
                 log.exception("anomaly detector %s failed", det.name)
+                # A detector that raised contributed nothing to `seen`;
+                # its active events must not be treated as absent below
+                # (they'd spuriously clear and re-onset next cycle,
+                # double-counting tpu_anomaly_events_total).
+                failed_detectors.add(det.name)
 
         with self._lock:
             self._cycles += 1
@@ -201,10 +210,29 @@ class AnomalyEngine:
             # A signal that stopped reporting entirely (runtime detached,
             # link vanished) clears its event: absence is "no data", and
             # an event nothing can refresh must not stay active forever.
+            # Debounced: a single absent cycle is routinely a hiccup (one
+            # empty sample, a raised detector), and clearing on it makes
+            # the event re-onset next cycle — double-counting totals and
+            # faking a clear on /anomalies. Only absence_clear_cycles
+            # CONSECUTIVE absent cycles clear, and a detector that raised
+            # this cycle is excluded entirely (its signals aren't absent,
+            # they're unobserved).
+            for key in seen:
+                self._absent.pop(key, None)
+            clear_after = max(1, int(t.absence_clear_cycles))
             for key in [k for k in self._live if k not in seen]:
+                if key[0] in failed_detectors:
+                    continue
+                self._absent[key] += 1
+                if self._absent[key] < clear_after:
+                    continue
+                del self._absent[key]
                 ev = self._live.pop(key)
                 ev.clear_ts = ts
                 ev.updated_ts = ts
+            # Drop debounce state for events that cleared by other paths.
+            for key in [k for k in self._absent if k not in self._live]:
+                del self._absent[key]
 
     # -- poll-loop integration --------------------------------------------
 

@@ -121,12 +121,24 @@ class PodAttribution:
 
     Backs off after failures: off-cluster there is no kubelet socket, and
     the 1 Hz poll budget must not pay a connection attempt every cycle.
+    The backoff is the shared bounded-exponential policy
+    (tpumon/resilience/policy.py): first failure retries quickly — a
+    kubelet restart is usually seconds — then delays double with jitter
+    up to ``BACKOFF_MAX_S``, so a permanently absent socket settles at
+    one attempt per ~5 minutes instead of a fixed cadence every
+    DaemonSet pod shares.
     """
 
-    FAILURE_BACKOFF_S = 60.0
+    BACKOFF_BASE_S = 5.0
+    BACKOFF_MAX_S = 300.0
 
     def __init__(self, client: PodResourcesClient | None = None) -> None:
+        from tpumon.resilience import Backoff
+
         self.client = client or PodResourcesClient()
+        self._backoff = Backoff(
+            base_s=self.BACKOFF_BASE_S, max_s=self.BACKOFF_MAX_S
+        )
         self._next_try = 0.0
 
     @staticmethod
@@ -161,9 +173,10 @@ class PodAttribution:
         if now < self._next_try:
             return
         devices = self.client.list_devices()
-        if devices is None:  # failure → back off
-            self._next_try = now + self.FAILURE_BACKOFF_S
+        if devices is None:  # failure → back off (exponential, jittered)
+            self._next_try = now + self._backoff.next_delay()
             return
+        self._backoff.reset()
         self._next_try = 0.0
         if not devices:  # healthy but no accelerator pods: keep polling
             return

@@ -17,39 +17,74 @@ log = logging.getLogger(__name__)
 __all__ = ["Backend", "BackendError", "RawMetric", "create_backend"]
 
 
+def _retry_policy(cfg: Config):
+    """Transport-level retry policy from config (tpumon/resilience)."""
+    from tpumon.resilience import RetryPolicy
+
+    base = Config()
+    return RetryPolicy(
+        # Clamp, don't substitute: TPUMON_RETRY_ATTEMPTS=0 means "no
+        # retry" (same as 1), matching doctor's reported policy.
+        attempts=max(1, cfg.retry_attempts),
+        base_s=cfg.retry_base_s if cfg.retry_base_s > 0 else base.retry_base_s,
+        max_s=cfg.retry_max_s if cfg.retry_max_s > 0 else base.retry_max_s,
+    )
+
+
 def create_backend(cfg: Config) -> Backend:
     kind = cfg.backend
     if kind == "auto":
         kind = _autodetect()
         log.info("backend auto-detected: %s", kind)
 
+    backend: Backend
     if kind == "stub":
         from tpumon.backends.stub import StubBackend
 
-        return StubBackend()
-    if kind == "libtpu":
+        backend = StubBackend()
+    elif kind == "libtpu":
         from tpumon.backends.libtpu_backend import LibtpuBackend
 
-        return LibtpuBackend(topology_file=cfg.topology_file)
-    if kind == "grpc":
+        backend = LibtpuBackend(
+            topology_file=cfg.topology_file, retry=_retry_policy(cfg)
+        )
+    elif kind == "grpc":
         from tpumon.backends.grpc_backend import GrpcMonitoringBackend
 
-        return GrpcMonitoringBackend(
+        backend = GrpcMonitoringBackend(
             addr=cfg.grpc_addr,
             timeout=cfg.grpc_timeout,
             topology_file=cfg.topology_file,
             service=cfg.grpc_service,
             watch=cfg.grpc_watch,
+            retry=_retry_policy(cfg),
         )
-    if kind == "fake":
+    elif kind == "fake":
         from tpumon.backends.fake import FakeTpuBackend
 
-        return FakeTpuBackend.preset(cfg.fake_topology)
-    if kind == "nvml":
+        backend = FakeTpuBackend.preset(cfg.fake_topology)
+    elif kind == "nvml":
         from tpumon.backends.nvml_backend import NvmlBackend
 
-        return NvmlBackend()
-    raise ValueError(f"unknown backend {kind!r}")
+        backend = NvmlBackend()
+    else:
+        raise ValueError(f"unknown backend {kind!r}")
+
+    if cfg.faults:
+        # Chaos mode (TPUMON_FAULTS): deterministic fault injection
+        # around whichever backend was selected, so the resilience plane
+        # is exercisable end to end without real device failures.
+        from tpumon.resilience import FaultInjectingBackend, FaultSpec
+
+        spec = FaultSpec.parse(cfg.faults)
+        log.warning(
+            "fault injection ACTIVE (TPUMON_FAULTS): %s", spec.describe()
+        )
+        # The fault layer carries the same transport-retry policy a real
+        # flaky transport would sit beneath, so injected errors exercise
+        # the retry plane too (not just breakers + stale serving).
+        backend = FaultInjectingBackend(backend, spec, retry=_retry_policy(cfg))
+    return backend
 
 
 def _autodetect() -> str:

@@ -404,10 +404,21 @@ class GrpcMonitoringBackend:
         topology_file: str | None = None,
         service: str = DEFAULT_SERVICE,
         watch: bool = True,
+        retry=None,
     ) -> None:
+        from tpumon.resilience import RetryCounter, RetryPolicy
+
         self.addr = addr
         self.timeout = timeout
         self.service = service
+        #: Transport-level retry (bounded exponential backoff with
+        #: jitter, tpumon/resilience/policy.py) around each unary RPC;
+        #: the per-attempt deadline stays ``timeout``. Sustained failure
+        #: is the collector-level circuit breaker's job, not retries'.
+        self.retry = retry if retry is not None else RetryPolicy()
+        #: Retries performed, by call kind — folded into
+        #: tpumon_retries_total by the poller (delta-read).
+        self._retries = RetryCounter()
         #: Subscribe to a server-streaming watch method when the service
         #: has one; False pins every read to the unary poll (ops escape
         #: hatch, TPUMON_GRPC_WATCH=0).
@@ -445,6 +456,10 @@ class GrpcMonitoringBackend:
             from tpumon.backends.libtpu_backend import LibtpuBackend
 
             self._delegate = LibtpuBackend(topology_file)
+            # Share the configured transport-retry policy with the SDK
+            # delegate (attribute, not ctor kwarg: test doubles keep the
+            # original constructor signature).
+            self._delegate.retry = self.retry
         except BackendError as exc:
             log.info("libtpu SDK unavailable (%s); grpc-only mode", exc)
 
@@ -621,6 +636,47 @@ class GrpcMonitoringBackend:
                 return field.name
         return None
 
+    def _retrying(self, call: str, fn):
+        """Run one unary RPC under the transport retry policy, counting
+        retries by call kind."""
+        return self._retries.call(call, fn, self.retry)
+
+    def retry_counts(self) -> dict[str, int]:
+        """Cumulative transport-retry counts by call kind (this backend
+        plus the SDK delegate) — the tpumon_retries_total feed."""
+        out = self._retries.counts()
+        if self._delegate is not None:
+            delegate_counts = getattr(self._delegate, "retry_counts", None)
+            if delegate_counts is not None:
+                for call, n in delegate_counts().items():
+                    out[call] = out.get(call, 0) + n
+        return out
+
+    def reset(self) -> None:
+        """Watchdog recovery: tear down the channel (failing any
+        in-flight RPC at the transport layer), drop the cached stub and
+        watches, and re-dial a fresh channel so the next poll rebuilds
+        from reflection immediately (no retry throttle)."""
+        log.warning("resetting monitoring channel to %s (recovery)", self.addr)
+        self._close_watches()
+        self._stub = None
+        self._stub_failed_at = None
+        self._stub_call_failures = 0
+        if self._channel is not None:
+            try:
+                self._channel.close()
+            except Exception:
+                pass
+            self._channel = None
+        if self._grpc is not None:
+            try:
+                self._channel = self._grpc.insecure_channel(self.addr)
+            except Exception as exc:
+                log.warning("channel re-dial failed: %s", exc)
+        delegate_reset = getattr(self._delegate, "reset", None)
+        if delegate_reset is not None:
+            delegate_reset()
+
     def _grpc_list(self) -> dict[str, str]:
         """Enumerate the service's metrics → {unified name: server name}."""
         stub = self._ensure_stub()
@@ -633,7 +689,10 @@ class GrpcMonitoringBackend:
             # exporter's trace plane is on (tpumon.trace); no-op
             # otherwise — doctor and ad-hoc callers pay nothing.
             with trace_span(f"rpc:{self._list_method}", stage="backend_rpc"):
-                resp = stub.call(self._list_method, timeout=self.timeout)
+                resp = self._retrying(
+                    "grpc:list",
+                    lambda: stub.call(self._list_method, timeout=self.timeout),
+                )
         except Exception as exc:
             log.debug("grpc %s failed: %s", self._list_method, exc)
             self._note_stub_call(ok=False)
@@ -666,8 +725,11 @@ class GrpcMonitoringBackend:
             with trace_span(
                 f"rpc:{self._get_method}:{server_name}", stage="backend_rpc"
             ):
-                resp = stub.call(
-                    self._get_method, timeout=self.timeout, **fields
+                resp = self._retrying(
+                    "grpc:get",
+                    lambda: stub.call(
+                        self._get_method, timeout=self.timeout, **fields
+                    ),
                 )
         except Exception as exc:
             self._note_stub_call(ok=False)

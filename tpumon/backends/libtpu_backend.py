@@ -31,28 +31,82 @@ log = logging.getLogger(__name__)
 class LibtpuBackend:
     name = "libtpu"
 
-    def __init__(self, topology_file: str | None = None) -> None:
+    def __init__(self, topology_file: str | None = None, retry=None) -> None:
         try:
             from libtpu.sdk import tpumonitoring
         except Exception as exc:  # ImportError or native-load failure
             raise BackendError(f"libtpu SDK unavailable: {exc}") from exc
+        from tpumon.resilience import RetryCounter, RetryPolicy
+
         self._mon = tpumonitoring
+        self._topology_file = topology_file
         self._topology = discover(topology_file)
+        #: Transport-level retry (tpumon/resilience/policy.py): one SDK
+        #: call blip — a runtime restarting mid-poll — is absorbed here;
+        #: sustained failure belongs to the collector's circuit breaker.
+        self.retry = retry if retry is not None else RetryPolicy()
+        #: Retries performed, by call kind (tpumon_retries_total feed).
+        self._retries = RetryCounter()
+        #: Set by reset() (watchdog thread); consumed on the poller
+        #: thread before the next SDK call.
+        self._needs_rebind = False
+
+    def _retrying(self, call: str, fn):
+        return self._retries.call(call, fn, self.retry)
+
+    def retry_counts(self) -> dict[str, int]:
+        return self._retries.counts()
 
     def list_metrics(self) -> tuple[str, ...]:
+        self._maybe_rebind()
         try:
-            return tuple(self._mon.list_supported_metrics())
+            return tuple(
+                self._retrying(
+                    "libtpu:list", self._mon.list_supported_metrics
+                )
+            )
         except Exception as exc:
             raise BackendError(f"list_supported_metrics failed: {exc}") from exc
 
     def sample(self, name: str) -> RawMetric:
+        self._maybe_rebind()
         try:
-            data = self._mon.get_metric(name).data()
+            data = self._retrying(
+                "libtpu:sample", lambda: self._mon.get_metric(name).data()
+            )
         except Exception as exc:
             raise BackendError(f"get_metric({name}) failed: {exc}") from exc
         if data is None:
             return RawMetric(name, ())
         return RawMetric(name, tuple(str(entry) for entry in data))
+
+    def reset(self) -> None:
+        """Watchdog recovery hook (runs on the watchdog thread).
+
+        The SDK is in-process: a stuck native call cannot be failed from
+        another thread (unlike the gRPC channel-close path), and
+        reloading the module concurrently with an in-flight native call
+        could corrupt the process. So reset() only *schedules* a re-bind
+        of the SDK entry points + re-discovery; the poller thread
+        performs it before its next SDK call — recovery for a runtime
+        restart that left the cached module handle pointing at dead
+        state, not for an unabortable native hang."""
+        self._needs_rebind = True
+
+    def _maybe_rebind(self) -> None:
+        if not self._needs_rebind:
+            return
+        self._needs_rebind = False
+        try:
+            import importlib
+
+            self._mon = importlib.reload(self._mon)
+        except Exception as exc:
+            log.warning("libtpu SDK re-bind failed: %s", exc)
+        try:
+            self._topology = discover(self._topology_file)
+        except Exception as exc:
+            log.warning("topology re-discovery failed: %s", exc)
 
     def core_states(self) -> dict[str, str]:
         """Per-core state via tpuz; empty dict when the runtime is down."""

@@ -119,6 +119,32 @@ class Config:
     anomaly: bool = True
     #: Per-device retained-event cap for the anomaly engine's rings.
     anomaly_events_max: int = 256
+    #: Fault-tolerance plane (tpumon/resilience): per-query circuit
+    #: breakers + stale-but-served degradation in the poll loop. Off
+    #: restores the pre-resilience behavior (failures drop families).
+    resilience: bool = True
+    #: Stale-but-served window seconds: on query failure / open breaker,
+    #: the last good family keeps being served (flagged via
+    #: tpumon_degraded / tpumon_family_staleness_seconds) up to this age.
+    #: 0 disables last-good serving while keeping the breakers.
+    stale_serve_s: float = 300.0
+    #: Device-call retry attempts (1 = no retry) and the bounded
+    #: exponential-backoff envelope between attempts.
+    retry_attempts: int = 2
+    retry_base_s: float = 0.05
+    retry_max_s: float = 0.5
+    #: Circuit breaker: consecutive failures that open it, seconds the
+    #: open state refuses calls before a half-open probe, and probe
+    #: successes required to close again.
+    breaker_failures: int = 5
+    breaker_open_s: float = 15.0
+    breaker_probes: int = 2
+    #: Poll-cycle hang budget in seconds before the watchdog recovers
+    #: the backend (interrupt + channel teardown/re-init); 0 disables.
+    watchdog_hang_s: float = 10.0
+    #: Fault-injection spec (TPUMON_FAULTS, tpumon/resilience/faults.py)
+    #: wrapping the selected backend — chaos testing only; empty = off.
+    faults: str = ""
     #: Internal trace plane (tpumon/trace): per-stage spans around every
     #: poll-pipeline stage, served at /debug/traces (+/slow) and as the
     #: tpumon_trace_stage_duration_seconds self-metric.
@@ -170,6 +196,20 @@ class Config:
             anomaly_events_max=_env_int(
                 "ANOMALY_EVENTS_MAX", base.anomaly_events_max
             ),
+            resilience=_env_bool("RESILIENCE", base.resilience),
+            stale_serve_s=_env_float("STALE_SERVE_S", base.stale_serve_s),
+            retry_attempts=_env_int("RETRY_ATTEMPTS", base.retry_attempts),
+            retry_base_s=_env_float("RETRY_BASE_S", base.retry_base_s),
+            retry_max_s=_env_float("RETRY_MAX_S", base.retry_max_s),
+            breaker_failures=_env_int(
+                "BREAKER_FAILURES", base.breaker_failures
+            ),
+            breaker_open_s=_env_float("BREAKER_OPEN_S", base.breaker_open_s),
+            breaker_probes=_env_int("BREAKER_PROBES", base.breaker_probes),
+            watchdog_hang_s=_env_float(
+                "WATCHDOG_HANG_S", base.watchdog_hang_s
+            ),
+            faults=_env("FAULTS", base.faults) or base.faults,
             trace=_env_bool("TRACE", base.trace),
             trace_slow_cycle_ms=_env_float(
                 "TRACE_SLOW_CYCLE_MS", base.trace_slow_cycle_ms
@@ -221,6 +261,29 @@ class Config:
             "--anomaly-events-max",
             type=int,
             help="per-device retained-event cap for the anomaly engine",
+        )
+        g.add_argument(
+            "--stale-serve-s",
+            type=float,
+            help="serve last-good families up to this many seconds old "
+            "when queries fail or a breaker is open (0 disables)",
+        )
+        g.add_argument(
+            "--watchdog-hang-s",
+            type=float,
+            help="poll-cycle hang budget before the watchdog recovers "
+            "the backend (0 disables)",
+        )
+        g.add_argument(
+            "--breaker-open-s",
+            type=float,
+            help="seconds an open circuit breaker refuses device calls "
+            "before a half-open probe",
+        )
+        g.add_argument(
+            "--faults",
+            help="fault-injection spec (chaos testing), e.g. "
+            "error_rate=0.3,hang_every=20,hang_s=10",
         )
         g.add_argument(
             "--trace-slow-cycle-ms",

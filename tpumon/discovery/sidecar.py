@@ -101,6 +101,10 @@ def main(argv: list[str] | None = None) -> int:
     # and liveness gauges are actually visible to Prometheus.
     telemetry = SelfTelemetry(registry)
     telemetry.last_poll.set(time.time())
+    # The sidecar has no device poll loop; its refresh loop is its
+    # liveness. Without this the shared tpumon_up gauge would read 0
+    # forever and falsely trip the TPUMonPollLoopDown alert.
+    telemetry.up.set(1)
     app = _make_app(registry_renderer(registry), telemetry, lambda: (True, "ok\n"))
     server = ExporterServer(app, cfg.addr, cfg.port)
     server.start()

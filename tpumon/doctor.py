@@ -187,6 +187,37 @@ def run(cfg: Config, out=sys.stdout, backend=None) -> int:
             for s in stages[:6]:
                 p(f"  {s['name']:<28s} {s['duration_seconds'] * 1e3:8.2f} ms")
 
+        # Fault-tolerance plane (tpumon/resilience): the policy a live
+        # exporter would run with this config, plus the chaos notice —
+        # an operator reading doctor output during an incident needs to
+        # know whether fault injection is part of the picture. Live
+        # breaker/staleness state comes from the running exporter
+        # (GET /debug/vars "resilience", or the smi DEGRADED line).
+        if cfg.resilience:
+            p(
+                "\nresilience: enabled — retries "
+                f"{max(1, cfg.retry_attempts) - 1} per call, breaker "
+                f"opens after {cfg.breaker_failures} consecutive "
+                f"failures ({cfg.breaker_open_s:.0f}s probe window), "
+                f"last-good families served up to {cfg.stale_serve_s:.0f}s"
+                + (
+                    f", watchdog recovers hangs after "
+                    f"{cfg.watchdog_hang_s:.0f}s"
+                    if cfg.watchdog_hang_s > 0
+                    else ", watchdog disabled"
+                )
+            )
+        else:
+            p("\nresilience: disabled (TPUMON_RESILIENCE=0)")
+        fault_spec = getattr(backend, "spec", None)
+        if cfg.faults or fault_spec is not None:
+            desc = (
+                fault_spec.describe()
+                if fault_spec is not None and hasattr(fault_spec, "describe")
+                else cfg.faults
+            )
+            p(f"WARNING: fault injection ACTIVE (TPUMON_FAULTS): {desc}")
+
         # Streaming anomaly detection (tpumon.anomaly): doctor runs ONE
         # poll cycle, and every detector needs warmup/streaks, so there is
         # no verdict to print here — only the armed roster. Live verdicts

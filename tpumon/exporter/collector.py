@@ -47,6 +47,15 @@ class PollStats:
     #: consumers (smi standalone mode, doctor) reuse it instead of
     #: re-walking the families.
     snapshot: dict | None = None
+    #: True when this cycle served anything other than fresh-complete
+    #: data: stale-but-served families, an open breaker, or a recovered
+    #: enumeration outage (tpumon/resilience). Drives tpumon_degraded.
+    degraded: bool = False
+    #: Queries skipped this cycle because their breaker was open.
+    breaker_open: int = 0
+    #: family name -> age seconds, for families served from the
+    #: last-good cache this cycle (tpumon_family_staleness_seconds).
+    stale_families: dict = field(default_factory=dict)
 
 
 class SampleCache:
@@ -164,19 +173,47 @@ def _topology_families(topo, base_keys, base_vals) -> list[Metric]:
     return [count, cores, hosts, info]
 
 
+def _serve_stale(resilience, name: str, families: list, stats: PollStats) -> None:
+    """Append the last-good family for ``name`` (if fresh enough) with
+    staleness bookkeeping — the stale-but-served degradation path."""
+    if resilience is None:
+        return
+    entry = resilience.stale(name)
+    if entry is None:
+        return
+    fam, fam_name, age = entry
+    families.append(fam)
+    stats.stale_families[fam_name] = age
+    stats.degraded = True
+
+
 def build_families(
-    backend: Backend, cfg: Config, attribution=None, histograms=None
+    backend: Backend, cfg: Config, attribution=None, histograms=None,
+    resilience=None, watchdog=None,
 ) -> tuple[list[Metric], PollStats]:
     """One poll cycle: query every enabled metric, parse, build families.
 
     Runs only on the poller thread. Every failure mode degrades to a
     dropped sample plus a counter increment (SURVEY.md §5.3).
     ``histograms`` (a PollHistograms) accumulates the 1 Hz utilization
-    distribution across polls — state outlives this call.
+    distribution across polls — state outlives this call. ``resilience``
+    (a tpumon.resilience.PollResilience) adds per-query circuit breakers
+    and stale-but-served degradation: failed/refused queries serve the
+    last good family with freshness metadata instead of going absent.
     """
     stats = PollStats()
+
+    def beat() -> None:
+        # Per-device-call progress heartbeat: a cycle that is slow
+        # because calls keep completing (at their bounded deadlines)
+        # must not read as a hang — only a single stuck call may let
+        # the watchdog budget elapse without a beat.
+        if watchdog is not None:
+            watchdog.beat()
+
     with trace_span("topology"):
         topo = backend.topology()
+    beat()
     base = topo.base_labels()
     base_keys = tuple(base)
     stats.base_keys = base_keys
@@ -185,14 +222,42 @@ def build_families(
     families: list[Metric] = _topology_families(topo, base_keys, base_vals)
 
     list_failed = False
-    try:
-        with trace_span("list_metrics"):
-            supported = tuple(backend.list_metrics())
-    except Exception as exc:
-        log.warning("list_metrics failed: %s", exc)
-        stats.backend_errors += 1
-        supported = ()
+    supported: tuple[str, ...] = ()
+    list_br = (
+        resilience.breakers.get("list_metrics")
+        if resilience is not None
+        else None
+    )
+    if list_br is not None and not list_br.allow():
+        # Open breaker: the enumeration outage is established — don't pay
+        # a device call per poll to reconfirm it (probe schedule applies).
         list_failed = True
+        stats.breaker_open += 1
+        stats.degraded = True
+    else:
+        try:
+            with trace_span("list_metrics"):
+                supported = tuple(backend.list_metrics())
+        except Exception as exc:
+            log.warning("list_metrics failed: %s", exc)
+            stats.backend_errors += 1
+            list_failed = True
+            if list_br is not None:
+                list_br.record(False)
+        else:
+            if list_br is not None:
+                list_br.record(True)
+            if resilience is not None:
+                resilience.store_supported(supported)
+        beat()
+    if list_failed and resilience is not None:
+        # Keep sampling from the last good enumeration so data flows
+        # through the outage; coverage still reads 0.0 below, so the
+        # enumeration alert fires exactly while this is happening.
+        entry = resilience.stale_supported()
+        if entry is not None:
+            supported = entry[0]
+            stats.degraded = True
 
     # A failed enumeration is 0% coverage, not a vacuous 100%: an alert on
     # the coverage gauge must fire during exactly this outage.
@@ -208,24 +273,49 @@ def build_families(
         if spec is None:
             unmapped.append(name)
             continue
+        br = (
+            resilience.breakers.get(f"sample:{name}")
+            if resilience is not None
+            else None
+        )
+        if br is not None and not br.allow():
+            stats.breaker_open += 1
+            stats.degraded = True
+            _serve_stale(resilience, name, families, stats)
+            continue
         try:
             with trace_span(f"query:{name}"):
                 raw = backend.sample(name)
         except BackendError as exc:
             log.debug("sample(%s) failed: %s", name, exc)
             stats.backend_errors += 1
+            if br is not None:
+                br.record(False)
+            beat()
+            _serve_stale(resilience, name, families, stats)
             continue
         except Exception as exc:  # never let a device bug kill the poller
             log.warning("sample(%s) raised unexpectedly: %s", name, exc)
             stats.backend_errors += 1
+            if br is not None:
+                br.record(False)
+            beat()
+            _serve_stale(resilience, name, families, stats)
             continue
+        beat()
+        if br is not None:
+            br.record(True)
 
         with trace_span(f"parse:{name}"):
             result = parse(raw, spec)
             stats.parse_errors += result.errors
             if result.empty:
                 # Runtime-detached / no data: family absent, not zero
-                # (SURVEY.md §2.2 caveat).
+                # (SURVEY.md §2.2 caveat). Absence is the truth now —
+                # drop the last-good entry so stale serving can never
+                # mask a detach.
+                if resilience is not None:
+                    resilience.forget(name)
                 continue
             if histograms is not None:
                 # Cumulative distribution of the 1 Hz series (BASELINE
@@ -243,6 +333,8 @@ def build_families(
                     point.value,
                 )
             families.append(fam)
+            if resilience is not None:
+                resilience.store(name, fam)
             stats.points += len(result.points)
 
     if histograms is not None:
@@ -383,6 +475,8 @@ class Poller:
         histograms=None,
         anomaly=None,
         tracer=None,
+        resilience=None,
+        watchdog=None,
     ) -> None:
         self._backend = backend
         self._cfg = cfg
@@ -393,6 +487,12 @@ class Poller:
         self._histograms = histograms
         self._anomaly = anomaly
         self._tracer = tracer
+        self._resilience = resilience
+        self._watchdog = watchdog
+        #: Staleness-gauge label reconciliation (tpumon/resilience).
+        self._stale_labeled: set[str] = set()
+        #: Last-seen backend retry counters (delta-fed into telemetry).
+        self._retry_seen: dict[str, int] = {}
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="tpumon-poller", daemon=True
@@ -403,16 +503,26 @@ class Poller:
         self.on_cycle = None
 
     def poll_once(self) -> PollStats:
-        # The traced path wraps the cycle in a tpumon.trace span tree
-        # (recorded on this thread, rendered lazily on /debug reads);
-        # tracing disabled runs the identical pipeline bare.
-        if self._tracer is None:
-            return self._poll_cycle()
-        with self._tracer.cycle() as cycle:
-            stats = self._poll_cycle()
-            if cycle is not None:
-                cycle.set_stats(stats)
-            return stats
+        # The watchdog heartbeat brackets the whole cycle: a device call
+        # stuck past the hang budget triggers backend interrupt/teardown
+        # from the watchdog thread, which makes the stuck call raise and
+        # the cycle complete as a counted backend error.
+        if self._watchdog is not None:
+            self._watchdog.cycle_started()
+        try:
+            # The traced path wraps the cycle in a tpumon.trace span tree
+            # (recorded on this thread, rendered lazily on /debug reads);
+            # tracing disabled runs the identical pipeline bare.
+            if self._tracer is None:
+                return self._poll_cycle()
+            with self._tracer.cycle() as cycle:
+                stats = self._poll_cycle()
+                if cycle is not None:
+                    cycle.set_stats(stats)
+                return stats
+        finally:
+            if self._watchdog is not None:
+                self._watchdog.cycle_finished()
 
     def _poll_cycle(self) -> PollStats:
         t0 = time.monotonic()
@@ -424,7 +534,9 @@ class Poller:
                 advance()
         with trace_span("build_families"):
             families, stats = build_families(
-                self._backend, self._cfg, self._attribution, self._histograms
+                self._backend, self._cfg, self._attribution,
+                self._histograms, resilience=self._resilience,
+                watchdog=self._watchdog,
             )
         now = time.time()
         if self._history is not None:
@@ -472,10 +584,47 @@ class Poller:
         t.last_poll.set(time.time())
         t.poll_lag.set(max(0.0, elapsed - self._cfg.interval))
         t.coverage.set(stats.coverage)
+        self._update_resilience_telemetry(stats)
         self.last_stats = stats
         if self.on_cycle is not None:
             self.on_cycle()
         return stats
+
+    def _update_resilience_telemetry(self, stats: PollStats) -> None:
+        """Post-cycle freshness/breaker/retry gauges (tpumon/resilience):
+        the degradation the page carries must be flagged on the same page."""
+        t = self._telemetry
+        t.up.set(1.0)
+        t.degraded.set(1.0 if stats.degraded else 0.0)
+        # Staleness gauge: one series per stale-served family, removed
+        # again the cycle the family turns fresh (absent = fresh).
+        stale = stats.stale_families
+        for fam_name, age in stale.items():
+            t.family_staleness.labels(family=fam_name).set(age)
+        for fam_name in self._stale_labeled - set(stale):
+            try:
+                t.family_staleness.remove(fam_name)
+            except KeyError:
+                pass
+        self._stale_labeled = set(stale)
+        if self._resilience is not None:
+            from tpumon.resilience.breaker import STATE_VALUES
+
+            for key, state in self._resilience.breakers.states().items():
+                t.breaker_state.labels(query=key).set(STATE_VALUES[state])
+        # Retry counts accumulate inside the backends (transport-level
+        # bounded retries); fold the deltas into the shared counter.
+        rc_fn = getattr(self._backend, "retry_counts", None)
+        if rc_fn is not None:
+            try:
+                counts = rc_fn()
+            except Exception:
+                counts = {}
+            for call, n in counts.items():
+                delta = n - self._retry_seen.get(call, 0)
+                if delta > 0:
+                    t.retries.labels(call=call).inc(delta)
+                    self._retry_seen[call] = n
 
     def start(self) -> None:
         # Prime the cache synchronously so the first scrape is never empty.
@@ -501,6 +650,9 @@ class Poller:
                 # Last-ditch guard: the poller thread must never die.
                 log.exception("poll cycle failed")
                 self._telemetry.poll_errors.labels(kind="backend").inc()
+                # A wholesale-failed cycle published nothing fresh.
+                self._telemetry.up.set(0.0)
+                self._telemetry.degraded.set(1.0)
                 if self.on_cycle is not None:
                     # poll_once died before its own on_cycle: re-render
                     # anyway so the error counter is scrapeable now, not

@@ -550,10 +550,41 @@ class Exporter:
                 slow_cycle_ms=slow_ms, ring=ring, slow_ring=slow_ring,
                 observe=observe_stage,
             )
+        self.resilience = None
+        if cfg.resilience:
+            from tpumon.resilience import PollResilience
+
+            defaults = type(cfg)()
+            self.resilience = PollResilience(
+                breaker_failures=(
+                    cfg.breaker_failures
+                    if cfg.breaker_failures > 0
+                    else defaults.breaker_failures
+                ),
+                breaker_open_s=(
+                    cfg.breaker_open_s
+                    if cfg.breaker_open_s > 0
+                    else defaults.breaker_open_s
+                ),
+                breaker_probes=(
+                    cfg.breaker_probes
+                    if cfg.breaker_probes > 0
+                    else defaults.breaker_probes
+                ),
+                stale_serve_s=max(0.0, cfg.stale_serve_s),
+            )
+        self.watchdog = None
+        if cfg.watchdog_hang_s > 0:
+            from tpumon.resilience import PollWatchdog
+
+            self.watchdog = PollWatchdog(
+                cfg.watchdog_hang_s, self._recover_backend
+            )
         self.poller = Poller(
             backend, cfg, self.cache, self.telemetry, attribution,
             history=self.history, histograms=self.histograms,
             anomaly=self.anomaly, tracer=self.tracer,
+            resilience=self.resilience, watchdog=self.watchdog,
         )
         version_fn = getattr(backend, "version", None)
         self.telemetry.backend_info.labels(
@@ -607,6 +638,31 @@ class Exporter:
                 # HTTP scrape plane.
                 log.warning("grpc metrics service unavailable: %s", exc)
 
+    def _recover_backend(self) -> None:
+        """Watchdog hook: a poll cycle is stuck past the hang budget.
+
+        Runs on the watchdog thread. ``interrupt()`` releases injected
+        hangs (fault backend); ``reset()`` tears down transport state
+        (the gRPC backend closes its channel, failing any in-flight RPC
+        so the stuck call raises and the cycle completes). The flags are
+        re-rendered immediately so the very next scrape shows the onset.
+        """
+        self.telemetry.watchdog_recoveries.inc()
+        self.telemetry.up.set(0.0)
+        self.telemetry.degraded.set(1.0)
+        for method in ("interrupt", "reset"):
+            fn = getattr(self.backend, method, None)
+            if fn is None:
+                continue
+            try:
+                fn()
+            except Exception:
+                log.exception("backend %s() failed during recovery", method)
+        try:
+            self._selfpage.refresh()
+        except Exception:
+            log.exception("self-telemetry refresh failed during recovery")
+
     def _debug_vars(self) -> dict:
         """The /debug/vars body (expvar analogue): process, config, and
         subsystem occupancy — O(1) in-process reads only, no device
@@ -633,8 +689,21 @@ class Exporter:
                 "coverage": stats.coverage,
                 "backend_errors": stats.backend_errors,
                 "parse_errors": stats.parse_errors,
+                "degraded": stats.degraded,
+                "breaker_open": stats.breaker_open,
+                "stale_families": {
+                    name: round(age, 3)
+                    for name, age in stats.stale_families.items()
+                },
             },
         }
+        if self.resilience is not None:
+            doc["resilience"] = self.resilience.snapshot()
+        if self.watchdog is not None:
+            doc.setdefault("resilience", {})["watchdog"] = {
+                "hang_budget_s": self.watchdog.hang_budget_s,
+                "recoveries": self.watchdog.recoveries,
+            }
         if self.tracer is not None:
             doc["trace"] = {
                 "slow_cycle_ms": self.tracer.slow_cycle_ms,
@@ -672,6 +741,8 @@ class Exporter:
         return True, "ok\n"
 
     def start(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.start()
         self.poller.start()
         self.server.start()
         log.info(
@@ -685,7 +756,11 @@ class Exporter:
         if self.grpc_server is not None:
             self.grpc_server.close()
         self.server.close()
+        # Poller first: a cycle stuck in a device call still gets watchdog
+        # recovery while stop() waits on the join.
         self.poller.stop()
+        if self.watchdog is not None:
+            self.watchdog.stop()
         self._selfpage.close()
         self.backend.close()
 

@@ -93,6 +93,52 @@ class SelfTelemetry:
             "(BASELINE ≥0.95 target).",
             registry=registry,
         )
+        # -- fault-tolerance plane (tpumon/resilience) -------------------
+        self.up = Gauge(
+            "tpumon_up",
+            "1 while the poll loop completes cycles; 0 after a "
+            "wholesale-failed cycle or a watchdog-detected hang (the "
+            "next completed cycle restores 1).",
+            registry=registry,
+        )
+        self.degraded = Gauge(
+            "tpumon_degraded",
+            "1 when the last cycle served anything other than "
+            "fresh-complete data: stale-but-served families, an open "
+            "circuit breaker, or a recovered enumeration outage "
+            "(tpumon/resilience).",
+            registry=registry,
+        )
+        self.family_staleness = Gauge(
+            "tpumon_family_staleness_seconds",
+            "Age of each family currently served from the last-good "
+            "cache instead of a fresh device query; absent when the "
+            "family is fresh.",
+            labelnames=("family",),
+            registry=registry,
+        )
+        self.breaker_state = Gauge(
+            "tpumon_breaker_state",
+            "Per-device-query circuit-breaker state: 0 closed, "
+            "1 half-open (probing), 2 open (calls refused, last-good "
+            "served).",
+            labelnames=("query",),
+            registry=registry,
+        )
+        self.retries = Counter(
+            "tpumon_retries",
+            "Transport-level device-call retries (bounded exponential "
+            "backoff with jitter, tpumon/resilience/policy.py), by call.",
+            labelnames=("call",),
+            registry=registry,
+        )
+        self.watchdog_recoveries = Counter(
+            "tpumon_watchdog_recoveries",
+            "Stuck-poll-cycle recoveries: the watchdog detected a device "
+            "call past the hang budget and tore the backend down "
+            "(interrupt + channel re-init).",
+            registry=registry,
+        )
         self.backend_info = Gauge(
             "exporter_backend_info",
             "Static info about the active device backend (value is 1).",

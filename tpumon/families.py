@@ -125,6 +125,40 @@ SELF_FAMILIES: dict[str, tuple[str, str]] = {
         "pass) by stage — the cycle survives, the stage's output is "
         "missing",
     ),
+    "tpumon_up": (
+        "gauge",
+        "1 while the poll loop completes cycles; 0 after a "
+        "wholesale-failed cycle or a watchdog-detected hang "
+        "(tpumon/resilience)",
+    ),
+    "tpumon_degraded": (
+        "gauge",
+        "1 when the last cycle served anything other than fresh-complete "
+        "data: stale-but-served families, an open circuit breaker, or a "
+        "recovered enumeration outage",
+    ),
+    "tpumon_family_staleness_seconds": (
+        "gauge",
+        "Age of each family currently served from the last-good cache "
+        "instead of a fresh device query (family label); absent when "
+        "fresh",
+    ),
+    "tpumon_breaker_state": (
+        "gauge",
+        "Per-device-query circuit-breaker state (query label): 0 closed, "
+        "1 half-open (probing), 2 open (calls refused, last-good served)",
+    ),
+    "tpumon_retries_total": (
+        "counter",
+        "Transport-level device-call retries (bounded exponential "
+        "backoff with jitter), by call kind",
+    ),
+    "tpumon_watchdog_recoveries_total": (
+        "counter",
+        "Stuck-poll-cycle recoveries: a device call ran past the hang "
+        "budget and the watchdog tore the backend down (interrupt + "
+        "channel re-init)",
+    ),
 }
 
 #: family -> description (workload-side harness --metrics-port)

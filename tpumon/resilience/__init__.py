@@ -1,0 +1,55 @@
+"""Fault-tolerance policy layer (the "survives them" half of the trace plane).
+
+The paper's core promise is an exporter that keeps answering scrapes no
+matter what the device runtime does. PR 2's trace plane made slow/stuck
+cycles *visible*; this package makes the exporter *survive* them:
+
+- :mod:`tpumon.resilience.policy` — bounded exponential backoff with
+  jitter (:class:`Backoff`), per-call retry with an overall deadline
+  (:class:`RetryPolicy` / :func:`retry_call`).
+- :mod:`tpumon.resilience.breaker` — per-query circuit breaker
+  (closed → open → half-open → closed) with a throttled probe schedule,
+  so a dead runtime costs one probe per window instead of a timeout per
+  poll (:class:`CircuitBreaker` / :class:`BreakerRegistry`).
+- :mod:`tpumon.resilience.degrade` — the last-good family cache backing
+  stale-but-served degradation: when a query fails or its breaker is
+  open, the exporter serves the last good sample with explicit
+  freshness metadata instead of dropping the family
+  (:class:`PollResilience`).
+- :mod:`tpumon.resilience.watchdog` — poll-loop hang detection +
+  recovery by backend interrupt/channel teardown
+  (:class:`PollWatchdog`).
+- :mod:`tpumon.resilience.faults` — deterministic fault injection
+  (:class:`FaultInjectingBackend`, ``TPUMON_FAULTS``) so every failure
+  mode above is exercised in CI rather than asserted in prose.
+
+Degradation is always *observable*: ``tpumon_up`` / ``tpumon_degraded``
+/ ``tpumon_family_staleness_seconds`` / ``tpumon_breaker_state`` ride
+the self-telemetry registry (tpumon/families.py, docs/METRICS.md).
+"""
+
+from __future__ import annotations
+
+from tpumon.resilience.breaker import BreakerRegistry, CircuitBreaker
+from tpumon.resilience.degrade import PollResilience
+from tpumon.resilience.faults import FaultInjectingBackend, FaultSpec
+from tpumon.resilience.policy import (
+    Backoff,
+    RetryCounter,
+    RetryPolicy,
+    retry_call,
+)
+from tpumon.resilience.watchdog import PollWatchdog
+
+__all__ = [
+    "Backoff",
+    "BreakerRegistry",
+    "CircuitBreaker",
+    "FaultInjectingBackend",
+    "FaultSpec",
+    "PollResilience",
+    "PollWatchdog",
+    "RetryCounter",
+    "RetryPolicy",
+    "retry_call",
+]

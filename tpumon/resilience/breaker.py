@@ -1,0 +1,149 @@
+"""Per-query circuit breaker: closed → open → half-open → closed.
+
+One breaker per device query (plus one for metric enumeration), owned by
+the poll loop via :class:`tpumon.resilience.degrade.PollResilience`. The
+contract that matters operationally:
+
+- **Closed** — calls flow; ``failures`` consecutive failures open it.
+- **Open** — calls are refused for ``open_s`` seconds. The exporter
+  serves last-good data meanwhile (stale-but-served), so an open breaker
+  costs *zero* device calls per poll instead of a timeout per poll.
+- **Half-open** — after ``open_s``, exactly one probe call is admitted
+  per poll; ``probes`` consecutive probe successes close the breaker,
+  any probe failure re-opens it (restarting the window). Device-query
+  attempts during an outage are therefore capped by the probe schedule:
+  at most ``ceil(outage / open_s)`` probes.
+
+Thread model: used from the poller thread; ``state``/``snapshot`` may be
+read from HTTP threads — a lock guards the tiny state transitions.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+#: Numeric encoding for the tpumon_breaker_state gauge (docs/METRICS.md).
+STATE_VALUES = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        failures: int = 5,
+        open_s: float = 15.0,
+        probes: int = 2,
+        clock=time.monotonic,
+    ) -> None:
+        self.failures = max(1, int(failures))
+        self.open_s = open_s
+        self.probes = max(1, int(probes))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._probe_successes = 0
+        self._opened_at = 0.0
+        #: Monotonic transition counter (observability, never reset).
+        self.opens = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        """May the caller attempt the guarded call right now?
+
+        Open → half-open happens here (time-driven), so the first call
+        after the window elapses is the probe.
+        """
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if self._clock() - self._opened_at >= self.open_s:
+                    self._state = HALF_OPEN
+                    self._probe_successes = 0
+                    return True
+                return False
+            # Half-open: one probe per allow() — the poll loop calls once
+            # per cycle per query, so this throttles probes to poll cadence.
+            return True
+
+    def record(self, ok: bool) -> None:
+        with self._lock:
+            if ok:
+                if self._state == HALF_OPEN:
+                    self._probe_successes += 1
+                    if self._probe_successes >= self.probes:
+                        self._state = CLOSED
+                        self._consecutive_failures = 0
+                elif self._state == CLOSED:
+                    self._consecutive_failures = 0
+                return
+            if self._state == HALF_OPEN:
+                self._trip()
+                return
+            self._consecutive_failures += 1
+            if self._state == CLOSED and (
+                self._consecutive_failures >= self.failures
+            ):
+                self._trip()
+
+    def _trip(self) -> None:
+        self._state = OPEN
+        self._opened_at = self._clock()
+        self._consecutive_failures = 0
+        self._probe_successes = 0
+        self.opens += 1
+
+
+class BreakerRegistry:
+    """Lazily-created breakers keyed by query name, shared settings."""
+
+    def __init__(
+        self,
+        failures: int = 5,
+        open_s: float = 15.0,
+        probes: int = 2,
+        clock=time.monotonic,
+    ) -> None:
+        self._failures = failures
+        self._open_s = open_s
+        self._probes = probes
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._breakers: dict[str, CircuitBreaker] = {}
+
+    def get(self, key: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(key)
+            if br is None:
+                br = CircuitBreaker(
+                    self._failures, self._open_s, self._probes, self._clock
+                )
+                self._breakers[key] = br
+            return br
+
+    def states(self) -> dict[str, str]:
+        with self._lock:
+            items = list(self._breakers.items())
+        return {key: br.state for key, br in items}
+
+    def open_count(self) -> int:
+        return sum(1 for s in self.states().values() if s != CLOSED)
+
+
+__all__ = [
+    "CLOSED",
+    "HALF_OPEN",
+    "OPEN",
+    "STATE_VALUES",
+    "BreakerRegistry",
+    "CircuitBreaker",
+]

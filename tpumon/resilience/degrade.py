@@ -1,0 +1,130 @@
+"""Stale-but-served degradation state for the poll loop.
+
+:class:`PollResilience` is the bridge between the policy layer and the
+collector: it owns one circuit breaker per device query plus the
+last-good cache that keeps ``/metrics`` populated while the device
+runtime misbehaves. The semantics encode the SURVEY distinctions:
+
+- a **failed** query (BackendError, breaker open) serves the last good
+  family for up to ``stale_serve_s`` seconds, flagged via
+  ``tpumon_degraded`` and ``tpumon_family_staleness_seconds{family}`` —
+  stale data labeled stale beats a silent gap;
+- an **empty** query (runtime detached) is truth, not failure: the
+  family goes absent AND the last-good entry is dropped, so a detach
+  can never be masked by stale serving (absent ≠ zero, SURVEY §2.2);
+- a failed **enumeration** keeps sampling from the last good metric
+  list (data keeps flowing) while coverage still reads 0.0 — the
+  enumeration-outage alert fires exactly then (collector contract).
+
+Thread model: mutation happens on the poller thread only; ``snapshot``
+is read from HTTP threads (doctor, /debug/vars) under the same lock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tpumon.resilience.breaker import BreakerRegistry
+
+
+class PollResilience:
+    def __init__(
+        self,
+        *,
+        breaker_failures: int = 5,
+        breaker_open_s: float = 15.0,
+        breaker_probes: int = 2,
+        stale_serve_s: float = 300.0,
+        clock=time.time,
+        breaker_clock=time.monotonic,
+    ) -> None:
+        self.stale_serve_s = stale_serve_s
+        self.breakers = BreakerRegistry(
+            failures=breaker_failures,
+            open_s=breaker_open_s,
+            probes=breaker_probes,
+            clock=breaker_clock,
+        )
+        self._clock = clock
+        self._lock = threading.Lock()
+        #: metric name -> (family object, family name, stored-at ts)
+        self._last_good: dict[str, tuple[object, str, float]] = {}
+        self._supported: tuple[tuple[str, ...], float] | None = None
+
+    # -- last-good families -----------------------------------------------
+
+    def store(self, metric: str, family, ts: float | None = None) -> None:
+        with self._lock:
+            self._last_good[metric] = (
+                family,
+                getattr(family, "name", metric),
+                ts if ts is not None else self._clock(),
+            )
+
+    def forget(self, metric: str) -> None:
+        """Empty sample = runtime detached: absent is the truth now."""
+        with self._lock:
+            self._last_good.pop(metric, None)
+
+    def stale(self, metric: str, now: float | None = None):
+        """(family, family_name, age_s) if a servable last-good exists."""
+        now = now if now is not None else self._clock()
+        with self._lock:
+            entry = self._last_good.get(metric)
+        if entry is None:
+            return None
+        family, fam_name, ts = entry
+        age = max(0.0, now - ts)
+        # stale_serve_s <= 0 disables stale serving entirely (the
+        # documented TPUMON_STALE_SERVE_S=0 opt-out) — never "no cap".
+        if self.stale_serve_s <= 0 or age > self.stale_serve_s:
+            return None
+        return family, fam_name, age
+
+    # -- last-good enumeration --------------------------------------------
+
+    def store_supported(self, supported, ts: float | None = None) -> None:
+        with self._lock:
+            self._supported = (
+                tuple(supported),
+                ts if ts is not None else self._clock(),
+            )
+
+    def stale_supported(self, now: float | None = None):
+        """The last good metric list (with age), if still servable."""
+        now = now if now is not None else self._clock()
+        with self._lock:
+            entry = self._supported
+        if entry is None:
+            return None
+        supported, ts = entry
+        age = max(0.0, now - ts)
+        if self.stale_serve_s <= 0 or age > self.stale_serve_s:
+            return None
+        return supported, age
+
+    # -- observability -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The /debug/vars + doctor surface: breaker states and the ages
+        of every last-good entry (O(queries), no device calls)."""
+        now = self._clock()
+        with self._lock:
+            ages = {
+                fam_name: round(max(0.0, now - ts), 3)
+                for _, fam_name, ts in self._last_good.values()
+            }
+            supported = self._supported
+        return {
+            "stale_serve_s": self.stale_serve_s,
+            "breakers": self.breakers.states(),
+            "breakers_open": self.breakers.open_count(),
+            "last_good_age_s": ages,
+            "last_good_enumeration_age_s": (
+                round(max(0.0, now - supported[1]), 3) if supported else None
+            ),
+        }
+
+
+__all__ = ["PollResilience"]

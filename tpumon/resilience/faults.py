@@ -1,0 +1,261 @@
+"""Deterministic fault injection: the chaos backend.
+
+:class:`FaultInjectingBackend` wraps any real backend and injects the
+failure modes the resilience plane claims to survive — so the claim is
+exercised in CI (tests/test_chaos.py, ``tools/soak.py --chaos``) rather
+than asserted in prose:
+
+- ``error_rate`` / ``list_error_rate`` — BackendError on that fraction
+  of sample / enumeration calls (seeded RNG: deterministic given the
+  call sequence);
+- ``latency_ms`` — added latency per device call (GIL-holding runtime
+  stalls in miniature);
+- ``hang_every`` / ``hang_s`` — every Nth sample call blocks for
+  ``hang_s`` seconds, releasable early by :meth:`interrupt` (what the
+  poll watchdog calls on recovery);
+- ``garbage_rate`` / ``partial_rate`` — malformed rows the parser must
+  skip-and-count, and half-dropped payloads;
+- ``flap_start`` / ``flap_end`` — a poll-cycle window in which the
+  runtime flaps attached/detached every cycle (empty vectors on the
+  detached beats — absent, not zero).
+
+Every injected call is counted in :attr:`calls` (by query) so tests can
+assert the breaker's probe schedule caps device-query attempts during
+an outage.
+
+Configured via the ``TPUMON_FAULTS`` spec string, e.g.::
+
+    TPUMON_FAULTS="error_rate=0.3,hang_every=20,hang_s=10,flap_start=30,flap_end=45"
+
+Unknown or malformed tokens warn and are skipped — a typo'd chaos spec
+must degrade the chaos, never the exporter.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from collections import Counter
+from dataclasses import dataclass, fields
+
+from tpumon.backends.base import BackendError, RawMetric
+
+log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """Parsed ``TPUMON_FAULTS`` spec; all rates in [0, 1], times as noted."""
+
+    #: Fraction of sample() calls that raise BackendError.
+    error_rate: float = 0.0
+    #: Fraction of list_metrics() calls that raise BackendError.
+    list_error_rate: float = 0.0
+    #: Added latency per device call, milliseconds.
+    latency_ms: float = 0.0
+    #: Every Nth sample() call hangs (0 disables).
+    hang_every: float = 0.0
+    #: Hang duration in seconds (interrupt() releases early).
+    hang_s: float = 10.0
+    #: Fraction of sample() payloads corrupted with unparseable rows.
+    garbage_rate: float = 0.0
+    #: Fraction of sample() payloads truncated to half their rows.
+    partial_rate: float = 0.0
+    #: Poll-cycle window [start, end) in which the runtime flaps
+    #: attached/detached every cycle (0/0 disables).
+    flap_start: float = 0.0
+    flap_end: float = 0.0
+    #: RNG seed for deterministic injection.
+    seed: float = 0.0
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultSpec":
+        """``key=value,key=value`` → FaultSpec; bad tokens warn + skip."""
+        known = {f.name for f in fields(cls)}
+        kwargs: dict[str, float] = {}
+        for token in (spec or "").split(","):
+            token = token.strip()
+            if not token:
+                continue
+            key, sep, raw = token.partition("=")
+            key = key.strip()
+            if not sep or key not in known:
+                log.warning("ignoring unknown TPUMON_FAULTS token %r", token)
+                continue
+            try:
+                kwargs[key] = float(raw.strip())
+            except ValueError:
+                log.warning("ignoring malformed TPUMON_FAULTS token %r", token)
+        return cls(**kwargs)
+
+    def describe(self) -> str:
+        """Compact non-default-fields form (doctor / soak records)."""
+        base = type(self)()
+        parts = [
+            f"{f.name}={getattr(self, f.name):g}"
+            for f in fields(self)
+            if getattr(self, f.name) != getattr(base, f.name)
+        ]
+        return ",".join(parts) or "none"
+
+
+class FaultInjectingBackend:
+    """Backend wrapper injecting the configured faults deterministically.
+
+    Everything not overridden (topology, version, core_states, sources,
+    watch_states, ...) passes through to the wrapped backend.
+    """
+
+    def __init__(self, inner, spec: FaultSpec, sleep=time.sleep, retry=None) -> None:
+        from tpumon.resilience.policy import RetryCounter
+
+        self._inner = inner
+        self.spec = spec
+        self.name = f"{inner.name}+faults"
+        self._sleep = sleep
+        #: Optional transport-style retry around the *injected* faults:
+        #: with it, chaos exercises the retry plane for real (injected
+        #: errors get retried and tpumon_retries_total moves) — the
+        #: layer a wrapped-outside fault injector would otherwise never
+        #: reach. None = raw injection (unit-test determinism).
+        self.retry = retry
+        self._retries = RetryCounter()
+        self._rng = random.Random(int(spec.seed))
+        self._lock = threading.Lock()
+        self._hang_release = threading.Event()
+        self._sample_calls = 0
+        self._cycle = 0
+        #: Device-call attempts by query key ("sample:<metric>",
+        #: "list_metrics") — the breaker-probe-schedule evidence.
+        self.calls: Counter = Counter()
+        #: Injected-fault tallies, by kind.
+        self.injected: Counter = Counter()
+
+    # -- chaos controls ----------------------------------------------------
+
+    def interrupt(self) -> None:
+        """Release any in-progress injected hang immediately (the poll
+        watchdog's recovery hook)."""
+        self._hang_release.set()
+
+    def reset(self) -> None:
+        """Watchdog teardown: release hangs, forward to the inner backend."""
+        self.interrupt()
+        inner_reset = getattr(self._inner, "reset", None)
+        if inner_reset is not None:
+            inner_reset()
+
+    def _flapping_detached(self) -> bool:
+        s, e = self.spec.flap_start, self.spec.flap_end
+        if not (s or e) or not (s <= self._cycle < e):
+            return False
+        return (self._cycle - int(s)) % 2 == 0
+
+    def _maybe_hang(self) -> None:
+        every = int(self.spec.hang_every)
+        if every <= 0:
+            return
+        with self._lock:
+            self._sample_calls += 1
+            due = self._sample_calls % every == 0
+        if not due:
+            return
+        self.injected["hang"] += 1
+        # A fresh hang ignores interrupts aimed at an earlier one.
+        self._hang_release.clear()
+        released = self._hang_release.wait(self.spec.hang_s)
+        if released:
+            self._hang_release.clear()
+            self.injected["hang_interrupted"] += 1
+            raise BackendError("injected hang interrupted by recovery")
+        # An uninterrupted hang is just a very slow call: proceed.
+
+    def _maybe_latency(self) -> None:
+        if self.spec.latency_ms > 0:
+            self._sleep(self.spec.latency_ms / 1e3)
+
+    def _corrupt(self, raw: RawMetric) -> RawMetric:
+        data = raw.data
+        if data and self.spec.partial_rate > 0 and (
+            self._rng.random() < self.spec.partial_rate
+        ):
+            self.injected["partial"] += 1
+            data = data[: max(1, len(data) // 2)]
+        if data and self.spec.garbage_rate > 0 and (
+            self._rng.random() < self.spec.garbage_rate
+        ):
+            self.injected["garbage"] += 1
+            data = ("not-a-number",) + data[1:] + ("trailing: garbage: x",)
+        return RawMetric(raw.name, data)
+
+    # -- Backend protocol --------------------------------------------------
+
+    def list_metrics(self):
+        if self.retry is None:
+            return self._list_once()
+        return self._retries.call("faults:list", self._list_once, self.retry)
+
+    def _list_once(self):
+        self.calls["list_metrics"] += 1
+        self._maybe_latency()
+        if self.spec.list_error_rate > 0 and (
+            self._rng.random() < self.spec.list_error_rate
+        ):
+            self.injected["list_error"] += 1
+            raise BackendError("injected enumeration failure")
+        return self._inner.list_metrics()
+
+    def sample(self, name: str) -> RawMetric:
+        if self.retry is None:
+            return self._sample_once(name)
+        return self._retries.call(
+            "faults:sample", lambda: self._sample_once(name), self.retry
+        )
+
+    def _sample_once(self, name: str) -> RawMetric:
+        self.calls[f"sample:{name}"] += 1
+        self._maybe_hang()
+        self._maybe_latency()
+        if self._flapping_detached():
+            self.injected["flap_detach"] += 1
+            return RawMetric(name, ())
+        if self.spec.error_rate > 0 and (
+            self._rng.random() < self.spec.error_rate
+        ):
+            self.injected["error"] += 1
+            raise BackendError(f"injected failure for {name}")
+        return self._corrupt(self._inner.sample(name))
+
+    def retry_counts(self) -> dict[str, int]:
+        out = self._retries.counts()
+        inner_counts = getattr(self._inner, "retry_counts", None)
+        if inner_counts is not None:
+            for call, n in inner_counts().items():
+                out[call] = out.get(call, 0) + n
+        return out
+
+    def advance(self, steps: int = 1) -> None:
+        """Poll-cycle clock for the flap window; forwards to backends
+        that have a time dimension (the fake)."""
+        self._cycle += steps
+        inner_advance = getattr(self._inner, "advance", None)
+        if inner_advance is not None:
+            inner_advance(steps)
+
+    def topology(self):
+        return self._inner.topology()
+
+    def version(self) -> str:
+        return self._inner.version()
+
+    def close(self) -> None:
+        self.interrupt()
+        self._inner.close()
+
+    def __getattr__(self, attr):
+        return getattr(self._inner, attr)
+
+
+__all__ = ["FaultInjectingBackend", "FaultSpec"]

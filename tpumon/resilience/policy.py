@@ -1,0 +1,151 @@
+"""Backoff and retry policy: the lowest layer of the resilience stack.
+
+Design rules, distilled from what actually bites in a 1 Hz poll loop:
+
+- **Bounded.** Delays cap at ``max_s`` and attempts at ``attempts``; a
+  retry storm can never outlive its caller's budget, and an overall
+  ``deadline_s`` stops a retry sequence even when individual calls are
+  fast-failing.
+- **Jittered.** Full deterministic backoff synchronizes every exporter
+  in a DaemonSet against a shared dependency (the kubelet socket, a
+  slice-wide runtime restart); each delay is multiplied by a uniform
+  factor in ``[1 - jitter, 1 + jitter]``.
+- **Observable.** ``retry_call`` reports each retry through an optional
+  callback; the poller folds those counts into
+  ``tpumon_retries_total{call}``.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff with jitter, shared by every caller.
+
+    ``attempts`` counts total tries (1 = no retry). The k-th retry waits
+    ``min(base_s * 2**k, max_s)`` scaled by the jitter factor.
+    ``deadline_s`` (when set) bounds the whole sequence: no retry starts
+    after the deadline has elapsed since the first attempt.
+    """
+
+    attempts: int = 2
+    base_s: float = 0.05
+    max_s: float = 1.0
+    jitter: float = 0.5
+    deadline_s: float | None = None
+
+    def delay(self, retry_index: int, rng: random.Random | None = None) -> float:
+        """Delay before the ``retry_index``-th retry (0-based), jittered."""
+        r = rng if rng is not None else random
+        capped = min(self.base_s * (2.0 ** retry_index), self.max_s)
+        lo = max(0.0, 1.0 - self.jitter)
+        return capped * (lo + (1.0 + self.jitter - lo) * r.random())
+
+    def delay_bounds(self, retry_index: int) -> tuple[float, float]:
+        """[lo, hi] envelope of :meth:`delay` — the testable contract."""
+        capped = min(self.base_s * (2.0 ** retry_index), self.max_s)
+        return capped * max(0.0, 1.0 - self.jitter), capped * (1.0 + self.jitter)
+
+
+def retry_call(
+    fn,
+    policy: RetryPolicy,
+    *,
+    rng: random.Random | None = None,
+    clock=time.monotonic,
+    sleep=time.sleep,
+    on_retry=None,
+    retryable=Exception,
+):
+    """Call ``fn()`` under ``policy``; re-raises the last failure.
+
+    ``on_retry(attempt_index, exc)`` fires before each retry sleep (the
+    counting hook). ``retryable`` narrows which exceptions are retried —
+    anything else propagates immediately.
+    """
+    t0 = clock()
+    attempts = max(1, int(policy.attempts))
+    last_exc: BaseException | None = None
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except retryable as exc:
+            last_exc = exc
+            if attempt + 1 >= attempts:
+                break
+            delay = policy.delay(attempt, rng)
+            if (
+                policy.deadline_s is not None
+                and clock() - t0 + delay > policy.deadline_s
+            ):
+                break
+            if on_retry is not None:
+                on_retry(attempt, exc)
+            if delay > 0:
+                sleep(delay)
+    assert last_exc is not None
+    raise last_exc
+
+
+class RetryCounter:
+    """Retry accounting shared by the transport backends.
+
+    Wraps :func:`retry_call` and tallies retries by call kind — the
+    ``tpumon_retries_total{call}`` feed, delta-read by the poller via
+    each backend's ``retry_counts()``.
+    """
+
+    def __init__(self) -> None:
+        self._counts: dict[str, int] = {}
+
+    def call(self, call: str, fn, policy: RetryPolicy):
+        def note(_attempt, _exc) -> None:
+            self._counts[call] = self._counts.get(call, 0) + 1
+
+        return retry_call(fn, policy, on_retry=note)
+
+    def counts(self) -> dict[str, int]:
+        return dict(self._counts)
+
+
+class Backoff:
+    """Stateful bounded exponential backoff for poll-by-poll callers.
+
+    For code that decides "should I try again *this cycle*" rather than
+    retrying inline (pod attribution, stream reopen): each failure
+    advances the delay ``base_s, 2*base_s, ... max_s`` (jittered), a
+    success resets it. Never sleeps — callers schedule themselves.
+    """
+
+    def __init__(
+        self,
+        base_s: float = 5.0,
+        max_s: float = 300.0,
+        jitter: float = 0.25,
+        rng: random.Random | None = None,
+    ) -> None:
+        self.base_s = base_s
+        self.max_s = max_s
+        self.jitter = jitter
+        self._rng = rng
+        self.failures = 0
+
+    def next_delay(self) -> float:
+        """Register one failure and return the delay before the next try."""
+        # Exponent clamped: 2.0**1024 raises OverflowError, and a
+        # years-long outage must keep backing off, not start storming.
+        capped = min(self.base_s * (2.0 ** min(self.failures, 32)), self.max_s)
+        self.failures += 1
+        r = self._rng if self._rng is not None else random
+        lo = max(0.0, 1.0 - self.jitter)
+        return capped * (lo + (1.0 + self.jitter - lo) * r.random())
+
+    def reset(self) -> None:
+        self.failures = 0
+
+
+__all__ = ["Backoff", "RetryCounter", "RetryPolicy", "retry_call"]

@@ -1,0 +1,120 @@
+"""Poll-loop watchdog: detect a stuck device call, trigger recovery.
+
+Python cannot kill a thread blocked inside a native device call, so the
+watchdog recovers the *call*, not the thread: when a poll cycle runs
+past ``hang_budget_s``, it fires ``on_hang()``, which the exporter wires
+to backend teardown — ``interrupt()`` (fault-injection hangs release
+immediately) and ``reset()`` (the gRPC backend closes its channel, which
+fails any in-flight RPC at the transport layer and forces a clean
+re-dial on the next cycle). The blocked call then raises, the cycle
+completes as a counted backend error, and stale-but-served degradation
+carries ``/metrics`` throughout.
+
+The monitor thread wakes at ``hang_budget_s / 4`` granularity (floored
+at 50 ms) and fires at most once per budget overrun — a cycle stuck for
+``3 * hang_budget_s`` gets three recovery attempts, not a busy loop.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+log = logging.getLogger(__name__)
+
+
+class PollWatchdog:
+    def __init__(
+        self,
+        hang_budget_s: float,
+        on_hang,
+        clock=time.monotonic,
+    ) -> None:
+        if hang_budget_s <= 0:
+            raise ValueError(f"hang budget must be > 0, got {hang_budget_s}")
+        self.hang_budget_s = hang_budget_s
+        self._on_hang = on_hang
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._cycle_started: float | None = None
+        self._fired_for: float | None = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="tpumon-watchdog", daemon=True
+        )
+        #: Recoveries triggered since start (mirrored into
+        #: tpumon_watchdog_recoveries_total by the exporter's hook).
+        self.recoveries = 0
+
+    # -- heartbeat (called from the poller thread) ------------------------
+
+    def cycle_started(self) -> None:
+        with self._lock:
+            self._cycle_started = self._clock()
+            self._fired_for = None
+
+    def beat(self) -> None:
+        """Progress heartbeat: each completed device call resets the
+        hang timer. A cycle that is slow because every call fails at its
+        bounded per-call deadline (black-holed endpoint) is *progressing*
+        — that outage belongs to the breakers; the watchdog must only
+        fire when one call is actually stuck past the budget."""
+        with self._lock:
+            if self._cycle_started is not None:
+                self._cycle_started = self._clock()
+                self._fired_for = None
+
+    def cycle_finished(self) -> None:
+        with self._lock:
+            self._cycle_started = None
+            self._fired_for = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+
+    # -- monitor -----------------------------------------------------------
+
+    def check(self) -> bool:
+        """One monitor evaluation; fires on_hang when the current cycle
+        overran the budget (and hasn't been fired for yet). Public so
+        tests can drive the state machine without the thread."""
+        with self._lock:
+            started = self._cycle_started
+            if started is None:
+                return False
+            now = self._clock()
+            overrun = now - started
+            if overrun < self.hang_budget_s:
+                return False
+            if self._fired_for is not None and (
+                now - self._fired_for < self.hang_budget_s
+            ):
+                return False
+            self._fired_for = now
+            self.recoveries += 1
+        log.warning(
+            "poll cycle stuck for %.1fs (budget %.1fs); recovering backend",
+            overrun,
+            self.hang_budget_s,
+        )
+        try:
+            self._on_hang()
+        except Exception:
+            log.exception("watchdog recovery hook failed")
+        return True
+
+    def _run(self) -> None:
+        tick = max(0.05, self.hang_budget_s / 4.0)
+        while not self._stop.wait(timeout=tick):
+            self.check()
+
+
+__all__ = ["PollWatchdog"]

@@ -46,6 +46,9 @@ _F_COUNT = "accelerator_device_count"
 _F_COVERAGE = "exporter_metric_coverage_ratio"
 _F_WATCH = "accelerator_monitor_watch_streams"
 _F_NET_RATE = "accelerator_network_delivery_rate_mbps"
+_F_DEGRADED = "tpumon_degraded"
+_F_STALENESS = "tpumon_family_staleness_seconds"
+_F_BREAKER = "tpumon_breaker_state"
 
 
 def _fetch(url: str, timeout: float) -> str:
@@ -112,6 +115,29 @@ def snapshot_from_families(families) -> dict:
         snap["watch_streams"] = {
             s.labels.get("state", "?"): int(s.value) for s in watch.samples
         }
+
+    deg = fams.get(_F_DEGRADED)
+    if deg is not None and deg.samples:
+        # Fault-tolerance plane (tpumon/resilience): degraded-serving
+        # state + which families ride the last-good cache and how old
+        # they are. Absent on pre-resilience exporters and in-process
+        # snapshots (self-telemetry families live off the device page).
+        degraded: dict = {"active": deg.samples[0].value > 0, "families": {}}
+        stale = fams.get(_F_STALENESS)
+        if stale is not None:
+            degraded["families"] = {
+                s.labels.get("family", "?"): s.value for s in stale.samples
+            }
+        breaker = fams.get(_F_BREAKER)
+        if breaker is not None:
+            open_queries = [
+                s.labels.get("query", "?")
+                for s in breaker.samples
+                if s.value >= 2
+            ]
+            if open_queries:
+                degraded["breakers_open"] = sorted(open_queries)
+        snap["degraded"] = degraded
 
     net = fams.get(_F_NET_RATE)
     if net is not None:
@@ -481,6 +507,27 @@ def render(snap: dict, out=None) -> None:
         if ici["worst"]:
             line += f" (worst: {ici['worst'][0]} score={ici['worst'][1]:.0f})"
         p(line)
+    degraded = snap.get("degraded")
+    if degraded and degraded.get("active"):
+        stale = degraded.get("families") or {}
+        parts = []
+        if stale:
+            parts.append(f"serving last-good data for {len(stale)} families")
+            oldest = max(stale.items(), key=lambda kv: kv[1])
+            parts.append(f"oldest {oldest[0]} at {oldest[1]:.0f}s")
+        if degraded.get("breakers_open"):
+            parts.append(
+                f"{len(degraded['breakers_open'])} breakers open "
+                f"({', '.join(degraded['breakers_open'][:3])}"
+                + ("..." if len(degraded["breakers_open"]) > 3 else "")
+                + ")"
+            )
+        if not parts:
+            # Degraded without stale families or open breakers (e.g. a
+            # recovered enumeration outage): still worth the line.
+            parts.append("serving on degraded data paths")
+        p("DEGRADED: " + "; ".join(parts))
+
     streams = snap.get("watch_streams")
     if streams:
         p(

@@ -26,6 +26,14 @@ import time
 
 from tpumon.tools.measure import PAGE_SENTINEL, quantile
 
+#: Default --chaos spec: sustained RPC errors + periodic hangs + one
+#: payload-corruption dose — the ISSUE acceptance mix, scaled so a short
+#: smoke exercises every injector (tpumon/resilience/faults.py).
+DEFAULT_CHAOS_SPEC = (
+    "error_rate=0.3,hang_every=40,hang_s=10,garbage_rate=0.05,"
+    "partial_rate=0.05,flap_start=15,flap_end=25"
+)
+
 
 def soak(
     duration_s: float,
@@ -33,6 +41,7 @@ def soak(
     topology: str = "v5p-64",
     interval: float = 1.0,
     backend: str = "fake",
+    chaos: str | None = None,
 ) -> dict:
     """``backend="fake"`` soaks the synthetic v5p topology (the bench's
     configuration); any other value is a Config backend selection —
@@ -56,24 +65,52 @@ def soak(
     # Everything that can fail on bad arguments happens BEFORE the
     # switch-interval mutation below, so an invalid topology/backend
     # leaves the caller's interpreter settings untouched.
+    fault_backend = None
+    chaos_cfg: dict = {}
+    if chaos:
+        from tpumon.resilience import FaultSpec
+
+        fault_spec = FaultSpec.parse(chaos)
+        # Chaos runs tighten the recovery knobs so a short soak exercises
+        # breaker-open AND watchdog-recovery, not just retry.
+        chaos_cfg = dict(
+            watchdog_hang_s=max(2.0, interval * 2.0),
+            breaker_open_s=5.0,
+        )
     if backend == "fake":
-        cfg = Config(port=0, addr="127.0.0.1", interval=interval)
-        exporter = build_exporter(cfg, FakeTpuBackend.preset(topology))
+        cfg = Config(port=0, addr="127.0.0.1", interval=interval, **chaos_cfg)
+        inner = FakeTpuBackend.preset(topology)
+        if chaos:
+            from tpumon.resilience import FaultInjectingBackend, RetryPolicy
+
+            inner = fault_backend = FaultInjectingBackend(
+                inner, fault_spec, retry=RetryPolicy()
+            )
+        exporter = build_exporter(cfg, inner)
     else:
         cfg = Config(
-            port=0, addr="127.0.0.1", interval=interval, backend=backend
+            port=0, addr="127.0.0.1", interval=interval, backend=backend,
+            faults=chaos or "", **chaos_cfg,
         )
-        exporter = build_exporter(cfg)  # create_backend resolves it
+        exporter = build_exporter(cfg)  # create_backend resolves + wraps
+        if chaos:
+            fault_backend = exporter.backend
 
     # On a real idle host the data families are absent by design (runtime
     # detached — SURVEY §2.2), so page integrity is judged by an identity
-    # family that must always be present instead.
+    # family that must always be present instead. Under chaos the
+    # degraded plane may be serving last-good data, but identity is
+    # built fresh every cycle — it must never vanish.
     sentinel = (
-        PAGE_SENTINEL if backend == "fake" else b"accelerator_device_count"
+        PAGE_SENTINEL
+        if backend == "fake" and not chaos
+        else b"accelerator_device_count"
     )
     lat_ms: list[float] = []
     rss: list[float] = []
     bad_pages = 0
+    degraded_scrapes = 0
+    failed_scrapes = 0
     conn = None
     # Mirror the daemon entrypoint's scrape-tail tuning, same opt-out
     # (exporter/main.py): without it the poll cycle can hold a scrape
@@ -95,21 +132,46 @@ def soak(
         next_at = t0
         while time.time() - t0 < duration_s:
             s = time.perf_counter()
-            conn.request("GET", "/metrics")
-            body = conn.getresponse().read()
-            lat_ms.append((time.perf_counter() - s) * 1e3)
-            if sentinel not in body:
-                bad_pages += 1
+            try:
+                conn.request("GET", "/metrics")
+                body = conn.getresponse().read()
+            except (OSError, http.client.HTTPException):
+                # The acceptance bar is "every scrape answered": count
+                # the miss (it should never happen — the scrape path is
+                # device-free) and reconnect rather than aborting the
+                # evidence run.
+                failed_scrapes += 1
+                conn.close()
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", exporter.server.port, timeout=10
+                )
+            else:
+                lat_ms.append((time.perf_counter() - s) * 1e3)
+                # Page checks only apply to pages we actually received —
+                # a connection failure is failed_scrapes, not bad_pages.
+                if sentinel not in body:
+                    bad_pages += 1
+                if b"\ntpumon_degraded 1.0" in body:
+                    degraded_scrapes += 1
             if rss_of is not None and len(lat_ms) % 300 == 1:
                 rss.append(round(rss_of().rss / 1e6, 1))
             next_at += scrape_every_s
             time.sleep(max(0.0, next_at - time.time()))
-        conn.request("GET", "/metrics")
-        page = conn.getresponse().read().decode()
+        try:
+            conn.request("GET", "/metrics")
+            page = conn.getresponse().read().decode()
+        except (OSError, http.client.HTTPException):
+            page = ""  # dead server: the record (failed_scrapes) is the story
         # ^-anchored: the family's HELP line also starts with the name.
         polls = re.search(r"^collector_polls_total (\S+)", page, re.M)
         errors = re.findall(
             r'^collector_errors_total\{kind="(\w+)"\} (\S+)', page, re.M
+        )
+        recoveries = re.search(
+            r"^tpumon_watchdog_recoveries_total (\S+)", page, re.M
+        )
+        retries = re.findall(
+            r'^tpumon_retries_total\{call="([^"]+)"\} (\S+)', page, re.M
         )
     finally:
         if conn is not None:
@@ -118,22 +180,51 @@ def soak(
         sys.setswitchinterval(prev_switch)
 
     lat_ms.sort()
-    return {
+
+    def _q(p: float):
+        # An all-scrapes-failed run (server died at startup) must still
+        # produce the evidence record — failed_scrapes is the finding.
+        return round(quantile(lat_ms, p), 3) if lat_ms else None
+
+    record = {
         # The *resolved* backend, not the requested one: --backend auto
         # can fall back to stub, and soak evidence must say which SDK it
         # actually exercised.
         "backend": exporter.backend.name,
         "scrapes": len(lat_ms),
         "duration_s": round(time.time() - t0, 1),
-        "p50_ms": round(quantile(lat_ms, 0.5), 3),
-        "p99_ms": round(quantile(lat_ms, 0.99), 3),
-        "p999_ms": round(quantile(lat_ms, 0.999), 3),
-        "max_ms": round(lat_ms[-1], 3),
+        "p50_ms": _q(0.5),
+        "p99_ms": _q(0.99),
+        "p999_ms": _q(0.999),
+        "max_ms": round(lat_ms[-1], 3) if lat_ms else None,
         "bad_pages": bad_pages,
+        "failed_scrapes": failed_scrapes,
         "rss_mb_samples": rss,
         "poll_cycles": float(polls.group(1)) if polls else None,
         "collector_errors": {k: float(v) for k, v in errors},
     }
+    if chaos:
+        record["chaos"] = {
+            "spec": fault_spec.describe(),
+            "degraded_scrapes": degraded_scrapes,
+            "watchdog_recoveries": (
+                float(recoveries.group(1)) if recoveries else 0.0
+            ),
+            "retries": {k: float(v) for k, v in retries},
+            "injected": (
+                dict(fault_backend.injected)
+                if fault_backend is not None
+                and hasattr(fault_backend, "injected")
+                else {}
+            ),
+            "device_calls": (
+                sum(fault_backend.calls.values())
+                if fault_backend is not None
+                and hasattr(fault_backend, "calls")
+                else None
+            ),
+        }
+    return record
 
 
 def main(argv=None) -> int:
@@ -152,12 +243,18 @@ def main(argv=None) -> int:
                         help="'fake' (synthetic --topology preset) or a "
                         "real backend selection — 'auto'/'libtpu' soak "
                         "the real monitoring SDK on a TPU host")
+    parser.add_argument("--chaos", nargs="?", const=DEFAULT_CHAOS_SPEC,
+                        default=None, metavar="SPEC",
+                        help="wrap the backend in deterministic fault "
+                        "injection (tpumon/resilience/faults.py) and "
+                        "report degraded-serving evidence; optional SPEC "
+                        f"overrides the default ({DEFAULT_CHAOS_SPEC!r})")
     args = parser.parse_args(argv)
     if args.duration <= 0:
         parser.error("--duration must be > 0")
     print(json.dumps(soak(
         args.duration, args.scrape_every, args.topology, args.interval,
-        args.backend,
+        args.backend, chaos=args.chaos,
     )))
     return 0
 

@@ -881,6 +881,11 @@ def main(argv: list[str] | None = None) -> int:
         registry.register(StatsCollector(stats))
         telemetry = SelfTelemetry(registry)
         telemetry.last_poll.set(time.time())
+        # No device poll loop here; the serving process is the liveness.
+        # Without this the shared tpumon_up gauge reads 0 forever and
+        # falsely trips the TPUMonPollLoopDown alert (same fix as the
+        # discovery sidecar).
+        telemetry.up.set(1)
         server = ExporterServer(
             _make_app(registry_renderer(registry), telemetry, lambda: (True, "ok\n")),
             "0.0.0.0",

@@ -36,12 +36,14 @@ def _known_metric_names():
 
 # `tpu_anomaly` (not bare `tpu_`): libtpu SOURCE metric names like
 # tpu_throttle_score appear in docs and must not be mistaken for
-# Prometheus families. Same for the trace-plane and resilience-plane
-# self-metrics: match those family prefixes, not every "tpumon" mention.
+# Prometheus families. Same for the trace-plane, resilience-plane, and
+# guard-plane self-metrics: match those family prefixes, not every
+# "tpumon" mention.
 _METRIC_RE = re.compile(
     r"\b(?:(?:accelerator|exporter|collector|workload|tpu_anomaly"
     r"|tpumon_trace|tpumon_poll|tpumon_family|tpumon_breaker"
-    r"|tpumon_retries|tpumon_watchdog)_[a-z0-9_]+"
+    r"|tpumon_retries|tpumon_watchdog|tpumon_guard|tpumon_shed"
+    r"|tpumon_cardinality)_[a-z0-9_]+"
     r"|tpumon_up|tpumon_degraded)\b"
 )
 

@@ -1,0 +1,1024 @@
+"""Self-protection plane (tpumon/guard): admission control, deadlines,
+cardinality budget, memory watermarks, malformed ingress, and the storm
+acceptance run.
+
+The fast tests run the machinery at compressed timescales (tier-1);
+``test_storm_acceptance_full`` is the full-length ISSUE criterion run
+(tier-2 @slow, the CI storm job executes it).
+"""
+
+import json
+import re
+import socket
+import threading
+import time
+
+import pytest
+
+from tpumon.backends.fake import FakeTpuBackend
+from tpumon.config import Config
+from tpumon.exporter.server import build_exporter
+from tpumon.guard.cardinality import SENTINEL, CardinalityGovernor
+from tpumon.guard.ingress import IngressGuard, TokenBucket
+from tpumon.guard.memwatch import (
+    HARD,
+    NORMAL,
+    SOFT,
+    MemoryWatch,
+    resolve_watermarks,
+)
+
+
+def _counter_value(text: str, name: str) -> float:
+    m = re.search(rf"^{name} (\S+)", text, flags=re.M)
+    return float(m.group(1)) if m else 0.0
+
+
+def _labeled_series(text: str, name: str) -> dict:
+    out = {}
+    for labels, value in re.findall(
+        rf"^{name}\{{([^}}]*)\}} (\S+)", text, flags=re.M
+    ):
+        out[labels] = float(value)
+    return out
+
+
+def _raw_exchange(port: int, payload: bytes, timeout: float = 5.0) -> bytes:
+    """Send raw bytes, read whatever comes back until EOF/timeout."""
+    sock = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    try:
+        sock.sendall(payload)
+        chunks = []
+        try:
+            while True:
+                data = sock.recv(4096)
+                if not data:
+                    break
+                chunks.append(data)
+        except socket.timeout:
+            pass
+        return b"".join(chunks)
+    finally:
+        sock.close()
+
+
+# -- token bucket / admission units ---------------------------------------
+
+
+def test_token_bucket_rate_and_burst():
+    clock = [0.0]
+    bucket = TokenBucket(rate=10.0, burst=5.0, clock=lambda: clock[0])
+    assert sum(bucket.allow() for _ in range(10)) == 5  # burst drains
+    clock[0] += 0.5  # refills 5 tokens
+    assert sum(bucket.allow() for _ in range(10)) == 5
+    clock[0] += 0.05  # refills 0.5 token: not enough for one request
+    assert not bucket.allow()
+    clock[0] += 0.06
+    assert bucket.allow()
+
+
+def test_token_bucket_zero_rate_is_unlimited():
+    bucket = TokenBucket(rate=0.0, burst=0.0)
+    assert all(bucket.allow() for _ in range(1000))
+
+
+def test_ingress_classify():
+    assert IngressGuard.classify("/metrics") == ("metrics", "metrics")
+    assert IngressGuard.classify("/") == ("metrics", "metrics")
+    assert IngressGuard.classify("/history") == ("history", "debug")
+    assert IngressGuard.classify("/anomalies") == ("anomalies", "debug")
+    assert IngressGuard.classify("/debug/vars") == ("debug", "debug")
+    assert IngressGuard.classify("/debug/traces/slow") == ("debug", "debug")
+    assert IngressGuard.classify("/health/devices") == ("debug", "debug")
+    # Never shed: kubelet probes and unknown paths.
+    assert IngressGuard.classify("/healthz") == (None, None)
+    assert IngressGuard.classify("/livez") == (None, None)
+    assert IngressGuard.classify("/nope") == (None, None)
+
+
+def _wsgi_call(app, path):
+    captured = {}
+
+    def start_response(status, headers):
+        captured["status"] = status
+        captured["headers"] = dict(headers)
+
+    body = b"".join(app({"PATH_INFO": path}, start_response))
+    return captured["status"], captured["headers"], body
+
+
+def test_middleware_sheds_on_concurrency_and_releases():
+    guard = IngressGuard(metrics_inflight=1, metrics_rps=0.0)
+    entered = threading.Event()
+    release = threading.Event()
+
+    def inner(environ, start_response):
+        entered.set()
+        release.wait(5.0)
+        start_response("200 OK", [])
+        return [b"ok"]
+
+    app = guard.wsgi(inner)
+    t = threading.Thread(
+        target=lambda: _wsgi_call(app, "/metrics"), daemon=True
+    )
+    t.start()
+    assert entered.wait(5.0)
+    status, headers, body = _wsgi_call(app, "/metrics")  # over the cap
+    assert status.startswith("503")
+    assert headers["Retry-After"] == "1"
+    assert b"shed" in body
+    assert guard.shed_counts[("metrics", "concurrency")] == 1
+    release.set()
+    t.join(5.0)
+    status, _, body = _wsgi_call(app, "/metrics")  # slot released
+    assert status.startswith("200")
+
+
+def test_middleware_sheds_on_rate():
+    clock = [0.0]
+    guard = IngressGuard(debug_rps=1.0, clock=lambda: clock[0])
+
+    def inner(environ, start_response):
+        start_response("200 OK", [])
+        return [b"ok"]
+
+    app = guard.wsgi(inner)
+    results = [_wsgi_call(app, "/history")[0] for _ in range(5)]
+    assert results.count("503 Service Unavailable") == 3  # burst = 2
+    assert guard.shed_counts[("history", "rate")] == 3
+    clock[0] += 1.0  # one token back
+    assert _wsgi_call(app, "/history")[0].startswith("200")
+
+
+def test_middleware_memory_hard_sheds_debug_not_metrics():
+    state = [HARD]
+    guard = IngressGuard(memory_state=lambda: state[0])
+
+    def inner(environ, start_response):
+        start_response("200 OK", [])
+        return [b"ok"]
+
+    app = guard.wsgi(inner)
+    assert _wsgi_call(app, "/metrics")[0].startswith("200")
+    status, headers, _ = _wsgi_call(app, "/debug/vars")
+    assert status.startswith("503")
+    assert headers["Retry-After"]
+    assert guard.shed_counts[("debug", "memory")] == 1
+    state[0] = NORMAL
+    assert _wsgi_call(app, "/debug/vars")[0].startswith("200")
+
+
+# -- cardinality governor --------------------------------------------------
+
+
+def _pod_family(n):
+    from prometheus_client.core import GaugeMetricFamily
+
+    fam = GaugeMetricFamily(
+        "accelerator_pod_info", "pods", labels=("host", "namespace", "pod")
+    )
+    for i in range(n):
+        fam.add_metric(("node0", "ns", f"pod-{i:04d}"), 1.0)
+    return fam
+
+
+def test_governor_collapses_overflow_into_other():
+    drops = {}
+    gov = CardinalityGovernor(
+        10, observe_drop=lambda f, n: drops.__setitem__(f, n)
+    )
+    fam = _pod_family(25)
+    collapsed = gov.govern([fam], base_keys=("host",))
+    assert collapsed == 15
+    assert len(fam.samples) == 11  # 10 kept + 1 sentinel
+    sentinel = fam.samples[-1]
+    assert sentinel.labels == {
+        "host": "node0", "namespace": SENTINEL, "pod": SENTINEL
+    }
+    assert sentinel.value == 15.0  # sum of collapsed values
+    # The survivors are the FIRST n in build order — stable identity.
+    assert fam.samples[0].labels["pod"] == "pod-0000"
+    assert drops == {"accelerator_pod_info": 15}
+    assert gov.dropped == {"accelerator_pod_info": 15}
+
+
+def test_governor_skips_within_budget_and_histograms():
+    gov = CardinalityGovernor(10)
+    small = _pod_family(5)
+    gov.govern([small], base_keys=("host",))
+    assert len(small.samples) == 5 and not gov.dropped
+
+    # Histogram-shaped family (mixed sample names): never collapsed.
+    from prometheus_client.core import GaugeMetricFamily
+
+    hist = GaugeMetricFamily("x_bucket_like", "h", labels=("le",))
+    for i in range(20):
+        hist.add_metric((str(i),), float(i))
+    hist.samples[0] = type(hist.samples[0])(
+        "x_bucket_like_sum", {}, 1.0
+    )
+    gov.govern([hist], base_keys=())
+    assert len(hist.samples) == 20
+
+
+def test_governor_idempotent_on_already_governed_family():
+    """A stale-served family from the last-good cache arrives already
+    collapsed (budget + sentinel): re-governing it must not count
+    phantom drops every cycle."""
+    gov = CardinalityGovernor(10)
+    fam = _pod_family(25)
+    gov.govern([fam], base_keys=("host",))
+    counted = dict(gov.dropped)
+    gov.govern([fam], base_keys=("host",))
+    assert gov.dropped == counted
+    assert len(fam.samples) == 11
+
+
+def test_governor_disabled_with_nonpositive_budget():
+    gov = CardinalityGovernor(0)
+    fam = _pod_family(50)
+    assert gov.govern([fam]) == 0
+    assert len(fam.samples) == 50
+
+
+def test_governor_bounds_live_scrape_and_raises_counter(scrape):
+    """End to end: a topology whose per-chip/per-link cardinality blows
+    the budget gets collapsed on the page and the drop counter moves."""
+    backend = FakeTpuBackend.preset("v5p-64")  # 64 chips: >8 series/family
+    cfg = Config(
+        port=0, addr="127.0.0.1", interval=30.0,
+        guard_max_series_per_family=8,
+    )
+    exp = build_exporter(cfg, backend)
+    exp.start()
+    try:
+        exp.poller.poll_once()
+        _, text = scrape(exp.server.url + "/metrics")
+        dropped = _labeled_series(
+            text, "tpumon_cardinality_dropped_series_total"
+        )
+        assert any(v > 0 for v in dropped.values()), dropped
+        assert f'="{SENTINEL}"' in text
+        # Every governed (device-page) family respects the budget
+        # (+1 sentinel). Histogram exposition rows and the
+        # self-telemetry registry (bounded by construction, not
+        # governed) are exempt.
+        from prometheus_client.parser import text_string_to_metric_families
+
+        for fam in text_string_to_metric_families(text):
+            if not fam.name.startswith(("accelerator_", "tpu_")):
+                continue
+            names = {s.name for s in fam.samples}
+            if len(names) > 1:
+                continue  # histogram exposition rows
+            assert len(fam.samples) <= 9, fam.name
+    finally:
+        exp.close()
+
+
+# -- memory watermarks -----------------------------------------------------
+
+
+def test_memwatch_transitions_and_hooks():
+    rss = [100e6]
+    fired = []
+    mw = MemoryWatch(
+        soft_bytes=200e6, hard_bytes=300e6, rss_fn=lambda: rss[0]
+    )
+    mw.add_hooks(lambda: fired.append("degrade"), lambda: fired.append("restore"))
+    assert mw.check() == NORMAL and not fired
+    rss[0] = 210e6
+    assert mw.check() == SOFT
+    assert fired == ["degrade"]
+    assert mw.check() == SOFT and fired == ["degrade"]  # no re-fire
+    rss[0] = 310e6
+    assert mw.check() == HARD and fired == ["degrade"]  # already degraded
+    rss[0] = 250e6  # under hard*0.9=270 but over soft*0.9=180
+    assert mw.check() == SOFT
+    rss[0] = 150e6
+    assert mw.check() == NORMAL
+    assert fired == ["degrade", "restore"]
+    assert mw.transitions == 4
+    assert mw.max_rss == 310e6
+
+
+def test_memwatch_hysteresis_no_flap():
+    rss = [199e6]
+    mw = MemoryWatch(soft_bytes=200e6, hard_bytes=0, rss_fn=lambda: rss[0])
+    assert mw.check() == NORMAL
+    rss[0] = 200e6
+    assert mw.check() == SOFT
+    rss[0] = 195e6  # over soft*0.9=180: stays SOFT
+    assert mw.check() == SOFT
+    rss[0] = 179e6
+    assert mw.check() == NORMAL
+
+
+def test_memwatch_disarmed_without_thresholds_or_reader():
+    mw = MemoryWatch(soft_bytes=0, hard_bytes=0, rss_fn=lambda: 1e12)
+    assert not mw.armed and mw.check() == NORMAL
+    mw = MemoryWatch(soft_bytes=1, hard_bytes=2, rss_fn=None)
+    if mw._rss_fn is None:  # platform without psutil//proc
+        assert not mw.armed
+
+
+def test_memwatch_sampling_failure_restores_service():
+    """A dying RSS source must not freeze SOFT/HARD (and its shedding)
+    until restart: disarming restores NORMAL and fires restore hooks."""
+    rss = [250e6]
+    fired = []
+    mw = MemoryWatch(
+        soft_bytes=100e6, hard_bytes=200e6, rss_fn=lambda: rss[0]
+    )
+    mw.add_hooks(lambda: fired.append("degrade"), lambda: fired.append("restore"))
+    assert mw.check() == HARD
+
+    def boom():
+        raise OSError("EMFILE")
+
+    mw._rss_fn = boom
+    assert mw.check() == NORMAL
+    assert fired == ["degrade", "restore"]
+    assert not mw.armed
+    assert mw.check() == NORMAL  # stays disarmed, no re-raise
+
+
+def test_resolve_watermarks_semantics():
+    # Absolute MB values win.
+    assert resolve_watermarks(100, 200, limit_fn=lambda: None) == (
+        100e6, 200e6,
+    )
+    # 0 = auto from the container limit.
+    soft, hard = resolve_watermarks(0, 0, limit_fn=lambda: 256e6)
+    assert soft == pytest.approx(192e6) and hard == pytest.approx(230.4e6)
+    # No limit -> disarmed, never DaemonSet-sized defaults in a test
+    # runner or embedder.
+    assert resolve_watermarks(0, 0, limit_fn=lambda: None) == (0.0, 0.0)
+    # Negative disables a stage.
+    assert resolve_watermarks(-1, 500, limit_fn=lambda: 256e6) == (
+        0.0, 500e6,
+    )
+
+
+def test_soft_watermark_shrinks_rings_and_recovers(scrape):
+    """Exporter integration: crossing the soft watermark shrinks the
+    trace/history/anomaly rings and disables slow capture; recovery
+    restores capacity. The hard watermark drops to metrics-only serving
+    — and everything is visible on the page and /debug/vars."""
+    rss = [50e6]
+    cfg = Config(
+        port=0, addr="127.0.0.1", interval=30.0,
+        guard_soft_rss_mb=100, guard_hard_rss_mb=200,
+    )
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v4-8"))
+    exp.memwatch._rss_fn = lambda: rss[0]
+    exp.start()
+    try:
+        full_ring = exp.tracer.counts()["ring_capacity"]
+        full_hist = exp.history.max_samples
+        full_events = exp.anomaly.max_events
+
+        rss[0] = 120e6
+        exp.poller.poll_once()
+        assert exp.memwatch.state == SOFT
+        assert exp.tracer.counts()["ring_capacity"] == max(1, full_ring // 4)
+        assert exp.tracer.counts()["degraded"] is True
+        assert exp.history.max_samples == max(64, full_hist // 4)
+        assert exp.anomaly.max_events == max(8, full_events // 4)
+        _, text = scrape(exp.server.url + "/metrics")
+        assert _counter_value(text, "tpumon_guard_state") == 1.0
+        assert _counter_value(text, "tpumon_guard_rss_bytes") == 120e6
+        # Debug endpoints still served at SOFT.
+        status, _ = scrape(exp.server.url + "/debug/vars")
+        assert status == 200
+
+        rss[0] = 250e6
+        exp.poller.poll_once()
+        assert exp.memwatch.state == HARD
+        status, _ = scrape(exp.server.url + "/debug/vars")
+        assert status == 503  # metrics-only serving
+        status, _ = scrape(exp.server.url + "/history")
+        assert status == 503
+        status, _ = scrape(exp.server.url + "/metrics")
+        assert status == 200  # the one thing that must keep answering
+        status, _ = scrape(exp.server.url + "/healthz")
+        assert status == 200  # liveness never shed
+
+        rss[0] = 40e6
+        exp.poller.poll_once()
+        assert exp.memwatch.state == NORMAL
+        assert exp.tracer.counts()["ring_capacity"] == full_ring
+        assert exp.history.max_samples == full_hist
+        assert exp.anomaly.max_events == full_events
+        status, body = scrape(exp.server.url + "/debug/vars")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["guard"]["memory"]["state"] == "normal"
+        assert doc["guard"]["memory"]["transitions"] == 3
+        sheds = doc["guard"]["ingress"]["shed"]
+        assert sheds.get("debug:memory", 0) >= 1
+        assert sheds.get("history:memory", 0) >= 1
+    finally:
+        exp.close()
+
+
+# -- replay bounds (satellite) --------------------------------------------
+
+
+def test_traces_replay_bounded_with_continuation(scrape):
+    cfg = Config(
+        port=0, addr="127.0.0.1", interval=30.0,
+        guard_replay_max_items=5, trace_ring=64,
+    )
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v4-8"))
+    exp.start()
+    try:
+        for _ in range(17):
+            exp.poller.poll_once()
+        seen = []
+        since = 0.0
+        for _ in range(20):  # a stale since walks the ring in pages
+            _, body = scrape(
+                exp.server.url + f"/debug/traces?since={since}"
+            )
+            doc = json.loads(body)
+            assert len(doc["traces"]) <= 5
+            seen.extend(t["seq"] for t in doc["traces"])
+            if not doc.get("truncated"):
+                break
+            since = doc["next_since"]
+        else:
+            pytest.fail("continuation never terminated")
+        assert len(seen) == 18  # priming poll + 17
+        assert seen == sorted(seen) and len(set(seen)) == 18
+    finally:
+        exp.close()
+
+
+def test_traces_replay_bounded_by_bytes(scrape):
+    cfg = Config(
+        port=0, addr="127.0.0.1", interval=30.0,
+        guard_replay_max_bytes=4096,
+    )
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v4-8"))
+    exp.start()
+    try:
+        for _ in range(10):
+            exp.poller.poll_once()
+        _, body = scrape(exp.server.url + "/debug/traces")
+        doc = json.loads(body)
+        assert doc["truncated"] is True
+        assert len(body) < 64 * 1024  # the whole ring would be far bigger
+    finally:
+        exp.close()
+
+
+def test_anomalies_replay_cursor(scrape):
+    from collections import deque
+
+    from tpumon.anomaly.engine import Event
+
+    cfg = Config(
+        port=0, addr="127.0.0.1", interval=30.0,
+        guard_replay_max_items=3,
+    )
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v4-8"))
+    # Seed deterministic events straight into the engine rings.
+    engine = exp.anomaly
+    for i in range(10):
+        engine._seq += 1
+        ev = Event(
+            id=engine._seq, detector="duty_ewma", severity="warn",
+            device=f"chip{i}", signal=f"chip{i}", message="m", value=1.0,
+            onset_ts=100.0 + i, updated_ts=100.0 + i,
+        )
+        engine._rings.setdefault(
+            f"chip{i}", deque(maxlen=engine.max_events)
+        ).append(ev)
+    exp.start()
+    try:
+        ids = []
+        cursor = 0
+        for _ in range(10):
+            _, body = scrape(
+                exp.server.url + f"/anomalies?cursor={cursor}"
+            )
+            doc = json.loads(body)
+            assert len(doc["events"]) <= 3
+            ids.extend(e["id"] for e in doc["events"])
+            if not doc.get("truncated"):
+                break
+            cursor = doc["next_cursor"]
+        assert ids == sorted(ids) and len(ids) == 10
+        status, body = scrape(exp.server.url + "/anomalies?cursor=-1")
+        assert status == 400
+        status, body = scrape(exp.server.url + "/anomalies?cursor=abc")
+        assert status == 400
+    finally:
+        exp.close()
+
+
+# -- malformed ingress (satellite) ----------------------------------------
+
+
+@pytest.fixture
+def quiet_exporter():
+    cfg = Config(port=0, addr="127.0.0.1", interval=30.0)
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v4-8"))
+    exp.start()
+    yield exp
+    exp.close()
+
+
+def test_oversized_request_line_414(quiet_exporter, caplog):
+    import logging
+
+    with caplog.at_level(logging.ERROR):
+        data = _raw_exchange(
+            quiet_exporter.server.port,
+            b"GET /" + b"a" * 70000 + b" HTTP/1.1\r\n\r\n",
+        )
+    assert b" 414 " in data.split(b"\r\n", 1)[0]
+    assert not [r for r in caplog.records if r.levelno >= logging.ERROR]
+
+
+def test_oversized_headers_431(quiet_exporter, caplog):
+    import logging
+
+    flood = b"".join(b"X-H%d: %s\r\n" % (i, b"v" * 400) for i in range(200))
+    with caplog.at_level(logging.ERROR):
+        data = _raw_exchange(
+            quiet_exporter.server.port,
+            b"GET /metrics HTTP/1.1\r\nHost: x\r\n" + flood + b"\r\n",
+        )
+    assert b" 431 " in data.split(b"\r\n", 1)[0]
+    assert not [r for r in caplog.records if r.levelno >= logging.ERROR]
+
+
+def test_too_many_headers_431(quiet_exporter):
+    """A small head with >100 header FIELDS trips the stdlib count
+    limit (a different bound than the 64KB byte cap): still 431."""
+    data = _raw_exchange(
+        quiet_exporter.server.port,
+        b"GET /metrics HTTP/1.1\r\nHost: x\r\n"
+        + b"".join(b"X-N%d: y\r\n" % i for i in range(150))
+        + b"\r\n",
+    )
+    assert b" 431 " in data.split(b"\r\n", 1)[0]
+
+
+def test_oversized_single_header_line_431(quiet_exporter, caplog):
+    """414 fits only the request line; ONE oversized header line is 431
+    (RFC 6585), matching the flooded-headers path."""
+    import logging
+
+    with caplog.at_level(logging.ERROR):
+        data = _raw_exchange(
+            quiet_exporter.server.port,
+            b"GET /metrics HTTP/1.1\r\nX-Big: " + b"v" * 70000 + b"\r\n\r\n",
+        )
+    assert b" 431 " in data.split(b"\r\n", 1)[0]
+    assert not [r for r in caplog.records if r.levelno >= logging.ERROR]
+
+
+def test_bogus_request_line_400(quiet_exporter):
+    # A non-HTTP request line gets a 400 (body-only for the implied
+    # HTTP/0.9 client — there is no status line to stamp) and a close.
+    data = _raw_exchange(
+        quiet_exporter.server.port, b"utter garbage\r\n\r\n"
+    )
+    assert b"400" in data
+    # A malformed HTTP version on a proper 3-token line: 400 again.
+    data = _raw_exchange(
+        quiet_exporter.server.port, b"GET /metrics BADPROTO\r\n\r\n"
+    )
+    assert b"400" in data
+
+
+def test_unknown_method_serves_app(quiet_exporter):
+    # The WSGI app routes on path, not method: an unknown-but-wellformed
+    # method still parses and gets the path's response.
+    data = _raw_exchange(
+        quiet_exporter.server.port,
+        b"FROB /healthz HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n",
+    )
+    assert b" 200 " in data.split(b"\r\n", 1)[0]
+
+
+def test_truncated_headers_then_disconnect_is_quiet(quiet_exporter, caplog):
+    """A client that sends half a request and vanishes must not leave a
+    traceback at ERROR or wedge the server."""
+    import logging
+
+    with caplog.at_level(logging.DEBUG):
+        sock = socket.create_connection(
+            ("127.0.0.1", quiet_exporter.server.port), timeout=5
+        )
+        sock.sendall(b"GET /metrics HTTP/1.1\r\nHost: tru")
+        sock.close()
+        time.sleep(0.3)
+    assert not [r for r in caplog.records if r.levelno >= logging.ERROR]
+    # Server still serves.
+    data = _raw_exchange(
+        quiet_exporter.server.port,
+        b"GET /healthz HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n",
+    )
+    assert b" 200 " in data.split(b"\r\n", 1)[0]
+
+
+def test_eof_mid_head_is_not_counted_as_slowloris(scrape):
+    """A peer that hangs up mid-head (Ctrl-C'd curl, port scanner) must
+    NOT count as a slowloris shed — that would keep the shedding alert
+    asserted on routine probe traffic."""
+    cfg = Config(
+        port=0, addr="127.0.0.1", interval=30.0,
+        guard_header_timeout_s=5.0,
+    )
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v4-8"))
+    exp.start()
+    try:
+        for _ in range(3):
+            sock = socket.create_connection(
+                ("127.0.0.1", exp.server.port), timeout=5
+            )
+            sock.sendall(b"GET /metrics HTTP/1.1\r\nHost: ha")
+            sock.close()
+        time.sleep(0.3)
+        assert exp.guard.shed_counts.get(
+            ("connection", "slowloris"), 0
+        ) == 0, exp.guard.shed_counts
+    finally:
+        exp.close()
+
+
+def test_early_disconnect_mid_response_is_quiet(quiet_exporter, caplog):
+    import logging
+
+    with caplog.at_level(logging.DEBUG):
+        sock = socket.create_connection(
+            ("127.0.0.1", quiet_exporter.server.port), timeout=5
+        )
+        sock.sendall(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+        sock.recv(64)  # read a token amount, then slam the door
+        sock.setsockopt(
+            socket.SOL_SOCKET, socket.SO_LINGER,
+            __import__("struct").pack("ii", 1, 0),  # RST on close
+        )
+        sock.close()
+        time.sleep(0.3)
+    assert not [r for r in caplog.records if r.levelno >= logging.ERROR]
+
+
+def test_listener_socket_hygiene(quiet_exporter):
+    """SO_REUSEADDR set; listener not inherited across exec."""
+    httpd = quiet_exporter.server._httpd
+    assert httpd.socket.getsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR)
+    assert httpd.socket.get_inheritable() is False
+
+
+def test_request_with_body_closes_connection(quiet_exporter):
+    """No endpoint reads bodies; a request that carries one must not
+    poison the keep-alive stream with its body bytes."""
+    data = _raw_exchange(
+        quiet_exporter.server.port,
+        b"GET /healthz HTTP/1.1\r\nHost: x\r\nContent-Length: 5\r\n\r\n"
+        b"xxxxxGET /healthz HTTP/1.1\r\n\r\n",
+    )
+    head = data.split(b"\r\n\r\n", 1)[0]
+    assert b" 200 " in head.split(b"\r\n", 1)[0]
+    assert b"Connection: close" in head or data.count(b"HTTP/1.1") == 1
+
+
+# -- slowloris / deadlines -------------------------------------------------
+
+
+def test_slowloris_evicted_within_header_deadline(scrape):
+    cfg = Config(
+        port=0, addr="127.0.0.1", interval=30.0,
+        guard_header_timeout_s=0.5,
+    )
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v4-8"))
+    exp.start()
+    try:
+        from tpumon.guard.stormer import slowloris
+
+        t0 = time.monotonic()
+        report = slowloris(
+            "127.0.0.1", exp.server.port, duration_s=4.0, conns=2,
+            drip_every_s=0.2,
+        )
+        assert report["evicted"] == 2
+        assert report["held_open"] == 0
+        assert time.monotonic() - t0 < 4.5
+        # Normal service unaffected, and the kill was counted.
+        status, _ = scrape(exp.server.url + "/metrics")
+        assert status == 200
+        exp.poller.poll_once()  # refresh the self-telemetry render
+        _, text = scrape(exp.server.url + "/metrics")
+        sheds = _labeled_series(text, "tpumon_shed_requests_total")
+        assert sheds.get(
+            'endpoint="connection",reason="slowloris"', 0
+        ) >= 2, sheds
+    finally:
+        exp.close()
+
+
+def test_guard_disabled_restores_unguarded_serving(scrape):
+    cfg = Config(port=0, addr="127.0.0.1", interval=30.0, guard=False)
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v4-8"))
+    exp.start()
+    try:
+        assert exp.guard is None and exp.memwatch is None
+        assert exp.governor is None
+        status, body = scrape(exp.server.url + "/debug/vars")
+        assert status == 200
+        assert "guard" not in json.loads(body)
+        status, _ = scrape(exp.server.url + "/metrics")
+        assert status == 200
+    finally:
+        exp.close()
+
+
+# -- operator surfaces -----------------------------------------------------
+
+
+def test_smi_guard_line_and_doctor_policy(scrape):
+    """Guard interventions must be readable where operators look: the
+    smi snapshot/render grow a GUARD line, doctor prints the resolved
+    policy."""
+    import io as _io
+
+    from tpumon import doctor, smi
+
+    rss = [50e6]
+    cfg = Config(
+        port=0, addr="127.0.0.1", interval=30.0,
+        guard_soft_rss_mb=100, guard_hard_rss_mb=200,
+        guard_max_series_per_family=8,
+    )
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v5p-64"))
+    exp.memwatch._rss_fn = lambda: rss[0]
+    exp.start()
+    try:
+        rss[0] = 250e6  # hard watermark
+        exp.poller.poll_once()
+        scrape(exp.server.url + "/history")  # shed: memory
+        exp.poller.poll_once()
+        _, text = scrape(exp.server.url + "/metrics")
+        snap = smi.snapshot_from_text(text)
+        assert snap["guard"]["state"] == 2
+        assert snap["guard"]["shed_total"] >= 1
+        assert snap["guard"]["cardinality_dropped"]
+        out = _io.StringIO()
+        smi.render(snap, out=out)
+        rendered = out.getvalue()
+        assert "GUARD:" in rendered
+        assert "HARD memory watermark" in rendered
+    finally:
+        exp.close()
+
+    out = _io.StringIO()
+    doctor.run(cfg, out=out, backend=FakeTpuBackend.preset("v4-8"))
+    text = out.getvalue()
+    assert "self-protection: enabled" in text
+    assert "memory watermarks soft 100 MB / hard 200 MB" in text
+
+    out = _io.StringIO()
+    doctor.run(
+        Config(guard=False), out=out, backend=FakeTpuBackend.preset("v4-8")
+    )
+    assert "self-protection: disabled" in out.getvalue()
+
+
+# -- gRPC per-client stream cap -------------------------------------------
+
+
+def test_watch_per_client_stream_cap(scrape):
+    pytest.importorskip("grpc")
+    cfg = Config(
+        port=0, addr="127.0.0.1", interval=30.0, grpc_serve_port=0,
+        guard_watch_per_client=1,
+    )
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v4-8"))
+    exp.start()
+    try:
+        assert exp.grpc_server is not None
+        from tpumon.guard.stormer import watch_hammer
+
+        report = watch_hammer(
+            f"127.0.0.1:{exp.grpc_server.port}", streams=3, duration_s=1.0
+        )
+        assert report["admitted"] == 1
+        assert report["refused"] == 2
+        exp.poller.poll_once()
+        _, text = scrape(exp.server.url + "/metrics")
+        sheds = _labeled_series(text, "tpumon_shed_requests_total")
+        assert sheds.get(
+            'endpoint="grpc_watch",reason="client_cap"', 0
+        ) >= 2, sheds
+    finally:
+        exp.close()
+
+
+# -- storm acceptance ------------------------------------------------------
+
+
+def _well_behaved_scrapes(url: str, duration_s: float, every_s: float):
+    """Sequential 1-connection scrape loop (the 'good citizen'); returns
+    (answered_200_with_identity, total, latencies_ms)."""
+    import http.client
+    from urllib.parse import urlparse
+
+    parsed = urlparse(url)
+    conn = http.client.HTTPConnection(
+        parsed.hostname, parsed.port, timeout=10
+    )
+    good = total = 0
+    lat = []
+    deadline = time.monotonic() + duration_s
+    try:
+        while time.monotonic() < deadline:
+            t0 = time.perf_counter()
+            try:
+                conn.request("GET", "/metrics")
+                resp = conn.getresponse()
+                body = resp.read()
+            except (OSError, Exception):
+                conn.close()
+                conn = http.client.HTTPConnection(
+                    parsed.hostname, parsed.port, timeout=10
+                )
+                total += 1
+                continue
+            lat.append((time.perf_counter() - t0) * 1e3)
+            total += 1
+            if resp.status == 200 and b"accelerator_device_count" in body:
+                good += 1
+            time.sleep(every_s)
+    finally:
+        conn.close()
+    return good, total, lat
+
+
+def test_storm_acceptance_fast(scrape):
+    """Compressed ISSUE acceptance (tier-1, ~6 s): 8x scrape concurrency
+    + slowloris + a debug-replay storm against a live 4 Hz poller.
+    Every well-behaved scrape answers 200 with identity, sheds get
+    503+Retry-After, the poll cadence holds, and the poll thread lives.
+    The daemon's scrape-tail GIL tuning applies (exporter/main.py sets
+    it in production; without it the storm threads can starve the
+    poller for the default 5 ms switch interval at a time)."""
+    import sys as _sys
+
+    from tpumon.guard.stormer import Stormer
+
+    cfg = Config(
+        port=0, addr="127.0.0.1", interval=0.25,
+        guard_debug_rps=10.0, guard_header_timeout_s=0.5,
+    )
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v4-8"))
+    prev_switch = _sys.getswitchinterval()
+    _sys.setswitchinterval(min(prev_switch, 0.001))
+    exp.start()
+    try:
+        polls_before = exp.telemetry.polls._value.get()
+        t0 = time.monotonic()
+        stormer = Stormer("127.0.0.1", exp.server.port)
+        result_holder = {}
+        storm_thread = threading.Thread(
+            target=lambda: result_holder.update(stormer.run(4.0)),
+            daemon=True,
+        )
+        storm_thread.start()
+        good, total, lat = _well_behaved_scrapes(
+            exp.server.url, duration_s=4.0, every_s=0.1
+        )
+        storm_thread.join(15.0)
+        elapsed = time.monotonic() - t0
+
+        # Enough samples to mean something, derived from wall time: the
+        # loop paces at 0.1 s + per-request latency, and under storm on
+        # a 2-core CI box a request can take ~1 s — so require one
+        # sample per 1.1 s of elapsed, not a fixed count.
+        assert total >= elapsed / 1.1, (total, elapsed)
+        assert good == total, f"{total - good} well-behaved scrapes failed"
+        # Storm evidence: sheds answered 503 with Retry-After on every one.
+        debug = result_holder["debug_storm"]
+        assert debug["statuses"].get("503", 0) > 0
+        assert debug["missing_retry_after"] == 0
+        assert result_holder["slowloris"]["evicted"] == 2
+        assert result_holder["oversized"]["long_request_line"] == "414"
+        assert result_holder["oversized"]["huge_headers"] == "431"
+        # Poll cadence holds the ISSUE bar (>=0.9 Hz) with plenty of
+        # margin — the 4 Hz poller runs well above it even while the
+        # storm threads fight it for the GIL. (The @slow full run
+        # asserts the criterion at its native 1 Hz.)
+        polls = exp.telemetry.polls._value.get() - polls_before
+        assert polls >= 1.5 * elapsed, (polls, elapsed)
+        assert exp.poller._thread.is_alive()
+        # ...and the evidence is on the page.
+        exp.poller.poll_once()
+        _, text = scrape(exp.server.url + "/metrics")
+        sheds = _labeled_series(text, "tpumon_shed_requests_total")
+        assert sum(sheds.values()) > 0, sheds
+    finally:
+        exp.close()
+        _sys.setswitchinterval(prev_switch)
+
+
+@pytest.mark.slow
+def test_storm_acceptance_full(scrape):
+    """The ISSUE criterion at full length: >=8x normal scrape
+    concurrency + 2 slowloris + a Watch-stream hammer for 20 s over a
+    1 Hz poller. Every well-behaved scrape is answered within budget,
+    shed requests get 503+Retry-After, poll cadence stays >=0.9 Hz, and
+    RSS stays under the (armed) hard watermark."""
+    import sys as _sys
+
+    from tpumon.guard.stormer import Stormer
+
+    cfg = Config(
+        port=0, addr="127.0.0.1", interval=1.0, grpc_serve_port=0,
+        guard_debug_rps=10.0, guard_header_timeout_s=1.0,
+        guard_soft_rss_mb=1536, guard_hard_rss_mb=2048,  # armed, sane
+    )
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v5e-16"))
+    prev_switch = _sys.getswitchinterval()
+    _sys.setswitchinterval(min(prev_switch, 0.001))  # the daemon's tuning
+    exp.start()
+    try:
+        polls_before = exp.telemetry.polls._value.get()
+        t0 = time.monotonic()
+        grpc_addr = (
+            f"127.0.0.1:{exp.grpc_server.port}" if exp.grpc_server else None
+        )
+        stormer = Stormer("127.0.0.1", exp.server.port, grpc_addr=grpc_addr)
+        result_holder = {}
+        storm_thread = threading.Thread(
+            target=lambda: result_holder.update(
+                stormer.run(20.0, scrape_threads=8, slowloris_conns=2)
+            ),
+            daemon=True,
+        )
+        storm_thread.start()
+        good, total, lat = _well_behaved_scrapes(
+            exp.server.url, duration_s=20.0, every_s=1.0
+        )
+        storm_thread.join(30.0)
+        elapsed = time.monotonic() - t0
+
+        # Every well-behaved scrape answered, within budget. Sample
+        # count floor derives from wall time (1 s pace + up to ~1 s of
+        # under-storm latency per request on a starved CI box).
+        assert total >= elapsed / 2.0, (total, elapsed)
+        assert good == total, f"{total - good} well-behaved scrapes failed"
+        lat.sort()
+        assert lat[len(lat) // 2] < 1000.0  # median well under a second
+
+        # Sheds: 503 + Retry-After, counted on the page.
+        debug = result_holder["debug_storm"]
+        assert debug["statuses"].get("503", 0) > 0
+        assert debug["missing_retry_after"] == 0
+        assert result_holder["slowloris"]["evicted"] == 2
+        if grpc_addr:
+            wh = result_holder["watch_hammer"]
+            if not wh.get("skipped"):
+                assert wh["refused"] > 0  # per-client cap held
+
+        # Poll cadence >= 0.9 Hz throughout the storm.
+        polls = exp.telemetry.polls._value.get() - polls_before
+        assert polls >= 0.9 * elapsed, (polls, elapsed)
+        assert exp.poller._thread.is_alive()
+
+        # RSS stayed under the armed hard watermark (no memory shed).
+        assert exp.memwatch.armed
+        assert exp.memwatch.max_rss < exp.memwatch.hard_bytes
+        exp.poller.poll_once()
+        _, text = scrape(exp.server.url + "/metrics")
+        sheds = _labeled_series(text, "tpumon_shed_requests_total")
+        assert sum(sheds.values()) > 0
+        assert _counter_value(text, "tpumon_guard_state") == 0.0
+    finally:
+        exp.close()
+        _sys.setswitchinterval(prev_switch)
+
+
+@pytest.mark.slow
+def test_soak_storm_smoke():
+    """tools/soak.py --storm end to end: clean well-behaved scrapes, the
+    ISSUE's >=0.9 Hz poll cadence at the native 1 Hz interval, and a
+    coherent storm evidence record."""
+    from tpumon.tools.soak import soak
+
+    rec = soak(
+        duration_s=10.0, scrape_every_s=0.5, topology="v4-8",
+        interval=1.0, storm=True,
+    )
+    assert rec["bad_pages"] == 0
+    assert rec["failed_scrapes"] == 0
+    storm = rec["storm"]
+    assert storm["report"]["oversized"]["long_request_line"] == "414"
+    assert storm["report"]["slowloris"]["evicted"] >= 1
+    assert sum(storm["shed"].values()) > 0
+    assert storm["poll_hz"] >= 0.9

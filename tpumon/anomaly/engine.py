@@ -134,6 +134,24 @@ class AnomalyEngine:
     def detector_names(self) -> tuple[str, ...]:
         return tuple(d.name for d in self._detectors)
 
+    @property
+    def max_events(self) -> int:
+        return self._max_events
+
+    def set_max_events(self, n: int) -> None:
+        """Re-cap the per-device event rings in place — the
+        memory-watermark response (tpumon/guard/memwatch). Newest events
+        are retained; reversible (re-capping up keeps the survivors)."""
+        n = max(1, int(n))
+        with self._lock:
+            if n == self._max_events:
+                return
+            self._max_events = n
+            self._rings = {
+                dev: deque(ring, maxlen=n)
+                for dev, ring in self._rings.items()
+            }
+
     def _series_window(self, ts: float, family: str, label_match, t) -> tuple[str, list]:
         if self._history is None:
             return "", []

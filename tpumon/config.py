@@ -145,6 +145,49 @@ class Config:
     #: Fault-injection spec (TPUMON_FAULTS, tpumon/resilience/faults.py)
     #: wrapping the selected backend — chaos testing only; empty = off.
     faults: str = ""
+    #: Self-protection plane (tpumon/guard): scrape admission control,
+    #: request deadlines, cardinality governor, and memory watermarks.
+    #: Off restores the unguarded serving paths (replay-response bounds
+    #: stay — they are API semantics, not load policy).
+    guard: bool = True
+    #: Concurrent in-flight cap for /metrics requests (0 = uncapped).
+    guard_metrics_inflight: int = 16
+    #: Concurrent in-flight cap shared by the debug-class endpoints
+    #: (/debug/*, /history, /anomalies, /health/devices).
+    guard_debug_inflight: int = 4
+    #: Token-bucket rate limits, requests/s with 2x burst (0 = unlimited).
+    #: /metrics is uncapped by default — the scrape path serves cached
+    #: bytes and must absorb Prometheus HA fan-in; the JSON endpoints
+    #: allocate per request and get a real budget.
+    guard_metrics_rps: float = 0.0
+    guard_debug_rps: float = 20.0
+    #: Header-read deadline seconds: once a request's first byte arrives,
+    #: the full request line + headers must complete within this budget
+    #: (the slowloris kill). 0 disables.
+    guard_header_timeout_s: float = 5.0
+    #: Idle keep-alive eviction seconds: a persistent connection with no
+    #: next request within this window is closed. 0 disables.
+    guard_idle_timeout_s: float = 65.0
+    #: Response write deadline seconds (half-dead peers can't park a
+    #: serving thread forever). 0 disables.
+    guard_write_timeout_s: float = 10.0
+    #: Replay-response bounds for /debug/traces and /anomalies ?since=
+    #: reads: max items and max payload bytes per response; past either,
+    #: the response is truncated with a continuation token.
+    guard_replay_max_items: int = 256
+    guard_replay_max_bytes: int = 1048576
+    #: Per-family series budget (tpumon/guard/cardinality.py): overflow
+    #: series collapse into a sentinel `other` label value. 0 disables.
+    guard_max_series_per_family: int = 1000
+    #: RSS watermarks in MB (tpumon/guard/memwatch.py): soft shrinks the
+    #: trace/history/anomaly rings and disables slow-cycle capture; hard
+    #: drops to metrics-only serving. 0 = auto (75% / 90% of the cgroup
+    #: container memory limit; disarmed when the process has none — test
+    #: runners and embedders); >0 absolute MB; <0 disables that stage.
+    guard_soft_rss_mb: float = 0.0
+    guard_hard_rss_mb: float = 0.0
+    #: Concurrent gRPC Watch streams admitted per client address.
+    guard_watch_per_client: int = 4
     #: Internal trace plane (tpumon/trace): per-stage spans around every
     #: poll-pipeline stage, served at /debug/traces (+/slow) and as the
     #: tpumon_trace_stage_duration_seconds self-metric.
@@ -210,6 +253,47 @@ class Config:
                 "WATCHDOG_HANG_S", base.watchdog_hang_s
             ),
             faults=_env("FAULTS", base.faults) or base.faults,
+            guard=_env_bool("GUARD", base.guard),
+            guard_metrics_inflight=_env_int(
+                "GUARD_METRICS_INFLIGHT", base.guard_metrics_inflight
+            ),
+            guard_debug_inflight=_env_int(
+                "GUARD_DEBUG_INFLIGHT", base.guard_debug_inflight
+            ),
+            guard_metrics_rps=_env_float(
+                "GUARD_METRICS_RPS", base.guard_metrics_rps
+            ),
+            guard_debug_rps=_env_float(
+                "GUARD_DEBUG_RPS", base.guard_debug_rps
+            ),
+            guard_header_timeout_s=_env_float(
+                "GUARD_HEADER_TIMEOUT_S", base.guard_header_timeout_s
+            ),
+            guard_idle_timeout_s=_env_float(
+                "GUARD_IDLE_TIMEOUT_S", base.guard_idle_timeout_s
+            ),
+            guard_write_timeout_s=_env_float(
+                "GUARD_WRITE_TIMEOUT_S", base.guard_write_timeout_s
+            ),
+            guard_replay_max_items=_env_int(
+                "GUARD_REPLAY_MAX_ITEMS", base.guard_replay_max_items
+            ),
+            guard_replay_max_bytes=_env_int(
+                "GUARD_REPLAY_MAX_BYTES", base.guard_replay_max_bytes
+            ),
+            guard_max_series_per_family=_env_int(
+                "GUARD_MAX_SERIES_PER_FAMILY",
+                base.guard_max_series_per_family,
+            ),
+            guard_soft_rss_mb=_env_float(
+                "GUARD_SOFT_RSS_MB", base.guard_soft_rss_mb
+            ),
+            guard_hard_rss_mb=_env_float(
+                "GUARD_HARD_RSS_MB", base.guard_hard_rss_mb
+            ),
+            guard_watch_per_client=_env_int(
+                "GUARD_WATCH_PER_CLIENT", base.guard_watch_per_client
+            ),
             trace=_env_bool("TRACE", base.trace),
             trace_slow_cycle_ms=_env_float(
                 "TRACE_SLOW_CYCLE_MS", base.trace_slow_cycle_ms
@@ -284,6 +368,30 @@ class Config:
             "--faults",
             help="fault-injection spec (chaos testing), e.g. "
             "error_rate=0.3,hang_every=20,hang_s=10",
+        )
+        g.add_argument(
+            "--guard-soft-rss-mb",
+            type=float,
+            help="soft memory watermark MB: shrink trace/history/anomaly "
+            "rings and stop slow-cycle capture (0 disables)",
+        )
+        g.add_argument(
+            "--guard-hard-rss-mb",
+            type=float,
+            help="hard memory watermark MB: drop to metrics-only serving "
+            "(0 disables)",
+        )
+        g.add_argument(
+            "--guard-debug-rps",
+            type=float,
+            help="token-bucket rate limit for the debug-class endpoints "
+            "(/debug/*, /history, /anomalies), requests/s (0 = unlimited)",
+        )
+        g.add_argument(
+            "--guard-header-timeout-s",
+            type=float,
+            help="request header-read deadline seconds (slowloris kill; "
+            "0 disables)",
         )
         g.add_argument(
             "--trace-slow-cycle-ms",

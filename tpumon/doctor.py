@@ -209,6 +209,37 @@ def run(cfg: Config, out=sys.stdout, backend=None) -> int:
             )
         else:
             p("\nresilience: disabled (TPUMON_RESILIENCE=0)")
+
+        # Self-protection plane (tpumon/guard): the admission-control /
+        # watermark policy a live exporter would run with this config.
+        # Live shed counts come from the running exporter (GET
+        # /debug/vars "guard", or the smi GUARD line).
+        if cfg.guard:
+            from tpumon.guard.memwatch import resolve_watermarks
+
+            soft_b, hard_b = resolve_watermarks(
+                cfg.guard_soft_rss_mb, cfg.guard_hard_rss_mb
+            )
+            if soft_b or hard_b:
+                watermarks = (
+                    f"memory watermarks soft {soft_b / 1e6:.0f} MB / "
+                    f"hard {hard_b / 1e6:.0f} MB"
+                )
+            else:
+                watermarks = (
+                    "memory watermarks disarmed (no container memory "
+                    "limit detected)"
+                )
+            p(
+                "self-protection: enabled — debug endpoints "
+                f"{cfg.guard_debug_rps:g} rps / {cfg.guard_debug_inflight} "
+                f"in flight, /metrics {cfg.guard_metrics_inflight} in "
+                f"flight, header deadline {cfg.guard_header_timeout_s:g}s, "
+                f"series budget {cfg.guard_max_series_per_family}/family, "
+                + watermarks
+            )
+        else:
+            p("self-protection: disabled (TPUMON_GUARD=0)")
         fault_spec = getattr(backend, "spec", None)
         if cfg.faults or fault_spec is not None:
             desc = (

@@ -477,6 +477,7 @@ class Poller:
         tracer=None,
         resilience=None,
         watchdog=None,
+        governor=None,
     ) -> None:
         self._backend = backend
         self._cfg = cfg
@@ -489,6 +490,7 @@ class Poller:
         self._tracer = tracer
         self._resilience = resilience
         self._watchdog = watchdog
+        self._governor = governor
         #: Staleness-gauge label reconciliation (tpumon/resilience).
         self._stale_labeled: set[str] = set()
         #: Last-seen backend retry counters (delta-fed into telemetry).
@@ -538,6 +540,12 @@ class Poller:
                 self._histograms, resilience=self._resilience,
                 watchdog=self._watchdog,
             )
+        if self._governor is not None:
+            # Per-family cardinality budget (tpumon/guard/cardinality):
+            # runs BEFORE history/anomaly/publish so an exploding family
+            # is bounded everywhere downstream, not just on the page.
+            with trace_span("guard"):
+                self._governor.govern(families, stats.base_keys)
         now = time.time()
         if self._history is not None:
             # Flight recorder (DCGM field-cache analogue): keep the 1 Hz

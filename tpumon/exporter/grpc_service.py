@@ -48,6 +48,24 @@ _WATCH_IDLE_TIMEOUT = 5.0
 _MAX_WATCHERS = 12
 _POOL_WORKERS = 16
 
+#: Default per-client Watch-stream cap (tpumon/guard): one misbehaving
+#: consumer reconnect-looping Watch must not consume the global watcher
+#: budget. Overridden by the guard's watch_per_client when wired.
+_DEFAULT_WATCH_PER_CLIENT = 4
+
+#: Transport-level self-protection (tpumon/guard): requests on this
+#: service are EMPTY messages, so anything big is abuse — bound it at
+#: the transport; plus server-side keepalive and idle-connection
+#: eviction so half-dead clients can't hold HTTP/2 connections forever.
+_SERVER_OPTIONS = (
+    ("grpc.so_reuseport", 0),
+    ("grpc.max_receive_message_length", 1 << 16),
+    ("grpc.keepalive_time_ms", 30000),
+    ("grpc.keepalive_timeout_ms", 10000),
+    ("grpc.http2.max_pings_without_data", 2),
+    ("grpc.max_connection_idle_ms", 300000),
+)
+
 
 def encode_page_response(page: bytes, version: int) -> bytes:
     """PageResponse{bytes page=1; uint64 version=2}."""
@@ -76,7 +94,8 @@ class MetricsGrpcServer:
     """
 
     def __init__(
-        self, render_with_version, cache, addr: str, port: int, tracer=None
+        self, render_with_version, cache, addr: str, port: int, tracer=None,
+        guard=None,
     ) -> None:
         import threading
 
@@ -87,6 +106,20 @@ class MetricsGrpcServer:
         self._render_with_version = render_with_version
         self._cache = cache
         watcher_slots = threading.BoundedSemaphore(_MAX_WATCHERS)
+        # Per-client stream accounting (tpumon/guard): `guard` supplies
+        # the cap and the tpumon_shed_requests_total funnel; without it
+        # the default cap still applies (sheds just aren't counted).
+        per_client_cap = (
+            guard.watch_per_client
+            if guard is not None
+            else _DEFAULT_WATCH_PER_CLIENT
+        )
+        client_streams: dict[str, int] = {}
+        clients_lock = threading.Lock()
+
+        def count_shed(reason: str) -> None:
+            if guard is not None:
+                guard.count_shed("grpc_watch", reason)
 
         def serve_span(name: str):
             # tpumon.trace serving spans: these run on gRPC worker
@@ -102,22 +135,47 @@ class MetricsGrpcServer:
             return encode_page_response(page, version)
 
         def watch(request: bytes, context):
-            if not watcher_slots.acquire(blocking=False):
-                context.abort(
-                    grpc.StatusCode.RESOURCE_EXHAUSTED,
-                    f"watcher limit ({_MAX_WATCHERS}) reached",
-                )
+            # Client address without the ephemeral port: the per-client
+            # cap must see "the same consumer reconnecting", not a new
+            # identity per TCP connection.
+            peer = context.peer()
+            client = peer.rsplit(":", 1)[0] if ":" in peer else peer
+            with clients_lock:
+                if (
+                    per_client_cap > 0
+                    and client_streams.get(client, 0) >= per_client_cap
+                ):
+                    count_shed("client_cap")
+                    context.abort(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED,
+                        f"per-client watch limit ({per_client_cap}) reached",
+                    )
+                client_streams[client] = client_streams.get(client, 0) + 1
             try:
-                version = 0
-                while context.is_active():
-                    newer = cache.wait_newer(version, _WATCH_IDLE_TIMEOUT)
-                    if newer == version:
-                        continue  # idle timeout: re-check liveness
-                    with serve_span("grpc_watch_push"):
-                        page, version = self._render_with_version()
-                    yield encode_page_response(page, version)
+                if not watcher_slots.acquire(blocking=False):
+                    count_shed("stream_cap")
+                    context.abort(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED,
+                        f"watcher limit ({_MAX_WATCHERS}) reached",
+                    )
+                try:
+                    version = 0
+                    while context.is_active():
+                        newer = cache.wait_newer(version, _WATCH_IDLE_TIMEOUT)
+                        if newer == version:
+                            continue  # idle timeout: re-check liveness
+                        with serve_span("grpc_watch_push"):
+                            page, version = self._render_with_version()
+                        yield encode_page_response(page, version)
+                finally:
+                    watcher_slots.release()
             finally:
-                watcher_slots.release()
+                with clients_lock:
+                    n = client_streams.get(client, 1) - 1
+                    if n <= 0:
+                        client_streams.pop(client, None)
+                    else:
+                        client_streams[client] = n
 
         def reflect(request_iterator, context):
             # list_services is the only query we answer; everything else
@@ -172,10 +230,12 @@ class MetricsGrpcServer:
         # free workers. so_reuseport=0: without it a second server binds
         # the SAME port successfully on Linux and the kernel round-robins
         # clients between processes — the bind-conflict check below would
-        # never fire.
+        # never fire. The rest of _SERVER_OPTIONS is transport
+        # self-protection: bounded request messages, keepalive, and
+        # idle-connection eviction (tpumon/guard).
         self._server = grpc.server(
             ThreadPoolExecutor(max_workers=_POOL_WORKERS),
-            options=(("grpc.so_reuseport", 0),),
+            options=_SERVER_OPTIONS,
         )
         self._server.add_generic_rpc_handlers(
             (metrics_handler, reflection_handler)

@@ -9,8 +9,10 @@ timing is measured by middleware around the exposition app and feeds the
 from __future__ import annotations
 
 import gzip
+import io
 import logging
 import socket
+import sys
 import threading
 import time
 from socketserver import ThreadingMixIn
@@ -35,6 +37,115 @@ log = logging.getLogger(__name__)
 HEALTH_STALE_INTERVALS = 5.0
 
 
+#: Hard caps on the request head, independent of any guard config: one
+#: line (request line or header) and the whole head (line + headers).
+#: Past either, the server answers 414/431 and closes — it never buffers
+#: proportionally to what the client sends.
+_MAX_HEAD_LINE = 65536
+_MAX_HEAD_BYTES = 65536
+
+
+class _HeadAborted(Exception):
+    """Request-head read did not complete. ``kind``:
+
+    - "idle" — no first byte within the keep-alive idle window (routine
+      eviction, not counted);
+    - "deadline" — bytes arrived but the head missed its overall
+      deadline: the slowloris shape (counted, answered 408);
+    - "eof" — the peer hung up mid-head (a Ctrl-C'd curl, a port
+      scanner): quiet close, NOT a slowloris — misclassifying it would
+      keep the shedding alert asserted on routine probe traffic."""
+
+    def __init__(self, kind: str) -> None:
+        self.kind = kind
+
+
+class _HeadTooLong(Exception):
+    """``kind`` is "line" (→414 for the request line) or "total" (→431)."""
+
+    def __init__(self, kind: str) -> None:
+        self.kind = kind
+
+
+class _DeadlineReader:
+    """Buffered head reader over the raw connection that enforces an
+    OVERALL deadline across ``recv()`` calls.
+
+    A per-recv socket timeout alone cannot kill a slowloris: a client
+    dripping one byte per ``timeout - ε`` keeps every individual recv
+    legal forever. This reader re-arms the socket timeout with the
+    *remaining* deadline before each recv, so the head as a whole is
+    bounded no matter how the bytes arrive. Leftover bytes (pipelined
+    requests) stay buffered across calls.
+    """
+
+    def __init__(self, sock) -> None:
+        self._sock = sock
+        self._buf = bytearray()
+
+    def read_head(
+        self, idle_timeout: float | None, header_timeout: float | None
+    ) -> bytes:
+        """Request line + headers + blank line, raw. Waits up to
+        ``idle_timeout`` for the first byte (keep-alive eviction); once
+        any byte exists the whole head must land within
+        ``header_timeout``. Raises _HeadAborted / _HeadTooLong /
+        ConnectionError; returns b"" on a clean EOF before any byte."""
+        head = bytearray()
+        scan_from = 0
+        deadline = (
+            time.monotonic() + header_timeout
+            if header_timeout and self._buf
+            else None
+        )
+        while True:
+            nl = self._buf.find(b"\n", scan_from)
+            if nl >= 0:
+                line = self._buf[: nl + 1]
+                del self._buf[: nl + 1]
+                scan_from = 0
+                if len(line) > _MAX_HEAD_LINE:
+                    # 414 only fits the request line; an oversized
+                    # HEADER line is 431 territory (RFC 6585).
+                    raise _HeadTooLong("line" if not head else "total")
+                head += line
+                if len(head) > _MAX_HEAD_BYTES:
+                    raise _HeadTooLong("total")
+                if line in (b"\r\n", b"\n") and head != line:
+                    return bytes(head)
+                if line in (b"\r\n", b"\n"):
+                    head.clear()  # ignore leading blank lines (RFC 9112)
+                continue
+            if len(self._buf) > _MAX_HEAD_LINE:
+                raise _HeadTooLong(
+                    "line" if not head else "total"
+                )
+            scan_from = len(self._buf)
+            first_byte_seen = bool(head) or bool(self._buf)
+            if deadline is None:
+                timeout = idle_timeout if not first_byte_seen else None
+            else:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    raise _HeadAborted(
+                        "deadline" if first_byte_seen else "idle"
+                    )
+            self._sock.settimeout(timeout)
+            try:
+                chunk = self._sock.recv(8192)
+            except (TimeoutError, socket.timeout):
+                raise _HeadAborted(
+                    "deadline" if first_byte_seen else "idle"
+                ) from None
+            if not chunk:
+                if first_byte_seen:
+                    raise _HeadAborted("eof")  # half a request, then FIN
+                return b""
+            if deadline is None and header_timeout:
+                deadline = time.monotonic() + header_timeout
+            self._buf += chunk
+
+
 class _Handler(WSGIRequestHandler):
     """HTTP/1.1 keep-alive so Prometheus reuses its scrape connection.
 
@@ -44,6 +155,13 @@ class _Handler(WSGIRequestHandler):
     standard BaseHTTPRequestHandler loop and forces the handler's HTTP
     version. Every response carries an exact Content-Length (see
     ``_make_app``), which persistent connections require.
+
+    The request head is read through :class:`_DeadlineReader` (overall
+    header deadline = the slowloris kill; idle timeout = keep-alive
+    eviction; hard line/head byte caps → 414/431), with the budgets
+    coming from ``server.ingress_guard`` when the exporter runs guarded
+    — the sidecar's unguarded server keeps None timeouts and only the
+    byte caps.
     """
 
     protocol_version = "HTTP/1.1"
@@ -53,27 +171,82 @@ class _Handler(WSGIRequestHandler):
     # production path.
     disable_nagle_algorithm = True
 
+    #: Niceness for guarded serving threads: under CPU starvation (the
+    #: DaemonSet runs at a 250m limit) the kernel must prefer the 1 Hz
+    #: poll thread over scrape serving, or a scrape storm converts into
+    #: missed poll beats. Raising nice needs no privileges; one syscall
+    #: per connection thread.
+    SERVE_NICENESS = 10
+
+    def setup(self) -> None:
+        super().setup()
+        self._reader = _DeadlineReader(self.connection)
+        if getattr(self.server, "ingress_guard", None) is not None:
+            try:
+                import os
+
+                os.setpriority(
+                    os.PRIO_PROCESS, threading.get_native_id(),
+                    self.SERVE_NICENESS,
+                )
+            except (AttributeError, OSError):
+                pass  # non-Linux or denied: serving just stays at nice 0
+
     def handle(self) -> None:
         self.close_connection = True
-        self.handle_one_request()
-        while not self.close_connection:
+        try:
             self.handle_one_request()
+            while not self.close_connection:
+                self.handle_one_request()
+        except OSError as exc:
+            # Half-closed peers, write deadlines, and close races are
+            # routine client behavior, not exporter errors: close
+            # quietly, never leak a traceback (or the serving thread —
+            # it exits right here).
+            log.debug("connection error from %s: %s", self.client_address, exc)
 
     def handle_one_request(self) -> None:
-        self.raw_requestline = self.rfile.readline(65537)
-        if len(self.raw_requestline) > 65536:
-            self.requestline = ""
-            self.request_version = ""
-            self.command = ""
-            self.send_error(414)
+        guard = getattr(self.server, "ingress_guard", None)
+        idle_t = guard.idle_timeout_s if guard is not None else 0.0
+        header_t = guard.header_timeout_s if guard is not None else 0.0
+        try:
+            head = self._reader.read_head(idle_t or None, header_t or None)
+        except _HeadAborted as err:
+            if err.kind == "deadline" and guard is not None:
+                # Mid-head stall past the deadline: the slowloris shape.
+                # ("eof" — peer hung up mid-head — closes quietly; "idle"
+                # is routine keep-alive eviction.)
+                guard.count_shed("connection", "slowloris")
+                self._best_effort_error(408)
             self.close_connection = True
             return
-        if not self.raw_requestline:
+        except _HeadTooLong as err:
+            self._best_effort_error(414 if err.kind == "line" else 431)
             self.close_connection = True
             return
+        if not head:
+            self.close_connection = True
+            return
+        stream = io.BytesIO(head)
+        self.raw_requestline = stream.readline(_MAX_HEAD_LINE + 1)
+        self.rfile = stream  # parse_request reads the headers from here
         if not self.parse_request():  # sets close_connection itself
             return
-        handler = ServerHandler(
+        if self.headers.get("Content-Length") or self.headers.get(
+            "Transfer-Encoding"
+        ):
+            # No endpoint reads a body; rather than parse/drain one, stop
+            # reusing the connection so its bytes can't be misread as the
+            # next request line.
+            self.close_connection = True
+        if guard is not None:
+            # Response-write deadline: a peer that stops reading can park
+            # this thread for at most the write budget per send. ALWAYS
+            # re-armed — the head reader leaves whatever remained of the
+            # header budget on the socket, and "0 disables" must mean
+            # blocking writes, not an arbitrary leftover deadline.
+            self.connection.settimeout(guard.write_timeout_s or None)
+        handler = _QuietServerHandler(
             self.rfile,
             self.wfile,
             self.get_stderr(),
@@ -84,14 +257,90 @@ class _Handler(WSGIRequestHandler):
         handler.request_handler = self
         handler.run(self.server.get_app())
 
+    def _best_effort_error(self, code: int) -> None:
+        """send_error against a possibly-dead socket, quietly."""
+        self.requestline = ""
+        self.request_version = ""
+        self.command = ""
+        try:
+            self.send_error(code)
+        except (ConnectionError, TimeoutError, socket.timeout, OSError):
+            pass
+
     def log_message(self, *args) -> None:  # keep scrape noise out of logs
         pass
 
 
+class _QuietServerHandler(ServerHandler):
+    """wsgiref's ServerHandler prints tracebacks to stderr on any failure
+    mid-response; this routes them through logging instead — connection
+    drops and write timeouts at DEBUG (routine client behavior), real
+    app bugs at ERROR — and never tries to write an error body to a
+    socket that just failed a write."""
+
+    _CLIENT_GONE = (ConnectionError, TimeoutError, socket.timeout)
+
+    def run(self, application) -> None:
+        # wsgiref's run() silently swallows ConnectionAborted/BrokenPipe/
+        # ConnectionReset WITHOUT reaching handle_error — which would
+        # leave the keep-alive loop free to reuse a connection whose
+        # response was truncated mid-write. Route every failure through
+        # handle_error instead, which ends the connection.
+        try:
+            self.setup_environ()
+            self.result = application(self.environ, self.start_response)
+            self.finish_response()
+        except BaseException:
+            try:
+                self.handle_error()
+            except BaseException:
+                self.close()
+                raise
+
+    def log_exception(self, exc_info) -> None:
+        if isinstance(exc_info[1], self._CLIENT_GONE):
+            log.debug("client connection lost mid-response: %s", exc_info[1])
+        else:
+            log.error("unhandled error serving request", exc_info=exc_info)
+
+    def handle_error(self) -> None:
+        self.log_exception(sys.exc_info())
+        # Whatever failed, this response is not trustworthy framing for
+        # a persistent connection: a truncated body or a Content-Length
+        # -less error page would corrupt the next pipelined exchange,
+        # and a half-dead peer must not park this thread for another
+        # idle-timeout. End the connection after this request.
+        if self.request_handler is not None:
+            self.request_handler.close_connection = True
+        if isinstance(sys.exc_info()[1], self._CLIENT_GONE):
+            self.close()
+            return
+        if not self.headers_sent:
+            self.result = self.error_output(self.environ, self.start_response)
+            self.finish_response()
+
+
 class _ThreadingWSGIServer(ThreadingMixIn, WSGIServer):
     daemon_threads = True
-    allow_reuse_address = True
+    allow_reuse_address = True  # SO_REUSEADDR: fast rebind across restarts
     address_family = socket.AF_INET
+    #: Set by ExporterServer when the exporter runs guarded; the handler
+    #: and middleware read their budgets from it. None = unguarded.
+    ingress_guard = None
+
+    def server_bind(self) -> None:
+        super().server_bind()
+        # Close-on-exec (redundantly with PEP 446, but explicit): a
+        # backend recovery that ever exec()s must not leak the scrape
+        # listener into the child.
+        self.socket.set_inheritable(False)
+
+    def handle_error(self, request, client_address) -> None:
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (ConnectionError, TimeoutError, socket.timeout)):
+            log.debug("connection error from %s: %s", client_address, exc)
+        else:
+            log.exception("error processing request from %s", client_address)
 
 
 #: Prometheus text exposition format 0.0.4.
@@ -133,10 +382,18 @@ def _json_dump(doc) -> bytes:
     ).encode() + b"\n"
 
 
+#: Replay-response bounds (items / payload bytes) for /debug/traces and
+#: /anomalies — defaults for unguarded embedders (sidecar); the exporter
+#: passes its TPUMON_GUARD_REPLAY_* knobs.
+DEFAULT_REPLAY_MAX_ITEMS = 256
+DEFAULT_REPLAY_MAX_BYTES = 1 << 20
+
+
 def _make_app(
     render_body, telemetry: SelfTelemetry, health, history=None,
     device_health=None, post_scrape=None, anomalies=None, tracer=None,
-    debug_vars=None,
+    debug_vars=None, replay_max_items=DEFAULT_REPLAY_MAX_ITEMS,
+    replay_max_bytes=DEFAULT_REPLAY_MAX_BYTES,
 ):
     """WSGI app. ``render_body(want_gzip: bool) -> bytes`` produces the
     /metrics payload (already gzip-encoded when asked); the exporter
@@ -156,6 +413,7 @@ def _make_app(
             body, status = _traces_response(
                 tracer, environ.get("QUERY_STRING", ""),
                 slow=path.endswith("/slow"),
+                max_items=replay_max_items, max_bytes=replay_max_bytes,
             )
             start_response(
                 status,
@@ -177,7 +435,8 @@ def _make_app(
             return [body]
         if path == "/anomalies" and anomalies is not None:
             body, status = _anomalies_response(
-                anomalies, environ.get("QUERY_STRING", "")
+                anomalies, environ.get("QUERY_STRING", ""),
+                max_items=replay_max_items, max_bytes=replay_max_bytes,
             )
             start_response(
                 status,
@@ -302,7 +561,29 @@ def _history_response(history, query_string: str) -> tuple[bytes, str]:
     return body, "200 OK"
 
 
-def _traces_response(tracer, query_string: str, slow: bool) -> tuple[bytes, str]:
+def _bounded_items(items: list, max_items: int, max_bytes: int):
+    """Truncate a replay item list to the response bounds; returns
+    (kept, truncated?). At least one item is always kept so an oversized
+    single item stays fetchable. Byte accounting serializes per item —
+    exact enough, and these endpoints are off every hot path."""
+    kept: list = []
+    total = 0
+    for item in items:
+        size = len(_json_dump(item))
+        if kept and (
+            len(kept) >= max(1, max_items) or total + size > max_bytes
+        ):
+            return kept, True
+        kept.append(item)
+        total += size
+    return kept, False
+
+
+def _traces_response(
+    tracer, query_string: str, slow: bool,
+    max_items: int = DEFAULT_REPLAY_MAX_ITEMS,
+    max_bytes: int = DEFAULT_REPLAY_MAX_BYTES,
+) -> tuple[bytes, str]:
     """The /debug/traces[/slow] JSON API (poll-thread state, rendered
     lazily here — never on the scrape path).
 
@@ -315,6 +596,11 @@ def _traces_response(tracer, query_string: str, slow: bool) -> tuple[bytes, str]
     - ``?since=<ts>`` replays traces ending at/after ``ts`` — the same
       replay semantics (and the same ``_finite`` validator) as /history
       and /anomalies.
+    - Responses are BOUNDED: at most ``max_items`` traces /
+      ``max_bytes`` payload per response. A truncated response carries
+      ``"truncated": true`` and ``"next_since"`` — pass it back as
+      ``?since=`` to continue; a stale ``since`` can therefore never
+      serialize the whole ring in one allocation.
     """
     from urllib.parse import parse_qs
 
@@ -325,11 +611,23 @@ def _traces_response(tracer, query_string: str, slow: bool) -> tuple[bytes, str]
     doc = tracer.counts()
     doc["now"] = time.time()
     doc["slow_cycle_ms"] = tracer.slow_cycle_ms
-    doc["traces"] = tracer.traces(slow=slow, since=since)
+    items = tracer.traces(slow=slow, since=since)
+    kept, truncated = _bounded_items(items, max_items, max_bytes)
+    doc["traces"] = kept
+    if truncated:
+        doc["truncated"] = True
+        # Traces are oldest-first with monotonically increasing end_ts;
+        # the first excluded item's end_ts is an exact resume point for
+        # the >= since filter.
+        doc["next_since"] = items[len(kept)]["end_ts"]
     return _json_dump(doc), "200 OK"
 
 
-def _anomalies_response(engine, query_string: str) -> tuple[bytes, str]:
+def _anomalies_response(
+    engine, query_string: str,
+    max_items: int = DEFAULT_REPLAY_MAX_ITEMS,
+    max_bytes: int = DEFAULT_REPLAY_MAX_BYTES,
+) -> tuple[bytes, str]:
     """The /anomalies JSON API (poll-thread state, no device calls).
 
     - ``GET /anomalies`` → every retained event (bounded per-device
@@ -340,6 +638,11 @@ def _anomalies_response(engine, query_string: str) -> tuple[bytes, str]:
       id-ordered, so replays are deterministic.
     - ``GET /anomalies?since=<ts>`` → only events updated (onset OR
       clear) at/after ``ts`` — the same replay semantics as /history.
+    - Responses are BOUNDED: at most ``max_items`` events /
+      ``max_bytes`` payload per response. A truncated response carries
+      ``"truncated": true`` and ``"next_cursor"`` (the last included
+      event id) — pass it back as ``?cursor=`` (combinable with
+      ``since``) to fetch events with a greater id.
     """
     from urllib.parse import parse_qs
 
@@ -347,9 +650,21 @@ def _anomalies_response(engine, query_string: str) -> tuple[bytes, str]:
     since = _finite(params.get("since", ["0"])[0])
     if since is None:
         return b'{"error": "bad since"}\n', "400 Bad Request"
+    cursor_raw = params.get("cursor", ["0"])[0]
+    try:
+        cursor = int(cursor_raw)
+    except ValueError:
+        cursor = -1
+    if cursor < 0:
+        return b'{"error": "bad cursor"}\n', "400 Bad Request"
     doc = engine.summary()
     doc["now"] = time.time()
-    doc["events"] = engine.events(since)
+    events = [e for e in engine.events(since) if e["id"] > cursor]
+    kept, truncated = _bounded_items(events, max_items, max_bytes)
+    doc["events"] = kept
+    if truncated:
+        doc["truncated"] = True
+        doc["next_cursor"] = kept[-1]["id"]
     return _json_dump(doc), "200 OK"
 
 
@@ -441,12 +756,15 @@ class _SelfTelemetryPage:
 
 class ExporterServer:
     """Owns the WSGI server thread; ``port`` is resolved after bind
-    (port 0 → ephemeral, used heavily by tests)."""
+    (port 0 → ephemeral, used heavily by tests). ``guard`` (an
+    IngressGuard) arms the handler's request deadlines; None leaves the
+    server unguarded (the sidecar)."""
 
-    def __init__(self, app, addr: str, port: int) -> None:
+    def __init__(self, app, addr: str, port: int, guard=None) -> None:
         self._httpd = make_server(
             addr, port, app, server_class=_ThreadingWSGIServer, handler_class=_Handler
         )
+        self._httpd.ingress_guard = guard
         self.addr = addr
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
@@ -580,11 +898,86 @@ class Exporter:
             self.watchdog = PollWatchdog(
                 cfg.watchdog_hang_s, self._recover_backend
             )
+        # Self-protection plane (tpumon/guard): ingress admission control,
+        # per-family cardinality budget, and RSS watermarks. Built after
+        # the ring-owning subsystems so the memory watchdog can register
+        # its shrink/restore hooks against them.
+        self.guard = None
+        self.memwatch = None
+        self.governor = None
+        if cfg.guard:
+            from tpumon.guard import (
+                CardinalityGovernor,
+                IngressGuard,
+                MemoryWatch,
+            )
+            from tpumon.guard.memwatch import resolve_watermarks
+
+            soft_bytes, hard_bytes = resolve_watermarks(
+                cfg.guard_soft_rss_mb, cfg.guard_hard_rss_mb
+            )
+            self.memwatch = MemoryWatch(
+                soft_bytes=soft_bytes, hard_bytes=hard_bytes
+            )
+            shed_counter = self.telemetry.shed_requests
+
+            def observe_shed(endpoint: str, reason: str) -> None:
+                shed_counter.labels(endpoint=endpoint, reason=reason).inc()
+
+            self.guard = IngressGuard(
+                metrics_inflight=cfg.guard_metrics_inflight,
+                debug_inflight=cfg.guard_debug_inflight,
+                metrics_rps=cfg.guard_metrics_rps,
+                debug_rps=cfg.guard_debug_rps,
+                header_timeout_s=cfg.guard_header_timeout_s,
+                idle_timeout_s=cfg.guard_idle_timeout_s,
+                write_timeout_s=cfg.guard_write_timeout_s,
+                watch_per_client=cfg.guard_watch_per_client,
+                memory_state=lambda: self.memwatch.state,
+                observe_shed=observe_shed,
+            )
+            if cfg.guard_max_series_per_family > 0:
+                drop_counter = self.telemetry.cardinality_dropped
+
+                def observe_drop(family: str, n: int) -> None:
+                    drop_counter.labels(family=family).inc(n)
+
+                self.governor = CardinalityGovernor(
+                    cfg.guard_max_series_per_family,
+                    observe_drop=observe_drop,
+                )
+            # Soft-watermark degradation hooks: shrink each bounded ring
+            # to a quarter (reversed when RSS recovers under hysteresis).
+            if self.tracer is not None:
+                self.memwatch.add_hooks(
+                    self.tracer.degrade, self.tracer.restore
+                )
+            if self.history is not None:
+                full_samples = self.history.max_samples
+
+                def shrink_history() -> None:
+                    self.history.resize(max(64, full_samples // 4))
+
+                def restore_history() -> None:
+                    self.history.resize(full_samples)
+
+                self.memwatch.add_hooks(shrink_history, restore_history)
+            if self.anomaly is not None:
+                full_events = self.anomaly.max_events
+
+                def shrink_anomaly() -> None:
+                    self.anomaly.set_max_events(max(8, full_events // 4))
+
+                def restore_anomaly() -> None:
+                    self.anomaly.set_max_events(full_events)
+
+                self.memwatch.add_hooks(shrink_anomaly, restore_anomaly)
         self.poller = Poller(
             backend, cfg, self.cache, self.telemetry, attribution,
             history=self.history, histograms=self.histograms,
             anomaly=self.anomaly, tracer=self.tracer,
             resilience=self.resilience, watchdog=self.watchdog,
+            governor=self.governor,
         )
         version_fn = getattr(backend, "version", None)
         self.telemetry.backend_info.labels(
@@ -596,7 +989,7 @@ class Exporter:
         # bytes on the scrape path (device page per poll, self-telemetry
         # per scrape/poll via the off-path refresher).
         self._selfpage = _SelfTelemetryPage(self.registry)
-        self.poller.on_cycle = self._selfpage.refresh
+        self.poller.on_cycle = self._on_cycle
 
         def render(want_gzip: bool) -> bytes:
             # Single gzip member per response: multi-member concatenation
@@ -617,13 +1010,29 @@ class Exporter:
             return dev + self._selfpage.latest(), version
 
         self.render_with_version = render_with_version
+        defaults = type(cfg)()
+        replay_items = (
+            cfg.guard_replay_max_items
+            if cfg.guard_replay_max_items > 0
+            else defaults.guard_replay_max_items
+        )
+        replay_bytes = (
+            cfg.guard_replay_max_bytes
+            if cfg.guard_replay_max_bytes > 0
+            else defaults.guard_replay_max_bytes
+        )
         app = _make_app(
             render, self.telemetry, self._health, self.history,
             self._device_health, post_scrape=self._selfpage.poke,
             anomalies=self.anomaly, tracer=self.tracer,
             debug_vars=self._debug_vars,
+            replay_max_items=replay_items, replay_max_bytes=replay_bytes,
         )
-        self.server = ExporterServer(app, cfg.addr, cfg.port)
+        if self.guard is not None:
+            # Admission control wraps the whole app; shedding answers
+            # before any endpoint code runs.
+            app = self.guard.wsgi(app)
+        self.server = ExporterServer(app, cfg.addr, cfg.port, guard=self.guard)
         self.grpc_server = None
         if cfg.grpc_serve_port >= 0:  # -1 disables; 0 = ephemeral (tests)
             try:
@@ -632,11 +1041,22 @@ class Exporter:
                 self.grpc_server = MetricsGrpcServer(
                     self.render_with_version, self.cache, cfg.addr,
                     cfg.grpc_serve_port, tracer=self.tracer,
+                    guard=self.guard,
                 )
             except Exception as exc:
                 # grpcio missing or bind failure must not take down the
                 # HTTP scrape plane.
                 log.warning("grpc metrics service unavailable: %s", exc)
+
+    def _on_cycle(self) -> None:
+        """Post-cycle hook (poller thread): sample the memory watchdog,
+        publish the guard gauges, then refresh the self-telemetry render
+        so the new state rides the very next scrape."""
+        if self.memwatch is not None:
+            state = self.memwatch.check()
+            self.telemetry.guard_state.set(float(state))
+            self.telemetry.guard_rss.set(self.memwatch.last_rss)
+        self._selfpage.refresh()
 
     def _recover_backend(self) -> None:
         """Watchdog hook: a poll cycle is stuck past the hang budget.
@@ -704,6 +1124,13 @@ class Exporter:
                 "hang_budget_s": self.watchdog.hang_budget_s,
                 "recoveries": self.watchdog.recoveries,
             }
+        if self.guard is not None:
+            gdoc: dict = {"ingress": self.guard.snapshot()}
+            if self.memwatch is not None:
+                gdoc["memory"] = self.memwatch.snapshot()
+            if self.governor is not None:
+                gdoc["cardinality"] = self.governor.snapshot()
+            doc["guard"] = gdoc
         if self.tracer is not None:
             doc["trace"] = {
                 "slow_cycle_ms": self.tracer.slow_cycle_ms,

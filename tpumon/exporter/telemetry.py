@@ -139,6 +139,37 @@ class SelfTelemetry:
             "(interrupt + channel re-init).",
             registry=registry,
         )
+        # -- self-protection plane (tpumon/guard) ------------------------
+        self.guard_state = Gauge(
+            "tpumon_guard_state",
+            "Self-protection memory state: 0 normal, 1 soft watermark "
+            "(rings shrunk, slow-cycle capture off), 2 hard watermark "
+            "(metrics-only serving; debug-class requests shed).",
+            registry=registry,
+        )
+        self.guard_rss = Gauge(
+            "tpumon_guard_rss_bytes",
+            "Exporter process RSS as sampled by the memory watchdog "
+            "each poll cycle (0 until the first sample or when no RSS "
+            "source exists).",
+            registry=registry,
+        )
+        self.shed_requests = Counter(
+            "tpumon_shed_requests",
+            "Requests refused by the ingress guard, by endpoint class "
+            "and reason (concurrency, rate, memory, slowloris): the "
+            "client got a cheap 503 + Retry-After instead of service.",
+            labelnames=("endpoint", "reason"),
+            registry=registry,
+        )
+        self.cardinality_dropped = Counter(
+            "tpumon_cardinality_dropped_series",
+            "Series collapsed into the sentinel `other` label value by "
+            "the per-family cardinality budget "
+            "(TPUMON_GUARD_MAX_SERIES_PER_FAMILY), by family.",
+            labelnames=("family",),
+            registry=registry,
+        )
         self.backend_info = Gauge(
             "exporter_backend_info",
             "Static info about the active device backend (value is 1).",

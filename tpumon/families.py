@@ -159,6 +159,28 @@ SELF_FAMILIES: dict[str, tuple[str, str]] = {
         "budget and the watchdog tore the backend down (interrupt + "
         "channel re-init)",
     ),
+    "tpumon_guard_state": (
+        "gauge",
+        "Self-protection memory state (tpumon/guard): 0 normal, 1 soft "
+        "watermark (rings shrunk, slow-cycle capture off), 2 hard "
+        "watermark (metrics-only serving)",
+    ),
+    "tpumon_guard_rss_bytes": (
+        "gauge",
+        "Exporter process RSS sampled by the memory watchdog each poll "
+        "cycle (0 until the first sample)",
+    ),
+    "tpumon_shed_requests_total": (
+        "counter",
+        "Requests refused by the ingress guard (503 + Retry-After with "
+        "a static body), by endpoint class and reason (concurrency, "
+        "rate, memory, slowloris)",
+    ),
+    "tpumon_cardinality_dropped_series_total": (
+        "counter",
+        "Series collapsed into the sentinel `other` label value by the "
+        "per-family cardinality budget, by family",
+    ),
 }
 
 #: family -> description (workload-side harness --metrics-port)

@@ -1,0 +1,53 @@
+"""Self-protection plane: the exporter guarding itself from its clients.
+
+PR 3 (tpumon/resilience) made the exporter survive a misbehaving device
+*backend*; this package makes it survive misbehaving *clients* and its
+own unbounded growth — a scrape storm from N Prometheus replicas, a
+slowloris connection, a runaway ``/debug/traces?since=`` replay, or
+pod-churn-driven label-cardinality explosion must degrade observably
+instead of stalling the 1 Hz poll loop or OOM-killing the DaemonSet pod:
+
+- :mod:`tpumon.guard.ingress` — scrape admission control: per-endpoint
+  concurrency caps + token-bucket rate limits (:class:`IngressGuard`,
+  :class:`TokenBucket`), hard request deadlines (header-read + write
+  timeouts that kill slowloris, enforced in the HTTP handler), and load
+  shedding that answers ``503 + Retry-After`` with a cheap static body
+  when saturated.
+- :mod:`tpumon.guard.cardinality` — per-family label-set budget
+  (:class:`CardinalityGovernor`): overflow series collapse into a
+  sentinel ``other`` label value, bounding /metrics size and Prometheus
+  ingestion cost no matter how fast pods churn.
+- :mod:`tpumon.guard.memwatch` — RSS/ring-accounting watermarks
+  (:class:`MemoryWatch`): at the soft watermark the trace/history/
+  anomaly rings shrink and slow-cycle capture stops; at the hard
+  watermark serving drops to metrics-only. Both states are reversible
+  and surfaced via ``tpumon_guard_state``.
+- :mod:`tpumon.guard.stormer` — the client-side chaos counterpart to
+  tpumon/resilience/faults.py (:class:`Stormer`): deterministic scrape
+  storms, slowloris connections, oversized requests, and Watch-stream
+  hammering, so the shedding claims are exercised in CI
+  (tests/test_guard.py, ``tools/soak.py --storm``) rather than asserted.
+
+Degradation is always *observable*: ``tpumon_guard_state`` /
+``tpumon_shed_requests_total{endpoint,reason}`` /
+``tpumon_cardinality_dropped_series_total{family}`` ride the
+self-telemetry registry (tpumon/families.py, docs/METRICS.md).
+"""
+
+from __future__ import annotations
+
+from tpumon.guard.cardinality import CardinalityGovernor
+from tpumon.guard.ingress import IngressGuard, TokenBucket
+from tpumon.guard.memwatch import HARD, NORMAL, SOFT, MemoryWatch
+from tpumon.guard.stormer import Stormer
+
+__all__ = [
+    "CardinalityGovernor",
+    "HARD",
+    "IngressGuard",
+    "MemoryWatch",
+    "NORMAL",
+    "SOFT",
+    "Stormer",
+    "TokenBucket",
+]

@@ -1,0 +1,109 @@
+"""Cardinality governor: a per-family label-set budget for /metrics.
+
+Pod churn (accelerator_pod_info grows one series per pod placement),
+attribution noise, or a runtime that suddenly enumerates per-link series
+on a big slice can inflate the exposition page without bound — and every
+series costs Prometheus ingestion, the history recorder, and the render
+loop forever. The governor runs once per poll cycle on the poller thread
+(families are freshly built, so mutation is private) and enforces a hard
+per-family series budget:
+
+- the first ``max_series`` samples of a family (build order is
+  deterministic, so the surviving set is stable across cycles — no
+  series churn from the governor itself) are served untouched;
+- every overflow sample collapses into ONE sentinel sample whose
+  non-base label values read ``other`` and whose value is the SUM of the
+  collapsed samples (bounded cost is the contract; the sentinel is an
+  aggregate, not a per-series truth — alert on the drop counter, not on
+  ``other``'s value);
+- the drop is observable: ``tpumon_cardinality_dropped_series_total
+  {family}`` counts collapsed series-samples cumulatively.
+
+Histogram-shaped families (mixed sample names: ``_bucket``/``_sum``/
+``_count`` rows) are skipped — their cardinality is already bounded by
+the fixed bucket ladder, and summing across mixed row kinds would be
+nonsense.
+"""
+
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger(__name__)
+
+SENTINEL = "other"
+
+
+class CardinalityGovernor:
+    """Per-family series budget with sentinel-``other`` collapse.
+
+    ``observe_drop(family, n)`` (optional) feeds the self-telemetry
+    counter; :attr:`dropped` keeps the cumulative per-family tally for
+    /debug/vars either way. ``max_series <= 0`` disables the governor
+    (``govern`` becomes a no-op).
+    """
+
+    def __init__(self, max_series: int, observe_drop=None) -> None:
+        self.max_series = int(max_series)
+        self._observe_drop = observe_drop
+        #: family -> cumulative collapsed-sample count.
+        self.dropped: dict[str, int] = {}
+
+    def govern(self, families, base_keys=()) -> int:
+        """Enforce the budget in place; returns samples collapsed this
+        cycle. ``base_keys`` are the node-constant identity labels —
+        preserved on the sentinel sample so it joins like every other
+        series."""
+        if self.max_series <= 0:
+            return 0
+        base = set(base_keys)
+        collapsed = 0
+        for fam in families:
+            samples = fam.samples
+            if len(samples) <= self.max_series:
+                continue
+            if len({s.name for s in samples}) > 1:
+                continue  # histogram-shaped: bounded by its bucket ladder
+            overflow = samples[self.max_series:]
+            if len(overflow) == 1 and all(
+                v == SENTINEL
+                for k, v in overflow[0].labels.items()
+                if k not in base
+            ):
+                # Already governed (a stale-served family from the
+                # last-good cache): budget + its own sentinel. Re-collapsing
+                # would count a phantom drop every cycle.
+                continue
+            del samples[self.max_series:]
+            first = overflow[0]
+            sentinel_labels = {
+                k: (v if k in base else SENTINEL)
+                for k, v in first.labels.items()
+            }
+            total = sum(s.value for s in overflow)
+            samples.append(type(first)(first.name, sentinel_labels, total))
+            collapsed += len(overflow)
+            prev = self.dropped.get(fam.name, 0)
+            self.dropped[fam.name] = prev + len(overflow)
+            if prev == 0:
+                log.warning(
+                    "cardinality budget (%d) exceeded for %s: collapsing "
+                    "%d series into label value %r",
+                    self.max_series, fam.name, len(overflow), SENTINEL,
+                )
+            if self._observe_drop is not None:
+                try:
+                    self._observe_drop(fam.name, len(overflow))
+                except Exception:
+                    pass  # a metrics hiccup must never fail the cycle
+        return collapsed
+
+    def snapshot(self) -> dict:
+        """The /debug/vars "guard" cardinality block."""
+        return {
+            "max_series_per_family": self.max_series,
+            "dropped": dict(sorted(self.dropped.items())),
+        }
+
+
+__all__ = ["CardinalityGovernor", "SENTINEL"]

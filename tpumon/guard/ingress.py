@@ -1,0 +1,252 @@
+"""Scrape admission control: caps, rate limits, deadlines, shedding.
+
+The scrape path serves cached bytes, so a *well-behaved* scraper can
+never hurt the exporter — but nothing in HTTP makes clients well
+behaved. :class:`IngressGuard` is the policy object both serving planes
+consult:
+
+- the WSGI middleware (:meth:`IngressGuard.wsgi`) classifies each
+  request into an endpoint class, enforces a concurrency cap and a
+  token-bucket rate limit per class, and answers ``503 Service
+  Unavailable`` with ``Retry-After`` and a pre-built static body when
+  saturated — shedding costs one dict lookup and a counter increment,
+  never a render;
+- the HTTP handler (tpumon/exporter/server.py) reads the deadline knobs
+  to evict idle keep-alive connections and kill slowloris (header bytes
+  must complete within ``header_timeout_s`` of the first byte);
+- the gRPC service (tpumon/exporter/grpc_service.py) counts its
+  per-client Watch-stream sheds through the same
+  ``tpumon_shed_requests_total{endpoint,reason}`` funnel;
+- the memory watchdog (tpumon/guard/memwatch.py) plugs in as
+  ``memory_state``: at the hard watermark every debug-class endpoint is
+  shed with ``reason="memory"`` — metrics-only serving.
+
+Everything is lock-cheap: admission is O(1) under one small mutex per
+endpoint class, far off the poll loop's thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+#: Endpoint classes with independent caps/buckets. The health probes are
+#: deliberately unlisted: kubelet liveness must keep answering while
+#: everything else sheds, or overload converts into a restart storm.
+METRICS = "metrics"
+DEBUG = "debug"
+
+#: Pre-built shed response (the whole point is that shedding is cheaper
+#: than serving).
+SHED_BODY = b"overloaded: request shed, retry later\n"
+SHED_STATUS = "503 Service Unavailable"
+SHED_HEADERS = (
+    ("Content-Type", "text/plain; charset=utf-8"),
+    ("Retry-After", "1"),
+    ("Content-Length", str(len(SHED_BODY))),
+)
+
+
+class TokenBucket:
+    """Classic token bucket; ``rate`` tokens/s, capacity ``burst``.
+
+    ``rate <= 0`` disables the bucket (always allows). Injectable clock
+    for deterministic tests.
+    """
+
+    def __init__(self, rate: float, burst: float, clock=time.monotonic) -> None:
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst))
+        self._clock = clock
+        self._tokens = self.burst
+        self._last = clock()
+        self._lock = threading.Lock()
+
+    def allow(self) -> bool:
+        if self.rate <= 0:
+            return True
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._last) * self.rate
+            )
+            self._last = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+
+class _EndpointPolicy:
+    """Concurrency cap + rate bucket for one endpoint class."""
+
+    def __init__(self, max_inflight: int, rps: float, clock) -> None:
+        self.max_inflight = int(max_inflight)
+        self.bucket = TokenBucket(rps, burst=2.0 * rps, clock=clock)
+        self.inflight = 0
+        self.lock = threading.Lock()
+
+    def admit(self) -> str | None:
+        """None = admitted (caller must release()); else the shed reason.
+
+        Concurrency is checked BEFORE a rate token is consumed: a
+        concurrency-shed burst must not drain the bucket and convert
+        later well-paced requests into misattributed "rate" sheds."""
+        with self.lock:
+            if self.max_inflight > 0 and self.inflight >= self.max_inflight:
+                return "concurrency"
+            if not self.bucket.allow():
+                return "rate"
+            self.inflight += 1
+        return None
+
+    def release(self) -> None:
+        with self.lock:
+            self.inflight -= 1
+
+
+class IngressGuard:
+    """The admission-control policy shared by the HTTP and gRPC planes.
+
+    ``count_shed(endpoint, reason)`` feeds the
+    ``tpumon_shed_requests_total`` counter through an injected observer
+    (the exporter passes the self-telemetry counter; tests pass a dict
+    recorder); ``memory_state`` (a ``() -> int`` callable, 0/1/2) is the
+    memwatch plug — at 2 (hard watermark) debug-class requests shed with
+    ``reason="memory"``.
+    """
+
+    def __init__(
+        self,
+        metrics_inflight: int = 16,
+        debug_inflight: int = 4,
+        metrics_rps: float = 0.0,
+        debug_rps: float = 20.0,
+        header_timeout_s: float = 5.0,
+        idle_timeout_s: float = 65.0,
+        write_timeout_s: float = 10.0,
+        watch_per_client: int = 4,
+        memory_state=None,
+        observe_shed=None,
+        clock=time.monotonic,
+    ) -> None:
+        self.header_timeout_s = max(0.0, float(header_timeout_s))
+        self.idle_timeout_s = max(0.0, float(idle_timeout_s))
+        self.write_timeout_s = max(0.0, float(write_timeout_s))
+        self.watch_per_client = int(watch_per_client)
+        self._memory_state = memory_state
+        self._observe_shed = observe_shed
+        self._policies = {
+            METRICS: _EndpointPolicy(metrics_inflight, metrics_rps, clock),
+            DEBUG: _EndpointPolicy(debug_inflight, debug_rps, clock),
+        }
+        self._shed_lock = threading.Lock()
+        #: (endpoint, reason) -> count, for /debug/vars and tests.
+        self.shed_counts: dict[tuple[str, str], int] = {}
+
+    # -- classification ----------------------------------------------------
+
+    @staticmethod
+    def classify(path: str) -> tuple[str | None, str | None]:
+        """(endpoint label, policy class) for a request path; (None, None)
+        leaves the request unguarded (health probes, 404s)."""
+        if path in ("/metrics", "/"):
+            return METRICS, METRICS
+        if path == "/history":
+            return "history", DEBUG
+        if path == "/anomalies":
+            return "anomalies", DEBUG
+        if path.startswith("/debug/") or path == "/health/devices":
+            return DEBUG, DEBUG
+        return None, None
+
+    # -- accounting --------------------------------------------------------
+
+    def count_shed(self, endpoint: str, reason: str) -> None:
+        with self._shed_lock:
+            key = (endpoint, reason)
+            self.shed_counts[key] = self.shed_counts.get(key, 0) + 1
+        if self._observe_shed is not None:
+            try:
+                self._observe_shed(endpoint, reason)
+            except Exception:
+                pass  # a metrics hiccup must never fail the shed path
+
+    def memory_state(self) -> int:
+        if self._memory_state is None:
+            return 0
+        try:
+            return int(self._memory_state())
+        except Exception:
+            return 0
+
+    def snapshot(self) -> dict:
+        """The /debug/vars "guard" ingress block."""
+        with self._shed_lock:
+            shed = {
+                f"{ep}:{reason}": n
+                for (ep, reason), n in sorted(self.shed_counts.items())
+            }
+        return {
+            "shed": shed,
+            "inflight": {
+                name: pol.inflight for name, pol in self._policies.items()
+            },
+            "limits": {
+                name: {
+                    "max_inflight": pol.max_inflight,
+                    "rps": pol.bucket.rate,
+                }
+                for name, pol in self._policies.items()
+            },
+            "deadlines": {
+                "header_s": self.header_timeout_s,
+                "idle_s": self.idle_timeout_s,
+                "write_s": self.write_timeout_s,
+            },
+        }
+
+    # -- WSGI middleware ---------------------------------------------------
+
+    def wsgi(self, app):
+        """Wrap a WSGI app in admission control + load shedding."""
+
+        def guarded(environ, start_response):
+            endpoint, policy_name = self.classify(
+                environ.get("PATH_INFO", "/")
+            )
+            if endpoint is None:
+                return app(environ, start_response)
+            if policy_name == DEBUG and self.memory_state() >= 2:
+                # Hard watermark: metrics-only serving. The expensive
+                # JSON endpoints are exactly the allocations we are
+                # trying to stop making.
+                self.count_shed(endpoint, "memory")
+                start_response(SHED_STATUS, list(SHED_HEADERS))
+                return [SHED_BODY]
+            policy = self._policies[policy_name]
+            reason = policy.admit()
+            if reason is not None:
+                self.count_shed(endpoint, reason)
+                start_response(SHED_STATUS, list(SHED_HEADERS))
+                return [SHED_BODY]
+            try:
+                # Every inner app returns a fully materialized [bytes],
+                # so releasing after the call (not after iteration) is
+                # correct — nothing streams lazily.
+                return app(environ, start_response)
+            finally:
+                policy.release()
+
+        return guarded
+
+
+__all__ = [
+    "DEBUG",
+    "IngressGuard",
+    "METRICS",
+    "SHED_BODY",
+    "SHED_HEADERS",
+    "SHED_STATUS",
+    "TokenBucket",
+]

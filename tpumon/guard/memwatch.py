@@ -1,0 +1,230 @@
+"""Memory watermarks: staged, reversible degradation before the OOM kill.
+
+The DaemonSet pod runs under a hard container memory limit (256Mi in the
+shipped manifests); crossing it is a kill, not a degradation. The
+watchdog samples RSS once per poll cycle (one /proc read — psutil when
+present, /proc/self/statm otherwise) and walks a three-state machine:
+
+- **NORMAL (0)** — full service.
+- **SOFT (1)** — RSS crossed the soft watermark: every registered
+  degrade hook fires once (the exporter shrinks the trace/history/
+  anomaly rings to a quarter and disables slow-cycle capture), cutting
+  the bounded-but-large consumers before the kernel cuts the process.
+- **HARD (2)** — RSS crossed the hard watermark: the ingress guard
+  reads this state and sheds every debug-class request with
+  ``reason="memory"`` — metrics-only serving, because the JSON replay
+  endpoints are exactly the transient allocations left.
+
+Both transitions are reversible with 10% hysteresis (re-entering NORMAL
+restores the rings), and always observable: ``tpumon_guard_state`` and
+``tpumon_guard_rss_bytes`` ride the self-telemetry page, /debug/vars
+carries the full snapshot, and state changes log at WARNING.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+NORMAL, SOFT, HARD = 0, 1, 2
+STATE_NAMES = {NORMAL: "normal", SOFT: "soft", HARD: "hard"}
+
+#: Fraction of a watermark RSS must drop below to leave its state —
+#: without it, a process sitting exactly at the watermark would flap the
+#: ring shrink/restore hooks every cycle.
+HYSTERESIS = 0.9
+
+#: Auto-watermark fractions of the container memory limit (the 256Mi
+#: DaemonSet default → soft ~201 MB, hard ~241 MB).
+AUTO_SOFT_FRACTION = 0.75
+AUTO_HARD_FRACTION = 0.90
+
+#: cgroup values at/above this are "no limit" sentinels (v1 reports
+#: 2^63-1, v2 the literal "max").
+_NO_LIMIT = float(1 << 60)
+
+
+def container_memory_limit() -> float | None:
+    """This process's cgroup memory limit in bytes, or None when
+    unlimited/undetectable (bare processes, test runners)."""
+    for path in (
+        "/sys/fs/cgroup/memory.max",  # v2
+        "/sys/fs/cgroup/memory/memory.limit_in_bytes",  # v1
+    ):
+        try:
+            with open(path, encoding="ascii") as fh:
+                raw = fh.read().strip()
+        except OSError:
+            continue
+        if raw == "max":
+            return None
+        try:
+            value = float(raw)
+        except ValueError:
+            continue
+        if value <= 0 or value >= _NO_LIMIT:
+            return None
+        return value
+    return None
+
+
+def resolve_watermarks(
+    soft_mb: float, hard_mb: float, limit_fn=container_memory_limit
+) -> tuple[float, float]:
+    """Knob semantics → byte thresholds: ``>0`` is an absolute MB value,
+    ``0`` is auto (a fraction of the container memory limit; disarmed
+    when the process has no meaningful limit — test runners and
+    embedders must not inherit DaemonSet-sized thresholds), ``<0``
+    disables that stage."""
+    limit = limit_fn() if (soft_mb == 0 or hard_mb == 0) else None
+
+    def one(mb: float, fraction: float) -> float:
+        if mb > 0:
+            return mb * 1e6
+        if mb < 0:
+            return 0.0
+        return limit * fraction if limit else 0.0
+
+    return one(soft_mb, AUTO_SOFT_FRACTION), one(hard_mb, AUTO_HARD_FRACTION)
+
+
+def _default_rss_fn():
+    """Best available RSS reader, or None when the platform has none
+    (the watchdog then disarms rather than guessing)."""
+    try:
+        import psutil
+
+        info = psutil.Process(os.getpid()).memory_info
+        return lambda: float(info().rss)
+    except ImportError:
+        pass
+    try:
+        page = os.sysconf("SC_PAGE_SIZE")
+        open("/proc/self/statm", "rb").close()  # probe readability
+
+        def rss() -> float:
+            with open("/proc/self/statm", "rb") as fh:
+                return float(int(fh.read().split()[1]) * page)
+
+        return rss
+    except (OSError, ValueError, AttributeError):
+        return None
+
+
+class MemoryWatch:
+    """The RSS state machine; ``check()`` runs once per poll cycle.
+
+    ``soft_bytes``/``hard_bytes`` <= 0 disable their stage. ``rss_fn``
+    is injectable for tests; when no reader exists the watch stays
+    disarmed at NORMAL. ``degrade``/``restore`` hooks are registered via
+    :meth:`add_hooks` and fire on the NORMAL→(SOFT|HARD) and →NORMAL
+    edges; a raising hook is logged and skipped — the state machine must
+    never wedge on a consumer bug.
+    """
+
+    def __init__(
+        self, soft_bytes: float, hard_bytes: float, rss_fn=None
+    ) -> None:
+        self.soft_bytes = float(soft_bytes)
+        self.hard_bytes = float(hard_bytes)
+        if 0 < self.hard_bytes < self.soft_bytes:
+            # Malformed knobs degrade to a sane order, never crash.
+            self.soft_bytes = self.hard_bytes
+        self._rss_fn = rss_fn if rss_fn is not None else _default_rss_fn()
+        self.state = NORMAL
+        self.last_rss = 0.0
+        self.max_rss = 0.0
+        self.transitions = 0
+        self._hooks: list[tuple] = []  # (degrade, restore)
+
+    @property
+    def armed(self) -> bool:
+        return self._rss_fn is not None and (
+            self.soft_bytes > 0 or self.hard_bytes > 0
+        )
+
+    def add_hooks(self, degrade, restore) -> None:
+        self._hooks.append((degrade, restore))
+
+    def _fire(self, index: int, label: str) -> None:
+        for pair in self._hooks:
+            try:
+                pair[index]()
+            except Exception:
+                log.exception("memory watchdog %s hook failed", label)
+
+    def check(self) -> int:
+        """Sample RSS, transition, fire hooks on edges; returns state."""
+        if not self.armed:
+            return self.state
+        try:
+            rss = float(self._rss_fn())
+        except Exception:
+            log.exception("RSS sampling failed; memory watchdog disarmed")
+            self._rss_fn = None
+            if self.state != NORMAL:
+                # Disarming while degraded would freeze SOFT/HARD (and
+                # its shedding) until process restart — no sample can
+                # ever clear it. Blind is blind: restore full service.
+                self.state = NORMAL
+                self.transitions += 1
+                self._fire(1, "restore")
+            return self.state
+        self.last_rss = rss
+        self.max_rss = max(self.max_rss, rss)
+
+        new = self.state
+        if self.state == NORMAL:
+            if 0 < self.hard_bytes <= rss:
+                new = HARD
+            elif 0 < self.soft_bytes <= rss:
+                new = SOFT
+        elif self.state == SOFT:
+            if 0 < self.hard_bytes <= rss:
+                new = HARD
+            elif rss < self.soft_bytes * HYSTERESIS:
+                new = NORMAL
+        elif self.state == HARD:
+            if rss < self.hard_bytes * HYSTERESIS:
+                # Fall back to SOFT (not straight to NORMAL) so the ring
+                # shrink persists until RSS is genuinely back under the
+                # soft watermark too.
+                new = (
+                    SOFT
+                    if 0 < self.soft_bytes * HYSTERESIS <= rss
+                    else NORMAL
+                )
+        if new == self.state:
+            return self.state
+
+        old = self.state
+        self.state = new
+        self.transitions += 1
+        log.warning(
+            "memory watermark: %s -> %s (rss %.1f MB, soft %.1f / hard "
+            "%.1f MB)",
+            STATE_NAMES[old], STATE_NAMES[new], rss / 1e6,
+            self.soft_bytes / 1e6, self.hard_bytes / 1e6,
+        )
+        if old == NORMAL:
+            self._fire(0, "degrade")
+        elif new == NORMAL:
+            self._fire(1, "restore")
+        return self.state
+
+    def snapshot(self) -> dict:
+        """The /debug/vars "guard" memory block."""
+        return {
+            "state": STATE_NAMES[self.state],
+            "armed": self.armed,
+            "rss_bytes": self.last_rss,
+            "max_rss_bytes": self.max_rss,
+            "soft_bytes": self.soft_bytes,
+            "hard_bytes": self.hard_bytes,
+            "transitions": self.transitions,
+        }
+
+
+__all__ = ["HARD", "MemoryWatch", "NORMAL", "SOFT", "STATE_NAMES"]

@@ -1,0 +1,332 @@
+"""Client-side chaos: the request-generator counterpart to faults.py.
+
+tpumon/resilience/faults.py injects a misbehaving *backend* under the
+exporter; :class:`Stormer` points misbehaving *clients* at it — so the
+guard plane's shedding/deadline/cap claims are exercised in CI
+(tests/test_guard.py, ``tools/soak.py --storm``) rather than asserted:
+
+- **scrape storm** — N threads hammering an endpoint back-to-back over
+  persistent connections (the N-Prometheus-replicas / runaway-fan-in
+  shape), counting statuses and well-behaved latencies;
+- **slowloris** — connections that trickle header bytes forever; the
+  server must evict them within the header deadline while normal
+  scrapes keep answering;
+- **oversized requests** — request lines and header blocks past the
+  parser bounds; the server must answer 414/431 and close, never
+  allocate proportionally;
+- **Watch hammer** — more concurrent gRPC ``Watch`` streams than the
+  per-client cap; the overflow must be refused with RESOURCE_EXHAUSTED
+  while admitted streams keep receiving pushes.
+
+Everything is deterministic given the knobs (fixed thread counts, fixed
+durations, no randomness), and every probe reports an evidence dict the
+callers assert on or embed in the soak record.
+"""
+
+from __future__ import annotations
+
+import http.client
+import socket
+import threading
+import time
+
+
+def scrape_storm(
+    host: str,
+    port: int,
+    duration_s: float,
+    threads: int = 8,
+    path: str = "/metrics",
+) -> dict:
+    """Hammer ``path`` from ``threads`` persistent connections for
+    ``duration_s``; returns status counts, latency stats, and whether
+    every 503 carried Retry-After."""
+    lock = threading.Lock()
+    statuses: dict[int, int] = {}
+    lat_ms: list[float] = []
+    missing_retry_after = 0
+    errors = 0
+
+    def worker() -> None:
+        nonlocal missing_retry_after, errors
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        deadline = time.monotonic() + duration_s
+        try:
+            while time.monotonic() < deadline:
+                t0 = time.perf_counter()
+                try:
+                    conn.request("GET", path)
+                    resp = conn.getresponse()
+                    resp.read()
+                except (OSError, http.client.HTTPException):
+                    with lock:
+                        errors += 1
+                    conn.close()
+                    conn = http.client.HTTPConnection(host, port, timeout=10)
+                    continue
+                ms = (time.perf_counter() - t0) * 1e3
+                with lock:
+                    statuses[resp.status] = statuses.get(resp.status, 0) + 1
+                    lat_ms.append(ms)
+                    if resp.status == 503 and not resp.getheader(
+                        "Retry-After"
+                    ):
+                        missing_retry_after += 1
+        finally:
+            conn.close()
+
+    pool = [
+        threading.Thread(target=worker, name=f"storm-{i}", daemon=True)
+        for i in range(threads)
+    ]
+    for t in pool:
+        t.start()
+    for t in pool:
+        t.join()
+    lat_ms.sort()
+    return {
+        "path": path,
+        "threads": threads,
+        "requests": sum(statuses.values()),
+        "statuses": {str(k): v for k, v in sorted(statuses.items())},
+        "errors": errors,
+        "missing_retry_after": missing_retry_after,
+        "p50_ms": round(lat_ms[len(lat_ms) // 2], 3) if lat_ms else None,
+        "max_ms": round(lat_ms[-1], 3) if lat_ms else None,
+    }
+
+
+def slowloris(
+    host: str,
+    port: int,
+    duration_s: float,
+    conns: int = 2,
+    drip_every_s: float = 0.5,
+) -> dict:
+    """Open ``conns`` connections that never finish their headers,
+    dripping one header byte per ``drip_every_s``. Reports how many the
+    server closed (evicted) before the duration elapsed."""
+    evicted = 0
+    held_open = 0
+    lock = threading.Lock()
+
+    def worker(i: int) -> None:
+        nonlocal evicted, held_open
+        try:
+            sock = socket.create_connection((host, port), timeout=5)
+        except OSError:
+            with lock:
+                evicted += 1  # couldn't even connect: counted as refused
+            return
+        try:
+            sock.sendall(b"GET /metrics HTTP/1.1\r\nHost: storm\r\nX-Drip: ")
+            deadline = time.monotonic() + duration_s
+            while time.monotonic() < deadline:
+                time.sleep(drip_every_s)
+                try:
+                    sock.sendall(b"a")
+                except OSError:
+                    with lock:
+                        evicted += 1
+                    return
+                # A server that closed its side surfaces as EOF on read.
+                sock.settimeout(0.01)
+                try:
+                    if sock.recv(1024) == b"":
+                        with lock:
+                            evicted += 1
+                        return
+                except socket.timeout:
+                    pass
+                except OSError:
+                    with lock:
+                        evicted += 1
+                    return
+            with lock:
+                held_open += 1
+        finally:
+            sock.close()
+
+    pool = [
+        threading.Thread(target=worker, args=(i,), daemon=True)
+        for i in range(conns)
+    ]
+    for t in pool:
+        t.start()
+    for t in pool:
+        t.join()
+    return {"conns": conns, "evicted": evicted, "held_open": held_open}
+
+
+def oversized_request(host: str, port: int) -> dict:
+    """One oversized request line + one oversized header block; returns
+    the statuses the server answered (or 'closed')."""
+
+    def probe(payload: bytes) -> str:
+        try:
+            sock = socket.create_connection((host, port), timeout=5)
+        except OSError:
+            return "refused"
+        try:
+            sock.sendall(payload)
+            sock.settimeout(5)
+            data = sock.recv(256)
+            if not data:
+                return "closed"
+            line = data.split(b"\r\n", 1)[0].decode("latin-1", "replace")
+            parts = line.split()
+            return parts[1] if len(parts) >= 2 else "garbage"
+        except OSError:
+            return "closed"
+        finally:
+            sock.close()
+
+    return {
+        "long_request_line": probe(
+            b"GET /" + b"a" * 70000 + b" HTTP/1.1\r\n\r\n"
+        ),
+        # Past the 64KB total-head bound (40 x ~2KB values), not just
+        # the stdlib 100-header count limit — this exercises the
+        # server's own allocation cap.
+        "huge_headers": probe(
+            b"GET /metrics HTTP/1.1\r\nHost: x\r\n"
+            + b"".join(
+                b"X-Flood-%d: %s\r\n" % (i, b"v" * 2048) for i in range(40)
+            )
+            + b"\r\n"
+        ),
+    }
+
+
+def watch_hammer(
+    grpc_addr: str, streams: int, duration_s: float, timeout: float = 5.0
+) -> dict:
+    """Open ``streams`` concurrent Watch streams from this process and
+    hold them for ``duration_s``; reports admitted vs refused. Returns
+    ``{"skipped": True}`` when grpcio is unavailable."""
+    try:
+        import grpc
+
+        from tpumon.exporter.grpc_service import METHOD_WATCH
+    except ImportError:
+        return {"skipped": True}
+
+    admitted = 0
+    refused = 0  # RESOURCE_EXHAUSTED only: the cap actually engaged
+    errors = 0  # transport failures — NOT evidence of the cap
+    lock = threading.Lock()
+
+    def worker() -> None:
+        nonlocal admitted, refused, errors
+        channel = grpc.insecure_channel(grpc_addr)
+        try:
+            call = channel.unary_stream(
+                METHOD_WATCH, request_serializer=None,
+                response_deserializer=None,
+            )
+            stream = call(b"", timeout=duration_s + timeout)
+            try:
+                next(iter(stream))  # first push (or the abort)
+                with lock:
+                    admitted += 1
+                time.sleep(duration_s)
+            except grpc.RpcError as err:
+                code = err.code() if hasattr(err, "code") else None
+                with lock:
+                    if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                        refused += 1
+                    else:
+                        errors += 1
+            finally:
+                stream.cancel()
+        finally:
+            channel.close()
+
+    pool = [
+        threading.Thread(target=worker, daemon=True) for _ in range(streams)
+    ]
+    for t in pool:
+        t.start()
+    for t in pool:
+        t.join()
+    return {
+        "streams": streams,
+        "admitted": admitted,
+        "refused": refused,
+        "errors": errors,
+    }
+
+
+class Stormer:
+    """Runs every probe concurrently against one exporter — the
+    acceptance-test / ``soak.py --storm`` driver."""
+
+    def __init__(
+        self, host: str, port: int, grpc_addr: str | None = None
+    ) -> None:
+        self.host = host
+        self.port = port
+        self.grpc_addr = grpc_addr
+
+    def run(
+        self,
+        duration_s: float,
+        scrape_threads: int = 8,
+        slowloris_conns: int = 2,
+        debug_threads: int = 4,
+        watch_streams: int = 8,
+    ) -> dict:
+        """The ISSUE acceptance mix: a /metrics storm at ``scrape_threads``
+        × the normal (single-scraper) concurrency, a /debug replay storm,
+        slowloris connections, oversized requests, and a Watch hammer —
+        all at once, for ``duration_s``."""
+        results: dict = {}
+        lock = threading.Lock()
+
+        def put(key, fn, *args, **kwargs):
+            def run() -> None:
+                try:
+                    out = fn(*args, **kwargs)
+                except Exception as exc:  # evidence, not a crash
+                    out = {"error": repr(exc)}
+                with lock:
+                    results[key] = out
+
+            return threading.Thread(target=run, name=f"storm-{key}", daemon=True)
+
+        jobs = [
+            put(
+                "scrape_storm", scrape_storm, self.host, self.port,
+                duration_s, scrape_threads, "/metrics",
+            ),
+            put(
+                "debug_storm", scrape_storm, self.host, self.port,
+                duration_s, debug_threads, "/debug/traces?since=0",
+            ),
+            put(
+                "slowloris", slowloris, self.host, self.port, duration_s,
+                slowloris_conns,
+            ),
+            put("oversized", oversized_request, self.host, self.port),
+        ]
+        if self.grpc_addr:
+            jobs.append(
+                put(
+                    "watch_hammer", watch_hammer, self.grpc_addr,
+                    watch_streams, min(duration_s, 3.0),
+                )
+            )
+        for t in jobs:
+            t.start()
+        for t in jobs:
+            t.join()
+        return results
+
+
+__all__ = [
+    "Stormer",
+    "oversized_request",
+    "scrape_storm",
+    "slowloris",
+    "watch_hammer",
+]

@@ -181,19 +181,22 @@ class History:
         native=None,
     ) -> None:
         self.max_age = max_age
+        #: Current per-series cap; tracked so a concurrent native
+        #: upgrade honors a resize() that landed while it compiled.
+        self.max_samples = max_samples
         self._swap_lock = threading.Lock()
         if native is None:
             self.engine = PyEngine(max_age, max_samples)
             threading.Thread(
                 target=self._upgrade_to_native,
-                args=(max_age, max_samples),
+                args=(max_age,),
                 name="tpumon-history-build",
                 daemon=True,
             ).start()
         else:
             self.engine = make_engine(max_age, max_samples, native)
 
-    def _upgrade_to_native(self, max_age: float, max_samples: int) -> None:
+    def _upgrade_to_native(self, max_age: float) -> None:
         try:
             cls = _load_native()  # may compile; runs off the poll path
         except Exception as exc:  # pragma: no cover - load_extension guards
@@ -201,8 +204,8 @@ class History:
             return
         if cls is None:
             return
-        fresh = cls(max_age, max_samples)
         with self._swap_lock:
+            fresh = cls(max_age, self.max_samples)
             old = self.engine
             # Replay everything recorded during the build. Per-series
             # timestamps are in order, which is all the engines' pruning
@@ -214,6 +217,31 @@ class History:
             self.engine = fresh
         log.info("history engine upgraded to native (replayed %d series)",
                  len(old.keys()))
+
+    def resize(self, max_samples: int) -> None:
+        """Re-cap every series ring — the memory-watermark response
+        (tpumon/guard/memwatch): swaps in a fresh engine at the new cap
+        and replays the newest retained samples. Engine-agnostic: the
+        replay uses only the public record/query API, so it works on the
+        C++ engine and the Python fallback alike. Reversible (resizing
+        back up keeps whatever survived the shrink)."""
+        max_samples = max(1, int(max_samples))
+        with self._swap_lock:
+            if max_samples == self.max_samples:
+                return
+            self.max_samples = max_samples
+            old = self.engine
+            fresh = type(old)(self.max_age, max_samples)
+            # Batch by timestamp (poll cycles share one ts across
+            # series) so the replay is one record_batch per cycle, not
+            # one per sample.
+            batches: dict[float, list] = {}
+            for key in old.keys():
+                for ts, value in old.query(key)[-max_samples:]:
+                    batches.setdefault(ts, []).append((key, value))
+            for ts in sorted(batches):
+                fresh.record_batch(ts, batches[ts])
+            self.engine = fresh
 
     @property
     def is_native(self) -> bool:

@@ -49,6 +49,10 @@ _F_NET_RATE = "accelerator_network_delivery_rate_mbps"
 _F_DEGRADED = "tpumon_degraded"
 _F_STALENESS = "tpumon_family_staleness_seconds"
 _F_BREAKER = "tpumon_breaker_state"
+_F_GUARD_STATE = "tpumon_guard_state"
+#: The parser strips the _total suffix from counter families.
+_F_SHED = "tpumon_shed_requests"
+_F_CARDINALITY = "tpumon_cardinality_dropped_series"
 
 
 def _fetch(url: str, timeout: float) -> str:
@@ -138,6 +142,34 @@ def snapshot_from_families(families) -> dict:
             if open_queries:
                 degraded["breakers_open"] = sorted(open_queries)
         snap["degraded"] = degraded
+
+    guard_state = fams.get(_F_GUARD_STATE)
+    if guard_state is not None and guard_state.samples:
+        # Self-protection plane (tpumon/guard): memory watermark state
+        # plus shed/cardinality-drop tallies. Absent on pre-guard
+        # exporters and in-process snapshots.
+        guard: dict = {"state": int(guard_state.samples[0].value)}
+        shed = fams.get(_F_SHED)
+        if shed is not None:
+            by_key = {
+                f"{s.labels.get('endpoint', '?')}:"
+                f"{s.labels.get('reason', '?')}": s.value
+                for s in shed.samples
+                if s.value > 0 and not s.name.endswith("_created")
+            }
+            if by_key:
+                guard["shed"] = by_key
+                guard["shed_total"] = sum(by_key.values())
+        dropped = fams.get(_F_CARDINALITY)
+        if dropped is not None:
+            collapsed = {
+                s.labels.get("family", "?"): s.value
+                for s in dropped.samples
+                if s.value > 0 and not s.name.endswith("_created")
+            }
+            if collapsed:
+                guard["cardinality_dropped"] = collapsed
+        snap["guard"] = guard
 
     net = fams.get(_F_NET_RATE)
     if net is not None:
@@ -527,6 +559,32 @@ def render(snap: dict, out=None) -> None:
             # recovered enumeration outage): still worth the line.
             parts.append("serving on degraded data paths")
         p("DEGRADED: " + "; ".join(parts))
+
+    guard = snap.get("guard")
+    if guard and (guard.get("state", 0) > 0 or guard.get("shed_total")
+                  or guard.get("cardinality_dropped")):
+        # Self-protection plane (tpumon/guard): only printed while the
+        # guard has actually intervened — a quiet exporter stays quiet.
+        parts = []
+        state = guard.get("state", 0)
+        if state >= 2:
+            parts.append("HARD memory watermark (metrics-only serving)")
+        elif state == 1:
+            parts.append("soft memory watermark (rings shrunk)")
+        if guard.get("shed_total"):
+            worst = max(guard["shed"].items(), key=lambda kv: kv[1])
+            parts.append(
+                f"{guard['shed_total']:.0f} requests shed "
+                f"(most: {worst[0]})"
+            )
+        if guard.get("cardinality_dropped"):
+            fams_hit = sorted(guard["cardinality_dropped"])
+            parts.append(
+                f"cardinality budget collapsing {len(fams_hit)} "
+                f"families ({', '.join(fams_hit[:2])}"
+                + ("..." if len(fams_hit) > 2 else "") + ")"
+            )
+        p("GUARD: " + "; ".join(parts))
 
     streams = snap.get("watch_streams")
     if streams:

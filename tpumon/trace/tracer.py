@@ -131,6 +131,10 @@ class Tracer:
         self._slow: deque[CycleTrace] = deque(maxlen=max(1, int(slow_ring)))
         self._seq = 0
         self._cycles = 0
+        #: Memory-watermark degradation (tpumon/guard/memwatch): rings
+        #: quartered, slow-cycle capture suspended. Reversible.
+        self._degraded = False
+        self._full_caps = (self._ring.maxlen, self._slow.maxlen)
 
     # -- recording (poll thread) ------------------------------------------
 
@@ -172,8 +176,36 @@ class Tracer:
         with self._lock:
             self._cycles += 1
             self._ring.append(ct)
-            if ct.slow:
+            if ct.slow and not self._degraded:
+                # Slow-cycle capture retains full span trees + stats;
+                # under memory pressure that flight recorder is the
+                # first thing to stop growing.
                 self._slow.append(ct)
+
+    # -- memory-watermark degradation (tpumon/guard/memwatch) -------------
+
+    def degrade(self) -> None:
+        """Quarter both rings (newest entries retained) and suspend
+        slow-cycle capture; reversed by :meth:`restore`."""
+        with self._lock:
+            if self._degraded:
+                return
+            self._degraded = True
+            self._ring = deque(
+                self._ring, maxlen=max(1, self._full_caps[0] // 4)
+            )
+            self._slow = deque(
+                self._slow, maxlen=max(1, self._full_caps[1] // 4)
+            )
+
+    def restore(self) -> None:
+        """Back to full ring capacity + slow capture (contents kept)."""
+        with self._lock:
+            if not self._degraded:
+                return
+            self._degraded = False
+            self._ring = deque(self._ring, maxlen=self._full_caps[0])
+            self._slow = deque(self._slow, maxlen=self._full_caps[1])
 
     @contextmanager
     def span(self, name: str, stage: str | None = None):
@@ -229,6 +261,7 @@ class Tracer:
                 "ring_capacity": self._ring.maxlen,
                 "slow": len(self._slow),
                 "slow_capacity": self._slow.maxlen,
+                "degraded": self._degraded,
             }
 
 

@@ -1,0 +1,662 @@
+"""Invariant analyzer (tpumon/analysis): every rule fires on a known-bad
+fixture, the suppression machinery works, and the repo itself passes
+clean against the checked-in baseline — the tier-1 drift gate that CI's
+``lint-invariants`` job enforces with ``--strict``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpumon.analysis import load_project, run_rules
+from tpumon.analysis.core import Project
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_on(files: dict, rules=None):
+    return run_rules(Project.from_files(files), rules)
+
+
+def keys(violations):
+    return {v.key for v in violations}
+
+
+# -- knob-drift ------------------------------------------------------------
+
+CONFIG_SNIPPET = '''
+import os
+
+ENV_PREFIX = "TPUMON_"
+
+
+def _env(name, default=None):
+    return os.environ.get(ENV_PREFIX + name, default)
+
+
+def _env_int(name, default):
+    return int(_env(name) or default)
+
+
+class Config:
+    port: int = 9400
+    shiny_knob: int = 3
+
+    @classmethod
+    def from_env(cls):
+        return cls(port=_env_int("PORT", 9400))
+'''
+
+CHART_SNIPPET = """
+          env:
+            - name: TPUMON_PORT
+              value: "9400"
+            - name: TPUMON_REMOVED_KNOB
+              value: "1"
+"""
+
+
+def test_knob_drift_fires_per_check():
+    violations = run_on(
+        {
+            "tpumon/config.py": CONFIG_SNIPPET,
+            "charts/tpumon/templates/daemonset.yaml": CHART_SNIPPET,
+            "deploy/daemonset.yaml": (
+                "          env:\n"
+                "            - name: TPUMON_INTERVAL\n"
+                '              value: "1.0"\n'
+            ),
+            "docs/OPERATIONS.md": "Only TPUMON_PORT is documented here.",
+        },
+        rules=["knob-drift"],
+    )
+    got = keys(violations)
+    # Prefix-resolved knob (TPUMON_PORT via _env_int) is discovered: it
+    # is documented + charted, so it must NOT be flagged as undocumented.
+    assert "undocumented:TPUMON_PORT" not in got
+    # Config field never wired in from_env.
+    assert "config-unwired:shiny_knob" in got
+    # Chart sets an env no code reads (renamed/removed knob).
+    assert "chart-unknown:TPUMON_REMOVED_KNOB" in got
+    # Kustomize pins a knob the chart cannot set... but TPUMON_INTERVAL
+    # is not discovered in this fixture either -> deploy-unknown.
+    assert "deploy-unknown:TPUMON_INTERVAL" in got
+    # The unwired field's knob is absent from docs and chart.
+    assert "undocumented:TPUMON_SHINY_KNOB" in got
+    assert "chart-missing:TPUMON_SHINY_KNOB" in got
+
+
+def test_knob_drift_resolves_prefix_composed_family():
+    violations = run_on(
+        {
+            "tpumon/health.py": (
+                "import os\n"
+                "from dataclasses import dataclass, fields\n"
+                "@dataclass(frozen=True)\n"
+                "class Thresholds:\n"
+                "    secret_ratio: float = 0.5\n"
+                "    @classmethod\n"
+                "    def from_env(cls):\n"
+                "        for f in fields(cls):\n"
+                "            os.environ.get('TPUMON_HEALTH_' + f.name.upper())\n"
+            ),
+            "docs/OPERATIONS.md": "nothing documented",
+        },
+        rules=["knob-drift"],
+    )
+    # Plain grep cannot see TPUMON_HEALTH_SECRET_RATIO anywhere in the
+    # fixture; the AST resolution must synthesize it from the dataclass.
+    assert "undocumented:TPUMON_HEALTH_SECRET_RATIO" in keys(violations)
+
+
+def test_knob_drift_prefix_knob_not_satisfied_by_longer_name():
+    """TPUMON_TRACE documented nowhere must be flagged even when
+    TPUMON_TRACE_RING appears in the docs (word-boundary, not substring)."""
+    violations = run_on(
+        {
+            "tpumon/config.py": (
+                "import os\n"
+                'ENV_PREFIX = "TPUMON_"\n'
+                "def _env(name, default=None):\n"
+                "    return os.environ.get(ENV_PREFIX + name, default)\n"
+                "class Config:\n"
+                "    trace: bool = True\n"
+                "    trace_ring: int = 128\n"
+                "    @classmethod\n"
+                "    def from_env(cls):\n"
+                '        return cls(trace=_env("TRACE"), trace_ring=_env("TRACE_RING"))\n'
+            ),
+            "docs/OPERATIONS.md": "Only `TPUMON_TRACE_RING` is documented.",
+        },
+        rules=["knob-drift"],
+    )
+    got = keys(violations)
+    assert "undocumented:TPUMON_TRACE" in got
+    assert "undocumented:TPUMON_TRACE_RING" not in got
+
+
+# -- family-drift ----------------------------------------------------------
+
+FAMILIES_SNIPPET = '''
+SELF_FAMILIES: dict = {
+    "tpumon_up": ("gauge", "poll loop alive"),
+}
+'''
+
+
+def test_family_drift_unregistered_emission_fires():
+    violations = run_on(
+        {
+            "tpumon/families.py": FAMILIES_SNIPPET,
+            "tpumon/exporter/telemetry.py": (
+                "from prometheus_client import Gauge\n"
+                "g = Gauge('tpumon_guard_rogue_gauge', 'not registered')\n"
+            ),
+        },
+        rules=["family-drift"],
+    )
+    assert "unregistered:tpumon_guard_rogue_gauge" in keys(violations)
+
+
+def test_family_drift_counter_total_normalization():
+    violations = run_on(
+        {
+            "tpumon/families.py": (
+                "SELF_FAMILIES: dict = {\n"
+                '    "tpumon_retries_total": ("counter", "retries"),\n'
+                "}\n"
+            ),
+            "tpumon/exporter/telemetry.py": (
+                "from prometheus_client import Counter\n"
+                "c = Counter('tpumon_retries', 'client lib appends _total')\n"
+            ),
+        },
+        rules=["family-drift"],
+    )
+    assert not violations  # registered under its exposition name
+
+
+def test_family_drift_promql_unknown_metric_fires():
+    dash = json.dumps(
+        {
+            "panels": [
+                {
+                    "targets": [
+                        {"expr": "rate(tpumon_retries_total[5m])"},
+                        {"expr": "tpumon_guard_bogus_metric > 0"},
+                    ]
+                }
+            ]
+        }
+    )
+    violations = run_on(
+        {
+            "tpumon/families.py": (
+                "SELF_FAMILIES: dict = {\n"
+                '    "tpumon_retries_total": ("counter", "retries"),\n'
+                "}\n"
+            ),
+            "dashboards/exporter-health.json": dash,
+        },
+        rules=["family-drift"],
+    )
+    got = keys(violations)
+    assert (
+        "promql:dashboards/exporter-health.json:tpumon_guard_bogus_metric"
+        in got
+    )
+    assert not any("tpumon_retries_total" in k for k in got)
+
+
+def test_family_drift_alert_rule_exprs_scanned():
+    violations = run_on(
+        {
+            "tpumon/families.py": FAMILIES_SNIPPET,
+            "deploy/prometheus-rules.yaml": (
+                "groups:\n"
+                "  - name: tpumon\n"
+                "    rules:\n"
+                "      - alert: Bogus\n"
+                "        expr: tpumon_watchdog_ghost_total > 0\n"
+            ),
+        },
+        rules=["family-drift"],
+    )
+    assert (
+        "promql:deploy/prometheus-rules.yaml:tpumon_watchdog_ghost_total"
+        in keys(violations)
+    )
+
+
+def test_family_drift_undocumented_family_fires():
+    violations = run_on(
+        {
+            "tpumon/families.py": FAMILIES_SNIPPET,
+            "docs/METRICS.md": "# Metrics\n\nnothing here\n",
+        },
+        rules=["family-drift"],
+    )
+    assert "undocumented:tpumon_up" in keys(violations)
+
+
+# -- lock-discipline -------------------------------------------------------
+
+LOCKED_CLASS = '''
+import threading
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._page = b""  # guarded-by: self._lock
+
+    def publish(self, page):
+        with self._lock:
+            self._page = page
+
+    def read(self):
+        return self._page  # unguarded!
+'''
+
+
+def test_lock_discipline_fires_on_unguarded_read():
+    violations = run_on(
+        {"tpumon/exporter/cache.py": LOCKED_CLASS}, rules=["lock-discipline"]
+    )
+    assert keys(violations) == {"Cache._page:read"}
+
+
+def test_lock_discipline_holds_annotation_exempts():
+    fixed = LOCKED_CLASS.replace(
+        "    def read(self):",
+        "    def read(self):  # holds: self._lock",
+    )
+    assert not run_on(
+        {"tpumon/exporter/cache.py": fixed}, rules=["lock-discipline"]
+    )
+
+
+def test_lock_discipline_alias_lock_names():
+    src = '''
+import threading
+
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._v = 0  # guarded-by: self._lock, self._cond
+
+    def bump(self):
+        with self._cond:
+            self._v += 1
+'''
+    assert not run_on({"tpumon/exporter/c.py": src}, rules=["lock-discipline"])
+
+
+def test_lock_discipline_reports_every_attr_in_a_method():
+    src = '''
+import threading
+
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._a = 0  # guarded-by: self._lock
+        self._b = 0  # guarded-by: self._lock
+
+    def bad(self):
+        return self._a + self._b
+'''
+    got = keys(run_on({"tpumon/exporter/c.py": src}, rules=["lock-discipline"]))
+    assert got == {"C._a:bad", "C._b:bad"}  # not just the first attr
+
+
+# -- lock-order ------------------------------------------------------------
+
+def test_lock_order_cycle_fires():
+    src = '''
+import threading
+
+
+class A:
+    def __init__(self):
+        self._x = threading.Lock()
+        self._y = threading.Lock()
+
+    def one(self):
+        with self._x:
+            with self._y:
+                pass
+
+    def two(self):
+        with self._y:
+            with self._x:
+                pass
+'''
+    violations = run_on({"tpumon/guard/a.py": src}, rules=["lock-order"])
+    assert violations and "cycle:" in violations[0].key
+    assert "A._x" in violations[0].key and "A._y" in violations[0].key
+
+
+def test_lock_order_consistent_nesting_clean():
+    src = '''
+import threading
+
+
+class A:
+    def __init__(self):
+        self._x = threading.Lock()
+        self._y = threading.Lock()
+
+    def one(self):
+        with self._x:
+            with self._y:
+                pass
+
+    def two(self):
+        with self._x:
+            with self._y:
+                pass
+'''
+    assert not run_on({"tpumon/guard/a.py": src}, rules=["lock-order"])
+
+
+# -- deadline --------------------------------------------------------------
+
+def test_deadline_fires_on_unbounded_join_and_recv():
+    src = '''
+import socket
+import threading
+
+
+def serve(sock, thread):
+    data = sock.recv(1024)
+    thread.join()
+    return data
+'''
+    violations = run_on({"tpumon/exporter/srv.py": src}, rules=["deadline"])
+    got = keys(violations)
+    assert "tpumon/exporter/srv.py:serve:join" in got
+    assert "tpumon/exporter/srv.py:serve:recv" in got
+
+
+def test_deadline_satisfied_by_timeout_and_annotation():
+    src = '''
+import socket
+import threading
+
+
+def serve(sock, thread, stop):
+    sock.settimeout(5.0)
+    data = sock.recv(1024)
+    thread.join(timeout=2.0)
+    stop.wait()  # deadline: woken by close() — lifecycle wait
+    return data
+'''
+    assert not run_on({"tpumon/exporter/srv.py": src}, rules=["deadline"])
+
+
+def test_deadline_subprocess_without_timeout_fires():
+    src = '''
+import subprocess
+
+
+def build():
+    subprocess.run(["make"], check=True)
+'''
+    violations = run_on({"tpumon/tools/b.py": src}, rules=["deadline"])
+    assert "tpumon/tools/b.py:build:subprocess.run" in keys(violations)
+
+
+def test_deadline_out_of_scope_modules_ignored():
+    src = "def f(t):\n    t.join()\n"
+    assert not run_on({"tpumon/workload/w.py": src}, rules=["deadline"])
+
+
+# -- except-hygiene --------------------------------------------------------
+
+def test_except_hygiene_fires_on_silent_swallow():
+    src = '''
+def poll(backend):
+    try:
+        return backend.sample()
+    except Exception:
+        return None
+'''
+    violations = run_on({"tpumon/exporter/c.py": src}, rules=["except-hygiene"])
+    assert keys(violations) == {"tpumon/exporter/c.py:poll:Exception#1"}
+
+
+def test_except_hygiene_log_counter_and_raise_pass():
+    src = '''
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def a(backend):
+    try:
+        return backend.sample()
+    except Exception as exc:
+        log.warning("sample failed: %s", exc)
+
+
+def b(backend, counter):
+    try:
+        return backend.sample()
+    except Exception:
+        counter.labels(stage="sample").inc()
+
+
+def c(backend):
+    try:
+        return backend.sample()
+    except Exception:
+        raise RuntimeError("fatal")
+'''
+    assert not run_on({"tpumon/exporter/c.py": src}, rules=["except-hygiene"])
+
+
+def test_except_hygiene_control_flow_calls_do_not_count():
+    """`.set()` on an Event (or a bare `.labels()`) is control flow, not
+    observation — the handler must still be flagged."""
+    src = '''
+def f(x, stop, counter):
+    try:
+        return x()
+    except Exception:
+        stop.set()
+        counter.labels(stage="f")
+        return None
+'''
+    violations = run_on({"tpumon/exporter/c.py": src}, rules=["except-hygiene"])
+    assert keys(violations) == {"tpumon/exporter/c.py:f:Exception#1"}
+
+
+def test_except_hygiene_narrow_handlers_exempt():
+    src = '''
+def f(x):
+    try:
+        return x()
+    except (AttributeError, OSError):
+        return None
+'''
+    assert not run_on({"tpumon/exporter/c.py": src}, rules=["except-hygiene"])
+
+
+def test_inline_suppression_comment():
+    src = '''
+def f(x):
+    try:
+        return x()
+    # tpumon-invariants: disable=except-hygiene (fixture reason)
+    except Exception:
+        return None
+'''
+    assert not run_on({"tpumon/exporter/c.py": src}, rules=["except-hygiene"])
+
+
+# -- baseline machinery ----------------------------------------------------
+
+def test_baseline_parse_and_count(tmp_path):
+    from tpumon.analysis import baseline_count, load_baseline
+
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(
+        "# comment\n"
+        "\n"
+        "knob-drift chart-missing:TPUMON_X  # reason one\n"
+        "deadline tpumon/a.py:f:join  # reason two\n"
+    )
+    entries = load_baseline(str(bl))
+    assert entries == {
+        "knob-drift chart-missing:TPUMON_X": "reason one",
+        "deadline tpumon/a.py:f:join": "reason two",
+    }
+    assert baseline_count(str(bl)) == 2
+
+
+def test_baseline_round_trips_lock_order_cycles(tmp_path):
+    """A consciously-accepted deadlock cycle must be suppressible: the
+    fingerprint written by --update-baseline must match on re-load even
+    though cycle keys encode a multi-lock chain."""
+    src = '''
+import threading
+
+
+class A:
+    def __init__(self):
+        self._x = threading.Lock()
+        self._y = threading.Lock()
+
+    def one(self):
+        with self._x:
+            with self._y:
+                pass
+
+    def two(self):
+        with self._y:
+            with self._x:
+                pass
+'''
+    (violation,) = run_on({"tpumon/guard/a.py": src}, rules=["lock-order"])
+    from tpumon.analysis import load_baseline
+
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(f"{violation.fingerprint}  # accepted for the fixture\n")
+    assert violation.fingerprint in load_baseline(str(bl))
+
+
+def test_checker_cli_baseline_suppression_and_stale(tmp_path):
+    """End-to-end CLI: a violation is suppressed by a baseline entry; a
+    dangling entry is stale and fails only --strict."""
+    root = tmp_path / "repo"
+    (root / "tpumon" / "analysis").mkdir(parents=True)
+    (root / "tpumon" / "exporter").mkdir(parents=True)
+    (root / "tpumon" / "exporter" / "bad.py").write_text(
+        "def f(t):\n    t.join()\n"
+    )
+    bl = root / "tpumon" / "analysis" / "baseline.txt"
+    bl.write_text(
+        "deadline tpumon/exporter/bad.py:f:join  # known, tracked\n"
+        "deadline tpumon/exporter/gone.py:g:join  # stale entry\n"
+    )
+    from tpumon.tools.check import main
+
+    assert main(["--root", str(root), "--no-stamp"]) == 0
+    assert main(["--root", str(root), "--no-stamp", "--strict"]) == 1
+    bl.write_text("deadline tpumon/exporter/bad.py:f:join  # known\n")
+    assert main(["--root", str(root), "--no-stamp", "--strict"]) == 0
+
+
+# -- the repo itself -------------------------------------------------------
+
+def test_repo_passes_clean_against_baseline():
+    """The tier-1 self-check: zero unsuppressed violations, zero stale
+    baseline entries, on the real repo."""
+    from tpumon.analysis import load_baseline as load_bl
+
+    project = load_project(ROOT)
+    violations = run_rules(project)
+    baseline = load_bl()
+    current = {v.fingerprint for v in violations}
+    new = sorted(v.fingerprint for v in violations if v.fingerprint not in baseline)
+    stale = sorted(set(baseline) - current)
+    assert not new, f"new invariant violations: {new}"
+    assert not stale, f"stale baseline entries (delete them): {stale}"
+    # Every baseline entry must carry a justification.
+    for fp, reason in baseline.items():
+        assert reason, f"baseline entry {fp!r} has no reason"
+
+
+def test_repo_lock_annotations_have_coverage():
+    """The discipline rule must actually be watching something: the
+    annotated shared state across all four planes."""
+    import ast
+
+    from tpumon.analysis.locks import _guarded_attrs
+
+    project = load_project(ROOT)
+    annotated = {}
+    for path, src in project.python.items():
+        for cls in ast.walk(src.tree):
+            if isinstance(cls, ast.ClassDef):
+                attrs = _guarded_attrs(cls, src)
+                if attrs:
+                    annotated[f"{path}:{cls.name}"] = sorted(attrs)
+    planes = ("exporter/collector", "trace/tracer", "anomaly/engine",
+              "resilience/breaker", "resilience/degrade",
+              "resilience/watchdog", "guard/ingress", "history")
+    for plane in planes:
+        assert any(plane in k for k in annotated), (
+            f"no guarded-by annotations found in {plane}; coverage lost"
+        )
+
+
+def test_checker_cli_strict_on_repo():
+    """`python -m tpumon.tools.check --strict` exits 0 on the repo — the
+    exact command the lint-invariants CI job runs."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpumon.tools.check", "--strict", "--no-stamp"],
+        cwd=ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "invariants OK" in proc.stdout
+
+
+def test_stamp_roundtrip_and_doctor_line(tmp_path, monkeypatch):
+    from tpumon.analysis.baseline import STAMP_ENV, stamp_info, write_stamp
+    from tpumon.doctor import _invariants_line
+
+    stamp_path = tmp_path / "stamp.json"
+    monkeypatch.setenv(STAMP_ENV, str(stamp_path))
+    write_stamp(str(tmp_path), new=0, baselined=3, stale=0, version="9.9.9")
+    doc = stamp_info(str(tmp_path))
+    assert doc and doc["ok"] and doc["baselined"] == 3
+    line = _invariants_line()
+    assert line.startswith("invariants: ok (3 baselined")
+    assert "9.9.9" in line
+    # And the not-checked fallback.
+    monkeypatch.setenv(STAMP_ENV, str(tmp_path / "missing.json"))
+    assert "not checked" in _invariants_line()
+
+
+def test_debug_vars_exposes_invariants():
+    import tpumon.exporter.server as server_mod
+
+    doc = server_mod._invariants_vars()
+    assert doc["analyzer_version"]
+    assert isinstance(doc["baseline_violations"], int)
+    assert doc["baseline_violations"] >= 0
+
+
+def test_unknown_rule_name_rejected():
+    with pytest.raises(KeyError):
+        run_on({"tpumon/x.py": "pass\n"}, rules=["no-such-rule"])

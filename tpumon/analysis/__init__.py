@@ -1,0 +1,50 @@
+"""Repo-specific invariant analyzer (AST-driven lint plane).
+
+Four planes of growth (trace, resilience, guard, anomaly) left tpumon's
+correctness resting on cross-file invariants nothing enforced: every
+``TPUMON_*`` knob must exist in config/chart/kustomize/docs, every metric
+family must be registered and documented, shared state must stay under
+its lock, blocking calls on the serving/poll paths must carry deadlines,
+and ``except Exception`` in the poll pipeline must never swallow
+silently. This package proves those invariants mechanically:
+
+- ``python -m tpumon.tools.check`` — the CLI (``--strict`` gates CI);
+- ``tests/test_analysis.py`` — per-rule fixture proofs + a repo
+  self-check that runs in the tier-1 suite;
+- ``tpumon/analysis/baseline.txt`` — the suppression file enumerating
+  accepted violations (each with a reason); new violations fail CI.
+
+Everything here is stdlib-only (ast + tokenize + json + re): the
+analyzer must run on a bare checkout with no dependencies installed.
+See docs/INVARIANTS.md for the rule catalog and annotation conventions
+(``# guarded-by:``, ``# holds:``, ``# deadline:``,
+``# tpumon-invariants: disable=<rule>``).
+"""
+
+from __future__ import annotations
+
+from tpumon.analysis.core import (
+    ANALYZER_VERSION,
+    Project,
+    Violation,
+    load_project,
+    run_rules,
+)
+from tpumon.analysis.baseline import (
+    baseline_count,
+    baseline_path,
+    load_baseline,
+    stamp_info,
+)
+
+__all__ = [
+    "ANALYZER_VERSION",
+    "Project",
+    "Violation",
+    "baseline_count",
+    "baseline_path",
+    "load_baseline",
+    "load_project",
+    "run_rules",
+    "stamp_info",
+]

@@ -1,0 +1,98 @@
+"""Baseline (suppression) file + check-stamp helpers.
+
+``tpumon/analysis/baseline.txt`` ships inside the package and enumerates
+the violations the repo has consciously accepted, one per line:
+
+    <rule> <key>  # <reason>
+
+Fingerprints are line-number-free (see core.Violation), so the baseline
+survives refactoring; a fingerprint that stops matching is reported as
+STALE and fails ``--strict`` — burn-down is enforced, not aspirational.
+
+The checker also writes a stamp (``.tpumon-invariants.json`` at the repo
+root, or ``$TPUMON_INVARIANTS_STAMP``) recording the last run's verdict;
+``tpumon doctor`` prints it and the exporter's ``/debug/vars`` carries
+the analyzer version + baseline size, so discipline status is visible
+from the running DaemonSet, not only from CI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+STAMP_ENV = "TPUMON_INVARIANTS_STAMP"
+STAMP_NAME = ".tpumon-invariants.json"
+
+
+def baseline_path(root: str | None = None) -> str:
+    """The packaged baseline file (or the one in a checkout at root)."""
+    if root is not None:
+        return os.path.join(root, "tpumon", "analysis", "baseline.txt")
+    return os.path.join(os.path.dirname(__file__), "baseline.txt")
+
+
+def load_baseline(path: str | None = None) -> dict[str, str]:
+    """fingerprint -> reason (empty string when none given)."""
+    path = path or baseline_path()
+    out: dict[str, str] = {}
+    try:
+        with open(path, encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return out
+    for line in lines:
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        entry, _, reason = line.partition("  #")
+        # The WHOLE pre-comment text is the fingerprint: keys may carry
+        # internal spaces (nothing guarantees them space-free forever),
+        # and truncating would break the --update-baseline round-trip.
+        entry = entry.strip()
+        if len(entry.split()) >= 2:
+            out[entry] = reason.strip()
+    return out
+
+
+def baseline_count(path: str | None = None) -> int:
+    return len(load_baseline(path))
+
+
+def default_stamp_path(root: str) -> str:
+    return os.environ.get(STAMP_ENV) or os.path.join(root, STAMP_NAME)
+
+
+def write_stamp(
+    root: str, *, new: int, baselined: int, stale: int, version: str
+) -> str:
+    path = default_stamp_path(root)
+    doc = {
+        "ts": time.time(),
+        "analyzer_version": version,
+        "new_violations": new,
+        "baselined": baselined,
+        "stale_baseline_entries": stale,
+        "ok": new == 0 and stale == 0,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def stamp_info(root: str | None = None) -> dict | None:
+    """The last check's stamp, or None when never run. ``root`` defaults
+    to the checkout containing this package (doctor's case)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+    path = default_stamp_path(root)
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None

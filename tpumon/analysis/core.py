@@ -1,0 +1,261 @@
+"""Analyzer infrastructure: project model, annotations, rule registry.
+
+The analyzer parses every tracked source file ONCE into a
+:class:`SourceFile` (AST + per-line comment map) and hands the whole
+:class:`Project` to each rule. Rules are pure functions
+``(Project) -> list[Violation]`` registered in :data:`RULES`; fixture
+tests build synthetic projects with :meth:`Project.from_files`, so every
+rule is provable on a known-bad snippet without touching the repo.
+
+Violations carry a line number for humans but fingerprint WITHOUT it
+(``rule key``): the baseline file must survive aggressive refactoring,
+so keys are stable identities (knob name, family name,
+``Class.attr:method``) — never positions.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import tokenize
+from dataclasses import dataclass, field
+
+
+ANALYZER_VERSION = "1.0.0"
+
+#: Source trees the analyzer never parses (generated / vendored).
+_EXCLUDED_PARTS = ("_native/build",)
+_EXCLUDED_FILES = ("tpumon/attribution/podresources_pb2.py",)
+
+#: Non-python files the rules cross-check (text-scanned, never parsed as
+#: YAML — helm templates are not valid YAML).
+_TEXT_GLOBS = (
+    ("charts", (".yaml", ".yml", ".json")),
+    ("deploy", (".yaml", ".yml", ".json")),
+    ("dashboards", (".json",)),
+    ("docs", (".md",)),
+)
+_TEXT_FILES = ("README.md",)
+
+#: In-source suppression: ``# tpumon-invariants: disable=<rule>`` on the
+#: offending line (reason after an em dash or extra text encouraged).
+_DISABLE_MARK = "tpumon-invariants: disable="
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One invariant breach. ``key`` is the line-number-free identity the
+    baseline file matches on; ``fingerprint`` is what gets written."""
+
+    rule: str
+    key: str
+    path: str
+    line: int
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule} {self.key}"
+
+
+class SourceFile:
+    """One parsed python file: AST, comment map, and parent links."""
+
+    def __init__(self, path: str, text: str) -> None:
+        self.path = path
+        self.text = text
+        self.tree = ast.parse(text, filename=path)
+        #: line number -> comment text (without the leading ``#``).
+        self.comments: dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string.lstrip("#").strip()
+        except tokenize.TokenError:
+            pass
+        #: child AST node -> parent (ancestor walks for with/except scopes).
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+
+    def ancestors(self, node: ast.AST):
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def comment_near(self, line: int) -> str:
+        """The comment on ``line``, or on the line above (annotations may
+        not fit beside long statements)."""
+        return self.comments.get(line) or self.comments.get(line - 1) or ""
+
+    def disabled_rules(self, line: int) -> set[str]:
+        """Rules suppressed in-source at ``line``."""
+        out: set[str] = set()
+        for text in (self.comments.get(line, ""), self.comments.get(line - 1, "")):
+            if _DISABLE_MARK in text:
+                spec = text.split(_DISABLE_MARK, 1)[1]
+                out.add(spec.split()[0].rstrip(","))
+        return out
+
+
+@dataclass
+class Project:
+    """Everything the rules look at, loaded once."""
+
+    root: str
+    python: dict[str, SourceFile] = field(default_factory=dict)
+    texts: dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def from_files(cls, files: dict[str, str], root: str = "<memory>") -> "Project":
+        """Synthetic project for fixture tests: ``.py`` entries are
+        parsed, everything else lands in ``texts``."""
+        proj = cls(root=root)
+        for path, text in files.items():
+            if path.endswith(".py"):
+                proj.python[path] = SourceFile(path, text)
+            else:
+                proj.texts[path] = text
+        return proj
+
+    def py(self, path: str) -> SourceFile | None:
+        return self.python.get(path)
+
+    def text_items(self, prefix: str = "", suffix: str = ""):
+        for path, text in sorted(self.texts.items()):
+            if path.startswith(prefix) and path.endswith(suffix):
+                yield path, text
+
+
+def load_project(root: str) -> Project:
+    """Parse the repo at ``root`` (a checkout or an installed tree)."""
+    proj = Project(root=root)
+    pkg = os.path.join(root, "tpumon")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in sorted(dirnames) if d != "__pycache__"]
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, root).replace(os.sep, "/")
+            if rel in _EXCLUDED_FILES or any(p in rel for p in _EXCLUDED_PARTS):
+                continue
+            with open(full, encoding="utf-8") as fh:
+                proj.python[rel] = SourceFile(rel, fh.read())
+    for sub, suffixes in _TEXT_GLOBS:
+        base = os.path.join(root, sub)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames.sort()
+            for name in sorted(filenames):
+                if not name.endswith(suffixes):
+                    continue
+                full = os.path.join(dirpath, name)
+                rel = os.path.relpath(full, root).replace(os.sep, "/")
+                with open(full, encoding="utf-8") as fh:
+                    proj.texts[rel] = fh.read()
+    for name in _TEXT_FILES:
+        full = os.path.join(root, name)
+        if os.path.isfile(full):
+            with open(full, encoding="utf-8") as fh:
+                proj.texts[name] = fh.read()
+    return proj
+
+
+# -- rule registry ---------------------------------------------------------
+
+def all_rules() -> dict:
+    """name -> rule callable. Imported lazily so ``tpumon.analysis`` stays
+    importable (for /debug/vars' baseline count) without pulling every
+    rule module."""
+    from tpumon.analysis import deadlines, exceptions, families_rule, knobs, locks
+
+    return {
+        "knob-drift": knobs.check,
+        "family-drift": families_rule.check,
+        "lock-discipline": locks.check_discipline,
+        "lock-order": locks.check_order,
+        "deadline": deadlines.check,
+        "except-hygiene": exceptions.check,
+    }
+
+
+def run_rules(
+    project: Project, rules: list[str] | None = None
+) -> list[Violation]:
+    """Run the named rules (default: all) and apply in-source
+    ``# tpumon-invariants: disable=`` suppressions."""
+    registry = all_rules()
+    names = rules if rules else sorted(registry)
+    out: list[Violation] = []
+    for name in names:
+        if name not in registry:
+            raise KeyError(
+                f"unknown rule {name!r}; known: {', '.join(sorted(registry))}"
+            )
+        for v in registry[name](project):
+            src = project.py(v.path)
+            if src is not None and v.rule in src.disabled_rules(v.line):
+                continue
+            out.append(v)
+    out.sort(key=lambda v: (v.rule, v.path, v.line, v.key))
+    return out
+
+
+# -- shared AST helpers ----------------------------------------------------
+
+#: The poll/serving pipeline modules every path-scoped rule starts from.
+#: Rules extend this explicitly (deadline adds CLI/tools surfaces,
+#: except-hygiene adds the parser) so a new plane added here is picked
+#: up by ALL of them at once — the same drift class the analyzer hunts.
+PIPELINE_PREFIXES = (
+    "tpumon/exporter/",
+    "tpumon/backends/",
+    "tpumon/attribution/",
+    "tpumon/resilience/",
+    "tpumon/guard/",
+    "tpumon/trace/",
+    "tpumon/anomaly/",
+    "tpumon/history.py",
+)
+
+
+def iter_functions(tree: ast.Module):
+    """Every (possibly nested) function definition in the module."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+def str_const(node: ast.AST) -> str | None:
+    """The literal value when ``node`` is a string constant."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def call_name(node: ast.Call) -> str:
+    """Trailing name of the called object: ``a.b.c()`` -> ``c``."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def dotted(node: ast.AST) -> str:
+    """Best-effort dotted source form: ``self._lock``, ``os.environ``."""
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def has_kwarg(node: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in node.keywords)

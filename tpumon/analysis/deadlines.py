@@ -1,0 +1,179 @@
+"""Rule ``deadline``: blocking calls on serving/poll paths carry bounds.
+
+A DaemonSet exporter has two latency contracts — the 1 Hz poll budget
+and the scrape p99 — and one unbounded blocking call anywhere on either
+path converts a misbehaving peer into a wedged exporter. The rule flags,
+in the scoped modules:
+
+- ``<thread>.join()`` with no arguments — ``Thread.join`` blocks
+  forever (``str.join`` always takes an argument, so no-arg ``join`` is
+  reliably a thread);
+- ``<event>.wait()`` / ``<future>.result()`` / ``<queue>.get()`` with
+  no arguments — unbounded waits;
+- ``subprocess.run/call/check_call/check_output`` and
+  ``Popen.communicate/wait`` without ``timeout=``;
+- ``urllib.request.urlopen`` without ``timeout=``;
+- raw socket ops (``recv``/``recv_into``/``accept``/``connect``/
+  ``sendall``) in a function that never arms a deadline — no
+  ``settimeout``/``setdefaulttimeout`` call and no
+  ``create_connection(..., timeout=...)`` in the same function.
+
+A call that is *deliberately* unbounded (a lifecycle wait another
+thread is guaranteed to wake) declares why on its line:
+
+    stop.wait()  # deadline: woken by SIGTERM handler — lifecycle, not a request path
+
+Violation keys: ``<path>:<function>:<callee>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpumon.analysis.core import (
+    PIPELINE_PREFIXES,
+    Project,
+    Violation,
+    call_name,
+    dotted,
+    has_kwarg,
+    iter_functions,
+)
+
+RULE = "deadline"
+
+_DEADLINE_MARK = "deadline:"
+
+#: The shared pipeline scope plus the operator-facing surfaces whose
+#: hangs strand a human (CLI tools, discovery, smi). Workload/bench
+#: tooling is driver-side and excluded.
+SCOPE_PREFIXES = PIPELINE_PREFIXES + (
+    "tpumon/discovery/",
+    "tpumon/tools/",
+    "tpumon/smi.py",
+)
+
+_NOARG_BLOCKERS = {
+    "join": "Thread.join() without a timeout blocks forever",
+    "wait": "Event.wait() without a timeout blocks forever",
+    "result": "Future.result() without a timeout blocks forever",
+}
+_SUBPROCESS_FNS = {"run", "call", "check_call", "check_output", "communicate"}
+_SOCKET_OPS = {"recv", "recv_into", "accept", "connect", "sendall", "makefile"}
+_ARMING_CALLS = {"settimeout", "setdefaulttimeout", "create_connection"}
+
+
+def _annotated(src, line: int) -> bool:
+    return _DEADLINE_MARK in src.comment_near(line)
+
+
+def _fn_arms_deadline(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in ("settimeout", "setdefaulttimeout"):
+                # settimeout(None) DISABLES the timeout (the stdlib
+                # fully-blocking idiom) — that arms nothing. A variable
+                # argument is trusted (the _DeadlineReader pattern
+                # re-arms with a computed remaining budget).
+                if (
+                    node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and node.args[0].value is None
+                ):
+                    continue
+                return True
+            if name == "create_connection" and (
+                has_kwarg(node, "timeout") or len(node.args) >= 2
+            ):
+                return True
+    return False
+
+
+def _check_file(path: str, src, out: list[Violation]) -> None:
+    reported: set[str] = set()
+
+    def flag(fn_name: str, node: ast.Call, callee: str, why: str) -> None:
+        key = f"{path}:{fn_name}:{callee}"
+        if key in reported or _annotated(src, node.lineno):
+            return
+        reported.add(key)
+        out.append(
+            Violation(
+                RULE, key, path, node.lineno,
+                f"{why} (in {fn_name}); pass a timeout/deadline, or "
+                "annotate the line `# deadline: <why unbounded is "
+                "safe>`",
+            )
+        )
+
+    for fn in iter_functions(src.tree):
+        arms = None  # lazy: only computed when a socket op appears
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            # Calls belong to the innermost def (it gets its own visit).
+            owner = next(
+                (
+                    a for a in src.ancestors(node)
+                    if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+                ),
+                None,
+            )
+            if owner is not fn:
+                continue
+            name = call_name(node)
+            full = dotted(node.func)
+            if (
+                name in _NOARG_BLOCKERS
+                and isinstance(node.func, ast.Attribute)
+                and not node.args
+                and not node.keywords
+            ):
+                flag(fn.name, node, name, _NOARG_BLOCKERS[name])
+            elif name == "get" and isinstance(node.func, ast.Attribute):
+                # queue.get() with neither timeout nor block=False.
+                if (
+                    not node.args
+                    and not node.keywords
+                    and full.endswith("queue.get")
+                ):
+                    flag(
+                        fn.name, node, "queue.get",
+                        "Queue.get() without a timeout blocks forever",
+                    )
+            elif name in _SUBPROCESS_FNS and (
+                full.startswith("subprocess.")
+                or name in ("communicate",)
+            ):
+                if not has_kwarg(node, "timeout"):
+                    flag(
+                        fn.name, node, f"subprocess.{name}",
+                        f"{full or name}() without timeout= can hang "
+                        "on a stuck child",
+                    )
+            elif name == "urlopen":
+                if not has_kwarg(node, "timeout") and len(node.args) < 3:
+                    flag(
+                        fn.name, node, "urlopen",
+                        "urlopen() without timeout= hangs on a "
+                        "half-dead server",
+                    )
+            elif name in _SOCKET_OPS and isinstance(node.func, ast.Attribute):
+                if arms is None:
+                    arms = _fn_arms_deadline(fn)
+                if not arms:
+                    flag(
+                        fn.name, node, name,
+                        f"socket .{name}() in a function that never "
+                        "arms a deadline (no settimeout/"
+                        "create_connection(timeout=))",
+                    )
+
+
+def check(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+    for path, src in sorted(project.python.items()):
+        if path.startswith(SCOPE_PREFIXES):
+            _check_file(path, src, out)
+    return out

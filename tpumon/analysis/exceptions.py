@@ -1,0 +1,120 @@
+"""Rule ``except-hygiene``: broad handlers in the poll/serving pipeline
+must observe the failure — log it, count it, or re-raise.
+
+``except Exception: pass`` in a 1 Hz loop is how a permanently broken
+stage becomes invisible: the exporter keeps publishing, the family just
+quietly vanishes. The collector's contract (SURVEY §5.3) is "degrade to
+a dropped sample PLUS a counter increment"; this rule makes the *plus*
+mechanical.
+
+A handler is compliant when its body (transitively) contains any of:
+
+- a ``raise``;
+- a logging call (``log.*``/``logger.*``/``logging.*`` with a level
+  method name);
+- a counter/telemetry call (``.inc()``, ``.observe()``, ``.record()``,
+  ``.count_shed()``) — the stage-error funnel (bare ``.labels()`` /
+  ``.set()`` do NOT count: they move no counter a human can alert on);
+- an explicit ``# tpumon-invariants: disable=except-hygiene`` (core
+  suppression) on the ``except`` line.
+
+Only broad handlers are checked: ``except Exception``, ``except
+BaseException``, bare ``except``, and tuples containing them. Narrow
+handlers (``except (AttributeError, OSError)``) encode intent already.
+
+Violation keys: ``<path>:<function>:<line-of-handler-relative-id>`` —
+actually ``<path>:<function>:<exception-type>#<n>`` (n-th broad handler
+in that function) so line churn does not invalidate the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpumon.analysis.core import (
+    PIPELINE_PREFIXES,
+    Project,
+    Violation,
+    call_name,
+    dotted,
+    iter_functions,
+)
+
+RULE = "except-hygiene"
+
+#: The shared pipeline scope plus the parser (sample decoding is
+#: poll-pipeline work even though it lives at top level).
+SCOPE_PREFIXES = PIPELINE_PREFIXES + ("tpumon/parsing.py",)
+
+_LOG_METHODS = {
+    "debug", "info", "warning", "error", "exception", "critical", "log",
+}
+#: Calls that actually record the failure somewhere a human or alert can
+#: see it. Deliberately narrow: bare `.labels(...)` creates a series
+#: without moving it, and `.set()` on an Event is control flow — neither
+#: observes anything.
+_COUNT_METHODS = {"inc", "observe", "record", "count_shed"}
+_LOG_OBJECTS = {"log", "logger", "logging", "self"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [getattr(el, "id", "") for el in t.elts]
+    elif isinstance(t, ast.Name):
+        names = [t.id]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _observes(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            base = dotted(node.func).split(".", 1)[0]
+            if name in _LOG_METHODS and base in _LOG_OBJECTS:
+                return True
+            if name in _COUNT_METHODS and isinstance(node.func, ast.Attribute):
+                return True
+    return False
+
+
+def check(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+    for path, src in sorted(project.python.items()):
+        if not path.startswith(SCOPE_PREFIXES):
+            continue
+        for fn in iter_functions(src.tree):
+            broad_seen = 0
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                # Handlers belong to the innermost function: skip ones
+                # owned by a nested def (they get their own visit).
+                owner = None
+                for anc in src.ancestors(node):
+                    if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        owner = anc
+                        break
+                if owner is not fn or not _is_broad(node):
+                    continue
+                broad_seen += 1
+                if _observes(node):
+                    continue
+                kind = "bare" if node.type is None else "Exception"
+                out.append(
+                    Violation(
+                        RULE,
+                        f"{path}:{fn.name}:{kind}#{broad_seen}",
+                        path,
+                        node.lineno,
+                        f"broad `except {kind}` in {fn.name} swallows the "
+                        "failure silently: log it, count it "
+                        "(stage-error counter), or re-raise",
+                    )
+                )
+    return out

@@ -1,0 +1,243 @@
+"""Rule ``family-drift``: emitted ⊆ registered ⊆ documented, and every
+PromQL expression references only registered families.
+
+The registry (tpumon/families.py + schema.py + host.py + histograms.py)
+is extracted by AST — not imported — so the analyzer runs on a bare
+checkout and fixture tests can swap in synthetic registries.
+
+Checks (violation keys):
+
+- ``unregistered:<family>`` — a metric family constructed in code
+  (``*MetricFamily("name", ...)``, ``Counter/Gauge/Histogram("name",
+  ...)``) that the registry does not know. Counters are normalized to
+  their ``_total`` exposition name first (prometheus_client appends it).
+- ``undocumented:<family>`` — a registered family absent from
+  docs/METRICS.md (the generated reference drifted).
+- ``promql:<file>:<family>`` — a dashboard panel/annotation expr or a
+  Prometheus alert rule references a family-shaped metric name the
+  registry does not serve (family drift breaks dashboards silently —
+  the exact dcgm-exporter failure class).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+
+from tpumon.analysis.core import Project, Violation, call_name, str_const
+
+RULE = "family-drift"
+
+_FAMILY_CTORS = {
+    "GaugeMetricFamily",
+    "CounterMetricFamily",
+    "HistogramMetricFamily",
+    "SummaryMetricFamily",
+    "InfoMetricFamily",
+}
+_CLIENT_CTORS = {"Counter", "Gauge", "Histogram", "Summary", "Info"}
+_COUNTER_CTORS = {"CounterMetricFamily", "Counter"}
+
+#: Registry dict literals in tpumon/families.py and friends.
+_REGISTRY_DICTS = {
+    "IDENTITY_FAMILIES",
+    "HEALTH_FAMILIES",
+    "ANOMALY_FAMILIES",
+    "SELF_FAMILIES",
+    "WORKLOAD_FAMILIES",
+    "HOST_FAMILIES",
+}
+
+#: Family-shaped metric tokens in PromQL — the same prefix net as
+#: tests/test_dashboards.py (bare ``tpu_`` stays out: libtpu SOURCE
+#: metric names appear in prose).
+_METRIC_RE = re.compile(
+    r"\b(?:(?:accelerator|exporter|collector|workload|host|tpu_anomaly"
+    r"|tpumon_trace|tpumon_poll|tpumon_family|tpumon_breaker"
+    r"|tpumon_retries|tpumon_watchdog|tpumon_guard|tpumon_shed"
+    r"|tpumon_cardinality)_[a-z0-9_]+"
+    r"|tpumon_up|tpumon_degraded)\b"
+)
+
+_EXPR_LINE_RE = re.compile(r"^\s*(?:expr|query)\s*:\s*(.*)$")
+
+#: Modules whose metric constructions are checked against the registry.
+_EMIT_PREFIXES = (
+    "tpumon/exporter/",
+    "tpumon/anomaly/",
+    "tpumon/guard/",
+    "tpumon/resilience/",
+    "tpumon/attribution/",
+    "tpumon/discovery/",
+    "tpumon/workload/",
+)
+
+
+def _counter_name(name: str) -> str:
+    return name if name.endswith("_total") else name + "_total"
+
+
+def registered_families(project: Project) -> set[str]:
+    """Registry extraction: dict-literal keys, FamilySpec family args,
+    DISTRIBUTION_SOURCES family tuples."""
+    names: set[str] = set()
+    for path, src in project.python.items():
+        for node in ast.walk(src.tree):
+            targets: list[str] = []
+            if isinstance(node, ast.Assign):
+                targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            elif isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name
+            ):
+                targets = [node.target.id]
+            if targets and isinstance(node.value, ast.Dict):
+                if not any(
+                    t in _REGISTRY_DICTS or t == "DISTRIBUTION_SOURCES"
+                    for t in targets
+                ):
+                    continue
+                if any(t == "DISTRIBUTION_SOURCES" for t in targets):
+                    # source -> (family, help, label): take tuple[0].
+                    for value in node.value.values:
+                        if isinstance(value, ast.Tuple) and value.elts:
+                            fam = str_const(value.elts[0])
+                            if fam:
+                                names.add(fam)
+                    continue
+                for key in node.value.keys:
+                    lit = str_const(key)
+                    if lit:
+                        names.add(lit)
+            # FamilySpec("source", "family", ...) rows in schema.py.
+            if isinstance(node, ast.Call) and call_name(node) == "FamilySpec":
+                if len(node.args) >= 2:
+                    fam = str_const(node.args[1])
+                    if fam:
+                        names.add(fam)
+    return names
+
+
+def emitted_families(project: Project) -> dict[str, list[tuple[str, int]]]:
+    """family (exposition name) -> construction sites."""
+    out: dict[str, list[tuple[str, int]]] = {}
+    for path, src in sorted(project.python.items()):
+        if not path.startswith(_EMIT_PREFIXES):
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name not in (_FAMILY_CTORS | _CLIENT_CTORS) or not node.args:
+                continue
+            fam = str_const(node.args[0])
+            if not fam:
+                continue
+            if name in _COUNTER_CTORS:
+                fam = _counter_name(fam)
+            out.setdefault(fam, []).append((path, node.lineno))
+    return out
+
+
+def _with_histogram_suffixes(names: set[str]) -> set[str]:
+    """PromQL sees histogram families as _bucket/_sum/_count series."""
+    hist = {n for n in names if n.endswith(("_seconds", "_percent"))}
+    return names | {
+        n + suffix for n in hist for suffix in ("_bucket", "_sum", "_count")
+    }
+
+
+def _dashboard_exprs(text: str):
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        return
+    stack = [doc]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, dict):
+            for key, value in node.items():
+                if key == "expr" and isinstance(value, str):
+                    yield value
+                else:
+                    stack.append(value)
+        elif isinstance(node, list):
+            stack.extend(node)
+
+
+def _rule_exprs(text: str):
+    """``expr:`` lines from prometheus-rules YAML (helm-templated copies
+    are not valid YAML, so this is a line scan; multi-line ``|`` exprs
+    yield their continuation lines too)."""
+    lines = text.splitlines()
+    for i, line in enumerate(lines):
+        m = _EXPR_LINE_RE.match(line)
+        if not m:
+            continue
+        value = m.group(1).strip()
+        if value and not value.startswith(("|", ">")):
+            yield value
+            continue
+        indent = len(line) - len(line.lstrip())
+        for cont in lines[i + 1:]:
+            if cont.strip() and (len(cont) - len(cont.lstrip())) <= indent:
+                break
+            yield cont
+
+
+def check(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+    registered = registered_families(project)
+    if not registered:
+        return out
+    known = _with_histogram_suffixes(registered)
+
+    for fam, sites in sorted(emitted_families(project).items()):
+        if fam in registered:
+            continue
+        path, line = sites[0]
+        out.append(
+            Violation(
+                RULE, f"unregistered:{fam}", path, line,
+                f"{path} constructs metric family {fam!r} but it is not "
+                "registered in tpumon/families.py (or schema/host/"
+                "histogram registries) — docs, dashboards, and the "
+                "drift tests cannot see it",
+            )
+        )
+
+    metrics_doc = project.texts.get("docs/METRICS.md")
+    if metrics_doc is not None:
+        for fam in sorted(registered):
+            if fam not in metrics_doc:
+                out.append(
+                    Violation(
+                        RULE, f"undocumented:{fam}", "docs/METRICS.md", 0,
+                        f"registered family {fam} is missing from "
+                        "docs/METRICS.md (regenerate: python -m "
+                        "tpumon.tools.gen_metrics_doc)",
+                    )
+                )
+
+    promql: list[tuple[str, str]] = []
+    for path, text in project.text_items(suffix=".json"):
+        if "/dashboards/" in path or path.startswith("dashboards/"):
+            promql.extend((path, e) for e in _dashboard_exprs(text))
+    for path, text in project.texts.items():
+        if "rules" in path and path.endswith((".yaml", ".yml")):
+            promql.extend((path, e) for e in _rule_exprs(text))
+    flagged: set[tuple[str, str]] = set()
+    for path, expr in promql:
+        for ref in _METRIC_RE.findall(expr):
+            if ref in known or (path, ref) in flagged:
+                continue
+            flagged.add((path, ref))
+            out.append(
+                Violation(
+                    RULE, f"promql:{path}:{ref}", path, 0,
+                    f"{path} queries {ref!r} but no registered family "
+                    "serves it — the panel/alert would silently show "
+                    "nothing",
+                )
+            )
+    return out

@@ -1,0 +1,299 @@
+"""Rule ``knob-drift``: every TPUMON_* env knob exists everywhere it must.
+
+Knob discovery is AST-resolution, not grep, because the repo composes
+env names three ways a text search cannot see as knobs:
+
+- ``config.py`` reads ``_env("PORT")`` — the ``TPUMON_`` prefix lives in
+  ``ENV_PREFIX`` and is applied inside ``_env``;
+- ``health.py``/``detectors.py`` read
+  ``os.environ.get("TPUMON_HEALTH_" + f.name.upper())`` inside a loop
+  over ``dataclasses.fields(cls)`` — one PREFIX yields one knob per
+  dataclass field;
+- everything else reads literal ``os.environ.get("TPUMON_X")``.
+
+Checks (violation keys in parentheses):
+
+- ``undocumented:<knob>`` — knob not mentioned anywhere in docs/ or
+  README.md. Operators discover knobs from OPERATIONS.md's reference
+  table, not from the source.
+- ``chart-missing:<knob>`` — a Config-field knob (the curated operator
+  surface) not settable via the Helm chart's daemonset template or
+  values.yaml. Prefix-family knobs (TPUMON_HEALTH_*/TPUMON_ANOMALY_*)
+  are exempt: charts pass them through ``exporter.extraEnv``.
+- ``chart-unknown:<knob>`` / ``deploy-unknown:<knob>`` — an env name a
+  daemonset manifest sets that no code reads (the dcgm-exporter
+  field-metadata drift class: a renamed knob silently stops applying).
+- ``deploy-chart-drift:<knob>`` — a knob the kustomize daemonset pins
+  that the chart daemonset cannot set: the two install paths disagree
+  about the tunable surface.
+- ``config-unwired:<field>`` — a Config dataclass field never resolved
+  from the environment in ``from_env`` (a new field that silently
+  ignores its documented env var).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tpumon.analysis.core import (
+    Project,
+    Violation,
+    call_name,
+    dotted,
+    str_const,
+)
+
+RULE = "knob-drift"
+
+_CONFIG_PATH = "tpumon/config.py"
+_ENV_FNS = ("_env", "_env_int", "_env_float", "_env_bool")
+_ENV_NAME_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
+_MANIFEST_ENV_RE = re.compile(r"-\s+name:\s+(TPUMON_[A-Z0-9_]+)")
+
+#: Docs a knob may be documented in.
+_DOC_PATHS = (
+    "docs/OPERATIONS.md",
+    "docs/ARCHITECTURE.md",
+    "docs/METRICS.md",
+    "docs/MIGRATING.md",
+    "README.md",
+)
+
+
+def _env_prefix(src) -> str:
+    """Resolve ``ENV_PREFIX`` from the module's assignments."""
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "ENV_PREFIX":
+                    value = str_const(node.value)
+                    if value:
+                        return value
+    return "TPUMON_"
+
+
+def _dataclass_fields(tree: ast.Module) -> dict[str, list[str]]:
+    """class name -> ordered field names (AnnAssign targets)."""
+    out: dict[str, list[str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            fields = [
+                stmt.target.id
+                for stmt in node.body
+                if isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+            ]
+            out[node.name] = fields
+    return out
+
+
+def _fields_loop_class(node: ast.AST, src) -> str | None:
+    """When ``node`` sits inside ``for f in fields(X)`` (statement or
+    comprehension), return ``X``'s class name — ``cls``/``self`` resolve
+    to the enclosing class."""
+    def _fields_arg(it: ast.AST) -> str | None:
+        if isinstance(it, ast.Call) and call_name(it) == "fields" and it.args:
+            arg = it.args[0]
+            if isinstance(arg, ast.Name):
+                return arg.id
+        return None
+
+    chain = [node, *src.ancestors(node)]
+    for anc in chain:
+        target: str | None = None
+        if isinstance(anc, ast.For):
+            target = _fields_arg(anc.iter)
+        elif isinstance(anc, (ast.GeneratorExp, ast.ListComp, ast.SetComp)):
+            for comp in anc.generators:
+                target = target or _fields_arg(comp.iter)
+        if target is None:
+            continue
+        if target in ("cls", "self"):
+            for outer in src.ancestors(anc):
+                if isinstance(outer, ast.ClassDef):
+                    return outer.name
+            return None
+        return target
+    return None
+
+
+def discover_knobs(project: Project) -> dict[str, list[tuple[str, int]]]:
+    """knob -> [(path, line), ...] across every resolution style."""
+    knobs: dict[str, list[tuple[str, int]]] = {}
+
+    def add(name: str, path: str, line: int) -> None:
+        knobs.setdefault(name, []).append((path, line))
+
+    for path, src in sorted(project.python.items()):
+        prefix = _env_prefix(src) if path == _CONFIG_PATH else "TPUMON_"
+        classes = _dataclass_fields(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            # Style 1: config.py _env*("NAME") — prefix applied inside.
+            if path == _CONFIG_PATH and name in _ENV_FNS and node.args:
+                lit = str_const(node.args[0])
+                if lit and _ENV_NAME_RE.match(lit):
+                    add(prefix + lit, path, node.lineno)
+                continue
+            # Styles 2+3 ride os.environ.get / env.get / os.getenv.
+            if name not in ("get", "getenv"):
+                continue
+            base = dotted(node.func)
+            if base not in ("os.environ.get", "env.get", "os.getenv", "environ.get"):
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            lit = str_const(arg)
+            if lit and lit.startswith("TPUMON_"):
+                add(lit, path, node.lineno)
+                continue
+            # Style 2: "TPUMON_X_" + f.name.upper() inside fields(C) loop.
+            if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add):
+                left = str_const(arg.left)
+                if left and left.startswith("TPUMON_"):
+                    cls = _fields_loop_class(node, src)
+                    for fld in classes.get(cls or "", []):
+                        add(left + fld.upper(), path, node.lineno)
+    return knobs
+
+
+def _config_surface(project: Project) -> tuple[list[str], set[str]]:
+    """(Config field names in order, env names resolved in from_env)."""
+    src = project.py(_CONFIG_PATH)
+    if src is None:
+        return [], set()
+    fields: list[str] = []
+    wired: set[str] = set()
+    prefix = _env_prefix(src)
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            fields = [
+                stmt.target.id
+                for stmt in node.body
+                if isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+            ]
+            for fn in node.body:
+                if isinstance(fn, ast.FunctionDef) and fn.name == "from_env":
+                    for call in ast.walk(fn):
+                        if (
+                            isinstance(call, ast.Call)
+                            and call_name(call) in _ENV_FNS
+                            and call.args
+                        ):
+                            lit = str_const(call.args[0])
+                            if lit:
+                                wired.add(prefix + lit)
+    return fields, wired
+
+
+def _manifest_env(text: str) -> set[str]:
+    return set(_MANIFEST_ENV_RE.findall(text))
+
+
+def check(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+    knobs = discover_knobs(project)
+    fields, wired = _config_surface(project)
+    # AST-resolved, same as _config_surface: if ENV_PREFIX is ever
+    # renamed, both halves of the rule must move together.
+    cfg_src = project.py(_CONFIG_PATH)
+    prefix = _env_prefix(cfg_src) if cfg_src is not None else "TPUMON_"
+    field_knobs = {prefix + f.upper(): f for f in fields}
+
+    docs_blob = "\n".join(
+        project.texts.get(p, "") for p in _DOC_PATHS
+    )
+    chart_blob = "\n".join(
+        text for path, text in project.text_items(prefix="charts/")
+        if path.endswith((".yaml", ".yml"))
+    )
+    # Same suffix coverage as chart_blob above: an env entry in a .yml
+    # file must be visible to BOTH the presence and dead-name checks.
+    chart_env: set[str] = set()
+    deploy_env: set[str] = set()
+    for path, text in project.texts.items():
+        if not path.endswith((".yaml", ".yml")):
+            continue
+        if path.startswith("charts/"):
+            chart_env |= _manifest_env(text)
+        elif path.startswith("deploy/"):
+            deploy_env |= _manifest_env(text)
+
+    # A Config field implies an intended TPUMON_* knob even when (by
+    # bug) it is not wired in from_env — include those in the universe
+    # so the doc/chart checks still see them.
+    universe: dict[str, tuple[str, int]] = {
+        knob: sites[0] for knob, sites in knobs.items()
+    }
+    for knob in field_knobs:
+        universe.setdefault(knob, (_CONFIG_PATH, 0))
+
+    def present(knob: str, blob: str) -> bool:
+        # Word-boundary match: TPUMON_TRACE must not be satisfied by
+        # TPUMON_TRACE_RING (the prefix-knob blind spot of substring
+        # search is exactly the drift class this rule exists to catch).
+        return re.search(rf"\b{re.escape(knob)}\b", blob) is not None
+
+    for knob in sorted(universe):
+        path, line = universe[knob]
+        if docs_blob and not present(knob, docs_blob):
+            out.append(
+                Violation(
+                    RULE, f"undocumented:{knob}", path, line,
+                    f"{knob} is read by {path} but documented nowhere in "
+                    "docs/ or README.md (add it to the OPERATIONS.md "
+                    "configuration reference)",
+                )
+            )
+        if knob in field_knobs and chart_blob and not present(knob, chart_blob):
+            out.append(
+                Violation(
+                    RULE, f"chart-missing:{knob}", path, line,
+                    f"{knob} is a Config knob but the Helm chart cannot "
+                    "set it (add an env entry to "
+                    "charts/tpumon/templates/daemonset.yaml + values.yaml)",
+                )
+            )
+
+    # Dead env names: a manifest sets a knob no code reads.
+    for scope, env, manifest in (
+        ("chart", chart_env, "charts/tpumon/templates/daemonset.yaml"),
+        ("deploy", deploy_env, "deploy/daemonset.yaml"),
+    ):
+        for name in sorted(env - set(universe)):
+            out.append(
+                Violation(
+                    RULE, f"{scope}-unknown:{name}", manifest, 0,
+                    f"{manifest} sets {name} but no code reads it "
+                    "(renamed or removed knob — the setting silently "
+                    "stops applying)",
+                )
+            )
+
+    # Kustomize pins a knob the chart cannot set at all.
+    for name in sorted((deploy_env & set(universe)) - chart_env):
+        out.append(
+            Violation(
+                RULE, f"deploy-chart-drift:{name}", "deploy/daemonset.yaml", 0,
+                f"deploy/daemonset.yaml pins {name} but the chart "
+                "daemonset has no matching env entry — the two install "
+                "paths disagree on the tunable surface",
+            )
+        )
+
+    # Config fields that silently ignore their env var.
+    for knob, fld in sorted(field_knobs.items()):
+        if wired and knob not in wired:
+            out.append(
+                Violation(
+                    RULE, f"config-unwired:{fld}", _CONFIG_PATH, 0,
+                    f"Config.{fld} is never resolved from {knob} in "
+                    "Config.from_env — the documented env var is ignored",
+                )
+            )
+    return out

@@ -1,0 +1,247 @@
+"""Rules ``lock-discipline`` and ``lock-order``.
+
+``lock-discipline`` enforces an annotation convention (the prometheus
+client-library lock-bug class from PAPERS.md): a shared attribute is
+declared guarded by writing
+
+    self._ring = deque()  # guarded-by: self._lock
+
+on its ``__init__`` assignment. Every later load/store of that attribute
+inside the class must then sit lexically under ``with self._lock:`` (a
+comma list allows aliases — ``# guarded-by: self._lock, self._cond``
+for a Condition wrapping the same lock). Helper methods that are only
+ever called with the lock already held declare it:
+
+    def _trip(self) -> None:  # holds: self._lock
+
+``__init__`` itself is exempt (construction happens-before sharing).
+
+``lock-order`` builds the acquisition graph from syntactic nesting —
+``with self.a:`` containing ``with self.b:`` adds edge ``Class.a ->
+Class.b`` — across every analyzed module, and reports any cycle: two
+threads taking the same pair of locks in opposite orders is a deadlock
+that no test reliably reproduces.
+
+Violation keys: ``Class.attr:method`` (discipline),
+``cycle:<a>-><b>->...`` (order).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpumon.analysis.core import Project, Violation
+
+DISCIPLINE_RULE = "lock-discipline"
+ORDER_RULE = "lock-order"
+
+_GUARD_MARK = "guarded-by:"
+_HOLDS_MARK = "holds:"
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """``self.x`` -> ``x`` (only for direct self attributes)."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _parse_marked_names(comment: str, mark: str) -> set[str]:
+    """``# guarded-by: self._lock, self._cond`` -> {"_lock", "_cond"}."""
+    if mark not in comment:
+        return set()
+    spec = comment.split(mark, 1)[1]
+    # Allow trailing prose after an em dash or semicolon.
+    for stop in ("—", ";", " - "):
+        spec = spec.split(stop, 1)[0]
+    names = set()
+    for part in spec.split(","):
+        part = part.strip()
+        if part.startswith("self."):
+            names.add(part[len("self."):])
+        elif part:
+            names.add(part)
+    return names
+
+
+def _stmt_comment(src, node: ast.AST) -> str:
+    """Comments on the statement's own lines ONLY (no line-above
+    fallback: an annotation must not leak onto the next assignment)."""
+    end = getattr(node, "end_lineno", node.lineno) or node.lineno
+    return " ".join(
+        src.comments[ln]
+        for ln in range(node.lineno, end + 1)
+        if ln in src.comments
+    )
+
+
+def _guarded_attrs(cls: ast.ClassDef, src) -> dict[str, set[str]]:
+    """attr -> lock-name aliases, from annotated __init__ assignments."""
+    out: dict[str, set[str]] = {}
+    for fn in cls.body:
+        if not isinstance(fn, ast.FunctionDef) or fn.name != "__init__":
+            continue
+        for node in ast.walk(fn):
+            targets: list[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            else:
+                continue
+            locks = _parse_marked_names(_stmt_comment(src, node), _GUARD_MARK)
+            if not locks:
+                continue
+            for tgt in targets:
+                attr = _self_attr(tgt)
+                if attr:
+                    out[attr] = locks
+    return out
+
+
+def _with_lock_names(node: ast.With) -> set[str]:
+    """Lock attrs acquired by a ``with`` statement (``with self.a, self.b:``)."""
+    out = set()
+    for item in node.items:
+        attr = _self_attr(item.context_expr)
+        if attr:
+            out.add(attr)
+    return out
+
+
+def _held_locks(node: ast.AST, src, fn: ast.FunctionDef) -> set[str]:
+    """Locks lexically held at ``node`` within ``fn`` (with-statement
+    ancestors), plus locks ``fn`` declares via ``# holds:``."""
+    held = _parse_marked_names(src.comments.get(fn.lineno, ""), _HOLDS_MARK)
+    for anc in src.ancestors(node):
+        if isinstance(anc, ast.With):
+            held |= _with_lock_names(anc)
+        if anc is fn:
+            break
+    return held
+
+
+def _methods(cls: ast.ClassDef):
+    for fn in cls.body:
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield fn
+
+
+def check_discipline(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+    for path, src in sorted(project.python.items()):
+        for cls in ast.walk(src.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            guarded = _guarded_attrs(cls, src)
+            if not guarded:
+                continue
+            for fn in _methods(cls):
+                if fn.name == "__init__":
+                    continue  # happens-before: no concurrent readers yet
+                seen_attrs: set[str] = set()
+                for node in ast.walk(fn):
+                    attr = _self_attr(node)
+                    if attr is None or attr not in guarded or attr in seen_attrs:
+                        continue
+                    # The acquisition itself (`with self._lock:`) and
+                    # passing the lock object around are not data access.
+                    parent = src.parents.get(node)
+                    if isinstance(parent, ast.withitem):
+                        continue
+                    held = _held_locks(node, src, fn)
+                    if held & guarded[attr]:
+                        continue
+                    seen_attrs.add(attr)  # one report per (attr, method)
+                    locks = ", ".join(
+                        "self." + lk for lk in sorted(guarded[attr])
+                    )
+                    out.append(
+                        Violation(
+                            DISCIPLINE_RULE,
+                            f"{cls.name}.{attr}:{fn.name}",
+                            path,
+                            node.lineno,
+                            f"{cls.name}.{fn.name} touches self.{attr} "
+                            f"(guarded-by {locks}) outside the lock; "
+                            "wrap in `with`, or mark the method "
+                            f"`# holds: {locks}` if callers always hold it",
+                        )
+                    )
+    return out
+
+
+def _acquisition_edges(project: Project) -> dict[tuple[str, str], tuple[str, int]]:
+    """(outer, inner) -> first site, from nested ``with self.x`` blocks.
+    Lock identities are ``Class.attr`` so distinct classes' ``_lock``
+    attributes stay distinct nodes."""
+    edges: dict[tuple[str, str], tuple[str, int]] = {}
+    for path, src in sorted(project.python.items()):
+        for cls in ast.walk(src.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.With):
+                    continue
+                inner = _with_lock_names(node)
+                if not inner:
+                    continue
+                for anc in src.ancestors(node):
+                    if isinstance(anc, ast.ClassDef):
+                        break
+                    if not isinstance(anc, ast.With):
+                        continue
+                    for outer_name in _with_lock_names(anc):
+                        for inner_name in inner:
+                            if outer_name == inner_name:
+                                continue
+                            edge = (
+                                f"{cls.name}.{outer_name}",
+                                f"{cls.name}.{inner_name}",
+                            )
+                            edges.setdefault(edge, (path, node.lineno))
+    return edges
+
+
+def check_order(project: Project) -> list[Violation]:
+    edges = _acquisition_edges(project)
+    graph: dict[str, set[str]] = {}
+    for outer, inner in edges:
+        graph.setdefault(outer, set()).add(inner)
+
+    out: list[Violation] = []
+    seen_cycles: set[tuple[str, ...]] = set()
+
+    def dfs(node: str, stack: list[str], on_stack: set[str]) -> None:
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_stack:
+                cycle = stack[stack.index(nxt):] + [nxt]
+                # Canonical rotation so each cycle reports once.
+                ring = tuple(cycle[:-1])
+                lo = ring.index(min(ring))
+                canon = ring[lo:] + ring[:lo]
+                if canon in seen_cycles:
+                    continue
+                seen_cycles.add(canon)
+                path, line = edges[(node, nxt)]
+                # Space-free key: baseline fingerprints read cleanest as
+                # a single token (the human chain goes in the message).
+                chain = "->".join([*canon, canon[0]])
+                human = " -> ".join([*canon, canon[0]])
+                out.append(
+                    Violation(
+                        ORDER_RULE, f"cycle:{chain}", path, line,
+                        f"lock acquisition cycle {human}: two threads "
+                        "taking these locks in opposite orders deadlock",
+                    )
+                )
+                continue
+            dfs(nxt, stack + [nxt], on_stack | {nxt})
+
+    for start in sorted(graph):
+        dfs(start, [start], {start})
+    return out

@@ -118,17 +118,17 @@ class AnomalyEngine:
         self._detectors = detectors if detectors is not None else default_detectors()
         self._thresholds = thresholds
         self._lock = threading.Lock()
-        self._seq = 0
-        self._cycles = 0
+        self._seq = 0  # guarded-by: self._lock
+        self._cycles = 0  # guarded-by: self._lock
         #: (detector, signal) -> active Event
-        self._live: dict[tuple[str, str], Event] = {}
+        self._live: dict[tuple[str, str], Event] = {}  # guarded-by: self._lock
         #: device -> bounded ring of Events (active ones included)
-        self._rings: dict[str, deque] = {}
+        self._rings: dict[str, deque] = {}  # guarded-by: self._lock
         #: monotonic onset counts by (detector, severity)
-        self._totals: Counter = Counter()
+        self._totals: Counter = Counter()  # guarded-by: self._lock
         #: (detector, signal) -> consecutive cycles absent from readings
         #: (absence-clear debounce; see observe()).
-        self._absent: Counter = Counter()
+        self._absent: Counter = Counter()  # guarded-by: self._lock
 
     @property
     def detector_names(self) -> tuple[str, ...]:
@@ -339,9 +339,10 @@ class AnomalyEngine:
         with self._lock:
             total = sum(self._totals.values())
             n_active = len(self._live)
+            cycles = self._cycles
         return {
             "detectors": list(self.detector_names),
-            "cycles": self._cycles,
+            "cycles": cycles,
             "active": n_active,
             "total": total,
             "status": self.worst_severity(),

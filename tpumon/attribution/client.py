@@ -110,8 +110,8 @@ class PodResourcesClient:
         if self._channel is not None:
             try:
                 self._channel.close()
-            except Exception:
-                pass
+            except Exception as exc:
+                log.debug("pod-resources channel close failed: %s", exc)
             self._channel = None
             self._call = None
 

@@ -390,8 +390,8 @@ class _MetricWatch:
         if call is not None:
             try:
                 call.cancel()
-            except Exception:
-                pass
+            except Exception as exc:
+                log.debug("watch cancel failed (already dead?): %s", exc)
 
 
 class GrpcMonitoringBackend:
@@ -477,7 +477,8 @@ class GrpcMonitoringBackend:
             fut = self._grpc.channel_ready_future(self._channel)
             fut.result(timeout=self.timeout)
             return True
-        except Exception:
+        except Exception as exc:
+            log.debug("monitoring service unreachable: %s", exc)
             return False
 
     def services(self) -> list[str] | None:
@@ -665,8 +666,8 @@ class GrpcMonitoringBackend:
         if self._channel is not None:
             try:
                 self._channel.close()
-            except Exception:
-                pass
+            except Exception as exc:
+                log.debug("channel close failed during reset: %s", exc)
             self._channel = None
         if self._grpc is not None:
             try:
@@ -851,8 +852,8 @@ class GrpcMonitoringBackend:
         if self._channel is not None:
             try:
                 self._channel.close()
-            except Exception:
-                pass
+            except Exception as exc:
+                log.debug("channel close failed: %s", exc)
         if self._delegate is not None:
             self._delegate.close()
 

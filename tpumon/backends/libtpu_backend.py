@@ -129,7 +129,8 @@ class LibtpuBackend:
             import importlib.metadata as md
 
             return md.version("libtpu")
-        except Exception:
+        except Exception as exc:
+            log.debug("libtpu version lookup failed: %s", exc)
             return "unknown"
 
     def close(self) -> None:

@@ -97,7 +97,8 @@ class NvmlBackend:
         nv = self._nv
         try:
             reasons = nv.nvmlDeviceGetCurrentClocksThrottleReasons(handle)
-        except Exception:
+        except Exception as exc:
+            log.debug("throttle-reason query failed: %s", exc)
             return 0
         benign = getattr(nv, "nvmlClocksThrottleReasonGpuIdle", 0) | getattr(
             nv, "nvmlClocksThrottleReasonApplicationsClocksSetting", 0
@@ -112,13 +113,14 @@ class NvmlBackend:
             try:
                 raw = nv.nvmlDeviceGetUUID(h)
                 uuid = raw.decode() if isinstance(raw, bytes) else str(raw)
-            except Exception:
-                pass
+            except Exception as exc:
+                log.debug("UUID query failed for device %d: %s", i, exc)
             chips.append(Chip(index=i, num_cores=1, device_id=uuid))
         try:
             raw_name = nv.nvmlDeviceGetName(self._handles[0]) if chips else "gpu"
             accel = raw_name.decode() if isinstance(raw_name, bytes) else str(raw_name)
-        except Exception:
+        except Exception as exc:
+            log.debug("device-name query failed: %s", exc)
             accel = "gpu"
         return Topology(
             accelerator_type=accel,
@@ -130,11 +132,12 @@ class NvmlBackend:
         try:
             raw = self._nv.nvmlSystemGetDriverVersion()
             return raw.decode() if isinstance(raw, bytes) else str(raw)
-        except Exception:
+        except Exception as exc:
+            log.debug("driver-version query failed: %s", exc)
             return "unknown"
 
     def close(self) -> None:
         try:
             self._nv.nvmlShutdown()
-        except Exception:
-            pass
+        except Exception as exc:
+            log.debug("nvmlShutdown failed: %s", exc)

@@ -265,6 +265,12 @@ def run(cfg: Config, out=sys.stdout, backend=None) -> int:
         else:
             p("anomaly detection: disabled (TPUMON_ANOMALY=0)")
 
+        # Invariant analyzer (tpumon/analysis, docs/INVARIANTS.md): the
+        # last `python -m tpumon.tools.check` verdict + its age, so an
+        # operator can see whether the checkout's cross-file discipline
+        # (knobs/families/locks/deadlines) was ever proven, and when.
+        p(_invariants_line())
+
         from tpumon.attribution import PodResourcesClient
 
         # Runtime monitoring gRPC endpoint: reachability + (when the
@@ -308,6 +314,42 @@ def run(cfg: Config, out=sys.stdout, backend=None) -> int:
     finally:
         if owned:
             backend.close()
+
+
+def _invariants_line(now: float | None = None) -> str:
+    """One doctor line from the analyzer stamp (never gates the exit
+    code — discipline status is advisory here, enforced in CI)."""
+    import time as _time
+
+    from tpumon.analysis import ANALYZER_VERSION, baseline_count, stamp_info
+
+    stamp = stamp_info()
+    baselined = baseline_count()
+    if stamp is None:
+        return (
+            f"invariants: not checked (analyzer {ANALYZER_VERSION}, "
+            f"{baselined} baselined) — run python -m tpumon.tools.check"
+        )
+    age = max(0.0, (now if now is not None else _time.time()) - stamp.get("ts", 0.0))
+    if age < 120:
+        age_s = f"{age:.0f}s ago"
+    elif age < 7200:
+        age_s = f"{age / 60:.0f}m ago"
+    else:
+        age_s = f"{age / 3600:.1f}h ago"
+    verdict = "ok" if stamp.get("ok") else (
+        f"{stamp.get('new_violations', '?')} NEW violations"
+        + (
+            f", {stamp['stale_baseline_entries']} stale baseline entries"
+            if stamp.get("stale_baseline_entries")
+            else ""
+        )
+    )
+    return (
+        f"invariants: {verdict} ({stamp.get('baselined', baselined)} "
+        f"baselined; checked {age_s}, analyzer "
+        f"{stamp.get('analyzer_version', ANALYZER_VERSION)})"
+    )
 
 
 def main(argv: list[str] | None = None) -> int:

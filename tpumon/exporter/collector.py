@@ -72,9 +72,9 @@ class SampleCache:
         # it), so a page can never tear from the version it's labeled with.
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._snapshot: tuple[Metric, ...] = ()
-        self._rendered: bytes = b""
-        self._version = 0
+        self._snapshot: tuple[Metric, ...] = ()  # guarded-by: self._lock, self._cond
+        self._rendered: bytes = b""  # guarded-by: self._lock, self._cond
+        self._version = 0  # guarded-by: self._lock, self._cond
 
     def publish(self, families: list[Metric]) -> None:
         from tpumon._native import render_families
@@ -626,7 +626,8 @@ class Poller:
         if rc_fn is not None:
             try:
                 counts = rc_fn()
-            except Exception:
+            except Exception as exc:
+                log.debug("backend retry_counts() failed: %s", exc)
                 counts = {}
             for call, n in counts.items():
                 delta = n - self._retry_seen.get(call, 0)

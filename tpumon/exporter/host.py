@@ -61,7 +61,8 @@ def host_families(base_keys: tuple[str, ...], base_vals: tuple[str, ...]):
     """Build the host gauge/counter families; [] when psutil is missing."""
     try:
         import psutil
-    except Exception:  # pragma: no cover - psutil is installed here
+    except Exception as exc:  # pragma: no cover - psutil is installed here
+        log.debug("host metrics disabled: psutil unavailable (%s)", exc)
         return []
 
     out = []

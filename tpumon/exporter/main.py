@@ -79,7 +79,7 @@ def main(argv: list[str] | None = None) -> int:
 
     exporter.start()
     try:
-        stop.wait()
+        stop.wait()  # deadline: woken by the SIGTERM/SIGINT handler — lifecycle wait, not a request path
     finally:
         exporter.close()
     return 0

@@ -709,7 +709,7 @@ class _SelfTelemetryPage:
         self._registry = registry
         self._lock = threading.Lock()
         self._render_lock = threading.Lock()
-        self._bytes = exposition.generate_latest(registry)
+        self._bytes = exposition.generate_latest(registry)  # guarded-by: self._lock
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -735,7 +735,7 @@ class _SelfTelemetryPage:
 
     def _run(self) -> None:
         while True:
-            self._wake.wait()
+            self._wake.wait()  # deadline: close() sets _wake after _stop — guaranteed wakeup
             if self._stop.is_set():
                 return
             self._wake.clear()
@@ -790,6 +790,30 @@ class ExporterServer:
         if self._started:
             self._httpd.shutdown()
         self._httpd.server_close()
+
+
+_invariants_cache: dict | None = None
+
+
+def _invariants_vars() -> dict:
+    """The /debug/vars "invariants" block: analyzer version + baseline
+    size (static per process) plus the last check stamp when one exists
+    on this filesystem (a checkout; container images usually ship none)."""
+    global _invariants_cache
+    if _invariants_cache is None:
+        from tpumon.analysis import ANALYZER_VERSION, baseline_count
+
+        _invariants_cache = {
+            "analyzer_version": ANALYZER_VERSION,
+            "baseline_violations": baseline_count(),
+        }
+    doc = dict(_invariants_cache)
+    from tpumon.analysis import stamp_info
+
+    stamp = stamp_info()
+    if stamp is not None:
+        doc["last_check"] = stamp
+    return doc
 
 
 class Exporter:
@@ -1145,6 +1169,11 @@ class Exporter:
             }
         if self.anomaly is not None:
             doc["anomaly"] = self.anomaly.summary()
+        # Invariant-analyzer status (tpumon/analysis): operators can see
+        # from the running exporter whether the shipped checkout's
+        # cross-file discipline was proven, and against how many accepted
+        # baseline entries. O(1): the baseline is read once and cached.
+        doc["invariants"] = _invariants_vars()
         return doc
 
     def _device_health(self) -> dict:

@@ -28,6 +28,7 @@ nonsense.
 from __future__ import annotations
 
 import logging
+from typing import Callable
 
 log = logging.getLogger(__name__)
 
@@ -43,7 +44,11 @@ class CardinalityGovernor:
     (``govern`` becomes a no-op).
     """
 
-    def __init__(self, max_series: int, observe_drop=None) -> None:
+    def __init__(
+        self,
+        max_series: int,
+        observe_drop: Callable[[str, int], None] | None = None,
+    ) -> None:
         self.max_series = int(max_series)
         self._observe_drop = observe_drop
         #: family -> cumulative collapsed-sample count.
@@ -95,7 +100,8 @@ class CardinalityGovernor:
                 try:
                     self._observe_drop(fam.name, len(overflow))
                 except Exception:
-                    pass  # a metrics hiccup must never fail the cycle
+                    # A metrics hiccup must never fail the cycle.
+                    log.debug("cardinality drop observer failed", exc_info=True)
         return collapsed
 
     def snapshot(self) -> dict:

@@ -27,8 +27,12 @@ endpoint class, far off the poll loop's thread.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
+from typing import Callable
+
+log = logging.getLogger(__name__)
 
 #: Endpoint classes with independent caps/buckets. The health probes are
 #: deliberately unlisted: kubelet liveness must keep answering while
@@ -58,8 +62,8 @@ class TokenBucket:
         self.rate = float(rate)
         self.burst = max(1.0, float(burst))
         self._clock = clock
-        self._tokens = self.burst
-        self._last = clock()
+        self._tokens = self.burst  # guarded-by: self._lock
+        self._last = clock()  # guarded-by: self._lock
         self._lock = threading.Lock()
 
     def allow(self) -> bool:
@@ -83,7 +87,7 @@ class _EndpointPolicy:
     def __init__(self, max_inflight: int, rps: float, clock) -> None:
         self.max_inflight = int(max_inflight)
         self.bucket = TokenBucket(rps, burst=2.0 * rps, clock=clock)
-        self.inflight = 0
+        self.inflight = 0  # guarded-by: self.lock
         self.lock = threading.Lock()
 
     def admit(self) -> str | None:
@@ -126,9 +130,9 @@ class IngressGuard:
         idle_timeout_s: float = 65.0,
         write_timeout_s: float = 10.0,
         watch_per_client: int = 4,
-        memory_state=None,
-        observe_shed=None,
-        clock=time.monotonic,
+        memory_state: Callable[[], int] | None = None,
+        observe_shed: Callable[[str, str], None] | None = None,
+        clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self.header_timeout_s = max(0.0, float(header_timeout_s))
         self.idle_timeout_s = max(0.0, float(idle_timeout_s))
@@ -142,7 +146,7 @@ class IngressGuard:
         }
         self._shed_lock = threading.Lock()
         #: (endpoint, reason) -> count, for /debug/vars and tests.
-        self.shed_counts: dict[tuple[str, str], int] = {}
+        self.shed_counts: dict[tuple[str, str], int] = {}  # guarded-by: self._shed_lock
 
     # -- classification ----------------------------------------------------
 
@@ -170,7 +174,8 @@ class IngressGuard:
             try:
                 self._observe_shed(endpoint, reason)
             except Exception:
-                pass  # a metrics hiccup must never fail the shed path
+                # A metrics hiccup must never fail the shed path.
+                log.debug("shed observer failed", exc_info=True)
 
     def memory_state(self) -> int:
         if self._memory_state is None:
@@ -178,6 +183,8 @@ class IngressGuard:
         try:
             return int(self._memory_state())
         except Exception:
+            # Failing open (no shed) beats shedding on a broken probe.
+            log.debug("memory-state probe failed", exc_info=True)
             return 0
 
     def snapshot(self) -> dict:

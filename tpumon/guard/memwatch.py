@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import logging
 import os
+from typing import Callable
 
 log = logging.getLogger(__name__)
 
@@ -137,7 +138,7 @@ class MemoryWatch:
         self.last_rss = 0.0
         self.max_rss = 0.0
         self.transitions = 0
-        self._hooks: list[tuple] = []  # (degrade, restore)
+        self._hooks: list[tuple[Callable[[], None], Callable[[], None]]] = []  # (degrade, restore)
 
     @property
     def armed(self) -> bool:
@@ -145,7 +146,9 @@ class MemoryWatch:
             self.soft_bytes > 0 or self.hard_bytes > 0
         )
 
-    def add_hooks(self, degrade, restore) -> None:
+    def add_hooks(
+        self, degrade: Callable[[], None], restore: Callable[[], None]
+    ) -> None:
         self._hooks.append((degrade, restore))
 
     def _fire(self, index: int, label: str) -> None:

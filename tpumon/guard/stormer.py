@@ -82,17 +82,27 @@ def scrape_storm(
     for t in pool:
         t.start()
     for t in pool:
-        t.join()
-    lat_ms.sort()
+        # Workers are deadline-bounded (duration_s + per-request timeout);
+        # the join bound only guards against a wedged worker thread.
+        t.join(timeout=duration_s + 30.0)
+    # A worker that outlived the join bound may still be appending:
+    # aggregate a lock-held snapshot, never the live containers.
+    stragglers = sum(1 for t in pool if t.is_alive())
+    with lock:
+        lat = sorted(lat_ms)
+        status_snap = dict(statuses)
+        error_count = errors
+        missing = missing_retry_after
     return {
         "path": path,
         "threads": threads,
-        "requests": sum(statuses.values()),
-        "statuses": {str(k): v for k, v in sorted(statuses.items())},
-        "errors": errors,
-        "missing_retry_after": missing_retry_after,
-        "p50_ms": round(lat_ms[len(lat_ms) // 2], 3) if lat_ms else None,
-        "max_ms": round(lat_ms[-1], 3) if lat_ms else None,
+        "requests": sum(status_snap.values()),
+        "statuses": {str(k): v for k, v in sorted(status_snap.items())},
+        "errors": error_count,
+        "missing_retry_after": missing,
+        "stragglers": stragglers,
+        "p50_ms": round(lat[len(lat) // 2], 3) if lat else None,
+        "max_ms": round(lat[-1], 3) if lat else None,
     }
 
 
@@ -154,7 +164,7 @@ def slowloris(
     for t in pool:
         t.start()
     for t in pool:
-        t.join()
+        t.join(timeout=duration_s + 30.0)
     return {"conns": conns, "evicted": evicted, "held_open": held_open}
 
 
@@ -248,7 +258,7 @@ def watch_hammer(
     for t in pool:
         t.start()
     for t in pool:
-        t.join()
+        t.join(timeout=duration_s + timeout + 30.0)
     return {
         "streams": streams,
         "admitted": admitted,
@@ -287,6 +297,7 @@ class Stormer:
             def run() -> None:
                 try:
                     out = fn(*args, **kwargs)
+                # tpumon-invariants: disable=except-hygiene (the failure IS the evidence: it lands in the storm report as {"error": ...})
                 except Exception as exc:  # evidence, not a crash
                     out = {"error": repr(exc)}
                 with lock:
@@ -319,7 +330,19 @@ class Stormer:
         for t in jobs:
             t.start()
         for t in jobs:
-            t.join()
+            # Every probe is duration-bounded; the join bound keeps a
+            # wedged probe thread from hanging the whole storm report.
+            t.join(timeout=duration_s + 60.0)
+        for t in jobs:
+            if t.is_alive():
+                # The report contract is "every probe key present,
+                # possibly as an error record" — a wedged probe must
+                # say so, not vanish into a consumer KeyError.
+                key = t.name.removeprefix("storm-")
+                with lock:
+                    results.setdefault(
+                        key, {"error": "probe thread timed out"}
+                    )
         return results
 
 

@@ -61,8 +61,8 @@ class PyEngine:
             raise ValueError("max_age and max_samples must be > 0")
         self._max_age = max_age
         self._max_samples = max_samples
-        self._series: dict[str, deque] = {}
-        self._record_calls = 0
+        self._series: dict[str, deque] = {}  # guarded-by: self._lock
+        self._record_calls = 0  # guarded-by: self._lock
         self._lock = threading.Lock()
 
     def record_batch(self, ts: float, items) -> None:

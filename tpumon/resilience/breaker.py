@@ -44,12 +44,12 @@ class CircuitBreaker:
         self.probes = max(1, int(probes))
         self._clock = clock
         self._lock = threading.Lock()
-        self._state = CLOSED
-        self._consecutive_failures = 0
-        self._probe_successes = 0
-        self._opened_at = 0.0
+        self._state = CLOSED  # guarded-by: self._lock
+        self._consecutive_failures = 0  # guarded-by: self._lock
+        self._probe_successes = 0  # guarded-by: self._lock
+        self._opened_at = 0.0  # guarded-by: self._lock
         #: Monotonic transition counter (observability, never reset).
-        self.opens = 0
+        self.opens = 0  # guarded-by: self._lock
 
     @property
     def state(self) -> str:
@@ -95,7 +95,7 @@ class CircuitBreaker:
             ):
                 self._trip()
 
-    def _trip(self) -> None:
+    def _trip(self) -> None:  # holds: self._lock
         self._state = OPEN
         self._opened_at = self._clock()
         self._consecutive_failures = 0
@@ -118,7 +118,7 @@ class BreakerRegistry:
         self._probes = probes
         self._clock = clock
         self._lock = threading.Lock()
-        self._breakers: dict[str, CircuitBreaker] = {}
+        self._breakers: dict[str, CircuitBreaker] = {}  # guarded-by: self._lock
 
     def get(self, key: str) -> CircuitBreaker:
         with self._lock:

@@ -49,8 +49,8 @@ class PollResilience:
         self._clock = clock
         self._lock = threading.Lock()
         #: metric name -> (family object, family name, stored-at ts)
-        self._last_good: dict[str, tuple[object, str, float]] = {}
-        self._supported: tuple[tuple[str, ...], float] | None = None
+        self._last_good: dict[str, tuple[object, str, float]] = {}  # guarded-by: self._lock
+        self._supported: tuple[tuple[str, ...], float] | None = None  # guarded-by: self._lock
 
     # -- last-good families -----------------------------------------------
 

@@ -40,10 +40,10 @@ class RetryPolicy:
 
     def delay(self, retry_index: int, rng: random.Random | None = None) -> float:
         """Delay before the ``retry_index``-th retry (0-based), jittered."""
-        r = rng if rng is not None else random
+        roll = rng.random() if rng is not None else random.random()
         capped = min(self.base_s * (2.0 ** retry_index), self.max_s)
         lo = max(0.0, 1.0 - self.jitter)
-        return capped * (lo + (1.0 + self.jitter - lo) * r.random())
+        return capped * (lo + (1.0 + self.jitter - lo) * roll)
 
     def delay_bounds(self, retry_index: int) -> tuple[float, float]:
         """[lo, hi] envelope of :meth:`delay` — the testable contract."""
@@ -131,7 +131,7 @@ class Backoff:
         self.base_s = base_s
         self.max_s = max_s
         self.jitter = jitter
-        self._rng = rng
+        self._rng: random.Random | None = rng
         self.failures = 0
 
     def next_delay(self) -> float:
@@ -140,9 +140,9 @@ class Backoff:
         # years-long outage must keep backing off, not start storming.
         capped = min(self.base_s * (2.0 ** min(self.failures, 32)), self.max_s)
         self.failures += 1
-        r = self._rng if self._rng is not None else random
+        roll = self._rng.random() if self._rng is not None else random.random()
         lo = max(0.0, 1.0 - self.jitter)
-        return capped * (lo + (1.0 + self.jitter - lo) * r.random())
+        return capped * (lo + (1.0 + self.jitter - lo) * roll)
 
     def reset(self) -> None:
         self.failures = 0

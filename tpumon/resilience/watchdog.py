@@ -20,6 +20,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from typing import Callable
 
 log = logging.getLogger(__name__)
 
@@ -28,8 +29,8 @@ class PollWatchdog:
     def __init__(
         self,
         hang_budget_s: float,
-        on_hang,
-        clock=time.monotonic,
+        on_hang: Callable[[], None],
+        clock: Callable[[], float] = time.monotonic,
     ) -> None:
         if hang_budget_s <= 0:
             raise ValueError(f"hang budget must be > 0, got {hang_budget_s}")
@@ -37,8 +38,8 @@ class PollWatchdog:
         self._on_hang = on_hang
         self._clock = clock
         self._lock = threading.Lock()
-        self._cycle_started: float | None = None
-        self._fired_for: float | None = None
+        self._cycle_started: float | None = None  # guarded-by: self._lock
+        self._fired_for: float | None = None  # guarded-by: self._lock
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="tpumon-watchdog", daemon=True

@@ -737,6 +737,7 @@ def main(argv: list[str] | None = None, out=None) -> int:
         # an exception outside fetch_workload's curated catches re-raises
         # here with its real traceback instead of dying in the thread.
         from concurrent.futures import ThreadPoolExecutor
+        from concurrent.futures import TimeoutError as FutureTimeout
 
         wl_future = None
         pool = None
@@ -746,7 +747,20 @@ def main(argv: list[str] | None = None, out=None) -> int:
         try:
             snap = _chip_snapshot()
             if wl_future is not None:
-                snap["workload"] = wl_future.result()
+                # fetch_workload bounds its own socket I/O (args.timeout);
+                # the result bound guards the thread itself wedging. Its
+                # TimeoutError is NOT in fetch_errors (py3.10: not an
+                # OSError), so degrade here — a wedged workload fetch
+                # must not take the chip table (or a --watch loop) down.
+                try:
+                    snap["workload"] = wl_future.result(
+                        timeout=args.timeout + 30.0
+                    )
+                except FutureTimeout:
+                    snap["workload"] = {
+                        "url": args.workload,
+                        "error": "workload fetch timed out",
+                    }
         finally:
             if pool is not None:
                 pool.shutdown(wait=False)

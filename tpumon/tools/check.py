@@ -1,0 +1,166 @@
+"""Invariant-checker CLI: ``python -m tpumon.tools.check [--strict]``.
+
+Runs the AST-driven invariant analyzer (tpumon/analysis, rule catalog in
+docs/INVARIANTS.md) over a checkout and reports violations against the
+checked-in baseline (tpumon/analysis/baseline.txt):
+
+- exit 0 — no new violations (baselined ones are summarized);
+- exit 1 — new violations, or (``--strict``) stale baseline entries
+  that no longer match anything and must be deleted.
+
+``--update-baseline`` rewrites the baseline from the current findings
+(preserving reasons for fingerprints that survive); use it once when
+adopting a rule, then burn entries down. A stamp
+(``.tpumon-invariants.json``) records the verdict for ``tpumon doctor``
+and ``/debug/vars``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tpumon.analysis import (
+    ANALYZER_VERSION,
+    load_baseline,
+    load_project,
+    run_rules,
+)
+from tpumon.analysis.baseline import baseline_path, write_stamp
+from tpumon.analysis.core import all_rules
+
+
+def _default_root() -> str:
+    """The checkout containing this package."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(here)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpumon.tools.check",
+        description="AST-driven invariant analyzer (docs/INVARIANTS.md)",
+    )
+    parser.add_argument(
+        "--root", default=_default_root(),
+        help="repo root to analyze (default: this checkout)",
+    )
+    parser.add_argument(
+        "--rule", action="append", dest="rules", metavar="NAME",
+        help=f"run only this rule (repeatable); known: "
+        f"{', '.join(sorted(all_rules()))}",
+    )
+    parser.add_argument(
+        "--strict", action="store_true",
+        help="also fail on stale baseline entries (the CI gate)",
+    )
+    parser.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline from current findings",
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help="baseline file (default: tpumon/analysis/baseline.txt "
+        "under --root)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable report on stdout",
+    )
+    parser.add_argument(
+        "--no-stamp", action="store_true",
+        help="do not write the .tpumon-invariants.json stamp",
+    )
+    args = parser.parse_args(argv)
+    if args.update_baseline and args.rules:
+        # A partial run must never rewrite the whole baseline: every
+        # other rule's accepted entries (and their curated reasons)
+        # would silently vanish.
+        parser.error("--update-baseline cannot be combined with --rule")
+
+    root = os.path.abspath(args.root)
+    project = load_project(root)
+    violations = run_rules(project, args.rules)
+
+    bl_path = args.baseline or baseline_path(root)
+    baseline = load_baseline(bl_path)
+    current = {v.fingerprint for v in violations}
+    new = [v for v in violations if v.fingerprint not in baseline]
+    suppressed = [v for v in violations if v.fingerprint in baseline]
+    # Stale entries only assessable when every rule ran.
+    stale = (
+        sorted(set(baseline) - current) if not args.rules else []
+    )
+
+    if args.update_baseline:
+        lines = [
+            "# tpumon invariant baseline — accepted violations, one per",
+            "# line: `<rule> <key>  # <reason>`. Entries that stop",
+            "# matching are STALE and fail --strict: delete them.",
+            "# Regenerate: python -m tpumon.tools.check --update-baseline",
+            "",
+        ]
+        for v in violations:
+            reason = baseline.get(v.fingerprint, "TODO: justify or fix")
+            lines.append(f"{v.fingerprint}  # {reason}")
+        with open(bl_path, "w", encoding="utf-8") as fh:
+            fh.write("\n".join(lines) + "\n")
+        print(f"baseline rewritten: {bl_path} ({len(violations)} entries)")
+        return 0
+
+    if args.as_json:
+        print(
+            json.dumps(
+                {
+                    "analyzer_version": ANALYZER_VERSION,
+                    "new": [v.__dict__ for v in new],
+                    "baselined": [v.fingerprint for v in suppressed],
+                    "stale": stale,
+                },
+                indent=2,
+                sort_keys=True,
+            )
+        )
+    else:
+        for v in new:
+            loc = f"{v.path}:{v.line}" if v.line else v.path
+            print(f"{v.rule}: {loc}: {v.message}")
+            print(f"    fingerprint: {v.fingerprint}")
+        for fp in stale:
+            print(
+                f"stale-baseline: {fp!r} no longer matches anything — "
+                f"delete it from {os.path.relpath(bl_path, root)}"
+            )
+        verdict = "OK" if not new else "FAIL"
+        if stale and args.strict:
+            verdict = "FAIL"
+        print(
+            f"invariants {verdict}: {len(new)} new, "
+            f"{len(suppressed)} baselined, {len(stale)} stale "
+            f"(analyzer {ANALYZER_VERSION}, "
+            f"{len(project.python)} py / {len(project.texts)} text files)"
+        )
+
+    if not args.no_stamp and not args.rules:
+        try:
+            write_stamp(
+                root,
+                new=len(new),
+                baselined=len(suppressed),
+                stale=len(stale),
+                version=ANALYZER_VERSION,
+            )
+        except OSError as exc:
+            print(f"warning: could not write stamp: {exc}", file=sys.stderr)
+
+    if new:
+        return 1
+    if stale and args.strict:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,11 +17,14 @@ render it to JSON outside the lock.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
+
+log = logging.getLogger(__name__)
 
 #: Budget above which a cycle is promoted to the slow ring (ms).
 DEFAULT_SLOW_CYCLE_MS = 250.0
@@ -127,13 +130,13 @@ class Tracer:
         self.slow_cycle_ms = float(slow_cycle_ms)
         self._observe = observe
         self._lock = threading.Lock()
-        self._ring: deque[CycleTrace] = deque(maxlen=max(1, int(ring)))
-        self._slow: deque[CycleTrace] = deque(maxlen=max(1, int(slow_ring)))
-        self._seq = 0
-        self._cycles = 0
+        self._ring: deque[CycleTrace] = deque(maxlen=max(1, int(ring)))  # guarded-by: self._lock
+        self._slow: deque[CycleTrace] = deque(maxlen=max(1, int(slow_ring)))  # guarded-by: self._lock
+        self._seq = 0  # guarded-by: self._lock
+        self._cycles = 0  # guarded-by: self._lock
         #: Memory-watermark degradation (tpumon/guard/memwatch): rings
         #: quartered, slow-cycle capture suspended. Reversible.
-        self._degraded = False
+        self._degraded = False  # guarded-by: self._lock
         self._full_caps = (self._ring.maxlen, self._slow.maxlen)
 
     # -- recording (poll thread) ------------------------------------------
@@ -237,7 +240,8 @@ class Tracer:
                 try:
                     self._observe(bucket, sp.duration)
                 except Exception:
-                    pass  # a metrics hiccup must never fail the stage
+                    # A metrics hiccup must never fail the stage.
+                    log.debug("stage observer failed", exc_info=True)
 
     # -- query (HTTP threads) ---------------------------------------------
 

@@ -1,0 +1,548 @@
+"""Fleet aggregation tier (tpumon/fleet): fan-in, rollups, lifecycle.
+
+Integration tests drive N in-process fake exporters through a real
+aggregator shard — merge correctness, slice/pool rollup math, a node
+dying mid-run (stale-flagged rollups, then eviction), shard-assignment
+determinism, Watch fan-in, and guard shedding on the aggregator's own
+/metrics.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpumon.fleet.config import FleetConfig
+from tpumon.fleet.ingest import node_snapshot_from_text, parse_target
+from tpumon.fleet.rollup import classify, fleet_families, jsonable, rollup
+from tpumon.fleet.shard import owned_targets, shard_of
+
+
+def _get(url: str, timeout: float = 10.0) -> tuple[int, str]:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode()
+
+
+def _wait_for(predicate, timeout: float = 10.0, step: float = 0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(step)
+    raise AssertionError("condition not met within timeout")
+
+
+# -- config ----------------------------------------------------------------
+
+
+def test_fleet_config_from_env(monkeypatch):
+    monkeypatch.setenv("TPUMON_FLEET_PORT", "9600")
+    monkeypatch.setenv("TPUMON_FLEET_SHARD_COUNT", "4")
+    monkeypatch.setenv("TPUMON_FLEET_INTERVAL", "2.5")
+    monkeypatch.setenv("TPUMON_FLEET_GUARD", "0")
+    monkeypatch.setenv("TPUMON_FLEET_TARGETS", "a:9400, b:9400")
+    cfg = FleetConfig.from_env()
+    assert cfg.port == 9600
+    assert cfg.shard_count == 4
+    assert cfg.interval == 2.5
+    assert cfg.guard is False
+    assert cfg.target_list() == ["a:9400", "b:9400"]
+
+
+def test_fleet_config_malformed_env_keeps_default(monkeypatch):
+    monkeypatch.setenv("TPUMON_FLEET_PORT", "lots")
+    monkeypatch.setenv("TPUMON_FLEET_STALE_S", "NaNish")
+    cfg = FleetConfig.from_env()
+    assert cfg.port == FleetConfig.port
+    # float("NaNish") raises, so the default must survive.
+    assert cfg.stale_s == FleetConfig.stale_s
+
+
+def test_fleet_config_targets_file(tmp_path):
+    listing = tmp_path / "targets"
+    listing.write_text("# fleet\nnode-a:9400\n\nnode-b:9400\nnode-a:9400\n")
+    cfg = FleetConfig(targets="node-c:9400", targets_file=str(listing))
+    assert cfg.target_list() == ["node-c:9400", "node-a:9400", "node-b:9400"]
+
+
+def test_parse_target_forms():
+    assert parse_target("node:9400") == ("http://node:9400", None)
+    assert parse_target("http://node:9400/") == ("http://node:9400", None)
+    assert parse_target("node:9400", default_grpc_port=9401) == (
+        "http://node:9400", "node:9401",
+    )
+    url, grpc_addr = parse_target("http://node:9400|grpc=node:19401")
+    assert (url, grpc_addr) == ("http://node:9400", "node:19401")
+
+
+# -- shard assignment ------------------------------------------------------
+
+
+def test_shard_assignment_deterministic_and_complete():
+    targets = [f"http://node-{i}:9400" for i in range(64)]
+    count = 4
+    owned = [owned_targets(targets, i, count) for i in range(count)]
+    # Every target owned exactly once; repeat runs identical.
+    assert sorted(sum(owned, [])) == sorted(targets)
+    assert owned == [owned_targets(targets, i, count) for i in range(count)]
+    # No pathological skew (rendezvous over 64 targets / 4 shards).
+    sizes = [len(o) for o in owned]
+    assert min(sizes) >= 4, sizes
+
+
+def test_shard_growth_moves_only_new_shard_targets():
+    """The rendezvous property: going N -> N+1 shards moves ONLY the
+    targets the new shard wins — nobody else reconnects."""
+    targets = [f"http://node-{i}:9400" for i in range(100)]
+    before = {t: shard_of(t, 4) for t in targets}
+    after = {t: shard_of(t, 5) for t in targets}
+    moved = {t for t in targets if before[t] != after[t]}
+    assert all(after[t] == 4 for t in moved), "a move not to the new shard"
+    assert 0 < len(moved) < 50  # ~1/5 expected, far under half
+
+
+def test_single_shard_owns_everything():
+    targets = ["a", "b", "c"]
+    assert owned_targets(targets, 0, 1) == targets
+    assert shard_of("a", 1) == 0
+
+
+# -- rollup math -----------------------------------------------------------
+
+
+def _node(slice_name, pool, chips, *, state="up", ici=(4, 4), mfu=None,
+          degraded=False, host="h"):
+    """Synthetic ingest entry: `chips` is [(duty, used, total), ...]."""
+    snap = {
+        "identity": {"slice": slice_name, "accelerator": pool, "host": host},
+        "chips": {
+            str(i): {"duty_pct": duty, "hbm_used": used, "hbm_total": total}
+            for i, (duty, used, total) in enumerate(chips)
+        },
+        "ici": {"healthy": ici[0], "total": ici[1]},
+    }
+    if mfu is not None:
+        snap["mfu"] = mfu
+    if degraded:
+        snap["degraded"] = {"active": True}
+    return {"snap": snap, "state": state}
+
+
+def test_rollup_slice_math():
+    doc = rollup(
+        [
+            _node("s1", "v5p", [(10.0, 10.0, 100.0), (30.0, 40.0, 100.0)]),
+            _node("s1", "v5p", [(50.0, 50.0, 100.0)], mfu=0.4),
+        ]
+    )
+    s1 = doc["slices"][("v5p", "s1")]
+    assert s1["hosts"] == {"up": 2, "stale": 0, "dark": 0}
+    assert s1["chips"] == 3
+    assert s1["duty"]["mean"] == pytest.approx(30.0)
+    assert s1["duty"]["min"] == 10.0 and s1["duty"]["max"] == 50.0
+    assert s1["hbm_used"] == 100.0 and s1["hbm_total"] == 300.0
+    assert s1["hbm_headroom_ratio"] == pytest.approx(2.0 / 3.0)
+    assert s1["ici"] == {"healthy": 8, "links": 8, "score": 1.0}
+    assert s1["mfu"] == pytest.approx(0.4)
+    assert s1["stale"] is False
+
+
+def test_rollup_pool_and_fleet_levels():
+    doc = rollup(
+        [
+            _node("s1", "v5p", [(20.0, 1.0, 2.0)]),
+            _node("s2", "v5p", [(40.0, 1.0, 2.0)], ici=(3, 4)),
+            _node("e1", "v5e", [(60.0, 1.0, 2.0)], degraded=True),
+        ]
+    )
+    assert set(doc["slices"]) == {("v5p", "s1"), ("v5p", "s2"), ("v5e", "e1")}
+    v5p = doc["pools"]["v5p"]
+    assert v5p["chips"] == 2
+    assert v5p["duty"]["mean"] == pytest.approx(30.0)
+    assert v5p["ici"]["score"] == pytest.approx(7.0 / 8.0)
+    fleet = doc["fleet"]
+    assert fleet["chips"] == 3
+    assert fleet["slices"] == 3 and fleet["pools"] == 2
+    assert fleet["degraded_hosts"] == 1
+    assert doc["pools"]["v5e"]["degraded_hosts"] == 1
+
+
+def test_rollup_stale_included_dark_excluded():
+    doc = rollup(
+        [
+            _node("s1", "v5p", [(10.0, 1.0, 2.0)]),
+            _node("s1", "v5p", [(90.0, 1.0, 2.0)], state="stale"),
+            _node("s1", "v5p", [(50.0, 1.0, 2.0)], state="dark"),
+        ]
+    )
+    s1 = doc["slices"][("v5p", "s1")]
+    # Stale data still rolls up (flagged); dark data is evicted.
+    assert s1["chips"] == 2
+    assert s1["duty"]["mean"] == pytest.approx(50.0)
+    assert s1["hosts"] == {"up": 1, "stale": 1, "dark": 1}
+    assert s1["stale"] is True
+
+
+def test_rollup_never_fetched_dark_node_buckets_unknown():
+    doc = rollup([{"snap": None, "state": "dark"}])
+    assert doc["slices"][("unknown", "?")]["hosts"]["dark"] == 1
+    assert doc["fleet"]["chips"] == 0
+
+
+def test_classify_thresholds():
+    assert classify(0.0, 5.0, 60.0) == "up"
+    assert classify(5.0, 5.0, 60.0) == "up"
+    assert classify(5.1, 5.0, 60.0) == "stale"
+    assert classify(61.0, 5.0, 60.0) == "dark"
+    assert classify(float("inf"), 5.0, 60.0) == "dark"
+
+
+def test_fleet_families_rows_and_registry_agreement():
+    """Every family the rollup builder emits is registered (the
+    family-drift net's runtime half), and the scope rows are complete."""
+    from tpumon.families import FLEET_FAMILIES
+
+    doc = rollup(
+        [
+            _node("s1", "v5p", [(20.0, 1.0, 2.0)], mfu=0.3),
+            _node("e1", "v5e", [(60.0, 1.0, 2.0)], state="stale"),
+        ]
+    )
+    fams = {f.name: f for f in fleet_families(doc)}
+    for name, fam in fams.items():
+        assert name in FLEET_FAMILIES, name
+        _, _, labels = FLEET_FAMILIES[name]
+        for s in fam.samples:
+            assert set(s.labels) == set(labels), (name, s.labels)
+    hosts = fams["tpu_fleet_hosts"]
+    scopes = {s.labels["scope"] for s in hosts.samples}
+    assert scopes == {"slice", "pool", "fleet"}
+    stale = {
+        (s.labels["scope"], s.labels["pool"], s.labels["slice"]): s.value
+        for s in fams["tpu_fleet_stale_rollup"].samples
+    }
+    assert stale[("slice", "v5e", "e1")] == 1.0
+    assert stale[("slice", "v5p", "s1")] == 0.0
+    assert stale[("fleet", "", "")] == 1.0
+
+
+def test_jsonable_flattens_tuple_keys():
+    doc = jsonable(rollup([_node("s1", "v5p", [(20.0, 1.0, 2.0)])]))
+    assert doc["slices"][0]["pool"] == "v5p"
+    assert doc["slices"][0]["slice"] == "s1"
+    json.dumps(doc)  # must be serializable as-is
+
+
+def test_node_snapshot_parses_mfu():
+    text = (
+        "# HELP workload_mfu_ratio x\n# TYPE workload_mfu_ratio gauge\n"
+        "workload_mfu_ratio 0.42\n"
+    )
+    assert node_snapshot_from_text(text)["mfu"] == pytest.approx(0.42)
+
+
+def test_fast_parser_matches_full_parser_on_real_page():
+    """The targeted line parser (tpumon/fleet/ingest.py) must agree
+    with the full prometheus parser (tpumon.smi) on every field the
+    rollup and the fleet renderers consume — pinned on a REAL exporter
+    page so schema drift breaks this test, not production rollups."""
+    from tpumon import smi
+    from tpumon._native import _python_render
+    from tpumon.backends.fake import FakeTpuBackend
+    from tpumon.config import Config
+    from tpumon.exporter.collector import build_families
+
+    families, _ = build_families(FakeTpuBackend.preset("v5e-16"), Config())
+    text = _python_render(tuple(families)).decode()
+    fast = node_snapshot_from_text(text)
+    full = smi.snapshot_from_text(text)
+    assert fast["identity"] == full["identity"]
+    assert fast["device_count"] == full["device_count"]
+    assert fast["coverage"] == full["coverage"]
+    assert fast["chips"] == full["chips"]
+    assert fast["cores"] == full["cores"]
+    assert fast["ici"] == full["ici"]
+    assert fast.get("queues") == full.get("queues")
+
+
+# -- integration: real exporters through a real aggregator -----------------
+
+
+def _exporter(preset="v4-8", interval=0.2, **overrides):
+    from tpumon.backends.fake import FakeTpuBackend
+    from tpumon.config import Config
+    from tpumon.exporter.server import build_exporter
+
+    cfg = Config(
+        port=0, addr="127.0.0.1", interval=interval, history_window=0,
+        anomaly=False, trace=False, host_metrics=False, histograms=False,
+        guard=False, pod_attribution=False, **overrides,
+    )
+    exp = build_exporter(cfg, FakeTpuBackend.preset(preset))
+    exp.start()
+    return exp
+
+
+@pytest.fixture
+def small_fleet():
+    """Three live fake exporters (two pools) + teardown."""
+    exps = [_exporter("v4-8"), _exporter("v4-8"), _exporter("v5e-16")]
+    try:
+        yield exps
+    finally:
+        for exp in exps:
+            exp.close()
+
+
+def _aggregator(targets, **cfg_overrides):
+    from tpumon.fleet.server import build_aggregator
+
+    defaults = dict(
+        port=0, addr="127.0.0.1", interval=0.2, stale_s=1.0, evict_s=3.0,
+        timeout=2.0,
+    )
+    defaults.update(cfg_overrides)
+    agg = build_aggregator(
+        FleetConfig(targets=",".join(targets), **defaults)
+    )
+    agg.start()
+    return agg
+
+
+def _fleet_doc(agg) -> dict:
+    status, body = _get(agg.url + "/fleet")
+    assert status == 200
+    return json.loads(body)
+
+
+def test_aggregator_merges_fleet(small_fleet):
+    agg = _aggregator([e.server.url for e in small_fleet])
+    try:
+        doc = _wait_for(
+            lambda: (
+                d := _fleet_doc(agg)
+            )["fleet"].get("hosts", {}).get("up") == 3 and d
+        )
+        assert doc["fleet"]["chips"] == 12  # 4 + 4 + 4
+        assert {s["pool"] for s in doc["slices"]} == {"v4-8", "v5litepod-16"}
+        assert doc["shard"] == {"index": 0, "count": 1, "targets": 3}
+
+        status, page = _get(agg.url + "/metrics")
+        assert status == 200
+        # Pre-aggregated families present; per-node series NOT re-exported.
+        assert 'tpu_fleet_hosts{pool="",scope="fleet",slice="",state="up"} 3.0' in page
+        assert "tpu_fleet_ici_health_score" in page
+        assert "accelerator_duty_cycle_percent" not in page
+        assert "accelerator_info" not in page
+        # Aggregator self-telemetry rides the same page.
+        assert "tpu_fleet_collect_duration_seconds" in page
+        assert "tpu_fleet_up 1.0" in page
+
+        status, healthz = _get(agg.url + "/healthz")
+        assert status == 200 and healthz == "ok\n"
+    finally:
+        agg.close()
+
+
+def test_aggregator_node_death_stale_then_evicted(small_fleet):
+    agg = _aggregator(
+        [e.server.url for e in small_fleet], stale_s=0.6, evict_s=2.0
+    )
+    try:
+        _wait_for(lambda: _fleet_doc(agg)["fleet"]["hosts"]["up"] == 3)
+        victim = small_fleet[0]
+        victim.close()
+
+        # Stale window: the dead node's last-good data still rolls up,
+        # flagged — chips stay, stale host counted, slice stale-marked.
+        doc = _wait_for(
+            lambda: (d := _fleet_doc(agg))["fleet"]["hosts"]["stale"] == 1
+            and d,
+            timeout=5.0,
+        )
+        assert doc["fleet"]["hosts"]["up"] == 2
+        assert doc["fleet"]["chips"] == 12
+        assert doc["fleet"]["stale"] is True
+        victim_slice = next(
+            s for s in doc["slices"]
+            if s["hosts"]["stale"] == 1
+        )
+        assert victim_slice["stale"] is True
+        status, page = _get(agg.url + "/metrics")
+        assert 'state="stale"} 1.0' in page
+        assert 'tpu_fleet_stale_rollup{pool="",scope="fleet",slice=""} 1.0' in page
+
+        # Eviction: past evict_s the node is dark and its chips leave
+        # the rollup — but the host stays counted.
+        doc = _wait_for(
+            lambda: (d := _fleet_doc(agg))["fleet"]["hosts"]["dark"] == 1
+            and d,
+            timeout=6.0,
+        )
+        assert doc["fleet"]["chips"] == 8
+        assert doc["fleet"]["hosts"]["up"] == 2
+        dark_node = next(n for n in doc["nodes"] if n["state"] == "dark")
+        assert dark_node["url"] == victim.server.url
+    finally:
+        agg.close()
+
+
+def test_aggregator_watch_fanin(small_fleet):
+    """gRPC Watch fan-in: a target with a |grpc= override streams pushes
+    instead of polling."""
+    pytest.importorskip("grpc")
+    exp = _exporter("v4-8", grpc_serve_port=0)
+    try:
+        assert exp.grpc_server is not None
+        target = f"{exp.server.url}|grpc=127.0.0.1:{exp.grpc_server.port}"
+        agg = _aggregator([target], interval=0.3)
+        try:
+            _wait_for(
+                lambda: agg.feeds[0].watch_state_now() == "streaming",
+                timeout=8.0,
+            )
+            doc = _wait_for(
+                lambda: (d := _fleet_doc(agg))["fleet"]["hosts"].get("up") == 1
+                and d
+            )
+            assert doc["fleet"]["chips"] == 4
+            status, page = _get(agg.url + "/metrics")
+            assert 'tpu_fleet_watch_streams{state="streaming"} 1.0' in page
+            assert 'mode="watch"' in page  # fetch counter saw pushes
+        finally:
+            agg.close()
+    finally:
+        exp.close()
+
+
+def test_aggregator_sharding_splits_targets(small_fleet):
+    """Two shards over the same target list: disjoint ownership,
+    union = fleet, both deterministic."""
+    urls = [e.server.url for e in small_fleet]
+    shards = [
+        _aggregator(urls, shard_index=i, shard_count=2) for i in range(2)
+    ]
+    try:
+        owned = [set(s.targets) for s in shards]
+        assert owned[0] | owned[1] == set(urls)
+        assert not (owned[0] & owned[1])
+        total = 0
+        for shard in shards:
+            if not shard.targets:
+                continue
+            doc = _wait_for(
+                lambda s=shard: (
+                    d := _fleet_doc(s)
+                )["fleet"]["hosts"].get("up") == len(s.targets) and d
+            )
+            total += doc["fleet"]["hosts"]["up"]
+        assert total == 3
+    finally:
+        for shard in shards:
+            shard.close()
+
+
+def test_aggregator_guard_sheds_metrics_storm(small_fleet):
+    """Admission control on the aggregator's own ingress: past the
+    concurrency/rate budget, /metrics answers 503 + Retry-After with
+    the shed counted — the guard plane applied to the tier itself."""
+    from tpumon.fleet.server import build_aggregator
+
+    agg = build_aggregator(
+        FleetConfig(
+            targets=small_fleet[0].server.url, port=0, addr="127.0.0.1",
+            interval=0.2,
+        ),
+        # One request per ~100 s with burst 1: the second immediate
+        # request must shed deterministically.
+        ingress_overrides={"metrics_rps": 0.01},
+    )
+    agg.start()
+    try:
+        codes = []
+        for _ in range(3):
+            try:
+                with urllib.request.urlopen(
+                    agg.url + "/metrics", timeout=5
+                ) as resp:
+                    codes.append(resp.status)
+            except urllib.error.HTTPError as err:
+                codes.append(err.code)
+                assert err.headers.get("Retry-After") == "1"
+        assert codes[0] == 200
+        assert 503 in codes
+        assert agg.guard.shed_counts.get(("metrics", "rate"), 0) >= 1
+        # The shed rides the aggregator's own shed-counter family.
+        assert (
+            agg.telemetry.shed.labels(endpoint="metrics", reason="rate")
+            ._value.get() >= 1
+        )
+    finally:
+        agg.close()
+
+
+def test_aggregator_debug_surfaces(small_fleet):
+    """/debug/vars + /debug/traces + /history: the tier is as
+    observable as the exporters it watches."""
+    agg = _aggregator([e.server.url for e in small_fleet])
+    try:
+        _wait_for(lambda: _fleet_doc(agg)["fleet"]["hosts"].get("up") == 3)
+        status, body = _get(agg.url + "/debug/vars")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["shard"]["targets"] == 3
+        assert doc["cycles"] >= 1
+        assert len(doc["nodes"]) == 3
+        assert all("snap" not in n for n in doc["nodes"])
+
+        status, body = _get(agg.url + "/debug/traces")
+        assert status == 200
+        traces = json.loads(body)["traces"]
+        assert traces, "collect cycles must be traced"
+        stages = {s["name"] for t in traces for s in t["spans"]}
+        assert {"ingest_schedule", "rollup", "publish"} <= stages
+
+        status, body = _get(agg.url + "/history")
+        assert status == 200
+        series = json.loads(body)["series"]
+        assert any(k.startswith("tpu_fleet_duty_cycle_percent") for k in series)
+    finally:
+        agg.close()
+
+
+def test_smi_renders_from_aggregator(small_fleet):
+    from tpumon import smi
+
+    agg = _aggregator([e.server.url for e in small_fleet])
+    try:
+        _wait_for(lambda: _fleet_doc(agg)["fleet"]["hosts"].get("up") == 3)
+        out = io.StringIO()
+        assert smi.main(["--aggregator", agg.url], out=out) == 0
+        text = out.getvalue()
+        assert "fleet: 3/3 hosts up" in text
+        assert "aggregator " + agg.url in text
+        assert "slice fake-v4-8 [v4-8]:" in text
+    finally:
+        agg.close()
+
+
+def test_empty_shard_serves_empty_rollup():
+    """No targets: the aggregator still serves /metrics and /fleet
+    (a shard waiting for its ConfigMap must be scrape-healthy)."""
+    agg = _aggregator([])
+    try:
+        status, page = _get(agg.url + "/metrics")
+        assert status == 200
+        assert "tpu_fleet_shard_targets 0.0" in page
+        assert _fleet_doc(agg)["nodes"] == []
+    finally:
+        agg.close()

@@ -220,6 +220,7 @@ PIPELINE_PREFIXES = (
     "tpumon/guard/",
     "tpumon/trace/",
     "tpumon/anomaly/",
+    "tpumon/fleet/",
     "tpumon/history.py",
 )
 

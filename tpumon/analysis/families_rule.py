@@ -45,6 +45,7 @@ _REGISTRY_DICTS = {
     "HEALTH_FAMILIES",
     "ANOMALY_FAMILIES",
     "SELF_FAMILIES",
+    "FLEET_FAMILIES",
     "WORKLOAD_FAMILIES",
     "HOST_FAMILIES",
 }
@@ -54,7 +55,7 @@ _REGISTRY_DICTS = {
 #: metric names appear in prose).
 _METRIC_RE = re.compile(
     r"\b(?:(?:accelerator|exporter|collector|workload|host|tpu_anomaly"
-    r"|tpumon_trace|tpumon_poll|tpumon_family|tpumon_breaker"
+    r"|tpu_fleet|tpumon_trace|tpumon_poll|tpumon_family|tpumon_breaker"
     r"|tpumon_retries|tpumon_watchdog|tpumon_guard|tpumon_shed"
     r"|tpumon_cardinality)_[a-z0-9_]+"
     r"|tpumon_up|tpumon_degraded)\b"
@@ -70,6 +71,7 @@ _EMIT_PREFIXES = (
     "tpumon/resilience/",
     "tpumon/attribution/",
     "tpumon/discovery/",
+    "tpumon/fleet/",
     "tpumon/workload/",
 )
 

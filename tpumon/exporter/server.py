@@ -175,19 +175,33 @@ class _Handler(WSGIRequestHandler):
     #: DaemonSet runs at a 250m limit) the kernel must prefer the 1 Hz
     #: poll thread over scrape serving, or a scrape storm converts into
     #: missed poll beats. Raising nice needs no privileges; one syscall
-    #: per connection thread.
+    #: per connection thread. Overridable per server (`serve_niceness`
+    #: on the server object): the fleet aggregator inverts the priority
+    #: — ITS headline is scrape latency, and its collect/ingest work is
+    #: the elastic side, so it serves at nice 0 and demotes ingest.
     SERVE_NICENESS = 10
 
     def setup(self) -> None:
         super().setup()
         self._reader = _DeadlineReader(self.connection)
-        if getattr(self.server, "ingress_guard", None) is not None:
+        niceness = getattr(self.server, "serve_niceness", None)
+        if niceness is None:
+            # Default: demote only when guarded (the standalone
+            # exporter); the sidecar's unguarded server stays at 0. An
+            # EXPLICIT serve_niceness applies regardless of guard —
+            # guard presence is an admission-control choice, not a
+            # scheduling one.
+            niceness = (
+                self.SERVE_NICENESS
+                if getattr(self.server, "ingress_guard", None) is not None
+                else 0
+            )
+        if niceness:
             try:
                 import os
 
                 os.setpriority(
-                    os.PRIO_PROCESS, threading.get_native_id(),
-                    self.SERVE_NICENESS,
+                    os.PRIO_PROCESS, threading.get_native_id(), niceness
                 )
             except (AttributeError, OSError):
                 pass  # non-Linux or denied: serving just stays at nice 0
@@ -760,11 +774,16 @@ class ExporterServer:
     IngressGuard) arms the handler's request deadlines; None leaves the
     server unguarded (the sidecar)."""
 
-    def __init__(self, app, addr: str, port: int, guard=None) -> None:
+    def __init__(
+        self, app, addr: str, port: int, guard=None,
+        serve_niceness: int | None = None,
+    ) -> None:
         self._httpd = make_server(
             addr, port, app, server_class=_ThreadingWSGIServer, handler_class=_Handler
         )
         self._httpd.ingress_guard = guard
+        if serve_niceness is not None:
+            self._httpd.serve_niceness = serve_niceness
         self.addr = addr
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(

@@ -81,6 +81,114 @@ ANOMALY_FAMILIES: dict[str, tuple[str, tuple[str, ...]]] = {
     ),
 }
 
+#: family -> (prometheus type, description, extra labels) — the fleet
+#: aggregation tier (tpumon/fleet): pre-aggregated recording-rule-style
+#: rollups served by the aggregator's /metrics, plus the aggregator's
+#: own self-telemetry. Rollup families carry ``scope`` ∈
+#: slice/pool/fleet with ``pool``/``slice`` identity labels (empty at
+#: the wider scopes); per-node series are never re-exported.
+FLEET_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
+    "tpu_fleet_hosts": (
+        "gauge",
+        "Exporter hosts known to the aggregator shard by ingest state: "
+        "up (fresh), stale (rolled up from flagged last-good data), "
+        "dark (evicted from rollups, still counted)",
+        ("scope", "pool", "slice", "state"),
+    ),
+    "tpu_fleet_chips": (
+        "gauge",
+        "Accelerator chips contributing to the scope's rollup (dark "
+        "hosts excluded)",
+        ("scope", "pool", "slice"),
+    ),
+    "tpu_fleet_duty_cycle_percent": (
+        "gauge",
+        "Chip duty-cycle rollup across the scope (stat ∈ mean/min/max)",
+        ("scope", "pool", "slice", "stat"),
+    ),
+    "tpu_fleet_hbm_used_bytes": (
+        "gauge",
+        "Summed HBM bytes in use across the scope",
+        ("scope", "pool", "slice"),
+    ),
+    "tpu_fleet_hbm_total_bytes": (
+        "gauge",
+        "Summed HBM capacity bytes across the scope",
+        ("scope", "pool", "slice"),
+    ),
+    "tpu_fleet_hbm_headroom_ratio": (
+        "gauge",
+        "Free fraction of the scope's HBM (1 - used/total)",
+        ("scope", "pool", "slice"),
+    ),
+    "tpu_fleet_ici_links": (
+        "gauge",
+        "ICI interconnect links across the scope by health "
+        "(state ∈ healthy/degraded)",
+        ("scope", "pool", "slice", "state"),
+    ),
+    "tpu_fleet_ici_health_score": (
+        "gauge",
+        "ICI health scored per scope: healthy-link fraction, 1.0 = all "
+        "clean (absent when the scope reports no links)",
+        ("scope", "pool", "slice"),
+    ),
+    "tpu_fleet_mfu_ratio": (
+        "gauge",
+        "Mean model-FLOPs utilization over hosts reporting it (absent "
+        "when none do)",
+        ("scope", "pool", "slice"),
+    ),
+    "tpu_fleet_degraded_hosts": (
+        "gauge",
+        "Hosts in the scope whose exporter reports degraded serving "
+        "(tpumon_degraded)",
+        ("scope", "pool", "slice"),
+    ),
+    "tpu_fleet_stale_rollup": (
+        "gauge",
+        "1 when the scope's rollup includes stale (last-good) node "
+        "data — stale-flagged, never silently absent",
+        ("scope", "pool", "slice"),
+    ),
+    "tpu_fleet_scrape_duration_seconds": (
+        "histogram",
+        "Wall time to serve one aggregator /metrics exposition (the "
+        "fleet-dashboard p99)",
+        (),
+    ),
+    "tpu_fleet_collect_duration_seconds": (
+        "histogram",
+        "Wall time of one aggregator collect cycle (ingest scheduling "
+        "+ rollup + render)",
+        (),
+    ),
+    "tpu_fleet_node_fetches_total": (
+        "counter",
+        "Upstream fetch outcomes by transport mode (watch/poll) and "
+        "result (ok, error, parse_error, breaker_open)",
+        ("mode", "result"),
+    ),
+    "tpu_fleet_up": (
+        "gauge",
+        "1 while the aggregator's collect loop completes cycles; 0 "
+        "after a wholesale-failed cycle",
+        (),
+    ),
+    "tpu_fleet_shard_targets": (
+        "gauge",
+        "Upstream targets owned by this shard after rendezvous-hash "
+        "assignment (tpumon/fleet/shard.py)",
+        (),
+    ),
+    "tpu_fleet_watch_streams": (
+        "gauge",
+        "Upstream gRPC Watch fan-in streams by state (streaming/down/"
+        "off; off = the target rides HTTP polling)",
+        ("state",),
+    ),
+}
+
 #: family -> (prometheus type, description)
 SELF_FAMILIES: dict[str, tuple[str, str]] = {
     "exporter_scrape_duration_seconds": (
@@ -261,6 +369,7 @@ def all_family_names() -> set[str]:
         | set(ANOMALY_FAMILIES)
         | set(distribution_family_rows())
         | set(SELF_FAMILIES)
+        | set(FLEET_FAMILIES)
         | set(WORKLOAD_FAMILIES)
         | set(host_family_rows())
     )

@@ -1,0 +1,41 @@
+"""Fleet aggregation tier: from one exporter to a million-series fleet.
+
+Everything below this package watches ONE node. Dashboards and alerting
+for a whole org cannot fan a million raw per-chip series through
+Prometheus at interactive latency (PAPERS.md "Instant GPU Efficiency
+Visibility at Fleet Scale", arxiv 2605.20799) — they need a
+pre-aggregated tier. This package is that tier:
+
+- :mod:`tpumon.fleet.config` — ``TPUMON_FLEET_*`` knobs
+  (:class:`FleetConfig`), resolved the same env-first way as
+  tpumon.config.
+- :mod:`tpumon.fleet.shard` — deterministic rendezvous-hash target
+  ownership so N aggregator shards split a fleet with minimal movement
+  on resize (:func:`owned_targets`).
+- :mod:`tpumon.fleet.ingest` — the fan-in: one :class:`NodeFeed` per
+  exporter, preferring the exporter's gRPC Watch stream (1 Hz push)
+  and falling back to bounded HTTP /metrics polling, with the
+  resilience plane's per-upstream circuit breaker + reconnect backoff
+  and stale-but-served last-good snapshots.
+- :mod:`tpumon.fleet.rollup` — hierarchical node→slice→pool→fleet
+  merge (duty, HBM headroom, ICI health scored per slice, MFU,
+  degraded/stale/dark host counts) and the ``tpu_fleet_*``
+  recording-rule-style families built from it.
+- :mod:`tpumon.fleet.server` — :class:`FleetAggregator`: the collect
+  loop, the pre-rendered /metrics page (SampleCache reuse), the
+  ``/fleet`` JSON API ``tpumon smi --aggregator`` consumes, guard-plane
+  admission control on its own ingress, trace spans + /debug/vars, and
+  downsampled rollup retention via tpumon.history.
+
+Per-node series are deliberately NOT re-exported: the tier serves
+slice-granularity rollups (a v5p-64 × N-pool fleet is a few dozen
+series, not a million) — drill-down goes to the node exporter the
+rollup names.
+"""
+
+from __future__ import annotations
+
+from tpumon.fleet.config import FleetConfig
+from tpumon.fleet.shard import owned_targets, shard_of
+
+__all__ = ["FleetConfig", "owned_targets", "shard_of"]

@@ -1,0 +1,8 @@
+"""``python -m tpumon.fleet`` — the aggregator Deployment entrypoint."""
+
+import sys
+
+from tpumon.fleet.main import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,133 @@
+"""Env-first configuration for the fleet aggregation tier.
+
+Every knob is a ``TPUMON_FLEET_<FIELD>`` environment variable (the
+natural way to configure a Deployment pod), resolved from the dataclass
+fields the same way tpumon.health resolves its thresholds — one field,
+one knob, no drift. A malformed value logs and keeps the default; the
+aggregator must never CrashLoopBackOff on a typo (same stance as
+tpumon.config).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass, fields
+
+log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class FleetConfig:
+    """Immutable run configuration for the fleet aggregator.
+
+    Every field is settable via ``TPUMON_FLEET_<FIELD>`` (e.g.
+    ``TPUMON_FLEET_SHARD_COUNT=4``).
+    """
+
+    #: TCP port for the aggregator's own /metrics + /fleet endpoints.
+    port: int = 9500
+    #: Bind address for the HTTP server.
+    addr: str = "0.0.0.0"
+    #: CSV of upstream exporter targets. Each entry is a base URL
+    #: (``http://node:9400`` — a bare ``node:9400`` gets http://) with an
+    #: optional per-target Watch override: ``http://node:9400|grpc=node:9401``.
+    targets: str = ""
+    #: File with one target per line (# comments allowed); merged with
+    #: ``targets``. Lets a ConfigMap or a discovery sidecar own the list.
+    targets_file: str = ""
+    #: This shard's index and the total shard count: targets are split
+    #: by rendezvous hashing (tpumon/fleet/shard.py), so resizing the
+    #: shard set only moves the targets the new shard wins.
+    shard_index: int = 0
+    shard_count: int = 1
+    #: Collect/rollup cadence seconds (also the HTTP poll cadence for
+    #: targets without a live Watch stream).
+    interval: float = 1.0
+    #: Per-upstream fetch deadline seconds (every fan-in call is bounded).
+    timeout: float = 2.0
+    #: Per-shard fan-in budget: concurrent upstream fetches in flight.
+    concurrency: int = 16
+    #: Default exporter gRPC Watch port tried for every target
+    #: (TPUMON_GRPC_SERVE_PORT on the DaemonSet); -1 disables Watch
+    #: fan-in and every target rides HTTP polling. A per-target
+    #: ``|grpc=host:port`` suffix overrides this.
+    grpc_port: int = -1
+    #: Node snapshots older than this many seconds are STALE: still
+    #: merged into rollups, but flagged (tpu_fleet_stale_rollup,
+    #: hosts{state="stale"}).
+    stale_s: float = 10.0
+    #: Node snapshots older than this are DARK: evicted from rollups
+    #: (counted in hosts{state="dark"} so absence is observable).
+    evict_s: float = 120.0
+    #: Rollup-history retention window seconds (tpumon.history reuse,
+    #: served at /history); 0 disables.
+    history_window: float = 600.0
+    #: Per-series sample cap for the rollup history (downsampling bound).
+    history_max_samples: int = 4096
+    #: Guard-plane admission control on the aggregator's own ingress
+    #: (tpumon/guard: concurrency caps, rate limits, request deadlines).
+    guard: bool = True
+    #: Trace plane for the collect loop (/debug/traces, /debug/vars).
+    trace: bool = True
+    #: Log level name.
+    log_level: str = "INFO"
+
+    @classmethod
+    def from_env(cls, environ=None) -> "FleetConfig":
+        env = os.environ if environ is None else environ
+        kwargs = {}
+        for f in fields(cls):
+            raw = env.get("TPUMON_FLEET_" + f.name.upper())
+            if raw is None or not raw.strip():
+                continue
+            default = getattr(cls, f.name)
+            try:
+                if isinstance(default, bool):
+                    kwargs[f.name] = raw.strip().lower() in (
+                        "1", "true", "yes", "on"
+                    )
+                elif isinstance(default, int):
+                    kwargs[f.name] = int(raw)
+                elif isinstance(default, float):
+                    kwargs[f.name] = float(raw)
+                else:
+                    kwargs[f.name] = raw
+            except ValueError:
+                log.warning(
+                    "ignoring malformed TPUMON_FLEET_%s=%r",
+                    f.name.upper(), raw,
+                )
+        return cls(**kwargs)
+
+    def target_list(self) -> list[str]:
+        """The merged, de-duplicated target list (CSV + file), order
+        preserved — BEFORE shard filtering (tpumon/fleet/shard.py)."""
+        out: list[str] = []
+        seen: set[str] = set()
+
+        def add(raw: str) -> None:
+            entry = raw.strip()
+            if not entry or entry.startswith("#") or entry in seen:
+                return
+            seen.add(entry)
+            out.append(entry)
+
+        for part in self.targets.split(","):
+            add(part)
+        if self.targets_file:
+            try:
+                with open(self.targets_file, encoding="utf-8") as fh:
+                    for line in fh:
+                        add(line)
+            except OSError as exc:
+                # A missing list file means an empty shard, not a crash:
+                # the file may be a ConfigMap that lands after the pod.
+                log.warning(
+                    "fleet targets file %s unreadable: %s",
+                    self.targets_file, exc,
+                )
+        return out
+
+
+__all__ = ["FleetConfig"]

@@ -1,0 +1,446 @@
+"""Fan-in: one :class:`NodeFeed` per upstream exporter.
+
+Transport preference mirrors the DCGM-hostengine genre: the exporter's
+own gRPC ``tpumon.v1.Metrics/Watch`` stream when reachable (one push per
+poll cycle — the aggregator sees every 1 Hz sample, where HTTP polling
+sees one per collect interval), falling back to bounded HTTP /metrics
+polling. Both paths land in the same place: the feed's last-good parsed
+snapshot with a fetched-at timestamp, from which staleness is *derived*
+(tpumon/fleet/rollup.py) rather than tracked as mutable state.
+
+Resilience reuse (tpumon/resilience): HTTP fetches ride a per-upstream
+:class:`~tpumon.resilience.breaker.CircuitBreaker` (a dark node costs
+one probe per open window, not a timeout per collect cycle), and Watch
+reconnects ride a jittered :class:`~tpumon.resilience.policy.Backoff`
+(a slice-wide exporter restart must not synchronize every shard's
+reconnect storm). A failed fetch never clears the last-good snapshot:
+stale-but-served with explicit age beats a silent gap, exactly the
+degrade.py stance one layer down.
+"""
+
+from __future__ import annotations
+
+import http.client
+import logging
+import re
+import threading
+import time
+import urllib.error
+
+from tpumon.resilience import Backoff, CircuitBreaker
+
+log = logging.getLogger(__name__)
+
+#: A Watch stream is given this overall deadline, then redialed: a
+#: half-dead HTTP/2 peer can park a stream forever without it, and one
+#: reconnect per window per node is noise.
+WATCH_STREAM_DEADLINE_S = 300.0
+
+#: Everything an upstream exporter (or whatever squats on its port) can
+#: throw at the HTTP fetch path: connect failures, torn reads, and
+#: non-exposition response text — the same curated set tpumon.smi uses.
+FETCH_ERRORS: tuple[type[BaseException], ...] = (
+    urllib.error.URLError,
+    OSError,
+    http.client.HTTPException,
+    ValueError,
+)
+
+
+def parse_target(entry: str, default_grpc_port: int = -1):
+    """``http://node:9400[|grpc=node:9401]`` -> (base_url, grpc_addr|None).
+
+    A bare ``node:9400`` gets ``http://``. With no per-target override,
+    ``default_grpc_port >= 0`` derives the Watch address from the URL's
+    host (the DaemonSet serves one TPUMON_GRPC_SERVE_PORT fleet-wide).
+    """
+    url = entry
+    grpc_addr = None
+    if "|" in entry:
+        url, _, opts = entry.partition("|")
+        for opt in opts.split("|"):
+            key, _, value = opt.partition("=")
+            if key.strip() == "grpc" and value.strip():
+                grpc_addr = value.strip()
+    url = url.strip()
+    if not url.startswith(("http://", "https://")):
+        url = "http://" + url
+    url = url.rstrip("/")
+    if grpc_addr is None and default_grpc_port >= 0:
+        host = url.split("//", 1)[1].rsplit(":", 1)[0]
+        grpc_addr = f"{host}:{default_grpc_port}"
+    return url, grpc_addr
+
+
+#: Label pairs inside one sample line. Values in this schema never
+#: contain escaped quotes, so a flat scan is exact (coords like "0,0,0"
+#: are why splitting on commas would NOT be).
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+
+#: Per-chip gauge families -> snapshot field (the tpumon.smi vocabulary).
+_CHIP_FIELDS = {
+    "accelerator_duty_cycle_percent": "duty_pct",
+    "accelerator_memory_used_bytes": "hbm_used",
+    "accelerator_memory_total_bytes": "hbm_total",
+    "accelerator_throttle_score": "throttle",
+}
+
+#: Identity labels lifted off the first accelerator_info sample.
+_IDENTITY_KEYS = ("slice", "host", "accelerator", "worker")
+
+
+def node_snapshot_from_text(text: str) -> dict:
+    """Parse one exporter /metrics page into the fleet's node snapshot
+    (the tpumon.smi structured form, plus workload MFU when present).
+
+    This is a TARGETED line parser, not a general exposition parser:
+    the rollup consumes ~10 families of a page whose bulk is histogram
+    buckets, and ``prometheus_client``'s parser materializes all of it
+    (measured: 78 ms per 43 KB page — at fleet fan-in rates that is
+    most of a core spent inside the aggregator's GIL, starving its own
+    scrape serving). Scanning lines and regex-parsing labels only for
+    wanted families costs ~1-2 ms. Equivalence with the full parser on
+    the shared fields is pinned by tests/test_fleet.py; ROADMAP item 2
+    (negotiated protobuf exposition) is the next step down this path.
+    """
+    snap: dict = {
+        "identity": {},
+        "chips": {},
+        "cores": {},
+        "ici": {"healthy": 0, "total": 0, "worst": None},
+        "coverage": None,
+        "device_count": None,
+    }
+    chips = snap["chips"]
+    queues: dict[str, float] = {}
+    links: dict[str, float] = {}
+    stale_families: dict[str, float] = {}
+    degraded_active = None
+    healthy = total = 0
+    worst = None
+    for line in text.splitlines():
+        if not line or line[0] == "#":
+            continue
+        brace = line.find("{")
+        space = line.find(" ") if brace < 0 else -1
+        name = line[:brace] if brace >= 0 else line[:space]
+        if name in _CHIP_FIELDS:
+            labels = dict(_LABEL_RE.findall(line[brace:line.rfind("}") + 1]))
+            value = float(line.rsplit(" ", 1)[1])
+            chips.setdefault(labels.get("chip", "?"), {})[
+                _CHIP_FIELDS[name]
+            ] = value
+        elif name == "accelerator_info":
+            labels = dict(_LABEL_RE.findall(line[brace:line.rfind("}") + 1]))
+            if not snap["identity"]:
+                for key in _IDENTITY_KEYS:
+                    if key in labels:
+                        snap["identity"][key] = labels[key]
+            chips.setdefault(labels.get("chip", "?"), {})["coords"] = (
+                labels.get("coords", "")
+            )
+        elif name == "accelerator_interconnect_link_health":
+            labels = dict(_LABEL_RE.findall(line[brace:line.rfind("}") + 1]))
+            value = float(line.rsplit(" ", 1)[1])
+            link = labels.get("link", "?")
+            links[link] = value
+            total += 1
+            if value == 0:
+                healthy += 1
+            if worst is None or value > worst[1]:
+                worst = (link, value)
+        elif name == "accelerator_core_utilization_percent":
+            labels = dict(_LABEL_RE.findall(line[brace:line.rfind("}") + 1]))
+            snap["cores"][labels.get("core", "?")] = float(
+                line.rsplit(" ", 1)[1]
+            )
+        elif name == "accelerator_queue_size":
+            labels = dict(_LABEL_RE.findall(line[brace:line.rfind("}") + 1]))
+            queues[labels.get("core", "?")] = float(line.rsplit(" ", 1)[1])
+        elif name == "accelerator_device_count":
+            snap["device_count"] = int(float(line.rsplit(" ", 1)[1]))
+        elif name == "collector_last_poll_timestamp_seconds":
+            snap["last_poll_ts"] = float(line.rsplit(" ", 1)[1])
+        elif name == "exporter_metric_coverage_ratio":
+            snap["coverage"] = float(line.rsplit(" ", 1)[1])
+        elif name == "tpumon_degraded":
+            degraded_active = float(line.rsplit(" ", 1)[1]) > 0
+        elif name == "tpumon_family_staleness_seconds":
+            labels = dict(_LABEL_RE.findall(line[brace:line.rfind("}") + 1]))
+            stale_families[labels.get("family", "?")] = float(
+                line.rsplit(" ", 1)[1]
+            )
+        elif name == "workload_mfu_ratio":
+            snap["mfu"] = float(line.rsplit(" ", 1)[1])
+    if queues:
+        snap["queues"] = queues
+    if total:
+        snap["ici"] = {
+            "healthy": healthy,
+            "total": total,
+            "worst": worst if worst and worst[1] > 0 else None,
+            "links": links,
+        }
+    if degraded_active is not None:
+        snap["degraded"] = {
+            "active": degraded_active,
+            "families": stale_families,
+        }
+    return snap
+
+
+class NodeFeed:
+    """One upstream exporter's ingest state.
+
+    Mutated from the Watch thread and the fetch executor; read from the
+    collect loop and HTTP threads (via the aggregator's /fleet doc) —
+    one small lock guards the snapshot triple.
+    """
+
+    def __init__(
+        self,
+        target: str,
+        *,
+        timeout: float = 2.0,
+        default_grpc_port: int = -1,
+        breaker_failures: int = 3,
+        breaker_open_s: float = 15.0,
+        observe_fetch=None,
+        clock=time.time,
+    ) -> None:
+        self.target = target
+        self.url, self.grpc_addr = parse_target(target, default_grpc_port)
+        self.timeout = timeout
+        self._clock = clock
+        self._observe_fetch = observe_fetch
+        #: HTTP-path breaker: a dark node costs one probe per open
+        #: window instead of a fetch timeout per collect cycle.
+        self.breaker = CircuitBreaker(
+            failures=breaker_failures, open_s=breaker_open_s
+        )
+        #: Watch reconnect schedule (jittered, capped).
+        self.backoff = Backoff(base_s=1.0, max_s=60.0)
+        self._lock = threading.Lock()
+        self._snap: dict | None = None  # guarded-by: self._lock
+        self._fetched_at: float = 0.0  # guarded-by: self._lock
+        self._last_error: str = ""  # guarded-by: self._lock
+        #: "streaming" while the Watch stream delivers, "down" between
+        #: reconnects, "off" when Watch is not configured.
+        self.watch_state = "off" if self.grpc_addr is None else "down"  # guarded-by: self._lock
+        self._inflight = False  # guarded-by: self._lock
+        #: Persistent poll connection; touched only inside poll()
+        #: (serialized by _inflight), never concurrently.
+        self._conn: http.client.HTTPConnection | None = None
+        self._stop = threading.Event()
+        self._watch_thread: threading.Thread | None = None
+        self._watch_call = None  # guarded-by: self._lock
+
+    # -- snapshot access ---------------------------------------------------
+
+    def store_text(self, text: str, mode: str) -> None:
+        """Parse + publish one exposition page (both transports land here)."""
+        try:
+            snap = node_snapshot_from_text(text)
+        except Exception as exc:
+            # A garbage page is an upstream bug, not a feed crash — the
+            # last-good snapshot keeps serving, aged.
+            log.warning("%s: unparseable page via %s: %s", self.url, mode, exc)
+            self._count(mode, "parse_error")
+            return
+        now = self._clock()
+        # Effective data timestamp: the fetch time MINUS how stale the
+        # node's own poll loop already was when it served this page
+        # (collector_last_poll_timestamp_seconds). A zombie exporter —
+        # HTTP plane answering, poll loop dead — must age toward
+        # stale/dark exactly like a node that stopped answering; fetch
+        # success alone is not freshness. Skew-clamped: a node with a
+        # broken clock reads as very stale (operators see it), never as
+        # fresher than the fetch.
+        data_ts = now
+        last_poll = snap.get("last_poll_ts")
+        if last_poll:
+            data_ts = now - min(max(0.0, now - last_poll), 3600.0)
+        with self._lock:
+            self._snap = snap
+            self._fetched_at = data_ts
+            self._last_error = ""
+        self._count(mode, "ok")
+
+    def current(self) -> tuple[dict | None, float, str]:
+        """(last-good snapshot, fetched-at ts, last error) — atomically."""
+        with self._lock:
+            return self._snap, self._fetched_at, self._last_error
+
+    def watch_state_now(self) -> str:
+        with self._lock:
+            return self.watch_state
+
+    def age(self, now: float | None = None) -> float:
+        with self._lock:
+            fetched_at = self._fetched_at
+        if fetched_at == 0.0:
+            return float("inf")
+        return max(0.0, (now if now is not None else self._clock()) - fetched_at)
+
+    def _count(self, mode: str, result: str) -> None:
+        if self._observe_fetch is not None:
+            try:
+                self._observe_fetch(mode, result)
+            except Exception:
+                # A metrics hiccup must never fail the ingest path.
+                log.debug("fetch observer failed", exc_info=True)
+
+    def _note_error(self, message: str) -> None:
+        with self._lock:
+            self._last_error = message[:200]
+
+    # -- HTTP polling fallback ---------------------------------------------
+
+    def _fetch_page(self) -> str:
+        """GET /metrics over a persistent per-feed connection.
+
+        Keep-alive matters at fleet scale: a fresh TCP connect per poll
+        per node is O(fleet) connection churn per second on the shard
+        AND a new handler thread per poll on every exporter. The
+        connection is rebuilt on any error; ``poll`` is serialized per
+        feed (``_inflight``), so one connection needs no locking."""
+        host = self.url.split("//", 1)[1]
+        if self._conn is None:
+            self._conn = http.client.HTTPConnection(
+                host, timeout=self.timeout
+            )
+        try:
+            self._conn.request("GET", "/metrics")
+            resp = self._conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                raise http.client.HTTPException(f"status {resp.status}")
+            return body.decode()
+        except BaseException:
+            # Whatever happened, this connection's framing is suspect.
+            try:
+                self._conn.close()
+            finally:
+                self._conn = None
+            raise
+
+    def poll(self) -> None:
+        """One bounded HTTP /metrics fetch (runs on the fetch executor).
+        Breaker-gated: while open, the fetch is refused locally."""
+        with self._lock:
+            if self._inflight:
+                return
+            self._inflight = True
+        try:
+            if not self.breaker.allow():
+                self._count("poll", "breaker_open")
+                return
+            try:
+                text = self._fetch_page()
+            except FETCH_ERRORS as exc:
+                self.breaker.record(False)
+                self._note_error(str(exc))
+                self._count("poll", "error")
+                log.debug("%s: poll failed: %s", self.url, exc)
+                return
+            self.breaker.record(True)
+            self.store_text(text, "poll")
+        finally:
+            with self._lock:
+                self._inflight = False
+
+    # -- gRPC Watch stream --------------------------------------------------
+
+    def start_watch(self) -> None:
+        """Start the Watch fan-in thread when the target has a gRPC
+        address and grpcio is importable; otherwise the feed stays on
+        HTTP polling (watch_state == "off")."""
+        if self.grpc_addr is None or self._watch_thread is not None:
+            return
+        try:
+            import grpc  # noqa: F401
+        except ImportError:
+            with self._lock:
+                self.watch_state = "off"
+            return
+        self._watch_thread = threading.Thread(
+            target=self._watch_loop,
+            name=f"tpumon-fleet-watch-{self.grpc_addr}",
+            daemon=True,
+        )
+        self._watch_thread.start()
+
+    def _watch_loop(self) -> None:
+        import grpc
+
+        from tpumon.exporter.grpc_service import (
+            METHOD_WATCH,
+            decode_page_response,
+        )
+
+        while not self._stop.is_set():
+            channel = grpc.insecure_channel(self.grpc_addr)
+            try:
+                call = channel.unary_stream(
+                    METHOD_WATCH,
+                    request_serializer=None,
+                    response_deserializer=None,
+                )
+                # Overall stream deadline: the stream ends (and redials)
+                # after the window even against a half-dead peer.
+                stream = call(b"", timeout=WATCH_STREAM_DEADLINE_S)
+                with self._lock:
+                    self._watch_call = stream
+                for raw in stream:
+                    page, _version = decode_page_response(raw)
+                    self.store_text(page.decode(), "watch")
+                    with self._lock:
+                        self.watch_state = "streaming"
+                    self.backoff.reset()
+                    if self._stop.is_set():
+                        break
+            except grpc.RpcError as exc:
+                code = getattr(exc, "code", lambda: None)()
+                if code == grpc.StatusCode.DEADLINE_EXCEEDED:
+                    # Routine stream-window expiry: redial immediately.
+                    self.backoff.reset()
+                else:
+                    self._note_error(f"watch: {code}")
+                    self._count("watch", "error")
+                    log.debug("%s: watch stream failed: %s", self.grpc_addr, code)
+            except Exception:
+                self._count("watch", "error")
+                log.exception("%s: watch loop error", self.grpc_addr)
+            finally:
+                with self._lock:
+                    self._watch_call = None
+                    if not self._stop.is_set():
+                        self.watch_state = "down"
+                channel.close()
+            if self._stop.wait(self.backoff.next_delay()):
+                break
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            call = self._watch_call
+        if call is not None:
+            try:
+                call.cancel()
+            except Exception:
+                log.debug("watch cancel failed", exc_info=True)
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=2.0)
+        conn = self._conn
+        if conn is not None:
+            self._conn = None
+            conn.close()
+
+
+__all__ = [
+    "FETCH_ERRORS",
+    "NodeFeed",
+    "node_snapshot_from_text",
+    "parse_target",
+    "WATCH_STREAM_DEADLINE_S",
+]

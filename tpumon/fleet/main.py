@@ -1,0 +1,102 @@
+"""Fleet-aggregator container entrypoint.
+
+``python -m tpumon.fleet`` (Deployment command, deploy/aggregator.yaml):
+load ``TPUMON_FLEET_*`` config → build the shard's aggregator → serve
+until SIGTERM. CLI flags override the environment, same precedence as
+the exporter entrypoint.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+import signal
+import sys
+import threading
+
+from tpumon.fleet.config import FleetConfig
+from tpumon.fleet.server import build_aggregator
+
+log = logging.getLogger(__name__)
+
+
+def _parse(argv: list[str] | None) -> FleetConfig:
+    parser = argparse.ArgumentParser(
+        prog="tpumon-fleet",
+        description="fleet aggregation tier: shardable fan-in over node "
+        "exporters, pre-aggregated tpu_fleet_* exposition + /fleet API",
+    )
+    parser.add_argument("--port", type=int, help="HTTP port (/metrics, /fleet)")
+    parser.add_argument("--addr", help="bind address")
+    parser.add_argument(
+        "--targets",
+        help="CSV of exporter base URLs (optionally url|grpc=host:port)",
+    )
+    parser.add_argument("--targets-file", help="file with one target per line")
+    parser.add_argument("--shard-index", type=int, help="this shard's index")
+    parser.add_argument("--shard-count", type=int, help="total shard count")
+    parser.add_argument("--interval", type=float, help="collect cadence seconds")
+    parser.add_argument("--timeout", type=float, help="upstream fetch deadline")
+    parser.add_argument(
+        "--concurrency", type=int, help="per-shard fan-in fetch budget"
+    )
+    parser.add_argument(
+        "--grpc-port", type=int,
+        help="default exporter gRPC Watch port (-1 = HTTP polling only)",
+    )
+    parser.add_argument("--stale-s", type=float, help="stale-flag age seconds")
+    parser.add_argument("--evict-s", type=float, help="dark-eviction age seconds")
+    parser.add_argument("--log-level", help="log level")
+    args = parser.parse_args(argv)
+    cfg = FleetConfig.from_env()
+    updates = {
+        f.name: getattr(args, f.name)
+        for f in dataclasses.fields(FleetConfig)
+        if getattr(args, f.name, None) is not None
+    }
+    return dataclasses.replace(cfg, **updates)
+
+
+def main(argv: list[str] | None = None) -> int:
+    cfg = _parse(argv)
+    level = getattr(logging, cfg.log_level.upper(), logging.INFO)
+    logging.basicConfig(
+        level=level if isinstance(level, int) else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    # Scrape-tail control, daemon-only (same opt-out as the exporter
+    # entrypoint): the aggregator hosts N fetch/parse threads next to
+    # its serving threads, and short GIL quanta keep the scrape p99
+    # from queueing behind ingest work.
+    import os
+
+    if not os.environ.get("TPUMON_KEEP_SWITCH_INTERVAL"):
+        sys.setswitchinterval(min(sys.getswitchinterval(), 0.0005))
+
+    aggregator = build_aggregator(cfg)
+    if not aggregator.targets:
+        log.warning(
+            "no targets owned by shard %d/%d — set TPUMON_FLEET_TARGETS "
+            "or TPUMON_FLEET_TARGETS_FILE (serving empty rollups)",
+            cfg.shard_index, cfg.shard_count,
+        )
+    stop = threading.Event()
+
+    def _signal(signum, frame) -> None:
+        log.info("received signal %s, shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _signal)
+    signal.signal(signal.SIGINT, _signal)
+
+    aggregator.start()
+    try:
+        stop.wait()  # deadline: woken by the SIGTERM/SIGINT handler — lifecycle wait, not a request path
+    finally:
+        aggregator.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,290 @@
+"""Hierarchical node→slice→pool→fleet merge and the ``tpu_fleet_*``
+families built from it.
+
+The hierarchy comes from the identity labels every exporter already
+stamps: a node's **slice** is its ``slice`` label, its **pool** is its
+``accelerator`` type label (one pool per accelerator generation —
+v5p-64 pods, v5e-16 pods — the granularity a capacity dashboard ranks).
+
+Exposition is recording-rule style: ONE family per signal with a
+``scope`` label (``slice`` / ``pool`` / ``fleet``), so a Grafana panel
+over the whole org is a single O(#slices) selector —
+``tpu_fleet_duty_cycle_percent{scope="fleet",stat="mean"}`` — and
+per-node series are never re-exported through the tier. Staleness is a
+first-class output, not a side channel: a slice whose rollup includes
+stale node data carries ``tpu_fleet_stale_rollup == 1``, and host
+counts split by state (``up`` / ``stale`` / ``dark``) so a dark node is
+visible in the same family that counts live ones.
+
+Pure functions over parsed snapshots — no I/O, no clocks — so the
+rollup math is unit-testable sample-for-sample (tests/test_fleet.py).
+"""
+
+from __future__ import annotations
+
+from prometheus_client.core import GaugeMetricFamily
+
+#: Node ingest states (tpumon/fleet/ingest.py feeds, classified by age).
+UP = "up"
+STALE = "stale"
+DARK = "dark"
+
+#: Identity fallbacks for a node that went dark before ever delivering
+#: a snapshot (no labels to bucket it by).
+UNKNOWN_POOL = "unknown"
+UNKNOWN_SLICE = "?"
+
+
+def classify(age: float, stale_s: float, evict_s: float) -> str:
+    """Feed age → ingest state. ``stale`` snapshots still roll up
+    (flagged); ``dark`` ones are evicted from the math but counted."""
+    if age <= stale_s:
+        return UP
+    if age <= evict_s:
+        return STALE
+    return DARK
+
+
+class _Agg:
+    """One accumulation bucket (a slice, a pool, or the fleet)."""
+
+    def __init__(self) -> None:
+        self.hosts = {UP: 0, STALE: 0, DARK: 0}
+        self.chips = 0
+        self.duty_sum = 0.0
+        self.duty_n = 0
+        self.duty_min: float | None = None
+        self.duty_max: float | None = None
+        self.hbm_used = 0.0
+        self.hbm_total = 0.0
+        self.ici_healthy = 0
+        self.ici_links = 0
+        self.mfu_sum = 0.0
+        self.mfu_n = 0
+        self.degraded_hosts = 0
+
+    def add_node(self, snap: dict, state: str) -> None:
+        self.hosts[state] += 1
+        if state == DARK:
+            return  # counted, never merged — dark data is no data
+        self.chips += len(snap.get("chips", {}))
+        for row in snap.get("chips", {}).values():
+            duty = row.get("duty_pct")
+            if duty is not None:
+                self.duty_sum += duty
+                self.duty_n += 1
+                if self.duty_min is None or duty < self.duty_min:
+                    self.duty_min = duty
+                if self.duty_max is None or duty > self.duty_max:
+                    self.duty_max = duty
+            used, total = row.get("hbm_used"), row.get("hbm_total")
+            if used is not None and total is not None:
+                self.hbm_used += used
+                self.hbm_total += total
+        ici = snap.get("ici") or {}
+        self.ici_healthy += ici.get("healthy", 0)
+        self.ici_links += ici.get("total", 0)
+        mfu = snap.get("mfu")
+        if mfu is not None:
+            self.mfu_sum += mfu
+            self.mfu_n += 1
+        degraded = snap.get("degraded")
+        if degraded and degraded.get("active"):
+            self.degraded_hosts += 1
+
+    def to_dict(self) -> dict:
+        doc: dict = {
+            "hosts": dict(self.hosts),
+            "chips": self.chips,
+            "degraded_hosts": self.degraded_hosts,
+            "stale": self.hosts[STALE] > 0,
+        }
+        if self.duty_n:
+            doc["duty"] = {
+                "mean": self.duty_sum / self.duty_n,
+                "min": self.duty_min,
+                "max": self.duty_max,
+            }
+        if self.hbm_total > 0:
+            doc["hbm_used"] = self.hbm_used
+            doc["hbm_total"] = self.hbm_total
+            doc["hbm_headroom_ratio"] = 1.0 - self.hbm_used / self.hbm_total
+        if self.ici_links:
+            doc["ici"] = {
+                "healthy": self.ici_healthy,
+                "links": self.ici_links,
+                "score": self.ici_healthy / self.ici_links,
+            }
+        if self.mfu_n:
+            doc["mfu"] = self.mfu_sum / self.mfu_n
+        return doc
+
+
+def rollup(nodes: list[dict]) -> dict:
+    """Merge node entries into the slice/pool/fleet hierarchy.
+
+    ``nodes``: ``[{"snap": <smi snapshot>|None, "state": up|stale|dark,
+    ...}, ...]`` (ingest feeds, pre-classified). Returns::
+
+        {"slices": {(pool, slice): {...}},   # _Agg.to_dict shapes
+         "pools":  {pool: {...}},
+         "fleet":  {...,"slices": n, "pools": n}}
+    """
+    slices: dict[tuple[str, str], _Agg] = {}
+    pools: dict[str, _Agg] = {}
+    fleet = _Agg()
+    for node in nodes:
+        snap = node.get("snap") or {}
+        ident = snap.get("identity") or {}
+        pool = ident.get("accelerator") or UNKNOWN_POOL
+        slc = ident.get("slice") or UNKNOWN_SLICE
+        state = node["state"]
+        slices.setdefault((pool, slc), _Agg()).add_node(snap, state)
+        pools.setdefault(pool, _Agg()).add_node(snap, state)
+        fleet.add_node(snap, state)
+    fleet_doc = fleet.to_dict()
+    fleet_doc["slices"] = len(slices)
+    fleet_doc["pools"] = len(pools)
+    return {
+        "slices": {key: agg.to_dict() for key, agg in slices.items()},
+        "pools": {pool: agg.to_dict() for pool, agg in pools.items()},
+        "fleet": fleet_doc,
+    }
+
+
+#: (family, help, extra labels beyond scope/pool/slice) — the builder
+#: below and the FLEET_FAMILIES registry (tpumon/families.py) must agree;
+#: the family-drift rule and tests/test_fleet.py hold them together.
+_SCOPED = ("scope", "pool", "slice")
+
+
+def _rows(doc: dict):
+    """Every (labels, bucket) pair: slice rows, pool rows, the fleet row."""
+    for (pool, slc), bucket in sorted(doc["slices"].items()):
+        yield ("slice", pool, slc), bucket
+    for pool, bucket in sorted(doc["pools"].items()):
+        yield ("pool", pool, ""), bucket
+    yield ("fleet", "", ""), doc["fleet"]
+
+
+def fleet_families(doc: dict) -> list:
+    """The pre-aggregated exposition: one GaugeMetricFamily per signal,
+    scope-labeled rows for every slice, pool, and the fleet."""
+    hosts = GaugeMetricFamily(
+        "tpu_fleet_hosts",
+        "Exporter hosts known to this aggregator shard by ingest state "
+        "(up = fresh, stale = serving last-good flagged data, dark = "
+        "evicted from rollups).",
+        labels=_SCOPED + ("state",),
+    )
+    chips = GaugeMetricFamily(
+        "tpu_fleet_chips",
+        "Accelerator chips contributing to this rollup (dark hosts "
+        "excluded).",
+        labels=_SCOPED,
+    )
+    duty = GaugeMetricFamily(
+        "tpu_fleet_duty_cycle_percent",
+        "Chip duty-cycle rollup across the scope (stat ∈ mean/min/max "
+        "over contributing chips).",
+        labels=_SCOPED + ("stat",),
+    )
+    hbm_used = GaugeMetricFamily(
+        "tpu_fleet_hbm_used_bytes",
+        "Summed HBM bytes in use across the scope.",
+        labels=_SCOPED,
+    )
+    hbm_total = GaugeMetricFamily(
+        "tpu_fleet_hbm_total_bytes",
+        "Summed HBM capacity bytes across the scope.",
+        labels=_SCOPED,
+    )
+    headroom = GaugeMetricFamily(
+        "tpu_fleet_hbm_headroom_ratio",
+        "Free fraction of the scope's HBM (1 - used/total).",
+        labels=_SCOPED,
+    )
+    ici_links = GaugeMetricFamily(
+        "tpu_fleet_ici_links",
+        "ICI interconnect links across the scope by health "
+        "(state ∈ healthy/degraded).",
+        labels=_SCOPED + ("state",),
+    )
+    ici_score = GaugeMetricFamily(
+        "tpu_fleet_ici_health_score",
+        "ICI health scored per scope: healthy-link fraction, 1.0 = "
+        "every link clean (absent when the scope reports no links).",
+        labels=_SCOPED,
+    )
+    mfu = GaugeMetricFamily(
+        "tpu_fleet_mfu_ratio",
+        "Mean model-FLOPs utilization over hosts reporting it (absent "
+        "when none do).",
+        labels=_SCOPED,
+    )
+    degraded = GaugeMetricFamily(
+        "tpu_fleet_degraded_hosts",
+        "Hosts in the scope whose exporter reports degraded serving "
+        "(tpumon_degraded — stale-but-served families or open breakers).",
+        labels=_SCOPED,
+    )
+    stale_flag = GaugeMetricFamily(
+        "tpu_fleet_stale_rollup",
+        "1 when this scope's rollup includes stale (last-good) node "
+        "data — stale-flagged beats silently absent.",
+        labels=_SCOPED,
+    )
+
+    for labels, bucket in _rows(doc):
+        for state, n in sorted(bucket["hosts"].items()):
+            hosts.add_metric(labels + (state,), float(n))
+        chips.add_metric(labels, float(bucket["chips"]))
+        if "duty" in bucket:
+            for stat in ("mean", "min", "max"):
+                duty.add_metric(labels + (stat,), float(bucket["duty"][stat]))
+        if "hbm_total" in bucket:
+            hbm_used.add_metric(labels, bucket["hbm_used"])
+            hbm_total.add_metric(labels, bucket["hbm_total"])
+            headroom.add_metric(labels, bucket["hbm_headroom_ratio"])
+        if "ici" in bucket:
+            ici = bucket["ici"]
+            ici_links.add_metric(labels + ("healthy",), float(ici["healthy"]))
+            ici_links.add_metric(
+                labels + ("degraded",), float(ici["links"] - ici["healthy"])
+            )
+            ici_score.add_metric(labels, ici["score"])
+        if "mfu" in bucket:
+            mfu.add_metric(labels, bucket["mfu"])
+        degraded.add_metric(labels, float(bucket["degraded_hosts"]))
+        stale_flag.add_metric(labels, 1.0 if bucket["stale"] else 0.0)
+
+    return [
+        hosts, chips, duty, hbm_used, hbm_total, headroom,
+        ici_links, ici_score, mfu, degraded, stale_flag,
+    ]
+
+
+def jsonable(doc: dict) -> dict:
+    """The /fleet API form of a rollup doc (tuple keys → flat rows)."""
+    return {
+        "slices": [
+            {"pool": pool, "slice": slc, **bucket}
+            for (pool, slc), bucket in sorted(doc["slices"].items())
+        ],
+        "pools": [
+            {"pool": pool, **bucket}
+            for pool, bucket in sorted(doc["pools"].items())
+        ],
+        "fleet": doc["fleet"],
+    }
+
+
+__all__ = [
+    "DARK",
+    "STALE",
+    "UP",
+    "classify",
+    "fleet_families",
+    "jsonable",
+    "rollup",
+]

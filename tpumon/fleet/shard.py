@@ -1,0 +1,42 @@
+"""Deterministic target→shard assignment (rendezvous hashing).
+
+Every aggregator shard runs this same pure function over the same
+target list and keeps exactly the targets it wins — no coordinator, no
+shared state, no ordering sensitivity. Rendezvous (highest-random-
+weight) hashing gives the property that matters operationally: growing
+the shard set from N to N+1 moves ONLY the targets the new shard wins
+(~1/(N+1) of the fleet); every other target keeps its watcher, so a
+scale-up does not reconnect the whole fleet's Watch streams at once.
+
+Hashing is md5 over ``"<shard>:<target>"`` — stable across processes,
+machines, and Python versions (``hash()`` is salted per process and
+would assign differently on every restart).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+
+def _weight(shard: int, target: str) -> int:
+    digest = hashlib.md5(f"{shard}:{target}".encode()).digest()
+    return int.from_bytes(digest[:8], "big")
+
+
+def shard_of(target: str, shard_count: int) -> int:
+    """The shard index that owns ``target`` among ``shard_count`` shards."""
+    if shard_count <= 1:
+        return 0
+    return max(range(shard_count), key=lambda i: _weight(i, target))
+
+
+def owned_targets(
+    targets: list[str], shard_index: int, shard_count: int
+) -> list[str]:
+    """The subset of ``targets`` this shard owns, input order preserved."""
+    if shard_count <= 1:
+        return list(targets)
+    return [t for t in targets if shard_of(t, shard_count) == shard_index]
+
+
+__all__ = ["owned_targets", "shard_of"]

@@ -25,6 +25,7 @@ Two data sources:
 from __future__ import annotations
 
 import argparse
+import http.client
 import json
 import sys
 import time
@@ -32,6 +33,21 @@ import urllib.error
 import urllib.request
 
 from prometheus_client.parser import text_string_to_metric_families
+
+#: Everything a dying — or simply non-exporter — listener can throw
+#: mid-request: connect failures (URLError/OSError), torn connections
+#: mid-body (IncompleteRead and friends are HTTPException, not OSError),
+#: non-exposition response text (parser ValueError). Shared by the
+#: fleet fetcher, the first-snapshot probe, and the watch loop, so an
+#: unrelated service on 9400 degrades to the in-process fallback (or an
+#: UNREACHABLE fleet row) instead of crashing smi. Same curated set as
+#: tpumon/fleet/ingest.FETCH_ERRORS.
+FETCH_ERRORS: tuple = (
+    urllib.error.URLError,
+    OSError,
+    http.client.HTTPException,
+    ValueError,
+)
 
 # Families rendered into the table, keyed by their per-chip label.
 _F_DUTY = "accelerator_duty_cycle_percent"
@@ -376,6 +392,101 @@ def snapshot_from_url(url: str, timeout: float, window: float) -> dict:
     return snap
 
 
+def fetch_fleet_snapshots(
+    urls: list[str],
+    timeout: float,
+    window: float,
+    fetch_errors: tuple = FETCH_ERRORS,
+    max_workers: int = 16,
+) -> list[dict]:
+    """Bounded-concurrency snapshot fetch across exporter URLs.
+
+    One refresh costs one timeout, not one per down host (a 16-host view
+    with dead nodes must not stall N×), and the worker bound keeps a
+    500-URL invocation from spawning 500 sockets at once. Unreachable
+    hosts come back as ``{"url", "error"}`` rows — a down node must be
+    visible, not silently missing. This is the same merge feed the fleet
+    aggregator (tpumon/fleet) runs as a service; the CLI path remains
+    for air-gapped and ad-hoc use, ``--aggregator`` for fleets.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    def fetch(url: str) -> dict:
+        try:
+            return snapshot_from_url(url, timeout, window)
+        except fetch_errors as exc:
+            return {"url": url, "error": str(exc)}
+
+    with ThreadPoolExecutor(
+        max_workers=max(1, min(len(urls), max_workers))
+    ) as pool:
+        return list(pool.map(fetch, urls))
+
+
+def aggregator_snapshot(url: str, timeout: float) -> dict:
+    """One /fleet document from a running fleet aggregator (tpumon/fleet)."""
+    doc = json.loads(_fetch(url.rstrip("/") + "/fleet", timeout))
+    return {"aggregator": doc, "aggregator_url": url, "ts": time.time()}
+
+
+def render_aggregator(snap: dict, out=None) -> None:
+    """The ``--aggregator`` view: the aggregator's per-node snapshots
+    through the same fleet table, then the pre-aggregated rollup lines
+    the tier exists to serve."""
+    out = out if out is not None else sys.stdout
+    doc = snap["aggregator"]
+
+    def p(line: str = "") -> None:
+        print(line, file=out)
+
+    snaps = []
+    for node in doc.get("nodes", ()):
+        node_snap = node.get("snap")
+        if node.get("state") == "dark" or not node_snap:
+            snaps.append(
+                {
+                    "url": node.get("url", node.get("target", "?")),
+                    "error": node.get("error") or "dark (no recent data)",
+                }
+            )
+        else:
+            snaps.append(node_snap)
+    render_fleet(snaps, out)
+
+    shard = doc.get("shard", {})
+    fleet = doc.get("fleet", {})
+    hosts = fleet.get("hosts", {})
+    p(
+        f"aggregator {snap.get('aggregator_url', '?')} "
+        f"[shard {shard.get('index', 0)}/{shard.get('count', 1)}, "
+        f"{shard.get('targets', len(snaps))} targets]: "
+        f"{hosts.get('up', 0)} up / {hosts.get('stale', 0)} stale / "
+        f"{hosts.get('dark', 0)} dark, {fleet.get('chips', 0)} chips"
+    )
+    for row in doc.get("slices", ()):
+        parts = [f"{row.get('chips', 0)} chips"]
+        duty = row.get("duty")
+        if duty:
+            parts.append(
+                f"duty {duty['mean']:.1f}% "
+                f"({duty['min']:.1f}-{duty['max']:.1f})"
+            )
+        if "hbm_headroom_ratio" in row:
+            parts.append(f"HBM headroom {row['hbm_headroom_ratio']:.0%}")
+        ici = row.get("ici")
+        if ici:
+            parts.append(f"ICI {ici['score']:.2f}")
+        if "mfu" in row:
+            parts.append(f"MFU {row['mfu']:.1%}")
+        if row.get("degraded_hosts"):
+            parts.append(f"{row['degraded_hosts']} degraded")
+        flag = "  STALE" if row.get("stale") else ""
+        p(
+            f"  slice {row.get('slice', '?')} [{row.get('pool', '?')}]: "
+            + ", ".join(parts) + flag
+        )
+
+
 def snapshot_from_backend(cfg, backend=None) -> dict:
     """Standalone mode: poll a backend once and snapshot the families.
 
@@ -653,6 +764,13 @@ def main(argv: list[str] | None = None, out=None) -> int:
         "is probed and an in-process backend is the fallback",
     )
     parser.add_argument(
+        "--aggregator",
+        metavar="URL",
+        help="a running fleet aggregator's base URL (tpumon/fleet): "
+        "render the fleet view from its pre-aggregated /fleet API "
+        "instead of fanning out to every exporter from this CLI",
+    )
+    parser.add_argument(
         "--watch", type=float, metavar="SEC", help="refresh every SEC seconds"
     )
     parser.add_argument("--json", action="store_true", help="machine-readable output")
@@ -680,21 +798,7 @@ def main(argv: list[str] | None = None, out=None) -> int:
     # every second — the touching this CLI promises to avoid).
     source: dict = {"mode": None, "backend": None, "cfg": None}
 
-    import http.client
-
-    # Everything a dying — or simply non-exporter — listener can throw
-    # mid-request: connect failures (URLError/OSError), torn connections
-    # mid-body (IncompleteRead and friends are HTTPException, not OSError),
-    # non-exposition response text (parser ValueError). Shared by the
-    # fleet fetcher, the first-snapshot probe, and the watch loop, so an
-    # unrelated service on 9400 degrades to the in-process fallback
-    # instead of crashing smi.
-    fetch_errors = (
-        urllib.error.URLError,
-        OSError,
-        http.client.HTTPException,
-        ValueError,
-    )
+    fetch_errors = FETCH_ERRORS  # module-level set, documented there
 
     def pinned_backend():
         if source["backend"] is None:
@@ -705,18 +809,11 @@ def main(argv: list[str] | None = None, out=None) -> int:
         return source["backend"]
 
     def fleet_snapshot(urls: list[str]) -> dict:
-        # Concurrent fetch: one refresh costs one timeout, not one per
-        # down host (a 16-host view with dead nodes must not stall N×).
-        from concurrent.futures import ThreadPoolExecutor
-
-        def fetch(url: str) -> dict:
-            try:
-                return snapshot_from_url(url, args.timeout, args.window)
-            except fetch_errors as exc:
-                return {"url": url, "error": str(exc)}
-
-        with ThreadPoolExecutor(max_workers=min(len(urls), 16)) as pool:
-            snaps = list(pool.map(fetch, urls))
+        # Bounded-concurrency fan-out (module-level helper, shared
+        # idiom with the fleet tier's ingest).
+        snaps = fetch_fleet_snapshots(
+            urls, args.timeout, args.window, fetch_errors
+        )
         return {"fleet": snaps, "ts": time.time()}
 
     def fetch_workload() -> dict:
@@ -768,6 +865,10 @@ def main(argv: list[str] | None = None, out=None) -> int:
         return snap
 
     def _chip_snapshot() -> dict:
+        if args.aggregator:
+            # The fleet tier already fanned in and rolled up; one fetch
+            # renders the whole fleet whatever its size.
+            return aggregator_snapshot(args.aggregator, args.timeout)
         if args.url and len(args.url) > 1:
             return fleet_snapshot(args.url)
         if args.url:
@@ -801,6 +902,10 @@ def main(argv: list[str] | None = None, out=None) -> int:
     def emit(snap: dict) -> None:
         if args.json:
             print(json.dumps(snap, sort_keys=True), file=out)
+        elif "aggregator" in snap:
+            render_aggregator(snap, out)
+            if "workload" in snap:
+                render_workload(snap["workload"], lambda l="": print(l, file=out))
         elif "fleet" in snap:
             render_fleet(snap["fleet"], out)
             if "workload" in snap:

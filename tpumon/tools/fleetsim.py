@@ -1,0 +1,205 @@
+"""N-port fleet simulator: realistic exporter endpoints, one process.
+
+``tools/soak.py --fleet`` needs a 64-node fleet on a 2-core CI runner.
+Sixty-four real exporter interpreters oversubscribe such a box so badly
+that every measurement collapses into scheduler noise (measured: child
+response p50 ~50 ms from pure process-wakeup latency) — so the fleet is
+simulated instead: ONE process listens on N ports, each serving a
+distinct node identity (slice/host labels rewritten per port) over a
+genuine fake-backend exposition page that advances every
+``node_interval`` and carries a fresh
+``collector_last_poll_timestamp_seconds``. The aggregator under test
+does exactly the work it would against real nodes — N fetches/s, N
+parses/s, full rollup hierarchy — while the simulation costs a few
+percent of one core.
+
+Node death is scriptable over stdin (``kill N``): half the victims
+CLOSE their listeners (connection-refused path), half FREEZE — the
+listener keeps answering but the page (and its poll timestamp) stops
+advancing, the zombie-exporter shape the tier's data-age staleness
+exists to catch.
+
+Run standalone:
+    python -m tpumon.tools.fleetsim --nodes 64
+(prints ``PORTS p1 p2 ...`` when ready, then serves until EOF/``quit``.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+#: Nodes per simulated slice (8 hosts ≈ a v4-64 pod's host count).
+SLICE_SIZE = 8
+
+
+class FleetSim:
+    """The page store + N listeners. Thread model: a ticker thread
+    rewrites pages; handler threads read them under the lock; stdin
+    control runs on the caller's thread via :meth:`kill`/:meth:`close`."""
+
+    def __init__(
+        self, nodes: int, topology: str = "v4-8",
+        node_interval: float = 1.0, addr: str = "127.0.0.1",
+    ) -> None:
+        from tpumon.backends.fake import FakeTpuBackend
+        from tpumon.config import Config
+
+        self.nodes = nodes
+        self.node_interval = node_interval
+        self._backend = FakeTpuBackend.preset(topology)
+        self._cfg = Config()
+        base = self._backend.topology().base_labels()
+        self._orig_slice = f'slice="{base.get("slice", "")}"'
+        self._orig_host = f'host="{base.get("host", "")}"'
+        self._lock = threading.Lock()
+        self._pages: list[bytes] = [b""] * nodes  # guarded-by: self._lock
+        self._frozen: set[int] = set()  # guarded-by: self._lock
+        self._stop = threading.Event()
+        self.tick()  # pages exist before the first request can land
+
+        sim = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            node_index = 0  # overridden per server subclass below
+
+            def do_GET(self) -> None:
+                if self.path != "/metrics":
+                    self.send_error(404)
+                    return
+                with sim._lock:
+                    body = sim._pages[self.node_index]
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:
+                pass
+
+        self._servers: list[ThreadingHTTPServer] = []
+        self.ports: list[int] = []
+        for i in range(nodes):
+            handler = type("_H%d" % i, (_Handler,), {"node_index": i})
+            server = ThreadingHTTPServer((addr, 0), handler)
+            server.daemon_threads = True
+            self._servers.append(server)
+            self.ports.append(server.server_address[1])
+            threading.Thread(
+                target=server.serve_forever, kwargs={"poll_interval": 0.5},
+                name=f"fleetsim-{i}", daemon=True,
+            ).start()
+        self._ticker = threading.Thread(
+            target=self._run, name="fleetsim-ticker", daemon=True
+        )
+        self._ticker.start()
+
+    # -- page generation ---------------------------------------------------
+
+    def tick(self) -> None:
+        """Advance the fake backend one step and rewrite every live
+        node's page with its own identity + a fresh poll timestamp."""
+        from tpumon._native import render_families
+        from tpumon.exporter.collector import build_families
+
+        self._backend.advance()
+        families, _stats = build_families(self._backend, self._cfg)
+        template = render_families(tuple(families)).decode()
+        now = time.time()
+        stamp = (
+            "# TYPE collector_last_poll_timestamp_seconds gauge\n"
+            f"collector_last_poll_timestamp_seconds {now}\n"
+        )
+        with self._lock:
+            frozen = set(self._frozen)
+        pages = {}
+        for i in range(self.nodes):
+            if i in frozen:
+                continue
+            page = template.replace(
+                self._orig_slice, f'slice="sim-{i // SLICE_SIZE}"'
+            ).replace(self._orig_host, f'host="node-{i}"')
+            pages[i] = (page + stamp).encode()
+        with self._lock:
+            for i, body in pages.items():
+                self._pages[i] = body
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.node_interval):
+            self.tick()
+
+    # -- node death --------------------------------------------------------
+
+    def kill(self, n: int) -> list[str]:
+        """Kill the first ``n`` live nodes. Every victim's page (and
+        its poll timestamp) freezes — dead nodes produce no new data,
+        however they die. Odd victims additionally close their
+        listener (new connections refused); even ones keep answering
+        with the frozen page — the zombie-exporter shape. Established
+        keep-alive connections are untouched either way, exactly like a
+        real half-dead node: the aggregator must detect death from
+        DATA age, not transport failures."""
+        out = []
+        with self._lock:
+            live = [i for i in range(self.nodes) if i not in self._frozen]
+        for k, i in enumerate(live[:n]):
+            with self._lock:
+                self._frozen.add(i)
+            if k % 2 == 0:
+                out.append(f"froze node-{i} (zombie page)")
+            else:
+                server, self._servers[i] = self._servers[i], None
+                if server is not None:
+                    server.shutdown()
+                    server.server_close()
+                out.append(f"closed node-{i} (listener down, page frozen)")
+        return out
+
+    def close(self) -> None:
+        self._stop.set()
+        for server in self._servers:
+            if server is not None:
+                server.shutdown()
+                server.server_close()
+        self._ticker.join(timeout=2.0)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="tpumon-fleetsim", description=__doc__)
+    parser.add_argument("--nodes", type=int, default=64)
+    parser.add_argument("--topology", default="v4-8")
+    parser.add_argument("--node-interval", type=float, default=1.0,
+                        help="page-advance cadence seconds")
+    parser.add_argument("--addr", default="127.0.0.1")
+    args = parser.parse_args(argv)
+    sim = FleetSim(
+        args.nodes, topology=args.topology,
+        node_interval=args.node_interval, addr=args.addr,
+    )
+    print("PORTS " + " ".join(str(p) for p in sim.ports), flush=True)
+    try:
+        for line in sys.stdin:  # control protocol: "kill N" / "quit"
+            parts = line.split()
+            if not parts:
+                continue
+            if parts[0] == "quit":
+                break
+            if parts[0] == "kill" and len(parts) == 2:
+                for desc in sim.kill(int(parts[1])):
+                    print(desc, flush=True)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        sim.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,6 +13,7 @@ import sys
 
 from tpumon.families import (
     ANOMALY_FAMILIES,
+    FLEET_FAMILIES,
     HEALTH_FAMILIES,
     IDENTITY_FAMILIES,
     SELF_FAMILIES,
@@ -148,6 +149,26 @@ def render() -> str:
     ]
     for name, typ, desc in SELF:
         lines.append(f"| `{name}` | {typ} | {desc} |")
+
+    lines += [
+        "",
+        "## Fleet aggregation tier (`tpumon/fleet`, aggregator `/metrics`)",
+        "",
+        "Pre-aggregated node→slice→pool→fleet rollups served by the",
+        "shardable aggregator (`python -m tpumon.fleet`) — fleet dashboards",
+        "and alerts query this tier, not the DaemonSets, and per-node",
+        "series are never re-exported through it. Rollup families carry a",
+        "`scope` label (`slice` / `pool` / `fleet`; `pool` is the",
+        "accelerator-type label, `slice` the slice label — empty at wider",
+        "scopes). Configured via `TPUMON_FLEET_*` (see",
+        "docs/OPERATIONS.md).",
+        "",
+        "| family | type | description | labels |",
+        "|---|---|---|---|",
+    ]
+    for name, (kind, desc, labels) in FLEET_FAMILIES.items():
+        label_s = ", ".join(f"`{l}`" for l in labels) or "—"
+        lines.append(f"| `{name}` | {kind} | {desc} | {label_s} |")
 
     lines += [
         "",

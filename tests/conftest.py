@@ -53,6 +53,19 @@ def pytest_collection_modifyitems(config, items):
 
 
 @pytest.fixture
+def proc_tree(tmp_path):
+    """Hermetic fake procfs/cgroupfs tree for the host-correlation plane
+    (tpumon/hostcorr/fixture.py) — point the sampler at it via
+    ``HostSampler(proc_root=proc_tree.root)`` or
+    ``Config(hostcorr_proc_root=proc_tree.root)`` /
+    ``TPUMON_HOSTCORR_PROC_ROOT``, so hostcorr tests and CI run without
+    a PSI-capable kernel."""
+    from tpumon.hostcorr.fixture import FakeProcTree
+
+    return FakeProcTree(str(tmp_path / "procroot"))
+
+
+@pytest.fixture
 def scrape():
     """Return a helper that GETs a URL path and returns (status, text)."""
     import urllib.request

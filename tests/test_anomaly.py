@@ -383,6 +383,8 @@ class TestExporterIntegration:
         assert doc["status"] == "ok"
         assert doc["detectors"] == [
             "duty_ewma", "hbm_ewma", "ici_flap", "bw_cusum", "queue_stall",
+            # Cross-signal roster (tpumon/hostcorr), armed by default.
+            "host_straggler", "host_stall",
         ]
         # The armed-detector gauge is on the page even with zero events.
         _, text = scrape(exp.server.url + "/metrics")

@@ -1,0 +1,896 @@
+"""Host-correlation plane (tpumon/hostcorr): sampler over the hermetic
+fixture tree, cross-signal straggler attribution, graceful degradation
+without PSI/schedstat, the /hostcorr replay API, and the fleet rollup of
+straggler verdicts."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from tpumon.hostcorr import (
+    HostCorrPlane,
+    HostCorrThresholds,
+    HostSampler,
+    HostSignals,
+    StragglerJudge,
+    attribute_cause,
+    hostcorr_detectors,
+    parse_psi,
+)
+
+#: Deterministic thresholds for judge tests (no env dependence).
+T = HostCorrThresholds()
+
+
+def sample_twice(sampler, dt: float = 1.0):
+    """First sample primes the deltas; the second carries rates."""
+    t0 = time.time()
+    sampler.sample(t0)
+    return sampler.sample(t0 + dt)
+
+
+# -- sampler -----------------------------------------------------------------
+
+
+def test_parse_psi_full_and_some():
+    rows = parse_psi(
+        "some avg10=12.50 avg60=3.00 avg300=1.00 total=4500000\n"
+        "full avg10=2.00 avg60=0.50 avg300=0.10 total=900000\n"
+    )
+    assert rows["some"] == {"avg10": 12.5, "total_us": 4500000.0}
+    assert rows["full"]["avg10"] == 2.0
+
+
+def test_parse_psi_malformed_lines_skipped():
+    assert parse_psi("garbage\nsome avg10=nope total=1\n") == {}
+    assert parse_psi("") == {}
+
+
+def test_sampler_reads_all_groups(proc_tree):
+    proc_tree.set_pressure("cpu", some_avg10=30.0, some_total_us=1_000_000)
+    proc_tree.add_pod("aaaa1111-2222-4333-8444-555566667777", 201, 0)
+    sampler = HostSampler(proc_tree.root)
+    sig = sampler.sample(time.time())
+    assert sig.available
+    assert sig.groups == {
+        "psi": True, "sched": True, "net": True, "disk": True, "vm": True
+    }
+    assert sig.psi_share("cpu") == pytest.approx(0.30)
+    assert sig.psi["cpu"]["some"]["stall_s"] == pytest.approx(1.0)
+    assert "aaaa1111-2222-4333-8444-555566667777" in sig.sched
+    assert sig.page_cache_bytes == pytest.approx(1_000_000 * 1024.0)
+
+
+def test_sched_delay_delta_becomes_share(proc_tree):
+    uid = "bbbb1111-2222-4333-8444-555566667777"
+    proc_tree.add_pod(uid, 301, run_delay_ns=1_000_000_000)
+    sampler = HostSampler(proc_tree.root)
+    t0 = time.time()
+    sampler.sample(t0)
+    # +0.5 s of run delay over a 1 s wall window = share 0.5.
+    proc_tree.set_pod_delay(301, 1_500_000_000)
+    sig = sampler.sample(t0 + 1.0)
+    assert sig.sched[uid]["delay_s"] == pytest.approx(0.5)
+    assert sig.sched[uid]["share"] == pytest.approx(0.5)
+
+
+def test_sched_first_observation_contributes_no_delta(proc_tree):
+    uid = "cccc1111-2222-4333-8444-555566667777"
+    proc_tree.add_pod(uid, 401, run_delay_ns=9_000_000_000)
+    sampler = HostSampler(proc_tree.root)
+    sig = sample_twice(sampler)
+    # Pre-existing delay at first sight is a baseline, not a burst.
+    assert sig.sched[uid]["delay_s"] == pytest.approx(0.0)
+
+
+def test_net_and_disk_rates(proc_tree):
+    sampler = HostSampler(proc_tree.root)
+    t0 = time.time()
+    proc_tree.set_net(1000, 2000)
+    proc_tree.set_disk(100, 200)
+    sampler.sample(t0)
+    proc_tree.set_net(11000, 4000)
+    proc_tree.set_disk(300, 200)
+    sig = sampler.sample(t0 + 2.0)
+    assert sig.net_bps["rx"] == pytest.approx(5000.0)
+    assert sig.net_bps["tx"] == pytest.approx(1000.0)
+    assert sig.disk_bps["read"] == pytest.approx((200 * 512) / 2.0)
+    assert sig.disk_bps["write"] == pytest.approx(0.0)
+
+
+def test_net_excludes_virtual_interfaces(proc_tree):
+    """veth/bridge/tunnel counters would double-count every pod byte
+    the NIC already carried."""
+    sampler = HostSampler(proc_tree.root)
+    t0 = time.time()
+    virt = [("veth0abc", 0, 0), ("cni0", 0, 0), ("docker0", 0, 0)]
+    proc_tree.set_net(1000, 1000, extra_ifaces=tuple(virt))
+    sampler.sample(t0)
+    # eth0 +1000; each virtual interface "carries" the same bytes again.
+    virt2 = [(n, 1000, 1000) for n, _, _ in virt]
+    proc_tree.set_net(2000, 2000, extra_ifaces=tuple(virt2))
+    sig = sampler.sample(t0 + 1.0)
+    assert sig.net_bps["rx"] == pytest.approx(1000.0)
+    assert sig.net_bps["tx"] == pytest.approx(1000.0)
+
+
+def test_disk_excludes_stacked_devices(proc_tree):
+    """An LVM write increments both dm-0 and the backing sda — only the
+    physical layer counts."""
+    sampler = HostSampler(proc_tree.root)
+    t0 = time.time()
+    proc_tree.set_disk(100, 100, extra_devices=(("dm-0", 100, 100),))
+    sampler.sample(t0)
+    proc_tree.set_disk(300, 100, extra_devices=(("dm-0", 300, 100),))
+    sig = sampler.sample(t0 + 1.0)
+    assert sig.disk_bps["read"] == pytest.approx(200 * 512.0)
+
+
+def test_reclaim_rate(proc_tree):
+    sampler = HostSampler(proc_tree.root)
+    t0 = time.time()
+    proc_tree.set_vmstat(1000, 0)
+    sampler.sample(t0)
+    proc_tree.set_vmstat(2000, 500)
+    sig = sampler.sample(t0 + 1.0)
+    assert sig.reclaim_pps == pytest.approx(1500.0)
+
+
+def test_first_cycle_rates_absent(proc_tree):
+    sig = HostSampler(proc_tree.root).sample(time.time())
+    assert sig.net_bps == {"rx": None, "tx": None}
+    assert sig.reclaim_pps is None
+
+
+def test_missing_tree_degrades_to_unavailable(tmp_path):
+    sig = HostSampler(str(tmp_path / "nope")).sample(time.time())
+    assert not sig.available
+    assert not any(sig.groups.values())
+
+
+def test_psi_absent_marks_group_only(proc_tree):
+    proc_tree.remove_pressure()
+    sig = HostSampler(proc_tree.root).sample(time.time())
+    assert sig.available  # other groups still read
+    assert sig.groups["psi"] is False
+    assert sig.psi == {}
+
+
+def test_pod_regex_matches_both_cgroup_drivers():
+    from tpumon.hostcorr.sampler import _POD_RE
+
+    uid = "3b4f12ab-dead-beef-8000-000000000001"
+    shapes = [
+        # systemd driver: uid with underscores, QoS folded into the name.
+        "0::/kubepods.slice/kubepods-burstable.slice/"
+        f"kubepods-burstable-pod{uid.replace('-', '_')}.slice/cri-x.scope",
+        # cgroupfs driver: QoS class is its own path segment...
+        f"0::/kubepods/burstable/pod{uid}/abc",
+        f"0::/kubepods/besteffort/pod{uid}/abc",
+        # ...and guaranteed pods sit directly under /kubepods/.
+        f"0::/kubepods/pod{uid}/abc",
+    ]
+    for line in shapes:
+        m = _POD_RE.search(line)
+        assert m is not None, line
+        assert m.group(1).replace("_", "-") == uid, line
+
+
+def test_sampler_maps_cgroupfs_driver_pods(proc_tree):
+    uid = "dddd1111-2222-4333-8444-555566667777"
+    proc_tree.add_pod(uid, 501, run_delay_ns=0, driver="cgroupfs")
+    sig = HostSampler(proc_tree.root).sample(time.time())
+    assert uid in sig.sched
+
+
+def test_dead_pod_series_pruned_on_refresh(proc_tree):
+    uid = "eeee1111-2222-4333-8444-555566667777"
+    other = "ffff1111-2222-4333-8444-555566667777"
+    proc_tree.add_pod(uid, 601, run_delay_ns=0)
+    proc_tree.add_pod(other, 602, run_delay_ns=0)
+    sampler = HostSampler(proc_tree.root)
+    sampler.MAP_REFRESH_CYCLES = 2
+    t0 = time.time()
+    assert uid in sampler.sample(t0).sched
+    proc_tree.remove_pod(601)
+    # Between refreshes the accumulated counter survives (a dead pid is
+    # not yet a dead pod; the group stays available via the live pod)...
+    sig = sampler.sample(t0 + 1.0)
+    assert sig.groups["sched"]
+    assert uid in sig.sched
+    # ...but once the refresh scan shows the pod gone from the kubepods
+    # tree, its series leave the exposition (absent-not-zero).
+    sig = sampler.sample(t0 + 2.0)
+    assert uid not in sig.sched
+    assert other in sig.sched
+
+
+def test_sched_blackout_exports_no_zombie_series(proc_tree):
+    """When no pod pid's schedstat is readable, the sched group reads
+    unavailable AND its series leave the exposition — frozen counters
+    and zero shares under an unavailable flag would violate
+    absent-not-zero."""
+    uid = "abcd1111-2222-4333-8444-555566667777"
+    proc_tree.add_pod(uid, 701, run_delay_ns=10**9)
+    sampler = HostSampler(proc_tree.root)
+    t0 = time.time()
+    sig = sampler.sample(t0)
+    assert sig.groups["sched"]
+    assert uid in sig.sched
+    proc_tree.remove_pod(701)  # the only mapped pid: every read now fails
+    sig = sampler.sample(t0 + 1.0)
+    assert not sig.groups["sched"]
+    assert sig.sched == {}
+
+
+# -- attribution -------------------------------------------------------------
+
+
+def _host(cpu=0.0, mem=0.0, io=0.0, sched=None, reclaim=None, available=True):
+    sig = HostSignals(ts=0.0, available=available)
+    sig.psi = {
+        "cpu": {"some": {"share": cpu, "stall_s": 0.0}},
+        "memory": {"some": {"share": mem, "stall_s": 0.0}},
+        "io": {"some": {"share": io, "stall_s": 0.0}},
+    }
+    if sched is not None:
+        sig.sched = {"pod-1": {"delay_s": 1.0, "share": sched}}
+    sig.reclaim_pps = reclaim
+    return sig
+
+
+def test_attribute_cpu_pressure():
+    assert attribute_cause(_host(cpu=0.4), {}, T) == "host-cpu"
+
+
+def test_attribute_sched_delay_without_psi():
+    assert attribute_cause(_host(sched=0.5), {}, T) == "host-cpu"
+
+
+def test_attribute_memory_and_io():
+    assert attribute_cause(_host(mem=0.2), {}, T) == "host-mem"
+    assert attribute_cause(_host(reclaim=5000.0), {}, T) == "host-mem"
+    assert attribute_cause(_host(io=0.3), {}, T) == "host-io"
+
+
+def test_attribute_strongest_signal_wins():
+    sig = _host(cpu=0.9, io=0.06)  # cpu at 9x threshold, io at 1.2x
+    assert attribute_cause(sig, {"throttled": True}, T) == "host-cpu"
+
+
+def test_attribute_device_when_host_quiet():
+    assert attribute_cause(_host(), {"throttled": True}, T) == "device"
+
+
+def test_attribute_unknown_when_nothing_confesses():
+    assert attribute_cause(_host(), {}, T) == "unknown"
+
+
+def test_attribute_host_unavailable_falls_back_to_device_only():
+    # The graceful-degradation contract: no host signals → device-only
+    # attribution, never an error.
+    sig = _host(cpu=0.9, available=False)
+    assert attribute_cause(sig, {"throttled": True}, T) == "device"
+    assert attribute_cause(sig, {}, T) == "unknown"
+    assert attribute_cause(None, {}, T) == "unknown"
+
+
+# -- straggler judge ---------------------------------------------------------
+
+
+def _lagging(chip="0", lag=5.0, others=80.0, n=4):
+    duties = {str(i): others for i in range(n)}
+    duties[chip] = lag
+    return duties
+
+
+def test_judge_requires_streak():
+    judge = StragglerJudge()
+    for i in range(int(T.skew_cycles) - 1):
+        v = judge.judge(_lagging(), _host(cpu=0.5), {}, T)
+        assert not v["active"], i
+    v = judge.judge(_lagging(), _host(cpu=0.5), {}, T)
+    assert v["active"]
+    assert v["cause"] == "host-cpu"
+    assert v["chip"] == "0"
+    assert v["skew_pct"] == pytest.approx(75.0)
+
+
+def test_judge_worst_chip_must_be_stable():
+    judge = StragglerJudge()
+    # Alternating worst chip (noise) never onsets, whatever the skew.
+    for i in range(4 * int(T.skew_cycles)):
+        v = judge.judge(_lagging(chip=str(i % 2)), _host(), {}, T)
+        assert not v["active"], i
+
+
+def test_judge_idle_slice_never_stragglers():
+    judge = StragglerJudge()
+    for _ in range(3 * int(T.skew_cycles)):
+        v = judge.judge(_lagging(lag=0.0, others=10.0), _host(), {}, T)
+        assert not v["active"]
+
+
+def test_judge_single_chip_no_verdict():
+    v = StragglerJudge().judge({"0": 50.0}, _host(), {}, T)
+    assert not v["active"]
+    assert v["skew_pct"] is None
+
+
+def test_judge_clears_with_hysteresis():
+    judge = StragglerJudge()
+    for _ in range(int(T.skew_cycles)):
+        judge.judge(_lagging(), _host(), {}, T)
+    # Skew above warn/2 keeps the event active (hysteresis)...
+    v = judge.judge(
+        _lagging(lag=80.0 - 0.6 * T.skew_warn_pct), _host(), {}, T
+    )
+    assert v["active"]
+    # ...below warn/2 clears.
+    v = judge.judge(_lagging(lag=79.0), _host(), {}, T)
+    assert not v["active"]
+
+
+def test_judge_cause_sticky_through_decay():
+    # The hysteresis decay tail (host calm again, skew still above the
+    # clear threshold) must keep the cause the onset established — the
+    # retained event message and the events_total counter tell one story.
+    judge = StragglerJudge()
+    for _ in range(int(T.skew_cycles)):
+        v = judge.judge(_lagging(), _host(cpu=0.5), {}, T)
+    assert v["active"] and v["cause"] == "host-cpu"
+    v = judge.judge(
+        _lagging(lag=80.0 - 0.6 * T.skew_warn_pct), _host(), {}, T
+    )
+    assert v["active"]
+    assert v["cause"] == "host-cpu"
+    # The clear resets the episode: a fresh onset re-attributes.
+    judge.judge(_lagging(lag=79.0), _host(), {}, T)
+    for _ in range(int(T.skew_cycles)):
+        v = judge.judge(_lagging(), _host(), {"throttled": True}, T)
+    assert v["active"] and v["cause"] == "device"
+
+
+def test_zero_threshold_attributes_instead_of_dividing():
+    # TPUMON_HOSTCORR_CPU_SHARE=0 means "always attribute cpu", not a
+    # ZeroDivisionError killing the hostcorr stage every cycle.
+    t0 = HostCorrThresholds(cpu_share=0.0)
+    assert attribute_cause(_host(cpu=0.0), {}, t0) == "host-cpu"
+    assert attribute_cause(_host(io=0.9), {}, t0) == "host-cpu"
+
+
+def test_judge_device_cause_from_throttle():
+    judge = StragglerJudge()
+    for _ in range(int(T.skew_cycles)):
+        v = judge.judge(_lagging(), _host(), {"throttled": True}, T)
+    assert v["active"]
+    assert v["cause"] == "device"
+
+
+# -- anomaly-engine integration ----------------------------------------------
+
+
+def _snap(hostcorr_block, chips=None):
+    snap = {"chips": chips or {}}
+    snap["hostcorr"] = hostcorr_block
+    return snap
+
+
+def test_host_straggler_events_through_engine():
+    from tpumon.anomaly import AnomalyEngine
+
+    engine = AnomalyEngine(detectors=hostcorr_detectors())
+    active = {
+        "available": True,
+        "straggler": {
+            "active": True, "skew_pct": 60.0, "chip": "2",
+            "cause": "host-cpu", "streak": 7,
+        },
+    }
+    for ts in (1.0, 2.0):
+        engine.observe(ts, _snap(active))
+    events = engine.events()
+    assert len(events) == 1
+    ev = events[0]
+    assert ev["detector"] == "host_straggler"
+    assert ev["device"] == "chip:2"
+    assert "host-cpu" in ev["message"]
+    assert ev["clear_ts"] is None
+    # CRIT at >= 2x the warn skew.
+    assert ev["severity"] == "crit"
+
+    cleared = {"available": True, "straggler": {"active": False, "skew_pct": 1.0}}
+    engine.observe(3.0, _snap(cleared))
+    assert engine.events()[0]["clear_ts"] == 3.0
+
+
+def test_host_stall_detector_needs_pressure_and_flat_hbm():
+    from tpumon.anomaly import AnomalyEngine
+
+    engine = AnomalyEngine(detectors=hostcorr_detectors())
+    chips = {
+        "0": {"duty_pct": 0.0, "hbm_used": 8.0e9, "hbm_total": 16.0e9},
+        "1": {"duty_pct": 0.5, "hbm_used": 8.0e9, "hbm_total": 16.0e9},
+    }
+    pressured = {
+        "available": True,
+        "signals": {
+            "available": True,
+            "psi": {"cpu": {"some": {"share": 0.6, "stall_s": 1.0}}},
+            "sched": {},
+        },
+        "straggler": {"active": False},
+    }
+    for i in range(6):
+        engine.observe(float(i), _snap(pressured, chips=chips))
+    events = [
+        e for e in engine.events() if e["detector"] == "host_stall"
+    ]
+    assert len(events) == 1
+    assert "host-side stall" in events[0]["message"]
+
+
+def test_host_stall_thresholds_independent(monkeypatch):
+    """Raising cpu_share must quiet PSI-cpu even while sched_share stays
+    low — each signal checks ITS OWN threshold, not min() of the two."""
+    from tpumon.anomaly import AnomalyEngine
+
+    monkeypatch.setenv("TPUMON_HOSTCORR_CPU_SHARE", "0.5")
+    engine = AnomalyEngine(detectors=hostcorr_detectors())
+    chips = {
+        "0": {"duty_pct": 0.0, "hbm_used": 8.0e9, "hbm_total": 16.0e9},
+        "1": {"duty_pct": 0.5, "hbm_used": 8.0e9, "hbm_total": 16.0e9},
+    }
+    # PSI cpu 0.2: above the default sched_share (0.10) but below the
+    # raised cpu_share (0.5) — and there is no sched delay at all.
+    mild = {
+        "available": True,
+        "signals": {
+            "available": True,
+            "psi": {"cpu": {"some": {"share": 0.2, "stall_s": 1.0}}},
+            "sched": {},
+        },
+        "straggler": {"active": False},
+    }
+    for i in range(8):
+        engine.observe(float(i), _snap(mild, chips=chips))
+    assert [e for e in engine.events() if e["detector"] == "host_stall"] == []
+
+
+def test_host_stall_window_follows_stall_cycles_knob(monkeypatch):
+    """stall_cycles above the deque's initial capacity must grow the
+    HBM flatness window, not silently disable the detector."""
+    from tpumon.anomaly import AnomalyEngine
+
+    monkeypatch.setenv("TPUMON_HOSTCORR_STALL_CYCLES", "20")
+    engine = AnomalyEngine(detectors=hostcorr_detectors())
+    chips = {
+        "0": {"duty_pct": 0.0, "hbm_used": 8.0e9, "hbm_total": 16.0e9},
+        "1": {"duty_pct": 0.5, "hbm_used": 8.0e9, "hbm_total": 16.0e9},
+    }
+    pressured = {
+        "available": True,
+        "signals": {
+            "available": True,
+            "psi": {"cpu": {"some": {"share": 0.6, "stall_s": 1.0}}},
+            "sched": {},
+        },
+        "straggler": {"active": False},
+    }
+    # ~2x the window: `window` cycles fill the flatness deque, then the
+    # streak itself must reach `window` stalled cycles.
+    for i in range(45):
+        engine.observe(float(i), _snap(pressured, chips=chips))
+    events = [e for e in engine.events() if e["detector"] == "host_stall"]
+    assert len(events) == 1
+
+
+def test_host_stall_event_anchors_to_triggering_resource():
+    """An io-driven stall's event must point its history window at the
+    io PSI series, not a hardcoded cpu one."""
+    from tpumon.hostcorr.detectors import HostStallDetector
+
+    det = HostStallDetector()
+    chips = {
+        "0": {"duty_pct": 0.0, "hbm_used": 8.0e9, "hbm_total": 16.0e9},
+        "1": {"duty_pct": 0.5, "hbm_used": 8.0e9, "hbm_total": 16.0e9},
+    }
+    io_pressured = {
+        "available": True,
+        "signals": {
+            "available": True,
+            "psi": {"io": {"some": {"share": 0.5, "stall_s": 1.0}}},
+            "sched": {},
+        },
+        "straggler": {"active": False},
+    }
+    readings = []
+    for i in range(6):
+        readings = det.observe(float(i), _snap(io_pressured, chips=chips), None)
+    assert readings and readings[0].active
+    assert ("resource", "io") in readings[0].label_match
+    assert "io pressure" in readings[0].message
+
+
+def test_host_pressure_ranks_by_threshold_ratio():
+    """host_stall must attribute the same cause attribute_cause would:
+    ranked by signal/threshold ratio, with reclaim counted as memory."""
+    from tpumon.hostcorr.detectors import HostStallDetector
+
+    # cpu 0.12 (1.2x its 0.10 threshold) vs memory 0.11 (2.2x its 0.05
+    # threshold): memory wins on ratio even though cpu's raw share is
+    # higher — matching attribute_cause on the same state.
+    host = {
+        "psi": {
+            "cpu": {"some": {"share": 0.12, "stall_s": 0.0}},
+            "memory": {"some": {"share": 0.11, "stall_s": 0.0}},
+        },
+        "sched": {},
+    }
+    share, cause, signal, pod = HostStallDetector._host_pressure(host, T)
+    assert cause == "host-mem"
+    assert share == pytest.approx(0.11)
+    assert signal == "psi-mem"
+    assert pod is None
+    # A reclaim-only memory stall (PSI memory quiet) is still host-mem,
+    # and the winning signal (and its value) is the reclaim rate — not
+    # the quiet PSI series.
+    reclaiming = {"psi": {}, "sched": {}, "reclaim_pps": 5000.0}
+    value, cause, signal, _ = HostStallDetector._host_pressure(reclaiming, T)
+    assert cause == "host-mem"
+    assert signal == "reclaim"
+    assert value == pytest.approx(5000.0)
+
+
+def test_host_stall_quiet_host_no_event():
+    from tpumon.anomaly import AnomalyEngine
+
+    engine = AnomalyEngine(detectors=hostcorr_detectors())
+    chips = {
+        "0": {"duty_pct": 0.0, "hbm_used": 8.0e9, "hbm_total": 16.0e9},
+        "1": {"duty_pct": 0.5, "hbm_used": 8.0e9, "hbm_total": 16.0e9},
+    }
+    calm = {
+        "available": True,
+        "signals": {"available": True, "psi": {}, "sched": {}},
+        "straggler": {"active": False},
+    }
+    for i in range(8):
+        engine.observe(float(i), _snap(calm, chips=chips))
+    assert [e for e in engine.events() if e["detector"] == "host_stall"] == []
+
+
+# -- plane -------------------------------------------------------------------
+
+
+class _Stats:
+    def __init__(self, snapshot):
+        self.snapshot = snapshot
+        self.base_keys = ("slice", "host")
+        self.base_vals = ("s0", "h0")
+        self.degraded = False
+
+
+def _plane_cycle(plane, snapshot, ts):
+    stats = _Stats(snapshot)
+    fams = plane.cycle(ts, stats)
+    return {f.name: f for f in fams}, stats
+
+
+def test_plane_families_and_injection(proc_tree):
+    plane = HostCorrPlane(proc_root=proc_tree.root, ring=8)
+    snapshot = {"chips": {"0": {"duty_pct": 80.0}, "1": {"duty_pct": 20.0}}}
+    fams, stats = _plane_cycle(plane, snapshot, 100.0)
+    assert fams["tpu_hostcorr_available"].samples[0].value == 1.0
+    groups = {
+        s.labels["signal"]: s.value
+        for s in fams["tpu_hostcorr_signal_available"].samples
+    }
+    assert groups == {
+        "psi": 1.0, "sched": 1.0, "net": 1.0, "disk": 1.0, "vm": 1.0
+    }
+    assert "tpu_straggler_skew_pct" in fams
+    # median(80, 20) = 50; worst 20 → skew 30.
+    assert fams["tpu_straggler_skew_pct"].samples[0].value == pytest.approx(
+        30.0
+    )
+    # The cross-signal block rides the snapshot for the anomaly engine.
+    assert stats.snapshot["hostcorr"]["available"] is True
+    assert stats.snapshot["hostcorr"]["straggler"]["skew_pct"] == pytest.approx(30.0)
+
+
+def test_plane_unavailable_tree_reports_zero(tmp_path):
+    plane = HostCorrPlane(proc_root=str(tmp_path / "missing"), ring=8)
+    fams, stats = _plane_cycle(plane, {"chips": {}}, 1.0)
+    assert fams["tpu_hostcorr_available"].samples[0].value == 0.0
+    # Signal families absent — absent-not-zero.
+    assert "tpu_hostcorr_psi_share" not in fams
+    assert stats.snapshot["hostcorr"]["available"] is False
+
+
+def test_plane_verdict_family_and_events(proc_tree, monkeypatch):
+    monkeypatch.setenv("TPUMON_HOSTCORR_SKEW_CYCLES", "2")
+    proc_tree.set_pressure("io", some_avg10=40.0)
+    plane = HostCorrPlane(proc_root=proc_tree.root, ring=8)
+    snapshot = {"chips": {"0": {"duty_pct": 80.0}, "1": {"duty_pct": 5.0}}}
+    for i in range(3):
+        fams, _ = _plane_cycle(plane, dict(snapshot), float(i))
+    verdict = fams["tpu_straggler_verdict"].samples[0]
+    assert verdict.labels["cause"] == "host-io"
+    assert verdict.labels["chip"] == "1"
+    # prometheus_client strips the _total suffix from the family object;
+    # the wire name stays tpu_straggler_events_total.
+    totals = {
+        s.labels["cause"]: s.value
+        for s in fams["tpu_straggler_events"].samples
+        if not s.name.endswith("_created")
+    }
+    assert totals == {"host-io": 1.0}
+
+
+def test_plane_ring_replay_and_resize(proc_tree):
+    plane = HostCorrPlane(proc_root=proc_tree.root, ring=4)
+    for i in range(8):
+        _plane_cycle(plane, {"chips": {}}, float(i))
+    doc, records = plane.replay(0.0)
+    assert doc["cycles"] == 8
+    assert [r["ts"] for r in records] == [4.0, 5.0, 6.0, 7.0]
+    _, since = plane.replay(6.0)
+    assert [r["ts"] for r in since] == [6.0, 7.0]
+    plane.resize(2)
+    _, shrunk = plane.replay(0.0)
+    assert len(shrunk) == 2
+    plane.resize(4)
+    assert plane.snapshot()["ring_capacity"] == 4
+
+
+# -- exporter end-to-end -----------------------------------------------------
+
+
+@pytest.fixture
+def exporter(proc_tree):
+    from tpumon.backends.fake import FakeTpuBackend
+    from tpumon.config import Config
+    from tpumon.exporter.server import build_exporter
+
+    proc_tree.add_pod("dddd1111-2222-4333-8444-555566667777", 501, 0)
+    cfg = Config(
+        port=0, addr="127.0.0.1", interval=0.2,
+        hostcorr_proc_root=proc_tree.root, hostcorr_ring=64,
+    )
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v4-8"))
+    exp.start()
+    try:
+        yield exp
+    finally:
+        exp.close()
+
+
+def _get_json(exp, path):
+    with urllib.request.urlopen(f"{exp.server.url}{path}", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_exporter_serves_hostcorr_families(exporter):
+    exporter.poller.poll_once()
+    page = urllib.request.urlopen(
+        f"{exporter.server.url}/metrics", timeout=10
+    ).read().decode()
+    assert 'tpu_hostcorr_signal_available{' in page
+    assert "tpu_hostcorr_psi_share{" in page
+    assert "tpu_hostcorr_sched_delay_seconds_total{" in page
+    assert "tpu_straggler_skew_pct{" in page
+    # Availability is a labeled gauge reading 1 on the fixture tree.
+    assert 'signal="psi"' in page
+
+
+def test_exporter_hostcorr_replay_api(exporter):
+    for _ in range(3):
+        exporter.poller.poll_once()
+    doc = _get_json(exporter, "/hostcorr")
+    assert doc["available"] is True
+    assert doc["records"]
+    rec = doc["records"][-1]
+    assert set(rec) == {"ts", "host", "device", "straggler"}
+    assert rec["host"]["groups"]["psi"] is True
+    # since-replay honors the timestamp filter; bad since is a 400.
+    later = _get_json(exporter, f"/hostcorr?since={rec['ts']}")
+    assert all(r["ts"] >= rec["ts"] for r in later["records"])
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _get_json(exporter, "/hostcorr?since=nan")
+    assert err.value.code == 400
+
+
+def test_exporter_debug_vars_and_detector_roster(exporter):
+    doc = _get_json(exporter, "/debug/vars")
+    assert doc["hostcorr"]["available"] is True
+    assert doc["anomaly"]["detectors"][-2:] == ["host_straggler", "host_stall"]
+
+
+def test_exporter_history_records_hostcorr_series(exporter):
+    for _ in range(3):
+        exporter.poller.poll_once()
+    doc = _get_json(exporter, "/history")
+    assert any(k.startswith("tpu_straggler_skew_pct") for k in doc["series"])
+
+
+def test_hostcorr_disabled_no_surface(proc_tree):
+    from tpumon.backends.fake import FakeTpuBackend
+    from tpumon.config import Config
+    from tpumon.exporter.server import build_exporter
+
+    cfg = Config(port=0, addr="127.0.0.1", interval=0.2, hostcorr=False)
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v4-8"))
+    exp.start()
+    try:
+        page = urllib.request.urlopen(
+            f"{exp.server.url}/metrics", timeout=10
+        ).read().decode()
+        # No hostcorr series or declarations — prose mentions in OTHER
+        # families' HELP text (host_network_bytes_total cross-references
+        # the hostcorr rate) are fine.
+        assert not any(
+            line.startswith(("tpu_hostcorr", "tpu_straggler"))
+            or line.startswith(
+                ("# TYPE tpu_hostcorr", "# TYPE tpu_straggler")
+            )
+            for line in page.splitlines()
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get_json(exp, "/hostcorr")
+        assert err.value.code == 404
+    finally:
+        exp.close()
+
+
+def test_smi_snapshot_and_render_straggler(exporter, monkeypatch):
+    import io
+
+    from tpumon import smi
+
+    monkeypatch.setenv("TPUMON_HOSTCORR_SKEW_CYCLES", "1")
+    exporter.poller.poll_once()
+    page = urllib.request.urlopen(
+        f"{exporter.server.url}/metrics", timeout=10
+    ).read().decode()
+    snap = smi.snapshot_from_text(page)
+    assert snap["hostcorr_available"] is True
+    assert "skew_pct" in snap.get("straggler", {})
+    # Render a synthetic active verdict — the STRAGGLER line must show.
+    snap["straggler"] = {
+        "active": True, "cause": "host-cpu", "chip": "3", "skew_pct": 42.0
+    }
+    out = io.StringIO()
+    smi.render(snap, out=out)
+    assert "STRAGGLER: chip 3" in out.getvalue()
+    assert "host-cpu" in out.getvalue()
+
+
+def test_doctor_prints_hostcorr_line(proc_tree, capsys):
+    import io
+
+    from tpumon import doctor
+    from tpumon.backends.fake import FakeTpuBackend
+    from tpumon.config import Config
+
+    out = io.StringIO()
+    cfg = Config(hostcorr_proc_root=proc_tree.root)
+    rc = doctor.run(
+        cfg, out=out, backend=FakeTpuBackend.preset("v4-8", ici_flake=0.0)
+    )
+    text = out.getvalue()
+    assert rc == 0
+    assert "host correlation: enabled" in text
+    assert "psi=ok" in text
+    assert "host_straggler" in text  # roster line includes the new detectors
+
+
+def test_doctor_reports_absent_host_signals(tmp_path):
+    import io
+
+    from tpumon import doctor
+    from tpumon.backends.fake import FakeTpuBackend
+    from tpumon.config import Config
+
+    out = io.StringIO()
+    cfg = Config(hostcorr_proc_root=str(tmp_path / "missing"))
+    doctor.run(
+        cfg, out=out, backend=FakeTpuBackend.preset("v4-8", ici_flake=0.0)
+    )
+    assert "NO host signals readable" in out.getvalue()
+
+
+# -- fleet rollup ------------------------------------------------------------
+
+
+def _node(pool, slc, straggler=None):
+    snap = {
+        "identity": {"accelerator": pool, "slice": slc},
+        "chips": {"0": {"duty_pct": 50.0}},
+    }
+    if straggler is not None:
+        snap["straggler"] = straggler
+    return {"snap": snap, "state": "up"}
+
+
+def test_fleet_rollup_counts_stragglers_by_cause():
+    from tpumon.fleet.rollup import fleet_families, rollup
+
+    doc = rollup(
+        [
+            _node("v5p", "s0", {"active": True, "cause": "host-cpu",
+                                "skew_pct": 44.0}),
+            _node("v5p", "s0", {"active": True, "cause": "device",
+                                "skew_pct": 30.0}),
+            _node("v5p", "s1", {"active": False, "skew_pct": 5.0}),
+            _node("v5e", "s2"),
+        ]
+    )
+    fleet = doc["fleet"]
+    assert fleet["stragglers"] == {"host-cpu": 1, "device": 1}
+    assert fleet["straggler_skew_max_pct"] == pytest.approx(44.0)
+    assert doc["pools"]["v5e"].get("stragglers") is None
+
+    fams = {f.name: f for f in fleet_families(doc)}
+    rows = {
+        (s.labels["scope"], s.labels["pool"], s.labels["slice"],
+         s.labels["cause"]): s.value
+        for s in fams["tpu_fleet_stragglers"].samples
+    }
+    assert rows[("fleet", "", "", "host-cpu")] == 1.0
+    assert rows[("slice", "v5p", "s0", "device")] == 1.0
+    skews = {
+        (s.labels["scope"], s.labels["pool"]): s.value
+        for s in fams["tpu_fleet_straggler_skew_pct"].samples
+    }
+    assert skews[("fleet", "")] == pytest.approx(44.0)
+
+
+def test_fleet_ingest_parses_straggler_lines():
+    from tpumon.fleet.ingest import node_snapshot_from_text
+
+    page = (
+        'accelerator_info{slice="s0",host="h0",accelerator="v5p",'
+        'worker="0",chip="0",coords="0,0,0",device_id="d0",cores="2"} 1.0\n'
+        "tpu_hostcorr_available{slice=\"s0\",host=\"h0\"} 1.0\n"
+        "tpu_straggler_skew_pct{slice=\"s0\",host=\"h0\"} 33.5\n"
+        'tpu_straggler_verdict{slice="s0",host="h0",cause="host-io",'
+        'chip="2"} 1.0\n'
+    )
+    snap = node_snapshot_from_text(page)
+    assert snap["hostcorr_available"] is True
+    assert snap["straggler"] == {
+        "active": True, "skew_pct": 33.5, "cause": "host-io", "chip": "2"
+    }
+
+
+def test_fleet_ingest_skew_without_verdict_stays_inactive():
+    from tpumon.fleet.ingest import node_snapshot_from_text
+
+    snap = node_snapshot_from_text(
+        "tpu_straggler_skew_pct{slice=\"s0\"} 3.0\n"
+    )
+    assert snap["straggler"] == {"active": False, "skew_pct": 3.0}
+
+
+# -- registry / docs coherence ----------------------------------------------
+
+
+def test_hostcorr_families_registered_and_documented():
+    from tpumon.families import HOSTCORR_FAMILIES, all_family_names
+
+    assert set(HOSTCORR_FAMILIES) <= all_family_names()
+    with open("docs/METRICS.md", encoding="utf-8") as fh:
+        doc = fh.read()
+    for name in HOSTCORR_FAMILIES:
+        assert name in doc, name
+
+
+def test_guard_classifies_hostcorr_as_debug():
+    from tpumon.guard.ingress import IngressGuard
+
+    assert IngressGuard.classify("/hostcorr") == ("hostcorr", "debug")

@@ -221,6 +221,7 @@ PIPELINE_PREFIXES = (
     "tpumon/trace/",
     "tpumon/anomaly/",
     "tpumon/fleet/",
+    "tpumon/hostcorr/",
     "tpumon/history.py",
 )
 

@@ -44,6 +44,7 @@ _REGISTRY_DICTS = {
     "IDENTITY_FAMILIES",
     "HEALTH_FAMILIES",
     "ANOMALY_FAMILIES",
+    "HOSTCORR_FAMILIES",
     "SELF_FAMILIES",
     "FLEET_FAMILIES",
     "WORKLOAD_FAMILIES",
@@ -55,6 +56,7 @@ _REGISTRY_DICTS = {
 #: metric names appear in prose).
 _METRIC_RE = re.compile(
     r"\b(?:(?:accelerator|exporter|collector|workload|host|tpu_anomaly"
+    r"|tpu_hostcorr|tpu_straggler"
     r"|tpu_fleet|tpumon_trace|tpumon_poll|tpumon_family|tpumon_breaker"
     r"|tpumon_retries|tpumon_watchdog|tpumon_guard|tpumon_shed"
     r"|tpumon_cardinality)_[a-z0-9_]+"
@@ -72,6 +74,7 @@ _EMIT_PREFIXES = (
     "tpumon/attribution/",
     "tpumon/discovery/",
     "tpumon/fleet/",
+    "tpumon/hostcorr/",
     "tpumon/workload/",
 )
 

@@ -145,6 +145,17 @@ class Config:
     #: Fault-injection spec (TPUMON_FAULTS, tpumon/resilience/faults.py)
     #: wrapping the selected backend — chaos testing only; empty = off.
     faults: str = ""
+    #: Host-correlation plane (tpumon/hostcorr): 1 Hz procfs/cgroupfs
+    #: host-signal sampling (cgroup PSI, per-pod sched delay, net/io
+    #: rates, page-cache pressure) time-aligned with the poll stream,
+    #: cross-signal straggler attribution (tpu_straggler_*), /hostcorr.
+    hostcorr: bool = True
+    #: Root prepended to every procfs/cgroupfs path the hostcorr sampler
+    #: reads; empty = the real / (tests point it at a fixture tree).
+    hostcorr_proc_root: str = ""
+    #: Correlation-ring capacity (one joined host+device record per poll
+    #: cycle, served by /hostcorr).
+    hostcorr_ring: int = 600
     #: Self-protection plane (tpumon/guard): scrape admission control,
     #: request deadlines, cardinality governor, and memory watermarks.
     #: Off restores the unguarded serving paths (replay-response bounds
@@ -253,6 +264,12 @@ class Config:
                 "WATCHDOG_HANG_S", base.watchdog_hang_s
             ),
             faults=_env("FAULTS", base.faults) or base.faults,
+            hostcorr=_env_bool("HOSTCORR", base.hostcorr),
+            hostcorr_proc_root=_env(
+                "HOSTCORR_PROC_ROOT", base.hostcorr_proc_root
+            )
+            or base.hostcorr_proc_root,
+            hostcorr_ring=_env_int("HOSTCORR_RING", base.hostcorr_ring),
             guard=_env_bool("GUARD", base.guard),
             guard_metrics_inflight=_env_int(
                 "GUARD_METRICS_INFLIGHT", base.guard_metrics_inflight
@@ -368,6 +385,17 @@ class Config:
             "--faults",
             help="fault-injection spec (chaos testing), e.g. "
             "error_rate=0.3,hang_every=20,hang_s=10",
+        )
+        g.add_argument(
+            "--hostcorr-proc-root",
+            help="root prepended to the procfs/cgroupfs paths the "
+            "host-correlation sampler reads (fixture trees, tests)",
+        )
+        g.add_argument(
+            "--hostcorr-ring",
+            type=int,
+            help="correlation-ring capacity for /hostcorr (one joined "
+            "host+device record per poll cycle)",
         )
         g.add_argument(
             "--guard-soft-rss-mb",

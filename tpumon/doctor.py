@@ -257,13 +257,53 @@ def run(cfg: Config, out=sys.stdout, backend=None) -> int:
         if cfg.anomaly:
             from tpumon.anomaly import DETECTOR_NAMES
 
+            roster = list(DETECTOR_NAMES)
+            if cfg.hostcorr:
+                from tpumon.hostcorr import HOSTCORR_DETECTOR_NAMES
+
+                roster += list(HOSTCORR_DETECTOR_NAMES)
             p(
                 "anomaly detection: enabled (detectors: "
-                + ", ".join(DETECTOR_NAMES)
+                + ", ".join(roster)
                 + "; verdicts stream from the exporter's GET /anomalies)"
             )
         else:
             p("anomaly detection: disabled (TPUMON_ANOMALY=0)")
+
+        # Host-correlation plane (tpumon/hostcorr): probe the host-signal
+        # groups once — the "would straggler attribution work on this
+        # node" answer. Procfs reads only, zero device queries; live
+        # verdicts come from the exporter's GET /hostcorr.
+        if cfg.hostcorr:
+            import time as _time
+
+            from tpumon.hostcorr import SIGNAL_GROUPS, HostSampler
+
+            probe = HostSampler(cfg.hostcorr_proc_root)
+            host_sig = probe.sample(_time.time())
+            group_s = ", ".join(
+                f"{g}={'ok' if host_sig.groups.get(g) else 'ABSENT'}"
+                for g in SIGNAL_GROUPS
+            )
+            root_s = (
+                f" (proc root {cfg.hostcorr_proc_root})"
+                if cfg.hostcorr_proc_root
+                else ""
+            )
+            if host_sig.available:
+                pods = len(host_sig.sched)
+                p(
+                    f"host correlation: enabled — {group_s}; "
+                    f"{pods} kubepods pod(s) mapped{root_s}"
+                )
+            else:
+                p(
+                    "host correlation: enabled but NO host signals "
+                    f"readable ({group_s}){root_s} — straggler verdicts "
+                    "degrade to device-only attribution"
+                )
+        else:
+            p("host correlation: disabled (TPUMON_HOSTCORR=0)")
 
         # Invariant analyzer (tpumon/analysis, docs/INVARIANTS.md): the
         # last `python -m tpumon.tools.check` verdict + its age, so an

@@ -478,6 +478,7 @@ class Poller:
         resilience=None,
         watchdog=None,
         governor=None,
+        hostcorr=None,
     ) -> None:
         self._backend = backend
         self._cfg = cfg
@@ -491,6 +492,7 @@ class Poller:
         self._resilience = resilience
         self._watchdog = watchdog
         self._governor = governor
+        self._hostcorr = hostcorr
         #: Staleness-gauge label reconciliation (tpumon/resilience).
         self._stale_labeled: set[str] = set()
         #: Last-seen backend retry counters (delta-fed into telemetry).
@@ -540,13 +542,32 @@ class Poller:
                 self._histograms, resilience=self._resilience,
                 watchdog=self._watchdog,
             )
+        now = time.time()
+        if self._hostcorr is not None:
+            # Host-correlation plane (tpumon/hostcorr): procfs/cgroupfs
+            # sampling time-aligned with THIS cycle's device snapshot —
+            # zero device queries. Runs before the governor (its per-pod
+            # series ride the same cardinality budget), before history
+            # (so tpu_hostcorr_*/tpu_straggler_* series are in the 1 Hz
+            # flight recorder), and before anomaly (the cross-signal
+            # detectors read the hostcorr block it injects into
+            # stats.snapshot).
+            with trace_span("hostcorr") as sp:
+                try:
+                    families.extend(self._hostcorr.cycle(now, stats))
+                except Exception:
+                    log.exception("host correlation failed")
+                    if sp is not None:
+                        sp.status = "error"
+                    self._telemetry.poll_stage_errors.labels(
+                        stage="hostcorr"
+                    ).inc()
         if self._governor is not None:
             # Per-family cardinality budget (tpumon/guard/cardinality):
             # runs BEFORE history/anomaly/publish so an exploding family
             # is bounded everywhere downstream, not just on the page.
             with trace_span("guard"):
                 self._governor.govern(families, stats.base_keys)
-        now = time.time()
         if self._history is not None:
             # Flight recorder (DCGM field-cache analogue): keep the 1 Hz
             # series Prometheus's 15-60 s scrape interval aliases away.

@@ -50,8 +50,10 @@ HOST_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
     ),
     "host_network_bytes_total": (
         "counter",
-        "Host network bytes since boot by direction — DCN saturation "
-        "context for transfer-latency spikes",
+        "Host network bytes since boot by direction, summed over ALL "
+        "interfaces incl. lo/veth (psutil) — DCN saturation context; "
+        "tpu_hostcorr_net_bytes_per_second is the physical-NIC-only "
+        "rate, so the two deliberately disagree on pod-dense nodes",
         ("dir",),
     ),
 }

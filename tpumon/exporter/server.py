@@ -406,7 +406,8 @@ DEFAULT_REPLAY_MAX_BYTES = 1 << 20
 def _make_app(
     render_body, telemetry: SelfTelemetry, health, history=None,
     device_health=None, post_scrape=None, anomalies=None, tracer=None,
-    debug_vars=None, replay_max_items=DEFAULT_REPLAY_MAX_ITEMS,
+    debug_vars=None, hostcorr=None,
+    replay_max_items=DEFAULT_REPLAY_MAX_ITEMS,
     replay_max_bytes=DEFAULT_REPLAY_MAX_BYTES,
 ):
     """WSGI app. ``render_body(want_gzip: bool) -> bytes`` produces the
@@ -416,8 +417,9 @@ def _make_app(
     the /history JSON endpoint; ``device_health`` (a () -> dict callable)
     enables /health/devices (the dcgmi-health analogue); ``anomalies``
     (a tpumon.anomaly.AnomalyEngine) enables /anomalies; ``tracer``
-    (a tpumon.trace.Tracer) enables /debug/traces[/slow] and
-    ``debug_vars`` (a () -> dict callable) /debug/vars. ``post_scrape``
+    (a tpumon.trace.Tracer) enables /debug/traces[/slow],
+    ``debug_vars`` (a () -> dict callable) /debug/vars, and ``hostcorr``
+    (a tpumon.hostcorr.HostCorrPlane) /hostcorr. ``post_scrape``
     (if set) runs after the duration observation — the exporter uses it
     to poke the off-path self-telemetry renderer."""
 
@@ -441,6 +443,19 @@ def _make_app(
             body = _json_dump(debug_vars())
             start_response(
                 "200 OK",
+                [
+                    ("Content-Type", "application/json; charset=utf-8"),
+                    ("Content-Length", str(len(body))),
+                ],
+            )
+            return [body]
+        if path == "/hostcorr" and hostcorr is not None:
+            body, status = _hostcorr_response(
+                hostcorr, environ.get("QUERY_STRING", ""),
+                max_items=replay_max_items, max_bytes=replay_max_bytes,
+            )
+            start_response(
+                status,
                 [
                     ("Content-Type", "application/json; charset=utf-8"),
                     ("Content-Length", str(len(body))),
@@ -546,13 +561,10 @@ def _history_response(history, query_string: str) -> tuple[bytes, str]:
     ``since`` and ``window`` share one validator (module-level
     ``_finite``): NaN/inf/negative values are a 400, never coerced.
     """
-    from urllib.parse import parse_qs
-
-    params = parse_qs(query_string)
+    params, since = _parse_since(query_string)
     now = time.time()
     key = params.get("series", [None])[0]
     if key is not None:
-        since = _finite(params.get("since", ["0"])[0])
         if since is None:
             return b'{"error": "bad since"}\n', "400 Bad Request"
         points = history.query(key, since)
@@ -573,6 +585,34 @@ def _history_response(history, query_string: str) -> tuple[bytes, str]:
         }
     )
     return body, "200 OK"
+
+
+def _parse_since(query_string: str):
+    """(params, since) for the replay endpoints — one ``_finite``
+    validator so /debug/traces, /anomalies, and /hostcorr can't drift on
+    what a bad ``since`` means (``None`` = caller answers 400)."""
+    from urllib.parse import parse_qs
+
+    params = parse_qs(query_string)
+    return params, _finite(params.get("since", ["0"])[0])
+
+
+def _bounded_replay(
+    doc: dict, items: list, items_key: str,
+    max_items: int, max_bytes: int, resume,
+) -> tuple[bytes, str]:
+    """Shared tail of every replay endpoint: bound the item list, stamp
+    ``now``/``truncated`` and the continuation token, serialize.
+    ``resume(kept, items)`` returns the ``(key, value)`` continuation
+    field for a truncated response."""
+    doc["now"] = time.time()
+    kept, truncated = _bounded_items(items, max_items, max_bytes)
+    doc[items_key] = kept
+    if truncated:
+        doc["truncated"] = True
+        key, value = resume(kept, items)
+        doc[key] = value
+    return _json_dump(doc), "200 OK"
 
 
 def _bounded_items(items: list, max_items: int, max_bytes: int):
@@ -616,25 +656,19 @@ def _traces_response(
       ``?since=`` to continue; a stale ``since`` can therefore never
       serialize the whole ring in one allocation.
     """
-    from urllib.parse import parse_qs
-
-    params = parse_qs(query_string)
-    since = _finite(params.get("since", ["0"])[0])
+    _, since = _parse_since(query_string)
     if since is None:
         return b'{"error": "bad since"}\n', "400 Bad Request"
     doc = tracer.counts()
-    doc["now"] = time.time()
     doc["slow_cycle_ms"] = tracer.slow_cycle_ms
-    items = tracer.traces(slow=slow, since=since)
-    kept, truncated = _bounded_items(items, max_items, max_bytes)
-    doc["traces"] = kept
-    if truncated:
-        doc["truncated"] = True
+    return _bounded_replay(
+        doc, tracer.traces(slow=slow, since=since), "traces",
+        max_items, max_bytes,
         # Traces are oldest-first with monotonically increasing end_ts;
         # the first excluded item's end_ts is an exact resume point for
         # the >= since filter.
-        doc["next_since"] = items[len(kept)]["end_ts"]
-    return _json_dump(doc), "200 OK"
+        lambda kept, items: ("next_since", items[len(kept)]["end_ts"]),
+    )
 
 
 def _anomalies_response(
@@ -658,10 +692,7 @@ def _anomalies_response(
       event id) — pass it back as ``?cursor=`` (combinable with
       ``since``) to fetch events with a greater id.
     """
-    from urllib.parse import parse_qs
-
-    params = parse_qs(query_string)
-    since = _finite(params.get("since", ["0"])[0])
+    params, since = _parse_since(query_string)
     if since is None:
         return b'{"error": "bad since"}\n', "400 Bad Request"
     cursor_raw = params.get("cursor", ["0"])[0]
@@ -671,15 +702,44 @@ def _anomalies_response(
         cursor = -1
     if cursor < 0:
         return b'{"error": "bad cursor"}\n', "400 Bad Request"
-    doc = engine.summary()
-    doc["now"] = time.time()
     events = [e for e in engine.events(since) if e["id"] > cursor]
-    kept, truncated = _bounded_items(events, max_items, max_bytes)
-    doc["events"] = kept
-    if truncated:
-        doc["truncated"] = True
-        doc["next_cursor"] = kept[-1]["id"]
-    return _json_dump(doc), "200 OK"
+    return _bounded_replay(
+        engine.summary(), events, "events", max_items, max_bytes,
+        lambda kept, items: ("next_cursor", kept[-1]["id"]),
+    )
+
+
+def _hostcorr_response(
+    plane, query_string: str,
+    max_items: int = DEFAULT_REPLAY_MAX_ITEMS,
+    max_bytes: int = DEFAULT_REPLAY_MAX_BYTES,
+) -> tuple[bytes, str]:
+    """The /hostcorr JSON API (poll-thread state, no device calls).
+
+    - ``GET /hostcorr`` → the correlation-ring replay plus the plane
+      envelope: ``{"now": ts, "cycles": n, "available": bool, "groups":
+      {psi: bool, ...}, "straggler": {active, skew_pct, chip, cause?},
+      "events_total": {cause: n}, "records": [{ts, host, device,
+      straggler}, ...]}`` — each record is one poll cycle's time-aligned
+      host+device join, oldest first.
+    - ``GET /hostcorr?since=<ts>`` → only records at/after ``ts`` — the
+      same replay semantics (and ``_finite`` validator) as /history and
+      /anomalies.
+    - Responses are BOUNDED: at most ``max_items`` records /
+      ``max_bytes`` payload. A truncated response carries
+      ``"truncated": true`` and ``"next_since"`` — pass it back as
+      ``?since=`` to continue.
+    """
+    _, since = _parse_since(query_string)
+    if since is None:
+        return b'{"error": "bad since"}\n', "400 Bad Request"
+    doc, records = plane.replay(since)
+    return _bounded_replay(
+        doc, records, "records", max_items, max_bytes,
+        # Records are oldest-first with monotonically increasing ts; the
+        # first excluded record's ts resumes the >= since filter exactly.
+        lambda kept, items: ("next_since", items[len(kept)]["ts"]),
+    )
 
 
 def registry_renderer(registry: CollectorRegistry):
@@ -877,16 +937,38 @@ class Exporter:
             from tpumon.exporter.histograms import PollHistograms
 
             self.histograms = PollHistograms()
+        self.hostcorr = None
+        if cfg.hostcorr:
+            from tpumon.hostcorr import HostCorrPlane
+
+            # Same malformed-knob stance as history_max_samples below.
+            ring = cfg.hostcorr_ring
+            if ring <= 0:
+                ring = type(cfg)().hostcorr_ring
+            self.hostcorr = HostCorrPlane(
+                proc_root=cfg.hostcorr_proc_root, ring=ring
+            )
         self.anomaly = None
         if cfg.anomaly:
             from tpumon.anomaly import AnomalyEngine
+            from tpumon.anomaly.detectors import default_detectors
 
             # Same malformed-knob stance as history_max_samples above.
             max_events = cfg.anomaly_events_max
             if max_events <= 0:
                 max_events = type(cfg)().anomaly_events_max
+            detectors = default_detectors()
+            if self.hostcorr is not None:
+                # Cross-signal detectors (tpumon/hostcorr/detectors.py)
+                # ride the same engine: onset/clear events, /anomalies
+                # replay, history windows — fed by the hostcorr block
+                # the plane injects into each cycle's snapshot.
+                from tpumon.hostcorr import hostcorr_detectors
+
+                detectors.extend(hostcorr_detectors())
             self.anomaly = AnomalyEngine(
-                history=self.history, max_events=max_events
+                history=self.history, max_events=max_events,
+                detectors=detectors,
             )
         self.tracer = None
         if cfg.trace:
@@ -1015,12 +1097,22 @@ class Exporter:
                     self.anomaly.set_max_events(full_events)
 
                 self.memwatch.add_hooks(shrink_anomaly, restore_anomaly)
+            if self.hostcorr is not None:
+                full_ring = self.hostcorr.ring_capacity
+
+                def shrink_hostcorr() -> None:
+                    self.hostcorr.resize(max(16, full_ring // 4))
+
+                def restore_hostcorr() -> None:
+                    self.hostcorr.resize(full_ring)
+
+                self.memwatch.add_hooks(shrink_hostcorr, restore_hostcorr)
         self.poller = Poller(
             backend, cfg, self.cache, self.telemetry, attribution,
             history=self.history, histograms=self.histograms,
             anomaly=self.anomaly, tracer=self.tracer,
             resilience=self.resilience, watchdog=self.watchdog,
-            governor=self.governor,
+            governor=self.governor, hostcorr=self.hostcorr,
         )
         version_fn = getattr(backend, "version", None)
         self.telemetry.backend_info.labels(
@@ -1068,7 +1160,7 @@ class Exporter:
             render, self.telemetry, self._health, self.history,
             self._device_health, post_scrape=self._selfpage.poke,
             anomalies=self.anomaly, tracer=self.tracer,
-            debug_vars=self._debug_vars,
+            debug_vars=self._debug_vars, hostcorr=self.hostcorr,
             replay_max_items=replay_items, replay_max_bytes=replay_bytes,
         )
         if self.guard is not None:
@@ -1188,6 +1280,8 @@ class Exporter:
             }
         if self.anomaly is not None:
             doc["anomaly"] = self.anomaly.summary()
+        if self.hostcorr is not None:
+            doc["hostcorr"] = self.hostcorr.snapshot()
         # Invariant-analyzer status (tpumon/analysis): operators can see
         # from the running exporter whether the shipped checkout's
         # cross-file discipline was proven, and against how many accepted

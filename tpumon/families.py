@@ -81,6 +81,105 @@ ANOMALY_FAMILIES: dict[str, tuple[str, tuple[str, ...]]] = {
     ),
 }
 
+#: family -> (prometheus type, description, extra labels) — the
+#: host-correlation plane (tpumon/hostcorr): 1 Hz procfs/cgroupfs host
+#: signals time-aligned with the poll stream, plus the cross-signal
+#: straggler verdict. ``tpu_hostcorr_available`` is always present while
+#: the plane is enabled (0 on hosts without PSI/schedstat — the
+#: graceful-degradation flag); every signal family is absent when its
+#: source is unreadable (absent-not-zero), and ``tpu_straggler_verdict``
+#: is absent unless a straggler is active.
+HOSTCORR_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
+    "tpu_hostcorr_available": (
+        "gauge",
+        "1 when the host-correlation sampler reads at least one host "
+        "signal group; 0 on kernels without PSI/schedstat — detectors "
+        "then fall back to device-only verdicts",
+        (),
+    ),
+    "tpu_hostcorr_signal_available": (
+        "gauge",
+        "Per-group host-signal availability (signal ∈ psi/sched/net/"
+        "disk/vm)",
+        ("signal",),
+    ),
+    "tpu_hostcorr_psi_share": (
+        "gauge",
+        "cgroup PSI avg10 stall share (0-1 fraction of wall time tasks "
+        "stalled on the resource; resource ∈ cpu/memory/io, kind ∈ "
+        "some/full)",
+        ("resource", "kind"),
+    ),
+    "tpu_hostcorr_psi_stall_seconds_total": (
+        "counter",
+        "Cumulative PSI stall seconds by resource and kind (the "
+        "kernel's total= counter)",
+        ("resource", "kind"),
+    ),
+    "tpu_hostcorr_sched_delay_seconds_total": (
+        "counter",
+        "Per-pod scheduler run delay (runnable-but-not-running) "
+        "accumulated from /proc/<pid>/schedstat since exporter start; "
+        "pod is the kubepods pod UID",
+        ("pod",),
+    ),
+    "tpu_hostcorr_sched_delay_share": (
+        "gauge",
+        "Per-pod sched-delay rate over the last poll cycle (delay "
+        "seconds per wall second; ~1.0 = a core's worth of waiting)",
+        ("pod",),
+    ),
+    "tpu_hostcorr_net_bytes_per_second": (
+        "gauge",
+        "Physical-NIC byte rate over the last poll cycle (dir ∈ rx/tx; "
+        "lo and virtual veth/bridge/tunnel interfaces excluded, so this "
+        "reads LOWER than the all-interface host_network_bytes_total on "
+        "pod-dense nodes) — DCN/input-pipeline saturation context",
+        ("dir",),
+    ),
+    "tpu_hostcorr_disk_bytes_per_second": (
+        "gauge",
+        "Physical whole-device disk byte rate over the last poll cycle "
+        "(dir ∈ read/write; partitions and dm/md stacked devices "
+        "excluded — one payload byte counts once) — checkpoint/"
+        "input-pipeline IO context",
+        ("dir",),
+    ),
+    "tpu_hostcorr_page_cache_bytes": (
+        "gauge",
+        "Host page-cache occupancy (/proc/meminfo Cached)",
+        (),
+    ),
+    "tpu_hostcorr_reclaim_pages_per_second": (
+        "gauge",
+        "Page-reclaim scan rate (pgscan_kswapd + pgscan_direct) over "
+        "the last poll cycle — page-cache pressure",
+        (),
+    ),
+    "tpu_straggler_skew_pct": (
+        "gauge",
+        "Worst-chip vs median duty-cycle skew in percentage points "
+        "(absent when fewer than 2 chips report duty)",
+        (),
+    ),
+    "tpu_straggler_verdict": (
+        "gauge",
+        "1 while a straggler is active: the same chip sat skew_warn_pct "
+        "below the slice median for skew_cycles consecutive polls; "
+        "cause ∈ device/host-cpu/host-mem/host-io/unknown, chip is the "
+        "laggard (absent when no straggler)",
+        ("cause", "chip"),
+    ),
+    "tpu_straggler_events_total": (
+        "counter",
+        "Straggler episodes since exporter start by attributed cause; "
+        "an episode is counted once its cause is established (onset, or "
+        "the later unknown→host-* upgrade; never-attributed episodes "
+        "count as unknown at clear)",
+        ("cause",),
+    ),
+}
+
 #: family -> (prometheus type, description, extra labels) — the fleet
 #: aggregation tier (tpumon/fleet): pre-aggregated recording-rule-style
 #: rollups served by the aggregator's /metrics, plus the aggregator's
@@ -143,6 +242,19 @@ FLEET_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "gauge",
         "Hosts in the scope whose exporter reports degraded serving "
         "(tpumon_degraded)",
+        ("scope", "pool", "slice"),
+    ),
+    "tpu_fleet_stragglers": (
+        "gauge",
+        "Hosts in the scope with an active straggler verdict "
+        "(tpu_straggler_verdict), by attributed cause — the fleet-wide "
+        "straggler ranking the hostcorr plane feeds",
+        ("scope", "pool", "slice", "cause"),
+    ),
+    "tpu_fleet_straggler_skew_pct": (
+        "gauge",
+        "Worst straggler skew across the scope's hosts (max of each "
+        "host's tpu_straggler_skew_pct; absent when no host reports it)",
         ("scope", "pool", "slice"),
     ),
     "tpu_fleet_stale_rollup": (
@@ -367,6 +479,7 @@ def all_family_names() -> set[str]:
         | set(IDENTITY_FAMILIES)
         | set(HEALTH_FAMILIES)
         | set(ANOMALY_FAMILIES)
+        | set(HOSTCORR_FAMILIES)
         | set(distribution_family_rows())
         | set(SELF_FAMILIES)
         | set(FLEET_FAMILIES)

@@ -172,6 +172,19 @@ def node_snapshot_from_text(text: str) -> dict:
             )
         elif name == "workload_mfu_ratio":
             snap["mfu"] = float(line.rsplit(" ", 1)[1])
+        elif name == "tpu_straggler_skew_pct":
+            snap.setdefault("straggler", {}).setdefault("active", False)
+            snap["straggler"]["skew_pct"] = float(line.rsplit(" ", 1)[1])
+        elif name == "tpu_straggler_verdict":
+            # Active straggler with its attributed cause (tpumon/hostcorr)
+            # — the fleet tier counts and ranks these across pools.
+            labels = dict(_LABEL_RE.findall(line[brace:line.rfind("}") + 1]))
+            st = snap.setdefault("straggler", {})
+            st["active"] = True
+            st["cause"] = labels.get("cause", "unknown")
+            st["chip"] = labels.get("chip", "")
+        elif name == "tpu_hostcorr_available":
+            snap["hostcorr_available"] = float(line.rsplit(" ", 1)[1]) > 0
     if queues:
         snap["queues"] = queues
     if total:

@@ -62,6 +62,9 @@ class _Agg:
         self.mfu_sum = 0.0
         self.mfu_n = 0
         self.degraded_hosts = 0
+        #: Active straggler hosts by attributed cause (tpumon/hostcorr).
+        self.stragglers: dict[str, int] = {}
+        self.straggler_skew_max: float | None = None
 
     def add_node(self, snap: dict, state: str) -> None:
         self.hosts[state] += 1
@@ -91,6 +94,17 @@ class _Agg:
         degraded = snap.get("degraded")
         if degraded and degraded.get("active"):
             self.degraded_hosts += 1
+        straggler = snap.get("straggler")
+        if straggler:
+            skew = straggler.get("skew_pct")
+            if skew is not None and (
+                self.straggler_skew_max is None
+                or skew > self.straggler_skew_max
+            ):
+                self.straggler_skew_max = skew
+            if straggler.get("active"):
+                cause = straggler.get("cause", "unknown")
+                self.stragglers[cause] = self.stragglers.get(cause, 0) + 1
 
     def to_dict(self) -> dict:
         doc: dict = {
@@ -117,6 +131,10 @@ class _Agg:
             }
         if self.mfu_n:
             doc["mfu"] = self.mfu_sum / self.mfu_n
+        if self.stragglers:
+            doc["stragglers"] = dict(self.stragglers)
+        if self.straggler_skew_max is not None:
+            doc["straggler_skew_max_pct"] = self.straggler_skew_max
         return doc
 
 
@@ -228,6 +246,18 @@ def fleet_families(doc: dict) -> list:
         "(tpumon_degraded — stale-but-served families or open breakers).",
         labels=_SCOPED,
     )
+    stragglers = GaugeMetricFamily(
+        "tpu_fleet_stragglers",
+        "Hosts in the scope with an active straggler verdict "
+        "(tpu_straggler_verdict, tpumon/hostcorr), by attributed cause.",
+        labels=_SCOPED + ("cause",),
+    )
+    straggler_skew = GaugeMetricFamily(
+        "tpu_fleet_straggler_skew_pct",
+        "Worst straggler skew across the scope's hosts (max per-host "
+        "worst-chip vs median duty skew; absent when none report it).",
+        labels=_SCOPED,
+    )
     stale_flag = GaugeMetricFamily(
         "tpu_fleet_stale_rollup",
         "1 when this scope's rollup includes stale (last-good) node "
@@ -255,12 +285,19 @@ def fleet_families(doc: dict) -> list:
             ici_score.add_metric(labels, ici["score"])
         if "mfu" in bucket:
             mfu.add_metric(labels, bucket["mfu"])
+        for cause, n in sorted(bucket.get("stragglers", {}).items()):
+            stragglers.add_metric(labels + (cause,), float(n))
+        if "straggler_skew_max_pct" in bucket:
+            straggler_skew.add_metric(
+                labels, bucket["straggler_skew_max_pct"]
+            )
         degraded.add_metric(labels, float(bucket["degraded_hosts"]))
         stale_flag.add_metric(labels, 1.0 if bucket["stale"] else 0.0)
 
     return [
         hosts, chips, duty, hbm_used, hbm_total, headroom,
-        ici_links, ici_score, mfu, degraded, stale_flag,
+        ici_links, ici_score, mfu, stragglers, straggler_skew,
+        degraded, stale_flag,
     ]
 
 

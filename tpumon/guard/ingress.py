@@ -160,6 +160,10 @@ class IngressGuard:
             return "history", DEBUG
         if path == "/anomalies":
             return "anomalies", DEBUG
+        if path == "/hostcorr":
+            # Host-correlation replay (tpumon/hostcorr): serializes ring
+            # records per request — debug-class budget.
+            return "hostcorr", DEBUG
         if path == "/fleet":
             # Fleet-tier JSON API (tpumon/fleet/server.py): allocates a
             # full per-node document per request — debug-class budget.

@@ -1,0 +1,50 @@
+"""Host-correlation plane: non-instrumented straggler/stall attribution.
+
+The sixth node-level plane (after anomaly, trace, resilience, guard,
+analysis) and the first that explains *why* a device metric moved: a 1 Hz
+procfs/cgroupfs sampler (cgroup PSI, per-pod sched delay, net/disk byte
+rates, page-cache pressure — zero device queries, zero instrumentation)
+time-aligned with each cycle's PollStats into a bounded correlation ring,
+plus cross-signal detectors that join device and host series into a
+per-slice straggler verdict with a cause label
+(``device`` / ``host-cpu`` / ``host-mem`` / ``host-io`` / ``unknown``).
+
+Surfaces: ``tpu_hostcorr_*`` / ``tpu_straggler_*`` families on the poll
+page, ``GET /hostcorr`` (``?since=`` replay), host_straggler/host_stall
+events on ``/anomalies``, smi/doctor lines, and fleet-tier rollups
+(``tpu_fleet_stragglers``). Grounded in PAPERS.md arXiv 2510.16946
+(host-side telemetry) and arXiv 2506.02007 (eACGM's non-instrumented
+stance).
+"""
+
+from tpumon.hostcorr.detectors import (
+    CAUSES,
+    HOSTCORR_DETECTOR_NAMES,
+    HostCorrThresholds,
+    StragglerJudge,
+    attribute_cause,
+    hostcorr_detectors,
+)
+from tpumon.hostcorr.plane import HostCorrPlane
+from tpumon.hostcorr.sampler import (
+    PSI_RESOURCES,
+    SIGNAL_GROUPS,
+    HostSampler,
+    HostSignals,
+    parse_psi,
+)
+
+__all__ = [
+    "CAUSES",
+    "HOSTCORR_DETECTOR_NAMES",
+    "PSI_RESOURCES",
+    "SIGNAL_GROUPS",
+    "HostCorrPlane",
+    "HostCorrThresholds",
+    "HostSampler",
+    "HostSignals",
+    "StragglerJudge",
+    "attribute_cause",
+    "hostcorr_detectors",
+    "parse_psi",
+]

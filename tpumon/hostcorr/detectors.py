@@ -1,0 +1,453 @@
+"""Cross-signal straggler/stall attribution over device + host series.
+
+Two halves:
+
+- :class:`StragglerJudge` — runs inside the HostCorrPlane's poll-cycle
+  pass, joining this cycle's per-chip duty snapshot with the same
+  cycle's :class:`~tpumon.hostcorr.sampler.HostSignals` into a per-slice
+  straggler verdict: worst-chip vs median duty skew, attributed to a
+  cause ∈ ``device`` / ``host-cpu`` / ``host-mem`` / ``host-io`` /
+  ``unknown``. A straggler is a *consistent* laggard: the SAME chip must
+  sit ``skew_warn_pct`` below the slice median for ``skew_cycles``
+  consecutive polls while the median itself is busy — per-cycle jitter
+  (the fake backend's noise, real MoE imbalance) never qualifies.
+
+- :class:`HostStragglerDetector` / :class:`HostStallDetector` — streaming
+  detectors with the tpumon.anomaly observe() contract, consuming the
+  ``hostcorr`` block the plane injects into PollStats.snapshot. They ride
+  the existing AnomalyEngine (onset/clear events, /anomalies replay,
+  history windows) — the first detectors that explain *why* a device
+  metric moved rather than just that it moved.
+
+Cause attribution order: the strongest host signal above its threshold
+wins (host evidence explains the symptom without blaming the device);
+with no host signal, device-side evidence on the lagging chip (throttle)
+reads ``device``; otherwise ``unknown``. When host signals are entirely
+unavailable (no PSI kernel, no proc root) the verdict degrades to
+device-only attribution instead of erroring — the graceful-degradation
+contract of the plane.
+
+Thresholds follow the AnomalyThresholds pattern: every field is a
+``TPUMON_HOSTCORR_<FIELD>`` env var, malformed values keep the default,
+re-parsed only when the env changes.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import statistics
+from collections import deque
+from dataclasses import dataclass, fields
+
+from tpumon.health import CRIT, WARN
+
+log = logging.getLogger(__name__)
+
+#: Verdict cause labels, in exposition order.
+CAUSES = ("device", "host-cpu", "host-mem", "host-io", "unknown")
+
+
+@dataclass(frozen=True)
+class HostCorrThresholds:
+    """Cross-signal tuning, overridable per deployment via TPUMON_HOSTCORR_*."""
+
+    #: Straggler onset: worst chip this many duty points below the slice
+    #: median, for skew_cycles consecutive polls with the same worst chip,
+    #: while the median is at least busy_duty_pct (idle slices have no
+    #: stragglers). Clears at half the onset skew.
+    skew_warn_pct: float = 20.0
+    skew_cycles: float = 5.0
+    busy_duty_pct: float = 25.0
+    #: Host-cause attribution thresholds: PSI avg10 shares (0-1) per
+    #: resource, per-pod sched-delay share (delay s per wall s), and the
+    #: page-reclaim scan rate backing host-mem.
+    cpu_share: float = 0.10
+    mem_share: float = 0.05
+    io_share: float = 0.05
+    sched_share: float = 0.10
+    reclaim_pps: float = 1000.0
+    #: Host-stall detector: duty collapsed below stall_duty_pct on every
+    #: chip for stall_cycles polls while HBM stays flat (occupancy range
+    #: under hbm_flat_delta) and a host signal is above threshold.
+    stall_duty_pct: float = 1.0
+    stall_cycles: float = 3.0
+    hbm_flat_delta: float = 0.002
+
+    @classmethod
+    def from_env(cls, environ=None) -> "HostCorrThresholds":
+        env = os.environ if environ is None else environ
+        kwargs = {}
+        for f in fields(cls):
+            raw = env.get("TPUMON_HOSTCORR_" + f.name.upper())
+            if raw is None:
+                continue
+            try:
+                kwargs[f.name] = float(raw)
+            except ValueError:
+                log.warning(
+                    "ignoring malformed TPUMON_HOSTCORR_%s=%r",
+                    f.name.upper(), raw,
+                )
+        return cls(**kwargs)
+
+
+#: (env-values key, parsed thresholds) — re-parse only when the env
+#: changed, same cache shape as anomaly/health env_thresholds.
+_env_cache: tuple | None = None
+
+
+def env_thresholds() -> HostCorrThresholds:
+    global _env_cache
+    key = tuple(
+        os.environ.get("TPUMON_HOSTCORR_" + f.name.upper())
+        for f in fields(HostCorrThresholds)
+    )
+    if _env_cache is None or _env_cache[0] != key:
+        _env_cache = (key, HostCorrThresholds.from_env())
+    return _env_cache[1]
+
+
+def score_host_signals(
+    cpu: float, sched: float, mem: float, reclaim: float, io: float,
+    t: HostCorrThresholds,
+) -> list[tuple[float, float, str, str]]:
+    """The single cause-scoring rule: ``(ratio, value, cause, signal)``
+    candidates for every host signal at-or-above ITS OWN threshold,
+    ratio = signal/threshold so a screaming PSI beats a marginal sched
+    delay, reclaim counted toward host-mem. ``signal`` names the concrete
+    series that won within the cause (``psi-cpu``/``sched``,
+    ``psi-mem``/``reclaim``, ``psi-io``) and ``value`` is THAT signal's
+    level — so event anchoring can point at the series that actually
+    moved, not a flat sibling. Both :func:`attribute_cause` (/hostcorr
+    verdicts) and ``HostStallDetector`` (/anomalies events) rank by
+    ``max()`` of this list, so the two surfaces can never attribute the
+    same host state to different causes.
+    """
+    def ratio(value: float, threshold: float) -> float:
+        # A zero (or negative) threshold means "always attribute this
+        # signal" — the >= gate above it is then unconditionally true —
+        # so rank it as infinitely strong instead of dividing by zero
+        # and killing the hostcorr stage every cycle.
+        return value / threshold if threshold > 0 else float("inf")
+
+    scores: list[tuple[float, float, str, str]] = []
+    if cpu >= t.cpu_share or sched >= t.sched_share:
+        scores.append(max(
+            (ratio(cpu, t.cpu_share), cpu, "host-cpu", "psi-cpu"),
+            (ratio(sched, t.sched_share), sched, "host-cpu", "sched"),
+        ))
+    if mem >= t.mem_share or reclaim >= t.reclaim_pps:
+        scores.append(max(
+            (ratio(mem, t.mem_share), mem, "host-mem", "psi-mem"),
+            (ratio(reclaim, t.reclaim_pps), reclaim, "host-mem", "reclaim"),
+        ))
+    if io >= t.io_share:
+        scores.append((ratio(io, t.io_share), io, "host-io", "psi-io"))
+    return scores
+
+
+def attribute_cause(host, evidence: dict, t: HostCorrThresholds) -> str:
+    """Pick the cause label for an active straggler/stall.
+
+    ``host`` is a HostSignals (or None); ``evidence`` carries the
+    device-side booleans the plane extracted from the snapshot
+    (``throttled`` on the worst chip). The strongest host signal above
+    threshold wins (:func:`score_host_signals`); the absence of every
+    host signal falls back to device evidence, then ``unknown``.
+    """
+    scores: list[tuple[float, float, str]] = []
+    if host is not None and host.available:
+        scores = score_host_signals(
+            host.psi_share("cpu") or 0.0,
+            host.max_sched_share() or 0.0,
+            host.psi_share("memory") or 0.0,
+            host.reclaim_pps or 0.0,
+            host.psi_share("io") or 0.0,
+            t,
+        )
+    if scores:
+        return max(scores)[2]
+    if evidence.get("throttled"):
+        return "device"
+    return "unknown"
+
+
+class StragglerJudge:
+    """Worst-chip-vs-median skew tracking; poll thread only."""
+
+    def __init__(self) -> None:
+        self._streak = 0
+        self._last_worst: str | None = None
+        self._active = False
+        self._cause: str | None = None
+
+    def judge(
+        self,
+        duties: dict[str, float],
+        host,
+        evidence: dict,
+        t: HostCorrThresholds | None = None,
+    ) -> dict:
+        """One cycle's verdict. Returns a JSON-able dict; ``active`` only
+        after the streak requirement is met, ``cause`` present while
+        active."""
+        t = t if t is not None else env_thresholds()
+        if len(duties) < 2:
+            self._streak = 0
+            self._last_worst = None
+            self._active = False
+            self._cause = None
+            return {"active": False, "skew_pct": None}
+        med = statistics.median(duties.values())
+        worst = min(duties, key=lambda c: duties[c])
+        skew = med - duties[worst]
+        clear_at = t.skew_warn_pct / 2.0
+        threshold = clear_at if self._active else t.skew_warn_pct
+        candidate = med >= t.busy_duty_pct and skew >= threshold
+        if candidate and worst == self._last_worst:
+            self._streak += 1
+        elif candidate:
+            self._streak = 1
+        else:
+            self._streak = 0
+        self._last_worst = worst if candidate else None
+        self._active = self._streak >= max(1, int(t.skew_cycles))
+        verdict: dict = {
+            "active": self._active,
+            "skew_pct": skew,
+            "chip": worst,
+            "median_duty_pct": med,
+            "streak": self._streak,
+        }
+        if self._active:
+            # Sticky per-episode attribution: during the hysteresis
+            # decay tail the host is already calm, and recomputing
+            # would erase the cause the onset established — the event
+            # message, the events_total counter, and the fleet rollup
+            # must all tell the same story. Only an "unknown" episode
+            # may upgrade if evidence arrives later.
+            cause = attribute_cause(host, evidence, t)
+            if self._cause in (None, "unknown"):
+                self._cause = cause
+            verdict["cause"] = self._cause
+        else:
+            self._cause = None
+        return verdict
+
+
+class HostStragglerDetector:
+    """AnomalyEngine adapter over the plane's straggler verdict.
+
+    The judgment already happened in the plane (same cycle); this
+    detector translates it into the engine's onset/clear event stream so
+    stragglers get /anomalies replay, bounded rings, and the 1 Hz
+    history window of ``tpu_straggler_skew_pct`` attached at onset.
+    """
+
+    name = "host_straggler"
+    _family = "tpu_straggler_skew_pct"
+
+    def __init__(self) -> None:
+        self._active = False
+        self._chip = "?"
+
+    def observe(self, ts: float, snap: dict, t) -> list:
+        from tpumon.anomaly.detectors import Reading
+
+        verdict = (snap.get("hostcorr") or {}).get("straggler") or {}
+        active = bool(verdict.get("active"))
+        was = self._active
+        self._active = active
+        if not active and not was:
+            return []
+        hc = env_thresholds()
+        skew = verdict.get("skew_pct") or 0.0
+        cause = verdict.get("cause", "unknown")
+        # The clearing cycle's verdict may no longer name a chip; the
+        # clear reading must carry the SAME signal id as the onset or
+        # the engine would age the event out by absence instead of
+        # clearing it now.
+        chip = verdict.get("chip", self._chip) if active else self._chip
+        self._chip = chip
+        sev = CRIT if skew >= 2.0 * hc.skew_warn_pct else WARN
+        return [
+            Reading(
+                f"chip:{chip}",
+                active,
+                sev,
+                skew,
+                f"chip {chip} duty {skew:.0f} pts below the slice median "
+                f"for {verdict.get('streak', 0)} polls — cause: {cause}",
+                self._family,
+                (),
+            )
+        ]
+
+
+class HostStallDetector:
+    """Whole-device stall with host-side pressure: "HBM flat + duty
+    collapsed + host signal spiked" = the runtime is starved by the
+    host, not wedged by the device (that pairing is queue_stall's).
+    """
+
+    name = "host_stall"
+
+    #: signal -> (family, label_match builder) for event anchoring: the
+    #: onset history window and the operator's first click must land on
+    #: the series that actually spiked — a sched-triggered stall points
+    #: at the pod's delay share, a reclaim-triggered one at the scan
+    #: rate, never at a flat PSI sibling.
+    _ANCHORS = {
+        "psi-cpu": ("tpu_hostcorr_psi_share", "cpu"),
+        "psi-mem": ("tpu_hostcorr_psi_share", "memory"),
+        "psi-io": ("tpu_hostcorr_psi_share", "io"),
+        "sched": ("tpu_hostcorr_sched_delay_share", None),
+        "reclaim": ("tpu_hostcorr_reclaim_pages_per_second", None),
+    }
+
+    def __init__(self) -> None:
+        self._streak = 0
+        self._hbm: deque = deque(maxlen=16)
+        self._active = False
+        #: [value, cause, signal, pod] latched at onset: the retained
+        #: event (message rewritten every active cycle) and its clear
+        #: must keep telling the onset's story even if another signal
+        #: overtakes mid-episode or the host is already calm on the
+        #: clearing cycle. Only the latched signal's own level updates.
+        self._latched: list | None = None
+
+    def observe(self, ts: float, snap: dict, t) -> list:
+        from tpumon.anomaly.detectors import Reading
+
+        hc_block = snap.get("hostcorr") or {}
+        host = hc_block.get("signals") or {}
+        if not host.get("available"):
+            # Graceful degradation: without host signals there is no
+            # host-stall verdict to render (device-only detectors still
+            # cover the wedged-runtime case).
+            self._streak = 0
+            if not self._active:
+                return []
+        hc = env_thresholds()
+        duties = [
+            row.get("duty_pct")
+            for row in (snap.get("chips") or {}).values()
+            if row.get("duty_pct") is not None
+        ]
+        ratios = [
+            row["hbm_used"] / row["hbm_total"]
+            for row in (snap.get("chips") or {}).values()
+            if row.get("hbm_used") is not None and row.get("hbm_total")
+        ]
+        window = max(1, int(hc.stall_cycles))
+        if self._hbm.maxlen < window:
+            # The flatness window must hold stall_cycles samples — a
+            # fixed cap would silently disable the detector for any
+            # TPUMON_HOSTCORR_STALL_CYCLES above it.
+            self._hbm = deque(self._hbm, maxlen=window)
+        if ratios:
+            self._hbm.append(sum(ratios) / len(ratios))
+        collapsed = bool(duties) and max(duties) <= hc.stall_duty_pct
+        recent = list(self._hbm)[-window:]
+        hbm_flat = (
+            len(recent) >= window
+            and max(recent) - min(recent) <= hc.hbm_flat_delta
+        )
+        pressure = self._host_pressure(host, hc)
+        stalled = collapsed and hbm_flat and pressure is not None
+        self._streak = self._streak + 1 if stalled else 0
+        was = self._active
+        self._active = self._streak >= window
+        if not self._active and not was:
+            return []
+        if self._active and not was:
+            # pressure is non-None here: `stalled` (and so the streak
+            # that just crossed the window) requires it.
+            self._latched = list(pressure)
+        elif (
+            self._latched is not None
+            and pressure is not None
+            and pressure[2] == self._latched[2]
+        ):
+            self._latched[0] = pressure[0]
+        value, cause, signal, pod = (
+            self._latched if self._latched is not None
+            else (0.0, "unknown", "psi-cpu", None)
+        )
+        if not self._active:
+            self._latched = None
+        family, resource = self._ANCHORS[signal]
+        if signal == "sched":
+            label_match = (("pod", pod),) if pod else ()
+            evidence = (
+                f"pod {pod} runnable-but-waiting {value:.0%} of wall time"
+            )
+        elif signal == "reclaim":
+            label_match = ()
+            evidence = f"page-reclaim scanning at {value:.0f} pages/s"
+        else:
+            label_match = (("resource", resource), ("kind", "some"))
+            evidence = (
+                f"{cause.removeprefix('host-')} pressure "
+                f"({value:.0%} stall share)"
+            )
+        return [
+            Reading(
+                "node",
+                self._active,
+                WARN,
+                value,
+                f"device idle with flat HBM while the host shows "
+                f"{evidence} for {self._streak} polls — "
+                "host-side stall, not a device fault",
+                family,
+                label_match,
+            )
+        ]
+
+    @staticmethod
+    def _host_pressure(host: dict, hc: HostCorrThresholds):
+        """(value, cause, signal, pod) for the strongest host signal
+        above threshold, from the compact signals block the plane
+        injects; None if calm. Scoring delegates to
+        :func:`score_host_signals` — the one rule shared with
+        attribute_cause. ``value`` is the winning signal's own level
+        (PSI/sched shares as 0-1 fractions, reclaim as pages/s);
+        ``pod`` names the worst-delayed pod when sched won, else None.
+        """
+        psi = host.get("psi") or {}
+
+        def share(resource: str) -> float:
+            return ((psi.get(resource) or {}).get("some") or {}).get(
+                "share"
+            ) or 0.0
+
+        sched = {
+            pod: row.get("share") or 0.0
+            for pod, row in (host.get("sched") or {}).items()
+        }
+        scores = score_host_signals(
+            share("cpu"),
+            max(sched.values()) if sched else 0.0,
+            share("memory"),
+            host.get("reclaim_pps") or 0.0,
+            share("io"),
+            hc,
+        )
+        if not scores:
+            return None
+        _, value, cause, signal = max(scores)
+        pod = None
+        if signal == "sched" and sched:
+            pod = max(sched, key=lambda p: sched[p])
+        return value, cause, signal, pod
+
+
+def hostcorr_detectors() -> list:
+    """The cross-signal detector roster appended to the anomaly engine
+    when the host-correlation plane is enabled."""
+    return [HostStragglerDetector(), HostStallDetector()]
+
+
+HOSTCORR_DETECTOR_NAMES: tuple[str, ...] = ("host_straggler", "host_stall")

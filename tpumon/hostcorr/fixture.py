@@ -1,0 +1,234 @@
+"""Hermetic fake procfs/cgroupfs tree + deterministic straggler backend.
+
+Test/CI doubles for the host-correlation plane, mirroring the role
+FakeTpuBackend plays for the device side:
+
+- :class:`FakeProcTree` writes a directory tree shaped like the slice of
+  ``/proc`` + ``/sys/fs/cgroup`` the sampler reads (PSI files, kubepods
+  pids with schedstat, net/dev, diskstats, meminfo, vmstat), pointed at
+  via ``TPUMON_HOSTCORR_PROC_ROOT`` / ``Config.hostcorr_proc_root`` — so
+  hostcorr tests and CI run without a PSI-capable kernel, and chaos
+  drills can script host pressure by rewriting files mid-run.
+- :class:`StragglerBackend` wraps any device backend and pins one chip's
+  duty cycle low (and optionally its throttle score high) — the
+  deterministic device-side straggler the fixture tree's host pressure
+  is correlated against. It also counts every ``sample()`` call, which
+  is the "zero additional device queries per cycle" evidence in
+  ``soak.py --straggler``.
+
+Used by tests/conftest.py (the ``proc_tree`` fixture), tests/test_hostcorr.py,
+and tools/soak.py; never imported by the exporter itself.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import Counter
+
+
+class FakeProcTree:
+    """Writable fake proc root. All setters are idempotent full-file
+    rewrites, so a mutator thread can script a scenario mid-run."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        os.makedirs(os.path.join(root, "proc", "pressure"), exist_ok=True)
+        os.makedirs(os.path.join(root, "proc", "net"), exist_ok=True)
+        os.makedirs(os.path.join(root, "proc", "self"), exist_ok=True)
+        os.makedirs(os.path.join(root, "sys", "fs", "cgroup"), exist_ok=True)
+        # Healthy defaults: zero pressure, quiet counters, schedstat
+        # support present (proc/self marks the kernel capability).
+        for resource in ("cpu", "memory", "io"):
+            self.set_pressure(resource)
+        self._write("proc", "self", "schedstat", "0 0 0\n")
+        self.set_net(0, 0)
+        self.set_disk(0, 0)
+        self.set_meminfo(cached_kb=1_000_000)
+        self.set_vmstat(0)
+
+    def _write(self, *parts_and_text: str) -> None:
+        # Atomic temp+rename: a mutator thread scripts scenarios mid-run
+        # while the sampler reads the same files, and a truncate-then-write
+        # open() would hand the sampler empty/partial reads.
+        *parts, text = parts_and_text
+        path = os.path.join(self.root, *parts)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        os.replace(tmp, path)
+
+    # -- PSI ---------------------------------------------------------------
+
+    def set_pressure(
+        self,
+        resource: str,
+        some_avg10: float = 0.0,
+        some_total_us: int = 0,
+        full_avg10: float = 0.0,
+        full_total_us: int = 0,
+    ) -> None:
+        text = (
+            f"some avg10={some_avg10:.2f} avg60=0.00 avg300=0.00 "
+            f"total={some_total_us}\n"
+            f"full avg10={full_avg10:.2f} avg60=0.00 avg300=0.00 "
+            f"total={full_total_us}\n"
+        )
+        self._write("proc", "pressure", resource, text)
+        self._write("sys", "fs", "cgroup", f"{resource}.pressure", text)
+
+    def remove_pressure(self) -> None:
+        """Simulate a pre-PSI kernel (the graceful-degradation path)."""
+        for resource in ("cpu", "memory", "io"):
+            for path in (
+                os.path.join(self.root, "proc", "pressure", resource),
+                os.path.join(
+                    self.root, "sys", "fs", "cgroup", f"{resource}.pressure"
+                ),
+            ):
+                if os.path.exists(path):
+                    os.remove(path)
+
+    # -- pods / schedstat --------------------------------------------------
+
+    def add_pod(
+        self, uid: str, pid: int, run_delay_ns: int = 0,
+        driver: str = "systemd",
+    ) -> None:
+        """One kubepods process: cgroup membership + schedstat. ``driver``
+        picks the cgroup-path shape: ``systemd`` (…pod<uid>.slice, the
+        kubeadm default) or ``cgroupfs`` (/kubepods/burstable/pod<uid>/,
+        where the QoS class is its own path segment)."""
+        if driver == "cgroupfs":
+            line = f"0::/kubepods/burstable/pod{uid}/abc123\n"
+        else:
+            line = (
+                "0::/kubepods.slice/kubepods-burstable.slice/"
+                f"kubepods-burstable-pod{uid.replace('-', '_')}.slice/"
+                "cri-containerd-abc123.scope\n"
+            )
+        self._write("proc", str(pid), "cgroup", line)
+        self.set_pod_delay(pid, run_delay_ns)
+
+    def remove_pod(self, pid: int) -> None:
+        """The pod's process is gone (pod deleted / job finished)."""
+        import shutil
+
+        shutil.rmtree(
+            os.path.join(self.root, "proc", str(pid)), ignore_errors=True
+        )
+
+    def set_pod_delay(self, pid: int, run_delay_ns: int) -> None:
+        self._write(
+            "proc", str(pid), "schedstat", f"123456 {run_delay_ns} 42\n"
+        )
+
+    def remove_schedstat(self) -> None:
+        """Simulate a kernel without CONFIG_SCHED_INFO."""
+        for entry in os.listdir(os.path.join(self.root, "proc")):
+            path = os.path.join(self.root, "proc", entry, "schedstat")
+            if os.path.exists(path):
+                os.remove(path)
+
+    # -- counters ----------------------------------------------------------
+
+    def set_net(
+        self, rx_bytes: int, tx_bytes: int,
+        extra_ifaces: tuple = (),
+    ) -> None:
+        """``extra_ifaces``: (name, rx, tx) rows appended after eth0 —
+        for exercising the virtual-interface exclusion."""
+        lines = [
+            "Inter-|   Receive                |  Transmit\n",
+            " face |bytes packets errs drop fifo frame compressed "
+            "multicast|bytes packets errs drop fifo colls carrier "
+            "compressed\n",
+            "    lo: 9999 9 0 0 0 0 0 0 9999 9 0 0 0 0 0 0\n",
+            f"  eth0: {rx_bytes} 1 0 0 0 0 0 0 {tx_bytes} 1 0 0 0 0 0 0\n",
+        ]
+        for name, rx, tx in extra_ifaces:
+            lines.append(
+                f"  {name}: {rx} 1 0 0 0 0 0 0 {tx} 1 0 0 0 0 0 0\n"
+            )
+        self._write("proc", "net", "dev", "".join(lines))
+
+    def set_disk(
+        self, read_sectors: int, write_sectors: int,
+        extra_devices: tuple = (),
+    ) -> None:
+        """``extra_devices``: (name, read_sectors, write_sectors) rows —
+        for exercising the stacked-device (dm-*/md*) exclusion."""
+        lines = [
+            f"   8       0 sda 10 0 {read_sectors} 5 10 0 "
+            f"{write_sectors} 5 0 10 10\n",
+            "   8       1 sda1 10 0 999999 5 10 0 999999 5 0 10 10\n",
+            "   7       0 loop0 10 0 999999 5 10 0 999999 5 0 10 10\n",
+        ]
+        for name, rd, wr in extra_devices:
+            lines.append(
+                f" 253       0 {name} 10 0 {rd} 5 10 0 {wr} 5 0 10 10\n"
+            )
+        self._write("proc", "diskstats", "".join(lines))
+
+    def set_meminfo(self, cached_kb: int, dirty_kb: int = 0) -> None:
+        self._write(
+            "proc", "meminfo",
+            "MemTotal:       16000000 kB\n"
+            "MemAvailable:    8000000 kB\n"
+            f"Cached:         {cached_kb} kB\n"
+            f"Dirty:          {dirty_kb} kB\n",
+        )
+
+    def set_vmstat(self, pgscan_kswapd: int, pgscan_direct: int = 0) -> None:
+        self._write(
+            "proc", "vmstat",
+            f"pgscan_kswapd {pgscan_kswapd}\n"
+            f"pgscan_direct {pgscan_direct}\n"
+            "pgsteal_kswapd 0\n",
+        )
+
+
+class StragglerBackend:
+    """Wraps a device backend; pins one chip slow (and optionally
+    throttled) while counting every device query."""
+
+    def __init__(self, inner) -> None:
+        self._inner = inner
+        #: Chip index pinned to lag_duty (None = pass-through).
+        self.lag_chip: int | None = None
+        self.lag_duty = 3.0
+        self.busy_duty = 75.0
+        #: Chip index reporting a hard throttle score (device evidence).
+        self.throttle_chip: int | None = None
+        #: metric name -> sample() call count (query-budget evidence).
+        self.calls: Counter = Counter()
+
+    @property
+    def name(self) -> str:
+        return self._inner.name
+
+    def sample(self, metric: str):
+        from tpumon.backends.base import RawMetric
+
+        self.calls[metric] += 1
+        raw = self._inner.sample(metric)
+        chips = len(raw.data)
+        if metric == "duty_cycle_pct" and self.lag_chip is not None and chips:
+            data = tuple(
+                f"{self.lag_duty if i == self.lag_chip else self.busy_duty:.2f}"
+                for i in range(chips)
+            )
+            return RawMetric(metric, data)
+        if (
+            metric == "tpu_throttle_score"
+            and self.throttle_chip is not None
+            and chips
+        ):
+            data = tuple(
+                "8" if i == self.throttle_chip else "0" for i in range(chips)
+            )
+            return RawMetric(metric, data)
+        return raw
+
+    def __getattr__(self, attr):
+        return getattr(self._inner, attr)

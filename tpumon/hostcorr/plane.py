@@ -1,0 +1,295 @@
+"""The host-correlation plane wired into the poll loop.
+
+One :meth:`HostCorrPlane.cycle` call per poll, fed the PollStats the
+collector already computed. The pass:
+
+1. samples host signals (procfs/cgroupfs only — **zero device queries**,
+   preserving the collector's scrape-latency design rule);
+2. joins them with the SAME cycle's device snapshot into a per-slice
+   straggler verdict (tpumon/hostcorr/detectors.py);
+3. appends one time-aligned record to the bounded correlation ring
+   (served as ``GET /hostcorr``, ``?since=`` replay like /anomalies);
+4. injects a ``hostcorr`` block into ``PollStats.snapshot`` so the
+   anomaly engine's cross-signal detectors (host_straggler, host_stall)
+   see host and device series side by side;
+5. returns the ``tpu_hostcorr_*`` / ``tpu_straggler_*`` families for
+   this cycle's page (names/help/labels from the HOSTCORR_FAMILIES
+   registry, so docs and dashboards cannot drift).
+
+Graceful degradation: on hosts without PSI/schedstat the page carries
+``tpu_hostcorr_available 0`` and per-group availability; the verdict
+falls back to device-only attribution (never errors), and every signal
+family is simply absent (absent-not-zero).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import Counter, deque
+
+from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
+
+from tpumon.hostcorr.detectors import StragglerJudge, env_thresholds
+from tpumon.hostcorr.sampler import SIGNAL_GROUPS, HostSampler
+
+log = logging.getLogger(__name__)
+
+
+class HostCorrPlane:
+    """Thread model: ``cycle`` runs on the poller thread only;
+    ``replay``/``snapshot``/``resize`` may be called from HTTP threads —
+    shared state (ring, last record, onset totals) is guarded by one
+    lock held for deque/dict work only."""
+
+    def __init__(
+        self,
+        proc_root: str = "",
+        ring: int = 600,
+        sampler: HostSampler | None = None,
+    ) -> None:
+        self._sampler = sampler if sampler is not None else HostSampler(proc_root)
+        self._judge = StragglerJudge()
+        self._full_ring = max(1, int(ring))
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self._full_ring)  # guarded-by: self._lock
+        self._last: dict | None = None  # guarded-by: self._lock
+        self._totals: Counter = Counter()  # guarded-by: self._lock
+        self._cycles = 0  # guarded-by: self._lock
+        self._was_active = False  # poller thread only
+        #: Episode onset seen but cause still "unknown" — the count is
+        #: held until the judge upgrades it (or the episode clears), so
+        #: tpu_straggler_events_total, the verdict gauge, and the event
+        #: stream always name the SAME cause for one episode (counters
+        #: can't decrement a mislabeled onset).
+        self._pending_unknown = False  # poller thread only
+
+    @property
+    def ring_capacity(self) -> int:
+        return self._full_ring
+
+    def resize(self, n: int) -> None:
+        """Re-cap the correlation ring in place — the memory-watermark
+        response (tpumon/guard/memwatch); newest records retained,
+        reversible."""
+        n = max(1, int(n))
+        with self._lock:
+            if n == self._ring.maxlen:
+                return
+            self._ring = deque(self._ring, maxlen=n)
+
+    # -- poll-loop integration --------------------------------------------
+
+    def cycle(self, now: float, stats) -> list:
+        """One Poller cycle: sample, judge, record, inject, emit."""
+        host = self._sampler.sample(now)
+        snap = stats.snapshot if stats.snapshot is not None else {}
+        duties: dict[str, float] = {}
+        worst_throttled = False
+        chips = snap.get("chips") or {}
+        for chip, row in chips.items():
+            duty = row.get("duty_pct")
+            if duty is not None:
+                duties[chip] = duty
+        t = env_thresholds()
+        worst = min(duties, key=lambda c: duties[c]) if duties else None
+        if worst is not None:
+            worst_throttled = (chips.get(worst) or {}).get("throttle", 0) > 0
+        evidence = {"throttled": worst_throttled}
+        verdict = self._judge.judge(duties, host, evidence, t)
+
+        active = bool(verdict.get("active"))
+        onset = active and not self._was_active
+        cleared = self._was_active and not active
+        self._was_active = active
+        cause = verdict.get("cause", "unknown")
+
+        host_doc = host.to_dict()
+        record = {
+            "ts": now,
+            "host": host_doc,
+            "device": {
+                "duty": duties,
+                "median_duty_pct": verdict.get("median_duty_pct"),
+                "worst_chip": verdict.get("chip"),
+                "worst_throttled": worst_throttled,
+                "degraded": bool(stats.degraded),
+            },
+            "straggler": verdict,
+        }
+        with self._lock:
+            self._cycles += 1
+            if onset:
+                if cause == "unknown":
+                    self._pending_unknown = True
+                else:
+                    self._totals[cause] += 1
+            elif active and self._pending_unknown and cause != "unknown":
+                # The sticky judge upgraded the episode's cause: count it
+                # now, once, under the cause every other surface reports.
+                self._totals[cause] += 1
+                self._pending_unknown = False
+            elif cleared and self._pending_unknown:
+                # The episode ended without ever confessing: it WAS
+                # unknown, and stays counted that way.
+                self._totals["unknown"] += 1
+                self._pending_unknown = False
+            self._ring.append(record)
+            self._last = record
+            totals = dict(self._totals)
+
+        if stats.snapshot is not None:
+            # The anomaly engine's cross-signal detectors read this block
+            # from the snapshot the engine is fed anyway — no side channel.
+            stats.snapshot["hostcorr"] = {
+                "available": host.available,
+                "signals": host_doc,
+                "straggler": verdict,
+            }
+        return self._families(
+            stats.base_keys, stats.base_vals, host, verdict, totals
+        )
+
+    # -- exposition --------------------------------------------------------
+
+    def _families(self, base_keys, base_vals, host, verdict, totals) -> list:
+        from tpumon.families import HOSTCORR_FAMILIES
+
+        labels = tuple(base_keys)
+        vals = tuple(base_vals)
+
+        def fam(name, cls):
+            _, help_text, extra = HOSTCORR_FAMILIES[name]
+            return cls(name, help_text, labels=labels + extra)
+
+        available = fam("tpu_hostcorr_available", GaugeMetricFamily)
+        available.add_metric(vals, 1.0 if host.available else 0.0)
+        out = [available]
+
+        groups = fam("tpu_hostcorr_signal_available", GaugeMetricFamily)
+        for group in SIGNAL_GROUPS:
+            groups.add_metric(
+                vals + (group,), 1.0 if host.groups.get(group) else 0.0
+            )
+        out.append(groups)
+
+        if host.psi:
+            share = fam("tpu_hostcorr_psi_share", GaugeMetricFamily)
+            stall = fam(
+                "tpu_hostcorr_psi_stall_seconds_total", CounterMetricFamily
+            )
+            for resource in sorted(host.psi):
+                for kind in sorted(host.psi[resource]):
+                    row = host.psi[resource][kind]
+                    share.add_metric(
+                        vals + (resource, kind), row["share"]
+                    )
+                    stall.add_metric(
+                        vals + (resource, kind), row["stall_s"]
+                    )
+            out.extend([share, stall])
+
+        pods = {
+            pod: row for pod, row in host.sched.items() if row
+        }
+        if pods:
+            delay = fam(
+                "tpu_hostcorr_sched_delay_seconds_total", CounterMetricFamily
+            )
+            shares = fam("tpu_hostcorr_sched_delay_share", GaugeMetricFamily)
+            any_share = False
+            for pod in sorted(pods):
+                row = pods[pod]
+                delay.add_metric(vals + (pod,), row["delay_s"])
+                if row.get("share") is not None:
+                    shares.add_metric(vals + (pod,), row["share"])
+                    any_share = True
+            out.append(delay)
+            if any_share:
+                out.append(shares)
+
+        rates = {
+            "tpu_hostcorr_net_bytes_per_second": host.net_bps,
+            "tpu_hostcorr_disk_bytes_per_second": host.disk_bps,
+        }
+        for name, by_dir in rates.items():
+            present = {
+                d: v for d, v in by_dir.items() if v is not None
+            }
+            if present:
+                rate_fam = fam(name, GaugeMetricFamily)
+                for direction in sorted(present):
+                    rate_fam.add_metric(
+                        vals + (direction,), present[direction]
+                    )
+                out.append(rate_fam)
+
+        if host.page_cache_bytes is not None:
+            cache = fam("tpu_hostcorr_page_cache_bytes", GaugeMetricFamily)
+            cache.add_metric(vals, host.page_cache_bytes)
+            out.append(cache)
+        if host.reclaim_pps is not None:
+            reclaim = fam(
+                "tpu_hostcorr_reclaim_pages_per_second", GaugeMetricFamily
+            )
+            reclaim.add_metric(vals, host.reclaim_pps)
+            out.append(reclaim)
+
+        if verdict.get("skew_pct") is not None:
+            skew = fam("tpu_straggler_skew_pct", GaugeMetricFamily)
+            skew.add_metric(vals, verdict["skew_pct"])
+            out.append(skew)
+        if verdict.get("active"):
+            vfam = fam("tpu_straggler_verdict", GaugeMetricFamily)
+            vfam.add_metric(
+                vals
+                + (verdict.get("cause", "unknown"), verdict.get("chip", "")),
+                1.0,
+            )
+            out.append(vfam)
+        if totals:
+            events = fam("tpu_straggler_events_total", CounterMetricFamily)
+            for cause in sorted(totals):
+                events.add_metric(vals + (cause,), float(totals[cause]))
+            out.append(events)
+        return out
+
+    # -- query surfaces ----------------------------------------------------
+
+    def replay(self, since: float = 0.0) -> tuple[dict, list]:
+        """(/hostcorr envelope, records at/after ``since``) — the server
+        bounds the record list and stamps continuation tokens."""
+        with self._lock:
+            records = [r for r in self._ring if r["ts"] >= since]
+            last = self._last
+            totals = dict(self._totals)
+            cycles = self._cycles
+            capacity = self._ring.maxlen
+        doc = {
+            "cycles": cycles,
+            "ring_capacity": capacity,
+            "available": bool(last and last["host"]["available"]),
+            "groups": dict(last["host"]["groups"]) if last else {},
+            "straggler": dict(last["straggler"]) if last else {},
+            "events_total": totals,
+        }
+        return doc, records
+
+    def snapshot(self) -> dict:
+        """The /debug/vars "hostcorr" block: O(1) occupancy + verdict."""
+        with self._lock:
+            return {
+                "cycles": self._cycles,
+                "records": len(self._ring),
+                "ring_capacity": self._ring.maxlen,
+                "available": bool(
+                    self._last and self._last["host"]["available"]
+                ),
+                "groups": (
+                    dict(self._last["host"]["groups"]) if self._last else {}
+                ),
+                "straggler": (
+                    dict(self._last["straggler"]) if self._last else {}
+                ),
+                "events_total": dict(self._totals),
+            }

@@ -1,0 +1,492 @@
+"""Non-instrumented host-signal sampling from procfs/cgroupfs.
+
+The host-correlation plane (ROADMAP item 3; PAPERS.md "Host-Side
+Telemetry for Performance Diagnosis" arXiv 2510.16946, eACGM arXiv
+2506.02007) reads ONLY kernel-exported files — no ptrace, no eBPF
+programs, no agent inside the workload, and critically **zero device
+queries**: every signal here comes from ``/proc`` and
+``/sys/fs/cgroup``, sampled once per poll cycle on the poller thread.
+
+Signal groups, each independently degradable (older kernels without PSI,
+disarmed cgroup controllers, non-Linux test hosts):
+
+- ``psi``   — cgroup-v2 pressure-stall information for cpu/memory/io
+  (``/sys/fs/cgroup/*.pressure`` at the root cgroup, falling back to
+  ``/proc/pressure/*``): the kernel's own "how much wall time did tasks
+  lose waiting for this resource" accounting.
+- ``sched`` — per-pod scheduler run delay from ``/proc/<pid>/schedstat``
+  (field 2: ns spent runnable-but-not-running), with pids grouped into
+  pods by the kubepods cgroup path in ``/proc/<pid>/cgroup`` — the
+  pod→pid mapping the attribution plane's kubelet view cannot provide
+  (the pod-resources API names pods, not processes).
+- ``net``   — interface byte counters from ``/proc/net/dev`` (lo and
+  virtual veth/bridge/tunnel interfaces excluded), as rx/tx rates.
+- ``disk``  — physical whole-device sector counters from
+  ``/proc/diskstats`` (partitions and dm/md stacked devices excluded),
+  as read/write byte rates.
+- ``vm``    — page-cache occupancy from ``/proc/meminfo`` and reclaim
+  scan activity (``pgscan_kswapd + pgscan_direct``) from
+  ``/proc/vmstat`` — the page-cache-pressure signal.
+
+Every path is rooted at ``TPUMON_HOSTCORR_PROC_ROOT`` so tests and CI
+run against a hermetic fixture tree (tpumon/hostcorr/fixture.py) instead
+of requiring a PSI-capable kernel.
+
+Rates are deltas between consecutive samples; the first cycle has no
+delta and reports ``None`` (absent-not-zero, the repo-wide stance).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import time
+from dataclasses import dataclass, field
+
+log = logging.getLogger(__name__)
+
+#: PSI resources sampled, in exposition order.
+PSI_RESOURCES = ("cpu", "memory", "io")
+
+#: Signal-group names (the `signal` label of tpu_hostcorr_signal_available).
+SIGNAL_GROUPS = ("psi", "sched", "net", "disk", "vm")
+
+#: kubepods pod-UID extraction from a /proc/<pid>/cgroup line. Matches
+#: both the systemd-driver shape (kubepods-burstable-pod3b4f_12ab.slice,
+#: underscores for dashes) and the cgroupfs-driver shape, where the QoS
+#: class is its OWN path segment between kubepods and the pod dir
+#: (/kubepods/burstable/pod3b4f-12ab/...; guaranteed pods sit directly
+#: under /kubepods/).
+_POD_RE = re.compile(
+    r"kubepods[^/]*(?:/(?:burstable|besteffort))?"
+    r"[/-]pod([0-9a-fA-F][0-9a-fA-F_-]{7,})"
+)
+
+#: Physical whole-device names in /proc/diskstats. Partitions are
+#: excluded so bytes are not double-counted — and so are stacked devices
+#: (dm-*, md*): an LVM/dm-crypt write increments BOTH the dm row and the
+#: backing sda/nvme row, so counting only the physical layer keeps one
+#: payload byte one accounted byte. loop/ram/zram excluded as
+#: non-storage.
+_DISK_RE = re.compile(
+    r"^(?:sd[a-z]+|hd[a-z]+|vd[a-z]+|xvd[a-z]+|nvme\d+n\d+|mmcblk\d+)$"
+)
+
+#: Virtual interfaces excluded from /proc/net/dev rates: pod traffic
+#: traverses the NIC *and* the CNI bridge *and* a veth pair, so counting
+#: them all would report 2-3x the real wire rate (node-exporter's
+#: default device exclusion, same motivation).
+#: bond/team masters are excluded too: the master row re-reports every
+#: byte already counted on its physical slave rows.
+_VIRTUAL_IF_RE = re.compile(
+    r"^(?:lo|veth.*|docker.*|br-.*|cni.*|flannel.*|cali.*|tunl.*"
+    r"|virbr.*|kube-.*|dummy.*|tap.*|vxlan.*|gre.*|nodelocaldns"
+    r"|bond.*|team.*)$"
+)
+
+_SECTOR_BYTES = 512.0
+
+
+@dataclass
+class HostSignals:
+    """One cycle's host-side sample, time-aligned with PollStats.
+
+    ``psi[resource][kind]`` carries ``share`` (avg10 as a 0-1 fraction)
+    and ``stall_s`` (cumulative stall seconds). ``sched[pod]`` carries
+    ``delay_s`` (cumulative run-delay seconds accumulated since plane
+    start) and ``share`` (delay seconds per wall second over the last
+    cycle; ``None`` on the first observation). Rate fields are ``None``
+    until a previous sample exists.
+    """
+
+    ts: float = 0.0
+    available: bool = False
+    groups: dict = field(default_factory=dict)  # group -> bool
+    psi: dict = field(default_factory=dict)
+    sched: dict = field(default_factory=dict)
+    net_bps: dict = field(default_factory=dict)  # dir -> rate | None
+    disk_bps: dict = field(default_factory=dict)
+    page_cache_bytes: float | None = None
+    dirty_bytes: float | None = None
+    reclaim_pps: float | None = None
+
+    def psi_share(self, resource: str, kind: str = "some") -> float | None:
+        row = (self.psi.get(resource) or {}).get(kind)
+        return None if row is None else row.get("share")
+
+    def max_sched_share(self) -> float | None:
+        shares = [
+            row["share"] for row in self.sched.values()
+            if row.get("share") is not None
+        ]
+        return max(shares) if shares else None
+
+    def to_dict(self) -> dict:
+        return {
+            "ts": self.ts,
+            "available": self.available,
+            "groups": dict(self.groups),
+            "psi": {
+                res: {kind: dict(row) for kind, row in kinds.items()}
+                for res, kinds in self.psi.items()
+            },
+            "sched": {pod: dict(row) for pod, row in self.sched.items()},
+            "net_bps": dict(self.net_bps),
+            "disk_bps": dict(self.disk_bps),
+            "page_cache_bytes": self.page_cache_bytes,
+            "dirty_bytes": self.dirty_bytes,
+            "reclaim_pps": self.reclaim_pps,
+        }
+
+
+def parse_psi(text: str) -> dict:
+    """``some avg10=1.23 ... total=456`` lines → {kind: {avg10, total_us}}.
+
+    Malformed lines are skipped (a truncated read must degrade to fewer
+    kinds, not a dead sampler).
+    """
+    out: dict[str, dict[str, float]] = {}
+    for line in text.splitlines():
+        parts = line.split()
+        if not parts or parts[0] not in ("some", "full"):
+            continue
+        row: dict[str, float] = {}
+        for tok in parts[1:]:
+            key, _, val = tok.partition("=")
+            try:
+                row[key] = float(val)
+            except ValueError:
+                continue
+        if "avg10" in row and "total" in row:
+            out[parts[0]] = {"avg10": row["avg10"], "total_us": row["total"]}
+    return out
+
+
+class HostSampler:
+    """Reads the host-signal files and folds deltas into rates.
+
+    Runs ONLY on the poller thread (the plane publishes results under its
+    own lock), so no locking here. Every group degrades independently:
+    an unreadable file marks its group unavailable for the cycle and the
+    sampler keeps going.
+    """
+
+    #: Cycles between full /proc scans rebuilding the pod→pid map; the
+    #: per-cycle cost between refreshes is one schedstat read per known
+    #: pod process, not a full process-table walk.
+    MAP_REFRESH_CYCLES = 15
+
+    #: Pod cardinality bound (a node hosts tens of pods, not thousands;
+    #: a runaway kubepods tree must not explode series — the guard
+    #: plane's governor is the backstop, this is the sane default).
+    MAX_PODS = 64
+
+    def __init__(self, proc_root: str = "") -> None:
+        self.proc_root = proc_root or ""
+        self._cycles = 0
+        #: resource -> resolved PSI path parts (or None = absent); probed
+        #: on the refresh cadence, read directly between refreshes so a
+        #: cycle costs one open per resource, not two.
+        self._psi_paths: dict[str, tuple[str, ...] | None] = {}
+        #: Cached "kernel exposes schedstat" probe (refresh cadence).
+        self._schedstat_ok = False
+        #: pod uid -> {pid: last run-delay ns} (delta accumulation).
+        self._pod_pids: dict[str, dict[int, float]] = {}
+        #: pod uid -> cumulative delay seconds since sampler start.
+        self._pod_delay_s: dict[str, float] = {}
+        #: Previous cumulative counters for rate computation.
+        self._prev_ts: float | None = None
+        self._prev_net: dict[str, float] | None = None
+        self._prev_disk: dict[str, float] | None = None
+        self._prev_reclaim: float | None = None
+        #: pod uid -> previous cumulative delay (share computation).
+        self._prev_pod_delay: dict[str, float] = {}
+
+    # -- path helpers ------------------------------------------------------
+
+    def _path(self, *parts: str) -> str:
+        return os.path.join(self.proc_root or "/", *parts)
+
+    def _read(self, *parts: str) -> str | None:
+        try:
+            with open(self._path(*parts), encoding="utf-8") as fh:
+                return fh.read()
+        except OSError:
+            return None
+
+    # -- the per-cycle entry point ----------------------------------------
+
+    def sample(self, now: float | None = None) -> HostSignals:
+        ts = time.time() if now is None else now
+        sig = HostSignals(ts=ts)
+        dt = None
+        if self._prev_ts is not None:
+            dt = ts - self._prev_ts
+            if dt <= 0:
+                dt = None  # clock went sideways: skip rates this cycle
+
+        # Path discovery (which PSI source exists, schedstat support,
+        # the pod→pid map) is re-probed on the refresh cadence only; the
+        # steady-state cycle pays one read per live signal, keeping the
+        # stage's poll-budget cost flat (measured: the every-cycle /proc
+        # walk alone cost ~1 ms on a 2-core sandbox kernel).
+        refresh = self._cycles % self.MAP_REFRESH_CYCLES == 0
+        if refresh:
+            self._probe_paths()
+            # Pods gone from the kubepods tree leave the exposition too
+            # (absent-not-zero): without this, every pod ever seen keeps
+            # a frozen counter + zero-share gauge for the exporter's
+            # lifetime — unbounded label cardinality under pod churn.
+            for uid in list(self._pod_delay_s):
+                if uid not in self._pod_pids:
+                    del self._pod_delay_s[uid]
+                    self._prev_pod_delay.pop(uid, None)
+
+        sig.groups["psi"] = self._sample_psi(sig)
+        sig.groups["sched"] = self._sample_sched(sig, dt)
+        sig.groups["net"] = self._sample_net(sig, dt)
+        sig.groups["disk"] = self._sample_disk(sig, dt)
+        sig.groups["vm"] = self._sample_vm(sig, dt)
+        sig.available = any(sig.groups.values())
+        self._prev_ts = ts
+        self._cycles += 1
+        return sig
+
+    def _probe_paths(self) -> None:
+        """Refresh-cadence discovery: PSI source per resource, schedstat
+        support, and the pod→pid map."""
+        for resource in PSI_RESOURCES:
+            for parts in (
+                ("sys", "fs", "cgroup", f"{resource}.pressure"),
+                ("proc", "pressure", resource),
+            ):
+                if os.path.exists(self._path(*parts)):
+                    self._psi_paths[resource] = parts
+                    break
+            else:
+                self._psi_paths[resource] = None
+        self._schedstat_ok = os.path.exists(
+            self._path("proc", "self", "schedstat")
+        )
+        self._pod_pids = self._scan_pod_pids()
+
+    # -- PSI ---------------------------------------------------------------
+
+    def _sample_psi(self, sig: HostSignals) -> bool:
+        found = False
+        for resource in PSI_RESOURCES:
+            parts = self._psi_paths.get(resource)
+            if parts is None:
+                continue
+            text = self._read(*parts)
+            if text is None:
+                continue
+            rows = parse_psi(text)
+            if not rows:
+                continue
+            found = True
+            sig.psi[resource] = {
+                kind: {
+                    "share": row["avg10"] / 100.0,
+                    "stall_s": row["total_us"] / 1e6,
+                }
+                for kind, row in rows.items()
+            }
+        return found
+
+    # -- per-pod scheduler delay ------------------------------------------
+
+    def _scan_pod_pids(self) -> dict[str, dict[int, float]]:
+        """Walk /proc once, grouping pids by kubepods pod UID. Preserves
+        each surviving pid's last-seen delay so deltas stay continuous
+        across refreshes."""
+        proc_dir = self._path("proc")
+        try:
+            entries = os.listdir(proc_dir)
+        except OSError:
+            return {}
+        pods: dict[str, dict[int, float]] = {}
+        for entry in entries:
+            if not entry.isdigit():
+                continue
+            pid = int(entry)
+            cgroup = self._read("proc", entry, "cgroup")
+            if cgroup is None:
+                continue  # pid exited between listdir and read: routine
+            m = _POD_RE.search(cgroup)
+            if m is None:
+                continue
+            uid = m.group(1).replace("_", "-")
+            if uid not in pods and len(pods) >= self.MAX_PODS:
+                continue
+            prev = self._pod_pids.get(uid, {}).get(pid)
+            pods.setdefault(uid, {})[pid] = prev if prev is not None else -1.0
+        return pods
+
+    def _read_run_delay_ns(self, pid: int) -> float | None:
+        text = self._read("proc", str(pid), "schedstat")
+        if text is None:
+            return None
+        parts = text.split()
+        if len(parts) < 2:
+            return None
+        try:
+            return float(parts[1])
+        except ValueError:
+            return None
+
+    def _sample_sched(self, sig: HostSignals, dt: float | None) -> bool:
+        any_read = False
+        for uid, pids in self._pod_pids.items():
+            for pid in list(pids):
+                delay_ns = self._read_run_delay_ns(pid)
+                if delay_ns is None:
+                    del pids[pid]  # pid died; its past deltas are kept
+                    continue
+                any_read = True
+                last = pids[pid]
+                if last >= 0 and delay_ns >= last:
+                    self._pod_delay_s[uid] = (
+                        self._pod_delay_s.get(uid, 0.0)
+                        + (delay_ns - last) / 1e9
+                    )
+                else:
+                    # First observation of this pid (or a counter reset):
+                    # establish the baseline, contribute no delta.
+                    self._pod_delay_s.setdefault(uid, 0.0)
+                pids[pid] = delay_ns
+        if self._pod_pids:
+            available = any_read  # pods exist; did any schedstat read?
+        else:
+            # No kubepods on this host (bare exporters, CI): the sched
+            # signal is available iff the kernel exposes schedstat at all
+            # (cached probe, refresh cadence).
+            available = self._schedstat_ok
+        if not available:
+            # Absent-not-zero: with schedstat unreadable this cycle the
+            # remembered per-pod totals are zombies — exporting them
+            # would show frozen counters and zero shares under a group
+            # flagged unavailable.
+            return False
+        for uid, total_s in self._pod_delay_s.items():
+            prev = self._prev_pod_delay.get(uid)
+            share = None
+            if dt is not None and prev is not None:
+                share = max(0.0, (total_s - prev) / dt)
+            sig.sched[uid] = {"delay_s": total_s, "share": share}
+        self._prev_pod_delay = dict(self._pod_delay_s)
+        return True
+
+    # -- /proc/net/dev byte rates -----------------------------------------
+
+    def _sample_net(self, sig: HostSignals, dt: float | None) -> bool:
+        text = self._read("proc", "net", "dev")
+        if text is None:
+            return False
+        rx = tx = 0.0
+        seen = False
+        for line in text.splitlines():
+            name, sep, rest = line.partition(":")
+            if not sep:
+                continue
+            iface = name.strip()
+            if _VIRTUAL_IF_RE.match(iface):
+                continue
+            parts = rest.split()
+            if len(parts) < 9:
+                continue
+            try:
+                rx += float(parts[0])
+                tx += float(parts[8])
+            except ValueError:
+                continue
+            seen = True
+        if not seen:
+            return False
+        cur = {"rx": rx, "tx": tx}
+        if dt is not None and self._prev_net is not None:
+            for direction in ("rx", "tx"):
+                delta = cur[direction] - self._prev_net[direction]
+                sig.net_bps[direction] = max(0.0, delta / dt)
+        else:
+            sig.net_bps = {"rx": None, "tx": None}
+        self._prev_net = cur
+        return True
+
+    # -- /proc/diskstats byte rates ---------------------------------------
+
+    def _sample_disk(self, sig: HostSignals, dt: float | None) -> bool:
+        text = self._read("proc", "diskstats")
+        if text is None:
+            return False
+        read_b = write_b = 0.0
+        seen = False
+        for line in text.splitlines():
+            parts = line.split()
+            if len(parts) < 10 or not _DISK_RE.match(parts[2]):
+                continue
+            try:
+                read_b += float(parts[5]) * _SECTOR_BYTES
+                write_b += float(parts[9]) * _SECTOR_BYTES
+            except ValueError:
+                continue
+            seen = True
+        if not seen:
+            # All-stacked storage (dm-only LVM/dm-crypt roots): a flat-0
+            # rate here would read "disk quiet" during a real IO storm —
+            # absent-not-zero, same as _sample_net with no physical NIC.
+            return False
+        cur = {"read": read_b, "write": write_b}
+        if dt is not None and self._prev_disk is not None:
+            for direction in ("read", "write"):
+                delta = cur[direction] - self._prev_disk[direction]
+                sig.disk_bps[direction] = max(0.0, delta / dt)
+        else:
+            sig.disk_bps = {"read": None, "write": None}
+        self._prev_disk = cur
+        return True
+
+    # -- page cache + reclaim ---------------------------------------------
+
+    def _sample_vm(self, sig: HostSignals, dt: float | None) -> bool:
+        meminfo = self._read("proc", "meminfo")
+        vmstat = self._read("proc", "vmstat")
+        found = False
+        if meminfo is not None:
+            for line in meminfo.splitlines():
+                parts = line.split()
+                if len(parts) < 2:
+                    continue
+                if parts[0] == "Cached:":
+                    try:
+                        sig.page_cache_bytes = float(parts[1]) * 1024.0
+                        found = True
+                    except ValueError:
+                        pass
+                elif parts[0] == "Dirty:":
+                    try:
+                        sig.dirty_bytes = float(parts[1]) * 1024.0
+                    except ValueError:
+                        pass
+        if vmstat is not None:
+            scans = 0.0
+            seen = False
+            for line in vmstat.splitlines():
+                parts = line.split()
+                if len(parts) == 2 and parts[0] in (
+                    "pgscan_kswapd", "pgscan_direct"
+                ):
+                    try:
+                        scans += float(parts[1])
+                        seen = True
+                    except ValueError:
+                        continue
+            if seen:
+                found = True
+                if dt is not None and self._prev_reclaim is not None:
+                    sig.reclaim_pps = max(
+                        0.0, (scans - self._prev_reclaim) / dt
+                    )
+                self._prev_reclaim = scans
+        return found

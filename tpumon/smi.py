@@ -69,6 +69,9 @@ _F_GUARD_STATE = "tpumon_guard_state"
 #: The parser strips the _total suffix from counter families.
 _F_SHED = "tpumon_shed_requests"
 _F_CARDINALITY = "tpumon_cardinality_dropped_series"
+_F_HOSTCORR_AVAILABLE = "tpu_hostcorr_available"
+_F_STRAGGLER_SKEW = "tpu_straggler_skew_pct"
+_F_STRAGGLER_VERDICT = "tpu_straggler_verdict"
 
 
 def _fetch(url: str, timeout: float) -> str:
@@ -186,6 +189,28 @@ def snapshot_from_families(families) -> dict:
             if collapsed:
                 guard["cardinality_dropped"] = collapsed
         snap["guard"] = guard
+
+    hc_avail = fams.get(_F_HOSTCORR_AVAILABLE)
+    if hc_avail is not None and hc_avail.samples:
+        # Host-correlation plane (tpumon/hostcorr): present iff the
+        # plane is enabled on the exporter; 0 = host signals unreadable
+        # (device-only verdicts).
+        snap["hostcorr_available"] = hc_avail.samples[0].value > 0
+    skew = fams.get(_F_STRAGGLER_SKEW)
+    if skew is not None and skew.samples:
+        snap["straggler"] = {
+            "skew_pct": skew.samples[0].value, "active": False
+        }
+    verdict = fams.get(_F_STRAGGLER_VERDICT)
+    if verdict is not None and verdict.samples:
+        s0 = verdict.samples[0]
+        snap.setdefault("straggler", {}).update(
+            {
+                "active": True,
+                "cause": s0.labels.get("cause", "unknown"),
+                "chip": s0.labels.get("chip", "?"),
+            }
+        )
 
     net = fams.get(_F_NET_RATE)
     if net is not None:
@@ -696,6 +721,22 @@ def render(snap: dict, out=None) -> None:
                 + ("..." if len(fams_hit) > 2 else "") + ")"
             )
         p("GUARD: " + "; ".join(parts))
+
+    straggler = snap.get("straggler")
+    if straggler and straggler.get("active"):
+        # Host-correlation verdict (tpumon/hostcorr): the laggard chip
+        # plus the cause the cross-signal join attributed.
+        p(
+            f"STRAGGLER: chip {straggler.get('chip', '?')} lagging "
+            f"{straggler.get('skew_pct', 0):.0f} duty points below the "
+            f"slice median — cause: {straggler.get('cause', 'unknown')} "
+            "(GET /hostcorr for the time-aligned host signals)"
+        )
+    if snap.get("hostcorr_available") is False:
+        p(
+            "hostcorr: host signals unavailable (no PSI/schedstat) — "
+            "straggler verdicts are device-only"
+        )
 
     streams = snap.get("watch_streams")
     if streams:

@@ -15,6 +15,7 @@ from tpumon.families import (
     ANOMALY_FAMILIES,
     FLEET_FAMILIES,
     HEALTH_FAMILIES,
+    HOSTCORR_FAMILIES,
     IDENTITY_FAMILIES,
     SELF_FAMILIES,
     WORKLOAD_FAMILIES,
@@ -122,6 +123,31 @@ def render() -> str:
     for name, (desc, labels) in ANOMALY_FAMILIES.items():
         label_s = ", ".join(f"`{l}`" for l in labels) or "—"
         lines.append(f"| `{name}` | {desc} | {label_s} |")
+
+    lines += [
+        "",
+        "## Host correlation & straggler attribution (`tpumon/hostcorr`)",
+        "",
+        "Non-instrumented host signals (cgroup PSI, per-pod sched delay,",
+        "net/disk byte rates, page-cache pressure) sampled from",
+        "procfs/cgroupfs at the same 1 Hz cadence as the device poll —",
+        "zero device queries, zero workload instrumentation — and joined",
+        "with each cycle's device snapshot into a per-slice straggler",
+        "verdict (cause ∈ `device` / `host-cpu` / `host-mem` / `host-io` /",
+        "`unknown`). Time-aligned records replay via `GET /hostcorr`",
+        "(`?since=`); host_straggler/host_stall events ride `/anomalies`.",
+        "Enabled by default; `TPUMON_HOSTCORR=0` disables,",
+        "`TPUMON_HOSTCORR_<FIELD>` tunes thresholds",
+        "(`tpumon/hostcorr/detectors.py`). On kernels without",
+        "PSI/schedstat the plane reports `tpu_hostcorr_available 0` and",
+        "verdicts degrade to device-only attribution.",
+        "",
+        "| family | type | description | extra labels |",
+        "|---|---|---|---|",
+    ]
+    for name, (kind, desc, labels) in HOSTCORR_FAMILIES.items():
+        label_s = ", ".join(f"`{l}`" for l in labels) or "—"
+        lines.append(f"| `{name}` | {kind} | {desc} | {label_s} |")
 
     from tpumon.families import host_family_rows
 

@@ -44,7 +44,7 @@ _METRIC_RE = re.compile(
     r"|tpu_hostcorr|tpu_straggler"
     r"|tpu_fleet|tpumon_trace|tpumon_poll|tpumon_family|tpumon_breaker"
     r"|tpumon_retries|tpumon_watchdog|tpumon_guard|tpumon_shed"
-    r"|tpumon_cardinality)_[a-z0-9_]+"
+    r"|tpumon_cardinality|tpumon_render|tpumon_exposition)_[a-z0-9_]+"
     r"|tpumon_up|tpumon_degraded)\b"
 )
 

@@ -236,6 +236,7 @@ class TestZigzagPermutationAlgebra:
     exercise end-to-end (hypothesis over n up to 512)."""
 
     def test_permutation_properties(self):
+        pytest.importorskip("hypothesis")
         from hypothesis import given, settings
         from hypothesis import strategies as st
 

@@ -1,0 +1,572 @@
+"""Delta render + negotiated exposition (tpumon/exporter/encodings.py).
+
+The contract under test, in order of importance:
+
+1. **Byte equivalence** — the incremental renderer's assembled page is
+   byte-for-byte identical to the full renderer's, under randomized
+   add/change/remove family sequences (property-style, seeded), and the
+   fleet parser/binary decode see identical snapshots either way.
+2. **Encoding caches never serve stale bytes** — a gzip (or snapshot)
+   response cached for version N can never be served once version N+1
+   published.
+3. **Negotiation** — Accept picks text / OpenMetrics / snapshot with
+   text as the wildcard floor; the fleet fan-in decodes the compact
+   frame from a live exporter and falls back cleanly to text parsing
+   against a text-only exporter.
+"""
+
+from __future__ import annotations
+
+import gzip
+import random
+
+import pytest
+from prometheus_client.core import (
+    CounterMetricFamily,
+    GaugeMetricFamily,
+    HistogramMetricFamily,
+)
+
+from tpumon.backends.fake import FakeTpuBackend
+from tpumon.config import Config
+from tpumon.exporter.collector import SampleCache, build_families
+from tpumon.exporter.encodings import (
+    FORMAT_OPENMETRICS,
+    FORMAT_SNAPSHOT,
+    FORMAT_TEXT,
+    OPENMETRICS_CONTENT_TYPE,
+    SNAPSHOT_CONTENT_TYPE,
+    TEXT_CONTENT_TYPE,
+    decode_snapshot,
+    encode_snapshot,
+    is_snapshot,
+    negotiate,
+    parse_formats,
+    requested_format,
+    snapshot_request,
+)
+from tpumon.fleet.ingest import NodeFeed, node_snapshot_from_text
+
+
+# -- randomized equivalence (property-style, seeded: no hypothesis dep) ----
+
+def _random_families(rng: random.Random, names: list[str]):
+    """One cycle's family list for the given live name set."""
+    import zlib
+
+    fams = []
+    for name in names:
+        # crc32, not hash(): str hashing is salted per interpreter run,
+        # and the seeded suite must cover the same family-type mix on
+        # every CI run.
+        kind = zlib.crc32(name.encode()) % 3
+        if kind == 0:
+            fam = GaugeMetricFamily(name, f"help for {name}", labels=("chip",))
+            for chip in range(rng.randint(1, 4)):
+                fam.add_metric((str(chip),), rng.choice(
+                    [0.0, 1.5, rng.random() * 100, float(rng.randint(0, 10))]
+                ))
+        elif kind == 1:
+            fam = CounterMetricFamily(name, f"count of {name}", labels=("k",))
+            fam.add_metric(("a",), float(rng.randint(0, 1000)))
+        else:
+            fam = HistogramMetricFamily(name, f"dist of {name}", labels=())
+            count = rng.randint(0, 50)
+            fam.add_metric(
+                (), [("1.0", float(count // 2)), ("+Inf", float(count))],
+                sum_value=float(count) * 0.5,
+            )
+        fams.append(fam)
+    return fams
+
+
+def _mutate(rng: random.Random, names: list[str], pool: list[str]) -> list[str]:
+    """Randomly add/remove/reorder the live family name set."""
+    names = [n for n in names if rng.random() > 0.2]  # remove some
+    for candidate in pool:
+        if candidate not in names and rng.random() < 0.25:
+            names.append(candidate)
+    if rng.random() < 0.3:
+        rng.shuffle(names)
+    return names
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_delta_page_byte_equal_under_random_change_sequences(seed):
+    rng = random.Random(seed)
+    pool = [f"synthetic_family_{i}" for i in range(14)]
+    names = pool[:6]
+    full = SampleCache(delta=False)
+    delta = SampleCache(delta=True)
+    for _cycle in range(12):
+        # The same family objects go through both renderers: values
+        # change with probability per family, membership churns.
+        fams = _random_families(rng, names)
+        full.publish(list(fams))
+        stats = delta.publish(list(fams))
+        assert full.rendered() == delta.rendered(), f"cycle {_cycle}"
+        assert stats.families == len(fams)
+        assert stats.hits + stats.rendered == len(fams)
+        # Fleet views agree too (trivially, given byte equality — but
+        # this is the consumer contract the ISSUE names).
+        assert node_snapshot_from_text(
+            full.rendered().decode()
+        ) == node_snapshot_from_text(delta.rendered().decode())
+        names = _mutate(rng, names, pool)
+
+
+def test_delta_equivalence_without_native_renderer(monkeypatch):
+    from tpumon import _native
+
+    monkeypatch.setenv("TPUMON_NO_NATIVE", "1")
+    monkeypatch.setattr(_native, "_modules", {})
+    rng = random.Random(7)
+    names = [f"py_family_{i}" for i in range(8)]
+    full = SampleCache(delta=False)
+    delta = SampleCache(delta=True)
+    for _cycle in range(6):
+        fams = _random_families(rng, names)
+        full.publish(list(fams))
+        delta.publish(list(fams))
+        assert full.rendered() == delta.rendered()
+        names = _mutate(rng, names, list(names))
+
+
+def test_unchanged_families_hit_the_segment_cache():
+    cache = SampleCache(delta=True)
+    rng = random.Random(11)
+    fams = _random_families(rng, [f"stable_{i}" for i in range(5)])
+    first = cache.publish(list(fams))
+    assert first.rendered == 5 and first.hits == 0
+    again = cache.publish(list(fams))
+    assert again.hits == 5 and again.rendered == 0
+    stats = cache.render_stats()
+    assert stats["hit_ratio"] == 0.5
+
+
+def test_duplicate_family_names_do_not_alias():
+    cache = SampleCache(delta=True)
+    a = GaugeMetricFamily("dup_name", "first", labels=())
+    a.add_metric((), 1.0)
+    b = GaugeMetricFamily("dup_name", "second", labels=())
+    b.add_metric((), 2.0)
+    full = SampleCache(delta=False)
+    for _ in range(2):
+        cache.publish([a, b])
+        full.publish([a, b])
+        assert cache.rendered() == full.rendered()
+
+
+def test_live_poll_page_equivalence():
+    """The real poll pipeline's families through both renderers."""
+    backend = FakeTpuBackend.preset("v4-8")
+    cfg = Config()
+    full = SampleCache(delta=False)
+    delta = SampleCache(delta=True)
+    for _ in range(4):
+        backend.advance()
+        fams, _stats = build_families(backend, cfg)
+        full.publish(list(fams))
+        rs = delta.publish(list(fams))
+        assert full.rendered() == delta.rendered()
+    # A live page always has invariant families (identity, info): the
+    # delta renderer must be hitting on them by the second cycle.
+    assert rs.hits > 0
+
+
+def test_exotic_family_parks_page_on_python_pass():
+    """A family the native renderer can't take (timestamped sample) must
+    not wreck delta mode: after the first doomed native attempt the page
+    parks on the Python pass — whose segment cache keeps earning hits
+    while the family persists — and stays byte-equal to the full render.
+    Once the exotic family leaves, native is retried."""
+    from tpumon import _native
+
+    if _native.load_extension("_exposition") is None:
+        pytest.skip("native renderer unavailable")
+    rng = random.Random(13)
+    names = [f"plain_{i}" for i in range(5)]
+    exotic = GaugeMetricFamily("exotic_stamped", "ts sample", labels=())
+    exotic.add_metric((), 1.0, timestamp=123.0)
+    full = SampleCache(delta=False)
+    delta = SampleCache(delta=True)
+    fams = _random_families(rng, names)
+    for cycle in range(3):
+        page = [*fams, exotic]
+        full.publish(list(page))
+        stats = delta.publish(list(page))
+        assert full.rendered() == delta.rendered(), f"cycle {cycle}"
+        if cycle > 0:
+            # The Python pass's segments survive across cycles even
+            # though the native extension is loaded and blocked.
+            assert stats.hits == len(page)
+    assert delta._native_blocked == {"exotic_stamped"}
+    # Exotic family gone: native pass resumes, bytes still equal.
+    full.publish(list(fams))
+    delta.publish(list(fams))
+    assert full.rendered() == delta.rendered()
+    assert not delta._native_blocked
+
+
+# -- snapshot codec ---------------------------------------------------------
+
+def test_snapshot_codec_roundtrip_is_identity():
+    backend = FakeTpuBackend.preset("v4-8")
+    fams, _ = build_families(backend, Config())
+    cache = SampleCache()
+    cache.publish(list(fams))
+    snap = node_snapshot_from_text(cache.rendered().decode())
+    frame = encode_snapshot(snap)
+    assert is_snapshot(frame)
+    assert decode_snapshot(frame) == snap
+    # Deterministic: equal snapshots encode to equal bytes (the
+    # response cache dedupes on this).
+    assert encode_snapshot(decode_snapshot(frame)) == frame
+
+
+def test_snapshot_codec_rejects_garbage():
+    with pytest.raises(ValueError):
+        decode_snapshot(b"# HELP nope a text page\n")
+    frame = encode_snapshot({"a": 1})
+    with pytest.raises(ValueError):
+        decode_snapshot(frame[:-2])  # truncated payload
+    with pytest.raises(ValueError):
+        decode_snapshot(frame[:6])  # truncated length varint
+
+
+# -- negotiation ------------------------------------------------------------
+
+def test_negotiate_wildcards_and_defaults_stay_text():
+    formats = parse_formats(("text", "openmetrics", "snapshot"))
+    assert negotiate("", formats) == FORMAT_TEXT
+    assert negotiate("*/*", formats) == FORMAT_TEXT
+    assert negotiate("text/*", formats) == FORMAT_TEXT
+    assert negotiate("application/json", formats) == FORMAT_TEXT
+
+
+def test_negotiate_explicit_formats():
+    formats = parse_formats(("text", "openmetrics", "snapshot"))
+    assert negotiate(SNAPSHOT_CONTENT_TYPE, formats) == FORMAT_SNAPSHOT
+    assert (
+        negotiate("application/openmetrics-text; version=1.0.0", formats)
+        == FORMAT_OPENMETRICS
+    )
+    # The Prometheus scraper shape: OM at q=0.5 beats */* at q=0.1.
+    assert (
+        negotiate(
+            "application/openmetrics-text;version=1.0.0;q=0.5,*/*;q=0.1",
+            formats,
+        )
+        == FORMAT_OPENMETRICS
+    )
+    # The fleet shape: snapshot first, text as explicit fallback.
+    assert (
+        negotiate(f"{SNAPSHOT_CONTENT_TYPE}, text/plain;q=0.5", formats)
+        == FORMAT_SNAPSHOT
+    )
+    # q=0 means "never this".
+    assert (
+        negotiate("application/openmetrics-text;q=0", formats) == FORMAT_TEXT
+    )
+
+
+def test_negotiate_respects_disabled_formats():
+    text_only = parse_formats(("text",))
+    assert negotiate(SNAPSHOT_CONTENT_TYPE, text_only) == FORMAT_TEXT
+    assert negotiate("application/openmetrics-text", text_only) == FORMAT_TEXT
+
+
+def test_parse_formats_always_keeps_text():
+    assert parse_formats(()) == ("text",)
+    assert parse_formats(("snapshot",)) == ("text", "snapshot")
+    assert parse_formats(("bogus", "openmetrics")) == ("text", "openmetrics")
+
+
+def test_grpc_format_request_roundtrip():
+    assert requested_format(b"") == FORMAT_TEXT
+    assert requested_format(snapshot_request("snapshot")) == FORMAT_SNAPSHOT
+    assert requested_format(snapshot_request("nonsense")) == FORMAT_TEXT
+    assert requested_format(b"\xff\xff garbage") == FORMAT_TEXT
+
+
+# -- the exporter's negotiated scrape path ---------------------------------
+
+@pytest.fixture
+def exporter():
+    from tpumon.exporter.server import build_exporter
+
+    # A long interval so the page only moves when the test says so.
+    exp = build_exporter(
+        Config(port=0, addr="127.0.0.1", interval=60.0),
+        FakeTpuBackend.preset("v4-8"),
+    )
+    exp.poller.poll_once()
+    try:
+        yield exp
+    finally:
+        exp.close()
+
+
+def test_negotiated_responses_by_accept(exporter):
+    r = exporter.renderer
+    text, headers = r.respond({})
+    assert ("Content-Type", TEXT_CONTENT_TYPE) in headers
+    assert b"accelerator_duty_cycle_percent" in text
+
+    om, headers = r.respond({"HTTP_ACCEPT": "application/openmetrics-text"})
+    assert ("Content-Type", OPENMETRICS_CONTENT_TYPE) in headers
+    assert om.endswith(b"# EOF\n")
+    assert om.count(b"# EOF") == 1  # two halves joined into one document
+    assert b"accelerator_duty_cycle_percent" in om
+    assert b"exporter_scrape_duration_seconds" in om  # self half present
+
+    snap_body, headers = r.respond({"HTTP_ACCEPT": SNAPSHOT_CONTENT_TYPE})
+    assert ("Content-Type", SNAPSHOT_CONTENT_TYPE) in headers
+    assert is_snapshot(snap_body)
+    assert decode_snapshot(snap_body) == node_snapshot_from_text(text.decode())
+
+
+def test_snapshot_ignores_gzip_encoding(exporter):
+    body, headers = exporter.renderer.respond(
+        {"HTTP_ACCEPT": SNAPSHOT_CONTENT_TYPE, "HTTP_ACCEPT_ENCODING": "gzip"}
+    )
+    assert is_snapshot(body)
+    assert not any(h[0] == "Content-Encoding" for h in headers)
+
+
+def test_gzip_cache_hit_and_invalidation(exporter):
+    r = exporter.renderer
+    text, _ = r.respond({})
+    gz1, headers = r.respond({"HTTP_ACCEPT_ENCODING": "gzip"})
+    assert ("Content-Encoding", "gzip") in headers
+    assert gzip.decompress(gz1) == text
+    # Unchanged page: the SAME object comes back — a dict lookup, zero
+    # encode work.
+    gz2, _ = r.respond({"HTTP_ACCEPT_ENCODING": "gzip"})
+    assert gz2 is gz1
+    saves = exporter.telemetry.render_encode_saves.labels(
+        format="text", encoding="gzip"
+    )._value.get()
+    assert saves >= 1
+    # New publish -> new version: the stale compressed page can never
+    # be served for it.
+    exporter.backend.advance()
+    exporter.poller.poll_once()
+    text2, _ = r.respond({})
+    assert text2 != text
+    gz3, _ = r.respond({"HTTP_ACCEPT_ENCODING": "gzip"})
+    assert gzip.decompress(gz3) == text2
+
+
+def test_snapshot_cache_invalidation_tracks_versions(exporter):
+    r = exporter.renderer
+    s1, _ = r.respond({"HTTP_ACCEPT": SNAPSHOT_CONTENT_TYPE})
+    s1_again, _ = r.respond({"HTTP_ACCEPT": SNAPSHOT_CONTENT_TYPE})
+    assert s1_again is s1
+    exporter.backend.advance()
+    exporter.poller.poll_once()
+    s2, _ = r.respond({"HTTP_ACCEPT": SNAPSHOT_CONTENT_TYPE})
+    text2, _ = r.respond({})
+    assert decode_snapshot(s2) == node_snapshot_from_text(text2.decode())
+
+
+def test_exposition_requests_counted(exporter):
+    r = exporter.renderer
+    before = exporter.telemetry.exposition_requests.labels(
+        format="snapshot"
+    )._value.get()
+    r.respond({"HTTP_ACCEPT": SNAPSHOT_CONTENT_TYPE})
+    after = exporter.telemetry.exposition_requests.labels(
+        format="snapshot"
+    )._value.get()
+    assert after == before + 1
+
+
+def test_render_self_families_on_page(exporter):
+    page = exporter.render_page().decode()
+    assert "tpumon_render_delta 1.0" in page
+    assert "tpumon_render_invalidated_families" in page
+    assert "tpumon_render_family_cache_hits_total" in page
+    assert "tpumon_render_encode_saves_total" in page
+    assert "tpumon_exposition_requests_total" in page
+
+
+def test_render_delta_off_still_serves_identical_bytes():
+    from tpumon.exporter.server import build_exporter
+
+    off = build_exporter(
+        Config(port=0, addr="127.0.0.1", interval=60.0, render_delta=False),
+        FakeTpuBackend.preset("v4-8"),
+    )
+    try:
+        off.poller.poll_once()
+        page = off.render_page().decode()
+        assert "tpumon_render_delta 0.0" in page
+        assert off.cache.render_stats()["delta"] is False
+        assert "accelerator_duty_cycle_percent" in page
+    finally:
+        off.close()
+
+
+# -- fleet fan-in against live exporters -----------------------------------
+
+def test_fleet_feed_negotiates_snapshot_and_falls_back():
+    from tpumon.exporter.server import build_exporter
+
+    negotiating = build_exporter(
+        Config(port=0, addr="127.0.0.1", interval=60.0),
+        FakeTpuBackend.preset("v4-8"),
+    )
+    text_only = build_exporter(
+        Config(
+            port=0, addr="127.0.0.1", interval=60.0,
+            exposition_formats=("text",),
+        ),
+        FakeTpuBackend.preset("v4-8"),
+    )
+    negotiating.start()
+    text_only.start()
+    feed_new = NodeFeed(negotiating.server.url)
+    feed_old = NodeFeed(text_only.server.url)
+    try:
+        feed_new.poll()
+        feed_old.poll()
+        snap_new, ts_new, err_new = feed_new.current()
+        snap_old, ts_old, err_old = feed_old.current()
+        assert snap_new is not None and err_new == ""
+        assert snap_old is not None and err_old == ""
+        assert feed_new.snapshot_decoded  # the compact frame was used
+        assert not feed_old.snapshot_decoded  # text parse fallback
+        # Both transports produce the same snapshot structure.
+        assert set(snap_new) == set(snap_old)
+        assert snap_new["device_count"] == snap_old["device_count"]
+        assert snap_new["identity"].keys() == snap_old["identity"].keys()
+    finally:
+        feed_new.stop()
+        feed_old.stop()
+        negotiating.close()
+        text_only.close()
+
+
+def test_grpc_get_and_watch_serve_negotiated_snapshot():
+    grpc = pytest.importorskip("grpc")  # noqa: F841
+    from tpumon.exporter.grpc_service import (
+        METHOD_GET,
+        decode_page_response,
+        watch_pages,
+    )
+    from tpumon.exporter.server import build_exporter
+
+    exp = build_exporter(
+        Config(
+            port=0, addr="127.0.0.1", interval=0.2, grpc_serve_port=0,
+        ),
+        FakeTpuBackend.preset("v4-8"),
+    )
+    exp.start()
+    try:
+        addr = f"127.0.0.1:{exp.grpc_server.port}"
+        channel = grpc.insecure_channel(addr)
+        try:
+            call = channel.unary_unary(
+                METHOD_GET, request_serializer=None, response_deserializer=None
+            )
+            # Old-style empty request: text, exactly as before.
+            page, version = decode_page_response(call(b"", timeout=5))
+            assert not is_snapshot(page)
+            assert b"accelerator_duty_cycle_percent" in page
+            # Negotiated: the compact frame, equal to parsing the page.
+            frame, version2 = decode_page_response(
+                call(snapshot_request("snapshot"), timeout=5)
+            )
+            assert is_snapshot(frame)
+            assert version2 >= version
+            snap = decode_snapshot(frame)
+            assert snap["device_count"] == 4
+        finally:
+            channel.close()
+        # Watch stream with the format request: every push decodes.
+        import grpc as grpc_mod
+
+        channel = grpc_mod.insecure_channel(addr)
+        try:
+            call = channel.unary_stream(
+                "/tpumon.v1.Metrics/Watch",
+                request_serializer=None,
+                response_deserializer=None,
+            )
+            stream = call(snapshot_request("snapshot"), timeout=30)
+            frames = []
+            for raw in stream:
+                frames.append(decode_page_response(raw)[0])
+                if len(frames) >= 2:
+                    break
+            stream.cancel()
+            assert all(is_snapshot(f) for f in frames)
+            assert all(
+                decode_snapshot(f)["device_count"] == 4 for f in frames
+            )
+        finally:
+            channel.close()
+        # And the plain helper still sees text pages (back-compat).
+        pages = watch_pages(addr, max_messages=1)
+        assert pages and not is_snapshot(pages[0][0])
+    finally:
+        exp.close()
+
+
+def test_fleet_watch_fan_in_decodes_snapshot_frames():
+    pytest.importorskip("grpc")
+    import time
+
+    from tpumon.exporter.server import build_exporter
+
+    exp = build_exporter(
+        Config(
+            port=0, addr="127.0.0.1", interval=0.2, grpc_serve_port=0,
+        ),
+        FakeTpuBackend.preset("v4-8"),
+    )
+    exp.start()
+    feed = NodeFeed(
+        f"{exp.server.url}|grpc=127.0.0.1:{exp.grpc_server.port}"
+    )
+    try:
+        feed.start_watch()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if feed.watch_state_now() == "streaming":
+                break
+            time.sleep(0.05)
+        assert feed.watch_state_now() == "streaming"
+        snap, _, _ = feed.current()
+        assert snap is not None
+        assert feed.snapshot_decoded  # pushes arrived as compact frames
+        assert snap["device_count"] == 4
+    finally:
+        feed.stop()
+        exp.close()
+
+
+# -- registry_renderer (sidecar / workload harness) gzip cache -------------
+
+def test_registry_renderer_reuses_gzip_for_unchanged_page():
+    from prometheus_client import Counter
+    from prometheus_client.registry import CollectorRegistry
+
+    from tpumon.exporter.server import registry_renderer
+
+    registry = CollectorRegistry()
+    counter = Counter("demo_events", "demo", registry=registry)
+    render = registry_renderer(registry)
+    plain = render(False)
+    gz1 = render(True)
+    assert gzip.decompress(gz1) == plain
+    # Unchanged registry: the gzip bytes come straight from the cache.
+    gz2 = render(True)
+    assert gz2 is gz1
+    # Changed registry: fresh compression, never the stale body.
+    counter.inc()
+    gz3 = render(True)
+    assert gz3 is not gz1
+    assert b"demo_events_total 1.0" in gzip.decompress(gz3)

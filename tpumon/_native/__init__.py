@@ -127,8 +127,10 @@ def native_available() -> bool:
     return load_extension("_exposition") is not None
 
 
-def _flatten(families) -> list | None:
-    """Metric-family objects → the plain structure the C renderer takes.
+def flatten_family(fam) -> tuple | None:
+    """One metric-family object → the plain structure the C renderer
+    takes: ``(expo_name, help, type, [samples])``, each sample a
+    ``(sample_name, label_keys, label_values, value)`` tuple.
 
     Gauges, counters, and histograms (the three types the poll loop
     produces) all flatten; anything else — or samples carrying
@@ -136,36 +138,47 @@ def _flatten(families) -> list | None:
     renderer takes over. Counters render under their text-format
     ``_total`` exposition name and histogram samples under their
     ``_bucket``/``_count``/``_sum`` names, matching prometheus_client
-    byte-for-byte.
+    byte-for-byte. The flattened shape doubles as the delta renderer's
+    change fingerprint (tpumon/exporter/collector.py): equal flattenings
+    render to equal bytes.
     """
+    # Text exposition 0.0.4 names counters '<family>_total' in
+    # HELP/TYPE and on every sample line.
+    expo_name = fam.name + "_total" if fam.type == "counter" else fam.name
+    if fam.type == "histogram":
+        allowed = {
+            fam.name + "_bucket",
+            fam.name + "_count",
+            fam.name + "_sum",
+        }
+    else:
+        allowed = {expo_name}
+    samples = []
+    for s in fam.samples:
+        if s.name not in allowed:
+            return None
+        if getattr(s, "timestamp", None) is not None or getattr(
+            s, "exemplar", None
+        ):
+            return None
+        # Sort label keys to match prometheus_client's renderer, so
+        # native and fallback output are byte-identical.
+        items = sorted(s.labels.items())
+        keys = tuple(k for k, _ in items)
+        vals = tuple(str(v) for _, v in items)
+        samples.append((s.name, keys, vals, float(s.value)))
+    return (expo_name, fam.documentation, fam.type, samples)
+
+
+def _flatten(families) -> list | None:
+    """Flatten a whole page; None when ANY family resists (the page then
+    renders via prometheus_client as one unit)."""
     out = []
     for fam in families:
-        # Text exposition 0.0.4 names counters '<family>_total' in
-        # HELP/TYPE and on every sample line.
-        expo_name = fam.name + "_total" if fam.type == "counter" else fam.name
-        if fam.type == "histogram":
-            allowed = {
-                fam.name + "_bucket",
-                fam.name + "_count",
-                fam.name + "_sum",
-            }
-        else:
-            allowed = {expo_name}
-        samples = []
-        for s in fam.samples:
-            if s.name not in allowed:
-                return None
-            if getattr(s, "timestamp", None) is not None or getattr(
-                s, "exemplar", None
-            ):
-                return None
-            # Sort label keys to match prometheus_client's renderer, so
-            # native and fallback output are byte-identical.
-            items = sorted(s.labels.items())
-            keys = tuple(k for k, _ in items)
-            vals = tuple(str(v) for _, v in items)
-            samples.append((s.name, keys, vals, float(s.value)))
-        out.append((expo_name, fam.documentation, fam.type, samples))
+        flat = flatten_family(fam)
+        if flat is None:
+            return None
+        out.append(flat)
     return out
 
 

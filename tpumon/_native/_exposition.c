@@ -166,9 +166,42 @@ fail:
     return NULL;
 }
 
+/* Assemble a page from pre-rendered per-family byte segments (the
+ * incremental-render fast path): one exact-size allocation + memcpy per
+ * segment, no intermediate buffers. */
+static PyObject *concat(PyObject *self, PyObject *segments) {
+    (void)self;
+    if (!PyList_Check(segments)) {
+        PyErr_SetString(PyExc_TypeError, "segments must be a list");
+        return NULL;
+    }
+    Py_ssize_t n = PyList_GET_SIZE(segments);
+    Py_ssize_t total = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *seg = PyList_GET_ITEM(segments, i);
+        if (!PyBytes_Check(seg)) {
+            PyErr_SetString(PyExc_TypeError, "segments must be bytes");
+            return NULL;
+        }
+        total += PyBytes_GET_SIZE(seg);
+    }
+    PyObject *out = PyBytes_FromStringAndSize(NULL, total);
+    if (!out) return NULL;
+    char *dst = PyBytes_AS_STRING(out);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *seg = PyList_GET_ITEM(segments, i);
+        Py_ssize_t len = PyBytes_GET_SIZE(seg);
+        memcpy(dst, PyBytes_AS_STRING(seg), len);
+        dst += len;
+    }
+    return out;
+}
+
 static PyMethodDef methods[] = {
     {"render", render, METH_O,
      "render(families) -> bytes — Prometheus text exposition 0.0.4"},
+    {"concat", concat, METH_O,
+     "concat(segments) -> bytes — join pre-rendered page segments"},
     {NULL, NULL, 0, NULL},
 };
 

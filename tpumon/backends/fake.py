@@ -66,6 +66,10 @@ TOPOLOGIES: dict[str, Preset] = {
     "v4-8": Preset("v4-8", 1, 4, 2, 34_359_738_368),
     "v5e-16": Preset("v5litepod-16", 4, 4, 1, 17_179_869_184),
     "v5p-64": Preset("v5p-64", 16, 4, 2, 103_079_215_104),
+    # Cardinality stress shape for the scrape-latency bench: twice the
+    # per-host chip count of any real host so the exposition page clears
+    # 1000 series (BENCH_r06 acceptance), not a hardware SKU.
+    "bench-1k": Preset("bench-1k", 16, 12, 2, 103_079_215_104),
 }
 
 

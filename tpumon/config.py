@@ -199,6 +199,18 @@ class Config:
     guard_hard_rss_mb: float = 0.0
     #: Concurrent gRPC Watch streams admitted per client address.
     guard_watch_per_client: int = 4
+    #: Incremental (delta) page render: per-family cached byte segments
+    #: with change fingerprints — only families whose samples changed
+    #: re-render each poll cycle, the page assembles by concatenation.
+    #: Off restores the full per-cycle render (a diagnostic escape
+    #: hatch; output bytes are identical either way).
+    render_delta: bool = True
+    #: Exposition formats /metrics (and gRPC Get/Watch) will negotiate,
+    #: CSV of: text (Prometheus 0.0.4, always kept — the compatibility
+    #: floor), openmetrics (OpenMetrics 1.0 via Accept), snapshot (the
+    #: compact length-prefixed binary snapshot the fleet tier's fan-in
+    #: requests first).
+    exposition_formats: tuple[str, ...] = ("text", "openmetrics", "snapshot")
     #: Internal trace plane (tpumon/trace): per-stage spans around every
     #: poll-pipeline stage, served at /debug/traces (+/slow) and as the
     #: tpumon_trace_stage_duration_seconds self-metric.
@@ -311,6 +323,9 @@ class Config:
             guard_watch_per_client=_env_int(
                 "GUARD_WATCH_PER_CLIENT", base.guard_watch_per_client
             ),
+            render_delta=_env_bool("RENDER_DELTA", base.render_delta),
+            exposition_formats=_split_csv(_env("EXPOSITION_FORMATS"))
+            or base.exposition_formats,
             trace=_env_bool("TRACE", base.trace),
             trace_slow_cycle_ms=_env_float(
                 "TRACE_SLOW_CYCLE_MS", base.trace_slow_cycle_ms

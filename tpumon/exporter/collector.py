@@ -58,6 +58,20 @@ class PollStats:
     stale_families: dict = field(default_factory=dict)
 
 
+@dataclass
+class RenderStats:
+    """One publish's delta-render accounting (tpumon_render_* metrics)."""
+
+    #: Families whose cached byte segment was reused unchanged.
+    hits: int = 0
+    #: Families (re-)rendered this cycle (dirty or new).
+    rendered: int = 0
+    #: Total families on the page.
+    families: int = 0
+    #: Whether the incremental path ran (False = full render).
+    delta: bool = False
+
+
 class SampleCache:
     """Atomic snapshot holder shared by the poller and HTTP threads.
 
@@ -65,9 +79,19 @@ class SampleCache:
     **pre-rendered text exposition**: rendering happens once per poll
     (1 Hz), so a scrape is a cached-bytes write instead of an O(samples)
     serialization — this is most of the p99 scrape-latency headline.
+
+    With ``delta=True`` (TPUMON_RENDER_DELTA, the default) the render
+    itself is incremental: each family's text segment is cached keyed on
+    a flattened-sample fingerprint, only changed families re-render, and
+    the page is assembled by buffer concatenation (the C fast path in
+    ``tpumon/_native/_exposition.c`` when built). Most of a 1 Hz page is
+    identical between polls — identity/info families, histogram buckets
+    that received no in-range sample, health verdicts — so the per-cycle
+    render cost tracks what *changed*, not page size. Byte equivalence
+    with the full render is pinned by tests/test_render_delta.py.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, delta: bool = True) -> None:
         # One lock guards page, snapshot, AND version (the Condition wraps
         # it), so a page can never tear from the version it's labeled with.
         self._lock = threading.Lock()
@@ -75,24 +99,164 @@ class SampleCache:
         self._snapshot: tuple[Metric, ...] = ()  # guarded-by: self._lock, self._cond
         self._rendered: bytes = b""  # guarded-by: self._lock, self._cond
         self._version = 0  # guarded-by: self._lock, self._cond
+        self._delta = delta
+        #: Per-family segment cache: (name, occurrence) -> (type, help,
+        #: samples-copy, rendered bytes) — the first three are the
+        #: change fingerprint, the fourth the cached segment. Touched
+        #: only by the single publishing thread (the poller / the fleet
+        #: collect loop), never by scrape threads — no lock needed.
+        self._segments: dict[tuple, tuple] = {}
+        #: Which renderer produced the cached segments (the native
+        #: extension loads asynchronously; a py→native flip mid-run must
+        #: invalidate every segment or the page would mix float styles).
+        self._render_gen: object = None
+        #: Family names whose flatten failed under the native renderer:
+        #: while any of them is on the page, the Python pass owns the
+        #: render (publisher thread only, like _segments).
+        self._native_blocked: set[str] = set()
+        self.last_render = RenderStats()  # guarded-by: self._lock, self._cond
+        self.render_hits_total = 0  # guarded-by: self._lock, self._cond
+        self.render_rendered_total = 0  # guarded-by: self._lock, self._cond
 
-    def publish(self, families: list[Metric]) -> None:
-        from tpumon._native import render_families
+    def _render_page(self, snap: tuple[Metric, ...]) -> tuple[bytes, RenderStats]:
+        """Full or incremental render of one page; publisher thread only."""
+        from tpumon import _native
 
+        if not self._delta:
+            stats = RenderStats(families=len(snap), delta=False)
+            stats.rendered = len(snap)
+            return _native.render_families(snap), stats
+
+        ext = _native.load_extension("_exposition")
+        if ext is not None and self._native_blocked:
+            # A family that resisted native flattening is (or was, last
+            # cycle) on the page. Stay on the Python pass — its segment
+            # cache keeps earning hits — instead of re-attempting native
+            # every cycle, which would clear both caches and pay a
+            # doomed partial native render per publish. Retry native
+            # only once every blocking family has left the page.
+            if self._native_blocked.intersection(f.name for f in snap):
+                ext = None
+            else:
+                self._native_blocked.clear()
+        if ext is not None:
+            result = self._delta_pass(snap, ext)
+            if result is not None:
+                return result
+            # A family the native renderer can't take appeared: mirror
+            # render_families' all-or-nothing choice and render the
+            # whole page via the Python renderer, so delta-assembled
+            # bytes always match the full path.
+        return self._delta_pass(snap, None)  # python pass cannot fail
+
+    def _delta_pass(self, snap, ext):
+        """One incremental render with a fixed renderer (native ``ext``
+        or the Python fallback). Returns None when a family resists
+        native flattening (caller retries with the Python renderer).
+
+        The change test compares the cached cycle's raw sample objects
+        against this cycle's (list/namedtuple/dict equality, all C
+        loops, zero allocation): ``flatten_family`` — the dominant
+        publish cost at high cardinality — runs only for families that
+        actually changed. NaN-valued samples compare unequal to
+        themselves and simply re-render every cycle: conservative, never
+        wrong. Dict equality ignores label-insertion order, which is
+        sound because rendering sorts label keys.
+        """
+        from tpumon import _native
+
+        stats = RenderStats(families=len(snap), delta=True)
+        gen = ("native", id(ext)) if ext is not None else ("python",)
+        if gen != self._render_gen:
+            self._segments.clear()
+            self._render_gen = gen
+        segments: list[bytes] = []
+        new_cache: dict[tuple, tuple] = {}
+        occurrence: dict[str, int] = {}
+        for fam in snap:
+            # Duplicate family names (malformed producer) disambiguate by
+            # occurrence index so they cannot alias each other's segment.
+            n = occurrence.get(fam.name, 0)
+            occurrence[fam.name] = n + 1
+            key = (fam.name, n)
+            entry = self._segments.get(key)
+            if (
+                entry is not None
+                and entry[0] == fam.type
+                and entry[1] == fam.documentation
+                and entry[2] == fam.samples
+            ):
+                segment = entry[3]
+                new_cache[key] = entry
+                stats.hits += 1
+            else:
+                if ext is not None:
+                    flat = _native.flatten_family(fam)
+                    if flat is None:
+                        # Exotic family: the page goes Python, and stays
+                        # there while this family keeps appearing.
+                        self._native_blocked.add(fam.name)
+                        return None
+                    segment = ext.render([flat])
+                else:
+                    segment = _native._python_render([fam])
+                # A COPY of the sample list: a producer that republishes
+                # the same family object after appending/replacing
+                # samples must compare unequal, not identical.
+                new_cache[key] = (
+                    fam.type, fam.documentation, list(fam.samples), segment,
+                )
+                stats.rendered += 1
+            segments.append(segment)
+        self._segments = new_cache
+        if ext is not None:
+            return ext.concat(segments), stats
+        return b"".join(segments), stats
+
+    def publish(self, families: list[Metric]) -> RenderStats:
         snap = tuple(families)
         # Child spans of the poller's "publish" stage: the exposition
-        # render is the O(samples) half, the swap is a lock + notify.
+        # render is the O(changed samples) half, the swap is a lock +
+        # notify.
         with trace_span("render"):
-            rendered = render_families(snap)
+            rendered, stats = self._render_page(snap)
         with self._cond:
             self._snapshot = snap
             self._rendered = rendered
             self._version += 1
+            self.last_render = stats
+            self.render_hits_total += stats.hits
+            self.render_rendered_total += stats.rendered
             self._cond.notify_all()
+        return stats
+
+    def render_stats(self) -> dict:
+        """Cumulative + last-cycle delta-render accounting (/debug/vars,
+        bench hit-ratio evidence)."""
+        with self._lock:
+            last = self.last_render
+            hits, rendered = self.render_hits_total, self.render_rendered_total
+        total = hits + rendered
+        return {
+            "delta": self._delta,
+            "last_hits": last.hits,
+            "last_rendered": last.rendered,
+            "families": last.families,
+            "hits_total": hits,
+            "rendered_total": rendered,
+            "hit_ratio": round(hits / total, 4) if total else None,
+        }
 
     def snapshot(self) -> tuple[Metric, ...]:
         with self._lock:
             return self._snapshot
+
+    def snapshot_with_version(self) -> tuple[tuple[Metric, ...], int]:
+        """Atomic (snapshot, version) pair — the OpenMetrics response
+        cache keys on it, so a body cached for version N is always built
+        from version N's families."""
+        with self._lock:
+            return self._snapshot, self._version
 
     def rendered(self) -> bytes:
         with self._lock:
@@ -600,10 +764,16 @@ class Poller:
                         stage="anomaly"
                     ).inc()
         with trace_span("publish"):
-            self._cache.publish(families)
+            render_stats = self._cache.publish(families)
         elapsed = time.monotonic() - t0
 
         t = self._telemetry
+        # Delta-render accounting (tpumon/exporter/encodings.py plane):
+        # cumulative segment-cache hits + how much of this cycle's page
+        # actually re-rendered.
+        if render_stats.hits:
+            t.render_cache_hits.inc(render_stats.hits)
+        t.render_invalidated.set(render_stats.rendered)
         t.poll_duration.observe(elapsed)
         if stats.backend_errors:
             t.poll_errors.labels(kind="backend").inc(stats.backend_errors)

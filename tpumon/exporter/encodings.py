@@ -1,0 +1,318 @@
+"""Negotiated exposition formats + per-encoding response caches.
+
+The scrape path serves one logical document — the node's metric page —
+in whichever representation the consumer is cheapest to feed
+(ROADMAP item 2; PAPER.md §exposition):
+
+- **text** — Prometheus text 0.0.4, the default and the only format old
+  exporters speak. Served from the pre-rendered SampleCache bytes.
+- **openmetrics** — OpenMetrics 1.0 text for scrapers that negotiate it
+  (``Accept: application/openmetrics-text``). Rendered lazily from the
+  cached family snapshot, at most once per cache version.
+- **snapshot** — a compact length-prefixed binary snapshot of the
+  fleet-relevant fields (the ``node_snapshot_from_text`` structure),
+  requested first by the fleet tier's NodeFeed so fan-in is a direct
+  decode instead of a 0.37 ms/page text parse. Old exporters ignore the
+  Accept header and serve text; the magic prefix makes the two
+  indistinguishable to mix up.
+
+Every format is cached per (format, content-encoding) keyed on the page
+version pair, so an unchanged page costs zero encode work no matter how
+many scrapers ask (:class:`EncodedPageCache`): the dcgm-exporter genre
+re-serializes and re-compresses the world per scrape; tpumon pays once
+per change.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import logging
+import threading
+
+from tpumon.backends.reflection import (
+    _decode_varint,
+    _encode_varint,
+    _iter_fields,
+)
+
+log = logging.getLogger(__name__)
+
+#: Format names accepted by TPUMON_EXPOSITION_FORMATS (CSV).
+FORMAT_TEXT = "text"
+FORMAT_OPENMETRICS = "openmetrics"
+FORMAT_SNAPSHOT = "snapshot"
+KNOWN_FORMATS = (FORMAT_TEXT, FORMAT_OPENMETRICS, FORMAT_SNAPSHOT)
+
+#: Content types, response side. Text matches prometheus_client.
+TEXT_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
+SNAPSHOT_CONTENT_TYPE = "application/vnd.tpumon.snapshot"
+
+CONTENT_TYPES = {
+    FORMAT_TEXT: TEXT_CONTENT_TYPE,
+    FORMAT_OPENMETRICS: OPENMETRICS_CONTENT_TYPE,
+    FORMAT_SNAPSHOT: SNAPSHOT_CONTENT_TYPE,
+}
+
+#: Wire prefix of the snapshot encoding: magic + format version byte.
+#: A text exposition page can never start with these bytes, so a client
+#: that asked for a snapshot detects an old text-only exporter from the
+#: payload itself (transport-agnostic: HTTP body or gRPC page field).
+SNAPSHOT_MAGIC = b"TPMN\x01"
+
+
+def parse_formats(raw: tuple[str, ...]) -> tuple[str, ...]:
+    """Validate a TPUMON_EXPOSITION_FORMATS tuple: unknown names are
+    dropped WITH a warning (malformed env must not take the scrape
+    plane down, but a typo silently disabling an encoding would only
+    surface as the fleet tier quietly falling back to the slow text
+    parse), and text is always present — it is the compatibility floor
+    every consumer (Prometheus, curl, old fleet shards) can parse.
+    Names are case-insensitive, like every other env knob."""
+    raw = tuple(f.strip().lower() for f in raw)
+    unknown = tuple(f for f in raw if f not in KNOWN_FORMATS)
+    if unknown:
+        log.warning(
+            "ignoring unknown exposition format(s) %s; accepted: %s",
+            ", ".join(unknown), ", ".join(KNOWN_FORMATS),
+        )
+    formats = tuple(f for f in raw if f in KNOWN_FORMATS)
+    if FORMAT_TEXT not in formats:
+        formats = (FORMAT_TEXT, *formats)
+    return formats
+
+
+def negotiate(accept: str, formats: tuple[str, ...]) -> str:
+    """Pick the exposition format for an Accept header value.
+
+    Semantics (deliberately small — this is an exporter, not a general
+    content server):
+
+    - each *enabled* format scores the best q among Accept entries whose
+      media type names it exactly (``application/vnd.tpumon.snapshot``,
+      ``application/openmetrics-text``, ``text/plain``);
+    - ``text/*`` and ``*/*`` score for **text only** — a wildcard client
+      (curl, a browser) must get the default format, never a binary
+      payload;
+    - highest q wins; ties break toward the more specific ask
+      (snapshot > openmetrics > text), which only matters when a client
+      explicitly lists two formats at equal q;
+    - no Accept header, or nothing matching: text.
+    """
+    if not accept:
+        return FORMAT_TEXT
+    scores = dict.fromkeys(formats, 0.0)
+    for entry in accept.split(","):
+        parts = entry.split(";")
+        media = parts[0].strip().lower()
+        q = 1.0
+        for param in parts[1:]:
+            key, _, value = param.partition("=")
+            if key.strip().lower() == "q":
+                try:
+                    q = float(value.strip())
+                except ValueError:
+                    q = 0.0
+        target = None
+        if media == SNAPSHOT_CONTENT_TYPE:
+            target = FORMAT_SNAPSHOT
+        elif media == "application/openmetrics-text":
+            target = FORMAT_OPENMETRICS
+        elif media in ("text/plain", "text/*", "*/*"):
+            target = FORMAT_TEXT
+        if target in scores:
+            scores[target] = max(scores[target], q)
+    best_q = max(scores.values())
+    if best_q <= 0.0:
+        return FORMAT_TEXT
+    for fmt in (FORMAT_SNAPSHOT, FORMAT_OPENMETRICS, FORMAT_TEXT):
+        if scores.get(fmt, 0.0) == best_q:
+            return fmt
+    return FORMAT_TEXT
+
+
+# -- compact snapshot codec -------------------------------------------------
+
+def encode_snapshot(snap: dict) -> bytes:
+    """Snapshot dict -> magic + varint payload length + compact JSON.
+
+    The payload is canonical (sorted keys, tight separators) so equal
+    snapshots encode to equal bytes — the per-version response cache
+    and the equivalence tests both lean on that. Non-finite floats ride
+    Python's NaN/Infinity tokens: this codec owns both ends, and
+    mapping them to null would break decode==parse equivalence for
+    pages that legitimately carry NaN samples.
+    """
+    payload = json.dumps(
+        snap, sort_keys=True, separators=(",", ":")
+    ).encode()
+    return SNAPSHOT_MAGIC + _encode_varint(len(payload)) + payload
+
+
+def is_snapshot(data: bytes) -> bool:
+    return data.startswith(SNAPSHOT_MAGIC)
+
+
+def decode_snapshot(data: bytes) -> dict:
+    """Inverse of :func:`encode_snapshot`; raises ValueError on a frame
+    that is not a well-formed snapshot (callers fall back to the text
+    parser)."""
+    if not is_snapshot(data):
+        raise ValueError("not a tpumon snapshot frame")
+    body = data[len(SNAPSHOT_MAGIC):]
+    length, idx = _decode_varint(body, 0)
+    payload = body[idx:idx + length]
+    if len(payload) != length:
+        raise ValueError("truncated snapshot payload")
+    doc = json.loads(payload.decode())
+    if not isinstance(doc, dict):
+        raise ValueError("snapshot payload is not an object")
+    return doc
+
+
+# -- OpenMetrics rendering --------------------------------------------------
+
+def openmetrics_render(families) -> bytes:
+    """Render metric families as one OpenMetrics 1.0 document (with the
+    ``# EOF`` terminator). Runs at most once per cache version — never
+    on the per-scrape path."""
+    from prometheus_client.openmetrics.exposition import generate_latest
+
+    class _Shim:
+        def collect(self):
+            return families
+
+    return generate_latest(_Shim())
+
+
+def openmetrics_join(parts: list[bytes]) -> bytes:
+    """Concatenate independently rendered OpenMetrics documents into one:
+    every part's ``# EOF`` terminator except the last is dropped."""
+    eof = b"# EOF\n"
+    out: list[bytes] = []
+    for i, part in enumerate(parts):
+        if i < len(parts) - 1 and part.endswith(eof):
+            part = part[: -len(eof)]
+        out.append(part)
+    return b"".join(out)
+
+
+# -- per-encoding response cache --------------------------------------------
+
+class EncodedPageCache:
+    """Last-version response cache per (format, content-encoding).
+
+    ``get(slot, key, build)`` returns the cached body when ``key`` (the
+    page-version pair) still matches the slot, else calls ``build()``,
+    stores, and returns. One entry per slot: scrapers all want the
+    current page, so history is worthless. The builder runs OUTSIDE the
+    lock — an encode must never block cache hits for other slots — at
+    the cost of a redundant build when two scrapers race the same
+    version transition (both results are identical bytes, and the race
+    window is one encode).
+
+    The ``observe(slot, hit)`` hook feeds the
+    ``tpumon_render_encode_saves_total`` self-metric.
+    """
+
+    def __init__(self, observe=None) -> None:
+        self._lock = threading.Lock()
+        self._entries: dict[tuple, tuple] = {}  # guarded-by: self._lock
+        self._observe = observe
+        self.hits = 0  # guarded-by: self._lock
+        self.misses = 0  # guarded-by: self._lock
+
+    def get(self, slot: tuple, key: tuple, build):
+        with self._lock:
+            entry = self._entries.get(slot)
+            if entry is not None and entry[0] == key:
+                self.hits += 1
+                body = entry[1]
+            else:
+                body = None
+                self.misses += 1
+        if body is not None:
+            self._count(slot, True)
+            return body
+        body = build()
+        with self._lock:
+            # A slow builder that lost the race must not clobber an
+            # entry a faster builder stored for a NEWER version
+            # meanwhile (the slot would thrash, re-paying the encode per
+            # scrape around every version transition): store when the
+            # slot is untouched since our lookup, or when our key is not
+            # older than the stored one (version pairs are monotonic and
+            # componentwise comparable; every slot keeps one key shape).
+            stored = self._entries.get(slot)
+            if stored is entry or (stored is not None and key >= stored[0]):
+                self._entries[slot] = (key, body)
+        self._count(slot, False)
+        return body
+
+    def _count(self, slot: tuple, hit: bool) -> None:
+        if self._observe is not None:
+            try:
+                self._observe(slot, hit)
+            except Exception:
+                # A metrics hook must never fail a scrape.
+                log.debug("encode-cache observer failed", exc_info=True)
+
+    def stats(self) -> tuple[int, int]:
+        with self._lock:
+            return self.hits, self.misses
+
+
+def gzip_page(body: bytes) -> bytes:
+    """Single-member gzip at level 1 — the one spelling of response
+    compression (multi-member concatenation of separately compressed
+    halves would silently truncate on one-shot zlib decoders)."""
+    return gzip.compress(body, compresslevel=1)
+
+
+def snapshot_request(fmt: str) -> bytes:
+    """PageRequest{string format = 1} for the gRPC Get/Watch methods."""
+    data = fmt.encode()
+    return _encode_varint((1 << 3) | 2) + _encode_varint(len(data)) + data
+
+
+def requested_format(request: bytes) -> str:
+    """Parse a PageRequest's format field; empty/garbage requests mean
+    text (the pre-negotiation wire shape — old clients send b"")."""
+    if not request:
+        return FORMAT_TEXT
+    try:
+        for field, wire, value in _iter_fields(request):
+            if field == 1 and wire == 2:
+                fmt = value.decode("utf-8", "replace")
+                return fmt if fmt in KNOWN_FORMATS else FORMAT_TEXT
+    except Exception as exc:
+        # A malformed request frame negotiates down to text, never errors.
+        log.debug("unparseable page request (%s); serving text", exc)
+    return FORMAT_TEXT
+
+
+__all__ = [
+    "CONTENT_TYPES",
+    "EncodedPageCache",
+    "FORMAT_OPENMETRICS",
+    "FORMAT_SNAPSHOT",
+    "FORMAT_TEXT",
+    "KNOWN_FORMATS",
+    "OPENMETRICS_CONTENT_TYPE",
+    "SNAPSHOT_CONTENT_TYPE",
+    "SNAPSHOT_MAGIC",
+    "TEXT_CONTENT_TYPE",
+    "decode_snapshot",
+    "encode_snapshot",
+    "gzip_page",
+    "is_snapshot",
+    "negotiate",
+    "openmetrics_join",
+    "openmetrics_render",
+    "parse_formats",
+    "requested_format",
+    "snapshot_request",
+]

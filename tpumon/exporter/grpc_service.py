@@ -95,7 +95,7 @@ class MetricsGrpcServer:
 
     def __init__(
         self, render_with_version, cache, addr: str, port: int, tracer=None,
-        guard=None,
+        guard=None, renderer=None,
     ) -> None:
         import threading
 
@@ -103,8 +103,22 @@ class MetricsGrpcServer:
         from concurrent.futures import ThreadPoolExecutor
         from contextlib import nullcontext
 
+        from tpumon.exporter.encodings import requested_format
+
         self._render_with_version = render_with_version
         self._cache = cache
+        #: NegotiatedRenderer (tpumon/exporter/server.py): when wired,
+        #: Get/Watch honor PageRequest.format and serve the same cached
+        #: per-format payloads as HTTP negotiation — text requests
+        #: included, so tpumon_exposition_requests_total counts gRPC
+        #: traffic too. Without it (older embedders) every request
+        #: serves text via the plain renderer, exactly as before.
+        self._renderer = renderer
+
+        def negotiated_page(request: bytes) -> tuple[bytes, int]:
+            if self._renderer is None:
+                return self._render_with_version()
+            return self._renderer.page_with_version(requested_format(request))
         watcher_slots = threading.BoundedSemaphore(_MAX_WATCHERS)
         # Per-client stream accounting (tpumon/guard): `guard` supplies
         # the cap and the tpumon_shed_requests_total funnel; without it
@@ -131,7 +145,7 @@ class MetricsGrpcServer:
 
         def get(request: bytes, context):
             with serve_span("grpc_get"):
-                page, version = self._render_with_version()
+                page, version = negotiated_page(request)
             return encode_page_response(page, version)
 
         def watch(request: bytes, context):
@@ -165,7 +179,7 @@ class MetricsGrpcServer:
                         if newer == version:
                             continue  # idle timeout: re-check liveness
                         with serve_span("grpc_watch_push"):
-                            page, version = self._render_with_version()
+                            page, version = negotiated_page(request)
                         yield encode_page_response(page, version)
                 finally:
                     watcher_slots.release()

@@ -409,11 +409,16 @@ def _make_app(
     debug_vars=None, hostcorr=None,
     replay_max_items=DEFAULT_REPLAY_MAX_ITEMS,
     replay_max_bytes=DEFAULT_REPLAY_MAX_BYTES,
+    negotiated=None,
 ):
     """WSGI app. ``render_body(want_gzip: bool) -> bytes`` produces the
     /metrics payload (already gzip-encoded when asked); the exporter
     passes cached-bytes + self-telemetry concatenation, the sidecar a
-    plain registry render. ``history`` (a tpumon.history.History) enables
+    plain registry render. ``negotiated`` (a NegotiatedRenderer), when
+    given, takes over /metrics entirely: content negotiation across the
+    enabled exposition formats with per-encoding response caches —
+    ``render_body`` then only backs embedders that skip negotiation.
+    ``history`` (a tpumon.history.History) enables
     the /history JSON endpoint; ``device_health`` (a () -> dict callable)
     enables /health/devices (the dcgmi-health analogue); ``anomalies``
     (a tpumon.anomaly.AnomalyEngine) enables /anomalies; ``tracer``
@@ -519,15 +524,20 @@ def _make_app(
         if path in ("/metrics", "/"):
             t0 = time.perf_counter()
             try:
-                # Prometheus sends Accept-Encoding: gzip on every scrape;
-                # at 1 Hz × full families the ~10x shrink matters on the
-                # pod network.
-                want_gzip = "gzip" in environ.get("HTTP_ACCEPT_ENCODING", "")
-                body = render_body(want_gzip)
-                headers = [("Content-Type", _CONTENT_TYPE)]
-                if want_gzip:
-                    headers.append(("Content-Encoding", "gzip"))
-                headers.append(("Content-Length", str(len(body))))
+                if negotiated is not None:
+                    body, headers = negotiated.respond(environ)
+                else:
+                    # Prometheus sends Accept-Encoding: gzip on every
+                    # scrape; at 1 Hz × full families the ~10x shrink
+                    # matters on the pod network.
+                    want_gzip = "gzip" in environ.get(
+                        "HTTP_ACCEPT_ENCODING", ""
+                    )
+                    body = render_body(want_gzip)
+                    headers = [("Content-Type", _CONTENT_TYPE)]
+                    if want_gzip:
+                        headers.append(("Content-Encoding", "gzip"))
+                    headers.append(("Content-Length", str(len(body))))
                 start_response("200 OK", headers)
                 return [body]
             finally:
@@ -743,11 +753,181 @@ def _hostcorr_response(
 
 
 def registry_renderer(registry: CollectorRegistry):
+    """Plain registry renderer (sidecar, workload harness): render per
+    scrape, but compress per *change* — the gzip of an unchanged page is
+    reused via a one-entry cache keyed on the identity bytes, so a
+    scraper polling a quiet registry costs a render + memcmp, not a
+    render + deflate every time."""
+    from tpumon.exporter.encodings import EncodedPageCache, gzip_page
+
+    cache = EncodedPageCache()
+
     def render(want_gzip: bool) -> bytes:
         body = exposition.generate_latest(registry)
-        return gzip.compress(body, compresslevel=1) if want_gzip else body
+        if not want_gzip:
+            return body
+        return cache.get(("registry", "gzip"), (body,), lambda: gzip_page(body))
 
     return render
+
+
+class NegotiatedRenderer:
+    """/metrics response builder for the exporter: content negotiation
+    (text / OpenMetrics / compact snapshot) + per-(format, encoding)
+    response caches keyed on the page-version pair.
+
+    The page has two halves with independent versions — the device page
+    (SampleCache, bumped per poll) and the self-telemetry page (bumped
+    per refresh). A cache hit means the exact response bytes for the
+    current (device, self) version pair already exist: the scrape is two
+    dict lookups and a socket write, zero render/encode/compress work.
+    Every builder below runs at most once per version pair per slot, no
+    matter how many scrapers are asking.
+    """
+
+    def __init__(
+        self, cache, selfpage, formats, telemetry=None, tracer=None,
+        self_registry=None,
+    ) -> None:
+        from tpumon.exporter.encodings import EncodedPageCache, parse_formats
+
+        self._cache = cache
+        self._selfpage = selfpage
+        #: Registry behind the self-telemetry half; the OpenMetrics body
+        #: re-renders it in OM syntax (the cached text bytes are the
+        #: wrong format to reuse).
+        self._self_registry = self_registry
+        self.formats = parse_formats(tuple(formats))
+        self._telemetry = telemetry
+        self._tracer = tracer
+        observe = None
+        if telemetry is not None:
+            saves = telemetry.render_encode_saves
+
+            def observe(slot, hit):
+                if hit:
+                    saves.labels(format=slot[0], encoding=slot[1]).inc()
+
+        self.encoded = EncodedPageCache(observe=observe)
+
+    def _span(self, name: str):
+        from contextlib import nullcontext
+
+        if self._tracer is None:
+            return nullcontext()
+        # Serving-side encode spans (cache misses only): same
+        # self-metric funnel as the gRPC serve spans — never attached
+        # to a poll cycle's tree.
+        return self._tracer.span(name, stage="scrape_encode")
+
+    def _openmetrics(self, snap) -> bytes:
+        """OpenMetrics body from an atomically captured device snapshot.
+        The self half re-renders live from the registry: its content may
+        be newer than the self_version component of the cache key (the
+        registry only ever moves forward — a cached body can carry
+        fresher self-telemetry than its key, never staler)."""
+        from tpumon.exporter.encodings import (
+            openmetrics_join,
+            openmetrics_render,
+        )
+
+        parts = [openmetrics_render(snap)]
+        if self._self_registry is not None:
+            from prometheus_client.openmetrics.exposition import (
+                generate_latest,
+            )
+
+            parts.append(generate_latest(self._self_registry))
+        return openmetrics_join(parts)
+
+    def _identity_source(self, fmt: str):
+        """(cache key, builder) for the identity-encoded body of ``fmt``
+        — the ONE place that maps a format to its bytes, shared by HTTP
+        negotiation and gRPC Get/Watch: both transports store into the
+        same (fmt, "identity") cache slot, so a second dispatch copy
+        drifting would poison the other transport's cached responses."""
+        from tpumon.exporter.encodings import (
+            FORMAT_OPENMETRICS,
+            FORMAT_SNAPSHOT,
+            encode_snapshot,
+        )
+
+        selfb, self_version = self._selfpage.latest_with_version()
+        if fmt == FORMAT_OPENMETRICS:
+            # The OM body builds from the family snapshot, so the
+            # version captured WITH that snapshot is the key: a body
+            # cached for version N is always built from N's families.
+            snap, dev_version = self._cache.snapshot_with_version()
+
+            def build() -> bytes:
+                with self._span("encode:openmetrics"):
+                    return self._openmetrics(snap)
+        else:
+            dev, dev_version = self._cache.rendered_with_version()
+            if fmt == FORMAT_SNAPSHOT:
+                def build() -> bytes:
+                    from tpumon.fleet.ingest import node_snapshot_from_text
+
+                    with self._span("encode:snapshot"):
+                        return encode_snapshot(
+                            node_snapshot_from_text((dev + selfb).decode())
+                        )
+            else:
+                def build() -> bytes:
+                    return dev + selfb
+        return (dev_version, self_version), build
+
+    def respond(self, environ) -> tuple[bytes, list[tuple[str, str]]]:
+        """(body, headers) for one /metrics request."""
+        from tpumon.exporter.encodings import (
+            CONTENT_TYPES,
+            FORMAT_SNAPSHOT,
+            gzip_page,
+            negotiate,
+        )
+
+        fmt = negotiate(environ.get("HTTP_ACCEPT", ""), self.formats)
+        # The snapshot encoding is already compact; gzip applies to the
+        # text formats only (Prometheus sends Accept-Encoding: gzip on
+        # every scrape — at 1 Hz × full families the ~10x shrink matters
+        # on the pod network).
+        want_gzip = (
+            fmt != FORMAT_SNAPSHOT
+            and "gzip" in environ.get("HTTP_ACCEPT_ENCODING", "")
+        )
+        key, build = self._identity_source(fmt)
+        body = self.encoded.get((fmt, "identity"), key, build)
+        headers = [("Content-Type", CONTENT_TYPES[fmt])]
+        if want_gzip:
+            identity = body
+
+            def build_gzip() -> bytes:
+                with self._span("encode:gzip"):
+                    return gzip_page(identity)
+
+            body = self.encoded.get((fmt, "gzip"), key, build_gzip)
+            headers.append(("Content-Encoding", "gzip"))
+        # The response varies on negotiation inputs: any cache between
+        # scraper and exporter must key on both headers.
+        headers.append(("Vary", "Accept, Accept-Encoding"))
+        headers.append(("Content-Length", str(len(body))))
+        if self._telemetry is not None:
+            self._telemetry.exposition_requests.labels(format=fmt).inc()
+        return body, headers
+
+    def page_with_version(self, fmt: str) -> tuple[bytes, int]:
+        """Current page in ``fmt`` (identity encoding) plus the device
+        cache version — the gRPC Get/Watch payload. Unknown/disabled
+        formats serve text, mirroring HTTP negotiation's fallback."""
+        from tpumon.exporter.encodings import FORMAT_TEXT
+
+        if fmt not in self.formats:
+            fmt = FORMAT_TEXT
+        key, build = self._identity_source(fmt)
+        body = self.encoded.get((fmt, "identity"), key, build)
+        if self._telemetry is not None:
+            self._telemetry.exposition_requests.labels(format=fmt).inc()
+        return body, key[0]
 
 
 class _SelfTelemetryPage:
@@ -784,6 +964,9 @@ class _SelfTelemetryPage:
         self._lock = threading.Lock()
         self._render_lock = threading.Lock()
         self._bytes = exposition.generate_latest(registry)  # guarded-by: self._lock
+        #: Bumped per publish — the self half of the response-cache key
+        #: (tpumon/exporter/encodings.py EncodedPageCache).
+        self._version = 1  # guarded-by: self._lock
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -795,6 +978,11 @@ class _SelfTelemetryPage:
         with self._lock:
             return self._bytes
 
+    def latest_with_version(self) -> tuple[bytes, int]:
+        """Atomic (page, version) pair — the response caches key on it."""
+        with self._lock:
+            return self._bytes, self._version
+
     def refresh(self) -> None:
         """One re-render (~0.3 ms), safe from any thread: the render
         mutex makes render+publish atomic w.r.t. other renderers, so a
@@ -802,7 +990,12 @@ class _SelfTelemetryPage:
         with self._render_lock:
             body = exposition.generate_latest(self._registry)
             with self._lock:
-                self._bytes = body
+                # Version bumps only when the bytes differ: an idle
+                # registry re-rendering identical content keeps its
+                # response-cache entries (and their gzip work) valid.
+                if body != self._bytes:
+                    self._bytes = body
+                    self._version += 1
 
     def poke(self) -> None:
         self._wake.set()
@@ -907,7 +1100,8 @@ class Exporter:
         # cached bytes + this small registry's render.
         self.registry = CollectorRegistry()
         self.telemetry = SelfTelemetry(self.registry)
-        self.cache = SampleCache()
+        self.cache = SampleCache(delta=cfg.render_delta)
+        self.telemetry.render_delta.set(1.0 if cfg.render_delta else 0.0)
         # Start the native-renderer build off the poll path; renders use
         # the Python fallback until it's ready.
         from tpumon import _native
@@ -1126,12 +1320,23 @@ class Exporter:
         self._selfpage = _SelfTelemetryPage(self.registry)
         self.poller.on_cycle = self._on_cycle
 
+        #: Negotiated /metrics renderer: text / OpenMetrics / compact
+        #: snapshot, each response cached per (format, encoding) keyed
+        #: on the (device, self) version pair — an unchanged page costs
+        #: zero encode work regardless of scraper count.
+        self.renderer = NegotiatedRenderer(
+            self.cache, self._selfpage, cfg.exposition_formats,
+            telemetry=self.telemetry, tracer=self.tracer,
+            self_registry=self.registry,
+        )
+
         def render(want_gzip: bool) -> bytes:
             # Single gzip member per response: multi-member concatenation
             # of a cached compressed part would be RFC-legal but silently
             # truncates on one-shot zlib decoders (browsers, naive
             # scrapers); level-1 over ~35 KB costs ~0.3 ms, a price worth
-            # universal correctness.
+            # universal correctness. Embedder-facing — the HTTP app
+            # itself goes through self.renderer.
             body = self.cache.rendered() + self._selfpage.latest()
             return gzip.compress(body, compresslevel=1) if want_gzip else body
 
@@ -1162,6 +1367,7 @@ class Exporter:
             anomalies=self.anomaly, tracer=self.tracer,
             debug_vars=self._debug_vars, hostcorr=self.hostcorr,
             replay_max_items=replay_items, replay_max_bytes=replay_bytes,
+            negotiated=self.renderer,
         )
         if self.guard is not None:
             # Admission control wraps the whole app; shedding answers
@@ -1176,7 +1382,7 @@ class Exporter:
                 self.grpc_server = MetricsGrpcServer(
                     self.render_with_version, self.cache, cfg.addr,
                     cfg.grpc_serve_port, tracer=self.tracer,
-                    guard=self.guard,
+                    guard=self.guard, renderer=self.renderer,
                 )
             except Exception as exc:
                 # grpcio missing or bind failure must not take down the
@@ -1271,6 +1477,13 @@ class Exporter:
                 "slow_cycle_ms": self.tracer.slow_cycle_ms,
                 **self.tracer.counts(),
             }
+        encode_hits, encode_misses = self.renderer.encoded.stats()
+        doc["render"] = {
+            **self.cache.render_stats(),
+            "formats": list(self.renderer.formats),
+            "encode_cache_hits": encode_hits,
+            "encode_cache_misses": encode_misses,
+        }
         if self.history is not None:
             series, samples = self.history.stats()
             doc["history"] = {

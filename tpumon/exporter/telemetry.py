@@ -170,6 +170,43 @@ class SelfTelemetry:
             labelnames=("family",),
             registry=registry,
         )
+        # -- delta render + negotiated exposition ------------------------
+        self.render_delta = Gauge(
+            "tpumon_render_delta",
+            "1 while the incremental (delta) page renderer is active: "
+            "per-family cached byte segments, only changed families "
+            "re-render each cycle (TPUMON_RENDER_DELTA).",
+            registry=registry,
+        )
+        self.render_cache_hits = Counter(
+            "tpumon_render_family_cache_hits",
+            "Family byte segments served unchanged from the render "
+            "cache across poll cycles (delta renderer; a re-rendered "
+            "family is not a hit).",
+            registry=registry,
+        )
+        self.render_invalidated = Gauge(
+            "tpumon_render_invalidated_families",
+            "Families re-rendered in the last poll cycle because their "
+            "samples changed (or first appeared); page total minus this "
+            "is the cycle's cache-hit count.",
+            registry=registry,
+        )
+        self.render_encode_saves = Counter(
+            "tpumon_render_encode_saves",
+            "Scrape responses served straight from the per-encoding "
+            "response cache (zero encode work), by exposition format "
+            "and content encoding.",
+            labelnames=("format", "encoding"),
+            registry=registry,
+        )
+        self.exposition_requests = Counter(
+            "tpumon_exposition_requests",
+            "Negotiated /metrics (and gRPC Get/Watch) responses by "
+            "exposition format (text / openmetrics / snapshot).",
+            labelnames=("format",),
+            registry=registry,
+        )
         self.backend_info = Gauge(
             "exporter_backend_info",
             "Static info about the active device backend (value is 1).",
@@ -185,3 +222,13 @@ class SelfTelemetry:
             self.trace_stage_duration.labels(stage=stage)
         self.poll_stage_errors.labels(stage="history_record")
         self.poll_stage_errors.labels(stage="anomaly")
+        # Exposition formats: text always serves; pre-create the others
+        # so "format never requested" is a scrapeable zero, not absence.
+        for fmt in ("text", "openmetrics", "snapshot"):
+            self.exposition_requests.labels(format=fmt)
+            # Snapshot responses are never gzip-encoded (already compact).
+            encodings = ("identity",) if fmt == "snapshot" else (
+                "identity", "gzip",
+            )
+            for enc in encodings:
+                self.render_encode_saves.labels(format=fmt, encoding=enc)

@@ -401,6 +401,34 @@ SELF_FAMILIES: dict[str, tuple[str, str]] = {
         "Series collapsed into the sentinel `other` label value by the "
         "per-family cardinality budget, by family",
     ),
+    "tpumon_render_delta": (
+        "gauge",
+        "1 while the incremental (delta) page renderer is active "
+        "(TPUMON_RENDER_DELTA): per-family cached byte segments, only "
+        "changed families re-render each poll cycle",
+    ),
+    "tpumon_render_family_cache_hits_total": (
+        "counter",
+        "Family byte segments served unchanged from the render cache "
+        "across poll cycles (delta renderer)",
+    ),
+    "tpumon_render_invalidated_families": (
+        "gauge",
+        "Families re-rendered in the last poll cycle because their "
+        "samples changed or first appeared",
+    ),
+    "tpumon_render_encode_saves_total": (
+        "counter",
+        "Scrape responses served straight from the per-encoding "
+        "response cache (zero encode work), by exposition format and "
+        "content encoding (format/encoding labels)",
+    ),
+    "tpumon_exposition_requests_total": (
+        "counter",
+        "Negotiated /metrics (and gRPC Get/Watch) responses by "
+        "exposition format: text, openmetrics, or the compact snapshot "
+        "encoding the fleet tier requests (format label)",
+    ),
 }
 
 #: family -> description (workload-side harness --metrics-port)

@@ -70,6 +70,10 @@ class FleetConfig:
     guard: bool = True
     #: Trace plane for the collect loop (/debug/traces, /debug/vars).
     trace: bool = True
+    #: Incremental (delta) render of the pre-aggregated page — the same
+    #: diagnostic escape hatch the exporter's TPUMON_RENDER_DELTA is,
+    #: scoped to this tier (output bytes are identical either way).
+    render_delta: bool = True
     #: Log level name.
     log_level: str = "INFO"
 

@@ -148,7 +148,10 @@ def node_snapshot_from_text(text: str) -> dict:
             if value == 0:
                 healthy += 1
             if worst is None or value > worst[1]:
-                worst = (link, value)
+                # A list, not a tuple: the snapshot must survive the
+                # compact binary encoding's JSON round-trip unchanged
+                # (decode == parse, tests/test_render_delta.py).
+                worst = [link, value]
         elif name == "accelerator_core_utilization_percent":
             labels = dict(_LABEL_RE.findall(line[brace:line.rfind("}") + 1]))
             snap["cores"][labels.get("core", "?")] = float(
@@ -240,6 +243,10 @@ class NodeFeed:
         #: "streaming" while the Watch stream delivers, "down" between
         #: reconnects, "off" when Watch is not configured.
         self.watch_state = "off" if self.grpc_addr is None else "down"  # guarded-by: self._lock
+        #: True while the last stored snapshot arrived as a decoded
+        #: compact frame rather than a parsed text page (evidence that
+        #: the negotiated encoding is actually in use).
+        self.snapshot_decoded = False  # guarded-by: self._lock
         self._inflight = False  # guarded-by: self._lock
         #: Persistent poll connection; touched only inside poll()
         #: (serialized by _inflight), never concurrently.
@@ -250,8 +257,35 @@ class NodeFeed:
 
     # -- snapshot access ---------------------------------------------------
 
+    def store_page(self, body: bytes, mode: str) -> None:
+        """Publish one fetched payload, whichever representation arrived:
+        a compact snapshot frame decodes directly (the negotiated fast
+        path), anything else is a text exposition page for the line
+        parser — which is exactly what an old, non-negotiating exporter
+        serves no matter what we asked for."""
+        from tpumon.exporter.encodings import decode_snapshot, is_snapshot
+
+        if is_snapshot(body):
+            try:
+                snap = decode_snapshot(body)
+            except ValueError as exc:
+                log.warning(
+                    "%s: bad snapshot frame via %s: %s", self.url, mode, exc
+                )
+                self._count(mode, "parse_error")
+                return
+            self.store_snapshot(snap, mode, decoded=True)
+            return
+        try:
+            text = body.decode()
+        except UnicodeDecodeError as exc:
+            log.warning("%s: undecodable page via %s: %s", self.url, mode, exc)
+            self._count(mode, "parse_error")
+            return
+        self.store_text(text, mode)
+
     def store_text(self, text: str, mode: str) -> None:
-        """Parse + publish one exposition page (both transports land here)."""
+        """Parse + publish one exposition page."""
         try:
             snap = node_snapshot_from_text(text)
         except Exception as exc:
@@ -260,6 +294,11 @@ class NodeFeed:
             log.warning("%s: unparseable page via %s: %s", self.url, mode, exc)
             self._count(mode, "parse_error")
             return
+        self.store_snapshot(snap, mode)
+
+    def store_snapshot(self, snap: dict, mode: str, decoded: bool = False) -> None:
+        """Publish one parsed/decoded node snapshot (all transports and
+        representations land here)."""
         now = self._clock()
         # Effective data timestamp: the fetch time MINUS how stale the
         # node's own poll loop already was when it served this page
@@ -277,6 +316,7 @@ class NodeFeed:
             self._snap = snap
             self._fetched_at = data_ts
             self._last_error = ""
+            self.snapshot_decoded = decoded
         self._count(mode, "ok")
 
     def current(self) -> tuple[dict | None, float, str]:
@@ -309,26 +349,39 @@ class NodeFeed:
 
     # -- HTTP polling fallback ---------------------------------------------
 
-    def _fetch_page(self) -> str:
+    def _fetch_page(self) -> bytes:
         """GET /metrics over a persistent per-feed connection.
 
         Keep-alive matters at fleet scale: a fresh TCP connect per poll
         per node is O(fleet) connection churn per second on the shard
         AND a new handler thread per poll on every exporter. The
         connection is rebuilt on any error; ``poll`` is serialized per
-        feed (``_inflight``), so one connection needs no locking."""
+        feed (``_inflight``), so one connection needs no locking.
+
+        The Accept header asks for the compact snapshot encoding first
+        (one dict decode instead of a 0.37 ms text parse per page); an
+        old exporter ignores Accept and serves text — ``store_page``
+        tells the two apart by the payload's magic prefix, so the
+        fallback needs no version handshake."""
+        from tpumon.exporter.encodings import SNAPSHOT_CONTENT_TYPE
+
         host = self.url.split("//", 1)[1]
         if self._conn is None:
             self._conn = http.client.HTTPConnection(
                 host, timeout=self.timeout
             )
         try:
-            self._conn.request("GET", "/metrics")
+            self._conn.request(
+                "GET", "/metrics",
+                headers={
+                    "Accept": f"{SNAPSHOT_CONTENT_TYPE}, text/plain;q=0.5"
+                },
+            )
             resp = self._conn.getresponse()
             body = resp.read()
             if resp.status != 200:
                 raise http.client.HTTPException(f"status {resp.status}")
-            return body.decode()
+            return body
         except BaseException:
             # Whatever happened, this connection's framing is suspect.
             try:
@@ -349,7 +402,7 @@ class NodeFeed:
                 self._count("poll", "breaker_open")
                 return
             try:
-                text = self._fetch_page()
+                body = self._fetch_page()
             except FETCH_ERRORS as exc:
                 self.breaker.record(False)
                 self._note_error(str(exc))
@@ -357,7 +410,7 @@ class NodeFeed:
                 log.debug("%s: poll failed: %s", self.url, exc)
                 return
             self.breaker.record(True)
-            self.store_text(text, "poll")
+            self.store_page(body, "poll")
         finally:
             with self._lock:
                 self._inflight = False
@@ -386,11 +439,17 @@ class NodeFeed:
     def _watch_loop(self) -> None:
         import grpc
 
+        from tpumon.exporter.encodings import snapshot_request
         from tpumon.exporter.grpc_service import (
             METHOD_WATCH,
             decode_page_response,
         )
 
+        # Ask every push to be the compact snapshot frame. An old
+        # exporter ignores the request body entirely and streams text
+        # pages — store_page's magic-prefix check is the fallback, same
+        # as the HTTP path.
+        request = snapshot_request("snapshot")
         while not self._stop.is_set():
             channel = grpc.insecure_channel(self.grpc_addr)
             try:
@@ -401,12 +460,12 @@ class NodeFeed:
                 )
                 # Overall stream deadline: the stream ends (and redials)
                 # after the window even against a half-dead peer.
-                stream = call(b"", timeout=WATCH_STREAM_DEADLINE_S)
+                stream = call(request, timeout=WATCH_STREAM_DEADLINE_S)
                 with self._lock:
                     self._watch_call = stream
                 for raw in stream:
                     page, _version = decode_page_response(raw)
-                    self.store_text(page.decode(), "watch")
+                    self.store_page(page, "watch")
                     with self._lock:
                         self.watch_state = "streaming"
                     self.backoff.reset()

@@ -24,7 +24,6 @@ slice/pool/fleet rollup — that ``tpumon smi --aggregator`` renders.
 
 from __future__ import annotations
 
-import gzip
 import logging
 import threading
 import time
@@ -149,7 +148,7 @@ class FleetAggregator:
 
         from tpumon.exporter.collector import SampleCache
 
-        self.cache = SampleCache()
+        self.cache = SampleCache(delta=cfg.render_delta)
         self.tracer = None
         if cfg.trace:
             from tpumon.trace import Tracer
@@ -177,9 +176,28 @@ class FleetAggregator:
 
         self._selfpage = _SelfTelemetryPage(self.registry)
 
+        from tpumon.exporter.encodings import EncodedPageCache, gzip_page
+
+        # Version-keyed gzip reuse: between collect cycles the
+        # pre-aggregated page (the largest page in the system at fleet
+        # scale) is unchanged, so HA Prometheus pairs re-scraping it
+        # cost a dict lookup, not a deflate each.
+        encoded = EncodedPageCache()
+
         def render(want_gzip: bool) -> bytes:
-            body = self.cache.rendered() + self._selfpage.latest()
-            return gzip.compress(body, compresslevel=1) if want_gzip else body
+            dev, dev_version = self.cache.rendered_with_version()
+            selfb, self_version = self._selfpage.latest_with_version()
+            key = (dev_version, self_version)
+            # Concat inside the builder: an unchanged-page scrape is a
+            # pure dict lookup, no O(page) copy.
+            body = encoded.get(
+                ("fleet", "identity"), key, lambda: dev + selfb
+            )
+            if not want_gzip:
+                return body
+            return encoded.get(
+                ("fleet", "gzip"), key, lambda: gzip_page(body)
+            )
 
         self.guard = None
         if cfg.guard:

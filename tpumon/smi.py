@@ -257,7 +257,10 @@ def snapshot_from_families(families) -> dict:
             if s.value == 0:
                 healthy += 1
             if worst is None or s.value > worst[1]:
-                worst = (s.labels.get("link", "?"), s.value)
+                # List, matching the fleet line parser's shape: both
+                # snapshots must survive a JSON round-trip (the compact
+                # binary exposition) structurally unchanged.
+                worst = [s.labels.get("link", "?"), s.value]
         snap["ici"] = {
             "healthy": healthy,
             "total": total,

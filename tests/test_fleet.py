@@ -407,7 +407,9 @@ def test_aggregator_watch_fanin(small_fleet):
         agg = _aggregator([target], interval=0.3)
         try:
             _wait_for(
-                lambda: agg.feeds[0].watch_state_now() == "streaming",
+                lambda: next(
+                    iter(agg.feeds.values())
+                ).watch_state_now() == "streaming",
                 timeout=8.0,
             )
             doc = _wait_for(

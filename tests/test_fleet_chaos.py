@@ -1,0 +1,1043 @@
+"""Fleet fault tolerance (ISSUE 9): discovery, shard failover, warm
+restart, partition-honest rollups, and ingest hardening.
+
+Unit tests pin the pure pieces (restricted rendezvous, endpoint JSON
+parsing, debounce, spool format discipline, bucket merging, hostile
+payload rejection); integration tests drive two real aggregator shards
+through peer death and a warm restart.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tpumon.fleet.config import FleetConfig
+from tpumon.fleet.discovery import (
+    Debouncer,
+    KubeEndpoints,
+    TargetResolver,
+    targets_from_endpoints,
+    targets_from_endpointslices,
+)
+from tpumon.fleet.failover import MembershipPlane, PeerWatcher, parse_peers
+from tpumon.fleet.ingest import NodeFeed
+from tpumon.fleet.rollup import merge_buckets, rollup, visibility_of
+from tpumon.fleet.shard import (
+    owned_targets,
+    owned_targets_among,
+    shard_of,
+)
+from tpumon.fleet.spool import SPOOL_VERSION, SnapshotSpool
+
+
+def _wait_for(predicate, timeout: float = 10.0, step: float = 0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(step)
+    raise AssertionError("condition not met within timeout")
+
+
+def _free_port() -> int:
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+def _get(url: str, timeout: float = 10.0) -> tuple[int, str]:
+    import urllib.error
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode()
+
+
+# -- restricted rendezvous (failover ownership) ----------------------------
+
+
+def test_owned_among_full_set_matches_static():
+    targets = [f"http://node-{i}:9400" for i in range(60)]
+    for index in range(3):
+        assert owned_targets_among(
+            targets, index, {0, 1, 2}, 3
+        ) == owned_targets(targets, index, 3)
+
+
+def test_owned_among_dead_shard_moves_only_orphans():
+    """Killing shard j re-homes EXACTLY j's targets; every survivor
+    keeps its own assignment (the takeover minimal-movement property)."""
+    targets = [f"http://node-{i}:9400" for i in range(100)]
+    static = {t: shard_of(t, 4) for t in targets}
+    survivors = {0, 1, 3}  # shard 2 died
+    owned = {
+        i: owned_targets_among(targets, i, survivors, 4) for i in survivors
+    }
+    flat = sorted(sum(owned.values(), []))
+    assert flat == sorted(targets)  # complete, no double ownership
+    for i in survivors:
+        mine = set(owned[i])
+        kept = {t for t in targets if static[t] == i}
+        assert kept <= mine  # nothing a survivor owned moved away
+        assert all(static[t] == 2 for t in mine - kept)  # only orphans
+
+
+def test_owned_among_self_dead_owns_nothing():
+    targets = ["a", "b", "c"]
+    assert owned_targets_among(targets, 2, {0, 1}, 3) == []
+
+
+def test_owned_among_empty_alive_falls_back_static():
+    targets = ["a", "b", "c", "d"]
+    assert owned_targets_among(targets, 1, set(), 2) == owned_targets(
+        targets, 1, 2
+    )
+
+
+# -- endpoint discovery parsing --------------------------------------------
+
+
+_SLICES = {
+    "items": [
+        {
+            "ports": [{"name": "metrics", "port": 9400}],
+            "endpoints": [
+                {"addresses": ["10.0.0.1"], "conditions": {"ready": True}},
+                {"addresses": ["10.0.0.2"], "conditions": {"ready": False}},
+                {"addresses": ["10.0.0.3"]},  # absent conditions = ready
+            ],
+        },
+        {
+            # Unnamed single port still resolves.
+            "ports": [{"port": 9500}],
+            "endpoints": [{"addresses": ["10.0.1.1", "fd00::7"]}],
+        },
+        {
+            # Two unnamed ports: ambiguous, skipped — never a guess.
+            "ports": [{"port": 1}, {"port": 2}],
+            "endpoints": [{"addresses": ["10.0.2.1"]}],
+        },
+    ]
+}
+
+
+def test_targets_from_endpointslices():
+    assert targets_from_endpointslices(_SLICES, "metrics") == [
+        "10.0.0.1:9400",
+        "10.0.0.3:9400",
+        "10.0.1.1:9500",
+        "[fd00::7]:9500",
+    ]
+
+
+def test_targets_from_endpoints():
+    doc = {
+        "subsets": [
+            {
+                "ports": [
+                    {"name": "metrics", "port": 9400},
+                    {"name": "grpc", "port": 9401},
+                ],
+                "addresses": [{"ip": "10.1.0.1"}, {"ip": "10.1.0.2"}],
+            }
+        ]
+    }
+    assert targets_from_endpoints(doc, "metrics") == [
+        "10.1.0.1:9400",
+        "10.1.0.2:9400",
+    ]
+
+
+class _FakeKubeHandler(BaseHTTPRequestHandler):
+    slices: dict | None = None
+    endpoints: dict | None = None
+    requests_seen: list
+
+    def do_GET(self) -> None:
+        if "endpointslices" in self.path and self.slices is not None:
+            body = json.dumps(self.slices).encode()
+        elif "/endpoints/" in self.path and self.endpoints is not None:
+            body = json.dumps(self.endpoints).encode()
+        else:
+            self.send_error(404)
+            return
+        type(self).requests_seen.append(self.path)
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args) -> None:
+        pass
+
+
+@pytest.fixture
+def fake_kube():
+    handler = type(
+        "_Kube", (_FakeKubeHandler,),
+        {"slices": None, "endpoints": None, "requests_seen": []},
+    )
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    thread = threading.Thread(
+        target=server.serve_forever, kwargs={"poll_interval": 0.2},
+        daemon=True,
+    )
+    thread.start()
+    try:
+        yield handler, f"http://127.0.0.1:{server.server_address[1]}"
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_kube_endpointslice_resolution(fake_kube):
+    handler, api = fake_kube
+    handler.slices = _SLICES
+    kube = KubeEndpoints(api, "tpumon/tpumon", port_name="metrics")
+    assert kube.resolve() == [
+        "10.0.0.1:9400",
+        "10.0.0.3:9400",
+        "10.0.1.1:9500",
+        "[fd00::7]:9500",
+    ]
+
+
+def test_kube_falls_back_to_endpoints_api(fake_kube):
+    handler, api = fake_kube
+    handler.endpoints = {
+        "subsets": [
+            {
+                "ports": [{"name": "metrics", "port": 9400}],
+                "addresses": [{"ip": "10.2.0.1"}],
+            }
+        ]
+    }
+    kube = KubeEndpoints(api, "tpumon/tpumon", port_name="metrics")
+    assert kube.resolve() == ["10.2.0.1:9400"]
+    # The 404 is remembered: later ticks go straight to core/v1.
+    assert kube.resolve() == ["10.2.0.1:9400"]
+    slice_lists = [p for p in handler.requests_seen if "slices" in p]
+    assert not slice_lists
+
+
+def test_kube_port_name_mismatch_is_failed_resolution(fake_kube):
+    """Endpoints exist but none carry the configured port name: that is
+    a misconfiguration (failed resolution → keep last universe), never
+    a silently-applied empty fleet."""
+    handler, api = fake_kube
+    # A LONE differently-named port self-heals (one choice ≠ a guess)…
+    handler.slices = {
+        "items": [
+            {
+                "ports": [{"name": "http-metrics", "port": 9400}],
+                "endpoints": [{"addresses": ["10.0.0.1"]}],
+            }
+        ]
+    }
+    kube = KubeEndpoints(api, "tpumon/tpumon", port_name="metrics")
+    assert kube.resolve() == ["10.0.0.1:9400"]
+    # …but several ports with no name match is a misconfiguration.
+    handler.slices = {
+        "items": [
+            {
+                "ports": [
+                    {"name": "http-metrics", "port": 9400},
+                    {"name": "grpc", "port": 9401},
+                ],
+                "endpoints": [{"addresses": ["10.0.0.1"]}],
+            }
+        ]
+    }
+    assert kube.resolve() is None
+    # A genuinely endpoint-less service still reads as an empty fleet.
+    handler.slices = {
+        "items": [
+            {"ports": [{"name": "metrics", "port": 9400}], "endpoints": []}
+        ]
+    }
+    assert kube.resolve() == []
+
+
+def test_kube_api_down_returns_none():
+    kube = KubeEndpoints(
+        f"http://127.0.0.1:{_free_port()}", "ns/svc", timeout=0.5
+    )
+    assert kube.resolve() is None
+
+
+def test_resolver_file_mode_rereads(tmp_path):
+    listing = tmp_path / "targets"
+    listing.write_text("node-a:9400\n")
+    cfg = FleetConfig(discovery="file", targets_file=str(listing))
+    resolver = TargetResolver(cfg)
+    assert resolver.resolve() == ["node-a:9400"]
+    listing.write_text("node-a:9400\nnode-b:9400\n")
+    assert resolver.resolve() == ["node-a:9400", "node-b:9400"]
+
+
+def test_debouncer_applies_first_immediately_then_settles():
+    debouncer = Debouncer(5.0)
+    assert debouncer.offer(["a"], 100.0) == ["a"]
+    # A new set must hold still for the window.
+    assert debouncer.offer(["a", "b"], 101.0) is None
+    assert debouncer.offer(["a", "b"], 103.0) is None
+    # Flapping resets the clock.
+    assert debouncer.offer(["a", "c"], 104.0) is None
+    assert debouncer.offer(["a", "c"], 108.0) is None
+    assert debouncer.offer(["a", "c"], 109.5) == ["a", "c"]
+    # Unchanged set: nothing to apply.
+    assert debouncer.offer(["a", "c"], 120.0) is None
+
+
+# -- warm-restart spool ----------------------------------------------------
+
+
+def test_spool_roundtrip(tmp_path):
+    spool = SnapshotSpool(str(tmp_path))
+    nodes = {
+        "http://n1:9400": {"snap": {"chips": {"0": {}}}, "fetched_at": 123.0},
+        "http://n2:9400": {"snap": {"chips": {}}, "fetched_at": 456.0},
+    }
+    assert spool.save(["http://n1:9400", "http://n2:9400"], nodes)
+    loaded = SnapshotSpool(str(tmp_path)).load()
+    assert loaded["nodes"] == nodes
+    assert loaded["universe"] == ["http://n1:9400", "http://n2:9400"]
+    assert loaded["saved_at"] > 0
+
+
+def test_spool_corrupt_file_quarantined(tmp_path):
+    spool = SnapshotSpool(str(tmp_path))
+    with open(spool.path, "wb") as fh:
+        fh.write(b'{"version": 1, "nodes": {"trunc')
+    loaded = spool.load()
+    assert loaded == {"universe": [], "nodes": {}, "saved_at": 0.0}
+    assert spool.last_load_error is not None
+    assert os.path.exists(spool.path + ".corrupt")
+    assert not os.path.exists(spool.path)
+    # A LATER clean start with the quarantine file still on disk is not
+    # an error: absence loads clean (no lingering alert noise).
+    fresh = SnapshotSpool(str(tmp_path))
+    fresh.load()
+    assert fresh.last_load_error is None
+
+
+def test_spool_wrong_version_and_shapes_ignored(tmp_path):
+    spool = SnapshotSpool(str(tmp_path))
+    with open(spool.path, "w", encoding="utf-8") as fh:
+        json.dump({"version": SPOOL_VERSION + 1, "nodes": {}}, fh)
+    assert spool.load()["nodes"] == {}
+    with open(spool.path, "w", encoding="utf-8") as fh:
+        json.dump(
+            {
+                "version": SPOOL_VERSION,
+                "universe": ["ok", 7],
+                "nodes": {
+                    "good": {"snap": {}, "fetched_at": 1.0},
+                    "bad-snap": {"snap": "nope", "fetched_at": 1.0},
+                    "bad-ts": {"snap": {}, "fetched_at": "soon"},
+                },
+            },
+            fh,
+        )
+    loaded = spool.load()
+    assert list(loaded["nodes"]) == ["good"]
+    assert loaded["universe"] == ["ok"]
+
+
+def test_spool_bound_drops_oldest(tmp_path):
+    spool = SnapshotSpool(str(tmp_path), max_bytes=5000)
+    pad = "x" * 400
+    nodes = {
+        f"http://n{i}:9400": {
+            "snap": {"pad": pad}, "fetched_at": float(i),
+        }
+        for i in range(20)
+    }
+    assert spool.save([], nodes)
+    assert spool.dropped_last_save > 0
+    loaded = spool.load()
+    kept = sorted(e["fetched_at"] for e in loaded["nodes"].values())
+    assert kept  # something survived
+    # Oldest entries went first: the survivors are the newest.
+    assert min(kept) > 0.0
+    assert max(kept) == 19.0
+
+
+def test_spool_missing_dir_save_fails_soft(tmp_path):
+    spool = SnapshotSpool(str(tmp_path / "sub" / "dir"))
+    assert spool.save([], {})  # creates the directory
+    ro = SnapshotSpool("/proc/tpumon-definitely-unwritable")
+    assert ro.save([], {}) is False  # logs, returns False, never raises
+
+
+# -- rollup merging + visibility -------------------------------------------
+
+
+def test_visibility_of():
+    assert visibility_of({"up": 4, "stale": 0, "dark": 0}) == 1.0
+    assert visibility_of({"up": 3, "stale": 1, "dark": 0}) == 0.75
+    assert visibility_of({"up": 0, "stale": 0, "dark": 2}) == 0.0
+    assert visibility_of({}) == 1.0
+
+
+def test_rollup_carries_visibility_per_scope():
+    doc = rollup(
+        [
+            {"snap": {"identity": {"accelerator": "v5p", "slice": "s1"},
+                      "chips": {}}, "state": "up"},
+            {"snap": {"identity": {"accelerator": "v5p", "slice": "s1"},
+                      "chips": {}}, "state": "stale"},
+            {"snap": None, "state": "dark"},
+        ]
+    )
+    assert doc["slices"][("v5p", "s1")]["visibility"] == 0.5
+    assert doc["fleet"]["visibility"] == pytest.approx(1.0 / 3.0)
+
+
+def test_merge_buckets_weighted_and_additive():
+    a = {
+        "hosts": {"up": 2, "stale": 0, "dark": 0},
+        "chips": 8,
+        "degraded_hosts": 1,
+        "stale": False,
+        "duty": {"mean": 10.0, "min": 5.0, "max": 20.0, "n": 4},
+        "hbm_used": 10.0, "hbm_total": 100.0,
+        "hbm_headroom_ratio": 0.9,
+        "ici": {"healthy": 6, "links": 8, "score": 0.75},
+        "mfu": 0.2, "mfu_n": 2,
+        "stragglers": {"host-cpu": 1},
+    }
+    b = {
+        "hosts": {"up": 1, "stale": 1, "dark": 1},
+        "chips": 4,
+        "degraded_hosts": 0,
+        "stale": True,
+        "duty": {"mean": 40.0, "min": 30.0, "max": 50.0, "n": 2},
+        "hbm_used": 30.0, "hbm_total": 100.0,
+        "ici": {"healthy": 4, "links": 4, "score": 1.0},
+        "stragglers": {"host-cpu": 2, "device": 1},
+        "straggler_skew_max_pct": 35.0,
+    }
+    merged = merge_buckets([a, b])
+    assert merged["hosts"] == {"up": 3, "stale": 1, "dark": 1}
+    assert merged["chips"] == 12
+    assert merged["degraded_hosts"] == 1
+    assert merged["stale"] is True
+    assert merged["visibility"] == pytest.approx(3.0 / 5.0)
+    # n-weighted mean: (10*4 + 40*2) / 6 = 20
+    assert merged["duty"]["mean"] == pytest.approx(20.0)
+    assert merged["duty"]["min"] == 5.0 and merged["duty"]["max"] == 50.0
+    assert merged["hbm_used"] == 40.0 and merged["hbm_total"] == 200.0
+    assert merged["ici"] == {"healthy": 10, "links": 12, "score": 10 / 12}
+    assert merged["mfu"] == pytest.approx(0.2)
+    assert merged["stragglers"] == {"host-cpu": 3, "device": 1}
+    assert merged["straggler_skew_max_pct"] == 35.0
+
+
+def test_merge_buckets_unweighted_duty_drops_honestly():
+    """A peer summary without the merge weight (pre-failover shard
+    version): its mean cannot merge, so the global duty is absent, not
+    guessed."""
+    a = {"hosts": {"up": 1}, "chips": 1,
+         "duty": {"mean": 10.0, "min": 10.0, "max": 10.0, "n": 1}}
+    b = {"hosts": {"up": 1}, "chips": 1,
+         "duty": {"mean": 90.0, "min": 90.0, "max": 90.0}}
+    assert "duty" not in merge_buckets([a, b])
+
+
+# -- ingest hardening (satellites 2 + 4) -----------------------------------
+
+
+def _feed(**kwargs) -> tuple[NodeFeed, list, list]:
+    fetches: list = []
+    rejects: list = []
+    feed = NodeFeed(
+        "127.0.0.1:1",
+        observe_fetch=lambda mode, result: fetches.append((mode, result)),
+        observe_reject=rejects.append,
+        **kwargs,
+    )
+    return feed, fetches, rejects
+
+
+def test_hostile_length_prefix_rejected_before_allocation():
+    from tpumon.backends.reflection import _encode_varint
+    from tpumon.exporter.encodings import SNAPSHOT_MAGIC, decode_snapshot
+
+    hostile = SNAPSHOT_MAGIC + _encode_varint(1 << 50) + b"\x00" * 16
+    with pytest.raises(ValueError, match="exceeds cap"):
+        decode_snapshot(hostile, max_bytes=1 << 20)
+    feed, fetches, rejects = _feed(max_snapshot_bytes=1 << 20)
+    feed.store_page(hostile, "poll")
+    assert rejects == ["bad_frame"]
+    assert ("poll", "parse_error") in fetches
+    assert feed.current()[0] is None  # nothing stored
+
+
+def test_truncated_snapshot_payload_keeps_last_good():
+    from tpumon.backends.reflection import _encode_varint
+    from tpumon.exporter.encodings import SNAPSHOT_MAGIC, encode_snapshot
+
+    feed, _fetches, rejects = _feed()
+    good = {"chips": {"0": {"duty_pct": 50.0}}, "identity": {}}
+    feed.store_page(encode_snapshot(good), "poll")
+    assert feed.current()[0] == good
+    assert feed.snapshot_decoded is True
+    truncated = SNAPSHOT_MAGIC + _encode_varint(500) + b'{"chips"'
+    feed.store_page(truncated, "poll")
+    assert rejects == ["bad_frame"]
+    assert feed.current()[0] == good  # last-good survives the garbage
+
+
+def test_partial_magic_prefix_is_text_not_snapshot():
+    from tpumon.exporter.encodings import SNAPSHOT_MAGIC, is_snapshot
+
+    partial = SNAPSHOT_MAGIC[:3]
+    assert not is_snapshot(partial)
+    feed, fetches, rejects = _feed()
+    # Parses as a (contentless) text page — stored, not rejected, and
+    # NOT marked decoded.
+    feed.store_page(partial, "poll")
+    assert rejects == []
+    assert ("poll", "ok") in fetches
+    assert feed.snapshot_decoded is False
+
+
+def test_midstream_text_to_snapshot_upgrade_flips_decoded_flag():
+    from tpumon.exporter.encodings import encode_snapshot
+
+    feed, _fetches, _rejects = _feed()
+    text = (
+        "# TYPE accelerator_device_count gauge\n"
+        "accelerator_device_count 4\n"
+    )
+    feed.store_page(text.encode(), "poll")
+    assert feed.snapshot_decoded is False
+    assert feed.current()[0]["device_count"] == 4
+    # The exporter restarts into a negotiating version mid-stream: the
+    # same feed upgrades transparently on the magic prefix...
+    feed.store_page(
+        encode_snapshot({"device_count": 8, "identity": {}}), "poll"
+    )
+    assert feed.snapshot_decoded is True
+    assert feed.current()[0]["device_count"] == 8
+    # ...and downgrades just as transparently (rollback).
+    feed.store_page(text.encode(), "poll")
+    assert feed.snapshot_decoded is False
+    assert feed.current()[0]["device_count"] == 4
+
+
+def test_oversized_body_rejected():
+    feed, _fetches, rejects = _feed(max_snapshot_bytes=4096)
+    feed.store_page(b"x" * 5000, "poll")
+    assert rejects == ["oversized"]
+
+
+def test_adaptive_cadence_backs_off_and_resets():
+    clock = [1000.0]
+    feed, _fetches, _rejects = _feed(
+        fresh_s=2.0, poll_backoff_base_s=1.0, poll_backoff_max_s=30.0,
+        clock=lambda: clock[0],
+    )
+    # Never-seen feed: escalating, jitter-bounded delays.
+    d1 = feed.next_poll_delay(1.0)
+    d2 = feed.next_poll_delay(1.0)
+    d3 = feed.next_poll_delay(1.0)
+    assert 1.0 <= d1 <= 1.25
+    assert d2 >= 1.5 and d3 >= 3.0 and d3 <= 30.0
+    # A fresh page restores full cadence immediately.
+    feed.store_snapshot({"identity": {}}, "poll")
+    assert feed.next_poll_delay(1.0) == 1.0
+    # The data aging out (zombie or dead upstream) re-escalates.
+    clock[0] += 10.0
+    assert feed.next_poll_delay(1.0) >= 0.75
+    delays = [feed.next_poll_delay(1.0) for _ in range(6)]
+    assert max(delays) > 2.0
+    assert all(d <= 30.0 * 1.25 for d in delays)
+
+
+def test_zombie_page_does_not_reset_backoff():
+    clock = [2000.0]
+    feed, _fetches, _rejects = _feed(
+        fresh_s=2.0, poll_backoff_base_s=1.0, poll_backoff_max_s=30.0,
+        clock=lambda: clock[0],
+    )
+    for _ in range(4):
+        feed.next_poll_delay(1.0)
+    before = feed.poll_backoff.failures
+    # A fetch that lands a FROZEN page (poll timestamp 100 s old) must
+    # not restore full cadence — data age, not fetch success, is truth.
+    feed.store_snapshot(
+        {"identity": {}, "last_poll_ts": clock[0] - 100.0}, "poll"
+    )
+    assert feed.poll_backoff.failures == before
+    assert feed.next_poll_delay(1.0) > 1.0
+
+
+# -- peer liveness + membership plane --------------------------------------
+
+
+def test_parse_peers_forms():
+    assert parse_peers("http://a:9500, b:9500", 2) == [
+        "http://a:9500", "http://b:9500",
+    ]
+    assert parse_peers("a,b,c", 2) == ["http://a", "http://b"]
+    assert parse_peers("", 4) == []
+    # Empty entries are positional placeholders — a blanked own-slot
+    # must not shift every later peer's shard index.
+    assert parse_peers("http://s0,,http://s2", 3) == [
+        "http://s0", "", "http://s2",
+    ]
+
+
+def test_unprobed_shards_are_never_declared_dead():
+    """A short or gapped peers list leaves the unlisted indices
+    UNPROBED: no evidence of death, no takeover — a shard may only
+    adopt from peers it can actually observe failing."""
+    clock = [0.0]
+
+    def fetch(url: str) -> dict:
+        raise OSError("down")
+
+    # Short list: shard 2 of 3 has no URL anywhere.
+    watcher = PeerWatcher(
+        ["http://p0", "http://p1"], 0,
+        takeover_s=5.0, shard_count=3,
+        clock=lambda: clock[0], fetch=fetch,
+    )
+    clock[0] = 100.0  # peer 1 long dead; 2 was never probed
+    watcher.probe_once()
+    assert watcher.alive() == {0, 2}
+    # Placeholder gap: index 1 is "" — unprobed, alive; index 2 probed.
+    watcher = PeerWatcher(
+        parse_peers("http://s0,,http://s2", 3), 0,
+        takeover_s=5.0, shard_count=3,
+        clock=lambda: clock[0], fetch=fetch,
+    )
+    assert sorted(watcher.peers) == [2]
+    clock[0] = 200.0
+    assert watcher.alive() == {0, 1}
+
+
+def test_file_discovery_unreadable_keeps_last_universe(tmp_path):
+    """A transiently unreadable targets file is a FAILED resolution
+    (None — caller keeps the last universe), never an empty fleet."""
+    listing = tmp_path / "targets"
+    listing.write_text("node-a:9400\n")
+    cfg = FleetConfig(discovery="file", targets_file=str(listing))
+    resolver = TargetResolver(cfg)
+    assert resolver.resolve() == ["node-a:9400"]
+    listing.unlink()  # ConfigMap remount window
+    assert resolver.resolve() is None
+    listing.write_text("node-a:9400\nnode-b:9400\n")
+    assert resolver.resolve() == ["node-a:9400", "node-b:9400"]
+
+
+def test_peer_watcher_lifecycle():
+    clock = [0.0]
+    summaries = {"http://p1": {"fleet": {"chips": 4}, "shard": {}}}
+    fail = {"http://p1": False}
+
+    def fetch(url: str) -> dict:
+        if fail[url]:
+            raise OSError("down")
+        return summaries[url]
+
+    watcher = PeerWatcher(
+        ["http://p0", "http://p1"], 0,
+        takeover_s=10.0, clock=lambda: clock[0], fetch=fetch,
+    )
+    # Startup grace: the un-probed peer counts alive for a full window.
+    assert watcher.alive() == {0, 1}
+    clock[0] = 5.0
+    watcher.probe_once()
+    assert watcher.alive() == {0, 1}
+    assert watcher.summaries()[1]["fleet"]["chips"] == 4
+    # Dead past the takeover deadline; its summary leaves the merge.
+    fail["http://p1"] = True
+    clock[0] = 20.0
+    assert watcher.alive() == {0}
+    assert watcher.summaries() == {}
+    assert watcher.states()[1]["alive"] is False
+    # One good probe resurrects it.
+    fail["http://p1"] = False
+    watcher.probe_once()
+    assert watcher.alive() == {0, 1}
+
+
+def test_membership_plane_takeover_and_return():
+    clock = [0.0]
+    peer_ok = [True]
+
+    def fetch(url: str) -> dict:
+        if not peer_ok[0]:
+            raise OSError("down")
+        return {"fleet": {}, "shard": {"index": 1}}
+
+    targets = ",".join(f"node-{i}:9400" for i in range(12))
+    cfg = FleetConfig(
+        targets=targets, shard_index=0, shard_count=2,
+        peers="http://a:9500,http://b:9500",
+        probe_interval=1.0, takeover_s=5.0, discovery_interval=1.0,
+    )
+    events: list = []
+    applied: list = []
+    plane = MembershipPlane(
+        cfg,
+        on_membership=lambda owned, info: applied.append((owned, info)),
+        observe_event=lambda kind, n: events.append((kind, n)),
+        clock=lambda: clock[0],
+        fetch=fetch,
+    )
+    try:
+        static = owned_targets(cfg.target_list(), 0, 2)
+        assert plane.snapshot()["owned"] == len(static)
+        assert ("add", 12) in events
+        # Peer dies: past the deadline the orphans are adopted.
+        peer_ok[0] = False
+        clock[0] = 2.0
+        plane.tick()
+        assert plane.snapshot()["owned"] == len(static)
+        clock[0] = 10.0
+        plane.tick()
+        snap = plane.snapshot()
+        assert snap["owned"] == 12
+        assert snap["alive_shards"] == [0]
+        assert snap["takeovers_total"] == 12 - len(static)
+        assert ("takeover", 12 - len(static)) in events
+        # Peer returns: the orphans are handed back.
+        peer_ok[0] = True
+        clock[0] = 11.0
+        plane.tick()
+        assert plane.snapshot()["owned"] == len(static)
+        removed = applied[-1][1]["removed"]
+        assert sorted(removed) == sorted(
+            set(cfg.target_list()) - set(static)
+        )
+    finally:
+        plane.stop()
+
+
+# -- integration: two shards, peer death, warm restart ---------------------
+
+
+def _exporter(preset="v4-8", interval=0.2):
+    from tpumon.backends.fake import FakeTpuBackend
+    from tpumon.config import Config
+    from tpumon.exporter.server import build_exporter
+
+    cfg = Config(
+        port=0, addr="127.0.0.1", interval=interval, history_window=0,
+        anomaly=False, trace=False, host_metrics=False, histograms=False,
+        guard=False, pod_attribution=False,
+    )
+    exp = build_exporter(cfg, FakeTpuBackend.preset(preset))
+    exp.start()
+    return exp
+
+
+def test_two_shards_failover_and_global_scope():
+    """Peer death end-to-end: the survivor adopts the dead shard's
+    exporters after the takeover deadline, serves their rollups, counts
+    the takeover, and the global scope stays honest throughout."""
+    from tpumon.fleet.server import build_aggregator
+
+    exps = [_exporter() for _ in range(3)]
+    ports = [_free_port(), _free_port()]
+    peers = ",".join(f"http://127.0.0.1:{p}" for p in ports)
+    urls = [e.server.url for e in exps]
+
+    def cfg(index: int) -> FleetConfig:
+        return FleetConfig(
+            port=ports[index], addr="127.0.0.1",
+            targets=",".join(urls), shard_index=index, shard_count=2,
+            interval=0.2, stale_s=1.0, evict_s=60.0, peers=peers,
+            probe_interval=0.25, takeover_s=1.5, history_window=0.0,
+        )
+
+    shards = [build_aggregator(cfg(0)), build_aggregator(cfg(1))]
+    try:
+        for shard in shards:
+            shard.start()
+        assert sorted(shards[0].targets + shards[1].targets) == sorted(urls)
+        split = {0: list(shards[0].targets), 1: list(shards[1].targets)}
+        victim = 0 if split[0] and len(split[0]) <= len(split[1]) else 1
+        if not split[victim]:
+            victim = 1 - victim
+        survivor = 1 - victim
+
+        # Warm: each shard sees its own slice up; global row visible.
+        _wait_for(
+            lambda: json.loads(
+                _get(shards[survivor].url + "/fleet")[1]
+            )["fleet"].get("hosts", {}).get("up", 0) == len(split[survivor])
+        )
+        status, page = _get(shards[survivor].url + "/metrics")
+        assert status == 200
+        assert 'scope="global"' in page
+        assert "tpu_fleet_visibility_ratio" in page
+        assert 'tpu_fleet_peer_up{peer="%d"} 1.0' % victim in page
+
+        status, body = _get(shards[survivor].url + "/fleet/summary")
+        assert status == 200
+        summary = json.loads(body)
+        assert summary["shard"]["index"] == survivor
+        assert summary["universe"] == 3
+
+        shards[victim].close()
+        dead = shards[victim]
+        shards[victim] = None
+
+        # Takeover: the survivor adopts the orphans and serves them.
+        _wait_for(
+            lambda: sorted(shards[survivor].targets) == sorted(urls),
+            timeout=15.0,
+        )
+        assert set(split[survivor]) <= set(shards[survivor].targets)
+        doc = _wait_for(
+            lambda: (
+                d := json.loads(_get(shards[survivor].url + "/fleet")[1])
+            )["fleet"].get("hosts", {}).get("up", 0) == 3 and d,
+            timeout=15.0,
+        )
+        assert doc["membership"]["alive_shards"] == [survivor]
+        assert doc["membership"]["takeovers_total"] == len(split[victim])
+        status, page = _get(shards[survivor].url + "/metrics")
+        assert f"tpu_fleet_takeovers_total {float(len(split[victim]))}" in page
+        assert 'tpu_fleet_peer_up{peer="%d"} 0.0' % victim in page
+        del dead
+    finally:
+        for shard in shards:
+            if shard is not None:
+                shard.close()
+        for exp in exps:
+            exp.close()
+
+
+def test_warm_restart_serves_spooled_rollups(tmp_path):
+    """Aggregator restart with a spool: the reborn shard's FIRST
+    serving cycle carries the journaled last-good rollups, stale-flagged
+    and partial-visibility — not a blind window."""
+    from tpumon.fleet.server import build_aggregator
+
+    exp = _exporter()
+    port = _free_port()
+
+    def cfg() -> FleetConfig:
+        return FleetConfig(
+            port=port, addr="127.0.0.1", targets=exp.server.url,
+            interval=0.2, stale_s=0.5, evict_s=300.0,
+            spool_dir=str(tmp_path), spool_every_s=0.2,
+            history_window=0.0,
+        )
+
+    agg = build_aggregator(cfg())
+    agg.start()
+    try:
+        _wait_for(
+            lambda: json.loads(
+                _get(agg.url + "/fleet")[1]
+            )["fleet"].get("hosts", {}).get("up", 0) == 1
+        )
+    finally:
+        agg.close()  # final spool save
+    exp.close()  # the node is GONE: restore is the only data source
+
+    reborn = build_aggregator(cfg())
+    reborn.start()
+    try:
+        # The priming collect cycle inside start() already served the
+        # spooled snapshot — no waiting, first page is the proof.
+        status, page = _get(reborn.url + "/metrics")
+        assert status == 200
+        assert "tpu_fleet_spool_restored_nodes 1.0" in page
+        assert (
+            'tpu_fleet_hosts{pool="",scope="fleet",slice="",state="stale"} 1.0'
+            in page
+            or 'tpu_fleet_hosts{pool="",scope="fleet",slice="",state="up"} 1.0'
+            in page
+        )
+        doc = json.loads(_get(reborn.url + "/fleet")[1])
+        assert doc["fleet"]["chips"] == 4  # v4-8 host: data, not absence
+        # Aged honestly: within a second the restored feed goes stale
+        # (its exporter is dead) and the rollup flags it.
+        doc = _wait_for(
+            lambda: (
+                d := json.loads(_get(reborn.url + "/fleet")[1])
+            )["fleet"]["hosts"].get("stale", 0) == 1 and d,
+            timeout=10.0,
+        )
+        assert d_vis(doc) < 1.0
+        assert doc["fleet"]["stale"] is True
+        status, page = _get(reborn.url + "/metrics")
+        assert (
+            'tpu_fleet_stale_rollup{pool="",scope="fleet",slice=""} 1.0'
+            in page
+        )
+    finally:
+        reborn.close()
+
+
+def d_vis(doc: dict) -> float:
+    return doc["fleet"].get("visibility", 1.0)
+
+
+def test_spool_restore_skips_unowned_targets(tmp_path):
+    """A restored shard only re-serves snapshots for targets it OWNS
+    under the current membership — the rest stay in the spool for the
+    shard that owns them (or for a later takeover)."""
+    from tpumon.fleet.server import build_aggregator
+
+    spool = SnapshotSpool(str(tmp_path))
+    universe = [f"http://node-{i}:9400" for i in range(8)]
+    spool.save(
+        universe,
+        {
+            t: {"snap": {"identity": {}, "chips": {}}, "fetched_at": time.time()}
+            for t in universe
+        },
+    )
+    agg = build_aggregator(
+        FleetConfig(
+            port=0, addr="127.0.0.1", targets=",".join(universe),
+            shard_index=0, shard_count=2, spool_dir=str(tmp_path),
+            interval=0.5, history_window=0.0,
+        )
+    )
+    try:
+        owned = owned_targets(universe, 0, 2)
+        assert sorted(agg.targets) == sorted(owned)
+        restored = [
+            t for t, f in agg.feeds.items() if f.current()[0] is not None
+        ]
+        assert sorted(restored) == sorted(owned)
+    finally:
+        agg.close()
+
+
+def test_spooled_universe_backs_failed_discovery(tmp_path):
+    """k8s discovery dark at boot + a journaled universe: the shard
+    comes up serving the spooled membership instead of empty."""
+    from tpumon.fleet.server import build_aggregator
+
+    spool = SnapshotSpool(str(tmp_path))
+    universe = ["http://node-0:9400", "http://node-1:9400"]
+    spool.save(universe, {})
+    agg = build_aggregator(
+        FleetConfig(
+            port=0, addr="127.0.0.1",
+            discovery="k8s", k8s_service="tpumon/tpumon",
+            k8s_api=f"http://127.0.0.1:{_free_port()}",  # dead API
+            spool_dir=str(tmp_path), interval=0.5, history_window=0.0,
+            timeout=0.5,
+        )
+    )
+    try:
+        assert sorted(agg.targets) == sorted(universe)
+    finally:
+        agg.close()
+
+
+# -- fleetsim chaos vocabulary ---------------------------------------------
+
+
+def test_fleetsim_partition_slow_corrupt_heal():
+    from tpumon.tools.fleetsim import FleetSim, _corrupt_payload
+
+    sim = FleetSim(3, topology="v4-8", node_interval=0.5)
+    try:
+        url = f"http://127.0.0.1:{sim.ports[0]}/metrics"
+
+        def fetch() -> bytes:
+            with urllib.request.urlopen(url, timeout=2.0) as resp:
+                return resp.read()
+
+        assert b"accelerator_device_count" in fetch()
+        # Partition: accepted then dropped — a torn read, not a refusal.
+        assert sim.partition(1) == ["partitioned node-0"]
+        with pytest.raises(Exception):
+            fetch()
+        assert sim.heal() == ["healed 1 fault(s)"]
+        assert b"accelerator_device_count" in fetch()
+        # Corrupt picks from the TAIL (disjoint from partition victims).
+        assert sim.corrupt(1) == ["corrupting node-2"]
+        tail = f"http://127.0.0.1:{sim.ports[2]}/metrics"
+        with urllib.request.urlopen(tail, timeout=2.0) as resp:
+            hostile = resp.read()
+        from tpumon.exporter.encodings import SNAPSHOT_MAGIC
+
+        assert hostile.startswith(SNAPSHOT_MAGIC) or hostile[:1] == b"\xff"
+        # Slow: answers, late.
+        sim.slow(1, 0.2)
+        t0 = time.monotonic()
+        fetch()
+        assert time.monotonic() - t0 >= 0.2
+        # Both hostile payload shapes exist in the alternation.
+        kinds = {_corrupt_payload(s)[:5] for s in (1, 2)}
+        assert len(kinds) == 2
+        # corrupt(0) is a no-op, not everything ([-0:] slices the lot).
+        assert sim.corrupt(0) == []
+    finally:
+        sim.close()
+
+
+def test_fleetsim_flap_toggles_with_ticks():
+    from tpumon.tools.fleetsim import FleetSim
+
+    sim = FleetSim(2, topology="v4-8", node_interval=60.0)
+    try:
+        sim.flap(1)
+        states = set()
+        for _ in range(4):
+            sim.tick()
+            with sim._lock:
+                states.add(0 in sim._partitioned)
+        assert states == {True, False}
+    finally:
+        sim.close()
+
+
+# -- smi retry (satellite 3) ------------------------------------------------
+
+
+def test_smi_aggregator_snapshot_retries_transient_errors(monkeypatch):
+    from tpumon import smi
+
+    calls = {"n": 0}
+
+    def flaky(url: str, timeout: float) -> str:
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("connection reset")
+        return json.dumps({"nodes": [], "fleet": {}, "slices": []})
+
+    monkeypatch.setattr(smi, "_fetch", flaky)
+    snap = smi.aggregator_snapshot("http://127.0.0.1:1", 1.0)
+    assert calls["n"] == 3
+    assert snap["aggregator"]["fleet"] == {}
+
+
+def test_smi_aggregator_snapshot_gives_up_after_bounded_retries(monkeypatch):
+    from tpumon import smi
+
+    calls = {"n": 0}
+
+    def dead(url: str, timeout: float) -> str:
+        calls["n"] += 1
+        raise OSError("no route")
+
+    monkeypatch.setattr(smi, "_fetch", dead)
+    with pytest.raises(OSError):
+        smi.aggregator_snapshot("http://127.0.0.1:1", 1.0)
+    assert calls["n"] == 3  # bounded, not forever

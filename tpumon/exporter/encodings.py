@@ -156,14 +156,24 @@ def is_snapshot(data: bytes) -> bool:
     return data.startswith(SNAPSHOT_MAGIC)
 
 
-def decode_snapshot(data: bytes) -> dict:
+def decode_snapshot(data: bytes, max_bytes: int | None = None) -> dict:
     """Inverse of :func:`encode_snapshot`; raises ValueError on a frame
     that is not a well-formed snapshot (callers fall back to the text
-    parser)."""
+    parser).
+
+    ``max_bytes`` caps the DECLARED payload length, checked before any
+    payload-sized work: a hostile length prefix (varints happily encode
+    2**60) must be rejected up front, not discovered as an allocation —
+    the fleet tier passes TPUMON_FLEET_MAX_SNAPSHOT_BYTES here.
+    """
     if not is_snapshot(data):
         raise ValueError("not a tpumon snapshot frame")
     body = data[len(SNAPSHOT_MAGIC):]
     length, idx = _decode_varint(body, 0)
+    if length < 0 or (max_bytes is not None and length > max_bytes):
+        raise ValueError(
+            f"snapshot length prefix {length} exceeds cap {max_bytes}"
+        )
     payload = body[idx:idx + length]
     if len(payload) != length:
         raise ValueError("truncated snapshot payload")

@@ -263,6 +263,58 @@ FLEET_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "data — stale-flagged, never silently absent",
         ("scope", "pool", "slice"),
     ),
+    "tpu_fleet_visibility_ratio": (
+        "gauge",
+        "Fraction of the scope's known hosts contributing FRESH data "
+        "to the rollup — below 1.0 the rollup is PARTIAL (stale "
+        "last-good inclusions, partition, dead feeds, takeover in "
+        "progress), never silently renormalized; scope=global covers "
+        "the whole universe across shards",
+        ("scope", "pool", "slice"),
+    ),
+    "tpu_fleet_membership_targets": (
+        "gauge",
+        "Target universe size by discovery source (static / file / "
+        "k8s Endpoints)",
+        ("source",),
+    ),
+    "tpu_fleet_membership_changes_total": (
+        "counter",
+        "Live membership churn applied after the debounce window, by "
+        "op (add/remove of universe targets)",
+        ("op",),
+    ),
+    "tpu_fleet_peer_up": (
+        "gauge",
+        "Peer aggregator shard liveness from /fleet/summary probes "
+        "(1 answering, 0 past the takeover deadline), by peer index",
+        ("peer",),
+    ),
+    "tpu_fleet_takeovers_total": (
+        "counter",
+        "Orphaned targets this shard adopted after a peer shard died "
+        "(rendezvous re-claim over the surviving shards)",
+        (),
+    ),
+    "tpu_fleet_ingest_rejects_total": (
+        "counter",
+        "Upstream payloads refused before parsing, by reason "
+        "(oversized / bad_frame hostile length prefix / undecodable / "
+        "unparseable) — a corrupt feed never costs aggregator memory",
+        ("reason",),
+    ),
+    "tpu_fleet_spool_restored_nodes": (
+        "gauge",
+        "Node snapshots served from the warm-restart spool since "
+        "startup (stale-flagged by ordinary age classification)",
+        (),
+    ),
+    "tpu_fleet_spool_errors_total": (
+        "counter",
+        "Warm-restart spool failures by op (load/write); the "
+        "aggregator runs on, cold",
+        ("op",),
+    ),
     "tpu_fleet_scrape_duration_seconds": (
         "histogram",
         "Wall time to serve one aggregator /metrics exposition (the "

@@ -60,6 +60,60 @@ class FleetConfig:
     #: Node snapshots older than this are DARK: evicted from rollups
     #: (counted in hosts{state="dark"} so absence is observable).
     evict_s: float = 120.0
+    #: Target discovery mode (tpumon/fleet/discovery.py): ``static``
+    #: reads targets/targets_file once at startup; ``file`` re-reads
+    #: them live (mtime-watched — a ConfigMap update lands without a
+    #: restart); ``k8s`` derives the list from the Endpoints /
+    #: EndpointSlice objects of ``k8s_service`` (plus any static
+    #: targets), so scaling the DaemonSet IS the discovery event.
+    discovery: str = "static"
+    #: Discovery resolution cadence seconds (file stat / k8s LIST).
+    discovery_interval: float = 10.0
+    #: Membership churn debounce seconds: a changed target set must hold
+    #: still this long before it is applied (a rolling restart flapping
+    #: endpoint readiness must not thrash feeds and Watch streams).
+    discovery_debounce_s: float = 5.0
+    #: ``namespace/service`` whose endpoints are the fleet (k8s mode).
+    k8s_service: str = ""
+    #: In-cluster API base; tests point this at a fake API server.
+    k8s_api: str = "https://kubernetes.default.svc"
+    #: ServiceAccount bearer-token file (empty = no auth header).
+    k8s_token_file: str = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+    #: API server CA bundle; empty falls back to system trust.
+    k8s_ca_file: str = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
+    #: Endpoint port NAME to scrape (falls back to the port number of
+    #: the first listed port when unnamed).
+    k8s_port_name: str = "metrics"
+    #: CSV of ALL shards' base URLs in shard-index order (position i =
+    #: shard i, this shard's own entry included). Set on every shard of
+    #: a sharded deployment to enable peer liveness probes, dead-shard
+    #: target takeover, and the cross-shard scope="global" rollup;
+    #: empty disables failover (static ownership only).
+    peers: str = ""
+    #: Peer /fleet/summary probe cadence seconds.
+    probe_interval: float = 3.0
+    #: Takeover deadline seconds: a peer unreachable this long is dead
+    #: and its targets are re-claimed by rendezvous over the survivors;
+    #: also the grace a restarting peer gets before being declared dead.
+    takeover_s: float = 15.0
+    #: Warm-restart spool directory: last-good node snapshots + rollup
+    #: identity journaled here (atomic temp+replace) so a restarted
+    #: aggregator serves flagged last-good rollups within one fan-in
+    #: cycle instead of a blind window. Empty disables.
+    spool_dir: str = ""
+    #: Spool file size bound bytes; oldest node entries drop first.
+    spool_max_bytes: int = 16777216
+    #: Spool journal cadence seconds.
+    spool_every_s: float = 10.0
+    #: Hard cap on one upstream payload: HTTP bodies are read at most
+    #: this far, and a snapshot frame whose length prefix claims more
+    #: is rejected BEFORE allocation (tpu_fleet_ingest_rejects_total) —
+    #: a corrupt or hostile feed must not OOM the aggregator.
+    max_snapshot_bytes: int = 8388608
+    #: Adaptive fetch cadence ceiling seconds: stale/dark feeds back
+    #: off toward this on the jittered Backoff; the first good page
+    #: restores full cadence (storm-free mass recovery).
+    poll_backoff_max_s: float = 60.0
     #: Rollup-history retention window seconds (tpumon.history reuse,
     #: served at /history); 0 disables.
     history_window: float = 600.0

@@ -1,0 +1,347 @@
+"""Live target discovery: the membership half of the failover plane.
+
+PR 6 shipped the fleet tier with a static target list (CSV / ConfigMap
+file read once at startup) — ROADMAP item 1's named follow-up is deriving
+the list from the Kubernetes Endpoints API so scaling the exporter
+DaemonSet *is* the discovery event. Three modes
+(``TPUMON_FLEET_DISCOVERY``):
+
+- ``static`` — the PR 6 behavior: ``target_list()`` resolved once.
+- ``file`` — ``targets_file`` re-read on every discovery tick (cheap at
+  a 10 s cadence), so a ConfigMap update propagates without a restart.
+- ``k8s`` — EndpointSlice (``discovery.k8s.io/v1``, preferred) or
+  Endpoints (``v1``, fallback for old control planes) objects of
+  ``k8s_service``, fetched from the in-cluster API with the pod's
+  ServiceAccount token. No client library: the two GETs this needs are
+  plain HTTPS+JSON, and the JSON→target parsing is a pure function
+  (:func:`targets_from_endpointslices` / :func:`targets_from_endpoints`)
+  unit-tested against fixture documents.
+
+A failed resolution returns ``None`` — the caller keeps the last
+applied universe (stale membership beats an empty fleet, the same
+stale-but-served stance as every other plane). Churn is debounced by
+the caller (:class:`Debouncer`): a resolved set must hold still for
+``discovery_debounce_s`` before it is applied, so endpoint-readiness
+flapping during a rolling restart cannot thrash feeds and Watch
+streams.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import ssl
+import urllib.error
+import urllib.request
+
+log = logging.getLogger(__name__)
+
+#: Discovery source labels (tpu_fleet_membership_targets{source}).
+SOURCE_STATIC = "static"
+SOURCE_FILE = "file"
+SOURCE_K8S = "k8s"
+
+
+def _endpoint_port(ports: list, port_name: str) -> int | None:
+    """Pick the scrape port from an EndpointSlice/Endpoints port list:
+    the one named ``port_name``, else the SINGLE listed port (named or
+    not — one choice is not a guess; a lone differently-named port
+    self-heals a port-name typo). Several ports with no name match
+    return None — never a guess among several."""
+    for port in ports or ():
+        if port.get("name") == port_name and port.get("port"):
+            return int(port["port"])
+    if len(ports or ()) == 1 and ports[0].get("port"):
+        return int(ports[0]["port"])
+    return None
+
+
+def _host_port(addr: str, port: int) -> str:
+    if ":" in addr:  # IPv6 literal
+        return f"[{addr}]:{port}"
+    return f"{addr}:{port}"
+
+
+def targets_from_endpointslices(doc: dict, port_name: str) -> list[str]:
+    """EndpointSlice LIST document -> sorted ``host:port`` targets.
+
+    Only ready endpoints count (``conditions.ready`` absent means ready,
+    per the API contract); not-ready pods will be re-admitted by the
+    next resolution once kubelet flips them back.
+    """
+    out: set[str] = set()
+    for item in doc.get("items", ()):
+        port = _endpoint_port(item.get("ports") or [], port_name)
+        if port is None:
+            continue
+        for endpoint in item.get("endpoints") or ():
+            ready = (endpoint.get("conditions") or {}).get("ready")
+            if ready is False:
+                continue
+            for addr in endpoint.get("addresses") or ():
+                out.add(_host_port(addr, port))
+    return sorted(out)
+
+
+def targets_from_endpoints(doc: dict, port_name: str) -> list[str]:
+    """core/v1 Endpoints document -> sorted ``host:port`` targets."""
+    out: set[str] = set()
+    for subset in doc.get("subsets") or ():
+        port = _endpoint_port(subset.get("ports") or [], port_name)
+        if port is None:
+            continue
+        for addr in subset.get("addresses") or ():
+            ip = addr.get("ip")
+            if ip:
+                out.add(_host_port(ip, port))
+    return sorted(out)
+
+
+class KubeEndpoints:
+    """Minimal in-cluster reader for one Service's endpoints.
+
+    Auth is the mounted ServiceAccount token; TLS trusts the mounted
+    cluster CA. Both degrade: an unreadable token file means no auth
+    header (fine against a test API server), a missing CA file falls
+    back to system trust. Every request is deadline-bounded.
+    """
+
+    def __init__(
+        self,
+        api: str,
+        service: str,
+        *,
+        token_file: str = "",
+        ca_file: str = "",
+        port_name: str = "metrics",
+        timeout: float = 5.0,
+    ) -> None:
+        self.api = api.rstrip("/")
+        namespace, _, name = service.strip().strip("/").partition("/")
+        if not name:
+            namespace, name = "default", namespace
+        self.namespace = namespace
+        self.name = name
+        self.port_name = port_name
+        self.timeout = timeout
+        self._token_file = token_file
+        self._context: ssl.SSLContext | None = None
+        if self.api.startswith("https://"):
+            try:
+                if ca_file:
+                    self._context = ssl.create_default_context(cafile=ca_file)
+                else:
+                    self._context = ssl.create_default_context()
+            except (OSError, ssl.SSLError) as exc:
+                log.warning(
+                    "k8s CA bundle %s unusable (%s); using system trust",
+                    ca_file, exc,
+                )
+                self._context = ssl.create_default_context()
+        #: Once the EndpointSlice API has answered (even empty), skip
+        #: the legacy Endpoints fallback on later ticks.
+        self._slices_supported: bool | None = None
+
+    def _token(self) -> str:
+        if not self._token_file:
+            return ""
+        try:
+            with open(self._token_file, encoding="utf-8") as fh:
+                return fh.read().strip()
+        except OSError:
+            return ""
+
+    def _get_json(self, path: str) -> dict:
+        request = urllib.request.Request(self.api + path)
+        token = self._token()
+        if token:
+            request.add_header("Authorization", f"Bearer {token}")
+        request.add_header("Accept", "application/json")
+        with urllib.request.urlopen(
+            request, timeout=self.timeout, context=self._context
+        ) as resp:
+            return json.loads(resp.read().decode())
+
+    def _has_unmatched_ports(self, port_lists) -> bool:
+        """True when endpoints EXIST but none carried a usable port: a
+        port-name mismatch (``k8s_port_name`` vs the Service's actual
+        port name) must read as a FAILED resolution — applying it as an
+        empty fleet would silently tear down every feed."""
+        for ports in port_lists:
+            if ports and _endpoint_port(list(ports), self.port_name) is None:
+                log.warning(
+                    "k8s endpoints for %s/%s carry no port matching %r "
+                    "(ports: %s); treating as a failed resolution — check "
+                    "TPUMON_FLEET_K8S_PORT_NAME",
+                    self.namespace, self.name, self.port_name,
+                    [p.get("name") for p in ports],
+                )
+                return True
+        return False
+
+    def resolve(self) -> list[str] | None:
+        """Current ready targets, or ``None`` when the API is
+        unreachable or the configured port name matches nothing
+        (caller keeps the last universe)."""
+        if self._slices_supported is not False:
+            try:
+                doc = self._get_json(
+                    f"/apis/discovery.k8s.io/v1/namespaces/{self.namespace}"
+                    "/endpointslices?labelSelector="
+                    f"kubernetes.io%2Fservice-name%3D{self.name}"
+                )
+                self._slices_supported = True
+                targets = targets_from_endpointslices(doc, self.port_name)
+                if not targets and self._has_unmatched_ports(
+                    item.get("ports") for item in doc.get("items", ())
+                ):
+                    return None  # misconfigured port name, not an empty fleet
+                return targets
+            except urllib.error.HTTPError as exc:
+                if exc.code in (403, 404) and self._slices_supported is None:
+                    # Old control plane / RBAC without the discovery
+                    # group: remember and ride core/v1 Endpoints.
+                    self._slices_supported = False
+                else:
+                    log.warning("k8s endpointslice list failed: %s", exc)
+                    return None
+            except (OSError, ValueError) as exc:
+                log.warning("k8s endpointslice list failed: %s", exc)
+                return None
+        try:
+            doc = self._get_json(
+                f"/api/v1/namespaces/{self.namespace}/endpoints/{self.name}"
+            )
+            targets = targets_from_endpoints(doc, self.port_name)
+            if not targets and self._has_unmatched_ports(
+                subset.get("ports") for subset in doc.get("subsets") or ()
+            ):
+                return None
+            return targets
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                # The Service genuinely has no endpoints object: an
+                # empty fleet, not an outage.
+                return []
+            log.warning("k8s endpoints get failed: %s", exc)
+            return None
+        except (OSError, ValueError) as exc:
+            log.warning("k8s endpoints get failed: %s", exc)
+            return None
+
+
+class TargetResolver:
+    """One ``resolve()`` per discovery tick, whatever the mode."""
+
+    def __init__(self, cfg) -> None:
+        self.cfg = cfg
+        self.mode = (cfg.discovery or SOURCE_STATIC).strip().lower()
+        if self.mode not in (SOURCE_STATIC, SOURCE_FILE, SOURCE_K8S):
+            log.warning(
+                "unknown TPUMON_FLEET_DISCOVERY=%r; using static",
+                cfg.discovery,
+            )
+            self.mode = SOURCE_STATIC
+        self._static = cfg.target_list()
+        self._kube: KubeEndpoints | None = None
+        if self.mode == SOURCE_K8S:
+            if cfg.k8s_service:
+                self._kube = KubeEndpoints(
+                    cfg.k8s_api, cfg.k8s_service,
+                    token_file=cfg.k8s_token_file,
+                    ca_file=cfg.k8s_ca_file,
+                    port_name=cfg.k8s_port_name,
+                    timeout=max(1.0, cfg.timeout),
+                )
+            else:
+                log.warning(
+                    "TPUMON_FLEET_DISCOVERY=k8s without "
+                    "TPUMON_FLEET_K8S_SERVICE; serving static targets only"
+                )
+
+    def _targets_file_readable(self) -> bool:
+        """A configured targets file that is transiently unreadable
+        (volume remount, ConfigMap rollout) must read as a FAILED
+        resolution, not as an empty fleet — ``target_list()`` swallows
+        the OSError, so probe it here first. Checked only in live
+        modes: static mode keeps its boot-time semantics."""
+        if not self.cfg.targets_file:
+            return True
+        try:
+            with open(self.cfg.targets_file, encoding="utf-8"):
+                return True
+        except OSError:
+            return False
+
+    def resolve(self) -> list[str] | None:
+        """The merged target universe, or ``None`` on a failed
+        resolution (k8s API down, targets file unreadable — the caller
+        keeps the last universe)."""
+        if self.mode == SOURCE_STATIC:
+            return list(self._static)
+        if not self._targets_file_readable():
+            log.warning(
+                "targets file %s unreadable; keeping last universe",
+                self.cfg.targets_file,
+            )
+            return None
+        if self.mode == SOURCE_FILE:
+            return self.cfg.target_list()
+        discovered = self._kube.resolve() if self._kube else []
+        if discovered is None:
+            return None
+        # Static CSV targets ride along (an out-of-cluster exporter, a
+        # canary) — file targets too, re-read live like `file` mode.
+        merged = self.cfg.target_list()
+        seen = set(merged)
+        for target in discovered:
+            if target not in seen:
+                seen.add(target)
+                merged.append(target)
+        return merged
+
+
+class Debouncer:
+    """Membership churn settle window.
+
+    ``offer(resolved, now)`` returns the newly APPLIED universe when the
+    resolved set has held still for ``debounce_s`` (or on the very first
+    resolution — startup must not wait out the window), else ``None``.
+    A set that keeps changing keeps resetting its own clock.
+    """
+
+    def __init__(self, debounce_s: float) -> None:
+        self.debounce_s = max(0.0, debounce_s)
+        self.applied: list[str] | None = None
+        self._pending: list[str] | None = None
+        self._pending_since = 0.0
+
+    def offer(self, resolved: list[str], now: float) -> list[str] | None:
+        if self.applied is None:
+            self.applied = list(resolved)
+            return self.applied
+        if resolved == self.applied:
+            self._pending = None
+            return None
+        if self._pending != resolved:
+            self._pending = list(resolved)
+            self._pending_since = now
+            if self.debounce_s > 0:
+                return None
+        if now - self._pending_since >= self.debounce_s:
+            self.applied = self._pending
+            self._pending = None
+            return self.applied
+        return None
+
+
+__all__ = [
+    "Debouncer",
+    "KubeEndpoints",
+    "SOURCE_FILE",
+    "SOURCE_K8S",
+    "SOURCE_STATIC",
+    "TargetResolver",
+    "targets_from_endpoints",
+    "targets_from_endpointslices",
+]

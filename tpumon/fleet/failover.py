@@ -1,0 +1,426 @@
+"""Shard failover: peer liveness, dead-shard takeover, and the
+membership plane that owns "which targets is this shard watching".
+
+PR 6's sharding was static: rendezvous over ``shard_count`` indices,
+forever. A dead shard's slice of the fleet simply went invisible until
+a human or a controller acted. This module closes that hole with the
+same no-coordinator stance the sharding itself has:
+
+- :class:`PeerWatcher` probes every peer shard's ``/fleet/summary``
+  (cheap: a few hundred bytes of JSON, unguarded like a health probe).
+  A peer unreachable for ``takeover_s`` is DEAD; one good probe brings
+  it back. The summaries double as the cross-shard rollup feed — one
+  probe buys liveness AND the ``scope="global"`` totals.
+- :class:`MembershipPlane` runs the loop: resolve the target universe
+  (tpumon/fleet/discovery), debounce churn, fold in peer liveness, and
+  recompute ownership with :func:`~tpumon.fleet.shard.owned_targets_among`
+  — rendezvous over the SURVIVING shards, so a takeover adopts exactly
+  the dead peer's targets and nothing else moves (minimal movement, the
+  property tests/test_fleet_chaos.py pins).
+
+Every shard runs the same pure functions over the same inputs, so two
+survivors never adopt the same orphan. The failure mode left open is
+deliberate: a PARTITIONED (not dead) peer and its prober disagree about
+liveness, and a target is briefly watched twice — duplicate fan-in is
+the safe side. In the asymmetric case the unreachable peer's summary is
+excluded from the global merge (we think it's dead), so its totals are
+not double-counted; in the brief hand-back window where an alive peer
+and we both still claim a target (at most ~one probe round), the global
+row reports MORE hosts than the universe and the server flags it
+(``contested`` + stale) instead of renormalizing — flagged-overlapping,
+never silently wrong.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor, wait
+
+from tpumon.fleet.discovery import Debouncer, TargetResolver
+from tpumon.fleet.shard import owned_targets_among
+
+log = logging.getLogger(__name__)
+
+#: Everything a peer probe can throw (same curated set as ingest).
+PROBE_ERRORS: tuple[type[BaseException], ...] = (
+    urllib.error.URLError,
+    OSError,
+    ValueError,
+)
+
+
+def parse_peers(raw: str, shard_count: int) -> list[str]:
+    """``TPUMON_FLEET_PEERS`` CSV -> index-ordered base URLs (position
+    i = shard i). Empty entries are kept as ``""`` PLACEHOLDERS — an
+    operator blanking their own slot must not shift every later peer's
+    index — and placeholder/tail shards are simply unprobed (assumed
+    alive, never declared dead). Extras beyond ``shard_count`` are
+    ignored with a warning."""
+    if not raw.strip():
+        return []
+    peers = [p.strip().rstrip("/") for p in raw.split(",")]
+    for i, peer in enumerate(peers):
+        if peer and not peer.startswith(("http://", "https://")):
+            peers[i] = "http://" + peer
+    if len(peers) > shard_count:
+        log.warning(
+            "TPUMON_FLEET_PEERS lists %d peers for %d shards; ignoring "
+            "the extras", len(peers), shard_count,
+        )
+        peers = peers[:shard_count]
+    return peers
+
+
+class PeerWatcher:
+    """Liveness + last summary for every peer shard.
+
+    Probes run on the membership thread; ``alive()``/``summaries()``
+    are read from the collect loop — one lock guards the maps.
+    """
+
+    def __init__(
+        self,
+        peers: list[str],
+        shard_index: int,
+        *,
+        takeover_s: float,
+        shard_count: int | None = None,
+        timeout: float = 2.0,
+        clock=time.time,
+        fetch=None,
+    ) -> None:
+        self.shard_index = shard_index
+        self.shard_count = (
+            shard_count if shard_count is not None else len(peers)
+        )
+        self.takeover_s = takeover_s
+        self.timeout = timeout
+        self._clock = clock
+        self._fetch = fetch if fetch is not None else self._http_fetch
+        #: Probed peers only: an index with no URL (short list, ""
+        #: placeholder) is NEVER probed and therefore never declared
+        #: dead — a shard may only take over from peers it can actually
+        #: observe failing.
+        self.peers = {
+            i: url for i, url in enumerate(peers) if i != shard_index and url
+        }
+        self._executor: ThreadPoolExecutor | None = None
+        self._lock = threading.Lock()
+        now = clock()
+        #: Startup grace: every peer starts "alive" with a full takeover
+        #: window to answer, so a cold sharded rollout doesn't have
+        #: shard 0 claiming the whole fleet while shard 1 pulls images.
+        self._last_ok = {i: now for i in self.peers}  # guarded-by: self._lock
+        self._summaries: dict[int, dict] = {}  # guarded-by: self._lock
+        self._errors: dict[int, str] = {}  # guarded-by: self._lock
+
+    def _http_fetch(self, url: str) -> dict:
+        with urllib.request.urlopen(
+            url + "/fleet/summary", timeout=self.timeout
+        ) as resp:
+            return json.loads(resp.read().decode())
+
+    def probe_once(self) -> None:
+        """One probe round over every peer, CONCURRENTLY: sequential
+        probes would make the round last up to len(peers)×timeout, and
+        a round longer than takeover_s ages healthy peers' last-ok past
+        the deadline — a partition hanging half the peers must never
+        make the OTHER half read dead. The round blocks at most one
+        probe timeout (+slack); a straggler probe finishes on its
+        worker and still updates last-ok late."""
+        if not self.peers:
+            return
+        if self._executor is None:
+            self._executor = ThreadPoolExecutor(
+                max_workers=min(8, len(self.peers)),
+                thread_name_prefix="tpumon-fleet-peer-probe",
+            )
+        futures = {
+            self._executor.submit(self._probe_one, index, url)
+            for index, url in self.peers.items()
+        }
+        wait(futures, timeout=self.timeout + 0.5)
+
+    def _probe_one(self, index: int, url: str) -> None:
+        try:
+            summary = self._fetch(url)
+        except PROBE_ERRORS as exc:
+            with self._lock:
+                self._errors[index] = str(exc)[:200]
+            log.debug("peer %d (%s) probe failed: %s", index, url, exc)
+            return
+        if not isinstance(summary, dict):
+            with self._lock:
+                self._errors[index] = "non-object summary"
+            return
+        with self._lock:
+            self._last_ok[index] = self._clock()
+            self._summaries[index] = summary
+            self._errors.pop(index, None)
+
+    def close(self) -> None:
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)
+
+    def alive(self) -> set[int]:
+        """Shard indices currently considered alive: self, every
+        UNPROBED index (no URL configured — assumed alive, we have no
+        evidence either way), and every probed peer inside its takeover
+        window."""
+        now = self._clock()
+        with self._lock:
+            dead = {
+                i for i, ts in self._last_ok.items()
+                if now - ts > self.takeover_s
+            }
+        return set(range(self.shard_count)) - dead
+
+    def summaries(self) -> dict[int, dict]:
+        """index -> last /fleet/summary doc, ALIVE peers only (a dead
+        peer's totals are its takeover's to re-earn, not ours to
+        re-serve)."""
+        live = self.alive()
+        with self._lock:
+            return {
+                i: doc for i, doc in self._summaries.items() if i in live
+            }
+
+    def states(self) -> dict[int, dict]:
+        """Per-peer debug/telemetry view (peer_up gauge, /debug/vars)."""
+        now = self._clock()
+        alive = self.alive()
+        with self._lock:
+            return {
+                i: {
+                    "url": url,
+                    "alive": i in alive,
+                    "last_ok_age_s": round(
+                        max(0.0, now - self._last_ok[i]), 3
+                    ),
+                    "error": self._errors.get(i),
+                }
+                for i, url in self.peers.items()
+            }
+
+
+class MembershipPlane:
+    """The coherent loop: discovery → debounce → liveness → ownership.
+
+    ``on_membership(owned, info)`` fires (from the plane thread) every
+    time this shard's owned target set changes; ``observe_event(kind,
+    count)`` counts universe adds/removes and takeover adoptions into
+    the server's ``tpu_fleet_membership_*`` / takeover counters.
+    """
+
+    def __init__(
+        self,
+        cfg,
+        *,
+        on_membership,
+        observe_event=None,
+        initial_universe: list[str] | None = None,
+        clock=time.time,
+        fetch=None,
+    ) -> None:
+        self.cfg = cfg
+        self._clock = clock
+        self._on_membership = on_membership
+        self._observe_event = observe_event
+        self.resolver = TargetResolver(cfg)
+        self.debouncer = Debouncer(cfg.discovery_debounce_s)
+        self.watcher: PeerWatcher | None = None
+        peers = parse_peers(cfg.peers, cfg.shard_count)
+        if any(peers) and cfg.shard_count > 1:
+            self.watcher = PeerWatcher(
+                peers, cfg.shard_index,
+                takeover_s=cfg.takeover_s,
+                shard_count=cfg.shard_count,
+                timeout=min(cfg.timeout, max(0.5, cfg.probe_interval)),
+                clock=clock,
+                fetch=fetch,
+            )
+        self._lock = threading.Lock()
+        #: Last (universe, alive) rendezvous inputs — membership-thread
+        #: only (plus the synchronous constructor seed), so unlocked.
+        self._last_inputs: tuple | None = None
+        self._universe: list[str] = []  # guarded-by: self._lock
+        self._owned: list[str] | None = None  # guarded-by: self._lock
+        self._alive: set[int] = set(range(cfg.shard_count))  # guarded-by: self._lock
+        self.takeovers_total = 0  # guarded-by: self._lock
+        self._discover_due = 0.0
+        self._probe_due = 0.0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="tpumon-fleet-membership", daemon=True
+        )
+        # Seed synchronously so the aggregator's first collect cycle has
+        # feeds: a warm restart's spooled universe backs a failed first
+        # k8s resolution, and static mode is complete before start().
+        if initial_universe:
+            self.debouncer.applied = list(initial_universe)
+        resolved = self.resolver.resolve()
+        if resolved is not None:
+            self.debouncer.offer(resolved, self._clock())
+        self._recompute(first=True)
+
+    # -- loop --------------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        if self.watcher is not None:
+            self.watcher.close()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:
+                # The membership loop must never die: a resolution bug
+                # leaves the CURRENT feeds serving, which is the safe
+                # degradation.
+                log.exception("membership tick failed")
+            step = min(
+                max(0.5, self.cfg.probe_interval)
+                if self.watcher is not None
+                else self.cfg.discovery_interval,
+                self.cfg.discovery_interval,
+            )
+            if self._stop.wait(max(0.25, step)):
+                return
+
+    def tick(self) -> None:
+        """One membership round (tests drive this directly)."""
+        now = self._clock()
+        if now >= self._discover_due:
+            self._discover_due = now + self.cfg.discovery_interval
+            resolved = self.resolver.resolve()
+            if resolved is not None:
+                self.debouncer.offer(resolved, now)
+        if self.watcher is not None and now >= self._probe_due:
+            self._probe_due = now + max(0.5, self.cfg.probe_interval)
+            self.watcher.probe_once()
+        self._recompute()
+
+    # -- ownership ---------------------------------------------------------
+
+    def _recompute(self, first: bool = False) -> None:
+        cfg = self.cfg
+        universe = list(self.debouncer.applied or [])
+        alive = (
+            self.watcher.alive()
+            if self.watcher is not None
+            else set(range(cfg.shard_count))
+        )
+        # Steady-state fast path: same universe, same alive set ⇒ same
+        # rendezvous outcome — skip re-hashing the whole universe every
+        # tick (10k targets × N shards of md5 per probe round adds up).
+        inputs = (tuple(universe), frozenset(alive))
+        if not first and inputs == self._last_inputs:
+            return
+        self._last_inputs = inputs
+        owned = owned_targets_among(
+            universe, cfg.shard_index, alive, cfg.shard_count
+        )
+        with self._lock:
+            old_universe = self._universe
+            old_owned = self._owned
+            old_alive = self._alive
+            self._universe = universe
+            self._owned = owned
+            self._alive = alive
+        universe_set, old_set = set(universe), set(old_universe)
+        self._count(
+            "add", len(universe_set) if first else len(universe_set - old_set)
+        )
+        self._count("remove", len(old_set - universe_set))
+        if owned == old_owned and not first:
+            return
+        # Set-based diffs: list membership here would be O(n·m) string
+        # compares — at fleet scale that stalls THIS thread (which also
+        # runs the peer probes) long enough to age every peer past the
+        # takeover deadline and mass-adopt the fleet spuriously.
+        old_owned_set = set(old_owned or [])
+        owned_set = set(owned)
+        added = [t for t in owned if t not in old_owned_set]
+        removed = [t for t in (old_owned or []) if t not in owned_set]
+        #: Adoption caused by shards dying (not by universe growth):
+        #: newly-owned targets that were already in the universe while a
+        #: previously-alive shard dropped out.
+        died = old_alive - alive
+        if died and added:
+            takeover = len([t for t in added if t in old_set])
+            if takeover:
+                with self._lock:
+                    self.takeovers_total += takeover
+                self._count("takeover", takeover)
+                log.warning(
+                    "shard %d adopting %d orphaned target(s) from dead "
+                    "shard(s) %s", cfg.shard_index, takeover, sorted(died),
+                )
+        if added or removed or first:
+            try:
+                self._on_membership(
+                    owned,
+                    {
+                        "universe": universe,
+                        "alive": sorted(alive),
+                        "added": added,
+                        "removed": removed,
+                        "first": first,
+                    },
+                )
+            except Exception:
+                log.exception("membership apply failed")
+
+    def _count(self, kind: str, n: int) -> None:
+        if n and self._observe_event is not None:
+            try:
+                self._observe_event(kind, n)
+            except Exception:
+                # Metrics hooks must never break membership.
+                log.debug("membership observer failed", exc_info=True)
+
+    # -- views -------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            universe = list(self._universe)
+            owned = list(self._owned or [])
+            alive = sorted(self._alive)
+            takeovers = self.takeovers_total
+        doc: dict = {
+            "source": self.resolver.mode,
+            "universe": len(universe),
+            "owned": len(owned),
+            "alive_shards": alive,
+            "takeovers_total": takeovers,
+        }
+        if self.watcher is not None:
+            doc["peers"] = self.watcher.states()
+        return doc
+
+    def universe(self) -> list[str]:
+        with self._lock:
+            return list(self._universe)
+
+    def alive_shards(self) -> set[int]:
+        with self._lock:
+            return set(self._alive)
+
+    def peer_summaries(self) -> dict[int, dict]:
+        if self.watcher is None:
+            return {}
+        return self.watcher.summaries()
+
+
+__all__ = ["MembershipPlane", "PeerWatcher", "PROBE_ERRORS", "parse_peers"]

@@ -222,6 +222,11 @@ class NodeFeed:
         breaker_failures: int = 3,
         breaker_open_s: float = 15.0,
         observe_fetch=None,
+        observe_reject=None,
+        max_snapshot_bytes: int = 8388608,
+        fresh_s: float = float("inf"),
+        poll_backoff_base_s: float = 1.0,
+        poll_backoff_max_s: float = 60.0,
         clock=time.time,
     ) -> None:
         self.target = target
@@ -229,6 +234,14 @@ class NodeFeed:
         self.timeout = timeout
         self._clock = clock
         self._observe_fetch = observe_fetch
+        self._observe_reject = observe_reject
+        #: Payload hard cap: HTTP bodies read at most this far, and a
+        #: snapshot frame DECLARING more is rejected pre-allocation.
+        self.max_snapshot_bytes = max(4096, int(max_snapshot_bytes))
+        #: Data younger than this counts as fresh — the adaptive-cadence
+        #: reset condition. A zombie page (fetch ok, frozen data) never
+        #: resets the backoff, so dark-but-answering nodes back off too.
+        self.fresh_s = fresh_s
         #: HTTP-path breaker: a dark node costs one probe per open
         #: window instead of a fetch timeout per collect cycle.
         self.breaker = CircuitBreaker(
@@ -236,6 +249,16 @@ class NodeFeed:
         )
         #: Watch reconnect schedule (jittered, capped).
         self.backoff = Backoff(base_s=1.0, max_s=60.0)
+        #: Adaptive HTTP poll cadence (ROADMAP item 1 follow-up): a
+        #: stale/dark/failing feed's polls space out on this jittered
+        #: schedule; the first FRESH page resets it to full cadence.
+        #: Jitter is what makes mass recovery storm-free — 1000 nodes
+        #: returning at once re-poll spread over the backoff window,
+        #: then settle back to the phase-spread steady state.
+        self.poll_backoff = Backoff(
+            base_s=max(0.1, poll_backoff_base_s),
+            max_s=max(poll_backoff_base_s, poll_backoff_max_s),
+        )
         self._lock = threading.Lock()
         self._snap: dict | None = None  # guarded-by: self._lock
         self._fetched_at: float = 0.0  # guarded-by: self._lock
@@ -265,14 +288,25 @@ class NodeFeed:
         serves no matter what we asked for."""
         from tpumon.exporter.encodings import decode_snapshot, is_snapshot
 
+        if len(body) > self.max_snapshot_bytes:
+            # The transport reads were already capped; a body at the cap
+            # is a truncation, and truncated data must not be trusted.
+            log.warning(
+                "%s: payload via %s exceeds %d-byte cap; rejected",
+                self.url, mode, self.max_snapshot_bytes,
+            )
+            self._reject(mode, "oversized")
+            return
         if is_snapshot(body):
             try:
-                snap = decode_snapshot(body)
+                snap = decode_snapshot(
+                    body, max_bytes=self.max_snapshot_bytes
+                )
             except ValueError as exc:
                 log.warning(
                     "%s: bad snapshot frame via %s: %s", self.url, mode, exc
                 )
-                self._count(mode, "parse_error")
+                self._reject(mode, "bad_frame")
                 return
             self.store_snapshot(snap, mode, decoded=True)
             return
@@ -280,7 +314,7 @@ class NodeFeed:
             text = body.decode()
         except UnicodeDecodeError as exc:
             log.warning("%s: undecodable page via %s: %s", self.url, mode, exc)
-            self._count(mode, "parse_error")
+            self._reject(mode, "undecodable")
             return
         self.store_text(text, mode)
 
@@ -292,7 +326,7 @@ class NodeFeed:
             # A garbage page is an upstream bug, not a feed crash — the
             # last-good snapshot keeps serving, aged.
             log.warning("%s: unparseable page via %s: %s", self.url, mode, exc)
-            self._count(mode, "parse_error")
+            self._reject(mode, "unparseable")
             return
         self.store_snapshot(snap, mode)
 
@@ -317,7 +351,22 @@ class NodeFeed:
             self._fetched_at = data_ts
             self._last_error = ""
             self.snapshot_decoded = decoded
+        if now - data_ts <= self.fresh_s:
+            # FRESH data restores full poll cadence; a zombie's frozen
+            # timestamps do not (the fetch succeeded, the data is dead).
+            self.poll_backoff.reset()
         self._count(mode, "ok")
+
+    def restore(self, snap: dict, fetched_at: float) -> None:
+        """Seed the last-good snapshot from the warm-restart spool —
+        original data timestamp preserved, so ordinary age
+        classification stale-flags it honestly. Never overwrites data a
+        live fetch already landed."""
+        with self._lock:
+            if self._snap is not None:
+                return
+            self._snap = snap
+            self._fetched_at = fetched_at
 
     def current(self) -> tuple[dict | None, float, str]:
         """(last-good snapshot, fetched-at ts, last error) — atomically."""
@@ -342,6 +391,30 @@ class NodeFeed:
             except Exception:
                 # A metrics hiccup must never fail the ingest path.
                 log.debug("fetch observer failed", exc_info=True)
+
+    def _reject(self, mode: str, reason: str) -> None:
+        """One rejected payload: rides the fetch counter as parse_error
+        (the transport view) AND the ingest-rejects counter by reason
+        (the corrupt-feed forensics view)."""
+        self._count(mode, "parse_error")
+        if self._observe_reject is not None:
+            try:
+                self._observe_reject(reason)
+            except Exception:
+                log.debug("reject observer failed", exc_info=True)
+
+    def next_poll_delay(self, interval: float) -> float:
+        """Seconds until this feed's next HTTP poll (adaptive cadence).
+
+        Fresh feeds poll at full ``interval``; one that is failing,
+        breaker-open, or serving only stale/dark data spaces out on the
+        jittered backoff — each consultation escalates it, the first
+        fresh page resets it. Darkness is judged by DATA age, so
+        zombie exporters back off exactly like closed ports (and a
+        never-seen target escalates from its very first miss)."""
+        if self.age() <= self.fresh_s:
+            return interval
+        return max(interval, self.poll_backoff.next_delay())
 
     def _note_error(self, message: str) -> None:
         with self._lock:
@@ -378,9 +451,21 @@ class NodeFeed:
                 },
             )
             resp = self._conn.getresponse()
-            body = resp.read()
+            # Bounded read: one byte past the cap proves oversize
+            # without buffering whatever a hostile feed would stream.
+            body = resp.read(self.max_snapshot_bytes + 1)
             if resp.status != 200:
                 raise http.client.HTTPException(f"status {resp.status}")
+            if len(body) > self.max_snapshot_bytes:
+                # Tail left unread on purpose: drop the connection (its
+                # framing is now unusable) and let store_page count the
+                # reject — the caller still records a completed fetch,
+                # which is true: the TRANSPORT worked, the payload is
+                # what's hostile.
+                try:
+                    self._conn.close()
+                finally:
+                    self._conn = None
             return body
         except BaseException:
             # Whatever happened, this connection's framing is suspect.
@@ -451,7 +536,15 @@ class NodeFeed:
         # as the HTTP path.
         request = snapshot_request("snapshot")
         while not self._stop.is_set():
-            channel = grpc.insecure_channel(self.grpc_addr)
+            # Receive cap mirrors the HTTP body cap: a hostile or
+            # corrupt push stream errors out instead of ballooning RSS.
+            channel = grpc.insecure_channel(
+                self.grpc_addr,
+                options=[
+                    ("grpc.max_receive_message_length",
+                     self.max_snapshot_bytes),
+                ],
+            )
             try:
                 call = channel.unary_stream(
                     METHOD_WATCH,

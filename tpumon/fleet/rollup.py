@@ -45,6 +45,20 @@ def classify(age: float, stale_s: float, evict_s: float) -> str:
     return DARK
 
 
+def visibility_of(hosts: dict) -> float:
+    """Fraction of a scope's known hosts contributing FRESH data — the
+    partition-honesty number. Stale hosts still roll up (flagged via
+    tpu_fleet_stale_rollup) but no longer count as visible: during a
+    partition the totals hold flagged-steady while this ratio drops,
+    which is exactly the "flagged-partial, never confidently-wrong"
+    contract. A scope with no known hosts reads 1.0 (nothing is
+    missing from nothing)."""
+    total = sum(hosts.values())
+    if total <= 0:
+        return 1.0
+    return hosts.get(UP, 0) / total
+
+
 class _Agg:
     """One accumulation bucket (a slice, a pool, or the fleet)."""
 
@@ -112,12 +126,16 @@ class _Agg:
             "chips": self.chips,
             "degraded_hosts": self.degraded_hosts,
             "stale": self.hosts[STALE] > 0,
+            "visibility": visibility_of(self.hosts),
         }
         if self.duty_n:
+            # "n" (contributing chips) makes the mean mergeable across
+            # shards (merge_buckets) — weights, not a re-average.
             doc["duty"] = {
                 "mean": self.duty_sum / self.duty_n,
                 "min": self.duty_min,
                 "max": self.duty_max,
+                "n": self.duty_n,
             }
         if self.hbm_total > 0:
             doc["hbm_used"] = self.hbm_used
@@ -131,6 +149,7 @@ class _Agg:
             }
         if self.mfu_n:
             doc["mfu"] = self.mfu_sum / self.mfu_n
+            doc["mfu_n"] = self.mfu_n
         if self.stragglers:
             doc["stragglers"] = dict(self.stragglers)
         if self.straggler_skew_max is not None:
@@ -170,6 +189,75 @@ def rollup(nodes: list[dict]) -> dict:
     }
 
 
+def merge_buckets(buckets: list[dict]) -> dict:
+    """Merge :meth:`_Agg.to_dict` shapes across shards (the cross-shard
+    ``scope="global"`` row): host/chip/HBM/ICI/straggler totals are
+    additive, duty/MFU means merge by their carried ``n`` weights,
+    min/max and stale flags combine the obvious way, and visibility is
+    recomputed from the merged host counts. Pure — peer summaries are
+    plain JSON dicts by the time they reach this."""
+    out = _Agg()
+    duty_missing = mfu_missing = False
+    for bucket in buckets:
+        if not bucket:
+            continue
+        hosts = bucket.get("hosts", {})
+        for state in (UP, STALE, DARK):
+            out.hosts[state] += int(hosts.get(state, 0))
+        out.chips += int(bucket.get("chips", 0))
+        out.degraded_hosts += int(bucket.get("degraded_hosts", 0))
+        duty = bucket.get("duty")
+        if duty and duty.get("n"):
+            n = int(duty["n"])
+            out.duty_sum += float(duty["mean"]) * n
+            out.duty_n += n
+            if duty.get("min") is not None:
+                out.duty_min = (
+                    duty["min"] if out.duty_min is None
+                    else min(out.duty_min, duty["min"])
+                )
+            if duty.get("max") is not None:
+                out.duty_max = (
+                    duty["max"] if out.duty_max is None
+                    else max(out.duty_max, duty["max"])
+                )
+        elif duty:
+            # A pre-failover peer without the "n" weight: its mean
+            # cannot merge honestly — drop duty from the global row
+            # rather than guess a weight.
+            duty_missing = True
+        out.hbm_used += float(bucket.get("hbm_used", 0.0))
+        out.hbm_total += float(bucket.get("hbm_total", 0.0))
+        ici = bucket.get("ici")
+        if ici:
+            out.ici_healthy += int(ici.get("healthy", 0))
+            out.ici_links += int(ici.get("links", 0))
+        if bucket.get("mfu") is not None:
+            n = int(bucket.get("mfu_n", 0))
+            if n:
+                out.mfu_sum += float(bucket["mfu"]) * n
+                out.mfu_n += n
+            else:
+                mfu_missing = True
+        for cause, count in bucket.get("stragglers", {}).items():
+            out.stragglers[cause] = out.stragglers.get(cause, 0) + int(count)
+        skew = bucket.get("straggler_skew_max_pct")
+        if skew is not None and (
+            out.straggler_skew_max is None or skew > out.straggler_skew_max
+        ):
+            out.straggler_skew_max = skew
+    doc = out.to_dict()
+    doc["stale"] = doc["stale"] or any(
+        b.get("stale") for b in buckets if b
+    )
+    if duty_missing:
+        doc.pop("duty", None)
+    if mfu_missing:
+        doc.pop("mfu", None)
+        doc.pop("mfu_n", None)
+    return doc
+
+
 #: (family, help, extra labels beyond scope/pool/slice) — the builder
 #: below and the FLEET_FAMILIES registry (tpumon/families.py) must agree;
 #: the family-drift rule and tests/test_fleet.py hold them together.
@@ -177,12 +265,16 @@ _SCOPED = ("scope", "pool", "slice")
 
 
 def _rows(doc: dict):
-    """Every (labels, bucket) pair: slice rows, pool rows, the fleet row."""
+    """Every (labels, bucket) pair: slice rows, pool rows, the fleet
+    row, and — when cross-shard peer data was merged in — the global
+    row."""
     for (pool, slc), bucket in sorted(doc["slices"].items()):
         yield ("slice", pool, slc), bucket
     for pool, bucket in sorted(doc["pools"].items()):
         yield ("pool", pool, ""), bucket
     yield ("fleet", "", ""), doc["fleet"]
+    if "global" in doc:
+        yield ("global", "", ""), doc["global"]
 
 
 def fleet_families(doc: dict) -> list:
@@ -264,6 +356,14 @@ def fleet_families(doc: dict) -> list:
         "data — stale-flagged beats silently absent.",
         labels=_SCOPED,
     )
+    visibility = GaugeMetricFamily(
+        "tpu_fleet_visibility_ratio",
+        "Fraction of the scope's known hosts contributing FRESH data "
+        "to this rollup — below 1.0 the rollup is PARTIAL (stale "
+        "last-good inclusions, a partition, dead feeds, or a takeover "
+        "in progress), never silently renormalized.",
+        labels=_SCOPED,
+    )
 
     for labels, bucket in _rows(doc):
         for state, n in sorted(bucket["hosts"].items()):
@@ -293,17 +393,20 @@ def fleet_families(doc: dict) -> list:
             )
         degraded.add_metric(labels, float(bucket["degraded_hosts"]))
         stale_flag.add_metric(labels, 1.0 if bucket["stale"] else 0.0)
+        visibility.add_metric(
+            labels, float(bucket.get("visibility", visibility_of(bucket["hosts"])))
+        )
 
     return [
         hosts, chips, duty, hbm_used, hbm_total, headroom,
         ici_links, ici_score, mfu, stragglers, straggler_skew,
-        degraded, stale_flag,
+        degraded, stale_flag, visibility,
     ]
 
 
 def jsonable(doc: dict) -> dict:
     """The /fleet API form of a rollup doc (tuple keys → flat rows)."""
-    return {
+    out = {
         "slices": [
             {"pool": pool, "slice": slc, **bucket}
             for (pool, slc), bucket in sorted(doc["slices"].items())
@@ -314,6 +417,9 @@ def jsonable(doc: dict) -> dict:
         ],
         "fleet": doc["fleet"],
     }
+    if "global" in doc:
+        out["global"] = doc["global"]
+    return out
 
 
 __all__ = [
@@ -323,5 +429,7 @@ __all__ = [
     "classify",
     "fleet_families",
     "jsonable",
+    "merge_buckets",
     "rollup",
+    "visibility_of",
 ]

@@ -36,8 +36,15 @@ from tpumon.exporter.server import ExporterServer, _json_dump, _make_app
 from tpumon.exporter.telemetry import POLL_BUCKETS, SCRAPE_BUCKETS
 from tpumon.fleet.config import FleetConfig
 from tpumon.fleet.ingest import NodeFeed
-from tpumon.fleet.rollup import classify, fleet_families, jsonable, rollup
-from tpumon.fleet.shard import owned_targets
+from tpumon.fleet.rollup import (
+    DARK,
+    classify,
+    fleet_families,
+    jsonable,
+    merge_buckets,
+    rollup,
+    visibility_of,
+)
 
 log = logging.getLogger(__name__)
 
@@ -98,6 +105,56 @@ class FleetTelemetry:
             labelnames=("endpoint", "reason"),
             registry=registry,
         )
+        self.membership_targets = Gauge(
+            "tpu_fleet_membership_targets",
+            "Target universe size by discovery source (static CSV/file "
+            "read once, file re-read live, or k8s Endpoints-derived).",
+            labelnames=("source",),
+            registry=registry,
+        )
+        self.membership_changes = Counter(
+            "tpu_fleet_membership_changes",
+            "Live membership churn applied after debounce, by op "
+            "(add / remove of universe targets).",
+            labelnames=("op",),
+            registry=registry,
+        )
+        self.peer_up = Gauge(
+            "tpu_fleet_peer_up",
+            "Peer aggregator shard liveness from /fleet/summary probes "
+            "(1 answering, 0 past the takeover deadline), by peer "
+            "shard index.",
+            labelnames=("peer",),
+            registry=registry,
+        )
+        self.takeovers = Counter(
+            "tpu_fleet_takeovers",
+            "Orphaned targets this shard adopted because their owning "
+            "peer shard died (rendezvous over the survivors).",
+            registry=registry,
+        )
+        self.ingest_rejects = Counter(
+            "tpu_fleet_ingest_rejects",
+            "Upstream payloads refused before parsing, by reason "
+            "(oversized body, hostile snapshot length prefix, "
+            "undecodable/unparseable page) — a corrupt feed costs a "
+            "counter tick, never aggregator memory.",
+            labelnames=("reason",),
+            registry=registry,
+        )
+        self.spool_restored = Gauge(
+            "tpu_fleet_spool_restored_nodes",
+            "Node snapshots served from the warm-restart spool since "
+            "startup (flagged by ordinary age classification).",
+            registry=registry,
+        )
+        self.spool_errors = Counter(
+            "tpu_fleet_spool_errors",
+            "Warm-restart spool failures by op (load / write); the "
+            "aggregator runs on, cold.",
+            labelnames=("op",),
+            registry=registry,
+        )
 
 
 class FleetAggregator:
@@ -119,20 +176,47 @@ class FleetAggregator:
         def observe_fetch(mode: str, result: str) -> None:
             self.telemetry.fetches.labels(mode=mode, result=result).inc()
 
-        all_targets = cfg.target_list()
-        self.targets = owned_targets(
-            all_targets, cfg.shard_index, cfg.shard_count
-        )
-        self.telemetry.shard_targets.set(float(len(self.targets)))
-        self.feeds = [
-            NodeFeed(
-                target,
-                timeout=cfg.timeout,
-                default_grpc_port=cfg.grpc_port,
-                observe_fetch=observe_fetch,
+        def observe_reject(reason: str) -> None:
+            self.telemetry.ingest_rejects.labels(reason=reason).inc()
+
+        self._observe_fetch = observe_fetch
+        self._observe_reject = observe_reject
+
+        # Warm-restart spool: loaded BEFORE membership so a restarted
+        # shard's first feeds carry last-good snapshots (flagged by
+        # ordinary age classification) and a failed first discovery
+        # resolution can fall back to the journaled universe.
+        self.spool = None
+        self._spool_nodes: dict[str, dict] = {}
+        self._spool_last_save = 0.0
+        #: True while a journal write is in flight (collect thread sets,
+        #: executor worker clears — a bool flip, no lock needed; worst
+        #: case one deferred save).
+        self._spool_saving = False
+        self._restored_count = 0
+        spool_universe: list[str] = []
+        if cfg.spool_dir:
+            from tpumon.fleet.spool import SnapshotSpool
+
+            self.spool = SnapshotSpool(
+                cfg.spool_dir, max_bytes=cfg.spool_max_bytes
             )
-            for target in self.targets
-        ]
+            loaded = self.spool.load()
+            self._spool_nodes = loaded["nodes"]
+            spool_universe = loaded["universe"]
+            if self.spool.last_load_error is not None:
+                self.telemetry.spool_errors.labels(op="load").inc()
+
+        #: Live feeds keyed by target. The dict object is REPLACED
+        #: wholesale on membership change (never mutated in place), so
+        #: the collect loop and poll scheduler read a consistent set by
+        #: grabbing one reference — no reader locking. _apply_lock
+        #: serializes the writers (membership thread + close()).
+        self.feeds: dict[str, NodeFeed] = {}
+        self.targets: list[str] = []
+        self._apply_lock = threading.Lock()
+        self._watching = False  # start_watch() deferred until start()
+
         #: Fan-in budget: at most `concurrency` upstream HTTP fetches in
         #: flight per shard, whatever the fleet size. Deliberately NOT
         #: niced below the serving threads: a demoted thread that holds
@@ -145,6 +229,28 @@ class FleetAggregator:
             max_workers=max(1, cfg.concurrency),
             thread_name_prefix="tpumon-fleet-fetch",
         )
+
+        def observe_event(kind: str, n: int) -> None:
+            if kind == "takeover":
+                self.telemetry.takeovers.inc(n)
+            else:
+                self.telemetry.membership_changes.labels(op=kind).inc(n)
+
+        from tpumon.fleet.failover import MembershipPlane
+
+        #: The membership-and-failover plane: discovery (static / file /
+        #: k8s Endpoints), churn debounce, peer liveness, and rendezvous
+        #: ownership over the SURVIVING shards. Constructing it applies
+        #: the initial membership synchronously (feeds exist before the
+        #: first collect cycle).
+        self.membership = MembershipPlane(
+            cfg,
+            on_membership=self._apply_membership,
+            observe_event=observe_event,
+            initial_universe=spool_universe,
+        )
+        if self.spool is not None:
+            self.telemetry.spool_restored.set(float(self._restored_count))
 
         from tpumon.exporter.collector import SampleCache
 
@@ -239,6 +345,71 @@ class FleetAggregator:
             target=self._poll_scheduler, name="tpumon-fleet-poll", daemon=True
         )
 
+    # -- membership --------------------------------------------------------
+
+    def _apply_membership(self, owned: list[str], info: dict) -> None:
+        """Apply one ownership change from the membership plane: build
+        feeds for adopted targets (seeded from the spool when we have
+        their last-good data), hand back feeds for targets a returning
+        peer reclaimed. Runs on the membership thread (and once,
+        synchronously, during construction)."""
+        cfg = self.cfg
+        with self._apply_lock:
+            current = self.feeds
+            next_feeds: dict[str, NodeFeed] = {}
+            removed: list[NodeFeed] = []
+            for target in owned:
+                feed = current.get(target)
+                if feed is None:
+                    feed = NodeFeed(
+                        target,
+                        timeout=cfg.timeout,
+                        default_grpc_port=cfg.grpc_port,
+                        observe_fetch=self._observe_fetch,
+                        observe_reject=self._observe_reject,
+                        max_snapshot_bytes=cfg.max_snapshot_bytes,
+                        fresh_s=cfg.stale_s,
+                        poll_backoff_base_s=cfg.interval,
+                        poll_backoff_max_s=cfg.poll_backoff_max_s,
+                        # The breaker's open window scales with the
+                        # staleness budget: a node must get its probe
+                        # chance before sitting needlessly stale behind
+                        # a breaker sized for a different tier (the
+                        # adaptive poll backoff owns long-haul spacing).
+                        breaker_open_s=min(
+                            15.0, max(2.0 * cfg.interval, cfg.stale_s / 2.0)
+                        ),
+                    )
+                    spooled = self._spool_nodes.get(target)
+                    if spooled is not None:
+                        feed.restore(spooled["snap"], spooled["fetched_at"])
+                        self._restored_count += 1
+                    if self._watching:
+                        feed.start_watch()
+                next_feeds[target] = feed
+            for target, feed in current.items():
+                if target not in next_feeds:
+                    removed.append(feed)
+            self.feeds = next_feeds
+            self.targets = list(owned)
+            self.telemetry.shard_targets.set(float(len(owned)))
+            if self.spool is not None:
+                self.telemetry.spool_restored.set(
+                    float(self._restored_count)
+                )
+        for feed in removed:
+            # Outside the apply lock: stop() joins the watch thread.
+            try:
+                feed.stop()
+            except Exception:
+                log.exception("feed stop failed for %s", feed.target)
+        if not info.get("first"):
+            log.info(
+                "membership applied: %d owned (+%d/-%d), alive shards %s",
+                len(owned), len(info.get("added", ())),
+                len(info.get("removed", ())), info.get("alive"),
+            )
+
     # -- serving -----------------------------------------------------------
 
     @property
@@ -246,24 +417,51 @@ class FleetAggregator:
         return self.server.url
 
     def _with_fleet_endpoint(self, inner):
-        """The /fleet JSON API in front of the shared exporter app."""
+        """The /fleet JSON API (plus the tiny /fleet/summary peers
+        probe) in front of the shared exporter app. /fleet/summary is
+        DELIBERATELY outside the guard's endpoint classes, like the
+        health probes: shedding peer probes under load would read as
+        shard death and trigger spurious takeovers."""
 
         def app(environ, start_response):
-            if environ.get("PATH_INFO", "/") == "/fleet":
+            path = environ.get("PATH_INFO", "/")
+            if path == "/fleet":
                 with self._doc_lock:
                     doc = self._fleet_doc
                 body = _json_dump(doc)
-                start_response(
-                    "200 OK",
-                    [
-                        ("Content-Type", "application/json; charset=utf-8"),
-                        ("Content-Length", str(len(body))),
-                    ],
-                )
-                return [body]
-            return inner(environ, start_response)
+            elif path == "/fleet/summary":
+                body = _json_dump(self._summary_doc())
+            else:
+                return inner(environ, start_response)
+            start_response(
+                "200 OK",
+                [
+                    ("Content-Type", "application/json; charset=utf-8"),
+                    ("Content-Length", str(len(body))),
+                ],
+            )
+            return [body]
 
         return app
+
+    def _summary_doc(self) -> dict:
+        """What a peer shard needs from us, in a few hundred bytes:
+        liveness (the 200 itself), our fleet-scope bucket for its
+        scope="global" merge, and our cycle/identity counters."""
+        with self._doc_lock:
+            doc = self._fleet_doc
+            cycles = self._cycles
+        return {
+            "shard": doc.get("shard", {
+                "index": self.cfg.shard_index,
+                "count": self.cfg.shard_count,
+                "targets": len(self.targets),
+            }),
+            "now": doc.get("now", 0.0),
+            "cycles": cycles,
+            "fleet": doc.get("fleet", {}),
+            "universe": len(self.membership.universe()),
+        }
 
     def _health(self) -> tuple[bool, str]:
         with self._doc_lock:
@@ -297,8 +495,16 @@ class FleetAggregator:
             },
             "cycles": cycles,
             "nodes": nodes,
+            "membership": self.membership.snapshot(),
             "cache_version": self.cache.rendered_with_version()[1],
         }
+        if self.spool is not None:
+            doc["spool"] = {
+                "path": self.spool.path,
+                "restored_nodes": self._restored_count,
+                "last_write_ts": self.spool.last_write_ts,
+                "dropped_last_save": self.spool.dropped_last_save,
+            }
         if self.guard is not None:
             doc["guard"] = {"ingress": self.guard.snapshot()}
         if self.tracer is not None:
@@ -325,40 +531,53 @@ class FleetAggregator:
             return doc
 
     def _poll_scheduler(self) -> None:
-        """Phase-spread HTTP polling: each feed polls once per interval
-        at a stable per-target phase offset, so a 64-node shard issues
-        ~one fetch every interval/64 instead of a 64-fetch thundering
-        herd at every tick (measured: the herd put a ~250 ms pile-up
-        tail on the aggregator's own scrape p99; spread, the parse load
-        is a steady trickle). Watch-fed feeds are skipped while their
-        stream delivers — polling is the fallback, not a duplicate."""
+        """Phase-spread, ADAPTIVE HTTP polling: each feed polls at a
+        stable per-target phase offset, so a 64-node shard issues ~one
+        fetch every interval/64 instead of a 64-fetch thundering herd
+        at every tick (measured: the herd put a ~250 ms pile-up tail on
+        the aggregator's own scrape p99; spread, the parse load is a
+        steady trickle). Watch-fed feeds are skipped while their stream
+        delivers — polling is the fallback, not a duplicate.
+
+        Cadence is per-feed (``NodeFeed.next_poll_delay``): fresh feeds
+        re-poll at the full interval, stale/dark/failing ones space out
+        on a jittered backoff capped at TPUMON_FLEET_POLL_BACKOFF_MAX_S,
+        and the first fresh page restores full cadence — so a dead
+        slice costs its shard a trickle, and a 1000-node mass return
+        recovers jitter-spread instead of as a poll storm. Membership
+        changes land between rounds: adopted targets get a fresh phase,
+        departed ones just fall out of the schedule."""
         import hashlib
 
         interval = self.cfg.interval
-        next_at: dict[int, float] = {}
-        base = time.monotonic()
-        for i, feed in enumerate(self.feeds):
-            digest = hashlib.md5(feed.target.encode()).digest()
-            phase = int.from_bytes(digest[:4], "big") / 2**32
-            next_at[i] = base + phase * interval
+        next_at: dict[str, float] = {}
         while not self._stop.is_set():
-            if not next_at:
-                if self._stop.wait(interval):
-                    return
-                continue
+            feeds = self.feeds  # one consistent membership snapshot
             now = time.monotonic()
-            for i, due in next_at.items():
+            for target, feed in feeds.items():
+                due = next_at.get(target)
+                if due is None:
+                    digest = hashlib.md5(target.encode()).digest()
+                    phase = int.from_bytes(digest[:4], "big") / 2**32
+                    next_at[target] = now + phase * interval
+                    continue
                 if due > now:
                     continue
-                feed = self.feeds[i]
                 if (
                     feed.watch_state_now() != "streaming"
                     or feed.age() > self.cfg.stale_s
                 ):
                     self._executor.submit(feed.poll)
-                while next_at[i] <= now:
-                    next_at[i] += interval
-            sleep = max(0.005, min(next_at.values()) - time.monotonic())
+                    next_at[target] = now + feed.next_poll_delay(interval)
+                else:
+                    # Streaming and fresh: check back next interval.
+                    next_at[target] = now + interval
+            for target in list(next_at):
+                if target not in feeds:
+                    del next_at[target]
+            sleep = interval
+            if next_at:
+                sleep = max(0.005, min(next_at.values()) - time.monotonic())
             if self._stop.wait(min(sleep, interval)):
                 return
 
@@ -367,14 +586,15 @@ class FleetAggregator:
 
         t0 = time.monotonic()
         now = time.time()
+        feeds = list(self.feeds.values())  # one membership snapshot
         with trace_span("ingest_schedule"):
             watch_states = {"streaming": 0, "down": 0, "off": 0}
-            for feed in self.feeds:
+            for feed in feeds:
                 state = feed.watch_state_now()
                 watch_states[state] = watch_states.get(state, 0) + 1
         with trace_span("rollup"):
             nodes = []
-            for feed in self.feeds:
+            for feed in feeds:
                 snap, fetched_at, error = feed.current()
                 age = (
                     float("inf") if fetched_at == 0.0
@@ -392,6 +612,8 @@ class FleetAggregator:
                     }
                 )
             doc = rollup(nodes)
+            membership = self.membership.snapshot()
+            self._merge_peers(doc, membership)
             families = fleet_families(doc)
         if self.history is not None:
             with trace_span("history_record"):
@@ -408,6 +630,7 @@ class FleetAggregator:
                 "count": self.cfg.shard_count,
                 "targets": len(self.targets),
             },
+            "membership": membership,
             **jsonable(doc),
             "nodes": nodes,
         }
@@ -419,8 +642,84 @@ class FleetAggregator:
         t.up.set(1.0)
         for state, n in watch_states.items():
             t.watch_streams.labels(state=state).set(float(n))
+        t.membership_targets.labels(source=membership["source"]).set(
+            float(membership["universe"])
+        )
+        for index, peer in membership.get("peers", {}).items():
+            t.peer_up.labels(peer=str(index)).set(
+                1.0 if peer["alive"] else 0.0
+            )
+        self._maybe_spool(now, nodes)
         self._selfpage.refresh()
         return fleet_doc
+
+    def _merge_peers(self, doc: dict, membership: dict) -> None:
+        """Attach the cross-shard ``scope="global"`` bucket: this
+        shard's fleet totals merged with every ALIVE peer's last
+        /fleet/summary, with universe targets nobody currently reports
+        counted DARK — so the global row reads partial (visibility < 1)
+        during a peer outage or a takeover in progress, never
+        silently smaller."""
+        if self.membership.watcher is None:
+            return
+        peer_docs = self.membership.peer_summaries()
+        buckets = [doc["fleet"]]
+        for summary in peer_docs.values():
+            fleet = summary.get("fleet")
+            if isinstance(fleet, dict):
+                buckets.append(fleet)
+        merged = merge_buckets(buckets)
+        universe_n = membership["universe"]
+        seen = sum(merged["hosts"].values())
+        if universe_n > seen:
+            merged["hosts"][DARK] += universe_n - seen
+            merged["visibility"] = visibility_of(merged["hosts"])
+        elif seen > universe_n:
+            # More hosts reported than the universe holds: a takeover /
+            # hand-back window where two shards briefly own the same
+            # targets (asymmetric partition, or a returning peer
+            # re-claiming before we relinquish). The overlap is counted
+            # twice in these totals for up to a probe round — FLAG it
+            # (contested + stale) rather than renormalize; the flag is
+            # the honesty, the window is self-healing.
+            merged["contested"] = seen - universe_n
+            merged["stale"] = True
+        merged["shards_alive"] = len(membership["alive_shards"])
+        merged["shards"] = self.cfg.shard_count
+        doc["global"] = merged
+
+    def _maybe_spool(self, now: float, nodes: list[dict]) -> None:
+        """Journal last-good snapshots on the spool cadence (off the
+        collect thread — the executor absorbs the serialize+fsync).
+        One save in flight at a time: overlapping saves could land
+        their os.replace out of order and regress the journal to older
+        data (SnapshotSpool is single-writer by contract). A save still
+        running at the next cadence tick just defers it — the retry
+        happens on the following cycle."""
+        if self.spool is None or now - self._spool_last_save < self.cfg.spool_every_s:
+            return
+        if self._spool_saving:
+            return  # last save still running; cadence clock not reset
+        self._spool_saving = True
+        self._spool_last_save = now
+        universe = self.membership.universe()
+        entries = {
+            n["target"]: {"snap": n["snap"], "fetched_at": now - n["age_s"]}
+            for n in nodes
+            if n["snap"] is not None and n["age_s"] is not None
+        }
+
+        def save() -> None:
+            try:
+                if not self.spool.save(universe, entries):
+                    self.telemetry.spool_errors.labels(op="write").inc()
+            except Exception:
+                log.exception("fleet spool save failed")
+                self.telemetry.spool_errors.labels(op="write").inc()
+            finally:
+                self._spool_saving = False
+
+        self._executor.submit(save)
 
     def _run(self) -> None:
         interval = self.cfg.interval
@@ -449,9 +748,13 @@ class FleetAggregator:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
-        for feed in self.feeds:
+        with self._apply_lock:
+            self._watching = True
+            feeds = list(self.feeds.values())
+        for feed in feeds:
             feed.start_watch()
         self.collect_once()  # prime: the first scrape is never empty
+        self.membership.start()
         self._poll_thread.start()
         self._thread.start()
         self.server.start()
@@ -463,14 +766,35 @@ class FleetAggregator:
 
     def close(self) -> None:
         self._stop.set()
+        self.membership.stop()
         if self._thread.is_alive():
             self._thread.join(timeout=5.0)
         if self._poll_thread.is_alive():
             self._poll_thread.join(timeout=5.0)
         self.server.close()
-        for feed in self.feeds:
+        for feed in self.feeds.values():
             feed.stop()
-        self._executor.shutdown(wait=False)
+        # cancel_futures: drain only IN-FLIGHT work. A backlog of queued
+        # dark-feed polls (each worth a fetch timeout) must not push
+        # shutdown past the pod's termination grace — being SIGKILLed
+        # mid-close would skip the final journal below and defeat the
+        # warm restart it exists for.
+        self._executor.shutdown(wait=True, cancel_futures=True)
+        if self.spool is not None:
+            # Final journal so the restart picks up the freshest
+            # last-good state (executor already drained above).
+            now = time.time()
+            entries = {}
+            for target, feed in self.feeds.items():
+                snap, fetched_at, _error = feed.current()
+                if snap is not None and fetched_at > 0.0:
+                    entries[target] = {
+                        "snap": snap, "fetched_at": fetched_at,
+                    }
+            try:
+                self.spool.save(self.membership.universe(), entries)
+            except Exception:
+                log.exception("final fleet spool save failed")
         self._selfpage.close()
 
 

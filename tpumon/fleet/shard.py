@@ -39,4 +39,40 @@ def owned_targets(
     return [t for t in targets if shard_of(t, shard_count) == shard_index]
 
 
-__all__ = ["owned_targets", "shard_of"]
+def shard_of_among(target: str, alive: tuple[int, ...]) -> int:
+    """The shard index that owns ``target`` among the ``alive`` subset
+    of the configured shard set (failover reassignment)."""
+    if len(alive) == 1:
+        return alive[0]
+    return max(alive, key=lambda i: _weight(i, target))
+
+
+def owned_targets_among(
+    targets: list[str],
+    shard_index: int,
+    alive: set[int] | frozenset[int],
+    shard_count: int,
+) -> list[str]:
+    """The subset of ``targets`` this shard owns when only the ``alive``
+    shards participate in the rendezvous — the failover form.
+
+    HRW over a SUBSET keeps the minimal-movement property in the
+    direction that matters here: removing a dead shard j moves ONLY j's
+    targets (each to its next-highest-weight surviving shard) — every
+    target whose winner is still alive keeps its owner, so a takeover
+    never re-deals the whole fleet's feeds. When the full set is alive
+    this is exactly :func:`owned_targets`.
+    """
+    live = tuple(sorted(set(alive) & set(range(shard_count))))
+    if not live:
+        # A shard that believes everyone (itself included) is dead is
+        # confused, not empty: own your static assignment.
+        return owned_targets(targets, shard_index, shard_count)
+    if shard_index not in live:
+        return []
+    if len(live) == shard_count:
+        return owned_targets(targets, shard_index, shard_count)
+    return [t for t in targets if shard_of_among(t, live) == shard_index]
+
+
+__all__ = ["owned_targets", "owned_targets_among", "shard_of", "shard_of_among"]

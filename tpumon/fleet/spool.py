@@ -1,0 +1,182 @@
+"""Warm-restart spool: last-good node snapshots on disk.
+
+A restarted or rescheduled aggregator used to come up BLIND: every feed
+empty, every rollup absent until the first full fan-in round — on a
+1000-node shard with adaptive cadence that is a real visibility gap,
+and exactly the window a crash-looping aggregator spends all its time
+in. The spool closes it: the collect loop journals each feed's
+last-good snapshot (plus the target universe — the rollup's identity)
+to one bounded JSON file, and a fresh aggregator loads it before its
+first cycle, serving STALE-FLAGGED last-good rollups within one fan-in
+cycle of startup. Honesty is preserved by construction: restored
+snapshots keep their original data timestamps, so the ordinary
+age-classification (up/stale/dark) flags them for exactly as long as
+they deserve.
+
+Write discipline (the journald/prometheus-WAL genre, scaled way down):
+
+- **atomic** — temp file in the same directory + ``os.replace``; a
+  crash mid-write leaves the previous spool intact, never a torn one.
+- **versioned** — a format byte in the document; an unknown version
+  loads as empty instead of exploding on a downgrade.
+- **bounded** — serialized size capped at ``max_bytes``; the OLDEST
+  node entries drop first (they were closest to dark anyway).
+- **corrupt-tolerant** — any load failure (truncation, garbage, bad
+  JSON shapes) quarantines the file aside as ``.corrupt`` and returns
+  empty: a bad spool costs the warm start, never the process.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import time
+
+log = logging.getLogger(__name__)
+
+SPOOL_VERSION = 1
+SPOOL_NAME = "fleet-spool.json"
+
+
+class SnapshotSpool:
+    """One shard's on-disk last-good journal. Single-writer (the
+    collect loop / its executor serializes saves through one submit at
+    a time); loads happen before the writer starts."""
+
+    def __init__(
+        self, directory: str, max_bytes: int = 16777216, clock=time.time
+    ) -> None:
+        self.directory = directory
+        self.path = os.path.join(directory, SPOOL_NAME)
+        self.max_bytes = max(4096, int(max_bytes))
+        self._clock = clock
+        self.last_write_ts = 0.0
+        self.dropped_last_save = 0
+        #: Set by :meth:`load`: why the last load came back empty-handed
+        #: (None = clean load or a simply-absent file). The caller's
+        #: error counter keys off THIS, never off quarantine files left
+        #: on disk by earlier incarnations.
+        self.last_load_error: str | None = None
+
+    # -- write -------------------------------------------------------------
+
+    def save(self, universe: list[str], nodes: dict[str, dict]) -> bool:
+        """Journal ``{target: {"snap":..., "fetched_at":...}}`` plus the
+        universe. Returns False (and logs) on any failure — a full disk
+        degrades warm restart, never the aggregator."""
+        doc = {
+            "version": SPOOL_VERSION,
+            "saved_at": self._clock(),
+            "universe": list(universe),
+            "nodes": dict(nodes),
+        }
+        try:
+            body, self.dropped_last_save = self._bounded(doc)
+            os.makedirs(self.directory, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(
+                dir=self.directory, prefix=".spool-", suffix=".tmp"
+            )
+            try:
+                with os.fdopen(fd, "wb") as fh:
+                    fh.write(body)
+                os.replace(tmp, self.path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    log.debug("spool temp cleanup failed", exc_info=True)
+                raise
+            self.last_write_ts = doc["saved_at"]
+            return True
+        except (OSError, TypeError, ValueError) as exc:
+            log.warning("fleet spool write failed: %s", exc)
+            return False
+
+    def _bounded(self, doc: dict) -> tuple[bytes, int]:
+        """Serialize under ``max_bytes``, dropping oldest nodes first."""
+        body = json.dumps(doc, sort_keys=True).encode()
+        dropped = 0
+        while len(body) > self.max_bytes and doc["nodes"]:
+            by_age = sorted(
+                doc["nodes"],
+                key=lambda t: doc["nodes"][t].get("fetched_at", 0.0),
+            )
+            # Drop in batches proportional to the overshoot so a very
+            # over-budget spool doesn't re-serialize per entry.
+            overshoot = len(body) / self.max_bytes
+            batch = max(1, int(len(doc["nodes"]) * (1.0 - 1.0 / overshoot)))
+            for target in by_age[:batch]:
+                del doc["nodes"][target]
+                dropped += 1
+            body = json.dumps(doc, sort_keys=True).encode()
+        if dropped:
+            log.warning(
+                "fleet spool over %d bytes: dropped %d oldest node "
+                "entries", self.max_bytes, dropped,
+            )
+        return body, dropped
+
+    # -- read --------------------------------------------------------------
+
+    def load(self) -> dict:
+        """The journaled state: ``{"universe": [...], "nodes": {target:
+        {"snap":..., "fetched_at":...}}, "saved_at": ts}`` — empty on
+        absence, corruption, or version mismatch (quarantined aside)."""
+        empty = {"universe": [], "nodes": {}, "saved_at": 0.0}
+        self.last_load_error = None
+        try:
+            with open(self.path, "rb") as fh:
+                raw = fh.read(self.max_bytes + 1)
+        except FileNotFoundError:
+            return empty  # cold start, not an error
+        except OSError as exc:
+            log.warning("fleet spool unreadable: %s", exc)
+            self.last_load_error = str(exc)
+            return empty
+        try:
+            if len(raw) > self.max_bytes:
+                raise ValueError("spool exceeds max_bytes")
+            doc = json.loads(raw.decode())
+            if not isinstance(doc, dict):
+                raise ValueError("spool root is not an object")
+            if doc.get("version") != SPOOL_VERSION:
+                log.warning(
+                    "fleet spool version %r != %d; ignoring",
+                    doc.get("version"), SPOOL_VERSION,
+                )
+                return empty
+            universe = doc.get("universe")
+            nodes = doc.get("nodes")
+            if not isinstance(universe, list) or not isinstance(nodes, dict):
+                raise ValueError("spool fields have wrong shapes")
+            out_nodes: dict[str, dict] = {}
+            for target, entry in nodes.items():
+                if (
+                    isinstance(target, str)
+                    and isinstance(entry, dict)
+                    and isinstance(entry.get("snap"), dict)
+                    and isinstance(entry.get("fetched_at"), (int, float))
+                ):
+                    out_nodes[target] = entry
+            return {
+                "universe": [t for t in universe if isinstance(t, str)],
+                "nodes": out_nodes,
+                "saved_at": float(doc.get("saved_at") or 0.0),
+            }
+        except (ValueError, UnicodeDecodeError) as exc:
+            quarantine = self.path + ".corrupt"
+            log.warning(
+                "fleet spool corrupt (%s); quarantining to %s",
+                exc, quarantine,
+            )
+            self.last_load_error = str(exc)
+            try:
+                os.replace(self.path, quarantine)
+            except OSError:
+                log.debug("spool quarantine failed", exc_info=True)
+            return empty
+
+
+__all__ = ["SnapshotSpool", "SPOOL_NAME", "SPOOL_VERSION"]

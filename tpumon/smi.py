@@ -452,8 +452,25 @@ def fetch_fleet_snapshots(
 
 
 def aggregator_snapshot(url: str, timeout: float) -> dict:
-    """One /fleet document from a running fleet aggregator (tpumon/fleet)."""
-    doc = json.loads(_fetch(url.rstrip("/") + "/fleet", timeout))
+    """One /fleet document from a running fleet aggregator (tpumon/fleet).
+
+    Transient connection errors (an aggregator pod rolling, one dropped
+    keep-alive) retry on a bounded jittered backoff instead of blanking
+    a ``--watch`` frame or killing a one-shot invocation: three tries
+    over at most ~2 s, then the error propagates to the caller's
+    ordinary handling (the watch loop renders it and keeps watching).
+    """
+    from tpumon.resilience import RetryPolicy, retry_call
+
+    policy = RetryPolicy(
+        attempts=3, base_s=0.2, max_s=1.0, deadline_s=max(2.0, timeout)
+    )
+    body = retry_call(
+        lambda: _fetch(url.rstrip("/") + "/fleet", timeout),
+        policy,
+        retryable=FETCH_ERRORS,
+    )
+    doc = json.loads(body)
     return {"aggregator": doc, "aggregator_url": url, "ts": time.time()}
 
 
@@ -484,13 +501,35 @@ def render_aggregator(snap: dict, out=None) -> None:
     shard = doc.get("shard", {})
     fleet = doc.get("fleet", {})
     hosts = fleet.get("hosts", {})
+    visibility = fleet.get("visibility")
+    partial = (
+        f", visibility {visibility:.0%} PARTIAL"
+        if visibility is not None and visibility < 1.0
+        else ""
+    )
     p(
         f"aggregator {snap.get('aggregator_url', '?')} "
         f"[shard {shard.get('index', 0)}/{shard.get('count', 1)}, "
         f"{shard.get('targets', len(snaps))} targets]: "
         f"{hosts.get('up', 0)} up / {hosts.get('stale', 0)} stale / "
         f"{hosts.get('dark', 0)} dark, {fleet.get('chips', 0)} chips"
+        + partial
     )
+    glob = doc.get("global")
+    if glob:
+        ghosts = glob.get("hosts", {})
+        gvis = glob.get("visibility")
+        p(
+            f"  global [{glob.get('shards_alive', '?')}/"
+            f"{glob.get('shards', '?')} shards alive]: "
+            f"{ghosts.get('up', 0)} up / {ghosts.get('stale', 0)} stale / "
+            f"{ghosts.get('dark', 0)} dark, {glob.get('chips', 0)} chips"
+            + (
+                f", visibility {gvis:.0%} PARTIAL"
+                if gvis is not None and gvis < 1.0
+                else ""
+            )
+        )
     for row in doc.get("slices", ()):
         parts = [f"{row.get('chips', 0)} chips"]
         duty = row.get("duty")

@@ -13,11 +13,26 @@ does exactly the work it would against real nodes — N fetches/s, N
 parses/s, full rollup hierarchy — while the simulation costs a few
 percent of one core.
 
-Node death is scriptable over stdin (``kill N``): half the victims
-CLOSE their listeners (connection-refused path), half FREEZE — the
-listener keeps answering but the page (and its poll timestamp) stops
-advancing, the zombie-exporter shape the tier's data-age staleness
-exists to catch.
+Fault scripting over stdin (the fleet-chaos vocabulary,
+``soak.py --fleet-chaos``):
+
+- ``kill N`` — permanent node death: half the victims CLOSE their
+  listeners (connection-refused path), half FREEZE — the listener keeps
+  answering but the page (and its poll timestamp) stops advancing, the
+  zombie-exporter shape the tier's data-age staleness exists to catch.
+- ``partition N`` — network partition: connections are accepted then
+  dropped without a byte while the victims' pages KEEP advancing (the
+  nodes are healthy, the path isn't); ``heal`` restores them with fresh
+  data — the mass-return shape adaptive cadence must absorb storm-free.
+- ``slow N MS`` — the victims answer after an MS-millisecond stall
+  (congested path / overloaded node; exercises fetch deadlines).
+- ``corrupt N`` — the victims alternate hostile payloads: a snapshot
+  frame whose varint length prefix claims a terabyte (the
+  pre-allocation reject path) and undecodable binary garbage.
+- ``flap N`` — membership flapping: the victims toggle between
+  partitioned and healthy on every page tick (the churn-debounce and
+  breaker-thrash shape).
+- ``heal`` — clear partition/slow/corrupt/flap (killed nodes stay dead).
 
 Run standalone:
     python -m tpumon.tools.fleetsim --nodes 64
@@ -34,6 +49,19 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 #: Nodes per simulated slice (8 hosts ≈ a v4-64 pod's host count).
 SLICE_SIZE = 8
+
+
+def _corrupt_payload(serial: int) -> bytes:
+    """Alternating hostile payloads for ``corrupt`` nodes: a snapshot
+    frame whose length prefix claims ~1 TB (the aggregator must reject
+    it BEFORE allocating — tpu_fleet_ingest_rejects_total{bad_frame})
+    and undecodable binary garbage (…{undecodable})."""
+    from tpumon.backends.reflection import _encode_varint
+    from tpumon.exporter.encodings import SNAPSHOT_MAGIC
+
+    if serial % 2 == 0:
+        return SNAPSHOT_MAGIC + _encode_varint(1 << 40) + b"\x00" * 64
+    return b"\xff\xfe" * 128
 
 
 class FleetSim:
@@ -58,6 +86,12 @@ class FleetSim:
         self._lock = threading.Lock()
         self._pages: list[bytes] = [b""] * nodes  # guarded-by: self._lock
         self._frozen: set[int] = set()  # guarded-by: self._lock
+        self._partitioned: set[int] = set()  # guarded-by: self._lock
+        self._slow: dict[int, float] = {}  # guarded-by: self._lock
+        self._corrupt: set[int] = set()  # guarded-by: self._lock
+        self._flap: set[int] = set()  # guarded-by: self._lock
+        self._flap_phase = False  # guarded-by: self._lock
+        self._corrupt_serial = 0  # guarded-by: self._lock
         self._stop = threading.Event()
         self.tick()  # pages exist before the first request can land
 
@@ -71,8 +105,25 @@ class FleetSim:
                 if self.path != "/metrics":
                     self.send_error(404)
                     return
+                i = self.node_index
                 with sim._lock:
-                    body = sim._pages[self.node_index]
+                    body = sim._pages[i]
+                    partitioned = i in sim._partitioned
+                    delay = sim._slow.get(i, 0.0)
+                    corrupt = i in sim._corrupt
+                    if corrupt:
+                        sim._corrupt_serial += 1
+                        serial = sim._corrupt_serial
+                if partitioned:
+                    # Accepted, then dropped without a byte: the client
+                    # sees a torn read, not a refused connect — the
+                    # half-open shape a real partition produces.
+                    self.close_connection = True
+                    return
+                if delay:
+                    time.sleep(delay)
+                if corrupt:
+                    body = _corrupt_payload(serial)
                 self.send_response(200)
                 self.send_header(
                     "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
@@ -130,6 +181,14 @@ class FleetSim:
         with self._lock:
             for i, body in pages.items():
                 self._pages[i] = body
+            if self._flap:
+                # Membership flapping: flap nodes toggle between
+                # partitioned and healthy every page tick.
+                self._flap_phase = not self._flap_phase
+                if self._flap_phase:
+                    self._partitioned |= self._flap
+                else:
+                    self._partitioned -= self._flap
 
     def _run(self) -> None:
         while not self._stop.wait(self.node_interval):
@@ -162,6 +221,57 @@ class FleetSim:
                 out.append(f"closed node-{i} (listener down, page frozen)")
         return out
 
+    def _live(self) -> list[int]:
+        with self._lock:
+            return [i for i in range(self.nodes) if i not in self._frozen]
+
+    def partition(self, n: int) -> list[str]:
+        """Partition the first ``n`` live nodes: connections accepted
+        then dropped, pages still advancing (healthy node, dead path)."""
+        victims = self._live()[:n]
+        with self._lock:
+            self._partitioned.update(victims)
+        return [f"partitioned node-{i}" for i in victims]
+
+    def slow(self, n: int, delay_s: float) -> list[str]:
+        """The first ``n`` live nodes answer after ``delay_s``."""
+        victims = self._live()[:n]
+        with self._lock:
+            for i in victims:
+                self._slow[i] = delay_s
+        return [f"slowed node-{i} to {delay_s:g}s" for i in victims]
+
+    def corrupt(self, n: int) -> list[str]:
+        """The LAST ``n`` live nodes serve hostile payloads (from the
+        tail so a script composing partition+corrupt hits disjoint
+        victims — a breaker opened by the partition would otherwise
+        shield the corrupt page from ever being fetched)."""
+        victims = self._live()[-n:] if n > 0 else []  # [-0:] is EVERYTHING
+        with self._lock:
+            self._corrupt.update(victims)
+        return [f"corrupting node-{i}" for i in victims]
+
+    def flap(self, n: int) -> list[str]:
+        """The first ``n`` live nodes toggle partitioned/healthy on
+        every page tick (flapping membership)."""
+        victims = self._live()[:n]
+        with self._lock:
+            self._flap.update(victims)
+        return [f"flapping node-{i}" for i in victims]
+
+    def heal(self) -> list[str]:
+        """Clear every recoverable fault (killed nodes stay dead)."""
+        with self._lock:
+            cleared = (
+                len(self._partitioned) + len(self._slow)
+                + len(self._corrupt) + len(self._flap)
+            )
+            self._partitioned.clear()
+            self._slow.clear()
+            self._corrupt.clear()
+            self._flap.clear()
+        return [f"healed {cleared} fault(s)"]
+
     def close(self) -> None:
         self._stop.set()
         for server in self._servers:
@@ -185,15 +295,34 @@ def main(argv=None) -> int:
     )
     print("PORTS " + " ".join(str(p) for p in sim.ports), flush=True)
     try:
-        for line in sys.stdin:  # control protocol: "kill N" / "quit"
+        # Control protocol: "kill N" / "partition N" / "slow N MS" /
+        # "corrupt N" / "flap N" / "heal" / "quit".
+        for line in sys.stdin:
             parts = line.split()
             if not parts:
                 continue
-            if parts[0] == "quit":
+            cmd = parts[0]
+            if cmd == "quit":
                 break
-            if parts[0] == "kill" and len(parts) == 2:
-                for desc in sim.kill(int(parts[1])):
-                    print(desc, flush=True)
+            try:
+                if cmd == "kill" and len(parts) == 2:
+                    out = sim.kill(int(parts[1]))
+                elif cmd == "partition" and len(parts) == 2:
+                    out = sim.partition(int(parts[1]))
+                elif cmd == "slow" and len(parts) == 3:
+                    out = sim.slow(int(parts[1]), float(parts[2]) / 1e3)
+                elif cmd == "corrupt" and len(parts) == 2:
+                    out = sim.corrupt(int(parts[1]))
+                elif cmd == "flap" and len(parts) == 2:
+                    out = sim.flap(int(parts[1]))
+                elif cmd == "heal" and len(parts) == 1:
+                    out = sim.heal()
+                else:
+                    out = [f"unknown command: {line.strip()}"]
+            except ValueError as exc:
+                out = [f"bad arguments ({exc}): {line.strip()}"]
+            for desc in out:
+                print(desc, flush=True)
     except KeyboardInterrupt:
         pass
     finally:

@@ -769,6 +769,445 @@ def fleet_soak(
     }
 
 
+def _free_port() -> int:
+    """An ephemeral port the OS just handed out (racy by nature, fine
+    for a soak: the fleet-chaos shards need KNOWN ports up front so the
+    peers CSV and the restart can name them)."""
+    import socket
+
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+def _page_stats(body: bytes) -> dict:
+    """The fleet-scope honesty numbers off one /metrics page."""
+    def g(name: str, labels: bytes) -> float | None:
+        m = re.search(
+            rb"^" + name.encode() + rb"\{" + labels + rb"\} (\S+)",
+            body, re.M,
+        )
+        return float(m.group(1)) if m else None
+
+    fleet = rb'pool="",scope="fleet",slice=""'
+    out = {
+        "up": g("tpu_fleet_hosts", fleet + rb',state="up"'),
+        "stale": g("tpu_fleet_hosts", fleet + rb',state="stale"'),
+        "dark": g("tpu_fleet_hosts", fleet + rb',state="dark"'),
+        "visibility": g("tpu_fleet_visibility_ratio", fleet),
+        "stale_flag": g("tpu_fleet_stale_rollup", fleet),
+    }
+    m = re.search(rb"^tpu_fleet_shard_targets (\S+)", body, re.M)
+    out["targets"] = float(m.group(1)) if m else None
+    m = re.search(
+        rb'^tpu_fleet_visibility_ratio\{pool="",scope="global",slice=""\} (\S+)',
+        body, re.M,
+    )
+    out["global_visibility"] = float(m.group(1)) if m else None
+    return out
+
+
+def _reject_counts(body: bytes) -> dict[str, float]:
+    return {
+        reason.decode(): float(value)
+        for reason, value in re.findall(
+            rb'^tpu_fleet_ingest_rejects_total\{reason="([^"]+)"\} (\S+)',
+            body, re.M,
+        )
+    }
+
+
+def fleet_chaos_soak(
+    duration_s: float,
+    nodes: int = 12,
+    topology: str = "v4-8",
+    interval: float = 0.5,
+    scrape_every_s: float = 0.5,
+    takeover_s: float | None = None,
+) -> dict:
+    """Fleet fault-tolerance acceptance evidence (ISSUE 9): two
+    aggregator shards (peer-probing each other, warm-restart spools on)
+    over a scripted tools/fleetsim.py fleet, driven through the full
+    fault vocabulary:
+
+    - **partition** a quarter of the nodes → the owning shards'
+      ``tpu_fleet_visibility_ratio`` must drop and rollups must flag
+      stale/partial (honesty: no scrape may report missing hosts at
+      full visibility with no stale flag); **heal** → full cadence and
+      visibility restored (recovery latency recorded — adaptive
+      backoff's storm-free mass return).
+    - **corrupt** two nodes (hostile varint length prefix + binary
+      garbage) → ``tpu_fleet_ingest_rejects_total`` ticks, both shards
+      keep serving.
+    - **kill shard 1** → shard 0 must adopt the orphaned targets within
+      two takeover windows (latency recorded), with
+      ``tpu_fleet_takeovers_total`` counting the adoption and shard 0's
+      original targets untouched (minimal movement).
+    - **restart shard 0** (same port, same spool dir) → its first
+      serving cycle must already cover its targets from journaled
+      last-good snapshots (restored count + time-to-first-scrape
+      recorded).
+    """
+    import tempfile
+
+    from tpumon.fleet.config import FleetConfig
+    from tpumon.fleet.server import build_aggregator
+
+    if duration_s <= 0:
+        raise ValueError(f"duration must be > 0 seconds, got {duration_s}")
+    if duration_s < 40 * interval:
+        raise ValueError(
+            f"--duration {duration_s:g} is too short for the fleet-chaos "
+            f"script at --interval {interval:g} (need > 40*interval: the "
+            "partition/kill/restart windows each span several collect "
+            "cycles)"
+        )
+    if takeover_s is None:
+        takeover_s = max(2.0, 4 * interval)
+
+    ports = [_free_port(), _free_port()]
+    peers = ",".join(f"http://127.0.0.1:{p}" for p in ports)
+    spools = [
+        tempfile.mkdtemp(prefix="tpumon-fleet-spool-0-"),
+        tempfile.mkdtemp(prefix="tpumon-fleet-spool-1-"),
+    ]
+
+    def shard_cfg(index: int, urls: list[str]) -> "FleetConfig":
+        return FleetConfig(
+            port=ports[index], addr="127.0.0.1",
+            targets=",".join(urls),
+            shard_index=index, shard_count=2,
+            interval=interval,
+            stale_s=max(2.0, 3.0 * interval),
+            evict_s=max(duration_s * 2, 120.0),
+            peers=peers,
+            probe_interval=max(0.25, takeover_s / 4.0),
+            takeover_s=takeover_s,
+            spool_dir=spools[index],
+            spool_every_s=interval,
+            poll_backoff_max_s=5.0,
+            history_window=0.0,
+        )
+
+    sim_proc = None
+    shards: list = [None, None]
+    conns: dict[int, http.client.HTTPConnection] = {}
+    lat_ms: list[float] = []
+    failed_scrapes = 0
+    honesty_violations = 0
+    min_visibility = {0: 1.0, 1: 1.0}
+    min_global_visibility = 1.0
+    stale_flagged = 0
+    partial_flagged = 0
+    rejects_accum: dict[str, float] = {}
+    shard1_rejects: dict[str, float] = {}
+    record: dict = {
+        "mode": "fleet-chaos",
+        "nodes": nodes,
+        "shards": 2,
+        "topology": topology,
+        "interval_s": interval,
+        "takeover_s": takeover_s,
+    }
+    prev_switch = sys.getswitchinterval()
+
+    def scrape(index: int) -> bytes | None:
+        nonlocal failed_scrapes
+        conn = conns.get(index)
+        if conn is None:
+            conn = conns[index] = http.client.HTTPConnection(
+                "127.0.0.1", ports[index], timeout=10
+            )
+        start = time.perf_counter()
+        try:
+            conn.request("GET", "/metrics")
+            body = conn.getresponse().read()
+        except (OSError, http.client.HTTPException):
+            failed_scrapes += 1
+            conn.close()
+            conns.pop(index, None)
+            return None
+        lat_ms.append((time.perf_counter() - start) * 1e3)
+        return body
+
+    def observe(index: int) -> dict | None:
+        nonlocal honesty_violations, stale_flagged, partial_flagged
+        nonlocal min_global_visibility
+        body = scrape(index)
+        if body is None:
+            return None
+        stats = _page_stats(body)
+        vis = stats["visibility"]
+        if vis is not None:
+            min_visibility[index] = min(min_visibility[index], vis)
+            if vis < 1.0:
+                partial_flagged += 1
+        if stats["global_visibility"] is not None:
+            min_global_visibility = min(
+                min_global_visibility, stats["global_visibility"]
+            )
+        if stats["stale_flag"] == 1.0:
+            stale_flagged += 1
+        # The honesty invariant: hosts missing from the up count must
+        # surface as a stale flag or reduced visibility on the SAME
+        # page — never a silently smaller (or renormalized) rollup.
+        if (
+            stats["up"] is not None
+            and stats["targets"] is not None
+            and stats["up"] < stats["targets"]
+            and stats["stale_flag"] == 0.0
+            and (vis is None or vis >= 1.0)
+        ):
+            honesty_violations += 1
+        return stats
+
+    def fleet_doc(index: int) -> dict:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", ports[index], timeout=10
+        )
+        try:
+            conn.request("GET", "/fleet")
+            return json.loads(conn.getresponse().read())
+        finally:
+            conn.close()
+
+    def covered(index: int) -> float:
+        doc = fleet_doc(index)
+        hosts = doc["fleet"].get("hosts", {})
+        return hosts.get("up", 0) + hosts.get("stale", 0)
+
+    sim_log: list[str] = []
+
+    def sim_cmd(command: str, expect_lines: int) -> None:
+        # Read the ack lines back: confirms the command landed (the
+        # evidence record carries them) and keeps the stdout pipe
+        # drained.
+        sim_proc.stdin.write(command + "\n")
+        sim_proc.stdin.flush()
+        for _ in range(expect_lines):
+            line = sim_proc.stdout.readline()  # deadline: fleetsim acks every command immediately or died (outer CI timeout bounds the run)
+            if not line:
+                sim_log.append(f"{command}: sim died mid-ack")
+                return
+            sim_log.append(line.strip())
+
+    try:
+        if not os.environ.get("TPUMON_KEEP_SWITCH_INTERVAL"):
+            sys.setswitchinterval(min(prev_switch, 0.0005))
+        sim_proc, urls = _spawn_fleetsim(nodes, topology, interval)
+        shards[0] = build_aggregator(shard_cfg(0, urls))
+        shards[1] = build_aggregator(shard_cfg(1, urls))
+        shards[0].start()
+        shards[1].start()
+        record["shard_targets"] = [len(s.targets) for s in shards]
+        owned0_before = set(shards[0].targets)
+
+        # Warm-up gate: both shards fully fed before the script starts.
+        warm_deadline = time.time() + max(60.0, 2.0 * nodes)
+        while time.time() < warm_deadline:
+            if all(
+                fleet_doc(i)["fleet"].get("hosts", {}).get("up", 0)
+                >= len(shards[i].targets)
+                for i in range(2)
+            ):
+                break
+            time.sleep(0.25)
+
+        t0 = time.time()
+        partitioned = max(2, nodes // 4)
+        # Recovery is measured in the heal→corrupt gap: it must be wide
+        # enough for the worst-case adaptive backoff (the shards run
+        # poll_backoff_max_s=5, jitter ×1.25) or the corrupt phase's
+        # own staleness would pollute the partition-recovery number.
+        script = {
+            "partition_at": 0.10 * duration_s,
+            "heal_at": 0.25 * duration_s,
+            "corrupt_at": 0.45 * duration_s,
+            "kill_at": 0.60 * duration_s,
+            "restart_at": 0.80 * duration_s,
+        }
+        record["script"] = {k: round(v, 1) for k, v in script.items()}
+        done: set[str] = set()
+        recovery_from = None
+        recovery_s = None
+        takeover = None
+        next_at = t0
+
+        while time.time() - t0 < duration_s:
+            t = time.time() - t0
+            if t >= script["partition_at"] and "partition" not in done:
+                done.add("partition")
+                sim_cmd(f"partition {partitioned}", partitioned)
+            if t >= script["heal_at"] and "heal" not in done:
+                done.add("heal")
+                sim_cmd("heal", 1)
+                recovery_from = time.time()
+            if t >= script["corrupt_at"] and "corrupt" not in done:
+                done.add("corrupt")
+                # Close the recovery measurement window: past this
+                # point staleness belongs to the corrupt phase.
+                recovery_from = None
+                sim_cmd("corrupt 2", 2)
+            if t >= script["kill_at"] and "kill" not in done:
+                done.add("kill")
+                sim_cmd("heal", 1)  # corruption dose delivered; clean fleet
+                # Harvest the victim's counters first: its ingest
+                # rejects die with the process.
+                body = scrape(1)
+                if body is not None:
+                    shard1_rejects = _reject_counts(body)
+                    for reason, count in shard1_rejects.items():
+                        rejects_accum[reason] = (
+                            rejects_accum.get(reason, 0.0) + count
+                        )
+                kill_t = time.time()
+                shards[1].close()
+                shards[1] = None
+                conns.pop(1, None)
+            if t >= script["restart_at"] and "restart" not in done:
+                done.add("restart")
+                if takeover is None:
+                    takeover = {"latency_s": None, "windows": None}
+                # Harvest shard 0's counters first — the restart wipes
+                # its in-memory registry.
+                body = scrape(0)
+                if body is not None:
+                    for reason, count in _reject_counts(body).items():
+                        rejects_accum[reason] = (
+                            rejects_accum.get(reason, 0.0) + count
+                        )
+                restart_t = time.time()
+                shards[0].close()
+                shards[0] = build_aggregator(shard_cfg(0, urls))
+                shards[0].start()
+                conns.pop(0, None)
+                first = None
+                first_deadline = time.time() + max(10.0, 10 * interval)
+                while time.time() < first_deadline and first is None:
+                    first = observe(0)
+                    if first is None:
+                        time.sleep(0.1)
+                debug = {}
+                try:
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", ports[0], timeout=10
+                    )
+                    conn.request("GET", "/debug/vars")
+                    debug = json.loads(conn.getresponse().read())
+                    conn.close()
+                except (OSError, http.client.HTTPException, ValueError):
+                    pass
+                record["restart"] = {
+                    "first_scrape_s": round(time.time() - restart_t, 3),
+                    "restored_nodes": debug.get("spool", {}).get(
+                        "restored_nodes"
+                    ),
+                    "first_page": first,
+                    #: One fan-in cycle: served_within counts collect
+                    #: intervals from start to the first good page.
+                    "intervals_to_first_page": round(
+                        (time.time() - restart_t) / interval, 2
+                    ),
+                }
+            # Takeover progress: after the kill, watch shard 0 adopt.
+            if "kill" in done and takeover is None:
+                cover = None
+                try:
+                    cover = covered(0)
+                except (OSError, ValueError, http.client.HTTPException):
+                    pass
+                if cover is not None and cover >= nodes - 0.5:
+                    latency = time.time() - kill_t
+                    takeover = {
+                        "latency_s": round(latency, 2),
+                        "windows": round(latency / takeover_s, 2),
+                        "orphans_adopted": len(
+                            set(shards[0].targets) - owned0_before
+                        ),
+                        "minimal_movement": owned0_before
+                        <= set(shards[0].targets),
+                    }
+            # Partition recovery: both live shards back at visibility 1.
+            if recovery_from is not None and recovery_s is None:
+                views = [
+                    observe(i) for i in range(2) if shards[i] is not None
+                ]
+                if views and all(
+                    v is not None and v["visibility"] == 1.0 for v in views
+                ):
+                    recovery_s = round(time.time() - recovery_from, 2)
+            else:
+                for i in range(2):
+                    if shards[i] is not None:
+                        observe(i)
+            next_at += scrape_every_s
+            time.sleep(max(0.0, next_at - time.time()))
+
+        final_pages = {
+            i: observe(i) for i in range(2) if shards[i] is not None
+        }
+        body = scrape(0)
+        takeovers_total = 0.0
+        if body is not None:
+            for reason, count in _reject_counts(body).items():
+                rejects_accum[reason] = (
+                    rejects_accum.get(reason, 0.0) + count
+                )
+            m = re.search(rb"^tpu_fleet_takeovers_total (\S+)", body, re.M)
+            takeovers_total = float(m.group(1)) if m else 0.0
+    finally:
+        for conn in conns.values():
+            conn.close()
+        for shard in shards:
+            if shard is not None:
+                shard.close()
+        if sim_proc is not None:
+            sim_proc.terminate()
+            try:
+                sim_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                sim_proc.kill()
+        for spool_dir in spools:
+            shutil.rmtree(spool_dir, ignore_errors=True)
+        sys.setswitchinterval(prev_switch)
+
+    lat_ms.sort()
+
+    def _q(p: float):
+        return round(quantile(lat_ms, p), 3) if lat_ms else None
+
+    record.update(
+        {
+            "duration_s": round(duration_s, 1),
+            "scrapes": len(lat_ms),
+            "failed_scrapes": failed_scrapes,
+            "p50_ms": _q(0.5),
+            "p99_ms": _q(0.99),
+            "partition": {
+                "partitioned": partitioned,
+                "min_visibility": {
+                    str(i): round(v, 3) for i, v in min_visibility.items()
+                },
+                "min_global_visibility": round(min_global_visibility, 3),
+                "stale_flagged_scrapes": stale_flagged,
+                "partial_flagged_scrapes": partial_flagged,
+                "honesty_violations": honesty_violations,
+                "recovery_s": recovery_s,
+            },
+            "corrupt": {
+                "rejects": rejects_accum,
+                "shard1_rejects": shard1_rejects,
+            },
+            "sim_log": sim_log,
+            "takeover": takeover
+            or {"latency_s": None, "windows": None},
+            "takeovers_total": takeovers_total,
+            "final_pages": final_pages,
+        }
+    )
+    return record
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="tpumon-soak")
     parser.add_argument("--duration", type=float, default=2700.0,
@@ -811,8 +1250,20 @@ def main(argv=None) -> int:
                         "them dying mid-run; reports rollup freshness, "
                         "stale-flagged degradation, and the aggregator's "
                         "scrape latency over the pre-aggregated page")
+    parser.add_argument("--fleet-chaos", action="store_true",
+                        help="fleet fault-tolerance acceptance soak "
+                        "(tpumon/fleet failover plane): two peer-probing "
+                        "aggregator shards with warm-restart spools over "
+                        "a scripted fleetsim fleet — partition/heal, "
+                        "corrupt payloads, shard kill (takeover latency), "
+                        "aggregator restart (spool warm start); reports "
+                        "visibility honesty, takeover windows, ingest "
+                        "rejects, and restart latency")
+    parser.add_argument("--fleet-takeover-s", type=float, default=None,
+                        help="peer takeover deadline for --fleet-chaos "
+                        "(default: max(2, 4*interval))")
     parser.add_argument("--fleet-nodes", type=int, default=16,
-                        help="simulated fleet size for --fleet")
+                        help="simulated fleet size for --fleet/--fleet-chaos")
     parser.add_argument("--fleet-kill", type=int, default=8,
                         help="exporters killed at half time for --fleet")
     parser.add_argument("--fleet-node-interval", type=float, default=None,
@@ -826,6 +1277,12 @@ def main(argv=None) -> int:
         record = straggler_soak(
             args.duration, topology=args.topology,
             interval=args.interval, scrape_every_s=args.scrape_every,
+        )
+    elif args.fleet_chaos:
+        record = fleet_chaos_soak(
+            args.duration, nodes=args.fleet_nodes, topology=args.topology,
+            interval=args.interval, scrape_every_s=args.scrape_every,
+            takeover_s=args.fleet_takeover_s,
         )
     elif args.fleet:
         record = fleet_soak(

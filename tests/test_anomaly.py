@@ -385,6 +385,8 @@ class TestExporterIntegration:
             "duty_ewma", "hbm_ewma", "ici_flap", "bw_cusum", "queue_stall",
             # Cross-signal roster (tpumon/hostcorr), armed by default.
             "host_straggler", "host_stall",
+            # Step/lifecycle roster (tpumon/lifecycle), armed by default.
+            "step_regression", "collective_wait", "lifecycle",
         ]
         # The armed-detector gauge is on the page even with zero events.
         _, text = scrape(exp.server.url + "/metrics")

@@ -261,14 +261,18 @@ def test_governor_bounds_live_scrape_and_raises_counter(scrape):
         assert any(v > 0 for v in dropped.values()), dropped
         assert f'="{SENTINEL}"' in text
         # Every governed (device-page) family respects the budget
-        # (+1 sentinel). Histogram exposition rows and the
-        # self-telemetry registry (bounded by construction, not
-        # governed) are exempt.
+        # (+1 sentinel). Histogram exposition rows, the self-telemetry
+        # registry, and the anomaly families (appended AFTER the
+        # governor stage, bounded by the detector roster / severity
+        # vocabulary by construction — the roster gauge alone is one
+        # row per armed detector) are exempt.
         from prometheus_client.parser import text_string_to_metric_families
 
         for fam in text_string_to_metric_families(text):
             if not fam.name.startswith(("accelerator_", "tpu_")):
                 continue
+            if fam.name.startswith("tpu_anomaly"):
+                continue  # post-governor, roster-bounded
             names = {s.name for s in fam.samples}
             if len(names) > 1:
                 continue  # histogram exposition rows

@@ -708,7 +708,11 @@ def test_exporter_hostcorr_replay_api(exporter):
 def test_exporter_debug_vars_and_detector_roster(exporter):
     doc = _get_json(exporter, "/debug/vars")
     assert doc["hostcorr"]["available"] is True
-    assert doc["anomaly"]["detectors"][-2:] == ["host_straggler", "host_stall"]
+    # Cross-signal roster sits after the device detectors; the
+    # lifecycle roster (tpumon/lifecycle) follows it.
+    assert doc["anomaly"]["detectors"][5:7] == [
+        "host_straggler", "host_stall",
+    ]
 
 
 def test_exporter_history_records_hostcorr_series(exporter):
@@ -894,3 +898,78 @@ def test_guard_classifies_hostcorr_as_debug():
     from tpumon.guard.ingress import IngressGuard
 
     assert IngressGuard.classify("/hostcorr") == ("hostcorr", "debug")
+
+
+# -- per-pod cgroup PSI (ISSUE 10 satellite) --------------------------------
+
+
+POD_UID = "deadbeef-0000-4000-8000-000000000042"
+
+
+def test_pod_psi_sampled_both_driver_shapes(proc_tree):
+    from tpumon.hostcorr.sampler import HostSampler
+
+    proc_tree.add_pod(POD_UID, pid=7001, driver="systemd")
+    proc_tree.set_pod_pressure(
+        POD_UID, "cpu", some_avg10=35.0, some_total_us=50000,
+        driver="systemd",
+    )
+    sampler = HostSampler(proc_tree.root)
+    sig = sampler.sample(1.0)
+    assert sig.pod_psi[POD_UID]["cpu"]["share"] == pytest.approx(0.35)
+    assert sig.pod_psi[POD_UID]["cpu"]["stall_s"] == pytest.approx(0.05)
+    assert sig.max_pod_psi_share("cpu") == pytest.approx(0.35)
+    assert sig.max_pod_psi_share("io") is None
+
+    # cgroupfs-driver path shape (QoS class as its own segment).
+    proc_tree.remove_pod(7001)
+    proc_tree.add_pod(POD_UID, pid=7002, driver="cgroupfs")
+    proc_tree.set_pod_pressure(
+        POD_UID, "io", some_avg10=20.0, driver="cgroupfs",
+    )
+    sampler2 = HostSampler(proc_tree.root)
+    sig2 = sampler2.sample(1.0)
+    assert sig2.pod_psi[POD_UID]["io"]["share"] == pytest.approx(0.20)
+
+
+def test_pod_psi_feeds_attribution_when_node_psi_quiet(proc_tree):
+    from tpumon.hostcorr.detectors import attribute_cause, env_thresholds
+    from tpumon.hostcorr.sampler import HostSampler
+
+    proc_tree.add_pod(POD_UID, pid=7003, driver="systemd")
+    proc_tree.set_pod_pressure(
+        POD_UID, "cpu", some_avg10=40.0, driver="systemd",
+    )
+    sig = HostSampler(proc_tree.root).sample(1.0)
+    # Node-scope PSI is quiet (fixture default 0); the pod's own dir
+    # screams — attribution must still read host-cpu.
+    assert (sig.psi_share("cpu") or 0.0) < 0.01
+    assert attribute_cause(sig, {}, env_thresholds()) == "host-cpu"
+
+
+def test_pod_psi_family_on_page(proc_tree):
+    from tpumon.hostcorr.plane import HostCorrPlane
+
+    proc_tree.add_pod(POD_UID, pid=7004, driver="systemd")
+    proc_tree.set_pod_pressure(
+        POD_UID, "memory", some_avg10=12.0, driver="systemd",
+    )
+    plane = HostCorrPlane(proc_root=proc_tree.root)
+    fams = {f.name: f for f in plane.cycle(2.0, _Stats({}))}
+    fam = fams["tpu_hostcorr_pod_psi_share"]
+    (sample,) = fam.samples
+    assert sample.labels["pod"] == POD_UID
+    assert sample.labels["resource"] == "memory"
+    assert sample.value == pytest.approx(0.12)
+
+
+def test_no_pod_dirs_keeps_node_scope_fallback(proc_tree):
+    from tpumon.hostcorr.plane import HostCorrPlane
+    from tpumon.hostcorr.sampler import HostSampler
+
+    sig = HostSampler(proc_tree.root).sample(1.0)
+    assert sig.pod_psi == {}
+    assert sig.groups["psi"] is True  # node-scope PSI still reads
+    plane = HostCorrPlane(proc_root=proc_tree.root)
+    fams = {f.name for f in plane.cycle(2.0, _Stats({}))}
+    assert "tpu_hostcorr_pod_psi_share" not in fams  # absent-not-zero

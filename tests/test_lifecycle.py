@@ -1,0 +1,719 @@
+"""Workload-lifecycle robustness plane (tpumon/lifecycle): probe,
+classifier, suppression, step detectors, exposition, fleet rollup.
+
+Hermetic throughout: workload feeds are ScriptedWorkload servers (the
+real WorkloadStats + StatsCollector + ExporterServer stack the harness
+runs, minus jax), the device side is LifecycleBackend over the fake
+backend, and the classifier units drive LifecycleTracker directly with
+synthetic per-cycle inputs.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from tpumon.backends.fake import FakeTpuBackend
+from tpumon.config import Config
+from tpumon.exporter.server import build_exporter
+from tpumon.lifecycle.detectors import (
+    SUPPRESSIBLE_DETECTORS,
+    CollectiveWaitDetector,
+    LifecycleThresholds,
+    LifecycleTracker,
+    StepRegressionDetector,
+)
+from tpumon.lifecycle.fixture import LifecycleBackend, ScriptedWorkload
+from tpumon.lifecycle.probe import StepProbe, step_snapshot_from_text
+from tpumon.workload.stats import WorkloadStats, stats_families
+
+T = LifecycleThresholds(
+    window_s=10.0, suppress_s=20.0, steady_cycles=4.0, lost_cycles=2.0,
+    duty_collapse_pct=5.0, step_warmup=3.0, wait_warmup=3.0,
+)
+
+
+def _feed(url="http://f:1", available=True, was_available=True, **snap):
+    return {
+        "url": url,
+        "available": available,
+        "was_available": was_available,
+        "snapshot": snap,
+    }
+
+
+def _snap(duties=(70.0, 72.0), chips=None):
+    if chips is None:
+        chips = {
+            str(i): {"duty_pct": d} for i, d in enumerate(duties)
+        }
+    return {"chips": chips}
+
+
+# ---------------------------------------------------------------- stats --
+
+
+class TestWorkloadStepFamilies:
+    def test_step_families_on_page(self):
+        stats = WorkloadStats()
+        stats.configure(
+            flops_per_step=1e9, tokens_per_step=512,
+            peak_flops_total=None, axes={"dp": 2},
+        )
+        stats.record(2.0, 10, 5.0)
+        stats.record_phases({"fwd": 0.1, "bwd": 0.2, "optimizer": 0.05})
+        stats.record_collective_wait(0.3)
+        stats.record_checkpoint("restore", 2.0)
+        stats.set_start_step(100)
+        fams = {f.name: f for f in stats_families(stats)}
+        assert fams["tpu_step_counter"].samples[0].value == 110
+        assert fams["tpu_step_duration_seconds"].samples[0].value == 0.5
+        phases = {
+            s.labels["phase"]: s.value
+            for s in fams["tpu_step_phase_seconds"].samples
+        }
+        assert phases == {"fwd": 0.1, "bwd": 0.2, "optimizer": 0.05}
+        assert fams["tpu_step_collective_wait_fraction"].samples[0].value == 0.3
+        # Counter family name normalizes to _total on exposition.
+        assert "tpu_step_checkpoints" in fams
+        assert fams["tpu_step_terminating"].samples[0].value == 0.0
+        stats.mark_terminating()
+        fams = {f.name: f for f in stats_families(stats)}
+        assert fams["tpu_step_terminating"].samples[0].value == 1.0
+
+    def test_collective_wait_clamped(self):
+        stats = WorkloadStats()
+        stats.record_collective_wait(3.7)
+        assert stats.snapshot()["collective_wait_fraction"] == 1.0
+
+
+# ---------------------------------------------------------------- probe --
+
+
+class TestStepProbeParser:
+    PAGE = """# HELP tpu_step_counter x
+tpu_step_counter 42.0
+tpu_step_duration_seconds 0.25
+tpu_step_phase_seconds{phase="fwd"} 0.08
+tpu_step_phase_seconds{phase="bwd"} 0.15
+tpu_step_collective_wait_fraction 0.4
+tpu_step_checkpoint_seconds{op="restore"} 2.5
+tpu_step_checkpoints_total{op="restore"} 1.0
+tpu_step_terminating 1.0
+workload_steps_per_second 4.0
+workload_mesh_info{dp="2",tp="2",sp="1",pp="1",ep="1"} 1.0
+"""
+
+    def test_parse(self):
+        snap = step_snapshot_from_text(self.PAGE)
+        assert snap["step"] == 42.0
+        assert snap["step_seconds"] == 0.25
+        assert snap["phases"] == {"fwd": 0.08, "bwd": 0.15}
+        assert snap["collective_wait_fraction"] == 0.4
+        assert snap["checkpoints"]["restore"] == {"last_s": 2.5, "count": 1.0}
+        assert snap["terminating"] is True
+        assert snap["steps_per_second"] == 4.0
+        assert snap["axes"] == {"dp": 2, "tp": 2, "sp": 1, "pp": 1, "ep": 1}
+
+    def test_non_workload_page_is_absent(self):
+        snap = step_snapshot_from_text("foo_bar 1.0\n")
+        assert snap == {}
+
+    def test_probe_against_scripted_feed(self):
+        wl = ScriptedWorkload(steps_per_second=3.0)
+        wl.start()
+        try:
+            probe = StepProbe(wl.url)
+            ok, snap = probe.sample()
+            assert ok and probe.was_available
+            assert snap["steps_per_second"] == pytest.approx(3.0)
+            wl.close()
+            ok, _ = probe.sample()
+            assert not ok and probe.was_available  # loss, not never-seen
+        finally:
+            probe.close()
+            wl.close()
+
+
+# -------------------------------------------------------------- tracker --
+
+
+class TestLifecycleTracker:
+    def test_preemption_requires_both_halves(self):
+        tr = LifecycleTracker()
+        # Terminating alone: no event.
+        b = tr.update(0.0, [_feed(terminating=True)], _snap(), T)
+        assert b["new_events"] == [] and not b["transition"]
+        # Duty collapse joins within window_s -> preemption.
+        b = tr.update(2.0, [_feed(terminating=True)], _snap((0.0, 0.0)), T)
+        assert b["new_events"] == ["preemption"]
+        assert b["transition"] and b["suppress"] == list(
+            SUPPRESSIBLE_DETECTORS
+        )
+
+    def test_stale_half_signal_expires(self):
+        tr = LifecycleTracker()
+        tr.update(0.0, [_feed(terminating=True)], _snap(), T)
+        # Collapse arrives past window_s: the halves must NOT join.
+        b = tr.update(50.0, [_feed()], _snap((0.0, 0.0)), T)
+        assert b["new_events"] == []
+
+    def test_feed_loss_debounced(self):
+        tr = LifecycleTracker()
+        tr.update(0.0, [_feed()], _snap(), T)
+        b = tr.update(1.0, [_feed(available=False)], _snap(), T)
+        assert "feed_lost" not in b["signals"]  # one blip is not a loss
+        b = tr.update(2.0, [_feed(available=False)], _snap((0.0, 0.0)), T)
+        assert "feed_lost" in b["signals"]
+        assert b["new_events"] == ["preemption"]
+
+    def test_resize_on_chip_set_change(self):
+        tr = LifecycleTracker()
+        tr.update(0.0, [], _snap((70, 70, 70, 70)), T)
+        b = tr.update(1.0, [], _snap((70, 70)), T)
+        assert b["new_events"] == ["resize"]
+        # Same shrunken set again: no second event.
+        b = tr.update(2.0, [], _snap((70, 70)), T)
+        assert b["new_events"] == []
+
+    def test_detach_is_not_resize(self):
+        tr = LifecycleTracker()
+        tr.update(0.0, [], _snap((70, 70)), T)
+        b = tr.update(1.0, [], {"chips": {}}, T)
+        assert "membership" not in b["signals"]
+        assert "detach" in b["signals"]
+        # Recovery to the SAME set must not read as a resize either.
+        b = tr.update(2.0, [], _snap((70, 70)), T)
+        assert b["new_events"] == []
+
+    def test_restore_span_onsets(self):
+        tr = LifecycleTracker()
+        tr.update(0.0, [_feed(checkpoints={"restore": {"count": 1}})],
+                  _snap(), T)
+        # First observation establishes the baseline AND is a restore
+        # (count 1 > nothing-seen 0).
+        assert tr.transition_active
+        b = tr.update(1.0, [_feed(checkpoints={"restore": {"count": 1}})],
+                      _snap(), T)
+        assert b["new_events"] == []  # unchanged count: no new window
+
+    def test_restore_recognized_after_counter_reset(self):
+        """A rescheduled pod's fresh process restarts the restore
+        counter at 1 — which must STILL read as a new restore (the old
+        high-water mark dies with the old process)."""
+        tr = LifecycleTracker()
+        tr.update(0.0, [_feed(checkpoints={"restore": {"count": 1}})],
+                  _snap(), T)
+        # Run the first window out.
+        ts = 1.0
+        for _ in range(int(T.steady_cycles) + 1):
+            tr.update(ts, [_feed()], _snap(), T)
+            ts += 1.0
+        assert not tr.transition_active
+        # Feed lost (pod rescheduled)...
+        for _ in range(int(T.lost_cycles) + 1):
+            tr.update(ts, [_feed(available=False)], _snap(), T)
+            ts += 1.0
+        # Age the feed-loss half-signal out so the return is clean.
+        ts += T.window_s + 1.0
+        for _ in range(int(T.steady_cycles) + 2):
+            b = tr.update(ts, [_feed(available=False)], _snap(), T)
+            ts += 1.0
+        # ...and the replacement restores, counter back at 1.
+        b = tr.update(ts, [_feed(checkpoints={"restore": {"count": 1}})],
+                      _snap(), T)
+        assert "restore" in b["new_events"], b
+
+    def test_window_closes_early_on_steady(self):
+        tr = LifecycleTracker()
+        tr.update(0.0, [], _snap((70, 70, 70, 70)), T)
+        tr.update(1.0, [], _snap((70, 70)), T)  # resize opens window
+        ts = 2.0
+        for _ in range(int(T.steady_cycles)):
+            b = tr.update(ts, [], _snap((70, 70)), T)
+            ts += 1.0
+        assert not b["transition"]  # closed well before suppress_s
+
+    def test_ongoing_signals_refresh_window(self):
+        """A 20-cycle preempted phase (duty still collapsed every
+        cycle) must hold ONE window open from the preemption event to
+        the restore — no suppression gap in the middle."""
+        tr = LifecycleTracker()
+        tr.update(0.0, [_feed(terminating=True)], _snap(), T)
+        tr.update(1.0, [_feed(terminating=True)], _snap((0.0, 0.0)), T)
+        assert tr.transition_active
+        # Far past suppress_s, but collapse signals keep arriving.
+        ts = 2.0
+        for _ in range(int(T.suppress_s) + 20):
+            b = tr.update(ts, [_feed(available=False)], _snap((0.0, 0.0)), T)
+            ts += 1.0
+        assert b["transition"], "window lapsed mid-collapse"
+        # …but the refresh horizon is bounded (4x suppress_s past the
+        # last recognized event + one final window): a forever-idle
+        # node returns to normal detection in bounded time.
+        closed_at = None
+        while ts < 400.0:
+            b = tr.update(ts, [_feed(available=False)], _snap((0.0, 0.0)), T)
+            if not b["transition"]:
+                closed_at = ts
+                break
+            ts += 1.0
+        assert closed_at is not None, "idle node suppressed forever"
+        assert closed_at <= 6.0 * T.suppress_s
+
+    def test_suppressed_detectors_rebaseline(self, lifecycle_exporter=None):
+        """Engine resets suppressed detectors so the RECOVERY from a
+        transition doesn't fire against the pre-event baseline."""
+        from tpumon.anomaly import AnomalyEngine
+        from tpumon.anomaly.detectors import EwmaZDetector, _duty_by_chip
+
+        det = EwmaZDetector(
+            "duty_ewma", "duty", _duty_by_chip,
+            "accelerator_duty_cycle_percent", "duty_min_std",
+        )
+        eng = AnomalyEngine(detectors=[det])
+        busy = {"chips": {"0": {"duty_pct": 70.0}}}
+        idle = {"chips": {"0": {"duty_pct": 0.0}},
+                "lifecycle": {"suppress": ["duty_ewma"]}}
+        for ts in range(25):
+            eng.observe(float(ts), busy)
+        for ts in range(25, 35):
+            eng.observe(float(ts), idle)  # transition: reset each cycle
+        # Window closed; duty recovers — must NOT flag the recovery.
+        for ts in range(35, 60):
+            eng.observe(float(ts), busy)
+        assert eng.active() == []
+        assert eng.suppressed_counts().get("duty_ewma", 0) >= 1
+
+    def test_window_expires_by_time(self):
+        tr = LifecycleTracker()
+        tr.update(0.0, [], _snap((70, 70, 70, 70)), T)
+        b = tr.update(1.0, [_feed(terminating=True)], _snap((70, 70)), T)
+        assert b["transition"]
+        # Signals keep arriving (no steady streak) but time runs out.
+        b = tr.update(1.0 + T.suppress_s + 1.0,
+                      [_feed(terminating=True)], _snap((0.0, 0.0)), T)
+        # terminating+collapse at this cycle re-onset a NEW preemption —
+        # which is correct; drop the feed signals instead:
+        tr2 = LifecycleTracker()
+        tr2.update(0.0, [], _snap((70, 70, 70, 70)), T)
+        tr2.update(1.0, [], _snap((70, 70)), T)
+        b = tr2.update(
+            1.0 + T.suppress_s + 1.0, [], _snap((70, 70)), T
+        )
+        assert not b["transition"]
+
+
+# ---------------------------------------------------- engine suppression --
+
+
+class _AlwaysActive:
+    name = "duty_ewma"  # a suppressible name
+
+    def observe(self, ts, snap, t):
+        from tpumon.anomaly.detectors import Reading
+
+        return [Reading("chip:0", True, "warn", 1.0, "boom", "fam", ())]
+
+
+class TestEngineSuppression:
+    def _engine(self):
+        from tpumon.anomaly import AnomalyEngine
+
+        return AnomalyEngine(detectors=[_AlwaysActive()])
+
+    def test_suppressed_verdict_never_onsets(self):
+        eng = self._engine()
+        snap = {"x": 1, "lifecycle": {"suppress": ["duty_ewma"]}}
+        for ts in range(5):
+            eng.observe(float(ts), snap)
+        assert eng.active() == []
+        assert eng.suppressed_counts() == {"duty_ewma": 5}
+        assert eng.summary()["suppressed"] == 5
+        # Counter family objects carry the un-suffixed name; exposition
+        # appends _total (the registry key is the exposition name).
+        fams = {
+            f.name + ("_total" if f.type == "counter" else "")
+            for f in eng.families((), ())
+        }
+        assert "tpu_anomaly_suppressed_total" in fams
+
+    def test_active_event_clears_on_suppression(self):
+        eng = self._engine()
+        eng.observe(0.0, {"x": 1})
+        assert len(eng.active()) == 1
+        eng.observe(1.0, {"x": 1, "lifecycle": {"suppress": ["duty_ewma"]}})
+        assert eng.active() == []  # the transition explains it: clear NOW
+        events = eng.events()
+        assert events and events[0]["clear_ts"] == 1.0
+        assert "[suppressed: lifecycle transition]" in events[0]["message"]
+
+    def test_fires_again_after_window(self):
+        eng = self._engine()
+        eng.observe(0.0, {"x": 1, "lifecycle": {"suppress": ["duty_ewma"]}})
+        assert eng.active() == []
+        eng.observe(1.0, {"x": 1, "lifecycle": {"suppress": []}})
+        assert len(eng.active()) == 1  # suppression delays, never blinds
+
+
+# ------------------------------------------------------- step detectors --
+
+
+class TestStepDetectors:
+    def _lc(self, step_s=None, wait=None, transition=False):
+        feeds = {}
+        if step_s is not None or wait is not None:
+            feeds["http://f:1"] = {
+                "step_seconds": step_s,
+                "collective_wait_fraction": wait,
+            }
+        return {
+            "lifecycle": {"transition": transition, "feeds": feeds}
+        }
+
+    def test_step_regression_onsets_one_sided(self, monkeypatch):
+        monkeypatch.setenv("TPUMON_LIFECYCLE_STEP_WARMUP", "3")
+        det = StepRegressionDetector()
+        for ts in range(6):
+            assert det.observe(float(ts), self._lc(step_s=0.5), None) == []
+        out = det.observe(10.0, self._lc(step_s=1.0), None)
+        assert out and out[0].active
+        assert "regression" in out[0].message
+        # Faster never fires (nobody pages on a speedup).
+        det2 = StepRegressionDetector()
+        for ts in range(6):
+            det2.observe(float(ts), self._lc(step_s=0.5), None)
+        assert det2.observe(10.0, self._lc(step_s=0.1), None) == []
+
+    def test_step_regression_resets_on_transition(self, monkeypatch):
+        monkeypatch.setenv("TPUMON_LIFECYCLE_STEP_WARMUP", "3")
+        det = StepRegressionDetector()
+        for ts in range(6):
+            det.observe(float(ts), self._lc(step_s=0.5), None)
+        assert det.observe(6.0, self._lc(transition=True), None) == []
+        # Post-transition the old baseline is gone: the doubled step
+        # time is the NEW normal until warmup re-arms.
+        assert det.observe(7.0, self._lc(step_s=1.0), None) == []
+        for ts in range(8, 12):
+            det.observe(float(ts), self._lc(step_s=1.0), None)
+        # …and a further regression against the new baseline fires.
+        out = det.observe(20.0, self._lc(step_s=2.0), None)
+        assert out and out[0].active
+
+    def test_collective_wait_growth(self, monkeypatch):
+        monkeypatch.setenv("TPUMON_LIFECYCLE_WAIT_WARMUP", "3")
+        det = CollectiveWaitDetector()
+        for ts in range(6):
+            assert det.observe(float(ts), self._lc(wait=0.05), None) == []
+        out = det.observe(10.0, self._lc(wait=0.5), None)
+        assert out and out[0].active
+        assert "contention" in out[0].message
+
+
+# ------------------------------------------------------------- exporter --
+
+
+@pytest.fixture
+def lifecycle_exporter():
+    built = []
+
+    def _build(step_urls="", **cfg_kwargs):
+        backend = LifecycleBackend(
+            FakeTpuBackend.preset("v4-8", ici_flake=0.0)
+        )
+        cfg = Config(
+            port=0, addr="127.0.0.1", interval=30.0,
+            pod_attribution=False, lifecycle_step_urls=step_urls,
+            **cfg_kwargs,
+        )
+        exp = build_exporter(cfg, backend)
+        exp.start()
+        built.append(exp)
+        return exp, backend
+
+    yield _build
+    for exp in built:
+        exp.close()
+
+
+def _get_json(url: str) -> dict:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+class TestExporterIntegration:
+    def test_page_families_and_replay(self, lifecycle_exporter, scrape):
+        wl = ScriptedWorkload(steps_per_second=2.0)
+        wl.start()
+        try:
+            exp, backend = lifecycle_exporter(step_urls=wl.url)
+            wl.set_collective_wait(0.1)
+            for _ in range(3):
+                exp.poller.poll_once()
+            _, text = scrape(exp.server.url + "/metrics")
+            assert 'tpu_lifecycle_workloads{' in text
+            assert "tpu_lifecycle_state" in text
+            assert "tpu_lifecycle_step_rate" in text
+            assert "tpu_lifecycle_collective_wait_fraction" in text
+            doc = _get_json(exp.server.url + "/lifecycle")
+            assert doc["workloads"] == {"configured": 1, "available": 1}
+            assert doc["records"]
+            assert not doc["transition"]
+            # ?since= replay + bad since validation (shared validator).
+            mid = doc["records"][-1]["ts"]
+            doc2 = _get_json(f"{exp.server.url}/lifecycle?since={mid}")
+            assert all(r["ts"] >= mid for r in doc2["records"])
+            status, _ = scrape(exp.server.url + "/lifecycle?since=nan")
+            assert status == 400
+            dv = _get_json(exp.server.url + "/debug/vars")
+            assert dv["lifecycle"]["workloads"]["available"] == 1
+        finally:
+            wl.close()
+
+    def test_detector_roster_includes_lifecycle(self, lifecycle_exporter):
+        exp, _ = lifecycle_exporter()
+        doc = _get_json(exp.server.url + "/anomalies")
+        for name in ("step_regression", "collective_wait", "lifecycle"):
+            assert name in doc["detectors"]
+
+    def test_preemption_suppresses_and_counts(
+        self, lifecycle_exporter, scrape
+    ):
+        wl = ScriptedWorkload(steps_per_second=2.0)
+        wl.start()
+        try:
+            exp, backend = lifecycle_exporter(step_urls=wl.url)
+            for _ in range(3):
+                exp.poller.poll_once()
+            wl.mark_terminating()
+            backend.duty_zero = True
+            for _ in range(4):
+                exp.poller.poll_once()
+            doc = _get_json(exp.server.url + "/lifecycle")
+            assert doc["transition"] and "preemption" in doc["kinds"]
+            assert doc["events_total"] == {"preemption": 1}
+            anomalies = _get_json(exp.server.url + "/anomalies")
+            active = [
+                e for e in anomalies["events"]
+                if e["clear_ts"] is None and e["detector"] != "lifecycle"
+            ]
+            assert active == []  # no false verdicts during the window
+            lifecycle_events = [
+                e for e in anomalies["events"]
+                if e["detector"] == "lifecycle"
+            ]
+            assert lifecycle_events and lifecycle_events[0]["clear_ts"] is None
+            _, text = scrape(exp.server.url + "/metrics")
+            assert "tpu_lifecycle_events_total" in text
+            assert 'kind="preemption"' in text
+        finally:
+            wl.close()
+
+    def test_resize_reenumeration(self, lifecycle_exporter):
+        exp, backend = lifecycle_exporter()
+        for _ in range(2):
+            exp.poller.poll_once()
+        backend.visible_chips = 2
+        exp.poller.poll_once()
+        doc = _get_json(exp.server.url + "/lifecycle")
+        assert doc["events_total"].get("resize") == 1
+        # The page itself re-enumerated.
+        assert exp.poller.last_stats.snapshot["chips"] is not None
+        assert len(exp.poller.last_stats.snapshot["chips"]) <= 4
+
+    def test_disabled_plane(self, lifecycle_exporter, scrape):
+        exp, _ = lifecycle_exporter(lifecycle=False)
+        exp.poller.poll_once()
+        _, text = scrape(exp.server.url + "/metrics")
+        assert "tpu_lifecycle_" not in text
+        status, _ = scrape(exp.server.url + "/lifecycle")
+        assert status == 404
+
+    def test_guard_classifies_lifecycle_as_debug(self):
+        from tpumon.guard.ingress import IngressGuard
+
+        assert IngressGuard.classify("/lifecycle") == ("lifecycle", "debug")
+
+
+# ------------------------------------------------------ families & docs --
+
+
+class TestFamilyRegistry:
+    def test_lifecycle_families_registered_and_documented(self):
+        from tpumon.families import (
+            LIFECYCLE_FAMILIES,
+            STEP_FAMILIES,
+            all_family_names,
+        )
+
+        names = all_family_names()
+        assert set(LIFECYCLE_FAMILIES) <= names
+        assert set(STEP_FAMILIES) <= names
+        with open("docs/METRICS.md", encoding="utf-8") as fh:
+            doc = fh.read()
+        for fam in (
+            list(LIFECYCLE_FAMILIES)
+            + list(STEP_FAMILIES)
+            + [
+                "tpu_anomaly_suppressed_total",
+                "tpu_fleet_step_rate",
+                "tpu_fleet_lifecycle_transitions",
+                "tpu_fleet_peer_seeded_total",
+            ]
+        ):
+            assert fam in doc, fam
+
+    def test_emitted_families_are_registered(self, lifecycle_exporter):
+        from tpumon.families import all_family_names
+
+        wl = ScriptedWorkload()
+        wl.start()
+        try:
+            exp, backend = lifecycle_exporter(step_urls=wl.url)
+            wl.mark_terminating()
+            backend.duty_zero = True
+            for _ in range(4):
+                exp.poller.poll_once()
+            registered = all_family_names()
+            for fam in exp.cache.snapshot():
+                if fam.name.startswith(("tpu_lifecycle", "tpu_anomaly")):
+                    name = fam.name
+                    if fam.type == "counter":
+                        name = name + "_total"
+                    assert name in registered, name
+        finally:
+            wl.close()
+
+
+# ---------------------------------------------------------------- fleet --
+
+
+class TestFleetIntegration:
+    def test_ingest_and_rollup(self):
+        from tpumon.fleet.ingest import node_snapshot_from_text
+        from tpumon.fleet.rollup import fleet_families, merge_buckets, rollup
+
+        page = (
+            'accelerator_info{slice="s1",host="h1",accelerator="v4-8",'
+            'worker="0",chip="0",coords="",device_id="d",cores="2"} 1.0\n'
+            "tpu_lifecycle_step_rate 2.0\n"
+            "tpu_lifecycle_state 1.0\n"
+        )
+        snap = node_snapshot_from_text(page)
+        assert snap["step_rate"] == 2.0
+        assert snap["lifecycle_transition"] is True
+        other = dict(snap, step_rate=4.0, lifecycle_transition=False)
+        doc = rollup(
+            [
+                {"snap": snap, "state": "up"},
+                {"snap": other, "state": "up"},
+            ]
+        )
+        assert doc["fleet"]["step_rate"] == pytest.approx(3.0)
+        assert doc["fleet"]["lifecycle_transitions"] == 1
+        fams = {f.name: f for f in fleet_families(doc)}
+        assert fams["tpu_fleet_step_rate"].samples
+        assert fams["tpu_fleet_lifecycle_transitions"].samples
+        merged = merge_buckets([doc["fleet"], doc["fleet"]])
+        assert merged["step_rate"] == pytest.approx(3.0)
+        assert merged["step_rate_n"] == 4
+        assert merged["lifecycle_transitions"] == 2
+
+    def test_peer_seed_warm_adoption(self, monkeypatch, tmp_path):
+        from tpumon.fleet.config import FleetConfig
+        from tpumon.fleet.server import FleetAggregator
+
+        target = "127.0.0.1:59999"
+        cfg = FleetConfig(
+            port=0, addr="127.0.0.1", targets=target,
+            shard_index=0, shard_count=2,
+            peers="http://127.0.0.1:1,http://127.0.0.1:2",
+            history_window=0.0,
+        )
+        agg = FleetAggregator(cfg)
+        try:
+            peer_doc = {
+                "now": 1000.0,
+                "nodes": [
+                    {
+                        "target": target,
+                        "age_s": 2.5,
+                        "snap": {"identity": {"slice": "s"}, "chips": {}},
+                    }
+                ],
+            }
+
+            class _Resp:
+                def __enter__(self):
+                    return self
+
+                def __exit__(self, *exc):
+                    return False
+
+                def read(self):
+                    return json.dumps(peer_doc).encode()
+
+            monkeypatch.setattr(
+                "urllib.request.urlopen", lambda *a, **k: _Resp()
+            )
+            seeds = agg._peer_seed([target])
+            assert seeds[target]["fetched_at"] == pytest.approx(997.5)
+
+            # Full adoption path: wipe the feed, re-apply membership —
+            # the new feed must come up warm from the peer snapshot.
+            agg.feeds = {}
+            agg._apply_membership([target], {"first": False})
+            snap, fetched_at, _ = agg.feeds[target].current()
+            assert snap == peer_doc["nodes"][0]["snap"]
+            assert fetched_at == pytest.approx(997.5)
+            assert agg._peer_seeded_count == 1
+        finally:
+            agg.close()
+
+
+# -------------------------------------------------------- soak smoke ----
+
+
+@pytest.mark.slow
+class TestSoakSmoke:
+    def test_preempt_smoke(self):
+        from tpumon.tools.soak import preempt_soak
+
+        rec = preempt_soak(24.0, interval=0.25)
+        assert rec["false_positives"] == 0, rec["false_positive_events"]
+        assert rec["regression_detected"], rec
+        assert rec["lifecycle_events_total"].get("preemption") == 1
+        assert rec["lifecycle_events_total"].get("resize") == 1
+        assert rec["lifecycle_events_total"].get("restore") == 1
+        assert rec["device_calls_per_cycle"] == rec["control_calls_per_cycle"]
+
+    def test_interfere_smoke(self):
+        from tpumon.tools.soak import interfere_soak
+
+        rec = interfere_soak(18.0, interval=0.25)
+        assert rec["contention_events"] > 0
+        assert rec["false_straggler_events"] == 0, rec
+        assert rec["device_calls_per_cycle"] == rec["control_calls_per_cycle"]
+
+    def test_restore_storm_smoke(self):
+        from tpumon.tools.soak import restore_storm_soak
+
+        rec = restore_storm_soak(20.0, interval=0.25)
+        assert rec["false_positives"] == 0, rec["false_positive_events"]
+        assert rec["restore_events"] == 1
+        assert rec["debug_burst"]["shed"] > 0
+        assert rec["fleet_min_visibility"] == 1.0
+        assert rec["device_calls_per_cycle"] == rec["control_calls_per_cycle"]
+
+    def test_duration_guards(self):
+        from tpumon.tools.soak import (
+            interfere_soak,
+            preempt_soak,
+            restore_storm_soak,
+        )
+
+        for fn in (preempt_soak, interfere_soak, restore_storm_soak):
+            with pytest.raises(ValueError):
+                fn(1.0, interval=0.25)

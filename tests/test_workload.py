@@ -333,7 +333,12 @@ class TestWorkloadStats:
 
         stats = WorkloadStats()
         fams = self._families(stats)
-        assert set(fams) == {"workload_steps"}  # counter reads 0
+        # Counter reads 0; the step counter and the SIGTERM flag are
+        # static too (the lifecycle plane needs both scrapeable before
+        # the first window — a preemption can arrive during warmup).
+        assert set(fams) == {
+            "workload_steps", "tpu_step_counter", "tpu_step_terminating",
+        }
 
     def test_concurrent_record_and_collect(self):
         """SURVEY §5.2 discipline: the train loop writes while the

@@ -222,6 +222,7 @@ PIPELINE_PREFIXES = (
     "tpumon/anomaly/",
     "tpumon/fleet/",
     "tpumon/hostcorr/",
+    "tpumon/lifecycle/",
     "tpumon/history.py",
 )
 

@@ -45,7 +45,9 @@ _REGISTRY_DICTS = {
     "HEALTH_FAMILIES",
     "ANOMALY_FAMILIES",
     "HOSTCORR_FAMILIES",
+    "LIFECYCLE_FAMILIES",
     "SELF_FAMILIES",
+    "STEP_FAMILIES",
     "FLEET_FAMILIES",
     "WORKLOAD_FAMILIES",
     "HOST_FAMILIES",
@@ -56,7 +58,7 @@ _REGISTRY_DICTS = {
 #: metric names appear in prose).
 _METRIC_RE = re.compile(
     r"\b(?:(?:accelerator|exporter|collector|workload|host|tpu_anomaly"
-    r"|tpu_hostcorr|tpu_straggler"
+    r"|tpu_hostcorr|tpu_straggler|tpu_lifecycle|tpu_step"
     r"|tpu_fleet|tpumon_trace|tpumon_poll|tpumon_family|tpumon_breaker"
     r"|tpumon_retries|tpumon_watchdog|tpumon_guard|tpumon_shed"
     r"|tpumon_cardinality|tpumon_render|tpumon_exposition)_[a-z0-9_]+"
@@ -75,6 +77,7 @@ _EMIT_PREFIXES = (
     "tpumon/discovery/",
     "tpumon/fleet/",
     "tpumon/hostcorr/",
+    "tpumon/lifecycle/",
     "tpumon/workload/",
 )
 

@@ -172,6 +172,15 @@ class EwmaZDetector:
         self._state: dict[str, _Ewma] = {}
         self._active: set[str] = set()
 
+    def reset(self) -> None:
+        """Drop baselines and re-warm — called by the engine while a
+        lifecycle transition suppresses this detector: the pre-event
+        baseline is not evidence about the post-event regime (a resized
+        mesh, a restored checkpoint), so re-baselining beats flagging
+        the recovery as a spike forever."""
+        self._state.clear()
+        self._active.clear()
+
     def observe(self, ts: float, snap: dict, t: AnomalyThresholds) -> list[Reading]:
         out: list[Reading] = []
         vals = self._extract(snap)
@@ -243,6 +252,10 @@ class CusumDriftDetector:
         self._s_neg = 0.0
         self._active = False
 
+    def reset(self) -> None:
+        """Lifecycle-suppression re-baseline (see EwmaZDetector)."""
+        self.__init__()
+
     def observe(self, ts: float, snap: dict, t: AnomalyThresholds) -> list[Reading]:
         rate = (snap.get("network") or {}).get("delivery_rate_mbps")
         if rate is None:
@@ -297,6 +310,11 @@ class LinkFlapDetector:
         self._transitions: dict[str, deque] = {}
         self._stable_streak: dict[str, int] = {}
         self._active: set[str] = set()
+
+    def reset(self) -> None:
+        """Lifecycle-suppression re-baseline: links flap by design
+        while a slice re-enumerates; a fresh burst must re-onset."""
+        self.__init__()
 
     def observe(self, ts: float, snap: dict, t: AnomalyThresholds) -> list[Reading]:
         links = (snap.get("ici") or {}).get("links") or {}
@@ -371,6 +389,12 @@ class QueueStallDetector:
     def __init__(self) -> None:
         self._streak: dict[str, int] = {}
         self._active: set[str] = set()
+
+    def reset(self) -> None:
+        """Lifecycle-suppression re-baseline: a preempted slice's
+        drained queues are the transition's business; a wedged runtime
+        AFTER it re-earns its streak."""
+        self.__init__()
 
     def observe(self, ts: float, snap: dict, t: AnomalyThresholds) -> list[Reading]:
         queues = snap.get("queues") or {}

@@ -31,6 +31,7 @@ from tpumon import health as health_mod
 from tpumon.anomaly.detectors import (
     DETECTOR_NAMES,
     AnomalyThresholds,
+    Reading,
     default_detectors,
     env_thresholds,
 )
@@ -129,6 +130,9 @@ class AnomalyEngine:
         #: (detector, signal) -> consecutive cycles absent from readings
         #: (absence-clear debounce; see observe()).
         self._absent: Counter = Counter()  # guarded-by: self._lock
+        #: detector -> verdicts suppressed during lifecycle transitions
+        #: (tpumon/lifecycle; tpu_anomaly_suppressed_total).
+        self._suppressed: Counter = Counter()  # guarded-by: self._lock
 
     @property
     def detector_names(self) -> tuple[str, ...]:
@@ -182,10 +186,55 @@ class AnomalyEngine:
                 # double-counting tpu_anomaly_events_total).
                 failed_detectors.add(det.name)
 
+        # Lifecycle suppression (tpumon/lifecycle): during a recognized
+        # clean transition (preemption/resize/restore) the plane injects
+        # a suppress list into the snapshot. Active verdicts from those
+        # detectors are downgraded to inactive — existing events clear
+        # NOW (the transition explains them) and new ones never onset —
+        # and each suppression is counted, so "how many false verdicts
+        # did the window absorb" is scrapeable, never silent. A
+        # regression persisting past the window fires normally.
+        suppress = frozenset(
+            (snap.get("lifecycle") or {}).get("suppress") or ()
+        )
+        if suppress:
+            # Re-baseline suppressed detectors: their pre-event state
+            # (EWMA means, stall streaks, flap windows) is not evidence
+            # about the post-transition regime — without this, the
+            # RECOVERY from a preemption reads as a giant z-score spike
+            # the moment the window closes. Detection resumes from a
+            # fresh warmup on post-event data; readings already
+            # collected above still clear live events and count below.
+            for det in self._detectors:
+                if det.name not in suppress:
+                    continue
+                reset = getattr(det, "reset", None)
+                if reset is None:
+                    continue
+                try:
+                    reset()
+                except Exception:
+                    log.exception(
+                        "anomaly detector %s reset failed", det.name
+                    )
+
         with self._lock:
             self._cycles += 1
             seen: set[tuple[str, str]] = set()
             for det_name, r in readings:
+                if r.active and det_name in suppress:
+                    self._suppressed[det_name] += 1
+                    r = Reading(
+                        r.signal, False, r.severity, r.value,
+                        r.message + " [suppressed: lifecycle transition]",
+                        r.family, r.label_match,
+                    )
+                    live = self._live.get((det_name, r.signal))
+                    if live is not None:
+                        # The clear path below keeps the onset message;
+                        # a suppression-clear should SAY it was the
+                        # transition, not leave the alarm text standing.
+                        live.message = r.message
                 key = (det_name, r.signal)
                 seen.add(key)
                 live = self._live.get(key)
@@ -271,6 +320,7 @@ class AnomalyEngine:
                 (ev.detector, ev.severity) for ev in self._live.values()
             )
             totals = dict(self._totals)
+            suppressed = dict(self._suppressed)
 
         labels = tuple(base_keys)
 
@@ -294,6 +344,12 @@ class AnomalyEngine:
             for (d, sev), n in sorted(totals.items()):
                 total.add_metric(tuple(base_vals) + (d, sev), float(n))
             out.append(total)
+
+        if suppressed:
+            sup = fam("tpu_anomaly_suppressed_total", CounterMetricFamily)
+            for d, n in sorted(suppressed.items()):
+                sup.add_metric(tuple(base_vals) + (d,), float(n))
+            out.append(sup)
         return out
 
     # -- query surfaces ----------------------------------------------------
@@ -334,16 +390,24 @@ class AnomalyEngine:
                     worst = ev.severity
             return worst
 
+    def suppressed_counts(self) -> dict[str, int]:
+        """detector -> lifecycle-suppressed verdict count (evidence
+        surface for the lifecycle soak modes)."""
+        with self._lock:
+            return dict(self._suppressed)
+
     def summary(self) -> dict:
         """The /anomalies envelope (events appended by the caller)."""
         with self._lock:
             total = sum(self._totals.values())
             n_active = len(self._live)
             cycles = self._cycles
+            suppressed = sum(self._suppressed.values())
         return {
             "detectors": list(self.detector_names),
             "cycles": cycles,
             "active": n_active,
             "total": total,
+            "suppressed": suppressed,
             "status": self.worst_severity(),
         }

@@ -156,6 +156,21 @@ class Config:
     #: Correlation-ring capacity (one joined host+device record per poll
     #: cycle, served by /hostcorr).
     hostcorr_ring: int = 600
+    #: Workload-lifecycle robustness plane (tpumon/lifecycle): probe the
+    #: workload harness's metrics port (tpu_step_* families), classify
+    #: preemption/resize/restore transitions, suppress false verdicts
+    #: during clean transitions, and arm the step-regression /
+    #: ICI-contention detectors. Classifier thresholds are separate
+    #: TPUMON_LIFECYCLE_<FIELD> env vars (tpumon/lifecycle/detectors.py).
+    lifecycle: bool = True
+    #: Workload step-feed URLs the lifecycle plane probes once per poll
+    #: cycle (CSV; typically the harness --metrics-port on localhost).
+    #: Empty = no feeds — the plane still tracks device-side lifecycle
+    #: signatures (resize via topology re-enumeration).
+    lifecycle_step_urls: str = ""
+    #: Lifecycle-ring capacity (one joined step+device record per poll
+    #: cycle, served by /lifecycle).
+    lifecycle_ring: int = 600
     #: Self-protection plane (tpumon/guard): scrape admission control,
     #: request deadlines, cardinality governor, and memory watermarks.
     #: Off restores the unguarded serving paths (replay-response bounds
@@ -282,6 +297,12 @@ class Config:
             )
             or base.hostcorr_proc_root,
             hostcorr_ring=_env_int("HOSTCORR_RING", base.hostcorr_ring),
+            lifecycle=_env_bool("LIFECYCLE", base.lifecycle),
+            lifecycle_step_urls=_env(
+                "LIFECYCLE_STEP_URLS", base.lifecycle_step_urls
+            )
+            or base.lifecycle_step_urls,
+            lifecycle_ring=_env_int("LIFECYCLE_RING", base.lifecycle_ring),
             guard=_env_bool("GUARD", base.guard),
             guard_metrics_inflight=_env_int(
                 "GUARD_METRICS_INFLIGHT", base.guard_metrics_inflight
@@ -411,6 +432,18 @@ class Config:
             type=int,
             help="correlation-ring capacity for /hostcorr (one joined "
             "host+device record per poll cycle)",
+        )
+        g.add_argument(
+            "--lifecycle-step-urls",
+            help="workload step-feed URLs the lifecycle plane probes "
+            "(CSV; the harness --metrics-port), e.g. "
+            "http://127.0.0.1:9401",
+        )
+        g.add_argument(
+            "--lifecycle-ring",
+            type=int,
+            help="lifecycle-ring capacity for /lifecycle (one joined "
+            "step+device record per poll cycle)",
         )
         g.add_argument(
             "--guard-soft-rss-mb",

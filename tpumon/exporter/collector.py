@@ -643,6 +643,7 @@ class Poller:
         watchdog=None,
         governor=None,
         hostcorr=None,
+        lifecycle=None,
     ) -> None:
         self._backend = backend
         self._cfg = cfg
@@ -657,6 +658,7 @@ class Poller:
         self._watchdog = watchdog
         self._governor = governor
         self._hostcorr = hostcorr
+        self._lifecycle = lifecycle
         #: Staleness-gauge label reconciliation (tpumon/resilience).
         self._stale_labeled: set[str] = set()
         #: Last-seen backend retry counters (delta-fed into telemetry).
@@ -725,6 +727,25 @@ class Poller:
                         sp.status = "error"
                     self._telemetry.poll_stage_errors.labels(
                         stage="hostcorr"
+                    ).inc()
+        if self._lifecycle is not None:
+            # Workload-lifecycle plane (tpumon/lifecycle): probe the
+            # workload step feeds (localhost HTTP — zero device queries),
+            # classify preemption/resize/restore against THIS cycle's
+            # device snapshot, and inject the suppression list + step
+            # telemetry the anomaly pass consumes. Runs after hostcorr
+            # (same snapshot bus), before the governor/history/anomaly so
+            # tpu_lifecycle_* series ride the budget, the 1 Hz flight
+            # recorder, and the same published page.
+            with trace_span("lifecycle") as sp:
+                try:
+                    families.extend(self._lifecycle.cycle(now, stats))
+                except Exception:
+                    log.exception("lifecycle plane failed")
+                    if sp is not None:
+                        sp.status = "error"
+                    self._telemetry.poll_stage_errors.labels(
+                        stage="lifecycle"
                     ).inc()
         if self._governor is not None:
             # Per-family cardinality budget (tpumon/guard/cardinality):

@@ -406,7 +406,7 @@ DEFAULT_REPLAY_MAX_BYTES = 1 << 20
 def _make_app(
     render_body, telemetry: SelfTelemetry, health, history=None,
     device_health=None, post_scrape=None, anomalies=None, tracer=None,
-    debug_vars=None, hostcorr=None,
+    debug_vars=None, hostcorr=None, lifecycle=None,
     replay_max_items=DEFAULT_REPLAY_MAX_ITEMS,
     replay_max_bytes=DEFAULT_REPLAY_MAX_BYTES,
     negotiated=None,
@@ -457,6 +457,19 @@ def _make_app(
         if path == "/hostcorr" and hostcorr is not None:
             body, status = _hostcorr_response(
                 hostcorr, environ.get("QUERY_STRING", ""),
+                max_items=replay_max_items, max_bytes=replay_max_bytes,
+            )
+            start_response(
+                status,
+                [
+                    ("Content-Type", "application/json; charset=utf-8"),
+                    ("Content-Length", str(len(body))),
+                ],
+            )
+            return [body]
+        if path == "/lifecycle" and lifecycle is not None:
+            body, status = _lifecycle_response(
+                lifecycle, environ.get("QUERY_STRING", ""),
                 max_items=replay_max_items, max_bytes=replay_max_bytes,
             )
             start_response(
@@ -735,6 +748,39 @@ def _hostcorr_response(
     - ``GET /hostcorr?since=<ts>`` → only records at/after ``ts`` — the
       same replay semantics (and ``_finite`` validator) as /history and
       /anomalies.
+    - Responses are BOUNDED: at most ``max_items`` records /
+      ``max_bytes`` payload. A truncated response carries
+      ``"truncated": true`` and ``"next_since"`` — pass it back as
+      ``?since=`` to continue.
+    """
+    _, since = _parse_since(query_string)
+    if since is None:
+        return b'{"error": "bad since"}\n', "400 Bad Request"
+    doc, records = plane.replay(since)
+    return _bounded_replay(
+        doc, records, "records", max_items, max_bytes,
+        # Records are oldest-first with monotonically increasing ts; the
+        # first excluded record's ts resumes the >= since filter exactly.
+        lambda kept, items: ("next_since", items[len(kept)]["ts"]),
+    )
+
+
+def _lifecycle_response(
+    plane, query_string: str,
+    max_items: int = DEFAULT_REPLAY_MAX_ITEMS,
+    max_bytes: int = DEFAULT_REPLAY_MAX_BYTES,
+) -> tuple[bytes, str]:
+    """The /lifecycle JSON API (poll-thread state, no device calls).
+
+    - ``GET /lifecycle`` → the lifecycle-ring replay plus the plane
+      envelope: ``{"now": ts, "cycles": n, "workloads": {configured,
+      available}, "transition": bool, "kinds": [...], "events_total":
+      {kind: n}, "records": [{ts, transition, kinds, signals,
+      new_events, workloads, step_rate, ...}, ...]}`` — each record is
+      one poll cycle's time-aligned step+device join, oldest first.
+    - ``GET /lifecycle?since=<ts>`` → only records at/after ``ts`` —
+      the same replay semantics (and ``_finite`` validator) as
+      /history, /anomalies, and /hostcorr.
     - Responses are BOUNDED: at most ``max_items`` records /
       ``max_bytes`` payload. A truncated response carries
       ``"truncated": true`` and ``"next_since"`` — pass it back as
@@ -1142,6 +1188,18 @@ class Exporter:
             self.hostcorr = HostCorrPlane(
                 proc_root=cfg.hostcorr_proc_root, ring=ring
             )
+        self.lifecycle = None
+        if cfg.lifecycle:
+            from tpumon.lifecycle import LifecyclePlane
+
+            # Same malformed-knob stance as history_max_samples below.
+            lc_ring = cfg.lifecycle_ring
+            if lc_ring <= 0:
+                lc_ring = type(cfg)().lifecycle_ring
+            self.lifecycle = LifecyclePlane(
+                step_urls=cfg.lifecycle_step_urls, ring=lc_ring,
+                probe_timeout=min(1.0, max(0.2, cfg.interval / 2.0)),
+            )
         self.anomaly = None
         if cfg.anomaly:
             from tpumon.anomaly import AnomalyEngine
@@ -1160,6 +1218,14 @@ class Exporter:
                 from tpumon.hostcorr import hostcorr_detectors
 
                 detectors.extend(hostcorr_detectors())
+            if self.lifecycle is not None:
+                # Step-signal + lifecycle detectors (tpumon/lifecycle):
+                # step-time regression, collective-wait contention, and
+                # the transition event stream — fed by the lifecycle
+                # block the plane injects into each cycle's snapshot.
+                from tpumon.lifecycle import lifecycle_detectors
+
+                detectors.extend(lifecycle_detectors())
             self.anomaly = AnomalyEngine(
                 history=self.history, max_events=max_events,
                 detectors=detectors,
@@ -1301,12 +1367,23 @@ class Exporter:
                     self.hostcorr.resize(full_ring)
 
                 self.memwatch.add_hooks(shrink_hostcorr, restore_hostcorr)
+            if self.lifecycle is not None:
+                full_lc_ring = self.lifecycle.ring_capacity
+
+                def shrink_lifecycle() -> None:
+                    self.lifecycle.resize(max(16, full_lc_ring // 4))
+
+                def restore_lifecycle() -> None:
+                    self.lifecycle.resize(full_lc_ring)
+
+                self.memwatch.add_hooks(shrink_lifecycle, restore_lifecycle)
         self.poller = Poller(
             backend, cfg, self.cache, self.telemetry, attribution,
             history=self.history, histograms=self.histograms,
             anomaly=self.anomaly, tracer=self.tracer,
             resilience=self.resilience, watchdog=self.watchdog,
             governor=self.governor, hostcorr=self.hostcorr,
+            lifecycle=self.lifecycle,
         )
         version_fn = getattr(backend, "version", None)
         self.telemetry.backend_info.labels(
@@ -1366,6 +1443,7 @@ class Exporter:
             self._device_health, post_scrape=self._selfpage.poke,
             anomalies=self.anomaly, tracer=self.tracer,
             debug_vars=self._debug_vars, hostcorr=self.hostcorr,
+            lifecycle=self.lifecycle,
             replay_max_items=replay_items, replay_max_bytes=replay_bytes,
             negotiated=self.renderer,
         )
@@ -1495,6 +1573,8 @@ class Exporter:
             doc["anomaly"] = self.anomaly.summary()
         if self.hostcorr is not None:
             doc["hostcorr"] = self.hostcorr.snapshot()
+        if self.lifecycle is not None:
+            doc["lifecycle"] = self.lifecycle.snapshot()
         # Invariant-analyzer status (tpumon/analysis): operators can see
         # from the running exporter whether the shipped checkout's
         # cross-file discipline was proven, and against how many accepted
@@ -1543,6 +1623,8 @@ class Exporter:
         self.poller.stop()
         if self.watchdog is not None:
             self.watchdog.stop()
+        if self.lifecycle is not None:
+            self.lifecycle.close()
         self._selfpage.close()
         self.backend.close()
 

@@ -79,6 +79,64 @@ ANOMALY_FAMILIES: dict[str, tuple[str, tuple[str, ...]]] = {
         "Anomaly event onsets since exporter start by detector and severity",
         ("detector", "severity"),
     ),
+    "tpu_anomaly_suppressed_total": (
+        "Detector verdicts suppressed during a clean workload-lifecycle "
+        "transition window (tpumon/lifecycle: preemption / elastic "
+        "resize / checkpoint restore), by detector — a false straggler "
+        "that a preemption would have raised shows up here instead of "
+        "as an event",
+        ("detector",),
+    ),
+}
+
+#: family -> (prometheus type, description, extra labels) — the
+#: workload-lifecycle robustness plane (tpumon/lifecycle): the exporter
+#: probes the workload harness's metrics port (tpu_step_* families
+#: below), classifies preemption/resize/restore transitions from the
+#: joint step+device+membership signals, and suppresses false verdicts
+#: during clean transitions. ``tpu_lifecycle_workloads`` is always
+#: present while the plane is enabled; step-derived families are absent
+#: when no workload feed answers (absent-not-zero).
+LIFECYCLE_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
+    "tpu_lifecycle_workloads": (
+        "gauge",
+        "Workload step feeds by probe state (state ∈ available/absent); "
+        "a node with no configured feeds reports absent=0 available=0 — "
+        "the plane still tracks device-side lifecycle signatures",
+        ("state",),
+    ),
+    "tpu_lifecycle_state": (
+        "gauge",
+        "0 steady, 1 while a recognized lifecycle transition "
+        "(preemption/resize/restore) holds the suppression window open",
+        (),
+    ),
+    "tpu_lifecycle_events_total": (
+        "counter",
+        "Recognized workload-lifecycle transitions since exporter start "
+        "by kind (preemption / resize / restore)",
+        ("kind",),
+    ),
+    "tpu_lifecycle_step_rate": (
+        "gauge",
+        "Optimizer steps per second reported by the probed workload "
+        "feeds (mean over available feeds; absent when none report) — "
+        "the fleet tier rolls this up per slice",
+        (),
+    ),
+    "tpu_lifecycle_step_duration_seconds": (
+        "gauge",
+        "Mean wall seconds per optimizer step over the probed feeds "
+        "(absent when none report)",
+        (),
+    ),
+    "tpu_lifecycle_collective_wait_fraction": (
+        "gauge",
+        "Worst collective-wait fraction across the probed workload "
+        "feeds (absent when none report it) — the ICI-contention "
+        "detector's input",
+        (),
+    ),
 }
 
 #: family -> (prometheus type, description, extra labels) — the
@@ -115,6 +173,15 @@ HOSTCORR_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "Cumulative PSI stall seconds by resource and kind (the "
         "kernel's total= counter)",
         ("resource", "kind"),
+    ),
+    "tpu_hostcorr_pod_psi_share": (
+        "gauge",
+        "Per-pod cgroup PSI avg10 stall share from the kubepods pod "
+        "dir's own *.pressure files (resource ∈ cpu/memory/io, 'some' "
+        "kind; pod is the kubepods pod UID) — names WHICH pod is "
+        "starving where the node-scope PSI only says that one is; "
+        "absent on cgroup-v1 nodes (node-scope PSI is the fallback)",
+        ("pod", "resource"),
     ),
     "tpu_hostcorr_sched_delay_seconds_total": (
         "counter",
@@ -256,6 +323,28 @@ FLEET_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "Worst straggler skew across the scope's hosts (max of each "
         "host's tpu_straggler_skew_pct; absent when no host reports it)",
         ("scope", "pool", "slice"),
+    ),
+    "tpu_fleet_step_rate": (
+        "gauge",
+        "Mean workload optimizer steps/s over the scope's hosts "
+        "reporting tpu_lifecycle_step_rate (absent when none do) — the "
+        "per-slice training-progress rollup the lifecycle plane feeds",
+        ("scope", "pool", "slice"),
+    ),
+    "tpu_fleet_lifecycle_transitions": (
+        "gauge",
+        "Hosts in the scope currently inside a workload-lifecycle "
+        "transition window (tpu_lifecycle_state == 1: preemption / "
+        "resize / restore in progress)",
+        ("scope", "pool", "slice"),
+    ),
+    "tpu_fleet_peer_seeded_total": (
+        "counter",
+        "Feeds adopted on takeover/hand-back that were seeded warm from "
+        "an alive peer shard's last-good snapshot instead of starting "
+        "cold (stale-flagged by ordinary age classification until the "
+        "first live fetch)",
+        (),
     ),
     "tpu_fleet_stale_rollup": (
         "gauge",
@@ -532,6 +621,44 @@ WORKLOAD_FAMILIES: dict[str, str] = {
     ),
 }
 
+#: family -> description — per-step phase telemetry the workload harness
+#: serves on its own metrics port (tpumon/workload/stats.py) and the
+#: exporter's lifecycle plane (tpumon/lifecycle) probes: the
+#: monitor↔trainer loop. Families are absent until the harness measures
+#: them (absent-not-zero); ``tpu_step_terminating`` flips to 1 inside a
+#: SIGTERM grace window — the preemption signature.
+STEP_FAMILIES: dict[str, str] = {
+    "tpu_step_counter": (
+        "Training-global optimizer step (checkpoint-resume start step "
+        "plus steps completed by this process)"
+    ),
+    "tpu_step_duration_seconds": (
+        "Mean wall seconds per optimizer step over the most recent "
+        "stats window (the lifecycle plane's step-time-regression input)"
+    ),
+    "tpu_step_phase_seconds": (
+        "Wall seconds of the last instrumented step's phases (phase ∈ "
+        "fwd/bwd/optimizer; harness --phase-stats, one instrumented "
+        "step per window)"
+    ),
+    "tpu_step_collective_wait_fraction": (
+        "Fraction of step wall time spent inside collective ops over "
+        "the most recent window (ICI-contention signal)"
+    ),
+    "tpu_step_checkpoint_seconds": (
+        "Wall seconds of the most recent checkpoint span by op "
+        "(save/restore) — restore spans are the restore-storm signature"
+    ),
+    "tpu_step_checkpoints_total": (
+        "Checkpoint spans completed since process start, by op "
+        "(save/restore)"
+    ),
+    "tpu_step_terminating": (
+        "1 once SIGTERM reached the harness (preemption grace window in "
+        "progress); 0 while training normally"
+    ),
+}
+
 
 def host_family_rows() -> dict[str, tuple[str, str, tuple[str, ...]]]:
     """Host-context families (declared next to their builder)."""
@@ -560,9 +687,11 @@ def all_family_names() -> set[str]:
         | set(HEALTH_FAMILIES)
         | set(ANOMALY_FAMILIES)
         | set(HOSTCORR_FAMILIES)
+        | set(LIFECYCLE_FAMILIES)
         | set(distribution_family_rows())
         | set(SELF_FAMILIES)
         | set(FLEET_FAMILIES)
         | set(WORKLOAD_FAMILIES)
+        | set(STEP_FAMILIES)
         | set(host_family_rows())
     )

@@ -188,6 +188,12 @@ def node_snapshot_from_text(text: str) -> dict:
             st["chip"] = labels.get("chip", "")
         elif name == "tpu_hostcorr_available":
             snap["hostcorr_available"] = float(line.rsplit(" ", 1)[1]) > 0
+        elif name == "tpu_lifecycle_step_rate":
+            # Workload training progress (tpumon/lifecycle) — rolled up
+            # per slice as tpu_fleet_step_rate.
+            snap["step_rate"] = float(line.rsplit(" ", 1)[1])
+        elif name == "tpu_lifecycle_state":
+            snap["lifecycle_transition"] = float(line.rsplit(" ", 1)[1]) > 0
     if queues:
         snap["queues"] = queues
     if total:

@@ -75,6 +75,9 @@ class _Agg:
         self.ici_links = 0
         self.mfu_sum = 0.0
         self.mfu_n = 0
+        self.step_rate_sum = 0.0
+        self.step_rate_n = 0
+        self.lifecycle_transitions = 0
         self.degraded_hosts = 0
         #: Active straggler hosts by attributed cause (tpumon/hostcorr).
         self.stragglers: dict[str, int] = {}
@@ -105,6 +108,15 @@ class _Agg:
         if mfu is not None:
             self.mfu_sum += mfu
             self.mfu_n += 1
+        step_rate = snap.get("step_rate")
+        if step_rate is not None:
+            # Mean, not sum: hosts of one data-parallel job each report
+            # the JOB's steps/s — summing would overcount by the host
+            # count. "n" carried for the cross-shard weighted merge.
+            self.step_rate_sum += step_rate
+            self.step_rate_n += 1
+        if snap.get("lifecycle_transition"):
+            self.lifecycle_transitions += 1
         degraded = snap.get("degraded")
         if degraded and degraded.get("active"):
             self.degraded_hosts += 1
@@ -150,6 +162,11 @@ class _Agg:
         if self.mfu_n:
             doc["mfu"] = self.mfu_sum / self.mfu_n
             doc["mfu_n"] = self.mfu_n
+        if self.step_rate_n:
+            doc["step_rate"] = self.step_rate_sum / self.step_rate_n
+            doc["step_rate_n"] = self.step_rate_n
+        if self.lifecycle_transitions:
+            doc["lifecycle_transitions"] = self.lifecycle_transitions
         if self.stragglers:
             doc["stragglers"] = dict(self.stragglers)
         if self.straggler_skew_max is not None:
@@ -239,6 +256,14 @@ def merge_buckets(buckets: list[dict]) -> dict:
                 out.mfu_n += n
             else:
                 mfu_missing = True
+        if bucket.get("step_rate") is not None:
+            n = int(bucket.get("step_rate_n", 0))
+            if n:
+                out.step_rate_sum += float(bucket["step_rate"]) * n
+                out.step_rate_n += n
+        out.lifecycle_transitions += int(
+            bucket.get("lifecycle_transitions", 0)
+        )
         for cause, count in bucket.get("stragglers", {}).items():
             out.stragglers[cause] = out.stragglers.get(cause, 0) + int(count)
         skew = bucket.get("straggler_skew_max_pct")
@@ -332,6 +357,20 @@ def fleet_families(doc: dict) -> list:
         "when none do).",
         labels=_SCOPED,
     )
+    step_rate = GaugeMetricFamily(
+        "tpu_fleet_step_rate",
+        "Mean workload optimizer steps/s over the scope's hosts "
+        "reporting tpu_lifecycle_step_rate (absent when none do) — "
+        "the per-slice training-progress rollup.",
+        labels=_SCOPED,
+    )
+    lifecycle = GaugeMetricFamily(
+        "tpu_fleet_lifecycle_transitions",
+        "Hosts in the scope currently inside a workload-lifecycle "
+        "transition window (tpu_lifecycle_state == 1: preemption / "
+        "resize / restore in progress).",
+        labels=_SCOPED,
+    )
     degraded = GaugeMetricFamily(
         "tpu_fleet_degraded_hosts",
         "Hosts in the scope whose exporter reports degraded serving "
@@ -385,6 +424,12 @@ def fleet_families(doc: dict) -> list:
             ici_score.add_metric(labels, ici["score"])
         if "mfu" in bucket:
             mfu.add_metric(labels, bucket["mfu"])
+        if "step_rate" in bucket:
+            step_rate.add_metric(labels, bucket["step_rate"])
+        if "lifecycle_transitions" in bucket:
+            lifecycle.add_metric(
+                labels, float(bucket["lifecycle_transitions"])
+            )
         for cause, n in sorted(bucket.get("stragglers", {}).items()):
             stragglers.add_metric(labels + (cause,), float(n))
         if "straggler_skew_max_pct" in bucket:
@@ -399,7 +444,8 @@ def fleet_families(doc: dict) -> list:
 
     return [
         hosts, chips, duty, hbm_used, hbm_total, headroom,
-        ici_links, ici_score, mfu, stragglers, straggler_skew,
+        ici_links, ici_score, mfu, step_rate, lifecycle,
+        stragglers, straggler_skew,
         degraded, stale_flag, visibility,
     ]
 

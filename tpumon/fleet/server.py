@@ -155,6 +155,14 @@ class FleetTelemetry:
             labelnames=("op",),
             registry=registry,
         )
+        self.peer_seeded = Counter(
+            "tpu_fleet_peer_seeded",
+            "Feeds adopted on takeover/hand-back seeded warm from an "
+            "alive peer shard's last-good snapshot instead of starting "
+            "cold (stale-flagged by ordinary age classification until "
+            "the first live fetch).",
+            registry=registry,
+        )
 
 
 class FleetAggregator:
@@ -194,6 +202,9 @@ class FleetAggregator:
         #: case one deferred save).
         self._spool_saving = False
         self._restored_count = 0
+        #: Adopted feeds seeded warm from a peer's /fleet snapshot
+        #: (membership thread only).
+        self._peer_seeded_count = 0
         spool_universe: list[str] = []
         if cfg.spool_dir:
             from tpumon.fleet.spool import SnapshotSpool
@@ -347,13 +358,81 @@ class FleetAggregator:
 
     # -- membership --------------------------------------------------------
 
+    def _peer_seed(self, targets: list[str]) -> dict[str, dict]:
+        """target -> {"snap", "fetched_at"} harvested from alive peers'
+        /fleet docs — the takeover/hand-back warm start (ROADMAP item 1
+        remnant): a shard adopting targets it has no spool data for asks
+        the peers that were just watching them for their last-good
+        snapshots, so adopted feeds serve (stale-flagged) data
+        immediately instead of starting dark while every Watch stream
+        redials cold. Bounded: one /fleet fetch per alive peer, each on
+        the configured timeout; any failure degrades to a cold adopt."""
+        watcher = self.membership.watcher if self.membership else None
+        if watcher is None or not targets:
+            return {}
+        import json as _json
+        import urllib.request
+
+        from tpumon.fleet.failover import PROBE_ERRORS
+
+        want = set(targets)
+        out: dict[str, dict] = {}
+        alive = self.membership.alive_shards()
+        for index, url in watcher.peers.items():
+            if index not in alive:
+                continue
+            if not (want - set(out)):
+                break  # every adopted target already seeded
+            try:
+                with urllib.request.urlopen(
+                    url + "/fleet", timeout=self.cfg.timeout
+                ) as resp:
+                    doc = _json.loads(resp.read().decode())
+            except PROBE_ERRORS as exc:
+                log.debug("peer %s /fleet seed fetch failed: %s", url, exc)
+                continue
+            if not isinstance(doc, dict):
+                continue
+            now = doc.get("now") or 0.0
+            for node in doc.get("nodes", []):
+                if not isinstance(node, dict):
+                    continue
+                target = node.get("target")
+                snap = node.get("snap")
+                age = node.get("age_s")
+                if (
+                    target in want
+                    and isinstance(snap, dict)
+                    and isinstance(age, (int, float))
+                ):
+                    fetched_at = now - max(0.0, float(age))
+                    prev = out.get(target)
+                    if prev is None or fetched_at > prev["fetched_at"]:
+                        out[target] = {
+                            "snap": snap, "fetched_at": fetched_at,
+                        }
+        return out
+
     def _apply_membership(self, owned: list[str], info: dict) -> None:
         """Apply one ownership change from the membership plane: build
         feeds for adopted targets (seeded from the spool when we have
-        their last-good data), hand back feeds for targets a returning
-        peer reclaimed. Runs on the membership thread (and once,
+        their last-good data, else warm-seeded from an alive peer's
+        /fleet snapshot), hand back feeds for targets a returning peer
+        reclaimed. Runs on the membership thread (and once,
         synchronously, during construction)."""
         cfg = self.cfg
+        # Peer warm-seed fetch happens BEFORE the apply lock (it blocks
+        # on peer HTTP); self.feeds is only ever written on this thread,
+        # so the pre-lock read is consistent.
+        peer_seeds: dict[str, dict] = {}
+        if not info.get("first"):
+            current_feeds = self.feeds
+            adopted = [
+                t for t in owned
+                if t not in current_feeds and t not in self._spool_nodes
+            ]
+            if adopted:
+                peer_seeds = self._peer_seed(adopted)
         with self._apply_lock:
             current = self.feeds
             next_feeds: dict[str, NodeFeed] = {}
@@ -384,6 +463,14 @@ class FleetAggregator:
                     if spooled is not None:
                         feed.restore(spooled["snap"], spooled["fetched_at"])
                         self._restored_count += 1
+                    else:
+                        seeded = peer_seeds.get(target)
+                        if seeded is not None:
+                            feed.restore(
+                                seeded["snap"], seeded["fetched_at"]
+                            )
+                            self._peer_seeded_count += 1
+                            self.telemetry.peer_seeded.inc()
                     if self._watching:
                         feed.start_watch()
                 next_feeds[target] = feed
@@ -496,6 +583,7 @@ class FleetAggregator:
             "cycles": cycles,
             "nodes": nodes,
             "membership": self.membership.snapshot(),
+            "peer_seeded_nodes": self._peer_seeded_count,
             "cache_version": self.cache.rendered_with_version()[1],
         }
         if self.spool is not None:

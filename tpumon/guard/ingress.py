@@ -164,6 +164,10 @@ class IngressGuard:
             # Host-correlation replay (tpumon/hostcorr): serializes ring
             # records per request — debug-class budget.
             return "hostcorr", DEBUG
+        if path == "/lifecycle":
+            # Lifecycle replay (tpumon/lifecycle): serializes ring
+            # records per request — debug-class budget.
+            return "lifecycle", DEBUG
         if path == "/fleet":
             # Fleet-tier JSON API (tpumon/fleet/server.py): allocates a
             # full per-node document per request — debug-class budget.

@@ -158,12 +158,22 @@ def attribute_cause(host, evidence: dict, t: HostCorrThresholds) -> str:
     """
     scores: list[tuple[float, float, str]] = []
     if host is not None and host.available:
+        def share(resource: str) -> float:
+            # Worst of node-scope and per-pod PSI: a single starving
+            # pod on a big node barely moves the root share but its
+            # own pod dir screams — per-pod is the sharper evidence,
+            # node scope stays the cgroup-v1 fallback.
+            return max(
+                host.psi_share(resource) or 0.0,
+                host.max_pod_psi_share(resource) or 0.0,
+            )
+
         scores = score_host_signals(
-            host.psi_share("cpu") or 0.0,
+            share("cpu"),
             host.max_sched_share() or 0.0,
-            host.psi_share("memory") or 0.0,
+            share("memory"),
             host.reclaim_pps or 0.0,
-            host.psi_share("io") or 0.0,
+            share("io"),
             t,
         )
     if scores:
@@ -252,6 +262,12 @@ class HostStragglerDetector:
         self._active = False
         self._chip = "?"
 
+    def reset(self) -> None:
+        """Lifecycle-suppression re-baseline (the plane's judge resets
+        itself when duty collapses — this clears the adapter's latch)."""
+        self._active = False
+        self._chip = "?"
+
     def observe(self, ts: float, snap: dict, t) -> list:
         from tpumon.anomaly.detectors import Reading
 
@@ -316,6 +332,14 @@ class HostStallDetector:
         #: overtakes mid-episode or the host is already calm on the
         #: clearing cycle. Only the latched signal's own level updates.
         self._latched: list | None = None
+
+    def reset(self) -> None:
+        """Lifecycle-suppression re-baseline: HBM flatness across a
+        restore is the checkpoint's doing, not a stall's."""
+        self._streak = 0
+        self._hbm.clear()
+        self._active = False
+        self._latched = None
 
     def observe(self, ts: float, snap: dict, t) -> list:
         from tpumon.anomaly.detectors import Reading
@@ -417,11 +441,19 @@ class HostStallDetector:
         ``pod`` names the worst-delayed pod when sched won, else None.
         """
         psi = host.get("psi") or {}
+        pod_psi = host.get("pod_psi") or {}
 
         def share(resource: str) -> float:
-            return ((psi.get(resource) or {}).get("some") or {}).get(
+            node = ((psi.get(resource) or {}).get("some") or {}).get(
                 "share"
             ) or 0.0
+            pods = [
+                (rows.get(resource) or {}).get("share") or 0.0
+                for rows in pod_psi.values()
+            ]
+            # Same worst-of-both rule as attribute_cause: the two
+            # surfaces must score identical host state identically.
+            return max([node, *pods]) if pods else node
 
         sched = {
             pod: row.get("share") or 0.0

@@ -110,6 +110,36 @@ class FakeProcTree:
         self._write("proc", str(pid), "cgroup", line)
         self.set_pod_delay(pid, run_delay_ns)
 
+    def set_pod_pressure(
+        self,
+        uid: str,
+        resource: str,
+        some_avg10: float = 0.0,
+        some_total_us: int = 0,
+        driver: str = "systemd",
+    ) -> None:
+        """The pod cgroup dir's own ``<resource>.pressure`` file (per-pod
+        PSI); ``driver`` must match the shape ``add_pod`` wrote."""
+        text = (
+            f"some avg10={some_avg10:.2f} avg60=0.00 avg300=0.00 "
+            f"total={some_total_us}\n"
+            f"full avg10=0.00 avg60=0.00 avg300=0.00 total=0\n"
+        )
+        self._write(
+            "sys", "fs", "cgroup", *self._pod_dir_parts(uid, driver),
+            f"{resource}.pressure", text,
+        )
+
+    @staticmethod
+    def _pod_dir_parts(uid: str, driver: str) -> tuple[str, ...]:
+        if driver == "cgroupfs":
+            return ("kubepods", "burstable", f"pod{uid}")
+        return (
+            "kubepods.slice",
+            "kubepods-burstable.slice",
+            f"kubepods-burstable-pod{uid.replace('-', '_')}.slice",
+        )
+
     def remove_pod(self, pid: int) -> None:
         """The pod's process is gone (pod deleted / job finished)."""
         import shutil

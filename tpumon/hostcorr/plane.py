@@ -189,6 +189,16 @@ class HostCorrPlane:
                     )
             out.extend([share, stall])
 
+        if host.pod_psi:
+            pod_share = fam("tpu_hostcorr_pod_psi_share", GaugeMetricFamily)
+            for pod in sorted(host.pod_psi):
+                for resource in sorted(host.pod_psi[pod]):
+                    pod_share.add_metric(
+                        vals + (pod, resource),
+                        host.pod_psi[pod][resource]["share"],
+                    )
+            out.append(pod_share)
+
         pods = {
             pod: row for pod, row in host.sched.items() if row
         }

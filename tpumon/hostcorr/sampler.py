@@ -88,6 +88,25 @@ _VIRTUAL_IF_RE = re.compile(
 _SECTOR_BYTES = 512.0
 
 
+def _pod_cgroup_dir(cgroup_text: str) -> str | None:
+    """The cgroup-v2 pod DIRECTORY (relative path under /sys/fs/cgroup)
+    from a /proc/<pid>/cgroup file: the ``0::<path>`` line's path cut
+    just past the pod segment — ``.../kubepods-besteffort-pod<uid>.slice``
+    (systemd driver) or ``.../kubepods/burstable/pod<uid>`` (cgroupfs).
+    None when no v2 line carries a kubepods pod segment."""
+    for line in cgroup_text.splitlines():
+        if not line.startswith("0::"):
+            continue
+        path = line[3:].strip()
+        m = _POD_RE.search(path)
+        if m is None:
+            continue
+        cut = path.find("/", m.end(1))
+        pod_path = path if cut < 0 else path[:cut]
+        return pod_path.lstrip("/")
+    return None
+
+
 @dataclass
 class HostSignals:
     """One cycle's host-side sample, time-aligned with PollStats.
@@ -104,6 +123,11 @@ class HostSignals:
     available: bool = False
     groups: dict = field(default_factory=dict)  # group -> bool
     psi: dict = field(default_factory=dict)
+    #: pod uid -> {resource: {share, stall_s}} from the kubepods pod
+    #: dir's OWN *.pressure files ('some' kind) — names WHICH pod is
+    #: starving where node-scope PSI only says that one is. Empty on
+    #: cgroup-v1 nodes (node scope is the fallback).
+    pod_psi: dict = field(default_factory=dict)
     sched: dict = field(default_factory=dict)
     net_bps: dict = field(default_factory=dict)  # dir -> rate | None
     disk_bps: dict = field(default_factory=dict)
@@ -114,6 +138,16 @@ class HostSignals:
     def psi_share(self, resource: str, kind: str = "some") -> float | None:
         row = (self.psi.get(resource) or {}).get(kind)
         return None if row is None else row.get("share")
+
+    def max_pod_psi_share(self, resource: str) -> float | None:
+        """Worst per-pod PSI 'some' share for one resource (None when
+        no pod dir carries pressure files)."""
+        shares = [
+            row[resource]["share"]
+            for row in self.pod_psi.values()
+            if resource in row
+        ]
+        return max(shares) if shares else None
 
     def max_sched_share(self) -> float | None:
         shares = [
@@ -130,6 +164,10 @@ class HostSignals:
             "psi": {
                 res: {kind: dict(row) for kind, row in kinds.items()}
                 for res, kinds in self.psi.items()
+            },
+            "pod_psi": {
+                pod: {res: dict(row) for res, row in rows.items()}
+                for pod, rows in self.pod_psi.items()
             },
             "sched": {pod: dict(row) for pod, row in self.sched.items()},
             "net_bps": dict(self.net_bps),
@@ -193,6 +231,10 @@ class HostSampler:
         self._schedstat_ok = False
         #: pod uid -> {pid: last run-delay ns} (delta accumulation).
         self._pod_pids: dict[str, dict[int, float]] = {}
+        #: pod uid -> cgroup-v2 pod dir (relative path under
+        #: /sys/fs/cgroup), discovered on the refresh scan; the pod
+        #: dir's own *.pressure files back per-pod PSI.
+        self._pod_dirs: dict[str, str] = {}
         #: pod uid -> cumulative delay seconds since sampler start.
         self._pod_delay_s: dict[str, float] = {}
         #: Previous cumulative counters for rate computation.
@@ -243,7 +285,12 @@ class HostSampler:
                     del self._pod_delay_s[uid]
                     self._prev_pod_delay.pop(uid, None)
 
-        sig.groups["psi"] = self._sample_psi(sig)
+        node_psi = self._sample_psi(sig)
+        pod_psi = self._sample_pod_psi(sig)
+        # The psi GROUP is available when either scope reads: a node
+        # whose root files are missing but whose pod dirs carry
+        # pressure still has the signal (and vice versa on cgroup v1).
+        sig.groups["psi"] = node_psi or pod_psi
         sig.groups["sched"] = self._sample_sched(sig, dt)
         sig.groups["net"] = self._sample_net(sig, dt)
         sig.groups["disk"] = self._sample_disk(sig, dt)
@@ -300,13 +347,16 @@ class HostSampler:
     def _scan_pod_pids(self) -> dict[str, dict[int, float]]:
         """Walk /proc once, grouping pids by kubepods pod UID. Preserves
         each surviving pid's last-seen delay so deltas stay continuous
-        across refreshes."""
+        across refreshes. Also harvests each pod's cgroup-v2 dir (the
+        ``0::`` line's path up to the pod segment) into ``_pod_dirs``
+        for the per-pod PSI reads."""
         proc_dir = self._path("proc")
         try:
             entries = os.listdir(proc_dir)
         except OSError:
             return {}
         pods: dict[str, dict[int, float]] = {}
+        dirs: dict[str, str] = {}
         for entry in entries:
             if not entry.isdigit():
                 continue
@@ -322,7 +372,42 @@ class HostSampler:
                 continue
             prev = self._pod_pids.get(uid, {}).get(pid)
             pods.setdefault(uid, {})[pid] = prev if prev is not None else -1.0
+            if uid not in dirs:
+                pod_dir = _pod_cgroup_dir(cgroup)
+                if pod_dir is not None:
+                    dirs[uid] = pod_dir
+        self._pod_dirs = dirs
         return pods
+
+    # -- per-pod PSI -------------------------------------------------------
+
+    def _sample_pod_psi(self, sig: HostSignals) -> bool:
+        """Per-pod PSI from the kubepods pod dirs' own *.pressure files
+        ('some' kind only — the per-pod question is "is THIS pod
+        stalled", not the full/partial split). cgroup-v1 nodes have no
+        per-pod pressure files and simply contribute nothing; the
+        node-scope PSI stays the fallback signal."""
+        found = False
+        for uid, pod_dir in self._pod_dirs.items():
+            rows: dict[str, dict[str, float]] = {}
+            for resource in PSI_RESOURCES:
+                text = self._read(
+                    "sys", "fs", "cgroup", *pod_dir.split("/"),
+                    f"{resource}.pressure",
+                )
+                if text is None:
+                    continue
+                parsed = parse_psi(text).get("some")
+                if parsed is None:
+                    continue
+                rows[resource] = {
+                    "share": parsed["avg10"] / 100.0,
+                    "stall_s": parsed["total_us"] / 1e6,
+                }
+            if rows:
+                sig.pod_psi[uid] = rows
+                found = True
+        return found
 
     def _read_run_delay_ns(self, pid: int) -> float | None:
         text = self._read("proc", str(pid), "schedstat")

@@ -1,0 +1,34 @@
+"""Workload-lifecycle robustness plane (ISSUE 10).
+
+Closes the monitor↔trainer loop: the exporter probes the workload
+harness's own metrics port (``tpu_step_*`` families —
+tpumon/workload/stats.py), classifies preemption / elastic-resize /
+checkpoint-restore transitions from the joined step+device+membership
+signals, suppresses the false straggler/stall/regression verdicts a
+clean transition would otherwise raise (counted, never silent), and
+feeds step-time-regression and ICI-contention detectors into the
+anomaly engine.
+"""
+
+from tpumon.lifecycle.detectors import (
+    KINDS,
+    LIFECYCLE_DETECTOR_NAMES,
+    SUPPRESSIBLE_DETECTORS,
+    LifecycleThresholds,
+    LifecycleTracker,
+    lifecycle_detectors,
+)
+from tpumon.lifecycle.plane import LifecyclePlane
+from tpumon.lifecycle.probe import StepProbe, step_snapshot_from_text
+
+__all__ = [
+    "KINDS",
+    "LIFECYCLE_DETECTOR_NAMES",
+    "LifecyclePlane",
+    "LifecycleThresholds",
+    "LifecycleTracker",
+    "StepProbe",
+    "SUPPRESSIBLE_DETECTORS",
+    "lifecycle_detectors",
+    "step_snapshot_from_text",
+]

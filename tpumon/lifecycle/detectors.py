@@ -1,0 +1,530 @@
+"""Workload-lifecycle classification and the step-signal detectors.
+
+Three halves:
+
+- :class:`LifecycleTracker` — runs inside the LifecyclePlane's
+  poll-cycle pass, joining this cycle's workload step feeds
+  (tpumon/lifecycle/probe.py) with the SAME cycle's device snapshot
+  into a lifecycle verdict: is a **clean lifecycle transition** —
+  slice preemption, elastic resize, checkpoint restore — in progress?
+  Signatures (ISSUE 10):
+
+  - *preemption*: a feed flags SIGTERM (``tpu_step_terminating``) or a
+    previously-available feed disappears, joined with a duty collapse
+    (or runtime detach) within ``window_s``;
+  - *resize*: the device chip-set signature changes while the exporter
+    stays up (topology re-enumeration — elastic resize, not death);
+  - *restore*: a feed's checkpoint-restore span count advances, or a
+    lost feed returns reporting a restore.
+
+  A recognized transition opens a **suppression window**
+  (``suppress_s``, refreshed by further signals, closed early after
+  ``steady_cycles`` clean cycles): detectors whose verdicts are
+  *expected* during a clean transition — straggler, stall, duty/HBM
+  z-score, step regression — are suppressed by the AnomalyEngine and
+  counted (``tpu_anomaly_suppressed_total``) instead of raised. A
+  regression that persists PAST the window fires normally: suppression
+  delays detection by at most the window, it never blinds it.
+
+- :class:`StepRegressionDetector` / :class:`CollectiveWaitDetector` —
+  streaming detectors with the tpumon.anomaly observe() contract,
+  consuming the ``lifecycle`` block the plane injects into
+  PollStats.snapshot: EWMA z-score on per-feed step duration (the
+  trainer got slower), and collective-wait-fraction growth (the fabric
+  is contended — two workloads on one pool interfering reads as BOTH
+  feeds' wait fraction climbing while duty stays high, which is
+  contention, not a straggler).
+
+- :class:`LifecycleEventDetector` — translates the tracker's
+  transitions into the engine's onset/clear event stream so lifecycle
+  events get /anomalies replay, bounded rings, and history windows.
+
+Thresholds follow the AnomalyThresholds pattern: every field is a
+``TPUMON_LIFECYCLE_<FIELD>`` env var, malformed values keep the
+default, re-parsed only when the env changes.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+from dataclasses import dataclass, fields
+
+from tpumon.health import WARN
+
+log = logging.getLogger(__name__)
+
+#: Lifecycle transition kinds, in exposition order.
+KINDS = ("preemption", "resize", "restore")
+
+#: Detectors whose verdicts a clean lifecycle transition suppresses.
+#: The ``lifecycle`` detector itself is never suppressed (it IS the
+#: transition), and absence-aging still clears events normally.
+SUPPRESSIBLE_DETECTORS = (
+    "duty_ewma", "hbm_ewma", "ici_flap", "bw_cusum", "queue_stall",
+    "host_straggler", "host_stall", "step_regression", "collective_wait",
+)
+
+
+@dataclass(frozen=True)
+class LifecycleThresholds:
+    """Classifier/detector tuning, overridable via TPUMON_LIFECYCLE_*."""
+
+    #: Seconds two signature halves (SIGTERM/feed-loss and duty
+    #: collapse) may be apart and still join into one preemption.
+    window_s: float = 30.0
+    #: Suppression window opened by a recognized transition; refreshed
+    #: by further lifecycle signals.
+    suppress_s: float = 60.0
+    #: Consecutive signal-free cycles that close the window early.
+    steady_cycles: float = 10.0
+    #: Consecutive unavailable cycles before a feed counts as lost
+    #: (one failed probe is routinely a blip, not a preemption).
+    lost_cycles: float = 3.0
+    #: Mean duty at/below this reads as a duty collapse.
+    duty_collapse_pct: float = 5.0
+    #: Step-regression EWMA: samples before arming, onset/clear z, and
+    #: the relative std floor (fraction of the baseline mean) so a
+    #: near-constant step time can't make z explode on jitter.
+    step_warmup: float = 10.0
+    step_z_warn: float = 4.0
+    step_z_clear: float = 2.0
+    step_min_rel_std: float = 0.05
+    #: Collective-wait growth: samples before arming, absolute onset
+    #: floor, and the growth-over-baseline that onsets below it.
+    wait_warmup: float = 10.0
+    wait_abs_warn: float = 0.4
+    wait_growth: float = 0.15
+
+    @classmethod
+    def from_env(cls, environ=None) -> "LifecycleThresholds":
+        env = os.environ if environ is None else environ
+        kwargs = {}
+        for f in fields(cls):
+            raw = env.get("TPUMON_LIFECYCLE_" + f.name.upper())
+            if raw is None:
+                continue
+            try:
+                kwargs[f.name] = float(raw)
+            except ValueError:
+                log.warning(
+                    "ignoring malformed TPUMON_LIFECYCLE_%s=%r",
+                    f.name.upper(), raw,
+                )
+        return cls(**kwargs)
+
+
+#: (env-values key, parsed thresholds) — re-parse only when the env
+#: changed, same cache shape as anomaly/hostcorr env_thresholds.
+_env_cache: tuple | None = None
+
+
+def env_thresholds() -> LifecycleThresholds:
+    global _env_cache
+    key = tuple(
+        os.environ.get("TPUMON_LIFECYCLE_" + f.name.upper())
+        for f in fields(LifecycleThresholds)
+    )
+    if _env_cache is None or _env_cache[0] != key:
+        _env_cache = (key, LifecycleThresholds.from_env())
+    return _env_cache[1]
+
+
+class LifecycleTracker:
+    """Per-cycle lifecycle classification; poller thread only.
+
+    ``update(now, feeds, snap, t)`` returns this cycle's lifecycle
+    block (also the plane's injection payload): transition state, the
+    suppression list, newly-onset event kinds, and the joined step
+    telemetry the step detectors consume.
+    """
+
+    def __init__(self) -> None:
+        #: Last seen device chip-id signature (frozenset; None before
+        #: the first non-empty enumeration).
+        self._chip_sig: frozenset | None = None
+        #: url -> consecutive unavailable cycles (feeds that were once
+        #: available only).
+        self._gone_cycles: dict[str, int] = {}
+        #: url -> last seen restore-span count.
+        self._restores: dict[str, float] = {}
+        #: url -> feed was lost (for return-detection).
+        self._lost: set[str] = set()
+        #: Pending signature halves: kind -> ts of the half-signal.
+        self._pending_preempt_ts: float | None = None
+        self._pending_collapse_ts: float | None = None
+        #: Suppression window state.
+        self._suppress_until = 0.0
+        self._steady_streak = 0
+        #: Last recognized EVENT (not mere signal): ongoing signals may
+        #: refresh the window only within a bounded horizon of it, so a
+        #: permanently-idle node (duty 0 forever after its slice left)
+        #: cannot hold suppression open indefinitely.
+        self._last_event_ts = 0.0
+        #: Kinds already counted inside the current window (dedup).
+        self._latched: set[str] = set()
+
+    @property
+    def transition_active(self) -> bool:
+        return self._suppress_until > 0.0
+
+    def update(self, now: float, feeds: list[dict], snap: dict,
+               t: LifecycleThresholds) -> dict:
+        """One cycle. ``feeds``: [{url, available, was_available,
+        snapshot}, ...]; ``snap``: this cycle's parsed device snapshot
+        (tpumon.smi shape)."""
+        new_events: list[str] = []
+        signals: list[str] = []
+
+        # -- device-side signals ------------------------------------------
+        chips = snap.get("chips") or {}
+        duties = [
+            row.get("duty_pct") for row in chips.values()
+            if row.get("duty_pct") is not None
+        ]
+        mean_duty = sum(duties) / len(duties) if duties else None
+        collapse = mean_duty is not None and mean_duty <= t.duty_collapse_pct
+        sig = frozenset(chips)
+        detached = self._chip_sig is not None and self._chip_sig and not sig
+        resized = (
+            self._chip_sig is not None
+            and bool(self._chip_sig)
+            and bool(sig)
+            and sig != self._chip_sig
+        )
+        if sig or self._chip_sig is None:
+            # Empty enumerations don't overwrite the remembered shape:
+            # a detach-then-return must compare against the pre-detach
+            # signature, or every recovery would read as a resize.
+            if resized:
+                signals.append("membership")
+            self._chip_sig = sig if sig else self._chip_sig
+        if collapse or detached:
+            self._pending_collapse_ts = now
+            signals.append("collapse" if collapse else "detach")
+
+        # -- workload-side signals ----------------------------------------
+        terminating = False
+        lost = False
+        restored = False
+        returned = False
+        for feed in feeds:
+            url = feed["url"]
+            fsnap = feed.get("snapshot") or {}
+            if feed.get("available"):
+                if url in self._lost:
+                    self._lost.discard(url)
+                    returned = True
+                self._gone_cycles[url] = 0
+                if fsnap.get("terminating"):
+                    terminating = True
+                restore_count = (
+                    (fsnap.get("checkpoints") or {})
+                    .get("restore", {})
+                    .get("count")
+                )
+                if restore_count is not None:
+                    seen = self._restores.get(url, 0.0)
+                    if restore_count > seen:
+                        restored = True
+                    self._restores[url] = restore_count
+            elif feed.get("was_available"):
+                n = self._gone_cycles.get(url, 0) + 1
+                self._gone_cycles[url] = n
+                if n == int(max(1, t.lost_cycles)):
+                    lost = True
+                    self._lost.add(url)
+                    # A lost feed's process is (about to be) gone; the
+                    # replacement restarts its restore counter from
+                    # scratch. Forget the high-water mark, or a
+                    # rescheduled pod's restore (count 1 again) would
+                    # never read as new and the restore storm it is
+                    # part of would go unclassified.
+                    self._restores.pop(url, None)
+        if terminating:
+            signals.append("terminating")
+            self._pending_preempt_ts = now
+        if lost:
+            signals.append("feed_lost")
+            self._pending_preempt_ts = now
+        if restored:
+            signals.append("restore_span")
+        if returned:
+            signals.append("feed_returned")
+
+        # -- classification -----------------------------------------------
+        def onset(kind: str) -> None:
+            if kind not in self._latched:
+                self._latched.add(kind)
+                new_events.append(kind)
+            self._last_event_ts = now
+            self._suppress_until = max(
+                self._suppress_until, now + t.suppress_s
+            )
+
+        if (
+            self._pending_preempt_ts is not None
+            and self._pending_collapse_ts is not None
+            and abs(self._pending_preempt_ts - self._pending_collapse_ts)
+            <= t.window_s
+        ):
+            onset("preemption")
+            self._pending_preempt_ts = None
+            self._pending_collapse_ts = None
+        if resized:
+            onset("resize")
+        if restored:
+            # Only a restore SPAN reads as a restore; a plain feed
+            # return (probe blip, rescheduled pod that did not restore)
+            # does not.
+            onset("restore")
+        # Expire stale half-signals so a SIGTERM today can't pair with
+        # a duty collapse an hour later.
+        for attr in ("_pending_preempt_ts", "_pending_collapse_ts"):
+            ts = getattr(self, attr)
+            if ts is not None and now - ts > t.window_s:
+                setattr(self, attr, None)
+
+        # -- window upkeep -------------------------------------------------
+        if self._suppress_until > 0.0:
+            if signals:
+                self._steady_streak = 0
+                # Ongoing lifecycle signals (duty still collapsed, the
+                # feed still flagging SIGTERM) REFRESH the window — a
+                # 20 s preempted phase must not lapse between the
+                # preemption event and the restore — but only within a
+                # bounded horizon of the last recognized event, so a
+                # node that stays idle forever eventually returns to
+                # normal detection (an idle node's wedged runtime is
+                # still queue_stall's to find).
+                if now - self._last_event_ts <= 4.0 * t.suppress_s:
+                    self._suppress_until = max(
+                        self._suppress_until, now + t.suppress_s
+                    )
+            else:
+                self._steady_streak += 1
+                if self._steady_streak >= int(max(1, t.steady_cycles)):
+                    # Early close: the transition finished and the node
+                    # has been quiet — stop deferring real detection.
+                    self._suppress_until = 0.0
+            if now >= self._suppress_until:
+                self._suppress_until = 0.0
+            if self._suppress_until == 0.0:
+                self._latched.clear()
+                self._steady_streak = 0
+
+        active = self._suppress_until > 0.0
+        block: dict = {
+            "transition": active,
+            "kinds": sorted(self._latched) if active else [],
+            "new_events": new_events,
+            "signals": signals,
+            "suppress": list(SUPPRESSIBLE_DETECTORS) if active else [],
+            "suppress_until": self._suppress_until if active else None,
+            "mean_duty_pct": mean_duty,
+        }
+        return block
+
+
+class StepRegressionDetector:
+    """EWMA z-score on per-feed step duration: the trainer got slower.
+
+    The baseline freezes while anomalous (a regression that *stays*
+    regressed keeps its event active) and RESETS on a lifecycle
+    transition — after an elastic resize the mesh changed, so the old
+    step-time baseline is not evidence about the new one; the detector
+    re-warms on post-transition data and genuine post-event regressions
+    still fire, just ``step_warmup`` cycles later.
+    """
+
+    name = "step_regression"
+    _family = "tpu_lifecycle_step_duration_seconds"
+
+    def __init__(self) -> None:
+        #: feed url -> (_Ewma-style mean/var/n) on step seconds.
+        self._state: dict[str, list] = {}  # url -> [mean, var, n]
+        self._active: set[str] = set()
+
+    def _reset(self) -> None:
+        self._state.clear()
+        self._active.clear()
+
+    def observe(self, ts: float, snap: dict, t) -> list:
+        from tpumon.anomaly.detectors import Reading
+
+        lc = snap.get("lifecycle") or {}
+        lt = env_thresholds()
+        if lc.get("transition"):
+            # The transition is the explanation; re-baseline after it.
+            self._reset()
+            return []
+        out: list[Reading] = []
+        feeds = lc.get("feeds") or {}
+        for url in sorted(feeds):
+            step_s = (feeds[url] or {}).get("step_seconds")
+            if step_s is None or step_s <= 0:
+                continue
+            mean, var, n = self._state.setdefault(url, [0.0, 0.0, 0])
+            alpha = 0.1
+            if n >= lt.step_warmup:
+                std = max(
+                    math.sqrt(max(var, 0.0)),
+                    lt.step_min_rel_std * max(mean, 1e-9),
+                )
+                z = (step_s - mean) / std
+                was = url in self._active
+                # One-sided: only SLOWER is a regression (faster steps
+                # re-baseline silently — nobody pages on a speedup).
+                active = z >= (lt.step_z_clear if was else lt.step_z_warn)
+                if active or was:
+                    out.append(
+                        Reading(
+                            f"feed:{url}",
+                            active,
+                            WARN,
+                            step_s,
+                            f"workload step time {step_s * 1e3:.0f} ms is "
+                            f"{z:.1f}σ above its {mean * 1e3:.0f} ms "
+                            "baseline — step-time regression",
+                            self._family,
+                            (),
+                        )
+                    )
+                if active:
+                    self._active.add(url)
+                    continue  # freeze baseline while anomalous
+                self._active.discard(url)
+            # EWMA update (unfrozen path).
+            if n == 0:
+                self._state[url] = [step_s, 0.0, 1]
+            else:
+                d = step_s - mean
+                mean += alpha * d
+                var = (1.0 - alpha) * (var + alpha * d * d)
+                self._state[url] = [mean, var, n + 1]
+        return out
+
+
+class CollectiveWaitDetector:
+    """Collective-wait-fraction growth: ICI contention, not a straggler.
+
+    Two workloads on one pool interfering shows as BOTH feeds' wait
+    fraction climbing while duty stays high and no chip lags the slice
+    median — the attribution the straggler plane cannot make alone.
+    """
+
+    name = "collective_wait"
+    _family = "tpu_lifecycle_collective_wait_fraction"
+
+    def __init__(self) -> None:
+        self._state: dict[str, list] = {}  # url -> [mean, n]
+        self._active: set[str] = set()
+
+    def observe(self, ts: float, snap: dict, t) -> list:
+        from tpumon.anomaly.detectors import Reading
+
+        lc = snap.get("lifecycle") or {}
+        lt = env_thresholds()
+        if lc.get("transition"):
+            self._state.clear()
+            self._active.clear()
+            return []
+        out: list[Reading] = []
+        feeds = lc.get("feeds") or {}
+        for url in sorted(feeds):
+            frac = (feeds[url] or {}).get("collective_wait_fraction")
+            if frac is None:
+                continue
+            mean, n = self._state.setdefault(url, [0.0, 0])
+            if n >= lt.wait_warmup:
+                threshold = min(lt.wait_abs_warn, mean + lt.wait_growth)
+                was = url in self._active
+                active = frac >= (threshold / 2.0 if was else threshold)
+                if active or was:
+                    out.append(
+                        Reading(
+                            f"feed:{url}",
+                            active,
+                            WARN,
+                            frac,
+                            f"collective-wait fraction {frac:.0%} (baseline "
+                            f"{mean:.0%}) — ICI contention: the fabric is "
+                            "contended, the chips are busy; interference, "
+                            "not a straggler",
+                            self._family,
+                            (),
+                        )
+                    )
+                if active:
+                    self._active.add(url)
+                    continue  # freeze baseline while contended
+                self._active.discard(url)
+            self._state[url] = [mean + 0.1 * (frac - mean), n + 1]
+        return out
+
+
+class LifecycleEventDetector:
+    """Engine adapter over the tracker's transitions: one event per
+    suppression window, message naming the recognized kinds — so
+    preemption/resize/restore get /anomalies replay and rings."""
+
+    name = "lifecycle"
+    _family = "tpu_lifecycle_state"
+
+    def __init__(self) -> None:
+        self._active = False
+
+    def observe(self, ts: float, snap: dict, t) -> list:
+        from tpumon.anomaly.detectors import Reading
+
+        lc = snap.get("lifecycle") or {}
+        active = bool(lc.get("transition"))
+        was = self._active
+        self._active = active
+        if not active and not was:
+            return []
+        kinds = lc.get("kinds") or []
+        return [
+            Reading(
+                "node",
+                active,
+                WARN,
+                float(len(kinds)),
+                "workload lifecycle transition "
+                f"({'/'.join(kinds) if kinds else 'signals pending'}) — "
+                "straggler/stall/regression verdicts suppressed while "
+                "the window holds",
+                self._family,
+                (),
+            )
+        ]
+
+
+def lifecycle_detectors() -> list:
+    """The step/lifecycle detector roster appended to the anomaly
+    engine when the lifecycle plane is enabled."""
+    return [
+        StepRegressionDetector(),
+        CollectiveWaitDetector(),
+        LifecycleEventDetector(),
+    ]
+
+
+LIFECYCLE_DETECTOR_NAMES: tuple[str, ...] = (
+    "step_regression", "collective_wait", "lifecycle",
+)
+
+
+__all__ = [
+    "KINDS",
+    "LIFECYCLE_DETECTOR_NAMES",
+    "LifecycleEventDetector",
+    "LifecycleThresholds",
+    "LifecycleTracker",
+    "CollectiveWaitDetector",
+    "StepRegressionDetector",
+    "SUPPRESSIBLE_DETECTORS",
+    "env_thresholds",
+    "lifecycle_detectors",
+]

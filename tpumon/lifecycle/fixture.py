@@ -1,0 +1,178 @@
+"""Hermetic doubles for the lifecycle plane: a scripted workload feed
+and a lifecycle-event device backend.
+
+Test/CI doubles mirroring the roles FakeProcTree/StragglerBackend play
+for the hostcorr plane:
+
+- :class:`ScriptedWorkload` serves a REAL harness-style metrics page —
+  the same :class:`~tpumon.workload.stats.WorkloadStats` +
+  ``StatsCollector`` + ``ExporterServer`` stack ``python -m
+  tpumon.workload.harness --metrics-port`` runs, minus jax — with
+  setters to script step rate, phase times, collective-wait fraction,
+  SIGTERM flags, and checkpoint spans mid-run. What the lifecycle
+  plane's probe parses in tests is byte-for-byte what a live harness
+  serves.
+- :class:`LifecycleBackend` wraps any device backend and scripts the
+  device half of the lifecycle signatures: duty collapse (preemption),
+  a shrunken visible chip set (elastic resize → topology
+  re-enumeration), while counting every ``sample()`` call — the
+  "zero additional device queries per cycle" evidence in
+  ``soak.py --preempt``.
+
+Used by tests/test_lifecycle.py and tools/soak.py; never imported by
+the exporter itself.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import Counter
+
+
+class ScriptedWorkload:
+    """One scriptable workload feed (ephemeral port by default;
+    ``port`` pins it so a "preempted" feed can return on the same
+    address, the way a rescheduled pod keeps its Service endpoint)."""
+
+    def __init__(self, steps_per_second: float = 2.0, port: int = 0) -> None:
+        from prometheus_client.registry import CollectorRegistry
+
+        from tpumon.exporter.server import (
+            ExporterServer,
+            _make_app,
+            registry_renderer,
+        )
+        from tpumon.exporter.telemetry import SelfTelemetry
+        from tpumon.workload.stats import StatsCollector, WorkloadStats
+
+        self.stats = WorkloadStats()
+        self.stats.configure(
+            flops_per_step=1e9, tokens_per_step=1024,
+            peak_flops_total=None, axes={"dp": 2, "tp": 2},
+        )
+        registry = CollectorRegistry()
+        registry.register(StatsCollector(self.stats))
+        telemetry = SelfTelemetry(registry)
+        telemetry.last_poll.set(time.time())
+        telemetry.up.set(1)  # same stance as the harness: serving is liveness
+        inner = _make_app(
+            registry_renderer(registry), telemetry, lambda: (True, "ok\n")
+        )
+        #: Process-death emulation: server.close() stops the LISTENER,
+        #: but a prober's keep-alive connection rides its handler thread
+        #: and would keep being served — a "preempted" feed that still
+        #: answers. A real SIGKILL drops every connection; the closest
+        #: WSGI-level equivalent is refusing with 503 (the probe treats
+        #: any non-200 as feed-gone and drops its connection).
+        self._dead = False
+
+        def app(environ, start_response):
+            if self._dead:
+                body = b"workload gone\n"
+                start_response(
+                    "503 Service Unavailable",
+                    [
+                        ("Content-Type", "text/plain; charset=utf-8"),
+                        ("Content-Length", str(len(body))),
+                    ],
+                )
+                return [body]
+            return inner(environ, start_response)
+
+        self.server = ExporterServer(app, "127.0.0.1", port)
+        self._steps = 0
+        self.set_rate(steps_per_second)
+
+    @property
+    def url(self) -> str:
+        return self.server.url
+
+    def start(self) -> None:
+        self.server.start()
+
+    def close(self) -> None:
+        self._dead = True  # live keep-alive connections start refusing
+        self.server.close()
+
+    # -- script surface ----------------------------------------------------
+
+    def set_rate(self, steps_per_second: float, loss: float = 2.5) -> None:
+        """Publish one window at this rate (step counter advances)."""
+        window_steps = max(1, int(steps_per_second))
+        self._steps += window_steps
+        self.stats.record(
+            loss, window_steps, window_steps / max(steps_per_second, 1e-9)
+        )
+
+    def set_phases(self, fwd: float, bwd: float, optimizer: float) -> None:
+        self.stats.record_phases(
+            {"fwd": fwd, "bwd": bwd, "optimizer": optimizer}
+        )
+
+    def set_collective_wait(self, fraction: float) -> None:
+        self.stats.record_collective_wait(fraction)
+
+    def mark_terminating(self) -> None:
+        self.stats.mark_terminating()
+
+    def record_checkpoint(self, op: str, seconds: float) -> None:
+        self.stats.record_checkpoint(op, seconds)
+
+
+class LifecycleBackend:
+    """Wraps a device backend; scripts duty collapse and elastic resize
+    while counting every device query."""
+
+    def __init__(self, inner) -> None:
+        self._inner = inner
+        #: True = every chip reports ~0 duty (slice preempted).
+        self.duty_zero = False
+        #: Visible chip cap (None = all): topology() and per-chip
+        #: samples truncate to the first N chips — the elastic-resize
+        #: re-enumeration signature.
+        self.visible_chips: int | None = None
+        #: metric name -> sample() call count (query-budget evidence).
+        self.calls: Counter = Counter()
+
+    @property
+    def name(self) -> str:
+        return self._inner.name
+
+    def topology(self):
+        topo = self._inner.topology()
+        if self.visible_chips is None or self.visible_chips >= len(topo.chips):
+            return topo
+        import dataclasses
+
+        # num_chips/num_cores are derived properties of `chips`, so one
+        # replace() re-enumerates the whole identity surface.
+        return dataclasses.replace(
+            topo, chips=topo.chips[: self.visible_chips]
+        )
+
+    def sample(self, metric: str):
+        from tpumon.backends.base import RawMetric
+
+        self.calls[metric] += 1
+        raw = self._inner.sample(metric)
+        n = len(raw.data)
+        if self.visible_chips is not None and n:
+            # Per-chip and per-core vectors truncate with the topology
+            # (a real re-enumeration shrinks every surface together);
+            # other payload shapes (per-link strings) pass through.
+            topo = self._inner.topology()
+            full = len(topo.chips)
+            if full and self.visible_chips < full:
+                if n == full:
+                    raw = RawMetric(metric, raw.data[: self.visible_chips])
+                elif n == topo.num_cores and full:
+                    per_chip = n // full
+                    raw = RawMetric(
+                        metric, raw.data[: self.visible_chips * per_chip]
+                    )
+        if metric == "duty_cycle_pct" and self.duty_zero and raw.data:
+            return RawMetric(metric, tuple("0.00" for _ in raw.data))
+        return raw
+
+    def __getattr__(self, attr):
+        return getattr(self._inner, attr)

@@ -1,0 +1,263 @@
+"""The workload-lifecycle robustness plane wired into the poll loop.
+
+One :meth:`LifecyclePlane.cycle` call per poll, fed the PollStats the
+collector already computed. The pass:
+
+1. probes the configured workload step feeds (bounded localhost HTTP,
+   tpumon/lifecycle/probe.py — **zero device queries**, preserving the
+   collector's scrape-latency design rule);
+2. joins them with the SAME cycle's device snapshot through the
+   :class:`~tpumon.lifecycle.detectors.LifecycleTracker`: is a clean
+   preemption / elastic resize / checkpoint restore in progress?
+3. appends one time-aligned record to the bounded lifecycle ring
+   (served as ``GET /lifecycle``, ``?since=`` replay like /hostcorr);
+4. injects a ``lifecycle`` block into ``PollStats.snapshot`` so the
+   anomaly engine sees the suppression list and the step detectors
+   (step_regression, collective_wait) see the per-feed step telemetry;
+5. returns the ``tpu_lifecycle_*`` families for this cycle's page
+   (names/help/labels from the LIFECYCLE_FAMILIES registry, so docs
+   and dashboards cannot drift).
+
+Graceful degradation: with no feeds configured the plane still tracks
+device-side lifecycle signatures (resize via topology re-enumeration);
+an unreachable feed is the NORMAL no-workload state, never an error.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import Counter, deque
+
+from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
+
+from tpumon.lifecycle.detectors import LifecycleTracker, env_thresholds
+from tpumon.lifecycle.probe import StepProbe, parse_step_urls
+
+log = logging.getLogger(__name__)
+
+
+class LifecyclePlane:
+    """Thread model: ``cycle`` runs on the poller thread only;
+    ``replay``/``snapshot``/``resize`` may be called from HTTP threads —
+    shared state (ring, last record, event totals) is guarded by one
+    lock held for deque/dict work only."""
+
+    def __init__(
+        self,
+        step_urls: str = "",
+        ring: int = 600,
+        probes: list | None = None,
+        probe_timeout: float = 1.0,
+    ) -> None:
+        self._probes = (
+            probes
+            if probes is not None
+            else [
+                StepProbe(url, timeout=probe_timeout)
+                for url in parse_step_urls(step_urls)
+            ]
+        )
+        self._tracker = LifecycleTracker()
+        self._full_ring = max(1, int(ring))
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self._full_ring)  # guarded-by: self._lock
+        self._last: dict | None = None  # guarded-by: self._lock
+        self._totals: Counter = Counter()  # guarded-by: self._lock
+        self._cycles = 0  # guarded-by: self._lock
+
+    @property
+    def ring_capacity(self) -> int:
+        return self._full_ring
+
+    @property
+    def probes(self) -> list:
+        return self._probes
+
+    def resize(self, n: int) -> None:
+        """Re-cap the lifecycle ring in place — the memory-watermark
+        response (tpumon/guard/memwatch); newest records retained,
+        reversible."""
+        n = max(1, int(n))
+        with self._lock:
+            if n == self._ring.maxlen:
+                return
+            self._ring = deque(self._ring, maxlen=n)
+
+    def close(self) -> None:
+        for probe in self._probes:
+            probe.close()
+
+    # -- poll-loop integration --------------------------------------------
+
+    def cycle(self, now: float, stats) -> list:
+        """One Poller cycle: probe, classify, record, inject, emit."""
+        t = env_thresholds()
+        feeds: list[dict] = []
+        feed_snaps: dict[str, dict] = {}
+        available = 0
+        for probe in self._probes:
+            ok, snap = probe.sample()
+            if ok:
+                available += 1
+                feed_snaps[probe.url] = snap
+            feeds.append(
+                {
+                    "url": probe.url,
+                    "available": ok,
+                    "was_available": probe.was_available,
+                    "snapshot": snap,
+                }
+            )
+        device_snap = stats.snapshot if stats.snapshot is not None else {}
+        block = self._tracker.update(now, feeds, device_snap, t)
+        block["feeds"] = feed_snaps
+        block["available"] = available
+        block["configured"] = len(self._probes)
+
+        # Joined step telemetry across available feeds: mean step rate
+        # (hosts in one dp job all report the job's rate — a mean, not a
+        # sum, is the honest merge), worst collective wait.
+        rates = [
+            s.get("steps_per_second")
+            for s in feed_snaps.values()
+            if s.get("steps_per_second") is not None
+        ]
+        durations = [
+            s.get("step_seconds")
+            for s in feed_snaps.values()
+            if s.get("step_seconds") is not None
+        ]
+        waits = [
+            s.get("collective_wait_fraction")
+            for s in feed_snaps.values()
+            if s.get("collective_wait_fraction") is not None
+        ]
+        step_rate = sum(rates) / len(rates) if rates else None
+        step_seconds = sum(durations) / len(durations) if durations else None
+        worst_wait = max(waits) if waits else None
+
+        record = {
+            "ts": now,
+            "transition": block["transition"],
+            "kinds": list(block["kinds"]),
+            "signals": list(block["signals"]),
+            "new_events": list(block["new_events"]),
+            "workloads": {"configured": len(self._probes), "available": available},
+            "step_rate": step_rate,
+            "step_seconds": step_seconds,
+            "collective_wait_fraction": worst_wait,
+            "mean_duty_pct": block.get("mean_duty_pct"),
+        }
+        with self._lock:
+            self._cycles += 1
+            for kind in block["new_events"]:
+                self._totals[kind] += 1
+            self._ring.append(record)
+            self._last = record
+            totals = dict(self._totals)
+
+        if stats.snapshot is not None:
+            # The anomaly engine reads this block from the snapshot it
+            # is fed anyway — the suppression list and the step
+            # detectors' inputs travel on the same bus, no side channel.
+            stats.snapshot["lifecycle"] = block
+        return self._families(
+            stats.base_keys, stats.base_vals, block,
+            step_rate, step_seconds, worst_wait, totals, available,
+        )
+
+    # -- exposition --------------------------------------------------------
+
+    def _families(
+        self, base_keys, base_vals, block,
+        step_rate, step_seconds, worst_wait, totals, available,
+    ) -> list:
+        from tpumon.families import LIFECYCLE_FAMILIES
+
+        labels = tuple(base_keys)
+        vals = tuple(base_vals)
+
+        def fam(name, cls):
+            _, help_text, extra = LIFECYCLE_FAMILIES[name]
+            return cls(name, help_text, labels=labels + extra)
+
+        workloads = fam("tpu_lifecycle_workloads", GaugeMetricFamily)
+        workloads.add_metric(vals + ("available",), float(available))
+        workloads.add_metric(
+            vals + ("absent",), float(len(self._probes) - available)
+        )
+        state = fam("tpu_lifecycle_state", GaugeMetricFamily)
+        state.add_metric(vals, 1.0 if block["transition"] else 0.0)
+        out = [workloads, state]
+
+        if totals:
+            events = fam("tpu_lifecycle_events_total", CounterMetricFamily)
+            for kind in sorted(totals):
+                events.add_metric(vals + (kind,), float(totals[kind]))
+            out.append(events)
+        if step_rate is not None:
+            rate = fam("tpu_lifecycle_step_rate", GaugeMetricFamily)
+            rate.add_metric(vals, step_rate)
+            out.append(rate)
+        if step_seconds is not None:
+            dur = fam(
+                "tpu_lifecycle_step_duration_seconds", GaugeMetricFamily
+            )
+            dur.add_metric(vals, step_seconds)
+            out.append(dur)
+        if worst_wait is not None:
+            wait = fam(
+                "tpu_lifecycle_collective_wait_fraction", GaugeMetricFamily
+            )
+            wait.add_metric(vals, worst_wait)
+            out.append(wait)
+        return out
+
+    # -- query surfaces ----------------------------------------------------
+
+    def replay(self, since: float = 0.0) -> tuple[dict, list]:
+        """(/lifecycle envelope, records at/after ``since``) — the
+        server bounds the record list and stamps continuation tokens."""
+        with self._lock:
+            records = [r for r in self._ring if r["ts"] >= since]
+            last = self._last
+            totals = dict(self._totals)
+            cycles = self._cycles
+            capacity = self._ring.maxlen
+        doc = {
+            "cycles": cycles,
+            "ring_capacity": capacity,
+            "workloads": dict(last["workloads"]) if last else {
+                "configured": len(self._probes), "available": 0
+            },
+            "transition": bool(last and last["transition"]),
+            "kinds": list(last["kinds"]) if last else [],
+            "events_total": totals,
+        }
+        return doc, records
+
+    def snapshot(self) -> dict:
+        """The /debug/vars "lifecycle" block: O(1) occupancy + state."""
+        with self._lock:
+            return {
+                "cycles": self._cycles,
+                "records": len(self._ring),
+                "ring_capacity": self._ring.maxlen,
+                "workloads": (
+                    dict(self._last["workloads"]) if self._last else {
+                        "configured": len(self._probes), "available": 0
+                    }
+                ),
+                "transition": bool(self._last and self._last["transition"]),
+                "kinds": list(self._last["kinds"]) if self._last else [],
+                "events_total": dict(self._totals),
+                "probes": [
+                    {
+                        "url": p.url,
+                        "available": p.available,
+                        "error": p.last_error or None,
+                    }
+                    for p in self._probes
+                ],
+            }

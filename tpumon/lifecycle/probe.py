@@ -1,0 +1,204 @@
+"""Workload step-feed probing: the monitor watches the trainer it ships.
+
+One :class:`StepProbe` per configured workload metrics URL
+(``TPUMON_LIFECYCLE_STEP_URLS``, CSV — typically the harness's
+``--metrics-port`` on localhost). The probe runs once per poll cycle on
+the poller thread: a bounded keep-alive HTTP GET plus a targeted line
+parse — **zero device queries**, same budget rule as tpumon/hostcorr.
+A workload that isn't running is the NORMAL state, not an error: the
+feed reads ``available=False`` and every step-derived family goes
+absent (absent-not-zero).
+
+The parser is the fleet tier's targeted-line-scan idiom
+(tpumon/fleet/ingest.py node_snapshot_from_text): the lifecycle plane
+wants ~10 families off a page whose bulk is collective-op counters, so
+scanning lines beats a general exposition parse by the same two orders
+of magnitude measured there.
+"""
+
+from __future__ import annotations
+
+import http.client
+import logging
+import re
+import urllib.error
+
+log = logging.getLogger(__name__)
+
+#: Everything a workload page fetch can throw (the fleet ingest set).
+PROBE_ERRORS: tuple[type[BaseException], ...] = (
+    urllib.error.URLError,
+    OSError,
+    http.client.HTTPException,
+    ValueError,
+)
+
+#: Workload pages are small (a few KB of counters); a page past this is
+#: not a harness.
+MAX_PAGE_BYTES = 1 << 20
+
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+
+#: Bare-value families lifted into the snapshot, name -> snapshot key.
+_SCALARS = {
+    "tpu_step_counter": "step",
+    "tpu_step_duration_seconds": "step_seconds",
+    "tpu_step_collective_wait_fraction": "collective_wait_fraction",
+    "tpu_step_terminating": "terminating",
+    "workload_steps_per_second": "steps_per_second",
+    "workload_steps_total": "steps_total",
+    "workload_loss": "loss",
+    "workload_mfu_ratio": "mfu",
+}
+
+
+def step_snapshot_from_text(text: str) -> dict:
+    """Parse one workload /metrics page into the lifecycle plane's step
+    snapshot. Keys absent when the page doesn't carry them."""
+    snap: dict = {}
+    phases: dict[str, float] = {}
+    checkpoints: dict[str, dict[str, float]] = {}
+    for line in text.splitlines():
+        if not line or line[0] == "#":
+            continue
+        brace = line.find("{")
+        space = line.find(" ") if brace < 0 else -1
+        name = line[:brace] if brace >= 0 else line[:space]
+        if name in _SCALARS:
+            try:
+                snap[_SCALARS[name]] = float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                continue
+        elif name == "tpu_step_phase_seconds":
+            labels = dict(_LABEL_RE.findall(line[brace:line.rfind("}") + 1]))
+            try:
+                phases[labels.get("phase", "?")] = float(
+                    line.rsplit(" ", 1)[1]
+                )
+            except ValueError:
+                continue
+        elif name in ("tpu_step_checkpoint_seconds",
+                      "tpu_step_checkpoints_total"):
+            labels = dict(_LABEL_RE.findall(line[brace:line.rfind("}") + 1]))
+            op = labels.get("op", "?")
+            try:
+                value = float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                continue
+            row = checkpoints.setdefault(op, {})
+            if name == "tpu_step_checkpoint_seconds":
+                row["last_s"] = value
+            else:
+                row["count"] = value
+        elif name == "workload_mesh_info":
+            labels = dict(_LABEL_RE.findall(line[brace:line.rfind("}") + 1]))
+            axes = {}
+            for axis in ("dp", "tp", "sp", "pp", "ep"):
+                try:
+                    axes[axis] = int(labels.get(axis, "1"))
+                except ValueError:
+                    axes[axis] = 1
+            snap["axes"] = axes
+    if "terminating" in snap:
+        snap["terminating"] = snap["terminating"] > 0
+    if phases:
+        snap["phases"] = phases
+    if checkpoints:
+        snap["checkpoints"] = checkpoints
+    return snap
+
+
+class StepProbe:
+    """One workload feed's probe state; poller thread only.
+
+    ``sample()`` returns ``(available, snapshot)``: available means the
+    fetch succeeded AND the page parsed as a workload page (it carries
+    at least one step/workload family). Consecutive failures after a
+    period of availability are the feed-loss signal the preemption
+    classifier consumes — surfaced as ``was_available``.
+    """
+
+    def __init__(self, url: str, timeout: float = 1.0) -> None:
+        self.url = url.strip().rstrip("/")
+        if not self.url.startswith(("http://", "https://")):
+            self.url = "http://" + self.url
+        self._tls = self.url.startswith("https://")
+        #: host[:port] only — a URL carrying a path must not poison the
+        #: connection's host string.
+        self._host = self.url.split("//", 1)[1].split("/", 1)[0]
+        self.timeout = timeout
+        self.available = False
+        #: True once this feed has EVER answered — distinguishes "no
+        #: workload scheduled here yet" from "the workload went away".
+        self.was_available = False
+        self.snapshot: dict = {}
+        self.last_error = ""
+        #: Persistent connection; probe() is poller-thread-only.
+        self._conn: http.client.HTTPConnection | None = None
+
+    def _fetch(self) -> str:
+        if self._conn is None:
+            conn_cls = (
+                http.client.HTTPSConnection
+                if self._tls
+                else http.client.HTTPConnection
+            )
+            self._conn = conn_cls(self._host, timeout=self.timeout)
+        try:
+            self._conn.request("GET", "/metrics")
+            resp = self._conn.getresponse()
+            body = resp.read(MAX_PAGE_BYTES + 1)
+            if resp.status != 200:
+                raise http.client.HTTPException(f"status {resp.status}")
+            if len(body) > MAX_PAGE_BYTES:
+                raise ValueError("workload page exceeds size cap")
+            return body.decode()
+        except BaseException:
+            # Whatever happened, the connection's framing is suspect.
+            try:
+                self._conn.close()
+            finally:
+                self._conn = None
+            raise
+
+    def sample(self) -> tuple[bool, dict]:
+        try:
+            text = self._fetch()
+        except PROBE_ERRORS as exc:
+            self.available = False
+            self.last_error = str(exc)[:200]
+            return False, self.snapshot
+        snap = step_snapshot_from_text(text)
+        if not snap:
+            # Something answered on the port but it isn't a workload
+            # page — treat as absent, keep the last real snapshot.
+            self.available = False
+            self.last_error = "no step families on page"
+            return False, self.snapshot
+        self.available = True
+        self.was_available = True
+        self.snapshot = snap
+        self.last_error = ""
+        return True, snap
+
+    def close(self) -> None:
+        conn = self._conn
+        if conn is not None:
+            self._conn = None
+            conn.close()
+
+
+def parse_step_urls(raw: str) -> list[str]:
+    """``TPUMON_LIFECYCLE_STEP_URLS`` CSV -> cleaned URL list."""
+    if not raw or not raw.strip():
+        return []
+    return [p.strip() for p in raw.split(",") if p.strip()]
+
+
+__all__ = [
+    "MAX_PAGE_BYTES",
+    "PROBE_ERRORS",
+    "StepProbe",
+    "parse_step_urls",
+    "step_snapshot_from_text",
+]

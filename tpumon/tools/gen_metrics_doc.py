@@ -17,7 +17,9 @@ from tpumon.families import (
     HEALTH_FAMILIES,
     HOSTCORR_FAMILIES,
     IDENTITY_FAMILIES,
+    LIFECYCLE_FAMILIES,
     SELF_FAMILIES,
+    STEP_FAMILIES,
     WORKLOAD_FAMILIES,
     distribution_family_rows,
 )
@@ -149,6 +151,32 @@ def render() -> str:
         label_s = ", ".join(f"`{l}`" for l in labels) or "—"
         lines.append(f"| `{name}` | {kind} | {desc} | {label_s} |")
 
+    lines += [
+        "",
+        "## Workload-lifecycle robustness plane (`tpumon/lifecycle`)",
+        "",
+        "The exporter probes the workload harness's metrics port",
+        "(`tpu_step_*` families below) once per poll cycle — zero device",
+        "queries — and classifies preemption / elastic-resize /",
+        "checkpoint-restore transitions from the joined",
+        "step+device+membership signals. A recognized clean transition",
+        "opens a suppression window: straggler/stall/regression verdicts",
+        "are counted into `tpu_anomaly_suppressed_total` instead of raised,",
+        "and regressions persisting past the window fire normally.",
+        "Time-aligned records replay via `GET /lifecycle` (`?since=`);",
+        "step_regression / collective_wait / lifecycle events ride",
+        "`/anomalies`. Enabled by default; `TPUMON_LIFECYCLE=0` disables,",
+        "`TPUMON_LIFECYCLE_STEP_URLS` names the workload feeds,",
+        "`TPUMON_LIFECYCLE_<FIELD>` tunes thresholds",
+        "(`tpumon/lifecycle/detectors.py`).",
+        "",
+        "| family | type | description | extra labels |",
+        "|---|---|---|---|",
+    ]
+    for name, (kind, desc, labels) in LIFECYCLE_FAMILIES.items():
+        label_s = ", ".join(f"`{l}`" for l in labels) or "—"
+        lines.append(f"| `{name}` | {kind} | {desc} | {label_s} |")
+
     from tpumon.families import host_family_rows
 
     lines += [
@@ -204,6 +232,23 @@ def render() -> str:
         "|---|---|",
     ]
     for name, desc in WORKLOAD:
+        lines.append(f"| `{name}` | {desc} |")
+
+    lines += [
+        "",
+        "## Per-step phase telemetry (harness `--metrics-port`, `tpu_step_*`)",
+        "",
+        "Exported by the workload harness itself",
+        "(`tpumon/workload/stats.py`) and consumed by the exporter's",
+        "lifecycle plane — the monitor↔trainer loop. Phase timings need",
+        "`--phase-stats` (one instrumented step per stats window);",
+        "`tpu_step_terminating` flips inside the SIGTERM grace window",
+        "(`TPUMON_STEP_TERM_GRACE_S`).",
+        "",
+        "| family | description |",
+        "|---|---|",
+    ]
+    for name, desc in STEP_FAMILIES.items():
         lines.append(f"| `{name}` | {desc} |")
     lines.append("")
     return "\n".join(lines)

@@ -561,6 +561,620 @@ def straggler_soak(
     }
 
 
+#: Detectors whose events inside a CLEAN lifecycle window count as
+#: false positives in the lifecycle soak evidence (the suppressible
+#: roster minus nothing: during a clean transition none of these should
+#: produce a retained event).
+_LC_FALSE_SET = (
+    "duty_ewma", "hbm_ewma", "ici_flap", "bw_cusum", "queue_stall",
+    "host_straggler", "host_stall", "step_regression", "collective_wait",
+)
+
+#: Tightened lifecycle thresholds for short soak windows: the classifier
+#: and step detectors must arm, fire, and close inside tens of seconds.
+_LC_ENV = {
+    "TPUMON_LIFECYCLE_SUPPRESS_S": None,  # filled per run from interval
+    "TPUMON_LIFECYCLE_STEADY_CYCLES": "6",
+    "TPUMON_LIFECYCLE_LOST_CYCLES": "2",
+    "TPUMON_LIFECYCLE_STEP_WARMUP": "6",
+    "TPUMON_LIFECYCLE_WAIT_WARMUP": "6",
+}
+
+
+def _lc_env(interval: float) -> dict:
+    env = dict(_LC_ENV)
+    env["TPUMON_LIFECYCLE_SUPPRESS_S"] = f"{max(3.0, 8 * interval):g}"
+    return env
+
+
+class _EnvPatch:
+    """Scoped os.environ patch (the soak runs in-process; thresholds
+    are env-cached and re-parsed on change, so this is the supported
+    way to tighten them for a short run)."""
+
+    def __init__(self, env: dict) -> None:
+        self._env = env
+        self._saved: dict = {}
+
+    def __enter__(self):
+        for key, value in self._env.items():
+            self._saved[key] = os.environ.get(key)
+            os.environ[key] = value
+        return self
+
+    def __exit__(self, *exc):
+        for key, old in self._saved.items():
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+
+
+def _lc_control_calls_per_cycle(topology: str, interval: float) -> float | None:
+    """Zero-additional-device-queries control: the identical exporter
+    with the lifecycle plane disabled must issue the same device calls
+    per poll cycle."""
+    from tpumon.backends.fake import FakeTpuBackend
+    from tpumon.config import Config
+    from tpumon.exporter.server import build_exporter
+    from tpumon.lifecycle.fixture import LifecycleBackend
+
+    backend = LifecycleBackend(FakeTpuBackend.preset(topology, ici_flake=0.0))
+    control = build_exporter(
+        Config(port=0, addr="127.0.0.1", interval=interval, lifecycle=False),
+        backend,
+    )
+    try:
+        control.start()
+        time.sleep(max(3.0, 12 * interval))
+    finally:
+        control.close()
+    polls = control.telemetry.polls._value.get()
+    return (
+        sum(backend.calls.values()) / polls if polls else None
+    )
+
+
+def _lc_events(anomalies: dict, detectors, window=None) -> list[dict]:
+    """Events from ``detectors`` (optionally onset inside ``window``,
+    run-relative seconds with t0 at index 2 of the tuple)."""
+    out = []
+    for e in anomalies.get("events", []):
+        if e.get("detector") not in detectors:
+            continue
+        if window is not None:
+            lo, hi, t0 = window
+            t = e.get("onset_ts", 0.0) - t0
+            if not (lo <= t < hi):
+                continue
+        out.append(e)
+    return out
+
+
+def _lc_scaffold(topology: str, interval: float, feeds: int,
+                 cfg_extra: dict | None = None):
+    """Common lifecycle-soak scaffolding: N scripted workload feeds +
+    one exporter over a LifecycleBackend probing them."""
+    from tpumon.backends.fake import FakeTpuBackend
+    from tpumon.config import Config
+    from tpumon.exporter.server import build_exporter
+    from tpumon.lifecycle.fixture import LifecycleBackend, ScriptedWorkload
+
+    workloads = [ScriptedWorkload() for _ in range(feeds)]
+    for wl in workloads:
+        wl.start()
+    backend = LifecycleBackend(FakeTpuBackend.preset(topology, ici_flake=0.0))
+    cfg = Config(
+        port=0, addr="127.0.0.1", interval=interval,
+        lifecycle_step_urls=",".join(wl.url for wl in workloads),
+        **(cfg_extra or {}),
+    )
+    exporter = build_exporter(cfg, backend)
+    return workloads, backend, exporter
+
+
+def _lc_run(exporter, workloads, duration_s, scrape_every_s, script):
+    """Drive one lifecycle scenario: scrape at cadence while ``script(t)``
+    mutates the fixtures; returns (lat_ms, failed, t0, elapsed)."""
+    lat_ms: list[float] = []
+    failed = 0
+    conn = http.client.HTTPConnection(
+        "127.0.0.1", exporter.server.port, timeout=10
+    )
+    t0 = time.time()
+    next_at = t0
+    try:
+        while time.time() - t0 < duration_s:
+            script(time.time() - t0)
+            s = time.perf_counter()
+            try:
+                conn.request("GET", "/metrics")
+                conn.getresponse().read()
+            except (OSError, http.client.HTTPException):
+                failed += 1
+                conn.close()
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", exporter.server.port, timeout=10
+                )
+            else:
+                lat_ms.append((time.perf_counter() - s) * 1e3)
+            next_at += scrape_every_s
+            time.sleep(max(0.0, next_at - time.time()))
+        return lat_ms, failed, t0, time.time() - t0, conn
+    except BaseException:
+        conn.close()
+        raise
+
+
+def _lc_harvest(port: int) -> tuple[dict, dict]:
+    """(/lifecycle full replay walk, /anomalies) off one exporter."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        def get_json(path: str) -> dict:
+            conn.request("GET", path)
+            return json.loads(conn.getresponse().read())
+
+        records: list = []
+        since = 0.0
+        while True:
+            doc = get_json(f"/lifecycle?since={since}")
+            records.extend(doc["records"])
+            if not doc.get("truncated"):
+                break
+            since = doc["next_since"]
+        doc["records"] = records
+        return doc, get_json("/anomalies")
+    finally:
+        conn.close()
+
+
+def preempt_soak(
+    duration_s: float,
+    topology: str = "v4-8",
+    interval: float = 0.25,
+    scrape_every_s: float = 0.5,
+) -> dict:
+    """``--preempt`` (ISSUE 10): slice preemption + elastic resize +
+    checkpoint restore mid-run, then a GENUINE step-time regression.
+
+    Script (fractions of --duration): steady → SIGTERM + duty collapse
+    + feed loss (preemption) → half the chips disappear (elastic
+    resize; exporter re-enumerates) → the feed returns on the same port
+    reporting a restore span and a mesh-adjusted step rate → steady →
+    step time doubles with NO lifecycle signals (real regression). The
+    evidence is the robustness contract: zero false straggler/stall/
+    duty/regression events during the clean transition window,
+    all three lifecycle kinds recognized, the post-window regression
+    detected, and zero added device queries vs a lifecycle-off control.
+    """
+    from tpumon.lifecycle.fixture import ScriptedWorkload
+
+    if duration_s <= 0:
+        raise ValueError(f"duration must be > 0 seconds, got {duration_s}")
+    if duration_s < 80 * interval:
+        raise ValueError(
+            f"--duration {duration_s:g} too short for the preempt script "
+            f"at --interval {interval:g} (need > 80*interval: warmup, the "
+            "transition, the suppression window, and the regression phase "
+            "each span several poll cycles)"
+        )
+
+    script_at = {
+        "preempt": 0.20 * duration_s,
+        "lose_feed": 0.26 * duration_s,
+        "resize": 0.34 * duration_s,
+        "restore": 0.42 * duration_s,
+        "regress": 0.72 * duration_s,
+    }
+    suppress_s = max(3.0, 8 * interval)
+    # The clean window: transition start until the last lifecycle signal
+    # plus the suppression budget (generous on the early side too: the
+    # EWMA baselines formed during warmup).
+    clean_win = (script_at["preempt"] - 1.0, script_at["restore"] + suppress_s)
+
+    workloads, backend, exporter = _lc_scaffold(topology, interval, feeds=1)
+    feed = workloads[0]
+    feed_port = feed.server.port if hasattr(feed.server, "port") else 0
+    state = {"feed": feed, "done": set(), "rate": 2.0}
+
+    def script(t: float) -> None:
+        done = state["done"]
+        # Keep the live feed publishing windows so steps_per_second ages
+        # honestly (a real harness records every stats_every steps).
+        if state["feed"] is not None and "lose_feed" not in done:
+            state["feed"].set_rate(state["rate"])
+        elif state["feed"] is not None and "restore" in done:
+            state["feed"].set_rate(state["rate"])
+        if t >= script_at["preempt"] and "preempt" not in done:
+            done.add("preempt")
+            state["feed"].mark_terminating()
+            backend.duty_zero = True
+        if t >= script_at["lose_feed"] and "lose_feed" not in done:
+            done.add("lose_feed")
+            state["feed"].close()
+            state["feed"] = None
+        if t >= script_at["resize"] and "resize" not in done:
+            done.add("resize")
+            backend.visible_chips = max(
+                1, len(backend._inner.topology().chips) // 2
+            )
+            backend.duty_zero = False
+        if t >= script_at["restore"] and "restore" not in done:
+            done.add("restore")
+            wl = ScriptedWorkload(port=feed_port)
+            wl.record_checkpoint("restore", 2.5)
+            wl.stats.set_start_step(64)
+            wl.start()
+            state["feed"] = wl
+            state["rate"] = 1.6  # mesh shrank; the new normal
+        if t >= script_at["regress"] and "regress" not in done:
+            done.add("regress")
+            state["rate"] = 0.8  # step time doubles, no lifecycle signal
+
+    with _EnvPatch(_lc_env(interval)):
+        try:
+            exporter.start()
+            lat_ms, failed, t0, elapsed, conn = _lc_run(
+                exporter, workloads, duration_s, scrape_every_s, script
+            )
+            conn.close()
+            lifecycle_doc, anomalies = _lc_harvest(exporter.server.port)
+        finally:
+            exporter.close()
+            if state["feed"] is not None:
+                state["feed"].close()
+    poll_cycles = exporter.telemetry.polls._value.get()
+    calls_per_cycle = (
+        sum(backend.calls.values()) / poll_cycles if poll_cycles else None
+    )
+    control = _lc_control_calls_per_cycle(topology, interval)
+
+    false_positives = _lc_events(
+        anomalies, _LC_FALSE_SET, (clean_win[0], clean_win[1], t0)
+    )
+    regressions = _lc_events(
+        anomalies, ("step_regression",),
+        (script_at["regress"], duration_s + 60.0, t0),
+    )
+    lat_ms.sort()
+    return {
+        "mode": "preempt",
+        "topology": topology,
+        "interval_s": interval,
+        "duration_s": round(elapsed, 1),
+        "script_s": {k: round(v, 1) for k, v in script_at.items()},
+        "scrapes": len(lat_ms),
+        "failed_scrapes": failed,
+        "p50_ms": round(quantile(lat_ms, 0.5), 3) if lat_ms else None,
+        "p99_ms": round(quantile(lat_ms, 0.99), 3) if lat_ms else None,
+        "lifecycle_events_total": lifecycle_doc.get("events_total", {}),
+        "suppressed": anomalies.get("suppressed", 0),
+        #: Zero is the acceptance bar: no false straggler/stall/duty/
+        #: regression event may onset inside the clean window.
+        "false_positives": len(false_positives),
+        "false_positive_events": [
+            {k: e.get(k) for k in ("detector", "device", "message")}
+            for e in false_positives[:8]
+        ],
+        #: >= 1 is the bar: the genuine post-window regression fired.
+        "regression_detected": len(regressions) > 0,
+        "regression_events": [
+            {k: e.get(k) for k in ("detector", "device", "message")}
+            for e in regressions[:4]
+        ],
+        "false_negatives": 0 if regressions else 1,
+        "device_calls_per_cycle": (
+            round(calls_per_cycle, 4) if calls_per_cycle else None
+        ),
+        "control_calls_per_cycle": (
+            round(control, 4) if control else None
+        ),
+    }
+
+
+def interfere_soak(
+    duration_s: float,
+    topology: str = "v4-8",
+    interval: float = 0.25,
+    scrape_every_s: float = 0.5,
+) -> dict:
+    """``--interfere`` (ISSUE 10): two workload presets on one pool.
+
+    Both feeds' collective-wait fraction climbs while every chip stays
+    busy and neither lags the slice median — fabric contention. The
+    detector must attribute ICI contention (collective_wait events) and
+    must NOT flag either workload as a straggler (zero straggler/stall
+    events is the acceptance bar).
+    """
+    if duration_s <= 0:
+        raise ValueError(f"duration must be > 0 seconds, got {duration_s}")
+    if duration_s < 60 * interval:
+        raise ValueError(
+            f"--duration {duration_s:g} too short for the interfere script "
+            f"at --interval {interval:g} (need > 60*interval)"
+        )
+
+    contend_at = 0.35 * duration_s
+    workloads, backend, exporter = _lc_scaffold(topology, interval, feeds=2)
+    state = {"contending": False}
+
+    def script(t: float) -> None:
+        contending = t >= contend_at
+        state["contending"] = contending
+        for i, wl in enumerate(workloads):
+            # Two presets: different baseline rates; under contention
+            # both slow down and both wait on the fabric.
+            base = 2.0 if i == 0 else 3.0
+            if contending:
+                wl.set_rate(base * 0.6)
+                wl.set_collective_wait(0.55)
+            else:
+                wl.set_rate(base)
+                wl.set_collective_wait(0.05)
+
+    with _EnvPatch(_lc_env(interval)):
+        try:
+            exporter.start()
+            lat_ms, failed, t0, elapsed, conn = _lc_run(
+                exporter, workloads, duration_s, scrape_every_s, script
+            )
+            conn.close()
+            lifecycle_doc, anomalies = _lc_harvest(exporter.server.port)
+        finally:
+            exporter.close()
+            for wl in workloads:
+                wl.close()
+    poll_cycles = exporter.telemetry.polls._value.get()
+    calls_per_cycle = (
+        sum(backend.calls.values()) / poll_cycles if poll_cycles else None
+    )
+    control = _lc_control_calls_per_cycle(topology, interval)
+
+    contention = _lc_events(anomalies, ("collective_wait",))
+    #: Straggler-shaped verdicts during the interference: the failure
+    #: mode this scenario exists to rule out.
+    stragglers = _lc_events(
+        anomalies,
+        ("host_straggler", "host_stall", "duty_ewma", "queue_stall"),
+        (contend_at, duration_s + 60.0, t0),
+    )
+    lat_ms.sort()
+    return {
+        "mode": "interfere",
+        "topology": topology,
+        "interval_s": interval,
+        "duration_s": round(elapsed, 1),
+        "contend_at_s": round(contend_at, 1),
+        "scrapes": len(lat_ms),
+        "failed_scrapes": failed,
+        "p50_ms": round(quantile(lat_ms, 0.5), 3) if lat_ms else None,
+        "p99_ms": round(quantile(lat_ms, 0.99), 3) if lat_ms else None,
+        #: >= 1 is the bar: contention attributed as contention.
+        "contention_events": len(contention),
+        "contention_messages": [
+            e.get("message") for e in contention[:4]
+        ],
+        #: Zero is the bar: neither workload flagged as a straggler.
+        "false_straggler_events": len(stragglers),
+        "false_straggler_detail": [
+            {k: e.get(k) for k in ("detector", "device", "message")}
+            for e in stragglers[:8]
+        ],
+        "false_negatives": 0 if contention else 1,
+        "lifecycle_events_total": lifecycle_doc.get("events_total", {}),
+        "device_calls_per_cycle": (
+            round(calls_per_cycle, 4) if calls_per_cycle else None
+        ),
+        "control_calls_per_cycle": (
+            round(control, 4) if control else None
+        ),
+    }
+
+
+def restore_storm_soak(
+    duration_s: float,
+    topology: str = "v4-8",
+    interval: float = 0.25,
+    scrape_every_s: float = 0.5,
+    pods: int = 6,
+) -> dict:
+    """``--restore-storm`` (ISSUE 10): N pods checkpoint-restore
+    simultaneously while debug traffic hammers the exporter and a fleet
+    aggregator watches it.
+
+    The bars: the storm classifies as ONE restore transition (not N
+    anomaly storms), zero false verdicts during it, the guard sheds the
+    debug burst gracefully (503s counted, /metrics unharmed), and the
+    aggregator's ``tpu_fleet_visibility_ratio`` stays honest — the
+    exporter's scrape path is device-free and keeps serving, so
+    visibility holds 1.0; any dip must come flagged, never silent.
+    """
+    import threading
+
+    from tpumon.fleet.config import FleetConfig
+    from tpumon.fleet.server import build_aggregator
+
+    if duration_s <= 0:
+        raise ValueError(f"duration must be > 0 seconds, got {duration_s}")
+    if duration_s < 60 * interval:
+        raise ValueError(
+            f"--duration {duration_s:g} too short for the restore-storm "
+            f"script at --interval {interval:g} (need > 60*interval)"
+        )
+
+    storm_win = (0.25 * duration_s, 0.55 * duration_s)
+    suppress_s = max(3.0, 8 * interval)
+    workloads, backend, exporter = _lc_scaffold(
+        topology, interval, feeds=pods,
+        cfg_extra=dict(guard_debug_rps=5.0),
+    )
+    state = {"done": set()}
+
+    def script(t: float) -> None:
+        in_storm = storm_win[0] <= t < storm_win[1]
+        if t >= storm_win[0] and "restore" not in state["done"]:
+            state["done"].add("restore")
+            for wl in workloads:
+                wl.record_checkpoint("restore", 4.0)
+        for wl in workloads:
+            # During the storm every pod replays its checkpoint: step
+            # progress stalls; after it, normal cadence resumes.
+            wl.set_rate(0.2 if in_storm else 2.0)
+
+    shed_probe = {"requests": 0, "shed": 0}
+    stop_burst = threading.Event()
+
+    def debug_burst(port: int) -> None:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        while not stop_burst.wait(0.01):
+            try:
+                conn.request("GET", "/anomalies")
+                resp = conn.getresponse()
+                resp.read()
+                shed_probe["requests"] += 1
+                if resp.status == 503:
+                    shed_probe["shed"] += 1
+            except (OSError, http.client.HTTPException):
+                conn.close()
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=5
+                )
+        conn.close()
+
+    min_visibility = 1.0
+    warmed = False  # visibility tracked only after the first full view
+    stale_flagged = 0
+    aggregator = None
+    burst = None
+    with _EnvPatch(_lc_env(interval)):
+        try:
+            exporter.start()
+            aggregator = build_aggregator(
+                FleetConfig(
+                    port=0, addr="127.0.0.1",
+                    targets=f"127.0.0.1:{exporter.server.port}",
+                    interval=max(0.5, interval),
+                    stale_s=max(2.0, 4 * interval),
+                    evict_s=max(duration_s, 60.0),
+                    history_window=0.0,
+                )
+            )
+            aggregator.start()
+            burst = threading.Thread(
+                target=debug_burst, args=(exporter.server.port,),
+                name="tpumon-lc-burst", daemon=True,
+            )
+            burst.start()
+            agg_conn = http.client.HTTPConnection(
+                "127.0.0.1", aggregator.server.port, timeout=10
+            )
+
+            base_script = script
+
+            def script_with_agg(t: float) -> None:
+                nonlocal min_visibility, stale_flagged, warmed
+                base_script(t)
+                try:
+                    agg_conn.request("GET", "/metrics")
+                    body = agg_conn.getresponse().read()
+                except (OSError, http.client.HTTPException):
+                    agg_conn.close()
+                    return
+                stats = _page_stats(body)
+                vis = stats["visibility"]
+                if vis is not None:
+                    if vis >= 1.0:
+                        # Warm-up gate: a cold aggregator's first cycles
+                        # legitimately read 0 — the honesty claim is
+                        # about the storm, not the boot.
+                        warmed = True
+                    if warmed:
+                        min_visibility = min(min_visibility, vis)
+                if stats["stale_flag"] == 1.0:
+                    stale_flagged += 1
+
+            lat_ms, failed, t0, elapsed, conn = _lc_run(
+                exporter, workloads, duration_s, scrape_every_s,
+                script_with_agg,
+            )
+            conn.close()
+            agg_conn.close()
+            # The burst drained the debug-class token bucket (that shed
+            # IS the evidence); stop it and let the bucket refill before
+            # the harvest uses the same debug-class endpoints.
+            stop_burst.set()
+            if burst is not None:
+                burst.join(timeout=5)
+                burst = None
+            for attempt in range(6):
+                try:
+                    lifecycle_doc, anomalies = _lc_harvest(
+                        exporter.server.port
+                    )
+                    break
+                except ValueError:
+                    if attempt == 5:
+                        raise
+                    time.sleep(1.0)
+        finally:
+            stop_burst.set()
+            if burst is not None:
+                burst.join(timeout=5)
+            if aggregator is not None:
+                aggregator.close()
+            exporter.close()
+            for wl in workloads:
+                wl.close()
+    poll_cycles = exporter.telemetry.polls._value.get()
+    calls_per_cycle = (
+        sum(backend.calls.values()) / poll_cycles if poll_cycles else None
+    )
+    control = _lc_control_calls_per_cycle(topology, interval)
+
+    false_positives = _lc_events(
+        anomalies, _LC_FALSE_SET,
+        (storm_win[0] - 1.0, storm_win[1] + suppress_s, t0),
+    )
+    restores = lifecycle_doc.get("events_total", {}).get("restore", 0)
+    lat_ms.sort()
+    return {
+        "mode": "restore-storm",
+        "topology": topology,
+        "pods": pods,
+        "interval_s": interval,
+        "duration_s": round(elapsed, 1),
+        "storm_window_s": [round(storm_win[0], 1), round(storm_win[1], 1)],
+        "scrapes": len(lat_ms),
+        "failed_scrapes": failed,
+        "p50_ms": round(quantile(lat_ms, 0.5), 3) if lat_ms else None,
+        "p99_ms": round(quantile(lat_ms, 0.99), 3) if lat_ms else None,
+        #: One restore transition for the whole storm is the bar (the N
+        #: simultaneous restores land inside one suppression window).
+        "restore_events": restores,
+        "lifecycle_events_total": lifecycle_doc.get("events_total", {}),
+        "false_positives": len(false_positives),
+        "false_positive_events": [
+            {k: e.get(k) for k in ("detector", "device", "message")}
+            for e in false_positives[:8]
+        ],
+        "suppressed": anomalies.get("suppressed", 0),
+        #: Guard-plane shedding evidence: the debug burst was refused
+        #: gracefully while every well-behaved /metrics scrape in
+        #: lat_ms/failed_scrapes was answered.
+        "debug_burst": dict(shed_probe),
+        #: Fleet honesty: the exporter kept serving through the storm,
+        #: so visibility must hold 1.0; any dip arrives stale-flagged.
+        "fleet_min_visibility": round(min_visibility, 3),
+        "fleet_stale_flagged_scrapes": stale_flagged,
+        "device_calls_per_cycle": (
+            round(calls_per_cycle, 4) if calls_per_cycle else None
+        ),
+        "control_calls_per_cycle": (
+            round(control, 4) if control else None
+        ),
+    }
+
+
 def _spawn_fleetsim(nodes: int, topology: str, node_interval: float):
     """One ``tools/fleetsim.py`` subprocess simulating ``nodes`` exporter
     endpoints. A separate process (own GIL) so simulation work never
@@ -1243,6 +1857,28 @@ def main(argv=None) -> int:
                         "tree; reports per-window cause attribution, "
                         "host_straggler events, and the "
                         "zero-additional-device-queries budget proof")
+    parser.add_argument("--preempt", action="store_true",
+                        help="workload-lifecycle acceptance soak "
+                        "(tpumon/lifecycle): scripted slice preemption + "
+                        "elastic resize + checkpoint restore, then a "
+                        "genuine step-time regression; reports false-"
+                        "positive/false-negative counts, lifecycle "
+                        "events, suppression, and the zero-added-device-"
+                        "queries budget proof")
+    parser.add_argument("--interfere", action="store_true",
+                        help="two workload presets on one pool: "
+                        "collective-wait climbs on both while all chips "
+                        "stay busy — must attribute ICI contention, must "
+                        "NOT flag either workload as a straggler")
+    parser.add_argument("--restore-storm", action="store_true",
+                        help="N pods checkpoint-restore simultaneously "
+                        "under a debug-request burst with a fleet "
+                        "aggregator watching: one classified restore "
+                        "window, zero false verdicts, graceful guard "
+                        "shedding, honest fleet visibility")
+    parser.add_argument("--pods", type=int, default=6,
+                        help="simultaneous restoring workload feeds for "
+                        "--restore-storm")
     parser.add_argument("--fleet", action="store_true",
                         help="soak the fleet aggregation tier instead of "
                         "one exporter: --fleet-nodes fake exporters "
@@ -1273,7 +1909,23 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.duration <= 0:
         parser.error("--duration must be > 0")
-    if args.straggler:
+    if args.preempt:
+        record = preempt_soak(
+            args.duration, topology=args.topology,
+            interval=args.interval, scrape_every_s=args.scrape_every,
+        )
+    elif args.interfere:
+        record = interfere_soak(
+            args.duration, topology=args.topology,
+            interval=args.interval, scrape_every_s=args.scrape_every,
+        )
+    elif args.restore_storm:
+        record = restore_storm_soak(
+            args.duration, topology=args.topology,
+            interval=args.interval, scrape_every_s=args.scrape_every,
+            pods=args.pods,
+        )
+    elif args.straggler:
         record = straggler_soak(
             args.duration, topology=args.topology,
             interval=args.interval, scrape_every_s=args.scrape_every,

@@ -220,6 +220,65 @@ class RunResult:
     grad_norm: float | None = None
 
 
+def _make_phase_probe(cfg, optimizer, attn_impl, shard_acts, shard_experts,
+                      forward_fn, remat, loss_chunk, grad_accum: int = 1):
+    """One instrumented step split into timed fwd / fwd+bwd / optimizer
+    phases (``--phase-stats``). Three separately-jitted functions with
+    NO donation (the live params/opt state must survive), run at most
+    once per stats window: bounded overhead, honest wall timings. bwd is
+    the grad pass minus the forward pass — the standard decomposition
+    when the train step itself is one fused jit.
+
+    Under ``grad_accum > 1`` the probe times ONE microbatch chunk and
+    scales fwd/bwd by the chunk count: the real step never executes a
+    full-batch backward (accumulation exists precisely because it would
+    not fit activation memory), so probing one would OOM exactly the
+    configs that need accumulation — and describe a step shape the run
+    never takes."""
+
+    def loss_of(params, tokens):
+        return loss_fn(
+            params, tokens, cfg, attn_impl, shard_acts, shard_experts,
+            forward_fn, remat, loss_chunk,
+        )
+
+    fwd_fn = jax.jit(loss_of)
+    grad_fn = jax.jit(jax.value_and_grad(loss_of))
+
+    def opt_of(params, opt_state, grads):
+        updates, _ = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates)
+
+    opt_fn = jax.jit(opt_of)
+    chunks = max(1, int(grad_accum))
+
+    def probe(params, opt_state, tokens) -> dict[str, float]:
+        if chunks > 1:
+            # Strided rows, mirroring make_train_step's chunking (every
+            # chunk stays balanced across the dp shards).
+            tokens = tokens[::chunks]
+        t0 = time.perf_counter()
+        jax.block_until_ready(fwd_fn(params, tokens))
+        fwd_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        _, grads = grad_fn(params, tokens)
+        jax.block_until_ready(grads)
+        grad_s = time.perf_counter() - t0
+        # Grads land untimed above; only the update+apply is clocked.
+        t0 = time.perf_counter()
+        jax.block_until_ready(opt_fn(params, opt_state, grads))
+        opt_s = time.perf_counter() - t0
+        return {
+            # Per-step phase estimate: chunk count × per-chunk time for
+            # the accumulated phases; the optimizer runs once per step.
+            "fwd": fwd_s * chunks,
+            "bwd": max(0.0, grad_s - fwd_s) * chunks,
+            "optimizer": opt_s,
+        }
+
+    return probe
+
+
 def run(
     cfg,
     *,
@@ -246,6 +305,8 @@ def run(
     checkpoint_every: int = 0,
     stats=None,
     stats_every: int = 20,
+    phase_stats: bool = False,
+    collective_us=None,
 ) -> RunResult:
     """Build, shard, and run the train step; returns losses + throughput.
 
@@ -288,6 +349,13 @@ def run(
     the latest loss and records the window's exact steps/s (the dispatch
     pipeline stays full between windows — one sync per window, not per
     step, so the generated traffic keeps its shape).
+
+    ``phase_stats=True`` additionally runs ONE instrumented step per
+    stats window (three undonated jitted calls: fwd, fwd+bwd, optimizer)
+    and records the per-phase wall times — the ``tpu_step_phase_seconds``
+    families the lifecycle plane consumes. ``collective_us`` (a callable
+    returning the HLO logger's cumulative collective-latency µs, or
+    None) turns on the per-window collective-wait fraction.
     """
     is_moe = isinstance(cfg, MoeConfig)
     if ep > 1 and not is_moe:
@@ -446,11 +514,39 @@ def run(
             axes={"dp": dp, "tp": tp, "sp": sp, "pp": pp, "ep": ep},
         )
 
+    phase_probe = None
+    if stats is not None and phase_stats:
+        phase_probe = _make_phase_probe(
+            cfg, optimizer, attn_impl, shard_acts, shard_experts,
+            forward_fn, remat and pp == 1, loss_chunk,
+            grad_accum=grad_accum,
+        )
+
+    def _record_window_extras(window_s: float, state: list) -> None:
+        # Collective-wait fraction: HLO-logger latency accumulated this
+        # window over the window's wall time across the run's devices.
+        if collective_us is None:
+            return
+        try:
+            cur = collective_us()
+        except Exception:
+            log.debug("collective_us probe failed", exc_info=True)
+            return
+        if cur is None:
+            return
+        if state and window_s > 0:
+            delta = max(0.0, cur - state[0])
+            stats.record_collective_wait(
+                (delta / 1e6) / (window_s * max(1, len(run_devices)))
+            )
+        state[:] = [cur]  # window_s <= 0 seeds the µs baseline only
+
     if checkpoint_dir is not None:
         return _run_checkpointed(
             step, params, opt_state, tokens, steps, checkpoint_dir,
             checkpoint_every, mesh, cfg=cfg, batch=batch, seq=seq,
-            stats=stats, dp=dp, tp=tp, sp=sp, pp=pp, ep=ep,
+            stats=stats, phase_probe=phase_probe,
+            dp=dp, tp=tp, sp=sp, pp=pp, ep=ep,
         )
 
     # Warmup/compile outside the timed window.
@@ -464,13 +560,30 @@ def run(
             params, opt_state, loss, gnorm = step(params, opt_state, tokens)
     else:
         window_t0, done = t0, 0
+        wait_state: list[float] = []
+        _record_window_extras(0.0, wait_state)  # seed the µs baseline
         for i in range(1, steps + 1):
             params, opt_state, loss, gnorm = step(params, opt_state, tokens)
             if i % max(stats_every, 1) == 0 or i == steps:
                 lv = float(loss)  # one host-read sync per window
                 now = time.perf_counter()
                 stats.record(lv, i - done, now - window_t0)
-                window_t0, done = now, i
+                _record_window_extras(now - window_t0, wait_state)
+                if phase_probe is not None:
+                    try:
+                        stats.record_phases(
+                            phase_probe(params, opt_state, tokens)
+                        )
+                    except Exception:
+                        # Telemetry must never kill the traffic generator.
+                        log.exception("phase probe failed")
+                        phase_probe = None
+                    # Re-seed the µs baseline AFTER the probe: its own
+                    # collectives must not land in the next window's
+                    # numerator while its wall time is excluded from
+                    # the denominator (a systematic over-read).
+                    _record_window_extras(0.0, wait_state)
+                window_t0, done = time.perf_counter(), i
     # The barrier is a host read, not block_until_ready: on remote-
     # dispatch transports (axon tunnel) block_until_ready can resolve
     # ~5% before execution completes (measured); float() cannot.
@@ -496,7 +609,8 @@ def run(
 
 def _run_checkpointed(
     step, params, opt_state, tokens, steps, checkpoint_dir, checkpoint_every,
-    mesh=None, cfg=None, batch=0, seq=0, stats=None, **axes,
+    mesh=None, cfg=None, batch=0, seq=0, stats=None, phase_probe=None,
+    **axes,
 ) -> RunResult:
     """Checkpoint/resume driver around the jitted train step.
 
@@ -521,6 +635,7 @@ def _run_checkpointed(
         start_step = 0
         latest = mngr.latest_step()
         if latest is not None:
+            restore_t0 = time.perf_counter()
             restored = mngr.restore(
                 latest,
                 args=ocp.args.StandardRestore(
@@ -548,6 +663,14 @@ def _run_checkpointed(
                 )
             params, opt_state = restored["params"], restored["opt_state"]
             start_step = latest
+            if stats is not None:
+                # The restore span + training-global step offset the
+                # lifecycle plane reads (tpu_step_checkpoint_seconds
+                # {op="restore"} is the restore-storm signature).
+                stats.record_checkpoint(
+                    "restore", time.perf_counter() - restore_t0
+                )
+                stats.set_start_step(start_step)
             log.info("resumed from %s at step %d", checkpoint_dir, latest)
 
         losses: list[float] = []
@@ -569,9 +692,16 @@ def _run_checkpointed(
                     # as the `timed` accounting — a ~60s compile would
                     # otherwise publish a near-zero steps/s and MFU).
                     stats.record(losses[-1], 1, dt)
+            elif stats is not None:
+                # The compile-paying step still HAPPENED: it advances the
+                # global step counter (seconds=0 → no rate sample), or
+                # tpu_step_counter would sit one behind the checkpoint's
+                # own step index after every resume.
+                stats.record(losses[-1], 1, 0.0)
             done = i + 1
             if (checkpoint_every and done % checkpoint_every == 0) or done == steps:
                 if done != saved_at:
+                    save_t0 = time.perf_counter()
                     mngr.save(
                         done,
                         args=ocp.args.StandardSave(
@@ -579,6 +709,20 @@ def _run_checkpointed(
                         ),
                     )
                     saved_at = done
+                    if stats is not None:
+                        stats.record_checkpoint(
+                            "save", time.perf_counter() - save_t0
+                        )
+            if stats is not None and phase_probe is not None and done == steps:
+                # One instrumented step at the end of the run (this path
+                # already syncs per step, so once is the honest budget).
+                try:
+                    stats.record_phases(
+                        phase_probe(params, opt_state, tokens)
+                    )
+                except Exception:
+                    log.exception("phase probe failed")
+                    phase_probe = None
         mngr.wait_until_finished()
         if not losses:
             log.info(
@@ -616,6 +760,48 @@ def _run_checkpointed(
         )
     finally:
         mngr.close()
+
+
+def _install_sigterm_marker(stats, grace_s: float | None = None) -> None:
+    """Flag a SIGTERM on the metrics page for the preemption grace
+    window, then exit with the conventional 143.
+
+    Kubernetes preemption is SIGTERM → grace period → SIGKILL; the
+    lifecycle plane (tpumon/lifecycle) probes the workload page at poll
+    cadence and needs to SEE ``tpu_step_terminating 1`` inside that
+    window to classify the event as a clean preemption instead of an
+    anonymous duty collapse. The handler marks the page immediately and
+    defers the exit by TPUMON_STEP_TERM_GRACE_S (default 5 s, clamped
+    ≥0) — well inside any real grace period, long enough for a 1 Hz
+    prober to observe the flag. A second SIGTERM exits immediately.
+    """
+    import signal
+    import threading
+
+    if grace_s is None:
+        raw = os.environ.get("TPUMON_STEP_TERM_GRACE_S", "5")
+        try:
+            grace_s = max(0.0, float(raw))
+        except ValueError:
+            grace_s = 5.0
+
+    state = {"seen": False}
+
+    def _on_term(signum, frame):
+        if state["seen"]:
+            os._exit(143)
+        state["seen"] = True
+        stats.mark_terminating()
+        timer = threading.Timer(grace_s, lambda: os._exit(143))
+        timer.daemon = True  # a finished run must not wait on the timer
+        timer.start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:
+        # Not the main thread (embedders driving main() from a worker):
+        # the flag can still be set by the embedder; skip the handler.
+        log.debug("SIGTERM marker not installed (not main thread)")
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -768,6 +954,14 @@ def main(argv: list[str] | None = None) -> int:
         "so stats windows are per-step there)",
     )
     parser.add_argument(
+        "--phase-stats",
+        action="store_true",
+        help="run ONE instrumented step per stats window (fwd / fwd+bwd "
+        "/ optimizer timed separately, no donation) and publish "
+        "tpu_step_phase_seconds — the lifecycle plane's phase "
+        "breakdown; needs --metrics-port",
+    )
+    parser.add_argument(
         "--platform",
         choices=("auto", "cpu"),
         default="auto",
@@ -893,6 +1087,13 @@ def main(argv: list[str] | None = None) -> int:
         )
         server.start()
         log.info("workload counters at %s/metrics", server.url)
+        _install_sigterm_marker(stats)
+
+    collective_us = None
+    if stats is not None and hooked:
+        def collective_us() -> float:
+            detail = counters.detailed_snapshot()
+            return float(sum(detail["latency_us"].values()))
 
     try:
         result = run(
@@ -917,6 +1118,8 @@ def main(argv: list[str] | None = None) -> int:
             checkpoint_every=args.checkpoint_every,
             stats=stats,
             stats_every=args.stats_every,
+            phase_stats=args.phase_stats,
+            collective_us=collective_us,
         )
         log.info(
             "loss %.4f → %.4f | %.2f steps/s | %.1f GFLOP/step | MFU %s | "

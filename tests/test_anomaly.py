@@ -387,6 +387,8 @@ class TestExporterIntegration:
             "host_straggler", "host_stall",
             # Step/lifecycle roster (tpumon/lifecycle), armed by default.
             "step_regression", "collective_wait", "lifecycle",
+            # Efficiency roster (tpumon/energy), armed by default.
+            "efficiency_regression",
         ]
         # The armed-detector gauge is on the page even with zero events.
         _, text = scrape(exp.server.url + "/metrics")

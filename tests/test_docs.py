@@ -66,9 +66,11 @@ def test_registry_matches_live_scrape():
 
     # Everything the fake can produce is served (pod_info needs a
     # kubelet; watch streams need the grpc backend's runtime service,
-    # covered by tests/test_grpc_backend.py::test_watch_streams_family_scrapeable).
+    # covered by tests/test_grpc_backend.py::test_watch_streams_family_scrapeable;
+    # device power needs a newer runtime — the fake's opt-in
+    # power_metric=True path is covered by tests/test_energy.py).
     expected = (
-        {s.family for s in LIBTPU_SPECS}
+        {s.family for s in LIBTPU_SPECS} - {"accelerator_power_watts"}
         | (
             set(IDENTITY_FAMILIES)
             - {"accelerator_pod_info", "accelerator_monitor_watch_streams"}

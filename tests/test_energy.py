@@ -1,0 +1,791 @@
+"""Energy & cost plane (tpumon/energy, ISSUE 12): power modeling with
+source honesty, joules monotonicity across backend flaps, pod-split
+conservation, the step-efficiency joins, the efficiency_regression
+detector with lifecycle-suppression interplay, fleet ingest/rollup, and
+the families⊆registry⊆METRICS.md drift net."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from tpumon.energy.model import (
+    DEFAULT_TDP_W,
+    EnergyTuning,
+    model_power_w,
+    tdp_for,
+)
+from tpumon.energy.plane import EnergyPlane
+from tpumon.exporter.collector import PollStats
+
+BASE_KEYS = ("slice", "host", "worker", "accelerator")
+BASE_VALS = ("s0", "h0", "0", "v4-8")
+
+
+def _stats(snapshot: dict) -> PollStats:
+    stats = PollStats()
+    stats.base_keys = BASE_KEYS
+    stats.base_vals = BASE_VALS
+    stats.snapshot = snapshot
+    return stats
+
+
+def _chip_snap(**chips) -> dict:
+    return {
+        "identity": {"accelerator": "v4-8"},
+        "chips": {name: dict(row) for name, row in chips.items()},
+    }
+
+
+def _by_name(families) -> dict:
+    return {f.name: f for f in families}
+
+
+# -- model ------------------------------------------------------------------
+
+
+class TestModel:
+    def test_tdp_table_prefix_match_longest_wins(self):
+        t = EnergyTuning()
+        assert tdp_for("v4-8", t) == (275.0, "v4")
+        assert tdp_for("v5litepod-16", t) == (205.0, "v5litepod")
+        assert tdp_for("v5p-64", t) == (470.0, "v5p")
+        assert tdp_for("bench-1k", t) == (DEFAULT_TDP_W, "default")
+        assert tdp_for(None, t) == (DEFAULT_TDP_W, "default")
+
+    def test_tdp_override_beats_table(self):
+        t = EnergyTuning(tdp_w=123.0)
+        assert tdp_for("v4-8", t) == (123.0, "override")
+
+    def test_model_power_bounds(self):
+        t = EnergyTuning()
+        tdp = 100.0
+        idle = t.idle_fraction * tdp
+        assert model_power_w(0.0, 0.0, tdp, t) == pytest.approx(idle)
+        assert model_power_w(100.0, 1.0, tdp, t) == pytest.approx(tdp)
+        # Missing HBM ratio degrades to the pure duty model.
+        assert model_power_w(50.0, None, tdp, t) == pytest.approx(
+            idle + (tdp - idle) * 0.5
+        )
+        # Out-of-range inputs clamp instead of extrapolating.
+        assert model_power_w(250.0, 2.0, tdp, t) == pytest.approx(tdp)
+        assert model_power_w(-5.0, -1.0, tdp, t) == pytest.approx(idle)
+
+    def test_hbm_adjustment_is_bounded_by_weight(self):
+        t = EnergyTuning(hbm_weight=0.2)
+        full = model_power_w(100.0, 1.0, 100.0, t)
+        empty = model_power_w(100.0, 0.0, 100.0, t)
+        assert (full - empty) / (full - t.idle_fraction * 100.0) == (
+            pytest.approx(0.2, abs=1e-9)
+        )
+
+    def test_tuning_env_roundtrip(self):
+        t = EnergyTuning.from_env(
+            {"TPUMON_ENERGY_DOLLARS_PER_KWH": "0.11",
+             "TPUMON_ENERGY_TDP_W": "333",
+             "TPUMON_ENERGY_MAX_GAP_S": "bogus"}  # malformed -> default
+        )
+        assert t.dollars_per_kwh == 0.11
+        assert t.tdp_w == 333.0
+        assert t.max_gap_s == EnergyTuning().max_gap_s
+
+
+# -- plane: sources, monotonicity, gaps, pod split --------------------------
+
+
+class TestPlane:
+    def test_modeled_vs_measured_labeling(self):
+        plane = EnergyPlane()
+        snap = _chip_snap(
+            **{
+                "0": {"duty_pct": 50.0, "hbm_used": 1.0, "hbm_total": 2.0},
+                "1": {"power_w": 200.0, "duty_pct": 50.0},
+            }
+        )
+        fams = _by_name(plane.cycle(1000.0, _stats(snap)))
+        watts = fams["tpu_energy_power_watts"]
+        by_chip = {
+            s.labels["chip"]: (s.labels["source"], s.value)
+            for s in watts.samples
+        }
+        assert by_chip["0"][0] == "modeled"
+        assert by_chip["1"] == ("measured", 200.0)
+        # A measured reading is used verbatim, never re-modeled.
+
+    def test_chip_without_duty_or_power_is_absent_not_zero(self):
+        plane = EnergyPlane()
+        snap = _chip_snap(**{"0": {"hbm_used": 1.0, "hbm_total": 2.0}})
+        fams = _by_name(plane.cycle(1000.0, _stats(snap)))
+        assert "tpu_energy_power_watts" not in fams
+        assert "tpu_energy_joules" not in fams
+
+    def test_joules_monotonic_across_backend_flaps(self):
+        """A backend flapping between exposing and hiding power moves
+        accumulation between the (chip, measured) and (chip, modeled)
+        series — EACH stays monotonic, neither ever resets."""
+        plane = EnergyPlane()
+        seen: dict[tuple[str, str], list[float]] = {}
+        for i in range(12):
+            row = (
+                {"power_w": 180.0, "duty_pct": 50.0}
+                if i % 3 == 0  # flap: measured every third cycle
+                else {"duty_pct": 50.0}
+            )
+            fams = _by_name(
+                plane.cycle(1000.0 + i, _stats(_chip_snap(**{"0": row})))
+            )
+            if i == 0:
+                # First cycle has no prior timestamp: nothing integrated
+                # yet, the counter family is honestly absent.
+                assert "tpu_energy_joules" not in fams
+                continue
+            for s in fams["tpu_energy_joules"].samples:
+                seen.setdefault(
+                    (s.labels["chip"], s.labels["source"]), []
+                ).append(s.value)
+        assert set(seen) == {("0", "measured"), ("0", "modeled")}
+        for series in seen.values():
+            assert series == sorted(series), "joules counter regressed"
+
+    def test_gap_honesty_clamps_integration(self):
+        plane = EnergyPlane()
+        snap = _chip_snap(**{"0": {"power_w": 100.0, "duty_pct": 50.0}})
+        plane.cycle(1000.0, _stats(snap))
+        # A 970 s poll gap integrates only max_gap_s (30) worth.
+        fams = _by_name(plane.cycle(1970.0, _stats(snap)))
+        (sample,) = fams["tpu_energy_joules"].samples
+        assert sample.value == pytest.approx(100.0 * 30.0)
+        doc = plane.snapshot()
+        assert doc["gap_skipped_seconds"] == pytest.approx(940.0)
+        assert doc["gaps_clamped"] == 1
+
+    def test_pod_split_sums_to_chip_total(self):
+        plane = EnergyPlane()
+        snap = _chip_snap(
+            **{
+                "0": {"power_w": 100.0, "duty_pct": 50.0},
+                "1": {"power_w": 60.0, "duty_pct": 50.0},
+                "2": {"power_w": 40.0, "duty_pct": 50.0},  # unattributed
+            }
+        )
+        snap["pods"] = {
+            "0": [("ml", "job-a")],
+            "1": [("ml", "job-a"), ("ml", "job-b")],  # shared chip
+        }
+        plane.cycle(1000.0, _stats(snap))
+        fams = _by_name(plane.cycle(1002.0, _stats(snap)))
+        chip_j = {
+            s.labels["chip"]: s.value
+            for s in fams["tpu_energy_joules"].samples
+        }
+        pod = fams["tpu_pod_energy_joules"]
+        pod_j = {
+            (s.labels["namespace"], s.labels["pod"]): s.value
+            for s in pod.samples
+        }
+        assert all(
+            s.labels["source"] == "measured" for s in pod.samples
+        )
+        # Conservation: the pod sums equal the ATTRIBUTED chips' total;
+        # the unattributed chip's energy stays chip-only.
+        assert sum(pod_j.values()) == pytest.approx(
+            chip_j["0"] + chip_j["1"]
+        )
+        # The shared chip split equally.
+        assert pod_j[("ml", "job-b")] == pytest.approx(chip_j["1"] / 2)
+
+    def test_step_join_and_source_propagation(self, monkeypatch):
+        monkeypatch.setenv("TPUMON_ENERGY_DOLLARS_PER_KWH", "0.10")
+        plane = EnergyPlane()
+        snap = _chip_snap(
+            **{
+                "0": {"power_w": 100.0, "duty_pct": 50.0},
+                "1": {"duty_pct": 50.0},  # one modeled chip
+            }
+        )
+        snap["lifecycle"] = {
+            # The canonical joined means the lifecycle plane injects
+            # (the energy plane reads these, never re-merges feeds).
+            "feeds": {
+                "u1": {"tokens_per_second": 2048.0, "step_seconds": 0.5},
+            },
+            "tokens_per_second": 2048.0,
+            "step_seconds": 0.5,
+        }
+        fams = _by_name(plane.cycle(1000.0, _stats(snap)))
+        node_w = sum(
+            s.value for s in fams["tpu_energy_power_watts"].samples
+        )
+        (tpj,) = fams["tpu_step_tokens_per_joule"].samples
+        assert tpj.value == pytest.approx(2048.0 / node_w)
+        # One modeled chip makes every joined family modeled.
+        assert tpj.labels["source"] == "modeled"
+        (step_j,) = fams["tpu_step_energy_joules"].samples
+        assert step_j.value == pytest.approx(node_w * 0.5)
+        (cost,) = fams["tpu_step_cost_dollars"].samples
+        assert cost.value == pytest.approx(node_w * 0.5 / 3.6e6 * 0.10)
+        block = snap["energy"]
+        assert block["source"] == "modeled"
+        assert block["tokens_per_joule"] == pytest.approx(tpj.value)
+
+    def test_tokens_per_joule_splits_job_rate_across_hosts(self):
+        """Each host of a dp job reports the JOB-global token rate; a
+        4-host slice must divide it by 4 before dividing by this node's
+        watts, or the headline is inflated by the host count."""
+        plane = EnergyPlane()
+        snap = _chip_snap(**{"0": {"power_w": 100.0, "duty_pct": 50.0}})
+        snap["identity"]["hosts"] = 4
+        snap["lifecycle"] = {
+            "feeds": {"u1": {}},
+            "tokens_per_second": 8000.0,
+            "step_seconds": 0.5,
+        }
+        fams = _by_name(plane.cycle(1000.0, _stats(snap)))
+        (tpj,) = fams["tpu_step_tokens_per_joule"].samples
+        assert tpj.value == pytest.approx(8000.0 / 4 / 100.0)
+        # Step energy stays node-scoped (THIS node's joules per step).
+        (step_j,) = fams["tpu_step_energy_joules"].samples
+        assert step_j.value == pytest.approx(100.0 * 0.5)
+
+    def test_attributed_pods_is_last_cycle_not_cumulative(self):
+        plane = EnergyPlane()
+        snap = _chip_snap(**{"0": {"power_w": 100.0, "duty_pct": 50.0}})
+        snap["pods"] = {"0": [("ml", "job-a")]}
+        plane.cycle(1000.0, _stats(snap))
+        plane.cycle(1001.0, _stats(snap))
+        # The pod churns away: the counter series stays (it's a
+        # counter) but the last-cycle block must read 0 attributed.
+        gone = _chip_snap(**{"0": {"power_w": 100.0, "duty_pct": 50.0}})
+        plane.cycle(1002.0, _stats(gone))
+        doc = plane.snapshot()
+        assert doc["pod_series"] == 1
+        assert doc["last"]["attributed_pods"] == 0
+
+    def test_cost_absent_while_price_unset(self, monkeypatch):
+        monkeypatch.delenv("TPUMON_ENERGY_DOLLARS_PER_KWH", raising=False)
+        plane = EnergyPlane()
+        snap = _chip_snap(**{"0": {"power_w": 100.0, "duty_pct": 50.0}})
+        snap["lifecycle"] = {
+            "feeds": {"u1": {"tokens_per_second": 10.0, "step_seconds": 0.5}},
+            "tokens_per_second": 10.0,
+            "step_seconds": 0.5,
+        }
+        fams = _by_name(plane.cycle(1000.0, _stats(snap)))
+        assert "tpu_step_cost_dollars" not in fams
+        assert "tpu_step_tokens_per_joule" in fams
+
+    def test_every_emitted_family_carries_source(self, monkeypatch):
+        monkeypatch.setenv("TPUMON_ENERGY_DOLLARS_PER_KWH", "0.10")
+        plane = EnergyPlane()
+        snap = _chip_snap(**{"0": {"power_w": 100.0, "duty_pct": 50.0}})
+        snap["pods"] = {"0": [("ml", "job-a")]}
+        snap["lifecycle"] = {
+            "feeds": {"u1": {"tokens_per_second": 10.0, "step_seconds": 0.5}},
+            "tokens_per_second": 10.0,
+            "step_seconds": 0.5,
+        }
+        plane.cycle(1000.0, _stats(snap))
+        for fam in plane.cycle(1001.0, _stats(snap)):
+            for s in fam.samples:
+                assert s.labels.get("source") in ("measured", "modeled"), (
+                    fam.name
+                )
+
+
+# -- efficiency_regression detector -----------------------------------------
+
+
+def _energy_block(tpj: float, sig=("u1",), transition=False) -> dict:
+    return {
+        "lifecycle": {"transition": transition},
+        "energy": {
+            "available": True,
+            "source": "modeled",
+            "tokens_per_joule": tpj,
+            "workload_sig": sig,
+        },
+    }
+
+
+class TestEfficiencyDetector:
+    def _warm(self, det, n=25, tpj=2.0, t0=0.0):
+        for i in range(n):
+            det.observe(t0 + i, _energy_block(tpj), None)
+
+    def test_fires_on_worse_tokens_per_joule_only(self):
+        from tpumon.energy.detectors import EfficiencyRegressionDetector
+
+        det = EfficiencyRegressionDetector()
+        self._warm(det)
+        # BETTER efficiency re-baselines silently (one-sided).
+        assert det.observe(100.0, _energy_block(3.0), None) == []
+        det.reset()
+        self._warm(det)
+        out = det.observe(200.0, _energy_block(1.3), None)
+        assert out and out[0].active
+        assert "efficiency regression" in out[0].message
+        # Clears once tokens/J recovers within the clear band.
+        cleared = det.observe(201.0, _energy_block(2.0), None)
+        assert cleared and not cleared[0].active
+
+    def test_preset_change_rewarns_instead_of_alerting(self):
+        from tpumon.energy.detectors import EfficiencyRegressionDetector
+
+        det = EfficiencyRegressionDetector()
+        self._warm(det)
+        # A new workload signature with much worse tokens/J is a new
+        # regime, not a regression against the old preset.
+        out = det.observe(100.0, _energy_block(0.5, sig=("u2",)), None)
+        assert out == []
+
+    def test_lifecycle_transition_resets_and_silences(self):
+        from tpumon.energy.detectors import EfficiencyRegressionDetector
+
+        det = EfficiencyRegressionDetector()
+        self._warm(det)
+        # A preemption collapses tokens/J mid-transition: no verdict.
+        for i in range(5):
+            assert (
+                det.observe(
+                    100.0 + i, _energy_block(0.1, transition=True), None
+                )
+                == []
+            )
+        # Recovery after the window is a fresh warmup, not a spike.
+        assert det.observe(110.0, _energy_block(2.0), None) == []
+
+    def test_rides_suppressible_roster(self):
+        from tpumon.lifecycle.detectors import SUPPRESSIBLE_DETECTORS
+
+        assert "efficiency_regression" in SUPPRESSIBLE_DETECTORS
+
+    def test_suppression_interplay_through_engine(self):
+        """Engine-level: an efficiency verdict raised while a lifecycle
+        window is open is counted into tpu_anomaly_suppressed_total,
+        never retained as an event."""
+        from tpumon.anomaly.engine import AnomalyEngine
+        from tpumon.energy.detectors import EfficiencyRegressionDetector
+
+        det = EfficiencyRegressionDetector()
+        engine = AnomalyEngine(detectors=[det])
+        for i in range(25):
+            engine.observe(float(i), _energy_block(2.0))
+        # The drop arrives in the same cycle the transition opens (the
+        # tracker recognized a preemption; suppress list is injected).
+        snap = _energy_block(1.3)
+        snap["lifecycle"] = {
+            "transition": False,  # detector itself sees no transition
+            "suppress": ["efficiency_regression"],
+        }
+        engine.observe(100.0, snap)
+        assert engine.events() == []
+        assert engine.suppressed_counts() == {"efficiency_regression": 1}
+
+
+# -- exporter e2e ------------------------------------------------------------
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+class TestExporterIntegration:
+    @pytest.fixture
+    def exporter_for(self):
+        built = []
+
+        def build(backend, **cfg_overrides):
+            from tpumon.config import Config
+            from tpumon.exporter.server import build_exporter
+
+            cfg = Config(
+                port=0, addr="127.0.0.1", interval=0.1,
+                pod_attribution=False, **cfg_overrides,
+            )
+            exp = build_exporter(cfg, backend)
+            exp.start()
+            built.append(exp)
+            return exp
+
+        yield build
+        for exp in built:
+            exp.close()
+
+    def _page(self, exp) -> str:
+        with urllib.request.urlopen(
+            exp.server.url + "/metrics", timeout=10
+        ) as resp:
+            return resp.read().decode()
+
+    def test_modeled_page_and_debug_vars(self, exporter_for):
+        from tpumon.backends.fake import FakeTpuBackend
+
+        exp = exporter_for(FakeTpuBackend.preset("v4-8", ici_flake=0.0))
+        exp.poller.poll_once()
+        page = self._page(exp)
+        assert 'tpu_energy_power_watts{' in page
+        assert 'source="modeled"' in page
+        assert 'source="measured"' not in page
+        assert 'tpu_energy_joules_total{' in page
+        doc = _get_json(exp.server.url + "/debug/vars")["energy"]
+        assert doc["last"]["tdp_key"] == "v4"
+        assert doc["last"]["chips"] == {"measured": 0, "modeled": 4}
+
+    def test_measured_page_uses_device_power(self, exporter_for):
+        from tpumon.backends.fake import FakeTpuBackend
+
+        exp = exporter_for(
+            FakeTpuBackend.preset("v4-8", ici_flake=0.0, power_metric=True)
+        )
+        exp.poller.poll_once()
+        page = self._page(exp)
+        assert 'accelerator_power_watts{' in page  # the device family
+        assert 'source="measured"' in page
+        # Every energy sample is measured: no chip fell back to a model.
+        for line in page.splitlines():
+            if line.startswith("tpu_energy_"):
+                assert 'source="measured"' in line, line
+
+    def test_disabled_plane_leaves_page_clean(self, exporter_for):
+        from tpumon.backends.fake import FakeTpuBackend
+
+        exp = exporter_for(
+            FakeTpuBackend.preset("v4-8", ici_flake=0.0), energy=False
+        )
+        exp.poller.poll_once()
+        page = self._page(exp)
+        assert "tpu_energy_" not in page
+        assert "energy" not in _get_json(exp.server.url + "/debug/vars")
+        assert "efficiency_regression" not in _get_json(
+            exp.server.url + "/anomalies"
+        )["detectors"]
+
+    def test_efficiency_detector_armed_on_default_exporter(self, exporter_for):
+        from tpumon.backends.fake import FakeTpuBackend
+
+        exp = exporter_for(FakeTpuBackend.preset("v4-8", ici_flake=0.0))
+        doc = _get_json(exp.server.url + "/anomalies")
+        assert "efficiency_regression" in doc["detectors"]
+
+    def test_smi_energy_line_from_live_page(self, exporter_for):
+        import io
+
+        from tpumon import smi
+        from tpumon.backends.fake import FakeTpuBackend
+
+        exp = exporter_for(FakeTpuBackend.preset("v4-8", ici_flake=0.0))
+        exp.poller.poll_once()
+        snap = smi.snapshot_from_text(self._page(exp))
+        assert snap["energy"]["source"] == "modeled"
+        assert snap["energy"]["watts"] > 0
+        out = io.StringIO()
+        smi.render(snap, out=out)
+        assert "ENERGY:" in out.getvalue()
+
+    def test_doctor_power_source_line(self):
+        import io
+
+        from tpumon import doctor
+        from tpumon.backends.fake import FakeTpuBackend
+        from tpumon.config import Config
+
+        out = io.StringIO()
+        doctor.run(
+            Config(), out=out,
+            backend=FakeTpuBackend.preset("v4-8", ici_flake=0.0),
+        )
+        text = out.getvalue()
+        assert "energy: power source MODELED" in text
+        assert "275 W/chip (v4" in text
+        out = io.StringIO()
+        doctor.run(
+            Config(), out=out,
+            backend=FakeTpuBackend.preset(
+                "v4-8", ici_flake=0.0, power_metric=True
+            ),
+        )
+        assert "energy: power source MEASURED" in out.getvalue()
+
+
+# -- step-skew straggler evidence (satellite) --------------------------------
+
+
+class TestStepSkewJudge:
+    def test_step_skew_onsets_without_duty_skew(self):
+        from tpumon.hostcorr.detectors import (
+            HostCorrThresholds,
+            StragglerJudge,
+        )
+
+        t = HostCorrThresholds(skew_cycles=3)
+        judge = StragglerJudge()
+        duties = {"0": 80.0, "1": 79.0}  # balanced chips: no duty skew
+        steps = {"a": 1.0, "b": 1.0, "c": 1.8}  # host c lags the job
+        for _ in range(2):
+            v = judge.judge(duties, None, {}, t, step_seconds=steps)
+            assert not v["active"]
+        v = judge.judge(duties, None, {}, t, step_seconds=steps)
+        assert v["active"]
+        assert v["step_feed"] == "c"
+        assert v["step_skew_ratio"] == pytest.approx(0.8)
+        # A step-only episode blames the lagging HOST, never this
+        # node's duty-worst chip (its duty evidence is meaningless).
+        assert v["chip"] == ""
+        assert v["evidence"] == ["step"]
+        # Cause attribution unchanged: no host signals, no throttle ->
+        # the same "unknown" any duty-skew episode would get.
+        assert v["cause"] == "unknown"
+
+    def test_step_episode_does_not_halve_duty_onset_bar(self):
+        """Per-stream hysteresis: a step episode must not let a benign
+        sub-onset duty skew (12 pts: above the 10-pt clear band, below
+        the 20-pt onset bar) latch the duty stream and keep the verdict
+        active after the step episode ends."""
+        from tpumon.hostcorr.detectors import (
+            HostCorrThresholds,
+            StragglerJudge,
+        )
+
+        t = HostCorrThresholds(skew_cycles=2)
+        judge = StragglerJudge()
+        duties = {"0": 80.0, "1": 80.0, "2": 68.0}  # 12-pt benign skew
+        lagging = {"a": 1.0, "b": 1.0, "c": 1.8}
+        recovered = {"a": 1.0, "b": 1.0, "c": 1.0}
+        for _ in range(3):
+            judge.judge(duties, None, {}, t, step_seconds=lagging)
+        v = judge.judge(duties, None, {}, t, step_seconds=lagging)
+        assert v["active"] and v["evidence"] == ["step"]
+        # Step skew recovers: the whole verdict must clear — the duty
+        # stream never earned an onset of its own.
+        for _ in range(4):
+            v = judge.judge(duties, None, {}, t, step_seconds=recovered)
+        assert not v["active"]
+
+    def test_step_skew_below_ratio_never_arms(self):
+        from tpumon.hostcorr.detectors import (
+            HostCorrThresholds,
+            StragglerJudge,
+        )
+
+        t = HostCorrThresholds(skew_cycles=2)
+        judge = StragglerJudge()
+        steps = {"a": 1.0, "b": 1.2}  # 20% < the 50% default ratio
+        for _ in range(6):
+            v = judge.judge({"0": 80.0, "1": 79.0}, None, {}, t,
+                            step_seconds=steps)
+            assert not v["active"]
+
+    def test_duty_only_call_shape_unchanged(self):
+        from tpumon.hostcorr.detectors import (
+            HostCorrThresholds,
+            StragglerJudge,
+        )
+
+        judge = StragglerJudge()
+        v = judge.judge({"0": 80.0}, None, {}, HostCorrThresholds())
+        assert v == {"active": False, "skew_pct": None}
+
+    def test_plane_feeds_step_telemetry_to_judge(self):
+        """End-to-end through HostCorrPlane.cycle: the lifecycle block
+        injected earlier in the cycle arms the step stream."""
+        from tpumon.hostcorr.plane import HostCorrPlane
+
+        plane = HostCorrPlane(proc_root="/nonexistent-proc-root")
+        snap = _chip_snap(
+            **{"0": {"duty_pct": 80.0}, "1": {"duty_pct": 79.0}}
+        )
+        snap["lifecycle"] = {
+            "feeds": {
+                "a": {"step_seconds": 1.0},
+                "b": {"step_seconds": 1.0},
+                "c": {"step_seconds": 2.0},
+            }
+        }
+        verdict = None
+        fams = None
+        for i in range(6):
+            stats = _stats(json.loads(json.dumps(snap)))
+            fams = _by_name(plane.cycle(1000.0 + i, stats))
+            verdict = stats.snapshot["hostcorr"]["straggler"]
+        assert verdict["active"]
+        assert verdict["step_feed"] == "c"
+        # The step magnitude is on the PAGE, not just in the JSON —
+        # fleet ranking and dashboards see the episode's size.
+        (ratio,) = fams["tpu_straggler_step_skew_ratio"].samples
+        assert ratio.value == pytest.approx(1.0)
+
+
+# -- fleet ingest / rollup ---------------------------------------------------
+
+
+_NODE_PAGE = """\
+accelerator_info{slice="s0",host="h0",worker="0",accelerator="v4-8",chip="0",coords="",device_id="d0",cores="2"} 1.0
+accelerator_device_count{slice="s0",host="h0",worker="0",accelerator="v4-8"} 2
+tpu_energy_power_watts{slice="s0",host="h0",worker="0",accelerator="v4-8",chip="0",source="measured"} 150.0
+tpu_energy_power_watts{slice="s0",host="h0",worker="0",accelerator="v4-8",chip="1",source="modeled"} 100.0
+tpu_step_tokens_per_joule{slice="s0",host="h0",worker="0",accelerator="v4-8",source="modeled"} 4.0
+"""
+
+
+class TestFleet:
+    def test_ingest_parses_energy(self):
+        from tpumon.fleet.ingest import node_snapshot_from_text
+
+        snap = node_snapshot_from_text(_NODE_PAGE)
+        assert snap["energy"]["watts"] == pytest.approx(250.0)
+        # One modeled chip makes the node modeled.
+        assert snap["energy"]["source"] == "modeled"
+        assert snap["energy"]["tokens_per_joule"] == pytest.approx(4.0)
+
+    def test_rollup_sums_watts_and_means_tpj(self):
+        from tpumon.fleet.rollup import fleet_families, rollup
+
+        def node(watts, tpj, source):
+            return {
+                "snap": {
+                    "identity": {"accelerator": "v4-8", "slice": "s0"},
+                    "chips": {},
+                    "energy": {
+                        "watts": watts, "source": source,
+                        "tokens_per_joule": tpj,
+                    },
+                },
+                "state": "up",
+            }
+
+        doc = rollup(
+            [node(250.0, 4.0, "measured"), node(150.0, 2.0, "modeled")]
+        )
+        fleet = doc["fleet"]
+        assert fleet["energy_watts"] == pytest.approx(400.0)
+        assert fleet["energy_source"] == "modeled"
+        assert fleet["tokens_per_joule"] == pytest.approx(3.0)
+        fams = _by_name(fleet_families(doc))
+        watts_rows = {
+            (s.labels["scope"], s.labels["source"]): s.value
+            for s in fams["tpu_fleet_energy_watts"].samples
+        }
+        assert watts_rows[("fleet", "modeled")] == pytest.approx(400.0)
+        assert ("slice", "modeled") in watts_rows
+        for s in fams["tpu_fleet_tokens_per_joule"].samples:
+            assert s.labels["source"] in ("measured", "modeled")
+
+    def test_all_measured_scope_stays_measured(self):
+        from tpumon.fleet.rollup import rollup
+
+        doc = rollup(
+            [
+                {
+                    "snap": {
+                        "identity": {"accelerator": "v4-8", "slice": "s0"},
+                        "chips": {},
+                        "energy": {"watts": 100.0, "source": "measured"},
+                    },
+                    "state": "up",
+                }
+            ]
+        )
+        assert doc["fleet"]["energy_source"] == "measured"
+
+    def test_merge_buckets_weights_tpj_and_degrades_source(self):
+        from tpumon.fleet.rollup import merge_buckets
+
+        merged = merge_buckets(
+            [
+                {
+                    "hosts": {"up": 2, "stale": 0, "dark": 0},
+                    "chips": 0, "degraded_hosts": 0, "stale": False,
+                    "energy_watts": 400.0, "energy_n": 2,
+                    "energy_source": "measured",
+                    "tokens_per_joule": 4.0, "tokens_per_joule_n": 2,
+                },
+                {
+                    "hosts": {"up": 1, "stale": 0, "dark": 0},
+                    "chips": 0, "degraded_hosts": 0, "stale": False,
+                    "energy_watts": 100.0, "energy_n": 1,
+                    "energy_source": "modeled",
+                    "tokens_per_joule": 1.0, "tokens_per_joule_n": 1,
+                },
+            ]
+        )
+        assert merged["energy_watts"] == pytest.approx(500.0)
+        assert merged["energy_source"] == "modeled"
+        assert merged["tokens_per_joule"] == pytest.approx(3.0)
+
+    def test_fast_parser_still_matches_full_on_power_page(self):
+        from tpumon import smi
+        from tpumon._native import _python_render
+        from tpumon.backends.fake import FakeTpuBackend
+        from tpumon.config import Config
+        from tpumon.exporter.collector import build_families
+        from tpumon.fleet.ingest import node_snapshot_from_text
+
+        families, _ = build_families(
+            FakeTpuBackend.preset("v4-8", power_metric=True), Config()
+        )
+        text = _python_render(tuple(families)).decode()
+        fast = node_snapshot_from_text(text)
+        full = smi.snapshot_from_text(text)
+        assert fast["chips"] == full["chips"]
+        assert all("power_w" in row for row in fast["chips"].values())
+
+
+# -- drift nets --------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_families_subset_registry_subset_docs(self):
+        import os
+
+        from tpumon.families import ENERGY_FAMILIES, all_family_names
+
+        assert set(ENERGY_FAMILIES) <= all_family_names()
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(
+            os.path.join(here, "docs", "METRICS.md"), encoding="utf-8"
+        ) as fh:
+            doc = fh.read()
+        for name in ENERGY_FAMILIES:
+            assert name in doc, f"{name} missing from docs/METRICS.md"
+        for name in ("tpu_fleet_energy_watts", "tpu_fleet_tokens_per_joule"):
+            assert name in doc
+
+    def test_emitted_families_are_registered(self):
+        """Every family the plane can emit exists in ENERGY_FAMILIES
+        with a source label registered."""
+        from tpumon.families import ENERGY_FAMILIES
+
+        for name, (_, _, labels) in ENERGY_FAMILIES.items():
+            assert "source" in labels, (
+                f"{name} must carry the source provenance label"
+            )
+
+
+# -- soak smoke --------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_efficiency_soak_smoke():
+    from tpumon.tools.soak import efficiency_soak
+
+    record = efficiency_soak(20.0, topology="v4-8", interval=0.25)
+    assert record["false_positives"] == 0
+    assert record["regression_detected"] is True
+    assert record["all_energy_families_source_labeled"] is True
+    assert record["device_calls_per_cycle"] == (
+        record["control_calls_per_cycle"]
+    )
+
+
+def test_efficiency_soak_rejects_bad_args():
+    from tpumon.tools.soak import efficiency_soak
+
+    with pytest.raises(ValueError):
+        efficiency_soak(0.0)
+    with pytest.raises(ValueError):
+        efficiency_soak(60.0, interval=10.0)  # < 60*interval
+    with pytest.raises(ValueError):
+        efficiency_soak(60.0, factor=1.5)

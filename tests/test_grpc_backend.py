@@ -912,7 +912,10 @@ def test_production_spellings_golden_routing(monkeypatch, topo_file):
     from tpumon.discovery.topology import Chip, Topology
 
     sdk_names = tuple(sp.source for sp in schema.LIBTPU_SPECS)
-    assert len(sdk_names) == 14  # the live-probed denominator (SURVEY §2.2)
+    # 14 live-probed libtpu 0.0.34 metrics (SURVEY §2.2) plus the
+    # forward-looking device_power spec (tpumon/energy): an SDK that
+    # lists it routes it like any other metric.
+    assert len(sdk_names) == 15
 
     class FakeSdk:
         def __init__(self, *a, **k):

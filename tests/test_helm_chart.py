@@ -68,12 +68,22 @@ def test_prometheusrule_template_matches_deploy_rules():
 
 
 def test_template_env_vars_exist_in_config():
-    """Every TPUMON_* env the chart sets must be a real Config knob."""
+    """Every TPUMON_* env the chart sets must be a real knob: a Config
+    field, or a prefix-composed tuning field the chart surfaces
+    explicitly (TPUMON_ENERGY_DOLLARS_PER_KWH — the one energy knob an
+    operator must set per deployment, so it gets a first-class value)."""
+    import dataclasses
+
     from tpumon.config import Config
+    from tpumon.energy.model import EnergyTuning
 
     known = {
         "TPUMON_" + f.upper()
         for f in Config.__dataclass_fields__  # type: ignore[attr-defined]
+    }
+    known |= {
+        "TPUMON_ENERGY_" + f.name.upper()
+        for f in dataclasses.fields(EnergyTuning)
     }
     with open(
         os.path.join(CHART, "templates", "daemonset.yaml"), encoding="utf-8"

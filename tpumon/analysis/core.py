@@ -223,6 +223,7 @@ PIPELINE_PREFIXES = (
     "tpumon/fleet/",
     "tpumon/hostcorr/",
     "tpumon/lifecycle/",
+    "tpumon/energy/",
     "tpumon/history.py",
 )
 

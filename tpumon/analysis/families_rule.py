@@ -46,6 +46,7 @@ _REGISTRY_DICTS = {
     "ANOMALY_FAMILIES",
     "HOSTCORR_FAMILIES",
     "LIFECYCLE_FAMILIES",
+    "ENERGY_FAMILIES",
     "SELF_FAMILIES",
     "STEP_FAMILIES",
     "FLEET_FAMILIES",
@@ -59,6 +60,7 @@ _REGISTRY_DICTS = {
 _METRIC_RE = re.compile(
     r"\b(?:(?:accelerator|exporter|collector|workload|host|tpu_anomaly"
     r"|tpu_hostcorr|tpu_straggler|tpu_lifecycle|tpu_step"
+    r"|tpu_energy|tpu_pod_energy"
     r"|tpu_fleet|tpumon_trace|tpumon_poll|tpumon_family|tpumon_breaker"
     r"|tpumon_retries|tpumon_watchdog|tpumon_guard|tpumon_shed"
     r"|tpumon_cardinality|tpumon_render|tpumon_exposition)_[a-z0-9_]+"
@@ -78,6 +80,7 @@ _EMIT_PREFIXES = (
     "tpumon/fleet/",
     "tpumon/hostcorr/",
     "tpumon/lifecycle/",
+    "tpumon/energy/",
     "tpumon/workload/",
 )
 

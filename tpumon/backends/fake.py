@@ -93,6 +93,7 @@ class FakeTpuBackend:
         fail_metrics: tuple[str, ...] = (),
         malformed_metrics: tuple[str, ...] = (),
         ici_flake: float = 0.03,
+        power_metric: bool = False,
     ) -> None:
         self._topology = topology
         self._hbm = hbm_bytes
@@ -104,6 +105,12 @@ class FakeTpuBackend:
         #: Per-step probability that a given ICI link reports unusable (10).
         #: 0.0 gives an always-healthy fabric (doctor/health OK-path tests).
         self.ici_flake = ici_flake
+        #: Opt-in "device_power" metric (newer-runtime power telemetry):
+        #: off by default so the 14-metric libtpu 0.0.34 shape stays the
+        #: golden-test baseline; on, per-chip watts correlate with the
+        #: same noise stream as duty_cycle_pct, so measured-vs-modeled
+        #: comparisons are deterministic (tests/test_energy.py).
+        self.power_metric = power_metric
 
     # -- construction -----------------------------------------------------
 
@@ -146,6 +153,8 @@ class FakeTpuBackend:
     # -- Backend protocol -------------------------------------------------
 
     def list_metrics(self) -> tuple[str, ...]:
+        if self.power_metric:
+            return LIBTPU_METRICS + ("device_power",)
         return LIBTPU_METRICS
 
     def topology(self) -> Topology:
@@ -171,7 +180,13 @@ class FakeTpuBackend:
     def sample(self, name: str) -> RawMetric:
         if name in self.fail_metrics:
             raise BackendError(f"injected failure for {name}")
-        if name not in LIBTPU_METRICS:
+        # Membership is checked against the static sets, NOT via
+        # list_metrics(): resilience tests wedge the enumeration call on
+        # purpose, and sampling from the remembered list must keep
+        # working through exactly that outage.
+        if name not in LIBTPU_METRICS and not (
+            self.power_metric and name == "device_power"
+        ):
             raise BackendError(f"unsupported metric {name}")
         if not self.attached or self._topology.num_chips == 0:
             return RawMetric(name, ())
@@ -192,6 +207,14 @@ class FakeTpuBackend:
 
         if name == "duty_cycle_pct":
             return tuple(f"{100 * self._u('duty', c):.2f}" for c in chips)
+        if name == "device_power":
+            # Watts tracking the SAME noise stream as duty_cycle_pct:
+            # idle floor + duty-proportional draw, so measured-vs-
+            # modeled comparisons are deterministic per (seed, step).
+            return tuple(
+                f"{200.0 * (0.15 + 0.85 * self._u('duty', c)):.2f}"
+                for c in chips
+            )
         if name == "tensorcore_util":
             return tuple(f"{100 * self._u('tc', c):.2f}" for c in cores)
         if name == "hbm_capacity_total":

@@ -156,6 +156,15 @@ class Config:
     #: Correlation-ring capacity (one joined host+device record per poll
     #: cycle, served by /hostcorr).
     hostcorr_ring: int = 600
+    #: Energy & cost plane (tpumon/energy): per-chip power (measured
+    #: where the backend exposes it, duty×TDP modeled where not — every
+    #: family source-labeled), monotonic joules counters, pod-attributed
+    #: energy, and tokens-per-joule / dollars-per-step joins against the
+    #: lifecycle plane's step telemetry. Tuning (incl. the
+    #: TPUMON_ENERGY_DOLLARS_PER_KWH price knob and the
+    #: TPUMON_ENERGY_TDP_W override) rides separate TPUMON_ENERGY_<FIELD>
+    #: env vars (tpumon/energy/model.py).
+    energy: bool = True
     #: Workload-lifecycle robustness plane (tpumon/lifecycle): probe the
     #: workload harness's metrics port (tpu_step_* families), classify
     #: preemption/resize/restore transitions, suppress false verdicts
@@ -297,6 +306,7 @@ class Config:
             )
             or base.hostcorr_proc_root,
             hostcorr_ring=_env_int("HOSTCORR_RING", base.hostcorr_ring),
+            energy=_env_bool("ENERGY", base.energy),
             lifecycle=_env_bool("LIFECYCLE", base.lifecycle),
             lifecycle_step_urls=_env(
                 "LIFECYCLE_STEP_URLS", base.lifecycle_step_urls

@@ -254,6 +254,40 @@ def run(cfg: Config, out=sys.stdout, backend=None) -> int:
         # no verdict to print here — only the armed roster. Live verdicts
         # (shared ok/warn/crit ordering) come from the running exporter:
         # GET /anomalies, or the `tpumon smi` anomalies line.
+        # Energy/cost plane (tpumon/energy): which power source this
+        # node would report — measured when the device library lists a
+        # power metric, otherwise the duty×TDP model with the table row
+        # (or override) it rides on. The operator's "can I trust the
+        # watts" answer without a running exporter.
+        if cfg.energy:
+            from tpumon.energy import env_thresholds as energy_tuning
+            from tpumon.energy import tdp_for
+            from tpumon.schema import SPECS_BY_FAMILY
+
+            power_spec = SPECS_BY_FAMILY["accelerator_power_watts"]
+            has_power = power_spec.source in supported
+            et = energy_tuning()
+            tdp_w, tdp_key = tdp_for(topo.accelerator_type, et)
+            if has_power:
+                p(
+                    "energy: power source MEASURED (device metric "
+                    f"{power_spec.source}); model fallback duty×TDP "
+                    f"{tdp_w:.0f} W/chip ({tdp_key})"
+                )
+            else:
+                p(
+                    "energy: power source MODELED — no device power "
+                    f"telemetry; duty×TDP {tdp_w:.0f} W/chip "
+                    f"({tdp_key}; override via TPUMON_ENERGY_TDP_W)"
+                    + (
+                        f", ${et.dollars_per_kwh:g}/kWh"
+                        if et.dollars_per_kwh > 0
+                        else ", cost family off (TPUMON_ENERGY_DOLLARS_PER_KWH unset)"
+                    )
+                )
+        else:
+            p("energy: disabled (TPUMON_ENERGY=0)")
+
         if cfg.anomaly:
             from tpumon.anomaly import DETECTOR_NAMES
 
@@ -262,6 +296,10 @@ def run(cfg: Config, out=sys.stdout, backend=None) -> int:
                 from tpumon.hostcorr import HOSTCORR_DETECTOR_NAMES
 
                 roster += list(HOSTCORR_DETECTOR_NAMES)
+            if cfg.energy:
+                from tpumon.energy import ENERGY_DETECTOR_NAMES
+
+                roster += list(ENERGY_DETECTOR_NAMES)
             p(
                 "anomaly detection: enabled (detectors: "
                 + ", ".join(roster)

@@ -1,0 +1,41 @@
+"""Energy & cost plane (ISSUE 12; PAPERS.md 2504.10702, 2605.20799).
+
+Joules per chip, tokens per joule, dollars per step — power sampled
+where the device library exposes it, modeled (duty × TDP,
+HBM-adjusted) where it doesn't, every family ``source``-labeled so a
+model is never passed off as a reading. See tpumon/energy/plane.py for
+the poll-cycle pass and docs/OPERATIONS.md for the efficiency-triage
+runbook.
+"""
+
+from tpumon.energy.detectors import (
+    ENERGY_DETECTOR_NAMES,
+    EfficiencyRegressionDetector,
+    energy_detectors,
+)
+from tpumon.energy.model import (
+    DEFAULT_TDP_W,
+    EnergyTuning,
+    SOURCE_MEASURED,
+    SOURCE_MODELED,
+    TDP_TABLE_W,
+    env_thresholds,
+    model_power_w,
+    tdp_for,
+)
+from tpumon.energy.plane import EnergyPlane
+
+__all__ = [
+    "DEFAULT_TDP_W",
+    "ENERGY_DETECTOR_NAMES",
+    "EfficiencyRegressionDetector",
+    "EnergyPlane",
+    "EnergyTuning",
+    "SOURCE_MEASURED",
+    "SOURCE_MODELED",
+    "TDP_TABLE_W",
+    "energy_detectors",
+    "env_thresholds",
+    "model_power_w",
+    "tdp_for",
+]

@@ -1,0 +1,123 @@
+"""The efficiency-regression detector: same preset, worse tokens/joule.
+
+Consumes the ``energy`` block the EnergyPlane injects into
+PollStats.snapshot (tokens/joule + the workload signature) with the
+tpumon.anomaly observe() contract, so efficiency regressions ride the
+existing engine: onset/clear events, /anomalies replay, bounded rings,
+and the 1 Hz history window of ``tpu_step_tokens_per_joule``.
+
+Design points (ISSUE 12):
+
+- **One-sided**: only *lower* tokens/J onsets — an efficiency
+  improvement re-baselines silently (nobody pages on cheaper training).
+- **Same workload preset**: the EWMA baseline is keyed to the energy
+  block's workload signature (feed set + mesh axes). A different preset
+  starting is a different efficiency regime, not a regression — the
+  baseline re-warms instead of comparing across workloads.
+- **Lifecycle-suppression aware**: during a recognized
+  preemption/resize/restore window the detector resets and stays
+  silent (and ``efficiency_regression`` rides SUPPRESSIBLE_DETECTORS,
+  so anything that does slip through is counted into
+  ``tpu_anomaly_suppressed_total``, never raised) — a preempted slice's
+  duty collapse at constant step accounting must not read as an
+  efficiency cliff.
+"""
+
+from __future__ import annotations
+
+import math
+
+from tpumon.energy.model import env_thresholds
+from tpumon.health import WARN
+
+
+class EfficiencyRegressionDetector:
+    """EWMA z-score on node tokens/joule, one-sided (lower is worse)."""
+
+    name = "efficiency_regression"
+    _family = "tpu_step_tokens_per_joule"
+
+    def __init__(self) -> None:
+        #: [mean, var, n] EWMA state on tokens/joule.
+        self._state: list[float] = [0.0, 0.0, 0]
+        self._sig: tuple | None = None
+        self._active = False
+
+    def reset(self) -> None:
+        """Lifecycle-suppression re-baseline: the transition explains
+        the efficiency move; post-event data re-warms the baseline."""
+        self._state = [0.0, 0.0, 0]
+        self._active = False
+
+    def observe(self, ts: float, snap: dict, t) -> list:
+        from tpumon.anomaly.detectors import Reading
+
+        lc = snap.get("lifecycle") or {}
+        if lc.get("transition"):
+            self.reset()
+            return []
+        block = snap.get("energy") or {}
+        tpj = block.get("tokens_per_joule")
+        if tpj is None or tpj <= 0:
+            return []
+        et = env_thresholds()
+        sig = block.get("workload_sig")
+        if sig != self._sig:
+            # A different preset (or feed set) is a different efficiency
+            # regime — never compare its tokens/J to the old baseline.
+            self._sig = sig
+            self.reset()
+        mean, var, n = self._state
+        out: list[Reading] = []
+        if n >= et.eff_warmup:
+            std = max(
+                math.sqrt(max(var, 0.0)),
+                et.eff_min_rel_std * max(mean, 1e-12),
+            )
+            z = (mean - tpj) / std  # positive = WORSE than baseline
+            was = self._active
+            active = z >= (et.eff_z_clear if was else et.eff_z_warn)
+            if active or was:
+                source = block.get("source") or "modeled"
+                out.append(
+                    Reading(
+                        "node",
+                        active,
+                        WARN,
+                        tpj,
+                        f"tokens/joule {tpj:.4g} is {z:.1f}σ below its "
+                        f"{mean:.4g} baseline for the same workload "
+                        f"preset ({source} power) — efficiency "
+                        "regression",
+                        self._family,
+                        (),
+                    )
+                )
+            self._active = active
+            if active:
+                return out  # freeze the baseline while regressed
+        # EWMA update (unfrozen path), alpha matching the step detector.
+        if n == 0:
+            self._state = [tpj, 0.0, 1]
+        else:
+            d = tpj - mean
+            mean += 0.1 * d
+            var = (1.0 - 0.1) * (var + 0.1 * d * d)
+            self._state = [mean, var, n + 1]
+        return out
+
+
+def energy_detectors() -> list:
+    """The efficiency detector roster appended to the anomaly engine
+    when the energy plane is enabled."""
+    return [EfficiencyRegressionDetector()]
+
+
+ENERGY_DETECTOR_NAMES: tuple[str, ...] = ("efficiency_regression",)
+
+
+__all__ = [
+    "ENERGY_DETECTOR_NAMES",
+    "EfficiencyRegressionDetector",
+    "energy_detectors",
+]

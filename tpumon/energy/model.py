@@ -1,0 +1,170 @@
+"""Power modeling and tuning for the energy/cost plane.
+
+Two power sources, always labeled (``source`` ∈ ``measured`` /
+``modeled``) so a dashboard can never pass a model off as a reading:
+
+- **measured** — the device library exposes instantaneous per-chip
+  power (the ``device_power`` metric → ``accelerator_power_watts``
+  family, tpumon/schema.py). Sampled by the ordinary poll loop like any
+  other device metric: the energy plane adds **zero** device queries.
+- **modeled** — no power telemetry: per-chip power is estimated as
+  duty-cycle × the accelerator generation's TDP envelope, adjusted for
+  HBM activity (container-level energy observability per PAPERS.md
+  2504.10702 models exactly this way when RAPL-style counters are
+  absent). The model is deliberately simple and *maintained*: the TDP
+  table below is the contract, ``TPUMON_ENERGY_TDP_W`` overrides it per
+  deployment, and docs/OPERATIONS.md carries the maintenance runbook.
+
+Tuning follows the AnomalyThresholds pattern: every field is a
+``TPUMON_ENERGY_<FIELD>`` env var, malformed values keep the default,
+re-parsed only when the env changes. ``TPUMON_ENERGY_DOLLARS_PER_KWH``
+(the cost knob) rides the same dataclass.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass, fields
+
+log = logging.getLogger(__name__)
+
+SOURCE_MEASURED = "measured"
+SOURCE_MODELED = "modeled"
+
+#: Nominal per-chip power envelope in watts by accelerator-type prefix
+#: (longest prefix wins; matched against the lowercased identity label).
+#: These are NOMINAL board-level envelopes for capacity math, not
+#: measurements — a fleet with power telemetry never consults this
+#: table, and one without it can pin exact values via
+#: TPUMON_ENERGY_TDP_W. Maintenance: add a row per new generation
+#: (docs/OPERATIONS.md "TDP table maintenance").
+TDP_TABLE_W: dict[str, float] = {
+    "v2": 280.0,
+    "v3": 450.0,
+    "v4": 275.0,
+    "v5litepod": 205.0,  # v5e market name; identity labels say v5litepod
+    "v5e": 205.0,
+    "v5p": 470.0,
+    "v6e": 185.0,
+}
+
+#: Fallback for accelerator types the table does not know (the fake
+#: bench shapes, future generations before their row lands).
+DEFAULT_TDP_W = 250.0
+
+
+@dataclass(frozen=True)
+class EnergyTuning:
+    """Energy-plane tuning, overridable per deployment via TPUMON_ENERGY_*."""
+
+    #: Electricity price driving tpu_step_cost_dollars; 0 (the default)
+    #: keeps the cost family absent — a made-up price is worse than none.
+    dollars_per_kwh: float = 0.0
+    #: Per-chip TDP override in watts; 0 = the TDP table above.
+    tdp_w: float = 0.0
+    #: Idle power as a fraction of TDP (chips draw real power at duty 0:
+    #: HBM refresh, ICI SerDes, clocks).
+    idle_fraction: float = 0.15
+    #: Fraction of the active (TDP - idle) envelope attributed to HBM
+    #: activity; the rest follows duty cycle alone. 0 = pure duty model.
+    hbm_weight: float = 0.2
+    #: Longest poll gap integrated into the joules counters: past this,
+    #: the remainder of the gap is NOT integrated (counted in the
+    #: /debug/vars energy block instead) — holding the last power
+    #: reading across a long outage would invent energy.
+    max_gap_s: float = 30.0
+    #: Efficiency-regression detector (tokens/joule EWMA, one-sided):
+    #: samples before arming, onset/clear z, and the relative std floor.
+    eff_warmup: float = 20.0
+    eff_z_warn: float = 4.0
+    eff_z_clear: float = 2.0
+    eff_min_rel_std: float = 0.05
+
+    @classmethod
+    def from_env(cls, environ=None) -> "EnergyTuning":
+        env = os.environ if environ is None else environ
+        kwargs = {}
+        for f in fields(cls):
+            raw = env.get("TPUMON_ENERGY_" + f.name.upper())
+            if raw is None:
+                continue
+            try:
+                kwargs[f.name] = float(raw)
+            except ValueError:
+                log.warning(
+                    "ignoring malformed TPUMON_ENERGY_%s=%r",
+                    f.name.upper(), raw,
+                )
+        return cls(**kwargs)
+
+
+#: (env-values key, parsed tuning) — re-parse only when the env changed,
+#: same cache shape as anomaly/hostcorr/lifecycle env_thresholds.
+_env_cache: tuple | None = None
+
+
+def env_thresholds() -> EnergyTuning:
+    global _env_cache
+    key = tuple(
+        os.environ.get("TPUMON_ENERGY_" + f.name.upper())
+        for f in fields(EnergyTuning)
+    )
+    if _env_cache is None or _env_cache[0] != key:
+        _env_cache = (key, EnergyTuning.from_env())
+    return _env_cache[1]
+
+
+def tdp_for(accelerator_type: str | None, t: EnergyTuning) -> tuple[float, str]:
+    """(per-chip TDP watts, provenance) for an identity label.
+
+    Provenance is the matched table key, ``"override"`` for the env
+    knob, or ``"default"`` — surfaced by doctor so an operator can see
+    which row their fleet's model rides on.
+    """
+    if t.tdp_w > 0:
+        return t.tdp_w, "override"
+    ident = (accelerator_type or "").lower()
+    best: tuple[int, float, str] | None = None
+    for prefix, watts in TDP_TABLE_W.items():
+        if ident.startswith(prefix) and (
+            best is None or len(prefix) > best[0]
+        ):
+            best = (len(prefix), watts, prefix)
+    if best is not None:
+        return best[1], best[2]
+    return DEFAULT_TDP_W, "default"
+
+
+def model_power_w(
+    duty_pct: float, hbm_ratio: float | None, tdp_w: float, t: EnergyTuning
+) -> float:
+    """Modeled per-chip power: idle floor plus the active envelope
+    scaled by duty cycle, HBM-activity adjusted.
+
+    ``activity = duty × ((1 - hbm_weight) + hbm_weight × hbm_ratio)``:
+    a chip at 100% duty with near-empty HBM (a spin loop, a tiny model)
+    draws less than one streaming a full HBM — the adjustment is bounded
+    by ``hbm_weight`` so a missing ratio degrades to the pure duty model
+    rather than guessing.
+    """
+    idle = t.idle_fraction * tdp_w
+    duty = min(max(duty_pct, 0.0), 100.0) / 100.0
+    if hbm_ratio is None:
+        activity = duty
+    else:
+        hbm = min(max(hbm_ratio, 0.0), 1.0)
+        activity = duty * ((1.0 - t.hbm_weight) + t.hbm_weight * hbm)
+    return idle + (tdp_w - idle) * activity
+
+
+__all__ = [
+    "DEFAULT_TDP_W",
+    "EnergyTuning",
+    "SOURCE_MEASURED",
+    "SOURCE_MODELED",
+    "TDP_TABLE_W",
+    "env_thresholds",
+    "model_power_w",
+    "tdp_for",
+]

@@ -1,0 +1,307 @@
+"""The energy/cost plane wired into the poll loop.
+
+One :meth:`EnergyPlane.cycle` call per poll, fed the PollStats the
+collector already computed. The pass:
+
+1. reads per-chip power where the device library exposed it this cycle
+   (``accelerator_power_watts`` — sampled by the ordinary poll loop,
+   **zero queries added by this plane**) and models it everywhere else
+   (duty-cycle × TDP, HBM-adjusted — tpumon/energy/model.py), labeling
+   every sample ``source="measured"|"modeled"``;
+2. integrates power into monotonic per-chip joules counters
+   (``tpu_energy_joules_total``) with gap honesty: a poll gap longer
+   than ``TPUMON_ENERGY_MAX_GAP_S`` is integrated only up to the cap —
+   the uncounted remainder is surfaced in the /debug/vars energy block
+   instead of invented;
+3. splits each chip's energy across the pods holding it (the existing
+   pod-attribution plane's ``accelerator_pod_info`` join) into
+   ``tpu_pod_energy_joules_total``;
+4. joins node power with the lifecycle plane's step telemetry
+   (``tpu_step_*`` feeds, same cycle) into the headline efficiency
+   families — ``tpu_step_energy_joules``, ``tpu_step_tokens_per_joule``,
+   ``tpu_step_cost_dollars`` (``TPUMON_ENERGY_DOLLARS_PER_KWH``);
+5. injects an ``energy`` block into ``PollStats.snapshot`` so the
+   efficiency-regression detector (tpumon/energy/detectors.py) sees
+   tokens/joule on the same bus every other detector rides.
+
+Source honesty: the joined step/efficiency families read ``measured``
+only when EVERY contributing chip's power was a device reading; one
+modeled chip makes the join ``modeled``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
+
+from tpumon.energy.model import (
+    SOURCE_MEASURED,
+    SOURCE_MODELED,
+    env_thresholds,
+    model_power_w,
+    tdp_for,
+)
+
+log = logging.getLogger(__name__)
+
+#: Joules per kilowatt-hour.
+_J_PER_KWH = 3.6e6
+
+
+class EnergyPlane:
+    """Thread model: ``cycle`` runs on the poller thread only;
+    ``snapshot`` may be called from HTTP threads — the totals dicts are
+    guarded by one lock held for dict work only."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        #: (chip, source) -> accumulated joules. Keyed per source so a
+        #: backend flapping between exposing and hiding power telemetry
+        #: moves accumulation between two series, EACH monotonic —
+        #: never a counter that jumps when the meaning of its value
+        #: changed under it.
+        self._joules: dict[tuple[str, str], float] = {}  # guarded-by: self._lock
+        #: (namespace, pod, source) -> accumulated joules.
+        self._pod_joules: dict[tuple[str, str, str], float] = {}  # guarded-by: self._lock
+        self._cycles = 0  # guarded-by: self._lock
+        #: Wall seconds NOT integrated because a poll gap exceeded
+        #: max_gap_s (+ how many gaps were clamped) — the honesty ledger.
+        self._gap_skipped_s = 0.0  # guarded-by: self._lock
+        self._gaps_clamped = 0  # guarded-by: self._lock
+        self._last: dict | None = None  # guarded-by: self._lock
+        #: Poller thread only.
+        self._last_ts: float | None = None
+
+    # -- poll-loop integration --------------------------------------------
+
+    def cycle(self, now: float, stats) -> list:
+        """One Poller cycle: read/model power, integrate, split, join."""
+        t = env_thresholds()
+        snap = stats.snapshot if stats.snapshot is not None else {}
+        chips = snap.get("chips") or {}
+        accel = (snap.get("identity") or {}).get("accelerator")
+        tdp_w, tdp_key = tdp_for(accel, t)
+
+        # Per-chip power, source-labeled. A chip reporting neither a
+        # device power reading nor a duty cycle contributes nothing
+        # (absent-not-zero) — modeling power for a chip we cannot see
+        # working would be a guess about a guess.
+        power: dict[str, tuple[float, str]] = {}
+        for chip in sorted(chips):
+            row = chips[chip]
+            measured = row.get("power_w")
+            if measured is not None:
+                power[chip] = (measured, SOURCE_MEASURED)
+                continue
+            duty = row.get("duty_pct")
+            if duty is None:
+                continue
+            used, total = row.get("hbm_used"), row.get("hbm_total")
+            hbm_ratio = used / total if used is not None and total else None
+            power[chip] = (
+                model_power_w(duty, hbm_ratio, tdp_w, t), SOURCE_MODELED
+            )
+
+        # Integration window with gap honesty.
+        dt = 0.0
+        skipped = 0.0
+        if self._last_ts is not None and now > self._last_ts:
+            gap = now - self._last_ts
+            dt = min(gap, max(0.0, t.max_gap_s)) if t.max_gap_s > 0 else gap
+            skipped = gap - dt
+        self._last_ts = now
+
+        # Pod split universe: accelerator_pod_info rows joined on the
+        # chip index label (the attribution plane already did the
+        # kubelet work; this is a dict walk).
+        pod_map: dict[str, list[tuple[str, str]]] = snap.get("pods") or {}
+
+        node_w = 0.0
+        source_counts = {SOURCE_MEASURED: 0, SOURCE_MODELED: 0}
+        #: Pods attributed THIS cycle (the cumulative _pod_joules keys
+        #: never drop — they are counters — so the /debug/vars "last"
+        #: block must not count them as current state).
+        cycle_pods: set[tuple[str, str]] = set()
+        with self._lock:
+            self._cycles += 1
+            if skipped > 0:
+                self._gap_skipped_s += skipped
+                self._gaps_clamped += 1
+            for chip, (watts, source) in power.items():
+                node_w += watts
+                source_counts[source] += 1
+                if dt > 0:
+                    key = (chip, source)
+                    self._joules[key] = self._joules.get(key, 0.0) + watts * dt
+                    pods = pod_map.get(chip) or ()
+                    if pods:
+                        share = watts * dt / len(pods)
+                        for ns, pod in pods:
+                            cycle_pods.add((ns, pod))
+                            pkey = (ns, pod, source)
+                            self._pod_joules[pkey] = (
+                                self._pod_joules.get(pkey, 0.0) + share
+                            )
+            joules = dict(self._joules)
+            pod_joules = dict(self._pod_joules)
+
+        # One label for the joined families: measured only when every
+        # contributing chip was measured.
+        join_source = (
+            SOURCE_MEASURED
+            if power and source_counts[SOURCE_MODELED] == 0
+            else SOURCE_MODELED
+        )
+
+        # Step/efficiency join from the lifecycle block (the plane runs
+        # after lifecycle in the poll cycle, same snapshot bus). The
+        # joined means are the lifecycle plane's CANONICAL merge — read,
+        # never re-derived, so the two planes cannot silently diverge
+        # on how feeds combine.
+        lc = snap.get("lifecycle") or {}
+        feeds = lc.get("feeds") or {}
+        tokens_per_s = lc.get("tokens_per_second")
+        step_seconds = lc.get("step_seconds")
+        # Each host of a dp job reports the JOB-global token rate
+        # (lifecycle's documented merge), while the watts below are
+        # THIS node's. Split the rate across the slice's hosts so
+        # tokens/J is node-tokens over node-joules — comparable across
+        # jobs of any host count instead of inflated by it. (Slice
+        # hosts is the best available job-span estimate: lifecycle
+        # feeds are localhost probes of jobs laid out one-harness-per-
+        # host across the slice.)
+        slice_hosts = max(1, int((snap.get("identity") or {}).get("hosts") or 1))
+        if tokens_per_s is not None:
+            tokens_per_s = tokens_per_s / slice_hosts
+
+        step_energy_j = (
+            node_w * step_seconds
+            if power and step_seconds is not None
+            else None
+        )
+        tokens_per_joule = (
+            tokens_per_s / node_w
+            if power and node_w > 0 and tokens_per_s is not None
+            else None
+        )
+        step_cost = (
+            step_energy_j / _J_PER_KWH * t.dollars_per_kwh
+            if step_energy_j is not None and t.dollars_per_kwh > 0
+            else None
+        )
+
+        last = {
+            "ts": now,
+            "node_power_w": round(node_w, 3) if power else None,
+            "source": join_source if power else None,
+            "chips": {
+                SOURCE_MEASURED: source_counts[SOURCE_MEASURED],
+                SOURCE_MODELED: source_counts[SOURCE_MODELED],
+            },
+            "tdp_w": tdp_w,
+            "tdp_key": tdp_key,
+            "tokens_per_joule": tokens_per_joule,
+            "step_energy_joules": step_energy_j,
+            "step_cost_dollars": step_cost,
+            "attributed_pods": len(cycle_pods),
+        }
+        with self._lock:
+            self._last = last
+
+        if stats.snapshot is not None:
+            # The efficiency-regression detector reads this block from
+            # the snapshot the anomaly engine is fed anyway — the
+            # tokens/joule series and the workload signature travel on
+            # the same bus as every other detector input.
+            stats.snapshot["energy"] = {
+                "available": bool(power),
+                "source": join_source if power else None,
+                "node_power_w": node_w if power else None,
+                "tokens_per_joule": tokens_per_joule,
+                "step_energy_joules": step_energy_j,
+                # Baseline identity for "same workload preset": the feed
+                # set plus each feed's mesh axes — a changed preset must
+                # re-warm the efficiency baseline, not alert against the
+                # old workload's tokens/J.
+                "workload_sig": tuple(
+                    (url, tuple(sorted((feeds[url].get("axes") or {}).items())))
+                    for url in sorted(feeds)
+                ),
+            }
+        return self._families(
+            stats.base_keys, stats.base_vals, power, joules, pod_joules,
+            join_source, step_energy_j, tokens_per_joule, step_cost,
+        )
+
+    # -- exposition --------------------------------------------------------
+
+    def _families(
+        self, base_keys, base_vals, power, joules, pod_joules,
+        join_source, step_energy_j, tokens_per_joule, step_cost,
+    ) -> list:
+        from tpumon.families import ENERGY_FAMILIES
+
+        labels = tuple(base_keys)
+        vals = tuple(base_vals)
+
+        def fam(name, cls):
+            _, help_text, extra = ENERGY_FAMILIES[name]
+            return cls(name, help_text, labels=labels + extra)
+
+        out: list = []
+        if power:
+            watts = fam("tpu_energy_power_watts", GaugeMetricFamily)
+            for chip in sorted(power):
+                w, source = power[chip]
+                watts.add_metric(vals + (chip, source), w)
+            out.append(watts)
+        if joules:
+            total = fam("tpu_energy_joules_total", CounterMetricFamily)
+            for chip, source in sorted(joules):
+                total.add_metric(
+                    vals + (chip, source), joules[(chip, source)]
+                )
+            out.append(total)
+        if pod_joules:
+            pod_total = fam(
+                "tpu_pod_energy_joules_total", CounterMetricFamily
+            )
+            for ns, pod, source in sorted(pod_joules):
+                pod_total.add_metric(
+                    vals + (ns, pod, source),
+                    pod_joules[(ns, pod, source)],
+                )
+            out.append(pod_total)
+        if step_energy_j is not None:
+            step = fam("tpu_step_energy_joules", GaugeMetricFamily)
+            step.add_metric(vals + (join_source,), step_energy_j)
+            out.append(step)
+        if tokens_per_joule is not None:
+            tpj = fam("tpu_step_tokens_per_joule", GaugeMetricFamily)
+            tpj.add_metric(vals + (join_source,), tokens_per_joule)
+            out.append(tpj)
+        if step_cost is not None:
+            cost = fam("tpu_step_cost_dollars", GaugeMetricFamily)
+            cost.add_metric(vals + (join_source,), step_cost)
+            out.append(cost)
+        return out
+
+    # -- query surfaces ----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The /debug/vars "energy" block: O(1) occupancy + the last
+        cycle's join, including the gap-honesty ledger."""
+        with self._lock:
+            doc = {
+                "cycles": self._cycles,
+                "chip_series": len(self._joules),
+                "pod_series": len(self._pod_joules),
+                "total_joules": round(sum(self._joules.values()), 3),
+                "gap_skipped_seconds": round(self._gap_skipped_s, 3),
+                "gaps_clamped": self._gaps_clamped,
+            }
+            if self._last is not None:
+                doc["last"] = dict(self._last)
+            return doc

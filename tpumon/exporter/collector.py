@@ -644,6 +644,7 @@ class Poller:
         governor=None,
         hostcorr=None,
         lifecycle=None,
+        energy=None,
     ) -> None:
         self._backend = backend
         self._cfg = cfg
@@ -659,6 +660,7 @@ class Poller:
         self._governor = governor
         self._hostcorr = hostcorr
         self._lifecycle = lifecycle
+        self._energy = energy
         #: Staleness-gauge label reconciliation (tpumon/resilience).
         self._stale_labeled: set[str] = set()
         #: Last-seen backend retry counters (delta-fed into telemetry).
@@ -709,15 +711,38 @@ class Poller:
                 watchdog=self._watchdog,
             )
         now = time.time()
+        if self._lifecycle is not None:
+            # Workload-lifecycle plane (tpumon/lifecycle): probe the
+            # workload step feeds (localhost HTTP — zero device queries),
+            # classify preemption/resize/restore against THIS cycle's
+            # device snapshot, and inject the suppression list + step
+            # telemetry the anomaly pass consumes. Runs FIRST among the
+            # snapshot-bus planes: the hostcorr straggler judge reads
+            # this cycle's per-feed step telemetry (step-skew evidence)
+            # and the energy plane reads the step/token rates, so both
+            # need the lifecycle block already injected. Before the
+            # governor/history/anomaly so tpu_lifecycle_* series ride
+            # the budget, the 1 Hz flight recorder, and the same page.
+            with trace_span("lifecycle") as sp:
+                try:
+                    families.extend(self._lifecycle.cycle(now, stats))
+                except Exception:
+                    log.exception("lifecycle plane failed")
+                    if sp is not None:
+                        sp.status = "error"
+                    self._telemetry.poll_stage_errors.labels(
+                        stage="lifecycle"
+                    ).inc()
         if self._hostcorr is not None:
             # Host-correlation plane (tpumon/hostcorr): procfs/cgroupfs
             # sampling time-aligned with THIS cycle's device snapshot —
-            # zero device queries. Runs before the governor (its per-pod
-            # series ride the same cardinality budget), before history
-            # (so tpu_hostcorr_*/tpu_straggler_* series are in the 1 Hz
-            # flight recorder), and before anomaly (the cross-signal
-            # detectors read the hostcorr block it injects into
-            # stats.snapshot).
+            # zero device queries. Runs after lifecycle (its straggler
+            # judge consumes the injected step telemetry), before the
+            # governor (its per-pod series ride the same cardinality
+            # budget), before history (so tpu_hostcorr_*/tpu_straggler_*
+            # series are in the 1 Hz flight recorder), and before
+            # anomaly (the cross-signal detectors read the hostcorr
+            # block it injects into stats.snapshot).
             with trace_span("hostcorr") as sp:
                 try:
                     families.extend(self._hostcorr.cycle(now, stats))
@@ -728,24 +753,26 @@ class Poller:
                     self._telemetry.poll_stage_errors.labels(
                         stage="hostcorr"
                     ).inc()
-        if self._lifecycle is not None:
-            # Workload-lifecycle plane (tpumon/lifecycle): probe the
-            # workload step feeds (localhost HTTP — zero device queries),
-            # classify preemption/resize/restore against THIS cycle's
-            # device snapshot, and inject the suppression list + step
-            # telemetry the anomaly pass consumes. Runs after hostcorr
-            # (same snapshot bus), before the governor/history/anomaly so
-            # tpu_lifecycle_* series ride the budget, the 1 Hz flight
-            # recorder, and the same published page.
-            with trace_span("lifecycle") as sp:
+        if self._energy is not None:
+            # Energy/cost plane (tpumon/energy): power where the device
+            # library exposed it this cycle (already sampled by
+            # build_families — zero queries added here), duty×TDP model
+            # everywhere else; joules integration, pod-energy split,
+            # and the tokens-per-joule join against the lifecycle block
+            # injected above. Before the governor/history/anomaly so
+            # the tpu_energy_*/tpu_step_* efficiency series ride the
+            # budget, the flight recorder, and the same page — and so
+            # the efficiency_regression detector sees this cycle's
+            # tokens/J in the same anomaly pass.
+            with trace_span("energy") as sp:
                 try:
-                    families.extend(self._lifecycle.cycle(now, stats))
+                    families.extend(self._energy.cycle(now, stats))
                 except Exception:
-                    log.exception("lifecycle plane failed")
+                    log.exception("energy plane failed")
                     if sp is not None:
                         sp.status = "error"
                     self._telemetry.poll_stage_errors.labels(
-                        stage="lifecycle"
+                        stage="energy"
                     ).inc()
         if self._governor is not None:
             # Per-family cardinality budget (tpumon/guard/cardinality):

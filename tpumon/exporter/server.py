@@ -1200,6 +1200,11 @@ class Exporter:
                 step_urls=cfg.lifecycle_step_urls, ring=lc_ring,
                 probe_timeout=min(1.0, max(0.2, cfg.interval / 2.0)),
             )
+        self.energy = None
+        if cfg.energy:
+            from tpumon.energy import EnergyPlane
+
+            self.energy = EnergyPlane()
         self.anomaly = None
         if cfg.anomaly:
             from tpumon.anomaly import AnomalyEngine
@@ -1226,6 +1231,13 @@ class Exporter:
                 from tpumon.lifecycle import lifecycle_detectors
 
                 detectors.extend(lifecycle_detectors())
+            if self.energy is not None:
+                # Efficiency detector (tpumon/energy): same-preset
+                # tokens/joule EWMA regression, fed by the energy block
+                # the plane injects into each cycle's snapshot.
+                from tpumon.energy import energy_detectors
+
+                detectors.extend(energy_detectors())
             self.anomaly = AnomalyEngine(
                 history=self.history, max_events=max_events,
                 detectors=detectors,
@@ -1383,7 +1395,7 @@ class Exporter:
             anomaly=self.anomaly, tracer=self.tracer,
             resilience=self.resilience, watchdog=self.watchdog,
             governor=self.governor, hostcorr=self.hostcorr,
-            lifecycle=self.lifecycle,
+            lifecycle=self.lifecycle, energy=self.energy,
         )
         version_fn = getattr(backend, "version", None)
         self.telemetry.backend_info.labels(
@@ -1575,6 +1587,8 @@ class Exporter:
             doc["hostcorr"] = self.hostcorr.snapshot()
         if self.lifecycle is not None:
             doc["lifecycle"] = self.lifecycle.snapshot()
+        if self.energy is not None:
+            doc["energy"] = self.energy.snapshot()
         # Invariant-analyzer status (tpumon/analysis): operators can see
         # from the running exporter whether the shipped checkout's
         # cross-file discipline was proven, and against how many accepted

@@ -229,6 +229,14 @@ HOSTCORR_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "(absent when fewer than 2 chips report duty)",
         (),
     ),
+    "tpu_straggler_step_skew_ratio": (
+        "gauge",
+        "Slowest workload feed's step time over the feed median, minus "
+        "1 (0.5 = 50% slower) — the straggler-HOST magnitude duty skew "
+        "cannot see; absent unless ≥2 lifecycle feeds report step "
+        "timing",
+        (),
+    ),
     "tpu_straggler_verdict": (
         "gauge",
         "1 while a straggler is active: the same chip sat skew_warn_pct "
@@ -244,6 +252,73 @@ HOSTCORR_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "the later unknown→host-* upgrade; never-attributed episodes "
         "count as unknown at clear)",
         ("cause",),
+    ),
+}
+
+#: family -> (prometheus type, description, extra labels) — the
+#: energy/cost plane (tpumon/energy): per-chip power/energy with an
+#: explicit provenance label on EVERY family (``source`` ∈ measured /
+#: modeled — a dashboard can never pass the duty×TDP model off as a
+#: device reading), pod-attributed energy, and the step-efficiency
+#: joins against the lifecycle plane's ``tpu_step_*`` telemetry. All
+#: families are absent-not-zero: no chips visible → no power series,
+#: no workload feed → no efficiency join, ``tpu_step_cost_dollars``
+#: absent until TPUMON_ENERGY_DOLLARS_PER_KWH is set.
+ENERGY_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
+    "tpu_energy_power_watts": (
+        "gauge",
+        "Instantaneous per-chip power draw in watts; source=measured "
+        "when the device library reported it (accelerator_power_watts),"
+        " source=modeled when estimated from duty cycle × the "
+        "accelerator's TDP envelope, HBM-activity adjusted "
+        "(tpumon/energy/model.py, TPUMON_ENERGY_TDP_W override)",
+        ("chip", "source"),
+    ),
+    "tpu_energy_joules_total": (
+        "counter",
+        "Accumulated per-chip energy since exporter start, integrated "
+        "at poll cadence with gap honesty: a poll gap past "
+        "TPUMON_ENERGY_MAX_GAP_S is integrated only up to the cap (the "
+        "skipped remainder is counted in the /debug/vars energy block, "
+        "never invented). Keyed per source so a backend flapping "
+        "between exposing and hiding power telemetry keeps each series "
+        "monotonic",
+        ("chip", "source"),
+    ),
+    "tpu_pod_energy_joules_total": (
+        "counter",
+        "Chip energy attributed to the pods holding each chip "
+        "(accelerator_pod_info join, split equally among co-holders); "
+        "the per-pod sums add up to the attributed chips' "
+        "tpu_energy_joules_total — energy on unattributed chips stays "
+        "chip-only",
+        ("namespace", "pod", "source"),
+    ),
+    "tpu_step_energy_joules": (
+        "gauge",
+        "Joules THIS NODE spends per optimizer step: node power × the "
+        "probed workload feeds' mean step duration (absent when no "
+        "feed reports step timing; job-level step energy = sum over "
+        "the job's hosts); source=measured only when every "
+        "contributing chip's power was a device reading",
+        ("source",),
+    ),
+    "tpu_step_tokens_per_joule": (
+        "gauge",
+        "Training tokens per joule, node-scoped: the probed feeds' "
+        "JOB-global tokens/s split across the slice's hosts (each host "
+        "of a dp job reports the job's rate) over THIS node's power — "
+        "comparable across jobs of any host count; the headline "
+        "efficiency number the efficiency_regression detector "
+        "baselines per workload preset",
+        ("source",),
+    ),
+    "tpu_step_cost_dollars": (
+        "gauge",
+        "Dollars one optimizer step costs at the configured "
+        "electricity price (TPUMON_ENERGY_DOLLARS_PER_KWH; absent "
+        "while the knob is 0 — a made-up price is worse than none)",
+        ("source",),
     ),
 }
 
@@ -324,6 +399,14 @@ FLEET_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "host's tpu_straggler_skew_pct; absent when no host reports it)",
         ("scope", "pool", "slice"),
     ),
+    "tpu_fleet_straggler_step_skew_ratio": (
+        "gauge",
+        "Worst step-skew ratio across the scope's hosts (max of each "
+        "host's tpu_straggler_step_skew_ratio — the lagging-HOST "
+        "magnitude duty skew cannot see; absent when no host reports "
+        "it)",
+        ("scope", "pool", "slice"),
+    ),
     "tpu_fleet_step_rate": (
         "gauge",
         "Mean workload optimizer steps/s over the scope's hosts "
@@ -337,6 +420,22 @@ FLEET_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "transition window (tpu_lifecycle_state == 1: preemption / "
         "resize / restore in progress)",
         ("scope", "pool", "slice"),
+    ),
+    "tpu_fleet_energy_watts": (
+        "gauge",
+        "Summed node power across the scope (tpu_energy_power_watts "
+        "rollup); source=measured only when every contributing host's "
+        "power was device-reported — one modeled host makes the scope "
+        "modeled, so capacity dashboards always know they are reading "
+        "an estimate",
+        ("scope", "pool", "slice", "source"),
+    ),
+    "tpu_fleet_tokens_per_joule": (
+        "gauge",
+        "Mean tokens/joule over the scope's hosts reporting "
+        "tpu_step_tokens_per_joule (absent when none do); same "
+        "worst-of source labeling as tpu_fleet_energy_watts",
+        ("scope", "pool", "slice", "source"),
     ),
     "tpu_fleet_peer_seeded_total": (
         "counter",
@@ -688,6 +787,7 @@ def all_family_names() -> set[str]:
         | set(ANOMALY_FAMILIES)
         | set(HOSTCORR_FAMILIES)
         | set(LIFECYCLE_FAMILIES)
+        | set(ENERGY_FAMILIES)
         | set(distribution_family_rows())
         | set(SELF_FAMILIES)
         | set(FLEET_FAMILIES)

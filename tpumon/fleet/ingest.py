@@ -83,6 +83,7 @@ _CHIP_FIELDS = {
     "accelerator_memory_used_bytes": "hbm_used",
     "accelerator_memory_total_bytes": "hbm_total",
     "accelerator_throttle_score": "throttle",
+    "accelerator_power_watts": "power_w",
 }
 
 #: Identity labels lifted off the first accelerator_info sample.
@@ -132,7 +133,11 @@ def node_snapshot_from_text(text: str) -> dict:
             ] = value
         elif name == "accelerator_info":
             labels = dict(_LABEL_RE.findall(line[brace:line.rfind("}") + 1]))
-            if not snap["identity"]:
+            # Keyed on a label this branch owns, NOT dict truthiness:
+            # the slice-host-count lift below lands in identity first
+            # (it precedes accelerator_info on the page) and must not
+            # suppress the base-label lift.
+            if "host" not in snap["identity"]:
                 for key in _IDENTITY_KEYS:
                     if key in labels:
                         snap["identity"][key] = labels[key]
@@ -162,6 +167,10 @@ def node_snapshot_from_text(text: str) -> dict:
             queues[labels.get("core", "?")] = float(line.rsplit(" ", 1)[1])
         elif name == "accelerator_device_count":
             snap["device_count"] = int(float(line.rsplit(" ", 1)[1]))
+        elif name == "accelerator_slice_host_count":
+            # Mirrors the full parser's identity lift (smi) — the
+            # equivalence test pins the two snapshots field-for-field.
+            snap["identity"]["hosts"] = int(float(line.rsplit(" ", 1)[1]))
         elif name == "collector_last_poll_timestamp_seconds":
             snap["last_poll_ts"] = float(line.rsplit(" ", 1)[1])
         elif name == "exporter_metric_coverage_ratio":
@@ -178,6 +187,11 @@ def node_snapshot_from_text(text: str) -> dict:
         elif name == "tpu_straggler_skew_pct":
             snap.setdefault("straggler", {}).setdefault("active", False)
             snap["straggler"]["skew_pct"] = float(line.rsplit(" ", 1)[1])
+        elif name == "tpu_straggler_step_skew_ratio":
+            snap.setdefault("straggler", {}).setdefault("active", False)
+            snap["straggler"]["step_skew_ratio"] = float(
+                line.rsplit(" ", 1)[1]
+            )
         elif name == "tpu_straggler_verdict":
             # Active straggler with its attributed cause (tpumon/hostcorr)
             # — the fleet tier counts and ranks these across pools.
@@ -194,6 +208,27 @@ def node_snapshot_from_text(text: str) -> dict:
             snap["step_rate"] = float(line.rsplit(" ", 1)[1])
         elif name == "tpu_lifecycle_state":
             snap["lifecycle_transition"] = float(line.rsplit(" ", 1)[1]) > 0
+        elif name == "tpu_energy_power_watts":
+            # Energy plane (tpumon/energy) — summed to node watts for
+            # the tpu_fleet_energy_watts rollup; one modeled chip makes
+            # the node (and so the scope) read modeled.
+            labels = dict(_LABEL_RE.findall(line[brace:line.rfind("}") + 1]))
+            row = snap.setdefault(
+                "energy", {"watts": 0.0, "source": "measured"}
+            )
+            row["watts"] = row.get("watts", 0.0) + float(
+                line.rsplit(" ", 1)[1]
+            )
+            if labels.get("source") != "measured":
+                row["source"] = "modeled"
+        elif name == "tpu_step_tokens_per_joule":
+            labels = dict(_LABEL_RE.findall(line[brace:line.rfind("}") + 1]))
+            row = snap.setdefault(
+                "energy", {"watts": 0.0, "source": "measured"}
+            )
+            row["tokens_per_joule"] = float(line.rsplit(" ", 1)[1])
+            if labels.get("source") != "measured":
+                row["source"] = "modeled"
     if queues:
         snap["queues"] = queues
     if total:

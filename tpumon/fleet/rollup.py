@@ -77,11 +77,23 @@ class _Agg:
         self.mfu_n = 0
         self.step_rate_sum = 0.0
         self.step_rate_n = 0
+        #: Energy rollup (tpumon/energy): summed node watts + the
+        #: worst-of provenance (one modeled host makes the scope
+        #: modeled), and the tokens/joule mean with its merge weight.
+        self.energy_watts = 0.0
+        self.energy_n = 0
+        self.energy_modeled = False
+        self.tpj_sum = 0.0
+        self.tpj_n = 0
         self.lifecycle_transitions = 0
         self.degraded_hosts = 0
         #: Active straggler hosts by attributed cause (tpumon/hostcorr).
         self.stragglers: dict[str, int] = {}
         self.straggler_skew_max: float | None = None
+        #: Worst step-skew ratio (the straggler-HOST magnitude) across
+        #: the scope's hosts — the ranking signal for episodes duty
+        #: skew cannot see.
+        self.straggler_step_skew_max: float | None = None
 
     def add_node(self, snap: dict, state: str) -> None:
         self.hosts[state] += 1
@@ -115,6 +127,20 @@ class _Agg:
             # count. "n" carried for the cross-shard weighted merge.
             self.step_rate_sum += step_rate
             self.step_rate_n += 1
+        energy = snap.get("energy")
+        if energy and energy.get("watts"):
+            # Truthiness gate on watts: a tokens/J-only page initializes
+            # the dict at 0.0 W, and a real node can never draw 0 (the
+            # model has an idle floor) — so 0 means "no power series".
+            self.energy_watts += energy["watts"]
+            self.energy_n += 1
+            if energy.get("source") != "measured":
+                self.energy_modeled = True
+        if energy and energy.get("tokens_per_joule") is not None:
+            self.tpj_sum += energy["tokens_per_joule"]
+            self.tpj_n += 1
+            if energy.get("source") != "measured":
+                self.energy_modeled = True
         if snap.get("lifecycle_transition"):
             self.lifecycle_transitions += 1
         degraded = snap.get("degraded")
@@ -128,6 +154,12 @@ class _Agg:
                 or skew > self.straggler_skew_max
             ):
                 self.straggler_skew_max = skew
+            step_skew = straggler.get("step_skew_ratio")
+            if step_skew is not None and (
+                self.straggler_step_skew_max is None
+                or step_skew > self.straggler_step_skew_max
+            ):
+                self.straggler_step_skew_max = step_skew
             if straggler.get("active"):
                 cause = straggler.get("cause", "unknown")
                 self.stragglers[cause] = self.stragglers.get(cause, 0) + 1
@@ -165,12 +197,26 @@ class _Agg:
         if self.step_rate_n:
             doc["step_rate"] = self.step_rate_sum / self.step_rate_n
             doc["step_rate_n"] = self.step_rate_n
+        if self.energy_n or self.tpj_n:
+            doc["energy_source"] = (
+                "modeled" if self.energy_modeled else "measured"
+            )
+        if self.energy_n:
+            doc["energy_watts"] = self.energy_watts
+            doc["energy_n"] = self.energy_n
+        if self.tpj_n:
+            doc["tokens_per_joule"] = self.tpj_sum / self.tpj_n
+            doc["tokens_per_joule_n"] = self.tpj_n
         if self.lifecycle_transitions:
             doc["lifecycle_transitions"] = self.lifecycle_transitions
         if self.stragglers:
             doc["stragglers"] = dict(self.stragglers)
         if self.straggler_skew_max is not None:
             doc["straggler_skew_max_pct"] = self.straggler_skew_max
+        if self.straggler_step_skew_max is not None:
+            doc["straggler_step_skew_max_ratio"] = (
+                self.straggler_step_skew_max
+            )
         return doc
 
 
@@ -261,6 +307,16 @@ def merge_buckets(buckets: list[dict]) -> dict:
             if n:
                 out.step_rate_sum += float(bucket["step_rate"]) * n
                 out.step_rate_n += n
+        if bucket.get("energy_watts") is not None:
+            out.energy_watts += float(bucket["energy_watts"])
+            out.energy_n += int(bucket.get("energy_n", 1))
+        if bucket.get("tokens_per_joule") is not None:
+            n = int(bucket.get("tokens_per_joule_n", 0))
+            if n:
+                out.tpj_sum += float(bucket["tokens_per_joule"]) * n
+                out.tpj_n += n
+        if bucket.get("energy_source") == "modeled":
+            out.energy_modeled = True
         out.lifecycle_transitions += int(
             bucket.get("lifecycle_transitions", 0)
         )
@@ -271,6 +327,12 @@ def merge_buckets(buckets: list[dict]) -> dict:
             out.straggler_skew_max is None or skew > out.straggler_skew_max
         ):
             out.straggler_skew_max = skew
+        step_skew = bucket.get("straggler_step_skew_max_ratio")
+        if step_skew is not None and (
+            out.straggler_step_skew_max is None
+            or step_skew > out.straggler_step_skew_max
+        ):
+            out.straggler_step_skew_max = step_skew
     doc = out.to_dict()
     doc["stale"] = doc["stale"] or any(
         b.get("stale") for b in buckets if b
@@ -364,6 +426,20 @@ def fleet_families(doc: dict) -> list:
         "the per-slice training-progress rollup.",
         labels=_SCOPED,
     )
+    energy_watts = GaugeMetricFamily(
+        "tpu_fleet_energy_watts",
+        "Summed node power across the scope (tpu_energy_power_watts "
+        "rollup); source=measured only when every contributing host's "
+        "power was device-reported.",
+        labels=_SCOPED + ("source",),
+    )
+    tokens_per_joule = GaugeMetricFamily(
+        "tpu_fleet_tokens_per_joule",
+        "Mean tokens/joule over the scope's hosts reporting "
+        "tpu_step_tokens_per_joule (absent when none do); one modeled "
+        "host makes the scope read source=modeled.",
+        labels=_SCOPED + ("source",),
+    )
     lifecycle = GaugeMetricFamily(
         "tpu_fleet_lifecycle_transitions",
         "Hosts in the scope currently inside a workload-lifecycle "
@@ -387,6 +463,13 @@ def fleet_families(doc: dict) -> list:
         "tpu_fleet_straggler_skew_pct",
         "Worst straggler skew across the scope's hosts (max per-host "
         "worst-chip vs median duty skew; absent when none report it).",
+        labels=_SCOPED,
+    )
+    straggler_step_skew = GaugeMetricFamily(
+        "tpu_fleet_straggler_step_skew_ratio",
+        "Worst step-skew ratio across the scope's hosts "
+        "(tpu_straggler_step_skew_ratio max: the lagging-HOST "
+        "magnitude duty skew cannot see; absent when none report it).",
         labels=_SCOPED,
     )
     stale_flag = GaugeMetricFamily(
@@ -426,6 +509,16 @@ def fleet_families(doc: dict) -> list:
             mfu.add_metric(labels, bucket["mfu"])
         if "step_rate" in bucket:
             step_rate.add_metric(labels, bucket["step_rate"])
+        if "energy_watts" in bucket:
+            energy_watts.add_metric(
+                labels + (bucket.get("energy_source", "modeled"),),
+                bucket["energy_watts"],
+            )
+        if "tokens_per_joule" in bucket:
+            tokens_per_joule.add_metric(
+                labels + (bucket.get("energy_source", "modeled"),),
+                bucket["tokens_per_joule"],
+            )
         if "lifecycle_transitions" in bucket:
             lifecycle.add_metric(
                 labels, float(bucket["lifecycle_transitions"])
@@ -436,6 +529,10 @@ def fleet_families(doc: dict) -> list:
             straggler_skew.add_metric(
                 labels, bucket["straggler_skew_max_pct"]
             )
+        if "straggler_step_skew_max_ratio" in bucket:
+            straggler_step_skew.add_metric(
+                labels, bucket["straggler_step_skew_max_ratio"]
+            )
         degraded.add_metric(labels, float(bucket["degraded_hosts"]))
         stale_flag.add_metric(labels, 1.0 if bucket["stale"] else 0.0)
         visibility.add_metric(
@@ -444,8 +541,9 @@ def fleet_families(doc: dict) -> list:
 
     return [
         hosts, chips, duty, hbm_used, hbm_total, headroom,
-        ici_links, ici_score, mfu, step_rate, lifecycle,
-        stragglers, straggler_skew,
+        ici_links, ici_score, mfu, step_rate,
+        energy_watts, tokens_per_joule, lifecycle,
+        stragglers, straggler_skew, straggler_step_skew,
         degraded, stale_flag, visibility,
     ]
 

@@ -59,6 +59,13 @@ class HostCorrThresholds:
     skew_warn_pct: float = 20.0
     skew_cycles: float = 5.0
     busy_duty_pct: float = 25.0
+    #: Step-skew onset (multi-host jobs): the slowest workload feed's
+    #: step time this fraction above the feed median — the signal that
+    #: catches a straggler HOST whose own chips are locally balanced
+    #: (duty skew can't see it; the lagging host's steps can). Same
+    #: streak/hysteresis discipline as duty skew; cause attribution
+    #: unchanged.
+    step_skew_ratio: float = 0.5
     #: Host-cause attribution thresholds: PSI avg10 shares (0-1) per
     #: resource, per-pod sched-delay share (delay s per wall s), and the
     #: page-reclaim scan rate backing host-mem.
@@ -184,13 +191,34 @@ def attribute_cause(host, evidence: dict, t: HostCorrThresholds) -> str:
 
 
 class StragglerJudge:
-    """Worst-chip-vs-median skew tracking; poll thread only."""
+    """Worst-chip-vs-median skew tracking; poll thread only.
+
+    Two independent evidence streams feed one verdict: per-chip duty
+    skew (this node's worst chip vs its slice median) and — when the
+    lifecycle plane probes multiple hosts of one job — per-feed STEP
+    skew (the slowest host's step time vs the feed median). Step skew
+    catches the straggler shape duty skew is blind to: a lagging host
+    whose own chips are perfectly balanced with each other. Either
+    stream crossing its streak requirement activates the verdict; cause
+    attribution (:func:`attribute_cause`) is identical for both.
+    """
 
     def __init__(self) -> None:
         self._streak = 0
         self._last_worst: str | None = None
-        self._active = False
+        self._step_streak = 0
+        self._last_step_worst: str | None = None
+        #: Per-stream hysteresis: each stream's clear-band applies only
+        #: while THAT stream is active — a step episode must not halve
+        #: the duty stream's onset bar (or a benign 12-pt duty skew
+        #: could latch the verdict forever once anything else fired).
+        self._duty_active = False
+        self._step_active = False
         self._cause: str | None = None
+
+    @property
+    def _active(self) -> bool:
+        return self._duty_active or self._step_active
 
     def judge(
         self,
@@ -198,38 +226,103 @@ class StragglerJudge:
         host,
         evidence: dict,
         t: HostCorrThresholds | None = None,
+        step_seconds: dict[str, float] | None = None,
     ) -> dict:
         """One cycle's verdict. Returns a JSON-able dict; ``active`` only
-        after the streak requirement is met, ``cause`` present while
-        active."""
+        after a streak requirement is met, ``cause`` present while
+        active. ``step_seconds`` (feed url -> step wall seconds, from
+        the lifecycle block) arms the step-skew stream when ≥2 feeds
+        report."""
         t = t if t is not None else env_thresholds()
-        if len(duties) < 2:
-            self._streak = 0
-            self._last_worst = None
-            self._active = False
-            self._cause = None
-            return {"active": False, "skew_pct": None}
-        med = statistics.median(duties.values())
-        worst = min(duties, key=lambda c: duties[c])
-        skew = med - duties[worst]
-        clear_at = t.skew_warn_pct / 2.0
-        threshold = clear_at if self._active else t.skew_warn_pct
-        candidate = med >= t.busy_duty_pct and skew >= threshold
-        if candidate and worst == self._last_worst:
-            self._streak += 1
-        elif candidate:
-            self._streak = 1
+
+        # -- duty-skew stream (per-chip, this node) -----------------------
+        skew = med = None
+        worst: str | None = None
+        if len(duties) >= 2:
+            med = statistics.median(duties.values())
+            worst = min(duties, key=lambda c: duties[c])
+            skew = med - duties[worst]
+            clear_at = t.skew_warn_pct / 2.0
+            threshold = clear_at if self._duty_active else t.skew_warn_pct
+            candidate = med >= t.busy_duty_pct and skew >= threshold
+            if candidate and worst == self._last_worst:
+                self._streak += 1
+            elif candidate:
+                self._streak = 1
+            else:
+                self._streak = 0
+            self._last_worst = worst if candidate else None
         else:
             self._streak = 0
-        self._last_worst = worst if candidate else None
-        self._active = self._streak >= max(1, int(t.skew_cycles))
+            self._last_worst = None
+
+        # -- step-skew stream (per-feed, multi-host jobs) -----------------
+        step_ratio = None
+        step_worst: str | None = None
+        if step_seconds and len(step_seconds) >= 2:
+            smed = statistics.median(step_seconds.values())
+            step_worst = max(step_seconds, key=lambda u: step_seconds[u])
+            if smed > 0:
+                step_ratio = step_seconds[step_worst] / smed - 1.0
+                s_threshold = (
+                    t.step_skew_ratio / 2.0
+                    if self._step_active
+                    else t.step_skew_ratio
+                )
+                s_candidate = step_ratio >= s_threshold
+                if s_candidate and step_worst == self._last_step_worst:
+                    self._step_streak += 1
+                elif s_candidate:
+                    self._step_streak = 1
+                else:
+                    self._step_streak = 0
+                self._last_step_worst = (
+                    step_worst if s_candidate else None
+                )
+            else:
+                self._step_streak = 0
+                self._last_step_worst = None
+        else:
+            self._step_streak = 0
+            self._last_step_worst = None
+
+        need = max(1, int(t.skew_cycles))
+        self._duty_active = self._streak >= need
+        self._step_active = self._step_streak >= need
+        if skew is None and self._step_streak < 1 and not self._active:
+            # Neither stream has evidence (single chip, ≤1 feed): the
+            # pre-step-skew idle shape, preserved for callers.
+            self._cause = None
+            return {"active": False, "skew_pct": None}
         verdict: dict = {
             "active": self._active,
             "skew_pct": skew,
-            "chip": worst,
+            # The chip label names the accused: only duty evidence
+            # accuses a chip. A step-skew-only episode is a lagging
+            # HOST (named by step_feed) — blaming this node's
+            # duty-worst chip would point the operator at an innocent
+            # device with meaningless duty evidence. Inactive verdicts
+            # keep naming the current worst chip (context, not blame).
+            "chip": (
+                ""
+                if worst is None
+                or (self._step_active and not self._duty_active)
+                else worst
+            ),
             "median_duty_pct": med,
             "streak": self._streak,
+            "evidence": [
+                name
+                for name, on in (
+                    ("duty", self._duty_active), ("step", self._step_active)
+                )
+                if on
+            ],
         }
+        if step_ratio is not None:
+            verdict["step_skew_ratio"] = step_ratio
+            verdict["step_feed"] = step_worst
+            verdict["step_streak"] = self._step_streak
         if self._active:
             # Sticky per-episode attribution: during the hysteresis
             # decay tail the host is already calm, and recomputing
@@ -257,16 +350,26 @@ class HostStragglerDetector:
 
     name = "host_straggler"
     _family = "tpu_straggler_skew_pct"
+    #: Step-skew-only episodes anchor their history window at the step
+    #: series — their duty skew is meaningless context, not evidence.
+    _step_family = "tpu_lifecycle_step_duration_seconds"
 
     def __init__(self) -> None:
         self._active = False
         self._chip = "?"
+        #: ("duty", chip) or ("step", feed) latched at onset: the
+        #: retained event and its clear must keep the onset's signal id
+        #: and story even if the other evidence stream takes over
+        #: mid-episode (a changing signal id would make the engine age
+        #: the event out by absence instead of clearing it).
+        self._latched: tuple[str, str] | None = None
 
     def reset(self) -> None:
         """Lifecycle-suppression re-baseline (the plane's judge resets
         itself when duty collapses — this clears the adapter's latch)."""
         self._active = False
         self._chip = "?"
+        self._latched = None
 
     def observe(self, ts: float, snap: dict, t) -> list:
         from tpumon.anomaly.detectors import Reading
@@ -280,20 +383,48 @@ class HostStragglerDetector:
         hc = env_thresholds()
         skew = verdict.get("skew_pct") or 0.0
         cause = verdict.get("cause", "unknown")
-        # The clearing cycle's verdict may no longer name a chip; the
-        # clear reading must carry the SAME signal id as the onset or
-        # the engine would age the event out by absence instead of
-        # clearing it now.
-        chip = verdict.get("chip", self._chip) if active else self._chip
-        self._chip = chip
+        evidence = verdict.get("evidence") or []
+        if active and self._latched is None:
+            # Onset: latch which stream accused whom. Step-only
+            # episodes blame the lagging HOST's feed — naming this
+            # node's duty-worst chip would accuse an innocent device.
+            if evidence == ["step"]:
+                self._latched = (
+                    "step", verdict.get("step_feed") or "?"
+                )
+            else:
+                self._latched = ("duty", verdict.get("chip", "?"))
+        kind, who = self._latched if self._latched is not None else (
+            "duty", self._chip
+        )
+        self._chip = who
+        if not active:
+            self._latched = None
+        if kind == "step":
+            ratio = verdict.get("step_skew_ratio") or 0.0
+            sev = CRIT if ratio >= 2.0 * hc.step_skew_ratio else WARN
+            return [
+                Reading(
+                    f"feed:{who}",
+                    active,
+                    sev,
+                    ratio,
+                    f"workload feed {who} step time {ratio:.0%} above "
+                    f"the job median for "
+                    f"{verdict.get('step_streak', 0)} polls — lagging "
+                    f"host, chips locally balanced — cause: {cause}",
+                    self._step_family,
+                    (),
+                )
+            ]
         sev = CRIT if skew >= 2.0 * hc.skew_warn_pct else WARN
         return [
             Reading(
-                f"chip:{chip}",
+                f"chip:{who}",
                 active,
                 sev,
                 skew,
-                f"chip {chip} duty {skew:.0f} pts below the slice median "
+                f"chip {who} duty {skew:.0f} pts below the slice median "
                 f"for {verdict.get('streak', 0)} polls — cause: {cause}",
                 self._family,
                 (),

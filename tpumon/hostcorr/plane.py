@@ -96,7 +96,22 @@ class HostCorrPlane:
         if worst is not None:
             worst_throttled = (chips.get(worst) or {}).get("throttle", 0) > 0
         evidence = {"throttled": worst_throttled}
-        verdict = self._judge.judge(duties, host, evidence, t)
+        # Step-skew evidence (ROADMAP remnant): when the lifecycle plane
+        # — which runs earlier in the same poll cycle — probes multiple
+        # hosts of one job, the per-feed step durations feed the judge's
+        # second evidence stream (a lagging HOST with locally balanced
+        # chips is invisible to duty skew). Cause attribution unchanged.
+        step_seconds = {
+            url: feed["step_seconds"]
+            for url, feed in (
+                (snap.get("lifecycle") or {}).get("feeds") or {}
+            ).items()
+            if isinstance(feed, dict)
+            and feed.get("step_seconds") is not None
+        }
+        verdict = self._judge.judge(
+            duties, host, evidence, t, step_seconds=step_seconds or None
+        )
 
         active = bool(verdict.get("active"))
         onset = active and not self._was_active
@@ -249,6 +264,15 @@ class HostCorrPlane:
             skew = fam("tpu_straggler_skew_pct", GaugeMetricFamily)
             skew.add_metric(vals, verdict["skew_pct"])
             out.append(skew)
+        if verdict.get("step_skew_ratio") is not None:
+            # The step-stream magnitude: without it a step-skew-only
+            # episode would read ~0 on the skew_pct family and rank
+            # last in every fleet worst-straggler view.
+            step_skew = fam(
+                "tpu_straggler_step_skew_ratio", GaugeMetricFamily
+            )
+            step_skew.add_metric(vals, verdict["step_skew_ratio"])
+            out.append(step_skew)
         if verdict.get("active"):
             vfam = fam("tpu_straggler_verdict", GaugeMetricFamily)
             vfam.add_metric(

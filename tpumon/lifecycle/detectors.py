@@ -64,6 +64,7 @@ KINDS = ("preemption", "resize", "restore")
 SUPPRESSIBLE_DETECTORS = (
     "duty_ewma", "hbm_ewma", "ici_flap", "bw_cusum", "queue_stall",
     "host_straggler", "host_stall", "step_regression", "collective_wait",
+    "efficiency_regression",
 )
 
 

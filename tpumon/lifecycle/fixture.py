@@ -127,6 +127,15 @@ class LifecycleBackend:
         self._inner = inner
         #: True = every chip reports ~0 duty (slice preempted).
         self.duty_zero = False
+        #: Pin every chip's duty to this constant (None = the inner
+        #: backend's noisy stream) — the "steady preset" shape the
+        #: efficiency soak baselines on (a per-cycle-noisy duty would
+        #: drown the tokens/J EWMA in model jitter).
+        self.duty_constant: float | None = None
+        #: Multiply every duty reading (clamped to 100): the efficiency
+        #: soak's injection — the same step rate suddenly costs more
+        #: duty (and so more modeled watts), tokens/J drops.
+        self.duty_scale = 1.0
         #: Visible chip cap (None = all): topology() and per-chip
         #: samples truncate to the first N chips — the elastic-resize
         #: re-enumeration signature.
@@ -170,8 +179,20 @@ class LifecycleBackend:
                     raw = RawMetric(
                         metric, raw.data[: self.visible_chips * per_chip]
                     )
-        if metric == "duty_cycle_pct" and self.duty_zero and raw.data:
-            return RawMetric(metric, tuple("0.00" for _ in raw.data))
+        if metric == "duty_cycle_pct" and raw.data:
+            if self.duty_zero:
+                return RawMetric(metric, tuple("0.00" for _ in raw.data))
+            if self.duty_constant is not None or self.duty_scale != 1.0:
+                base = self.duty_constant
+                out = []
+                for value in raw.data:
+                    try:
+                        duty = base if base is not None else float(value)
+                    except ValueError:
+                        out.append(value)  # malformed stays malformed
+                        continue
+                    out.append(f"{min(100.0, duty * self.duty_scale):.2f}")
+                return RawMetric(metric, tuple(out))
         return raw
 
     def __getattr__(self, attr):

@@ -117,25 +117,30 @@ class LifecyclePlane:
 
         # Joined step telemetry across available feeds: mean step rate
         # (hosts in one dp job all report the job's rate — a mean, not a
-        # sum, is the honest merge), worst collective wait.
-        rates = [
-            s.get("steps_per_second")
-            for s in feed_snaps.values()
-            if s.get("steps_per_second") is not None
-        ]
-        durations = [
-            s.get("step_seconds")
-            for s in feed_snaps.values()
-            if s.get("step_seconds") is not None
-        ]
+        # sum, is the honest merge), worst collective wait. Injected
+        # into the block as THE canonical join — downstream consumers
+        # (the energy plane's efficiency math) read these instead of
+        # re-deriving their own merge that could silently diverge.
+        def _mean(key: str) -> float | None:
+            vals = [
+                s.get(key)
+                for s in feed_snaps.values()
+                if s.get(key) is not None
+            ]
+            return sum(vals) / len(vals) if vals else None
+
         waits = [
             s.get("collective_wait_fraction")
             for s in feed_snaps.values()
             if s.get("collective_wait_fraction") is not None
         ]
-        step_rate = sum(rates) / len(rates) if rates else None
-        step_seconds = sum(durations) / len(durations) if durations else None
+        step_rate = _mean("steps_per_second")
+        step_seconds = _mean("step_seconds")
+        tokens_per_second = _mean("tokens_per_second")
         worst_wait = max(waits) if waits else None
+        block["step_rate"] = step_rate
+        block["step_seconds"] = step_seconds
+        block["tokens_per_second"] = tokens_per_second
 
         record = {
             "ts": now,

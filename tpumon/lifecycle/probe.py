@@ -46,6 +46,7 @@ _SCALARS = {
     "tpu_step_collective_wait_fraction": "collective_wait_fraction",
     "tpu_step_terminating": "terminating",
     "workload_steps_per_second": "steps_per_second",
+    "workload_tokens_per_second": "tokens_per_second",
     "workload_steps_total": "steps_total",
     "workload_loss": "loss",
     "workload_mfu_ratio": "mfu",

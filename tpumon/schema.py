@@ -66,8 +66,10 @@ class FamilySpec:
         return self.labels
 
 
-#: The 14 libtpu runtime metrics of libtpu 0.0.34 → unified families.
-#: Coverage denominator for the ≥95% BASELINE target (BASELINE.md).
+#: The 14 libtpu runtime metrics of libtpu 0.0.34 → unified families,
+#: plus forward-looking specs (device_power) for metrics newer runtimes
+#: expose. Coverage denominator for the ≥95% BASELINE target is whatever
+#: the runtime actually lists (BASELINE.md) — extra specs never inflate it.
 LIBTPU_SPECS: tuple[FamilySpec, ...] = (
     FamilySpec(
         "duty_cycle_pct",
@@ -98,6 +100,17 @@ LIBTPU_SPECS: tuple[FamilySpec, ...] = (
         "accelerator_memory_used_bytes",
         Shape.PER_CHIP,
         "Allocated device memory per chip in bytes.",
+        labels=("chip",),
+    ),
+    FamilySpec(
+        "device_power",
+        "accelerator_power_watts",
+        Shape.PER_CHIP,
+        "Instantaneous per-chip power draw in watts, where the device "
+        "library exposes power telemetry (GPU nvmlDeviceGetPowerUsage "
+        "analogue). Absent on runtimes without it — the energy plane "
+        "(tpumon/energy) then models power from duty cycle × TDP and "
+        "labels it source=modeled.",
         labels=("chip",),
     ),
     FamilySpec(

@@ -72,6 +72,11 @@ _F_CARDINALITY = "tpumon_cardinality_dropped_series"
 _F_HOSTCORR_AVAILABLE = "tpu_hostcorr_available"
 _F_STRAGGLER_SKEW = "tpu_straggler_skew_pct"
 _F_STRAGGLER_VERDICT = "tpu_straggler_verdict"
+_F_POWER = "accelerator_power_watts"
+_F_POD_INFO = "accelerator_pod_info"
+_F_ENERGY_WATTS = "tpu_energy_power_watts"
+_F_TOKENS_PER_JOULE = "tpu_step_tokens_per_joule"
+_F_STEP_COST = "tpu_step_cost_dollars"
 
 
 def _fetch(url: str, timeout: float) -> str:
@@ -126,6 +131,13 @@ def snapshot_from_families(families) -> dict:
     count = fams.get(_F_COUNT)
     if count is not None and count.samples:
         snap["device_count"] = int(count.samples[0].value)
+
+    hosts = fams.get("accelerator_slice_host_count")
+    if hosts is not None and hosts.samples:
+        # Slice host count, lifted for consumers that must split
+        # job-global feed rates across the job's hosts (the energy
+        # plane's tokens/joule join).
+        snap["identity"]["hosts"] = int(hosts.samples[0].value)
 
     cov = fams.get(_F_COVERAGE)
     if cov is not None and cov.samples:
@@ -221,11 +233,54 @@ def snapshot_from_families(families) -> dict:
                 snap["network"] = {"delivery_rate_mbps": s.value}
                 break
 
+    pods = fams.get(_F_POD_INFO)
+    if pods is not None and pods.samples:
+        # chip -> [(namespace, pod)] — the energy plane's attribution
+        # join (and any consumer wanting the chip→pod ownership map)
+        # reads it straight off the snapshot instead of re-walking the
+        # family. Chips without an attribution row stay absent.
+        pod_map: dict = {}
+        for s in pods.samples:
+            chip = s.labels.get("chip", "")
+            if not chip:
+                continue  # unjoinable kubelet ID: visible in the family
+            pod_map.setdefault(chip, []).append(
+                (s.labels.get("namespace", ""), s.labels.get("pod", ""))
+            )
+        if pod_map:
+            snap["pods"] = pod_map
+
+    # Energy plane (tpumon/energy) — present only when scraping a live
+    # exporter page (the in-process snapshot is built BEFORE the energy
+    # pass, which is how the plane reads its device inputs from here).
+    energy_watts = fams.get(_F_ENERGY_WATTS)
+    if energy_watts is not None and energy_watts.samples:
+        watts = 0.0
+        sources = set()
+        for s in energy_watts.samples:
+            watts += s.value
+            sources.add(s.labels.get("source", "?"))
+        snap["energy"] = {
+            "watts": watts,
+            "source": "measured" if sources == {"measured"} else "modeled",
+        }
+    tpj = fams.get(_F_TOKENS_PER_JOULE)
+    if tpj is not None and tpj.samples:
+        snap.setdefault("energy", {})["tokens_per_joule"] = (
+            tpj.samples[0].value
+        )
+    cost = fams.get(_F_STEP_COST)
+    if cost is not None and cost.samples:
+        snap.setdefault("energy", {})["step_cost_dollars"] = (
+            cost.samples[0].value
+        )
+
     per_chip = {
         _F_DUTY: "duty_pct",
         _F_HBM_USED: "hbm_used",
         _F_HBM_TOTAL: "hbm_total",
         _F_THROTTLE: "throttle",
+        _F_POWER: "power_w",
     }
     for fam_name, field in per_chip.items():
         fam = fams.get(fam_name)
@@ -763,6 +818,18 @@ def render(snap: dict, out=None) -> None:
                 + ("..." if len(fams_hit) > 2 else "") + ")"
             )
         p("GUARD: " + "; ".join(parts))
+
+    energy = snap.get("energy")
+    if energy and energy.get("watts") is not None:
+        # Energy/cost plane (tpumon/energy): node power with its
+        # provenance, plus the efficiency joins when a workload feed
+        # reports throughput.
+        parts = [f"{energy['watts']:.0f} W ({energy.get('source', '?')})"]
+        if energy.get("tokens_per_joule") is not None:
+            parts.append(f"{energy['tokens_per_joule']:.4g} tok/J")
+        if energy.get("step_cost_dollars") is not None:
+            parts.append(f"${energy['step_cost_dollars']:.4g}/step")
+        p("ENERGY: " + "  ".join(parts))
 
     straggler = snap.get("straggler")
     if straggler and straggler.get("active"):

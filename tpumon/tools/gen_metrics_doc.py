@@ -13,6 +13,7 @@ import sys
 
 from tpumon.families import (
     ANOMALY_FAMILIES,
+    ENERGY_FAMILIES,
     FLEET_FAMILIES,
     HEALTH_FAMILIES,
     HOSTCORR_FAMILIES,
@@ -174,6 +175,32 @@ def render() -> str:
         "|---|---|---|---|",
     ]
     for name, (kind, desc, labels) in LIFECYCLE_FAMILIES.items():
+        label_s = ", ".join(f"`{l}`" for l in labels) or "—"
+        lines.append(f"| `{name}` | {kind} | {desc} | {label_s} |")
+
+    lines += [
+        "",
+        "## Energy & cost plane (`tpumon/energy`)",
+        "",
+        "Per-chip power/energy with explicit provenance: `source=measured`",
+        "when the device library reported watts (`accelerator_power_watts`),",
+        "`source=modeled` when estimated from duty cycle × the accelerator",
+        "generation's TDP envelope, HBM-activity adjusted",
+        "(`tpumon/energy/model.py` — table override via",
+        "`TPUMON_ENERGY_TDP_W`). Joules counters integrate at poll cadence",
+        "with gap honesty (`TPUMON_ENERGY_MAX_GAP_S`), pod energy rides the",
+        "`accelerator_pod_info` attribution join, and the step-efficiency",
+        "families join the lifecycle plane's `tpu_step_*` feeds. The",
+        "`efficiency_regression` detector baselines tokens/joule per",
+        "workload preset and rides `/anomalies`",
+        "(lifecycle-suppression aware). Enabled by default;",
+        "`TPUMON_ENERGY=0` disables, `TPUMON_ENERGY_<FIELD>` tunes",
+        "(incl. `TPUMON_ENERGY_DOLLARS_PER_KWH` for the cost family).",
+        "",
+        "| family | type | description | extra labels |",
+        "|---|---|---|---|",
+    ]
+    for name, (kind, desc, labels) in ENERGY_FAMILIES.items():
         label_s = ", ".join(f"`{l}`" for l in labels) or "—"
         lines.append(f"| `{name}` | {kind} | {desc} | {label_s} |")
 

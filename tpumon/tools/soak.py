@@ -568,6 +568,7 @@ def straggler_soak(
 _LC_FALSE_SET = (
     "duty_ewma", "hbm_ewma", "ici_flap", "bw_cusum", "queue_stall",
     "host_straggler", "host_stall", "step_regression", "collective_wait",
+    "efficiency_regression",
 )
 
 #: Tightened lifecycle thresholds for short soak windows: the classifier
@@ -863,6 +864,204 @@ def preempt_soak(
             for e in regressions[:4]
         ],
         "false_negatives": 0 if regressions else 1,
+        "device_calls_per_cycle": (
+            round(calls_per_cycle, 4) if calls_per_cycle else None
+        ),
+        "control_calls_per_cycle": (
+            round(control, 4) if control else None
+        ),
+    }
+
+
+def _energy_control_calls_per_cycle(
+    topology: str, interval: float, duty_constant: float
+) -> float | None:
+    """Zero-additional-device-queries control for the energy plane: the
+    identical exporter (lifecycle ON — its probe is localhost HTTP, not
+    a device call) with ONLY the energy plane disabled must issue the
+    same device calls per poll cycle."""
+    from tpumon.backends.fake import FakeTpuBackend
+    from tpumon.config import Config
+    from tpumon.exporter.server import build_exporter
+    from tpumon.lifecycle.fixture import LifecycleBackend
+
+    backend = LifecycleBackend(FakeTpuBackend.preset(topology, ici_flake=0.0))
+    backend.duty_constant = duty_constant
+    control = build_exporter(
+        Config(port=0, addr="127.0.0.1", interval=interval, energy=False),
+        backend,
+    )
+    try:
+        control.start()
+        time.sleep(max(3.0, 12 * interval))
+    finally:
+        control.close()
+    polls = control.telemetry.polls._value.get()
+    return sum(backend.calls.values()) / polls if polls else None
+
+
+#: Exporter-page families the energy plane owns: every present one
+#: must carry an explicit source=measured|modeled label (the ISSUE 12
+#: honesty bar — a dashboard can never read a model as a meter).
+_ENERGY_FAMILY_PREFIXES = (
+    "tpu_energy_power_watts", "tpu_energy_joules_total",
+    "tpu_pod_energy_joules_total", "tpu_step_energy_joules",
+    "tpu_step_tokens_per_joule", "tpu_step_cost_dollars",
+)
+
+
+def efficiency_soak(
+    duration_s: float,
+    topology: str = "v4-8",
+    interval: float = 0.25,
+    scrape_every_s: float = 0.5,
+    factor: float = 0.7,
+) -> dict:
+    """``--efficiency`` (ISSUE 12): a steady preset suddenly pays more
+    energy for the same training progress.
+
+    Script: a workload feed publishes a CONSTANT step/token rate over a
+    steady pinned duty cycle (the baseline the tokens/J EWMA warms on);
+    at the injection point the same step rate starts costing
+    ``1/factor``× the duty — so modeled watts rise and tokens/joule
+    drops to ``factor``× its baseline — with NO lifecycle signal. The
+    bars: zero false verdicts in the clean (pre-injection) window, the
+    efficiency_regression event fires after injection, every present
+    energy family carries a ``source`` label, and the per-cycle device
+    call budget equals an energy-off control (the plane adds zero
+    device queries).
+    """
+    if duration_s <= 0:
+        raise ValueError(f"duration must be > 0 seconds, got {duration_s}")
+    if duration_s < 60 * interval:
+        raise ValueError(
+            f"--duration {duration_s:g} too short for the efficiency "
+            f"script at --interval {interval:g} (need > 60*interval: "
+            "EWMA warmup plus a detection phase each span many cycles)"
+        )
+    if not 0.0 < factor < 1.0:
+        raise ValueError(f"--efficiency factor must be in (0, 1), got {factor}")
+
+    inject_at = 0.55 * duration_s
+    duty_constant = 60.0
+    workloads, backend, exporter = _lc_scaffold(topology, interval, feeds=1)
+    backend.duty_constant = duty_constant
+    state = {"injected": False}
+
+    def script(t: float) -> None:
+        # Constant step rate throughout — the regression is pure
+        # energy-per-progress, never a throughput change.
+        workloads[0].set_rate(2.0)
+        if t >= inject_at and not state["injected"]:
+            state["injected"] = True
+            backend.duty_scale = 1.0 / factor
+
+    env = _lc_env(interval)
+    env.update(
+        {
+            # The tokens/J EWMA must arm inside a short smoke, and the
+            # cost family must be on the page so the source-label sweep
+            # covers all the step joins.
+            "TPUMON_ENERGY_EFF_WARMUP": "8",
+            "TPUMON_ENERGY_DOLLARS_PER_KWH": "0.12",
+        }
+    )
+    with _EnvPatch(env):
+        try:
+            exporter.start()
+            lat_ms, failed, t0, elapsed, conn = _lc_run(
+                exporter, workloads, duration_s, scrape_every_s, script
+            )
+            try:
+                conn.request("GET", "/metrics")
+                page = conn.getresponse().read().decode()
+            finally:
+                conn.close()
+            _, anomalies = _lc_harvest(exporter.server.port)
+            energy_vars = None
+            vconn = http.client.HTTPConnection(
+                "127.0.0.1", exporter.server.port, timeout=10
+            )
+            try:
+                vconn.request("GET", "/debug/vars")
+                energy_vars = json.loads(vconn.getresponse().read()).get(
+                    "energy"
+                )
+            finally:
+                vconn.close()
+        finally:
+            exporter.close()
+            for wl in workloads:
+                wl.close()
+    poll_cycles = exporter.telemetry.polls._value.get()
+    calls_per_cycle = (
+        sum(backend.calls.values()) / poll_cycles if poll_cycles else None
+    )
+    control = _energy_control_calls_per_cycle(
+        topology, interval, duty_constant
+    )
+
+    # Clean window: start of run (EWMA warmups included) to injection.
+    false_positives = _lc_events(
+        anomalies, _LC_FALSE_SET, (0.0, inject_at - 1.0, t0)
+    )
+    regressions = _lc_events(
+        anomalies, ("efficiency_regression",),
+        (inject_at, duration_s + 60.0, t0),
+    )
+
+    # Source-label honesty sweep over the final page: every present
+    # energy family line must carry source=.
+    families_present: set[str] = set()
+    unlabeled: list[str] = []
+    for line in page.splitlines():
+        if not line or line[0] == "#":
+            continue
+        for prefix in _ENERGY_FAMILY_PREFIXES:
+            if line.startswith(prefix) and line[len(prefix):len(prefix) + 1] in ("{", " "):
+                families_present.add(prefix)
+                if 'source="' not in line:
+                    unlabeled.append(line[:120])
+
+    lat_ms.sort()
+    return {
+        "mode": "efficiency",
+        "topology": topology,
+        "interval_s": interval,
+        "duration_s": round(elapsed, 1),
+        "inject_at_s": round(inject_at, 1),
+        #: tokens/J drops to this fraction of baseline at injection
+        #: (implemented as the same step rate costing 1/factor× duty).
+        "injected_efficiency_factor": factor,
+        "duty_constant_pct": duty_constant,
+        "scrapes": len(lat_ms),
+        "failed_scrapes": failed,
+        "p50_ms": round(quantile(lat_ms, 0.5), 3) if lat_ms else None,
+        "p99_ms": round(quantile(lat_ms, 0.99), 3) if lat_ms else None,
+        #: Zero is the bar: no detector verdict may onset before the
+        #: injection (the steady preset IS steady).
+        "false_positives": len(false_positives),
+        "false_positive_events": [
+            {k: e.get(k) for k in ("detector", "device", "message")}
+            for e in false_positives[:8]
+        ],
+        #: >= 1 is the bar: the post-injection regression fired.
+        "regression_detected": len(regressions) > 0,
+        "regression_events": [
+            {k: e.get(k) for k in ("detector", "device", "message")}
+            for e in regressions[:4]
+        ],
+        "false_negatives": 0 if regressions else 1,
+        "suppressed": anomalies.get("suppressed", 0),
+        #: Every present energy family carried source= (empty = pass);
+        #: pod energy is absent off-cluster (no kubelet) and that's fine
+        #: — the sweep covers what the page actually served.
+        "energy_families_present": sorted(families_present),
+        "unlabeled_energy_lines": unlabeled[:8],
+        "all_energy_families_source_labeled": not unlabeled,
+        "energy_debug_vars": energy_vars,
+        #: The zero-additional-device-queries proof: identical per-cycle
+        #: device-call budget with the plane on and off.
         "device_calls_per_cycle": (
             round(calls_per_cycle, 4) if calls_per_cycle else None
         ),
@@ -1879,6 +2078,18 @@ def main(argv=None) -> int:
     parser.add_argument("--pods", type=int, default=6,
                         help="simultaneous restoring workload feeds for "
                         "--restore-storm")
+    parser.add_argument("--efficiency", action="store_true",
+                        help="energy-plane scenario (tpumon/energy): a "
+                        "steady preset's tokens/joule drops to "
+                        "--efficiency-factor of baseline at constant "
+                        "step rate (duty inflation); the regression "
+                        "event must fire, the clean window must carry "
+                        "zero false verdicts, every energy family must "
+                        "be source-labeled, and the device-call budget "
+                        "must equal an energy-off control")
+    parser.add_argument("--efficiency-factor", type=float, default=0.7,
+                        help="post-injection tokens/joule as a fraction "
+                        "of baseline for --efficiency")
     parser.add_argument("--fleet", action="store_true",
                         help="soak the fleet aggregation tier instead of "
                         "one exporter: --fleet-nodes fake exporters "
@@ -1924,6 +2135,12 @@ def main(argv=None) -> int:
             args.duration, topology=args.topology,
             interval=args.interval, scrape_every_s=args.scrape_every,
             pods=args.pods,
+        )
+    elif args.efficiency:
+        record = efficiency_soak(
+            args.duration, topology=args.topology,
+            interval=args.interval, scrape_every_s=args.scrape_every,
+            factor=args.efficiency_factor,
         )
     elif args.straggler:
         record = straggler_soak(

@@ -420,6 +420,151 @@ def measure_fanin(page_text: str, iterations: int = 50) -> dict:
     }
 
 
+def measure_fanin_delta(page_text: str, iterations: int = 200) -> dict:
+    """Delta-protocol fan-in cost per node per cycle: the heartbeat
+    frame an idle node ships (vs the full snapshot frame), a
+    typical-churn frame (one chip's gauges moved), and the decode+apply
+    cost of a patch vs decoding a full snapshot — what the aggregator
+    pays per node once the wire is deltas."""
+    from tpumon.exporter.encodings import (
+        apply_delta,
+        decode_delta,
+        decode_snapshot,
+        encode_delta,
+        encode_snapshot,
+        snapshot_delta,
+    )
+    from tpumon.fleet.ingest import node_snapshot_from_text
+
+    snap = node_snapshot_from_text(page_text)
+    full = encode_snapshot(snap)
+    heartbeat = {**snap, "last_poll_ts": (snap.get("last_poll_ts") or 0) + 1}
+    hb_changed, hb_dropped = snapshot_delta(snap, heartbeat)
+    hb_frame = encode_delta(2, 1, hb_changed, hb_dropped)
+    churned = {**heartbeat, "chips": {
+        chip: dict(row) for chip, row in snap.get("chips", {}).items()
+    }}
+    for row in churned["chips"].values():
+        if "duty_pct" in row:
+            row["duty_pct"] = row["duty_pct"] + 1.0
+        break
+    ch_changed, ch_dropped = snapshot_delta(snap, churned)
+    churn_frame = encode_delta(2, 1, ch_changed, ch_dropped)
+
+    apply_samples = []
+    for _ in range(iterations):
+        t0 = time.perf_counter()
+        apply_delta(snap, decode_delta(churn_frame))
+        apply_samples.append((time.perf_counter() - t0) * 1e3)
+    decode_samples = []
+    for _ in range(iterations):
+        t0 = time.perf_counter()
+        decode_snapshot(full)
+        decode_samples.append((time.perf_counter() - t0) * 1e3)
+    apply_p50, _ = _percentiles(apply_samples)
+    decode_p50, _ = _percentiles(decode_samples)
+    return {
+        "snapshot_frame_bytes": len(full),
+        "heartbeat_frame_bytes": len(hb_frame),
+        "churn_frame_bytes": len(churn_frame),
+        "idle_bytes_ratio": round(len(hb_frame) / len(full), 4),
+        "delta_apply_p50_ms": round(apply_p50, 4),
+        "snapshot_decode_p50_ms": round(decode_p50, 4),
+    }
+
+
+def measure_rollup_churn(
+    nodes: int = 256, cycles: int = 30,
+) -> dict:
+    """Incremental-rollup CPU vs churn rate: update() cost over a
+    synthetic fleet at 0% / 1% / 10% / 100% content churn per cycle.
+    ``cpu_us_per_pct_churn`` is the marginal cost of one percent of the
+    fleet churning — the slope the delta fan-in keeps flat as idle
+    nodes are added."""
+    import random as _random
+
+    from tpumon.fleet.rollup import IncrementalRollup
+
+    rng = _random.Random(7)
+
+    def mk_snap(i: int) -> dict:
+        return {
+            "identity": {
+                "accelerator": "v4-8", "slice": f"s{i // 8}",
+                "host": f"n{i}",
+            },
+            "chips": {
+                str(c): {
+                    "duty_pct": rng.uniform(0, 100),
+                    "hbm_used": rng.uniform(0, 8e9),
+                    "hbm_total": 16e9,
+                }
+                for c in range(4)
+            },
+            "ici": {"healthy": 4, "total": 4},
+        }
+
+    out: dict = {"nodes": nodes}
+    per_churn = {}
+    for churn_pct in (0, 1, 10, 100):
+        roll = IncrementalRollup()
+        snaps = {i: mk_snap(i) for i in range(nodes)}
+        seqs = dict.fromkeys(range(nodes), 1)
+        roll.update(
+            [(f"n{i}", snaps[i], "up", seqs[i]) for i in range(nodes)]
+        )
+        k = nodes * churn_pct // 100
+        samples = []
+        for cycle in range(cycles):
+            for j in range(k):
+                i = (cycle * k + j) % nodes
+                snaps[i] = mk_snap(i)
+                seqs[i] += 1
+            entries = [
+                (f"n{i}", snaps[i], "up", seqs[i]) for i in range(nodes)
+            ]
+            t0 = time.perf_counter()
+            roll.update(entries)
+            samples.append((time.perf_counter() - t0) * 1e3)
+        p50, _ = _percentiles(samples)
+        per_churn[str(churn_pct)] = round(p50, 4)
+    out["update_p50_ms_by_churn_pct"] = per_churn
+    flat, full_churn = per_churn["0"], per_churn["100"]
+    out["cpu_us_per_pct_churn"] = round(10.0 * (full_churn - flat), 2)
+    out["full_vs_idle_ratio"] = (
+        round(full_churn / flat, 1) if flat else None
+    )
+    # Flat-as-the-fleet-grows evidence: idle update() at 4x the nodes
+    # (the per-feed key scan is the only O(fleet) term) vs what
+    # re-rolling the world costs at that size (the pre-delta baseline).
+    from tpumon.fleet.rollup import rollup as full_rollup
+
+    big = nodes * 4
+    roll = IncrementalRollup()
+    snaps = {i: mk_snap(i) for i in range(big)}
+    entries = [(f"n{i}", snaps[i], "up", 1) for i in range(big)]
+    roll.update(entries)
+    samples = []
+    for _ in range(cycles):
+        t0 = time.perf_counter()
+        roll.update(entries)
+        samples.append((time.perf_counter() - t0) * 1e3)
+    idle_big, _ = _percentiles(samples)
+    ref = [{"snap": snaps[i], "state": "up"} for i in range(big)]
+    samples = []
+    for _ in range(max(5, cycles // 3)):
+        t0 = time.perf_counter()
+        full_rollup(ref)
+        samples.append((time.perf_counter() - t0) * 1e3)
+    full_big, _ = _percentiles(samples)
+    out["idle_update_p50_ms_at_4x_nodes"] = round(idle_big, 4)
+    out["full_rollup_p50_ms_at_4x_nodes"] = round(full_big, 4)
+    out["idle_vs_full_rollup_at_4x"] = (
+        round(idle_big / full_big, 4) if full_big else None
+    )
+    return out
+
+
 def measure_gzip_cost(page: bytes, iterations: int = 30) -> float:
     """One-shot gzip cost of the current page in ms — the per-scrape
     deflate the per-encoding response cache eliminates."""
@@ -534,6 +679,7 @@ def main() -> int:
         )
         gzip_cost = measure_gzip_cost(page)
         fanin = measure_fanin(page.decode())
+        fanin_delta = measure_fanin_delta(page.decode())
         http_p50, http_p99 = _best_of(
             lambda: measure_http_client(exporter.server.port)
         )
@@ -546,6 +692,10 @@ def main() -> int:
         encode_hits, encode_misses = exporter.renderer.encoded.stats()
     finally:
         exporter.close()
+
+    # Incremental-rollup churn microbench: CPU-bound, runs after the
+    # latency loops so it can't pollute their tails.
+    rollup_churn = measure_rollup_churn()
 
     # Control run with the delta renderer off: full per-cycle render +
     # per-scrape encodes — the r05-and-earlier publish stage. Output
@@ -597,6 +747,8 @@ def main() -> int:
                     },
                     "encodings": encodings,
                     "fanin": fanin,
+                    "fanin_delta": fanin_delta,
+                    "rollup_churn": rollup_churn,
                     "sustained": sustained,
                 },
             )

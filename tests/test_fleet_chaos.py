@@ -975,9 +975,15 @@ def test_fleetsim_partition_slow_corrupt_heal():
         tail = f"http://127.0.0.1:{sim.ports[2]}/metrics"
         with urllib.request.urlopen(tail, timeout=2.0) as resp:
             hostile = resp.read()
-        from tpumon.exporter.encodings import SNAPSHOT_MAGIC
+        from tpumon.exporter.encodings import DELTA_MAGIC, SNAPSHOT_MAGIC
 
-        assert hostile.startswith(SNAPSHOT_MAGIC) or hostile[:1] == b"\xff"
+        # Three rotating variants: hostile snapshot length prefix,
+        # hostile DELTA length prefix, undecodable garbage.
+        assert (
+            hostile.startswith(SNAPSHOT_MAGIC)
+            or hostile.startswith(DELTA_MAGIC)
+            or hostile[:1] == b"\xff"
+        )
         # Slow: answers, late.
         sim.slow(1, 0.2)
         t0 = time.monotonic()

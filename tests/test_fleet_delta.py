@@ -1,0 +1,797 @@
+"""Push-based delta fan-in (ROADMAP item 3): wire protocol + resync
+rules + incremental rollups.
+
+Codec tests pin the frame grammar and its hostile-input caps; protocol
+tests drive a real exporter (HTTP conditional GET and gRPC Watch) and a
+real NodeFeed through the resync rules — a sequence gap forces a resync
+instead of silent drift, a mid-stream reconnect lands on a consistent
+full snapshot, oversized/hostile delta frames die at the payload caps.
+Rollup tests pin the incremental engine to the reference full rollup
+and to the no-double-count invariant through membership handoffs.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+import pytest
+
+from tpumon.exporter.encodings import (
+    DELTA_BASE_HEADER,
+    DELTA_CONTENT_TYPE,
+    DELTA_SEQ_HEADER,
+    DeltaHistory,
+    apply_delta,
+    decode_delta,
+    decode_snapshot,
+    encode_delta,
+    encode_snapshot,
+    is_delta,
+    is_snapshot,
+    negotiate,
+    snapshot_delta,
+)
+from tpumon.fleet.ingest import NodeFeed
+from tpumon.fleet.rollup import DARK, STALE, UP, IncrementalRollup, rollup
+
+
+def _wait_for(predicate, timeout: float = 10.0, step: float = 0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(step)
+    raise AssertionError("condition not met within timeout")
+
+
+# -- codec ------------------------------------------------------------------
+
+
+def test_delta_roundtrip():
+    prev = {"a": 1, "b": {"x": 2}, "gone": True}
+    cur = {"a": 1, "b": {"x": 3}, "new": [1, 2]}
+    changed, dropped = snapshot_delta(prev, cur)
+    assert changed == {"b": {"x": 3}, "new": [1, 2]}
+    assert dropped == ["gone"]
+    frame = encode_delta(7, 6, changed, dropped)
+    assert is_delta(frame) and not is_snapshot(frame)
+    doc = decode_delta(frame)
+    assert doc["seq"] == 7 and doc["base"] == 6
+    assert apply_delta(prev, doc) == cur
+
+
+def test_delta_apply_returns_new_dict():
+    prev = {"a": 1}
+    doc = decode_delta(encode_delta(2, 1, {"b": 2}, []))
+    merged = apply_delta(prev, doc)
+    assert merged == {"a": 1, "b": 2}
+    assert prev == {"a": 1}  # readers of the old snapshot never tear
+
+
+def test_delta_hostile_length_prefix_rejected_before_allocation():
+    from tpumon.backends.reflection import _encode_varint
+    from tpumon.exporter.encodings import DELTA_MAGIC
+
+    hostile = DELTA_MAGIC + _encode_varint(1 << 40) + b"\x00" * 64
+    with pytest.raises(ValueError, match="exceeds cap"):
+        decode_delta(hostile, max_bytes=1 << 20)
+
+
+@pytest.mark.parametrize(
+    "payload",
+    [
+        b"[]",  # not an object
+        b'{"seq":"x","base":1,"set":{}}',  # non-int seq
+        b'{"seq":1,"base":0,"set":[]}',  # set not an object
+        b'{"seq":1,"base":0,"set":{},"drop":[1]}',  # non-str drop key
+    ],
+)
+def test_delta_malformed_payloads_rejected(payload):
+    from tpumon.backends.reflection import _encode_varint
+    from tpumon.exporter.encodings import DELTA_MAGIC
+
+    frame = DELTA_MAGIC + _encode_varint(len(payload)) + payload
+    with pytest.raises(ValueError):
+        decode_delta(frame)
+
+
+def test_delta_negotiated_over_snapshot():
+    accept = f"{DELTA_CONTENT_TYPE}, application/vnd.tpumon.snapshot;q=0.9"
+    assert negotiate(accept, ("text", "snapshot", "delta")) == "delta"
+    # A wildcard client must never receive a binary patch.
+    assert negotiate("*/*", ("text", "snapshot", "delta")) == "text"
+    # Delta disabled: the q=0.9 snapshot ask wins.
+    assert negotiate(accept, ("text", "snapshot")) == "snapshot"
+
+
+def test_delta_history_seq_resync_and_pruning():
+    hist = DeltaHistory(depth=3)
+    assert hist.frame_from(None) is None  # nothing recorded yet
+    bulk = {f"k{i}": "x" * 40 for i in range(30)}  # realistic page bulk
+    seqs = []
+    for n in range(6):
+        snap = {**bulk, "v": n, "last_poll_ts": float(n)}
+        seq = hist.record((n,), snap, encode_snapshot(snap))
+        seqs.append(seq)
+    assert seqs == [1, 2, 3, 4, 5, 6]
+    # Same key re-records idempotently.
+    assert hist.record((5,), {"v": 5}, b"x") == 6
+    # Recent base: a delta frame naming exactly (base, seq).
+    frame, seq, kind = hist.frame_from(5)
+    assert kind == "delta" and seq == 6
+    doc = decode_delta(frame)
+    assert doc["base"] == 5 and doc["set"] == {
+        "v": 5, "last_poll_ts": 5.0,
+    }
+    # Pruned base (depth 3 keeps seqs 4-6): full resync.
+    _, _, kind = hist.frame_from(1)
+    assert kind == "snapshot"
+    # Unknown/future base: full resync, never a guess.
+    _, _, kind = hist.frame_from(99)
+    assert kind == "snapshot"
+
+
+def test_delta_history_prefers_full_when_patch_outgrows_snapshot():
+    hist = DeltaHistory()
+    a = {"k" + str(i): i for i in range(50)}
+    b = {"k" + str(i): i + 1 for i in range(50)}  # everything changed
+    hist.record((1,), a, encode_snapshot(a))
+    hist.record((2,), b, encode_snapshot(b))
+    _, _, kind = hist.frame_from(1)
+    assert kind == "snapshot"  # the patch would exceed the resync
+
+
+# -- exporter serving -------------------------------------------------------
+
+
+@pytest.fixture
+def exporter():
+    from tpumon.backends.fake import FakeTpuBackend
+    from tpumon.config import Config
+    from tpumon.exporter.server import build_exporter
+
+    cfg = Config(
+        port=0, addr="127.0.0.1", interval=0.2, pod_attribution=False,
+        grpc_serve_port=0,
+    )
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v4-8"))
+    exp.start()
+    yield exp
+    exp.close()
+
+
+def _http_delta_fetch(port: int, base: str | None = None):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        headers = {"Accept": DELTA_CONTENT_TYPE}
+        if base is not None:
+            headers[DELTA_BASE_HEADER] = base
+        conn.request("GET", "/metrics", headers=headers)
+        resp = conn.getresponse()
+        return resp.read(), resp.getheader(DELTA_SEQ_HEADER)
+    finally:
+        conn.close()
+
+
+def test_http_conditional_delta(exporter):
+    port = exporter.server.port
+    body, seq_hdr = _http_delta_fetch(port)
+    assert is_snapshot(body)  # no base: full resync frame
+    assert seq_hdr and ":" in seq_hdr
+    state = decode_snapshot(body)
+    _wait_for(
+        lambda: exporter.cache.rendered_with_version()[1]
+        > int(seq_hdr.split(":")[1])
+    )
+    body2, seq2 = _http_delta_fetch(port, base=seq_hdr)
+    assert is_delta(body2)
+    doc = decode_delta(body2)
+    state = apply_delta(state, doc)
+    # Consistency: the patched state matches a fresh full fetch at the
+    # same seq (fetch immediately and compare only when seqs line up —
+    # the poller advances concurrently).
+    body3, seq3 = _http_delta_fetch(port, base="0:0")  # wrong epoch
+    assert is_snapshot(body3)  # epoch mismatch always resyncs
+    if seq3 == seq2:
+        assert decode_snapshot(body3) == state
+
+
+def test_http_delta_stale_base_resyncs(exporter):
+    port = exporter.server.port
+    _, seq_hdr = _http_delta_fetch(port)
+    epoch, seq = seq_hdr.split(":")
+    # A base far older than the history depth: full frame, not a guess.
+    body, _ = _http_delta_fetch(port, base=f"{epoch}:-5")
+    assert is_snapshot(body)
+
+
+def test_grpc_watch_delta_stream_full_then_patches(exporter):
+    grpc = pytest.importorskip("grpc")
+    from tpumon.exporter.encodings import snapshot_request
+    from tpumon.exporter.grpc_service import (
+        METHOD_WATCH,
+        decode_page_response,
+    )
+
+    addr = f"127.0.0.1:{exporter.grpc_server.port}"
+    channel = grpc.insecure_channel(addr)
+    try:
+        call = channel.unary_stream(
+            METHOD_WATCH, request_serializer=None, response_deserializer=None
+        )
+        stream = call(snapshot_request("delta"), timeout=30)
+        frames = []
+        try:
+            for raw in stream:
+                frames.append(decode_page_response(raw))
+                if len(frames) >= 4:
+                    break
+        finally:
+            stream.cancel()
+    finally:
+        channel.close()
+    # First frame is ALWAYS the full snapshot; subsequent ones patch.
+    assert is_snapshot(frames[0][0])
+    state = decode_snapshot(frames[0][0])
+    last_seq = frames[0][1]
+    for payload, seq in frames[1:]:
+        assert is_delta(payload)
+        doc = decode_delta(payload)
+        assert doc["base"] == last_seq  # sequence chain, no gaps
+        state = apply_delta(state, doc)
+        last_seq = seq
+    assert state.get("chips")  # patched state still a full snapshot
+
+
+def test_grpc_watch_delta_reconnect_lands_on_full_snapshot(exporter):
+    """Mid-stream reconnect: the NEXT stream's first frame is a full
+    snapshot whose content matches the exporter's current state — a
+    reconnecting consumer can never inherit a stale base."""
+    grpc = pytest.importorskip("grpc")
+    from tpumon.exporter.encodings import snapshot_request
+    from tpumon.exporter.grpc_service import (
+        METHOD_WATCH,
+        decode_page_response,
+    )
+
+    addr = f"127.0.0.1:{exporter.grpc_server.port}"
+
+    def one_stream(n):
+        channel = grpc.insecure_channel(addr)
+        try:
+            call = channel.unary_stream(
+                METHOD_WATCH,
+                request_serializer=None, response_deserializer=None,
+            )
+            stream = call(snapshot_request("delta"), timeout=30)
+            out = []
+            try:
+                for raw in stream:
+                    out.append(decode_page_response(raw))
+                    if len(out) >= n:
+                        break
+            finally:
+                stream.cancel()
+            return out
+        finally:
+            channel.close()
+
+    first = one_stream(2)  # stream 1: full + one delta, then "crash"
+    second = one_stream(1)  # reconnect
+    assert is_snapshot(first[0][0]) and is_delta(first[1][0])
+    assert is_snapshot(second[0][0])  # resync, not a patch
+    assert second[0][1] >= first[1][1]  # seq moved forward, never back
+    snap = decode_snapshot(second[0][0])
+    assert snap.get("chips") and "identity" in snap
+
+
+def test_grpc_watch_frames_carry_epoch_for_poll_failover(exporter):
+    """Watch pushes stamp the delta-stream epoch (PageResponse field 3)
+    so a feed can fail over watch→poll and name its base on the HTTP
+    conditional GET instead of forcing a full-snapshot resync."""
+    grpc = pytest.importorskip("grpc")
+    from tpumon.exporter.encodings import snapshot_request
+    from tpumon.exporter.grpc_service import (
+        METHOD_WATCH,
+        decode_page_response_meta,
+    )
+
+    addr = f"127.0.0.1:{exporter.grpc_server.port}"
+    channel = grpc.insecure_channel(addr)
+    try:
+        call = channel.unary_stream(
+            METHOD_WATCH, request_serializer=None, response_deserializer=None
+        )
+        stream = call(snapshot_request("delta"), timeout=30)
+        try:
+            raw = next(iter(stream))
+        finally:
+            stream.cancel()
+    finally:
+        channel.close()
+    _page, _seq, epoch = decode_page_response_meta(raw)
+    assert epoch == exporter.renderer.delta.epoch
+
+
+def test_watch_honors_delta_disabled_in_formats():
+    """TPUMON_EXPOSITION_FORMATS without delta must disable the delta
+    protocol on EVERY transport — a Watch asking for delta degrades to
+    the SNAPSHOT frame (the nearest enabled ask, never a silent
+    reversion to full text pages)."""
+    from tpumon.backends.fake import FakeTpuBackend
+    from tpumon.config import Config
+    from tpumon.exporter.server import build_exporter
+
+    grpc = pytest.importorskip("grpc")
+    from tpumon.exporter.encodings import snapshot_request
+    from tpumon.exporter.grpc_service import (
+        METHOD_WATCH,
+        decode_page_response,
+    )
+
+    cfg = Config(
+        port=0, addr="127.0.0.1", interval=0.2, pod_attribution=False,
+        grpc_serve_port=0, exposition_formats=("text", "snapshot"),
+    )
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v4-8"))
+    exp.start()
+    try:
+        addr = f"127.0.0.1:{exp.grpc_server.port}"
+        channel = grpc.insecure_channel(addr)
+        try:
+            call = channel.unary_stream(
+                METHOD_WATCH,
+                request_serializer=None, response_deserializer=None,
+            )
+            stream = call(snapshot_request("delta"), timeout=30)
+            try:
+                page, _version = decode_page_response(next(iter(stream)))
+            finally:
+                stream.cancel()
+        finally:
+            channel.close()
+    finally:
+        exp.close()
+    assert is_snapshot(page)  # degraded to snapshot frames, not text
+    assert decode_snapshot(page).get("chips")
+
+
+def test_watch_periodic_resync_frame():
+    """After delta_resync_frames consecutive patches the stream carries
+    a full snapshot anyway — divergence is bounded by construction."""
+    from tpumon.backends.fake import FakeTpuBackend
+    from tpumon.config import Config
+    from tpumon.exporter.server import build_exporter
+
+    grpc = pytest.importorskip("grpc")
+    from tpumon.exporter.encodings import snapshot_request
+    from tpumon.exporter.grpc_service import (
+        METHOD_WATCH,
+        decode_page_response,
+    )
+
+    cfg = Config(
+        port=0, addr="127.0.0.1", interval=0.1, pod_attribution=False,
+        grpc_serve_port=0, delta_resync_frames=3,
+    )
+    exp = build_exporter(cfg, FakeTpuBackend.preset("v4-8"))
+    exp.start()
+    try:
+        addr = f"127.0.0.1:{exp.grpc_server.port}"
+        channel = grpc.insecure_channel(addr)
+        try:
+            call = channel.unary_stream(
+                METHOD_WATCH,
+                request_serializer=None, response_deserializer=None,
+            )
+            stream = call(snapshot_request("delta"), timeout=30)
+            kinds = []
+            try:
+                for raw in stream:
+                    payload, _ = decode_page_response(raw)
+                    kinds.append("snap" if is_snapshot(payload) else "delta")
+                    if len(kinds) >= 7:
+                        break
+            finally:
+                stream.cancel()
+        finally:
+            channel.close()
+    finally:
+        exp.close()
+    assert kinds[0] == "snap"
+    assert "delta" in kinds
+    # A second full frame must appear after at most 3 deltas.
+    assert "snap" in kinds[1:6]
+
+
+# -- NodeFeed resync rules --------------------------------------------------
+
+
+def _feed(**kwargs) -> NodeFeed:
+    return NodeFeed("http://127.0.0.1:1", **kwargs)
+
+
+def test_feed_applies_chained_deltas():
+    frames = []
+    resyncs = []
+    feed = _feed(
+        observe_frame=lambda m, k, n: frames.append((m, k)),
+        observe_resync=lambda r: resyncs.append(r),
+    )
+    base = {"identity": {"host": "n0"}, "chips": {"0": {"duty_pct": 1.0}}}
+    assert feed.store_page(
+        encode_snapshot(base), "watch", delta_seq=5
+    ) == "ok"
+    patch = encode_delta(6, 5, {"chips": {"0": {"duty_pct": 9.0}}}, [])
+    assert feed.store_page(patch, "watch", delta_seq=6) == "ok"
+    snap, _, _ = feed.current()
+    assert snap["chips"]["0"]["duty_pct"] == 9.0
+    assert snap["identity"] == {"host": "n0"}  # untouched segment kept
+    assert frames == [("watch", "snapshot"), ("watch", "delta")]
+    assert resyncs == []
+
+
+def test_feed_sequence_gap_forces_resync():
+    resyncs = []
+    feed = _feed(observe_resync=lambda r: resyncs.append(r))
+    base = {"identity": {"host": "n0"}, "v": 1}
+    feed.store_page(encode_snapshot(base), "watch", delta_seq=5)
+    # A patch whose base names seq 7 — we hold 5: MUST NOT apply.
+    gap = encode_delta(8, 7, {"v": 3}, [])
+    assert feed.store_page(gap, "watch", delta_seq=8) == "gap"
+    snap, _, _ = feed.current()
+    assert snap["v"] == 1  # last-good kept, drift refused
+    assert resyncs == ["gap"]
+    # Base state dropped: the next delta (even a well-formed chain from
+    # the stale seq) also reads as a gap until a full frame lands.
+    assert feed.store_page(
+        encode_delta(6, 5, {"v": 2}, []), "watch", delta_seq=6
+    ) == "gap"
+    # The resync frame restores the chain.
+    assert feed.store_page(
+        encode_snapshot({"v": 9}), "watch", delta_seq=9
+    ) == "ok"
+    assert feed.store_page(
+        encode_delta(10, 9, {"v": 10}, []), "watch", delta_seq=10
+    ) == "ok"
+
+
+def test_feed_discards_stale_inflight_frame_without_dropping_state():
+    """A late poll response landing after a Watch resync moved the base
+    forward is a STALE frame, not a gap: discard the frame, keep the
+    live state — dropping it would cascade into a spurious gap (and a
+    stream redial) on the healthy stream's next push."""
+    resyncs = []
+    feed = _feed(observe_resync=lambda r: resyncs.append(r))
+    feed.store_page(encode_snapshot({"v": 9}), "watch", delta_seq=9)
+    # The in-flight poll's response: a delta for seq 6 against base 5.
+    late = encode_delta(6, 5, {"v": 6}, [])
+    assert feed.store_page(late, "poll", delta_seq=6) == "stale"
+    snap, _, _ = feed.current()
+    assert snap["v"] == 9  # live state untouched
+    assert resyncs == []  # and no resync noise
+    # The healthy stream's next push still chains cleanly.
+    assert feed.store_page(
+        encode_delta(10, 9, {"v": 10}, []), "watch", delta_seq=10
+    ) == "ok"
+
+
+def test_feed_text_outcome_signals_downgrade():
+    """store_page tells the Watch loop when an upstream answered the
+    binary ask with a text page, so the loop can downgrade its request
+    format for old exporters instead of parsing text per push forever."""
+    feed = _feed()
+    out = feed.store_page(
+        b"accelerator_duty_cycle_percent 5.0\n", "watch"
+    )
+    assert out == "text"
+
+
+def test_feed_epoch_change_counts_epoch_resync():
+    resyncs = []
+    feed = _feed(observe_resync=lambda r: resyncs.append(r))
+    feed.store_page(
+        encode_snapshot({"v": 1}), "poll", delta_seq=4, delta_epoch=111
+    )
+    feed.store_page(
+        encode_snapshot({"v": 2}), "poll", delta_seq=1, delta_epoch=222
+    )
+    assert resyncs == ["epoch"]
+
+
+def test_feed_rejects_oversized_and_hostile_delta_frames():
+    rejects = []
+    feed = _feed(
+        observe_reject=lambda r: rejects.append(r),
+        max_snapshot_bytes=4096,
+    )
+    from tpumon.backends.reflection import _encode_varint
+    from tpumon.exporter.encodings import DELTA_MAGIC
+
+    # Hostile declared length: rejected pre-allocation.
+    hostile = DELTA_MAGIC + _encode_varint(1 << 40) + b"\x00" * 64
+    assert feed.store_page(hostile, "poll") == "rejected"
+    # Oversized actual body: rejected at the transport cap.
+    big = encode_delta(2, 1, {"blob": "x" * 8192}, [])
+    assert feed.store_page(big, "poll") == "rejected"
+    assert rejects == ["bad_frame", "oversized"]
+
+
+def test_feed_text_page_drops_delta_state():
+    feed = _feed()
+    feed.store_page(encode_snapshot({"v": 1}), "poll", delta_seq=3)
+    feed.store_page(b"accelerator_duty_cycle_percent 5.0\n", "poll")
+    # Held base is gone: a chained delta is now a gap, not an apply.
+    assert feed.store_page(
+        encode_delta(4, 3, {"v": 2}, []), "poll", delta_seq=4
+    ) == "gap"
+
+
+def test_feed_content_seq_ignores_heartbeat():
+    feed = _feed()
+    feed.store_snapshot({"v": 1, "last_poll_ts": 1.0}, "poll")
+    seq = feed.content_seq
+    feed.store_snapshot({"v": 1, "last_poll_ts": 2.0}, "poll")
+    assert feed.content_seq == seq  # heartbeat: not churn
+    feed.store_snapshot({"v": 2, "last_poll_ts": 3.0}, "poll")
+    assert feed.content_seq == seq + 1  # content: churn
+
+
+# -- incremental rollup -----------------------------------------------------
+
+
+def _rand_snap(rng, pool, slc, host):
+    snap = {
+        "identity": {"accelerator": pool, "slice": slc, "host": host},
+        "chips": {
+            str(i): {
+                "duty_pct": rng.uniform(0, 100),
+                "hbm_used": rng.uniform(0, 8e9),
+                "hbm_total": 16e9,
+            }
+            for i in range(4)
+        },
+        "ici": {"healthy": rng.randint(2, 4), "total": 4},
+    }
+    if rng.random() < 0.4:
+        snap["mfu"] = rng.uniform(0.2, 0.6)
+    if rng.random() < 0.3:
+        snap["energy"] = {"watts": rng.uniform(100, 400), "source": "modeled"}
+    if rng.random() < 0.2:
+        snap["straggler"] = {
+            "active": True, "cause": "host-cpu",
+            "skew_pct": rng.uniform(5, 40),
+        }
+    if rng.random() < 0.2:
+        snap["degraded"] = {"active": True}
+    return snap
+
+
+def _approx_equal(a, b, path=""):
+    if isinstance(a, dict) and isinstance(b, dict):
+        assert set(a) == set(b), f"{path}: keys {set(a)} != {set(b)}"
+        for key in a:
+            _approx_equal(a[key], b[key], f"{path}.{key}")
+    elif isinstance(a, float) and isinstance(b, float):
+        assert a == pytest.approx(b, rel=1e-9), f"{path}: {a} != {b}"
+    else:
+        assert a == b, f"{path}: {a!r} != {b!r}"
+
+
+def test_incremental_rollup_matches_full_over_random_churn():
+    rng = random.Random(42)
+    inc = IncrementalRollup()
+    nodes = {}
+    seqs = {}
+    for t in range(16):
+        nodes[f"n{t}"] = (
+            _rand_snap(rng, f"pool{t % 3}", f"s{t % 5}", f"n{t}"), UP
+        )
+        seqs[f"n{t}"] = 1
+    for cycle in range(12):
+        # Mutate a random subset: content changes, state flips, and —
+        # twice — membership changes (add/remove a node).
+        for target in rng.sample(sorted(nodes), k=rng.randint(0, 5)):
+            snap, _ = nodes[target]
+            i = int(target[1:])
+            nodes[target] = (
+                _rand_snap(rng, f"pool{i % 3}", f"s{i % 5}", target),
+                rng.choice([UP, UP, STALE, DARK]),
+            )
+            seqs[target] += 1
+        if cycle == 5:
+            del nodes["n3"], seqs["n3"]
+        if cycle == 8:
+            nodes["n99"] = (_rand_snap(rng, "pool9", "s9", "n99"), UP)
+            seqs["n99"] = 1
+        entries = [
+            (t, snap, state, seqs[t])
+            for t, (snap, state) in sorted(nodes.items())
+        ]
+        got = inc.update(entries)
+        want = rollup(
+            [{"snap": snap, "state": state} for _, snap, state, _ in entries]
+        )
+        _approx_equal(got, want)
+
+
+def test_incremental_rollup_reuses_clean_buckets():
+    inc = IncrementalRollup()
+    rng = random.Random(1)
+    entries = [
+        (f"n{i}", _rand_snap(rng, "p", f"s{i % 4}", f"n{i}"), UP, 1)
+        for i in range(16)
+    ]
+    inc.update(entries)
+    assert inc.last_dirty_nodes == 16
+    # Nothing changed: zero dirty work.
+    inc.update(entries)
+    assert inc.last_dirty_nodes == 0
+    assert inc.last_dirty_buckets == 0
+    # One node churns: exactly one bucket re-aggregates.
+    entries[0] = (
+        "n0", _rand_snap(rng, "p", "s0", "n0"), UP, 2
+    )
+    inc.update(entries)
+    assert inc.last_dirty_nodes == 1
+    assert inc.last_dirty_buckets == 1
+
+
+def test_incremental_rollup_never_double_counts_through_handoff():
+    """A target handed to another shard mid-delta (takeover/hand-back)
+    leaves every bucket it was in — host totals can never exceed the
+    owned set, whatever deltas were in flight."""
+    inc = IncrementalRollup()
+    rng = random.Random(2)
+    snap = _rand_snap(rng, "p", "s0", "n0")
+    others = [
+        (f"n{i}", _rand_snap(rng, "p", f"s{i}", f"n{i}"), UP, 1)
+        for i in range(1, 4)
+    ]
+    doc = inc.update([("n0", snap, UP, 1), *others])
+    assert sum(doc["fleet"]["hosts"].values()) == 4
+    # Hand-off: n0 leaves this shard while its content also changed
+    # (the in-flight delta applied just before the membership swap).
+    doc = inc.update(others)
+    assert sum(doc["fleet"]["hosts"].values()) == 3
+    assert ("p", "s0") not in doc["slices"]
+    # Re-adopt later (hand-back): counted exactly once again.
+    doc = inc.update([("n0", snap, STALE, 7), *others])
+    assert sum(doc["fleet"]["hosts"].values()) == 4
+    assert doc["fleet"]["hosts"][STALE] == 1
+
+
+def test_incremental_rollup_state_transitions_without_deltas():
+    """A silent node crosses fresh→stale→dark with NO delta arriving:
+    the age-derived state alone must dirty its bucket."""
+    inc = IncrementalRollup()
+    snap = {
+        "identity": {"accelerator": "p", "slice": "s", "host": "n0"},
+        "chips": {"0": {"duty_pct": 50.0}},
+    }
+    doc = inc.update([("n0", snap, UP, 1)])
+    assert doc["fleet"]["hosts"][UP] == 1
+    doc = inc.update([("n0", snap, STALE, 1)])
+    assert doc["fleet"]["hosts"][STALE] == 1
+    assert doc["fleet"]["stale"] is True
+    doc = inc.update([("n0", snap, DARK, 1)])
+    assert doc["fleet"]["hosts"][DARK] == 1
+    assert doc["fleet"]["chips"] == 0  # dark data left the math
+
+
+# -- aggregator integration -------------------------------------------------
+
+
+def test_aggregator_delta_fanin_over_fleetsim():
+    """End to end over the simulator: the aggregator negotiates delta
+    frames, steady-state fan-in rides heartbeat-sized patches, and the
+    rollup reports churn-proportional dirt."""
+    import http.client
+
+    from tpumon.fleet.config import FleetConfig
+    from tpumon.fleet.server import build_aggregator
+    from tpumon.tools.fleetsim import FleetSim
+
+    sim = FleetSim(6, node_interval=0.25, churn=0.0)
+    agg = None
+    try:
+        urls = [f"http://127.0.0.1:{p}" for p in sim.ports]
+        agg = build_aggregator(
+            FleetConfig(
+                port=0, addr="127.0.0.1", targets=",".join(urls),
+                interval=0.25, stale_s=2.0, evict_s=30.0,
+            )
+        )
+        agg.start()
+
+        def metrics() -> str:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", agg.server.port, timeout=5
+            )
+            try:
+                conn.request("GET", "/metrics")
+                return conn.getresponse().read().decode()
+            finally:
+                conn.close()
+
+        _wait_for(
+            lambda: 'tpu_fleet_hosts{pool="",scope="fleet",slice="",'
+            'state="up"} 6.0' in metrics(),
+            timeout=15.0,
+        )
+        def page_with_deltas():
+            p = metrics()
+            return p if 'kind="delta",mode="poll"' in p else None
+
+        page = _wait_for(page_with_deltas, timeout=15.0)
+        import re
+
+        def counter(pat):
+            m = re.search(pat, page, re.M)
+            return float(m.group(1)) if m else 0.0
+
+        delta_frames = counter(
+            r'tpu_fleet_fanin_frames_total\{kind="delta",mode="poll"\} (\S+)'
+        )
+        delta_bytes = counter(
+            r'tpu_fleet_fanin_bytes_total\{kind="delta",mode="poll"\} (\S+)'
+        )
+        snap_frames = counter(
+            r'tpu_fleet_fanin_frames_total\{kind="snapshot",mode="poll"\} (\S+)'
+        )
+        snap_bytes = counter(
+            r'tpu_fleet_fanin_bytes_total\{kind="snapshot",mode="poll"\} (\S+)'
+        )
+        assert delta_frames > 0 and snap_frames >= 6  # initial resyncs
+        # Zero churn: a delta frame is a heartbeat — a tiny fraction of
+        # the full snapshot frame.
+        assert delta_bytes / delta_frames < 0.2 * (snap_bytes / snap_frames)
+    finally:
+        if agg is not None:
+            agg.close()
+        sim.close()
+
+
+def test_aggregator_delta_off_rides_snapshots():
+    import http.client
+
+    from tpumon.fleet.config import FleetConfig
+    from tpumon.fleet.server import build_aggregator
+    from tpumon.tools.fleetsim import FleetSim
+
+    sim = FleetSim(3, node_interval=0.25, churn=0.0)
+    agg = None
+    try:
+        urls = [f"http://127.0.0.1:{p}" for p in sim.ports]
+        agg = build_aggregator(
+            FleetConfig(
+                port=0, addr="127.0.0.1", targets=",".join(urls),
+                interval=0.25, stale_s=2.0, evict_s=30.0, delta=False,
+            )
+        )
+        agg.start()
+
+        def metrics() -> str:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", agg.server.port, timeout=5
+            )
+            try:
+                conn.request("GET", "/metrics")
+                return conn.getresponse().read().decode()
+            finally:
+                conn.close()
+
+        def page_with_snapshots():
+            p = metrics()
+            return p if 'kind="snapshot",mode="poll"' in p else None
+
+        page = _wait_for(page_with_snapshots, timeout=15.0)
+        assert 'kind="delta"' not in page  # baseline mode: no patches
+    finally:
+        if agg is not None:
+            agg.close()
+        sim.close()

@@ -197,11 +197,61 @@ static PyObject *concat(PyObject *self, PyObject *segments) {
     return out;
 }
 
+/* Family-index probe for the cardinality governor (tpumon/guard): are
+ * all samples in this family published under ONE sample name?  Mixed
+ * names mean a histogram-shaped family (_bucket/_sum/_count rows) whose
+ * cardinality is already bounded by its bucket ladder — the governor
+ * must skip it.  At a 10k+ series budget the pure-Python set build this
+ * replaces is the governor's entire per-cycle cost; here it is one
+ * attribute fetch + one compare per sample, pointer-equality first
+ * (producers reuse the same interned name object per family). */
+static PyObject *uniform_names(PyObject *self, PyObject *samples) {
+    (void)self;
+    PyObject *fast = PySequence_Fast(samples, "samples must be a sequence");
+    if (!fast) return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    PyObject *first = NULL;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PySequence_Fast_GET_ITEM(fast, i);
+        PyObject *name = PyObject_GetAttrString(item, "name");
+        if (!name) {
+            Py_DECREF(fast);
+            Py_XDECREF(first);
+            return NULL;
+        }
+        if (first == NULL) {
+            first = name;
+            continue;
+        }
+        if (name != first) {
+            int eq = PyObject_RichCompareBool(name, first, Py_EQ);
+            if (eq < 0) {
+                Py_DECREF(name);
+                Py_DECREF(first);
+                Py_DECREF(fast);
+                return NULL;
+            }
+            if (!eq) {
+                Py_DECREF(name);
+                Py_DECREF(first);
+                Py_DECREF(fast);
+                Py_RETURN_FALSE;
+            }
+        }
+        Py_DECREF(name);
+    }
+    Py_XDECREF(first);
+    Py_DECREF(fast);
+    Py_RETURN_TRUE;
+}
+
 static PyMethodDef methods[] = {
     {"render", render, METH_O,
      "render(families) -> bytes — Prometheus text exposition 0.0.4"},
     {"concat", concat, METH_O,
      "concat(segments) -> bytes — join pre-rendered page segments"},
+    {"uniform_names", uniform_names, METH_O,
+     "uniform_names(samples) -> bool — one sample name across the family"},
     {NULL, NULL, 0, NULL},
 };
 

@@ -213,7 +213,10 @@ class Config:
     guard_replay_max_bytes: int = 1048576
     #: Per-family series budget (tpumon/guard/cardinality.py): overflow
     #: series collapse into a sentinel `other` label value. 0 disables.
-    guard_max_series_per_family: int = 1000
+    #: 10k (lifted from 1000 with the native-backed family index) so a
+    #: full-size slice's per-link/per-pod families fit ungoverned while
+    #: runaway label explosions still collapse.
+    guard_max_series_per_family: int = 10000
     #: RSS watermarks in MB (tpumon/guard/memwatch.py): soft shrinks the
     #: trace/history/anomaly rings and disables slow-cycle capture; hard
     #: drops to metrics-only serving. 0 = auto (75% / 90% of the cgroup
@@ -233,8 +236,15 @@ class Config:
     #: CSV of: text (Prometheus 0.0.4, always kept — the compatibility
     #: floor), openmetrics (OpenMetrics 1.0 via Accept), snapshot (the
     #: compact length-prefixed binary snapshot the fleet tier's fan-in
-    #: requests first).
-    exposition_formats: tuple[str, ...] = ("text", "openmetrics", "snapshot")
+    #: requests first), delta (sequence-numbered changed-segment frames
+    #: against that snapshot — fan-in bytes proportional to change rate).
+    exposition_formats: tuple[str, ...] = (
+        "text", "openmetrics", "snapshot", "delta",
+    )
+    #: Watch streams serving the delta format push a full-snapshot
+    #: resync frame after this many consecutive delta frames, bounding
+    #: worst-case consumer divergence to one resync window.
+    delta_resync_frames: int = 300
     #: Internal trace plane (tpumon/trace): per-stage spans around every
     #: poll-pipeline stage, served at /debug/traces (+/slow) and as the
     #: tpumon_trace_stage_duration_seconds self-metric.
@@ -357,6 +367,9 @@ class Config:
             render_delta=_env_bool("RENDER_DELTA", base.render_delta),
             exposition_formats=_split_csv(_env("EXPOSITION_FORMATS"))
             or base.exposition_formats,
+            delta_resync_frames=_env_int(
+                "DELTA_RESYNC_FRAMES", base.delta_resync_frames
+            ),
             trace=_env_bool("TRACE", base.trace),
             trace_slow_cycle_ms=_env_float(
                 "TRACE_SLOW_CYCLE_MS", base.trace_slow_cycle_ms

@@ -15,6 +15,15 @@ in whichever representation the consumer is cheapest to feed
   decode instead of a 0.37 ms/page text parse. Old exporters ignore the
   Accept header and serve text; the magic prefix makes the two
   indistinguishable to mix up.
+- **delta** — a sequence-numbered PATCH against a previous snapshot
+  (ROADMAP item 3: fleet fan-in cost proportional to change rate, not
+  fleet size). A delta frame carries only the snapshot's top-level
+  segments that changed since the consumer's acknowledged sequence —
+  the wire form of the delta renderer's invalidation set. Consumers
+  that hold no base (new/reconnecting), name a base the server no
+  longer has, or observe a sequence gap get a FULL snapshot frame (a
+  resync) instead; drift is impossible by construction because a delta
+  only ever applies to the exact base it names.
 
 Every format is cached per (format, content-encoding) keyed on the page
 version pair, so an unchanged page costs zero encode work no matter how
@@ -42,7 +51,10 @@ log = logging.getLogger(__name__)
 FORMAT_TEXT = "text"
 FORMAT_OPENMETRICS = "openmetrics"
 FORMAT_SNAPSHOT = "snapshot"
-KNOWN_FORMATS = (FORMAT_TEXT, FORMAT_OPENMETRICS, FORMAT_SNAPSHOT)
+FORMAT_DELTA = "delta"
+KNOWN_FORMATS = (
+    FORMAT_TEXT, FORMAT_OPENMETRICS, FORMAT_SNAPSHOT, FORMAT_DELTA,
+)
 
 #: Content types, response side. Text matches prometheus_client.
 TEXT_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -50,11 +62,13 @@ OPENMETRICS_CONTENT_TYPE = (
     "application/openmetrics-text; version=1.0.0; charset=utf-8"
 )
 SNAPSHOT_CONTENT_TYPE = "application/vnd.tpumon.snapshot"
+DELTA_CONTENT_TYPE = "application/vnd.tpumon.delta"
 
 CONTENT_TYPES = {
     FORMAT_TEXT: TEXT_CONTENT_TYPE,
     FORMAT_OPENMETRICS: OPENMETRICS_CONTENT_TYPE,
     FORMAT_SNAPSHOT: SNAPSHOT_CONTENT_TYPE,
+    FORMAT_DELTA: DELTA_CONTENT_TYPE,
 }
 
 #: Wire prefix of the snapshot encoding: magic + format version byte.
@@ -62,6 +76,21 @@ CONTENT_TYPES = {
 #: that asked for a snapshot detects an old text-only exporter from the
 #: payload itself (transport-agnostic: HTTP body or gRPC page field).
 SNAPSHOT_MAGIC = b"TPMN\x01"
+
+#: Wire prefix of a delta frame (same magic + length-prefix envelope as
+#: the snapshot encoding, distinct magic). A delta consumer therefore
+#: distinguishes "patch" from "resync" from "old text-only exporter" by
+#: the first bytes of every payload, on every transport.
+DELTA_MAGIC = b"TPMD\x01"
+
+#: Response/request header pair for the conditional-GET (HTTP polling)
+#: form of the delta protocol: the server stamps every snapshot/delta
+#: response with its stream epoch and sequence; a poller echoes them
+#: back to name its base. gRPC Watch needs neither — the stream itself
+#: scopes the sequence (PageResponse.version) and a reconnect always
+#: starts from a full frame.
+DELTA_SEQ_HEADER = "X-Tpumon-Delta-Seq"
+DELTA_BASE_HEADER = "X-Tpumon-Delta-Base"
 
 
 def parse_formats(raw: tuple[str, ...]) -> tuple[str, ...]:
@@ -98,8 +127,8 @@ def negotiate(accept: str, formats: tuple[str, ...]) -> str:
       (curl, a browser) must get the default format, never a binary
       payload;
     - highest q wins; ties break toward the more specific ask
-      (snapshot > openmetrics > text), which only matters when a client
-      explicitly lists two formats at equal q;
+      (delta > snapshot > openmetrics > text), which only matters when a
+      client explicitly lists two formats at equal q;
     - no Accept header, or nothing matching: text.
     """
     if not accept:
@@ -117,7 +146,9 @@ def negotiate(accept: str, formats: tuple[str, ...]) -> str:
                 except ValueError:
                     q = 0.0
         target = None
-        if media == SNAPSHOT_CONTENT_TYPE:
+        if media == DELTA_CONTENT_TYPE:
+            target = FORMAT_DELTA
+        elif media == SNAPSHOT_CONTENT_TYPE:
             target = FORMAT_SNAPSHOT
         elif media == "application/openmetrics-text":
             target = FORMAT_OPENMETRICS
@@ -128,7 +159,7 @@ def negotiate(accept: str, formats: tuple[str, ...]) -> str:
     best_q = max(scores.values())
     if best_q <= 0.0:
         return FORMAT_TEXT
-    for fmt in (FORMAT_SNAPSHOT, FORMAT_OPENMETRICS, FORMAT_TEXT):
+    for fmt in (FORMAT_DELTA, FORMAT_SNAPSHOT, FORMAT_OPENMETRICS, FORMAT_TEXT):
         if scores.get(fmt, 0.0) == best_q:
             return fmt
     return FORMAT_TEXT
@@ -181,6 +212,188 @@ def decode_snapshot(data: bytes, max_bytes: int | None = None) -> dict:
     if not isinstance(doc, dict):
         raise ValueError("snapshot payload is not an object")
     return doc
+
+
+# -- delta frame codec ------------------------------------------------------
+
+def snapshot_delta(prev: dict, cur: dict) -> tuple[dict, list]:
+    """(changed segments, dropped keys) between two node snapshots.
+
+    Segments are the snapshot's TOP-LEVEL keys — exactly the granularity
+    the fleet rollup consumes them at (identity, chips, ici, straggler,
+    energy, ...), and the dict-equality comparison per key is a C loop.
+    A key present in both with equal value ships nothing; an idle node's
+    delta is therefore just ``last_poll_ts`` — the heartbeat."""
+    changed = {
+        key: value
+        for key, value in cur.items()
+        if key not in prev or prev[key] != value
+    }
+    dropped = [key for key in prev if key not in cur]
+    return changed, dropped
+
+
+def encode_delta(seq: int, base: int, changed: dict, dropped: list) -> bytes:
+    """Delta frame: DELTA_MAGIC + varint payload length + canonical JSON
+    ``{"seq", "base", "set", "drop"}``. Same envelope discipline as
+    :func:`encode_snapshot` (sorted keys, tight separators, NaN tokens
+    allowed) so equal deltas encode to equal bytes and the per-(base,
+    seq) frame cache can share one encode across every consumer."""
+    payload = json.dumps(
+        {"seq": seq, "base": base, "set": changed, "drop": dropped},
+        sort_keys=True, separators=(",", ":"),
+    ).encode()
+    return DELTA_MAGIC + _encode_varint(len(payload)) + payload
+
+
+def is_delta(data: bytes) -> bool:
+    return data.startswith(DELTA_MAGIC)
+
+
+def decode_delta(data: bytes, max_bytes: int | None = None) -> dict:
+    """Inverse of :func:`encode_delta`; raises ValueError on anything
+    that is not a well-formed delta frame.
+
+    Mirrors :func:`decode_snapshot`'s hostile-input stance: ``max_bytes``
+    caps the DECLARED payload length before any payload-sized work (a
+    length prefix claiming terabytes is rejected pre-allocation), and
+    the decoded shape is type-checked — ``seq``/``base`` must be ints,
+    ``set`` an object, ``drop`` a list of strings — so a corrupt feed
+    can never smuggle a non-mergeable patch into per-feed state."""
+    if not is_delta(data):
+        raise ValueError("not a tpumon delta frame")
+    body = data[len(DELTA_MAGIC):]
+    length, idx = _decode_varint(body, 0)
+    if length < 0 or (max_bytes is not None and length > max_bytes):
+        raise ValueError(
+            f"delta length prefix {length} exceeds cap {max_bytes}"
+        )
+    payload = body[idx:idx + length]
+    if len(payload) != length:
+        raise ValueError("truncated delta payload")
+    doc = json.loads(payload.decode())
+    if not isinstance(doc, dict):
+        raise ValueError("delta payload is not an object")
+    if not isinstance(doc.get("seq"), int) or not isinstance(
+        doc.get("base"), int
+    ):
+        raise ValueError("delta frame missing integer seq/base")
+    if not isinstance(doc.get("set"), dict):
+        raise ValueError("delta set is not an object")
+    drop = doc.get("drop", [])
+    if not isinstance(drop, list) or not all(
+        isinstance(key, str) for key in drop
+    ):
+        raise ValueError("delta drop is not a list of keys")
+    return doc
+
+
+def apply_delta(state: dict, delta: dict) -> dict:
+    """New snapshot = ``state`` patched by one decoded delta frame.
+
+    Returns a NEW dict (the previous snapshot object may still be
+    serving readers — the fleet collect loop holds references without
+    locks, so in-place mutation would tear a rollup mid-cycle)."""
+    merged = dict(state)
+    merged.update(delta["set"])
+    for key in delta.get("drop", ()):
+        merged.pop(key, None)
+    return merged
+
+
+class DeltaHistory:
+    """Bounded (seq → snapshot) history + encoded-frame cache: the
+    server half of the delta protocol, shared by HTTP conditional GETs
+    and every gRPC Watch stream.
+
+    - ``record(key, snap)`` assigns the next sequence number to a new
+      page-version key (idempotent per key: all transports observe the
+      same seq for the same page), retaining the last ``depth`` snaps.
+    - ``frame_from(base)`` returns ``(frame, seq, kind)``: a delta frame
+      when ``base`` is retained and the encoded patch is actually
+      smaller than a full resync, else the full snapshot frame. One
+      encode per (base, seq) pair no matter how many consumers share
+      that transition.
+    - ``epoch`` scopes the sequence numbers to this process: a consumer
+      that survived a server restart would otherwise eventually see its
+      stale base number reassigned to unrelated content and apply a
+      wrong-base patch — the silent-drift failure the protocol exists
+      to make impossible.
+    """
+
+    def __init__(self, depth: int = 8) -> None:
+        import os as _os
+
+        self._lock = threading.Lock()
+        self._depth = max(2, depth)
+        #: seq -> snapshot dict, insertion-ordered (oldest first).
+        self._snaps: dict[int, dict] = {}  # guarded-by: self._lock
+        self._key: tuple | None = None  # guarded-by: self._lock
+        self._seq = 0  # guarded-by: self._lock
+        #: (base, seq) -> encoded frame; cleared as bases age out.
+        self._frames: dict[tuple[int, int], bytes] = {}  # guarded-by: self._lock
+        self._full: bytes | None = None  # guarded-by: self._lock
+        self.epoch = int.from_bytes(_os.urandom(4), "big")
+
+    def record(self, key: tuple, snap: dict, full_frame: bytes) -> int:
+        """Publish the snapshot for page-version ``key``; returns its
+        sequence number. ``full_frame`` is the already-encoded snapshot
+        frame (the resync payload) for this seq."""
+        with self._lock:
+            if key == self._key:
+                return self._seq
+            if self._key is not None and key < self._key:
+                # A slow builder losing the race to a NEWER version must
+                # not publish older content as the newest seq (version
+                # pairs are monotonic and componentwise comparable —
+                # the EncodedPageCache stance).
+                return self._seq
+            self._seq += 1
+            self._key = key
+            self._snaps[self._seq] = snap
+            self._full = full_frame
+            while len(self._snaps) > self._depth:
+                oldest = next(iter(self._snaps))
+                del self._snaps[oldest]
+            self._frames = {
+                pair: frame
+                for pair, frame in self._frames.items()
+                if pair[0] in self._snaps
+            }
+            return self._seq
+
+    def frame_from(self, base: int | None) -> tuple[bytes, int, str] | None:
+        """(payload, seq, "delta"|"snapshot") against the CURRENT seq,
+        or None when nothing was ever recorded. A base that is current
+        returns an empty delta (heartbeat for transports that must send
+        something); an unknown/pruned base returns the full frame."""
+        with self._lock:
+            seq = self._seq
+            full = self._full
+            if full is None:
+                return None
+            if base is None or base not in self._snaps:
+                return full, seq, FORMAT_SNAPSHOT
+            cached = self._frames.get((base, seq))
+            if cached is not None:
+                return cached, seq, FORMAT_DELTA
+            prev = self._snaps[base]
+            cur = self._snaps[seq]
+        # Encode OUTSIDE the lock (EncodedPageCache's builder stance): a
+        # diff+encode must never block other consumers' cache hits. Two
+        # racing consumers at the same (base, seq) produce identical
+        # bytes; the second store is a harmless overwrite.
+        changed, dropped = snapshot_delta(prev, cur)
+        frame = encode_delta(seq, base, changed, dropped)
+        if len(frame) >= len(full):
+            # The patch outgrew the resync (mass change): serve the full
+            # frame — cheaper for the consumer AND self-limits delta
+            # traffic to pages where deltas actually win.
+            return full, seq, FORMAT_SNAPSHOT
+        with self._lock:
+            if base in self._snaps and seq == self._seq:
+                self._frames[(base, seq)] = frame
+        return frame, seq, FORMAT_DELTA
 
 
 # -- OpenMetrics rendering --------------------------------------------------
@@ -306,7 +519,13 @@ def requested_format(request: bytes) -> str:
 
 __all__ = [
     "CONTENT_TYPES",
+    "DELTA_BASE_HEADER",
+    "DELTA_CONTENT_TYPE",
+    "DELTA_MAGIC",
+    "DELTA_SEQ_HEADER",
+    "DeltaHistory",
     "EncodedPageCache",
+    "FORMAT_DELTA",
     "FORMAT_OPENMETRICS",
     "FORMAT_SNAPSHOT",
     "FORMAT_TEXT",
@@ -315,14 +534,19 @@ __all__ = [
     "SNAPSHOT_CONTENT_TYPE",
     "SNAPSHOT_MAGIC",
     "TEXT_CONTENT_TYPE",
+    "apply_delta",
+    "decode_delta",
     "decode_snapshot",
+    "encode_delta",
     "encode_snapshot",
     "gzip_page",
+    "is_delta",
     "is_snapshot",
     "negotiate",
     "openmetrics_join",
     "openmetrics_render",
     "parse_formats",
     "requested_format",
+    "snapshot_delta",
     "snapshot_request",
 ]

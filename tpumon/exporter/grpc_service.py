@@ -67,22 +67,40 @@ _SERVER_OPTIONS = (
 )
 
 
-def encode_page_response(page: bytes, version: int) -> bytes:
-    """PageResponse{bytes page=1; uint64 version=2}."""
-    return _len_field(1, page) + _encode_varint((2 << 3) | 0) + _encode_varint(
+def encode_page_response(
+    page: bytes, version: int, epoch: int | None = None,
+) -> bytes:
+    """PageResponse{bytes page=1; uint64 version=2; uint64 epoch=3}.
+
+    ``epoch`` (delta pushes only) is the server's delta-stream epoch, so
+    a consumer can seed the HTTP conditional-GET base from a Watch frame
+    and fail over watch→poll WITHOUT a resync; old clients skip the
+    unknown field per protobuf rules."""
+    out = _len_field(1, page) + _encode_varint((2 << 3) | 0) + _encode_varint(
         version
     )
+    if epoch is not None:
+        out += _encode_varint((3 << 3) | 0) + _encode_varint(epoch)
+    return out
 
 
 def decode_page_response(data: bytes) -> tuple[bytes, int]:
     """Inverse of encode_page_response (used by clients and tests)."""
-    page, version = b"", 0
+    page, version, _epoch = decode_page_response_meta(data)
+    return page, version
+
+
+def decode_page_response_meta(data: bytes) -> tuple[bytes, int, int | None]:
+    """(page, version, delta epoch|None) — the fleet fan-in decode."""
+    page, version, epoch = b"", 0, None
     for field, wire, value in _iter_fields(data):
         if field == 1 and wire == 2:
             page = value
         elif field == 2 and wire == 0:
             version = value
-    return page, version
+        elif field == 3 and wire == 0:
+            epoch = value
+    return page, version, epoch
 
 
 class MetricsGrpcServer:
@@ -103,7 +121,7 @@ class MetricsGrpcServer:
         from concurrent.futures import ThreadPoolExecutor
         from contextlib import nullcontext
 
-        from tpumon.exporter.encodings import requested_format
+        from tpumon.exporter.encodings import FORMAT_DELTA, requested_format
 
         self._render_with_version = render_with_version
         self._cache = cache
@@ -148,6 +166,41 @@ class MetricsGrpcServer:
                 page, version = negotiated_page(request)
             return encode_page_response(page, version)
 
+        def delta_watch(context):
+            """Delta-format push loop (ROADMAP item 3): the stream's
+            first frame is ALWAYS the full snapshot (a reconnecting
+            consumer lands on a consistent base by construction), each
+            subsequent publish pushes the changed-segment patch against
+            the seq this stream last sent, and every
+            ``delta_resync_frames`` deltas a full resync frame rides the
+            stream anyway — an undetected consumer bug diverges for at
+            most one resync window. PageResponse.version carries the
+            delta sequence number."""
+            renderer = self._renderer
+            last_seq = None
+            deltas_since_full = 0
+            version = 0
+            while context.is_active():
+                newer = cache.wait_newer(version, _WATCH_IDLE_TIMEOUT)
+                if newer == version:
+                    continue  # idle timeout: re-check liveness
+                version = newer
+                base = last_seq
+                if (
+                    base is not None
+                    and deltas_since_full >= renderer.delta_resync_frames
+                ):
+                    base = None  # periodic full-snapshot resync
+                with serve_span("grpc_watch_push"):
+                    payload, seq, kind = renderer.delta_frame(base)
+                deltas_since_full = (
+                    deltas_since_full + 1 if kind == "delta" else 0
+                )
+                last_seq = seq
+                yield encode_page_response(
+                    payload, seq, epoch=renderer.delta.epoch
+                )
+
         def watch(request: bytes, context):
             # Client address without the ephemeral port: the per-client
             # cap must see "the same consumer reconnecting", not a new
@@ -173,14 +226,27 @@ class MetricsGrpcServer:
                         f"watcher limit ({_MAX_WATCHERS}) reached",
                     )
                 try:
-                    version = 0
-                    while context.is_active():
-                        newer = cache.wait_newer(version, _WATCH_IDLE_TIMEOUT)
-                        if newer == version:
-                            continue  # idle timeout: re-check liveness
-                        with serve_span("grpc_watch_push"):
-                            page, version = negotiated_page(request)
-                        yield encode_page_response(page, version)
+                    if (
+                        requested_format(request) == FORMAT_DELTA
+                        and self._renderer is not None
+                        # Honor TPUMON_EXPOSITION_FORMATS here too: a
+                        # delta-disabled exporter must fall back to the
+                        # negotiated page (text) on EVERY transport, or
+                        # the knob silently stops applying to Watch.
+                        and FORMAT_DELTA in self._renderer.formats
+                    ):
+                        yield from delta_watch(context)
+                    else:
+                        version = 0
+                        while context.is_active():
+                            newer = cache.wait_newer(
+                                version, _WATCH_IDLE_TIMEOUT
+                            )
+                            if newer == version:
+                                continue  # idle timeout: re-check liveness
+                            with serve_span("grpc_watch_push"):
+                                page, version = negotiated_page(request)
+                            yield encode_page_response(page, version)
                 finally:
                     watcher_slots.release()
             finally:

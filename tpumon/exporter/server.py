@@ -833,9 +833,13 @@ class NegotiatedRenderer:
 
     def __init__(
         self, cache, selfpage, formats, telemetry=None, tracer=None,
-        self_registry=None,
+        self_registry=None, delta_resync_frames: int = 300,
     ) -> None:
-        from tpumon.exporter.encodings import EncodedPageCache, parse_formats
+        from tpumon.exporter.encodings import (
+            DeltaHistory,
+            EncodedPageCache,
+            parse_formats,
+        )
 
         self._cache = cache
         self._selfpage = selfpage
@@ -846,6 +850,21 @@ class NegotiatedRenderer:
         self.formats = parse_formats(tuple(formats))
         self._telemetry = telemetry
         self._tracer = tracer
+        #: Server half of the delta protocol (bounded seq→snapshot
+        #: history + per-(base,seq) frame cache), shared by the HTTP
+        #: conditional-GET path and every gRPC Watch stream — one seq
+        #: space per exporter, so a consumer can switch transports
+        #: without a resync.
+        self.delta = DeltaHistory()
+        #: Watch streams force a full-snapshot resync frame after this
+        #: many consecutive deltas (bounds worst-case divergence from an
+        #: undetected consumer bug to one resync window).
+        self.delta_resync_frames = max(1, int(delta_resync_frames))
+        #: Decoded node snapshot for the current version pair — built at
+        #: most once per pair, shared by the snapshot AND delta formats
+        #: (the delta diff needs the dict, not the encoded bytes).
+        self._snap_state: tuple | None = None  # guarded-by: self._snap_lock
+        self._snap_lock = threading.Lock()
         observe = None
         if telemetry is not None:
             saves = telemetry.render_encode_saves
@@ -898,41 +917,95 @@ class NegotiatedRenderer:
             encode_snapshot,
         )
 
-        selfb, self_version = self._selfpage.latest_with_version()
         if fmt == FORMAT_OPENMETRICS:
             # The OM body builds from the family snapshot, so the
             # version captured WITH that snapshot is the key: a body
             # cached for version N is always built from N's families.
+            selfb, self_version = self._selfpage.latest_with_version()
             snap, dev_version = self._cache.snapshot_with_version()
 
             def build() -> bytes:
                 with self._span("encode:openmetrics"):
                     return self._openmetrics(snap)
-        else:
-            dev, dev_version = self._cache.rendered_with_version()
-            if fmt == FORMAT_SNAPSHOT:
-                def build() -> bytes:
-                    from tpumon.fleet.ingest import node_snapshot_from_text
 
-                    with self._span("encode:snapshot"):
-                        return encode_snapshot(
-                            node_snapshot_from_text((dev + selfb).decode())
-                        )
-            else:
-                def build() -> bytes:
-                    return dev + selfb
+            return (dev_version, self_version), build
+        if fmt == FORMAT_SNAPSHOT:
+            node, key = self._node_snapshot()
+
+            def build() -> bytes:
+                with self._span("encode:snapshot"):
+                    return encode_snapshot(node)
+
+            return key, build
+        selfb, self_version = self._selfpage.latest_with_version()
+        dev, dev_version = self._cache.rendered_with_version()
+
+        def build() -> bytes:
+            return dev + selfb
+
         return (dev_version, self_version), build
+
+    def _node_snapshot(self) -> tuple[dict, tuple]:
+        """(decoded node snapshot, page-version key) — the dict the
+        snapshot encoding serializes and the delta protocol diffs. The
+        page parse runs at most once per version pair (it IS the
+        per-change cost of both binary formats); a racing build for an
+        older pair never clobbers a newer one (same stance as
+        EncodedPageCache)."""
+        selfb, self_version = self._selfpage.latest_with_version()
+        dev, dev_version = self._cache.rendered_with_version()
+        key = (dev_version, self_version)
+        with self._snap_lock:
+            state = self._snap_state
+        if state is not None and state[0] == key:
+            return state[1], key
+        from tpumon.fleet.ingest import node_snapshot_from_text
+
+        with self._span("encode:snapshot_parse"):
+            snap = node_snapshot_from_text((dev + selfb).decode())
+        with self._snap_lock:
+            stored = self._snap_state
+            if stored is None or key >= stored[0]:
+                self._snap_state = (key, snap)
+        return snap, key
+
+    def delta_frame(self, base: int | None) -> tuple[bytes, int, str]:
+        """One delta-protocol payload: a patch against ``base`` when the
+        history can honestly produce one (base retained AND the patch is
+        smaller than a resync), else the full snapshot frame. Returns
+        ``(payload, seq, kind)`` with kind ∈ delta/snapshot — shared by
+        the HTTP conditional-GET path and the gRPC Watch push loop."""
+        from tpumon.exporter.encodings import (
+            FORMAT_DELTA,
+            FORMAT_SNAPSHOT,
+            encode_snapshot,
+        )
+
+        node, key = self._node_snapshot()
+        full = self.encoded.get(
+            (FORMAT_SNAPSHOT, "identity"), key, lambda: encode_snapshot(node)
+        )
+        self.delta.record(key, node, full)
+        payload, seq, kind = self.delta.frame_from(base)
+        if self._telemetry is not None:
+            self._telemetry.exposition_requests.labels(
+                format=FORMAT_DELTA
+            ).inc()
+        return payload, seq, kind
 
     def respond(self, environ) -> tuple[bytes, list[tuple[str, str]]]:
         """(body, headers) for one /metrics request."""
         from tpumon.exporter.encodings import (
             CONTENT_TYPES,
+            FORMAT_DELTA,
             FORMAT_SNAPSHOT,
             gzip_page,
             negotiate,
         )
 
         fmt = negotiate(environ.get("HTTP_ACCEPT", ""), self.formats)
+        if fmt == FORMAT_DELTA:
+            return self._delta_respond(environ)
         # The snapshot encoding is already compact; gzip applies to the
         # text formats only (Prometheus sends Accept-Encoding: gzip on
         # every scrape — at 1 Hz × full families the ~10x shrink matters
@@ -961,12 +1034,68 @@ class NegotiatedRenderer:
             self._telemetry.exposition_requests.labels(format=fmt).inc()
         return body, headers
 
+    def _delta_respond(self, environ) -> tuple[bytes, list[tuple[str, str]]]:
+        """The conditional-GET form of the delta protocol: the poller
+        names its base via ``X-Tpumon-Delta-Base: <epoch>:<seq>`` (the
+        values a previous response stamped); the response is a delta
+        frame when that base is usable, else a full snapshot frame — a
+        wrong or missing epoch (server restart, first fetch) always
+        resyncs. Binary formats never gzip."""
+        from tpumon.exporter.encodings import (
+            CONTENT_TYPES,
+            DELTA_BASE_HEADER,
+            DELTA_SEQ_HEADER,
+        )
+
+        environ_key = "HTTP_" + DELTA_BASE_HEADER.upper().replace("-", "_")
+        base = self._parse_base(environ.get(environ_key, ""))
+        body, seq, kind = self.delta_frame(base)
+        headers = [
+            ("Content-Type", CONTENT_TYPES[kind]),
+            (DELTA_SEQ_HEADER, f"{self.delta.epoch}:{seq}"),
+            ("Vary", "Accept, Accept-Encoding"),
+            ("Content-Length", str(len(body))),
+        ]
+        return body, headers
+
+    def _parse_base(self, raw: str) -> int | None:
+        """``<epoch>:<seq>`` → seq when the epoch is THIS process's
+        delta stream; anything else (other epoch, garbage, absent) is
+        no base — the server resyncs rather than guess."""
+        epoch_s, _, seq_s = raw.strip().partition(":")
+        try:
+            if int(epoch_s) != self.delta.epoch:
+                return None
+            return int(seq_s)
+        except ValueError:
+            return None
+
     def page_with_version(self, fmt: str) -> tuple[bytes, int]:
         """Current page in ``fmt`` (identity encoding) plus the device
         cache version — the gRPC Get/Watch payload. Unknown/disabled
-        formats serve text, mirroring HTTP negotiation's fallback."""
-        from tpumon.exporter.encodings import FORMAT_TEXT
+        formats serve text, mirroring HTTP negotiation's fallback —
+        except a disabled DELTA ask degrades to the snapshot frame when
+        that is enabled (the nearest ask, exactly what the same client's
+        HTTP Accept chain would have negotiated), so turning delta off
+        never silently reverts Watch fan-in to full text pages."""
+        from tpumon.exporter.encodings import (
+            FORMAT_DELTA,
+            FORMAT_SNAPSHOT,
+            FORMAT_TEXT,
+        )
 
+        if fmt == FORMAT_DELTA:
+            if fmt in self.formats:
+                # Unary Get names no base: serve the full resync frame,
+                # with the delta SEQ as the response version so a
+                # consumer can seed stream state from a one-shot fetch.
+                body, seq, _kind = self.delta_frame(None)
+                return body, seq
+            fmt = (
+                FORMAT_SNAPSHOT
+                if FORMAT_SNAPSHOT in self.formats
+                else FORMAT_TEXT
+            )
         if fmt not in self.formats:
             fmt = FORMAT_TEXT
         key, build = self._identity_source(fmt)
@@ -1417,6 +1546,12 @@ class Exporter:
             self.cache, self._selfpage, cfg.exposition_formats,
             telemetry=self.telemetry, tracer=self.tracer,
             self_registry=self.registry,
+            # Same malformed-knob stance as history_max_samples above.
+            delta_resync_frames=(
+                cfg.delta_resync_frames
+                if cfg.delta_resync_frames > 0
+                else type(cfg)().delta_resync_frames
+            ),
         )
 
         def render(want_gzip: bool) -> bytes:

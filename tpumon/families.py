@@ -539,6 +539,43 @@ FLEET_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "off; off = the target rides HTTP polling)",
         ("state",),
     ),
+    "tpu_fleet_fanin_bytes_total": (
+        "counter",
+        "Accepted fan-in payload bytes by transport mode (watch/poll) "
+        "and representation kind (delta frame / full snapshot frame / "
+        "text page) — with the delta protocol negotiated, steady-state "
+        "bytes track change rate, not fleet size",
+        ("mode", "kind"),
+    ),
+    "tpu_fleet_fanin_frames_total": (
+        "counter",
+        "Accepted fan-in payloads by transport mode and representation "
+        "kind; together with the bytes counter gives bytes/frame per "
+        "kind",
+        ("mode", "kind"),
+    ),
+    "tpu_fleet_fanin_resyncs_total": (
+        "counter",
+        "Full-snapshot frames that replaced live delta base state, by "
+        "cause (gap = sequence mismatch forced a resync, epoch = "
+        "upstream exporter restarted, full = upstream chose a resync); "
+        "a fleet-wide rate spike is a resync storm — see "
+        "docs/OPERATIONS.md triage",
+        ("reason",),
+    ),
+    "tpu_fleet_rollup_dirty_nodes": (
+        "gauge",
+        "Feeds whose rollup-relevant content or ingest state changed "
+        "last collect cycle — the observed churn the incremental "
+        "rollup's work is proportional to",
+        (),
+    ),
+    "tpu_fleet_rollup_dirty_buckets": (
+        "gauge",
+        "Slice buckets re-aggregated last collect cycle; all other "
+        "buckets' rollups were reused unchanged",
+        (),
+    ),
 }
 
 #: family -> (prometheus type, description)

@@ -114,6 +114,13 @@ class FleetConfig:
     #: off toward this on the jittered Backoff; the first good page
     #: restores full cadence (storm-free mass recovery).
     poll_backoff_max_s: float = 60.0
+    #: Delta fan-in (ROADMAP item 3): negotiate sequence-numbered
+    #: changed-segment frames on both transports (gRPC Watch pushes,
+    #: conditional HTTP polls), so steady-state wire bytes and rollup
+    #: CPU scale with churn rate instead of fleet size. Off restores
+    #: full-snapshot-per-fetch — the A/B baseline; decode/rollup
+    #: results are identical either way.
+    delta: bool = True
     #: Rollup-history retention window seconds (tpumon.history reuse,
     #: served at /history); 0 disables.
     history_window: float = 600.0

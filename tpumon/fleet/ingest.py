@@ -264,10 +264,13 @@ class NodeFeed:
         breaker_open_s: float = 15.0,
         observe_fetch=None,
         observe_reject=None,
+        observe_frame=None,
+        observe_resync=None,
         max_snapshot_bytes: int = 8388608,
         fresh_s: float = float("inf"),
         poll_backoff_base_s: float = 1.0,
         poll_backoff_max_s: float = 60.0,
+        delta: bool = True,
         clock=time.time,
     ) -> None:
         self.target = target
@@ -276,6 +279,19 @@ class NodeFeed:
         self._clock = clock
         self._observe_fetch = observe_fetch
         self._observe_reject = observe_reject
+        #: observe_frame(mode, kind, nbytes): fan-in wire accounting —
+        #: every accepted payload counted by transport mode (watch/poll)
+        #: and representation kind (delta/snapshot/text); feeds the
+        #: tpu_fleet_fanin_{bytes,frames}_total self-metrics.
+        self._observe_frame = observe_frame
+        #: observe_resync(reason): full-snapshot frames that REPLACED
+        #: live delta state, by cause (gap / epoch / full / reconnect) —
+        #: the resync-storm triage signal (docs/OPERATIONS.md).
+        self._observe_resync = observe_resync
+        #: Negotiate the delta encoding (ROADMAP item 3). Off, the feed
+        #: asks for snapshot/text only — the full-payload-per-fetch
+        #: baseline the soak A/Bs against.
+        self.delta = delta
         #: Payload hard cap: HTTP bodies read at most this far, and a
         #: snapshot frame DECLARING more is rejected pre-allocation.
         self.max_snapshot_bytes = max(4096, int(max_snapshot_bytes))
@@ -304,6 +320,20 @@ class NodeFeed:
         self._snap: dict | None = None  # guarded-by: self._lock
         self._fetched_at: float = 0.0  # guarded-by: self._lock
         self._last_error: str = ""  # guarded-by: self._lock
+        #: Delta-protocol base state: the snapshot the next patch
+        #: applies to, its sequence number, and (HTTP path only) the
+        #: server's stream epoch. One state for both transports — the
+        #: exporter serves one sequence space, so a feed can fail over
+        #: watch→poll without resyncing.
+        self._delta_state: dict | None = None  # guarded-by: self._lock
+        self._delta_seq: int | None = None  # guarded-by: self._lock
+        self._delta_epoch: int | None = None  # guarded-by: self._lock
+        #: Bumped only when a stored snapshot's ROLLUP-RELEVANT content
+        #: changed (everything except the heartbeat timestamp): the
+        #: incremental rollup's dirtiness signal. An idle node heartbeats
+        #: every cycle without dirtying its buckets.
+        self.content_seq = 0  # guarded-by: self._lock
+        self._content_cmp: dict | None = None  # guarded-by: self._lock
         #: "streaming" while the Watch stream delivers, "down" between
         #: reconnects, "off" when Watch is not configured.
         self.watch_state = "off" if self.grpc_addr is None else "down"  # guarded-by: self._lock
@@ -321,13 +351,30 @@ class NodeFeed:
 
     # -- snapshot access ---------------------------------------------------
 
-    def store_page(self, body: bytes, mode: str) -> None:
+    def store_page(
+        self, body: bytes, mode: str, *,
+        delta_seq: int | None = None, delta_epoch: int | None = None,
+    ) -> str:
         """Publish one fetched payload, whichever representation arrived:
-        a compact snapshot frame decodes directly (the negotiated fast
-        path), anything else is a text exposition page for the line
-        parser — which is exactly what an old, non-negotiating exporter
-        serves no matter what we asked for."""
-        from tpumon.exporter.encodings import decode_snapshot, is_snapshot
+        a delta frame patches this feed's base state (sequence-checked —
+        a gap forces a resync, NEVER a silent merge), a compact snapshot
+        frame decodes directly and becomes the new base, anything else
+        is a text exposition page for the line parser — which is exactly
+        what an old, non-negotiating exporter serves no matter what we
+        asked for. ``delta_seq``/``delta_epoch`` carry the transport's
+        sequence metadata (HTTP response header / gRPC PageResponse
+        version). Returns "ok", "text" (stored ok via the text parser —
+        the upstream is not speaking the binary protocol), "rejected",
+        "stale" (a late in-flight frame older than the held base:
+        discarded, state kept), or "gap" (delta base mismatch: the
+        caller should treat the stream as broken)."""
+        from tpumon.exporter.encodings import (
+            apply_delta,
+            decode_delta,
+            decode_snapshot,
+            is_delta,
+            is_snapshot,
+        )
 
         if len(body) > self.max_snapshot_bytes:
             # The transport reads were already capped; a body at the cap
@@ -337,7 +384,56 @@ class NodeFeed:
                 self.url, mode, self.max_snapshot_bytes,
             )
             self._reject(mode, "oversized")
-            return
+            return "rejected"
+        if is_delta(body):
+            try:
+                delta = decode_delta(body, max_bytes=self.max_snapshot_bytes)
+            except ValueError as exc:
+                log.warning(
+                    "%s: bad delta frame via %s: %s", self.url, mode, exc
+                )
+                self._reject(mode, "bad_frame")
+                return "rejected"
+            with self._lock:
+                state = self._delta_state
+                seq = self._delta_seq
+            if state is None or seq != delta["base"]:
+                if (
+                    state is not None
+                    and seq is not None
+                    and delta["seq"] <= seq
+                ):
+                    # A LATE frame, not a gap: an in-flight poll
+                    # response can land after a Watch reconnect already
+                    # resynced the base forward (both transports share
+                    # one seq space, so the compare is meaningful).
+                    # Discard the frame, keep the live state — dropping
+                    # it here would cascade into a spurious gap on the
+                    # healthy stream's next push.
+                    log.debug(
+                        "%s: discarding stale delta frame seq %s (held "
+                        "%s) via %s", self.url, delta["seq"], seq, mode,
+                    )
+                    return "stale"
+                # Sequence gap (or no base at all): applying would be
+                # silent drift — drop the base so the next fetch carries
+                # no base and lands a full resync frame instead.
+                log.warning(
+                    "%s: delta base %s does not match held seq %s via %s; "
+                    "forcing resync", self.url, delta["base"], seq, mode,
+                )
+                self._drop_delta_state()
+                self._count_resync("gap")
+                return "gap"
+            merged = apply_delta(state, delta)
+            with self._lock:
+                self._delta_state = merged
+                self._delta_seq = delta["seq"]
+                if delta_epoch is not None:
+                    self._delta_epoch = delta_epoch
+            self._count_frame(mode, "delta", len(body))
+            self.store_snapshot(merged, mode, decoded=True)
+            return "ok"
         if is_snapshot(body):
             try:
                 snap = decode_snapshot(
@@ -348,16 +444,65 @@ class NodeFeed:
                     "%s: bad snapshot frame via %s: %s", self.url, mode, exc
                 )
                 self._reject(mode, "bad_frame")
-                return
+                return "rejected"
+            if self.delta:
+                # A full frame while holding live base state is a resync
+                # (server restart = epoch change; pruned base, periodic
+                # Watch resync, or patch-outgrew-snapshot = full).
+                with self._lock:
+                    had_state = self._delta_state is not None
+                    prev_epoch = self._delta_epoch
+                    self._delta_state = snap
+                    self._delta_seq = delta_seq
+                    self._delta_epoch = delta_epoch
+                if had_state and delta_seq is not None:
+                    if (
+                        delta_epoch is not None
+                        and prev_epoch is not None
+                        and delta_epoch != prev_epoch
+                    ):
+                        self._count_resync("epoch")
+                    else:
+                        self._count_resync("full")
+            self._count_frame(mode, "snapshot", len(body))
             self.store_snapshot(snap, mode, decoded=True)
-            return
+            return "ok"
         try:
             text = body.decode()
         except UnicodeDecodeError as exc:
             log.warning("%s: undecodable page via %s: %s", self.url, mode, exc)
             self._reject(mode, "undecodable")
-            return
+            return "rejected"
+        # A text page means the upstream does not speak the binary
+        # protocol (or negotiation fell back): any held base state is
+        # from a different world — drop it rather than risk a later
+        # stale-base apply. The distinct return value lets the Watch
+        # loop downgrade its requested format for old exporters.
+        self._drop_delta_state()
+        self._count_frame(mode, "text", len(body))
         self.store_text(text, mode)
+        return "text"
+
+    def _drop_delta_state(self) -> None:
+        with self._lock:
+            self._delta_state = None
+            self._delta_seq = None
+            self._delta_epoch = None
+
+    def _count_frame(self, mode: str, kind: str, nbytes: int) -> None:
+        if self._observe_frame is not None:
+            try:
+                self._observe_frame(mode, kind, nbytes)
+            except Exception:
+                # A metrics hiccup must never fail the ingest path.
+                log.debug("frame observer failed", exc_info=True)
+
+    def _count_resync(self, reason: str) -> None:
+        if self._observe_resync is not None:
+            try:
+                self._observe_resync(reason)
+            except Exception:
+                log.debug("resync observer failed", exc_info=True)
 
     def store_text(self, text: str, mode: str) -> None:
         """Parse + publish one exposition page."""
@@ -387,11 +532,19 @@ class NodeFeed:
         last_poll = snap.get("last_poll_ts")
         if last_poll:
             data_ts = now - min(max(0.0, now - last_poll), 3600.0)
+        # Rollup-relevant content fingerprint: everything except the
+        # heartbeat timestamp. One shallow dict build + C-speed deep
+        # equality per store — what lets the incremental rollup skip
+        # idle nodes entirely.
+        cmp = {k: v for k, v in snap.items() if k != "last_poll_ts"}
         with self._lock:
             self._snap = snap
             self._fetched_at = data_ts
             self._last_error = ""
             self.snapshot_decoded = decoded
+            if self._content_cmp != cmp:
+                self._content_cmp = cmp
+                self.content_seq += 1
         if now - data_ts <= self.fresh_s:
             # FRESH data restores full poll cadence; a zombie's frozen
             # timestamps do not (the fetch succeeded, the data is dead).
@@ -408,11 +561,24 @@ class NodeFeed:
                 return
             self._snap = snap
             self._fetched_at = fetched_at
+            self._content_cmp = {
+                k: v for k, v in snap.items() if k != "last_poll_ts"
+            }
+            self.content_seq += 1
 
     def current(self) -> tuple[dict | None, float, str]:
         """(last-good snapshot, fetched-at ts, last error) — atomically."""
         with self._lock:
             return self._snap, self._fetched_at, self._last_error
+
+    def current_entry(self) -> tuple[dict | None, float, str, int]:
+        """current() plus the content sequence — one lock acquisition
+        per feed per collect cycle (the incremental rollup's read)."""
+        with self._lock:
+            return (
+                self._snap, self._fetched_at, self._last_error,
+                self.content_seq,
+            )
 
     def watch_state_now(self) -> str:
         with self._lock:
@@ -463,8 +629,11 @@ class NodeFeed:
 
     # -- HTTP polling fallback ---------------------------------------------
 
-    def _fetch_page(self) -> bytes:
-        """GET /metrics over a persistent per-feed connection.
+    def _fetch_page(self) -> tuple[bytes, int | None, int | None]:
+        """GET /metrics over a persistent per-feed connection; returns
+        (body, delta seq, delta epoch) — the sequence metadata from the
+        response's X-Tpumon-Delta-Seq header when the upstream speaks
+        the delta protocol, else (body, None, None).
 
         Keep-alive matters at fleet scale: a fresh TCP connect per poll
         per node is O(fleet) connection churn per second on the shard
@@ -472,25 +641,40 @@ class NodeFeed:
         connection is rebuilt on any error; ``poll`` is serialized per
         feed (``_inflight``), so one connection needs no locking.
 
-        The Accept header asks for the compact snapshot encoding first
-        (one dict decode instead of a 0.37 ms text parse per page); an
-        old exporter ignores Accept and serves text — ``store_page``
-        tells the two apart by the payload's magic prefix, so the
-        fallback needs no version handshake."""
-        from tpumon.exporter.encodings import SNAPSHOT_CONTENT_TYPE
+        The Accept header asks for the delta encoding first (with the
+        held base named in X-Tpumon-Delta-Base — the conditional-GET
+        form of the protocol: an idle node answers with a heartbeat
+        patch of a few dozen bytes), then the compact snapshot (one dict
+        decode instead of a 0.37 ms text parse per page); an old
+        exporter ignores Accept and serves text — ``store_page`` tells
+        the three apart by the payload's magic prefix, so the fallback
+        needs no version handshake."""
+        from tpumon.exporter.encodings import (
+            DELTA_BASE_HEADER,
+            DELTA_CONTENT_TYPE,
+            DELTA_SEQ_HEADER,
+            SNAPSHOT_CONTENT_TYPE,
+        )
 
         host = self.url.split("//", 1)[1]
         if self._conn is None:
             self._conn = http.client.HTTPConnection(
                 host, timeout=self.timeout
             )
-        try:
-            self._conn.request(
-                "GET", "/metrics",
-                headers={
-                    "Accept": f"{SNAPSHOT_CONTENT_TYPE}, text/plain;q=0.5"
-                },
+        headers = {
+            "Accept": f"{SNAPSHOT_CONTENT_TYPE}, text/plain;q=0.5"
+        }
+        if self.delta:
+            headers["Accept"] = (
+                f"{DELTA_CONTENT_TYPE}, {SNAPSHOT_CONTENT_TYPE};q=0.9, "
+                "text/plain;q=0.5"
             )
+            with self._lock:
+                seq, epoch = self._delta_seq, self._delta_epoch
+            if seq is not None and epoch is not None:
+                headers[DELTA_BASE_HEADER] = f"{epoch}:{seq}"
+        try:
+            self._conn.request("GET", "/metrics", headers=headers)
             resp = self._conn.getresponse()
             # Bounded read: one byte past the cap proves oversize
             # without buffering whatever a hostile feed would stream.
@@ -507,7 +691,15 @@ class NodeFeed:
                     self._conn.close()
                 finally:
                     self._conn = None
-            return body
+            seq = epoch = None
+            raw = resp.getheader(DELTA_SEQ_HEADER)
+            if raw:
+                epoch_s, _, seq_s = raw.partition(":")
+                try:
+                    epoch, seq = int(epoch_s), int(seq_s)
+                except ValueError:
+                    seq = epoch = None  # garbage header: treat as absent
+            return body, seq, epoch
         except BaseException:
             # Whatever happened, this connection's framing is suspect.
             try:
@@ -528,7 +720,7 @@ class NodeFeed:
                 self._count("poll", "breaker_open")
                 return
             try:
-                body = self._fetch_page()
+                body, seq, epoch = self._fetch_page()
             except FETCH_ERRORS as exc:
                 self.breaker.record(False)
                 self._note_error(str(exc))
@@ -536,7 +728,7 @@ class NodeFeed:
                 log.debug("%s: poll failed: %s", self.url, exc)
                 return
             self.breaker.record(True)
-            self.store_page(body, "poll")
+            self.store_page(body, "poll", delta_seq=seq, delta_epoch=epoch)
         finally:
             with self._lock:
                 self._inflight = False
@@ -568,15 +760,21 @@ class NodeFeed:
         from tpumon.exporter.encodings import snapshot_request
         from tpumon.exporter.grpc_service import (
             METHOD_WATCH,
-            decode_page_response,
+            decode_page_response_meta,
         )
 
-        # Ask every push to be the compact snapshot frame. An old
-        # exporter ignores the request body entirely and streams text
-        # pages — store_page's magic-prefix check is the fallback, same
-        # as the HTTP path.
-        request = snapshot_request("snapshot")
+        # Ask every push to be a delta frame (the exporter streams the
+        # full snapshot first, then changed-segment patches — fan-in
+        # bytes proportional to change rate), falling back to plain
+        # snapshot frames when delta fan-in is disabled. A delta-aware
+        # exporter with delta DISABLED degrades the ask to snapshot
+        # frames server-side; a genuinely old exporter streams text
+        # pages — observed below, the ask downgrades to "snapshot"
+        # (which PR 8-era exporters speak) and the stream redials, so a
+        # version-skewed fleet never sits on full text pages per push.
+        watch_fmt = "delta" if self.delta else "snapshot"
         while not self._stop.is_set():
+            request = snapshot_request(watch_fmt)
             # Receive cap mirrors the HTTP body cap: a hostile or
             # corrupt push stream errors out instead of ballooning RSS.
             channel = grpc.insecure_channel(
@@ -598,8 +796,38 @@ class NodeFeed:
                 with self._lock:
                     self._watch_call = stream
                 for raw in stream:
-                    page, _version = decode_page_response(raw)
-                    self.store_page(page, "watch")
+                    page, version, epoch = decode_page_response_meta(raw)
+                    outcome = self.store_page(
+                        page, "watch", delta_seq=version, delta_epoch=epoch,
+                    )
+                    if outcome == "gap":
+                        # Sequence gap mid-stream: the stream's framing
+                        # can no longer be trusted — redial; the fresh
+                        # stream's first frame is a full resync.
+                        try:
+                            stream.cancel()
+                        except Exception:
+                            log.debug(
+                                "gap-cancel failed", exc_info=True
+                            )
+                        break
+                    if outcome == "text" and watch_fmt == "delta":
+                        # Old exporter: it answered the delta ask with
+                        # full text pages. Downgrade this feed's ask to
+                        # the snapshot frame it does speak and redial.
+                        watch_fmt = "snapshot"
+                        log.info(
+                            "%s: upstream does not speak the delta "
+                            "protocol; downgrading watch to snapshot "
+                            "frames", self.grpc_addr,
+                        )
+                        try:
+                            stream.cancel()
+                        except Exception:
+                            log.debug(
+                                "downgrade-cancel failed", exc_info=True
+                            )
+                        break
                     with self._lock:
                         self.watch_state = "streaming"
                     self.backoff.reset()

@@ -345,6 +345,128 @@ def merge_buckets(buckets: list[dict]) -> dict:
     return doc
 
 
+class IncrementalRollup:
+    """Churn-proportional rollup: recompute only the buckets a change
+    touches (ROADMAP item 3 — at 10k feeds × 1 Hz, re-rolling the world
+    each cycle IS the fan-in wall once the wire is deltas).
+
+    Structure: a node belongs to exactly one (pool, slice) bucket.
+    Slice buckets re-aggregate from their member nodes only when a
+    member's content/state/membership changed; pool docs merge their
+    slices' docs (``merge_buckets`` — the exact math the cross-shard
+    global row already uses, so mergeability is a proven property, not
+    a new one); the fleet doc merges the pool docs. A cycle with zero
+    dirty nodes reuses every cached doc wholesale.
+
+    Dirtiness comes from the per-feed ``content_seq`` (bumped only when
+    rollup-relevant content changed — an idle node's heartbeat never
+    dirties) plus the age-derived ingest state, which CAN change with no
+    delta arriving (fresh→stale→dark), so the per-cycle cost floor is
+    one integer/str compare per feed — not one re-aggregation.
+
+    Single-threaded by contract (the collect loop); the docs it returns
+    are shared read-only with serving threads and are REPLACED on
+    recompute, never mutated in place.
+    """
+
+    def __init__(self) -> None:
+        #: target -> (content_seq, state) — the change fingerprint.
+        self._node_key: dict[str, tuple[int, str]] = {}
+        #: target -> (pool, slice) bucket membership.
+        self._node_bucket: dict[str, tuple[str, str]] = {}
+        #: bucket -> {target: (snap, state)} current members.
+        self._members: dict[tuple[str, str], dict[str, tuple]] = {}
+        #: bucket -> cached _Agg.to_dict() doc.
+        self._slice_docs: dict[tuple[str, str], dict] = {}
+        #: pool -> cached merged doc.
+        self._pool_docs: dict[str, dict] = {}
+        self._fleet_doc: dict = _Agg().to_dict()
+        self._fleet_doc["slices"] = 0
+        self._fleet_doc["pools"] = 0
+        #: Last update's churn accounting (telemetry).
+        self.last_dirty_nodes = 0
+        self.last_dirty_buckets = 0
+
+    def update(self, entries: list[tuple[str, dict | None, str, int]]) -> dict:
+        """One cycle: ``entries`` is ``[(target, snap|None, state,
+        content_seq), ...]`` for every feed this shard currently owns.
+        Returns the same doc shape as :func:`rollup`."""
+        dirty: set[tuple[str, str]] = set()
+        dirty_nodes = 0
+        seen: set[str] = set()
+        for target, snap, state, content_seq in entries:
+            seen.add(target)
+            key = (content_seq, state)
+            if self._node_key.get(target) == key:
+                continue
+            dirty_nodes += 1
+            self._node_key[target] = key
+            snap = snap or {}
+            ident = snap.get("identity") or {}
+            bucket = (
+                ident.get("accelerator") or UNKNOWN_POOL,
+                ident.get("slice") or UNKNOWN_SLICE,
+            )
+            prev_bucket = self._node_bucket.get(target)
+            if prev_bucket is not None and prev_bucket != bucket:
+                members = self._members.get(prev_bucket)
+                if members is not None:
+                    members.pop(target, None)
+                dirty.add(prev_bucket)
+            self._node_bucket[target] = bucket
+            self._members.setdefault(bucket, {})[target] = (snap, state)
+            dirty.add(bucket)
+        # Feeds that left this shard (membership change / takeover
+        # hand-back) leave their buckets too — adopted-elsewhere nodes
+        # must never stay counted here, or a takeover double-counts.
+        for target in list(self._node_key):
+            if target in seen:
+                continue
+            dirty_nodes += 1
+            del self._node_key[target]
+            bucket = self._node_bucket.pop(target, None)
+            if bucket is not None:
+                members = self._members.get(bucket)
+                if members is not None:
+                    members.pop(target, None)
+                dirty.add(bucket)
+        dirty_pools: set[str] = set()
+        for bucket in dirty:
+            members = self._members.get(bucket)
+            if not members:
+                self._members.pop(bucket, None)
+                self._slice_docs.pop(bucket, None)
+            else:
+                agg = _Agg()
+                for snap, state in members.values():
+                    agg.add_node(snap, state)
+                self._slice_docs[bucket] = agg.to_dict()
+            dirty_pools.add(bucket[0])
+        if dirty:
+            for pool in dirty_pools:
+                docs = [
+                    doc for (p, _s), doc in self._slice_docs.items()
+                    if p == pool
+                ]
+                if docs:
+                    self._pool_docs[pool] = merge_buckets(docs)
+                else:
+                    self._pool_docs.pop(pool, None)
+            fleet = merge_buckets(list(self._pool_docs.values()))
+            fleet["slices"] = len(self._slice_docs)
+            fleet["pools"] = len(self._pool_docs)
+            self._fleet_doc = fleet
+        self.last_dirty_nodes = dirty_nodes
+        self.last_dirty_buckets = len(dirty)
+        # Fresh top-level dict per cycle (callers attach "global" etc.);
+        # the bucket docs inside are shared, read-only.
+        return {
+            "slices": dict(self._slice_docs),
+            "pools": dict(self._pool_docs),
+            "fleet": self._fleet_doc,
+        }
+
+
 #: (family, help, extra labels beyond scope/pool/slice) — the builder
 #: below and the FLEET_FAMILIES registry (tpumon/families.py) must agree;
 #: the family-drift rule and tests/test_fleet.py hold them together.
@@ -568,6 +690,7 @@ def jsonable(doc: dict) -> dict:
 
 __all__ = [
     "DARK",
+    "IncrementalRollup",
     "STALE",
     "UP",
     "classify",

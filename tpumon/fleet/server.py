@@ -38,11 +38,11 @@ from tpumon.fleet.config import FleetConfig
 from tpumon.fleet.ingest import NodeFeed
 from tpumon.fleet.rollup import (
     DARK,
+    IncrementalRollup,
     classify,
     fleet_families,
     jsonable,
     merge_buckets,
-    rollup,
     visibility_of,
 )
 
@@ -96,6 +96,47 @@ class FleetTelemetry:
             "Upstream gRPC Watch fan-in streams by state (streaming / "
             "down / off; off = target rides HTTP polling).",
             labelnames=("state",),
+            registry=registry,
+        )
+        self.fanin_bytes = Counter(
+            "tpu_fleet_fanin_bytes",
+            "Accepted fan-in payload bytes by transport mode "
+            "(watch/poll) and representation kind (delta frame / full "
+            "snapshot frame / text page) — the wire-cost ledger the "
+            "delta protocol exists to shrink.",
+            labelnames=("mode", "kind"),
+            registry=registry,
+        )
+        self.fanin_frames = Counter(
+            "tpu_fleet_fanin_frames",
+            "Accepted fan-in payloads by transport mode and "
+            "representation kind; frames/bytes together give "
+            "bytes-per-frame per kind.",
+            labelnames=("mode", "kind"),
+            registry=registry,
+        )
+        self.fanin_resyncs = Counter(
+            "tpu_fleet_fanin_resyncs",
+            "Full-snapshot frames that REPLACED live delta base state, "
+            "by cause (gap = sequence mismatch forced it, epoch = "
+            "upstream restarted, full = upstream chose a resync: "
+            "pruned base, periodic Watch resync, or patch outgrew the "
+            "snapshot). A fleet-wide rate spike here is a resync storm "
+            "(docs/OPERATIONS.md).",
+            labelnames=("reason",),
+            registry=registry,
+        )
+        self.rollup_dirty_nodes = Gauge(
+            "tpu_fleet_rollup_dirty_nodes",
+            "Feeds whose rollup-relevant content or ingest state "
+            "changed last collect cycle — the observed churn the "
+            "incremental rollup's work is proportional to.",
+            registry=registry,
+        )
+        self.rollup_dirty_buckets = Gauge(
+            "tpu_fleet_rollup_dirty_buckets",
+            "Slice buckets re-aggregated last collect cycle; every "
+            "other bucket's rollup was reused unchanged.",
             registry=registry,
         )
         self.shed = Counter(
@@ -187,8 +228,19 @@ class FleetAggregator:
         def observe_reject(reason: str) -> None:
             self.telemetry.ingest_rejects.labels(reason=reason).inc()
 
+        def observe_frame(mode: str, kind: str, nbytes: int) -> None:
+            self.telemetry.fanin_bytes.labels(mode=mode, kind=kind).inc(
+                nbytes
+            )
+            self.telemetry.fanin_frames.labels(mode=mode, kind=kind).inc()
+
+        def observe_resync(reason: str) -> None:
+            self.telemetry.fanin_resyncs.labels(reason=reason).inc()
+
         self._observe_fetch = observe_fetch
         self._observe_reject = observe_reject
+        self._observe_frame = observe_frame
+        self._observe_resync = observe_resync
 
         # Warm-restart spool: loaded BEFORE membership so a restarted
         # shard's first feeds carry last-good snapshots (flagged by
@@ -286,8 +338,10 @@ class FleetAggregator:
             )
 
         self._doc_lock = threading.Lock()
-        self._fleet_doc: dict = {"nodes": [], "fleet": {}, "slices": [], "pools": []}  # guarded-by: self._doc_lock
+        self._fleet_doc: dict = {"fleet": {}, "slices": [], "pools": []}  # guarded-by: self._doc_lock
         self._cycles = 0  # guarded-by: self._doc_lock
+        #: Churn-proportional rollup state (collect thread only).
+        self._rollup = IncrementalRollup()
 
         from tpumon.exporter.server import _SelfTelemetryPage
 
@@ -446,6 +500,9 @@ class FleetAggregator:
                         default_grpc_port=cfg.grpc_port,
                         observe_fetch=self._observe_fetch,
                         observe_reject=self._observe_reject,
+                        observe_frame=self._observe_frame,
+                        observe_resync=self._observe_resync,
+                        delta=cfg.delta,
                         max_snapshot_bytes=cfg.max_snapshot_bytes,
                         fresh_s=cfg.stale_s,
                         poll_backoff_base_s=cfg.interval,
@@ -515,6 +572,13 @@ class FleetAggregator:
             if path == "/fleet":
                 with self._doc_lock:
                     doc = self._fleet_doc
+                # Per-node entries build HERE, on demand: the collect
+                # cycle stopped paying O(fleet) dict construction per
+                # second for a document that is read a few times a
+                # minute. "now" matches the node ages so peer warm-seed
+                # math (now - age_s) stays exact.
+                now = time.time()
+                doc = {**doc, "now": now, "nodes": self._node_entries(now)}
                 body = _json_dump(doc)
             elif path == "/fleet/summary":
                 body = _json_dump(self._summary_doc())
@@ -567,10 +631,7 @@ class FleetAggregator:
 
         with self._doc_lock:
             cycles = self._cycles
-            nodes = [
-                {k: v for k, v in n.items() if k != "snap"}
-                for n in self._fleet_doc.get("nodes", [])
-            ]
+        nodes = self._node_entries(time.time(), with_snap=False)
         doc: dict = {
             "now": time.time(),
             "uptime_seconds": time.time() - self._started_at,
@@ -585,6 +646,10 @@ class FleetAggregator:
             "membership": self.membership.snapshot(),
             "peer_seeded_nodes": self._peer_seeded_count,
             "cache_version": self.cache.rendered_with_version()[1],
+            "rollup": {
+                "dirty_nodes": self._rollup.last_dirty_nodes,
+                "dirty_buckets": self._rollup.last_dirty_buckets,
+            },
         }
         if self.spool is not None:
             doc["spool"] = {
@@ -627,47 +692,94 @@ class FleetAggregator:
         steady trickle). Watch-fed feeds are skipped while their stream
         delivers — polling is the fallback, not a duplicate.
 
+        The schedule is a due-time HEAP, not a per-wake scan: the old
+        dict scan cost O(fleet) per wake with one wake per fetch —
+        O(fleet²/interval) dict reads per second, which at the 640-node
+        soak (10k-feed target regime) burned more aggregator CPU than
+        the fetches themselves. Each wake now pops only what is due
+        (O(log fleet) per fetch); departed targets are discarded lazily
+        on pop, and adopted targets are scheduled when the feeds dict
+        object identity changes (membership REPLACES the dict).
+
         Cadence is per-feed (``NodeFeed.next_poll_delay``): fresh feeds
         re-poll at the full interval, stale/dark/failing ones space out
         on a jittered backoff capped at TPUMON_FLEET_POLL_BACKOFF_MAX_S,
         and the first fresh page restores full cadence — so a dead
         slice costs its shard a trickle, and a 1000-node mass return
-        recovers jitter-spread instead of as a poll storm. Membership
-        changes land between rounds: adopted targets get a fresh phase,
-        departed ones just fall out of the schedule."""
+        recovers jitter-spread instead of as a poll storm."""
         import hashlib
+        import heapq
 
         interval = self.cfg.interval
-        next_at: dict[str, float] = {}
+        heap: list[tuple[float, str]] = []
+        #: Authoritative due time per owned target; a popped heap entry
+        #: counts only when it matches (stale entries — departed
+        #: targets, or a departed-then-readopted target whose OLD entry
+        #: still carried a backed-off due time — discard lazily, so a
+        #: re-adopted target always starts from a fresh phase).
+        next_due: dict[str, float] = {}
+        last_feeds: dict | None = None
         while not self._stop.is_set():
             feeds = self.feeds  # one consistent membership snapshot
             now = time.monotonic()
-            for target, feed in feeds.items():
-                due = next_at.get(target)
-                if due is None:
-                    digest = hashlib.md5(target.encode()).digest()
-                    phase = int.from_bytes(digest[:4], "big") / 2**32
-                    next_at[target] = now + phase * interval
-                    continue
-                if due > now:
+            if feeds is not last_feeds:
+                last_feeds = feeds
+                for target in list(next_due):
+                    if target not in feeds:
+                        del next_due[target]  # heap entry dies on pop
+                for target in feeds:
+                    if target not in next_due:
+                        digest = hashlib.md5(target.encode()).digest()
+                        phase = int.from_bytes(digest[:4], "big") / 2**32
+                        due = now + phase * interval
+                        next_due[target] = due
+                        heapq.heappush(heap, (due, target))
+            while heap and heap[0][0] <= now:
+                due, target = heapq.heappop(heap)
+                if next_due.get(target) != due:
+                    continue  # stale entry: departed or superseded
+                feed = feeds.get(target)
+                if feed is None:
+                    del next_due[target]
                     continue
                 if (
                     feed.watch_state_now() != "streaming"
                     or feed.age() > self.cfg.stale_s
                 ):
                     self._executor.submit(feed.poll)
-                    next_at[target] = now + feed.next_poll_delay(interval)
+                    next_at = now + feed.next_poll_delay(interval)
                 else:
                     # Streaming and fresh: check back next interval.
-                    next_at[target] = now + interval
-            for target in list(next_at):
-                if target not in feeds:
-                    del next_at[target]
+                    next_at = now + interval
+                next_due[target] = next_at
+                heapq.heappush(heap, (next_at, target))
             sleep = interval
-            if next_at:
-                sleep = max(0.005, min(next_at.values()) - time.monotonic())
+            if heap:
+                sleep = max(0.005, heap[0][0] - time.monotonic())
             if self._stop.wait(min(sleep, interval)):
                 return
+
+    def _node_entries(self, now: float, with_snap: bool = True) -> list[dict]:
+        """The /fleet per-node entries, built on demand (serving threads
+        and the spool/debug paths — no longer a per-collect-cycle cost)."""
+        nodes = []
+        for feed in self.feeds.values():
+            snap, fetched_at, error = feed.current()
+            age = (
+                float("inf") if fetched_at == 0.0
+                else max(0.0, now - fetched_at)
+            )
+            entry = {
+                "target": feed.target,
+                "url": feed.url,
+                "state": classify(age, self.cfg.stale_s, self.cfg.evict_s),
+                "age_s": None if age == float("inf") else round(age, 3),
+                "error": error or None,
+            }
+            if with_snap:
+                entry["snap"] = snap
+            nodes.append(entry)
+        return nodes
 
     def _collect_cycle(self) -> dict:
         from tpumon.trace import trace_span
@@ -681,25 +793,22 @@ class FleetAggregator:
                 state = feed.watch_state_now()
                 watch_states[state] = watch_states.get(state, 0) + 1
         with trace_span("rollup"):
-            nodes = []
+            # Churn-proportional cycle: the per-feed scan is one lock +
+            # one age compare each (the unavoidable O(fleet) floor);
+            # everything heavier — bucket re-aggregation, family
+            # construction for changed values, render — tracks how many
+            # feeds actually CHANGED (content_seq) or crossed an ingest
+            # state boundary.
+            entries = []
             for feed in feeds:
-                snap, fetched_at, error = feed.current()
+                snap, fetched_at, _error, content_seq = feed.current_entry()
                 age = (
                     float("inf") if fetched_at == 0.0
                     else max(0.0, now - fetched_at)
                 )
                 state = classify(age, self.cfg.stale_s, self.cfg.evict_s)
-                nodes.append(
-                    {
-                        "target": feed.target,
-                        "url": feed.url,
-                        "state": state,
-                        "age_s": None if age == float("inf") else round(age, 3),
-                        "error": error or None,
-                        "snap": snap,
-                    }
-                )
-            doc = rollup(nodes)
+                entries.append((feed.target, snap, state, content_seq))
+            doc = self._rollup.update(entries)
             membership = self.membership.snapshot()
             self._merge_peers(doc, membership)
             families = fleet_families(doc)
@@ -720,7 +829,6 @@ class FleetAggregator:
             },
             "membership": membership,
             **jsonable(doc),
-            "nodes": nodes,
         }
         with self._doc_lock:
             self._fleet_doc = fleet_doc
@@ -728,6 +836,8 @@ class FleetAggregator:
         t = self.telemetry
         t.collect_duration.observe(time.monotonic() - t0)
         t.up.set(1.0)
+        t.rollup_dirty_nodes.set(float(self._rollup.last_dirty_nodes))
+        t.rollup_dirty_buckets.set(float(self._rollup.last_dirty_buckets))
         for state, n in watch_states.items():
             t.watch_streams.labels(state=state).set(float(n))
         t.membership_targets.labels(source=membership["source"]).set(
@@ -737,7 +847,7 @@ class FleetAggregator:
             t.peer_up.labels(peer=str(index)).set(
                 1.0 if peer["alive"] else 0.0
             )
-        self._maybe_spool(now, nodes)
+        self._maybe_spool(now)
         self._selfpage.refresh()
         return fleet_doc
 
@@ -776,14 +886,15 @@ class FleetAggregator:
         merged["shards"] = self.cfg.shard_count
         doc["global"] = merged
 
-    def _maybe_spool(self, now: float, nodes: list[dict]) -> None:
+    def _maybe_spool(self, now: float) -> None:
         """Journal last-good snapshots on the spool cadence (off the
         collect thread — the executor absorbs the serialize+fsync).
         One save in flight at a time: overlapping saves could land
         their os.replace out of order and regress the journal to older
         data (SnapshotSpool is single-writer by contract). A save still
         running at the next cadence tick just defers it — the retry
-        happens on the following cycle."""
+        happens on the following cycle. Entries build here, once per
+        spool cadence, not once per collect cycle."""
         if self.spool is None or now - self._spool_last_save < self.cfg.spool_every_s:
             return
         if self._spool_saving:
@@ -791,11 +902,11 @@ class FleetAggregator:
         self._spool_saving = True
         self._spool_last_save = now
         universe = self.membership.universe()
-        entries = {
-            n["target"]: {"snap": n["snap"], "fetched_at": now - n["age_s"]}
-            for n in nodes
-            if n["snap"] is not None and n["age_s"] is not None
-        }
+        entries = {}
+        for target, feed in self.feeds.items():
+            snap, fetched_at, _error = feed.current()
+            if snap is not None and fetched_at > 0.0:
+                entries[target] = {"snap": snap, "fetched_at": fetched_at}
 
         def save() -> None:
             try:

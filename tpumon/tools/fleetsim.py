@@ -32,7 +32,16 @@ Fault scripting over stdin (the fleet-chaos vocabulary,
 - ``flap N`` — membership flapping: the victims toggle between
   partitioned and healthy on every page tick (the churn-debounce and
   breaker-thrash shape).
+- ``churn F`` — set the per-tick content-churn fraction (``--churn``):
+  only F of the live nodes take new backend state each tick, the rest
+  heartbeat with unchanged content — the mostly-idle fleet shape the
+  delta fan-in protocol is benchmarked against.
 - ``heal`` — clear partition/slow/corrupt/flap (killed nodes stay dead).
+
+Exposition: each node serves text (default), the compact snapshot
+frame, or sequence-numbered delta frames (conditional GET via the
+X-Tpumon-Delta-* headers) through the SAME negotiate()/DeltaHistory
+code the real exporter uses — the sim cannot drift from the protocol.
 
 Run standalone:
     python -m tpumon.tools.fleetsim --nodes 64
@@ -50,17 +59,27 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 #: Nodes per simulated slice (8 hosts ≈ a v4-64 pod's host count).
 SLICE_SIZE = 8
 
+#: Delta-protocol header names (mirrors tpumon/exporter/encodings.py;
+#: literal here so the handler class needs no per-request import).
+_DELTA_SEQ_HEADER = "X-Tpumon-Delta-Seq"
+_DELTA_BASE_HEADER = "X-Tpumon-Delta-Base"
+
 
 def _corrupt_payload(serial: int) -> bytes:
-    """Alternating hostile payloads for ``corrupt`` nodes: a snapshot
+    """Rotating hostile payloads for ``corrupt`` nodes: a snapshot
     frame whose length prefix claims ~1 TB (the aggregator must reject
-    it BEFORE allocating — tpu_fleet_ingest_rejects_total{bad_frame})
-    and undecodable binary garbage (…{undecodable})."""
+    it BEFORE allocating — tpu_fleet_ingest_rejects_total{bad_frame}),
+    a DELTA frame with the same terabyte length prefix (the delta
+    decode path owns the identical pre-allocation cap), and undecodable
+    binary garbage (…{undecodable})."""
     from tpumon.backends.reflection import _encode_varint
-    from tpumon.exporter.encodings import SNAPSHOT_MAGIC
+    from tpumon.exporter.encodings import DELTA_MAGIC, SNAPSHOT_MAGIC
 
-    if serial % 2 == 0:
+    variant = serial % 3
+    if variant == 0:
         return SNAPSHOT_MAGIC + _encode_varint(1 << 40) + b"\x00" * 64
+    if variant == 1:
+        return DELTA_MAGIC + _encode_varint(1 << 40) + b"\x00" * 64
     return b"\xff\xfe" * 128
 
 
@@ -72,9 +91,11 @@ class FleetSim:
     def __init__(
         self, nodes: int, topology: str = "v4-8",
         node_interval: float = 1.0, addr: str = "127.0.0.1",
+        churn: float = 1.0,
     ) -> None:
         from tpumon.backends.fake import FakeTpuBackend
         from tpumon.config import Config
+        from tpumon.exporter.encodings import DeltaHistory
 
         self.nodes = nodes
         self.node_interval = node_interval
@@ -92,6 +113,24 @@ class FleetSim:
         self._flap: set[int] = set()  # guarded-by: self._lock
         self._flap_phase = False  # guarded-by: self._lock
         self._corrupt_serial = 0  # guarded-by: self._lock
+        #: Fraction of live nodes whose CONTENT advances per tick (the
+        #: churn-rate dial the delta-fan-in soak A/Bs against). Idle
+        #: nodes still refresh their poll timestamp every tick — the
+        #: heartbeat — so they read fresh, just unchanged.
+        self._churn = max(0.0, min(1.0, churn))  # guarded-by: self._lock
+        self._churn_cursor = 0  # ticker thread only
+        self._tick_no = 0  # ticker thread only
+        #: Per-node identity-rewritten page template (no timestamp
+        #: stamp); idle nodes reuse theirs across ticks. Ticker only.
+        self._templates: dict[int, str] = {}
+        #: Per-node rollup content (snapshot minus last_poll_ts);
+        #: ticker thread only.
+        self._contents: dict[int, dict] = {}
+        #: Per-node delta-protocol server state (seq history + frame
+        #: cache + epoch) — the same class the real exporter serves
+        #: from, so the sim's wire behavior cannot drift from the
+        #: protocol. Thread-safe internally.
+        self._delta = [DeltaHistory() for _ in range(nodes)]
         self._stop = threading.Event()
         self.tick()  # pages exist before the first request can land
 
@@ -124,10 +163,27 @@ class FleetSim:
                     time.sleep(delay)
                 if corrupt:
                     body = _corrupt_payload(serial)
-                self.send_response(200)
-                self.send_header(
-                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                    self._respond(
+                        body, "text/plain; version=0.0.4; charset=utf-8"
+                    )
+                    return
+                payload, content_type, seq_header = sim._negotiated(
+                    i, self.headers.get("Accept", ""),
+                    self.headers.get(_DELTA_BASE_HEADER, ""),
                 )
+                self._respond(
+                    body if payload is None else payload,
+                    content_type, seq_header,
+                )
+
+            def _respond(
+                self, body: bytes, content_type: str,
+                seq_header: str | None = None,
+            ) -> None:
+                self.send_response(200)
+                self.send_header("Content-Type", content_type)
+                if seq_header is not None:
+                    self.send_header(_DELTA_SEQ_HEADER, seq_header)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -155,10 +211,18 @@ class FleetSim:
     # -- page generation ---------------------------------------------------
 
     def tick(self) -> None:
-        """Advance the fake backend one step and rewrite every live
-        node's page with its own identity + a fresh poll timestamp."""
+        """Advance the fake backend one step, rewrite the CHURNING
+        nodes' content, and refresh every live node's poll timestamp.
+
+        At churn < 1.0 only a rotating fraction of live nodes takes the
+        new backend state; the rest keep their previous content and
+        just heartbeat — which is what a mostly-idle production fleet
+        looks like to the fan-in tier, and exactly the regime where the
+        delta protocol's bytes/node collapses to the heartbeat frame."""
         from tpumon._native import render_families
         from tpumon.exporter.collector import build_families
+        from tpumon.exporter.encodings import encode_snapshot
+        from tpumon.fleet.ingest import node_snapshot_from_text
 
         self._backend.advance()
         families, _stats = build_families(self._backend, self._cfg)
@@ -170,14 +234,41 @@ class FleetSim:
         )
         with self._lock:
             frozen = set(self._frozen)
+            churn = self._churn
+        self._tick_no += 1
+        live = [i for i in range(self.nodes) if i not in frozen]
+        churners: set[int] = set()
+        if live:
+            k = int(round(churn * len(live)))
+            # Rotate the churn window so partial churn spreads across
+            # the fleet instead of re-mutating the same nodes forever.
+            for j in range(k):
+                churners.add(live[(self._churn_cursor + j) % len(live)])
+            self._churn_cursor = (self._churn_cursor + k) % len(live)
+        # One parse of the shared template per tick; per-node content is
+        # the parse with its identity patched (equivalent to parsing the
+        # node's own page — slice/host only surface via accelerator_info).
+        base_content: dict | None = None
         pages = {}
-        for i in range(self.nodes):
-            if i in frozen:
-                continue
-            page = template.replace(
-                self._orig_slice, f'slice="sim-{i // SLICE_SIZE}"'
-            ).replace(self._orig_host, f'host="node-{i}"')
-            pages[i] = (page + stamp).encode()
+        for i in live:
+            if i in churners or i not in self._templates:
+                self._templates[i] = template.replace(
+                    self._orig_slice, f'slice="sim-{i // SLICE_SIZE}"'
+                ).replace(self._orig_host, f'host="node-{i}"')
+                if base_content is None:
+                    base_content = node_snapshot_from_text(template)
+                content = dict(base_content)
+                content["identity"] = {
+                    **base_content.get("identity", {}),
+                    "slice": f"sim-{i // SLICE_SIZE}",
+                    "host": f"node-{i}",
+                }
+                self._contents[i] = content
+            pages[i] = (self._templates[i] + stamp).encode()
+            snap = {**self._contents[i], "last_poll_ts": now}
+            self._delta[i].record(
+                (self._tick_no,), snap, encode_snapshot(snap)
+            )
         with self._lock:
             for i, body in pages.items():
                 self._pages[i] = body
@@ -189,6 +280,52 @@ class FleetSim:
                     self._partitioned |= self._flap
                 else:
                     self._partitioned -= self._flap
+
+    def _negotiated(
+        self, i: int, accept: str, base_raw: str,
+    ) -> tuple[bytes | None, str, str | None]:
+        """(payload, content type, seq header) for one request: delta /
+        snapshot consumers get protocol frames from the node's
+        DeltaHistory; everyone else gets ``(None, text type, None)`` —
+        serve the text page. A frozen node's history stays frozen, so
+        its delta consumers receive empty heartbeat-less patches whose
+        applied snapshot AGES — the zombie shape, honest on every
+        encoding."""
+        from tpumon.exporter.encodings import (
+            CONTENT_TYPES,
+            FORMAT_DELTA,
+            FORMAT_SNAPSHOT,
+            FORMAT_TEXT,
+            negotiate,
+        )
+
+        text_type = CONTENT_TYPES[FORMAT_TEXT]
+        fmt = negotiate(
+            accept, (FORMAT_TEXT, FORMAT_SNAPSHOT, FORMAT_DELTA)
+        )
+        if fmt not in (FORMAT_DELTA, FORMAT_SNAPSHOT):
+            return None, text_type, None
+        hist = self._delta[i]
+        base = None
+        if fmt == FORMAT_DELTA and base_raw:
+            epoch_s, _, seq_s = base_raw.partition(":")
+            try:
+                if int(epoch_s) == hist.epoch:
+                    base = int(seq_s)
+            except ValueError:
+                base = None
+        out = hist.frame_from(base if fmt == FORMAT_DELTA else None)
+        if out is None:
+            return None, text_type, None  # pre-first-tick race
+        payload, seq, kind = out
+        return payload, CONTENT_TYPES[kind], f"{hist.epoch}:{seq}"
+
+    def set_churn(self, fraction: float) -> list[str]:
+        """Set the per-tick content-churn fraction (0.0-1.0)."""
+        with self._lock:
+            self._churn = max(0.0, min(1.0, fraction))
+            value = self._churn
+        return [f"churn set to {value:g}"]
 
     def _run(self) -> None:
         while not self._stop.wait(self.node_interval):
@@ -288,15 +425,19 @@ def main(argv=None) -> int:
     parser.add_argument("--node-interval", type=float, default=1.0,
                         help="page-advance cadence seconds")
     parser.add_argument("--addr", default="127.0.0.1")
+    parser.add_argument("--churn", type=float, default=1.0,
+                        help="fraction of live nodes whose content "
+                        "advances per tick (idle nodes heartbeat only)")
     args = parser.parse_args(argv)
     sim = FleetSim(
         args.nodes, topology=args.topology,
         node_interval=args.node_interval, addr=args.addr,
+        churn=args.churn,
     )
     print("PORTS " + " ".join(str(p) for p in sim.ports), flush=True)
     try:
         # Control protocol: "kill N" / "partition N" / "slow N MS" /
-        # "corrupt N" / "flap N" / "heal" / "quit".
+        # "corrupt N" / "flap N" / "churn F" / "heal" / "quit".
         for line in sys.stdin:
             parts = line.split()
             if not parts:
@@ -315,6 +456,8 @@ def main(argv=None) -> int:
                     out = sim.corrupt(int(parts[1]))
                 elif cmd == "flap" and len(parts) == 2:
                     out = sim.flap(int(parts[1]))
+                elif cmd == "churn" and len(parts) == 2:
+                    out = sim.set_churn(float(parts[1]))
                 elif cmd == "heal" and len(parts) == 1:
                     out = sim.heal()
                 else:

@@ -16,3 +16,65 @@ def quantile(sorted_samples: list[float], q: float) -> float:
     """Nearest-rank quantile over an ascending-sorted non-empty list."""
     n = len(sorted_samples)
     return sorted_samples[min(max(int(n * q) - 1, 0), n - 1)]
+
+
+def fanin_stats(page: str) -> dict:
+    """The fan-in wire ledger off one aggregator /metrics page: bytes
+    and frames per (transport mode, representation kind), resyncs by
+    reason, and the collect-duration sum/count pair. One parser shared
+    by the fleet-delta soak, bench extras, and tests, so every
+    bytes-per-node-per-cycle figure in the evidence records is the same
+    arithmetic over the same counters."""
+    import re
+
+    out: dict = {"bytes": {}, "frames": {}, "resyncs": {}}
+    for metric, slot in (
+        ("tpu_fleet_fanin_bytes_total", "bytes"),
+        ("tpu_fleet_fanin_frames_total", "frames"),
+    ):
+        for kind, mode, value in re.findall(
+            r'^%s\{kind="([^"]+)",mode="([^"]+)"\} (\S+)' % metric,
+            page, re.M,
+        ):
+            out[slot][f"{mode}/{kind}"] = float(value)
+    for reason, value in re.findall(
+        r'^tpu_fleet_fanin_resyncs_total\{reason="([^"]+)"\} (\S+)',
+        page, re.M,
+    ):
+        out["resyncs"][reason] = float(value)
+    for field in ("sum", "count"):
+        m = re.search(
+            r"^tpu_fleet_collect_duration_seconds_%s (\S+)" % field,
+            page, re.M,
+        )
+        out[f"collect_{field}"] = float(m.group(1)) if m else 0.0
+    return out
+
+
+def fanin_window(before: dict, after: dict) -> dict:
+    """Deltas between two :func:`fanin_stats` reads: per-slot byte and
+    frame counts plus mean collect-cycle milliseconds over the window."""
+    bytes_d = {
+        slot: after["bytes"].get(slot, 0.0) - before["bytes"].get(slot, 0.0)
+        for slot in after["bytes"]
+    }
+    frames_d = {
+        slot: after["frames"].get(slot, 0.0)
+        - before["frames"].get(slot, 0.0)
+        for slot in after["frames"]
+    }
+    cycles = after["collect_count"] - before["collect_count"]
+    seconds = after["collect_sum"] - before["collect_sum"]
+    return {
+        "bytes": {k: v for k, v in bytes_d.items() if v},
+        "frames": {k: v for k, v in frames_d.items() if v},
+        "resyncs": {
+            reason: after["resyncs"].get(reason, 0.0)
+            - before["resyncs"].get(reason, 0.0)
+            for reason in after["resyncs"]
+        },
+        "collect_cycles": cycles,
+        "collect_ms_per_cycle": (
+            round(1e3 * seconds / cycles, 3) if cycles else None
+        ),
+    }

@@ -1374,7 +1374,10 @@ def restore_storm_soak(
     }
 
 
-def _spawn_fleetsim(nodes: int, topology: str, node_interval: float):
+def _spawn_fleetsim(
+    nodes: int, topology: str, node_interval: float,
+    churn: float | None = None,
+):
     """One ``tools/fleetsim.py`` subprocess simulating ``nodes`` exporter
     endpoints. A separate process (own GIL) so simulation work never
     shares the aggregator's interpreter; a SINGLE process because N real
@@ -1382,12 +1385,15 @@ def _spawn_fleetsim(nodes: int, topology: str, node_interval: float):
     noise (measured: upstream response p50 ~50 ms of pure process-wakeup
     latency with 64 children on 2 cores — the tier under test was idle).
     Returns (proc, urls)."""
+    cmd = [
+        sys.executable, "-m", "tpumon.tools.fleetsim",
+        "--nodes", str(nodes), "--topology", topology,
+        "--node-interval", str(node_interval),
+    ]
+    if churn is not None:
+        cmd += ["--churn", str(churn)]
     proc = subprocess.Popen(
-        [
-            sys.executable, "-m", "tpumon.tools.fleetsim",
-            "--nodes", str(nodes), "--topology", topology,
-            "--node-interval", str(node_interval),
-        ],
+        cmd,
         stdin=subprocess.PIPE,
         stdout=subprocess.PIPE,
         stderr=subprocess.DEVNULL,
@@ -1579,6 +1585,341 @@ def fleet_soak(
         "dark_flagged_scrapes": dark_seen,
         "collect_cycles": cycles,
         "final_hosts": final_hosts,
+    }
+
+
+def fleet_delta_soak(
+    duration_s: float,
+    nodes: int = 640,
+    topology: str = "v4-8",
+    interval: float = 1.0,
+    scrape_every_s: float = 1.0,
+    churn: float = 0.02,
+    churn_high: float = 0.5,
+    kill: int = 32,
+    node_interval: float | None = None,
+) -> dict:
+    """Delta fan-in acceptance soak (ROADMAP item 3, ISSUE 13): ``nodes``
+    simulated exporters (10× the PR 6 64-node evidence at the default
+    640) behind one aggregator shard negotiating the delta protocol.
+
+    Phases:
+
+    1. **idle** — content churn ``churn`` (default 2%): steady-state
+       fan-in bytes/node/cycle and collect-cycle CPU with the fleet
+       mostly heartbeating.
+    2. **churn** — the sim dials content churn to ``churn_high``: the
+       same measurements, so the record shows CPU/bytes tracking change
+       rate on the same box, same fleet size.
+    3. **honesty** — ``kill`` nodes die (half zombie, half
+       listener-down), then a partition+heal wave forces mid-stream
+       reconnects and pruned-base resyncs: every scrape is checked for
+       fabricated freshness (up-count above truly-live is a violation),
+       and the kill must land as stale/dark flags.
+    4. **controls** (after the main aggregator closes): a delta-on
+       shard over a quarter-size subset (same churn — the
+       flat-as-idle-fleet-grows evidence) and a delta-OFF shard over
+       the full fleet (the full-snapshot-per-fetch baseline the ≤10%
+       bytes gate divides against).
+    """
+    from tpumon.fleet.config import FleetConfig
+    from tpumon.fleet.server import build_aggregator
+    from tpumon.tools.measure import fanin_stats, fanin_window
+
+    if duration_s <= 0:
+        raise ValueError(f"duration must be > 0 seconds, got {duration_s}")
+    if node_interval is None:
+        node_interval = interval
+    kill = max(0, min(kill, nodes // 2))
+    stale_s = max(2.0, 3.0 * interval, 2.5 * node_interval)
+
+    sim_proc = None
+    aggs: list = []
+    lat_ms: list[float] = []
+    failed_scrapes = 0
+    honesty_violations = 0
+    prev_switch = sys.getswitchinterval()
+
+    def mk_agg(targets: list[str], delta: bool = True):
+        agg = build_aggregator(
+            FleetConfig(
+                port=0, addr="127.0.0.1", targets=",".join(targets),
+                interval=interval, stale_s=stale_s,
+                evict_s=max(duration_s * 2, 240.0), delta=delta,
+            )
+        )
+        agg.start()
+        aggs.append(agg)
+        return agg
+
+    def close_agg(agg) -> None:
+        agg.close()
+        aggs.remove(agg)
+
+    def scrape(agg) -> str | None:
+        nonlocal failed_scrapes
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", agg.server.port, timeout=10
+        )
+        try:
+            t0 = time.perf_counter()
+            conn.request("GET", "/metrics")
+            body = conn.getresponse().read()
+            lat_ms.append((time.perf_counter() - t0) * 1e3)
+            return body.decode()
+        except (OSError, http.client.HTTPException):
+            failed_scrapes += 1
+            return None
+        finally:
+            conn.close()
+
+    def hosts_of(page: str) -> dict:
+        stats = _page_stats(page.encode())
+        return {
+            "up": stats["up"] or 0.0,
+            "stale": stats["stale"] or 0.0,
+            "dark": stats["dark"] or 0.0,
+            "visibility": stats["visibility"],
+        }
+
+    def warm(agg, want_up: int, deadline_s: float) -> float:
+        t0 = time.time()
+        while time.time() - t0 < deadline_s:
+            page = scrape(agg)
+            if page and (hosts_of(page)["up"] or 0) >= want_up:
+                return round(time.time() - t0, 1)
+            time.sleep(max(0.25, interval / 2))
+        return round(time.time() - t0, 1)
+
+    def stats_retrying(agg, attempts: int = 3) -> dict:
+        """fanin_stats off a page that actually parsed: a transient
+        failed scrape at a window boundary must not zero (or overstate)
+        a phase's byte/counter deltas."""
+        for _ in range(attempts):
+            page = scrape(agg)
+            if page:
+                return fanin_stats(page)
+            time.sleep(scrape_every_s)
+        return fanin_stats("")
+
+    def measure(agg, window_s: float, live: int, check_honesty=False):
+        nonlocal honesty_violations
+        before = stats_retrying(agg)
+        after = before
+        dirty_samples: list[float] = []
+        t0 = time.time()
+        next_at = t0
+        while time.time() - t0 < window_s:
+            next_at += scrape_every_s
+            time.sleep(max(0.0, next_at - time.time()))
+            page = scrape(agg)
+            if not page:
+                continue
+            after = fanin_stats(page)  # last SUCCESSFUL read wins
+            m = re.search(
+                r"^tpu_fleet_rollup_dirty_nodes (\S+)", page, re.M
+            )
+            if m:
+                dirty_samples.append(float(m.group(1)))
+            if check_honesty:
+                h = hosts_of(page)
+                if h["up"] > live:
+                    honesty_violations += 1  # fabricated freshness
+        window = fanin_window(before, after)
+        elapsed = max(0.001, time.time() - t0)
+        cycles = elapsed / interval
+        total_bytes = sum(window["bytes"].values())
+        window["bytes_per_node_cycle"] = (
+            round(total_bytes / (max(1, live) * cycles), 1)
+        )
+        frames = window["frames"]
+        delta_frames = sum(
+            v for k, v in frames.items() if k.endswith("/delta")
+        )
+        window["delta_frame_share"] = (
+            round(delta_frames / sum(frames.values()), 4)
+            if frames else None
+        )
+        # Deterministic churn signal: feeds whose rollup-relevant
+        # content changed per cycle (the collect wall-clock means above
+        # are scheduler-sensitive on small shared boxes; this is not).
+        window["dirty_nodes_mean"] = (
+            round(sum(dirty_samples) / len(dirty_samples), 1)
+            if dirty_samples else None
+        )
+        return window
+
+    def sim_cmd(command: str, expect_lines: int) -> None:
+        sim_proc.stdin.write(command + "\n")
+        sim_proc.stdin.flush()
+        for _ in range(expect_lines):
+            sim_proc.stdout.readline()  # deadline: fleetsim answers each control line immediately (outer `timeout` bounds the job)
+
+    try:
+        if not os.environ.get("TPUMON_KEEP_SWITCH_INTERVAL"):
+            sys.setswitchinterval(min(prev_switch, 0.0005))
+        sim_proc, urls = _spawn_fleetsim(
+            nodes, topology, node_interval, churn=churn,
+        )
+        agg = mk_agg(urls, delta=True)
+        warmup_s = warm(agg, nodes, max(90.0, nodes * 0.2))
+
+        phase_idle = measure(agg, duration_s * 0.3, nodes)
+        sim_cmd(f"churn {churn_high}", 1)
+        time.sleep(2 * node_interval)  # let the new churn rate land
+        phase_churn = measure(agg, duration_s * 0.3, nodes)
+        sim_cmd(f"churn {churn}", 1)
+
+        # -- honesty: kills, then partition + heal (reconnect/resync) --
+        kill_t0 = time.time()
+        sim_cmd(f"kill {kill}", kill)
+        live = nodes - kill
+        settle = stale_s + 2 * interval + 2 * node_interval + 2.0
+        deadline = time.time() + max(settle * 3, duration_s * 0.2)
+        flagged = None
+        while time.time() < deadline:
+            time.sleep(scrape_every_s)
+            page = scrape(agg)
+            if not page:
+                continue
+            h = hosts_of(page)
+            # Dead nodes legitimately read "up" until their last-good
+            # data ages past stale_s; fabricated freshness is an
+            # up-count above truly-live AFTER the settle window.
+            if time.time() - kill_t0 >= settle and h["up"] > live:
+                honesty_violations += 1
+            if h["stale"] + h["dark"] >= kill and h["up"] <= live:
+                flagged = h
+                break
+        kill_flags_correct = flagged is not None
+        partition = max(1, min(64, live // 8))
+        sim_cmd(f"partition {partition}", partition)
+        time.sleep(settle)
+        page = scrape(agg)
+        partition_visibility = (
+            hosts_of(page)["visibility"] if page else None
+        )
+        resync_before = stats_retrying(agg)["resyncs"]
+        resync_after = resync_before
+        sim_cmd("heal", 1)
+        recovered = False
+        # The recovery envelope must cover the adaptive backoff the
+        # partition escalated (jittered, doubling toward the cap):
+        # mass return is DESIGNED to spread, not to storm back at once.
+        deadline = time.time() + max(settle * 3, duration_s * 0.2) + 60.0
+        while time.time() < deadline:
+            time.sleep(scrape_every_s)
+            page = scrape(agg)
+            if not page:
+                continue
+            resync_after = fanin_stats(page)["resyncs"]
+            h = hosts_of(page)
+            if h["up"] > live:
+                honesty_violations += 1
+            if h["up"] >= live:
+                recovered = True
+                break
+        recovery_resyncs = {
+            reason: resync_after.get(reason, 0.0)
+            - resync_before.get(reason, 0.0)
+            for reason in resync_after
+        }
+        close_agg(agg)
+
+        # -- controls: quarter-size subset (delta) + snapshot baseline --
+        control_s = min(30.0, max(10 * interval, duration_s * 0.25))
+        subset = urls[-max(nodes // 4, 1):]
+        agg_sub = mk_agg(subset, delta=True)
+        warm(agg_sub, len(subset), max(60.0, len(subset) * 0.2))
+        control_subset = measure(agg_sub, control_s, len(subset))
+        close_agg(agg_sub)
+
+        agg_snap = mk_agg(urls, delta=False)
+        warm(agg_snap, live, max(90.0, nodes * 0.2))
+        control_snapshot = measure(agg_snap, control_s, live)
+        close_agg(agg_snap)
+    finally:
+        for agg in list(aggs):
+            try:
+                agg.close()
+            except Exception:
+                pass
+        if sim_proc is not None:
+            sim_proc.terminate()
+            try:
+                sim_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                sim_proc.kill()
+        sys.setswitchinterval(prev_switch)
+
+    lat_ms.sort()
+
+    def _q(p: float):
+        return round(quantile(lat_ms, p), 3) if lat_ms else None
+
+    delta_bpnc = phase_idle["bytes_per_node_cycle"]
+    snap_bpnc = control_snapshot["bytes_per_node_cycle"]
+    idle_ms = phase_idle["collect_ms_per_cycle"]
+    churn_ms = phase_churn["collect_ms_per_cycle"]
+    subset_ms = control_subset["collect_ms_per_cycle"]
+    return {
+        "mode": "fleet-delta",
+        "nodes": nodes,
+        "topology": topology,
+        "node_interval_s": node_interval,
+        "churn_low": churn,
+        "churn_high": churn_high,
+        "killed": kill,
+        "warmup_s": warmup_s,
+        "phases": {
+            "idle": phase_idle,
+            "churn": phase_churn,
+            "subset_idle": control_subset,
+            "snapshot_idle": control_snapshot,
+        },
+        "fanin": {
+            #: Steady-state wire cost per node per collect cycle, delta
+            #: protocol at low churn vs the full-snapshot baseline —
+            #: the ≤10% acceptance gate.
+            "delta_idle_bytes_per_node_cycle": delta_bpnc,
+            "snapshot_bytes_per_node_cycle": snap_bpnc,
+            "delta_vs_snapshot_ratio": (
+                round(delta_bpnc / snap_bpnc, 4) if snap_bpnc else None
+            ),
+            "delta_frame_share_idle": phase_idle["delta_frame_share"],
+        },
+        "cpu": {
+            #: Collect-cycle mean ms per phase: churn scaling on one
+            #: box (churn/idle should be >1) and fleet-size scaling at
+            #: constant churn (full/subset should be << size ratio —
+            #: "flat as idle node count grows").
+            "idle_ms_per_cycle": idle_ms,
+            "churn_ms_per_cycle": churn_ms,
+            "subset_idle_ms_per_cycle": subset_ms,
+            "snapshot_idle_ms_per_cycle": (
+                control_snapshot["collect_ms_per_cycle"]
+            ),
+            "churn_scaling": (
+                round(churn_ms / idle_ms, 2)
+                if churn_ms and idle_ms else None
+            ),
+            "size_scaling_vs_4x_nodes": (
+                round(idle_ms / subset_ms, 2)
+                if idle_ms and subset_ms else None
+            ),
+        },
+        "honesty": {
+            "violations": honesty_violations,
+            "kill_flags_correct": kill_flags_correct,
+            "final_kill_flags": flagged,
+            "partition_visibility": partition_visibility,
+            "healed_recovered": recovered,
+            "recovery_resyncs": recovery_resyncs,
+        },
+        "scrapes": len(lat_ms),
+        "failed_scrapes": failed_scrapes,
+        "p50_ms": _q(0.5),
+        "p99_ms": _q(0.99),
     }
 
 
@@ -2106,6 +2447,21 @@ def main(argv=None) -> int:
                         "aggregator restart (spool warm start); reports "
                         "visibility honesty, takeover windows, ingest "
                         "rejects, and restart latency")
+    parser.add_argument("--fleet-delta", action="store_true",
+                        help="delta fan-in acceptance soak (ISSUE 13): "
+                        "--fleet-nodes simulated exporters behind one "
+                        "delta-negotiating shard; idle vs churn phases, "
+                        "kill + partition/heal honesty checks, then a "
+                        "quarter-size and a delta-off control — reports "
+                        "fan-in bytes/node/cycle, delta-vs-snapshot "
+                        "ratio, collect-CPU churn/size scaling, and "
+                        "resync accounting")
+    parser.add_argument("--fleet-churn", type=float, default=0.02,
+                        help="steady-state content churn fraction for "
+                        "--fleet-delta's idle phases")
+    parser.add_argument("--fleet-churn-high", type=float, default=0.5,
+                        help="churn fraction for --fleet-delta's "
+                        "high-churn phase")
     parser.add_argument("--fleet-takeover-s", type=float, default=None,
                         help="peer takeover deadline for --fleet-chaos "
                         "(default: max(2, 4*interval))")
@@ -2146,6 +2502,13 @@ def main(argv=None) -> int:
         record = straggler_soak(
             args.duration, topology=args.topology,
             interval=args.interval, scrape_every_s=args.scrape_every,
+        )
+    elif args.fleet_delta:
+        record = fleet_delta_soak(
+            args.duration, nodes=args.fleet_nodes, topology=args.topology,
+            interval=args.interval, scrape_every_s=args.scrape_every,
+            churn=args.fleet_churn, churn_high=args.fleet_churn_high,
+            kill=args.fleet_kill, node_interval=args.fleet_node_interval,
         )
     elif args.fleet_chaos:
         record = fleet_chaos_soak(

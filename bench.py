@@ -579,6 +579,101 @@ def measure_gzip_cost(page: bytes, iterations: int = 30) -> float:
     return round(p50, 3)
 
 
+def measure_ledger(
+    hours: float = 26.0, series: int = 16, cadence_s: float = 1.0
+) -> dict:
+    """Ledger compression density (tpumon/ledger): a ≥24 h simulated
+    horizon of realistic gauge random walks through the real tiered
+    store, reporting bytes per RAW-SAMPLE-EQUIVALENT per tier — the
+    5 min tier's figure is the acceptance gate (≤ 0.15 B/sample/series:
+    a coarse bucket's ~3 compressed stat points stand for 300 raw
+    seconds). Byte budgets are lifted so the number measures the codec,
+    not the retention policy."""
+    import random
+
+    from tpumon.ledger.compress import native_codec
+    from tpumon.ledger.store import TieredSeriesStore, default_tiers
+
+    rng = random.Random(99)
+    store = TieredSeriesStore(
+        default_tiers(max_bytes_total=1 << 30)
+    )
+    keys = [
+        ("tpu_fleet_duty_cycle_percent", "slice", "v5p", f"s{i}")
+        for i in range(series)
+    ]
+    values = dict.fromkeys(keys, 50.0)
+    t0 = 1_700_000_000.0
+    n = int(hours * 3600.0 / cadence_s)
+    started = time.perf_counter()
+    for i in range(n):
+        for key in keys:
+            values[key] = min(
+                100.0, max(0.0, values[key] + rng.gauss(0.0, 0.5))
+            )
+        store.record(t0 + i * cadence_s, values)
+    ingest_s = time.perf_counter() - started
+    store.flush()
+    stats = store.stats()
+    out: dict = {
+        "series": series,
+        "hours": hours,
+        "native_codec": native_codec() is not None,
+        "ingest_samples_per_s": round(n * series / ingest_s),
+        "dropped_chunks": stats["dropped_chunks"],
+    }
+    gate_value = None
+    for tier in stats["tiers"]:
+        buckets = tier["sealed_samples"]
+        raw_equiv = buckets * max(1.0, tier["resolution_s"] / cadence_s)
+        per_sample = (
+            round(tier["sealed_bytes"] / raw_equiv, 4) if raw_equiv else None
+        )
+        out[f"tier_{tier['name']}"] = {
+            "sealed_bytes": tier["sealed_bytes"],
+            "sealed_buckets": buckets,
+            "bytes_per_raw_sample": per_sample,
+        }
+        if tier["name"] == "5m":
+            gate_value = per_sample
+    out["gate_5m_bytes_per_raw_sample"] = gate_value
+    out["gate_budget"] = 0.15
+    out["gate_pass"] = gate_value is not None and gate_value <= 0.15
+    return out
+
+
+def measure_subdelta(page_text: str) -> dict:
+    """Sub-segment delta economics (PR 13 follow-up): the common
+    one-chip-jitter frame, whole-segment vs per-chip patch, on a real
+    node snapshot."""
+    from tpumon.exporter.encodings import (
+        encode_delta,
+        snapshot_delta,
+        snapshot_delta_sub,
+    )
+    from tpumon.fleet.ingest import node_snapshot_from_text
+
+    prev = node_snapshot_from_text(page_text)
+    if not prev.get("chips"):
+        return {"skipped": "page carries no chips"}
+    cur = {k: v for k, v in prev.items()}
+    chip, row = next(iter(prev["chips"].items()))
+    cur["chips"] = {
+        **prev["chips"],
+        chip: {**row, "duty_pct": (row.get("duty_pct") or 0.0) + 1.5},
+    }
+    changed, dropped = snapshot_delta(prev, cur)
+    full = encode_delta(2, 1, changed, dropped)
+    sch, sdr, subs = snapshot_delta_sub(prev, cur)
+    sub = encode_delta(2, 1, sch, sdr, subs)
+    return {
+        "chips": len(prev["chips"]),
+        "one_chip_jitter_frame_bytes": len(full),
+        "one_chip_jitter_sub_frame_bytes": len(sub),
+        "sub_vs_full_ratio": round(len(sub) / len(full), 3),
+    }
+
+
 def probe_compiled_kernel(timeout_s: float = 300.0) -> dict:
     """Run the flash kernel compiled on the real TPU, in a subprocess.
 
@@ -680,6 +775,7 @@ def main() -> int:
         gzip_cost = measure_gzip_cost(page)
         fanin = measure_fanin(page.decode())
         fanin_delta = measure_fanin_delta(page.decode())
+        subdelta = measure_subdelta(page.decode())
         http_p50, http_p99 = _best_of(
             lambda: measure_http_client(exporter.server.port)
         )
@@ -696,6 +792,10 @@ def main() -> int:
     # Incremental-rollup churn microbench: CPU-bound, runs after the
     # latency loops so it can't pollute their tails.
     rollup_churn = measure_rollup_churn()
+
+    # Ledger compression density over a 26 h simulated horizon — the
+    # ISSUE 14 acceptance gate (5 min tier ≤ 0.15 B/raw-sample/series).
+    ledger = measure_ledger()
 
     # Control run with the delta renderer off: full per-cycle render +
     # per-scrape encodes — the r05-and-earlier publish stage. Output
@@ -748,7 +848,9 @@ def main() -> int:
                     "encodings": encodings,
                     "fanin": fanin,
                     "fanin_delta": fanin_delta,
+                    "subdelta": subdelta,
                     "rollup_churn": rollup_churn,
+                    "ledger": ledger,
                     "sustained": sustained,
                 },
             )

@@ -795,3 +795,252 @@ def test_aggregator_delta_off_rides_snapshots():
         if agg is not None:
             agg.close()
         sim.close()
+
+
+# -- sub-segment (per-chip) deltas (PR 13 follow-up) ------------------------
+
+
+def _chips(n: int, duty: float = 50.0) -> dict:
+    return {
+        str(i): {
+            "duty_pct": duty + i, "coords": f"{i},0,0",
+            "hbm_used": 1.0e9, "hbm_total": 2.0e9,
+        }
+        for i in range(n)
+    }
+
+
+def test_snapshot_delta_sub_equivalence_randomized():
+    """Applying the sub frame and the whole-segment frame must land on
+    the same snapshot for ANY mutation mix (value change, chip added,
+    chip dropped, whole-segment replace)."""
+    from tpumon.exporter.encodings import snapshot_delta_sub
+
+    rng = random.Random(11)
+    prev = {
+        "identity": {"slice": "s"}, "chips": _chips(8),
+        "last_poll_ts": 1.0,
+    }
+    for step in range(60):
+        cur = {**prev, "last_poll_ts": prev["last_poll_ts"] + 1.0}
+        chips = {k: dict(v) for k, v in prev["chips"].items()}
+        op = rng.random()
+        if op < 0.5 and chips:  # one-chip jitter (the common frame)
+            chip = rng.choice(list(chips))
+            chips[chip]["duty_pct"] = rng.random() * 100.0
+        elif op < 0.7:
+            chips[str(100 + step)] = {"duty_pct": 1.0}  # chip appears
+        elif op < 0.9 and len(chips) > 1:
+            del chips[rng.choice(list(chips))]  # chip detaches
+        else:
+            chips = _chips(rng.randint(1, 12), duty=rng.random() * 90)
+        cur["chips"] = chips
+        changed, dropped = snapshot_delta(prev, cur)
+        full = apply_delta(prev, decode_delta(
+            encode_delta(step + 2, step + 1, changed, dropped)
+        ))
+        sch, sdr, subs = snapshot_delta_sub(prev, cur)
+        via_sub = apply_delta(prev, decode_delta(
+            encode_delta(step + 2, step + 1, sch, sdr, subs)
+        ))
+        assert full == via_sub == cur, step
+        prev = cur
+
+
+def test_sub_delta_frame_shrinks_one_chip_jitter():
+    """The motivating frame: ONE chip's gauge moved on an 8-chip node.
+    Whole-segment deltas re-ship every chip's row; the sub frame ships
+    one chip — pinned well under half the size."""
+    from tpumon.exporter.encodings import snapshot_delta_sub
+
+    prev = {"identity": {"slice": "s"}, "chips": _chips(8),
+            "last_poll_ts": 100.0}
+    cur = {**prev, "last_poll_ts": 101.0,
+           "chips": {**prev["chips"],
+                     "3": {**prev["chips"]["3"], "duty_pct": 61.5}}}
+    changed, dropped = snapshot_delta(prev, cur)
+    full_frame = encode_delta(2, 1, changed, dropped)
+    sch, sdr, subs = snapshot_delta_sub(prev, cur)
+    sub_frame = encode_delta(2, 1, sch, sdr, subs)
+    assert len(sub_frame) < len(full_frame) / 2, (
+        len(sub_frame), len(full_frame)
+    )
+    assert "chips" not in sch and "chips" in subs
+    assert list(subs["chips"]["set"]) == ["3"]
+
+
+@pytest.mark.parametrize("sub", [
+    {"chips": "not a patch"},
+    {"chips": {"set": "nope"}},
+    {"chips": {"set": {}, "drop": [1, 2]}},
+    "not an object",
+])
+def test_decode_delta_rejects_malformed_sub(sub):
+    import json as _json
+
+    from tpumon.exporter.encodings import DELTA_MAGIC
+    from tpumon.backends.reflection import _encode_varint
+
+    payload = _json.dumps(
+        {"seq": 2, "base": 1, "set": {}, "drop": [], "sub": sub}
+    ).encode()
+    frame = DELTA_MAGIC + _encode_varint(len(payload)) + payload
+    with pytest.raises(ValueError):
+        decode_delta(frame)
+
+
+def test_delta_history_sub_capability_keyed_per_consumer():
+    """Two consumers at the same (base, seq) transition — one
+    sub-capable, one not — must each get the right frame shape: the
+    cache is keyed on the capability, so a sub frame can never be
+    served to a consumer whose apply_delta would ignore it."""
+    prev = {"identity": {"slice": "s"}, "chips": _chips(6),
+            "last_poll_ts": 1.0}
+    cur = {**prev, "last_poll_ts": 2.0,
+           "chips": {**prev["chips"],
+                     "2": {**prev["chips"]["2"], "duty_pct": 99.0}}}
+    history = DeltaHistory()
+    history.record((1, 0), prev, encode_snapshot(prev))
+    history.record((2, 0), cur, encode_snapshot(cur))
+    sub_payload, seq_a, kind_a = history.frame_from(1, sub=True)
+    plain_payload, seq_b, kind_b = history.frame_from(1)
+    assert seq_a == seq_b
+    assert kind_a == "delta"
+    sub_doc = decode_delta(sub_payload)
+    assert "sub" in sub_doc and "chips" in sub_doc["sub"]
+    if kind_b == "delta":  # plain may self-limit to the full snapshot
+        plain_doc = decode_delta(plain_payload)
+        assert "sub" not in plain_doc
+        assert apply_delta(prev, plain_doc) == apply_delta(prev, sub_doc)
+    # Cached round: same shapes again (no cross-capability poisoning).
+    sub2, _, _ = history.frame_from(1, sub=True)
+    assert sub2 == sub_payload
+
+
+def test_requested_format_meta_sub_field():
+    from tpumon.exporter.encodings import (
+        requested_format,
+        requested_format_meta,
+        snapshot_request,
+    )
+
+    assert requested_format_meta(snapshot_request("delta", sub=True)) == (
+        "delta", True
+    )
+    assert requested_format_meta(snapshot_request("delta")) == (
+        "delta", False
+    )
+    # Old clients (no field 2) and old servers (requested_format) are
+    # both inert to the capability.
+    assert requested_format(snapshot_request("delta", sub=True)) == "delta"
+    assert requested_format_meta(b"") == ("text", False)
+    assert requested_format_meta(b"\xff\xff\xff") == ("text", False)
+
+
+def test_accept_delta_sub_parsing():
+    from tpumon.exporter.encodings import accept_delta_sub
+
+    assert accept_delta_sub(
+        f"{DELTA_CONTENT_TYPE};sub=1, text/plain;q=0.5"
+    )
+    assert accept_delta_sub(f"{DELTA_CONTENT_TYPE}; sub=1; q=0.9")
+    assert not accept_delta_sub(f"{DELTA_CONTENT_TYPE}, text/plain")
+    assert not accept_delta_sub("text/plain;sub=1")
+    assert not accept_delta_sub("")
+
+
+def test_http_sub_delta_negotiation(exporter):
+    """The conditional-GET path: an Accept advertising ;sub=1 gets
+    per-chip patches; the plain delta Accept gets whole-segment frames
+    — and both apply to the same state."""
+    import http.client
+
+    port = exporter.server.port
+
+    def fetch(base=None, sub=False):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        try:
+            accept = DELTA_CONTENT_TYPE + (";sub=1" if sub else "")
+            headers = {"Accept": accept}
+            if base is not None:
+                headers[DELTA_BASE_HEADER] = base
+            conn.request("GET", "/metrics", headers=headers)
+            resp = conn.getresponse()
+            return resp.read(), resp.getheader(DELTA_SEQ_HEADER)
+        finally:
+            conn.close()
+
+    body, seq_hdr = fetch(sub=True)
+    assert is_snapshot(body)  # no base: full resync either way
+    state = decode_snapshot(body)
+    _wait_for(
+        lambda: exporter.cache.rendered_with_version()[1]
+        > int(seq_hdr.split(":")[1])
+    )
+    body2, _seq2 = fetch(base=seq_hdr, sub=True)
+    assert is_delta(body2)
+    doc = decode_delta(body2)
+    # The fake backend jitters chip gauges every poll: the chips
+    # segment moved, and with sub negotiated it travels as a sub patch.
+    assert "chips" in doc.get("sub", {}), doc
+    assert "chips" not in doc["set"]
+    patched = apply_delta(state, doc)
+    assert patched.get("chips")
+
+
+def test_grpc_watch_sub_delta_stream(exporter):
+    grpc = pytest.importorskip("grpc")
+    from tpumon.exporter.encodings import snapshot_request
+    from tpumon.exporter.grpc_service import (
+        METHOD_WATCH,
+        decode_page_response,
+    )
+
+    addr = f"127.0.0.1:{exporter.grpc_server.port}"
+    channel = grpc.insecure_channel(addr)
+    try:
+        call = channel.unary_stream(
+            METHOD_WATCH, request_serializer=None,
+            response_deserializer=None,
+        )
+        stream = call(snapshot_request("delta", sub=True), timeout=30)
+        frames = []
+        try:
+            for raw in stream:
+                frames.append(decode_page_response(raw))
+                if len(frames) >= 4:
+                    break
+        finally:
+            stream.cancel()
+    finally:
+        channel.close()
+    assert is_snapshot(frames[0][0])
+    state = decode_snapshot(frames[0][0])
+    last_seq = frames[0][1]
+    saw_sub = False
+    for payload, seq in frames[1:]:
+        assert is_delta(payload)
+        doc = decode_delta(payload)
+        assert doc["base"] == last_seq
+        saw_sub = saw_sub or "sub" in doc
+        state = apply_delta(state, doc)
+        last_seq = seq
+    assert saw_sub, "sub-capable watch never received a sub patch"
+    assert state.get("chips")
+
+
+def test_feed_applies_sub_delta_frames():
+    feed = _feed()
+    base = {"identity": {"host": "n0"}, "chips": _chips(4)}
+    assert feed.store_page(
+        encode_snapshot(base), "watch", delta_seq=5
+    ) == "ok"
+    patch = encode_delta(
+        6, 5, {}, [],
+        {"chips": {"set": {"1": {"duty_pct": 88.0}}, "drop": ["3"]}},
+    )
+    assert feed.store_page(patch, "watch", delta_seq=6) == "ok"
+    snap, _, _ = feed.current()
+    assert snap["chips"]["1"]["duty_pct"] == 88.0
+    assert "3" not in snap["chips"]
+    assert snap["chips"]["0"] == base["chips"]["0"]  # untouched rows kept

@@ -1,0 +1,436 @@
+/* Gorilla chunk codec (CPython C extension).
+ *
+ * The fleet ledger (tpumon/ledger/compress.py) seals immutable chunks
+ * of (timestamp, value) samples with delta-of-delta integer timestamps
+ * and XOR-compressed IEEE doubles. This module is the fast path for
+ * encode/decode; tpumon/_native/__init__.py builds it on demand and the
+ * pure-Python codec in compress.py is the always-available fallback.
+ *
+ * CONTRACT: output bytes are identical to encode_chunk_py for every
+ * input (pinned by tests/test_ledger.py). Any format change lands in
+ * BOTH implementations or not at all.
+ *
+ *   encode(timestamps: list[int], values: list[float]) -> bytes
+ *   decode(data: bytes) -> (list[int], list[float])
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+typedef struct {
+    char *buf;
+    Py_ssize_t len;
+    Py_ssize_t cap;
+} gbuf;
+
+static int gb_reserve(gbuf *b, Py_ssize_t extra) {
+    if (b->len + extra <= b->cap) return 0;
+    Py_ssize_t ncap = b->cap ? b->cap : 4096;
+    while (ncap < b->len + extra) ncap *= 2;
+    char *nbuf = PyMem_Realloc(b->buf, ncap);
+    if (!nbuf) return -1;
+    b->buf = nbuf;
+    b->cap = ncap;
+    return 0;
+}
+
+static int gb_byte(gbuf *b, unsigned char c) {
+    if (gb_reserve(b, 1) < 0) return -1;
+    b->buf[b->len++] = (char)c;
+    return 0;
+}
+
+static int put_varint(gbuf *b, uint64_t v) {
+    while (1) {
+        unsigned char byte = v & 0x7F;
+        v >>= 7;
+        if (v) {
+            if (gb_byte(b, byte | 0x80) < 0) return -1;
+        } else {
+            return gb_byte(b, byte);
+        }
+    }
+}
+
+/* MSB-first bit writer (mirrors compress.py _BitWriter). */
+typedef struct {
+    gbuf *out;
+    uint64_t acc;
+    int nbits;
+} bitw;
+
+static int bw_write(bitw *w, uint64_t value, int nbits) {
+    /* nbits <= 64; keep the accumulator under 72 bits by draining. */
+    if (nbits < 64) value &= (((uint64_t)1 << nbits) - 1);
+    while (nbits > 0) {
+        int take = nbits > 32 ? 32 : nbits;
+        uint64_t part = (take < 64)
+            ? (value >> (nbits - take)) & (((uint64_t)1 << take) - 1)
+            : value;
+        w->acc = (w->acc << take) | part;
+        w->nbits += take;
+        nbits -= take;
+        while (w->nbits >= 8) {
+            w->nbits -= 8;
+            if (gb_byte(w->out,
+                        (unsigned char)((w->acc >> w->nbits) & 0xFF)) < 0)
+                return -1;
+        }
+        if (w->nbits > 0)
+            w->acc &= (((uint64_t)1 << w->nbits) - 1);
+        else
+            w->acc = 0;
+    }
+    return 0;
+}
+
+static int bw_flush(bitw *w) {
+    if (w->nbits) {
+        unsigned char byte =
+            (unsigned char)((w->acc << (8 - w->nbits)) & 0xFF);
+        w->nbits = 0;
+        w->acc = 0;
+        return gb_byte(w->out, byte);
+    }
+    return 0;
+}
+
+static int clz64(uint64_t x) {
+#if defined(__GNUC__) || defined(__clang__)
+    return x ? __builtin_clzll(x) : 64;
+#else
+    int n = 0;
+    if (!x) return 64;
+    while (!(x & ((uint64_t)1 << 63))) { x <<= 1; n++; }
+    return n;
+#endif
+}
+
+static int ctz64(uint64_t x) {
+#if defined(__GNUC__) || defined(__clang__)
+    return x ? __builtin_ctzll(x) : 64;
+#else
+    int n = 0;
+    if (!x) return 64;
+    while (!(x & 1)) { x >>= 1; n++; }
+    return n;
+#endif
+}
+
+static uint64_t dbl_bits(double d) {
+    uint64_t u;
+    memcpy(&u, &d, 8);
+    return u;
+}
+
+static double bits_dbl(uint64_t u) {
+    double d;
+    memcpy(&d, &u, 8);
+    return d;
+}
+
+static PyObject *g_encode(PyObject *self, PyObject *args) {
+    PyObject *ts_list, *val_list;
+    if (!PyArg_ParseTuple(args, "OO", &ts_list, &val_list)) return NULL;
+    ts_list = PySequence_Fast(ts_list, "timestamps must be a sequence");
+    if (!ts_list) return NULL;
+    val_list = PySequence_Fast(val_list, "values must be a sequence");
+    if (!val_list) { Py_DECREF(ts_list); return NULL; }
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(ts_list);
+    if (n != PySequence_Fast_GET_SIZE(val_list)) {
+        PyErr_SetString(PyExc_ValueError,
+                        "timestamp/value length mismatch");
+        goto fail;
+    }
+    gbuf out = {NULL, 0, 0};
+    if (put_varint(&out, (uint64_t)n) < 0) goto nomem;
+    if (n == 0) goto done;
+
+    {
+        long long ts0 = PyLong_AsLongLong(
+            PySequence_Fast_GET_ITEM(ts_list, 0));
+        if (ts0 == -1 && PyErr_Occurred()) goto fail_free;
+        if (ts0 < 0) {
+            PyErr_SetString(PyExc_ValueError, "negative timestamp");
+            goto fail_free;
+        }
+        double v0 = PyFloat_AsDouble(PySequence_Fast_GET_ITEM(val_list, 0));
+        if (v0 == -1.0 && PyErr_Occurred()) goto fail_free;
+        if (put_varint(&out, (uint64_t)ts0) < 0) goto nomem;
+        uint64_t b0 = dbl_bits(v0);
+        if (gb_reserve(&out, 8) < 0) goto nomem;
+        for (int k = 7; k >= 0; k--)
+            out.buf[out.len++] = (char)((b0 >> (k * 8)) & 0xFF);
+        if (n == 1) goto done;
+
+        bitw bw = {&out, 0, 0};
+        int64_t prev_ts = ts0;
+        int64_t prev_delta = 0;
+        uint64_t prev_bits = b0;
+        int win_lead = -1;
+        int win_len = 0;
+        for (Py_ssize_t i = 1; i < n; i++) {
+            long long tsll = PyLong_AsLongLong(
+                PySequence_Fast_GET_ITEM(ts_list, i));
+            if (tsll == -1 && PyErr_Occurred()) goto fail_free;
+            int64_t ts = (int64_t)tsll;
+            int64_t delta = ts - prev_ts;
+            int64_t dod = delta - prev_delta;
+            prev_ts = ts;
+            prev_delta = delta;
+            if (dod == 0) {
+                if (bw_write(&bw, 0, 1) < 0) goto nomem;
+            } else if (dod >= -63 && dod <= 64) {
+                if (bw_write(&bw, 2, 2) < 0) goto nomem;
+                if (bw_write(&bw, (uint64_t)(dod + 63), 7) < 0) goto nomem;
+            } else if (dod >= -255 && dod <= 256) {
+                if (bw_write(&bw, 6, 3) < 0) goto nomem;
+                if (bw_write(&bw, (uint64_t)(dod + 255), 9) < 0) goto nomem;
+            } else if (dod >= -2047 && dod <= 2048) {
+                if (bw_write(&bw, 14, 4) < 0) goto nomem;
+                if (bw_write(&bw, (uint64_t)(dod + 2047), 12) < 0)
+                    goto nomem;
+            } else {
+                if (bw_write(&bw, 15, 4) < 0) goto nomem;
+                if (bw_write(&bw, (uint64_t)dod, 64) < 0) goto nomem;
+            }
+            double v = PyFloat_AsDouble(
+                PySequence_Fast_GET_ITEM(val_list, i));
+            if (v == -1.0 && PyErr_Occurred()) goto fail_free;
+            uint64_t vb = dbl_bits(v);
+            uint64_t xor = vb ^ prev_bits;
+            prev_bits = vb;
+            if (xor == 0) {
+                if (bw_write(&bw, 0, 1) < 0) goto nomem;
+                continue;
+            }
+            if (bw_write(&bw, 1, 1) < 0) goto nomem;
+            int lead = clz64(xor);
+            if (lead > 31) lead = 31;
+            int trail = ctz64(xor);
+            if (win_lead >= 0 && lead >= win_lead
+                && trail >= 64 - win_lead - win_len) {
+                if (bw_write(&bw, 0, 1) < 0) goto nomem;
+                if (bw_write(&bw, xor >> (64 - win_lead - win_len),
+                             win_len) < 0)
+                    goto nomem;
+            } else {
+                int length = 64 - lead - trail;
+                if (bw_write(&bw, 1, 1) < 0) goto nomem;
+                if (bw_write(&bw, (uint64_t)lead, 5) < 0) goto nomem;
+                if (bw_write(&bw, (uint64_t)(length - 1), 6) < 0)
+                    goto nomem;
+                if (bw_write(&bw, xor >> trail, length) < 0) goto nomem;
+                win_lead = lead;
+                win_len = length;
+            }
+        }
+        if (bw_flush(&bw) < 0) goto nomem;
+    }
+done: {
+        PyObject *res = PyBytes_FromStringAndSize(out.buf, out.len);
+        PyMem_Free(out.buf);
+        Py_DECREF(ts_list);
+        Py_DECREF(val_list);
+        return res;
+    }
+nomem:
+    PyErr_NoMemory();
+fail_free:
+    PyMem_Free(out.buf);
+fail:
+    Py_DECREF(ts_list);
+    Py_DECREF(val_list);
+    return NULL;
+}
+
+/* MSB-first bit reader (mirrors compress.py _BitReader). */
+typedef struct {
+    const unsigned char *data;
+    Py_ssize_t len;
+    Py_ssize_t idx;
+    uint64_t acc;
+    int nbits;
+} bitr;
+
+static int br_read(bitr *r, int nbits, uint64_t *out) {
+    uint64_t value = 0;
+    int want = nbits;
+    while (want > 0) {
+        int take = want > 32 ? 32 : want;
+        while (r->nbits < take) {
+            if (r->idx >= r->len) {
+                PyErr_SetString(PyExc_ValueError,
+                                "truncated chunk bitstream");
+                return -1;
+            }
+            r->acc = (r->acc << 8) | r->data[r->idx++];
+            r->nbits += 8;
+        }
+        r->nbits -= take;
+        uint64_t part = (r->acc >> r->nbits) & (((uint64_t)1 << take) - 1);
+        if (r->nbits > 0)
+            r->acc &= (((uint64_t)1 << r->nbits) - 1);
+        else
+            r->acc = 0;
+        value = (take < 64) ? ((value << take) | part) : part;
+        want -= take;
+    }
+    *out = value;
+    return 0;
+}
+
+static int get_varint(const unsigned char *data, Py_ssize_t len,
+                      Py_ssize_t *idx, uint64_t *out) {
+    uint64_t result = 0;
+    int shift = 0;
+    while (1) {
+        if (*idx >= len) {
+            PyErr_SetString(PyExc_ValueError, "truncated varint");
+            return -1;
+        }
+        unsigned char byte = data[(*idx)++];
+        result |= ((uint64_t)(byte & 0x7F)) << shift;
+        if (!(byte & 0x80)) { *out = result; return 0; }
+        shift += 7;
+        if (shift > 70) {
+            PyErr_SetString(PyExc_ValueError, "oversized varint");
+            return -1;
+        }
+    }
+}
+
+static PyObject *g_decode(PyObject *self, PyObject *args) {
+    Py_buffer view;
+    if (!PyArg_ParseTuple(args, "y*", &view)) return NULL;
+    const unsigned char *data = view.buf;
+    Py_ssize_t len = view.len;
+    Py_ssize_t idx = 0;
+    PyObject *ts_list = NULL, *val_list = NULL, *res = NULL;
+    uint64_t n;
+    if (get_varint(data, len, &idx, &n) < 0) goto out;
+    if (n > ((uint64_t)1 << 30)) {
+        PyErr_SetString(PyExc_ValueError,
+                        "implausible chunk sample count");
+        goto out;
+    }
+    ts_list = PyList_New((Py_ssize_t)n);
+    val_list = PyList_New((Py_ssize_t)n);
+    if (!ts_list || !val_list) goto out;
+    if (n == 0) goto ok;
+    uint64_t ts0;
+    if (get_varint(data, len, &idx, &ts0) < 0) goto out;
+    if (idx + 8 > len) {
+        PyErr_SetString(PyExc_ValueError, "truncated chunk header");
+        goto out;
+    }
+    uint64_t b0 = 0;
+    for (int k = 0; k < 8; k++) b0 = (b0 << 8) | data[idx++];
+    {
+        PyObject *o = PyLong_FromLongLong((long long)ts0);
+        if (!o) goto out;
+        PyList_SET_ITEM(ts_list, 0, o);
+        o = PyFloat_FromDouble(bits_dbl(b0));
+        if (!o) goto out;
+        PyList_SET_ITEM(val_list, 0, o);
+    }
+    if (n == 1) goto ok;
+    {
+        bitr br = {data, len, idx, 0, 0};
+        int64_t prev_ts = (int64_t)ts0;
+        int64_t prev_delta = 0;
+        uint64_t prev_bits = b0;
+        int win_lead = -1;
+        int win_len = 0;
+        for (uint64_t i = 1; i < n; i++) {
+            uint64_t bit, raw;
+            int64_t dod;
+            if (br_read(&br, 1, &bit) < 0) goto out;
+            if (bit == 0) {
+                dod = 0;
+            } else {
+                if (br_read(&br, 1, &bit) < 0) goto out;
+                if (bit == 0) {
+                    if (br_read(&br, 7, &raw) < 0) goto out;
+                    dod = (int64_t)raw - 63;
+                } else {
+                    if (br_read(&br, 1, &bit) < 0) goto out;
+                    if (bit == 0) {
+                        if (br_read(&br, 9, &raw) < 0) goto out;
+                        dod = (int64_t)raw - 255;
+                    } else {
+                        if (br_read(&br, 1, &bit) < 0) goto out;
+                        if (bit == 0) {
+                            if (br_read(&br, 12, &raw) < 0) goto out;
+                            dod = (int64_t)raw - 2047;
+                        } else {
+                            if (br_read(&br, 64, &raw) < 0) goto out;
+                            dod = (int64_t)raw;
+                        }
+                    }
+                }
+            }
+            prev_delta += dod;
+            prev_ts += prev_delta;
+            PyObject *o = PyLong_FromLongLong((long long)prev_ts);
+            if (!o) goto out;
+            PyList_SET_ITEM(ts_list, (Py_ssize_t)i, o);
+            if (br_read(&br, 1, &bit) < 0) goto out;
+            if (bit != 0) {
+                if (br_read(&br, 1, &bit) < 0) goto out;
+                uint64_t xor;
+                if (bit == 0) {
+                    if (win_lead < 0) {
+                        PyErr_SetString(PyExc_ValueError,
+                                        "window reuse before any window");
+                        goto out;
+                    }
+                    if (br_read(&br, win_len, &raw) < 0) goto out;
+                    xor = raw << (64 - win_lead - win_len);
+                } else {
+                    uint64_t lead, lenbits;
+                    if (br_read(&br, 5, &lead) < 0) goto out;
+                    if (br_read(&br, 6, &lenbits) < 0) goto out;
+                    win_lead = (int)lead;
+                    win_len = (int)lenbits + 1;
+                    if (win_lead + win_len > 64) {
+                        PyErr_SetString(PyExc_ValueError,
+                                        "invalid XOR window");
+                        goto out;
+                    }
+                    if (br_read(&br, win_len, &raw) < 0) goto out;
+                    xor = raw << (64 - win_lead - win_len);
+                }
+                prev_bits ^= xor;
+            }
+            o = PyFloat_FromDouble(bits_dbl(prev_bits));
+            if (!o) goto out;
+            PyList_SET_ITEM(val_list, (Py_ssize_t)i, o);
+        }
+    }
+ok:
+    res = PyTuple_Pack(2, ts_list, val_list);
+out:
+    Py_XDECREF(ts_list);
+    Py_XDECREF(val_list);
+    PyBuffer_Release(&view);
+    return res;
+}
+
+static PyMethodDef g_methods[] = {
+    {"encode", g_encode, METH_VARARGS,
+     "encode(timestamps, values) -> sealed chunk bytes"},
+    {"decode", g_decode, METH_VARARGS,
+     "decode(data) -> (timestamps, values)"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef g_module = {
+    PyModuleDef_HEAD_INIT, "_gorilla",
+    "Gorilla chunk codec (native half of tpumon/ledger/compress.py)",
+    -1, g_methods,
+};
+
+PyMODINIT_FUNC PyInit__gorilla(void) { return PyModule_Create(&g_module); }

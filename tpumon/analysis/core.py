@@ -224,6 +224,7 @@ PIPELINE_PREFIXES = (
     "tpumon/hostcorr/",
     "tpumon/lifecycle/",
     "tpumon/energy/",
+    "tpumon/ledger/",
     "tpumon/history.py",
 )
 

@@ -216,6 +216,14 @@ def decode_snapshot(data: bytes, max_bytes: int | None = None) -> dict:
 
 # -- delta frame codec ------------------------------------------------------
 
+#: Snapshot segments that are per-key-diffable maps: a capable consumer
+#: can take a SUB-delta (changed inner keys only) instead of the whole
+#: segment. ``chips`` is the one that matters — it is the largest
+#: segment on the page, and the common steady-state frame is ONE chip's
+#: gauge jittering, which used to re-ship every chip's row.
+SUB_DELTA_SEGMENTS = ("chips",)
+
+
 def snapshot_delta(prev: dict, cur: dict) -> tuple[dict, list]:
     """(changed segments, dropped keys) between two node snapshots.
 
@@ -233,15 +241,56 @@ def snapshot_delta(prev: dict, cur: dict) -> tuple[dict, list]:
     return changed, dropped
 
 
-def encode_delta(seq: int, base: int, changed: dict, dropped: list) -> bytes:
+def snapshot_delta_sub(prev: dict, cur: dict) -> tuple[dict, list, dict]:
+    """Like :func:`snapshot_delta`, but SUB_DELTA_SEGMENTS whose value
+    changed ship as per-inner-key patches: ``(changed, dropped, subs)``
+    with ``subs = {segment: {"set": {inner: value}, "drop": [inner]}}``.
+
+    Sub frames are served ONLY to consumers that advertised the
+    capability (Accept ``;sub=1`` / PageRequest.sub) — a PR 12-era
+    ``apply_delta`` would silently ignore the ``sub`` key and drift,
+    which is exactly the failure class the delta protocol exists to
+    make impossible, so capability travels with the ask, never assumed.
+    """
+    changed, dropped = snapshot_delta(prev, cur)
+    subs: dict = {}
+    for segment in SUB_DELTA_SEGMENTS:
+        value = changed.get(segment)
+        prev_value = prev.get(segment)
+        if (
+            isinstance(value, dict)
+            and isinstance(prev_value, dict)
+            and prev_value
+        ):
+            subs[segment] = {
+                "set": {
+                    k: v
+                    for k, v in value.items()
+                    if k not in prev_value or prev_value[k] != v
+                },
+                "drop": [k for k in prev_value if k not in value],
+            }
+            del changed[segment]
+    return changed, dropped, subs
+
+
+def encode_delta(
+    seq: int, base: int, changed: dict, dropped: list,
+    subs: dict | None = None,
+) -> bytes:
     """Delta frame: DELTA_MAGIC + varint payload length + canonical JSON
-    ``{"seq", "base", "set", "drop"}``. Same envelope discipline as
-    :func:`encode_snapshot` (sorted keys, tight separators, NaN tokens
-    allowed) so equal deltas encode to equal bytes and the per-(base,
-    seq) frame cache can share one encode across every consumer."""
+    ``{"seq", "base", "set", "drop"[, "sub"]}``. Same envelope
+    discipline as :func:`encode_snapshot` (sorted keys, tight
+    separators, NaN tokens allowed) so equal deltas encode to equal
+    bytes and the per-(base, seq) frame cache can share one encode
+    across every consumer. ``sub`` (sub-segment patches) is emitted
+    only when non-empty, so frames without it are byte-identical to the
+    PR 12 wire format."""
+    doc: dict = {"seq": seq, "base": base, "set": changed, "drop": dropped}
+    if subs:
+        doc["sub"] = subs
     payload = json.dumps(
-        {"seq": seq, "base": base, "set": changed, "drop": dropped},
-        sort_keys=True, separators=(",", ":"),
+        doc, sort_keys=True, separators=(",", ":"),
     ).encode()
     return DELTA_MAGIC + _encode_varint(len(payload)) + payload
 
@@ -285,6 +334,21 @@ def decode_delta(data: bytes, max_bytes: int | None = None) -> dict:
         isinstance(key, str) for key in drop
     ):
         raise ValueError("delta drop is not a list of keys")
+    subs = doc.get("sub")
+    if subs is not None:
+        if not isinstance(subs, dict):
+            raise ValueError("delta sub is not an object")
+        for segment, patch in subs.items():
+            if (
+                not isinstance(segment, str)
+                or not isinstance(patch, dict)
+                or not isinstance(patch.get("set"), dict)
+                or not isinstance(patch.get("drop", []), list)
+                or not all(
+                    isinstance(k, str) for k in patch.get("drop", [])
+                )
+            ):
+                raise ValueError("delta sub patch has wrong shape")
     return doc
 
 
@@ -293,11 +357,18 @@ def apply_delta(state: dict, delta: dict) -> dict:
 
     Returns a NEW dict (the previous snapshot object may still be
     serving readers — the fleet collect loop holds references without
-    locks, so in-place mutation would tear a rollup mid-cycle)."""
+    locks, so in-place mutation would tear a rollup mid-cycle).
+    Sub-segment patches build a NEW inner dict for the same reason."""
     merged = dict(state)
     merged.update(delta["set"])
     for key in delta.get("drop", ()):
         merged.pop(key, None)
+    for segment, patch in (delta.get("sub") or {}).items():
+        inner = dict(merged.get(segment) or {})
+        inner.update(patch["set"])
+        for key in patch.get("drop", ()):
+            inner.pop(key, None)
+        merged[segment] = inner
     return merged
 
 
@@ -330,8 +401,10 @@ class DeltaHistory:
         self._snaps: dict[int, dict] = {}  # guarded-by: self._lock
         self._key: tuple | None = None  # guarded-by: self._lock
         self._seq = 0  # guarded-by: self._lock
-        #: (base, seq) -> encoded frame; cleared as bases age out.
-        self._frames: dict[tuple[int, int], bytes] = {}  # guarded-by: self._lock
+        #: (base, seq, sub?) -> encoded frame; cleared as bases age out.
+        #: The sub flag keys the cache because the same (base, seq)
+        #: transition encodes differently for sub-capable consumers.
+        self._frames: dict[tuple[int, int, bool], bytes] = {}  # guarded-by: self._lock
         self._full: bytes | None = None  # guarded-by: self._lock
         self.epoch = int.from_bytes(_os.urandom(4), "big")
 
@@ -362,11 +435,17 @@ class DeltaHistory:
             }
             return self._seq
 
-    def frame_from(self, base: int | None) -> tuple[bytes, int, str] | None:
+    def frame_from(
+        self, base: int | None, sub: bool = False
+    ) -> tuple[bytes, int, str] | None:
         """(payload, seq, "delta"|"snapshot") against the CURRENT seq,
         or None when nothing was ever recorded. A base that is current
         returns an empty delta (heartbeat for transports that must send
-        something); an unknown/pruned base returns the full frame."""
+        something); an unknown/pruned base returns the full frame.
+        ``sub`` (consumer-advertised capability) shrinks map segments
+        to per-inner-key patches — the one-chip-jitter frame ships one
+        chip's row, not the whole chips map."""
+        sub = bool(sub)
         with self._lock:
             seq = self._seq
             full = self._full
@@ -374,7 +453,7 @@ class DeltaHistory:
                 return None
             if base is None or base not in self._snaps:
                 return full, seq, FORMAT_SNAPSHOT
-            cached = self._frames.get((base, seq))
+            cached = self._frames.get((base, seq, sub))
             if cached is not None:
                 return cached, seq, FORMAT_DELTA
             prev = self._snaps[base]
@@ -383,8 +462,12 @@ class DeltaHistory:
         # diff+encode must never block other consumers' cache hits. Two
         # racing consumers at the same (base, seq) produce identical
         # bytes; the second store is a harmless overwrite.
-        changed, dropped = snapshot_delta(prev, cur)
-        frame = encode_delta(seq, base, changed, dropped)
+        if sub:
+            changed, dropped, subs = snapshot_delta_sub(prev, cur)
+            frame = encode_delta(seq, base, changed, dropped, subs)
+        else:
+            changed, dropped = snapshot_delta(prev, cur)
+            frame = encode_delta(seq, base, changed, dropped)
         if len(frame) >= len(full):
             # The patch outgrew the resync (mass change): serve the full
             # frame — cheaper for the consumer AND self-limits delta
@@ -392,7 +475,7 @@ class DeltaHistory:
             return full, seq, FORMAT_SNAPSHOT
         with self._lock:
             if base in self._snaps and seq == self._seq:
-                self._frames[(base, seq)] = frame
+                self._frames[(base, seq, sub)] = frame
         return frame, seq, FORMAT_DELTA
 
 
@@ -495,26 +578,61 @@ def gzip_page(body: bytes) -> bytes:
     return gzip.compress(body, compresslevel=1)
 
 
-def snapshot_request(fmt: str) -> bytes:
-    """PageRequest{string format = 1} for the gRPC Get/Watch methods."""
+def snapshot_request(fmt: str, sub: bool = False) -> bytes:
+    """PageRequest{string format = 1; bool sub = 2} for the gRPC
+    Get/Watch methods. ``sub`` advertises sub-segment delta capability;
+    pre-PR 14 servers skip the unknown field per protobuf rules and
+    serve whole-segment deltas — the capability degrades, never the
+    stream."""
     data = fmt.encode()
-    return _encode_varint((1 << 3) | 2) + _encode_varint(len(data)) + data
+    out = _encode_varint((1 << 3) | 2) + _encode_varint(len(data)) + data
+    if sub:
+        out += _encode_varint((2 << 3) | 0) + _encode_varint(1)
+    return out
 
 
 def requested_format(request: bytes) -> str:
     """Parse a PageRequest's format field; empty/garbage requests mean
     text (the pre-negotiation wire shape — old clients send b"")."""
+    return requested_format_meta(request)[0]
+
+
+def requested_format_meta(request: bytes) -> tuple[str, bool]:
+    """(format, sub-delta capability) from a PageRequest. Old clients
+    never set field 2, so sub defaults False — whole-segment frames."""
     if not request:
-        return FORMAT_TEXT
+        return FORMAT_TEXT, False
+    fmt = FORMAT_TEXT
+    sub = False
     try:
         for field, wire, value in _iter_fields(request):
             if field == 1 and wire == 2:
-                fmt = value.decode("utf-8", "replace")
-                return fmt if fmt in KNOWN_FORMATS else FORMAT_TEXT
+                name = value.decode("utf-8", "replace")
+                fmt = name if name in KNOWN_FORMATS else FORMAT_TEXT
+            elif field == 2 and wire == 0:
+                sub = bool(value)
     except Exception as exc:
         # A malformed request frame negotiates down to text, never errors.
         log.debug("unparseable page request (%s); serving text", exc)
-    return FORMAT_TEXT
+        return FORMAT_TEXT, False
+    return fmt, sub
+
+
+def accept_delta_sub(accept: str) -> bool:
+    """True when an Accept header's delta entry advertises the
+    sub-segment capability (``application/vnd.tpumon.delta;sub=1``).
+    Media-type parameters are exactly where HTTP puts capability hints;
+    old servers' negotiate() ignores unknown parameters, so the ask is
+    backward-inert."""
+    for entry in accept.split(","):
+        parts = entry.split(";")
+        if parts[0].strip().lower() != DELTA_CONTENT_TYPE:
+            continue
+        for param in parts[1:]:
+            key, _, value = param.partition("=")
+            if key.strip().lower() == "sub" and value.strip() == "1":
+                return True
+    return False
 
 
 __all__ = [
@@ -525,6 +643,8 @@ __all__ = [
     "DELTA_SEQ_HEADER",
     "DeltaHistory",
     "EncodedPageCache",
+    "SUB_DELTA_SEGMENTS",
+    "accept_delta_sub",
     "FORMAT_DELTA",
     "FORMAT_OPENMETRICS",
     "FORMAT_SNAPSHOT",
@@ -547,6 +667,8 @@ __all__ = [
     "openmetrics_render",
     "parse_formats",
     "requested_format",
+    "requested_format_meta",
     "snapshot_delta",
+    "snapshot_delta_sub",
     "snapshot_request",
 ]

@@ -121,7 +121,11 @@ class MetricsGrpcServer:
         from concurrent.futures import ThreadPoolExecutor
         from contextlib import nullcontext
 
-        from tpumon.exporter.encodings import FORMAT_DELTA, requested_format
+        from tpumon.exporter.encodings import (
+            FORMAT_DELTA,
+            requested_format,
+            requested_format_meta,
+        )
 
         self._render_with_version = render_with_version
         self._cache = cache
@@ -166,7 +170,7 @@ class MetricsGrpcServer:
                 page, version = negotiated_page(request)
             return encode_page_response(page, version)
 
-        def delta_watch(context):
+        def delta_watch(context, sub=False):
             """Delta-format push loop (ROADMAP item 3): the stream's
             first frame is ALWAYS the full snapshot (a reconnecting
             consumer lands on a consistent base by construction), each
@@ -192,7 +196,7 @@ class MetricsGrpcServer:
                 ):
                     base = None  # periodic full-snapshot resync
                 with serve_span("grpc_watch_push"):
-                    payload, seq, kind = renderer.delta_frame(base)
+                    payload, seq, kind = renderer.delta_frame(base, sub=sub)
                 deltas_since_full = (
                     deltas_since_full + 1 if kind == "delta" else 0
                 )
@@ -226,8 +230,9 @@ class MetricsGrpcServer:
                         f"watcher limit ({_MAX_WATCHERS}) reached",
                     )
                 try:
+                    fmt, sub = requested_format_meta(request)
                     if (
-                        requested_format(request) == FORMAT_DELTA
+                        fmt == FORMAT_DELTA
                         and self._renderer is not None
                         # Honor TPUMON_EXPOSITION_FORMATS here too: a
                         # delta-disabled exporter must fall back to the
@@ -235,7 +240,7 @@ class MetricsGrpcServer:
                         # the knob silently stops applying to Watch.
                         and FORMAT_DELTA in self._renderer.formats
                     ):
-                        yield from delta_watch(context)
+                        yield from delta_watch(context, sub=sub)
                     else:
                         version = 0
                         while context.is_active():

@@ -969,12 +969,16 @@ class NegotiatedRenderer:
                 self._snap_state = (key, snap)
         return snap, key
 
-    def delta_frame(self, base: int | None) -> tuple[bytes, int, str]:
+    def delta_frame(
+        self, base: int | None, sub: bool = False
+    ) -> tuple[bytes, int, str]:
         """One delta-protocol payload: a patch against ``base`` when the
         history can honestly produce one (base retained AND the patch is
         smaller than a resync), else the full snapshot frame. Returns
         ``(payload, seq, kind)`` with kind ∈ delta/snapshot — shared by
-        the HTTP conditional-GET path and the gRPC Watch push loop."""
+        the HTTP conditional-GET path and the gRPC Watch push loop.
+        ``sub`` is the consumer-advertised sub-segment capability
+        (per-chip patches instead of the whole chips map)."""
         from tpumon.exporter.encodings import (
             FORMAT_DELTA,
             FORMAT_SNAPSHOT,
@@ -986,7 +990,7 @@ class NegotiatedRenderer:
             (FORMAT_SNAPSHOT, "identity"), key, lambda: encode_snapshot(node)
         )
         self.delta.record(key, node, full)
-        payload, seq, kind = self.delta.frame_from(base)
+        payload, seq, kind = self.delta.frame_from(base, sub=sub)
         if self._telemetry is not None:
             self._telemetry.exposition_requests.labels(
                 format=FORMAT_DELTA
@@ -1045,11 +1049,14 @@ class NegotiatedRenderer:
             CONTENT_TYPES,
             DELTA_BASE_HEADER,
             DELTA_SEQ_HEADER,
+            accept_delta_sub,
         )
 
         environ_key = "HTTP_" + DELTA_BASE_HEADER.upper().replace("-", "_")
         base = self._parse_base(environ.get(environ_key, ""))
-        body, seq, kind = self.delta_frame(base)
+        body, seq, kind = self.delta_frame(
+            base, sub=accept_delta_sub(environ.get("HTTP_ACCEPT", ""))
+        )
         headers = [
             ("Content-Type", CONTENT_TYPES[kind]),
             (DELTA_SEQ_HEADER, f"{self.delta.epoch}:{seq}"),

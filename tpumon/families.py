@@ -137,6 +137,14 @@ LIFECYCLE_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "detector's input",
         (),
     ),
+    "tpu_lifecycle_checkpoints_total": (
+        "counter",
+        "Checkpoint spans completed across the probed workload feeds "
+        "by op (save/restore), summed per node — the fleet goodput "
+        "ledger's checkpoint-window signal (a feed restart resets its "
+        "share; ordinary counter-reset semantics)",
+        ("op",),
+    ),
 }
 
 #: family -> (prometheus type, description, extra labels) — the
@@ -578,6 +586,69 @@ FLEET_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
     ),
 }
 
+#: family -> (prometheus type, description, extra labels) — the fleet
+#: efficiency ledger (tpumon/ledger): long-horizon tiered storage
+#: self-metrics plus the per-job goodput accounting, served on the
+#: aggregator's /metrics page beside the FLEET_FAMILIES rollups.
+LEDGER_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
+    "tpu_fleet_goodput_chip_seconds_total": (
+        "counter",
+        "Chip-seconds accounted per job (scope=slice) and fleet-wide "
+        "by goodput bucket (productive / checkpoint / restore / "
+        "preempted / idle / contended / unaccounted). Buckets sum to "
+        "observed wall-clock × chips per job; partitions and "
+        "aggregator-blind windows land in unaccounted, never in idle",
+        ("scope", "pool", "slice", "bucket"),
+    ),
+    "tpu_ledger_series": (
+        "gauge",
+        "Distinct series stored per ledger tier (1s / 10s / 5m)",
+        ("tier",),
+    ),
+    "tpu_ledger_samples_total": (
+        "counter",
+        "Samples recorded into each ledger tier since start (aggregate "
+        "tiers count finalized buckets)",
+        ("tier",),
+    ),
+    "tpu_ledger_bytes": (
+        "gauge",
+        "Sealed compressed bytes held per ledger tier (open buffers "
+        "excluded)",
+        ("tier",),
+    ),
+    "tpu_ledger_dropped_chunks_total": (
+        "counter",
+        "Sealed chunks dropped by bound (retention age / tier byte "
+        "budget) — bounded by construction, drops counted never silent",
+        ("reason",),
+    ),
+    "tpu_ledger_gap_seconds_total": (
+        "counter",
+        "Wall seconds the ledger could not observe (aggregator "
+        "restarts between spool saves): ledgered into the unaccounted "
+        "goodput bucket, never interpolated into samples",
+        (),
+    ),
+    "tpu_ledger_queries_total": (
+        "counter",
+        "GET /ledger range queries served",
+        (),
+    ),
+    "tpu_ledger_spool_errors_total": (
+        "counter",
+        "Ledger spool failures by op (load / write); the plane runs "
+        "on, memory-only (absent unless the spool is configured)",
+        ("op",),
+    ),
+    "tpu_ledger_remote_write_total": (
+        "counter",
+        "Prometheus remote-write push outcomes (result ∈ ok/error); "
+        "absent unless TPUMON_FLEET_LEDGER_REMOTE_WRITE_URL is set",
+        ("result",),
+    ),
+}
+
 #: family -> (prometheus type, description)
 SELF_FAMILIES: dict[str, tuple[str, str]] = {
     "exporter_scrape_duration_seconds": (
@@ -828,6 +899,7 @@ def all_family_names() -> set[str]:
         | set(distribution_family_rows())
         | set(SELF_FAMILIES)
         | set(FLEET_FAMILIES)
+        | set(LEDGER_FAMILIES)
         | set(WORKLOAD_FAMILIES)
         | set(STEP_FAMILIES)
         | set(host_family_rows())

@@ -121,6 +121,31 @@ class FleetConfig:
     #: full-snapshot-per-fetch — the A/B baseline; decode/rollup
     #: results are identical either way.
     delta: bool = True
+    #: Fleet efficiency ledger (tpumon/ledger): long-horizon tiered
+    #: time-series store (1 s → 10 s → 5 min) over the curated rollup
+    #: family set plus per-job goodput chip-second accounting, served
+    #: at GET /ledger and as tpu_ledger_*/tpu_fleet_goodput_* families.
+    ledger: bool = True
+    #: Ledger warm-restart spool directory (sealed chunks + goodput
+    #: totals journaled atomically so a reschedule doesn't amnesia the
+    #: week); empty disables persistence — the ledger runs memory-only.
+    ledger_spool_dir: str = ""
+    #: Ledger journal cadence seconds.
+    ledger_spool_every_s: float = 30.0
+    #: Total compressed-storage budget bytes across the ledger tiers
+    #: (split 25/25/50 toward the 5-minute tier); oldest sealed chunks
+    #: drop first, counted in tpu_ledger_dropped_chunks_total.
+    ledger_max_bytes: int = 67108864
+    #: Per-tier retention seconds as a 3-entry CSV (1 s, 10 s, 5 min
+    #: tiers); empty keeps the defaults 7200,93600,1209600 (2 h / 26 h
+    #: / 14 d). Malformed entries keep their default.
+    ledger_retention_s: str = ""
+    #: Prometheus remote-write endpoint for the curated ledger samples
+    #: (snappy+protobuf push, dependency-free). Empty (the default)
+    #: disables — an external TSDB stays optional, not required.
+    ledger_remote_write_url: str = ""
+    #: Remote-write push cadence seconds.
+    ledger_remote_write_every_s: float = 30.0
     #: Rollup-history retention window seconds (tpumon.history reuse,
     #: served at /history); 0 disables.
     history_window: float = 600.0

@@ -208,6 +208,21 @@ def node_snapshot_from_text(text: str) -> dict:
             snap["step_rate"] = float(line.rsplit(" ", 1)[1])
         elif name == "tpu_lifecycle_state":
             snap["lifecycle_transition"] = float(line.rsplit(" ", 1)[1]) > 0
+        elif name == "tpu_lifecycle_events_total":
+            # Transition counters by kind — what lets the goodput
+            # ledger (tpumon/ledger) attribute an active transition
+            # window to preempted vs restore vs resize.
+            labels = dict(_LABEL_RE.findall(line[brace:line.rfind("}") + 1]))
+            snap.setdefault("lifecycle_events", {})[
+                labels.get("kind", "?")
+            ] = float(line.rsplit(" ", 1)[1])
+        elif name == "tpu_lifecycle_checkpoints_total":
+            labels = dict(_LABEL_RE.findall(line[brace:line.rfind("}") + 1]))
+            snap.setdefault("checkpoints", {})[
+                labels.get("op", "?")
+            ] = float(line.rsplit(" ", 1)[1])
+        elif name == "tpu_lifecycle_collective_wait_fraction":
+            snap["collective_wait"] = float(line.rsplit(" ", 1)[1])
         elif name == "tpu_energy_power_watts":
             # Energy plane (tpumon/energy) — summed to node watts for
             # the tpu_fleet_energy_watts rollup; one modeled chip makes
@@ -665,8 +680,12 @@ class NodeFeed:
             "Accept": f"{SNAPSHOT_CONTENT_TYPE}, text/plain;q=0.5"
         }
         if self.delta:
+            # ;sub=1 advertises sub-segment (per-chip) delta capability
+            # — a media-type parameter old servers' negotiate() ignores,
+            # so the ask is backward-inert (PR 13 follow-up).
             headers["Accept"] = (
-                f"{DELTA_CONTENT_TYPE}, {SNAPSHOT_CONTENT_TYPE};q=0.9, "
+                f"{DELTA_CONTENT_TYPE};sub=1, "
+                f"{SNAPSHOT_CONTENT_TYPE};q=0.9, "
                 "text/plain;q=0.5"
             )
             with self._lock:
@@ -774,7 +793,10 @@ class NodeFeed:
         # version-skewed fleet never sits on full text pages per push.
         watch_fmt = "delta" if self.delta else "snapshot"
         while not self._stop.is_set():
-            request = snapshot_request(watch_fmt)
+            # sub=True rides the delta ask only: PageRequest field 2 is
+            # skipped by pre-PR 14 exporters (whole-segment deltas keep
+            # flowing), honored by new ones (per-chip patches).
+            request = snapshot_request(watch_fmt, sub=watch_fmt == "delta")
             # Receive cap mirrors the HTTP body cap: a hostile or
             # corrupt push stream errors out instead of ballooning RSS.
             channel = grpc.insecure_channel(

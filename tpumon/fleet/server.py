@@ -343,6 +343,26 @@ class FleetAggregator:
         #: Churn-proportional rollup state (collect thread only).
         self._rollup = IncrementalRollup()
 
+        #: Fleet efficiency ledger (tpumon/ledger): long-horizon tiered
+        #: storage + per-job goodput accounting over the same rollup
+        #: doc and feed entries the cycle already built — zero extra
+        #: feed locks, disk/network on the fetch executor.
+        self.ledger = None
+        if cfg.ledger:
+            from tpumon.ledger import LedgerPlane
+            from tpumon.ledger.store import default_tiers
+
+            self.ledger = LedgerPlane(
+                tiers=default_tiers(
+                    cfg.ledger_retention_s, cfg.ledger_max_bytes
+                ),
+                spool_dir=cfg.ledger_spool_dir,
+                spool_every_s=cfg.ledger_spool_every_s,
+                remote_write_url=cfg.ledger_remote_write_url,
+                remote_write_every_s=cfg.ledger_remote_write_every_s,
+                remote_write_timeout=cfg.timeout,
+            )
+
         from tpumon.exporter.server import _SelfTelemetryPage
 
         self._selfpage = _SelfTelemetryPage(self.registry)
@@ -582,6 +602,19 @@ class FleetAggregator:
                 body = _json_dump(doc)
             elif path == "/fleet/summary":
                 body = _json_dump(self._summary_doc())
+            elif path == "/ledger" and self.ledger is not None:
+                body, status = self.ledger.query_response(
+                    environ.get("QUERY_STRING", "")
+                )
+                start_response(
+                    status,
+                    [
+                        ("Content-Type",
+                         "application/json; charset=utf-8"),
+                        ("Content-Length", str(len(body))),
+                    ],
+                )
+                return [body]
             else:
                 return inner(environ, start_response)
             start_response(
@@ -658,6 +691,8 @@ class FleetAggregator:
                 "last_write_ts": self.spool.last_write_ts,
                 "dropped_last_save": self.spool.dropped_last_save,
             }
+        if self.ledger is not None:
+            doc["ledger"] = self.ledger.debug_block()
         if self.guard is not None:
             doc["guard"] = {"ingress": self.guard.snapshot()}
         if self.tracer is not None:
@@ -811,7 +846,20 @@ class FleetAggregator:
             doc = self._rollup.update(entries)
             membership = self.membership.snapshot()
             self._merge_peers(doc, membership)
+        if self.ledger is not None:
+            with trace_span("ledger"):
+                try:
+                    self.ledger.cycle(
+                        now, doc, entries, submit=self._executor.submit
+                    )
+                except Exception:
+                    # The ledger must never take the collect loop down;
+                    # a failed cycle costs one cycle of history.
+                    log.exception("ledger cycle failed")
+        with trace_span("render"):
             families = fleet_families(doc)
+            if self.ledger is not None:
+                families = families + self.ledger.families()
         if self.history is not None:
             with trace_span("history_record"):
                 try:
@@ -994,6 +1042,10 @@ class FleetAggregator:
                 self.spool.save(self.membership.universe(), entries)
             except Exception:
                 log.exception("final fleet spool save failed")
+        if self.ledger is not None:
+            # Final ledger journal (executor already drained): the
+            # restart resumes every tier from here, gap ledgered.
+            self.ledger.close()
         self._selfpage.close()
 
 

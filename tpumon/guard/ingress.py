@@ -172,6 +172,10 @@ class IngressGuard:
             # Fleet-tier JSON API (tpumon/fleet/server.py): allocates a
             # full per-node document per request — debug-class budget.
             return "fleet", DEBUG
+        if path == "/ledger":
+            # Ledger range query (tpumon/ledger): decodes sealed chunks
+            # per request — debug-class budget, bounded + continuation.
+            return "ledger", DEBUG
         if path.startswith("/debug/") or path == "/health/devices":
             return DEBUG, DEBUG
         return None, None

@@ -1,0 +1,41 @@
+"""Fleet efficiency ledger: long-horizon tiered time-series storage +
+per-job goodput accounting inside the fleet aggregator (ROADMAP item 2;
+PAPERS.md 2605.20799 "instant fleet efficiency visibility",
+2504.10702 per-container accounting).
+
+Everything below the aggregator is a last-good snapshot or a bounded
+1 Hz ring; this package is what lets the tier answer *yesterday's*
+questions — "what was this job's MFU at 3am?", "which pool wasted the
+most chip-hours this week?" — without requiring an external TSDB:
+
+- :mod:`tpumon.ledger.compress` — Gorilla-style delta-of-delta
+  timestamp + XOR value chunk codec (native C in ``tpumon/_native/``,
+  byte-identical Python fallback).
+- :mod:`tpumon.ledger.store` — the tiered downsampling store
+  (1 s → 10 s → 5 min) over the curated fleet family set, with
+  bounded per-tier retention and byte budgets.
+- :mod:`tpumon.ledger.goodput` — per-job chip-second accounting into
+  productive / checkpoint / restore / preempted / idle / contended /
+  unaccounted buckets with a conservation invariant.
+- :mod:`tpumon.ledger.spool` — warm-restart journal (the PR 9
+  SnapshotSpool write discipline applied to sealed chunks).
+- :mod:`tpumon.ledger.remote_write` — optional Prometheus remote-write
+  push (dependency-free protobuf + snappy framing), off by default.
+- :mod:`tpumon.ledger.plane` — the aggregator-facing orchestration:
+  one ``cycle()`` per collect cycle, ``tpu_ledger_*`` /
+  ``tpu_fleet_goodput_*`` families, and the ``GET /ledger`` range
+  query.
+"""
+
+from tpumon.ledger.goodput import BUCKETS, GoodputLedger
+from tpumon.ledger.plane import LedgerPlane
+from tpumon.ledger.store import LEDGER_FAMILY_SET, TierSpec, TieredSeriesStore
+
+__all__ = [
+    "BUCKETS",
+    "GoodputLedger",
+    "LEDGER_FAMILY_SET",
+    "LedgerPlane",
+    "TierSpec",
+    "TieredSeriesStore",
+]

@@ -1,0 +1,331 @@
+"""Gorilla-style chunk codec: delta-of-delta timestamps + XOR values.
+
+The ledger's storage unit is a sealed **chunk**: one series' samples
+over a bounded window, encoded once and immutable afterwards. The
+encoding is the Facebook Gorilla scheme (delta-of-delta integer
+timestamps, XOR-with-previous IEEE doubles with a reusing
+leading/trailing-zero window), chosen because fleet telemetry is
+exactly its sweet spot — near-regular cadence (dod == 0 costs one bit)
+and slowly moving gauges (repeat value costs one bit). A steady series
+compresses to ~1.4 bits/sample; the 5-minute tier's aggregate points
+each stand for 300 raw seconds, which is how the bytes-per-raw-sample
+headline gets under 0.15 (bench.py ``ledger_compression``).
+
+Two implementations, one wire format:
+
+- the Python encoder/decoder below (always available), and
+- ``tpumon/_native/_gorilla.c`` built on demand through the shared
+  ``load_extension`` machinery.
+
+They are pinned **byte-identical** (tests/test_ledger.py encodes the
+same stream through both and compares bytes), so a chunk sealed by a
+native aggregator reloads fine after a restart onto a compiler-less
+node, and vice versa. ``TPUMON_NO_NATIVE`` forces the fallback.
+
+Chunk grammar (everything big-endian bit order, byte-padded with zero
+bits at the end)::
+
+    varint n                      # sample count; n == 0 ends the chunk
+    varint ts[0]                  # first timestamp, milliseconds
+    8 bytes                       # first value, IEEE-754 double
+    then per sample i in 1..n-1:
+      dod = (ts[i]-ts[i-1]) - (ts[i-1]-ts[i-2])   # ts[-1]: delta 0
+      '0'                                  when dod == 0
+      '10'   + 7  bits (dod + 63)          when -63   <= dod <= 64
+      '110'  + 9  bits (dod + 255)         when -255  <= dod <= 256
+      '1110' + 12 bits (dod + 2047)        when -2047 <= dod <= 2048
+      '1111' + 64 bits two's-complement    otherwise
+      x = bits(val[i]) ^ bits(val[i-1])
+      '0'                                  when x == 0
+      '1' '0' + meaningful bits            when x fits the prev window
+      '1' '1' + 5 bits leading-zero count (capped 31)
+              + 6 bits (meaningful-length - 1) + meaningful bits
+
+Timestamps are **integer milliseconds** — the ledger quantizes float
+epoch seconds on the way in, which keeps the codec lossless and the
+dod arithmetic exact.
+"""
+
+from __future__ import annotations
+
+import logging
+import struct
+
+from tpumon._native import load_extension
+
+log = logging.getLogger(__name__)
+
+_NATIVE_STEM = "_gorilla"
+
+
+def _encode_varint(value: int, out: bytearray) -> None:
+    while True:
+        byte = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(byte | 0x80)
+        else:
+            out.append(byte)
+            return
+
+
+def _decode_varint(data: bytes, idx: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if idx >= len(data):
+            raise ValueError("truncated varint")
+        byte = data[idx]
+        idx += 1
+        result |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return result, idx
+        shift += 7
+        if shift > 70:
+            raise ValueError("oversized varint")
+
+
+class _BitWriter:
+    """MSB-first bit accumulator over a bytearray."""
+
+    __slots__ = ("out", "_acc", "_nbits")
+
+    def __init__(self, out: bytearray) -> None:
+        self.out = out
+        self._acc = 0
+        self._nbits = 0
+
+    def write(self, value: int, nbits: int) -> None:
+        self._acc = (self._acc << nbits) | (value & ((1 << nbits) - 1))
+        self._nbits += nbits
+        while self._nbits >= 8:
+            self._nbits -= 8
+            self.out.append((self._acc >> self._nbits) & 0xFF)
+        self._acc &= (1 << self._nbits) - 1
+
+    def flush(self) -> None:
+        if self._nbits:
+            self.out.append((self._acc << (8 - self._nbits)) & 0xFF)
+            self._acc = 0
+            self._nbits = 0
+
+
+class _BitReader:
+    """MSB-first bit reader over bytes."""
+
+    __slots__ = ("data", "_idx", "_acc", "_nbits")
+
+    def __init__(self, data: bytes, idx: int) -> None:
+        self.data = data
+        self._idx = idx
+        self._acc = 0
+        self._nbits = 0
+
+    def read(self, nbits: int) -> int:
+        while self._nbits < nbits:
+            if self._idx >= len(self.data):
+                raise ValueError("truncated chunk bitstream")
+            self._acc = (self._acc << 8) | self.data[self._idx]
+            self._idx += 1
+            self._nbits += 8
+        self._nbits -= nbits
+        value = (self._acc >> self._nbits) & ((1 << nbits) - 1)
+        self._acc &= (1 << self._nbits) - 1
+        return value
+
+
+_D64 = struct.Struct(">d")
+_Q64 = struct.Struct(">Q")
+
+
+def _bits_of(value: float) -> int:
+    return _Q64.unpack(_D64.pack(value))[0]
+
+
+def _value_of(bits: int) -> float:
+    return _D64.unpack(_Q64.pack(bits))[0]
+
+
+def _clz64(x: int) -> int:
+    return 64 - x.bit_length()
+
+
+def _ctz64(x: int) -> int:
+    return (x & -x).bit_length() - 1
+
+
+def encode_chunk_py(timestamps: list[int], values: list[float]) -> bytes:
+    """Pure-Python chunk encoder (the portable reference; the native
+    encoder is pinned byte-identical to THIS)."""
+    n = len(timestamps)
+    if n != len(values):
+        raise ValueError("timestamp/value length mismatch")
+    out = bytearray()
+    _encode_varint(n, out)
+    if n == 0:
+        return bytes(out)
+    ts0 = int(timestamps[0])
+    if ts0 < 0:
+        raise ValueError("negative timestamp")
+    _encode_varint(ts0, out)
+    out += _D64.pack(values[0])
+    if n == 1:
+        return bytes(out)
+    bits = _BitWriter(out)
+    prev_ts = ts0
+    prev_delta = 0
+    prev_bits = _bits_of(values[0])
+    win_lead = -1
+    win_len = 0
+    for i in range(1, n):
+        ts = int(timestamps[i])
+        delta = ts - prev_ts
+        dod = delta - prev_delta
+        prev_ts = ts
+        prev_delta = delta
+        if dod == 0:
+            bits.write(0, 1)
+        elif -63 <= dod <= 64:
+            bits.write(0b10, 2)
+            bits.write(dod + 63, 7)
+        elif -255 <= dod <= 256:
+            bits.write(0b110, 3)
+            bits.write(dod + 255, 9)
+        elif -2047 <= dod <= 2048:
+            bits.write(0b1110, 4)
+            bits.write(dod + 2047, 12)
+        else:
+            bits.write(0b1111, 4)
+            bits.write(dod & 0xFFFFFFFFFFFFFFFF, 64)
+        vbits = _bits_of(values[i])
+        xor = vbits ^ prev_bits
+        prev_bits = vbits
+        if xor == 0:
+            bits.write(0, 1)
+            continue
+        bits.write(1, 1)
+        lead = min(_clz64(xor), 31)
+        trail = _ctz64(xor)
+        if (
+            win_lead >= 0
+            and lead >= win_lead
+            and trail >= 64 - win_lead - win_len
+        ):
+            bits.write(0, 1)
+            bits.write(xor >> (64 - win_lead - win_len), win_len)
+        else:
+            length = 64 - lead - trail
+            bits.write(1, 1)
+            bits.write(lead, 5)
+            bits.write(length - 1, 6)
+            bits.write(xor >> trail, length)
+            win_lead = lead
+            win_len = length
+    bits.flush()
+    return bytes(out)
+
+
+def decode_chunk_py(data: bytes) -> tuple[list[int], list[float]]:
+    """Pure-Python inverse of :func:`encode_chunk_py`. Raises ValueError
+    on a truncated or malformed chunk (the spool quarantines it)."""
+    n, idx = _decode_varint(data, 0)
+    if n == 0:
+        return [], []
+    if n < 0 or n > 1 << 30:
+        raise ValueError("implausible chunk sample count")
+    ts0, idx = _decode_varint(data, idx)
+    if idx + 8 > len(data):
+        raise ValueError("truncated chunk header")
+    val0 = _D64.unpack_from(data, idx)[0]
+    idx += 8
+    timestamps = [ts0]
+    values = [val0]
+    if n == 1:
+        return timestamps, values
+    bits = _BitReader(data, idx)
+    prev_ts = ts0
+    prev_delta = 0
+    prev_bits = _bits_of(val0)
+    win_lead = -1
+    win_len = 0
+    for _ in range(1, n):
+        if bits.read(1) == 0:
+            dod = 0
+        elif bits.read(1) == 0:
+            dod = bits.read(7) - 63
+        elif bits.read(1) == 0:
+            dod = bits.read(9) - 255
+        elif bits.read(1) == 0:
+            dod = bits.read(12) - 2047
+        else:
+            raw = bits.read(64)
+            dod = raw - (1 << 64) if raw >= 1 << 63 else raw
+        prev_delta += dod
+        prev_ts += prev_delta
+        timestamps.append(prev_ts)
+        if bits.read(1) == 0:
+            values.append(_value_of(prev_bits))
+            continue
+        if bits.read(1) == 0:
+            if win_lead < 0:
+                raise ValueError("window reuse before any window")
+            xor = bits.read(win_len) << (64 - win_lead - win_len)
+        else:
+            win_lead = bits.read(5)
+            win_len = bits.read(6) + 1
+            if win_lead + win_len > 64:
+                raise ValueError("invalid XOR window")
+            xor = bits.read(win_len) << (64 - win_lead - win_len)
+        prev_bits ^= xor
+        values.append(_value_of(prev_bits))
+    return timestamps, values
+
+
+def native_codec():
+    """The compiled codec module, or None (fallback in use)."""
+    return load_extension(_NATIVE_STEM)
+
+
+def encode_chunk(timestamps: list[int], values: list[float]) -> bytes:
+    """Encode one sealed chunk, native when the extension built.
+
+    Output bytes are identical either way (pinned); callers never need
+    to know which implementation sealed a chunk.
+    """
+    ext = native_codec()
+    if ext is not None:
+        try:
+            return ext.encode(list(timestamps), list(values))
+        except Exception:
+            # A native hiccup degrades to the fallback, never loses data.
+            log.warning(
+                "native gorilla encode failed; using fallback",
+                exc_info=True,
+            )
+    return encode_chunk_py(timestamps, values)
+
+
+def decode_chunk(data: bytes) -> tuple[list[int], list[float]]:
+    """Decode one sealed chunk (ValueError on malformed input)."""
+    ext = native_codec()
+    if ext is not None:
+        try:
+            ts, vals = ext.decode(bytes(data))
+            return list(ts), list(vals)
+        except ValueError:
+            raise
+        except Exception:
+            log.warning(
+                "native gorilla decode failed; using fallback",
+                exc_info=True,
+            )
+    return decode_chunk_py(data)
+
+
+__all__ = [
+    "decode_chunk",
+    "decode_chunk_py",
+    "encode_chunk",
+    "encode_chunk_py",
+    "native_codec",
+]

@@ -1,0 +1,348 @@
+"""Per-job goodput accounting: chip-seconds into honest buckets.
+
+Every observed chip-second of every job (a job is a ``(pool, slice)``
+identity — the granularity the hierarchy already rolls up at) is
+assigned to exactly ONE bucket per accounting window:
+
+- ``productive`` — steps advancing (or, for device-only nodes with no
+  workload feed, duty above the idle floor: duty is then the only
+  truth available, and the help text says so),
+- ``checkpoint`` — a checkpoint-save span completed inside the window
+  (tpu_lifecycle_checkpoints_total{op="save"} advanced),
+- ``restore`` — a restore or elastic-resize transition window
+  (reconfiguration time; resize rides this bucket by design),
+- ``preempted`` — a preemption transition window,
+- ``contended`` — collective-wait above the contention floor or an
+  active straggler verdict: chips busy-waiting on the fabric, not
+  computing,
+- ``idle`` — visible, healthy, and doing nothing,
+- ``unaccounted`` — the node was STALE or DARK for the window, or the
+  aggregator itself was down (warm-restart gap): we could not see, so
+  we say so. Partitions land HERE, never silently in ``idle`` — the
+  same honesty stance as ``tpu_fleet_visibility_ratio``.
+
+Conservation is the invariant everything else hangs on: per job,
+``sum(buckets) == observed wall seconds × chips`` exactly, because
+each feed's whole accounting window goes to one bucket and windows
+tile the feed's observed lifetime (a per-feed watermark, no overlaps,
+no holes). tests/test_ledger.py and ``soak.py --ledger`` both pin it.
+"""
+
+from __future__ import annotations
+
+import threading
+
+BUCKETS = (
+    "productive",
+    "checkpoint",
+    "restore",
+    "preempted",
+    "idle",
+    "contended",
+    "unaccounted",
+)
+
+#: Transition kind -> bucket (tpu_lifecycle_events_total kinds).
+_KIND_BUCKET = {
+    "preemption": "preempted",
+    "restore": "restore",
+    "resize": "restore",
+}
+
+
+class _FeedState:
+    __slots__ = (
+        "watermark", "chips", "job", "events", "checkpoints",
+        "last_kind",
+    )
+
+    def __init__(self, now: float) -> None:
+        self.watermark = now
+        self.chips = 0
+        self.job: tuple[str, str] | None = None
+        #: Last seen tpu_lifecycle_events_total counts by kind.
+        self.events: dict[str, float] = {}
+        #: Last seen tpu_lifecycle_checkpoints_total counts by op.
+        self.checkpoints: dict[str, float] = {}
+        #: Kind of the most recent transition counter advance — what an
+        #: ACTIVE tpu_lifecycle_state window is attributed to.
+        self.last_kind: str | None = None
+
+
+class GoodputLedger:
+    """Accumulates per-job bucket totals from fleet feed entries.
+
+    Single-writer (the collect thread). ``account`` consumes the same
+    ``(target, snap, state, ...)`` entries the incremental rollup
+    reads, so the plane costs zero extra feed locks.
+    """
+
+    def __init__(
+        self,
+        contended_wait: float = 0.25,
+        idle_duty_pct: float = 5.0,
+    ) -> None:
+        self.contended_wait = contended_wait
+        self.idle_duty_pct = idle_duty_pct
+        #: One lock for the structural state: account() runs on the
+        #: collect thread while jobs_doc()/totals() serve /ledger on
+        #: HTTP threads — a new job appearing mid-iteration would
+        #: otherwise RuntimeError the query.
+        self._lock = threading.Lock()
+        self._feeds: dict[str, _FeedState] = {}  # guarded-by: self._lock
+        #: (pool, slice) -> {bucket: chip_seconds}.
+        self._jobs: dict[tuple[str, str], dict[str, float]] = {}  # guarded-by: self._lock
+        #: Aggregator-blind seconds ledgered (warm-restart gaps).
+        self.gap_seconds = 0.0  # guarded-by: self._lock
+
+    # -- accounting ---------------------------------------------------------
+
+    def account(self, entries: list[tuple], now: float) -> None:
+        """One collect cycle: ``entries`` is ``[(target, snap|None,
+        state, ...), ...]``. Each feed's window since its watermark is
+        classified and charged to its job's bucket."""
+        with self._lock:
+            self._account_locked(entries, now)
+
+    def _account_locked(self, entries: list[tuple], now: float) -> None:  # holds: self._lock
+        seen = set()
+        for entry in entries:
+            target, snap, state = entry[0], entry[1], entry[2]
+            seen.add(target)
+            feed = self._feeds.get(target)
+            if feed is None:
+                feed = self._feeds[target] = _FeedState(now)
+                self._observe_counters(feed, snap)
+                self._update_identity(feed, snap)
+                continue  # first sight anchors the watermark only
+            dt = now - feed.watermark
+            feed.watermark = now
+            if dt <= 0:
+                self._observe_counters(feed, snap)
+                self._update_identity(feed, snap)
+                continue
+            bucket = self._classify(feed, snap, state)
+            self._update_identity(feed, snap)
+            if feed.job is not None and feed.chips > 0:
+                job = self._jobs.setdefault(
+                    feed.job, dict.fromkeys(BUCKETS, 0.0)
+                )
+                job[bucket] += dt * feed.chips
+        # Departed feeds (membership change / hand-back) stop accruing:
+        # their job totals stay — the ledger is history, not state.
+        for target in list(self._feeds):
+            if target not in seen:
+                del self._feeds[target]
+
+    def _update_identity(self, feed: _FeedState, snap: dict | None) -> None:
+        if not snap:
+            return
+        ident = snap.get("identity") or {}
+        pool = ident.get("accelerator")
+        slc = ident.get("slice")
+        if pool or slc:
+            feed.job = (pool or "unknown", slc or "?")
+        chips = len(snap.get("chips") or ())
+        if not chips:
+            chips = int(snap.get("device_count") or 0)
+        if chips:
+            feed.chips = chips
+
+    def _observe_counters(self, feed: _FeedState, snap: dict | None) -> None:
+        """Track lifecycle/checkpoint counter advances; returns nothing
+        — advances are recorded on the feed for _classify to read."""
+        if not snap:
+            return
+        events = snap.get("lifecycle_events")
+        if isinstance(events, dict):
+            for kind, count in events.items():
+                if count > feed.events.get(kind, 0.0):
+                    feed.last_kind = kind
+                feed.events[kind] = count
+        ckpts = snap.get("checkpoints")
+        if isinstance(ckpts, dict):
+            feed.checkpoints = ckpts
+
+    def _checkpoint_advanced(
+        self, feed: _FeedState, snap: dict | None
+    ) -> bool:
+        if not snap:
+            return False
+        ckpts = snap.get("checkpoints")
+        if not isinstance(ckpts, dict):
+            return False
+        prev = feed.checkpoints
+        return ckpts.get("save", 0.0) > prev.get("save", 0.0)
+
+    def _classify(
+        self, feed: _FeedState, snap: dict | None, state: str
+    ) -> str:
+        """One feed window -> one bucket. Priority order IS the
+        semantics: honesty first (can't see -> unaccounted), then
+        explicit lifecycle windows, then checkpoint spans, then
+        contention, then the productive/idle split."""
+        if state != "up" or not snap:
+            self._observe_counters(feed, snap)
+            return "unaccounted"
+        checkpoint = self._checkpoint_advanced(feed, snap)
+        self._observe_counters(feed, snap)
+        if snap.get("lifecycle_transition"):
+            bucket = _KIND_BUCKET.get(feed.last_kind or "")
+            if bucket is not None:
+                return bucket
+            # A transition window with no attributable kind (the feed
+            # was adopted mid-window): reconfiguration-class.
+            return "restore"
+        if checkpoint:
+            return "checkpoint"
+        straggler = snap.get("straggler") or {}
+        wait = snap.get("collective_wait")
+        if straggler.get("active") or (
+            wait is not None and wait >= self.contended_wait
+        ):
+            return "contended"
+        duty = self._duty_mean(snap)
+        step_rate = snap.get("step_rate")
+        if step_rate is not None:
+            if step_rate > 0.0:
+                return "productive"
+            return "idle" if (duty is None or duty < self.idle_duty_pct) \
+                else "contended"
+        if duty is not None and duty >= self.idle_duty_pct:
+            # Device-only node (no workload feed): duty is the only
+            # signal — busy chips count productive, and the family help
+            # says so.
+            return "productive"
+        return "idle"
+
+    @staticmethod
+    def _duty_mean(snap: dict) -> float | None:
+        total = 0.0
+        n = 0
+        for row in (snap.get("chips") or {}).values():
+            duty = row.get("duty_pct")
+            if duty is not None:
+                total += duty
+                n += 1
+        return total / n if n else None
+
+    def ledger_gap(self, seconds: float) -> None:
+        """Aggregator-blind time (warm-restart gap): charged to every
+        known job's ``unaccounted`` at its last-known chip count, and
+        counted — gap seconds are ledgered, never interpolated away."""
+        if seconds <= 0:
+            return
+        with self._lock:
+            self.gap_seconds += seconds
+            # Per-FEED charge (each feed contributes its own chips).
+            for feed in self._feeds.values():
+                if feed.job is None or feed.chips <= 0:
+                    continue
+                job = self._jobs.setdefault(
+                    feed.job, dict.fromkeys(BUCKETS, 0.0)
+                )
+                job["unaccounted"] += seconds * feed.chips
+
+    # -- read ---------------------------------------------------------------
+
+    def jobs(self) -> dict[tuple[str, str], dict[str, float]]:
+        """(pool, slice) -> bucket totals (chip-seconds). A shallow
+        copy: the job set is iteration-safe for the caller; the inner
+        bucket dicts are shared but key-stable (every bucket key is
+        preset), so concurrent value updates read merely slightly
+        stale, never torn."""
+        with self._lock:
+            return dict(self._jobs)
+
+    def totals(self) -> dict[str, float]:
+        out = dict.fromkeys(BUCKETS, 0.0)
+        for buckets in self.jobs().values():
+            for bucket, value in buckets.items():
+                out[bucket] += value
+        return out
+
+    def jobs_doc(self) -> list[dict]:
+        """The /ledger?view=goodput rows: per-job splits with the
+        conservation total spelled out."""
+        rows = []
+        for (pool, slc), buckets in sorted(self.jobs().items()):
+            total = sum(buckets.values())
+            rows.append({
+                "pool": pool,
+                "slice": slc,
+                "chip_seconds": total,
+                "buckets": {k: buckets[k] for k in BUCKETS},
+                "goodput_ratio": (
+                    buckets["productive"] / total if total > 0 else None
+                ),
+            })
+        return rows
+
+    # -- spool round-trip ---------------------------------------------------
+
+    def to_doc(self) -> dict:
+        with self._lock:
+            return {
+                "jobs": [
+                    {"pool": pool, "slice": slc, "buckets": dict(buckets)}
+                    for (pool, slc), buckets in sorted(self._jobs.items())
+                ],
+                "feeds": {
+                    target: {
+                        "chips": feed.chips,
+                        "job": list(feed.job) if feed.job else None,
+                        "events": dict(feed.events),
+                        "checkpoints": dict(feed.checkpoints),
+                        "last_kind": feed.last_kind,
+                    }
+                    for target, feed in self._feeds.items()
+                },
+                "gap_seconds": self.gap_seconds,
+            }
+
+    def restore(self, doc: dict, now: float) -> None:
+        """Rebuild totals + per-feed counter state from a spool doc.
+        Watermarks restart at ``now`` — the plane separately ledgers
+        the downtime gap via :meth:`ledger_gap`."""
+        with self._lock:
+            self._restore_locked(doc, now)
+
+    def _restore_locked(self, doc: dict, now: float) -> None:  # holds: self._lock
+        for row in doc.get("jobs", ()):
+            try:
+                job = (str(row["pool"]), str(row["slice"]))
+                buckets = dict.fromkeys(BUCKETS, 0.0)
+                for bucket, value in row["buckets"].items():
+                    if bucket in buckets:
+                        buckets[bucket] = float(value)
+                self._jobs[job] = buckets
+            except (KeyError, TypeError, ValueError):
+                continue
+        for target, row in (doc.get("feeds") or {}).items():
+            try:
+                feed = _FeedState(now)
+                feed.chips = int(row.get("chips") or 0)
+                job = row.get("job")
+                if isinstance(job, list) and len(job) == 2:
+                    feed.job = (str(job[0]), str(job[1]))
+                if isinstance(row.get("events"), dict):
+                    feed.events = {
+                        str(k): float(v) for k, v in row["events"].items()
+                    }
+                if isinstance(row.get("checkpoints"), dict):
+                    feed.checkpoints = {
+                        str(k): float(v)
+                        for k, v in row["checkpoints"].items()
+                    }
+                kind = row.get("last_kind")
+                feed.last_kind = str(kind) if kind else None
+                self._feeds[str(target)] = feed
+            except (TypeError, ValueError):
+                continue
+        try:
+            self.gap_seconds = float(doc.get("gap_seconds") or 0.0)
+        except (TypeError, ValueError):
+            self.gap_seconds = 0.0
+
+
+__all__ = ["BUCKETS", "GoodputLedger"]

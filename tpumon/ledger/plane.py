@@ -1,0 +1,483 @@
+"""The aggregator-facing ledger plane: one ``cycle()`` per collect
+cycle, exposition families, the ``GET /ledger`` range query, and the
+warm-restart / remote-write plumbing.
+
+Cost stance: the plane rides state the collect cycle already built —
+the rollup doc (curated samples) and the feed entries (goodput
+classification) — so it adds zero feed locks and zero upstream
+fetches. Disk (spool save) and network (remote write) happen on the
+aggregator's fetch executor, never on the collect thread, one in
+flight at a time.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.parse
+
+from tpumon.ledger.goodput import BUCKETS, GoodputLedger
+from tpumon.ledger.store import (
+    LEDGER_FAMILY_SET,
+    STATS,
+    TieredSeriesStore,
+    TierSpec,
+    default_tiers,
+)
+
+log = logging.getLogger(__name__)
+
+#: Hard per-response point bound for /ledger (continuation tokens page
+#: beyond it — the PR 4 bounded-replay stance applied to range reads).
+QUERY_MAX_POINTS = 2000
+QUERY_MAX_POINTS_CEILING = 20000
+
+
+def _json_bytes(doc: dict) -> bytes:
+    return json.dumps(doc, sort_keys=True).encode()
+
+
+class LedgerPlane:
+    """Tiered store + goodput ledger wired for one aggregator shard."""
+
+    def __init__(
+        self,
+        tiers: tuple[TierSpec, ...] | None = None,
+        spool_dir: str = "",
+        spool_max_bytes: int = 134217728,
+        spool_every_s: float = 30.0,
+        remote_write_url: str = "",
+        remote_write_every_s: float = 30.0,
+        remote_write_timeout: float = 5.0,
+        contended_wait: float = 0.25,
+        idle_duty_pct: float = 5.0,
+        clock=time.time,
+    ) -> None:
+        self._clock = clock
+        self.tiers = tuple(tiers) if tiers else default_tiers()
+        self.goodput = GoodputLedger(
+            contended_wait=contended_wait, idle_duty_pct=idle_duty_pct
+        )
+        self.spool = None
+        self.spool_every_s = spool_every_s
+        self._spool_last_save = 0.0
+        #: True while a journal write is in flight (collect thread sets,
+        #: executor worker clears — same one-bool discipline as the
+        #: aggregator's snapshot spool).
+        self._spool_saving = False
+        self.spool_errors = {"load": 0, "write": 0}
+        self.restored = False
+        now = clock()
+        if spool_dir:
+            from tpumon.ledger.spool import LedgerSpool
+
+            self.spool = LedgerSpool(
+                spool_dir, max_bytes=spool_max_bytes, clock=clock
+            )
+            loaded = self.spool.load()
+            if self.spool.last_load_error is not None:
+                self.spool_errors["load"] += 1
+            if loaded["saved_at"] > 0:
+                self.store = TieredSeriesStore.from_doc(
+                    loaded["store"], self.tiers
+                )
+                self.goodput.restore(loaded["goodput"], now)
+                self.restored = True
+                gap = now - loaded["saved_at"]
+                if gap > 0:
+                    # Downtime is LEDGERED: unaccounted chip-seconds
+                    # for every known job, a counted gap — and no
+                    # samples: the tiers simply hold nothing for the
+                    # window (gaps are never interpolated).
+                    self.goodput.ledger_gap(gap)
+            else:
+                self.store = TieredSeriesStore(self.tiers)
+        else:
+            self.store = TieredSeriesStore(self.tiers)
+        self.remote_write_url = remote_write_url
+        self.remote_write_every_s = remote_write_every_s
+        self.remote_write_timeout = remote_write_timeout
+        self._rw_last_push = 0.0
+        self._rw_inflight = False
+        self.remote_write_counts = {"ok": 0, "error": 0}
+        #: Samples accumulated since the last remote-write push:
+        #: {series_key: [(ts_ms, value), ...]} — bounded by dropping
+        #: oldest entries past the cadence backlog cap.
+        self._rw_pending: dict[tuple, list] = {}  # guarded-by: self._rw_lock
+        self._rw_lock = threading.Lock()
+        self.queries_total = 0
+        self.last_cycle_samples = 0
+
+    # -- collect-cycle hook -------------------------------------------------
+
+    def cycle(self, now: float, doc: dict, entries: list, submit=None) -> None:
+        """One collect cycle: account goodput over the feed entries,
+        record the curated samples from the rollup doc, then (on their
+        cadences, off-thread via ``submit``) journal and push."""
+        self.goodput.account(entries, now)
+        samples: dict[tuple, float] = {}
+        for labels, bucket in self._rows(doc):
+            for family, extract in LEDGER_FAMILY_SET.items():
+                value = extract(bucket)
+                if value is None:
+                    continue
+                samples[(family, *labels)] = float(value)
+        self.store.record(now, samples)
+        self.last_cycle_samples = len(samples)
+        if self.remote_write_url:
+            ts_ms = int(round(now * 1000.0))
+            with self._rw_lock:
+                for key, value in samples.items():
+                    pending = self._rw_pending.setdefault(key, [])
+                    pending.append((ts_ms, value))
+                    # Backlog bound: a dead endpoint must not grow RSS.
+                    if len(pending) > 600:
+                        del pending[: len(pending) - 600]
+            self._maybe_push(now, submit)
+        self._maybe_spool(now, submit)
+
+    @staticmethod
+    def _rows(doc: dict):
+        """(scope, pool, slice) rows of a rollup doc — slice, pool, and
+        fleet scopes (the cross-shard global row is a per-shard VIEW of
+        other shards' data; persisting it here would double-count on
+        every shard)."""
+        for (pool, slc), bucket in sorted(doc.get("slices", {}).items()):
+            yield ("slice", pool, slc), bucket
+        for pool, bucket in sorted(doc.get("pools", {}).items()):
+            yield ("pool", pool, ""), bucket
+        if doc.get("fleet"):
+            yield ("fleet", "", ""), doc["fleet"]
+
+    def _maybe_spool(self, now: float, submit=None) -> None:
+        if self.spool is None:
+            return
+        if now - self._spool_last_save < self.spool_every_s:
+            return
+        if self._spool_saving:
+            return
+        self._spool_saving = True
+        self._spool_last_save = now
+        # Docs build on the collect thread (the store is single-writer
+        # there — building on the executor would race appends); the
+        # serialize+fsync goes off-thread.
+        store_doc = self.store.to_doc()
+        goodput_doc = self.goodput.to_doc()
+
+        def save() -> None:
+            try:
+                if not self.spool.save(store_doc, goodput_doc):
+                    self.spool_errors["write"] += 1
+            except Exception:
+                log.exception("ledger spool save failed")
+                self.spool_errors["write"] += 1
+            finally:
+                self._spool_saving = False
+
+        if submit is not None:
+            submit(save)
+        else:
+            save()
+
+    def _maybe_push(self, now: float, submit=None) -> None:
+        if now - self._rw_last_push < self.remote_write_every_s:
+            return
+        if self._rw_inflight:
+            return
+        with self._rw_lock:
+            pending = self._rw_pending
+            self._rw_pending = {}
+        self._rw_last_push = now
+        if not pending:
+            # Nothing accumulated: no POST happens, so no outcome is
+            # counted — the ok/error counters reflect real pushes only.
+            return
+        self._rw_inflight = True
+        series = [
+            {
+                "labels": {
+                    "__name__": key[0],
+                    "scope": key[1],
+                    "pool": key[2],
+                    "slice": key[3],
+                },
+                "samples": points,
+            }
+            for key, points in sorted(pending.items())
+        ]
+
+        def do_push() -> None:
+            from tpumon.ledger.remote_write import PUSH_ERRORS, push
+
+            try:
+                push(
+                    self.remote_write_url, series,
+                    timeout=self.remote_write_timeout,
+                )
+                self.remote_write_counts["ok"] += 1
+            except PUSH_ERRORS as exc:
+                self.remote_write_counts["error"] += 1
+                log.warning("ledger remote write failed: %s", exc)
+            finally:
+                self._rw_inflight = False
+
+        if submit is not None:
+            submit(do_push)
+        else:
+            do_push()
+
+    def close(self) -> None:
+        """Final synchronous journal (the aggregator drains its
+        executor first, same as the snapshot spool)."""
+        if self.spool is None:
+            return
+        try:
+            if not self.spool.save(
+                self.store.to_doc(), self.goodput.to_doc()
+            ):
+                self.spool_errors["write"] += 1
+        except Exception:
+            log.exception("final ledger spool save failed")
+            self.spool_errors["write"] += 1
+
+    # -- exposition ---------------------------------------------------------
+
+    def families(self) -> list:
+        """The ledger's exposition rows, rebuilt per collect cycle like
+        every other fleet family."""
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+        )
+
+        goodput = CounterMetricFamily(
+            "tpu_fleet_goodput_chip_seconds",
+            "Chip-seconds accounted per job (slice scope) and fleet-wide "
+            "by goodput bucket: productive (steps advancing, or duty "
+            "above the idle floor on device-only nodes), checkpoint, "
+            "restore (incl. elastic resize), preempted, idle, contended "
+            "(collective-wait/straggler), unaccounted (node stale/dark "
+            "or aggregator blind — partitions land here, never in "
+            "idle). Buckets sum to observed wall-clock x chips per job.",
+            labels=("scope", "pool", "slice", "bucket"),
+        )
+        for (pool, slc), buckets in sorted(self.goodput.jobs().items()):
+            for bucket in BUCKETS:
+                goodput.add_metric(
+                    ("slice", pool, slc, bucket), buckets[bucket]
+                )
+        for bucket, value in self.goodput.totals().items():
+            goodput.add_metric(("fleet", "", "", bucket), value)
+        stats = self.store.stats()
+        series = GaugeMetricFamily(
+            "tpu_ledger_series",
+            "Distinct series stored per ledger tier.",
+            labels=("tier",),
+        )
+        samples = CounterMetricFamily(
+            "tpu_ledger_samples",
+            "Samples recorded into each ledger tier since start "
+            "(aggregate tiers count finalized buckets).",
+            labels=("tier",),
+        )
+        nbytes = GaugeMetricFamily(
+            "tpu_ledger_bytes",
+            "Sealed compressed bytes held per ledger tier (open buffers "
+            "excluded; the bench's bytes-per-sample headline divides "
+            "this by the raw samples the tier's window covers).",
+            labels=("tier",),
+        )
+        for idx, tier in enumerate(stats["tiers"]):
+            series.add_metric((tier["name"],), float(tier["series"]))
+            samples.add_metric(
+                (tier["name"],), float(self.store.samples_total[idx])
+            )
+            nbytes.add_metric((tier["name"],), float(tier["sealed_bytes"]))
+        dropped = CounterMetricFamily(
+            "tpu_ledger_dropped_chunks",
+            "Sealed chunks dropped by bound (retention age / tier byte "
+            "budget) — the ledger is bounded by construction, and drops "
+            "are counted, never silent.",
+            labels=("reason",),
+        )
+        for reason, count in sorted(stats["dropped_chunks"].items()):
+            dropped.add_metric((reason,), float(count))
+        gap = CounterMetricFamily(
+            "tpu_ledger_gap_seconds",
+            "Wall seconds the ledger could not observe (aggregator "
+            "restarts between spool saves): ledgered into the "
+            "unaccounted goodput bucket, never interpolated into "
+            "samples.",
+            labels=(),
+        )
+        gap.add_metric((), self.goodput.gap_seconds)
+        queries = CounterMetricFamily(
+            "tpu_ledger_queries",
+            "GET /ledger range queries served.",
+            labels=(),
+        )
+        queries.add_metric((), float(self.queries_total))
+        out = [goodput, series, samples, nbytes, dropped, gap, queries]
+        if self.spool is not None:
+            spool_errors = CounterMetricFamily(
+                "tpu_ledger_spool_errors",
+                "Ledger spool failures by op (load / write); the plane "
+                "runs on, memory-only.",
+                labels=("op",),
+            )
+            for op, count in sorted(self.spool_errors.items()):
+                spool_errors.add_metric((op,), float(count))
+            out.append(spool_errors)
+        if self.remote_write_url:
+            rw = CounterMetricFamily(
+                "tpu_ledger_remote_write",
+                "Remote-write push outcomes (result ∈ ok/error); absent "
+                "unless TPUMON_FLEET_LEDGER_REMOTE_WRITE_URL is set.",
+                labels=("result",),
+            )
+            for result, count in sorted(self.remote_write_counts.items()):
+                rw.add_metric((result,), float(count))
+            out.append(rw)
+        return out
+
+    # -- /ledger ------------------------------------------------------------
+
+    def query_response(self, query_string: str) -> tuple[bytes, str]:
+        """(body, status) for one GET /ledger. Three shapes:
+
+        - no parameters: the index (families, tiers, occupancy,
+          goodput totals);
+        - ``?view=goodput``: per-job bucket splits + conservation;
+        - ``?family=...``: a range query — ``scope`` (slice/pool/fleet),
+          optional ``pool``/``slice`` filters, ``start``/``end`` epoch
+          seconds (default: the last hour), ``step`` seconds (tier
+          selection hint), ``stat`` (mean/min/max at aggregate tiers),
+          ``max_points`` (server-capped). Bounded responses carry
+          ``next_start`` continuation cursors.
+        """
+        self.queries_total += 1
+        try:
+            params = dict(urllib.parse.parse_qsl(query_string))
+        except ValueError:
+            return _json_bytes({"error": "unparseable query"}), "400 Bad Request"
+        if params.get("view") == "goodput":
+            return _json_bytes({
+                "now": self._clock(),
+                "buckets": list(BUCKETS),
+                "jobs": self.goodput.jobs_doc(),
+                "totals": self.goodput.totals(),
+                "gap_seconds": self.goodput.gap_seconds,
+            }), "200 OK"
+        family = params.get("family")
+        if not family:
+            return _json_bytes(self._index_doc()), "200 OK"
+        if family not in LEDGER_FAMILY_SET:
+            return _json_bytes({
+                "error": f"unknown family {family!r}",
+                "families": sorted(LEDGER_FAMILY_SET),
+            }), "400 Bad Request"
+        now = self._clock()
+        try:
+            end = float(params.get("end", now))
+            start = float(params.get("start", end - 3600.0))
+            step = float(params["step"]) if "step" in params else None
+            max_points = int(params.get("max_points", QUERY_MAX_POINTS))
+        except ValueError:
+            return _json_bytes(
+                {"error": "malformed numeric parameter"}
+            ), "400 Bad Request"
+        if start >= end:
+            return _json_bytes(
+                {"error": "start must be before end"}
+            ), "400 Bad Request"
+        stat = params.get("stat", "mean")
+        if stat not in STATS:
+            return _json_bytes(
+                {"error": f"stat must be one of {STATS}"}
+            ), "400 Bad Request"
+        max_points = max(1, min(max_points, QUERY_MAX_POINTS_CEILING))
+        scope = params.get("scope", "fleet")
+        tier_idx = self.store.pick_tier(start, now, step)
+        spec = self.store.tiers[tier_idx]
+        keys = [
+            key for key in self.store.series_keys()
+            if key[0] == family and key[1] == scope
+            and ("pool" not in params or key[2] == params["pool"])
+            and ("slice" not in params or key[3] == params["slice"])
+        ]
+        series = []
+        remaining = max_points
+        next_start = None
+        for key in keys:
+            if remaining <= 0:
+                # Whole-series truncation: continuation resumes at the
+                # window start for the series we never reached.
+                next_start = start if next_start is None else min(
+                    next_start, start
+                )
+                break
+            points, cursor = self.store.query(
+                key, tier_idx, start, end, stat=stat, max_points=remaining
+            )
+            remaining -= len(points)
+            if cursor is not None:
+                next_start = cursor if next_start is None else min(
+                    next_start, cursor
+                )
+            series.append({
+                "scope": key[1],
+                "pool": key[2],
+                "slice": key[3],
+                "stat": "raw" if tier_idx == 0 else stat,
+                "points": [[round(ts, 3), value] for ts, value in points],
+            })
+        doc = {
+            "family": family,
+            "tier": spec.name,
+            "resolution_s": spec.resolution_s,
+            "start": start,
+            "end": end,
+            "series": series,
+        }
+        if next_start is not None:
+            doc["truncated"] = True
+            doc["next_start"] = next_start
+        return _json_bytes(doc), "200 OK"
+
+    def _index_doc(self) -> dict:
+        stats = self.store.stats()
+        return {
+            "now": self._clock(),
+            "families": sorted(LEDGER_FAMILY_SET),
+            "tiers": stats["tiers"],
+            "dropped_chunks": stats["dropped_chunks"],
+            "goodput_totals": self.goodput.totals(),
+            "gap_seconds": self.goodput.gap_seconds,
+            "restored": self.restored,
+        }
+
+    def debug_block(self) -> dict:
+        stats = self.store.stats()
+        block = {
+            "tiers": stats["tiers"],
+            "dropped_chunks": stats["dropped_chunks"],
+            "last_cycle_samples": self.last_cycle_samples,
+            "gap_seconds": self.goodput.gap_seconds,
+            "jobs": len(self.goodput.jobs()),
+            "queries": self.queries_total,
+            "restored": self.restored,
+        }
+        if self.spool is not None:
+            block["spool"] = {
+                "path": self.spool.path,
+                "last_write_ts": self.spool.last_write_ts,
+                "errors": dict(self.spool_errors),
+            }
+        if self.remote_write_url:
+            block["remote_write"] = dict(self.remote_write_counts)
+        return block
+
+
+__all__ = ["LedgerPlane", "QUERY_MAX_POINTS"]

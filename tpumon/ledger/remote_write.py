@@ -1,0 +1,137 @@
+"""Optional Prometheus remote-write push — an external TSDB stays
+optional, never required.
+
+Off by default (empty URL). When TPUMON_FLEET_LEDGER_REMOTE_WRITE_URL
+names an endpoint, the ledger plane pushes the curated family samples
+it just recorded on a bounded cadence, using the remote-write 1.0 wire
+shape: a snappy-compressed protobuf ``WriteRequest`` POST. Both layers
+are hand-rolled on the stdlib (the container bakes no snappy or
+protobuf dependency):
+
+- **protobuf**: ``WriteRequest{repeated TimeSeries{repeated Label,
+  repeated Sample}}`` is nested length-delimited messages over the
+  varint helpers tpumon.backends.reflection already owns — the same
+  trick the gRPC PageRequest codec uses.
+- **snappy**: the *block format* accepts a stream of literal elements
+  with no back-references — a valid (merely uncompressed) snappy body.
+  Prometheus's decoder inflates it like any other; the payload is
+  small (tens of series) and the ledger's own Gorilla chunks are where
+  real compression lives. Honesty over cleverness.
+
+Every push outcome is counted (``tpu_ledger_remote_write_total``
+{result=ok|error}); a dead endpoint costs one bounded timeout per
+cadence tick and never touches the collect loop (the plane pushes on
+the aggregator's fetch executor).
+"""
+
+from __future__ import annotations
+
+import logging
+import urllib.error
+import urllib.request
+
+from tpumon.backends.reflection import _encode_varint
+
+log = logging.getLogger(__name__)
+
+PUSH_ERRORS: tuple[type[BaseException], ...] = (
+    urllib.error.URLError,
+    OSError,
+    ValueError,
+)
+
+
+def snappy_block(data: bytes) -> bytes:
+    """``data`` as a valid snappy *block-format* body built from
+    literal elements only (uncompressed-length preamble + literal
+    chunks). Any conformant decoder round-trips it."""
+    out = bytearray(_encode_varint(len(data)))
+    idx = 0
+    while idx < len(data):
+        chunk = data[idx:idx + 65536]
+        idx += len(chunk)
+        n = len(chunk) - 1
+        if n < 60:
+            out.append(n << 2)
+        elif n < 1 << 8:
+            out.append(60 << 2)
+            out.append(n)
+        elif n < 1 << 16:
+            out.append(61 << 2)
+            out += n.to_bytes(2, "little")
+        else:
+            out.append(62 << 2)
+            out += n.to_bytes(3, "little")
+        out += chunk
+    return bytes(out)
+
+
+def _field(num: int, wire: int) -> bytes:
+    return _encode_varint((num << 3) | wire)
+
+
+def _len_delimited(num: int, payload: bytes) -> bytes:
+    return _field(num, 2) + _encode_varint(len(payload)) + payload
+
+
+def _label(name: str, value: str) -> bytes:
+    return (
+        _len_delimited(1, name.encode())
+        + _len_delimited(2, value.encode())
+    )
+
+
+def _sample(value: float, ts_ms: int) -> bytes:
+    import struct
+
+    out = _field(1, 1) + struct.pack("<d", value)
+    if ts_ms:
+        out += _field(2, 0) + _encode_varint(ts_ms)
+    return out
+
+
+def encode_write_request(series: list[dict]) -> bytes:
+    """``series``: ``[{"labels": {name: value}, "samples": [(ts_ms,
+    value), ...]}, ...]`` -> serialized WriteRequest. Labels are sorted
+    by name (the remote-write spec requires it; __name__ first falls
+    out of plain byte order)."""
+    body = bytearray()
+    for row in series:
+        ts_payload = bytearray()
+        for name, value in sorted(row["labels"].items()):
+            ts_payload += _len_delimited(1, _label(name, str(value)))
+        for ts_ms, value in row["samples"]:
+            ts_payload += _len_delimited(
+                2, _sample(float(value), int(ts_ms))
+            )
+        body += _len_delimited(1, bytes(ts_payload))
+    return bytes(body)
+
+
+def push(url: str, series: list[dict], timeout: float = 5.0) -> None:
+    """One remote-write POST (raises on failure — the caller counts).
+    Deadline-bounded; 2xx is success, anything else raises."""
+    payload = snappy_block(encode_write_request(series))
+    request = urllib.request.Request(
+        url,
+        data=payload,
+        headers={
+            "Content-Type": "application/x-protobuf",
+            "Content-Encoding": "snappy",
+            "X-Prometheus-Remote-Write-Version": "0.1.0",
+            "User-Agent": "tpumon-ledger/1.0",
+        },
+        method="POST",
+    )
+    with urllib.request.urlopen(request, timeout=timeout) as resp:
+        status = getattr(resp, "status", 200)
+        if status // 100 != 2:
+            raise ValueError(f"remote write status {status}")
+
+
+__all__ = [
+    "PUSH_ERRORS",
+    "encode_write_request",
+    "push",
+    "snappy_block",
+]

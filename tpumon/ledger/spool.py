@@ -1,0 +1,137 @@
+"""Ledger warm-restart spool: the week's history survives a reschedule.
+
+Same write discipline as the fleet SnapshotSpool (tpumon/fleet/spool.py
+— the journald/prometheus-WAL genre, scaled down): **atomic** (temp +
+``os.replace``), **versioned** (unknown versions load empty instead of
+exploding on a downgrade), **bounded** (the serialized document is
+refused over ``max_bytes`` — the store's own tier budgets are what keep
+it under), and **corrupt-tolerant** (any load failure quarantines the
+file as ``.corrupt`` and returns empty: a bad spool costs the warm
+start, never the aggregator).
+
+Payload: one JSON document ``{"store": <TieredSeriesStore.to_doc>,
+"goodput": <GoodputLedger.to_doc>, "saved_at": ts}`` — sealed chunks
+ride as base64. The plane uses ``saved_at`` to ledger the restart gap
+(tpu_ledger_gap_seconds_total): downtime becomes *unaccounted*
+chip-seconds and missing samples, never interpolated ones.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import time
+
+log = logging.getLogger(__name__)
+
+LEDGER_SPOOL_VERSION = 1
+LEDGER_SPOOL_NAME = "ledger-spool.json"
+
+
+class LedgerSpool:
+    """One shard's on-disk ledger journal. Single-writer (the collect
+    loop's executor, one save in flight at a time — the plane's
+    in-flight flag mirrors the aggregator snapshot spool)."""
+
+    def __init__(
+        self, directory: str, max_bytes: int = 134217728, clock=time.time
+    ) -> None:
+        self.directory = directory
+        self.path = os.path.join(directory, LEDGER_SPOOL_NAME)
+        self.max_bytes = max(4096, int(max_bytes))
+        self._clock = clock
+        self.last_write_ts = 0.0
+        self.last_load_error: str | None = None
+
+    def save(self, store_doc: dict, goodput_doc: dict) -> bool:
+        doc = {
+            "version": LEDGER_SPOOL_VERSION,
+            "saved_at": self._clock(),
+            "store": store_doc,
+            "goodput": goodput_doc,
+        }
+        try:
+            body = json.dumps(doc, sort_keys=True).encode()
+            if len(body) > self.max_bytes:
+                # The tier byte budgets should make this unreachable;
+                # if they didn't, refusing the write beats an unbounded
+                # disk file on a shared emptyDir.
+                log.warning(
+                    "ledger spool body %d bytes exceeds %d cap; skipped",
+                    len(body), self.max_bytes,
+                )
+                return False
+            os.makedirs(self.directory, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(
+                dir=self.directory, prefix=".ledger-", suffix=".tmp"
+            )
+            try:
+                with os.fdopen(fd, "wb") as fh:
+                    fh.write(body)
+                os.replace(tmp, self.path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    log.debug(
+                        "ledger spool temp cleanup failed", exc_info=True
+                    )
+                raise
+            self.last_write_ts = doc["saved_at"]
+            return True
+        except (OSError, TypeError, ValueError) as exc:
+            log.warning("ledger spool write failed: %s", exc)
+            return False
+
+    def load(self) -> dict:
+        """``{"store": {...}, "goodput": {...}, "saved_at": ts}`` —
+        empty shapes on absence, corruption, or version mismatch."""
+        empty = {"store": {}, "goodput": {}, "saved_at": 0.0}
+        self.last_load_error = None
+        try:
+            with open(self.path, "rb") as fh:
+                raw = fh.read(self.max_bytes + 1)
+        except FileNotFoundError:
+            return empty
+        except OSError as exc:
+            log.warning("ledger spool unreadable: %s", exc)
+            self.last_load_error = str(exc)
+            return empty
+        try:
+            if len(raw) > self.max_bytes:
+                raise ValueError("ledger spool exceeds max_bytes")
+            doc = json.loads(raw.decode())
+            if not isinstance(doc, dict):
+                raise ValueError("ledger spool root is not an object")
+            if doc.get("version") != LEDGER_SPOOL_VERSION:
+                log.warning(
+                    "ledger spool version %r != %d; ignoring",
+                    doc.get("version"), LEDGER_SPOOL_VERSION,
+                )
+                return empty
+            store = doc.get("store")
+            goodput = doc.get("goodput")
+            if not isinstance(store, dict) or not isinstance(goodput, dict):
+                raise ValueError("ledger spool fields have wrong shapes")
+            return {
+                "store": store,
+                "goodput": goodput,
+                "saved_at": float(doc.get("saved_at") or 0.0),
+            }
+        except (ValueError, UnicodeDecodeError) as exc:
+            quarantine = self.path + ".corrupt"
+            log.warning(
+                "ledger spool corrupt (%s); quarantining to %s",
+                exc, quarantine,
+            )
+            self.last_load_error = str(exc)
+            try:
+                os.replace(self.path, quarantine)
+            except OSError:
+                log.debug("ledger spool quarantine failed", exc_info=True)
+            return empty
+
+
+__all__ = ["LedgerSpool", "LEDGER_SPOOL_NAME", "LEDGER_SPOOL_VERSION"]

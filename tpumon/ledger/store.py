@@ -1,0 +1,558 @@
+"""Tiered downsampling time-series store over the curated fleet
+family set.
+
+Three tiers by default — raw collect-cadence samples, 10-second
+buckets, 5-minute buckets — each with its own retention window and
+byte budget. Samples land in the raw tier; every finalized raw-tier
+chunk boundary ALSO feeds per-series downsample accumulators, so a
+coarser tier's bucket is (min, max, count-weighted mean) of the finer
+tier's points. Nothing is ever interpolated: a collect-loop gap simply
+has no samples in any tier (the plane ledgers known gap seconds as a
+counter — absent honestly, never invented).
+
+Storage unit: the immutable sealed chunk (tpumon/ledger/compress.py
+Gorilla codec) plus one bounded open buffer per stream. Aggregate
+tiers keep three parallel streams per series (stat ∈ mean/min/max)
+sharing sample timestamps, so the one codec serves every tier.
+
+Bounding is two-sided per tier: age (``retention_s`` — sealed chunks
+whose newest sample fell out of the window drop) and bytes
+(``max_bytes`` — oldest chunks drop tier-wide first, counted by
+reason). Downsample error is documented, not hidden: a coarse bucket
+whose source window straddles a retention or budget drop aggregates
+the samples that survived; min/max remain true minima/maxima of the
+aggregated points, the mean is weighted by the contributing count.
+
+Pure in-memory + pure functions over time values passed in (no
+clock reads) — the plane owns wall time, the spool owns disk.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from dataclasses import dataclass
+
+from tpumon.ledger.compress import decode_chunk, encode_chunk
+
+#: Chunk seal threshold (samples). 512 raw samples ≈ 8.5 min at 1 Hz;
+#: small enough that retention drops are granular, large enough that
+#: per-chunk overhead (~14 bytes header) amortizes away.
+CHUNK_SAMPLES = 512
+
+#: curated family -> rollup-bucket extractor. THE ledger family set:
+#: what `/ledger` can answer about, what the bench compresses, what
+#: OPERATIONS.md documents. Extractors return None for absent signals
+#: (absent-not-zero, same stance as the live families) — except
+#: stragglers, where 0 active stragglers is a real, meaningful value.
+LEDGER_FAMILY_SET = {
+    "tpu_fleet_duty_cycle_percent": (
+        lambda b: (b.get("duty") or {}).get("mean")
+    ),
+    "tpu_fleet_mfu_ratio": lambda b: b.get("mfu"),
+    "tpu_fleet_step_rate": lambda b: b.get("step_rate"),
+    "tpu_fleet_hbm_headroom_ratio": lambda b: b.get("hbm_headroom_ratio"),
+    "tpu_fleet_stragglers": (
+        lambda b: float(sum(b.get("stragglers", {}).values()))
+    ),
+    "tpu_fleet_energy_watts": lambda b: b.get("energy_watts"),
+    "tpu_fleet_tokens_per_joule": lambda b: b.get("tokens_per_joule"),
+}
+
+#: Aggregate-tier statistic streams.
+STATS = ("mean", "min", "max")
+RAW_STAT = "raw"
+
+
+@dataclass(frozen=True)
+class TierSpec:
+    """One tier: display name, bucket resolution, retention, bytes."""
+
+    name: str
+    resolution_s: float
+    retention_s: float
+    max_bytes: int
+
+
+def default_tiers(
+    retention_csv: str = "", max_bytes_total: int = 67108864
+) -> tuple[TierSpec, ...]:
+    """The 1 s → 10 s → 5 min ladder. ``retention_csv`` overrides the
+    per-tier retention seconds (TPUMON_FLEET_LEDGER_RETENTION_S, three
+    comma-separated values); a malformed entry keeps its default —
+    config-typo tolerance, the tpumon.config stance. The byte budget
+    splits 25/25/50: the coarse tier is the long-memory one and gets
+    half."""
+    retentions = [7200.0, 93600.0, 1209600.0]
+    if retention_csv.strip():
+        parts = retention_csv.split(",")
+        for i, part in enumerate(parts[:3]):
+            try:
+                value = float(part)
+                if value > 0:
+                    retentions[i] = value
+            except ValueError:
+                pass
+    shares = (0.25, 0.25, 0.5)
+    return (
+        TierSpec("1s", 1.0, retentions[0],
+                 max(4096, int(max_bytes_total * shares[0]))),
+        TierSpec("10s", 10.0, retentions[1],
+                 max(4096, int(max_bytes_total * shares[1]))),
+        TierSpec("5m", 300.0, retentions[2],
+                 max(4096, int(max_bytes_total * shares[2]))),
+    )
+
+
+class _Stream:
+    """One series' storage within one tier for one stat: sealed chunks
+    plus the open buffer."""
+
+    __slots__ = ("chunks", "open_ts", "open_vals")
+
+    def __init__(self) -> None:
+        #: [(start_ms, end_ms, n_samples, encoded)] — start-ordered.
+        self.chunks: list[tuple[int, int, int, bytes]] = []
+        self.open_ts: list[int] = []
+        self.open_vals: list[float] = []
+
+    def append(self, ts_ms: int, value: float) -> bool:
+        """Append one sample; seals (returns True) at CHUNK_SAMPLES."""
+        if self.open_ts and ts_ms <= self.open_ts[-1]:
+            return False  # out-of-order/duplicate: first write wins
+        self.open_ts.append(ts_ms)
+        self.open_vals.append(value)
+        if len(self.open_ts) >= CHUNK_SAMPLES:
+            self.seal()
+            return True
+        return False
+
+    def seal(self) -> int:
+        """Encode + append the open buffer as a chunk; bytes added."""
+        if not self.open_ts:
+            return 0
+        data = encode_chunk(self.open_ts, self.open_vals)
+        self.chunks.append(
+            (self.open_ts[0], self.open_ts[-1], len(self.open_ts), data)
+        )
+        self.open_ts = []
+        self.open_vals = []
+        return len(data)
+
+    def bytes_sealed(self) -> int:
+        return sum(len(c[3]) for c in self.chunks)
+
+    def samples(self) -> int:
+        return sum(c[2] for c in self.chunks) + len(self.open_ts)
+
+    def points(self, start_ms: int, end_ms: int):
+        """Yield (ts_ms, value) within [start_ms, end_ms] in order."""
+        for c_start, c_end, _n, data in self.chunks:
+            if c_end < start_ms or c_start > end_ms:
+                continue
+            ts, vals = decode_chunk(data)
+            lo = bisect.bisect_left(ts, start_ms)
+            hi = bisect.bisect_right(ts, end_ms)
+            for i in range(lo, hi):
+                yield ts[i], vals[i]
+        lo = bisect.bisect_left(self.open_ts, start_ms)
+        hi = bisect.bisect_right(self.open_ts, end_ms)
+        for i in range(lo, hi):
+            yield self.open_ts[i], self.open_vals[i]
+
+
+class _Downsample:
+    """One series' in-progress coarse bucket (min/max/weighted mean)."""
+
+    __slots__ = ("bucket_start", "vmin", "vmax", "vsum", "n")
+
+    def __init__(self) -> None:
+        self.bucket_start = -1
+        self.vmin = 0.0
+        self.vmax = 0.0
+        self.vsum = 0.0
+        self.n = 0
+
+    def add(self, value: float, weight: int = 1) -> None:
+        if self.n == 0:
+            self.vmin = self.vmax = value
+        else:
+            self.vmin = min(self.vmin, value)
+            self.vmax = max(self.vmax, value)
+        self.vsum += value * weight
+        self.n += weight
+
+    def finalize(self) -> tuple[float, float, float, int]:
+        out = (self.vsum / self.n, self.vmin, self.vmax, self.n)
+        self.bucket_start = -1
+        self.vmin = self.vmax = self.vsum = 0.0
+        self.n = 0
+        return out
+
+
+class TieredSeriesStore:
+    """The multi-tier store. Single-writer (the collect thread), but
+    READ from serving threads (/ledger range queries, /debug/vars,
+    stats): one lock guards every structural access — streams dict,
+    chunk lists, open buffers — because a seal swaps the open buffer
+    and a retention drop mutates chunk lists mid-iteration otherwise.
+    Writes hold it for one cycle's appends; reads hold it for one
+    bounded query's decode (debug-class traffic)."""
+
+    def __init__(self, tiers: tuple[TierSpec, ...] | None = None) -> None:
+        self.tiers = tuple(tiers) if tiers else default_tiers()
+        self._lock = threading.Lock()
+        #: (series_key, tier_idx, stat) -> _Stream; series_key is the
+        #: (family, scope, pool, slice) tuple.
+        self._streams: dict[tuple, _Stream] = {}  # guarded-by: self._lock
+        #: (series_key, tier_idx) -> _Downsample accumulator feeding
+        #: tier_idx (from tier_idx-1's finalized buckets / raw samples).
+        self._accums: dict[tuple, _Downsample] = {}  # guarded-by: self._lock
+        #: Per-tier sealed byte totals (budget accounting).
+        self._tier_bytes = [0] * len(self.tiers)  # guarded-by: self._lock
+        self.samples_total = [0] * len(self.tiers)  # guarded-by: self._lock
+        self.dropped_chunks = {"retention": 0, "budget": 0}  # guarded-by: self._lock
+        self.last_record_ms = 0  # guarded-by: self._lock
+        #: Records since bounds were last enforced (the full-scan sweep
+        #: is amortized — see record()).
+        self._records_since_enforce = 0  # guarded-by: self._lock
+
+    # -- write -------------------------------------------------------------
+
+    def _stream(self, key: tuple, tier: int, stat: str) -> _Stream:  # holds: self._lock
+        slot = (key, tier, stat)
+        stream = self._streams.get(slot)
+        if stream is None:
+            stream = self._streams[slot] = _Stream()
+        return stream
+
+    #: Bounds-sweep cadence (records): the retention/budget scan walks
+    #: every stream, so it runs amortized — every N records or whenever
+    #: a chunk sealed — instead of per collect cycle.
+    ENFORCE_EVERY = 256
+
+    def record(self, now_s: float, samples: dict[tuple, float]) -> None:
+        """One collect cycle's curated samples: ``{(family, scope, pool,
+        slice): value}`` at wall time ``now_s``. Values land in the raw
+        tier and cascade into every coarser tier's accumulator."""
+        ts_ms = int(round(now_s * 1000.0))
+        with self._lock:
+            if ts_ms <= self.last_record_ms:
+                return  # a clock step backwards must not corrupt dod state
+            self.last_record_ms = ts_ms
+            sealed = False
+            for key, value in samples.items():
+                if value is None:
+                    continue
+                value = float(value)
+                stream = self._stream(key, 0, RAW_STAT)
+                if stream.append(ts_ms, value):
+                    self._tier_bytes[0] += len(stream.chunks[-1][3])
+                    sealed = True
+                self.samples_total[0] += 1
+                self._cascade(key, 1, ts_ms, value, value, value, 1)
+            self._records_since_enforce += 1
+            if sealed or self._records_since_enforce >= self.ENFORCE_EVERY:
+                self._records_since_enforce = 0
+                self._enforce_bounds(ts_ms)
+
+    def _cascade(  # holds: self._lock
+        self, key: tuple, tier: int, ts_ms: int,
+        mean: float, vmin: float, vmax: float, weight: int,
+    ) -> None:
+        """Feed one finer-tier point/bucket into ``tier``'s accumulator;
+        on bucket roll-over, emit the finalized bucket into the tier's
+        streams and recurse one tier coarser."""
+        if tier >= len(self.tiers):
+            return
+        res_ms = int(self.tiers[tier].resolution_s * 1000.0)
+        bucket = (ts_ms // res_ms) * res_ms
+        slot = (key, tier)
+        acc = self._accums.get(slot)
+        if acc is None:
+            acc = self._accums[slot] = _Downsample()
+        if acc.bucket_start >= 0 and bucket != acc.bucket_start:
+            self._emit_bucket(key, tier, acc)
+        if acc.bucket_start < 0:
+            acc.bucket_start = bucket
+        # min/max survive aggregation exactly; the mean is weighted by
+        # the finer tier's contributing counts.
+        if acc.n == 0:
+            acc.vmin, acc.vmax = vmin, vmax
+        else:
+            acc.vmin = min(acc.vmin, vmin)
+            acc.vmax = max(acc.vmax, vmax)
+        acc.vsum += mean * weight
+        acc.n += weight
+
+    def _emit_bucket(self, key: tuple, tier: int, acc: _Downsample) -> None:  # holds: self._lock
+        bucket_ts = acc.bucket_start
+        mean, vmin, vmax, n = acc.finalize()
+        for stat, value in (("mean", mean), ("min", vmin), ("max", vmax)):
+            stream = self._stream(key, tier, stat)
+            if stream.append(bucket_ts, value):
+                self._tier_bytes[tier] += len(stream.chunks[-1][3])
+        self.samples_total[tier] += 1
+        self._cascade(key, tier + 1, bucket_ts, mean, vmin, vmax, n)
+
+    def _enforce_bounds(self, now_ms: int) -> None:  # holds: self._lock
+        for tier_idx, spec in enumerate(self.tiers):
+            horizon = now_ms - int(spec.retention_s * 1000.0)
+            freed = 0
+            for (key, t, _stat), stream in self._streams.items():
+                if t != tier_idx:
+                    continue
+                while stream.chunks and stream.chunks[0][1] < horizon:
+                    freed += len(stream.chunks[0][3])
+                    self.dropped_chunks["retention"] += 1
+                    del stream.chunks[0]
+            self._tier_bytes[tier_idx] -= freed
+            while self._tier_bytes[tier_idx] > spec.max_bytes:
+                # Over budget: drop the tier's OLDEST sealed chunk.
+                oldest_slot = None
+                oldest_start = None
+                for slot, stream in self._streams.items():
+                    if slot[1] != tier_idx or not stream.chunks:
+                        continue
+                    start = stream.chunks[0][0]
+                    if oldest_start is None or start < oldest_start:
+                        oldest_start = start
+                        oldest_slot = slot
+                if oldest_slot is None:
+                    break
+                stream = self._streams[oldest_slot]
+                self._tier_bytes[tier_idx] -= len(stream.chunks[0][3])
+                self.dropped_chunks["budget"] += 1
+                del stream.chunks[0]
+
+    def flush(self) -> None:
+        """Seal every open buffer (bench/occupancy measurement).
+        Accumulators stay open — they persist via :meth:`to_doc` and
+        keep filling after a warm restart, which is what 'resumes
+        mid-tier without double-counting' means."""
+        with self._lock:
+            for (_key, tier, _stat), stream in self._streams.items():
+                if stream.open_ts:
+                    self._tier_bytes[tier] += stream.seal()
+
+    # -- read --------------------------------------------------------------
+
+    def series_keys(self) -> list[tuple]:
+        with self._lock:
+            return sorted({slot[0] for slot in self._streams})
+
+    def pick_tier(self, start_s: float, now_s: float,
+                  step_s: float | None) -> int:
+        """Tier for a range query: the finest tier that (a) still
+        retains ``start_s`` and (b) is not finer than the asked step.
+        A start older than every retention serves from the coarsest
+        tier — bounded-answer-honestly, never an error."""
+        for idx, spec in enumerate(self.tiers):
+            if step_s is not None and spec.resolution_s < step_s * 0.999:
+                continue
+            if now_s - spec.retention_s <= start_s:
+                return idx
+        return len(self.tiers) - 1
+
+    def query(
+        self, key: tuple, tier: int, start_s: float, end_s: float,
+        stat: str = "mean", max_points: int = 2000,
+    ) -> tuple[list[tuple[float, float]], float | None]:
+        """Points for one series from one tier over [start, end].
+
+        Returns ``(points, next_start)``: points as (epoch seconds,
+        value) capped at ``max_points``; ``next_start`` is the
+        continuation cursor (seconds) when the range was truncated —
+        the PR 4 bounded-replay discipline applied to range reads.
+        """
+        use_stat = RAW_STAT if tier == 0 else stat
+        start_ms = int(start_s * 1000.0)
+        end_ms = int(end_s * 1000.0)
+        out: list[tuple[float, float]] = []
+        # Under the lock end to end: points() walks chunk lists and the
+        # open buffer, both of which the collect thread mutates (seal
+        # swaps the buffer, retention pops chunks). The hold is bounded
+        # by max_points on debug-class traffic.
+        with self._lock:
+            stream = self._streams.get((key, tier, use_stat))
+            if stream is None:
+                return [], None
+            for ts_ms, value in stream.points(start_ms, end_ms):
+                if len(out) >= max_points:
+                    return out, ts_ms / 1000.0
+                out.append((ts_ms / 1000.0, value))
+        return out, None
+
+    def stats(self) -> dict:
+        """Per-tier occupancy for the tpu_ledger_* self-metrics and the
+        bench's bytes-per-raw-sample headline."""
+        tiers = []
+        with self._lock:
+            per_tier = [
+                (set(), [0], [0], [0]) for _ in self.tiers
+            ]
+            for (key, t, stat), stream in self._streams.items():
+                series, sealed_b, sealed_n, open_n = per_tier[t]
+                series.add(key)
+                if tier_primary_stat(t) == stat:
+                    sealed_n[0] += sum(c[2] for c in stream.chunks)
+                    open_n[0] += len(stream.open_ts)
+                sealed_b[0] += stream.bytes_sealed()
+            dropped = dict(self.dropped_chunks)
+        for idx, spec in enumerate(self.tiers):
+            series, sealed_b, sealed_n, open_n = per_tier[idx]
+            sealed_bytes = sealed_b[0]
+            sealed_samples = sealed_n[0]
+            open_samples = open_n[0]
+            tiers.append({
+                "name": spec.name,
+                "resolution_s": spec.resolution_s,
+                "retention_s": spec.retention_s,
+                "max_bytes": spec.max_bytes,
+                "series": len(series),
+                "sealed_bytes": sealed_bytes,
+                "sealed_samples": sealed_samples,
+                "open_samples": open_samples,
+            })
+        return {
+            "tiers": tiers,
+            "dropped_chunks": dropped,
+        }
+
+    # -- spool round-trip ---------------------------------------------------
+
+    def to_doc(self) -> dict:
+        """JSON-able state for the ledger spool: sealed chunks plus the
+        open buffers AS PLAIN LISTS (force-sealing per journal cadence
+        would fragment coarse-tier chunks down to a few samples each
+        and wreck the bytes-per-sample density the tiers exist for)
+        plus downsample accumulators so a restart resumes MID-BUCKET
+        instead of emitting a short duplicate bucket."""
+        import base64
+
+        with self._lock:
+            streams = []
+            for (key, tier, stat), stream in sorted(
+                self._streams.items(), key=lambda kv: (kv[0][1], kv[0][0])
+            ):
+                if not stream.chunks and not stream.open_ts:
+                    continue
+                streams.append({
+                    "key": list(key),
+                    "tier": tier,
+                    "stat": stat,
+                    "chunks": [
+                        [c[0], c[1], c[2],
+                         base64.b64encode(c[3]).decode("ascii")]
+                        for c in stream.chunks
+                    ],
+                    "open": [list(stream.open_ts), list(stream.open_vals)],
+                })
+            accums = []
+            for (key, tier), acc in sorted(
+                self._accums.items(), key=lambda kv: (kv[0][1], kv[0][0])
+            ):
+                if acc.bucket_start < 0 or acc.n == 0:
+                    continue
+                accums.append({
+                    "key": list(key), "tier": tier,
+                    "bucket_start": acc.bucket_start,
+                    "min": acc.vmin, "max": acc.vmax,
+                    "sum": acc.vsum, "n": acc.n,
+                })
+            return {
+                "streams": streams,
+                "accums": accums,
+                "last_record_ms": self.last_record_ms,
+                "samples_total": list(self.samples_total),
+            }
+
+    @classmethod
+    def from_doc(
+        cls, doc: dict, tiers: tuple[TierSpec, ...] | None = None
+    ) -> "TieredSeriesStore":
+        """Rebuild from a spool doc; malformed entries are skipped
+        individually (a partially corrupt spool restores what it can)."""
+        import base64
+
+        store = cls(tiers)
+        # The fresh store is unpublished (single-threaded here); the
+        # lock is held anyway so the discipline is uniform.
+        with store._lock:
+            return cls._restore_into(store, doc)
+
+    @staticmethod
+    def _restore_into(
+        store: "TieredSeriesStore", doc: dict
+    ) -> "TieredSeriesStore":
+        # holds: store._lock
+        import base64
+
+        for row in doc.get("streams", ()):
+            try:
+                key = tuple(row["key"])
+                tier = int(row["tier"])
+                stat = str(row["stat"])
+                if tier >= len(store.tiers):
+                    continue
+                stream = store._stream(key, tier, stat)
+                for start, end, n, b64 in row["chunks"]:
+                    data = base64.b64decode(b64)
+                    stream.chunks.append(
+                        (int(start), int(end), int(n), data)
+                    )
+                    store._tier_bytes[tier] += len(data)
+                open_buf = row.get("open")
+                if (
+                    isinstance(open_buf, list) and len(open_buf) == 2
+                    and isinstance(open_buf[0], list)
+                    and isinstance(open_buf[1], list)
+                    and len(open_buf[0]) == len(open_buf[1])
+                ):
+                    stream.open_ts = [int(t) for t in open_buf[0]]
+                    stream.open_vals = [float(v) for v in open_buf[1]]
+            except (KeyError, TypeError, ValueError):
+                continue
+        for row in doc.get("accums", ()):
+            try:
+                key = tuple(row["key"])
+                tier = int(row["tier"])
+                if tier < 1 or tier >= len(store.tiers):
+                    continue
+                acc = _Downsample()
+                acc.bucket_start = int(row["bucket_start"])
+                acc.vmin = float(row["min"])
+                acc.vmax = float(row["max"])
+                acc.vsum = float(row["sum"])
+                acc.n = int(row["n"])
+                store._accums[(key, tier)] = acc
+            except (KeyError, TypeError, ValueError):
+                continue
+        store.last_record_ms = int(doc.get("last_record_ms") or 0)
+        totals = doc.get("samples_total")
+        if isinstance(totals, list) and len(totals) == len(
+            store.samples_total
+        ):
+            try:
+                store.samples_total = [int(v) for v in totals]
+            except (TypeError, ValueError):
+                pass
+        return store
+
+
+def tier_primary_stat(tier: int) -> str:
+    """The stat stream whose sample count IS the tier's sample count
+    (raw for tier 0, mean above — min/max share its timestamps)."""
+    return RAW_STAT if tier == 0 else "mean"
+
+
+__all__ = [
+    "CHUNK_SAMPLES",
+    "LEDGER_FAMILY_SET",
+    "RAW_STAT",
+    "STATS",
+    "TierSpec",
+    "TieredSeriesStore",
+    "default_tiers",
+    "tier_primary_stat",
+]

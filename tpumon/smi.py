@@ -529,6 +529,101 @@ def aggregator_snapshot(url: str, timeout: float) -> dict:
     return {"aggregator": doc, "aggregator_url": url, "ts": time.time()}
 
 
+def ledger_snapshot(
+    url: str, timeout: float, job: str | None = None
+) -> dict:
+    """The ``--ledger`` view's data: the aggregator's goodput split and
+    the fleet tokens/J trend from its ``GET /ledger`` range API
+    (tpumon/ledger). Same bounded retry discipline as ``--aggregator``:
+    three tries over at most ~2 s per fetch, then the error propagates
+    to ordinary handling."""
+    from tpumon.resilience import RetryPolicy, retry_call
+
+    policy = RetryPolicy(
+        attempts=3, base_s=0.2, max_s=1.0, deadline_s=max(2.0, timeout)
+    )
+    base = url.rstrip("/")
+
+    def fetch(path: str) -> dict:
+        return json.loads(retry_call(
+            lambda: _fetch(base + path, timeout),
+            policy,
+            retryable=FETCH_ERRORS,
+        ))
+
+    goodput = fetch("/ledger?view=goodput")
+    now = time.time()
+    trend = fetch(
+        "/ledger?family=tpu_fleet_tokens_per_joule&scope=fleet"
+        f"&start={now - 3600.0:.3f}&end={now:.3f}&step=10"
+    )
+    return {
+        "ledger": {"goodput": goodput, "tokens_per_joule": trend,
+                   "job": job},
+        "aggregator_url": url,
+        "ts": now,
+    }
+
+
+def render_ledger(snap: dict, out=None) -> None:
+    """The ``--ledger`` view: per-job goodput splits (chip-hours by
+    bucket, unaccounted called out — see the OPERATIONS.md goodput
+    triage runbook for reading unaccounted vs idle) and the fleet
+    tokens/J trend over the last hour."""
+    out = out if out is not None else sys.stdout
+    doc = snap["ledger"]
+
+    def p(line: str = "") -> None:
+        print(line, file=out)
+
+    goodput = doc.get("goodput", {})
+    jobs = goodput.get("jobs", [])
+    job_filter = doc.get("job")
+    if job_filter:
+        jobs = [j for j in jobs if j.get("slice") == job_filter]
+    p(f"GOODPUT ledger @ {snap.get('aggregator_url', '?')}"
+      + (f" [job {job_filter}]" if job_filter else ""))
+    if not jobs:
+        p("  no accounted jobs"
+          + (f" matching slice {job_filter!r}" if job_filter else "")
+          + " yet")
+    for row in jobs:
+        total = row.get("chip_seconds") or 0.0
+        buckets = row.get("buckets", {})
+        hours = total / 3600.0
+        parts = []
+        for bucket in ("productive", "checkpoint", "restore",
+                       "preempted", "idle", "contended", "unaccounted"):
+            value = buckets.get(bucket, 0.0)
+            if total > 0 and value > 0:
+                label = bucket if bucket != "unaccounted" else "UNACCOUNTED"
+                parts.append(f"{label} {value / total:.1%}")
+        ratio = row.get("goodput_ratio")
+        p(
+            f"  {row.get('slice', '?')} [{row.get('pool', '?')}]: "
+            f"{hours:.2f} chip-h"
+            + (f", goodput {ratio:.1%}" if ratio is not None else "")
+            + (" — " + ", ".join(parts) if parts else "")
+        )
+    gap = goodput.get("gap_seconds")
+    if gap:
+        p(f"  aggregator-blind gap ledgered: {gap:.0f}s (unaccounted)")
+    trend = doc.get("tokens_per_joule", {})
+    series = trend.get("series") or []
+    points = series[0].get("points", []) if series else []
+    if points:
+        values = [v for _ts, v in points]
+        p(
+            f"tokens/J (fleet, last 1h @ {trend.get('tier', '?')} tier): "
+            f"{values[0]:.1f} -> {values[-1]:.1f} "
+            f"(min {min(values):.1f} / max {max(values):.1f}, "
+            f"n={len(values)})"
+        )
+    else:
+        p("tokens/J: no samples in the last hour "
+          "(no energy-reporting hosts, or a young ledger)")
+
+
 def render_aggregator(snap: dict, out=None) -> None:
     """The ``--aggregator`` view: the aggregator's per-node snapshots
     through the same fleet table, then the pre-aggregated rollup lines
@@ -921,6 +1016,18 @@ def main(argv: list[str] | None = None, out=None) -> int:
         "instead of fanning out to every exporter from this CLI",
     )
     parser.add_argument(
+        "--ledger",
+        action="store_true",
+        help="with --aggregator: render per-job goodput splits and the "
+        "fleet tokens/J trend from the aggregator's /ledger API "
+        "(tpumon/ledger) instead of the node table",
+    )
+    parser.add_argument(
+        "--job",
+        metavar="SLICE",
+        help="filter the --ledger goodput view to one job's slice",
+    )
+    parser.add_argument(
         "--watch", type=float, metavar="SEC", help="refresh every SEC seconds"
     )
     parser.add_argument("--json", action="store_true", help="machine-readable output")
@@ -939,6 +1046,9 @@ def main(argv: list[str] | None = None, out=None) -> int:
 
     Config.add_args(parser)
     args = parser.parse_args(argv)
+    if args.ledger and not args.aggregator:
+        parser.error("--ledger requires --aggregator URL (the ledger "
+                     "lives in the fleet aggregator)")
     out = out if out is not None else sys.stdout
 
     # The data source is chosen once and sticks: under --watch a transient
@@ -1015,6 +1125,12 @@ def main(argv: list[str] | None = None, out=None) -> int:
         return snap
 
     def _chip_snapshot() -> dict:
+        if args.ledger:
+            # Efficiency-ledger view: the aggregator's /ledger API
+            # (goodput splits + tokens/J trend), not the node table.
+            return ledger_snapshot(
+                args.aggregator, args.timeout, job=args.job
+            )
         if args.aggregator:
             # The fleet tier already fanned in and rolled up; one fetch
             # renders the whole fleet whatever its size.
@@ -1052,6 +1168,8 @@ def main(argv: list[str] | None = None, out=None) -> int:
     def emit(snap: dict) -> None:
         if args.json:
             print(json.dumps(snap, sort_keys=True), file=out)
+        elif "ledger" in snap:
+            render_ledger(snap, out)
         elif "aggregator" in snap:
             render_aggregator(snap, out)
             if "workload" in snap:

@@ -18,6 +18,7 @@ from tpumon.families import (
     HEALTH_FAMILIES,
     HOSTCORR_FAMILIES,
     IDENTITY_FAMILIES,
+    LEDGER_FAMILIES,
     LIFECYCLE_FAMILIES,
     SELF_FAMILIES,
     STEP_FAMILIES,
@@ -248,6 +249,28 @@ def render() -> str:
         "|---|---|---|---|",
     ]
     for name, (kind, desc, labels) in FLEET_FAMILIES.items():
+        label_s = ", ".join(f"`{l}`" for l in labels) or "—"
+        lines.append(f"| `{name}` | {kind} | {desc} | {label_s} |")
+
+    lines += [
+        "",
+        "## Fleet efficiency ledger (`tpumon/ledger`, aggregator `/metrics` + `GET /ledger`)",
+        "",
+        "Long-horizon tiered storage (1 s → 10 s → 5 min) over the curated",
+        "rollup family set plus per-job goodput chip-second accounting,",
+        "inside the aggregator. `tpu_fleet_goodput_chip_seconds_total`",
+        "conserves by construction: per job, buckets sum to observed",
+        "wall-clock × chips, with invisible windows (stale/dark nodes,",
+        "aggregator restarts) landing in `bucket=\"unaccounted\"` — never",
+        "silently in idle. Range queries over any curated family at any",
+        "scope are served by `GET /ledger` from the correct tier (see",
+        "docs/OPERATIONS.md for knobs, remote-write setup, and the",
+        "goodput triage runbook).",
+        "",
+        "| family | type | description | labels |",
+        "|---|---|---|---|",
+    ]
+    for name, (kind, desc, labels) in LEDGER_FAMILIES.items():
         label_s = ", ".join(f"`{l}`" for l in labels) or "—"
         lines.append(f"| `{name}` | {kind} | {desc} | {label_s} |")
 

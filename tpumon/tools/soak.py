@@ -1923,6 +1923,354 @@ def fleet_delta_soak(
     }
 
 
+class _ScriptedLedgerNode:
+    """One scripted exporter endpoint for the ledger soak: a real HTTP
+    /metrics server whose exposition text follows the phase script
+    (duty, step rate, lifecycle transitions, checkpoint counters) —
+    the aggregator's ingest path sees genuine pages, the goodput
+    ledger sees genuine signals, and ``dead`` makes the endpoint
+    answer 503 so the feed ages to stale exactly like a killed pod."""
+
+    def __init__(self, slice_name: str, host: str, chips: int = 4,
+                 pool: str = "v5p-16") -> None:
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.slice_name = slice_name
+        self.host = host
+        self.chips = chips
+        self.pool = pool
+        self.state = {
+            "duty": 70.0,
+            "step_rate": 2.0,
+            "transition": 0.0,
+            "events": {"preemption": 0.0, "resize": 0.0, "restore": 0.0},
+            "ckpt_saves": 0.0,
+            "wait": 0.05,
+            "dead": False,
+        }
+        node = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib casing)
+                if node.state["dead"]:
+                    self.send_response(503)
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    return
+                body = node.page().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_port
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def page(self) -> str:
+        s = self.state
+        lines = []
+        for chip in range(self.chips):
+            lines.append(
+                f'accelerator_info{{chip="{chip}",coords="{chip},0,0",'
+                f'accelerator="{self.pool}",slice="{self.slice_name}",'
+                f'host="{self.host}"}} 1.0'
+            )
+            lines.append(
+                f'accelerator_duty_cycle_percent{{chip="{chip}"}} '
+                f"{s['duty']}"
+            )
+        lines.append(f"accelerator_device_count {self.chips}")
+        lines.append(
+            f"collector_last_poll_timestamp_seconds {time.time()}"
+        )
+        lines.append(f"tpu_lifecycle_state {s['transition']}")
+        for kind, count in s["events"].items():
+            lines.append(
+                f'tpu_lifecycle_events_total{{kind="{kind}"}} {count}'
+            )
+        lines.append(
+            f'tpu_lifecycle_checkpoints_total{{op="save"}} '
+            f"{s['ckpt_saves']}"
+        )
+        lines.append(f"tpu_lifecycle_step_rate {s['step_rate']}")
+        lines.append(
+            f"tpu_lifecycle_collective_wait_fraction {s['wait']}"
+        )
+        return "\n".join(lines) + "\n"
+
+    def close(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def ledger_soak(
+    duration_s: float,
+    nodes: int = 4,
+    interval: float = 0.25,
+    scrape_every_s: float = 1.0,
+    spool_dir: str | None = None,
+) -> dict:
+    """Ledger acceptance soak (ISSUE 14): a scripted fleet walks
+    through productive → checkpoint → preemption → restore → idle →
+    KILL → recovery phases behind a ledger-enabled aggregator (with a
+    warm restart between idle and the kill window), and the record
+    carries the asserted evidence: per-phase bucket accrual, the
+    conservation invariant (buckets sum == observed wall × chips, per
+    job AND against an independent wall-clock expectation), honesty
+    (the kill window lands in unaccounted, idle accrues ZERO), spool
+    restore, and a range query answered from the store."""
+    import shutil as _shutil
+    import tempfile
+    import urllib.request
+
+    from tpumon.fleet.config import FleetConfig
+    from tpumon.fleet.server import build_aggregator
+
+    if duration_s < 20 * interval:
+        raise ValueError(
+            "ledger soak needs >= 20 intervals to walk its phases"
+        )
+    own_spool = spool_dir is None
+    if own_spool:
+        spool_dir = tempfile.mkdtemp(prefix="tpumon-ledger-soak-")
+    sim = [
+        _ScriptedLedgerNode(
+            f"job-{'ab'[i % 2]}", f"n{i}",
+        )
+        for i in range(nodes)
+    ]
+    chips_total = sum(n.chips for n in sim)
+
+    def build(stale_s: float) -> object:
+        cfg = FleetConfig(
+            port=0, addr="127.0.0.1",
+            targets=",".join(n.url for n in sim),
+            interval=interval, stale_s=stale_s, evict_s=3600.0,
+            guard=False, trace=False,
+            # Short recovery: dead feeds re-probe within ~8 intervals
+            # so the post-kill phase demonstrably returns to productive
+            # inside the soak window (the default 60 s ceiling is sized
+            # for real fleets).
+            poll_backoff_max_s=max(1.0, 8 * interval),
+            ledger_spool_dir=spool_dir, ledger_spool_every_s=1.0,
+        )
+        agg = build_aggregator(cfg)
+        agg.start()
+        return agg
+
+    stale_s = 3.0 * interval
+    agg = build(stale_s)
+    failed_scrapes = 0
+    scrapes = 0
+
+    def goodput_doc() -> dict:
+        with urllib.request.urlopen(
+            agg.url + "/ledger?view=goodput", timeout=5
+        ) as resp:
+            return json.loads(resp.read())
+
+    def totals() -> dict:
+        doc = goodput_doc()
+        out = dict(doc["totals"])
+        out["_jobs"] = doc["jobs"]
+        out["_gap"] = doc["gap_seconds"]
+        return out
+
+    def delta(a: dict, b: dict) -> dict:
+        return {
+            k: round(b[k] - a[k], 3)
+            for k in b
+            if not k.startswith("_") and b[k] - a[k] > 1e-9
+        }
+
+    #: (name, end-fraction, state mutation applied at phase START).
+    def enter_productive():
+        for n in sim:
+            n.state.update(duty=70.0, step_rate=2.0, transition=0.0)
+
+    def enter_checkpoint():
+        for n in sim:
+            n.state.update(duty=15.0, step_rate=0.0, transition=0.0)
+
+    def enter_preempt():
+        for n in sim:
+            n.state["events"]["preemption"] += 1
+            n.state.update(transition=1.0, duty=5.0, step_rate=0.0)
+
+    def enter_restore():
+        for n in sim:
+            n.state["events"]["restore"] += 1
+            n.state.update(transition=1.0, duty=5.0, step_rate=0.0)
+
+    def enter_idle():
+        for n in sim:
+            n.state.update(duty=1.0, step_rate=0.0, transition=0.0)
+
+    def enter_kill():
+        for n in sim:
+            n.state["dead"] = True
+
+    def enter_recover():
+        for n in sim:
+            n.state["dead"] = False
+            n.state.update(duty=70.0, step_rate=2.0, transition=0.0)
+
+    phases = [
+        ("productive", 0.22, enter_productive),
+        ("checkpoint", 0.38, enter_checkpoint),
+        ("preempted", 0.50, enter_preempt),
+        ("restore", 0.62, enter_restore),
+        ("idle", 0.72, enter_idle),
+        ("kill", 0.88, enter_kill),
+        ("recovery", 1.00, enter_recover),
+    ]
+    t0 = time.time()
+    time.sleep(3 * interval)  # first accounting windows land
+    t_first = time.time()
+    phase_records: dict[str, dict] = {}
+    restart_info: dict = {}
+    try:
+        before = totals()
+        for name, end_frac, enter in phases:
+            if name == "kill":
+                # Warm restart between idle and the kill window: the
+                # restart must restore every tier and ledger its gap.
+                agg.close()
+                gap_target = max(1.0, 4 * interval)
+                time.sleep(gap_target)
+                agg = build(stale_s)
+                time.sleep(2 * interval)
+                with urllib.request.urlopen(
+                    agg.url + "/ledger", timeout=5
+                ) as resp:
+                    index = json.loads(resp.read())
+                restart_info = {
+                    "restored": index.get("restored"),
+                    "gap_seconds": round(index.get("gap_seconds", 0.0), 3),
+                    "gap_target": gap_target,
+                }
+                before = totals()  # re-anchor (gap charged at load)
+            enter()
+            if name == "checkpoint":
+                # Advance the save counter every half interval so EVERY
+                # accounting window inside the phase sees an advance.
+                deadline = t0 + end_frac * duration_s
+                while time.time() < deadline:
+                    for n in sim:
+                        n.state["ckpt_saves"] += 1
+                    time.sleep(interval / 2.0)
+            else:
+                while time.time() < t0 + end_frac * duration_s:
+                    time.sleep(scrape_every_s)
+                    scrapes += 1
+                    try:
+                        with urllib.request.urlopen(
+                            agg.url + "/metrics", timeout=5
+                        ) as resp:
+                            if resp.status != 200:
+                                failed_scrapes += 1
+                            resp.read()
+                    except OSError:
+                        failed_scrapes += 1
+            # Give the last windows of the phase one cycle to land.
+            time.sleep(2 * interval)
+            after = totals()
+            phase_records[name] = delta(before, after)
+            before = after
+        t_end = time.time()
+        final = goodput_doc()
+        # Conservation, two ways. Exact: per job, buckets sum to the
+        # reported chip-seconds (identity by construction — pinned so a
+        # refactor cannot quietly break it). Independent: summed
+        # chip-seconds match wall-clock × chips (the soak's own clock),
+        # downtime included because the gap charge covers it.
+        worst_exact = 0.0
+        total_chip_seconds = 0.0
+        for job in final["jobs"]:
+            worst_exact = max(
+                worst_exact,
+                abs(sum(job["buckets"].values()) - job["chip_seconds"]),
+            )
+            total_chip_seconds += job["chip_seconds"]
+        expected = chips_total * (t_end - t_first)
+        tolerance = chips_total * (6 * interval + 2.0)
+        conservation_ratio = (
+            total_chip_seconds / expected if expected > 0 else None
+        )
+        # Honesty: once the kill window crosses the stale threshold,
+        # accrual must land in unaccounted and NEVER in idle — a
+        # partition reading as an idle fleet is the lie this ledger
+        # exists to not tell. The pre-stale tail (last-good data still
+        # inside the freshness budget, honestly classified from the
+        # frozen page) is the one allowed idle contribution.
+        kill = phase_records.get("kill", {})
+        idle_tail_allowance = chips_total * (stale_s + 2 * interval)
+        violations = 0
+        if kill.get("idle", 0.0) > idle_tail_allowance:
+            violations += 1
+        if kill.get("unaccounted", 0.0) <= 0.0:
+            violations += 1
+        # Range query over the whole soak from the store.
+        with urllib.request.urlopen(
+            agg.url + "/ledger?family=tpu_fleet_duty_cycle_percent"
+            f"&scope=fleet&start={t0:.3f}&end={time.time():.3f}",
+            timeout=5,
+        ) as resp:
+            rq = json.loads(resp.read())
+        record = {
+            "mode": "ledger",
+            "duration_s": round(time.time() - t0, 1),
+            "nodes": nodes,
+            "chips_total": chips_total,
+            "interval": interval,
+            "phases": phase_records,
+            "overall_buckets": {
+                k: round(v, 3) for k, v in final["totals"].items()
+            },
+            "gap_seconds": round(final["gap_seconds"], 3),
+            "conservation_exact_worst_abs": round(worst_exact, 9),
+            "conservation_ratio": (
+                round(conservation_ratio, 4)
+                if conservation_ratio is not None else None
+            ),
+            "conservation_tolerance_ratio": round(
+                tolerance / expected, 4
+            ) if expected else None,
+            "honesty_violations": violations,
+            "kill_idle_tail_allowance": round(idle_tail_allowance, 3),
+            "restart": restart_info,
+            "query": {
+                "tier": rq.get("tier"),
+                "series": len(rq.get("series", [])),
+                "points": sum(
+                    len(s["points"]) for s in rq.get("series", [])
+                ),
+            },
+            "scrapes": scrapes,
+            "failed_scrapes": failed_scrapes,
+        }
+        return record
+    finally:
+        agg.close()
+        for n in sim:
+            n.close()
+        if own_spool:
+            _shutil.rmtree(spool_dir, ignore_errors=True)
+
+
 def _free_port() -> int:
     """An ephemeral port the OS just handed out (racy by nature, fine
     for a soak: the fleet-chaos shards need KNOWN ports up front so the
@@ -2447,6 +2795,16 @@ def main(argv=None) -> int:
                         "aggregator restart (spool warm start); reports "
                         "visibility honesty, takeover windows, ingest "
                         "rejects, and restart latency")
+    parser.add_argument("--ledger", action="store_true",
+                        help="fleet efficiency ledger acceptance soak "
+                        "(tpumon/ledger): a scripted fleet walks "
+                        "productive/checkpoint/preemption/restore/idle"
+                        "/kill/recovery phases behind a ledger-enabled "
+                        "aggregator (warm restart included); reports "
+                        "per-phase goodput bucket accrual, the "
+                        "conservation invariant, kill-window honesty "
+                        "(unaccounted, never idle), spool restore, and "
+                        "a served range query")
     parser.add_argument("--fleet-delta", action="store_true",
                         help="delta fan-in acceptance soak (ISSUE 13): "
                         "--fleet-nodes simulated exporters behind one "
@@ -2501,6 +2859,11 @@ def main(argv=None) -> int:
     elif args.straggler:
         record = straggler_soak(
             args.duration, topology=args.topology,
+            interval=args.interval, scrape_every_s=args.scrape_every,
+        )
+    elif args.ledger:
+        record = ledger_soak(
+            args.duration, nodes=args.fleet_nodes,
             interval=args.interval, scrape_every_s=args.scrape_every,
         )
     elif args.fleet_delta:

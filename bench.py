@@ -473,17 +473,28 @@ def measure_fanin_delta(page_text: str, iterations: int = 200) -> dict:
     }
 
 
-def measure_rollup_churn(
+def measure_rollup(
     nodes: int = 256, cycles: int = 30,
 ) -> dict:
-    """Incremental-rollup CPU vs churn rate: update() cost over a
-    synthetic fleet at 0% / 1% / 10% / 100% content churn per cycle.
-    ``cpu_us_per_pct_churn`` is the marginal cost of one percent of the
-    fleet churning — the slope the delta fan-in keeps flat as idle
-    nodes are added."""
+    """Fleet rollup scaling bench (ISSUE 15 acceptance): the striped +
+    native-kernel path vs the single-lock pure-Python reference fold.
+
+    Three claims, all CPU-bound (scheduler-robust on shared runners):
+
+    - **churn proportionality** at ``nodes``: update() cost at 0% / 1%
+      / 10% / 100% content churn per cycle; ``cpu_us_per_pct_churn``
+      is the marginal cost of one percent of the fleet churning (gate:
+      ≤ half of BENCH_r08's 16.7 µs/%).
+    - **full-rollup A/B at 4× nodes**: the 100%-churn update (the
+      full-rollup shape, through the native bucket kernel) vs the
+      single-lock reference ``rollup()`` — the pure-Python whole-fleet
+      fold every pre-ISSUE-15 cycle paid (gate: ≥3× faster).
+    - **idle path at 4× nodes**: no worse than the pre-stripe idle
+      floor (the per-feed key scan is the only O(fleet) term).
+    """
     import random as _random
 
-    from tpumon.fleet.rollup import IncrementalRollup
+    from tpumon.fleet.rollup import IncrementalRollup, native_kernel
 
     rng = _random.Random(7)
 
@@ -504,7 +515,7 @@ def measure_rollup_churn(
             "ici": {"healthy": 4, "total": 4},
         }
 
-    out: dict = {"nodes": nodes}
+    out: dict = {"nodes": nodes, "native_kernel": native_kernel() is not None}
     per_churn = {}
     for churn_pct in (0, 1, 10, 100):
         roll = IncrementalRollup()
@@ -534,15 +545,16 @@ def measure_rollup_churn(
     out["full_vs_idle_ratio"] = (
         round(full_churn / flat, 1) if flat else None
     )
-    # Flat-as-the-fleet-grows evidence: idle update() at 4x the nodes
-    # (the per-feed key scan is the only O(fleet) term) vs what
-    # re-rolling the world costs at that size (the pre-delta baseline).
+    # The 4×-nodes A/B: idle and full-churn update() vs the single-lock
+    # reference fold at that size (the pre-delta, pre-kernel baseline
+    # BENCH_r08 measured at 15.0 ms p50 / 1024 nodes).
     from tpumon.fleet.rollup import rollup as full_rollup
 
     big = nodes * 4
     roll = IncrementalRollup()
     snaps = {i: mk_snap(i) for i in range(big)}
-    entries = [(f"n{i}", snaps[i], "up", 1) for i in range(big)]
+    seqs = dict.fromkeys(range(big), 1)
+    entries = [(f"n{i}", snaps[i], "up", seqs[i]) for i in range(big)]
     roll.update(entries)
     samples = []
     for _ in range(cycles):
@@ -550,17 +562,33 @@ def measure_rollup_churn(
         roll.update(entries)
         samples.append((time.perf_counter() - t0) * 1e3)
     idle_big, _ = _percentiles(samples)
+    samples = []
+    for _cycle in range(max(8, cycles // 2)):
+        for i in range(big):
+            snaps[i] = mk_snap(i)
+            seqs[i] += 1
+        entries = [
+            (f"n{i}", snaps[i], "up", seqs[i]) for i in range(big)
+        ]
+        t0 = time.perf_counter()
+        roll.update(entries)
+        samples.append((time.perf_counter() - t0) * 1e3)
+    full_update_big, _ = _percentiles(samples)
     ref = [{"snap": snaps[i], "state": "up"} for i in range(big)]
     samples = []
-    for _ in range(max(5, cycles // 3)):
+    for _ in range(max(8, cycles // 2)):
         t0 = time.perf_counter()
         full_rollup(ref)
         samples.append((time.perf_counter() - t0) * 1e3)
     full_big, _ = _percentiles(samples)
     out["idle_update_p50_ms_at_4x_nodes"] = round(idle_big, 4)
-    out["full_rollup_p50_ms_at_4x_nodes"] = round(full_big, 4)
+    out["full_update_p50_ms_at_4x_nodes"] = round(full_update_big, 4)
+    out["single_lock_rollup_p50_ms_at_4x_nodes"] = round(full_big, 4)
     out["idle_vs_full_rollup_at_4x"] = (
         round(idle_big / full_big, 4) if full_big else None
+    )
+    out["full_rollup_speedup_vs_single_lock"] = (
+        round(full_big / full_update_big, 2) if full_update_big else None
     )
     return out
 
@@ -789,9 +817,9 @@ def main() -> int:
     finally:
         exporter.close()
 
-    # Incremental-rollup churn microbench: CPU-bound, runs after the
+    # Rollup scaling microbench (ISSUE 15): CPU-bound, runs after the
     # latency loops so it can't pollute their tails.
-    rollup_churn = measure_rollup_churn()
+    rollup_bench = measure_rollup()
 
     # Ledger compression density over a 26 h simulated horizon — the
     # ISSUE 14 acceptance gate (5 min tier ≤ 0.15 B/raw-sample/series).
@@ -849,7 +877,7 @@ def main() -> int:
                     "fanin": fanin,
                     "fanin_delta": fanin_delta,
                     "subdelta": subdelta,
-                    "rollup_churn": rollup_churn,
+                    "rollup": rollup_bench,
                     "ledger": ledger,
                     "sustained": sustained,
                 },

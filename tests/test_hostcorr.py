@@ -973,3 +973,71 @@ def test_no_pod_dirs_keeps_node_scope_fallback(proc_tree):
     plane = HostCorrPlane(proc_root=proc_tree.root)
     fams = {f.name for f in plane.cycle(2.0, _Stats({}))}
     assert "tpu_hostcorr_pod_psi_share" not in fams  # absent-not-zero
+
+
+# -- step-skew job grouping (ISSUE 15 satellite) -----------------------------
+
+
+def test_same_job_step_seconds_groups_by_mesh_signature():
+    from tpumon.hostcorr.plane import _same_job_step_seconds
+
+    feeds = {
+        # Job A: 3 hosts of one dp job — comparable.
+        "a1": {"step_seconds": 1.0, "axes": {"dp": 4, "tp": 1}},
+        "a2": {"step_seconds": 1.1, "axes": {"dp": 4, "tp": 1}},
+        "a3": {"step_seconds": 2.4, "axes": {"dp": 4, "tp": 1}},
+        # Job B: a DIFFERENT preset sharing the pool, legitimately
+        # slower — must never enter job A's median.
+        "b1": {"step_seconds": 9.0, "axes": {"dp": 1, "tp": 4}},
+        "unavailable": {"step_seconds": None, "axes": {"dp": 4, "tp": 1}},
+        "garbage": "not-a-dict",
+    }
+    group = _same_job_step_seconds(feeds)
+    assert group == {"a1": 1.0, "a2": 1.1, "a3": 2.4}
+
+
+def test_same_job_step_seconds_cross_job_pair_never_compares():
+    from tpumon.hostcorr.plane import _same_job_step_seconds
+
+    feeds = {
+        "a": {"step_seconds": 1.0, "axes": {"dp": 4}},
+        "b": {"step_seconds": 9.0, "axes": {"tp": 4}},
+    }
+    # Two singleton jobs: no same-job pair, no step-skew evidence —
+    # the interference scenario must not read as a straggler.
+    assert _same_job_step_seconds(feeds) == {}
+
+
+def test_same_job_step_seconds_unlabeled_feeds_share_a_group():
+    from tpumon.hostcorr.plane import _same_job_step_seconds
+
+    feeds = {
+        "a": {"step_seconds": 1.0},
+        "b": {"step_seconds": 1.2},
+    }
+    assert _same_job_step_seconds(feeds) == {"a": 1.0, "b": 1.2}
+
+
+def test_plane_cross_job_step_skew_never_arms(proc_tree):
+    """Plane-level: two jobs on one pool with wildly different step
+    times — the judge must see NO step evidence and stay inactive."""
+    plane = HostCorrPlane(proc_root=proc_tree.root)
+    snap = {
+        "chips": {
+            "0": {"duty_pct": 80.0}, "1": {"duty_pct": 79.0},
+        },
+        "lifecycle": {
+            "feeds": {
+                "job-a": {"step_seconds": 1.0, "axes": {"dp": 2}},
+                "job-b": {"step_seconds": 9.0, "axes": {"pp": 2}},
+            }
+        },
+    }
+    verdict = None
+    for i in range(8):
+        stats = _Stats(json.loads(json.dumps(snap)))
+        plane.cycle(1000.0 + i, stats)
+        verdict = stats.snapshot["hostcorr"]["straggler"]
+    assert verdict is not None
+    assert not verdict["active"]
+    assert "step_skew_ratio" not in verdict

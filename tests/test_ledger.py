@@ -606,13 +606,23 @@ def test_ledger_families_subset_of_registry_and_docs():
     from tpumon.families import LEDGER_FAMILIES
 
     plane = LedgerPlane(tiers=_small_tiers(),
-                        remote_write_url="http://example.invalid/rw")
+                        remote_write_url="http://example.invalid/rw",
+                        dollars_per_kwh=0.12)
     plane.spool_errors = dict(plane.spool_errors)
-    # Exercise every optional family branch: fake a spool.
+    # Exercise every optional family branch: fake a spool, and run an
+    # energy-reporting feed through two accounting cycles so the
+    # joules/dollars families emit.
     class _FakeSpool:
         path = "/tmp/x"
         last_write_ts = 0.0
     plane.spool = _FakeSpool()
+    snap = {
+        "identity": {"accelerator": "v5p-16", "slice": "s0"},
+        "chips": {"0": {"duty_pct": 80.0}},
+        "energy": {"watts": 250.0, "source": "measured"},
+    }
+    plane.goodput.account([("t0", snap, "up", 1)], 100.0)
+    plane.goodput.account([("t0", snap, "up", 2)], 101.0)
     emitted = set()
     for fam in plane.families():
         name = fam.name
@@ -774,3 +784,278 @@ def test_smi_ledger_requires_aggregator(capsys):
 
     with pytest.raises(SystemExit):
         smi.main(["--ledger"])
+
+
+# -- server-side aggregation (/ledger?agg=, ISSUE 15) ------------------------
+
+
+def _agg_store(series: int = 6, samples: int = 30):
+    """A raw-tier store holding `series` slice series across two pools
+    on a shared 1 s timestamp grid (the shape record() produces)."""
+    store = TieredSeriesStore(_small_tiers())
+    keys = [
+        ("tpu_fleet_duty_cycle_percent", "slice", f"p{i % 2}", f"s{i}")
+        for i in range(series)
+    ]
+    t0 = 1_700_000_000.0
+    import random
+
+    rng = random.Random(3)
+    for step in range(samples):
+        store.record(
+            t0 + step,
+            {key: rng.uniform(0, 100) for key in keys},
+        )
+    return store, keys, t0
+
+
+def _client_fold(raw_points_by_key: dict, group_of, agg: str) -> dict:
+    """The DOCUMENTED client-side fold: series in sorted-key order,
+    points in time order, sum in visit order, mean = sum/series-count,
+    max first-wins. Byte-stability of ?agg= means the server reproduces
+    exactly this."""
+    groups: dict = {}
+    for key in sorted(raw_points_by_key):
+        acc = groups.setdefault(group_of(key), {})
+        for ts, value in raw_points_by_key[key]:
+            cell = acc.get(ts)
+            if cell is None:
+                acc[ts] = [value, 1, value]
+            else:
+                cell[0] += value
+                cell[1] += 1
+                if value > cell[2]:
+                    cell[2] = value
+    out = {}
+    for group, acc in groups.items():
+        points = []
+        for ts in sorted(acc):
+            s, n, vmax = acc[ts]
+            points.append(
+                [ts, s if agg == "sum" else s / n if agg == "mean" else vmax]
+            )
+        out[group] = points
+    return out
+
+
+def test_fold_byte_stable_vs_client_side_aggregation():
+    store, keys, t0 = _agg_store()
+    raw = {}
+    for key in keys:
+        points, cursor = store.query(key, 0, t0, t0 + 60.0)
+        assert cursor is None
+        raw[key] = points
+    for agg in ("sum", "mean", "max"):
+        for group_of in (
+            lambda k: (k[2], ""),       # by=pool
+            lambda k: (k[2], k[3]),     # by=slice/job
+            lambda k: ("", ""),         # by=none
+        ):
+            want = _client_fold(raw, group_of, agg)
+            got, next_start = store.fold(
+                keys, 0, t0, t0 + 60.0, agg=agg, group_of=group_of
+            )
+            assert next_start is None
+            got_lists = {
+                "|".join(g): [[ts, v] for ts, v in pts]
+                for g, pts in got.items()
+            }
+            want_lists = {
+                "|".join(g): [[ts, v] for ts, v in pts]
+                for g, pts in want.items()
+            }
+            assert json.dumps(got_lists, sort_keys=True) == \
+                json.dumps(want_lists, sort_keys=True)
+
+
+def test_fold_truncates_by_time_with_complete_buckets():
+    store, keys, t0 = _agg_store(series=4, samples=20)
+    got, next_start = store.fold(
+        keys, 0, t0, t0 + 60.0, agg="sum",
+        group_of=lambda k: (k[2], k[3]), max_points=10,
+    )
+    assert next_start is not None
+    kept_ts = sorted({ts for pts in got.values() for ts, _v in pts})
+    assert sum(len(p) for p in got.values()) <= 10
+    # Every kept timestamp precedes the cutoff, and every series
+    # contributed to every kept bucket (no partially-folded buckets).
+    assert all(ts < next_start for ts in kept_ts)
+    raw0 = dict(store.query(keys[0], 0, t0, t0 + 60.0)[0])
+    for (_pool, _slc), pts in got.items():
+        assert [ts for ts, _v in pts] == [t for t in kept_ts if t in raw0 or True][: len(pts)]
+    # Continuation resumes cleanly: the next page starts at the cutoff.
+    got2, _ = store.fold(
+        keys, 0, next_start, t0 + 60.0, agg="sum",
+        group_of=lambda k: (k[2], k[3]), max_points=1000,
+    )
+    resumed_ts = sorted({ts for pts in got2.values() for ts, _v in pts})
+    assert resumed_ts and resumed_ts[0] == next_start
+
+
+def test_ledger_agg_endpoint_matches_client_fold_bytes():
+    clock = {"now": 1_700_000_000.0}
+    plane = LedgerPlane(tiers=_small_tiers(), clock=lambda: clock["now"])
+    doc = {
+        "slices": {
+            (f"p{i % 2}", f"s{i}"): {"duty": {"mean": 10.0 * i + 0.123}}
+            for i in range(4)
+        },
+        "pools": {},
+        "fleet": {},
+    }
+    for _ in range(25):
+        clock["now"] += 1.0
+        # Values drift so the folds see real variation.
+        for i, bucket in enumerate(doc["slices"].values()):
+            bucket["duty"]["mean"] += 0.7 + i * 0.01
+        plane.cycle(clock["now"], doc, [])
+    start, end = clock["now"] - 60.0, clock["now"]
+    body, status = plane.query_response(
+        "family=tpu_fleet_duty_cycle_percent&scope=slice"
+        f"&agg=mean&by=pool&start={start}&end={end}"
+    )
+    assert status == "200 OK"
+    agg_doc = json.loads(body)
+    assert agg_doc["agg"] == "mean" and agg_doc["by"] == "pool"
+    raw_body, raw_status = plane.query_response(
+        "family=tpu_fleet_duty_cycle_percent&scope=slice"
+        f"&start={start}&end={end}"
+    )
+    assert raw_status == "200 OK"
+    raw_doc = json.loads(raw_body)
+    raw = {
+        ("x", "slice", row["pool"], row["slice"]): [
+            (ts, v) for ts, v in row["points"]
+        ]
+        for row in raw_doc["series"]
+    }
+    want = _client_fold(raw, lambda k: (k[2], ""), "mean")
+    got = {
+        (row["pool"], row["slice"]): row["points"]
+        for row in agg_doc["series"]
+    }
+    assert json.dumps(
+        {f"{p}|{s}": pts for (p, s), pts in sorted(got.items())},
+        sort_keys=True,
+    ) == json.dumps(
+        {f"{p}|{s}": pts for (p, s), pts in sorted(want.items())},
+        sort_keys=True,
+    )
+
+
+def test_ledger_agg_endpoint_validates_parameters():
+    plane = LedgerPlane(tiers=_small_tiers())
+    _body, status = plane.query_response(
+        "family=tpu_fleet_duty_cycle_percent&agg=median"
+    )
+    assert status == "400 Bad Request"
+    _body, status = plane.query_response(
+        "family=tpu_fleet_duty_cycle_percent&agg=sum&by=rack"
+    )
+    assert status == "400 Bad Request"
+
+
+# -- per-job energy dollars (ISSUE 15 satellite) -----------------------------
+
+
+def _energy_snap(watts: float, source: str = "measured") -> dict:
+    return {
+        "identity": {"accelerator": "v5p-16", "slice": "s0"},
+        "chips": {"0": {"duty_pct": 80.0}, "1": {"duty_pct": 82.0}},
+        "step_rate": 2.0,
+        "energy": {"watts": watts, "source": source},
+    }
+
+
+def test_goodput_energy_join_and_dollars():
+    ledger = GoodputLedger(dollars_per_kwh=0.20)
+    snap = _energy_snap(3600.0)  # 3.6 kW: 1 kWh per 1000 s
+    ledger.account([("t0", snap, "up", 1)], 0.0)
+    ledger.account([("t0", snap, "up", 2)], 1000.0)
+    rows = ledger.jobs_doc()
+    assert len(rows) == 1
+    row = rows[0]
+    # watts × window, independent of chip count (node power is node
+    # power); conservation untouched (chip-seconds = 1000 s × 2 chips).
+    assert row["energy_joules"] == pytest.approx(3600.0 * 1000.0)
+    assert row["energy_source"] == "measured"
+    assert row["energy_dollars"] == pytest.approx(0.20)
+    assert sum(row["buckets"].values()) == pytest.approx(2000.0)
+    # Totals stay pure chip-second buckets — no energy keys leak in.
+    assert set(ledger.totals()) == set(BUCKETS)
+
+
+def test_goodput_energy_modeled_worst_of_and_unaccounted_windows():
+    ledger = GoodputLedger()
+    ledger.account([("t0", _energy_snap(100.0), "up", 1)], 0.0)
+    ledger.account([("t0", _energy_snap(100.0, "modeled"), "up", 2)], 10.0)
+    # A stale window must not invent joules.
+    ledger.account([("t0", _energy_snap(100.0), "stale", 3)], 20.0)
+    energy = ledger.job_energy()
+    (joules, modeled), = energy.values()
+    assert joules == pytest.approx(100.0 * 10.0)
+    assert modeled is True
+    rows = ledger.jobs_doc()
+    assert "energy_dollars" not in rows[0]  # no configured price
+
+
+def test_goodput_energy_spool_roundtrip():
+    ledger = GoodputLedger(dollars_per_kwh=0.15)
+    snap = _energy_snap(500.0)
+    ledger.account([("t0", snap, "up", 1)], 0.0)
+    ledger.account([("t0", snap, "up", 2)], 100.0)
+    doc = ledger.to_doc()
+    restored = GoodputLedger(dollars_per_kwh=0.15)
+    restored.restore(doc, 200.0)
+    assert restored.job_energy() == ledger.job_energy()
+    assert restored.jobs_doc()[0]["energy_dollars"] == pytest.approx(
+        ledger.jobs_doc()[0]["energy_dollars"]
+    )
+
+
+def test_smi_ledger_by_pool_degrades_on_pre_agg_aggregator():
+    """A pre-agg aggregator IGNORES unknown ?agg=/&by= params and
+    answers 200 with the raw per-slice range. The CLI must detect the
+    missing "agg" echo and drop the breakdown — never render raw
+    slices mislabeled as server-side pool means."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from tpumon.smi import ledger_snapshot
+
+    raw_range = {
+        "family": "tpu_fleet_tokens_per_joule", "tier": "1s",
+        "start": 0, "end": 1,
+        # No "agg" key: the old server never saw the param.
+        "series": [
+            {"pool": "v4", "slice": f"s{i}", "stat": "raw",
+             "points": [[1.0, 2.0]]}
+            for i in range(5)
+        ],
+    }
+
+    class _OldAggregator(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if "view=goodput" in self.path:
+                body = json.dumps({"jobs": [], "totals": {},
+                                   "gap_seconds": 0.0}).encode()
+            else:
+                body = json.dumps(raw_range).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _OldAggregator)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        snap = ledger_snapshot(
+            f"http://127.0.0.1:{server.server_address[1]}", timeout=3
+        )
+        assert snap["ledger"]["tokens_per_joule_by_pool"] is None
+    finally:
+        server.shutdown()
+        server.server_close()

@@ -1,0 +1,1037 @@
+/* Rollup bucket-math kernel (CPython C extension).
+ *
+ * The fleet tier re-aggregates a slice bucket by folding its member
+ * node snapshots through ``_Agg.add_node`` (tpumon/fleet/rollup.py).
+ * At 10k-node fleets that Python loop IS the rollup cost: ~50 dict
+ * lookups and float ops of interpreter dispatch per node, times every
+ * member of every dirty bucket, every collect cycle. This module is
+ * the same fold in C — one call per bucket over the member list, with
+ * every arithmetic step in the same order as the Python loop.
+ *
+ * Two entry points, one per fold the rollup performs:
+ *
+ *   aggregate(members) — the _Agg.add_node loop over (snap, state)
+ *     members of one slice bucket;
+ *   merge(buckets)     — the merge_buckets fold over _Agg.to_dict
+ *     shaped docs (pool/fleet/cross-shard merges: additive totals,
+ *     n-weighted duty/MFU means, min/max, worst-of provenance).
+ *
+ * CONTRACT: the accumulated state is value-identical to running
+ * the pure-Python fold over the same inputs in the same order (pinned
+ * by tests/test_fleet_stripes.py on randomized buckets). That includes
+ * Python numeric semantics:
+ *   - float accumulators start at 0.0 and add in member order (IEEE
+ *     double, same associativity -> bit-identical sums);
+ *   - int accumulators (ici healthy/links) stay Python ints unless a
+ *     float value ever lands, after which they are floats forever
+ *     (the promoting accumulator below mirrors int.__add__/float);
+ *   - min/max keep the ORIGINAL Python object (an int stays an int in
+ *     the JSON doc), compared by value exactly like ``<``/``>``.
+ * Any semantic change lands in BOTH implementations or not at all.
+ *
+ *   aggregate(members: list[tuple[dict, str]]) -> state tuple
+ *
+ * Anything shape-unexpected raises; the Python wrapper falls back to
+ * the pure loop (which then raises the same error for genuinely bad
+ * input, or handles what this kernel does not model).
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <string.h>
+
+/* Interned dict keys: PyDict_GetItemString rebuilds a unicode
+ * object per call — at 10k folds/s that was a measured share of the
+ * kernel's cost. Interned once at module init. */
+static struct {
+    PyObject *chips;
+    PyObject *duty_pct;
+    PyObject *hbm_used;
+    PyObject *hbm_total;
+    PyObject *ici;
+    PyObject *healthy;
+    PyObject *total;
+    PyObject *mfu;
+    PyObject *step_rate;
+    PyObject *energy;
+    PyObject *watts;
+    PyObject *source;
+    PyObject *tokens_per_joule;
+    PyObject *lifecycle_transition;
+    PyObject *degraded;
+    PyObject *active;
+    PyObject *straggler;
+    PyObject *skew_pct;
+    PyObject *step_skew_ratio;
+    PyObject *cause;
+    PyObject *hosts;
+    PyObject *up;
+    PyObject *stale;
+    PyObject *dark;
+    PyObject *degraded_hosts;
+    PyObject *duty;
+    PyObject *n;
+    PyObject *mean;
+    PyObject *min;
+    PyObject *max;
+    PyObject *links;
+    PyObject *mfu_n;
+    PyObject *step_rate_n;
+    PyObject *energy_watts;
+    PyObject *energy_n;
+    PyObject *tokens_per_joule_n;
+    PyObject *energy_source;
+    PyObject *lifecycle_transitions;
+    PyObject *stragglers;
+    PyObject *straggler_skew_max_pct;
+    PyObject *straggler_step_skew_max_ratio;
+    PyObject *visibility;
+    PyObject *score;
+    PyObject *hbm_headroom_ratio;
+} K;
+
+/* Promoting accumulator: Python `x += v` where x starts as int 0 and
+ * v is int or float. Stays integral until the first float. */
+typedef struct {
+    int is_float;
+    long long i;
+    double d;
+} pacc;
+
+static int pacc_add(pacc *a, PyObject *v) {
+    if (!a->is_float && PyLong_Check(v)) {
+        int overflow = 0;
+        long long add = PyLong_AsLongLongAndOverflow(v, &overflow);
+        if (overflow || (add == -1 && PyErr_Occurred())) {
+            if (!PyErr_Occurred())
+                PyErr_SetString(PyExc_OverflowError, "count overflow");
+            return -1;
+        }
+        a->i += add;
+        return 0;
+    }
+    {
+        double add = PyFloat_AsDouble(v);
+        if (add == -1.0 && PyErr_Occurred()) return -1;
+        if (!a->is_float) {
+            a->d = (double)a->i;
+            a->is_float = 1;
+        }
+        a->d += add;
+        return 0;
+    }
+}
+
+static PyObject *pacc_value(const pacc *a) {
+    if (a->is_float) return PyFloat_FromDouble(a->d);
+    return PyLong_FromLongLong(a->i);
+}
+
+/* value-compare a candidate against the held best object; returns 1
+ * when `v OP best` is true the way Python's < / > would answer for
+ * numbers (doubles; NaN compares false, exactly like Python). */
+static int num_lt(double v, double best) { return v < best; }
+static int num_gt(double v, double best) { return v > best; }
+
+static double as_double(PyObject *v, int *err) {
+    double d = PyFloat_AsDouble(v);
+    if (d == -1.0 && PyErr_Occurred()) { *err = 1; }
+    return d;
+}
+
+static PyObject *r_aggregate(PyObject *self, PyObject *args) {
+    PyObject *members;
+    if (!PyArg_ParseTuple(args, "O", &members)) return NULL;
+    members = PySequence_Fast(members, "members must be a sequence");
+    if (!members) return NULL;
+
+    long long hosts_up = 0, hosts_stale = 0, hosts_dark = 0;
+    long long chips_n = 0, duty_n = 0, mfu_n = 0, step_rate_n = 0;
+    long long energy_n = 0, tpj_n = 0, lifecycle = 0, degraded_n = 0;
+    double duty_sum = 0.0, hbm_used = 0.0, hbm_total = 0.0;
+    double mfu_sum = 0.0, step_rate_sum = 0.0;
+    double energy_watts = 0.0, tpj_sum = 0.0;
+    int energy_modeled = 0;
+    pacc ici_healthy = {0, 0, 0.0}, ici_links = {0, 0, 0.0};
+    PyObject *duty_min = NULL, *duty_max = NULL;     /* borrowed+incref */
+    PyObject *skew_max = NULL, *step_skew_max = NULL;
+    PyObject *stragglers = PyDict_New();
+    PyObject *res = NULL;
+    if (!stragglers) { Py_DECREF(members); return NULL; }
+
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(members);
+    for (Py_ssize_t m = 0; m < n; m++) {
+        PyObject *item = PySequence_Fast_GET_ITEM(members, m);
+        if (!PyTuple_Check(item) || PyTuple_GET_SIZE(item) != 2) {
+            PyErr_SetString(PyExc_TypeError,
+                            "member must be a (snap, state) tuple");
+            goto fail;
+        }
+        PyObject *snap = PyTuple_GET_ITEM(item, 0);
+        PyObject *state = PyTuple_GET_ITEM(item, 1);
+        if (!PyDict_Check(snap) || !PyUnicode_Check(state)) {
+            PyErr_SetString(PyExc_TypeError,
+                            "member must be a (dict, str) tuple");
+            goto fail;
+        }
+        int is_dark = 0;
+        if (PyUnicode_CompareWithASCIIString(state, "up") == 0) {
+            hosts_up++;
+        } else if (PyUnicode_CompareWithASCIIString(state, "stale") == 0) {
+            hosts_stale++;
+        } else if (PyUnicode_CompareWithASCIIString(state, "dark") == 0) {
+            hosts_dark++;
+            is_dark = 1;
+        } else {
+            PyErr_Format(PyExc_KeyError, "unknown ingest state %R", state);
+            goto fail;
+        }
+        if (is_dark) continue;  /* counted, never merged */
+
+        PyObject *chips = PyDict_GetItem(snap, K.chips);
+        if (chips != NULL) {
+            if (!PyDict_Check(chips)) {
+                PyErr_SetString(PyExc_TypeError, "chips must be a dict");
+                goto fail;
+            }
+            chips_n += (long long)PyDict_GET_SIZE(chips);
+            PyObject *ckey, *row;
+            Py_ssize_t pos = 0;
+            while (PyDict_Next(chips, &pos, &ckey, &row)) {
+                if (!PyDict_Check(row)) {
+                    PyErr_SetString(PyExc_TypeError,
+                                    "chip row must be a dict");
+                    goto fail;
+                }
+                PyObject *duty = PyDict_GetItem(row, K.duty_pct);
+                if (duty != NULL && duty != Py_None) {
+                    int err = 0;
+                    double dv = as_double(duty, &err);
+                    if (err) goto fail;
+                    duty_sum += dv;
+                    duty_n++;
+                    if (duty_min == NULL) {
+                        Py_INCREF(duty); duty_min = duty;
+                    } else {
+                        int e2 = 0;
+                        double best = as_double(duty_min, &e2);
+                        if (e2) goto fail;
+                        if (num_lt(dv, best)) {
+                            Py_INCREF(duty);
+                            Py_SETREF(duty_min, duty);
+                        }
+                    }
+                    if (duty_max == NULL) {
+                        Py_INCREF(duty); duty_max = duty;
+                    } else {
+                        int e2 = 0;
+                        double best = as_double(duty_max, &e2);
+                        if (e2) goto fail;
+                        if (num_gt(dv, best)) {
+                            Py_INCREF(duty);
+                            Py_SETREF(duty_max, duty);
+                        }
+                    }
+                }
+                PyObject *used = PyDict_GetItem(row, K.hbm_used);
+                PyObject *total = PyDict_GetItem(row, K.hbm_total);
+                if (used != NULL && used != Py_None
+                    && total != NULL && total != Py_None) {
+                    int err = 0;
+                    double uv = as_double(used, &err);
+                    double tv = as_double(total, &err);
+                    if (err) goto fail;
+                    hbm_used += uv;
+                    hbm_total += tv;
+                }
+            }
+        }
+        /* ici = snap.get("ici") or {} — falsy collapses to skip */
+        PyObject *ici = PyDict_GetItem(snap, K.ici);
+        if (ici != NULL) {
+            int truthy = PyObject_IsTrue(ici);
+            if (truthy < 0) goto fail;
+            if (truthy) {
+                if (!PyDict_Check(ici)) {
+                    PyErr_SetString(PyExc_TypeError, "ici must be a dict");
+                    goto fail;
+                }
+                PyObject *healthy = PyDict_GetItem(ici, K.healthy);
+                PyObject *total = PyDict_GetItem(ici, K.total);
+                if (healthy != NULL && pacc_add(&ici_healthy, healthy) < 0)
+                    goto fail;
+                if (total != NULL && pacc_add(&ici_links, total) < 0)
+                    goto fail;
+            }
+        }
+        PyObject *mfu = PyDict_GetItem(snap, K.mfu);
+        if (mfu != NULL && mfu != Py_None) {
+            int err = 0;
+            double v = as_double(mfu, &err);
+            if (err) goto fail;
+            mfu_sum += v;
+            mfu_n++;
+        }
+        PyObject *step_rate = PyDict_GetItem(snap, K.step_rate);
+        if (step_rate != NULL && step_rate != Py_None) {
+            int err = 0;
+            double v = as_double(step_rate, &err);
+            if (err) goto fail;
+            step_rate_sum += v;
+            step_rate_n++;
+        }
+        PyObject *energy = PyDict_GetItem(snap, K.energy);
+        int energy_truthy = 0;
+        if (energy != NULL) {
+            energy_truthy = PyObject_IsTrue(energy);
+            if (energy_truthy < 0) goto fail;
+        }
+        if (energy_truthy) {
+            if (!PyDict_Check(energy)) {
+                PyErr_SetString(PyExc_TypeError, "energy must be a dict");
+                goto fail;
+            }
+            PyObject *watts = PyDict_GetItem(energy, K.watts);
+            PyObject *source = PyDict_GetItem(energy, K.source);
+            int w_truthy = 0;
+            if (watts != NULL) {
+                w_truthy = PyObject_IsTrue(watts);
+                if (w_truthy < 0) goto fail;
+            }
+            if (w_truthy) {
+                int err = 0;
+                double v = as_double(watts, &err);
+                if (err) goto fail;
+                energy_watts += v;
+                energy_n++;
+                if (source == NULL || !PyUnicode_Check(source)
+                    || PyUnicode_CompareWithASCIIString(
+                           source, "measured") != 0)
+                    energy_modeled = 1;
+            }
+            PyObject *tpj = PyDict_GetItem(energy, K.tokens_per_joule);
+            if (tpj != NULL && tpj != Py_None) {
+                int err = 0;
+                double v = as_double(tpj, &err);
+                if (err) goto fail;
+                tpj_sum += v;
+                tpj_n++;
+                if (source == NULL || !PyUnicode_Check(source)
+                    || PyUnicode_CompareWithASCIIString(
+                           source, "measured") != 0)
+                    energy_modeled = 1;
+            }
+        }
+        PyObject *transition = PyDict_GetItem(snap, K.lifecycle_transition);
+        if (transition != NULL) {
+            int truthy = PyObject_IsTrue(transition);
+            if (truthy < 0) goto fail;
+            if (truthy) lifecycle++;
+        }
+        PyObject *degraded = PyDict_GetItem(snap, K.degraded);
+        if (degraded != NULL) {
+            int truthy = PyObject_IsTrue(degraded);
+            if (truthy < 0) goto fail;
+            if (truthy) {
+                if (!PyDict_Check(degraded)) {
+                    PyErr_SetString(PyExc_TypeError,
+                                    "degraded must be a dict");
+                    goto fail;
+                }
+                PyObject *active = PyDict_GetItem(degraded, K.active);
+                int a_truthy = 0;
+                if (active != NULL) {
+                    a_truthy = PyObject_IsTrue(active);
+                    if (a_truthy < 0) goto fail;
+                }
+                if (a_truthy) degraded_n++;
+            }
+        }
+        PyObject *straggler = PyDict_GetItem(snap, K.straggler);
+        if (straggler != NULL) {
+            int truthy = PyObject_IsTrue(straggler);
+            if (truthy < 0) goto fail;
+            if (truthy) {
+                if (!PyDict_Check(straggler)) {
+                    PyErr_SetString(PyExc_TypeError,
+                                    "straggler must be a dict");
+                    goto fail;
+                }
+                PyObject *skew = PyDict_GetItem(straggler, K.skew_pct);
+                if (skew != NULL && skew != Py_None) {
+                    int err = 0;
+                    double v = as_double(skew, &err);
+                    if (err) goto fail;
+                    int take = (skew_max == NULL);
+                    if (!take) {
+                        int e2 = 0;
+                        double best = as_double(skew_max, &e2);
+                        if (e2) goto fail;
+                        take = num_gt(v, best);
+                    }
+                    if (take) {
+                        Py_INCREF(skew);
+                        Py_XSETREF(skew_max, skew);
+                    }
+                }
+                PyObject *sskew = PyDict_GetItem(straggler, K.step_skew_ratio);
+                if (sskew != NULL && sskew != Py_None) {
+                    int err = 0;
+                    double v = as_double(sskew, &err);
+                    if (err) goto fail;
+                    int take = (step_skew_max == NULL);
+                    if (!take) {
+                        int e2 = 0;
+                        double best = as_double(step_skew_max, &e2);
+                        if (e2) goto fail;
+                        take = num_gt(v, best);
+                    }
+                    if (take) {
+                        Py_INCREF(sskew);
+                        Py_XSETREF(step_skew_max, sskew);
+                    }
+                }
+                PyObject *active = PyDict_GetItem(straggler, K.active);
+                int a_truthy = 0;
+                if (active != NULL) {
+                    a_truthy = PyObject_IsTrue(active);
+                    if (a_truthy < 0) goto fail;
+                }
+                if (a_truthy) {
+                    PyObject *cause = PyDict_GetItem(straggler, K.cause);
+                    PyObject *key = cause;
+                    if (key == NULL) {
+                        key = PyUnicode_FromString("unknown");
+                        if (!key) goto fail;
+                    } else {
+                        Py_INCREF(key);
+                    }
+                    PyObject *cur = PyDict_GetItemWithError(
+                        stragglers, key);
+                    if (cur == NULL && PyErr_Occurred()) {
+                        Py_DECREF(key);
+                        goto fail;
+                    }
+                    long long count = 0;
+                    if (cur != NULL) {
+                        int overflow = 0;
+                        count = PyLong_AsLongLongAndOverflow(
+                            cur, &overflow);
+                        if (overflow
+                            || (count == -1 && PyErr_Occurred())) {
+                            Py_DECREF(key);
+                            goto fail;
+                        }
+                    }
+                    PyObject *next = PyLong_FromLongLong(count + 1);
+                    if (!next) { Py_DECREF(key); goto fail; }
+                    int rc = PyDict_SetItem(stragglers, key, next);
+                    Py_DECREF(next);
+                    Py_DECREF(key);
+                    if (rc < 0) goto fail;
+                }
+            }
+        }
+    }
+
+    res = Py_BuildValue(
+        "(LLLL dL OO dd NN dL dL dL N dL LL N OO)",
+        hosts_up, hosts_stale, hosts_dark, chips_n,
+        duty_sum, duty_n,
+        duty_min ? duty_min : Py_None,
+        duty_max ? duty_max : Py_None,
+        hbm_used, hbm_total,
+        pacc_value(&ici_healthy), pacc_value(&ici_links),
+        mfu_sum, mfu_n,
+        step_rate_sum, step_rate_n,
+        energy_watts, energy_n,
+        PyBool_FromLong(energy_modeled),
+        tpj_sum, tpj_n,
+        lifecycle, degraded_n,
+        stragglers,
+        skew_max ? skew_max : Py_None,
+        step_skew_max ? step_skew_max : Py_None);
+    /* Py_BuildValue "N" stole stragglers + the two pacc values; "O"
+     * entries were increfed by BuildValue, so drop our own refs. */
+    Py_XDECREF(duty_min);
+    Py_XDECREF(duty_max);
+    Py_XDECREF(skew_max);
+    Py_XDECREF(step_skew_max);
+    Py_DECREF(members);
+    return res;
+
+fail:
+    Py_XDECREF(duty_min);
+    Py_XDECREF(duty_max);
+    Py_XDECREF(skew_max);
+    Py_XDECREF(step_skew_max);
+    Py_DECREF(stragglers);
+    Py_DECREF(members);
+    return NULL;
+}
+
+/* _Agg.to_dict, in C, from r_aggregate's state tuple — the per-bucket
+ * doc construction was the last interpreter-bound cost in the rollup
+ * hot loop. Mirrors to_dict field for field (conditional presence,
+ * true-division semantics, original min/max objects). */
+static PyObject *doc_from_state(PyObject *st) {
+    PyObject *doc = NULL, *tmp = NULL;
+    long long hosts_up = PyLong_AsLongLong(PyTuple_GET_ITEM(st, 0));
+    long long hosts_stale = PyLong_AsLongLong(PyTuple_GET_ITEM(st, 1));
+    long long hosts_dark = PyLong_AsLongLong(PyTuple_GET_ITEM(st, 2));
+    long long duty_n = PyLong_AsLongLong(PyTuple_GET_ITEM(st, 5));
+    double duty_sum = PyFloat_AsDouble(PyTuple_GET_ITEM(st, 4));
+    double hbm_used = PyFloat_AsDouble(PyTuple_GET_ITEM(st, 8));
+    double hbm_total = PyFloat_AsDouble(PyTuple_GET_ITEM(st, 9));
+    double mfu_sum = PyFloat_AsDouble(PyTuple_GET_ITEM(st, 12));
+    long long mfu_n = PyLong_AsLongLong(PyTuple_GET_ITEM(st, 13));
+    double sr_sum = PyFloat_AsDouble(PyTuple_GET_ITEM(st, 14));
+    long long sr_n = PyLong_AsLongLong(PyTuple_GET_ITEM(st, 15));
+    double watts = PyFloat_AsDouble(PyTuple_GET_ITEM(st, 16));
+    long long energy_n = PyLong_AsLongLong(PyTuple_GET_ITEM(st, 17));
+    int modeled = PyObject_IsTrue(PyTuple_GET_ITEM(st, 18));
+    double tpj_sum = PyFloat_AsDouble(PyTuple_GET_ITEM(st, 19));
+    long long tpj_n = PyLong_AsLongLong(PyTuple_GET_ITEM(st, 20));
+    long long lifecycle = PyLong_AsLongLong(PyTuple_GET_ITEM(st, 21));
+    long long degraded = PyLong_AsLongLong(PyTuple_GET_ITEM(st, 22));
+    PyObject *ici_healthy = PyTuple_GET_ITEM(st, 10);
+    PyObject *ici_links = PyTuple_GET_ITEM(st, 11);
+    PyObject *stragglers = PyTuple_GET_ITEM(st, 23);
+    if (PyErr_Occurred() || modeled < 0) return NULL;
+
+#define SET(key, valexpr) \
+    do { \
+        tmp = (valexpr); \
+        if (!tmp) goto fail; \
+        if (PyDict_SetItem(doc, (key), tmp) < 0) goto fail; \
+        Py_CLEAR(tmp); \
+    } while (0)
+
+    doc = PyDict_New();
+    if (!doc) return NULL;
+    {
+        PyObject *hosts = PyDict_New();
+        if (!hosts) goto fail;
+        tmp = hosts;  /* owned until stored */
+        PyObject *v = PyLong_FromLongLong(hosts_up);
+        if (!v || PyDict_SetItem(hosts, K.up, v) < 0) {
+            Py_XDECREF(v); goto fail;
+        }
+        Py_DECREF(v);
+        v = PyLong_FromLongLong(hosts_stale);
+        if (!v || PyDict_SetItem(hosts, K.stale, v) < 0) {
+            Py_XDECREF(v); goto fail;
+        }
+        Py_DECREF(v);
+        v = PyLong_FromLongLong(hosts_dark);
+        if (!v || PyDict_SetItem(hosts, K.dark, v) < 0) {
+            Py_XDECREF(v); goto fail;
+        }
+        Py_DECREF(v);
+        if (PyDict_SetItem(doc, K.hosts, hosts) < 0) goto fail;
+        Py_CLEAR(tmp);
+    }
+    SET(K.chips, PyLong_FromLongLong(
+        PyLong_AsLongLong(PyTuple_GET_ITEM(st, 3))));
+    SET(K.degraded_hosts, PyLong_FromLongLong(degraded));
+    SET(K.stale, PyBool_FromLong(hosts_stale > 0));
+    {
+        long long total = hosts_up + hosts_stale + hosts_dark;
+        double vis = total <= 0 ? 1.0 : (double)hosts_up / (double)total;
+        SET(K.visibility, PyFloat_FromDouble(vis));
+    }
+    if (duty_n) {
+        PyObject *duty = PyDict_New();
+        if (!duty) goto fail;
+        tmp = duty;
+        PyObject *v = PyFloat_FromDouble(duty_sum / (double)duty_n);
+        if (!v || PyDict_SetItem(duty, K.mean, v) < 0) {
+            Py_XDECREF(v); goto fail;
+        }
+        Py_DECREF(v);
+        if (PyDict_SetItem(duty, K.min, PyTuple_GET_ITEM(st, 6)) < 0)
+            goto fail;
+        if (PyDict_SetItem(duty, K.max, PyTuple_GET_ITEM(st, 7)) < 0)
+            goto fail;
+        v = PyLong_FromLongLong(duty_n);
+        if (!v || PyDict_SetItem(duty, K.n, v) < 0) {
+            Py_XDECREF(v); goto fail;
+        }
+        Py_DECREF(v);
+        if (PyDict_SetItem(doc, K.duty, duty) < 0) goto fail;
+        Py_CLEAR(tmp);
+    }
+    if (hbm_total > 0.0) {
+        SET(K.hbm_used, PyFloat_FromDouble(hbm_used));
+        SET(K.hbm_total, PyFloat_FromDouble(hbm_total));
+        SET(K.hbm_headroom_ratio,
+            PyFloat_FromDouble(1.0 - hbm_used / hbm_total));
+    }
+    {
+        int links_truthy = PyObject_IsTrue(ici_links);
+        if (links_truthy < 0) goto fail;
+        if (links_truthy) {
+            PyObject *ici = PyDict_New();
+            if (!ici) goto fail;
+            tmp = ici;
+            if (PyDict_SetItem(ici, K.healthy, ici_healthy) < 0) goto fail;
+            if (PyDict_SetItem(ici, K.links, ici_links) < 0) goto fail;
+            PyObject *score = PyNumber_TrueDivide(ici_healthy, ici_links);
+            if (!score || PyDict_SetItem(ici, K.score, score) < 0) {
+                Py_XDECREF(score); goto fail;
+            }
+            Py_DECREF(score);
+            if (PyDict_SetItem(doc, K.ici, ici) < 0) goto fail;
+            Py_CLEAR(tmp);
+        }
+    }
+    if (mfu_n) {
+        SET(K.mfu, PyFloat_FromDouble(mfu_sum / (double)mfu_n));
+        SET(K.mfu_n, PyLong_FromLongLong(mfu_n));
+    }
+    if (sr_n) {
+        SET(K.step_rate, PyFloat_FromDouble(sr_sum / (double)sr_n));
+        SET(K.step_rate_n, PyLong_FromLongLong(sr_n));
+    }
+    if (energy_n || tpj_n) {
+        PyObject *src = PyUnicode_FromString(
+            modeled ? "modeled" : "measured");
+        if (!src || PyDict_SetItem(doc, K.energy_source, src) < 0) {
+            Py_XDECREF(src); goto fail;
+        }
+        Py_DECREF(src);
+    }
+    if (energy_n) {
+        SET(K.energy_watts, PyFloat_FromDouble(watts));
+        SET(K.energy_n, PyLong_FromLongLong(energy_n));
+    }
+    if (tpj_n) {
+        SET(K.tokens_per_joule,
+            PyFloat_FromDouble(tpj_sum / (double)tpj_n));
+        SET(K.tokens_per_joule_n, PyLong_FromLongLong(tpj_n));
+    }
+    if (lifecycle) {
+        SET(K.lifecycle_transitions, PyLong_FromLongLong(lifecycle));
+    }
+    if (PyDict_GET_SIZE(stragglers)) {
+        /* to_dict copies; the state tuple is transient here, but a
+         * caller holding both must not see shared mutation. */
+        SET(K.stragglers, PyDict_Copy(stragglers));
+    }
+    if (PyTuple_GET_ITEM(st, 24) != Py_None) {
+        if (PyDict_SetItem(doc, K.straggler_skew_max_pct,
+                           PyTuple_GET_ITEM(st, 24)) < 0)
+            goto fail;
+    }
+    if (PyTuple_GET_ITEM(st, 25) != Py_None) {
+        if (PyDict_SetItem(doc, K.straggler_step_skew_max_ratio,
+                           PyTuple_GET_ITEM(st, 25)) < 0)
+            goto fail;
+    }
+#undef SET
+    return doc;
+
+fail:
+    Py_XDECREF(tmp);
+    Py_XDECREF(doc);
+    return NULL;
+}
+
+/* aggregate_doc(members) -> the _Agg.to_dict doc for one bucket fold
+ * (aggregate + doc construction without touching the interpreter). */
+static PyObject *r_aggregate_doc(PyObject *self, PyObject *args) {
+    PyObject *state = r_aggregate(self, args);
+    if (!state) return NULL;
+    PyObject *doc = doc_from_state(state);
+    Py_DECREF(state);
+    return doc;
+}
+
+/* Python int(value) over the number types a merge doc carries (peer
+ * summaries arrive as JSON: ints and floats). Anything else raises —
+ * the wrapper falls back to the Python fold, which coerces or raises
+ * identically. Float truncation is toward zero, like int(). */
+static int as_count(PyObject *v, long long *out) {
+    if (PyLong_Check(v)) {
+        int overflow = 0;
+        long long x = PyLong_AsLongLongAndOverflow(v, &overflow);
+        if (overflow || (x == -1 && PyErr_Occurred())) {
+            if (!PyErr_Occurred())
+                PyErr_SetString(PyExc_OverflowError, "count overflow");
+            return -1;
+        }
+        *out = x;
+        return 0;
+    }
+    if (PyFloat_Check(v)) {
+        double d = PyFloat_AS_DOUBLE(v);
+        if (d != d || d >= 9.2e18 || d <= -9.2e18) {
+            PyErr_SetString(PyExc_ValueError, "non-finite count");
+            return -1;
+        }
+        *out = (long long)d;
+        return 0;
+    }
+    PyErr_SetString(PyExc_TypeError, "count must be a number");
+    return -1;
+}
+
+/* Python float(value) over ints/floats; anything else raises (the
+ * Python fold is the arbiter for exotic coercions). */
+static int as_floatv(PyObject *v, double *out) {
+    if (PyFloat_Check(v)) { *out = PyFloat_AS_DOUBLE(v); return 0; }
+    if (PyLong_Check(v)) {
+        double d = PyLong_AsDouble(v);
+        if (d == -1.0 && PyErr_Occurred()) return -1;
+        *out = d;
+        return 0;
+    }
+    PyErr_SetString(PyExc_TypeError, "value must be a number");
+    return -1;
+}
+
+/* merge(buckets: list[dict]) -> state tuple + (duty_missing,
+ * mfu_missing, any_stale) — the merge_buckets fold (additive totals,
+ * n-weighted means, min/max keeping original objects, worst-of
+ * provenance), value-identical to the pinned Python loop. */
+static PyObject *r_merge(PyObject *self, PyObject *args) {
+    PyObject *buckets;
+    if (!PyArg_ParseTuple(args, "O", &buckets)) return NULL;
+    buckets = PySequence_Fast(buckets, "buckets must be a sequence");
+    if (!buckets) return NULL;
+
+    long long hosts_up = 0, hosts_stale = 0, hosts_dark = 0;
+    long long chips_n = 0, degraded_n = 0, duty_n = 0, mfu_n = 0;
+    long long step_rate_n = 0, energy_n = 0, tpj_n = 0, lifecycle = 0;
+    long long ici_healthy = 0, ici_links = 0;
+    double duty_sum = 0.0, hbm_used = 0.0, hbm_total = 0.0;
+    double mfu_sum = 0.0, step_rate_sum = 0.0;
+    double energy_watts = 0.0, tpj_sum = 0.0;
+    int energy_modeled = 0, duty_missing = 0, mfu_missing = 0;
+    int any_stale = 0;
+    PyObject *duty_min = NULL, *duty_max = NULL;
+    PyObject *skew_max = NULL, *step_skew_max = NULL;
+    PyObject *stragglers = PyDict_New();
+    PyObject *res = NULL;
+    if (!stragglers) { Py_DECREF(buckets); return NULL; }
+
+    Py_ssize_t nb = PySequence_Fast_GET_SIZE(buckets);
+    for (Py_ssize_t b = 0; b < nb; b++) {
+        PyObject *bucket = PySequence_Fast_GET_ITEM(buckets, b);
+        int truthy = PyObject_IsTrue(bucket);
+        if (truthy < 0) goto fail;
+        if (!truthy) continue;
+        if (!PyDict_Check(bucket)) {
+            PyErr_SetString(PyExc_TypeError, "bucket must be a dict");
+            goto fail;
+        }
+        long long c;
+        double d;
+        PyObject *hosts = PyDict_GetItem(bucket, K.hosts);
+        if (hosts != NULL) {
+            if (!PyDict_Check(hosts)) {
+                PyErr_SetString(PyExc_TypeError, "hosts must be a dict");
+                goto fail;
+            }
+            PyObject *v = PyDict_GetItem(hosts, K.up);
+            if (v != NULL) { if (as_count(v, &c) < 0) goto fail; hosts_up += c; }
+            v = PyDict_GetItem(hosts, K.stale);
+            if (v != NULL) { if (as_count(v, &c) < 0) goto fail; hosts_stale += c; }
+            v = PyDict_GetItem(hosts, K.dark);
+            if (v != NULL) { if (as_count(v, &c) < 0) goto fail; hosts_dark += c; }
+        }
+        PyObject *v = PyDict_GetItem(bucket, K.chips);
+        if (v != NULL) { if (as_count(v, &c) < 0) goto fail; chips_n += c; }
+        v = PyDict_GetItem(bucket, K.degraded_hosts);
+        if (v != NULL) { if (as_count(v, &c) < 0) goto fail; degraded_n += c; }
+        PyObject *duty = PyDict_GetItem(bucket, K.duty);
+        if (duty != NULL) {
+            int d_truthy = PyObject_IsTrue(duty);
+            if (d_truthy < 0) goto fail;
+            if (d_truthy) {
+                if (!PyDict_Check(duty)) {
+                    PyErr_SetString(PyExc_TypeError, "duty must be a dict");
+                    goto fail;
+                }
+                PyObject *nobj = PyDict_GetItem(duty, K.n);
+                int n_truthy = nobj != NULL ? PyObject_IsTrue(nobj) : 0;
+                if (n_truthy < 0) goto fail;
+                if (n_truthy) {
+                    long long n;
+                    if (as_count(nobj, &n) < 0) goto fail;
+                    PyObject *mean = PyDict_GetItem(duty, K.mean);
+                    if (mean == NULL) {
+                        PyErr_SetString(PyExc_KeyError, "duty.mean");
+                        goto fail;
+                    }
+                    if (as_floatv(mean, &d) < 0) goto fail;
+                    duty_sum += d * (double)n;
+                    duty_n += n;
+                    PyObject *vmin = PyDict_GetItem(duty, K.min);
+                    if (vmin != NULL && vmin != Py_None) {
+                        int take = (duty_min == NULL);
+                        if (!take) {
+                            int e = 0;
+                            double nd = as_double(vmin, &e);
+                            double cd = as_double(duty_min, &e);
+                            if (e) goto fail;
+                            take = num_lt(nd, cd);
+                        }
+                        if (take) {
+                            Py_INCREF(vmin);
+                            Py_XSETREF(duty_min, vmin);
+                        }
+                    }
+                    PyObject *vmax = PyDict_GetItem(duty, K.max);
+                    if (vmax != NULL && vmax != Py_None) {
+                        int take = (duty_max == NULL);
+                        if (!take) {
+                            int e = 0;
+                            double nd = as_double(vmax, &e);
+                            double cd = as_double(duty_max, &e);
+                            if (e) goto fail;
+                            take = num_gt(nd, cd);
+                        }
+                        if (take) {
+                            Py_INCREF(vmax);
+                            Py_XSETREF(duty_max, vmax);
+                        }
+                    }
+                } else {
+                    /* Pre-failover peer without the n weight: means
+                     * cannot merge honestly — the doc drops duty. */
+                    duty_missing = 1;
+                }
+            }
+        }
+        v = PyDict_GetItem(bucket, K.hbm_used);
+        if (v != NULL) { if (as_floatv(v, &d) < 0) goto fail; hbm_used += d; }
+        v = PyDict_GetItem(bucket, K.hbm_total);
+        if (v != NULL) { if (as_floatv(v, &d) < 0) goto fail; hbm_total += d; }
+        PyObject *ici = PyDict_GetItem(bucket, K.ici);
+        if (ici != NULL) {
+            int i_truthy = PyObject_IsTrue(ici);
+            if (i_truthy < 0) goto fail;
+            if (i_truthy) {
+                if (!PyDict_Check(ici)) {
+                    PyErr_SetString(PyExc_TypeError, "ici must be a dict");
+                    goto fail;
+                }
+                v = PyDict_GetItem(ici, K.healthy);
+                if (v != NULL) { if (as_count(v, &c) < 0) goto fail; ici_healthy += c; }
+                v = PyDict_GetItem(ici, K.links);
+                if (v != NULL) { if (as_count(v, &c) < 0) goto fail; ici_links += c; }
+            }
+        }
+        PyObject *mfu = PyDict_GetItem(bucket, K.mfu);
+        if (mfu != NULL && mfu != Py_None) {
+            long long n = 0;
+            v = PyDict_GetItem(bucket, K.mfu_n);
+            if (v != NULL) { if (as_count(v, &n) < 0) goto fail; }
+            if (n) {
+                if (as_floatv(mfu, &d) < 0) goto fail;
+                mfu_sum += d * (double)n;
+                mfu_n += n;
+            } else {
+                mfu_missing = 1;
+            }
+        }
+        PyObject *sr = PyDict_GetItem(bucket, K.step_rate);
+        if (sr != NULL && sr != Py_None) {
+            long long n = 0;
+            v = PyDict_GetItem(bucket, K.step_rate_n);
+            if (v != NULL) { if (as_count(v, &n) < 0) goto fail; }
+            if (n) {
+                if (as_floatv(sr, &d) < 0) goto fail;
+                step_rate_sum += d * (double)n;
+                step_rate_n += n;
+            }
+        }
+        PyObject *ew = PyDict_GetItem(bucket, K.energy_watts);
+        if (ew != NULL && ew != Py_None) {
+            if (as_floatv(ew, &d) < 0) goto fail;
+            energy_watts += d;
+            long long n = 1;
+            v = PyDict_GetItem(bucket, K.energy_n);
+            if (v != NULL) { if (as_count(v, &n) < 0) goto fail; }
+            energy_n += n;
+        }
+        PyObject *tpj = PyDict_GetItem(bucket, K.tokens_per_joule);
+        if (tpj != NULL && tpj != Py_None) {
+            long long n = 0;
+            v = PyDict_GetItem(bucket, K.tokens_per_joule_n);
+            if (v != NULL) { if (as_count(v, &n) < 0) goto fail; }
+            if (n) {
+                if (as_floatv(tpj, &d) < 0) goto fail;
+                tpj_sum += d * (double)n;
+                tpj_n += n;
+            }
+        }
+        PyObject *src = PyDict_GetItem(bucket, K.energy_source);
+        if (src != NULL && PyUnicode_Check(src)
+            && PyUnicode_CompareWithASCIIString(src, "modeled") == 0)
+            energy_modeled = 1;
+        v = PyDict_GetItem(bucket, K.lifecycle_transitions);
+        if (v != NULL) { if (as_count(v, &c) < 0) goto fail; lifecycle += c; }
+        PyObject *stg = PyDict_GetItem(bucket, K.stragglers);
+        if (stg != NULL) {
+            if (!PyDict_Check(stg)) {
+                PyErr_SetString(PyExc_TypeError,
+                                "stragglers must be a dict");
+                goto fail;
+            }
+            PyObject *cause, *count;
+            Py_ssize_t pos = 0;
+            while (PyDict_Next(stg, &pos, &cause, &count)) {
+                long long add;
+                if (as_count(count, &add) < 0) goto fail;
+                long long cur = 0;
+                PyObject *curo = PyDict_GetItemWithError(stragglers, cause);
+                if (curo == NULL && PyErr_Occurred()) goto fail;
+                if (curo != NULL && as_count(curo, &cur) < 0) goto fail;
+                PyObject *next = PyLong_FromLongLong(cur + add);
+                if (!next) goto fail;
+                int rc = PyDict_SetItem(stragglers, cause, next);
+                Py_DECREF(next);
+                if (rc < 0) goto fail;
+            }
+        }
+        v = PyDict_GetItem(bucket, K.straggler_skew_max_pct);
+        if (v != NULL && v != Py_None) {
+            int take = (skew_max == NULL);
+            if (!take) {
+                int e = 0;
+                double nd = as_double(v, &e);
+                double cd = as_double(skew_max, &e);
+                if (e) goto fail;
+                take = num_gt(nd, cd);
+            }
+            if (take) { Py_INCREF(v); Py_XSETREF(skew_max, v); }
+        }
+        v = PyDict_GetItem(bucket, K.straggler_step_skew_max_ratio);
+        if (v != NULL && v != Py_None) {
+            int take = (step_skew_max == NULL);
+            if (!take) {
+                int e = 0;
+                double nd = as_double(v, &e);
+                double cd = as_double(step_skew_max, &e);
+                if (e) goto fail;
+                take = num_gt(nd, cd);
+            }
+            if (take) { Py_INCREF(v); Py_XSETREF(step_skew_max, v); }
+        }
+        v = PyDict_GetItem(bucket, K.stale);
+        if (v != NULL) {
+            int s_truthy = PyObject_IsTrue(v);
+            if (s_truthy < 0) goto fail;
+            if (s_truthy) any_stale = 1;
+        }
+    }
+
+    res = Py_BuildValue(
+        "(LLLL dL OO dd NN dL dL dL N dL LL N OO NNN)",
+        hosts_up, hosts_stale, hosts_dark, chips_n,
+        duty_sum, duty_n,
+        duty_min ? duty_min : Py_None,
+        duty_max ? duty_max : Py_None,
+        hbm_used, hbm_total,
+        PyLong_FromLongLong(ici_healthy), PyLong_FromLongLong(ici_links),
+        mfu_sum, mfu_n,
+        step_rate_sum, step_rate_n,
+        energy_watts, energy_n,
+        PyBool_FromLong(energy_modeled),
+        tpj_sum, tpj_n,
+        lifecycle, degraded_n,
+        stragglers,
+        skew_max ? skew_max : Py_None,
+        step_skew_max ? step_skew_max : Py_None,
+        PyBool_FromLong(duty_missing),
+        PyBool_FromLong(mfu_missing),
+        PyBool_FromLong(any_stale));
+    Py_XDECREF(duty_min);
+    Py_XDECREF(duty_max);
+    Py_XDECREF(skew_max);
+    Py_XDECREF(step_skew_max);
+    Py_DECREF(buckets);
+    return res;
+
+fail:
+    Py_XDECREF(duty_min);
+    Py_XDECREF(duty_max);
+    Py_XDECREF(skew_max);
+    Py_XDECREF(step_skew_max);
+    Py_DECREF(stragglers);
+    Py_DECREF(buckets);
+    return NULL;
+}
+
+static PyMethodDef r_methods[] = {
+    {"aggregate", r_aggregate, METH_VARARGS,
+     "aggregate(members) -> accumulated _Agg state tuple"},
+    {"aggregate_doc", r_aggregate_doc, METH_VARARGS,
+     "aggregate_doc(members) -> _Agg.to_dict doc for one bucket"},
+    {"merge", r_merge, METH_VARARGS,
+     "merge(buckets) -> merged _Agg state tuple + "
+     "(duty_missing, mfu_missing, any_stale)"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef r_module = {
+    PyModuleDef_HEAD_INIT, "_rollup",
+    "Rollup bucket-math kernel (native half of tpumon/fleet/rollup.py)",
+    -1, r_methods,
+};
+
+PyMODINIT_FUNC PyInit__rollup(void) {
+    PyObject *mod = PyModule_Create(&r_module);
+    if (!mod) return NULL;
+#define INTERN(name) \
+    K.name = PyUnicode_InternFromString(#name); \
+    if (!K.name) { Py_DECREF(mod); return NULL; }
+    INTERN(chips)
+    INTERN(duty_pct)
+    INTERN(hbm_used)
+    INTERN(hbm_total)
+    INTERN(ici)
+    INTERN(healthy)
+    INTERN(total)
+    INTERN(mfu)
+    INTERN(step_rate)
+    INTERN(energy)
+    INTERN(watts)
+    INTERN(source)
+    INTERN(tokens_per_joule)
+    INTERN(lifecycle_transition)
+    INTERN(degraded)
+    INTERN(active)
+    INTERN(straggler)
+    INTERN(skew_pct)
+    INTERN(step_skew_ratio)
+    INTERN(cause)
+    INTERN(hosts)
+    INTERN(up)
+    INTERN(stale)
+    INTERN(dark)
+    INTERN(degraded_hosts)
+    INTERN(duty)
+    INTERN(n)
+    INTERN(mean)
+    INTERN(min)
+    INTERN(max)
+    INTERN(links)
+    INTERN(mfu_n)
+    INTERN(step_rate_n)
+    INTERN(energy_watts)
+    INTERN(energy_n)
+    INTERN(tokens_per_joule_n)
+    INTERN(energy_source)
+    INTERN(lifecycle_transitions)
+    INTERN(stragglers)
+    INTERN(straggler_skew_max_pct)
+    INTERN(straggler_step_skew_max_ratio)
+    INTERN(visibility)
+    INTERN(score)
+    INTERN(hbm_headroom_ratio)
+#undef INTERN
+    return mod;
+}

@@ -584,6 +584,28 @@ FLEET_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "buckets' rollups were reused unchanged",
         (),
     ),
+    "tpu_fleet_rollup_shards": (
+        "gauge",
+        "Striped-ingest accumulator shard count "
+        "(TPUMON_FLEET_ROLLUP_STRIPES): fan-in writes land in "
+        "per-slice shards keyed by rendezvous of the slice identity, "
+        "so concurrent apply-delta calls never share a lock",
+        (),
+    ),
+    "tpu_fleet_rollup_shard_entries": (
+        "gauge",
+        "Feeds held per striped-ingest shard — a skewed distribution "
+        "means one slice dominates the fleet and its shard's lock "
+        "sees most of the write traffic",
+        ("shard",),
+    ),
+    "tpu_fleet_rollup_shard_writes_total": (
+        "counter",
+        "Snapshot stores landed per striped-ingest shard (the "
+        "writer-contention spread; rate it to see where fan-in write "
+        "traffic concentrates)",
+        ("shard",),
+    ),
 }
 
 #: family -> (prometheus type, description, extra labels) — the fleet
@@ -599,6 +621,22 @@ LEDGER_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "observed wall-clock × chips per job; partitions and "
         "aggregator-blind windows land in unaccounted, never in idle",
         ("scope", "pool", "slice", "bucket"),
+    ),
+    "tpu_fleet_goodput_energy_joules_total": (
+        "counter",
+        "Node energy attributed per job (scope=slice) and fleet-wide: "
+        "watts integrated over each feed's visible goodput accounting "
+        "windows (unaccounted windows invent no joules); "
+        "source=measured only when every contributing window's power "
+        "was device-reported",
+        ("scope", "pool", "slice", "source"),
+    ),
+    "tpu_fleet_goodput_energy_dollars_total": (
+        "counter",
+        "Per-job energy cost at the configured "
+        "TPUMON_FLEET_LEDGER_DOLLARS_PER_KWH electricity price; absent "
+        "(never 0) when no price is configured",
+        ("scope", "pool", "slice"),
     ),
     "tpu_ledger_series": (
         "gauge",

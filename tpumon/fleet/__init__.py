@@ -17,10 +17,17 @@ pre-aggregated tier. This package is that tier:
   and falling back to bounded HTTP /metrics polling, with the
   resilience plane's per-upstream circuit breaker + reconnect backoff
   and stale-but-served last-good snapshots.
+- :mod:`tpumon.fleet.stripes` — striped ingest shards: fan-in writers
+  push stored snapshots into per-slice accumulator shards (locks keyed
+  by rendezvous of the slice identity), so concurrent apply-delta
+  calls never share a lock and the collect cycle drains N shards
+  instead of taking one feed lock per feed per second.
 - :mod:`tpumon.fleet.rollup` — hierarchical node→slice→pool→fleet
   merge (duty, HBM headroom, ICI health scored per slice, MFU,
   degraded/stale/dark host counts) and the ``tpu_fleet_*``
-  recording-rule-style families built from it.
+  recording-rule-style families built from it; the bucket folds run
+  through the native kernel (``tpumon/_native/_rollup.c``) with pinned
+  byte-identical Python fallbacks.
 - :mod:`tpumon.fleet.server` — :class:`FleetAggregator`: the collect
   loop, the pre-rendered /metrics page (SampleCache reuse), the
   ``/fleet`` JSON API ``tpumon smi --aggregator`` consumes, guard-plane

@@ -121,6 +121,13 @@ class FleetConfig:
     #: full-snapshot-per-fetch — the A/B baseline; decode/rollup
     #: results are identical either way.
     delta: bool = True
+    #: Striped-ingest accumulator shard count (tpumon/fleet/stripes.py):
+    #: fan-in writers land snapshots in per-slice shards chosen by
+    #: rendezvous of the slice identity, so concurrent apply-delta
+    #: calls touch disjoint locks and the collect cycle drains N shards
+    #: instead of taking one lock per feed. More stripes = less writer
+    #: contention at very large fleets; the default suits 10k feeds.
+    rollup_stripes: int = 16
     #: Fleet efficiency ledger (tpumon/ledger): long-horizon tiered
     #: time-series store (1 s → 10 s → 5 min) over the curated rollup
     #: family set plus per-job goodput chip-second accounting, served
@@ -140,6 +147,12 @@ class FleetConfig:
     #: tiers); empty keeps the defaults 7200,93600,1209600 (2 h / 26 h
     #: / 14 d). Malformed entries keep their default.
     ledger_retention_s: str = ""
+    #: Electricity price for the per-job energy-dollars goodput rows
+    #: (tpu_fleet_goodput_energy_dollars_total, /ledger?view=goodput,
+    #: smi --ledger): joules observed per job convert at this $/kWh.
+    #: 0 (the default) keeps every dollars surface absent — a made-up
+    #: price would be confidently-wrong cost accounting.
+    ledger_dollars_per_kwh: float = 0.0
     #: Prometheus remote-write endpoint for the curated ledger samples
     #: (snappy+protobuf push, dependency-free). Empty (the default)
     #: disables — an external TSDB stays optional, not required.

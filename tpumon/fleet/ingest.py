@@ -281,6 +281,7 @@ class NodeFeed:
         observe_reject=None,
         observe_frame=None,
         observe_resync=None,
+        on_update=None,
         max_snapshot_bytes: int = 8388608,
         fresh_s: float = float("inf"),
         poll_backoff_base_s: float = 1.0,
@@ -303,6 +304,12 @@ class NodeFeed:
         #: live delta state, by cause (gap / epoch / full / reconnect) —
         #: the resync-storm triage signal (docs/OPERATIONS.md).
         self._observe_resync = observe_resync
+        #: on_update(target, snap, data_ts, content_seq): striped-ingest
+        #: push (tpumon/fleet/stripes.py) — every stored snapshot lands
+        #: in its slice's accumulator shard from the WRITER's thread, so
+        #: the collect cycle stops taking one feed lock per feed per
+        #: second. Values are the ones captured under this feed's lock.
+        self._on_update = on_update
         #: Negotiate the delta encoding (ROADMAP item 3). Off, the feed
         #: asks for snapshot/text only — the full-payload-per-fetch
         #: baseline the soak A/Bs against.
@@ -560,6 +567,25 @@ class NodeFeed:
             if self._content_cmp != cmp:
                 self._content_cmp = cmp
                 self.content_seq += 1
+            # The stripe push happens UNDER this feed's lock: the Watch
+            # thread and a poll-executor fetch can store concurrently
+            # during a transport transition, and dispatching after
+            # release could publish an older snapshot over a newer one
+            # (the stripe would then serve regressed data and a stale
+            # data_ts until the next store). Lock order feed→stripe is
+            # acyclic — nothing takes a feed lock while holding a
+            # stripe or route lock.
+            if self._on_update is not None:
+                try:
+                    self._on_update(
+                        self.target, snap, data_ts, self.content_seq
+                    )
+                except Exception:
+                    # A striping hiccup must never fail the ingest
+                    # path; the next store re-lands the state.
+                    log.exception(
+                        "%s: ingest stripe update failed", self.url
+                    )
         if now - data_ts <= self.fresh_s:
             # FRESH data restores full poll cadence; a zombie's frozen
             # timestamps do not (the fetch succeeded, the data is dead).
@@ -580,6 +606,18 @@ class NodeFeed:
                 k: v for k, v in snap.items() if k != "last_poll_ts"
             }
             self.content_seq += 1
+            # Under the lock for the same store-ordering guarantee as
+            # store_snapshot (a live fetch racing the restore must not
+            # be overwritten by the spooled snapshot in the stripe).
+            if self._on_update is not None:
+                try:
+                    self._on_update(
+                        self.target, snap, fetched_at, self.content_seq
+                    )
+                except Exception:
+                    log.exception(
+                        "%s: ingest stripe restore failed", self.url
+                    )
 
     def current(self) -> tuple[dict | None, float, str]:
         """(last-good snapshot, fetched-at ts, last error) — atomically."""

@@ -22,7 +22,13 @@ rollup math is unit-testable sample-for-sample (tests/test_fleet.py).
 
 from __future__ import annotations
 
+import logging
+
 from prometheus_client.core import GaugeMetricFamily
+
+from tpumon._native import load_extension
+
+log = logging.getLogger(__name__)
 
 #: Node ingest states (tpumon/fleet/ingest.py feeds, classified by age).
 UP = "up"
@@ -220,6 +226,76 @@ class _Agg:
         return doc
 
 
+def native_kernel():
+    """The native bucket-math kernel (tpumon/_native/_rollup.c), or
+    None when the pure-Python fold is in use — the bench and tests
+    record which path produced their numbers."""
+    return load_extension("_rollup")
+
+
+def _agg_from_state(state: tuple) -> _Agg:
+    """Rehydrate an :class:`_Agg` from the native kernel's state tuple
+    (field order is the kernel's output contract)."""
+    agg = _Agg()
+    (
+        agg.hosts[UP], agg.hosts[STALE], agg.hosts[DARK], agg.chips,
+        agg.duty_sum, agg.duty_n, agg.duty_min, agg.duty_max,
+        agg.hbm_used, agg.hbm_total,
+        agg.ici_healthy, agg.ici_links,
+        agg.mfu_sum, agg.mfu_n,
+        agg.step_rate_sum, agg.step_rate_n,
+        agg.energy_watts, agg.energy_n, agg.energy_modeled,
+        agg.tpj_sum, agg.tpj_n,
+        agg.lifecycle_transitions, agg.degraded_hosts,
+        agg.stragglers, agg.straggler_skew_max,
+        agg.straggler_step_skew_max,
+    ) = state
+    return agg
+
+
+def aggregate_members(members: list[tuple[dict, str]]) -> _Agg:
+    """Fold ``(snap, state)`` members into one bucket accumulator —
+    through the native kernel when it is available, else the pinned
+    pure-Python :meth:`_Agg.add_node` loop. The two paths are
+    value-identical by contract (tests/test_fleet_stripes.py pins it on
+    randomized buckets); a shape the kernel refuses falls back to the
+    Python loop, which is the arbiter of semantics either way."""
+    ext = load_extension("_rollup")
+    if ext is not None:
+        try:
+            return _agg_from_state(ext.aggregate(members))
+        except Exception:
+            # A shape outside the kernel's model: the Python loop
+            # either handles it or raises the genuine input error.
+            log.debug(
+                "native rollup kernel fell back to python", exc_info=True
+            )
+    agg = _Agg()
+    for snap, state in members:
+        agg.add_node(snap, state)
+    return agg
+
+
+def members_doc(members: list[tuple[dict, str]]) -> dict:
+    """One bucket's :meth:`_Agg.to_dict` doc from its ``(snap, state)``
+    members — straight to the doc in C when the kernel is available
+    (fold + doc construction without touching the interpreter), else
+    the pinned :func:`aggregate_members` + ``to_dict`` path. The hot
+    call of :class:`IncrementalRollup`."""
+    ext = load_extension("_rollup")
+    if ext is not None:
+        try:
+            return ext.aggregate_doc(members)
+        except Exception:
+            log.debug(
+                "native doc fold fell back to python", exc_info=True
+            )
+    agg = _Agg()
+    for snap, state in members:
+        agg.add_node(snap, state)
+    return agg.to_dict()
+
+
 def rollup(nodes: list[dict]) -> dict:
     """Merge node entries into the slice/pool/fleet hierarchy.
 
@@ -253,86 +329,164 @@ def rollup(nodes: list[dict]) -> dict:
 
 
 def merge_buckets(buckets: list[dict]) -> dict:
+    """Merge :meth:`_Agg.to_dict` shapes (pool/fleet folds every
+    collect cycle, plus the cross-shard ``scope="global"`` row) —
+    through the native kernel's ``merge`` when available, else the
+    pinned pure-Python fold. Value-identical by contract; a shape the
+    kernel refuses (exotic coercions) falls back to the Python fold,
+    which is the arbiter either way."""
+    ext = load_extension("_rollup")
+    if ext is not None:
+        try:
+            state = ext.merge(buckets)
+        except Exception:
+            log.debug(
+                "native merge kernel fell back to python", exc_info=True
+            )
+        else:
+            out = _agg_from_state(state[:26])
+            duty_missing, mfu_missing, any_stale = state[26:]
+            doc = out.to_dict()
+            doc["stale"] = doc["stale"] or any_stale
+            if duty_missing:
+                doc.pop("duty", None)
+            if mfu_missing:
+                doc.pop("mfu", None)
+                doc.pop("mfu_n", None)
+            return doc
+    return merge_buckets_py(buckets)
+
+
+def merge_buckets_py(buckets: list[dict]) -> dict:
     """Merge :meth:`_Agg.to_dict` shapes across shards (the cross-shard
     ``scope="global"`` row): host/chip/HBM/ICI/straggler totals are
     additive, duty/MFU means merge by their carried ``n`` weights,
     min/max and stale flags combine the obvious way, and visibility is
     recomputed from the merged host counts. Pure — peer summaries are
-    plain JSON dicts by the time they reach this."""
+    plain JSON dicts by the time they reach this. THE pinned reference
+    for the native kernel's ``merge`` (value-identical by contract).
+
+    Accumulation happens in locals (assigned into the :class:`_Agg`
+    once at the end): this merge runs per dirty pool per collect cycle
+    over every slice doc in the pool, and instance-attribute traffic
+    was a measured share of the full-rollup cost at 1024 nodes. The
+    arithmetic — coercions, order, min/max object identity — is
+    unchanged."""
     out = _Agg()
     duty_missing = mfu_missing = False
+    hosts_up = hosts_stale = hosts_dark = 0
+    chips = degraded_hosts = 0
+    duty_sum = 0.0
+    duty_n = 0
+    duty_min = duty_max = None
+    hbm_used = hbm_total = 0.0
+    ici_healthy = ici_links = 0
+    mfu_sum = 0.0
+    mfu_n = 0
+    step_rate_sum = 0.0
+    step_rate_n = 0
+    energy_watts = 0.0
+    energy_n = 0
+    energy_modeled = False
+    tpj_sum = 0.0
+    tpj_n = 0
+    lifecycle_transitions = 0
+    stragglers = out.stragglers
+    skew_max = step_skew_max = None
     for bucket in buckets:
         if not bucket:
             continue
-        hosts = bucket.get("hosts", {})
-        for state in (UP, STALE, DARK):
-            out.hosts[state] += int(hosts.get(state, 0))
-        out.chips += int(bucket.get("chips", 0))
-        out.degraded_hosts += int(bucket.get("degraded_hosts", 0))
-        duty = bucket.get("duty")
+        get = bucket.get
+        hosts = get("hosts", {})
+        hosts_up += int(hosts.get(UP, 0))
+        hosts_stale += int(hosts.get(STALE, 0))
+        hosts_dark += int(hosts.get(DARK, 0))
+        chips += int(get("chips", 0))
+        degraded_hosts += int(get("degraded_hosts", 0))
+        duty = get("duty")
         if duty and duty.get("n"):
             n = int(duty["n"])
-            out.duty_sum += float(duty["mean"]) * n
-            out.duty_n += n
+            duty_sum += float(duty["mean"]) * n
+            duty_n += n
             if duty.get("min") is not None:
-                out.duty_min = (
-                    duty["min"] if out.duty_min is None
-                    else min(out.duty_min, duty["min"])
+                duty_min = (
+                    duty["min"] if duty_min is None
+                    else min(duty_min, duty["min"])
                 )
             if duty.get("max") is not None:
-                out.duty_max = (
-                    duty["max"] if out.duty_max is None
-                    else max(out.duty_max, duty["max"])
+                duty_max = (
+                    duty["max"] if duty_max is None
+                    else max(duty_max, duty["max"])
                 )
         elif duty:
             # A pre-failover peer without the "n" weight: its mean
             # cannot merge honestly — drop duty from the global row
             # rather than guess a weight.
             duty_missing = True
-        out.hbm_used += float(bucket.get("hbm_used", 0.0))
-        out.hbm_total += float(bucket.get("hbm_total", 0.0))
-        ici = bucket.get("ici")
+        hbm_used += float(get("hbm_used", 0.0))
+        hbm_total += float(get("hbm_total", 0.0))
+        ici = get("ici")
         if ici:
-            out.ici_healthy += int(ici.get("healthy", 0))
-            out.ici_links += int(ici.get("links", 0))
-        if bucket.get("mfu") is not None:
-            n = int(bucket.get("mfu_n", 0))
+            ici_healthy += int(ici.get("healthy", 0))
+            ici_links += int(ici.get("links", 0))
+        if get("mfu") is not None:
+            n = int(get("mfu_n", 0))
             if n:
-                out.mfu_sum += float(bucket["mfu"]) * n
-                out.mfu_n += n
+                mfu_sum += float(bucket["mfu"]) * n
+                mfu_n += n
             else:
                 mfu_missing = True
-        if bucket.get("step_rate") is not None:
-            n = int(bucket.get("step_rate_n", 0))
+        if get("step_rate") is not None:
+            n = int(get("step_rate_n", 0))
             if n:
-                out.step_rate_sum += float(bucket["step_rate"]) * n
-                out.step_rate_n += n
-        if bucket.get("energy_watts") is not None:
-            out.energy_watts += float(bucket["energy_watts"])
-            out.energy_n += int(bucket.get("energy_n", 1))
-        if bucket.get("tokens_per_joule") is not None:
-            n = int(bucket.get("tokens_per_joule_n", 0))
+                step_rate_sum += float(bucket["step_rate"]) * n
+                step_rate_n += n
+        if get("energy_watts") is not None:
+            energy_watts += float(bucket["energy_watts"])
+            energy_n += int(get("energy_n", 1))
+        if get("tokens_per_joule") is not None:
+            n = int(get("tokens_per_joule_n", 0))
             if n:
-                out.tpj_sum += float(bucket["tokens_per_joule"]) * n
-                out.tpj_n += n
-        if bucket.get("energy_source") == "modeled":
-            out.energy_modeled = True
-        out.lifecycle_transitions += int(
-            bucket.get("lifecycle_transitions", 0)
-        )
-        for cause, count in bucket.get("stragglers", {}).items():
-            out.stragglers[cause] = out.stragglers.get(cause, 0) + int(count)
-        skew = bucket.get("straggler_skew_max_pct")
-        if skew is not None and (
-            out.straggler_skew_max is None or skew > out.straggler_skew_max
-        ):
-            out.straggler_skew_max = skew
-        step_skew = bucket.get("straggler_step_skew_max_ratio")
+                tpj_sum += float(bucket["tokens_per_joule"]) * n
+                tpj_n += n
+        if get("energy_source") == "modeled":
+            energy_modeled = True
+        lifecycle_transitions += int(get("lifecycle_transitions", 0))
+        for cause, count in get("stragglers", {}).items():
+            stragglers[cause] = stragglers.get(cause, 0) + int(count)
+        skew = get("straggler_skew_max_pct")
+        if skew is not None and (skew_max is None or skew > skew_max):
+            skew_max = skew
+        step_skew = get("straggler_step_skew_max_ratio")
         if step_skew is not None and (
-            out.straggler_step_skew_max is None
-            or step_skew > out.straggler_step_skew_max
+            step_skew_max is None or step_skew > step_skew_max
         ):
-            out.straggler_step_skew_max = step_skew
+            step_skew_max = step_skew
+    out.hosts[UP] = hosts_up
+    out.hosts[STALE] = hosts_stale
+    out.hosts[DARK] = hosts_dark
+    out.chips = chips
+    out.degraded_hosts = degraded_hosts
+    out.duty_sum = duty_sum
+    out.duty_n = duty_n
+    out.duty_min = duty_min
+    out.duty_max = duty_max
+    out.hbm_used = hbm_used
+    out.hbm_total = hbm_total
+    out.ici_healthy = ici_healthy
+    out.ici_links = ici_links
+    out.mfu_sum = mfu_sum
+    out.mfu_n = mfu_n
+    out.step_rate_sum = step_rate_sum
+    out.step_rate_n = step_rate_n
+    out.energy_watts = energy_watts
+    out.energy_n = energy_n
+    out.energy_modeled = energy_modeled
+    out.tpj_sum = tpj_sum
+    out.tpj_n = tpj_n
+    out.lifecycle_transitions = lifecycle_transitions
+    out.straggler_skew_max = skew_max
+    out.straggler_step_skew_max = step_skew_max
     doc = out.to_dict()
     doc["stale"] = doc["stale"] or any(
         b.get("stale") for b in buckets if b
@@ -393,43 +547,54 @@ class IncrementalRollup:
         Returns the same doc shape as :func:`rollup`."""
         dirty: set[tuple[str, str]] = set()
         dirty_nodes = 0
-        seen: set[str] = set()
+        # Local bindings: this loop runs once per feed per cycle — at
+        # 10k feeds the attribute lookups alone were a measurable share
+        # of the idle-path floor.
+        node_key = self._node_key
+        node_bucket = self._node_bucket
+        members_map = self._members
+        dirty_add = dirty.add
+        seen = {entry[0] for entry in entries}
         for target, snap, state, content_seq in entries:
-            seen.add(target)
             key = (content_seq, state)
-            if self._node_key.get(target) == key:
+            if node_key.get(target) == key:
                 continue
             dirty_nodes += 1
-            self._node_key[target] = key
+            node_key[target] = key
             snap = snap or {}
             ident = snap.get("identity") or {}
             bucket = (
                 ident.get("accelerator") or UNKNOWN_POOL,
                 ident.get("slice") or UNKNOWN_SLICE,
             )
-            prev_bucket = self._node_bucket.get(target)
+            prev_bucket = node_bucket.get(target)
             if prev_bucket is not None and prev_bucket != bucket:
-                members = self._members.get(prev_bucket)
+                members = members_map.get(prev_bucket)
                 if members is not None:
                     members.pop(target, None)
-                dirty.add(prev_bucket)
-            self._node_bucket[target] = bucket
-            self._members.setdefault(bucket, {})[target] = (snap, state)
-            dirty.add(bucket)
+                dirty_add(prev_bucket)
+            node_bucket[target] = bucket
+            members = members_map.get(bucket)
+            if members is None:
+                members = members_map[bucket] = {}
+            members[target] = (snap, state)
+            dirty_add(bucket)
         # Feeds that left this shard (membership change / takeover
         # hand-back) leave their buckets too — adopted-elsewhere nodes
         # must never stay counted here, or a takeover double-counts.
-        for target in list(self._node_key):
-            if target in seen:
-                continue
-            dirty_nodes += 1
-            del self._node_key[target]
-            bucket = self._node_bucket.pop(target, None)
-            if bucket is not None:
-                members = self._members.get(bucket)
-                if members is not None:
-                    members.pop(target, None)
-                dirty.add(bucket)
+        # The main loop only ever ADDS to node_key, so after it
+        # node_key ⊇ seen: a length mismatch is exactly "departures
+        # exist", and steady-state cycles skip the O(fleet) scan.
+        if len(node_key) > len(seen):
+            for target in [t for t in node_key if t not in seen]:
+                dirty_nodes += 1
+                del node_key[target]
+                bucket = node_bucket.pop(target, None)
+                if bucket is not None:
+                    members = members_map.get(bucket)
+                    if members is not None:
+                        members.pop(target, None)
+                    dirty_add(bucket)
         dirty_pools: set[str] = set()
         for bucket in dirty:
             members = self._members.get(bucket)
@@ -437,22 +602,33 @@ class IncrementalRollup:
                 self._members.pop(bucket, None)
                 self._slice_docs.pop(bucket, None)
             else:
-                agg = _Agg()
-                for snap, state in members.values():
-                    agg.add_node(snap, state)
-                self._slice_docs[bucket] = agg.to_dict()
+                # The bucket fold is the rollup's hot loop — native
+                # kernel when available, pinned Python loop otherwise.
+                # Members fold in SORTED target order: float sums are
+                # order-sensitive, and canonical order makes the doc a
+                # pure function of the member set — byte-identical
+                # across arrival histories, restarts, and shards
+                # (tests/test_fleet_stripes.py pins it under a
+                # concurrent-writer hammer).
+                self._slice_docs[bucket] = members_doc(
+                    [members[t] for t in sorted(members)]
+                )
             dirty_pools.add(bucket[0])
         if dirty:
             for pool in dirty_pools:
+                # Sorted slice order for the same canonical-order
+                # reason as the member fold above.
                 docs = [
-                    doc for (p, _s), doc in self._slice_docs.items()
+                    doc for (p, _s), doc in sorted(self._slice_docs.items())
                     if p == pool
                 ]
                 if docs:
                     self._pool_docs[pool] = merge_buckets(docs)
                 else:
                     self._pool_docs.pop(pool, None)
-            fleet = merge_buckets(list(self._pool_docs.values()))
+            fleet = merge_buckets(
+                [self._pool_docs[p] for p in sorted(self._pool_docs)]
+            )
             fleet["slices"] = len(self._slice_docs)
             fleet["pools"] = len(self._pool_docs)
             self._fleet_doc = fleet
@@ -693,10 +869,14 @@ __all__ = [
     "IncrementalRollup",
     "STALE",
     "UP",
+    "aggregate_members",
     "classify",
     "fleet_families",
     "jsonable",
+    "members_doc",
     "merge_buckets",
+    "merge_buckets_py",
+    "native_kernel",
     "rollup",
     "visibility_of",
 ]

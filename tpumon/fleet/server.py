@@ -139,6 +139,31 @@ class FleetTelemetry:
             "other bucket's rollup was reused unchanged.",
             registry=registry,
         )
+        self.rollup_shards = Gauge(
+            "tpu_fleet_rollup_shards",
+            "Striped-ingest accumulator shard count "
+            "(TPUMON_FLEET_ROLLUP_STRIPES): fan-in writes land in "
+            "per-slice shards keyed by rendezvous of the slice "
+            "identity, so concurrent apply-delta calls never share a "
+            "lock.",
+            registry=registry,
+        )
+        self.rollup_shard_entries = Gauge(
+            "tpu_fleet_rollup_shard_entries",
+            "Feeds held per striped-ingest shard — a skewed "
+            "distribution means one slice dominates the fleet and its "
+            "shard's lock sees most of the write traffic.",
+            labelnames=("shard",),
+            registry=registry,
+        )
+        self.rollup_shard_writes = Counter(
+            "tpu_fleet_rollup_shard_writes",
+            "Snapshot stores landed per striped-ingest shard (the "
+            "writer-contention spread; rate it to see where fan-in "
+            "write traffic concentrates).",
+            labelnames=("shard",),
+            registry=registry,
+        )
         self.shed = Counter(
             "tpumon_shed_requests",
             "Requests refused by the aggregator's ingress guard "
@@ -280,6 +305,25 @@ class FleetAggregator:
         self._apply_lock = threading.Lock()
         self._watching = False  # start_watch() deferred until start()
 
+        from tpumon.fleet.stripes import StripedIngest
+
+        #: Striped ingest shards (ISSUE 15): fan-in writers push stored
+        #: snapshots here from their OWN threads; the collect cycle
+        #: drains per-stripe state instead of taking one feed lock per
+        #: feed per second.
+        self.stripes = StripedIngest(cfg.rollup_stripes)
+        #: Last harvested per-shard write totals (collect thread only)
+        #: — the counter metric increments by delta.
+        self._shard_writes_seen = [0] * self.stripes.stripe_count
+        self.telemetry.rollup_shards.set(float(self.stripes.stripe_count))
+        for idx in range(self.stripes.stripe_count):
+            # Pre-created at 0 so the shard-distribution panel shows
+            # every stripe from the first scrape, quiet ones included.
+            self.telemetry.rollup_shard_writes.labels(shard=str(idx))
+            self.telemetry.rollup_shard_entries.labels(shard=str(idx)).set(
+                0.0
+            )
+
         #: Fan-in budget: at most `concurrency` upstream HTTP fetches in
         #: flight per shard, whatever the fleet size. Deliberately NOT
         #: niced below the serving threads: a demoted thread that holds
@@ -361,6 +405,7 @@ class FleetAggregator:
                 remote_write_url=cfg.ledger_remote_write_url,
                 remote_write_every_s=cfg.ledger_remote_write_every_s,
                 remote_write_timeout=cfg.timeout,
+                dollars_per_kwh=cfg.ledger_dollars_per_kwh,
             )
 
         from tpumon.exporter.server import _SelfTelemetryPage
@@ -514,6 +559,10 @@ class FleetAggregator:
             for target in owned:
                 feed = current.get(target)
                 if feed is None:
+                    # Stripe admission FIRST: the restore below fires
+                    # on_update into the stripes, and a never-reporting
+                    # feed must still be counted (dark) from adoption.
+                    self.stripes.register(target)
                     feed = NodeFeed(
                         target,
                         timeout=cfg.timeout,
@@ -522,6 +571,7 @@ class FleetAggregator:
                         observe_reject=self._observe_reject,
                         observe_frame=self._observe_frame,
                         observe_resync=self._observe_resync,
+                        on_update=self.stripes.put,
                         delta=cfg.delta,
                         max_snapshot_bytes=cfg.max_snapshot_bytes,
                         fresh_s=cfg.stale_s,
@@ -562,6 +612,11 @@ class FleetAggregator:
                     float(self._restored_count)
                 )
         for feed in removed:
+            # Stripe eviction BEFORE stop: a hand-back must leave the
+            # rollup the same cycle it leaves the shard (the peer now
+            # counts it — lingering here would double-count), and a
+            # late in-flight store hits the route check and is dropped.
+            self.stripes.remove(feed.target)
             # Outside the apply lock: stop() joins the watch thread.
             try:
                 feed.stop()
@@ -682,6 +737,8 @@ class FleetAggregator:
             "rollup": {
                 "dirty_nodes": self._rollup.last_dirty_nodes,
                 "dirty_buckets": self._rollup.last_dirty_buckets,
+                "stripes": self.stripes.stripe_count,
+                "shards": self.stripes.stats(),
             },
         }
         if self.spool is not None:
@@ -828,21 +885,18 @@ class FleetAggregator:
                 state = feed.watch_state_now()
                 watch_states[state] = watch_states.get(state, 0) + 1
         with trace_span("rollup"):
-            # Churn-proportional cycle: the per-feed scan is one lock +
-            # one age compare each (the unavoidable O(fleet) floor);
-            # everything heavier — bucket re-aggregation, family
-            # construction for changed values, render — tracks how many
-            # feeds actually CHANGED (content_seq) or crossed an ingest
-            # state boundary.
-            entries = []
-            for feed in feeds:
-                snap, fetched_at, _error, content_seq = feed.current_entry()
-                age = (
-                    float("inf") if fetched_at == 0.0
-                    else max(0.0, now - fetched_at)
-                )
-                state = classify(age, self.cfg.stale_s, self.cfg.evict_s)
-                entries.append((feed.target, snap, state, content_seq))
+            # Churn-proportional cycle over the STRIPED shards: fan-in
+            # writers already pushed every stored snapshot into its
+            # slice's stripe, so the publish step drains N stripe locks
+            # (zero feed locks) and classifies ages — the unavoidable
+            # O(fleet) floor, since fresh→stale→dark transitions happen
+            # with no write arriving. Everything heavier — bucket
+            # re-aggregation (native kernel), family construction,
+            # render — tracks how many feeds actually CHANGED
+            # (content_seq) or crossed an ingest state boundary.
+            entries = self.stripes.entries(
+                now, self.cfg.stale_s, self.cfg.evict_s
+            )
             doc = self._rollup.update(entries)
             membership = self.membership.snapshot()
             self._merge_peers(doc, membership)
@@ -886,6 +940,17 @@ class FleetAggregator:
         t.up.set(1.0)
         t.rollup_dirty_nodes.set(float(self._rollup.last_dirty_nodes))
         t.rollup_dirty_buckets.set(float(self._rollup.last_dirty_buckets))
+        t.rollup_shards.set(float(self.stripes.stripe_count))
+        for idx, shard in enumerate(self.stripes.stats()):
+            t.rollup_shard_entries.labels(shard=str(idx)).set(
+                float(shard["entries"])
+            )
+            delta_writes = shard["writes"] - self._shard_writes_seen[idx]
+            if delta_writes > 0:
+                t.rollup_shard_writes.labels(shard=str(idx)).inc(
+                    delta_writes
+                )
+                self._shard_writes_seen[idx] = shard["writes"]
         for state, n in watch_states.items():
             t.watch_streams.labels(state=state).set(float(n))
         t.membership_targets.labels(source=membership["source"]).set(

@@ -1,0 +1,235 @@
+"""Striped ingest: per-slice accumulator shards for the fan-in hot path.
+
+Before this module, every collect cycle walked every :class:`NodeFeed`
+and took its lock to read the current snapshot — an O(fleet) lock
+acquisition per second on the collect thread, interleaved against the
+same locks the Watch threads and the poll executor were taking to
+store data. At 10k feeds that serialization IS the ingest ceiling: the
+cost grows with fleet size even when nothing changed.
+
+Here the flow is inverted. Fan-in writers PUSH each stored snapshot
+into one of N accumulator shards ("stripes"), each with its own lock,
+chosen by **rendezvous hash of the slice identity** — so concurrent
+apply-delta calls for different slices touch disjoint shards (a slice's
+writers share one, which is also where its rollup locality lives), and
+the collect cycle's publish step drains per-stripe state under N brief
+lock holds instead of one per feed. The per-cycle floor is one age
+classification per feed (states age without any write arriving —
+fresh→stale→dark must be observed); everything heavier stays
+churn-proportional one layer down (rollup.IncrementalRollup).
+
+Membership discipline mirrors the rollup's no-double-count contract:
+``register`` is the only admission (a never-reported feed is counted
+DARK, not invisible), ``remove`` evicts, and a late in-flight ``put``
+for a target this shard no longer owns is dropped — a handed-back feed
+can never linger in a stripe and double-count across shards. A write
+racing a same-target slice move can briefly leave a stale copy in the
+old stripe; the publish scan resolves every target against the route
+table and lazily evicts copies whose route moved on, so duplicates are
+never emitted. Moves themselves (an identity change, or the first
+identity-bearing store after admission) serialize against publish
+scans on the route lock: a mid-move target must never be absent from
+EVERY stripe while a scan runs, or the cycle would publish it as
+departed and the goodput ledger would drop its accounting window
+outside even the ``unaccounted`` bucket. The common write path — same
+stripe as last time — takes only its stripe's lock.
+
+Byte-identity contract: ``entries()`` feeds the same
+:class:`~tpumon.fleet.rollup.IncrementalRollup` the single-lock path
+used, so the published rollup is byte-identical to the reference
+``rollup()`` over the same entries (tests/test_fleet_stripes.py
+hammers exactly this with concurrent writers).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from tpumon.fleet.rollup import classify
+from tpumon.fleet.shard import shard_of
+
+
+def stripe_of(key: str, stripe_count: int) -> int:
+    """Rendezvous winner among ``stripe_count`` stripes for ``key`` —
+    the shard-assignment hash (tpumon/fleet/shard.py) one level down,
+    delegated so there is exactly ONE rendezvous contract: stable
+    across processes, and growing the stripe set moves only the keys
+    the new stripe wins."""
+    return shard_of(key, stripe_count)
+
+
+class _Stripe:
+    """One accumulator shard: its lock, the per-target ingest state it
+    holds, and a write counter (the contention-spread telemetry)."""
+
+    __slots__ = ("lock", "entries", "writes")
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        #: target -> (snap|None, data_ts, content_seq); all three come
+        #: from the writer's own feed state, captured atomically there.
+        self.entries: dict[str, tuple] = {}
+        self.writes = 0
+
+
+class StripedIngest:
+    """The stripe set plus the target→stripe route table."""
+
+    def __init__(self, stripes: int = 16) -> None:
+        self.stripe_count = max(1, int(stripes))
+        self._stripes = [_Stripe() for _ in range(self.stripe_count)]
+        #: slice-identity -> stripe index. Cache of a pure function;
+        #: racy writes recompute the same deterministic answer, so no
+        #: lock (GIL-atomic dict ops).
+        self._slice_stripe: dict[str, int] = {}
+        self._route_lock = threading.Lock()
+        #: target -> stripe index it currently lives in. Registration
+        #: is admission: a put for an unrouted target is a late
+        #: in-flight store for a feed this shard handed away — dropped.
+        self._route: dict[str, int] = {}  # guarded-by: self._route_lock
+
+    # -- routing ------------------------------------------------------------
+
+    def _stripe_for_slice(self, slice_key: str) -> int:
+        idx = self._slice_stripe.get(slice_key)
+        if idx is None:
+            idx = stripe_of(slice_key, self.stripe_count)
+            self._slice_stripe[slice_key] = idx
+        return idx
+
+    @staticmethod
+    def _slice_key(snap: dict | None) -> str | None:
+        ident = (snap or {}).get("identity") or {}
+        pool = ident.get("accelerator")
+        slc = ident.get("slice")
+        if not pool and not slc:
+            return None
+        return f"{pool or ''}|{slc or ''}"
+
+    # -- membership (aggregator's membership thread) ------------------------
+
+    def register(self, target: str) -> None:
+        """Admit a target: a placeholder entry exists from this moment,
+        so a feed that never delivers is counted DARK — absence stays
+        observable, exactly like the pre-stripe path."""
+        with self._route_lock:
+            if target in self._route:
+                return
+            # No identity yet: route by target so placeholders spread.
+            # Placeholder lands under the route lock (route→stripe
+            # order) so a scan can never see the route without an
+            # entry backing it.
+            idx = stripe_of(target, self.stripe_count)
+            self._route[target] = idx
+            stripe = self._stripes[idx]
+            with stripe.lock:
+                stripe.entries.setdefault(target, (None, 0.0, 0))
+
+    def remove(self, target: str) -> None:
+        """Evict a handed-back/departed target. Stale copies a racing
+        writer may have left elsewhere die lazily on the next publish
+        scan (their route entry is gone)."""
+        with self._route_lock:
+            idx = self._route.pop(target, None)
+            if idx is None:
+                return
+            stripe = self._stripes[idx]
+            with stripe.lock:
+                stripe.entries.pop(target, None)
+
+    # -- writers (Watch threads / poll executor) ----------------------------
+
+    def put(
+        self, target: str, snap: dict | None, data_ts: float,
+        content_seq: int,
+    ) -> None:
+        """Land one stored snapshot in its slice's stripe.
+
+        Common path (stripe unchanged): one GIL-atomic route read + the
+        one stripe lock — writers for different slices never contend,
+        and the route lock is untouched. An identity MOVE takes the
+        route lock for the whole relocation (pop + insert under it, in
+        route→stripe lock order), which serializes moves against
+        publish scans: mid-move, the target is always present in at
+        least one stripe a scan can still reach, so a live feed can
+        never be published as departed for a cycle (which would make
+        the goodput ledger silently drop its window)."""
+        # Lock-free point read: a racing remove() leaves at worst a
+        # ghost entry that the publish scan lazily evicts unemitted.
+        cur = self._route.get(target)  # tpumon-invariants: disable=lock-discipline (GIL-atomic point read; the move path re-checks under the lock)
+        if cur is None:
+            return  # not (or no longer) owned: late in-flight store
+        slice_key = self._slice_key(snap)
+        dest = (
+            self._stripe_for_slice(slice_key)
+            if slice_key is not None else cur
+        )
+        if dest == cur:
+            stripe = self._stripes[cur]
+            with stripe.lock:
+                stripe.entries[target] = (snap, data_ts, content_seq)
+                stripe.writes += 1
+            return
+        with self._route_lock:
+            cur = self._route.get(target)
+            if cur is None:
+                return  # removed while we raced: drop, never resurrect
+            if dest != cur:
+                self._route[target] = dest
+                old = self._stripes[cur]
+                with old.lock:
+                    old.entries.pop(target, None)
+            stripe = self._stripes[dest]
+            with stripe.lock:
+                stripe.entries[target] = (snap, data_ts, content_seq)
+                stripe.writes += 1
+
+    # -- publish (collect thread) -------------------------------------------
+
+    def entries(
+        self, now: float, stale_s: float, evict_s: float
+    ) -> list[tuple]:
+        """One cycle's ``(target, snap, state, content_seq)`` rows —
+        the :class:`IncrementalRollup` / goodput-ledger input shape.
+        N brief stripe-lock holds; zero feed locks. Targets whose route
+        moved on (slice move, hand-back) are lazily evicted here rather
+        than emitted twice. The route lock is held across the scan so a
+        concurrent identity MOVE cannot leave a target absent from
+        every stripe mid-scan (common-path writes never take it — only
+        movers and membership wait, both rare)."""
+        out: list[tuple] = []
+        with self._route_lock:
+            route_get = self._route.get
+            for idx, stripe in enumerate(self._stripes):
+                evict: list[str] = []
+                with stripe.lock:
+                    for target, (snap, ts, seq) in stripe.entries.items():
+                        if route_get(target) != idx:
+                            evict.append(target)
+                            continue
+                        age = (
+                            float("inf") if ts == 0.0
+                            else max(0.0, now - ts)
+                        )
+                        out.append(
+                            (target, snap,
+                             classify(age, stale_s, evict_s), seq)
+                        )
+                    for target in evict:
+                        del stripe.entries[target]
+        return out
+
+    def stats(self) -> list[dict]:
+        """Per-stripe occupancy + cumulative writes (the
+        ``tpu_fleet_rollup_shard_*`` telemetry)."""
+        out = []
+        for stripe in self._stripes:
+            with stripe.lock:
+                out.append(
+                    {"entries": len(stripe.entries),
+                     "writes": stripe.writes}
+                )
+        return out
+
+
+__all__ = ["StripedIngest", "stripe_of"]

@@ -36,6 +36,36 @@ from tpumon.hostcorr.sampler import SIGNAL_GROUPS, HostSampler
 log = logging.getLogger(__name__)
 
 
+def _same_job_step_seconds(feeds: dict) -> dict[str, float]:
+    """Per-feed step seconds from the lifecycle block, restricted to
+    the LARGEST group of feeds sharing one workload mesh signature
+    (``workload_mesh_info`` axes — the job identity a feed carries).
+
+    Two different jobs sharing a pool run at legitimately different
+    step times; comparing them would arm the step-skew stream against
+    a phantom straggler. Feeds without a mesh signature group together
+    (device-only harnesses all look alike — better one honest bucket
+    than silently dropping them). Ties break deterministically on the
+    first-seen group, i.e. lifecycle feed configuration order."""
+    groups: dict[tuple, dict[str, float]] = {}
+    for url, feed in feeds.items():
+        if not isinstance(feed, dict):
+            continue
+        seconds = feed.get("step_seconds")
+        if seconds is None:
+            continue
+        axes = feed.get("axes")
+        sig = (
+            tuple(sorted(axes.items())) if isinstance(axes, dict) else ()
+        )
+        groups.setdefault(sig, {})[url] = seconds
+    best: dict[str, float] = {}
+    for group in groups.values():
+        if len(group) >= 2 and len(group) > len(best):
+            best = group
+    return best
+
+
 class HostCorrPlane:
     """Thread model: ``cycle`` runs on the poller thread only;
     ``replay``/``snapshot``/``resize`` may be called from HTTP threads —
@@ -98,17 +128,17 @@ class HostCorrPlane:
         evidence = {"throttled": worst_throttled}
         # Step-skew evidence (ROADMAP remnant): when the lifecycle plane
         # — which runs earlier in the same poll cycle — probes multiple
-        # hosts of one job, the per-feed step durations feed the judge's
+        # hosts of ONE JOB, the per-feed step durations feed the judge's
         # second evidence stream (a lagging HOST with locally balanced
         # chips is invisible to duty skew). Cause attribution unchanged.
-        step_seconds = {
-            url: feed["step_seconds"]
-            for url, feed in (
-                (snap.get("lifecycle") or {}).get("feeds") or {}
-            ).items()
-            if isinstance(feed, dict)
-            and feed.get("step_seconds") is not None
-        }
+        # Feeds group by their workload's mesh signature first: two
+        # DIFFERENT jobs sharing a pool (the interference scenario)
+        # legitimately run at different step times, and a cross-job
+        # median would read that as a straggler forever. Only the
+        # largest same-signature group (≥2 feeds) arms the stream.
+        step_seconds = _same_job_step_seconds(
+            (snap.get("lifecycle") or {}).get("feeds") or {}
+        )
         verdict = self._judge.judge(
             duties, host, evidence, t, step_seconds=step_seconds or None
         )

@@ -81,9 +81,15 @@ class GoodputLedger:
         self,
         contended_wait: float = 0.25,
         idle_duty_pct: float = 5.0,
+        dollars_per_kwh: float = 0.0,
     ) -> None:
         self.contended_wait = contended_wait
         self.idle_duty_pct = idle_duty_pct
+        #: Electricity price for the energy-dollars rows; 0 keeps every
+        #: dollars surface ABSENT (a made-up price would be
+        #: confidently-wrong cost accounting — the energy plane's
+        #: stance, applied to the ledger).
+        self.dollars_per_kwh = dollars_per_kwh
         #: One lock for the structural state: account() runs on the
         #: collect thread while jobs_doc()/totals() serve /ledger on
         #: HTTP threads — a new job appearing mid-iteration would
@@ -92,6 +98,12 @@ class GoodputLedger:
         self._feeds: dict[str, _FeedState] = {}  # guarded-by: self._lock
         #: (pool, slice) -> {bucket: chip_seconds}.
         self._jobs: dict[tuple[str, str], dict[str, float]] = {}  # guarded-by: self._lock
+        #: (pool, slice) -> [joules, modeled?] — node watts integrated
+        #: over each feed's accounting windows (ROADMAP item 2
+        #: follow-up: the energy plane's watts joined into the goodput
+        #: rows). Kept BESIDE the bucket dict: joules are not
+        #: chip-seconds and must never leak into conservation sums.
+        self._job_energy: dict[tuple[str, str], list] = {}  # guarded-by: self._lock
         #: Aggregator-blind seconds ledgered (warm-restart gaps).
         self.gap_seconds = 0.0  # guarded-by: self._lock
 
@@ -128,6 +140,19 @@ class GoodputLedger:
                     feed.job, dict.fromkeys(BUCKETS, 0.0)
                 )
                 job[bucket] += dt * feed.chips
+                # Energy join: the node's CURRENT watts integrate over
+                # this window (visible windows only — an unaccounted
+                # window invents no joules; that honesty already lives
+                # in `state`). Worst-of provenance, like every energy
+                # rollup.
+                energy = (snap or {}).get("energy") if state == "up" else None
+                if energy and energy.get("watts"):
+                    row = self._job_energy.setdefault(
+                        feed.job, [0.0, False]
+                    )
+                    row[0] += float(energy["watts"]) * dt
+                    if energy.get("source") != "measured":
+                        row[1] = True
         # Departed feeds (membership change / hand-back) stop accruing:
         # their job totals stay — the ledger is history, not state.
         for target in list(self._feeds):
@@ -261,13 +286,31 @@ class GoodputLedger:
                 out[bucket] += value
         return out
 
+    def job_energy(self) -> dict[tuple[str, str], tuple[float, bool]]:
+        """(pool, slice) -> (joules, modeled?) — node watts integrated
+        over the job's visible accounting windows."""
+        with self._lock:
+            return {
+                job: (row[0], row[1])
+                for job, row in self._job_energy.items()
+            }
+
+    def dollars_of(self, joules: float) -> float | None:
+        """Joules -> dollars at the configured $/kWh; None when no
+        price is configured (dollars surfaces stay absent, never 0)."""
+        if self.dollars_per_kwh <= 0:
+            return None
+        return joules / 3.6e6 * self.dollars_per_kwh
+
     def jobs_doc(self) -> list[dict]:
         """The /ledger?view=goodput rows: per-job splits with the
-        conservation total spelled out."""
+        conservation total spelled out, plus the energy join (joules
+        always when observed; dollars only at a configured price)."""
+        energy = self.job_energy()
         rows = []
         for (pool, slc), buckets in sorted(self.jobs().items()):
             total = sum(buckets.values())
-            rows.append({
+            row = {
                 "pool": pool,
                 "slice": slc,
                 "chip_seconds": total,
@@ -275,7 +318,16 @@ class GoodputLedger:
                 "goodput_ratio": (
                     buckets["productive"] / total if total > 0 else None
                 ),
-            })
+            }
+            joules_row = energy.get((pool, slc))
+            if joules_row is not None:
+                joules, modeled = joules_row
+                row["energy_joules"] = joules
+                row["energy_source"] = "modeled" if modeled else "measured"
+                dollars = self.dollars_of(joules)
+                if dollars is not None:
+                    row["energy_dollars"] = dollars
+            rows.append(row)
         return rows
 
     # -- spool round-trip ---------------------------------------------------
@@ -286,6 +338,13 @@ class GoodputLedger:
                 "jobs": [
                     {"pool": pool, "slice": slc, "buckets": dict(buckets)}
                     for (pool, slc), buckets in sorted(self._jobs.items())
+                ],
+                "energy": [
+                    {"pool": pool, "slice": slc, "joules": row[0],
+                     "modeled": bool(row[1])}
+                    for (pool, slc), row in sorted(
+                        self._job_energy.items()
+                    )
                 ],
                 "feeds": {
                     target: {
@@ -316,6 +375,14 @@ class GoodputLedger:
                     if bucket in buckets:
                         buckets[bucket] = float(value)
                 self._jobs[job] = buckets
+            except (KeyError, TypeError, ValueError):
+                continue
+        for row in doc.get("energy", ()):
+            try:
+                job = (str(row["pool"]), str(row["slice"]))
+                self._job_energy[job] = [
+                    float(row["joules"]), bool(row.get("modeled"))
+                ]
             except (KeyError, TypeError, ValueError):
                 continue
         for target, row in (doc.get("feeds") or {}).items():

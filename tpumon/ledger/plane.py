@@ -20,12 +20,20 @@ import urllib.parse
 
 from tpumon.ledger.goodput import BUCKETS, GoodputLedger
 from tpumon.ledger.store import (
+    AGGS,
     LEDGER_FAMILY_SET,
     STATS,
     TieredSeriesStore,
     TierSpec,
     default_tiers,
 )
+
+#: ?by= grouping for aggregated range queries: the label(s) each output
+#: series keeps. ``job`` is the (pool, slice) identity — the goodput
+#: ledger's job key — so ``by=job`` and ``by=slice`` group identically
+#: but read differently at call sites; ``none`` collapses everything
+#: matched into one series.
+GROUP_BYS = ("pool", "slice", "job", "none")
 
 log = logging.getLogger(__name__)
 
@@ -53,12 +61,14 @@ class LedgerPlane:
         remote_write_timeout: float = 5.0,
         contended_wait: float = 0.25,
         idle_duty_pct: float = 5.0,
+        dollars_per_kwh: float = 0.0,
         clock=time.time,
     ) -> None:
         self._clock = clock
         self.tiers = tuple(tiers) if tiers else default_tiers()
         self.goodput = GoodputLedger(
-            contended_wait=contended_wait, idle_duty_pct=idle_duty_pct
+            contended_wait=contended_wait, idle_duty_pct=idle_duty_pct,
+            dollars_per_kwh=dollars_per_kwh,
         )
         self.spool = None
         self.spool_every_s = spool_every_s
@@ -270,6 +280,57 @@ class LedgerPlane:
                 )
         for bucket, value in self.goodput.totals().items():
             goodput.add_metric(("fleet", "", "", bucket), value)
+        energy_fams: list = []
+        job_energy = self.goodput.job_energy()
+        if job_energy:
+            joules = CounterMetricFamily(
+                "tpu_fleet_goodput_energy_joules",
+                "Node energy attributed per job (scope=slice) and "
+                "fleet-wide: watts integrated over each feed's visible "
+                "goodput accounting windows (unaccounted windows "
+                "invent no joules); source=measured only when every "
+                "contributing window's power was device-reported.",
+                labels=("scope", "pool", "slice", "source"),
+            )
+            fleet_joules = 0.0
+            fleet_modeled = False
+            for (pool, slc), (value, modeled) in sorted(
+                job_energy.items()
+            ):
+                joules.add_metric(
+                    ("slice", pool, slc,
+                     "modeled" if modeled else "measured"),
+                    value,
+                )
+                fleet_joules += value
+                fleet_modeled = fleet_modeled or modeled
+            joules.add_metric(
+                ("fleet", "", "",
+                 "modeled" if fleet_modeled else "measured"),
+                fleet_joules,
+            )
+            energy_fams.append(joules)
+            if self.goodput.dollars_per_kwh > 0:
+                dollars = CounterMetricFamily(
+                    "tpu_fleet_goodput_energy_dollars",
+                    "Per-job energy cost at the configured "
+                    "TPUMON_FLEET_LEDGER_DOLLARS_PER_KWH price; absent "
+                    "(never 0) when no price is configured — a made-up "
+                    "price would be confidently-wrong cost accounting.",
+                    labels=("scope", "pool", "slice"),
+                )
+                for (pool, slc), (value, _modeled) in sorted(
+                    job_energy.items()
+                ):
+                    dollars.add_metric(
+                        ("slice", pool, slc),
+                        self.goodput.dollars_of(value),
+                    )
+                dollars.add_metric(
+                    ("fleet", "", ""),
+                    self.goodput.dollars_of(fleet_joules),
+                )
+                energy_fams.append(dollars)
         stats = self.store.stats()
         series = GaugeMetricFamily(
             "tpu_ledger_series",
@@ -319,7 +380,8 @@ class LedgerPlane:
             labels=(),
         )
         queries.add_metric((), float(self.queries_total))
-        out = [goodput, series, samples, nbytes, dropped, gap, queries]
+        out = [goodput, *energy_fams, series, samples, nbytes, dropped,
+               gap, queries]
         if self.spool is not None:
             spool_errors = CounterMetricFamily(
                 "tpu_ledger_spool_errors",
@@ -349,13 +411,21 @@ class LedgerPlane:
 
         - no parameters: the index (families, tiers, occupancy,
           goodput totals);
-        - ``?view=goodput``: per-job bucket splits + conservation;
+        - ``?view=goodput``: per-job bucket splits + conservation
+          (plus the energy joules/dollars join when observed);
         - ``?family=...``: a range query — ``scope`` (slice/pool/fleet),
           optional ``pool``/``slice`` filters, ``start``/``end`` epoch
           seconds (default: the last hour), ``step`` seconds (tier
           selection hint), ``stat`` (mean/min/max at aggregate tiers),
           ``max_points`` (server-capped). Bounded responses carry
           ``next_start`` continuation cursors.
+        - ``?family=...&agg=sum|mean|max[&by=pool|slice|job|none]``:
+          SERVER-SIDE aggregation — the matched series fold across
+          each other inside the read path (decode → aggregate →
+          re-emit; the raw range is never materialized), one output
+          series per ``by`` group. Byte-stable vs aggregating the raw
+          range client-side (tests pin it), so consumers stop shipping
+          per-slice series to compute a per-pool number.
         """
         self.queries_total += 1
         try:
@@ -369,6 +439,7 @@ class LedgerPlane:
                 "jobs": self.goodput.jobs_doc(),
                 "totals": self.goodput.totals(),
                 "gap_seconds": self.goodput.gap_seconds,
+                "dollars_per_kwh": self.goodput.dollars_per_kwh,
             }), "200 OK"
         family = params.get("family")
         if not family:
@@ -407,6 +478,56 @@ class LedgerPlane:
             and ("pool" not in params or key[2] == params["pool"])
             and ("slice" not in params or key[3] == params["slice"])
         ]
+        agg = params.get("agg")
+        if agg is not None:
+            if agg not in AGGS:
+                return _json_bytes(
+                    {"error": f"agg must be one of {AGGS}"}
+                ), "400 Bad Request"
+            by = params.get("by", "none")
+            if by not in GROUP_BYS:
+                return _json_bytes(
+                    {"error": f"by must be one of {GROUP_BYS}"}
+                ), "400 Bad Request"
+            if by == "pool":
+                def group_of(key):
+                    return (key[2], "")
+            elif by in ("slice", "job"):
+                def group_of(key):
+                    return (key[2], key[3])
+            else:
+                def group_of(key):
+                    return ("", "")
+            groups, agg_next = self.store.fold(
+                keys, tier_idx, start, end,
+                stat=stat, agg=agg, group_of=group_of,
+                max_points=max_points,
+            )
+            doc = {
+                "family": family,
+                "tier": spec.name,
+                "resolution_s": spec.resolution_s,
+                "agg": agg,
+                "by": by,
+                "start": start,
+                "end": end,
+                "series": [
+                    {
+                        "pool": pool,
+                        "slice": slc,
+                        "stat": "raw" if tier_idx == 0 else stat,
+                        "agg": agg,
+                        "points": [
+                            [round(ts, 3), value] for ts, value in points
+                        ],
+                    }
+                    for (pool, slc), points in sorted(groups.items())
+                ],
+            }
+            if agg_next is not None:
+                doc["truncated"] = True
+                doc["next_start"] = agg_next
+            return _json_bytes(doc), "200 OK"
         series = []
         remaining = max_points
         next_start = None

@@ -63,6 +63,9 @@ LEDGER_FAMILY_SET = {
 STATS = ("mean", "min", "max")
 RAW_STAT = "raw"
 
+#: Server-side cross-series aggregation operators (GET /ledger?agg=).
+AGGS = ("sum", "mean", "max")
+
 
 @dataclass(frozen=True)
 class TierSpec:
@@ -383,6 +386,122 @@ class TieredSeriesStore:
                 out.append((ts_ms / 1000.0, value))
         return out, None
 
+    def fold(
+        self,
+        keys: list[tuple],
+        tier: int,
+        start_s: float,
+        end_s: float,
+        *,
+        stat: str = "mean",
+        agg: str = "sum",
+        group_of,
+        max_points: int = 2000,
+    ) -> tuple[dict, float | None]:
+        """Cross-series aggregation INSIDE the read path (the
+        ``GET /ledger?agg=`` evaluator): chunks decode one at a time
+        and fold straight into per-``(group, timestamp)`` accumulators
+        — the full raw range is never materialized as per-series point
+        lists, so a 10k-slice consumer stops shipping (and re-decoding)
+        every slice's series client-side.
+
+        Fold order is part of the byte-stability contract: series are
+        visited in SORTED key order (enforced here, whatever order the
+        caller passes — the same order the raw query emits), points in
+        time order
+        within each series, and the operators are ``sum`` (running
+        float sum in visit order), ``mean`` (that sum divided by the
+        contributing-series count — unweighted across series, exactly
+        what client-side aggregation of the raw range computes), and
+        ``max`` (first-wins on ties). A client folding the raw
+        response the same way reproduces these bytes exactly
+        (tests/test_ledger.py pins it).
+
+        Truncation is BY TIME, never by cell: when the fold would
+        exceed ``max_points`` total output points, a timestamp cutoff
+        is chosen so every kept bucket still aggregates every series
+        (a partially-folded bucket would be silently wrong, not
+        partial), and ``next_start`` carries the continuation cursor.
+
+        Returns ``({group: [(ts_s, value), ...]}, next_start|None)``.
+        """
+        use_stat = RAW_STAT if tier == 0 else stat
+        start_ms = int(start_s * 1000.0)
+        end_ms = int(end_s * 1000.0)
+        groups: dict[tuple, dict[int, list]] = {}
+        total = 0
+        cutoff_ms: int | None = None
+        with self._lock:
+            for key in sorted(keys):
+                stream = self._streams.get((key, tier, use_stat))
+                if stream is None:
+                    continue
+                acc = groups.setdefault(group_of(key), {})
+                for ts_ms, value in stream.points(start_ms, end_ms):
+                    if cutoff_ms is not None and ts_ms >= cutoff_ms:
+                        # points() yields ascending per series: nothing
+                        # after this survives the cutoff either, and
+                        # decoding it just to skip it would hold the
+                        # store lock against the collect thread.
+                        break
+                    cell = acc.get(ts_ms)
+                    if cell is None:
+                        acc[ts_ms] = [value, 1, value]
+                        total += 1
+                        if total > max_points:
+                            cutoff_ms = self._fold_trim(
+                                groups, max_points
+                            )
+                            total = sum(len(a) for a in groups.values())
+                    else:
+                        cell[0] += value
+                        cell[1] += 1
+                        if value > cell[2]:
+                            cell[2] = value
+        out: dict[tuple, list] = {}
+        for group, acc in groups.items():
+            points = []
+            for ts_ms in sorted(acc):
+                s, n, vmax = acc[ts_ms]
+                if agg == "sum":
+                    value = s
+                elif agg == "mean":
+                    value = s / n
+                else:
+                    value = vmax
+                points.append((ts_ms / 1000.0, value))
+            if points:
+                out[group] = points
+        return out, (cutoff_ms / 1000.0 if cutoff_ms is not None else None)
+
+    @staticmethod
+    def _fold_trim(groups: dict, max_points: int) -> int:
+        """Pick the time cutoff that keeps at most ``max_points``
+        folded points, and drop everything at or past it — bounding
+        fold memory to ~the response size however wide the range is."""
+        counts: dict[int, int] = {}
+        for acc in groups.values():
+            for ts_ms in acc:
+                counts[ts_ms] = counts.get(ts_ms, 0) + 1
+        ordered = sorted(counts)
+        kept = 0
+        cutoff = ordered[-1] + 1
+        for ts_ms in ordered:
+            kept += counts[ts_ms]
+            if kept > max_points:
+                cutoff = ts_ms
+                break
+        if cutoff == ordered[0]:
+            # Degenerate: the first bucket alone exceeds the cap (more
+            # groups than max_points). Keep it anyway — an empty
+            # response with a cursor pointing at itself could never
+            # advance.
+            cutoff = ordered[1] if len(ordered) > 1 else ordered[0] + 1
+        for acc in groups.values():
+            for ts_ms in [t for t in acc if t >= cutoff]:
+                del acc[ts_ms]
+        return cutoff
+
     def stats(self) -> dict:
         """Per-tier occupancy for the tpu_ledger_* self-metrics and the
         bench's bytes-per-raw-sample headline."""
@@ -547,6 +666,7 @@ def tier_primary_stat(tier: int) -> str:
 
 
 __all__ = [
+    "AGGS",
     "CHUNK_SAMPLES",
     "LEDGER_FAMILY_SET",
     "RAW_STAT",

@@ -557,9 +557,27 @@ def ledger_snapshot(
         "/ledger?family=tpu_fleet_tokens_per_joule&scope=fleet"
         f"&start={now - 3600.0:.3f}&end={now:.3f}&step=10"
     )
+    # Per-pool efficiency breakdown via SERVER-SIDE aggregation: the
+    # aggregator folds its slice series into one series per pool
+    # inside the read path (?agg=mean&by=pool), so this CLI never
+    # ships — or client-aggregates — raw per-slice series. A pre-agg
+    # aggregator IGNORES the unknown params and answers 200 with the
+    # raw per-slice range — detected by the missing "agg" echo in the
+    # response, and degraded to no breakdown rather than rendering raw
+    # slices mislabeled as pool means. Transport errors degrade too.
+    try:
+        by_pool = fetch(
+            "/ledger?family=tpu_fleet_tokens_per_joule&scope=slice"
+            "&agg=mean&by=pool"
+            f"&start={now - 3600.0:.3f}&end={now:.3f}&step=60"
+        )
+    except FETCH_ERRORS:
+        by_pool = None
+    if by_pool is not None and by_pool.get("agg") != "mean":
+        by_pool = None  # old aggregator: raw series, not a fold
     return {
         "ledger": {"goodput": goodput, "tokens_per_joule": trend,
-                   "job": job},
+                   "tokens_per_joule_by_pool": by_pool, "job": job},
         "aggregator_url": url,
         "ts": now,
     }
@@ -599,10 +617,21 @@ def render_ledger(snap: dict, out=None) -> None:
                 label = bucket if bucket != "unaccounted" else "UNACCOUNTED"
                 parts.append(f"{label} {value / total:.1%}")
         ratio = row.get("goodput_ratio")
+        energy = ""
+        joules = row.get("energy_joules")
+        if joules is not None:
+            energy = (
+                f", energy {joules / 3.6e6:.2f} kWh"
+                f" ({row.get('energy_source', 'modeled')})"
+            )
+            dollars = row.get("energy_dollars")
+            if dollars is not None:
+                energy += f" ${dollars:.2f}"
         p(
             f"  {row.get('slice', '?')} [{row.get('pool', '?')}]: "
             f"{hours:.2f} chip-h"
             + (f", goodput {ratio:.1%}" if ratio is not None else "")
+            + energy
             + (" — " + ", ".join(parts) if parts else "")
         )
     gap = goodput.get("gap_seconds")
@@ -622,6 +651,18 @@ def render_ledger(snap: dict, out=None) -> None:
     else:
         p("tokens/J: no samples in the last hour "
           "(no energy-reporting hosts, or a young ledger)")
+    by_pool = doc.get("tokens_per_joule_by_pool") or {}
+    for row in by_pool.get("series", []):
+        points = row.get("points") or []
+        if not points:
+            continue
+        values = [v for _ts, v in points]
+        p(
+            f"  pool {row.get('pool', '?')}: "
+            f"{values[0]:.1f} -> {values[-1]:.1f} tokens/J "
+            f"(server-side {by_pool.get('agg', 'mean')} over slices, "
+            f"n={len(values)})"
+        )
 
 
 def render_aggregator(snap: dict, out=None) -> None:

@@ -1598,6 +1598,9 @@ def fleet_delta_soak(
     churn_high: float = 0.5,
     kill: int = 32,
     node_interval: float | None = None,
+    controls: bool = True,
+    check_leaks: bool = False,
+    mode: str = "fleet-delta",
 ) -> dict:
     """Delta fan-in acceptance soak (ROADMAP item 3, ISSUE 13): ``nodes``
     simulated exporters (10× the PR 6 64-node evidence at the default
@@ -1621,6 +1624,15 @@ def fleet_delta_soak(
        flat-as-idle-fleet-grows evidence) and a delta-OFF shard over
        the full fleet (the full-snapshot-per-fetch baseline the ≤10%
        bytes gate divides against).
+
+    ``controls=False`` is the FLEET-SCALE shape (``--fleet-scale``,
+    ISSUE 15: thousands of nodes on one box): the quarter-size control
+    is skipped and the delta-off baseline runs over a small subset
+    instead of the full fleet — snapshot bytes/node/cycle is
+    size-independent, so the ratio stays honest while the box is
+    spared a second full-fleet warmup. ``check_leaks=True`` scans every
+    scrape for re-exported per-node device families (the
+    ``per_node_series_leaks == 0`` acceptance gate).
     """
     from tpumon.fleet.config import FleetConfig
     from tpumon.fleet.server import build_aggregator
@@ -1638,6 +1650,7 @@ def fleet_delta_soak(
     lat_ms: list[float] = []
     failed_scrapes = 0
     honesty_violations = 0
+    leaked_series = 0
     prev_switch = sys.getswitchinterval()
 
     def mk_agg(targets: list[str], delta: bool = True):
@@ -1657,7 +1670,7 @@ def fleet_delta_soak(
         aggs.remove(agg)
 
     def scrape(agg) -> str | None:
-        nonlocal failed_scrapes
+        nonlocal failed_scrapes, leaked_series
         conn = http.client.HTTPConnection(
             "127.0.0.1", agg.server.port, timeout=10
         )
@@ -1666,6 +1679,8 @@ def fleet_delta_soak(
             conn.request("GET", "/metrics")
             body = conn.getresponse().read()
             lat_ms.append((time.perf_counter() - t0) * 1e3)
+            if check_leaks and b"accelerator_duty_cycle_percent" in body:
+                leaked_series += 1  # per-node series must NOT re-export
             return body.decode()
         except (OSError, http.client.HTTPException):
             failed_scrapes += 1
@@ -1828,16 +1843,31 @@ def fleet_delta_soak(
 
         # -- controls: quarter-size subset (delta) + snapshot baseline --
         control_s = min(30.0, max(10 * interval, duration_s * 0.25))
-        subset = urls[-max(nodes // 4, 1):]
-        agg_sub = mk_agg(subset, delta=True)
-        warm(agg_sub, len(subset), max(60.0, len(subset) * 0.2))
-        control_subset = measure(agg_sub, control_s, len(subset))
-        close_agg(agg_sub)
+        if controls:
+            subset = urls[-max(nodes // 4, 1):]
+            agg_sub = mk_agg(subset, delta=True)
+            warm(agg_sub, len(subset), max(60.0, len(subset) * 0.2))
+            control_subset = measure(agg_sub, control_s, len(subset))
+            close_agg(agg_sub)
 
-        agg_snap = mk_agg(urls, delta=False)
-        warm(agg_snap, live, max(90.0, nodes * 0.2))
-        control_snapshot = measure(agg_snap, control_s, live)
-        close_agg(agg_snap)
+            snap_targets = urls
+            snap_live = live
+            agg_snap = mk_agg(snap_targets, delta=False)
+            warm(agg_snap, snap_live, max(90.0, nodes * 0.2))
+            control_snapshot = measure(agg_snap, control_s, snap_live)
+            close_agg(agg_snap)
+        else:
+            # Fleet-scale shape: snapshot bytes/node/cycle is
+            # size-independent, so a small live-node subset gives the
+            # same baseline without a second full-fleet warmup.
+            control_subset = None
+            # Kill victims came from the list head — pick live nodes.
+            snap_targets = urls[kill: kill + max(1, min(64, nodes // 8))]
+            snap_live = len(snap_targets)
+            agg_snap = mk_agg(snap_targets, delta=False)
+            warm(agg_snap, snap_live, max(60.0, snap_live * 0.5))
+            control_snapshot = measure(agg_snap, control_s, snap_live)
+            close_agg(agg_snap)
     finally:
         for agg in list(aggs):
             try:
@@ -1861,9 +1891,12 @@ def fleet_delta_soak(
     snap_bpnc = control_snapshot["bytes_per_node_cycle"]
     idle_ms = phase_idle["collect_ms_per_cycle"]
     churn_ms = phase_churn["collect_ms_per_cycle"]
-    subset_ms = control_subset["collect_ms_per_cycle"]
+    subset_ms = (
+        control_subset["collect_ms_per_cycle"]
+        if control_subset is not None else None
+    )
     return {
-        "mode": "fleet-delta",
+        "mode": mode,
         "nodes": nodes,
         "topology": topology,
         "node_interval_s": node_interval,
@@ -1877,6 +1910,7 @@ def fleet_delta_soak(
             "subset_idle": control_subset,
             "snapshot_idle": control_snapshot,
         },
+        "snapshot_baseline_nodes": snap_live,
         "fanin": {
             #: Steady-state wire cost per node per collect cycle, delta
             #: protocol at low churn vs the full-snapshot baseline —
@@ -1918,6 +1952,9 @@ def fleet_delta_soak(
         },
         "scrapes": len(lat_ms),
         "failed_scrapes": failed_scrapes,
+        #: Scrapes whose page re-exported a per-node device family —
+        #: must be 0 (None when leak scanning was not requested).
+        "per_node_series_leaks": leaked_series if check_leaks else None,
         "p50_ms": _q(0.5),
         "p99_ms": _q(0.99),
     }
@@ -2814,6 +2851,15 @@ def main(argv=None) -> int:
                         "fan-in bytes/node/cycle, delta-vs-snapshot "
                         "ratio, collect-CPU churn/size scaling, and "
                         "resync accounting")
+    parser.add_argument("--fleet-scale", action="store_true",
+                        help="fleet-scale soak (ISSUE 15): the "
+                        "--fleet-delta scenario at thousands of nodes "
+                        "— striped ingest + native rollup under 2048+ "
+                        "simulated exporters — with per-node-series "
+                        "leak scanning, the quarter-size control "
+                        "skipped, and the delta-off baseline over a "
+                        "live subset (snapshot bytes/node is "
+                        "size-independent)")
     parser.add_argument("--fleet-churn", type=float, default=0.02,
                         help="steady-state content churn fraction for "
                         "--fleet-delta's idle phases")
@@ -2872,6 +2918,14 @@ def main(argv=None) -> int:
             interval=args.interval, scrape_every_s=args.scrape_every,
             churn=args.fleet_churn, churn_high=args.fleet_churn_high,
             kill=args.fleet_kill, node_interval=args.fleet_node_interval,
+        )
+    elif args.fleet_scale:
+        record = fleet_delta_soak(
+            args.duration, nodes=args.fleet_nodes, topology=args.topology,
+            interval=args.interval, scrape_every_s=args.scrape_every,
+            churn=args.fleet_churn, churn_high=args.fleet_churn_high,
+            kill=args.fleet_kill, node_interval=args.fleet_node_interval,
+            controls=False, check_leaks=True, mode="fleet-scale",
         )
     elif args.fleet_chaos:
         record = fleet_chaos_soak(

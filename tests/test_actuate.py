@@ -1,0 +1,521 @@
+"""tpumon/actuate/ unit tests: selector grammar, quantity encoding,
+External Metrics adapter paths (discovery, value query, freshness),
+headroom scoring, hint hysteresis, and the ActuatePlane read model.
+
+Everything runs against synthetic rollup docs and feed entries — no
+sockets, no aggregator — mirroring how the collect cycle feeds the
+plane (tpumon/fleet/server.py passes the SAME doc/entries the ledger
+gets).
+"""
+
+import json
+
+import pytest
+
+from tpumon.actuate.adapter import (
+    API_PREFIX,
+    API_VERSION,
+    EXTERNAL_METRICS,
+    parse_label_selector,
+    quantity,
+    rfc3339,
+    selector_matches,
+)
+from tpumon.actuate.hints import (
+    BANDS,
+    STRAGGLER_PENALTY,
+    HintHysteresis,
+    band_of,
+    headroom_score,
+)
+from tpumon.actuate.plane import (
+    ANNOTATION_BAND,
+    ANNOTATION_SCORE,
+    ActuatePlane,
+)
+
+
+# -- selector grammar -------------------------------------------------------
+
+
+def test_selector_equality_forms():
+    for raw in ("pool=v4-8", "pool==v4-8"):
+        reqs = parse_label_selector(raw)
+        assert reqs == [("pool", "in", {"v4-8"})]
+    assert parse_label_selector("pool!=v4-8") == [
+        ("pool", "notin", {"v4-8"})
+    ]
+
+
+def test_selector_set_forms_and_paren_commas():
+    reqs = parse_label_selector("slice in (s0, s1),pool notin (v5p)")
+    assert reqs == [
+        ("slice", "in", {"s0", "s1"}),
+        ("pool", "notin", {"v5p"}),
+    ]
+
+
+def test_selector_empty_is_match_all():
+    assert parse_label_selector("") == []
+    assert parse_label_selector("   ") == []
+    assert selector_matches([], {"pool": "anything"})
+
+
+def test_selector_garbage_raises_never_matches_all():
+    for raw in ("pool", "pool>=3", "in (a)", "pool=(v4)", "a=b=c"):
+        with pytest.raises(ValueError):
+            parse_label_selector(raw)
+
+
+def test_selector_missing_key_semantics():
+    labels = {"pool": "v4-8"}
+    # `in` on a missing key never matches.
+    assert not selector_matches(
+        parse_label_selector("slice=s0"), labels
+    )
+    # `notin` on a missing key matches.
+    assert selector_matches(
+        parse_label_selector("slice!=s0"), labels
+    )
+    assert selector_matches(
+        parse_label_selector("slice notin (s0,s1)"), labels
+    )
+
+
+def test_selector_conjunction():
+    reqs = parse_label_selector("pool=v4-8,slice in (s0,s1)")
+    assert selector_matches(reqs, {"pool": "v4-8", "slice": "s1"})
+    assert not selector_matches(reqs, {"pool": "v4-8", "slice": "s2"})
+    assert not selector_matches(reqs, {"pool": "v5p", "slice": "s0"})
+
+
+# -- quantity / timestamp ---------------------------------------------------
+
+
+def test_quantity_integral_serializes_bare():
+    assert quantity(3.0) == "3"
+    assert quantity(0.0) == "0"
+    assert quantity(192) == "192"
+
+
+def test_quantity_fractional_serializes_milli():
+    assert quantity(0.95) == "950m"
+    assert quantity(1.5) == "1500m"
+    assert quantity(0.0004) == "0m"
+
+
+def test_rfc3339_shape():
+    assert rfc3339(0.0) == "1970-01-01T00:00:00Z"
+
+
+# -- headroom score ---------------------------------------------------------
+
+
+def _bucket(**over):
+    bucket = {
+        "chips": 4,
+        "duty": {"mean": 40.0, "n": 8},
+        "hbm_headroom_ratio": 0.5,
+        "ici": {"links": 4, "score": 1.0},
+        "stragglers": 0,
+        "stale": False,
+    }
+    bucket.update(over)
+    return bucket
+
+
+def test_headroom_score_full_inputs():
+    score, inputs = headroom_score(
+        _bucket(),
+        {"productive": 80.0, "contended": 10.0, "idle": 10.0},
+    )
+    # duty .6*.35 + hbm .5*.25 + ici 1*.15 + goodput .8*.25 over 1.0.
+    assert score == pytest.approx(0.685)
+    assert inputs["duty_headroom"] == pytest.approx(0.6)
+    assert inputs["goodput_factor"] == pytest.approx(0.8)
+    assert inputs["straggler_active"] is False
+
+
+def test_headroom_score_renormalizes_missing_inputs():
+    # Only duty present: the score IS the duty headroom, not a blend
+    # with invented 0.5s (absent-not-zero applied to scoring).
+    score, inputs = headroom_score(
+        {"duty": {"mean": 25.0, "n": 2}}
+    )
+    assert score == pytest.approx(0.75)
+    assert set(inputs) == {"duty_headroom", "straggler_active"}
+
+
+def test_headroom_score_none_without_signals():
+    score, inputs = headroom_score({"chips": 4})
+    assert score is None
+    assert inputs == {}
+
+
+def test_headroom_score_straggler_penalty_and_clamp():
+    base, _ = headroom_score(_bucket())
+    hit, inputs = headroom_score(_bucket(stragglers=1))
+    assert inputs["straggler_active"] is True
+    assert hit == pytest.approx(max(0.0, base - STRAGGLER_PENALTY))
+    # Penalty clamps at zero rather than going negative.
+    floor, _ = headroom_score(
+        {"duty": {"mean": 95.0, "n": 1}, "stragglers": 2}
+    )
+    assert floor == 0.0
+
+
+def test_goodput_factor_excludes_unaccounted():
+    # Unaccounted chip-seconds join neither numerator nor denominator;
+    # a ledger that has ONLY unaccounted time contributes no factor.
+    score, inputs = headroom_score(
+        {"duty": {"mean": 0.0, "n": 1}},
+        {"unaccounted": 1000.0},
+    )
+    assert "goodput_factor" not in inputs
+    _, inputs = headroom_score(
+        {"duty": {"mean": 0.0, "n": 1}},
+        {"productive": 50.0, "contended": 25.0, "unaccounted": 500.0},
+    )
+    assert inputs["goodput_factor"] == pytest.approx(1.0 - 25.0 / 75.0)
+
+
+def test_band_of_thresholds():
+    assert band_of(0.6, 0.6, 0.25) == "prefer"
+    assert band_of(0.59, 0.6, 0.25) == "neutral"
+    assert band_of(0.25, 0.6, 0.25) == "avoid"
+    assert tuple(BANDS) == ("prefer", "neutral", "avoid")
+
+
+# -- hysteresis -------------------------------------------------------------
+
+
+def test_hysteresis_first_band_publishes_immediately():
+    h = HintHysteresis(hold_cycles=3)
+    assert h.update(("v4", "s0"), "avoid") == "avoid"
+    assert h.transitions == {("v4", "s0"): 0}
+
+
+def test_hysteresis_oscillation_never_flaps():
+    h = HintHysteresis(hold_cycles=3)
+    key = ("v4", "s0")
+    h.update(key, "prefer")
+    # Raw band oscillates every cycle: the streak never reaches 3, the
+    # published band never moves, no transition is ever counted.
+    for raw in ("avoid", "prefer", "avoid", "prefer", "avoid", "avoid"):
+        assert h.update(key, raw) == "prefer"
+    assert h.transitions[key] == 0
+    # A third CONSECUTIVE avoid finally publishes.
+    assert h.update(key, "avoid") == "avoid"
+    assert h.transitions[key] == 1
+
+
+def test_hysteresis_streak_resets_on_candidate_change():
+    h = HintHysteresis(hold_cycles=2)
+    key = ("v4", "s0")
+    h.update(key, "neutral")
+    assert h.update(key, "avoid") == "neutral"  # streak 1
+    assert h.update(key, "prefer") == "neutral"  # new candidate, streak 1
+    assert h.update(key, "prefer") == "prefer"  # streak 2 -> publish
+
+
+def test_hysteresis_forget_drops_state_keeps_history():
+    h = HintHysteresis(hold_cycles=2)
+    h.update(("v4", "s0"), "prefer")
+    h.update(("v4", "s0"), "avoid")
+    h.update(("v4", "s0"), "avoid")
+    assert h.transitions[("v4", "s0")] == 1
+    h.forget({("v4", "s1")})
+    # Counters are history and never regress; published state is gone,
+    # so the slice's next appearance publishes immediately again.
+    assert h.transitions[("v4", "s0")] == 1
+    assert h.update(("v4", "s0"), "neutral") == "neutral"
+
+
+# -- plane fixtures ---------------------------------------------------------
+
+
+def _entry(pool, slc, serve, state="up"):
+    snap = {
+        "identity": {"accelerator": pool, "slice": slc},
+        "serve": serve,
+    }
+    return ("http://node", snap, state)
+
+
+def _cycled_plane(now=1000.0, stale=False, **plane_kw):
+    plane = ActuatePlane(**plane_kw)
+    doc = {
+        "slices": {
+            ("v4-8", "s0"): _bucket(stale=stale),
+            ("v4-8", "s1"): _bucket(
+                duty={"mean": 90.0, "n": 4}, hbm_headroom_ratio=0.1
+            ),
+            ("v5p", "t0"): {"chips": 8},  # no scoreable signal
+        }
+    }
+    entries = [
+        _entry(
+            "v4-8",
+            "s0",
+            {
+                "requests_per_second": 8.0,
+                "queue_depth": 3.0,
+                "ttft_seconds": 0.12,
+                "slo_attainment_ratio": 1.0,
+                "batch_size": 32.0,
+            },
+        ),
+        _entry(
+            "v4-8",
+            "s0",
+            {
+                "requests_per_second": 4.0,
+                "queue_depth": 1.0,
+                "ttft_seconds": 0.3,
+                "slo_attainment_ratio": 0.5,
+                "batch_size": 16.0,
+            },
+        ),
+        # A stale feed's serve numbers must not join the aggregate.
+        _entry("v4-8", "s1", {"queue_depth": 99.0}, state="stale"),
+    ]
+    plane.cycle(now, doc, entries)
+    return plane
+
+
+# -- plane serve aggregation ------------------------------------------------
+
+
+def test_plane_serve_aggregation_sum_worst_mean():
+    plane = _cycled_plane()
+    rows = {(r["pool"], r["slice"]): r for r in plane.rows()}
+    serve = rows[("v4-8", "s0")]["serve"]
+    assert serve["requests_per_second"] == pytest.approx(12.0)
+    assert serve["queue_depth"] == pytest.approx(4.0)
+    assert serve["ttft_seconds"] == pytest.approx(0.3)  # worst feed
+    assert serve["slo_attainment_ratio"] == pytest.approx(0.75)
+    assert serve["batch_size"] == pytest.approx(24.0)
+    assert serve["feeds"] == 2
+    # The stale feed never reached s1's aggregate.
+    assert rows[("v4-8", "s1")]["serve"] is None
+
+
+def test_plane_families_scopes_and_bands():
+    plane = _cycled_plane()
+    samples = [
+        (fam.name, s.labels, s.value)
+        for fam in plane.families()
+        for s in fam.samples
+    ]
+    by_name = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+    queues = {
+        (l["scope"], l["pool"], l["slice"]): v
+        for l, v in by_name["tpu_fleet_serve_queue_depth"]
+    }
+    assert queues[("slice", "v4-8", "s0")] == pytest.approx(4.0)
+    assert queues[("pool", "v4-8", "")] == pytest.approx(4.0)
+    assert queues[("fleet", "", "")] == pytest.approx(4.0)
+    scores = {
+        (l["scope"], l["pool"], l["slice"]): v
+        for l, v in by_name["tpu_fleet_hint_headroom_score"]
+    }
+    # Unscoreable t0 emits no score sample at slice scope.
+    assert ("slice", "v5p", "t0") not in scores
+    assert ("fleet", "", "") in scores
+    bands = {
+        (l["pool"], l["slice"], l["band"]): v
+        for l, v in by_name["tpu_fleet_hint_band"]
+    }
+    # One-hot across the three bands per scored slice.
+    for slc in ("s0", "s1"):
+        assert sum(bands[("v4-8", slc, b)] for b in BANDS) == 1.0
+
+
+def test_plane_forgets_departed_slices():
+    plane = _cycled_plane()
+    hyst = plane._hysteresis
+    assert ("v4-8", "s1") in hyst._published
+    plane.cycle(1001.0, {"slices": {("v4-8", "s0"): _bucket()}}, [])
+    assert ("v4-8", "s1") not in hyst._published
+    assert [  # read model follows the doc
+        (r["pool"], r["slice"]) for r in plane.rows()
+    ] == [("v4-8", "s0")]
+
+
+# -- /hints -----------------------------------------------------------------
+
+
+def test_hints_response_annotations_and_pool_filter():
+    plane = _cycled_plane()
+    doc = json.loads(plane.hints_response("")[0])
+    assert doc["cycles"] == 1
+    assert doc["thresholds"]["hold_cycles"] == 3
+    by_key = {(s["pool"], s["slice"]): s for s in doc["slices"]}
+    s0 = by_key[("v4-8", "s0")]
+    assert s0["band"] in BANDS
+    assert s0["annotations"][ANNOTATION_BAND] == s0["band"]
+    assert s0["annotations"][ANNOTATION_SCORE] == f"{s0['score']:.3f}"
+    assert s0["patch"]["metadata"]["annotations"] == s0["annotations"]
+    # Unscoreable slice: present, explainable, but no patch to apply.
+    t0 = by_key[("v5p", "t0")]
+    assert t0["score"] is None and "patch" not in t0
+    filtered = json.loads(plane.hints_response("pool=v5p")[0])
+    assert [s["pool"] for s in filtered["slices"]] == ["v5p"]
+
+
+# -- External Metrics adapter ----------------------------------------------
+
+
+def test_adapter_discovery_documents():
+    adapter = _cycled_plane().adapter
+    status, body, metric, result = adapter.handle(API_PREFIX, "")
+    assert (status, metric, result) == ("200 OK", "", "ok")
+    group = json.loads(body)
+    assert group["kind"] == "APIGroup"
+    assert group["preferredVersion"]["version"] == API_VERSION
+
+    status, body, _, result = adapter.handle(
+        f"{API_PREFIX}/{API_VERSION}", ""
+    )
+    assert (status, result) == ("200 OK", "ok")
+    resources = json.loads(body)
+    assert resources["kind"] == "APIResourceList"
+    assert {r["name"] for r in resources["resources"]} == set(
+        EXTERNAL_METRICS
+    )
+    assert all(
+        r["kind"] == "ExternalMetricValueList"
+        for r in resources["resources"]
+    )
+
+
+def test_adapter_unknown_metric_and_path_404():
+    adapter = _cycled_plane().adapter
+    status, body, metric, result = adapter.handle(
+        f"{API_PREFIX}/{API_VERSION}/namespaces/default/tpumon_bogus", ""
+    )
+    assert (status, metric, result) == (
+        "404 Not Found",
+        "tpumon_bogus",
+        "not_found",
+    )
+    assert json.loads(body)["kind"] == "Status"
+    status, _, _, result = adapter.handle(
+        f"{API_PREFIX}/{API_VERSION}/nope", ""
+    )
+    assert (status, result) == ("404 Not Found", "not_found")
+
+
+def test_adapter_bad_selector_is_400_not_match_all():
+    adapter = _cycled_plane().adapter
+    status, body, metric, result = adapter.handle(
+        f"{API_PREFIX}/{API_VERSION}/namespaces/default/"
+        "tpumon_serve_queue_depth",
+        "labelSelector=pool%3E%3Dv4",
+    )
+    assert (status, result) == ("400 Bad Request", "bad_request")
+    assert metric == "tpumon_serve_queue_depth"
+    assert json.loads(body)["code"] == 400
+
+
+def test_adapter_value_query_end_to_end():
+    now = 1000.0
+    adapter = _cycled_plane(now=now).adapter
+    status, body, metric, result = adapter.handle(
+        f"{API_PREFIX}/{API_VERSION}/namespaces/default/"
+        "tpumon_serve_queue_depth",
+        "labelSelector=pool%3Dv4-8",
+        now=now + 1.0,
+    )
+    assert (status, result) == ("200 OK", "ok")
+    doc = json.loads(body)
+    assert doc["kind"] == "ExternalMetricValueList"
+    # Only s0 serves; s1 matched the selector but carries no queue
+    # signal (absent-not-zero: no item, not a zero item).
+    assert len(doc["items"]) == 1
+    item = doc["items"][0]
+    assert item["metricName"] == "tpumon_serve_queue_depth"
+    assert item["metricLabels"] == {
+        "pool": "v4-8",
+        "slice": "s0",
+        "job": "s0",
+    }
+    assert item["value"] == "4"
+    assert item["timestamp"] == rfc3339(now)
+
+
+def test_adapter_job_label_aliases_slice():
+    adapter = _cycled_plane().adapter
+    _, body, _, _ = adapter.handle(
+        f"{API_PREFIX}/{API_VERSION}/namespaces/default/"
+        "tpumon_serve_requests_per_second",
+        "labelSelector=job%3Ds0",
+    )
+    items = json.loads(body)["items"]
+    assert [i["metricLabels"]["slice"] for i in items] == ["s0"]
+    assert items[0]["value"] == "12"
+
+
+def test_adapter_stale_row_marked_honestly():
+    now = 1000.0
+    adapter = _cycled_plane(now=now, stale=True).adapter
+    status, body, _, result = adapter.handle(
+        f"{API_PREFIX}/{API_VERSION}/namespaces/default/"
+        "tpumon_serve_queue_depth",
+        "",
+        now=now + 1.0,
+    )
+    assert (status, result) == ("200 OK", "stale")
+    item = json.loads(body)["items"][0]
+    assert item["metricLabels"]["tpumon_stale"] == "true"
+    # Timestamp is the producing cycle's, never re-stamped as current.
+    assert item["timestamp"] == rfc3339(now)
+
+
+def test_adapter_quiet_plane_marks_everything_stale():
+    now = 1000.0
+    plane = _cycled_plane(now=now, stale_after_s=30.0)
+    assert not plane.is_stale(now + 30.0)
+    assert plane.is_stale(now + 31.0)
+    _, body, _, result = plane.adapter.handle(
+        f"{API_PREFIX}/{API_VERSION}/namespaces/default/"
+        "tpumon_hint_headroom_score",
+        "",
+        now=now + 31.0,
+    )
+    assert result == "stale"
+    assert all(
+        i["metricLabels"]["tpumon_stale"] == "true"
+        for i in json.loads(body)["items"]
+    )
+
+
+def test_adapter_non_serve_metrics_read_rollup_bucket():
+    adapter = _cycled_plane().adapter
+    _, body, _, _ = adapter.handle(
+        f"{API_PREFIX}/{API_VERSION}/namespaces/default/"
+        "tpumon_duty_cycle_percent",
+        "labelSelector=slice+in+%28s0%2Cs1%29",
+    )
+    values = {
+        i["metricLabels"]["slice"]: i["value"]
+        for i in json.loads(body)["items"]
+    }
+    assert values == {"s0": "40", "s1": "90"}
+    _, body, _, _ = adapter.handle(
+        f"{API_PREFIX}/{API_VERSION}/namespaces/default/"
+        "tpumon_hbm_headroom_ratio",
+        "labelSelector=slice%3Ds1",
+    )
+    assert json.loads(body)["items"][0]["value"] == "100m"
+
+
+def test_plane_debug_block_counts():
+    plane = _cycled_plane()
+    block = plane.debug_block()
+    assert block["cycles"] == 1
+    assert block["slices"] == 3
+    assert block["serving_slices"] == 1
+    assert block["scored_slices"] == 2

@@ -949,6 +949,211 @@ def test_spooled_universe_backs_failed_discovery(tmp_path):
         agg.close()
 
 
+# -- integration: identity moves racing two-shard membership churn ---------
+
+
+_IDENTITY_PAGE = """\
+# TYPE accelerator_info gauge
+accelerator_info{{accelerator="v4",chip="0",coords="0,0,0",host="{host}",slice="{slice}"}} 1.0
+accelerator_info{{accelerator="v4",chip="1",coords="1,0,0",host="{host}",slice="{slice}"}} 1.0
+# TYPE accelerator_duty_cycle_percent gauge
+accelerator_duty_cycle_percent{{chip="0"}} 55.0
+accelerator_duty_cycle_percent{{chip="1"}} 45.0
+# TYPE accelerator_device_count gauge
+accelerator_device_count 2
+# TYPE collector_last_poll_timestamp_seconds gauge
+collector_last_poll_timestamp_seconds {now}
+"""
+
+
+def _mutable_exporter(slice_name: str, host: str):
+    """A fake node whose slice identity can be rewritten mid-run — the
+    job-migration shape (same hardware, new (pool, slice) identity)."""
+    state = {"slice": slice_name}
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self) -> None:
+            if self.path != "/metrics":
+                self.send_error(404)
+                return
+            body = _IDENTITY_PAGE.format(
+                host=host, slice=state["slice"], now=time.time()
+            ).encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args) -> None:
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+    server.daemon_threads = True
+    threading.Thread(
+        target=server.serve_forever, kwargs={"poll_interval": 0.2},
+        daemon=True,
+    ).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    return server, state, url
+
+
+def _goodput_rows(url: str) -> dict[str, dict] | None:
+    """slice -> /ledger?view=goodput row, or None while unreachable
+    (including the guard's plain-text shed bodies)."""
+    try:
+        status, body = _get(url + "/ledger?view=goodput", timeout=2.0)
+        if status != 200:
+            return None
+        return {row["slice"]: row for row in json.loads(body)["jobs"]}
+    except Exception:
+        return None
+
+
+def test_two_shard_identity_move_keeps_departed_slice_goodput():
+    """ISSUE 16 satellite: identity moves RACING membership churn across
+    two real shards. At one instant the survivor-owned node's slice
+    identity moves, the victim-owned node's identity moves, and the
+    victim shard dies. The survivor must (a) charge the window that
+    straddles the move to the OLD job — a departed slice's last goodput
+    window is never dropped — and then freeze that job as history,
+    (b) accrue the new identities (its own node's and the adopted
+    orphan's), and (c) never invent totals for a slice it never
+    observed (the orphan's pre-move identity died with the peer).
+    Meanwhile /hints follows the live rollup doc: the departed slice
+    leaves the hint table even though the ledger remembers it."""
+    from tpumon.fleet.server import build_aggregator
+
+    # Spawn controllable nodes until BOTH shards own at least one
+    # (rendezvous hashing decides, so keep adding until it lands).
+    nodes: list = []
+    while True:
+        idx = len(nodes)
+        nodes.append(_mutable_exporter(f"start-{idx}", f"node-{idx}"))
+        owners = {shard_of(n[2], 2) for n in nodes}
+        if owners == {0, 1} or len(nodes) >= 16:
+            break
+    assert {shard_of(n[2], 2) for n in nodes} == {0, 1}
+    urls = [n[2] for n in nodes]
+
+    survivor = shard_of(urls[0], 2)
+    victim = 1 - survivor
+    state_a = nodes[0][1]  # survivor-owned: moves identity, stays up
+    b_index = next(
+        i for i, u in enumerate(urls) if shard_of(u, 2) == victim
+    )
+    state_b = nodes[b_index][1]  # victim-owned: moves during adoption
+
+    ports = [_free_port(), _free_port()]
+    peers = ",".join(f"http://127.0.0.1:{p}" for p in ports)
+
+    def cfg(index: int) -> FleetConfig:
+        return FleetConfig(
+            port=ports[index], addr="127.0.0.1",
+            targets=",".join(urls), shard_index=index, shard_count=2,
+            interval=0.2, stale_s=1.0, evict_s=60.0, peers=peers,
+            probe_interval=0.25, takeover_s=1.5, history_window=0.0,
+        )
+
+    shards = [build_aggregator(cfg(0)), build_aggregator(cfg(1))]
+    try:
+        for shard in shards:
+            shard.start()
+        base = shards[survivor].url
+
+        # The survivor accrues its own node's goodput under the
+        # pre-move identity.
+        _wait_for(
+            lambda: (
+                (rows := _goodput_rows(base)) is not None
+                and rows.get("start-0", {}).get("chip_seconds", 0.0) > 0.0
+            ),
+            timeout=15.0,
+        )
+        before = _goodput_rows(base)["start-0"]["chip_seconds"]
+
+        # The race: both identities move and the victim shard dies in
+        # the same instant.
+        state_a["slice"] = "moved-0"
+        state_b["slice"] = "moved-b"
+        shards[victim].close()
+        dead = shards[victim]
+        shards[victim] = None
+
+        _wait_for(
+            lambda: sorted(shards[survivor].targets) == sorted(urls),
+            timeout=15.0,
+        )
+        _wait_for(
+            lambda: (
+                (rows := _goodput_rows(base)) is not None
+                and rows.get("moved-0", {}).get("chip_seconds", 0.0) > 0.0
+                and rows.get("moved-b", {}).get("chip_seconds", 0.0) > 0.0
+            ),
+            timeout=15.0,
+        )
+
+        rows = _goodput_rows(base)
+        # (a) The departed slice kept every window it was charged —
+        # including the one straddling the move (classified before the
+        # identity update, so it landed on the OLD job).
+        assert rows["start-0"]["chip_seconds"] >= before
+        frozen = rows["start-0"]["chip_seconds"]
+        # (c) The orphan's pre-move identity was only ever observed by
+        # the dead shard: the survivor must not invent it.
+        assert f"start-{b_index}" not in rows
+        # Conservation holds per job through the churn.
+        for slc in ("start-0", "moved-0", "moved-b"):
+            row = rows[slc]
+            assert sum(row["buckets"].values()) == pytest.approx(
+                row["chip_seconds"]
+            )
+
+        # (b) History vs state: the departed job is frozen while the
+        # new identities keep accruing.
+        grown = _wait_for(
+            lambda: (
+                (r := _goodput_rows(base)) is not None
+                and r["moved-0"]["chip_seconds"]
+                > rows["moved-0"]["chip_seconds"]
+                and r
+            ),
+            timeout=15.0,
+        )
+        assert grown["start-0"]["chip_seconds"] == pytest.approx(frozen)
+
+        # /hints follows the live doc: moved identities present, the
+        # departed slice gone — the ledger alone remembers it.
+        def hint_slices():
+            try:
+                status, body = _get(base + "/hints", timeout=2.0)
+                if status != 200:
+                    return None
+                return {s["slice"] for s in json.loads(body)["slices"]}
+            except Exception:
+                return None
+
+        hints = _wait_for(
+            lambda: (
+                (s := hint_slices()) is not None
+                and {"moved-0", "moved-b"} <= s
+                and s
+            ),
+            timeout=15.0,
+        )
+        assert "start-0" not in hints
+        del dead
+    finally:
+        for shard in shards:
+            if shard is not None:
+                shard.close()
+        for server, _state, _url in nodes:
+            server.shutdown()
+            server.server_close()
+
+
 # -- fleetsim chaos vocabulary ---------------------------------------------
 
 

@@ -322,6 +322,110 @@ def test_striped_placeholder_counts_dark():
     assert entries == [("never-reports", None, "dark", 0)]
 
 
+# -- 2b. dirty-set publish (ISSUE 16 satellite) ------------------------------
+
+
+def _filled_stripes(n_nodes: int = 12, n_stripes: int = 4, now: float = 1000.0):
+    stripes = StripedIngest(stripes=n_stripes)
+    for i in range(n_nodes):
+        t = f"t{i}"
+        stripes.register(t)
+        stripes.put(
+            t,
+            {"identity": {"accelerator": "v4", "slice": f"s{i % 3}",
+                          "host": t},
+             "chips": {"0": {"duty_pct": float(i)}}},
+            now, 1,
+        )
+    return stripes
+
+
+def test_dirty_publish_clean_replay_is_free_and_identical():
+    """An idle fleet's second publish drains ZERO stripes and replays
+    the exact cached rows — same objects, same order (the byte-identity
+    contract rides on object identity here)."""
+    now = 1000.0
+    stripes = _filled_stripes(now=now)
+    first = stripes.entries(now, 10.0, 120.0)
+    assert stripes.last_dirty_stripes == 4  # cold: every stripe builds
+    second = stripes.entries(now + 1.0, 10.0, 120.0)
+    assert stripes.last_dirty_stripes == 0
+    assert len(second) == len(first)
+    assert all(a is b for a, b in zip(first, second))
+
+
+def test_dirty_publish_mutation_dirties_only_that_stripe():
+    now = 1000.0
+    stripes = _filled_stripes(now=now)
+    stripes.entries(now, 10.0, 120.0)
+    stripes.put(
+        "t0",
+        {"identity": {"accelerator": "v4", "slice": "s0", "host": "t0"},
+         "chips": {"0": {"duty_pct": 99.0}}},
+        now + 0.5, 2,
+    )
+    entries = stripes.entries(now + 1.0, 10.0, 120.0)
+    assert stripes.last_dirty_stripes == 1
+    row = {e[0]: e for e in entries}["t0"]
+    assert row[1]["chips"]["0"]["duty_pct"] == 99.0
+    assert row[3] == 2
+
+
+def test_dirty_publish_age_transition_invalidates():
+    """fresh→stale happens with no write arriving: the cache must NOT
+    replay a fresh classification past the row's stale boundary."""
+    now = 1000.0
+    stripes = StripedIngest(stripes=1)
+    stripes.register("t0")
+    stripes.put("t0", {"identity": {"slice": "s"}}, now, 1)
+    assert stripes.entries(now + 1.0, 10.0, 120.0)[0][2] == "up"
+    # Inside the stale window: clean replay.
+    assert stripes.entries(now + 5.0, 10.0, 120.0)[0][2] == "up"
+    assert stripes.last_dirty_stripes == 0
+    # Past the boundary: the stripe rebuilds and reclassifies.
+    assert stripes.entries(now + 10.5, 10.0, 120.0)[0][2] == "stale"
+    assert stripes.last_dirty_stripes == 1
+    assert stripes.entries(now + 120.5, 10.0, 120.0)[0][2] == "dark"
+
+
+def test_dirty_publish_threshold_change_invalidates():
+    now = 1000.0
+    stripes = _filled_stripes(now=now)
+    stripes.entries(now + 1.0, 10.0, 120.0)
+    stripes.entries(now + 1.1, 10.0, 120.0)
+    assert stripes.last_dirty_stripes == 0
+    # A config change mid-run re-classifies everything.
+    entries = stripes.entries(now + 1.2, 0.5, 120.0)
+    assert stripes.last_dirty_stripes == 4
+    assert all(e[2] == "stale" for e in entries)
+
+
+def test_dirty_publish_clock_backwards_rebuilds():
+    now = 1000.0
+    stripes = _filled_stripes(now=now)
+    stripes.entries(now + 5.0, 10.0, 120.0)
+    # Ages are monotone in ``now`` only forwards; a backwards clock
+    # must not replay classifications computed for a later instant.
+    stripes.entries(now + 2.0, 10.0, 120.0)
+    assert stripes.last_dirty_stripes == 4
+
+
+def test_dirty_publish_replay_renders_byte_identical():
+    """Cached-row replay feeds the SAME rollup bytes as a cold rebuild
+    over the same entries."""
+    now = 1000.0
+    stripes = _filled_stripes(n_nodes=24, n_stripes=8, now=now)
+    stripes.entries(now, 10.0, 120.0)
+    replayed = stripes.entries(now + 1.0, 10.0, 120.0)  # pure cache
+    assert stripes.last_dirty_stripes == 0
+    cached_doc = IncrementalRollup().update(replayed)
+    cold = _filled_stripes(n_nodes=24, n_stripes=8, now=now)
+    cold_doc = IncrementalRollup().update(cold.entries(now + 1.0, 10.0, 120.0))
+    assert render_families(fleet_families(cached_doc)) == render_families(
+        fleet_families(cold_doc)
+    )
+
+
 # -- 3. aggregator integration ----------------------------------------------
 
 

@@ -1,0 +1,42 @@
+"""The actuation plane: fleet rollups → Kubernetes control signals.
+
+Three pieces close the observe→act loop (ISSUE 16):
+
+- :mod:`tpumon.actuate.plane` — :class:`ActuatePlane` rides the
+  aggregator's collect cycle like the ledger does, rolling the
+  lifecycle plane's serving join up per slice/pool/fleet and running
+  the placement-hint engine, all into a pre-computed read model so a
+  query never touches raw per-node series;
+- :mod:`tpumon.actuate.adapter` — the Kubernetes External Metrics API
+  (``/apis/external.metrics.k8s.io/v1beta1/...``) served straight off
+  that read model, so an HPA can scale serving fleets on duty cycle,
+  HBM headroom, queue depth, TTFT, or goodput-under-SLO;
+- :mod:`tpumon.actuate.hints` — the per-slice headroom score
+  (duty + HBM + ICI + straggler state + ledger goodput history) with
+  hysteresis, published as ``/hints`` and as annotation patches a
+  scheduler extender or descheduler can consume.
+"""
+
+from tpumon.actuate.adapter import (
+    EXTERNAL_METRICS,
+    ExternalMetricsAdapter,
+    parse_label_selector,
+    quantity,
+)
+from tpumon.actuate.hints import (
+    HintHysteresis,
+    band_of,
+    headroom_score,
+)
+from tpumon.actuate.plane import ActuatePlane
+
+__all__ = [
+    "EXTERNAL_METRICS",
+    "ActuatePlane",
+    "ExternalMetricsAdapter",
+    "HintHysteresis",
+    "band_of",
+    "headroom_score",
+    "parse_label_selector",
+    "quantity",
+]

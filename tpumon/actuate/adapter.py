@@ -1,0 +1,326 @@
+"""Kubernetes External Metrics API served off the actuation read model.
+
+``GET /apis/external.metrics.k8s.io/v1beta1/namespaces/{ns}/{metric}``
+is what an HPA with an ``External`` metric source asks (via the API
+server's APIService proxy); this module answers it — plus the two
+discovery documents the aggregator layer needs (APIGroup and
+APIResourceList) — straight from the :class:`ActuatePlane`'s
+pre-computed per-slice rows. A metrics query therefore touches **no
+raw per-node series**: the adapter reads what the collect cycle
+already rolled up, the same read-model discipline as /fleet.
+
+Freshness is honest: a row backed by a stale rollup bucket (or an
+aggregator that hasn't completed a collect cycle recently) is served
+with ``metricLabels["tpumon_stale"] = "true"`` and the timestamp of the
+cycle that produced it — never re-stamped as current. An HPA reads the
+timestamp; a stale value that claims to be fresh would actuate on
+fiction, which is exactly the failure mode the exporter's
+absent-not-zero rule exists to prevent.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+
+API_GROUP = "external.metrics.k8s.io"
+API_VERSION = "v1beta1"
+API_PREFIX = "/apis/" + API_GROUP
+
+#: metric name -> (description, extractor over one ActuatePlane row).
+#: Extractors return None when the row doesn't carry the signal — the
+#: row then contributes no item (absent-not-zero, per slice).
+EXTERNAL_METRICS: dict = {
+    "tpumon_duty_cycle_percent": (
+        "Mean accelerator duty cycle of the slice's chips (percent)",
+        lambda row: (row["bucket"].get("duty") or {}).get("mean"),
+    ),
+    "tpumon_hbm_headroom_ratio": (
+        "Unused fraction of the slice's HBM",
+        lambda row: row["bucket"].get("hbm_headroom_ratio"),
+    ),
+    "tpumon_step_latency_seconds": (
+        "Mean wall seconds per optimizer step over the slice's feeds "
+        "(1 / step rate)",
+        lambda row: (
+            1.0 / row["bucket"]["step_rate"]
+            if row["bucket"].get("step_rate")
+            else None
+        ),
+    ),
+    "tpumon_serve_queue_depth": (
+        "Admitted-but-incomplete inference requests across the "
+        "slice's serving feeds — the canonical HPA scale signal",
+        lambda row: (row.get("serve") or {}).get("queue_depth"),
+    ),
+    "tpumon_serve_requests_per_second": (
+        "Completed inference requests per second across the slice's "
+        "serving feeds",
+        lambda row: (row.get("serve") or {}).get("requests_per_second"),
+    ),
+    "tpumon_serve_ttft_seconds": (
+        "Worst time-to-first-token proxy across the slice's serving "
+        "feeds",
+        lambda row: (row.get("serve") or {}).get("ttft_seconds"),
+    ),
+    "tpumon_goodput_slo_ratio": (
+        "Fraction of inference requests meeting the serving SLO "
+        "across the slice's feeds — goodput under SLO",
+        lambda row: (row.get("serve") or {}).get("slo_attainment_ratio"),
+    ),
+    "tpumon_hint_headroom_score": (
+        "Placement-hint headroom score in [0, 1] (higher = better "
+        "placement target)",
+        lambda row: row.get("score"),
+    ),
+}
+
+_SET_RE = re.compile(
+    r"^\s*([A-Za-z0-9._/-]+)\s+(in|notin)\s+\(([^)]*)\)\s*$"
+)
+_EQ_RE = re.compile(
+    r"^\s*([A-Za-z0-9._/-]+)\s*(==|!=|=)\s*([A-Za-z0-9._/-]*)\s*$"
+)
+
+
+def parse_label_selector(raw: str) -> list[tuple[str, str, set[str]]]:
+    """Kubernetes label-selector string -> [(key, op, values)] with op
+    ∈ {in, notin} (equality folds into a one-element set). Raises
+    ValueError on syntax the grammar doesn't cover — the adapter turns
+    that into a 400, never a silent match-all."""
+    requirements: list[tuple[str, str, set[str]]] = []
+    if not raw or not raw.strip():
+        return requirements
+    # Split on commas OUTSIDE parens ("k in (a,b),pool=v5p" is one
+    # selector with two requirements).
+    parts: list[str] = []
+    depth = 0
+    current = ""
+    for ch in raw:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth = max(0, depth - 1)
+        if ch == "," and depth == 0:
+            parts.append(current)
+            current = ""
+        else:
+            current += ch
+    parts.append(current)
+    for part in parts:
+        if not part.strip():
+            continue
+        m = _SET_RE.match(part)
+        if m:
+            key, op, values = m.group(1), m.group(2), m.group(3)
+            requirements.append(
+                (key, op, {v.strip() for v in values.split(",") if v.strip()})
+            )
+            continue
+        m = _EQ_RE.match(part)
+        if m:
+            key, op, value = m.group(1), m.group(2), m.group(3)
+            requirements.append(
+                (key, "notin" if op == "!=" else "in", {value})
+            )
+            continue
+        raise ValueError(f"unparseable selector requirement: {part!r}")
+    return requirements
+
+
+def selector_matches(
+    requirements: list[tuple[str, str, set[str]]], labels: dict[str, str]
+) -> bool:
+    """Evaluate parsed requirements against one row's labels
+    (Kubernetes semantics: ``in`` on a missing key never matches,
+    ``notin`` on a missing key matches)."""
+    for key, op, values in requirements:
+        value = labels.get(key)
+        if op == "in":
+            if value is None or value not in values:
+                return False
+        else:
+            if value is not None and value in values:
+                return False
+    return True
+
+
+def quantity(value: float) -> str:
+    """A Kubernetes resource.Quantity for a metric value: integral
+    values serialize bare, everything else at milli precision (the
+    API's conventional granularity for external metrics)."""
+    value = float(value)
+    if value == int(value):
+        return str(int(value))
+    return f"{int(round(value * 1000))}m"
+
+
+def rfc3339(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts))
+
+
+class ExternalMetricsAdapter:
+    """Routes the three External Metrics API paths against a plane.
+
+    ``handle`` returns ``(status, body, metric, result)`` so the WSGI
+    layer can respond and the telemetry counter can label the request
+    without re-parsing anything. Thread-safe: reads only the plane's
+    lock-published read model.
+    """
+
+    def __init__(self, plane) -> None:
+        self._plane = plane
+
+    def handle(
+        self, path: str, query_string: str, now: float | None = None
+    ) -> tuple[str, bytes, str, str]:
+        now = time.time() if now is None else now
+        path = path.rstrip("/") or "/"
+        if path == API_PREFIX:
+            return "200 OK", _json(self._api_group()), "", "ok"
+        if path == f"{API_PREFIX}/{API_VERSION}":
+            return "200 OK", _json(self._resource_list()), "", "ok"
+        m = re.match(
+            f"^{re.escape(API_PREFIX)}/{API_VERSION}"
+            r"/namespaces/([^/]+)/([^/]+)$",
+            path,
+        )
+        if not m:
+            return (
+                "404 Not Found",
+                _json(_status(404, f"unknown path {path}")),
+                "",
+                "not_found",
+            )
+        metric = m.group(2)
+        if metric not in EXTERNAL_METRICS:
+            return (
+                "404 Not Found",
+                _json(_status(404, f"unknown external metric {metric}")),
+                metric,
+                "not_found",
+            )
+        params = _query_params(query_string)
+        try:
+            requirements = parse_label_selector(
+                params.get("labelSelector", "")
+            )
+        except ValueError as exc:
+            return (
+                "400 Bad Request",
+                _json(_status(400, str(exc))),
+                metric,
+                "bad_request",
+            )
+        items, any_stale = self._items(metric, requirements, now)
+        body = {
+            "kind": "ExternalMetricValueList",
+            "apiVersion": f"{API_GROUP}/{API_VERSION}",
+            "metadata": {},
+            "items": items,
+        }
+        return "200 OK", _json(body), metric, "stale" if any_stale else "ok"
+
+    def _items(
+        self,
+        metric: str,
+        requirements: list[tuple[str, str, set[str]]],
+        now: float,
+    ) -> tuple[list[dict], bool]:
+        _, extract = EXTERNAL_METRICS[metric]
+        items: list[dict] = []
+        any_stale = False
+        for row in self._plane.rows():
+            labels = {
+                "pool": row["pool"],
+                "slice": row["slice"],
+                # An HPA selecting on job identity uses the slice name
+                # — the ledger's job key is (pool, slice) too.
+                "job": row["slice"],
+            }
+            if not selector_matches(requirements, labels):
+                continue
+            value = extract(row)
+            if value is None:
+                continue
+            stale = bool(row.get("stale")) or self._plane.is_stale(now)
+            metric_labels = {
+                "pool": row["pool"],
+                "slice": row["slice"],
+                "job": row["slice"],
+            }
+            if stale:
+                # Served, but honestly: the HPA (or a human) sees both
+                # the flag and the true age via the cycle timestamp.
+                metric_labels["tpumon_stale"] = "true"
+                any_stale = True
+            items.append(
+                {
+                    "metricName": metric,
+                    "metricLabels": metric_labels,
+                    "timestamp": rfc3339(row["ts"]),
+                    "value": quantity(value),
+                }
+            )
+        return items, any_stale
+
+    @staticmethod
+    def _api_group() -> dict:
+        return {
+            "kind": "APIGroup",
+            "apiVersion": "v1",
+            "name": API_GROUP,
+            "versions": [
+                {
+                    "groupVersion": f"{API_GROUP}/{API_VERSION}",
+                    "version": API_VERSION,
+                }
+            ],
+            "preferredVersion": {
+                "groupVersion": f"{API_GROUP}/{API_VERSION}",
+                "version": API_VERSION,
+            },
+        }
+
+    @staticmethod
+    def _resource_list() -> dict:
+        return {
+            "kind": "APIResourceList",
+            "apiVersion": "v1",
+            "groupVersion": f"{API_GROUP}/{API_VERSION}",
+            "resources": [
+                {
+                    "name": name,
+                    "singularName": "",
+                    "namespaced": True,
+                    "kind": "ExternalMetricValueList",
+                    "verbs": ["get"],
+                }
+                for name in sorted(EXTERNAL_METRICS)
+            ],
+        }
+
+
+def _query_params(query_string: str) -> dict[str, str]:
+    from urllib.parse import parse_qs
+
+    return {
+        k: v[-1]
+        for k, v in parse_qs(query_string or "", keep_blank_values=True).items()
+    }
+
+
+def _status(code: int, message: str) -> dict:
+    return {
+        "kind": "Status",
+        "apiVersion": "v1",
+        "metadata": {},
+        "status": "Failure",
+        "message": message,
+        "code": code,
+    }
+
+
+def _json(doc: dict) -> bytes:
+    return json.dumps(doc, sort_keys=True).encode()

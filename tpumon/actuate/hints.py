@@ -1,0 +1,162 @@
+"""Placement-hint engine: per-slice headroom scores with hysteresis.
+
+The score answers the scheduler's question — "where does new work land
+well?" — from signals the fleet tier already rolls up: duty headroom
+(an idle slice absorbs load), HBM headroom (a full slice OOMs it), ICI
+health (a degraded fabric slows collectives), straggler state (a slice
+dragging a straggler drags new work too), and the goodput ledger's
+contended/idle history (a slice that historically burns chip-seconds in
+``contended`` is a bad neighbor even when instantaneously idle).
+
+Everything here is pure functions plus one small stateful hysteresis
+class, so the scoring semantics are testable without an aggregator:
+the :class:`~tpumon.actuate.plane.ActuatePlane` wires them into the
+collect cycle.
+
+Missing inputs renormalize rather than defaulting: a slice with no HBM
+series is scored on the signals it HAS, not on an invented 0.5 — the
+absent-not-zero rule applied to scoring. A slice with no scoreable
+signal at all gets no score (hint absent, never neutral-by-fiat).
+"""
+
+from __future__ import annotations
+
+#: Signal weights (renormalized over the inputs a slice actually has).
+WEIGHT_DUTY = 0.35
+WEIGHT_HBM = 0.25
+WEIGHT_ICI = 0.15
+WEIGHT_GOODPUT = 0.25
+
+#: Score subtracted while the slice carries an active straggler.
+STRAGGLER_PENALTY = 0.2
+
+#: The hysteresis bands, best placement target first.
+BANDS = ("prefer", "neutral", "avoid")
+
+
+def headroom_score(
+    bucket: dict, goodput: dict | None = None
+) -> tuple[float | None, dict]:
+    """One slice's headroom score in [0, 1] from its rollup bucket
+    (:meth:`tpumon.fleet.rollup._Agg.to_dict` shape) and, when the
+    ledger runs, its goodput bucket totals (chip-seconds by bucket).
+
+    Returns ``(score | None, inputs)`` — inputs is the per-signal
+    breakdown published on /hints so a hint is always explainable.
+    """
+    parts: list[tuple[float, float]] = []
+    inputs: dict = {}
+
+    duty = bucket.get("duty")
+    if duty and duty.get("n"):
+        duty_headroom = min(1.0, max(0.0, 1.0 - duty["mean"] / 100.0))
+        parts.append((WEIGHT_DUTY, duty_headroom))
+        inputs["duty_headroom"] = duty_headroom
+
+    hbm = bucket.get("hbm_headroom_ratio")
+    if hbm is not None:
+        hbm = min(1.0, max(0.0, hbm))
+        parts.append((WEIGHT_HBM, hbm))
+        inputs["hbm_headroom_ratio"] = hbm
+
+    ici = bucket.get("ici")
+    if ici and ici.get("links"):
+        score = min(1.0, max(0.0, ici.get("score", 0.0)))
+        parts.append((WEIGHT_ICI, score))
+        inputs["ici_score"] = score
+
+    goodput_factor = _goodput_factor(goodput)
+    if goodput_factor is not None:
+        parts.append((WEIGHT_GOODPUT, goodput_factor))
+        inputs["goodput_factor"] = goodput_factor
+
+    if not parts:
+        return None, inputs
+
+    total_weight = sum(w for w, _ in parts)
+    score = sum(w * v for w, v in parts) / total_weight
+
+    straggling = bool(bucket.get("stragglers"))
+    inputs["straggler_active"] = straggling
+    if straggling:
+        score -= STRAGGLER_PENALTY
+    return min(1.0, max(0.0, score)), inputs
+
+
+def _goodput_factor(goodput: dict | None) -> float | None:
+    """1 minus the slice's historical contended+idle share of VISIBLE
+    chip-seconds (unaccounted windows are honesty, not evidence — they
+    join neither numerator nor denominator). None until the ledger has
+    accounted anything visible for the job."""
+    if not goodput:
+        return None
+    visible = sum(
+        v for k, v in goodput.items() if k != "unaccounted"
+    )
+    if visible <= 0:
+        return None
+    wasted = goodput.get("contended", 0.0) + goodput.get("idle", 0.0)
+    return min(1.0, max(0.0, 1.0 - wasted / visible))
+
+
+def band_of(score: float, prefer: float, avoid: float) -> str:
+    """Raw (pre-hysteresis) band for a score against the configured
+    thresholds: ≥ prefer → prefer, ≤ avoid → avoid, else neutral."""
+    if score >= prefer:
+        return "prefer"
+    if score <= avoid:
+        return "avoid"
+    return "neutral"
+
+
+class HintHysteresis:
+    """Band publication with a hold window so hints don't flap.
+
+    The first computed band publishes immediately (a new slice needs a
+    hint now, not in ``hold_cycles``); after that, a band change only
+    publishes once the candidate band has held for ``hold_cycles``
+    CONSECUTIVE cycles — a transient duty spike that dips a slice into
+    ``avoid`` for one rollup interval never reaches the scheduler.
+
+    Collect-cycle thread only (the plane publishes results under its
+    own lock), so no locking here.
+    """
+
+    def __init__(self, hold_cycles: int = 3) -> None:
+        self.hold_cycles = max(1, int(hold_cycles))
+        #: slice key -> published band.
+        self._published: dict[tuple[str, str], str] = {}
+        #: slice key -> (candidate band, consecutive cycles seen).
+        self._pending: dict[tuple[str, str], tuple[str, int]] = {}
+        #: slice key -> published transitions since start.
+        self.transitions: dict[tuple[str, str], int] = {}
+
+    def update(self, key: tuple[str, str], band: str) -> str:
+        """Feed one cycle's raw band; returns the published band."""
+        published = self._published.get(key)
+        if published is None:
+            self._published[key] = band
+            self.transitions.setdefault(key, 0)
+            return band
+        if band == published:
+            self._pending.pop(key, None)
+            return published
+        candidate, streak = self._pending.get(key, (band, 0))
+        if candidate != band:
+            candidate, streak = band, 0
+        streak += 1
+        if streak >= self.hold_cycles:
+            self._published[key] = band
+            self._pending.pop(key, None)
+            self.transitions[key] = self.transitions.get(key, 0) + 1
+            return band
+        self._pending[key] = (candidate, streak)
+        return published
+
+    def forget(self, live: set[tuple[str, str]]) -> None:
+        """Drop state for slices no longer in the rollup (identity
+        churn must not leak hysteresis state forever). Transition
+        counters stay — they are history, and counters never regress."""
+        for store in (self._published, self._pending):
+            for key in [k for k in store if k not in live]:
+                del store[key]

@@ -1,0 +1,350 @@
+"""The actuation plane wired into the aggregator's collect cycle.
+
+One :meth:`ActuatePlane.cycle` call per collect pass, fed the SAME
+rollup doc and feed entries the ledger gets — the plane:
+
+1. rolls the lifecycle plane's serving join (``snap["serve"]``, parsed
+   off node pages by tpumon/fleet/ingest.py) up per slice/pool/fleet;
+2. scores every slice through the placement-hint engine
+   (tpumon/actuate/hints.py) joining the rollup bucket with the goodput
+   ledger's per-job history, and runs band hysteresis;
+3. publishes the result as an immutable read model under one lock.
+
+Every query surface — the External Metrics adapter, ``/hints``, the
+``tpu_fleet_*`` families on the aggregator page — reads that model:
+a query touches **no raw per-node series** and does no aggregation of
+its own, the same read-model discipline as /fleet and the ledger.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
+
+from tpumon.actuate.adapter import ExternalMetricsAdapter
+from tpumon.actuate.hints import (
+    BANDS,
+    HintHysteresis,
+    band_of,
+    headroom_score,
+)
+
+#: Annotation keys published in the /hints patch shapes — what a
+#: scheduler extender or descheduler reads off the node/pool objects.
+ANNOTATION_SCORE = "tpumon.dev/headroom-score"
+ANNOTATION_BAND = "tpumon.dev/placement-band"
+
+_SERVE_KEYS = (
+    "requests_per_second",
+    "queue_depth",
+    "ttft_seconds",
+    "slo_attainment_ratio",
+)
+
+
+class _ServeAgg:
+    """One scope's serving accumulator: throughput and queue SUM over
+    feeds (independent request streams), TTFT takes the worst feed,
+    SLO attainment and batch size average — the same merge the
+    lifecycle plane applies across its feeds, one level up."""
+
+    __slots__ = ("rps", "queue", "ttft", "slo_sum", "slo_n",
+                 "batch_sum", "batch_n", "feeds")
+
+    def __init__(self) -> None:
+        self.rps: float | None = None
+        self.queue: float | None = None
+        self.ttft: float | None = None
+        self.slo_sum = 0.0
+        self.slo_n = 0
+        self.batch_sum = 0.0
+        self.batch_n = 0
+        self.feeds = 0
+
+    def add(self, serve: dict) -> None:
+        self.feeds += 1
+        rps = serve.get("requests_per_second")
+        if rps is not None:
+            self.rps = (self.rps or 0.0) + rps
+        queue = serve.get("queue_depth")
+        if queue is not None:
+            self.queue = (self.queue or 0.0) + queue
+        ttft = serve.get("ttft_seconds")
+        if ttft is not None and (self.ttft is None or ttft > self.ttft):
+            self.ttft = ttft
+        slo = serve.get("slo_attainment_ratio")
+        if slo is not None:
+            self.slo_sum += slo
+            self.slo_n += 1
+        batch = serve.get("batch_size")
+        if batch is not None:
+            self.batch_sum += batch
+            self.batch_n += 1
+
+    def to_dict(self) -> dict | None:
+        if not self.feeds:
+            return None
+        out: dict = {"feeds": self.feeds}
+        if self.rps is not None:
+            out["requests_per_second"] = self.rps
+        if self.queue is not None:
+            out["queue_depth"] = self.queue
+        if self.ttft is not None:
+            out["ttft_seconds"] = self.ttft
+        if self.slo_n:
+            out["slo_attainment_ratio"] = self.slo_sum / self.slo_n
+        if self.batch_n:
+            out["batch_size"] = self.batch_sum / self.batch_n
+        return out
+
+
+class ActuatePlane:
+    """Thread model: ``cycle`` runs on the collect thread only;
+    ``rows``/``families``/``hints_response``/``debug_block`` may be
+    called from HTTP threads — the published read model swaps wholesale
+    under one lock, readers get the reference (rows are never mutated
+    after publish)."""
+
+    def __init__(
+        self,
+        *,
+        hint_prefer: float = 0.6,
+        hint_avoid: float = 0.25,
+        hint_hold_cycles: int = 3,
+        stale_after_s: float = 30.0,
+    ) -> None:
+        self.hint_prefer = float(hint_prefer)
+        self.hint_avoid = float(hint_avoid)
+        self.stale_after_s = float(stale_after_s)
+        self._hysteresis = HintHysteresis(hint_hold_cycles)
+        self.adapter = ExternalMetricsAdapter(self)
+        self._lock = threading.Lock()
+        self._rows: list[dict] = []  # guarded-by: self._lock
+        self._pool_serve: dict[str, dict] = {}  # guarded-by: self._lock
+        self._fleet_serve: dict | None = None  # guarded-by: self._lock
+        self._last_cycle_ts = 0.0  # guarded-by: self._lock
+        self._cycles = 0  # guarded-by: self._lock
+
+    # -- collect-cycle hook -------------------------------------------------
+
+    def cycle(
+        self,
+        now: float,
+        doc: dict,
+        entries: list,
+        goodput_jobs: dict | None = None,
+    ) -> None:
+        """One collect cycle: aggregate serve joins off the entries,
+        score + hysterese every slice in the rollup doc, publish."""
+        slice_serve: dict[tuple[str, str], _ServeAgg] = {}
+        pool_serve: dict[str, _ServeAgg] = {}
+        fleet_serve = _ServeAgg()
+        for entry in entries:
+            snap, state = entry[1], entry[2]
+            if state != "up" or not snap:
+                # A stale feed's serve numbers are old news; the slice
+                # row still surfaces (marked stale) via the rollup
+                # bucket below, so staleness is visible, not silent.
+                continue
+            serve = snap.get("serve")
+            if not serve:
+                continue
+            ident = snap.get("identity") or {}
+            pool = ident.get("accelerator") or "unknown"
+            slc = ident.get("slice") or "?"
+            slice_serve.setdefault((pool, slc), _ServeAgg()).add(serve)
+            pool_serve.setdefault(pool, _ServeAgg()).add(serve)
+            fleet_serve.add(serve)
+
+        jobs = goodput_jobs or {}
+        rows: list[dict] = []
+        live: set[tuple[str, str]] = set()
+        for (pool, slc), bucket in sorted(doc.get("slices", {}).items()):
+            key = (pool, slc)
+            live.add(key)
+            score, inputs = headroom_score(bucket, jobs.get(key))
+            band = None
+            if score is not None:
+                band = self._hysteresis.update(
+                    key, band_of(score, self.hint_prefer, self.hint_avoid)
+                )
+            agg = slice_serve.get(key)
+            rows.append(
+                {
+                    "pool": pool,
+                    "slice": slc,
+                    "bucket": bucket,
+                    "serve": agg.to_dict() if agg else None,
+                    "score": score,
+                    "band": band,
+                    "inputs": inputs,
+                    "stale": bool(bucket.get("stale")),
+                    "ts": now,
+                }
+            )
+        self._hysteresis.forget(live)
+
+        with self._lock:
+            self._rows = rows
+            self._pool_serve = {
+                pool: agg.to_dict()
+                for pool, agg in sorted(pool_serve.items())
+                if agg.feeds
+            }
+            self._fleet_serve = fleet_serve.to_dict()
+            self._last_cycle_ts = now
+            self._cycles += 1
+
+    # -- read model ---------------------------------------------------------
+
+    def rows(self) -> list[dict]:
+        """The published per-slice rows (immutable after publish —
+        callers may hold the reference across their whole request)."""
+        with self._lock:
+            return self._rows
+
+    def is_stale(self, now: float) -> bool:
+        """True when no collect cycle has published recently — served
+        values then carry the stale flag rather than posing as current."""
+        with self._lock:
+            last = self._last_cycle_ts
+        return last <= 0.0 or (now - last) > self.stale_after_s
+
+    # -- exposition ---------------------------------------------------------
+
+    def families(self) -> list:
+        from tpumon.families import ACTUATE_FAMILIES
+
+        def gauge(name):
+            _, help_text, extra = ACTUATE_FAMILIES[name]
+            return GaugeMetricFamily(name, help_text, labels=extra)
+
+        def counter(name):
+            _, help_text, extra = ACTUATE_FAMILIES[name]
+            # prometheus_client appends _total on render.
+            return CounterMetricFamily(
+                name[: -len("_total")], help_text, labels=extra
+            )
+
+        with self._lock:
+            rows = self._rows
+            pool_serve = self._pool_serve
+            fleet_serve = self._fleet_serve
+
+        serve_fams = {
+            key: gauge(f"tpu_fleet_serve_{key}") for key in _SERVE_KEYS
+        }
+
+        def emit_serve(labels: tuple, serve: dict | None) -> None:
+            if not serve:
+                return
+            for key, fam in serve_fams.items():
+                value = serve.get(key)
+                if value is not None:
+                    fam.add_metric(labels, value)
+
+        score_fam = gauge("tpu_fleet_hint_headroom_score")
+        band_fam = gauge("tpu_fleet_hint_band")
+        trans_fam = counter("tpu_fleet_hint_transitions_total")
+        pool_scores: dict[str, tuple[float, float]] = {}
+        fleet_weight = fleet_score = 0.0
+        for row in rows:
+            labels = ("slice", row["pool"], row["slice"])
+            emit_serve(labels, row["serve"])
+            if row["score"] is None:
+                continue
+            score_fam.add_metric(labels, row["score"])
+            chips = float(row["bucket"].get("chips") or 0) or 1.0
+            w, s = pool_scores.get(row["pool"], (0.0, 0.0))
+            pool_scores[row["pool"]] = (w + chips, s + chips * row["score"])
+            fleet_weight += chips
+            fleet_score += chips * row["score"]
+            if row["band"]:
+                for band in BANDS:
+                    band_fam.add_metric(
+                        (row["pool"], row["slice"], band),
+                        1.0 if band == row["band"] else 0.0,
+                    )
+        for pool, (w, s) in sorted(pool_scores.items()):
+            score_fam.add_metric(("pool", pool, ""), s / w)
+        if fleet_weight:
+            score_fam.add_metric(("fleet", "", ""), fleet_score / fleet_weight)
+        for pool, serve in pool_serve.items():
+            emit_serve(("pool", pool, ""), serve)
+        emit_serve(("fleet", "", ""), fleet_serve)
+        for (pool, slc), count in sorted(self._hysteresis.transitions.items()):
+            trans_fam.add_metric((pool, slc), float(count))
+
+        out = []
+        for fam in (*serve_fams.values(), score_fam, band_fam, trans_fam):
+            if fam.samples:
+                out.append(fam)
+        return out
+
+    # -- query surfaces -----------------------------------------------------
+
+    def hints_response(self, query_string: str = "") -> tuple[bytes, str]:
+        """``GET /hints``: the per-slice hint table plus the annotation
+        patch shapes (``?pool=`` narrows to one pool)."""
+        from urllib.parse import parse_qs
+
+        params = {
+            k: v[-1] for k, v in parse_qs(query_string or "").items()
+        }
+        pool_filter = params.get("pool")
+        with self._lock:
+            rows = self._rows
+            last_ts = self._last_cycle_ts
+            cycles = self._cycles
+        slices = []
+        for row in rows:
+            if pool_filter and row["pool"] != pool_filter:
+                continue
+            entry: dict = {
+                "pool": row["pool"],
+                "slice": row["slice"],
+                "score": row["score"],
+                "band": row["band"],
+                "stale": row["stale"],
+                "inputs": row["inputs"],
+            }
+            if row["score"] is not None and row["band"] is not None:
+                annotations = {
+                    ANNOTATION_SCORE: f"{row['score']:.3f}",
+                    ANNOTATION_BAND: row["band"],
+                }
+                entry["annotations"] = annotations
+                # Ready-to-apply strategic-merge patch for a scheduler
+                # extender / descheduler (kubectl patch --type merge).
+                entry["patch"] = {"metadata": {"annotations": annotations}}
+            slices.append(entry)
+        doc = {
+            "ts": last_ts,
+            "cycles": cycles,
+            "thresholds": {
+                "prefer": self.hint_prefer,
+                "avoid": self.hint_avoid,
+                "hold_cycles": self._hysteresis.hold_cycles,
+            },
+            "slices": slices,
+        }
+        return json.dumps(doc, sort_keys=True).encode(), "200 OK"
+
+    def debug_block(self) -> dict:
+        """The /debug/vars "actuate" block: O(1) state, no rows."""
+        with self._lock:
+            rows = self._rows
+            last_ts = self._last_cycle_ts
+            cycles = self._cycles
+        return {
+            "cycles": cycles,
+            "last_cycle_ts": last_ts,
+            "slices": len(rows),
+            "serving_slices": sum(1 for r in rows if r["serve"]),
+            "scored_slices": sum(1 for r in rows if r["score"] is not None),
+            "hint_transitions": sum(
+                self._hysteresis.transitions.values()
+            ),
+        }

@@ -225,6 +225,7 @@ PIPELINE_PREFIXES = (
     "tpumon/lifecycle/",
     "tpumon/energy/",
     "tpumon/ledger/",
+    "tpumon/actuate/",
     "tpumon/history.py",
 )
 

@@ -51,7 +51,9 @@ _REGISTRY_DICTS = {
     "STEP_FAMILIES",
     "FLEET_FAMILIES",
     "LEDGER_FAMILIES",
+    "ACTUATE_FAMILIES",
     "WORKLOAD_FAMILIES",
+    "SERVE_FAMILIES",
     "HOST_FAMILIES",
 }
 
@@ -60,7 +62,7 @@ _REGISTRY_DICTS = {
 #: metric names appear in prose).
 _METRIC_RE = re.compile(
     r"\b(?:(?:accelerator|exporter|collector|workload|host|tpu_anomaly"
-    r"|tpu_hostcorr|tpu_straggler|tpu_lifecycle|tpu_step"
+    r"|tpu_hostcorr|tpu_straggler|tpu_lifecycle|tpu_step|tpu_serve"
     r"|tpu_energy|tpu_pod_energy|tpu_ledger"
     r"|tpu_fleet|tpumon_trace|tpumon_poll|tpumon_family|tpumon_breaker"
     r"|tpumon_retries|tpumon_watchdog|tpumon_guard|tpumon_shed"
@@ -84,6 +86,7 @@ _EMIT_PREFIXES = (
     "tpumon/energy/",
     "tpumon/ledger/",
     "tpumon/workload/",
+    "tpumon/actuate/",
 )
 
 

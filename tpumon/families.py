@@ -145,6 +145,39 @@ LIFECYCLE_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "share; ordinary counter-reset semantics)",
         ("op",),
     ),
+    "tpu_lifecycle_serve_requests_per_second": (
+        "gauge",
+        "Completed inference requests per second summed over the "
+        "probed serving feeds (absent when none report) — the fleet "
+        "actuation tier rolls this up per slice",
+        (),
+    ),
+    "tpu_lifecycle_serve_queue_depth": (
+        "gauge",
+        "Requests admitted but not yet completed, summed over the "
+        "probed serving feeds (absent when none report) — the primary "
+        "scale-out pressure signal",
+        (),
+    ),
+    "tpu_lifecycle_serve_ttft_seconds": (
+        "gauge",
+        "Worst time-to-first-token proxy across the probed serving "
+        "feeds over the last window (absent when none report)",
+        (),
+    ),
+    "tpu_lifecycle_serve_slo_attainment_ratio": (
+        "gauge",
+        "Fraction of requests meeting the serving latency SLO over the "
+        "last window, mean over the probed serving feeds (absent when "
+        "none report) — goodput-under-SLO at node granularity",
+        (),
+    ),
+    "tpu_lifecycle_serve_batch_size": (
+        "gauge",
+        "Mean effective batch size across the probed serving feeds "
+        "over the last window (absent when none report)",
+        (),
+    ),
 }
 
 #: family -> (prometheus type, description, extra labels) — the
@@ -606,6 +639,13 @@ FLEET_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "traffic concentrates)",
         ("shard",),
     ),
+    "tpu_fleet_rollup_dirty_stripes": (
+        "gauge",
+        "Striped-ingest shards actually drained last publish; clean "
+        "shards replayed their cached rows, so idle-fleet publish cost "
+        "is proportional to this, not to the shard count",
+        (),
+    ),
 }
 
 #: family -> (prometheus type, description, extra labels) — the fleet
@@ -904,6 +944,101 @@ STEP_FAMILIES: dict[str, str] = {
     ),
 }
 
+#: family -> description — request-level serving telemetry the workload
+#: harness's inference preset serves on its metrics port
+#: (tpumon/workload/serve.py) and the exporter's lifecycle plane lifts
+#: into ``tpu_lifecycle_serve_*``. Families are absent until the serving
+#: loop records a window (absent-not-zero).
+SERVE_FAMILIES: dict[str, str] = {
+    "tpu_serve_requests_total": (
+        "Inference requests completed by the serving loop since start"
+    ),
+    "tpu_serve_requests_per_second": (
+        "Completed requests per second over the most recent stats window"
+    ),
+    "tpu_serve_queue_depth": (
+        "Requests admitted but not yet completed (instantaneous) — the "
+        "scale-out pressure signal the actuation tier exports to HPAs"
+    ),
+    "tpu_serve_batch_size": (
+        "Mean effective batch size over the most recent window"
+    ),
+    "tpu_serve_ttft_seconds": (
+        "Time-to-first-token proxy over the most recent window: queue "
+        "wait plus one decode-step latency for newly admitted requests"
+    ),
+    "tpu_serve_slo_attainment_ratio": (
+        "Fraction of requests whose TTFT proxy met the configured SLO "
+        "over the most recent window — goodput under SLO"
+    ),
+    "tpu_serve_slo_threshold_seconds": (
+        "The configured TTFT SLO threshold the attainment ratio is "
+        "measured against (constant per run)"
+    ),
+}
+
+#: family -> (prometheus type, description, extra labels) — the
+#: actuation plane (tpumon/actuate): per-slice serving rollups, the
+#: placement-hint engine, and External Metrics adapter self-metrics,
+#: served on the aggregator's /metrics page beside FLEET_FAMILIES.
+#: Serving rollups are absent for scopes with no serving feeds; hint
+#: families are absent until a slice has a computed score.
+ACTUATE_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
+    "tpu_fleet_serve_requests_per_second": (
+        "gauge",
+        "Completed inference requests per second summed over the "
+        "scope's serving feeds (scope ∈ fleet/pool/slice)",
+        ("scope", "pool", "slice"),
+    ),
+    "tpu_fleet_serve_queue_depth": (
+        "gauge",
+        "Admitted-but-incomplete requests summed over the scope's "
+        "serving feeds — the external metric an HPA scales on",
+        ("scope", "pool", "slice"),
+    ),
+    "tpu_fleet_serve_ttft_seconds": (
+        "gauge",
+        "Worst time-to-first-token proxy across the scope's serving "
+        "feeds",
+        ("scope", "pool", "slice"),
+    ),
+    "tpu_fleet_serve_slo_attainment_ratio": (
+        "gauge",
+        "Mean fraction of requests meeting the serving SLO across the "
+        "scope's serving feeds — goodput under SLO",
+        ("scope", "pool", "slice"),
+    ),
+    "tpu_fleet_hint_headroom_score": (
+        "gauge",
+        "Placement-hint headroom score in [0, 1] per slice (duty + HBM "
+        "+ ICI + straggler state + ledger goodput history; higher = "
+        "better placement target); pool/fleet scopes are chip-weighted "
+        "means",
+        ("scope", "pool", "slice"),
+    ),
+    "tpu_fleet_hint_band": (
+        "gauge",
+        "1 for the slice's current hysteresis-held placement band "
+        "(band ∈ prefer/neutral/avoid), 0 for the others — the "
+        "annotation value a scheduler extender consumes",
+        ("pool", "slice", "band"),
+    ),
+    "tpu_fleet_hint_transitions_total": (
+        "counter",
+        "Published placement-band changes per slice since aggregator "
+        "start — a high rate means the hysteresis hold "
+        "(TPUMON_FLEET_HINT_HOLD_CYCLES) is too short for the fleet's "
+        "load variance",
+        ("pool", "slice"),
+    ),
+    "tpu_fleet_external_metrics_requests_total": (
+        "counter",
+        "External Metrics API requests served by the adapter, by "
+        "metric name and result (ok / stale / not_found / bad_request)",
+        ("metric", "result"),
+    ),
+}
+
 
 def host_family_rows() -> dict[str, tuple[str, str, tuple[str, ...]]]:
     """Host-context families (declared next to their builder)."""
@@ -938,7 +1073,9 @@ def all_family_names() -> set[str]:
         | set(SELF_FAMILIES)
         | set(FLEET_FAMILIES)
         | set(LEDGER_FAMILIES)
+        | set(ACTUATE_FAMILIES)
         | set(WORKLOAD_FAMILIES)
         | set(STEP_FAMILIES)
+        | set(SERVE_FAMILIES)
         | set(host_family_rows())
     )

@@ -173,6 +173,19 @@ class FleetConfig:
     #: diagnostic escape hatch the exporter's TPUMON_RENDER_DELTA is,
     #: scoped to this tier (output bytes are identical either way).
     render_delta: bool = True
+    #: Actuation plane (tpumon/actuate): per-slice serving rollups, the
+    #: placement-hint engine, the /hints endpoint, and the Kubernetes
+    #: External Metrics adapter (/apis/external.metrics.k8s.io) — the
+    #: observe→act ring. Off keeps the aggregator observation-only.
+    actuate: bool = True
+    #: Headroom score at or above which a slice's placement band is
+    #: ``prefer`` (scores are in [0, 1]; tpumon/actuate/hints.py).
+    hint_prefer: float = 0.6
+    #: Headroom score at or below which the band is ``avoid``.
+    hint_avoid: float = 0.25
+    #: Hysteresis hold: a band change publishes only after the new band
+    #: held for this many consecutive collect cycles (flap damping).
+    hint_hold_cycles: int = 3
     #: Log level name.
     log_level: str = "INFO"
 

@@ -223,6 +223,29 @@ def node_snapshot_from_text(text: str) -> dict:
             ] = float(line.rsplit(" ", 1)[1])
         elif name == "tpu_lifecycle_collective_wait_fraction":
             snap["collective_wait"] = float(line.rsplit(" ", 1)[1])
+        elif name == "tpu_lifecycle_serve_requests_per_second":
+            # Serving-scenario join (tpumon/lifecycle ← tpumon/workload/
+            # serve.py) — the actuation plane (tpumon/actuate) rolls the
+            # serve block up per slice for External Metrics queries.
+            snap.setdefault("serve", {})["requests_per_second"] = float(
+                line.rsplit(" ", 1)[1]
+            )
+        elif name == "tpu_lifecycle_serve_queue_depth":
+            snap.setdefault("serve", {})["queue_depth"] = float(
+                line.rsplit(" ", 1)[1]
+            )
+        elif name == "tpu_lifecycle_serve_ttft_seconds":
+            snap.setdefault("serve", {})["ttft_seconds"] = float(
+                line.rsplit(" ", 1)[1]
+            )
+        elif name == "tpu_lifecycle_serve_slo_attainment_ratio":
+            snap.setdefault("serve", {})["slo_attainment_ratio"] = float(
+                line.rsplit(" ", 1)[1]
+            )
+        elif name == "tpu_lifecycle_serve_batch_size":
+            snap.setdefault("serve", {})["batch_size"] = float(
+                line.rsplit(" ", 1)[1]
+            )
         elif name == "tpu_energy_power_watts":
             # Energy plane (tpumon/energy) — summed to node watts for
             # the tpu_fleet_energy_watts rollup; one modeled chip makes

@@ -164,6 +164,22 @@ class FleetTelemetry:
             labelnames=("shard",),
             registry=registry,
         )
+        self.rollup_dirty_stripes = Gauge(
+            "tpu_fleet_rollup_dirty_stripes",
+            "Striped-ingest shards actually drained last publish; "
+            "clean shards replayed their cached rows, so idle-fleet "
+            "publish cost is proportional to this, not to the shard "
+            "count.",
+            registry=registry,
+        )
+        self.external_metrics_requests = Counter(
+            "tpu_fleet_external_metrics_requests",
+            "External Metrics API requests served by the actuation "
+            "adapter, by metric name and result (ok / stale / "
+            "not_found / bad_request).",
+            labelnames=("metric", "result"),
+            registry=registry,
+        )
         self.shed = Counter(
             "tpumon_shed_requests",
             "Requests refused by the aggregator's ingress guard "
@@ -406,6 +422,24 @@ class FleetAggregator:
                 remote_write_every_s=cfg.ledger_remote_write_every_s,
                 remote_write_timeout=cfg.timeout,
                 dollars_per_kwh=cfg.ledger_dollars_per_kwh,
+            )
+
+        #: Actuation plane (tpumon/actuate, ISSUE 16): per-slice serving
+        #: rollups + placement hints + the External Metrics adapter,
+        #: riding the same rollup doc and feed entries the ledger gets.
+        #: Every query it serves reads the pre-computed model — no raw
+        #: per-node series on any actuation path.
+        self.actuate = None
+        if cfg.actuate:
+            from tpumon.actuate import ActuatePlane
+
+            self.actuate = ActuatePlane(
+                hint_prefer=cfg.hint_prefer,
+                hint_avoid=cfg.hint_avoid,
+                hint_hold_cycles=cfg.hint_hold_cycles,
+                # Values older than the staleness budget are served
+                # flagged, same clock the rollup's own stale class uses.
+                stale_after_s=max(cfg.stale_s, 3.0 * cfg.interval),
             )
 
         from tpumon.exporter.server import _SelfTelemetryPage
@@ -670,6 +704,37 @@ class FleetAggregator:
                     ],
                 )
                 return [body]
+            elif path == "/hints" and self.actuate is not None:
+                body, status = self.actuate.hints_response(
+                    environ.get("QUERY_STRING", "")
+                )
+                start_response(
+                    status,
+                    [
+                        ("Content-Type",
+                         "application/json; charset=utf-8"),
+                        ("Content-Length", str(len(body))),
+                    ],
+                )
+                return [body]
+            elif (
+                path.startswith("/apis/external.metrics.k8s.io")
+                and self.actuate is not None
+            ):
+                status, body, metric, result = self.actuate.adapter.handle(
+                    path, environ.get("QUERY_STRING", "")
+                )
+                self.telemetry.external_metrics_requests.labels(
+                    metric=metric or "_discovery", result=result
+                ).inc()
+                start_response(
+                    status,
+                    [
+                        ("Content-Type", "application/json"),
+                        ("Content-Length", str(len(body))),
+                    ],
+                )
+                return [body]
             else:
                 return inner(environ, start_response)
             start_response(
@@ -738,6 +803,7 @@ class FleetAggregator:
                 "dirty_nodes": self._rollup.last_dirty_nodes,
                 "dirty_buckets": self._rollup.last_dirty_buckets,
                 "stripes": self.stripes.stripe_count,
+                "dirty_stripes": self.stripes.last_dirty_stripes,
                 "shards": self.stripes.stats(),
             },
         }
@@ -750,6 +816,8 @@ class FleetAggregator:
             }
         if self.ledger is not None:
             doc["ledger"] = self.ledger.debug_block()
+        if self.actuate is not None:
+            doc["actuate"] = self.actuate.debug_block()
         if self.guard is not None:
             doc["guard"] = {"ingress": self.guard.snapshot()}
         if self.tracer is not None:
@@ -910,10 +978,28 @@ class FleetAggregator:
                     # The ledger must never take the collect loop down;
                     # a failed cycle costs one cycle of history.
                     log.exception("ledger cycle failed")
+        if self.actuate is not None:
+            with trace_span("actuate"):
+                try:
+                    self.actuate.cycle(
+                        now, doc, entries,
+                        goodput_jobs=(
+                            self.ledger.goodput.jobs()
+                            if self.ledger is not None
+                            else None
+                        ),
+                    )
+                except Exception:
+                    # Same stance as the ledger: actuation must never
+                    # take observation down — a failed cycle leaves the
+                    # previous hints serving, honestly aged.
+                    log.exception("actuate cycle failed")
         with trace_span("render"):
             families = fleet_families(doc)
             if self.ledger is not None:
                 families = families + self.ledger.families()
+            if self.actuate is not None:
+                families = families + self.actuate.families()
         if self.history is not None:
             with trace_span("history_record"):
                 try:
@@ -941,6 +1027,7 @@ class FleetAggregator:
         t.rollup_dirty_nodes.set(float(self._rollup.last_dirty_nodes))
         t.rollup_dirty_buckets.set(float(self._rollup.last_dirty_buckets))
         t.rollup_shards.set(float(self.stripes.stripe_count))
+        t.rollup_dirty_stripes.set(float(self.stripes.last_dirty_stripes))
         for idx, shard in enumerate(self.stripes.stats()):
             t.rollup_shard_entries.labels(shard=str(idx)).set(
                 float(shard["entries"])

@@ -60,9 +60,23 @@ def stripe_of(key: str, stripe_count: int) -> int:
 
 class _Stripe:
     """One accumulator shard: its lock, the per-target ingest state it
-    holds, and a write counter (the contention-spread telemetry)."""
+    holds, a write counter (the contention-spread telemetry), and the
+    dirty-set publish cache (ISSUE 16 satellite of the ISSUE 15 path).
 
-    __slots__ = ("lock", "entries", "writes")
+    ``changes`` advances under the stripe lock on EVERY membership or
+    content mutation (store, placeholder insert, pop — including the
+    move path's pop from the old stripe), so the publish scan can prove
+    a stripe clean by comparing one integer. The cache fields hold the
+    last built output rows plus everything that could invalidate them
+    without a mutation: the thresholds they were classified against and
+    the earliest future instant any row's age class transitions
+    (fresh→stale→dark happen with no write arriving)."""
+
+    __slots__ = (
+        "lock", "entries", "writes", "changes",
+        "cached_rows", "cached_changes", "cached_params",
+        "cached_next_transition", "cached_built_at",
+    )
 
     def __init__(self) -> None:
         self.lock = threading.Lock()
@@ -70,6 +84,14 @@ class _Stripe:
         #: from the writer's own feed state, captured atomically there.
         self.entries: dict[str, tuple] = {}
         self.writes = 0
+        #: Mutations since construction (stripe lock). The publish
+        #: cache is valid only while this matches cached_changes.
+        self.changes = 0
+        self.cached_rows: list[tuple] | None = None
+        self.cached_changes = -1
+        self.cached_params: tuple = ()
+        self.cached_next_transition = 0.0
+        self.cached_built_at = 0.0
 
 
 class StripedIngest:
@@ -87,6 +109,9 @@ class StripedIngest:
         #: is admission: a put for an unrouted target is a late
         #: in-flight store for a feed this shard handed away — dropped.
         self._route: dict[str, int] = {}  # guarded-by: self._route_lock
+        #: Stripes actually drained (cache miss) by the last publish —
+        #: the tpu_fleet_rollup_dirty_stripes gauge (collect thread).
+        self.last_dirty_stripes = 0
 
     # -- routing ------------------------------------------------------------
 
@@ -123,7 +148,9 @@ class StripedIngest:
             self._route[target] = idx
             stripe = self._stripes[idx]
             with stripe.lock:
-                stripe.entries.setdefault(target, (None, 0.0, 0))
+                if target not in stripe.entries:
+                    stripe.entries[target] = (None, 0.0, 0)
+                    stripe.changes += 1
 
     def remove(self, target: str) -> None:
         """Evict a handed-back/departed target. Stale copies a racing
@@ -136,6 +163,10 @@ class StripedIngest:
             stripe = self._stripes[idx]
             with stripe.lock:
                 stripe.entries.pop(target, None)
+                # Unconditional bump: a racing writer's ghost may land
+                # right after this pop, and the conservative dirty mark
+                # guarantees the next publish rescans (and evicts it).
+                stripe.changes += 1
 
     # -- writers (Watch threads / poll executor) ----------------------------
 
@@ -169,6 +200,7 @@ class StripedIngest:
             with stripe.lock:
                 stripe.entries[target] = (snap, data_ts, content_seq)
                 stripe.writes += 1
+                stripe.changes += 1
             return
         with self._route_lock:
             cur = self._route.get(target)
@@ -179,10 +211,14 @@ class StripedIngest:
                 old = self._stripes[cur]
                 with old.lock:
                     old.entries.pop(target, None)
+                    # The departure dirties the OLD stripe too — its
+                    # cached rows still carry this target.
+                    old.changes += 1
             stripe = self._stripes[dest]
             with stripe.lock:
                 stripe.entries[target] = (snap, data_ts, content_seq)
                 stripe.writes += 1
+                stripe.changes += 1
 
     # -- publish (collect thread) -------------------------------------------
 
@@ -191,32 +227,70 @@ class StripedIngest:
     ) -> list[tuple]:
         """One cycle's ``(target, snap, state, content_seq)`` rows —
         the :class:`IncrementalRollup` / goodput-ledger input shape.
-        N brief stripe-lock holds; zero feed locks. Targets whose route
-        moved on (slice move, hand-back) are lazily evicted here rather
-        than emitted twice. The route lock is held across the scan so a
-        concurrent identity MOVE cannot leave a target absent from
-        every stripe mid-scan (common-path writes never take it — only
-        movers and membership wait, both rare)."""
+        At most N brief stripe-lock holds; zero feed locks. Targets
+        whose route moved on (slice move, hand-back) are lazily evicted
+        here rather than emitted twice. The route lock is held across
+        the scan so a concurrent identity MOVE cannot leave a target
+        absent from every stripe mid-scan (common-path writes never
+        take it — only movers and membership wait, both rare).
+
+        Dirty-set publish: a stripe whose change counter, thresholds,
+        and age classes are all provably unchanged since its last drain
+        replays its cached rows verbatim — zero per-row work — so an
+        idle fleet's publish cost is proportional to the DIRTY stripe
+        count, not the stripe count. The cache is invalidated by any
+        mutation (the counter), a threshold change, the earliest
+        fresh→stale→dark boundary any cached row crosses with no write
+        arriving, or a clock that ran backwards (ages are monotone in
+        ``now`` only forwards). Replayed rows are the exact list the
+        rebuild would produce — same objects, same order — preserving
+        the byte-identity contract."""
         out: list[tuple] = []
+        params = (stale_s, evict_s)
+        dirty = 0
         with self._route_lock:
             route_get = self._route.get
             for idx, stripe in enumerate(self._stripes):
-                evict: list[str] = []
                 with stripe.lock:
+                    if (
+                        stripe.cached_rows is not None
+                        and stripe.cached_changes == stripe.changes
+                        and stripe.cached_params == params
+                        and stripe.cached_built_at <= now
+                        and now < stripe.cached_next_transition
+                    ):
+                        out.extend(stripe.cached_rows)
+                        continue
+                    dirty += 1
+                    rows: list[tuple] = []
+                    next_transition = float("inf")
+                    evict: list[str] = []
                     for target, (snap, ts, seq) in stripe.entries.items():
                         if route_get(target) != idx:
                             evict.append(target)
                             continue
-                        age = (
-                            float("inf") if ts == 0.0
-                            else max(0.0, now - ts)
-                        )
-                        out.append(
+                        if ts == 0.0:
+                            age = float("inf")
+                        else:
+                            age = max(0.0, now - ts)
+                            # The instants this row's class next flips
+                            # with no write arriving.
+                            for bound in (ts + stale_s, ts + evict_s):
+                                if now < bound < next_transition:
+                                    next_transition = bound
+                        rows.append(
                             (target, snap,
                              classify(age, stale_s, evict_s), seq)
                         )
                     for target in evict:
                         del stripe.entries[target]
+                    stripe.cached_rows = rows
+                    stripe.cached_changes = stripe.changes
+                    stripe.cached_params = params
+                    stripe.cached_next_transition = next_transition
+                    stripe.cached_built_at = now
+                    out.extend(rows)
+        self.last_dirty_stripes = dirty
         return out
 
     def stats(self) -> list[dict]:

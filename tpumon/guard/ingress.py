@@ -176,6 +176,16 @@ class IngressGuard:
             # Ledger range query (tpumon/ledger): decodes sealed chunks
             # per request — debug-class budget, bounded + continuation.
             return "ledger", DEBUG
+        if path == "/hints":
+            # Placement-hint table (tpumon/actuate): serializes the
+            # per-slice read model per request — debug-class budget.
+            return "hints", DEBUG
+        if path.startswith("/apis/"):
+            # External Metrics API (tpumon/actuate/adapter.py): served
+            # off the pre-computed read model, but per-request JSON
+            # construction — debug-class budget. An HPA polls at ~15 s
+            # cadence, far inside the budget; the guard bounds abuse.
+            return "external_metrics", DEBUG
         if path.startswith("/debug/") or path == "/health/devices":
             return DEBUG, DEBUG
         return None, None

@@ -142,6 +142,35 @@ class LifecyclePlane:
         block["step_seconds"] = step_seconds
         block["tokens_per_second"] = tokens_per_second
 
+        # Serving-side join (inference preset, tpumon/workload/serve.py):
+        # replicas serve independent request streams, so throughput and
+        # queue depth SUM across feeds; TTFT takes the worst feed (the
+        # SLO-relevant tail) and SLO attainment / batch size are means.
+        def _sum(key: str) -> float | None:
+            vals = [
+                s.get(key)
+                for s in feed_snaps.values()
+                if s.get(key) is not None
+            ]
+            return sum(vals) if vals else None
+
+        def _worst(key: str) -> float | None:
+            vals = [
+                s.get(key)
+                for s in feed_snaps.values()
+                if s.get(key) is not None
+            ]
+            return max(vals) if vals else None
+
+        serve = {
+            "requests_per_second": _sum("serve_requests_per_second"),
+            "queue_depth": _sum("serve_queue_depth"),
+            "ttft_seconds": _worst("serve_ttft_seconds"),
+            "slo_attainment_ratio": _mean("serve_slo_attainment_ratio"),
+            "batch_size": _mean("serve_batch_size"),
+        }
+        block["serve"] = serve
+
         record = {
             "ts": now,
             "transition": block["transition"],
@@ -235,6 +264,24 @@ class LifecyclePlane:
             for op in sorted(ckpt_totals):
                 ckpts.add_metric(vals + (op,), ckpt_totals[op])
             out.append(ckpts)
+        # Serving join (inference preset): absent unless at least one
+        # probed feed reports the serve_* side — the fleet actuation
+        # tier (tpumon/actuate) rolls these up per slice.
+        for key, name in (
+            ("requests_per_second", "tpu_lifecycle_serve_requests_per_second"),
+            ("queue_depth", "tpu_lifecycle_serve_queue_depth"),
+            ("ttft_seconds", "tpu_lifecycle_serve_ttft_seconds"),
+            (
+                "slo_attainment_ratio",
+                "tpu_lifecycle_serve_slo_attainment_ratio",
+            ),
+            ("batch_size", "tpu_lifecycle_serve_batch_size"),
+        ):
+            value = block.get("serve", {}).get(key)
+            if value is not None:
+                g = fam(name, GaugeMetricFamily)
+                g.add_metric(vals, value)
+                out.append(g)
         return out
 
     # -- query surfaces ----------------------------------------------------

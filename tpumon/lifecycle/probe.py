@@ -50,6 +50,14 @@ _SCALARS = {
     "workload_steps_total": "steps_total",
     "workload_loss": "loss",
     "workload_mfu_ratio": "mfu",
+    # Serving-preset families (tpumon/workload/serve.py): lifted under
+    # serve_* keys so the plane can join them per feed and the fleet
+    # actuation tier can roll them up per slice.
+    "tpu_serve_requests_per_second": "serve_requests_per_second",
+    "tpu_serve_queue_depth": "serve_queue_depth",
+    "tpu_serve_ttft_seconds": "serve_ttft_seconds",
+    "tpu_serve_slo_attainment_ratio": "serve_slo_attainment_ratio",
+    "tpu_serve_batch_size": "serve_batch_size",
 }
 
 

@@ -36,6 +36,11 @@ Fault scripting over stdin (the fleet-chaos vocabulary,
   only F of the live nodes take new backend state each tick, the rest
   heartbeat with unchanged content — the mostly-idle fleet shape the
   delta fan-in protocol is benchmarked against.
+- ``serve RPS QUEUE TTFT_MS SLO [BATCH]`` — every live node's page
+  carries ``tpu_lifecycle_serve_*`` at these values from the next tick
+  (``serve off`` clears) — the inference-scenario dial the actuation
+  tier's External Metrics adapter is drilled against
+  (``soak.py --serve-burst``).
 - ``heal`` — clear partition/slow/corrupt/flap (killed nodes stay dead).
 
 Exposition: each node serves text (default), the compact snapshot
@@ -118,6 +123,9 @@ class FleetSim:
         #: nodes still refresh their poll timestamp every tick — the
         #: heartbeat — so they read fresh, just unchanged.
         self._churn = max(0.0, min(1.0, churn))  # guarded-by: self._lock
+        #: Fleet-wide per-node serving profile (None = serve lines off);
+        #: applied to every live node's page at the next tick.
+        self._serve: dict | None = None  # guarded-by: self._lock
         self._churn_cursor = 0  # ticker thread only
         self._tick_no = 0  # ticker thread only
         #: Per-node identity-rewritten page template (no timestamp
@@ -235,6 +243,23 @@ class FleetSim:
         with self._lock:
             frozen = set(self._frozen)
             churn = self._churn
+            serve = dict(self._serve) if self._serve else None
+        if serve is not None:
+            # The serving join rides the stamp (per-tick, every live
+            # node) on BOTH encodings: text lines the ingest parser
+            # lifts into snap["serve"], and the snapshot/delta path's
+            # snap["serve"] below.
+            stamp += "".join(
+                f"# TYPE tpu_lifecycle_serve_{key} gauge\n"
+                f"tpu_lifecycle_serve_{key} {value:g}\n"
+                for key, value in (
+                    ("requests_per_second", serve["requests_per_second"]),
+                    ("queue_depth", serve["queue_depth"]),
+                    ("ttft_seconds", serve["ttft_seconds"]),
+                    ("slo_attainment_ratio", serve["slo_attainment_ratio"]),
+                    ("batch_size", serve["batch_size"]),
+                )
+            )
         self._tick_no += 1
         live = [i for i in range(self.nodes) if i not in frozen]
         churners: set[int] = set()
@@ -266,6 +291,8 @@ class FleetSim:
                 self._contents[i] = content
             pages[i] = (self._templates[i] + stamp).encode()
             snap = {**self._contents[i], "last_poll_ts": now}
+            if serve is not None:
+                snap["serve"] = serve
             self._delta[i].record(
                 (self._tick_no,), snap, encode_snapshot(snap)
             )
@@ -326,6 +353,36 @@ class FleetSim:
             self._churn = max(0.0, min(1.0, fraction))
             value = self._churn
         return [f"churn set to {value:g}"]
+
+    def serve_profile(self, spec: str) -> list[str]:
+        """Set (or clear with ``off``) the fleet-wide per-node serving
+        profile: ``RPS QUEUE TTFT_MS SLO [BATCH]``. Every live node's
+        page carries the matching ``tpu_lifecycle_serve_*`` gauges from
+        the next tick, so the aggregator's actuation plane sees a
+        uniform inference workload whose intensity this dial controls
+        mid-run (the ``--serve-burst`` traffic spike)."""
+        if spec.strip() == "off":
+            with self._lock:
+                self._serve = None
+            return ["serve telemetry off"]
+        parts = spec.split()
+        if len(parts) not in (4, 5):
+            raise ValueError("serve wants RPS QUEUE TTFT_MS SLO [BATCH]")
+        rps, queue, ttft_ms, slo = (float(p) for p in parts[:4])
+        batch = float(parts[4]) if len(parts) == 5 else 32.0
+        profile = {
+            "requests_per_second": rps,
+            "queue_depth": queue,
+            "ttft_seconds": ttft_ms / 1e3,
+            "slo_attainment_ratio": max(0.0, min(1.0, slo)),
+            "batch_size": batch,
+        }
+        with self._lock:
+            self._serve = profile
+        return [
+            f"serve rps={rps:g} queue={queue:g} ttft={ttft_ms:g}ms "
+            f"slo={profile['slo_attainment_ratio']:g} batch={batch:g}"
+        ]
 
     def _run(self) -> None:
         while not self._stop.wait(self.node_interval):
@@ -437,7 +494,8 @@ def main(argv=None) -> int:
     print("PORTS " + " ".join(str(p) for p in sim.ports), flush=True)
     try:
         # Control protocol: "kill N" / "partition N" / "slow N MS" /
-        # "corrupt N" / "flap N" / "churn F" / "heal" / "quit".
+        # "corrupt N" / "flap N" / "churn F" / "serve ..." / "heal" /
+        # "quit".
         for line in sys.stdin:
             parts = line.split()
             if not parts:
@@ -458,6 +516,8 @@ def main(argv=None) -> int:
                     out = sim.flap(int(parts[1]))
                 elif cmd == "churn" and len(parts) == 2:
                     out = sim.set_churn(float(parts[1]))
+                elif cmd == "serve" and len(parts) >= 2:
+                    out = sim.serve_profile(" ".join(parts[1:]))
                 elif cmd == "heal" and len(parts) == 1:
                     out = sim.heal()
                 else:

@@ -12,6 +12,7 @@ import os
 import sys
 
 from tpumon.families import (
+    ACTUATE_FAMILIES,
     ANOMALY_FAMILIES,
     ENERGY_FAMILIES,
     FLEET_FAMILIES,
@@ -21,6 +22,7 @@ from tpumon.families import (
     LEDGER_FAMILIES,
     LIFECYCLE_FAMILIES,
     SELF_FAMILIES,
+    SERVE_FAMILIES,
     STEP_FAMILIES,
     WORKLOAD_FAMILIES,
     distribution_family_rows,
@@ -276,12 +278,53 @@ def render() -> str:
 
     lines += [
         "",
+        "## Actuation plane (`tpumon/actuate`, aggregator `/metrics` + External Metrics API)",
+        "",
+        "The closed-loop tier: per-slice serving rollups, the",
+        "placement-hint engine (headroom scores with band hysteresis,",
+        "served as annotation patches on `GET /hints`), and the",
+        "Kubernetes External Metrics API",
+        "(`/apis/external.metrics.k8s.io/v1beta1/...`) answered straight",
+        "from the collect cycle's read model — an HPA query touches no",
+        "raw per-node series. Stale rollups are served with",
+        "`metricLabels[\"tpumon_stale\"]=\"true\"` and the producing",
+        "cycle's timestamp, never re-stamped as current. Enabled by",
+        "default; `TPUMON_FLEET_ACTUATE=0` disables (see",
+        "docs/OPERATIONS.md for the HPA wiring runbook).",
+        "",
+        "| family | type | description | labels |",
+        "|---|---|---|---|",
+    ]
+    for name, (kind, desc, labels) in ACTUATE_FAMILIES.items():
+        label_s = ", ".join(f"`{l}`" for l in labels) or "—"
+        lines.append(f"| `{name}` | {kind} | {desc} | {label_s} |")
+
+    lines += [
+        "",
         "## Workload-side counters (harness `--metrics-port`)",
         "",
         "| family | description |",
         "|---|---|",
     ]
     for name, desc in WORKLOAD:
+        lines.append(f"| `{name}` | {desc} |")
+
+    lines += [
+        "",
+        "## Inference serving telemetry (harness `--serve`, `tpu_serve_*`)",
+        "",
+        "Exported by the workload harness's serving preset",
+        "(`tpumon/workload/serve.py`; `--serve --serve-slo-ms <ms>`",
+        "alongside `--metrics-port`) and lifted by the exporter's",
+        "lifecycle plane into `tpu_lifecycle_serve_*`, from which the",
+        "fleet tier rolls up `tpu_fleet_serve_*` per slice — the full",
+        "path an HPA scale signal travels. Families are absent until",
+        "the first stats window completes (absent ≠ zero).",
+        "",
+        "| family | description |",
+        "|---|---|",
+    ]
+    for name, desc in SERVE_FAMILIES.items():
         lines.append(f"| `{name}` | {desc} |")
 
     lines += [

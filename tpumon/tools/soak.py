@@ -2747,6 +2747,375 @@ def fleet_chaos_soak(
     return record
 
 
+def serve_burst_soak(
+    duration_s: float,
+    nodes: int = 12,
+    scale_out: int = 4,
+    topology: str = "v4-8",
+    interval: float = 0.5,
+    scrape_every_s: float = 0.5,
+    queue_threshold: float | None = None,
+) -> dict:
+    """Inference serving drill (ISSUE 16 acceptance evidence): the
+    actuation loop end-to-end against a simulated serving fleet.
+
+    ``nodes`` fleetsim exporters publish ``tpu_lifecycle_serve_*`` at a
+    calm baseline behind one actuate-enabled aggregator; ``scale_out``
+    extra nodes start partitioned — capacity that has not scaled up
+    yet. The script then:
+
+    - **burst**: every node's serving profile spikes (queue depth 16×,
+      TTFT past the SLO) → the HPA-shaped External Metrics query
+      (``/apis/external.metrics.k8s.io/v1beta1/.../
+      tpumon_serve_queue_depth?labelSelector=pool=...``) must cross
+      ``queue_threshold`` within ~one rollup interval of the spike
+      reaching a node page (latency recorded in intervals);
+    - **scale-out**: the partitioned nodes heal — new capacity joining
+      mid-burst. Through the join, NO scrape may show a fleet straggler
+      verdict (the mass-return must not be misread as laggards) and
+      the honesty invariant holds (missing hosts always flagged);
+    - **cooldown**: the profile relaxes → the metric must fall back
+      under the threshold (the scale signal clears, hysteresis keeps
+      hint bands from flapping — transition count recorded).
+
+    Every External Metrics answer comes off the aggregator's published
+    rollup read model; the page scan additionally proves no per-node
+    ``tpu_serve_*`` series re-exports through the tier.
+    """
+    import urllib.parse
+
+    from tpumon.fleet.config import FleetConfig
+    from tpumon.fleet.server import build_aggregator
+
+    if duration_s <= 0:
+        raise ValueError(f"duration must be > 0 seconds, got {duration_s}")
+    if duration_s < 40 * interval:
+        raise ValueError(
+            f"--duration {duration_s:g} is too short for the serve-burst "
+            f"script at --interval {interval:g} (need > 40*interval: the "
+            "burst/scale-out/cooldown windows each span several collect "
+            "cycles)"
+        )
+    scale_out = max(0, scale_out)
+    total_nodes = nodes + scale_out
+    if queue_threshold is None:
+        # Between baseline (1/node) and burst (16/node) pool sums, in
+        # units of the SERVING node count.
+        queue_threshold = 4.0 * nodes
+
+    sim_proc = None
+    aggregator = None
+    conn = None
+    lat_ms: list[float] = []
+    failed_scrapes = 0
+    honesty_violations = 0
+    false_straggler_scrapes = 0
+    serve_leaks = 0
+    em_queries = 0
+    em_ok = 0
+    record: dict = {
+        "mode": "serve-burst",
+        "nodes": nodes,
+        "scale_out": scale_out,
+        "topology": topology,
+        "interval_s": interval,
+        "queue_threshold": queue_threshold,
+    }
+    sim_log: list[str] = []
+    prev_switch = sys.getswitchinterval()
+
+    def sim_cmd(command: str, expect_lines: int) -> None:
+        sim_proc.stdin.write(command + "\n")
+        sim_proc.stdin.flush()
+        for _ in range(expect_lines):
+            line = sim_proc.stdout.readline()  # deadline: fleetsim acks every command immediately or died (outer CI timeout bounds the run)
+            if not line:
+                sim_log.append(f"{command}: sim died mid-ack")
+                return
+            sim_log.append(line.strip())
+
+    def get(path: str) -> bytes | None:
+        nonlocal failed_scrapes, conn
+        start = time.perf_counter()
+        try:
+            conn.request("GET", path)
+            body = conn.getresponse().read()
+        except (OSError, http.client.HTTPException):
+            failed_scrapes += 1
+            conn.close()
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", aggregator.server.port, timeout=10
+            )
+            return None
+        lat_ms.append((time.perf_counter() - start) * 1e3)
+        return body
+
+    def _quantity(raw: str) -> float:
+        return (
+            float(raw[:-1]) / 1e3 if raw.endswith("m") else float(raw)
+        )
+
+    def _json_or_none(body: bytes | None):
+        # A shed answer (guard 503) is plain text, not JSON — skip it.
+        if body is None:
+            return None
+        try:
+            return json.loads(body)
+        except ValueError:
+            return None
+
+    def hpa_value(metric: str, selector: str) -> float | None:
+        """One HPA-shaped External Metrics query: the summed value over
+        the matching items (what an HPA's Value target consumes)."""
+        nonlocal em_queries, em_ok
+        em_queries += 1
+        body = get(
+            "/apis/external.metrics.k8s.io/v1beta1/namespaces/default/"
+            f"{metric}?labelSelector={urllib.parse.quote(selector)}"
+        )
+        if body is None:
+            return None
+        try:
+            doc = json.loads(body)
+        except ValueError:
+            return None
+        items = doc.get("items") or []
+        if not items:
+            return None
+        em_ok += 1
+        return sum(_quantity(item["value"]) for item in items)
+
+    def observe() -> None:
+        """Honesty + false-straggler + leak scan off one /metrics page."""
+        nonlocal honesty_violations, false_straggler_scrapes, serve_leaks
+        body = get("/metrics")
+        if body is None:
+            return
+        stats = _page_stats(body)
+        if (
+            stats["up"] is not None
+            and stats["targets"] is not None
+            and stats["up"] < stats["targets"]
+            and stats["stale_flag"] == 0.0
+            and (stats["visibility"] is None or stats["visibility"] >= 1.0)
+        ):
+            honesty_violations += 1
+        if any(
+            float(v) > 0
+            for v in re.findall(
+                rb"^tpu_fleet_stragglers\{[^}]*\} (\S+)", body, re.M
+            )
+        ):
+            false_straggler_scrapes += 1
+        if re.search(rb"^tpu_serve_", body, re.M):
+            serve_leaks += 1
+
+    try:
+        if not os.environ.get("TPUMON_KEEP_SWITCH_INTERVAL"):
+            sys.setswitchinterval(min(prev_switch, 0.0005))
+        sim_proc, urls = _spawn_fleetsim(total_nodes, topology, interval)
+        # The to-be-scaled-out capacity starts dark: partition the first
+        # scale_out nodes before the aggregator ever reaches them.
+        if scale_out:
+            sim_cmd(f"partition {scale_out}", scale_out)
+        sim_cmd("serve 8 1 120 1.0", 1)  # calm baseline profile
+        aggregator = build_aggregator(
+            FleetConfig(
+                port=0, addr="127.0.0.1", targets=",".join(urls),
+                interval=interval,
+                stale_s=max(2.0, 3.0 * interval),
+                evict_s=max(duration_s * 2, 120.0),
+                poll_backoff_max_s=2.0,  # mass return inside the drill
+                history_window=0.0,
+            )
+        )
+        aggregator.start()
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", aggregator.server.port, timeout=10
+        )
+
+        # Warm-up gate: every SERVING node reporting (the partitioned
+        # scale-out capacity stays dark by design).
+        warm_t0 = time.time()
+        warm_deadline = warm_t0 + max(60.0, 2.0 * total_nodes)
+        pool = None
+        while time.time() < warm_deadline:
+            doc = _json_or_none(get("/fleet"))
+            if doc is not None:
+                if doc.get("fleet", {}).get("hosts", {}).get("up", 0) >= nodes:
+                    # The serving pool: the identity-bearing pool row
+                    # with the most live hosts ("unknown" is the
+                    # placeholder pool of the still-dark capacity).
+                    rows = [
+                        row for row in doc.get("pools") or []
+                        if isinstance(row, dict)
+                        and row.get("pool") not in (None, "", "unknown")
+                        and row.get("hosts", {}).get("up", 0) > 0
+                    ]
+                    if rows:
+                        pool = max(
+                            rows,
+                            key=lambda r: r["hosts"].get("up", 0),
+                        )["pool"]
+                        break
+            time.sleep(0.25)
+        record["warmup_s"] = round(time.time() - warm_t0, 1)
+        record["pool"] = pool
+        selector = f"pool={pool}" if pool else ""
+        metric = "tpumon_serve_queue_depth"
+
+        # Discovery: the APIService registration paths an HPA's
+        # metrics client walks before its first query.
+        disco = get("/apis/external.metrics.k8s.io/v1beta1")
+        record["discovery_ok"] = bool(
+            disco and b"ExternalMetricValueList" in disco
+        )
+
+        t0 = time.time()
+        script = {
+            "burst_at": 0.25 * duration_s,
+            "scale_out_at": 0.50 * duration_s,
+            "cooldown_at": 0.75 * duration_s,
+        }
+        record["script"] = {k: round(v, 1) for k, v in script.items()}
+        done: set[str] = set()
+        signal: dict = {"fired": False, "latency_s": None,
+                        "intervals": None, "value": None}
+        clear: dict = {"cleared": False, "latency_s": None}
+        scale_event: dict = {"healed": scale_out, "completed_s": None,
+                             "up_after": None}
+        heal_t = None
+        next_at = t0
+
+        def rapid_poll(crossed) -> tuple[float, float] | None:
+            """Poll the HPA query sub-interval until ``crossed(value)``;
+            (latency_s, value) or None on timeout."""
+            poll_t0 = time.time()
+            deadline = poll_t0 + max(10.0, 10 * interval)
+            while time.time() < deadline:
+                value = hpa_value(metric, selector)
+                if value is not None and crossed(value):
+                    return time.time() - poll_t0, value
+                time.sleep(max(0.05, interval / 5.0))
+            return None
+
+        while time.time() - t0 < duration_s:
+            t = time.time() - t0
+            if t >= script["burst_at"] and "burst" not in done:
+                done.add("burst")
+                sim_cmd("serve 80 16 900 0.55", 1)
+                # The spike exists once a node page carries it: one sim
+                # tick. Signal latency is measured from there — the
+                # rollup path (fetch → parse → actuate cycle → adapter)
+                # is what the one-interval acceptance bounds.
+                time.sleep(interval)
+                hit = rapid_poll(lambda v: v > queue_threshold)
+                if hit is not None:
+                    signal = {
+                        "fired": True,
+                        "latency_s": round(hit[0], 3),
+                        "intervals": round(hit[0] / interval, 2),
+                        "value": round(hit[1], 1),
+                    }
+            if t >= script["scale_out_at"] and "scale_out" not in done:
+                done.add("scale_out")
+                sim_cmd("heal", 1)
+                heal_t = time.time()
+            if t >= script["cooldown_at"] and "cooldown" not in done:
+                done.add("cooldown")
+                sim_cmd("serve 8 2 150 1.0", 1)
+                time.sleep(interval)
+                hit = rapid_poll(lambda v: v <= queue_threshold)
+                if hit is not None:
+                    clear = {
+                        "cleared": True,
+                        "latency_s": round(hit[0], 3),
+                    }
+            if heal_t is not None and scale_event["completed_s"] is None:
+                doc = _json_or_none(get("/fleet"))
+                if doc is not None:
+                    up = doc.get("fleet", {}).get("hosts", {}).get("up", 0)
+                    if up >= total_nodes:
+                        scale_event["completed_s"] = round(
+                            time.time() - heal_t, 2
+                        )
+                        scale_event["up_after"] = up
+            observe()
+            hpa_value(metric, selector)  # the HPA's steady poll
+            next_at += scrape_every_s
+            time.sleep(max(0.0, next_at - time.time()))
+
+        # Final harvest: hint hysteresis + adapter funnel telemetry.
+        body = get("/metrics")
+        transitions = 0.0
+        em_by_result: dict[str, float] = {}
+        if body is not None:
+            transitions = sum(
+                float(v)
+                for v in re.findall(
+                    rb"^tpu_fleet_hint_transitions_total\{[^}]*\} (\S+)",
+                    body, re.M,
+                )
+            )
+            for m_label, result, value in re.findall(
+                rb'^tpu_fleet_external_metrics_requests_total\{'
+                rb'metric="([^"]*)",result="([^"]*)"\} (\S+)',
+                body, re.M,
+            ):
+                key = f"{m_label.decode()}:{result.decode()}"
+                em_by_result[key] = float(value)
+        hints_doc = _json_or_none(get("/hints")) or {}
+        bands: dict[str, int] = {}
+        for row in hints_doc.get("slices", []):
+            bands[row.get("band") or "none"] = (
+                bands.get(row.get("band") or "none", 0) + 1
+            )
+    finally:
+        if conn is not None:
+            conn.close()
+        if aggregator is not None:
+            aggregator.close()
+        if sim_proc is not None:
+            sim_proc.terminate()
+            try:
+                sim_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                sim_proc.kill()
+        sys.setswitchinterval(prev_switch)
+
+    lat_ms.sort()
+
+    def _q(p: float):
+        return round(quantile(lat_ms, p), 3) if lat_ms else None
+
+    record.update(
+        {
+            "duration_s": round(duration_s, 1),
+            "requests": len(lat_ms),
+            "failed_requests": failed_scrapes,
+            "p50_ms": _q(0.5),
+            "p99_ms": _q(0.99),
+            "scale_signal": signal,
+            "signal_clear": clear,
+            "scale_out_event": scale_event,
+            "false_straggler_scrapes": false_straggler_scrapes,
+            "honesty_violations": honesty_violations,
+            "per_node_serve_leaks": serve_leaks,
+            "external_metrics": {
+                "queries": em_queries,
+                "answered": em_ok,
+                "by_result": em_by_result,
+            },
+            "hints": {
+                "transitions_total": transitions,
+                "bands": bands,
+            },
+            "sim_log": sim_log,
+        }
+    )
+    return record
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="tpumon-soak")
     parser.add_argument("--duration", type=float, default=2700.0,
@@ -2860,6 +3229,21 @@ def main(argv=None) -> int:
                         "skipped, and the delta-off baseline over a "
                         "live subset (snapshot bytes/node is "
                         "size-independent)")
+    parser.add_argument("--serve-burst", action="store_true",
+                        help="inference serving drill (ISSUE 16): a "
+                        "fleetsim fleet publishing serving telemetry "
+                        "behind an actuate-enabled aggregator — traffic "
+                        "spike, HPA-shaped External Metrics query "
+                        "crossing its threshold within ~one rollup "
+                        "interval, scale-out (partitioned capacity "
+                        "healing) with zero false stragglers and zero "
+                        "honesty violations, cooldown clearing the "
+                        "signal; reports signal latency, hint "
+                        "hysteresis transitions, and per-node serve-"
+                        "series leak scans")
+    parser.add_argument("--serve-scale-out", type=int, default=4,
+                        help="extra capacity nodes that join mid-burst "
+                        "for --serve-burst")
     parser.add_argument("--fleet-churn", type=float, default=0.02,
                         help="steady-state content churn fraction for "
                         "--fleet-delta's idle phases")
@@ -2926,6 +3310,12 @@ def main(argv=None) -> int:
             churn=args.fleet_churn, churn_high=args.fleet_churn_high,
             kill=args.fleet_kill, node_interval=args.fleet_node_interval,
             controls=False, check_leaks=True, mode="fleet-scale",
+        )
+    elif args.serve_burst:
+        record = serve_burst_soak(
+            args.duration, nodes=args.fleet_nodes,
+            scale_out=args.serve_scale_out, topology=args.topology,
+            interval=args.interval, scrape_every_s=args.scrape_every,
         )
     elif args.fleet_chaos:
         record = fleet_chaos_soak(

@@ -279,6 +279,28 @@ def _make_phase_probe(cfg, optimizer, attn_impl, shard_acts, shard_experts,
     return probe
 
 
+def _record_serve_window(serve, batch: int, n_steps: int, window_s: float) -> None:
+    """One serving window from the traffic generator's shape: every
+    sequence in the batch is one request per step, and the window's
+    per-step wall time stands in for TTFT (queue wait + one decode
+    step). SLO attainment is all-or-nothing per window — the steps in a
+    window share one measured latency — which is exactly the granularity
+    the scale signal consumes (windowed ratios, not per-request tails)."""
+    step_s = window_s / max(n_steps, 1)
+    requests = n_steps * batch
+    thr = serve.snapshot()["slo_threshold_seconds"]
+    serve.set_queue_depth(batch)
+    serve.record_window(
+        requests=requests,
+        seconds=window_s,
+        batch_mean=float(batch),
+        ttft_worst_s=step_s,
+        slo_met=(
+            None if thr is None else (requests if step_s <= thr else 0)
+        ),
+    )
+
+
 def run(
     cfg,
     *,
@@ -307,6 +329,7 @@ def run(
     stats_every: int = 20,
     phase_stats: bool = False,
     collective_us=None,
+    serve=None,
 ) -> RunResult:
     """Build, shard, and run the train step; returns losses + throughput.
 
@@ -356,6 +379,13 @@ def run(
     families the lifecycle plane consumes. ``collective_us`` (a callable
     returning the HLO logger's cumulative collective-latency µs, or
     None) turns on the per-window collective-wait fraction.
+
+    ``serve`` (a workload.serve.ServeStats) reinterprets the loop as an
+    inference-shaped traffic generator: each sequence in the batch is
+    one request per step, the window's per-step wall time is the TTFT
+    proxy (queue wait + one decode step), and SLO attainment is whether
+    the proxy met the configured threshold — the ``tpu_serve_*``
+    families the actuation tier scales on.
     """
     is_moe = isinstance(cfg, MoeConfig)
     if ep > 1 and not is_moe:
@@ -541,6 +571,11 @@ def run(
             )
         state[:] = [cur]  # window_s <= 0 seeds the µs baseline only
 
+    if serve is not None and checkpoint_dir is not None:
+        # The checkpointed loop records per step by design; the serving
+        # window shape below assumes the windowed loop.
+        raise ValueError("serve telemetry composes with the windowed "
+                         "loop, not --checkpoint-dir")
     if checkpoint_dir is not None:
         return _run_checkpointed(
             step, params, opt_state, tokens, steps, checkpoint_dir,
@@ -568,6 +603,10 @@ def run(
                 lv = float(loss)  # one host-read sync per window
                 now = time.perf_counter()
                 stats.record(lv, i - done, now - window_t0)
+                if serve is not None:
+                    _record_serve_window(
+                        serve, batch, i - done, now - window_t0
+                    )
                 _record_window_extras(now - window_t0, wait_state)
                 if phase_probe is not None:
                     try:
@@ -962,6 +1001,22 @@ def main(argv: list[str] | None = None) -> int:
         "breakdown; needs --metrics-port",
     )
     parser.add_argument(
+        "--serve",
+        action="store_true",
+        help="inference-shaped preset: publish request-level serving "
+        "telemetry (tpu_serve_* — requests/s, queue depth, batch size, "
+        "TTFT proxy, goodput under SLO) alongside the step families; "
+        "each sequence in the batch counts as one request per step and "
+        "per-step latency is the TTFT proxy; needs --metrics-port",
+    )
+    parser.add_argument(
+        "--serve-slo-ms",
+        type=float,
+        default=500.0,
+        help="TTFT SLO threshold for --serve goodput accounting, in "
+        "milliseconds (0 disables the attainment ratio)",
+    )
+    parser.add_argument(
         "--platform",
         choices=("auto", "cpu"),
         default="auto",
@@ -995,6 +1050,12 @@ def main(argv: list[str] | None = None) -> int:
         )
     if args.num_processes > 1 and not args.coordinator:
         parser.error("--num-processes > 1 requires --coordinator")
+    if args.serve and not args.metrics_port:
+        parser.error("--serve publishes tpu_serve_* on the metrics "
+                     "port; it needs --metrics-port")
+    if args.serve and args.checkpoint_dir:
+        parser.error("--serve composes with the windowed loop, not "
+                     "--checkpoint-dir")
 
     if args.platform == "cpu":
         from tpumon.workload.platform import force_cpu_devices
@@ -1057,6 +1118,7 @@ def main(argv: list[str] | None = None) -> int:
     hooked = counters.start()
     server = None
     stats = None
+    serve_stats = None
     if args.metrics_port:
         from prometheus_client.registry import CollectorRegistry
 
@@ -1073,6 +1135,17 @@ def main(argv: list[str] | None = None) -> int:
         registry.register(CountersCollector(counters))
         stats = WorkloadStats()
         registry.register(StatsCollector(stats))
+        if args.serve:
+            from tpumon.workload.serve import ServeCollector, ServeStats
+
+            serve_stats = ServeStats()
+            serve_stats.configure(
+                slo_threshold_s=(
+                    args.serve_slo_ms / 1000.0
+                    if args.serve_slo_ms > 0 else None
+                )
+            )
+            registry.register(ServeCollector(serve_stats))
         telemetry = SelfTelemetry(registry)
         telemetry.last_poll.set(time.time())
         # No device poll loop here; the serving process is the liveness.
@@ -1120,6 +1193,7 @@ def main(argv: list[str] | None = None) -> int:
             stats_every=args.stats_every,
             phase_stats=args.phase_stats,
             collective_us=collective_us,
+            serve=serve_stats,
         )
         log.info(
             "loss %.4f → %.4f | %.2f steps/s | %.1f GFLOP/step | MFU %s | "

@@ -1,0 +1,779 @@
+"""The ledger read side (ISSUE 17): forecast math and its honesty
+gates, the waste/percentiles/what-if analytics, the composable /ledger
+query grammar (validation 400s, bucketed folds, rank), grouped
+continuation-cursor walk-to-completion vs the unbounded fold, tier
+boundary stats on both codec paths, and the External Metrics
+days-to-saturation surface."""
+
+from __future__ import annotations
+
+import json
+import math
+
+import pytest
+
+from tpumon.ledger import analytics
+from tpumon.ledger.compress import native_codec
+from tpumon.ledger.forecast import (
+    FORECAST_SIGNALS,
+    fit_trend,
+    forecast_pool,
+    forecast_signal,
+)
+from tpumon.ledger.plane import LedgerPlane
+from tpumon.ledger.store import TieredSeriesStore, TierSpec
+
+
+def _small_tiers(max_bytes: int = 1 << 20) -> tuple[TierSpec, ...]:
+    return (
+        TierSpec("1s", 1.0, 120.0, max_bytes),
+        TierSpec("10s", 10.0, 3600.0, max_bytes),
+        TierSpec("5m", 300.0, 14 * 86400.0, max_bytes),
+    )
+
+
+# -- forecast math ----------------------------------------------------------
+
+
+def _ramp(t0: float, n: int, dt: float, v0: float, rate: float,
+          noise=None) -> list:
+    pts = []
+    for i in range(n):
+        v = v0 + rate * i * dt
+        if noise is not None:
+            v += noise(i)
+        pts.append((t0 + i * dt, v))
+    return pts
+
+
+def test_fit_trend_recovers_exact_slope():
+    pts = _ramp(1000.0, 50, 10.0, 40.0, 0.05)
+    trend = fit_trend(pts)
+    assert trend["slope_per_s"] == pytest.approx(0.05, rel=1e-9)
+    assert trend["stderr_slope"] == pytest.approx(0.0, abs=1e-9)
+    assert trend["n"] == 50
+
+
+def test_fit_trend_gates_degenerate_input():
+    assert fit_trend([]) is None
+    assert fit_trend([(0.0, 1.0), (1.0, 2.0)]) is None  # < 3 points
+    assert fit_trend([(5.0, 1.0)] * 4) is None  # zero span
+
+
+def test_forecast_signal_insufficient_history_never_a_date():
+    # A PERFECT adverse trend, but too little history: the gate wins
+    # and no days field may exist — sparse data earns no date.
+    pts = _ramp(0.0, 20, 1.0, 90.0, 1.0)
+    doc = forecast_signal(
+        pts, target=95.0, direction=1, now_s=20.0,
+        min_history_s=3600.0,
+    )
+    assert doc["status"] == "insufficient_history"
+    assert "days_to_saturation" not in doc
+    # Same points, gate satisfied: a date appears.
+    ok = forecast_signal(
+        pts, target=95.0, direction=1, now_s=20.0, min_history_s=10.0,
+    )
+    assert ok["status"] in ("ok", "saturated")
+
+
+def test_forecast_signal_ok_date_and_band():
+    # duty 50% rising 0.1 pct/s: from the window end (t=1000, duty
+    # 150... pick rate so current < target). 50 + 0.02*1000 = 70 at
+    # end; (95-70)/0.02 = 1250 s to saturation.
+    pts = _ramp(0.0, 101, 10.0, 50.0, 0.02)
+    doc = forecast_signal(
+        pts, target=95.0, direction=1, now_s=1000.0, min_history_s=100.0,
+    )
+    assert doc["status"] == "ok"
+    expected_days = 1250.0 / 86400.0
+    assert doc["days_to_saturation"] == pytest.approx(
+        expected_days, rel=1e-3
+    )
+    # A noiseless fit has a zero-width band.
+    assert doc["days_lo"] == pytest.approx(expected_days, rel=1e-3)
+    assert doc["days_hi"] == pytest.approx(expected_days, rel=1e-3)
+
+
+def test_forecast_signal_band_widens_with_noise():
+    noise = lambda i: 1.5 * math.sin(i * 1.7)  # noqa: E731
+    pts = _ramp(0.0, 101, 10.0, 50.0, 0.02, noise=noise)
+    doc = forecast_signal(
+        pts, target=95.0, direction=1, now_s=1000.0, min_history_s=100.0,
+    )
+    assert doc["status"] == "ok"
+    assert doc["days_lo"] < doc["days_to_saturation"]
+    assert doc["days_hi"] is None or doc["days_hi"] > doc[
+        "days_to_saturation"]
+
+
+def test_forecast_signal_stable_flat_and_receding():
+    flat = _ramp(0.0, 50, 10.0, 60.0, 0.0)
+    doc = forecast_signal(
+        flat, target=95.0, direction=1, now_s=500.0, min_history_s=10.0,
+    )
+    assert doc["status"] == "stable"
+    assert "days_to_saturation" not in doc
+    receding = _ramp(0.0, 50, 10.0, 60.0, -0.05)
+    doc = forecast_signal(
+        receding, target=95.0, direction=1, now_s=500.0,
+        min_history_s=10.0,
+    )
+    assert doc["status"] == "stable"
+
+
+def test_forecast_signal_saturated_is_day_zero():
+    pts = _ramp(0.0, 50, 10.0, 96.0, 0.01)
+    doc = forecast_signal(
+        pts, target=95.0, direction=1, now_s=500.0, min_history_s=10.0,
+    )
+    assert doc["status"] == "saturated"
+    assert doc["days_to_saturation"] == 0.0
+
+
+def test_forecast_headroom_direction_downward():
+    # HBM headroom FALLING toward the 0.05 floor: direction -1.
+    pts = _ramp(0.0, 101, 10.0, 0.5, -0.0001)
+    doc = forecast_signal(
+        pts, target=0.05, direction=-1, now_s=1000.0, min_history_s=100.0,
+    )
+    assert doc["status"] == "ok"
+    # current = 0.5 - 0.0001*1000 = 0.4; (0.4-0.05)/0.0001 = 3500 s.
+    assert doc["days_to_saturation"] == pytest.approx(
+        3500.0 / 86400.0, rel=1e-3
+    )
+
+
+def test_forecast_pool_minimum_across_signals():
+    duty = _ramp(0.0, 101, 10.0, 50.0, 0.02)      # crosses in 1250 s
+    headroom = _ramp(0.0, 101, 10.0, 0.3, -0.001)  # crossed already
+    pool = forecast_pool(
+        {
+            "tpu_fleet_duty_cycle_percent": duty,
+            "tpu_fleet_hbm_headroom_ratio": headroom,
+        },
+        now_s=1000.0, min_history_s=100.0,
+    )
+    assert pool["status"] == "ok"
+    assert pool["leading_signal"] == "tpu_fleet_hbm_headroom_ratio"
+    assert pool["days_to_saturation"] == 0.0  # headroom already gone
+    assert set(pool["signals"]) == set(FORECAST_SIGNALS)
+
+
+def test_forecast_pool_gated_when_any_usable_signal_missing_history():
+    pool = forecast_pool(
+        {"tpu_fleet_duty_cycle_percent": _ramp(0.0, 4, 1.0, 50.0, 1.0)},
+        now_s=10.0, min_history_s=3600.0,
+    )
+    assert pool["status"] == "insufficient_history"
+    assert pool.get("days_to_saturation") is None
+
+
+# -- analytics pure functions -----------------------------------------------
+
+
+def test_percentile_linear_interpolation():
+    values = [1.0, 2.0, 3.0, 4.0]
+    assert analytics.percentile(values, 50.0) == pytest.approx(2.5)
+    assert analytics.percentile(values, 100.0) == pytest.approx(4.0)
+    assert analytics.percentile(values, 0.0) == pytest.approx(1.0)
+    assert analytics.percentile([7.5], 90.0) == 7.5
+
+
+def test_parse_rank_vocabulary():
+    assert analytics.parse_rank("topk:10") == 10
+    assert analytics.parse_rank("topk:1") == 1
+    assert analytics.parse_rank("topk:1000") == 1000
+    for bad in ("topk:0", "topk:1001", "topk:x", "top:5", "10"):
+        assert analytics.parse_rank(bad) is None
+
+
+def test_parse_whatif_vocabulary():
+    assert analytics.parse_whatif("dollars_per_kwh:0.12") == 0.12
+    for bad in ("dollars_per_kwh:0", "dollars_per_kwh:-1",
+                "dollars_per_kwh:nan", "dollars_per_kwh:inf",
+                "dollars_per_kwh:x", "kwh:0.1"):
+        assert analytics.parse_whatif(bad) is None
+
+
+def test_rebucket_spans_counts_and_percentiles():
+    # Two 1h buckets: 4 points in the first, 2 in the second.
+    pts = [(0.0, 1.0), (900.0, 2.0), (1800.0, 3.0), (2700.0, 4.0),
+           (3600.0, 10.0), (4500.0, 20.0)]
+    mean = analytics.rebucket(pts, 3600.0, "mean")
+    assert mean == [(0.0, 2.5, 4), (3600.0, 15.0, 2)]
+    p90 = analytics.rebucket(pts, 3600.0, "p90")
+    assert p90[0][2] == 4
+    assert p90[0][1] == pytest.approx(
+        analytics.percentile([1.0, 2.0, 3.0, 4.0], 90.0)
+    )
+
+
+def _goodput_row(pool, slc, *, contended=0.0, idle=0.0, productive=0.0,
+                 unaccounted=0.0, joules=None, wclass="train"):
+    buckets = dict.fromkeys(
+        ("productive", "checkpoint", "restore", "preempted", "idle",
+         "contended", "unaccounted"), 0.0)
+    buckets.update(contended=contended, idle=idle,
+                   productive=productive, unaccounted=unaccounted)
+    row = {
+        "pool": pool, "slice": slc, "wclass": wclass,
+        "chip_seconds": sum(buckets.values()), "buckets": buckets,
+    }
+    if joules is not None:
+        row["energy_joules"] = joules
+    return row
+
+
+def test_waste_doc_conservation_exact_and_honesty():
+    rows = [
+        _goodput_row("v5p", "a", contended=100.0, productive=900.0),
+        _goodput_row("v5p", "b", idle=300.0, productive=100.0),
+        # Unaccounted is blindness, NOT waste: this job must rank last.
+        _goodput_row("v5p", "c", unaccounted=5000.0),
+    ]
+    doc = analytics.waste_doc(rows, "job", 10)
+    assert [r["key"] for r in doc["rows"]] == ["v5p/b", "v5p/a", "v5p/c"]
+    assert doc["rows"][0]["wasted_chip_seconds"] == 300.0
+    assert doc["rows"][2]["wasted_chip_seconds"] == 0.0
+    cons = doc["conservation"]
+    # Exact: same floats, reassociated — not approximately equal.
+    assert cons["sum_groups_chip_seconds"] == cons["total_chip_seconds"]
+    assert cons["total_chip_seconds"] == sum(
+        r["chip_seconds"] for r in rows
+    )
+
+
+def test_waste_doc_topk_bounds_page_not_conservation():
+    rows = [
+        _goodput_row("v5p", f"j{i}", idle=float(10 + i), productive=5.0)
+        for i in range(7)
+    ]
+    doc = analytics.waste_doc(rows, "job", 3)
+    assert len(doc["rows"]) == 3
+    assert doc["groups_total"] == 7
+    # The conservation block covers EVERY group, not just the page.
+    assert doc["conservation"]["sum_groups_chip_seconds"] == sum(
+        r["chip_seconds"] for r in rows
+    )
+
+
+def test_waste_doc_whatif_absent_not_zero():
+    rows = [
+        _goodput_row("v5p", "a", idle=100.0, joules=3.6e6),  # 1 kWh
+        _goodput_row("v5p", "b", idle=50.0),  # no energy join
+    ]
+    doc = analytics.waste_doc(rows, "job", 10, price=0.25)
+    by_key = {r["key"]: r for r in doc["rows"]}
+    assert by_key["v5p/a"]["whatif_dollars"] == pytest.approx(0.25)
+    assert "whatif_dollars" not in by_key["v5p/b"]
+    assert doc["whatif"] == {"dollars_per_kwh": 0.25}
+    # Without a price, no whatif surface at all.
+    plain = analytics.waste_doc(rows, "job", 10)
+    assert "whatif" not in plain
+    assert all("whatif_dollars" not in r for r in plain["rows"])
+
+
+def test_percentiles_doc_class_cohorts_and_rank():
+    rows = [
+        _goodput_row("v5p", "t1", idle=10.0, productive=90.0),
+        _goodput_row("v5p", "t2", idle=30.0, productive=70.0),
+        _goodput_row("v5p", "t3", idle=50.0, productive=50.0),
+        _goodput_row("v5p", "s1", idle=40.0, productive=60.0,
+                     wclass="serve"),
+        _goodput_row("v5p", "zero"),  # zero chip-seconds: excluded
+    ]
+    doc = analytics.percentiles_doc(rows, ["p50", "p90", "p99"])
+    assert set(doc["classes"]) == {"v5p/train", "v5p/serve"}
+    assert doc["classes"]["v5p/train"]["jobs"] == 3
+    assert doc["classes"]["v5p/train"]["p50"] == pytest.approx(0.3)
+    # A serve job is only compared against its own class: alone, p100.
+    serve = [j for j in doc["jobs"] if j["slice"] == "s1"][0]
+    assert serve["class"] == "v5p/serve"
+    assert serve["pct_rank"] == 100.0
+    worst_train = [j for j in doc["jobs"] if j["slice"] == "t3"][0]
+    assert worst_train["pct_rank"] == 100.0
+    best_train = [j for j in doc["jobs"] if j["slice"] == "t1"][0]
+    assert best_train["pct_rank"] == pytest.approx(100.0 / 3.0)
+    assert not any(j["slice"] == "zero" for j in doc["jobs"])
+
+
+def test_whatif_rows_pass_through_without_joules():
+    rows = [
+        _goodput_row("v5p", "a", idle=1.0, joules=7.2e6),
+        _goodput_row("v5p", "b", idle=1.0),
+    ]
+    out = analytics.whatif_rows(rows, 0.5)
+    assert out[0]["whatif_dollars"] == pytest.approx(1.0)
+    assert out[1] is rows[1]  # untouched, not copied-with-zero
+
+
+# -- /ledger grammar --------------------------------------------------------
+
+
+def _plane(clock) -> LedgerPlane:
+    return LedgerPlane(
+        tiers=_small_tiers(), forecast_min_history_s=10.0,
+        forecast_every_s=0.0, clock=lambda: clock["now"],
+    )
+
+
+def _q(plane: LedgerPlane, query: str) -> tuple[dict, str]:
+    body, status = plane.query_response(query)
+    return json.loads(body), status
+
+
+def test_grammar_validation_400s():
+    clock = {"now": 1_700_000_000.0}
+    plane = _plane(clock)
+    fam = "family=tpu_fleet_duty_cycle_percent&scope=slice"
+    cases = [
+        "view=nonsense",
+        "view=waste&group_by=node",
+        "view=waste&rank=topk:0",
+        "view=percentiles&stat=p75",
+        "view=goodput&whatif=dollars_per_kwh:-3",
+        f"{fam}&bucket=1h",                # bucket without agg
+        f"{fam}&rank=topk:5",              # rank without agg
+        f"{fam}&agg=mean&stat=p90",        # pct stat without bucket
+        f"{fam}&agg=mean&bucket=90m",      # unknown span
+        f"{fam}&agg=mean&bucket=1h&stat=min",  # bucket stat vocabulary
+        f"{fam}&agg=median",
+        f"{fam}&agg=mean&by=node",
+        "family=no_such_family",
+        f"{fam}&start=10&end=5",
+    ]
+    for query in cases:
+        doc, status = _q(plane, query)
+        assert status == "400 Bad Request", (query, doc)
+        assert "error" in doc, query
+    # The unknown-view 400 teaches the vocabulary.
+    doc, _ = _q(plane, "view=nonsense")
+    assert doc["views"] == ["goodput", "waste", "percentiles", "forecast"]
+
+
+def _seed_rollups(plane, clock, *, cycles=40, dt=5.0):
+    """Drive cycle() with two pools' duty rollups (v5p ramping toward
+    saturation, v4 flat) and two accounted jobs."""
+    snap_a = {
+        "identity": {"accelerator": "v5p-16", "slice": "job-a"},
+        "chips": {"0": {"duty_pct": 80.0}},
+        "step_rate": 2.0,
+    }
+    snap_b = {
+        "identity": {"accelerator": "v5p-16", "slice": "job-b"},
+        "chips": {"0": {"duty_pct": 1.0}},
+        "step_rate": 0.0,
+    }
+    for step in range(cycles):
+        clock["now"] += dt
+        duty = min(94.0, 50.0 + 1.5 * step)
+        doc = {
+            "slices": {
+                ("v5p-16", "job-a"): {"duty": {"mean": duty, "min": duty,
+                                               "max": duty, "n": 1}},
+                ("v5p-16", "job-b"): {"duty": {"mean": 5.0, "min": 5.0,
+                                               "max": 5.0, "n": 1}},
+            },
+            "pools": {
+                "v5p-16": {"duty": {"mean": duty, "min": duty,
+                                    "max": duty, "n": 2}},
+                "v4-8": {"duty": {"mean": 30.0, "min": 30.0,
+                                  "max": 30.0, "n": 1}},
+            },
+            "fleet": {},
+        }
+        plane.cycle(clock["now"], doc, [
+            ("na", snap_a, "up", step), ("nb", snap_b, "up", step),
+        ])
+
+
+def test_view_waste_and_percentiles_over_plane():
+    clock = {"now": 1_700_000_000.0}
+    plane = _plane(clock)
+    _seed_rollups(plane, clock)
+    doc, status = _q(plane, "view=waste&group_by=job&rank=topk:10")
+    assert status == "200 OK"
+    assert doc["view"] == "waste"
+    keys = [r["key"] for r in doc["rows"]]
+    assert "v5p-16/job-b" in keys  # the idle job carries the waste
+    cons = doc["conservation"]
+    assert cons["sum_groups_chip_seconds"] == cons["total_chip_seconds"]
+    doc, status = _q(plane, "view=percentiles&stat=p90")
+    assert status == "200 OK"
+    for cls in doc["classes"].values():
+        assert set(cls) == {"jobs", "p90"}  # narrowed to one quantile
+
+
+def test_view_forecast_statuses_and_index():
+    clock = {"now": 1_700_000_000.0}
+    plane = _plane(clock)
+    _seed_rollups(plane, clock)
+    doc, status = _q(plane, "view=forecast")
+    assert status == "200 OK"
+    assert doc["min_history_s"] == 10.0
+    pools = doc["pools"]
+    assert pools["v5p-16"]["status"] in ("ok", "saturated")
+    assert pools["v4-8"]["status"] == "stable"  # flat: no date
+    assert pools["v4-8"].get("days_to_saturation") is None
+    # Pool filter narrows; unknown pool answers empty, not 404.
+    doc, _ = _q(plane, "view=forecast&pool=v4-8")
+    assert list(doc["pools"]) == ["v4-8"]
+    doc, _ = _q(plane, "view=forecast&pool=nope")
+    assert doc["pools"] == {}
+    # The bare index advertises views and per-pool statuses.
+    idx, _ = _q(plane, "")
+    assert "forecast" in idx and "views" in idx
+    assert idx["forecast"]["v5p-16"] in ("ok", "saturated")
+
+
+def test_forecast_families_absent_not_zero():
+    clock = {"now": 1_700_000_000.0}
+    plane = _plane(clock)
+    _seed_rollups(plane, clock)
+    fams = {f.name: f for f in plane.families()}
+    days = fams["tpu_fleet_forecast_days_to_saturation"]
+    pools_with_dates = {s.labels["pool"] for s in days.samples}
+    assert "v5p-16" in pools_with_dates
+    assert "v4-8" not in pools_with_dates  # stable pool: NO sample
+    gated = fams["tpu_fleet_forecast_insufficient_history"]
+    by_pool = {s.labels["pool"]: s.value for s in gated.samples}
+    assert by_pool["v5p-16"] == 0.0
+    assert by_pool["v4-8"] == 0.0
+
+
+def test_bucketed_fold_emits_triples_and_rank_orders():
+    clock = {"now": 1_700_000_000.0}
+    plane = _plane(clock)
+    _seed_rollups(plane, clock)
+    t0 = 1_700_000_000.0
+    doc, status = _q(
+        plane,
+        "family=tpu_fleet_duty_cycle_percent&scope=slice&agg=mean"
+        f"&by=slice&bucket=1h&stat=p90&start={t0}&end={clock['now']}",
+    )
+    assert status == "200 OK"
+    assert doc["bucket"] == "1h"
+    for row in doc["series"]:
+        for ts, _value, n in row["points"]:
+            assert ts % 3600.0 == 0.0
+            assert n >= 1
+    doc, status = _q(
+        plane,
+        "family=tpu_fleet_duty_cycle_percent&scope=slice&agg=mean"
+        f"&by=slice&rank=topk:1&start={t0}&end={clock['now']}",
+    )
+    assert status == "200 OK"
+    assert doc["rank"] == "topk:1"
+    assert len(doc["series"]) == 1
+    assert doc["series"][0]["slice"] == "job-a"  # the hot slice wins
+
+
+# -- grouped cursors: walk-to-completion == unbounded fold ------------------
+
+
+def _walk(plane, base_query, start, end, max_points, step):
+    """Page through a grouped query via next_start cursors. ``step``
+    pins the tier across pages — without it, later pages (whose start
+    is younger) would legally resolve to a finer tier and the walk
+    would not compare like with like."""
+    groups: dict = {}
+    pages = 0
+    cursor = start
+    while pages < 500:
+        doc, status = _q(
+            plane,
+            f"{base_query}&start={cursor!r}&end={end!r}"
+            f"&max_points={max_points}&step={step!r}",
+        )
+        assert status == "200 OK", doc
+        pages += 1
+        for row in doc["series"]:
+            key = (row["pool"], row["slice"])
+            groups.setdefault(key, []).extend(
+                tuple(p) for p in row["points"]
+            )
+        if "next_start" not in doc:
+            return groups, pages
+        cursor = doc["next_start"]
+    raise AssertionError("cursor walk did not terminate")
+
+
+def _unbounded(plane, base, t0, end):
+    doc, status = _q(plane, f"{base}&start={t0!r}&end={end!r}")
+    assert status == "200 OK"
+    assert "next_start" not in doc
+    expect = {
+        (row["pool"], row["slice"]): [tuple(p) for p in row["points"]]
+        for row in doc["series"]
+    }
+    return doc, expect
+
+
+@pytest.mark.parametrize("extra,max_points", [
+    ("", 7),              # grouped fold, tiny pages
+    ("", 1),              # degenerate single-point pages
+    # A percentile re-bucket may never split a bucket across pages (a
+    # split p90 would be silently wrong): with max_points above the
+    # points-per-coarse-bucket count summed over every group, the
+    # boundary alignment keeps each page bucket-aligned and equality
+    # is exact.
+    ("&bucket=1h&stat=p90", 24),
+])
+def test_grouped_cursor_walk_equals_unbounded_fold(extra, max_points):
+    """Satellite: bounded grouped queries walked to completion must
+    equal the unbounded fold — no double-counted and no skipped edge
+    points, with and without coarse re-bucketing."""
+    clock = {"now": 1_700_000_000.0}
+    plane = _plane(clock)
+    _seed_rollups(plane, clock, cycles=120, dt=47.0)  # spans 2+ hours
+    t0 = 1_700_000_000.0
+    base = (
+        "family=tpu_fleet_duty_cycle_percent&scope=slice"
+        f"&agg=mean&by=slice{extra}"
+    )
+    unbounded, expect = _unbounded(plane, base, t0, clock["now"])
+    walked, pages = _walk(
+        plane, base, t0, clock["now"], max_points,
+        step=unbounded["resolution_s"],
+    )
+    assert pages > 1, "walk must actually paginate to prove anything"
+    assert walked == expect
+
+
+def test_bucketed_mean_walk_merges_partial_segments_exactly():
+    """When a page fits entirely inside one coarse bucket the bucket is
+    served partial WITH its point count (the documented edge error). A
+    mean client recombines those segments count-weighted and lands on
+    the unbounded fold; nothing is double-counted or dropped."""
+    clock = {"now": 1_700_000_000.0}
+    plane = _plane(clock)
+    _seed_rollups(plane, clock, cycles=120, dt=47.0)
+    t0 = 1_700_000_000.0
+    base = (
+        "family=tpu_fleet_duty_cycle_percent&scope=slice"
+        "&agg=mean&by=slice&bucket=1h&stat=mean"
+    )
+    unbounded, expect = _unbounded(plane, base, t0, clock["now"])
+    walked, pages = _walk(
+        plane, base, t0, clock["now"], 7,
+        step=unbounded["resolution_s"],
+    )
+    assert pages > 1
+    for key, triples in expect.items():
+        merged: dict = {}
+        for ts, value, n in walked[key]:
+            wsum, nsum = merged.get(ts, (0.0, 0))
+            merged[ts] = (wsum + value * n, nsum + n)
+        got = [
+            (ts, wsum / nsum, nsum)
+            for ts, (wsum, nsum) in sorted(merged.items())
+        ]
+        assert [(t, n) for t, _v, n in got] == [
+            (t, n) for t, _v, n in triples
+        ], key
+        for (_, gv, _), (_, ev, _) in zip(got, triples):
+            assert gv == pytest.approx(ev, rel=1e-9)
+
+
+def test_raw_query_cursor_resume_no_double_count():
+    """The store-level cursor fix: a float cursor round-trip must not
+    re-admit the already-served edge point (rounding, not truncation,
+    on both record and query)."""
+    store = TieredSeriesStore(_small_tiers())
+    key = ("tpu_fleet_duty_cycle_percent", "fleet", "", "")
+    t0 = 1_700_000_000.0
+    for i in range(30):
+        store.record(t0 + i * 0.999, {key: float(i)})
+    points, _ = store.query(key, 0, t0 - 1.0, t0 + 60.0)
+    collected: list = []
+    cursor = t0 - 1.0
+    for _ in range(100):
+        page, nxt = store.query(
+            key, 0, cursor, t0 + 60.0, max_points=4
+        )
+        collected.extend(page)
+        if nxt is None:
+            break
+        cursor = nxt
+    assert collected == points
+
+
+# -- tier boundaries on both codec paths ------------------------------------
+
+
+def _force_codec(native: bool, monkeypatch):
+    from tpumon._native import load_extension
+
+    if native:
+        monkeypatch.delenv("TPUMON_NO_NATIVE", raising=False)
+    else:
+        monkeypatch.setenv("TPUMON_NO_NATIVE", "1")
+    load_extension("_gorilla", force=True)
+    if native and native_codec() is None:
+        pytest.skip("no native codec built")
+
+
+@pytest.fixture
+def _restore_codec():
+    yield
+    # Re-resolve under the test-exterior environment so later tests see
+    # whatever codec the session really has.
+    from tpumon._native import load_extension
+
+    load_extension("_gorilla", force=True)
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_tier_boundary_stats_both_codecs(
+    native, monkeypatch, _restore_codec
+):
+    """Satellite: a range spanning the 1s -> 10s -> 5m tier boundaries
+    serves exact min/max at every aggregate tier and means exact on
+    interior buckets (edge buckets carry the documented partial-bucket
+    error), identically on the native and pure-Python Gorilla paths."""
+    _force_codec(native, monkeypatch)
+    store = TieredSeriesStore(_small_tiers())
+    key = ("tpu_fleet_duty_cycle_percent", "fleet", "", "")
+    t0 = 1_700_000_000.0
+    horizon = 7200  # 2 h of 1 Hz samples crosses every tier boundary
+
+    def value_at(i: int) -> float:
+        return 50.0 + 0.005 * i + 3.0 * math.sin(i / 7.0)
+
+    for i in range(horizon):
+        store.record(t0 + i, {key: value_at(i)})
+    now = t0 + horizon - 1
+
+    # Tier selection follows the window start's age.
+    assert store.pick_tier(now - 90.0, now, None) == 0
+    assert store.pick_tier(now - 600.0, now, None) == 1
+    assert store.pick_tier(t0, now, None) == 2
+    # A step hint coarser than a tier's resolution skips past it.
+    assert store.pick_tier(now - 90.0, now, 10.0) == 1
+
+    def raw_in(lo_s: float, hi_s: float) -> list:
+        return [
+            value_at(i) for i in range(horizon)
+            if lo_s <= t0 + i < hi_s
+        ]
+
+    for tier_idx, res in ((1, 10.0), (2, 300.0)):
+        for stat in ("min", "max", "mean"):
+            points, cursor = store.query(
+                key, tier_idx, t0, now, stat=stat, max_points=5000
+            )
+            assert cursor is None
+            assert points, (tier_idx, stat)
+            last_bucket = points[-1][0]
+            for ts, got in points:
+                bucket_raw = raw_in(ts, ts + res)
+                assert bucket_raw, (tier_idx, ts)
+                if stat == "min":
+                    assert got == min(bucket_raw), (tier_idx, ts)
+                elif stat == "max":
+                    assert got == max(bucket_raw), (tier_idx, ts)
+                elif ts != last_bucket:
+                    # Interior bucket means are exact (count-weighted
+                    # through the cascade); the final bucket may still
+                    # be accumulating when a coarser bucket closed
+                    # early — the documented edge error.
+                    assert got == pytest.approx(
+                        sum(bucket_raw) / len(bucket_raw), rel=1e-12
+                    ), (tier_idx, ts)
+
+
+# -- External Metrics: days_to_saturation -----------------------------------
+
+
+class _FakeActuatePlane:
+    def __init__(self, stale=False):
+        self._stale = stale
+
+    def rows(self):
+        return []
+
+    def is_stale(self, now):
+        return self._stale
+
+
+def _forecasts_fixture():
+    return (
+        {
+            "ramping": {"status": "ok", "days_to_saturation": 11.5,
+                        "days_lo": 9.0, "days_hi": 14.0,
+                        "leading_signal": "tpu_fleet_duty_cycle_percent"},
+            "gated": {"status": "insufficient_history"},
+            "flat": {"status": "stable"},
+        },
+        1_700_000_000.0,
+    )
+
+
+def _adapter(stale=False):
+    from tpumon.actuate.adapter import ExternalMetricsAdapter
+
+    return ExternalMetricsAdapter(
+        _FakeActuatePlane(stale=stale),
+        forecast_provider=_forecasts_fixture,
+    )
+
+
+def _metric_items(adapter, query="", now=1_700_000_100.0):
+    from tpumon.actuate.adapter import API_PREFIX, API_VERSION
+
+    status, body, metric, result = adapter.handle(
+        f"{API_PREFIX}/{API_VERSION}/namespaces/default/"
+        "tpumon_days_to_saturation",
+        query, now=now,
+    )
+    assert status == "200 OK"
+    return json.loads(body)["items"], result
+
+
+def test_adapter_days_to_saturation_absent_not_zero():
+    items, result = _metric_items(_adapter())
+    # Only the pool WITH a date appears: gated and stable pools are
+    # absent — not 0, not infinity.
+    assert [i["metricLabels"]["pool"] for i in items] == ["ramping"]
+    item = items[0]
+    assert item["value"] == "11500m"
+    assert item["metricLabels"]["tpumon_forecast_status"] == "ok"
+    # Timestamp is the forecast's compute time, never re-stamped.
+    assert item["timestamp"] == "2023-11-14T22:13:20Z"
+    assert "tpumon_stale" not in item["metricLabels"]
+    assert result == "ok"
+
+
+def test_adapter_days_to_saturation_staleness_and_selector():
+    items, result = _metric_items(_adapter(stale=True))
+    assert items[0]["metricLabels"]["tpumon_stale"] == "true"
+    assert result == "stale"
+    items, _ = _metric_items(
+        _adapter(), query="labelSelector=pool%3Dramping"
+    )
+    assert len(items) == 1
+    items, _ = _metric_items(
+        _adapter(), query="labelSelector=pool%3Dother"
+    )
+    assert items == []
+
+
+def test_adapter_without_provider_answers_empty():
+    from tpumon.actuate.adapter import ExternalMetricsAdapter
+
+    adapter = ExternalMetricsAdapter(_FakeActuatePlane())
+    items, result = _metric_items(adapter)
+    assert items == [] and result == "ok"
+
+
+def test_adapter_resource_list_advertises_forecast_metric():
+    from tpumon.actuate.adapter import API_PREFIX, API_VERSION
+
+    adapter = _adapter()
+    status, body, _, _ = adapter.handle(
+        f"{API_PREFIX}/{API_VERSION}", "",
+    )
+    assert status == "200 OK"
+    names = {r["name"] for r in json.loads(body)["resources"]}
+    assert "tpumon_days_to_saturation" in names

@@ -603,15 +603,20 @@ def test_remote_write_pushes_and_counts_errors(tmp_path):
 
 
 def test_ledger_families_subset_of_registry_and_docs():
-    from tpumon.families import LEDGER_FAMILIES
+    from tpumon.families import ANALYTICS_FAMILIES, LEDGER_FAMILIES
 
+    clock = {"now": 1_700_000_000.0}
     plane = LedgerPlane(tiers=_small_tiers(),
                         remote_write_url="http://example.invalid/rw",
-                        dollars_per_kwh=0.12)
+                        dollars_per_kwh=0.12,
+                        forecast_min_history_s=10.0,
+                        forecast_every_s=0.0,
+                        clock=lambda: clock["now"])
     plane.spool_errors = dict(plane.spool_errors)
-    # Exercise every optional family branch: fake a spool, and run an
-    # energy-reporting feed through two accounting cycles so the
-    # joules/dollars families emit.
+    # Exercise every optional family branch: fake a spool, run an
+    # energy-reporting feed through accounting cycles so the
+    # joules/dollars + waste families emit, and ramp a pool's duty so
+    # the forecast families emit a real date.
     class _FakeSpool:
         path = "/tmp/x"
         last_write_ts = 0.0
@@ -621,19 +626,25 @@ def test_ledger_families_subset_of_registry_and_docs():
         "chips": {"0": {"duty_pct": 80.0}},
         "energy": {"watts": 250.0, "source": "measured"},
     }
-    plane.goodput.account([("t0", snap, "up", 1)], 100.0)
-    plane.goodput.account([("t0", snap, "up", 2)], 101.0)
+    for step in range(12):
+        clock["now"] += 5.0
+        duty = 50.0 + 4.0 * step
+        doc = {"slices": {}, "pools": {"v5p-16": {
+            "duty": {"mean": duty, "min": duty, "max": duty, "n": 1},
+        }}, "fleet": {}}
+        plane.cycle(clock["now"], doc, [("t0", snap, "up", step)])
     emitted = set()
     for fam in plane.families():
         name = fam.name
         if fam.type == "counter":
             name += "_total"
         emitted.add(name)
-    assert emitted <= set(LEDGER_FAMILIES), emitted - set(LEDGER_FAMILIES)
-    assert emitted == set(LEDGER_FAMILIES)
+    registered = set(LEDGER_FAMILIES) | set(ANALYTICS_FAMILIES)
+    assert emitted <= registered, emitted - registered
+    assert emitted == registered
     with open("docs/METRICS.md", encoding="utf-8") as fh:
         doc = fh.read()
-    for family in LEDGER_FAMILIES:
+    for family in registered:
         assert family in doc, f"{family} missing from docs/METRICS.md"
 
 

@@ -74,6 +74,19 @@ EXTERNAL_METRICS: dict = {
         "placement target)",
         lambda row: row.get("score"),
     ),
+    # Pool-scope metric: answered from the ledger's capacity forecast
+    # (tpumon/ledger/forecast.py) via the adapter's forecast provider,
+    # not from per-slice rows — the extractor slot is None and _items
+    # branches. Pools below the minimum-history gate (or with no
+    # saturating trend) contribute NO item: an HPA must never scale on
+    # a fabricated date (absent-not-zero, pool scope).
+    "tpumon_days_to_saturation": (
+        "Days until the pool saturates (duty rising to 95% or HBM "
+        "headroom falling to 5%) per the ledger's linear-trend "
+        "capacity forecast; absent for pools whose history or trend "
+        "cannot support a date",
+        None,
+    ),
 }
 
 _SET_RE = re.compile(
@@ -169,8 +182,12 @@ class ExternalMetricsAdapter:
     lock-published read model.
     """
 
-    def __init__(self, plane) -> None:
+    def __init__(self, plane, forecast_provider=None) -> None:
         self._plane = plane
+        #: Optional () -> (pool -> forecast doc, computed_at_ts) from
+        #: the ledger plane; None (no ledger) keeps the pool-scope
+        #: forecast metric answering an empty item list.
+        self._forecast_provider = forecast_provider
 
     def handle(
         self, path: str, query_string: str, now: float | None = None
@@ -229,6 +246,8 @@ class ExternalMetricsAdapter:
         now: float,
     ) -> tuple[list[dict], bool]:
         _, extract = EXTERNAL_METRICS[metric]
+        if extract is None:
+            return self._forecast_items(metric, requirements, now)
         items: list[dict] = []
         any_stale = False
         for row in self._plane.rows():
@@ -261,6 +280,46 @@ class ExternalMetricsAdapter:
                     "metricLabels": metric_labels,
                     "timestamp": rfc3339(row["ts"]),
                     "value": quantity(value),
+                }
+            )
+        return items, any_stale
+
+    def _forecast_items(
+        self,
+        metric: str,
+        requirements: list[tuple[str, str, set[str]]],
+        now: float,
+    ) -> tuple[list[dict], bool]:
+        """Pool-scope items off the ledger's forecast snapshot. One
+        item per pool WITH a supported date; gated / trendless pools
+        are absent, and the timestamp is the forecast's compute time —
+        never re-stamped as current."""
+        if self._forecast_provider is None:
+            return [], False
+        forecasts, computed_at = self._forecast_provider()
+        items: list[dict] = []
+        any_stale = False
+        for pool, doc in sorted(forecasts.items()):
+            days = doc.get("days_to_saturation")
+            if days is None:
+                continue
+            labels = {"pool": pool}
+            if not selector_matches(requirements, labels):
+                continue
+            stale = self._plane.is_stale(now)
+            metric_labels = {
+                "pool": pool,
+                "tpumon_forecast_status": doc["status"],
+            }
+            if stale:
+                metric_labels["tpumon_stale"] = "true"
+                any_stale = True
+            items.append(
+                {
+                    "metricName": metric,
+                    "metricLabels": metric_labels,
+                    "timestamp": rfc3339(computed_at),
+                    "value": quantity(days),
                 }
             )
         return items, any_stale

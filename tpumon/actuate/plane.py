@@ -114,12 +114,18 @@ class ActuatePlane:
         hint_avoid: float = 0.25,
         hint_hold_cycles: int = 3,
         stale_after_s: float = 30.0,
+        forecast_provider=None,
     ) -> None:
         self.hint_prefer = float(hint_prefer)
         self.hint_avoid = float(hint_avoid)
         self.stale_after_s = float(stale_after_s)
         self._hysteresis = HintHysteresis(hint_hold_cycles)
-        self.adapter = ExternalMetricsAdapter(self)
+        # forecast_provider: the ledger plane's forecast_snapshot (or
+        # None without a ledger) — feeds the adapter's pool-scope
+        # tpumon_days_to_saturation metric.
+        self.adapter = ExternalMetricsAdapter(
+            self, forecast_provider=forecast_provider
+        )
         self._lock = threading.Lock()
         self._rows: list[dict] = []  # guarded-by: self._lock
         self._pool_serve: dict[str, dict] = {}  # guarded-by: self._lock

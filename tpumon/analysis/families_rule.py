@@ -51,6 +51,7 @@ _REGISTRY_DICTS = {
     "STEP_FAMILIES",
     "FLEET_FAMILIES",
     "LEDGER_FAMILIES",
+    "ANALYTICS_FAMILIES",
     "ACTUATE_FAMILIES",
     "WORKLOAD_FAMILIES",
     "SERVE_FAMILIES",

@@ -727,6 +727,54 @@ LEDGER_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
     ),
 }
 
+#: family -> (prometheus type, description, extra labels) — the
+#: ledger's analytics read side (tpumon/ledger/analytics.py +
+#: forecast.py): waste ranking and capacity forecasting surfaced as
+#: exposition beside the LEDGER_FAMILIES rows, so the
+#: capacity-planning dashboard and the TPUMonPoolSaturating /
+#: TPUMonForecastBreach alerts run off Prometheus, not off /ledger.
+ANALYTICS_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
+    "tpu_fleet_waste_chip_seconds_total": (
+        "counter",
+        "Wasted chip-seconds per job (scope=slice) and fleet-wide: "
+        "the contended + idle goodput buckets — chips held but not "
+        "advancing work. A strict subset of "
+        "tpu_fleet_goodput_chip_seconds_total, so it conserves "
+        "against the same per-job totals",
+        ("scope", "pool", "slice"),
+    ),
+    "tpu_fleet_waste_fraction_quantile": (
+        "gauge",
+        "Waste-fraction quantiles (p50/p90/p99) per workload class "
+        "(pool/serve-or-train): the cohort a job's percentile "
+        "standing in /ledger?view=percentiles is computed against",
+        ("wclass", "quantile"),
+    ),
+    "tpu_fleet_forecast_days_to_saturation": (
+        "gauge",
+        "Days until the pool saturates (duty rising to 95% or HBM "
+        "headroom falling to 5%), least-squares over the ledger's "
+        "coarse tier; ABSENT for pools whose history or trend cannot "
+        "support a date — never a fabricated one. 0 means already "
+        "saturated",
+        ("pool",),
+    ),
+    "tpu_fleet_forecast_slope_per_day": (
+        "gauge",
+        "Fitted per-day trend slope per pool and signal (signal is "
+        "the stored ledger family the fit ran over)",
+        ("pool", "signal"),
+    ),
+    "tpu_fleet_forecast_insufficient_history": (
+        "gauge",
+        "1 when the pool's history span is below "
+        "TPUMON_FLEET_LEDGER_FORECAST_MIN_HISTORY_S and no saturation "
+        "date is served, else 0 — the honesty surface capacity alerts "
+        "gate on",
+        ("pool",),
+    ),
+}
+
 #: family -> (prometheus type, description)
 SELF_FAMILIES: dict[str, tuple[str, str]] = {
     "exporter_scrape_duration_seconds": (
@@ -1073,6 +1121,7 @@ def all_family_names() -> set[str]:
         | set(SELF_FAMILIES)
         | set(FLEET_FAMILIES)
         | set(LEDGER_FAMILIES)
+        | set(ANALYTICS_FAMILIES)
         | set(ACTUATE_FAMILIES)
         | set(WORKLOAD_FAMILIES)
         | set(STEP_FAMILIES)

@@ -159,6 +159,16 @@ class FleetConfig:
     ledger_remote_write_url: str = ""
     #: Remote-write push cadence seconds.
     ledger_remote_write_every_s: float = 30.0
+    #: Minimum history span (seconds) a pool must have before the
+    #: capacity forecast (tpumon/ledger/forecast.py) serves a
+    #: days-to-saturation date; below it the pool answers
+    #: "insufficient history" — never a fabricated date. The fit
+    #: window is 8× this value, so the default (6 h) reads the
+    #: 5-minute tier once a deployment has real history.
+    ledger_forecast_min_history_s: float = 21600.0
+    #: Forecast recompute cadence seconds (per-pool least-squares over
+    #: the coarse tier — cheap, but not per-collect-cycle cheap).
+    ledger_forecast_every_s: float = 60.0
     #: Rollup-history retention window seconds (tpumon.history reuse,
     #: served at /history); 0 disables.
     history_window: float = 600.0

@@ -422,6 +422,8 @@ class FleetAggregator:
                 remote_write_every_s=cfg.ledger_remote_write_every_s,
                 remote_write_timeout=cfg.timeout,
                 dollars_per_kwh=cfg.ledger_dollars_per_kwh,
+                forecast_min_history_s=cfg.ledger_forecast_min_history_s,
+                forecast_every_s=cfg.ledger_forecast_every_s,
             )
 
         #: Actuation plane (tpumon/actuate, ISSUE 16): per-slice serving
@@ -440,6 +442,12 @@ class FleetAggregator:
                 # Values older than the staleness budget are served
                 # flagged, same clock the rollup's own stale class uses.
                 stale_after_s=max(cfg.stale_s, 3.0 * cfg.interval),
+                # Pool-scope tpumon_days_to_saturation answers off the
+                # ledger's capacity forecast; without a ledger the
+                # metric serves an empty item list (absent-not-zero).
+                forecast_provider=(
+                    self.ledger.forecast_snapshot if self.ledger else None
+                ),
             )
 
         from tpumon.exporter.server import _SelfTelemetryPage

@@ -1,0 +1,277 @@
+"""The ledger's analytics read side: waste, percentiles, what-if.
+
+Pure functions from documents the ledger already serves — goodput
+rows (:meth:`GoodputLedger.jobs_doc`) and folded point lists
+(:meth:`TieredSeriesStore.fold`) — into capacity-planner answers.
+Nothing here touches raw samples or holds a lock; the plane calls
+these under its own read path and the soak pins the invariants:
+
+* **Conservation**: waste ranking redistributes the goodput rows'
+  chip-seconds, so the sum over ALL groups equals the fleet total
+  exactly (float-identical — same additions, reassociated per group),
+  and the response carries both numbers so a client can assert it.
+* **Absent, not zero**: what-if dollars exist only for rows with
+  observed joules; a job with no energy join gets no dollars row.
+* **Bounded**: top-k is a response bound by construction; the
+  re-bucketing helpers operate on already-bounded fold pages.
+
+Grammar tokens (shared with ``GET /ledger`` parsing):
+``group_by=job|pool|slice``, ``bucket=1h|1d``, ``rank=topk:<n>``,
+``stat=p50|p90|p99`` (percentile stats; the store's ``mean|min|max``
+stay valid where they already were), and
+``whatif=dollars_per_kwh:<v>``.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "WASTE_BUCKETS",
+    "GROUP_KEYS",
+    "BUCKET_SPANS",
+    "PCT_STATS",
+    "percentile",
+    "parse_rank",
+    "parse_whatif",
+    "rebucket",
+    "rank_groups",
+    "waste_doc",
+    "percentiles_doc",
+    "whatif_rows",
+]
+
+#: Goodput buckets that count as waste: chips held but not advancing
+#: work — busy-waiting on the fabric or visibly doing nothing.
+#: Unaccounted is NOT waste (we could not see; honesty bucket),
+#: checkpoint/restore/preempted are lifecycle overhead, not waste a
+#: job owner can act on the same way.
+WASTE_BUCKETS = ("contended", "idle")
+
+#: group_by vocabulary -> key function over a goodput row.
+GROUP_KEYS = {
+    "job": lambda row: f"{row['pool']}/{row['slice']}",
+    "pool": lambda row: row["pool"],
+    "slice": lambda row: row["slice"],
+}
+
+#: bucket vocabulary -> span in seconds.
+BUCKET_SPANS = {"1h": 3600.0, "1d": 86400.0}
+
+#: Percentile stats the grammar accepts (stat=p50 etc.).
+PCT_STATS = {"p50": 50.0, "p90": 90.0, "p99": 99.0}
+
+
+def percentile(values: list, q: float) -> float:
+    """Linear-interpolated percentile over a non-empty value list
+    (the numpy 'linear' method, hand-rolled: no numpy at runtime)."""
+    ordered = sorted(values)
+    if len(ordered) == 1:
+        return ordered[0]
+    rank = (q / 100.0) * (len(ordered) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(ordered) - 1)
+    frac = rank - lo
+    return ordered[lo] * (1.0 - frac) + ordered[hi] * frac
+
+
+def parse_rank(raw: str) -> int | None:
+    """``topk:<n>`` -> n (1..1000), else None (caller 400s)."""
+    if not raw.startswith("topk:"):
+        return None
+    try:
+        n = int(raw[len("topk:"):])
+    except ValueError:
+        return None
+    return n if 1 <= n <= 1000 else None
+
+
+def parse_whatif(raw: str) -> float | None:
+    """``dollars_per_kwh:<v>`` -> v (> 0, finite), else None."""
+    if not raw.startswith("dollars_per_kwh:"):
+        return None
+    try:
+        price = float(raw[len("dollars_per_kwh:"):])
+    except ValueError:
+        return None
+    if not (price > 0.0) or price != price or price == float("inf"):
+        return None
+    return price
+
+
+def rebucket(
+    points: list, span_s: float, stat: str,
+) -> list:
+    """Coarsen folded ``(ts_s, value)`` points into ``span_s`` buckets.
+
+    ``stat`` is ``mean`` or a :data:`PCT_STATS` key, computed over the
+    points landing in each bucket (bucket start = floor(ts / span)).
+    Returns ``[(bucket_start_s, value, n), ...]`` in time order — n is
+    the contributing point count, so a consumer can see a thin edge
+    bucket for what it is instead of trusting it blindly.
+    """
+    cells: dict[float, list] = {}
+    for ts, value in points:
+        cells.setdefault(ts - ts % span_s, []).append(value)
+    out = []
+    for start in sorted(cells):
+        vals = cells[start]
+        if stat == "mean":
+            value = sum(vals) / len(vals)
+        else:
+            value = percentile(vals, PCT_STATS[stat])
+        out.append((start, value, len(vals)))
+    return out
+
+
+def rank_groups(series: dict, topk: int) -> list:
+    """Order a fold's ``{group: [(ts, v), ...]}`` by mean value
+    descending (ties broken by group key, so pages are stable) and
+    keep the top ``topk`` group keys."""
+    scored = []
+    for group, points in series.items():
+        if points:
+            scored.append(
+                (-(sum(v for _, v in points) / len(points)), group)
+            )
+    scored.sort()
+    return [group for _, group in scored[:topk]]
+
+
+def waste_doc(
+    rows: list, group_by: str, topk: int, price: float | None = None,
+) -> dict:
+    """Top-k waste ranking over goodput rows.
+
+    Waste = contended + idle chip-seconds, grouped by ``group_by`` and
+    ranked descending. The conservation block sums chip-seconds over
+    EVERY group (not just the page): by construction it equals the
+    fleet total, and both numbers are in the response so the caller
+    can hold the ledger to it. With ``price`` set, each group's
+    observed joules are re-priced (what-if) — absent when no group
+    member carried an energy join.
+    """
+    key_of = GROUP_KEYS[group_by]
+    groups: dict[str, dict] = {}
+    total_chip_seconds = 0.0
+    for row in rows:
+        acc = groups.setdefault(key_of(row), {
+            "wasted_chip_seconds": 0.0, "chip_seconds": 0.0,
+            "by_bucket": dict.fromkeys(WASTE_BUCKETS, 0.0),
+            "energy_joules": None,
+        })
+        acc["chip_seconds"] += row["chip_seconds"]
+        total_chip_seconds += row["chip_seconds"]
+        for bucket in WASTE_BUCKETS:
+            wasted = row["buckets"][bucket]
+            acc["by_bucket"][bucket] += wasted
+            acc["wasted_chip_seconds"] += wasted
+        joules = row.get("energy_joules")
+        if joules is not None:
+            acc["energy_joules"] = (acc["energy_joules"] or 0.0) + joules
+    ranked = sorted(
+        groups.items(),
+        key=lambda item: (-item[1]["wasted_chip_seconds"], item[0]),
+    )
+    out_rows = []
+    for key, acc in ranked[:topk]:
+        entry = {
+            "key": key,
+            "wasted_chip_seconds": acc["wasted_chip_seconds"],
+            "wasted_chip_hours": acc["wasted_chip_seconds"] / 3600.0,
+            "chip_seconds": acc["chip_seconds"],
+            "waste_fraction": (
+                acc["wasted_chip_seconds"] / acc["chip_seconds"]
+                if acc["chip_seconds"] > 0 else None
+            ),
+            "by_bucket": acc["by_bucket"],
+        }
+        if acc["energy_joules"] is not None:
+            entry["energy_joules"] = acc["energy_joules"]
+            if price is not None:
+                entry["whatif_dollars"] = (
+                    acc["energy_joules"] / 3.6e6 * price
+                )
+        out_rows.append(entry)
+    doc = {
+        "group_by": group_by,
+        "rank": f"topk:{topk}",
+        "rows": out_rows,
+        "groups_total": len(groups),
+        "conservation": {
+            "sum_groups_chip_seconds": sum(
+                acc["chip_seconds"] for acc in groups.values()
+            ),
+            "total_chip_seconds": total_chip_seconds,
+        },
+    }
+    if price is not None:
+        doc["whatif"] = {"dollars_per_kwh": price}
+    return doc
+
+
+def percentiles_doc(rows: list, stats: list) -> dict:
+    """Fleet-wide efficiency percentiles by workload class.
+
+    Class = ``pool/wclass`` (the pool plus the serve/train preset
+    label): a serving job is only ever compared against serving jobs
+    on its own hardware. Each class reports the requested waste-
+    fraction quantiles; each job reports its own waste fraction and
+    its percentile standing within its class ("you are p90-wasteful"
+    == ``pct_rank >= 90``). Jobs with zero observed chip-seconds are
+    excluded — no standing can be honest about an empty denominator.
+    """
+    classes: dict[str, list] = {}
+    job_rows = []
+    for row in rows:
+        if row["chip_seconds"] <= 0:
+            continue
+        wasted = sum(row["buckets"][b] for b in WASTE_BUCKETS)
+        fraction = wasted / row["chip_seconds"]
+        wclass = f"{row['pool']}/{row.get('wclass', 'train')}"
+        classes.setdefault(wclass, []).append(fraction)
+        job_rows.append({
+            "pool": row["pool"],
+            "slice": row["slice"],
+            "class": wclass,
+            "waste_fraction": fraction,
+        })
+    class_docs = {}
+    for wclass, fractions in sorted(classes.items()):
+        class_docs[wclass] = {
+            "jobs": len(fractions),
+            **{
+                stat: percentile(fractions, PCT_STATS[stat])
+                for stat in stats
+            },
+        }
+    for job in job_rows:
+        cohort = classes[job["class"]]
+        # Percentile standing: the fraction of the cohort at or below
+        # this job's waste (inclusive of self — a lone job is p100).
+        at_or_below = sum(
+            1 for f in cohort if f <= job["waste_fraction"]
+        )
+        job["pct_rank"] = 100.0 * at_or_below / len(cohort)
+    job_rows.sort(key=lambda j: (-j["waste_fraction"], j["class"],
+                                 j["slice"]))
+    return {
+        "stats": list(stats),
+        "classes": class_docs,
+        "jobs": job_rows,
+    }
+
+
+def whatif_rows(rows: list, price: float) -> list:
+    """Re-price goodput rows' stored joules at ``price`` $/kWh
+    without touching the configured price or any raw sample: each row
+    with an energy join gains ``whatif_dollars``; rows without one
+    are passed through untouched (absent, not zero)."""
+    out = []
+    for row in rows:
+        joules = row.get("energy_joules")
+        if joules is None:
+            out.append(row)
+            continue
+        priced = dict(row)
+        priced["whatif_dollars"] = joules / 3.6e6 * price
+        out.append(priced)
+    return out

@@ -1,0 +1,248 @@
+"""Linear-trend capacity forecasting over the tiered ledger.
+
+The capacity-planner question is "when does pool X run out of
+headroom", and the honest answer is a least-squares line over the
+coarse tier with a confidence band — or "insufficient history" when
+the data cannot support a date. This module is pure math over
+``(ts_s, value)`` point lists the :class:`TieredSeriesStore` already
+serves; it never touches raw per-node series and never fabricates a
+date: every gate that fails returns a status string instead of a
+number.
+
+Two signals per pool, each with its own saturation direction:
+
+* ``hbm_headroom_ratio`` **falls** toward ``SATURATION_HEADROOM`` —
+  memory pressure growing until allocations stop fitting.
+* ``duty_cycle_percent`` **rises** toward ``SATURATION_DUTY`` — the
+  pool compute-bound with no slack left for growth.
+
+The pool's ``days_to_saturation`` is the minimum across signals that
+produced a date (the first wall you hit is the one that matters).
+
+Statuses are a closed vocabulary (tests pin it):
+
+``ok``
+    A date with a band: ``days_to_saturation`` plus ``days_lo`` /
+    ``days_hi`` from the ±1.96·SE slope band.
+``insufficient_history``
+    Span or point count below the gate — the honest "come back later".
+``stable``
+    The fitted trend points AWAY from saturation (or is flat within
+    the band): no date, and none should be alarmed into existence.
+``saturated``
+    The latest fitted value is already past the threshold: days 0.
+"""
+
+from __future__ import annotations
+
+import math
+
+__all__ = [
+    "SATURATION_DUTY",
+    "SATURATION_HEADROOM",
+    "FORECAST_SIGNALS",
+    "fit_trend",
+    "forecast_signal",
+    "forecast_pool",
+]
+
+#: Duty percent at which a pool counts as compute-saturated.
+SATURATION_DUTY = 95.0
+#: HBM headroom ratio at which a pool counts as memory-saturated.
+SATURATION_HEADROOM = 0.05
+#: 95% two-sided normal quantile for the slope confidence band.
+_Z95 = 1.96
+#: Forecasts further out than this are reported as ``stable`` — a
+#: 10-year extrapolation from weeks of history is noise, not a date.
+MAX_HORIZON_DAYS = 3650.0
+
+#: family suffix -> (target value, direction toward saturation).
+#: Direction +1 means the series rises into saturation, -1 falls.
+FORECAST_SIGNALS: dict[str, tuple[float, int]] = {
+    "tpu_fleet_duty_cycle_percent": (SATURATION_DUTY, +1),
+    "tpu_fleet_hbm_headroom_ratio": (SATURATION_HEADROOM, -1),
+}
+
+
+def fit_trend(points: list) -> dict | None:
+    """Ordinary least squares over ``(ts_s, value)`` points.
+
+    Returns ``{"slope_per_s", "intercept", "t0", "stderr_slope",
+    "residual_std", "n", "span_s"}`` with the intercept anchored at
+    the first timestamp (``t0``), or ``None`` for fewer than 3 points
+    or a degenerate (zero-span) time axis. ``stderr_slope`` is the
+    standard error of the slope estimate — the band the caller widens
+    a date with — and is 0.0 for a perfect fit.
+    """
+    n = len(points)
+    if n < 3:
+        return None
+    t0 = points[0][0]
+    xs = [p[0] - t0 for p in points]
+    ys = [p[1] for p in points]
+    span = xs[-1] - xs[0]
+    if span <= 0.0:
+        return None
+    xbar = sum(xs) / n
+    ybar = sum(ys) / n
+    sxx = sum((x - xbar) ** 2 for x in xs)
+    if sxx <= 0.0:
+        return None
+    sxy = sum((x - xbar) * (y - ybar) for x, y in zip(xs, ys))
+    slope = sxy / sxx
+    intercept = ybar - slope * xbar
+    sse = sum((y - (intercept + slope * x)) ** 2
+              for x, y in zip(xs, ys))
+    if n > 2:
+        residual_std = math.sqrt(max(sse, 0.0) / (n - 2))
+    else:  # pragma: no cover - n >= 3 enforced above
+        residual_std = 0.0
+    stderr = residual_std / math.sqrt(sxx) if sxx > 0 else 0.0
+    return {
+        "slope_per_s": slope,
+        "intercept": intercept,
+        "t0": t0,
+        "stderr_slope": stderr,
+        "residual_std": residual_std,
+        "n": n,
+        "span_s": span,
+    }
+
+
+def _days_to_cross(
+    current: float, target: float, slope_per_s: float, direction: int,
+) -> float | None:
+    """Days until the line from ``current`` crosses ``target`` moving
+    in ``direction``, or None when the slope points the wrong way."""
+    if direction > 0:
+        if slope_per_s <= 0.0 or current >= target:
+            return None
+        gap = target - current
+    else:
+        if slope_per_s >= 0.0 or current <= target:
+            return None
+        gap = current - target
+    seconds = gap / abs(slope_per_s)
+    return seconds / 86400.0
+
+
+def forecast_signal(
+    points: list,
+    *,
+    target: float,
+    direction: int,
+    now_s: float,
+    min_history_s: float,
+    min_points: int = 8,
+) -> dict:
+    """Forecast one (pool, signal) series toward its saturation wall.
+
+    ``points`` are (ts_s, value) in time order, normally the coarse
+    tier's bucket means. The gates run in honesty order: history span
+    first (never a date from sparse data), then fit viability, then
+    direction. The returned dict always carries ``status``; numeric
+    fields are present only when the status earns them.
+    """
+    doc: dict = {
+        "status": "insufficient_history",
+        "points": len(points),
+        "history_s": round(points[-1][0] - points[0][0], 3)
+        if len(points) >= 2 else 0.0,
+        "target": target,
+    }
+    if len(points) < min_points or doc["history_s"] < min_history_s:
+        return doc
+    trend = fit_trend(points)
+    if trend is None:
+        return doc
+    slope = trend["slope_per_s"]
+    # Evaluate the LINE at now, not the last raw point: a noisy final
+    # sample must not move the date the trend supports.
+    current = trend["intercept"] + slope * (now_s - trend["t0"])
+    doc.update(
+        slope_per_day=slope * 86400.0,
+        current=round(current, 6),
+        stderr_slope_per_day=trend["stderr_slope"] * 86400.0,
+        residual_std=round(trend["residual_std"], 6),
+    )
+    already = current >= target if direction > 0 else current <= target
+    if already:
+        doc["status"] = "saturated"
+        doc["days_to_saturation"] = 0.0
+        return doc
+    days = _days_to_cross(current, target, slope, direction)
+    if days is None or days > MAX_HORIZON_DAYS:
+        doc["status"] = "stable"
+        return doc
+    # Confidence band: re-solve the crossing with the slope at each
+    # edge of its ±1.96·SE interval. A slope whose interval includes
+    # zero has an unbounded far edge — the band is honest about that
+    # by leaving days_hi None ("could be never").
+    lo_slope = slope - _Z95 * trend["stderr_slope"]
+    hi_slope = slope + _Z95 * trend["stderr_slope"]
+    steep, shallow = (hi_slope, lo_slope) if direction > 0 else (
+        lo_slope, hi_slope)
+    days_lo = _days_to_cross(current, target, steep, direction)
+    days_hi = _days_to_cross(current, target, shallow, direction)
+    doc["status"] = "ok"
+    # 6 decimals of a day is ~0.1 s: precise enough that short-horizon
+    # fits (soaks, tests) are not quantized into their own tolerance,
+    # cheap enough to keep the JSON tidy.
+    doc["days_to_saturation"] = round(days, 6)
+    doc["days_lo"] = round(days_lo, 6) if days_lo is not None else round(
+        days, 6)
+    doc["days_hi"] = (
+        round(days_hi, 6)
+        if days_hi is not None and days_hi <= MAX_HORIZON_DAYS
+        else None
+    )
+    return doc
+
+
+def forecast_pool(
+    series: dict,
+    *,
+    now_s: float,
+    min_history_s: float,
+    min_points: int = 8,
+) -> dict:
+    """Combine per-signal forecasts into one pool answer.
+
+    ``series`` maps family name -> (ts_s, value) points for ONE pool.
+    The pool's ``days_to_saturation`` is the minimum over signals
+    whose status earned a date (``ok`` or ``saturated``); the pool
+    status is ``ok`` when any signal produced a date,
+    ``insufficient_history`` when every signal is gated (the honest
+    aggregate), else ``stable``.
+    """
+    signals: dict[str, dict] = {}
+    best: tuple[float, str] | None = None
+    statuses = set()
+    for family, (target, direction) in sorted(FORECAST_SIGNALS.items()):
+        pts = series.get(family)
+        if not pts:
+            continue
+        sig = forecast_signal(
+            pts, target=target, direction=direction, now_s=now_s,
+            min_history_s=min_history_s, min_points=min_points,
+        )
+        signals[family] = sig
+        statuses.add(sig["status"])
+        days = sig.get("days_to_saturation")
+        if days is not None and (best is None or days < best[0]):
+            best = (days, family)
+    if not signals:
+        return {"status": "insufficient_history", "signals": {}}
+    if best is not None:
+        lead = signals[best[1]]
+        return {
+            "status": "ok",
+            "days_to_saturation": best[0],
+            "days_lo": lead.get("days_lo", best[0]),
+            "days_hi": lead.get("days_hi"),
+            "leading_signal": best[1],
+            "signals": signals,
+        }
+    if statuses == {"insufficient_history"}:
+        return {"status": "insufficient_history", "signals": signals}
+    return {"status": "stable", "signals": signals}

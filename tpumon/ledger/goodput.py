@@ -104,6 +104,11 @@ class GoodputLedger:
         #: rows). Kept BESIDE the bucket dict: joules are not
         #: chip-seconds and must never leak into conservation sums.
         self._job_energy: dict[tuple[str, str], list] = {}  # guarded-by: self._lock
+        #: (pool, slice) -> workload class ("serve" | "train") — the
+        #: percentile cohort key. Sticky once "serve": a serving job
+        #: whose telemetry blips must not hop cohorts and reshuffle
+        #: everyone else's percentile standing.
+        self._job_class: dict[tuple[str, str], str] = {}  # guarded-by: self._lock
         #: Aggregator-blind seconds ledgered (warm-restart gaps).
         self.gap_seconds = 0.0  # guarded-by: self._lock
 
@@ -135,6 +140,8 @@ class GoodputLedger:
                 continue
             bucket = self._classify(feed, snap, state)
             self._update_identity(feed, snap)
+            if feed.job is not None and (snap or {}).get("serve"):
+                self._job_class[feed.job] = "serve"
             if feed.job is not None and feed.chips > 0:
                 job = self._jobs.setdefault(
                     feed.job, dict.fromkeys(BUCKETS, 0.0)
@@ -295,6 +302,12 @@ class GoodputLedger:
                 for job, row in self._job_energy.items()
             }
 
+    def job_classes(self) -> dict[tuple[str, str], str]:
+        """(pool, slice) -> workload class; jobs never seen serving
+        default to "train" at read time (absent key, not stored)."""
+        with self._lock:
+            return dict(self._job_class)
+
     def dollars_of(self, joules: float) -> float | None:
         """Joules -> dollars at the configured $/kWh; None when no
         price is configured (dollars surfaces stay absent, never 0)."""
@@ -307,12 +320,14 @@ class GoodputLedger:
         conservation total spelled out, plus the energy join (joules
         always when observed; dollars only at a configured price)."""
         energy = self.job_energy()
+        classes = self.job_classes()
         rows = []
         for (pool, slc), buckets in sorted(self.jobs().items()):
             total = sum(buckets.values())
             row = {
                 "pool": pool,
                 "slice": slc,
+                "wclass": classes.get((pool, slc), "train"),
                 "chip_seconds": total,
                 "buckets": {k: buckets[k] for k in BUCKETS},
                 "goodput_ratio": (
@@ -344,6 +359,12 @@ class GoodputLedger:
                      "modeled": bool(row[1])}
                     for (pool, slc), row in sorted(
                         self._job_energy.items()
+                    )
+                ],
+                "classes": [
+                    {"pool": pool, "slice": slc, "wclass": wclass}
+                    for (pool, slc), wclass in sorted(
+                        self._job_class.items()
                     )
                 ],
                 "feeds": {
@@ -383,6 +404,14 @@ class GoodputLedger:
                 self._job_energy[job] = [
                     float(row["joules"]), bool(row.get("modeled"))
                 ]
+            except (KeyError, TypeError, ValueError):
+                continue
+        for row in doc.get("classes", ()):
+            try:
+                job = (str(row["pool"]), str(row["slice"]))
+                wclass = str(row["wclass"])
+                if wclass == "serve":
+                    self._job_class[job] = wclass
             except (KeyError, TypeError, ValueError):
                 continue
         for target, row in (doc.get("feeds") or {}).items():

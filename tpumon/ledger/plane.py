@@ -18,6 +18,8 @@ import threading
 import time
 import urllib.parse
 
+from tpumon.ledger import analytics
+from tpumon.ledger.forecast import FORECAST_SIGNALS, forecast_pool
 from tpumon.ledger.goodput import BUCKETS, GoodputLedger
 from tpumon.ledger.store import (
     AGGS,
@@ -42,6 +44,13 @@ log = logging.getLogger(__name__)
 QUERY_MAX_POINTS = 2000
 QUERY_MAX_POINTS_CEILING = 20000
 
+#: /ledger view vocabulary (anything else 400s with this list).
+VIEWS = ("goodput", "waste", "percentiles", "forecast")
+
+#: Points fed to one (pool, signal) least-squares fit — 14 days of
+#: 5-minute buckets is 4032, well inside this.
+FORECAST_MAX_POINTS = 8192
+
 
 def _json_bytes(doc: dict) -> bytes:
     return json.dumps(doc, sort_keys=True).encode()
@@ -62,6 +71,9 @@ class LedgerPlane:
         contended_wait: float = 0.25,
         idle_duty_pct: float = 5.0,
         dollars_per_kwh: float = 0.0,
+        forecast_min_history_s: float = 21600.0,
+        forecast_every_s: float = 60.0,
+        forecast_min_points: int = 8,
         clock=time.time,
     ) -> None:
         self._clock = clock
@@ -119,6 +131,15 @@ class LedgerPlane:
         self._rw_lock = threading.Lock()
         self.queries_total = 0
         self.last_cycle_samples = 0
+        #: Capacity forecasting (tpumon/ledger/forecast.py): recomputed
+        #: on its own cadence inside cycle(), read lock-free by /ledger,
+        #: families(), and the External Metrics provider — the dict is
+        #: rebuilt and swapped atomically, never mutated in place.
+        self.forecast_min_history_s = forecast_min_history_s
+        self.forecast_every_s = forecast_every_s
+        self.forecast_min_points = forecast_min_points
+        self._forecasts: dict[str, dict] = {}
+        self._forecast_ts = 0.0
 
     # -- collect-cycle hook -------------------------------------------------
 
@@ -147,6 +168,56 @@ class LedgerPlane:
                         del pending[: len(pending) - 600]
             self._maybe_push(now, submit)
         self._maybe_spool(now, submit)
+        if now - self._forecast_ts >= self.forecast_every_s:
+            self._forecast_ts = now
+            self._forecasts = self._compute_forecasts(now)
+
+    # -- forecasting --------------------------------------------------------
+
+    def _compute_forecasts(self, now: float) -> dict[str, dict]:
+        """Per-pool saturation forecasts off the tiered store.
+
+        The fit window is 8× the minimum-history gate, so the tier the
+        fit reads follows history depth: a fleet with weeks of history
+        fits the 5-minute tier; one below the gate answers
+        "insufficient history" from whatever the fine tiers hold —
+        never a fabricated date.
+        """
+        start = now - 8.0 * self.forecast_min_history_s
+        tier_idx = self.store.pick_tier(start, now, None)
+        pools = sorted({
+            key[2] for key in self.store.series_keys()
+            if key[1] == "pool" and key[0] in FORECAST_SIGNALS
+        })
+        out: dict[str, dict] = {}
+        for pool in pools:
+            series: dict[str, list] = {}
+            for family in FORECAST_SIGNALS:
+                points, _cursor = self.store.query(
+                    (family, "pool", pool, ""), tier_idx, start, now,
+                    stat="mean", max_points=FORECAST_MAX_POINTS,
+                )
+                if points:
+                    series[family] = points
+            if series:
+                out[pool] = forecast_pool(
+                    series, now_s=now,
+                    min_history_s=self.forecast_min_history_s,
+                    min_points=self.forecast_min_points,
+                )
+        return out
+
+    def forecasts(self) -> dict[str, dict]:
+        """pool -> forecast doc (see :func:`forecast_pool`), as of the
+        last forecast cadence tick; the External Metrics adapter and
+        /ledger?view=forecast both read this."""
+        return self._forecasts
+
+    def forecast_snapshot(self) -> tuple[dict[str, dict], float]:
+        """(forecasts, computed_at) — the External Metrics adapter's
+        provider shape, so items carry the compute timestamp rather
+        than re-stamping served values as current."""
+        return self._forecasts, self._forecast_ts
 
     @staticmethod
     def _rows(doc: dict):
@@ -380,8 +451,87 @@ class LedgerPlane:
             labels=(),
         )
         queries.add_metric((), float(self.queries_total))
-        out = [goodput, *energy_fams, series, samples, nbytes, dropped,
-               gap, queries]
+        analytics_fams: list = []
+        jobs = self.goodput.jobs()
+        if jobs:
+            waste = CounterMetricFamily(
+                "tpu_fleet_waste_chip_seconds",
+                "Wasted chip-seconds per job (scope=slice) and "
+                "fleet-wide: the contended + idle goodput buckets — "
+                "chips held but not advancing work. A strict subset of "
+                "tpu_fleet_goodput_chip_seconds, so it conserves "
+                "against the same totals.",
+                labels=("scope", "pool", "slice"),
+            )
+            fleet_waste = 0.0
+            for (pool, slc), buckets in sorted(jobs.items()):
+                wasted = sum(
+                    buckets[b] for b in analytics.WASTE_BUCKETS
+                )
+                waste.add_metric(("slice", pool, slc), wasted)
+                fleet_waste += wasted
+            waste.add_metric(("fleet", "", ""), fleet_waste)
+            analytics_fams.append(waste)
+            pct = analytics.percentiles_doc(
+                self.goodput.jobs_doc(), list(analytics.PCT_STATS)
+            )
+            if pct["classes"]:
+                quantiles = GaugeMetricFamily(
+                    "tpu_fleet_waste_fraction_quantile",
+                    "Waste-fraction quantiles (p50/p90/p99) per "
+                    "workload class (pool/serve-or-train): the cohort "
+                    "a job's percentile standing is computed against "
+                    "in /ledger?view=percentiles.",
+                    labels=("wclass", "quantile"),
+                )
+                for wclass, row in sorted(pct["classes"].items()):
+                    for stat in analytics.PCT_STATS:
+                        quantiles.add_metric((wclass, stat), row[stat])
+                analytics_fams.append(quantiles)
+        forecasts = self.forecasts()
+        if forecasts:
+            days = GaugeMetricFamily(
+                "tpu_fleet_forecast_days_to_saturation",
+                "Days until the pool saturates (duty rising to 95% or "
+                "HBM headroom falling to 5%), least-squares over the "
+                "ledger's coarse tier; ABSENT for pools whose history "
+                "or trend cannot support a date — never a fabricated "
+                "one. 0 means already saturated.",
+                labels=("pool",),
+            )
+            slope = GaugeMetricFamily(
+                "tpu_fleet_forecast_slope_per_day",
+                "Fitted per-day trend slope per pool and signal "
+                "(signal is the stored family the fit ran over).",
+                labels=("pool", "signal"),
+            )
+            gated = GaugeMetricFamily(
+                "tpu_fleet_forecast_insufficient_history",
+                "1 when the pool's history span is below the "
+                "minimum-history gate (TPUMON_FLEET_LEDGER_FORECAST_"
+                "MIN_HISTORY_S) and no date is served, else 0 — the "
+                "honesty surface capacity alerts can gate on.",
+                labels=("pool",),
+            )
+            for pool, doc in sorted(forecasts.items()):
+                eta = doc.get("days_to_saturation")
+                if eta is not None:
+                    days.add_metric((pool,), eta)
+                gated.add_metric(
+                    (pool,),
+                    1.0 if doc["status"] == "insufficient_history"
+                    else 0.0,
+                )
+                for signal, sig in sorted(
+                    doc.get("signals", {}).items()
+                ):
+                    if "slope_per_day" in sig:
+                        slope.add_metric(
+                            (pool, signal), sig["slope_per_day"]
+                        )
+            analytics_fams.extend([days, slope, gated])
+        out = [goodput, *energy_fams, *analytics_fams, series, samples,
+               nbytes, dropped, gap, queries]
         if self.spool is not None:
             spool_errors = CounterMetricFamily(
                 "tpu_ledger_spool_errors",
@@ -407,12 +557,24 @@ class LedgerPlane:
     # -- /ledger ------------------------------------------------------------
 
     def query_response(self, query_string: str) -> tuple[bytes, str]:
-        """(body, status) for one GET /ledger. Three shapes:
+        """(body, status) for one GET /ledger. The shapes:
 
-        - no parameters: the index (families, tiers, occupancy,
+        - no parameters: the index (families, views, tiers, occupancy,
           goodput totals);
         - ``?view=goodput``: per-job bucket splits + conservation
           (plus the energy joules/dollars join when observed);
+        - ``?view=waste``: top-k waste ranking
+          (``group_by=job|pool|slice``, ``rank=topk:<n>``) with the
+          conservation block spelled out;
+        - ``?view=percentiles``: waste-fraction quantiles per workload
+          class (pool + serve/train) and each job's percentile
+          standing (``stat=p50|p90|p99`` narrows to one quantile);
+        - ``?view=forecast``: per-pool saturation forecasts
+          (optional ``pool=`` filter) — pools below the history gate
+          answer status "insufficient_history", never a date;
+        - ``?whatif=dollars_per_kwh:<v>`` on goodput/waste views:
+          re-prices stored joules at v without touching raw samples
+          or the configured price;
         - ``?family=...``: a range query — ``scope`` (slice/pool/fleet),
           optional ``pool``/``slice`` filters, ``start``/``end`` epoch
           seconds (default: the last hour), ``step`` seconds (tier
@@ -423,23 +585,94 @@ class LedgerPlane:
           SERVER-SIDE aggregation — the matched series fold across
           each other inside the read path (decode → aggregate →
           re-emit; the raw range is never materialized), one output
-          series per ``by`` group. Byte-stable vs aggregating the raw
-          range client-side (tests pin it), so consumers stop shipping
-          per-slice series to compute a per-pool number.
+          series per ``by`` group (``group_by=`` is accepted as an
+          alias). Byte-stable vs aggregating the raw range
+          client-side (tests pin it), so consumers stop shipping
+          per-slice series to compute a per-pool number. The fold
+          composes with ``bucket=1h|1d`` (coarse re-bucketing;
+          ``stat`` may then be ``mean`` or ``p50|p90|p99`` over each
+          coarse bucket's points, emitted as [ts, value, n] triples
+          so thin edge buckets are visible) and ``rank=topk:<n>``
+          (series ordered by mean value, top n kept).
         """
         self.queries_total += 1
         try:
             params = dict(urllib.parse.parse_qsl(query_string))
         except ValueError:
             return _json_bytes({"error": "unparseable query"}), "400 Bad Request"
-        if params.get("view") == "goodput":
+        whatif = None
+        if "whatif" in params:
+            whatif = analytics.parse_whatif(params["whatif"])
+            if whatif is None:
+                return _json_bytes({
+                    "error": "whatif must be dollars_per_kwh:<positive "
+                             "number>",
+                }), "400 Bad Request"
+        view = params.get("view")
+        if view is not None and view not in VIEWS:
             return _json_bytes({
+                "error": f"unknown view {view!r}",
+                "views": list(VIEWS),
+            }), "400 Bad Request"
+        if view == "goodput":
+            rows = self.goodput.jobs_doc()
+            doc = {
                 "now": self._clock(),
                 "buckets": list(BUCKETS),
-                "jobs": self.goodput.jobs_doc(),
+                "jobs": (
+                    analytics.whatif_rows(rows, whatif)
+                    if whatif is not None else rows
+                ),
                 "totals": self.goodput.totals(),
                 "gap_seconds": self.goodput.gap_seconds,
                 "dollars_per_kwh": self.goodput.dollars_per_kwh,
+            }
+            if whatif is not None:
+                doc["whatif"] = {"dollars_per_kwh": whatif}
+            return _json_bytes(doc), "200 OK"
+        if view == "waste":
+            group_by = params.get("group_by", "job")
+            if group_by not in analytics.GROUP_KEYS:
+                return _json_bytes({
+                    "error": "group_by must be one of "
+                             f"{sorted(analytics.GROUP_KEYS)}",
+                }), "400 Bad Request"
+            topk = analytics.parse_rank(params.get("rank", "topk:10"))
+            if topk is None:
+                return _json_bytes(
+                    {"error": "rank must be topk:<1..1000>"}
+                ), "400 Bad Request"
+            doc = analytics.waste_doc(
+                self.goodput.jobs_doc(), group_by, topk, price=whatif
+            )
+            doc["now"] = self._clock()
+            doc["view"] = "waste"
+            return _json_bytes(doc), "200 OK"
+        if view == "percentiles":
+            stat = params.get("stat")
+            if stat is not None and stat not in analytics.PCT_STATS:
+                return _json_bytes({
+                    "error": "stat must be one of "
+                             f"{sorted(analytics.PCT_STATS)}",
+                }), "400 Bad Request"
+            doc = analytics.percentiles_doc(
+                self.goodput.jobs_doc(),
+                [stat] if stat else list(analytics.PCT_STATS),
+            )
+            doc["now"] = self._clock()
+            doc["view"] = "percentiles"
+            return _json_bytes(doc), "200 OK"
+        if view == "forecast":
+            pools = self.forecasts()
+            if "pool" in params:
+                pool = params["pool"]
+                pools = {pool: pools[pool]} if pool in pools else {}
+            return _json_bytes({
+                "now": self._clock(),
+                "view": "forecast",
+                "min_history_s": self.forecast_min_history_s,
+                "computed_at": self._forecast_ts,
+                "pools": pools,
             }), "200 OK"
         family = params.get("family")
         if not family:
@@ -463,11 +696,40 @@ class LedgerPlane:
             return _json_bytes(
                 {"error": "start must be before end"}
             ), "400 Bad Request"
+        span_s = None
+        if "bucket" in params:
+            span_s = analytics.BUCKET_SPANS.get(params["bucket"])
+            if span_s is None:
+                return _json_bytes({
+                    "error": "bucket must be one of "
+                             f"{sorted(analytics.BUCKET_SPANS)}",
+                }), "400 Bad Request"
+        topk = None
+        if "rank" in params:
+            topk = analytics.parse_rank(params["rank"])
+            if topk is None:
+                return _json_bytes(
+                    {"error": "rank must be topk:<1..1000>"}
+                ), "400 Bad Request"
         stat = params.get("stat", "mean")
-        if stat not in STATS:
-            return _json_bytes(
-                {"error": f"stat must be one of {STATS}"}
-            ), "400 Bad Request"
+        pct_stat = stat if stat in analytics.PCT_STATS else None
+        if pct_stat is not None and span_s is None:
+            return _json_bytes({
+                "error": f"stat={stat} requires bucket=1h|1d "
+                         "(percentiles are computed over coarse "
+                         "bucket contents)",
+            }), "400 Bad Request"
+        if span_s is not None and stat not in (
+            "mean", *analytics.PCT_STATS
+        ):
+            return _json_bytes({
+                "error": "bucket supports stat mean|p50|p90|p99",
+            }), "400 Bad Request"
+        if pct_stat is None and stat not in STATS:
+            return _json_bytes({
+                "error": f"stat must be one of {STATS} "
+                         "(or p50|p90|p99 with bucket=)",
+            }), "400 Bad Request"
         max_points = max(1, min(max_points, QUERY_MAX_POINTS_CEILING))
         scope = params.get("scope", "fleet")
         tier_idx = self.store.pick_tier(start, now, step)
@@ -479,12 +741,16 @@ class LedgerPlane:
             and ("slice" not in params or key[3] == params["slice"])
         ]
         agg = params.get("agg")
+        if agg is None and (span_s is not None or topk is not None):
+            return _json_bytes({
+                "error": "bucket/rank require agg=sum|mean|max",
+            }), "400 Bad Request"
         if agg is not None:
             if agg not in AGGS:
                 return _json_bytes(
                     {"error": f"agg must be one of {AGGS}"}
                 ), "400 Bad Request"
-            by = params.get("by", "none")
+            by = params.get("by", params.get("group_by", "none"))
             if by not in GROUP_BYS:
                 return _json_bytes(
                     {"error": f"by must be one of {GROUP_BYS}"}
@@ -500,9 +766,51 @@ class LedgerPlane:
                     return ("", "")
             groups, agg_next = self.store.fold(
                 keys, tier_idx, start, end,
-                stat=stat, agg=agg, group_of=group_of,
-                max_points=max_points,
+                stat="mean" if pct_stat else stat, agg=agg,
+                group_of=group_of, max_points=max_points,
             )
+            if span_s is not None and agg_next is not None:
+                # Align the time cutoff DOWN to a coarse-bucket
+                # boundary so no 1h/1d bucket is split across pages —
+                # a split bucket's percentile would be silently wrong,
+                # not partial. When the whole page fits inside one
+                # coarse bucket no boundary can make progress; the
+                # bucket is then served partial with its point count
+                # visible (the documented edge-bucket error).
+                boundary = agg_next - (agg_next % span_s)
+                if boundary > start:
+                    agg_next = boundary
+                    groups = {
+                        group: kept
+                        for group, points in groups.items()
+                        if (kept := [
+                            p for p in points if p[0] < boundary
+                        ])
+                    }
+            ordered = sorted(groups.items())
+            if topk is not None:
+                keep = analytics.rank_groups(groups, topk)
+                ordered = [(group, groups[group]) for group in keep]
+            series = []
+            for (pool, slc), points in ordered:
+                row = {
+                    "pool": pool,
+                    "slice": slc,
+                    "stat": "raw" if tier_idx == 0 else stat,
+                    "agg": agg,
+                }
+                if span_s is not None:
+                    row["points"] = [
+                        [bucket_ts, value, n]
+                        for bucket_ts, value, n in analytics.rebucket(
+                            points, span_s, pct_stat or "mean"
+                        )
+                    ]
+                else:
+                    row["points"] = [
+                        [round(ts, 3), value] for ts, value in points
+                    ]
+                series.append(row)
             doc = {
                 "family": family,
                 "tier": spec.name,
@@ -511,19 +819,12 @@ class LedgerPlane:
                 "by": by,
                 "start": start,
                 "end": end,
-                "series": [
-                    {
-                        "pool": pool,
-                        "slice": slc,
-                        "stat": "raw" if tier_idx == 0 else stat,
-                        "agg": agg,
-                        "points": [
-                            [round(ts, 3), value] for ts, value in points
-                        ],
-                    }
-                    for (pool, slc), points in sorted(groups.items())
-                ],
+                "series": series,
             }
+            if span_s is not None:
+                doc["bucket"] = params["bucket"]
+            if topk is not None:
+                doc["rank"] = f"topk:{topk}"
             if agg_next is not None:
                 doc["truncated"] = True
                 doc["next_start"] = agg_next
@@ -572,10 +873,15 @@ class LedgerPlane:
         return {
             "now": self._clock(),
             "families": sorted(LEDGER_FAMILY_SET),
+            "views": list(VIEWS),
             "tiers": stats["tiers"],
             "dropped_chunks": stats["dropped_chunks"],
             "goodput_totals": self.goodput.totals(),
             "gap_seconds": self.goodput.gap_seconds,
+            "forecast": {
+                pool: doc["status"]
+                for pool, doc in sorted(self.forecasts().items())
+            },
             "restored": self.restored,
         }
 
@@ -588,6 +894,7 @@ class LedgerPlane:
             "gap_seconds": self.goodput.gap_seconds,
             "jobs": len(self.goodput.jobs()),
             "queries": self.queries_total,
+            "forecast_pools": len(self._forecasts),
             "restored": self.restored,
         }
         if self.spool is not None:

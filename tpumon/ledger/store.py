@@ -369,8 +369,12 @@ class TieredSeriesStore:
         the PR 4 bounded-replay discipline applied to range reads.
         """
         use_stat = RAW_STAT if tier == 0 else stat
-        start_ms = int(start_s * 1000.0)
-        end_ms = int(end_s * 1000.0)
+        # round(), not truncation: continuation cursors are emitted as
+        # ts_ms / 1000.0, and a float round-trip that lands a hair
+        # below the integer would re-admit the already-emitted edge
+        # point on resume (double count). record() rounds the same way.
+        start_ms = int(round(start_s * 1000.0))
+        end_ms = int(round(end_s * 1000.0))
         out: list[tuple[float, float]] = []
         # Under the lock end to end: points() walks chunk lists and the
         # open buffer, both of which the collect thread mutates (seal
@@ -426,8 +430,12 @@ class TieredSeriesStore:
         Returns ``({group: [(ts_s, value), ...]}, next_start|None)``.
         """
         use_stat = RAW_STAT if tier == 0 else stat
-        start_ms = int(start_s * 1000.0)
-        end_ms = int(end_s * 1000.0)
+        # Same rounding contract as query(): the cutoff cursor is
+        # cutoff_ms / 1000.0, and resuming from it must start AT the
+        # first un-emitted bucket — truncation here would re-fold a
+        # group's edge bucket into the next page.
+        start_ms = int(round(start_s * 1000.0))
+        end_ms = int(round(end_s * 1000.0))
         groups: dict[tuple, dict[int, list]] = {}
         total = 0
         cutoff_ms: int | None = None

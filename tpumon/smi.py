@@ -583,6 +583,134 @@ def ledger_snapshot(
     }
 
 
+def capacity_snapshot(
+    url: str, timeout: float, whatif: float | None = None
+) -> dict:
+    """The ``--capacity`` view's data: per-pool saturation forecasts,
+    the top-waste ranking, and fleet waste percentiles from the
+    aggregator's ``GET /ledger`` read side (tpumon/ledger/analytics.py
+    + forecast.py). Same bounded retry discipline as ``--ledger``.
+
+    An OLD aggregator (pre-forecast read side) answers ``view=forecast``
+    with a 400 (unknown view) or a doc missing the ``pools`` echo —
+    both degrade to an explicit "no capacity read side" marker rather
+    than rendering garbage or crashing the CLI.
+    """
+    from tpumon.resilience import RetryPolicy, retry_call
+
+    policy = RetryPolicy(
+        attempts=3, base_s=0.2, max_s=1.0, deadline_s=max(2.0, timeout)
+    )
+    base = url.rstrip("/")
+
+    def fetch(path: str) -> dict:
+        return json.loads(retry_call(
+            lambda: _fetch(base + path, timeout),
+            policy,
+            retryable=FETCH_ERRORS,
+        ))
+
+    try:
+        forecast = fetch("/ledger?view=forecast")
+    except FETCH_ERRORS:
+        forecast = None
+    if forecast is not None and "pools" not in forecast:
+        forecast = None  # old aggregator: no forecast read side
+    waste = None
+    pct = None
+    if forecast is not None:
+        suffix = ""
+        if whatif is not None:
+            suffix = f"&whatif=dollars_per_kwh:{whatif:g}"
+        try:
+            waste = fetch(
+                "/ledger?view=waste&group_by=job&rank=topk:10" + suffix
+            )
+            pct = fetch("/ledger?view=percentiles")
+        except FETCH_ERRORS:
+            pass
+    return {
+        "capacity": {"forecast": forecast, "waste": waste,
+                     "percentiles": pct, "whatif": whatif},
+        "aggregator_url": url,
+        "ts": time.time(),
+    }
+
+
+def render_capacity(snap: dict, out=None) -> None:
+    """The ``--capacity`` view: per-pool days-to-saturation (with the
+    confidence band and the leading signal), the top-waste job ranking
+    with its conservation line, and the per-class waste percentiles.
+    Pools below the history gate print "insufficient history" — the
+    server never fabricates a date, and neither does this renderer."""
+    out = out if out is not None else sys.stdout
+    doc = snap["capacity"]
+
+    def p(line: str = "") -> None:
+        print(line, file=out)
+
+    forecast = doc.get("forecast")
+    p(f"CAPACITY @ {snap.get('aggregator_url', '?')}")
+    if forecast is None:
+        p("  aggregator has no capacity read side "
+          "(pre-forecast server, or /ledger unreachable) — "
+          "upgrade the aggregator or use --ledger")
+        return
+    pools = forecast.get("pools") or {}
+    if not pools:
+        p("  no pool series yet (young ledger)")
+    for pool in sorted(pools):
+        verdict = pools[pool] or {}
+        status = verdict.get("status", "?")
+        if status == "ok":
+            days = verdict.get("days_to_saturation")
+            lo = verdict.get("days_lo")
+            hi = verdict.get("days_hi")
+            band = ""
+            if lo is not None:
+                band = (f" (95% band {lo:.1f}.."
+                        + (f"{hi:.1f}" if hi is not None else "inf")
+                        + " d)")
+            p(f"  {pool}: saturates in {days:.1f} days{band}"
+              f" — leading signal {verdict.get('leading_signal', '?')}")
+        elif status == "insufficient_history":
+            p(f"  {pool}: insufficient history "
+              f"(gate {forecast.get('min_history_s', 0):.0f}s — "
+              "no date until the ledger has seen enough)")
+        else:
+            p(f"  {pool}: {status} (no adverse trend)")
+    waste = doc.get("waste")
+    if waste:
+        rows = waste.get("rows") or []
+        whatif = doc.get("whatif")
+        p(f"top waste (contended+idle chip-hours, "
+          f"group_by={waste.get('group_by', 'job')}):")
+        for row in rows:
+            line = (
+                f"  {row.get('key', '?')}: "
+                f"{row.get('wasted_chip_hours', 0.0):.2f} chip-h wasted "
+                f"({row.get('waste_fraction', 0.0):.1%} of its time)"
+            )
+            dollars = row.get("whatif_dollars")
+            if dollars is not None:
+                line += f", ~${dollars:.2f} @ ${whatif:g}/kWh"
+            p(line)
+        cons = waste.get("conservation") or {}
+        if cons:
+            p(f"  conservation: {cons.get('sum_groups_chip_seconds', 0.0):.0f}"
+              f" == {cons.get('total_chip_seconds', 0.0):.0f} chip-s"
+              " (groups vs pinned total)")
+    pct = doc.get("percentiles")
+    if pct and pct.get("classes"):
+        p("waste percentiles by workload class:")
+        for wclass in sorted(pct["classes"]):
+            row = pct["classes"][wclass]
+            p(f"  {wclass}: p50 {row.get('p50', 0.0):.1%} / "
+              f"p90 {row.get('p90', 0.0):.1%} / "
+              f"p99 {row.get('p99', 0.0):.1%} "
+              f"({row.get('jobs', 0)} jobs)")
+
+
 def render_ledger(snap: dict, out=None) -> None:
     """The ``--ledger`` view: per-job goodput splits (chip-hours by
     bucket, unaccounted called out — see the OPERATIONS.md goodput
@@ -1069,6 +1197,21 @@ def main(argv: list[str] | None = None, out=None) -> int:
         help="filter the --ledger goodput view to one job's slice",
     )
     parser.add_argument(
+        "--capacity",
+        action="store_true",
+        help="with --aggregator: render per-pool saturation forecasts, "
+        "the top-waste ranking, and per-class waste percentiles from "
+        "the aggregator's /ledger read side (view=forecast/waste/"
+        "percentiles) instead of the node table",
+    )
+    parser.add_argument(
+        "--whatif",
+        type=float,
+        metavar="DOLLARS_PER_KWH",
+        help="with --capacity: re-price the waste ranking's stored "
+        "joules at this electricity price (?whatif=dollars_per_kwh:V)",
+    )
+    parser.add_argument(
         "--watch", type=float, metavar="SEC", help="refresh every SEC seconds"
     )
     parser.add_argument("--json", action="store_true", help="machine-readable output")
@@ -1090,6 +1233,9 @@ def main(argv: list[str] | None = None, out=None) -> int:
     if args.ledger and not args.aggregator:
         parser.error("--ledger requires --aggregator URL (the ledger "
                      "lives in the fleet aggregator)")
+    if args.capacity and not args.aggregator:
+        parser.error("--capacity requires --aggregator URL (the "
+                     "forecast read side lives in the fleet aggregator)")
     out = out if out is not None else sys.stdout
 
     # The data source is chosen once and sticks: under --watch a transient
@@ -1166,6 +1312,12 @@ def main(argv: list[str] | None = None, out=None) -> int:
         return snap
 
     def _chip_snapshot() -> dict:
+        if args.capacity:
+            # Capacity-planning view: forecasts + waste ranking off the
+            # ledger's read side; degrades explicitly on old servers.
+            return capacity_snapshot(
+                args.aggregator, args.timeout, whatif=args.whatif
+            )
         if args.ledger:
             # Efficiency-ledger view: the aggregator's /ledger API
             # (goodput splits + tokens/J trend), not the node table.
@@ -1209,6 +1361,8 @@ def main(argv: list[str] | None = None, out=None) -> int:
     def emit(snap: dict) -> None:
         if args.json:
             print(json.dumps(snap, sort_keys=True), file=out)
+        elif "capacity" in snap:
+            render_capacity(snap, out)
         elif "ledger" in snap:
             render_ledger(snap, out)
         elif "aggregator" in snap:

@@ -13,6 +13,7 @@ import sys
 
 from tpumon.families import (
     ACTUATE_FAMILIES,
+    ANALYTICS_FAMILIES,
     ANOMALY_FAMILIES,
     ENERGY_FAMILIES,
     FLEET_FAMILIES,
@@ -273,6 +274,30 @@ def render() -> str:
         "|---|---|---|---|",
     ]
     for name, (kind, desc, labels) in LEDGER_FAMILIES.items():
+        label_s = ", ".join(f"`{l}`" for l in labels) or "—"
+        lines.append(f"| `{name}` | {kind} | {desc} | {label_s} |")
+
+    lines += [
+        "",
+        "## Ledger analytics & capacity forecasting (`tpumon/ledger/analytics.py` + `forecast.py`)",
+        "",
+        "The ledger's read side for capacity planners: top-k waste",
+        "ranking, per-workload-class efficiency percentiles, and",
+        "linear-trend saturation forecasts, all computed off the tiered",
+        "fold (raw per-node series never cross the surface) and served",
+        "both on `GET /ledger` (`view=waste|percentiles|forecast`,",
+        "`whatif=dollars_per_kwh:<v>`) and as the exposition families",
+        "below. Forecast families are honest by construction: a pool",
+        "below the minimum-history gate emits",
+        "`tpu_fleet_forecast_insufficient_history=1` and NO",
+        "`days_to_saturation` — absent, never a fabricated date (see",
+        "docs/OPERATIONS.md for the capacity-planning runbook and the",
+        "query grammar).",
+        "",
+        "| family | type | description | labels |",
+        "|---|---|---|---|",
+    ]
+    for name, (kind, desc, labels) in ANALYTICS_FAMILIES.items():
         label_s = ", ".join(f"`{l}`" for l in labels) or "—"
         lines.append(f"| `{name}` | {kind} | {desc} | {label_s} |")
 

@@ -2308,6 +2308,202 @@ def ledger_soak(
             _shutil.rmtree(spool_dir, ignore_errors=True)
 
 
+def capacity_soak(
+    duration_s: float,
+    interval: float = 0.25,
+    scrape_every_s: float = 1.0,
+) -> dict:
+    """Capacity-forecast acceptance soak (ISSUE 17): a scripted fleet
+    whose duty ramps LINEARLY at a known rate behind a forecast-enabled
+    aggregator, plus a sparse pool that comes alive too late to clear
+    the history gate. The record carries the asserted evidence:
+
+    - the forecast's days-to-saturation against the script's own
+      ground-truth ETA (the ramp rate is ours, so the truth is exact);
+    - the sparse pool answering ``insufficient_history`` and NEVER a
+      date;
+    - the top-k waste ranking's conservation block (sum over groups ==
+      pinned total chip-seconds, exact);
+    - a bounded grouped range query walked to completion via its
+      ``next_start`` cursors equaling the unbounded fold, point for
+      point.
+    """
+    import urllib.request
+
+    from tpumon.fleet.config import FleetConfig
+    from tpumon.fleet.server import build_aggregator
+
+    if duration_s < 40 * interval:
+        raise ValueError(
+            "capacity soak needs >= 40 intervals for a fittable ramp"
+        )
+    # Ramp pool: duty climbs 50% -> 86% over the soak, so saturation
+    # (95%) sits a known distance PAST the end — the forecast must
+    # extrapolate, not read it off. job-b carries collective-wait
+    # contention so the waste ranking has a real top entry.
+    duty0 = 50.0
+    rate = 36.0 / duration_s  # percent per second
+    ramp = [
+        _ScriptedLedgerNode("job-a", "n0", pool="v5p-16"),
+        _ScriptedLedgerNode("job-b", "n1", pool="v5p-16"),
+    ]
+    ramp[1].state["wait"] = 0.45
+    # Sparse pool: dead until 75% of the soak; its history can never
+    # reach the gate below, so a served date would be a fabrication.
+    sparse = _ScriptedLedgerNode("job-sparse", "n2", pool="v4-8")
+    sparse.state["dead"] = True
+    sim = ramp + [sparse]
+    for n in sim:
+        n.state.update(duty=duty0, step_rate=2.0)
+    min_history_s = 0.45 * duration_s
+
+    cfg = FleetConfig(
+        port=0, addr="127.0.0.1",
+        targets=",".join(n.url for n in sim),
+        interval=interval, stale_s=3.0 * interval, evict_s=3600.0,
+        guard=False, trace=False,
+        poll_backoff_max_s=max(1.0, 8 * interval),
+        ledger_forecast_min_history_s=min_history_s,
+        ledger_forecast_every_s=interval,
+    )
+    agg = build_aggregator(cfg)
+    agg.start()
+
+    def fetch(path: str) -> dict:
+        with urllib.request.urlopen(agg.url + path, timeout=5) as resp:
+            return json.loads(resp.read())
+
+    t0 = time.time()
+    time.sleep(3 * interval)  # first accounting windows land
+    t_first = time.time()
+    sparse_alive_frac = 0.75
+    try:
+        last_scrape = 0.0
+        scrapes = failed_scrapes = 0
+        while True:
+            now = time.time()
+            if now >= t0 + duration_s:
+                break
+            duty = min(95.0, duty0 + rate * (now - t0))
+            for n in ramp:
+                n.state["duty"] = duty
+            if sparse.state["dead"] and now >= t0 + sparse_alive_frac * duration_s:
+                sparse.state.update(dead=False, duty=60.0)
+            if now - last_scrape >= scrape_every_s:
+                last_scrape = now
+                scrapes += 1
+                try:
+                    with urllib.request.urlopen(
+                        agg.url + "/metrics", timeout=5
+                    ) as resp:
+                        if resp.status != 200:
+                            failed_scrapes += 1
+                        resp.read()
+                except OSError:
+                    failed_scrapes += 1
+            time.sleep(interval / 4.0)
+        time.sleep(2 * interval)  # last windows + a forecast recompute
+        t_end = time.time()
+
+        # --- Forecast vs scripted ground truth -----------------------
+        fdoc = fetch("/ledger?view=forecast")
+        computed_at = fdoc.get("computed_at", t_end)
+        pools = fdoc.get("pools", {})
+        ramp_verdict = pools.get("v5p-16", {})
+        forecast_days = ramp_verdict.get("days_to_saturation")
+        duty_at_compute = min(95.0, duty0 + rate * (computed_at - t0))
+        truth_days = (95.0 - duty_at_compute) / rate / 86400.0
+        err_ratio = (
+            abs(forecast_days - truth_days) / truth_days
+            if forecast_days is not None and truth_days > 0 else None
+        )
+        sparse_verdict = pools.get("v4-8", {})
+        sparse_honest = (
+            sparse_verdict.get("status") == "insufficient_history"
+            and sparse_verdict.get("days_to_saturation") is None
+        )
+
+        # --- Waste ranking conservation ------------------------------
+        waste = fetch(
+            "/ledger?view=waste&group_by=job&rank=topk:10"
+            "&whatif=dollars_per_kwh:0.12"
+        )
+        cons = waste.get("conservation", {})
+        cons_err = abs(
+            cons.get("sum_groups_chip_seconds", 0.0)
+            - cons.get("total_chip_seconds", -1.0)
+        )
+        pct = fetch("/ledger?view=percentiles")
+
+        # --- Bounded grouped walk == unbounded fold ------------------
+        base = (
+            "/ledger?family=tpu_fleet_duty_cycle_percent&scope=slice"
+            f"&agg=mean&by=pool&start={t_first:.3f}&end={t_end:.3f}"
+        )
+        unbounded = fetch(base)
+        assert "next_start" not in unbounded, "control fold truncated"
+
+        def groups_of(doc: dict) -> dict:
+            return {
+                (row["pool"], row["slice"]): list(row["points"])
+                for row in doc.get("series", [])
+            }
+
+        walked: dict = {}
+        pages = 0
+        start = t_first
+        while pages < 1000:
+            page = fetch(
+                "/ledger?family=tpu_fleet_duty_cycle_percent"
+                "&scope=slice&agg=mean&by=pool"
+                f"&start={start:.3f}&end={t_end:.3f}&max_points=7"
+            )
+            pages += 1
+            for group, points in groups_of(page).items():
+                walked.setdefault(group, []).extend(points)
+            if "next_start" not in page:
+                break
+            start = page["next_start"]
+        walk_equal = walked == groups_of(unbounded)
+
+        return {
+            "mode": "capacity",
+            "duration_s": round(t_end - t0, 1),
+            "interval": interval,
+            "ramp": {"duty0": duty0, "rate_pct_per_s": round(rate, 6),
+                     "saturation_pct": 95.0},
+            "forecast": {
+                "status": ramp_verdict.get("status"),
+                "leading_signal": ramp_verdict.get("leading_signal"),
+                "days_to_saturation": forecast_days,
+                "days_lo": ramp_verdict.get("days_lo"),
+                "days_hi": ramp_verdict.get("days_hi"),
+                "truth_days": truth_days,
+                "err_ratio": err_ratio,
+                "min_history_s": round(min_history_s, 3),
+            },
+            "sparse_pool": {
+                "status": sparse_verdict.get("status"),
+                "honest": sparse_honest,
+            },
+            "waste": {
+                "rows": len(waste.get("rows", [])),
+                "top": (waste.get("rows") or [{}])[0].get("key"),
+                "conservation_abs_err": cons_err,
+                "whatif": waste.get("whatif"),
+            },
+            "percentile_classes": sorted(pct.get("classes", {})),
+            "walk": {"equal": walk_equal, "pages": pages,
+                     "groups": len(walked)},
+            "scrapes": scrapes,
+            "failed_scrapes": failed_scrapes,
+        }
+    finally:
+        agg.close()
+        for n in sim:
+            n.close()
+
+
 def _free_port() -> int:
     """An ephemeral port the OS just handed out (racy by nature, fine
     for a soak: the fleet-chaos shards need KNOWN ports up front so the
@@ -3211,6 +3407,17 @@ def main(argv=None) -> int:
                         "conservation invariant, kill-window honesty "
                         "(unaccounted, never idle), spool restore, and "
                         "a served range query")
+    parser.add_argument("--capacity", action="store_true",
+                        help="capacity-forecast acceptance soak "
+                        "(ISSUE 17): a scripted linear duty ramp "
+                        "behind a forecast-enabled aggregator plus a "
+                        "history-gated sparse pool; reports the "
+                        "forecast's days-to-saturation against the "
+                        "script's ground truth, the sparse pool's "
+                        "insufficient-history honesty, the top-k "
+                        "waste ranking's conservation block, and a "
+                        "bounded grouped query walked to completion "
+                        "vs its unbounded fold")
     parser.add_argument("--fleet-delta", action="store_true",
                         help="delta fan-in acceptance soak (ISSUE 13): "
                         "--fleet-nodes simulated exporters behind one "
@@ -3289,6 +3496,11 @@ def main(argv=None) -> int:
     elif args.straggler:
         record = straggler_soak(
             args.duration, topology=args.topology,
+            interval=args.interval, scrape_every_s=args.scrape_every,
+        )
+    elif args.capacity:
+        record = capacity_soak(
+            args.duration,
             interval=args.interval, scrape_every_s=args.scrape_every,
         )
     elif args.ledger:

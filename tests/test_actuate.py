@@ -459,8 +459,20 @@ def test_adapter_job_label_aliases_slice():
 
 
 def test_adapter_stale_row_marked_honestly():
+    # At the default trust floor a stale row is WITHHELD (absent item,
+    # the HPA holds); serving-stale-but-marked is the floor-0 operator
+    # choice ("always answer, I read the flags myself").
     now = 1000.0
     adapter = _cycled_plane(now=now, stale=True).adapter
+    status, body, _, result = adapter.handle(
+        f"{API_PREFIX}/{API_VERSION}/namespaces/default/"
+        "tpumon_serve_queue_depth",
+        "",
+        now=now + 1.0,
+    )
+    assert (status, result) == ("200 OK", "withheld")
+    assert json.loads(body)["items"] == []
+    adapter = _cycled_plane(now=now, stale=True, min_trust=0.0).adapter
     status, body, _, result = adapter.handle(
         f"{API_PREFIX}/{API_VERSION}/namespaces/default/"
         "tpumon_serve_queue_depth",

@@ -1,0 +1,741 @@
+"""Fail-safe actuation (ISSUE 18): trust-gated control signals,
+split-brain ownership epochs, hint-band freezing, and the spool /
+seeding plumbing that keeps all of it warm across restarts.
+
+Everything runs against synthetic rollup docs and feed entries — the
+same no-sockets stance as tests/test_actuate.py. The live two-shard
+behavior (takeover epochs, contested windows, spool restore) is
+exercised end-to-end by ``tpumon.tools.soak --actuate-chaos``.
+"""
+
+import json
+import logging
+
+import pytest
+
+from tpumon.actuate.plane import ActuatePlane
+from tpumon.actuate.trust import (
+    DEFAULT_MIN_TRUST,
+    FACTOR_CONTESTED,
+    FACTOR_STALE,
+    WARMTH_WEIGHT,
+    is_trusted,
+    min_trust_from_env,
+    trust_score,
+)
+from tpumon.fleet.failover import MembershipPlane
+from tpumon.fleet.spool import SnapshotSpool
+
+
+# -- trust scoring ----------------------------------------------------------
+
+
+def test_trust_score_clean_is_full():
+    trust, inputs = trust_score(visibility=1.0)
+    assert trust == 1.0
+    assert inputs["visibility"] == 1.0
+    assert inputs["stale"] is False
+    assert inputs["contested"] is False
+    assert "restored_fraction" not in inputs
+
+
+def test_trust_score_no_inputs_stays_full():
+    # A plane cycled without degradation plumbing (unit fixtures, older
+    # callers) must not suddenly distrust everything.
+    trust, inputs = trust_score()
+    assert trust == 1.0
+    assert "visibility" not in inputs
+
+
+def test_trust_score_factors():
+    assert trust_score(visibility=0.5)[0] == pytest.approx(0.5)
+    assert trust_score(stale=True)[0] == pytest.approx(FACTOR_STALE)
+    assert trust_score(contested=True)[0] == pytest.approx(
+        FACTOR_CONTESTED
+    )
+    assert trust_score(restored_fraction=1.0)[0] == pytest.approx(
+        1.0 - WARMTH_WEIGHT
+    )
+    # One warm feed in ten barely registers.
+    assert trust_score(restored_fraction=0.1)[0] == pytest.approx(0.95)
+
+
+def test_trust_score_compounds_multiplicatively():
+    trust, inputs = trust_score(visibility=0.5, stale=True)
+    assert trust == pytest.approx(0.5 * FACTOR_STALE)
+    assert inputs == {
+        "visibility": 0.5, "stale": True, "contested": False,
+    }
+    trust, _ = trust_score(
+        visibility=0.5, stale=True, contested=True, restored_fraction=1.0
+    )
+    assert trust == pytest.approx(
+        0.5 * FACTOR_STALE * FACTOR_CONTESTED * (1.0 - WARMTH_WEIGHT)
+    )
+
+
+def test_trust_score_clamps_hostile_inputs():
+    assert trust_score(visibility=7.0)[0] == 1.0
+    assert trust_score(visibility=-3.0)[0] == 0.0
+    assert trust_score(restored_fraction=99.0)[0] == pytest.approx(
+        1.0 - WARMTH_WEIGHT
+    )
+
+
+def test_is_trusted_gate():
+    assert is_trusted(None, 0.99)  # no score computed: stays trusted
+    assert is_trusted(0.5, 0.5)  # AT the floor serves
+    assert not is_trusted(0.49, 0.5)
+    assert is_trusted(1.0, 0.0)
+
+
+def test_min_trust_from_env_literal_wins():
+    assert min_trust_from_env(
+        0.7, environ={"TPUMON_ACTUATE_MIN_TRUST": "0.25"}
+    ) == 0.25
+    # Absent/blank: the FleetConfig-derived default stands.
+    assert min_trust_from_env(0.7, environ={}) == 0.7
+    assert min_trust_from_env(
+        0.7, environ={"TPUMON_ACTUATE_MIN_TRUST": "  "}
+    ) == 0.7
+
+
+def test_min_trust_from_env_malformed_keeps_default(caplog):
+    with caplog.at_level(logging.WARNING, logger="tpumon.actuate.trust"):
+        got = min_trust_from_env(
+            0.6, environ={"TPUMON_ACTUATE_MIN_TRUST": "lots"}
+        )
+    assert got == 0.6
+    assert "TPUMON_ACTUATE_MIN_TRUST" in caplog.text
+
+
+def test_min_trust_from_env_clamps():
+    assert min_trust_from_env(
+        0.5, environ={"TPUMON_ACTUATE_MIN_TRUST": "7"}
+    ) == 1.0
+    assert min_trust_from_env(
+        0.5, environ={"TPUMON_ACTUATE_MIN_TRUST": "-1"}
+    ) == 0.0
+
+
+# -- plane gating -----------------------------------------------------------
+
+
+def _bucket(**over):
+    bucket = {
+        "chips": 4,
+        "duty": {"mean": 40.0, "n": 8},
+        "hbm_headroom_ratio": 0.5,
+        "ici": {"links": 4, "score": 1.0},
+        "stragglers": 0,
+        "stale": False,
+        "visibility": 1.0,
+    }
+    bucket.update(over)
+    return bucket
+
+
+def _entry(target, pool, slc, state="up", serve=None):
+    snap = {"identity": {"accelerator": pool, "slice": slc}}
+    if serve is not None:
+        snap["serve"] = serve
+    return (target, snap, state)
+
+
+SERVE = {
+    "requests_per_second": 8.0,
+    "queue_depth": 3.0,
+    "ttft_seconds": 0.12,
+    "slo_attainment_ratio": 1.0,
+    "batch_size": 32.0,
+}
+
+
+def _doc(**slices):
+    return {"slices": {key: bucket for key, bucket in slices.items()}}
+
+
+def _row(plane, pool, slc):
+    return next(
+        r for r in plane.rows()
+        if r["pool"] == pool and r["slice"] == slc
+    )
+
+
+def _cycle(plane, now=1000.0, *, buckets=None, entries=None, **kw):
+    doc = {"slices": buckets or {("v4-8", "s0"): _bucket()}}
+    plane.cycle(
+        now, doc,
+        entries if entries is not None
+        else [_entry("http://n0", "v4-8", "s0", serve=SERVE)],
+        **kw,
+    )
+
+
+def test_clean_scope_is_trusted_and_served():
+    plane = ActuatePlane()
+    _cycle(plane)
+    row = _row(plane, "v4-8", "s0")
+    assert row["trust"] == 1.0
+    assert row["withheld"] is False
+    assert row["withheld_reason"] is None
+    assert row["band_frozen"] is False
+    status, body, _metric, result = plane.adapter.handle(
+        "/apis/external.metrics.k8s.io/v1beta1/namespaces/default"
+        "/tpumon_serve_queue_depth", "", now=1000.0,
+    )
+    assert status == "200 OK"
+    assert result == "ok"
+    items = json.loads(body)["items"]
+    assert [i["metricLabels"]["slice"] for i in items] == ["s0"]
+
+
+@pytest.mark.parametrize(
+    "degraded",
+    [
+        {"visibility": 0.25},
+        {"stale": True},  # FACTOR_STALE alone sits under the floor
+    ],
+)
+def test_degraded_scope_answers_absent_never_a_value(degraded):
+    plane = ActuatePlane()
+    _cycle(plane, buckets={("v4-8", "s0"): _bucket(**degraded)})
+    row = _row(plane, "v4-8", "s0")
+    assert row["trust"] < DEFAULT_MIN_TRUST
+    assert row["withheld"] is True
+    assert row["withheld_reason"] == "untrusted"
+    status, body, _metric, result = plane.adapter.handle(
+        "/apis/external.metrics.k8s.io/v1beta1/namespaces/default"
+        "/tpumon_serve_queue_depth", "", now=1000.0,
+    )
+    # The Kubernetes-correct "no data": an ABSENT item (the HPA holds),
+    # never a last-good or fabricated value, and never an error.
+    assert status == "200 OK"
+    assert result == "withheld"
+    assert json.loads(body)["items"] == []
+
+
+def test_contested_cycle_withholds_everything():
+    plane = ActuatePlane()
+    _cycle(plane, contested=True)
+    row = _row(plane, "v4-8", "s0")
+    assert row["trust"] == pytest.approx(FACTOR_CONTESTED)
+    assert row["withheld_reason"] == "untrusted"
+    assert row["trust_inputs"]["contested"] is True
+
+
+def test_restored_fraction_feeds_trust():
+    plane = ActuatePlane()
+    entries = [
+        _entry("http://n0", "v4-8", "s0", serve=SERVE),
+        _entry("http://n1", "v4-8", "s0", serve=SERVE),
+    ]
+    _cycle(plane, entries=entries, restored_targets={"http://n1"})
+    row = _row(plane, "v4-8", "s0")
+    assert row["trust_inputs"]["restored_fraction"] == 0.5
+    assert row["trust"] == pytest.approx(1.0 - WARMTH_WEIGHT * 0.5)
+    # Half-warm sits above the floor; fully-warm sits AT it — served.
+    assert row["withheld"] is False
+    _cycle(
+        plane, entries=entries,
+        restored_targets={"http://n0", "http://n1"},
+    )
+    row = _row(plane, "v4-8", "s0")
+    assert row["trust"] == pytest.approx(DEFAULT_MIN_TRUST)
+    assert row["withheld"] is False
+
+
+def test_configured_floor_is_respected():
+    plane = ActuatePlane(min_trust=0.0)
+    _cycle(plane, buckets={("v4-8", "s0"): _bucket(stale=True)})
+    row = _row(plane, "v4-8", "s0")
+    # Floor 0: even a stale scope serves (marked stale, not withheld).
+    assert row["withheld"] is False
+    strict = ActuatePlane(min_trust=0.99)
+    _cycle(strict, buckets={("v4-8", "s0"): _bucket(visibility=0.95)})
+    assert _row(strict, "v4-8", "s0")["withheld"] is True
+
+
+# -- hint-band freeze + decay ----------------------------------------------
+
+
+def test_withheld_band_freezes_at_last_good_then_decays():
+    plane = ActuatePlane(hint_decay_s=30.0)
+    good = {("v4-8", "s0"): _bucket()}
+    bad = {("v4-8", "s0"): _bucket(visibility=0.1)}
+    _cycle(plane, now=1000.0, buckets=good)
+    band = _row(plane, "v4-8", "s0")["band"]
+    assert band in ("prefer", "neutral", "avoid")
+    # Degraded: the band freezes at last-good instead of re-deriving
+    # from a half-visible rollup.
+    _cycle(plane, now=1010.0, buckets=bad)
+    row = _row(plane, "v4-8", "s0")
+    assert row["withheld"] is True
+    assert row["band_frozen"] is True
+    assert row["band"] == band
+    # Still inside the decay window: frozen at last-good.
+    _cycle(plane, now=1029.0, buckets=bad)
+    assert _row(plane, "v4-8", "s0")["band"] == band
+    # Degradation outlived the window: decay to neutral — a scheduler
+    # must not steer on hour-old bands.
+    _cycle(plane, now=1041.0, buckets=bad)
+    row = _row(plane, "v4-8", "s0")
+    assert row["band"] == "neutral"
+    assert row["band_frozen"] is True
+
+
+def test_withheld_scope_with_no_band_history_reads_neutral():
+    plane = ActuatePlane()
+    _cycle(plane, buckets={("v4-8", "s0"): _bucket(visibility=0.1)})
+    row = _row(plane, "v4-8", "s0")
+    assert row["band_frozen"] is True
+    assert row["band"] == "neutral"
+
+
+def test_recovery_unfreezes_and_resumes_hysteresis():
+    plane = ActuatePlane(hint_decay_s=30.0)
+    good = {("v4-8", "s0"): _bucket()}
+    _cycle(plane, now=1000.0, buckets=good)
+    band = _row(plane, "v4-8", "s0")["band"]
+    _cycle(
+        plane, now=1010.0,
+        buckets={("v4-8", "s0"): _bucket(visibility=0.1)},
+    )
+    _cycle(plane, now=1011.0, buckets=good)
+    row = _row(plane, "v4-8", "s0")
+    assert row["withheld"] is False
+    assert row["band_frozen"] is False
+    assert row["band"] == band
+    # A later freeze restarts the decay clock from ITS onset.
+    _cycle(
+        plane, now=1050.0,
+        buckets={("v4-8", "s0"): _bucket(visibility=0.1)},
+    )
+    assert _row(plane, "v4-8", "s0")["band"] == band
+
+
+# -- split-brain ownership epochs ------------------------------------------
+
+
+def _epoch_cycle(plane, *, epoch, peer_epoch, contested, now=1000.0):
+    plane.cycle(
+        now,
+        {"slices": {("v4-8", "s0"): _bucket()}},
+        [_entry("http://n0", "v4-8", "s0", serve=SERVE)],
+        target_epochs={"http://n0": epoch} if epoch else {},
+        peer_scope_epochs=(
+            {("v4-8", "s0"): peer_epoch} if peer_epoch else {}
+        ),
+        contested=contested,
+    )
+
+
+def test_epoch_conflict_older_claim_withholds():
+    plane = ActuatePlane()
+    _epoch_cycle(plane, epoch=2, peer_epoch=3, contested=True)
+    row = _row(plane, "v4-8", "s0")
+    assert row["epoch"] == 2
+    # epoch_conflict outranks the (also-true) contested distrust: the
+    # reason names the resolution, not just the symptom.
+    assert row["withheld_reason"] == "epoch_conflict"
+    assert plane.debug_block()["epoch_conflicts_total"] == 1
+
+
+def test_epoch_conflict_newer_claim_serves_and_counts():
+    plane = ActuatePlane(min_trust=0.0)
+    _epoch_cycle(plane, epoch=3, peer_epoch=2, contested=True)
+    row = _row(plane, "v4-8", "s0")
+    # Newest wins: we hold the newer claim, so we serve — but the
+    # conflict is still counted (both sides observed the split brain).
+    assert row["withheld_reason"] != "epoch_conflict"
+    assert plane.debug_block()["epoch_conflicts_total"] == 1
+
+
+def test_equal_epochs_and_uncontested_are_not_conflicts():
+    plane = ActuatePlane(min_trust=0.0)
+    _epoch_cycle(plane, epoch=2, peer_epoch=2, contested=True)
+    assert plane.debug_block()["epoch_conflicts_total"] == 0
+    # Rendezvous legitimately splits a slice across shards: differing
+    # epochs WITHOUT a contested rollup are steady state, not conflict.
+    _epoch_cycle(plane, epoch=2, peer_epoch=5, contested=False)
+    row = _row(plane, "v4-8", "s0")
+    assert plane.debug_block()["epoch_conflicts_total"] == 0
+    assert row["withheld_reason"] is None
+
+
+def test_scope_epochs_published_for_peers():
+    plane = ActuatePlane()
+    plane.cycle(
+        1000.0,
+        {"slices": {("v4-8", "s0"): _bucket()}},
+        [
+            _entry("http://n0", "v4-8", "s0", serve=SERVE),
+            _entry("http://n1", "v4-8", "s0", serve=SERVE),
+        ],
+        target_epochs={"http://n0": 2, "http://n1": 7},
+    )
+    assert plane.scope_epochs() == {("v4-8", "s0"): 7}
+
+
+# -- membership-plane epoch minting ----------------------------------------
+
+
+def _membership(fetch, initial_epochs=None, clock=None, shard_count=2):
+    from tpumon.fleet.config import FleetConfig
+
+    cfg = FleetConfig(
+        targets=",".join(f"node-{i}:9400" for i in range(8)),
+        shard_index=0, shard_count=shard_count,
+        # Index-aligned, self included (peer0 is this shard's own URL).
+        peers=",".join(
+            f"http://peer{i}:9500" for i in range(shard_count)
+        ),
+        probe_interval=1.0, takeover_s=5.0, discovery_interval=1.0,
+    )
+    return MembershipPlane(
+        cfg,
+        on_membership=lambda owned, info: None,
+        clock=clock or (lambda: 0.0),
+        fetch=fetch,
+        initial_epochs=initial_epochs,
+    )
+
+
+def test_takeover_mints_above_every_alive_peers_advertised_seq():
+    """Adoption stamps orphans with an epoch strictly above our own
+    mint counter AND the highest seq any ALIVE peer advertises (the
+    Lamport receive rule). The dead peer's own seq is deliberately NOT
+    folded — its claim is superseded newest-wins at the read model, and
+    its warm restart skips ahead of the adoption on its own."""
+    clock = [0.0]
+    peer1_ok = [True]
+
+    def fetch(url):
+        if "peer1" in url:
+            if not peer1_ok[0]:
+                raise OSError("down")
+            return {"fleet": {}, "epoch_seq": 1}
+        # peer2 stays alive the whole drill, advertising a high seq.
+        return {"fleet": {}, "epoch_seq": 5}
+
+    plane = _membership(fetch, clock=lambda: clock[0], shard_count=3)
+    try:
+        first_seq = plane.epoch_seq()
+        assert first_seq >= 1  # startup claim minted
+        own = set(plane.epochs())
+        assert own and all(
+            e == first_seq for e in plane.epochs().values()
+        )
+        clock[0] = 2.0
+        plane.tick()
+        peer1_ok[0] = False
+        clock[0] = 10.0
+        plane.tick()
+        adopted = set(plane.epochs()) - own
+        assert adopted
+        adopted_seq = plane.epoch_seq()
+        assert adopted_seq > 5  # folded alive peer2's advertised seq
+        assert all(plane.epochs()[t] == adopted_seq for t in adopted)
+        # Own targets keep their original (older) claim — adoption
+        # never re-stamps what we already owned.
+        assert all(plane.epochs()[t] == first_seq for t in own)
+        # Hand-back drops the adopted epochs — the new owner's claim is
+        # the only live one — but the mint counter never rewinds.
+        peer1_ok[0] = True
+        clock[0] = 11.0
+        plane.tick()
+        assert set(plane.epochs()) == own
+        assert plane.snapshot()["epoch_seq"] == adopted_seq
+    finally:
+        plane.stop()
+
+
+def test_warm_restart_reclaims_strictly_newer():
+    """The tie-break that makes newest-wins decidable: a peer adopting
+    our targets while we were down folded our LAST journaled seq and
+    minted one above it; restarting from that same journal must skip
+    ahead, so the re-claim epoch beats the adoption epoch."""
+    journaled = 3
+    adoption_epoch = journaled + 1  # what the surviving peer minted
+    plane = _membership(
+        lambda url: {"fleet": {}},
+        initial_epochs=(journaled, {"node-0:9400": journaled}),
+    )
+    try:
+        reclaim = plane.epoch_seq()
+        assert reclaim > adoption_epoch
+        assert all(e == reclaim for e in plane.epochs().values())
+    finally:
+        plane.stop()
+
+
+def test_corrupt_spool_epochs_cost_warmth_never_startup():
+    plane = _membership(
+        lambda url: {"fleet": {}},
+        initial_epochs=("garbage", "also-garbage"),
+    )
+    try:
+        assert plane.epoch_seq() >= 1  # fresh mint, no crash
+        junk = _membership(
+            lambda url: {"fleet": {}},
+            initial_epochs=(2, {"node-0:9400": "nope", 7: 3}),
+        )
+        junk.stop()
+    finally:
+        plane.stop()
+
+
+# -- spool persistence + band seeding --------------------------------------
+
+
+def test_spool_actuate_section_roundtrip(tmp_path):
+    spool = SnapshotSpool(str(tmp_path))
+    nodes = {"http://n1:9400": {"snap": {}, "fetched_at": 123.0}}
+    actuate = {
+        "bands": [["v4-8", "s0", "prefer"]],
+        "epoch_seq": 4,
+        "target_epochs": {"http://n1:9400": 4},
+    }
+    assert spool.save(["http://n1:9400"], nodes, actuate=actuate)
+    loaded = SnapshotSpool(str(tmp_path)).load()
+    assert loaded["actuate"] == actuate
+    # A spool written without the section (older writer) loads {}.
+    assert spool.save(["http://n1:9400"], nodes)
+    assert SnapshotSpool(str(tmp_path)).load()["actuate"] == {}
+
+
+def test_spool_actuate_wrong_shape_ignored(tmp_path):
+    import json as _json
+
+    from tpumon.fleet.spool import SPOOL_VERSION
+
+    spool = SnapshotSpool(str(tmp_path))
+    with open(spool.path, "w", encoding="utf-8") as fh:
+        _json.dump(
+            {
+                "version": SPOOL_VERSION,
+                "universe": [],
+                "nodes": {},
+                "actuate": ["not", "a", "dict"],
+            },
+            fh,
+        )
+    assert spool.load()["actuate"] == {}
+
+
+def test_band_state_export_and_seed_fill_only_missing():
+    plane = ActuatePlane()
+    _cycle(plane)
+    state = plane.band_state()
+    assert state == [["v4-8", "s0", _row(plane, "v4-8", "s0")["band"]]]
+    # Seeding a fresh plane warms scopes with NO history; the live
+    # scope's band must never regress to a seeded value.
+    fresh = ActuatePlane()
+    fresh.seed_bands(
+        [
+            ["v4-8", "s0", "avoid"],  # adopted scope, previously avoid
+            ["v4-8", "ghost", "avoid"],  # not (yet) reporting
+            ["v4-8", "junk"],  # wrong arity: ignored
+            "garbage",  # wrong type: ignored
+        ]
+    )
+    doc = {"slices": {("v4-8", "s0"): _bucket()}}
+    entries = [_entry("http://n0", "v4-8", "s0", serve=SERVE)]
+    fresh.cycle(1000.0, doc, entries)
+    # Continuity first: the seeded band holds through hysteresis — a
+    # takeover must not flap adopted scopes on their first cycle even
+    # when the live score disagrees.
+    assert _row(fresh, "v4-8", "s0")["band"] == "avoid"
+    # The cycle prunes seeded scopes that never reported: the spool
+    # must not carry ghost scopes forever, and /hints never advertises
+    # scopes it cannot see.
+    assert fresh.band_state() == [["v4-8", "s0", "avoid"]]
+    assert fresh.published_bands() == [["v4-8", "s0", "avoid"]]
+    # ...then live data wins: sustained good scores walk the band back
+    # to what an unseeded plane derives.
+    for i in range(1, 8):
+        fresh.cycle(1000.0 + i, doc, entries)
+    assert (
+        _row(fresh, "v4-8", "s0")["band"]
+        == _row(plane, "v4-8", "s0")["band"]
+    )
+
+
+def test_published_bands_reads_the_lock_published_model():
+    plane = ActuatePlane()
+    _cycle(plane)
+    bands = plane.published_bands()
+    assert bands == [["v4-8", "s0", _row(plane, "v4-8", "s0")["band"]]]
+
+
+# -- telemetry: families, /hints, /debug/vars ------------------------------
+
+
+def _family_samples(plane, name):
+    for family in plane.families():
+        if family.name == name:
+            return family.samples
+    return None
+
+
+def test_trust_families_emitted():
+    plane = ActuatePlane()
+    _cycle(plane, buckets={
+        ("v4-8", "s0"): _bucket(),
+        ("v4-8", "s1"): _bucket(visibility=0.1),
+    }, target_epochs={"http://n0": 3})
+    trust = _family_samples(plane, "tpu_actuate_trust_score")
+    by_slice = {s.labels["slice"]: s.value for s in trust}
+    assert by_slice["s0"] == 1.0
+    assert by_slice["s1"] == pytest.approx(0.1)
+    epoch = _family_samples(plane, "tpu_actuate_scope_epoch")
+    assert {s.labels["slice"]: s.value for s in epoch} == {"s0": 3.0}
+    frozen = _family_samples(plane, "tpu_actuate_hint_frozen")
+    frozen_by_slice = {s.labels["slice"]: s.value for s in frozen}
+    assert frozen_by_slice == {"s0": 0.0, "s1": 1.0}
+    withheld = _family_samples(plane, "tpu_actuate_withheld")
+    labels = {
+        (s.labels["slice"], s.labels["reason"]): s.value
+        for s in withheld
+    }
+    assert labels == {("s1", "untrusted"): 1.0}
+
+
+def test_withheld_counter_is_monotonic_across_cycles():
+    plane = ActuatePlane()
+    bad = {("v4-8", "s0"): _bucket(visibility=0.1)}
+    _cycle(plane, now=1000.0, buckets=bad)
+    _cycle(plane, now=1001.0, buckets=bad)
+    withheld = _family_samples(plane, "tpu_actuate_withheld")
+    assert [s.value for s in withheld] == [2.0]
+    assert plane.debug_block()["withheld_total"] == 2
+
+
+def test_epoch_conflict_family_emitted():
+    plane = ActuatePlane()
+    _epoch_cycle(plane, epoch=2, peer_epoch=3, contested=True)
+    conflicts = _family_samples(plane, "tpu_actuate_epoch_conflicts")
+    assert [(s.labels["slice"], s.value) for s in conflicts] == [
+        ("s0", 1.0)
+    ]
+
+
+def test_hints_response_carries_trust_and_thresholds():
+    plane = ActuatePlane(min_trust=0.5, hint_decay_s=45.0)
+    _cycle(plane, buckets={
+        ("v4-8", "s0"): _bucket(),
+        ("v4-8", "s1"): _bucket(visibility=0.1),
+    })
+    doc = json.loads(plane.hints_response("")[0])
+    assert doc["thresholds"]["min_trust"] == 0.5
+    assert doc["thresholds"]["hint_decay_s"] == 45.0
+    by_slice = {row["slice"]: row for row in doc["slices"]}
+    assert by_slice["s0"]["trust"] == 1.0
+    assert by_slice["s0"]["withheld"] is False
+    assert by_slice["s1"]["withheld"] is True
+    assert by_slice["s1"]["frozen"] is True
+    assert by_slice["s1"]["withheld_reason"] == "untrusted"
+    assert by_slice["s1"]["trust_inputs"]["visibility"] == 0.1
+
+
+def test_debug_block_trust_fields():
+    plane = ActuatePlane()
+    _cycle(plane, buckets={("v4-8", "s0"): _bucket(visibility=0.1)})
+    block = plane.debug_block()
+    assert block["min_trust"] == DEFAULT_MIN_TRUST
+    assert block["withheld_slices"] == 1
+    assert block["frozen_slices"] == 1
+    assert block["contested"] is False
+    assert block["withheld_total"] == 1
+    assert block["epoch_conflicts_total"] == 0
+
+
+# -- matrix: rollup state × trust floor → exact adapter response -----------
+
+
+def _adapter_items(plane, now=1000.0):
+    _status, body, _metric, result = plane.adapter.handle(
+        "/apis/external.metrics.k8s.io/v1beta1/namespaces/default"
+        "/tpumon_serve_queue_depth", "", now=now,
+    )
+    return json.loads(body)["items"], result
+
+
+#: (rollup state, trust floor) -> (served?, stale-marked?, result).
+#: The full cross product, pinned: the adapter's answer must be a pure
+#: function of the row's trust vs the floor — state never leaks a
+#: value through a floor that forbids it.
+MATRIX = [
+    ("fresh", 0.0, True, False, "ok"),
+    ("fresh", 0.5, True, False, "ok"),
+    ("fresh", 0.99, True, False, "ok"),
+    ("stale", 0.0, True, True, "stale"),
+    ("stale", 0.5, False, None, "withheld"),
+    ("stale", 0.99, False, None, "withheld"),
+    ("half_visible", 0.0, True, False, "ok"),
+    ("half_visible", 0.5, True, False, "ok"),  # 0.5 sits AT the floor
+    ("half_visible", 0.99, False, None, "withheld"),
+    ("contested", 0.0, True, False, "ok"),
+    ("contested", 0.5, False, None, "withheld"),
+    ("restored", 0.0, True, False, "ok"),
+    ("restored", 0.5, True, False, "ok"),  # warmth sits AT the floor
+    ("restored", 0.99, False, None, "withheld"),
+]
+
+
+def _matrix_cycle(plane, state):
+    bucket = _bucket()
+    kw = {}
+    if state == "stale":
+        bucket = _bucket(stale=True)
+    elif state == "half_visible":
+        bucket = _bucket(visibility=0.5)
+    elif state == "contested":
+        kw["contested"] = True
+    elif state == "restored":
+        kw["restored_targets"] = {"http://n0"}
+    plane.cycle(
+        1000.0,
+        {"slices": {("v4-8", "s0"): bucket}},
+        [_entry("http://n0", "v4-8", "s0", serve=SERVE)],
+        **kw,
+    )
+
+
+@pytest.mark.parametrize(
+    "state,floor,served,stale_marked,result", MATRIX
+)
+def test_matrix_state_by_floor(state, floor, served, stale_marked, result):
+    plane = ActuatePlane(min_trust=floor)
+    _matrix_cycle(plane, state)
+    items, got_result = _adapter_items(plane)
+    assert got_result == result, (state, floor)
+    if not served:
+        assert items == [], (state, floor)
+        return
+    assert len(items) == 1, (state, floor)
+    item = items[0]
+    assert item["metricLabels"]["pool"] == "v4-8"
+    assert item["value"] == "3"  # SERVE queue_depth, exact
+    assert (
+        item["metricLabels"].get("tpumon_stale") == "true"
+    ) is stale_marked, (state, floor)
+
+
+@pytest.mark.parametrize(
+    "state,floor,served,stale_marked,result", MATRIX
+)
+def test_matrix_holds_on_spool_restored_read_model(
+    state, floor, served, stale_marked, result
+):
+    """The same matrix against a warm-restarted plane: band state
+    seeded from the spool, first cycle still honoring the floor — a
+    restore must not leak a degraded value the fresh plane withholds."""
+    plane = ActuatePlane(min_trust=floor)
+    plane.seed_bands([["v4-8", "s0", "prefer"]])
+    _matrix_cycle(plane, state)
+    items, got_result = _adapter_items(plane)
+    assert got_result == result, (state, floor)
+    assert (len(items) == 1) is served, (state, floor)
+    if served:
+        assert items[0]["value"] == "3"

@@ -42,7 +42,7 @@ def _known_metric_names():
 _METRIC_RE = re.compile(
     r"\b(?:(?:accelerator|exporter|collector|workload|tpu_anomaly"
     r"|tpu_hostcorr|tpu_straggler|tpu_lifecycle|tpu_step"
-    r"|tpu_energy|tpu_pod_energy|tpu_ledger"
+    r"|tpu_energy|tpu_pod_energy|tpu_ledger|tpu_actuate"
     r"|tpu_fleet|tpumon_trace|tpumon_poll|tpumon_family|tpumon_breaker"
     r"|tpumon_retries|tpumon_watchdog|tpumon_guard|tpumon_shed"
     r"|tpumon_cardinality|tpumon_render|tpumon_exposition)_[a-z0-9_]+"

@@ -321,7 +321,9 @@ def test_spool_corrupt_file_quarantined(tmp_path):
     with open(spool.path, "wb") as fh:
         fh.write(b'{"version": 1, "nodes": {"trunc')
     loaded = spool.load()
-    assert loaded == {"universe": [], "nodes": {}, "saved_at": 0.0}
+    assert loaded == {
+        "universe": [], "nodes": {}, "actuate": {}, "saved_at": 0.0,
+    }
     assert spool.last_load_error is not None
     assert os.path.exists(spool.path + ".corrupt")
     assert not os.path.exists(spool.path)

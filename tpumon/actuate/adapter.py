@@ -16,6 +16,12 @@ cycle that produced it — never re-stamped as current. An HPA reads the
 timestamp; a stale value that claims to be fresh would actuate on
 fiction, which is exactly the failure mode the exporter's
 absent-not-zero rule exists to prevent.
+
+Trust goes one step further (tpumon/actuate/trust.py): a row whose
+trust fell below the configured floor — or whose scope a peer shard
+claims at a newer ownership epoch — contributes NO item at all. Absent
+is the Kubernetes-correct "no data": the HPA holds at current size
+instead of scaling on degraded or double-owned telemetry.
 """
 
 from __future__ import annotations
@@ -230,26 +236,37 @@ class ExternalMetricsAdapter:
                 metric,
                 "bad_request",
             )
-        items, any_stale = self._items(metric, requirements, now)
+        items, any_stale, any_withheld = self._items(
+            metric, requirements, now
+        )
         body = {
             "kind": "ExternalMetricValueList",
             "apiVersion": f"{API_GROUP}/{API_VERSION}",
             "metadata": {},
             "items": items,
         }
-        return "200 OK", _json(body), metric, "stale" if any_stale else "ok"
+        result = "ok"
+        if any_withheld:
+            result = "withheld"
+        elif any_stale:
+            result = "stale"
+        return "200 OK", _json(body), metric, result
 
     def _items(
         self,
         metric: str,
         requirements: list[tuple[str, str, set[str]]],
         now: float,
-    ) -> tuple[list[dict], bool]:
+    ) -> tuple[list[dict], bool, bool]:
         _, extract = EXTERNAL_METRICS[metric]
         if extract is None:
-            return self._forecast_items(metric, requirements, now)
+            items, any_stale = self._forecast_items(
+                metric, requirements, now
+            )
+            return items, any_stale, False
         items: list[dict] = []
         any_stale = False
+        any_withheld = False
         for row in self._plane.rows():
             labels = {
                 "pool": row["pool"],
@@ -259,6 +276,13 @@ class ExternalMetricsAdapter:
                 "job": row["slice"],
             }
             if not selector_matches(requirements, labels):
+                continue
+            if row.get("withheld"):
+                # Trust-gated (or epoch-conflicted) scope: the
+                # Kubernetes-correct "no data" is an ABSENT item — the
+                # HPA holds at current size. Never last-good, never a
+                # fabricated value a controller would scale on.
+                any_withheld = True
                 continue
             value = extract(row)
             if value is None:
@@ -282,7 +306,7 @@ class ExternalMetricsAdapter:
                     "value": quantity(value),
                 }
             )
-        return items, any_stale
+        return items, any_stale, any_withheld
 
     def _forecast_items(
         self,

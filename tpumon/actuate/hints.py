@@ -153,6 +153,44 @@ class HintHysteresis:
         self._pending[key] = (candidate, streak)
         return published
 
+    def published_band(self, key: tuple[str, str]) -> str | None:
+        """The currently published band for a slice (None before its
+        first score) — the value the trust layer freezes at while the
+        slice's telemetry is degraded."""
+        return self._published.get(key)
+
+    def export_state(self) -> list[list]:
+        """Spool-serializable published-band state:
+        ``[[pool, slice, band], ...]`` (JSON-safe — tuple keys don't
+        survive a round trip)."""
+        return [
+            [pool, slc, band]
+            for (pool, slc), band in sorted(self._published.items())
+        ]
+
+    def seed(self, state) -> int:
+        """Warm-start published bands from :meth:`export_state` output
+        (a spool restore, or an alive peer's /hints on takeover). Only
+        MISSING keys seed — a band this instance already published is
+        live truth and never regresses to journaled state. Tolerant of
+        junk rows (an old or foreign spool shape seeds nothing, never
+        raises). Returns the number of bands seeded."""
+        seeded = 0
+        for row in state or []:
+            if not (
+                isinstance(row, (list, tuple))
+                and len(row) == 3
+                and all(isinstance(v, str) for v in row)
+                and row[2] in BANDS
+            ):
+                continue
+            key = (row[0], row[1])
+            if key not in self._published:
+                self._published[key] = row[2]
+                self.transitions.setdefault(key, 0)
+                seeded += 1
+        return seeded
+
     def forget(self, live: set[tuple[str, str]]) -> None:
         """Drop state for slices no longer in the rollup (identity
         churn must not leak hysteresis state forever). Transition

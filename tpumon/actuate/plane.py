@@ -30,6 +30,7 @@ from tpumon.actuate.hints import (
     band_of,
     headroom_score,
 )
+from tpumon.actuate.trust import DEFAULT_MIN_TRUST, trust_score
 
 #: Annotation keys published in the /hints patch shapes — what a
 #: scheduler extender or descheduler reads off the node/pool objects.
@@ -114,11 +115,22 @@ class ActuatePlane:
         hint_avoid: float = 0.25,
         hint_hold_cycles: int = 3,
         stale_after_s: float = 30.0,
+        min_trust: float = DEFAULT_MIN_TRUST,
+        hint_decay_s: float = 120.0,
         forecast_provider=None,
     ) -> None:
         self.hint_prefer = float(hint_prefer)
         self.hint_avoid = float(hint_avoid)
         self.stale_after_s = float(stale_after_s)
+        #: Trust floor (tpumon/actuate/trust.py): rows scoring below it
+        #: are WITHHELD — absent from External Metrics answers, frozen
+        #: on /hints — instead of steering a controller off degraded
+        #: telemetry.
+        self.min_trust = float(min_trust)
+        #: How long a frozen (untrusted) hint band holds at last-good
+        #: before decaying to ``neutral``: last-good is the right answer
+        #: for a blip, but a scheduler must not steer on hour-old bands.
+        self.hint_decay_s = float(hint_decay_s)
         self._hysteresis = HintHysteresis(hint_hold_cycles)
         # forecast_provider: the ledger plane's forecast_snapshot (or
         # None without a ledger) — feeds the adapter's pool-scope
@@ -132,6 +144,19 @@ class ActuatePlane:
         self._fleet_serve: dict | None = None  # guarded-by: self._lock
         self._last_cycle_ts = 0.0  # guarded-by: self._lock
         self._cycles = 0  # guarded-by: self._lock
+        self._scope_epochs: dict[tuple[str, str], int] = {}  # guarded-by: self._lock
+        self._contested = False  # guarded-by: self._lock
+        #: Bands to warm-seed into the hysteresis at the next cycle
+        #: (spool restore or peer /hints on takeover). Written from the
+        #: startup/membership threads, drained on the collect thread —
+        #: the queue keeps the hysteresis itself single-threaded.
+        self._band_seed: list[list] = []  # guarded-by: self._lock
+        #: Collect-thread-only trust bookkeeping (same thread model as
+        #: the hysteresis): freeze start per scope, and the monotonic
+        #: withheld / epoch-conflict counters families() exposes.
+        self._frozen_since: dict[tuple[str, str], float] = {}
+        self._withheld_counts: dict[tuple[str, str, str], int] = {}
+        self._epoch_conflicts: dict[tuple[str, str], int] = {}
 
     # -- collect-cycle hook -------------------------------------------------
 
@@ -141,15 +166,54 @@ class ActuatePlane:
         doc: dict,
         entries: list,
         goodput_jobs: dict | None = None,
+        *,
+        target_epochs: dict | None = None,
+        peer_scope_epochs: dict | None = None,
+        restored_targets: set | None = None,
+        contested: bool = False,
     ) -> None:
         """One collect cycle: aggregate serve joins off the entries,
-        score + hysterese every slice in the rollup doc, publish."""
+        score + trust-gate + hysterese every slice in the rollup doc,
+        publish.
+
+        ``target_epochs`` (target -> ownership epoch, from the
+        membership plane) and ``peer_scope_epochs`` ((pool, slice) ->
+        highest epoch any ALIVE peer claims for the scope) drive the
+        split-brain resolution: a scope a peer claims at a NEWER epoch
+        is withheld here — the newer owner answers, this shard counts
+        the conflict. ``restored_targets`` and ``contested`` feed the
+        trust score (spool-restore warmth, double-owned window)."""
+        with self._lock:
+            band_seed, self._band_seed = self._band_seed, []
+        if band_seed:
+            self._hysteresis.seed(band_seed)
+
         slice_serve: dict[tuple[str, str], _ServeAgg] = {}
         pool_serve: dict[str, _ServeAgg] = {}
         fleet_serve = _ServeAgg()
+        #: Per-scope trust/epoch inputs joined off the same entries
+        #: pass: member feed counts, how many serve restored (spool)
+        #: data, and the highest ownership epoch among member targets.
+        members: dict[tuple[str, str], int] = {}
+        restored: dict[tuple[str, str], int] = {}
+        scope_epochs: dict[tuple[str, str], int] = {}
+        epochs = target_epochs or {}
+        warm = restored_targets or ()
         for entry in entries:
-            snap, state = entry[1], entry[2]
-            if state != "up" or not snap:
+            target, snap, state = entry[0], entry[1], entry[2]
+            if not snap:
+                continue
+            ident = snap.get("identity") or {}
+            pool = ident.get("accelerator") or "unknown"
+            slc = ident.get("slice") or "?"
+            key = (pool, slc)
+            members[key] = members.get(key, 0) + 1
+            if target in warm:
+                restored[key] = restored.get(key, 0) + 1
+            epoch = epochs.get(target)
+            if epoch:
+                scope_epochs[key] = max(scope_epochs.get(key, 0), epoch)
+            if state != "up":
                 # A stale feed's serve numbers are old news; the slice
                 # row still surfaces (marked stale) via the rollup
                 # bucket below, so staleness is visible, not silent.
@@ -157,40 +221,95 @@ class ActuatePlane:
             serve = snap.get("serve")
             if not serve:
                 continue
-            ident = snap.get("identity") or {}
-            pool = ident.get("accelerator") or "unknown"
-            slc = ident.get("slice") or "?"
-            slice_serve.setdefault((pool, slc), _ServeAgg()).add(serve)
+            slice_serve.setdefault(key, _ServeAgg()).add(serve)
             pool_serve.setdefault(pool, _ServeAgg()).add(serve)
             fleet_serve.add(serve)
 
         jobs = goodput_jobs or {}
+        peer_epochs = peer_scope_epochs or {}
         rows: list[dict] = []
         live: set[tuple[str, str]] = set()
         for (pool, slc), bucket in sorted(doc.get("slices", {}).items()):
             key = (pool, slc)
             live.add(key)
+            n = members.get(key, 0)
+            trust, trust_inputs = trust_score(
+                visibility=bucket.get("visibility"),
+                stale=bool(bucket.get("stale")),
+                contested=contested,
+                restored_fraction=(restored.get(key, 0) / n) if n else 0.0,
+            )
+            epoch = scope_epochs.get(key, 0)
+            peer_epoch = peer_epochs.get(key)
+            # Epoch conflicts only exist while a double answer does:
+            # rendezvous splits a slice's targets across shards, so two
+            # shards LEGITIMATELY hold different epochs for one scope in
+            # steady state — epochs disagreeing is normal; epochs
+            # disagreeing while the rollup is CONTESTED (more hosts
+            # reported than the universe holds — two shards answering
+            # for the same targets) is split brain. Resolution is
+            # newest-epoch-wins: the older claim withholds, the newer
+            # claim serves; both sides count the conflict.
+            conflicted = False
+            if contested and epoch and peer_epoch and peer_epoch != epoch:
+                self._epoch_conflicts[key] = (
+                    self._epoch_conflicts.get(key, 0) + 1
+                )
+                conflicted = peer_epoch > epoch
+            withheld_reason = None
+            if conflicted:
+                # Our claim is the OLDER one: the peer answers; serving
+                # our copy alongside would flap the HPA between two
+                # truths.
+                withheld_reason = "epoch_conflict"
+            elif trust < self.min_trust:
+                withheld_reason = "untrusted"
+            if withheld_reason is not None:
+                wkey = (pool, slc, withheld_reason)
+                self._withheld_counts[wkey] = (
+                    self._withheld_counts.get(wkey, 0) + 1
+                )
             score, inputs = headroom_score(bucket, jobs.get(key))
             band = None
+            frozen = False
             if score is not None:
-                band = self._hysteresis.update(
-                    key, band_of(score, self.hint_prefer, self.hint_avoid)
-                )
-            agg = slice_serve.get(key)
+                raw_band = band_of(score, self.hint_prefer, self.hint_avoid)
+                if withheld_reason is None:
+                    self._frozen_since.pop(key, None)
+                    band = self._hysteresis.update(key, raw_band)
+                else:
+                    # Freeze: the degraded score never reaches the
+                    # hysteresis — hints hold at last-good, then decay
+                    # to neutral once degradation outlives the window.
+                    frozen = True
+                    since = self._frozen_since.setdefault(key, now)
+                    band = self._hysteresis.published_band(key)
+                    if band is None or (now - since) > self.hint_decay_s:
+                        band = "neutral"
             rows.append(
                 {
                     "pool": pool,
                     "slice": slc,
                     "bucket": bucket,
-                    "serve": agg.to_dict() if agg else None,
+                    "serve": slice_serve[key].to_dict()
+                    if key in slice_serve
+                    else None,
                     "score": score,
                     "band": band,
                     "inputs": inputs,
                     "stale": bool(bucket.get("stale")),
+                    "trust": trust,
+                    "trust_inputs": trust_inputs,
+                    "epoch": epoch,
+                    "withheld": withheld_reason is not None,
+                    "withheld_reason": withheld_reason,
+                    "band_frozen": frozen,
                     "ts": now,
                 }
             )
         self._hysteresis.forget(live)
+        for key in [k for k in self._frozen_since if k not in live]:
+            del self._frozen_since[key]
 
         with self._lock:
             self._rows = rows
@@ -200,6 +319,8 @@ class ActuatePlane:
                 if agg.feeds
             }
             self._fleet_serve = fleet_serve.to_dict()
+            self._scope_epochs = scope_epochs
+            self._contested = bool(contested)
             self._last_cycle_ts = now
             self._cycles += 1
 
@@ -217,6 +338,45 @@ class ActuatePlane:
         with self._lock:
             last = self._last_cycle_ts
         return last <= 0.0 or (now - last) > self.stale_after_s
+
+    def scope_epochs(self) -> dict[tuple[str, str], int]:
+        """Published (pool, slice) -> ownership epoch map — what
+        /fleet/summary advertises so PEERS can detect a conflicting
+        (older) claim for a scope this shard owns."""
+        with self._lock:
+            return dict(self._scope_epochs)
+
+    def published_bands(self) -> list[list]:
+        """Currently-published (pool, slice, band) rows off the READ
+        MODEL — safe from any thread; what /fleet/summary advertises so
+        a peer adopting our targets can seed its hysteresis warm."""
+        with self._lock:
+            rows = self._rows
+        return [
+            [row["pool"], row["slice"], row["band"]]
+            for row in rows
+            if row["band"]
+        ]
+
+    def band_state(self) -> list[list]:
+        """Spool-serializable published-band state. Collect thread
+        only (reads the hysteresis) — the server captures it inside
+        the collect cycle before handing the spool save off."""
+        return self._hysteresis.export_state()
+
+    def seed_bands(self, state) -> None:
+        """Queue bands (export_state shape) to warm-seed into the
+        hysteresis at the next cycle. Safe from any thread — a spool
+        restore at startup, or the membership thread adopting targets
+        whose bands a peer already published."""
+        rows = [
+            list(row)
+            for row in state or []
+            if isinstance(row, (list, tuple)) and len(row) == 3
+        ]
+        if rows:
+            with self._lock:
+                self._band_seed.extend(rows)
 
     # -- exposition ---------------------------------------------------------
 
@@ -254,11 +414,25 @@ class ActuatePlane:
         score_fam = gauge("tpu_fleet_hint_headroom_score")
         band_fam = gauge("tpu_fleet_hint_band")
         trans_fam = counter("tpu_fleet_hint_transitions_total")
+        trust_fam = gauge("tpu_actuate_trust_score")
+        epoch_fam = gauge("tpu_actuate_scope_epoch")
+        frozen_fam = gauge("tpu_actuate_hint_frozen")
+        withheld_fam = counter("tpu_actuate_withheld_total")
+        conflict_fam = counter("tpu_actuate_epoch_conflicts_total")
         pool_scores: dict[str, tuple[float, float]] = {}
         fleet_weight = fleet_score = 0.0
         for row in rows:
             labels = ("slice", row["pool"], row["slice"])
             emit_serve(labels, row["serve"])
+            scope = (row["pool"], row["slice"])
+            if row.get("trust") is not None:
+                trust_fam.add_metric(scope, row["trust"])
+            if row.get("epoch"):
+                epoch_fam.add_metric(scope, float(row["epoch"]))
+            if row["band"] is not None:
+                frozen_fam.add_metric(
+                    scope, 1.0 if row.get("band_frozen") else 0.0
+                )
             if row["score"] is None:
                 continue
             score_fam.add_metric(labels, row["score"])
@@ -282,9 +456,25 @@ class ActuatePlane:
         emit_serve(("fleet", "", ""), fleet_serve)
         for (pool, slc), count in sorted(self._hysteresis.transitions.items()):
             trans_fam.add_metric((pool, slc), float(count))
+        for (pool, slc, reason), count in sorted(
+            self._withheld_counts.items()
+        ):
+            withheld_fam.add_metric((pool, slc, reason), float(count))
+        for (pool, slc), count in sorted(self._epoch_conflicts.items()):
+            conflict_fam.add_metric((pool, slc), float(count))
 
         out = []
-        for fam in (*serve_fams.values(), score_fam, band_fam, trans_fam):
+        for fam in (
+            *serve_fams.values(),
+            score_fam,
+            band_fam,
+            trans_fam,
+            trust_fam,
+            epoch_fam,
+            frozen_fam,
+            withheld_fam,
+            conflict_fam,
+        ):
             if fam.samples:
                 out.append(fam)
         return out
@@ -315,7 +505,15 @@ class ActuatePlane:
                 "band": row["band"],
                 "stale": row["stale"],
                 "inputs": row["inputs"],
+                "trust": row.get("trust"),
+                "trust_inputs": row.get("trust_inputs", {}),
+                "withheld": bool(row.get("withheld")),
+                "frozen": bool(row.get("band_frozen")),
             }
+            if row.get("withheld_reason"):
+                entry["withheld_reason"] = row["withheld_reason"]
+            if row.get("epoch"):
+                entry["epoch"] = row["epoch"]
             if row["score"] is not None and row["band"] is not None:
                 annotations = {
                     ANNOTATION_SCORE: f"{row['score']:.3f}",
@@ -333,6 +531,8 @@ class ActuatePlane:
                 "prefer": self.hint_prefer,
                 "avoid": self.hint_avoid,
                 "hold_cycles": self._hysteresis.hold_cycles,
+                "min_trust": self.min_trust,
+                "hint_decay_s": self.hint_decay_s,
             },
             "slices": slices,
         }
@@ -344,6 +544,7 @@ class ActuatePlane:
             rows = self._rows
             last_ts = self._last_cycle_ts
             cycles = self._cycles
+            contested = self._contested
         return {
             "cycles": cycles,
             "last_cycle_ts": last_ts,
@@ -353,4 +554,10 @@ class ActuatePlane:
             "hint_transitions": sum(
                 self._hysteresis.transitions.values()
             ),
+            "min_trust": self.min_trust,
+            "contested": contested,
+            "withheld_slices": sum(1 for r in rows if r.get("withheld")),
+            "frozen_slices": sum(1 for r in rows if r.get("band_frozen")),
+            "withheld_total": sum(self._withheld_counts.values()),
+            "epoch_conflicts_total": sum(self._epoch_conflicts.values()),
         }

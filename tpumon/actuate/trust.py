@@ -1,0 +1,122 @@
+"""Signal-integrity scoring for the actuation surfaces.
+
+The fleet tier already KNOWS when it is degraded — visibility ratios,
+stale-flagged rollups, the contested flag on a double-owned takeover
+window, spool-restored feeds serving last-good data — but until this
+module none of that honesty gated the control path: an External Metric
+or a placement hint computed from a half-visible, contested rollup was
+served with the same confidence as a healthy one. Trust scoring closes
+that gap: every actuation answer carries a trust in [0, 1] derived from
+the degradation signals of the scope it was computed from, and answers
+below the configured floor are WITHHELD (the Kubernetes-correct "no
+data" — an HPA holds at current size) rather than served as a number a
+controller would act on. Degraded telemetry holds the world still; it
+never steers it.
+
+Everything here is pure functions (the :class:`ActuatePlane` wires them
+into the collect cycle), so the trust semantics are testable without an
+aggregator — the same stance as tpumon/actuate/hints.py.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+#: Default trust floor: answers scoring below this are withheld. The
+#: deliberate midpoint — a scope at half visibility (or a stale rollup)
+#: sits AT the floor, so any compounding degradation drops it under.
+DEFAULT_MIN_TRUST = 0.5
+
+#: Freshness factor applied while the scope's rollup bucket is stale
+#: (serving last-good data past the staleness budget). Chosen below
+#: the default floor on its own: a fully-stale scope must never steer.
+FACTOR_STALE = 0.4
+
+#: Ownership factor applied while the global rollup is CONTESTED (two
+#: shards briefly both answering for the same targets during a
+#: takeover / hand-back window). Double-counted totals are the least
+#: trustworthy input an autoscaler could consume.
+FACTOR_CONTESTED = 0.3
+
+#: Trust lost when ALL of a scope's feeds serve spool-restored (warm
+#: restart) snapshots instead of live fetches: restored data is
+#: last-good by construction, honest but not current. Scales linearly
+#: with the restored fraction — one warm feed in ten barely registers.
+WARMTH_WEIGHT = 0.5
+
+
+def _clamp(value: float) -> float:
+    return min(1.0, max(0.0, float(value)))
+
+
+def trust_score(
+    *,
+    visibility: float | None = None,
+    stale: bool = False,
+    contested: bool = False,
+    restored_fraction: float = 0.0,
+) -> tuple[float, dict]:
+    """One scope's trust in [0, 1] from its degradation signals.
+
+    Returns ``(trust, inputs)`` — inputs is the per-factor breakdown
+    published on /hints and /debug/vars, so a withheld answer is always
+    explainable (the same contract headroom_score keeps for hints).
+
+    Multiplicative composition: each degradation scales trust down
+    independently, so compounding failures (a stale AND half-visible
+    scope) compound the distrust instead of averaging it away.
+    """
+    inputs: dict = {}
+    trust = 1.0
+    if visibility is not None:
+        vis = _clamp(visibility)
+        inputs["visibility"] = vis
+        trust *= vis
+    inputs["stale"] = bool(stale)
+    if stale:
+        trust *= FACTOR_STALE
+    inputs["contested"] = bool(contested)
+    if contested:
+        trust *= FACTOR_CONTESTED
+    warmth = _clamp(restored_fraction)
+    if warmth > 0.0:
+        inputs["restored_fraction"] = warmth
+        trust *= 1.0 - WARMTH_WEIGHT * warmth
+    return _clamp(trust), inputs
+
+
+def is_trusted(trust: float | None, min_trust: float) -> bool:
+    """The gate: ``None`` (no trust computed — a plane cycled without
+    degradation inputs, e.g. unit fixtures) stays trusted for
+    backward compatibility; a computed trust must meet the floor."""
+    return trust is None or trust >= min_trust
+
+
+def min_trust_from_env(default: float, environ=None) -> float:
+    """Resolve the trust floor: the documented literal
+    ``TPUMON_ACTUATE_MIN_TRUST`` wins over the FleetConfig-derived
+    default (``TPUMON_FLEET_ACTUATE_MIN_TRUST``); a malformed value
+    logs and keeps the default — never a crash loop on a typo."""
+    env = os.environ if environ is None else environ
+    raw = env.get("TPUMON_ACTUATE_MIN_TRUST")
+    if raw is None or not raw.strip():
+        return float(default)
+    try:
+        return _clamp(float(raw))
+    except ValueError:
+        log.warning("ignoring malformed TPUMON_ACTUATE_MIN_TRUST=%r", raw)
+        return float(default)
+
+
+__all__ = [
+    "DEFAULT_MIN_TRUST",
+    "FACTOR_CONTESTED",
+    "FACTOR_STALE",
+    "WARMTH_WEIGHT",
+    "is_trusted",
+    "min_trust_from_env",
+    "trust_score",
+]

@@ -430,7 +430,87 @@ def _invariants_line(now: float | None = None) -> str:
     )
 
 
+def run_aggregator(url: str, out=sys.stdout, timeout: float = 5.0) -> int:
+    """``--aggregator URL`` mode: one actuation-health probe against a
+    running fleet aggregator — is the actuation surface TRUSTWORTHY
+    right now (trust floor, withheld/frozen scopes, epoch conflicts,
+    contested ownership)? Exit 0 when every scored scope answers; 1
+    when any answer is being withheld or the probe fails."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    def p(line: str = "") -> None:
+        print(line, file=out)
+
+    base = url.rstrip("/")
+    if not base.startswith(("http://", "https://")):
+        base = "http://" + base
+    try:
+        with urllib.request.urlopen(
+            base + "/debug/vars", timeout=timeout
+        ) as resp:
+            doc = _json.loads(resp.read().decode())
+    except (urllib.error.URLError, OSError, ValueError) as exc:
+        p(f"aggregator {base}: UNREACHABLE ({exc})")
+        return 1
+    p(f"aggregator {base}: up (cycles {doc.get('cycles', '?')})")
+    membership = doc.get("membership") or {}
+    p(
+        f"membership: universe {membership.get('universe', '?')}, "
+        f"owned {membership.get('owned', '?')}, alive shards "
+        f"{membership.get('alive_shards', '?')}, epoch_seq "
+        f"{membership.get('epoch_seq', 0)}, takeovers "
+        f"{membership.get('takeovers_total', 0)}"
+    )
+    actuate = doc.get("actuate")
+    if not actuate:
+        p("actuation: disabled (TPUMON_FLEET_ACTUATE=0)")
+        p("\nverdict: OK (observation-only aggregator)")
+        return 0
+    p(
+        f"actuation: trust floor {actuate.get('min_trust', 0.0):.2f}, "
+        f"{actuate.get('scored_slices', 0)} scored / "
+        f"{actuate.get('slices', 0)} slices"
+    )
+    withheld = actuate.get("withheld_slices", 0)
+    frozen = actuate.get("frozen_slices", 0)
+    conflicts = actuate.get("epoch_conflicts_total", 0)
+    if actuate.get("contested"):
+        p(
+            "  CONTESTED: two shards briefly own overlapping targets "
+            "(takeover window; self-healing)"
+        )
+    if conflicts:
+        p(
+            f"  epoch conflicts since start: {conflicts} "
+            "(resolved newest-epoch-wins; sustained growth means a "
+            "partition is not healing)"
+        )
+    if withheld or frozen:
+        p(
+            f"  WITHHELD now: {withheld} scope(s) answering absent, "
+            f"{frozen} hint band(s) frozen at last-good "
+            f"({actuate.get('withheld_total', 0)} withheld cycles "
+            "since start)"
+        )
+        p("\nverdict: ACTUATION DEGRADED (answers being withheld)")
+        return 1
+    p("\nverdict: OK (all scopes trusted)")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    # The aggregator probe is argv-sniffed, not a Config field: it
+    # targets a remote service and needs none of the node-local
+    # backend configuration Config.load resolves.
+    if "--aggregator" in argv:
+        idx = argv.index("--aggregator")
+        if idx + 1 >= len(argv):
+            print("--aggregator requires a URL", file=sys.stderr)
+            return 2
+        return run_aggregator(argv[idx + 1])
     return run(Config.load(argv))
 
 

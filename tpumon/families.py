@@ -1082,8 +1082,49 @@ ACTUATE_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
     "tpu_fleet_external_metrics_requests_total": (
         "counter",
         "External Metrics API requests served by the adapter, by "
-        "metric name and result (ok / stale / not_found / bad_request)",
+        "metric name and result (ok / stale / withheld / not_found / "
+        "bad_request)",
         ("metric", "result"),
+    ),
+    "tpu_actuate_trust_score": (
+        "gauge",
+        "Signal-integrity trust in [0, 1] per slice scope "
+        "(tpumon/actuate/trust.py: visibility × staleness × contested "
+        "× spool warmth); answers below TPUMON_ACTUATE_MIN_TRUST are "
+        "withheld from the actuation surfaces",
+        ("pool", "slice"),
+    ),
+    "tpu_actuate_scope_epoch": (
+        "gauge",
+        "Ownership epoch this shard's answers for the scope were "
+        "minted under (Lamport-folded across peer shards); conflicting "
+        "claims resolve newest-epoch-wins",
+        ("pool", "slice"),
+    ),
+    "tpu_actuate_hint_frozen": (
+        "gauge",
+        "1 while the slice's placement band is FROZEN at last-good "
+        "(its telemetry is below the trust floor or epoch-conflicted; "
+        "decays to neutral after TPUMON_FLEET_HINT_DECAY_S), 0 while "
+        "the hysteresis runs live",
+        ("pool", "slice"),
+    ),
+    "tpu_actuate_withheld_total": (
+        "counter",
+        "Collect cycles a scope's actuation answers were withheld "
+        "(External Metric items absent, hint band frozen), by reason "
+        "(untrusted / epoch_conflict) — degraded telemetry holds the "
+        "world still, it never steers it",
+        ("pool", "slice", "reason"),
+    ),
+    "tpu_actuate_epoch_conflicts_total": (
+        "counter",
+        "CONTESTED cycles where a peer shard claimed this scope at a "
+        "different ownership epoch (split-brain double-answer window, "
+        "counted on both sides); resolved newest-epoch-wins — the "
+        "older claim withholds, the newer serves. A sustained rate "
+        "means a partition is not healing",
+        ("pool", "slice"),
     ),
 }
 

@@ -196,6 +196,16 @@ class FleetConfig:
     #: Hysteresis hold: a band change publishes only after the new band
     #: held for this many consecutive collect cycles (flap damping).
     hint_hold_cycles: int = 3
+    #: Trust floor for actuation answers (tpumon/actuate/trust.py):
+    #: External Metric items and hint-band updates whose scope scores
+    #: below it are WITHHELD (absent items; hints frozen at last-good).
+    #: The documented literal ``TPUMON_ACTUATE_MIN_TRUST`` overrides
+    #: this field when set.
+    actuate_min_trust: float = 0.5
+    #: How long an untrusted (frozen) hint band holds at last-good
+    #: before decaying to ``neutral`` — a blip deserves last-good, a
+    #: long outage must not steer the scheduler on hour-old bands.
+    hint_decay_s: float = 120.0
     #: Log level name.
     log_level: str = "INFO"
 

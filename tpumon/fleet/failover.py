@@ -224,6 +224,7 @@ class MembershipPlane:
         on_membership,
         observe_event=None,
         initial_universe: list[str] | None = None,
+        initial_epochs: tuple[int, dict] | None = None,
         clock=time.time,
         fetch=None,
     ) -> None:
@@ -252,6 +253,37 @@ class MembershipPlane:
         self._owned: list[str] | None = None  # guarded-by: self._lock
         self._alive: set[int] = set(range(cfg.shard_count))  # guarded-by: self._lock
         self.takeovers_total = 0  # guarded-by: self._lock
+        #: Split-brain ownership epochs (ISSUE 18): a Lamport-style
+        #: monotonic mint counter plus the epoch each owned target was
+        #: adopted under. Minting folds in the highest epoch observed in
+        #: any peer /fleet/summary, so a shard re-claiming targets after
+        #: a restart always stamps them NEWER than the takeover that
+        #: adopted them — the adapter resolves a double-answer window
+        #: newest-epoch-wins instead of flapping the HPA.
+        self._epoch_seq = 0  # guarded-by: self._lock
+        self._target_epochs: dict[str, int] = {}  # guarded-by: self._lock
+        if initial_epochs is not None:
+            seq, targets = initial_epochs
+            try:
+                self._epoch_seq = max(0, int(seq))
+                if self._epoch_seq:
+                    # Warm-restart skip-ahead: a peer that adopted our
+                    # targets while we were down folded the LAST seq we
+                    # advertised and minted exactly one above it.
+                    # Re-claiming from the same journaled seq would TIE
+                    # that adoption epoch (no winner); one extra step
+                    # makes restart re-claims strictly newer.
+                    self._epoch_seq += 1
+                self._target_epochs = {
+                    t: int(e)
+                    for t, e in dict(targets).items()
+                    if isinstance(t, str)
+                }
+            except (TypeError, ValueError):
+                # A corrupt spool section costs epoch warmth, never
+                # startup — fresh epochs mint strictly above peers'.
+                self._epoch_seq = 0
+                self._target_epochs = {}
         self._discover_due = 0.0
         self._probe_due = 0.0
         self._stop = threading.Event()
@@ -353,6 +385,7 @@ class MembershipPlane:
         owned_set = set(owned)
         added = [t for t in owned if t not in old_owned_set]
         removed = [t for t in (old_owned or []) if t not in owned_set]
+        self._mint_epochs(added, removed)
         #: Adoption caused by shards dying (not by universe growth):
         #: newly-owned targets that were already in the universe while a
         #: previously-alive shard dropped out.
@@ -382,6 +415,33 @@ class MembershipPlane:
             except Exception:
                 log.exception("membership apply failed")
 
+    def _mint_epochs(self, added: list[str], removed: list[str]) -> None:
+        """Stamp adopted targets with a fresh ownership epoch minted
+        STRICTLY ABOVE every epoch this shard has seen — its own mint
+        counter and the highest ``epoch_seq`` any alive peer's summary
+        advertises (the Lamport receive rule). A shard re-claiming
+        targets after a restart or partition therefore always claims
+        them newer than the takeover that adopted them, so a brief
+        double-answer window resolves newest-epoch-wins at the
+        actuation read model instead of flapping between two truths.
+        Handed-back targets drop their epoch — the new owner's claim is
+        the only live one."""
+        if not added and not removed:
+            return
+        peer_seq = 0
+        if self.watcher is not None:
+            for summary in self.watcher.summaries().values():
+                seq = summary.get("epoch_seq")
+                if isinstance(seq, (int, float)):
+                    peer_seq = max(peer_seq, int(seq))
+        with self._lock:
+            for target in removed:
+                self._target_epochs.pop(target, None)
+            if added:
+                self._epoch_seq = max(self._epoch_seq, peer_seq) + 1
+                for target in added:
+                    self._target_epochs[target] = self._epoch_seq
+
     def _count(self, kind: str, n: int) -> None:
         if n and self._observe_event is not None:
             try:
@@ -398,12 +458,14 @@ class MembershipPlane:
             owned = list(self._owned or [])
             alive = sorted(self._alive)
             takeovers = self.takeovers_total
+            epoch_seq = self._epoch_seq
         doc: dict = {
             "source": self.resolver.mode,
             "universe": len(universe),
             "owned": len(owned),
             "alive_shards": alive,
             "takeovers_total": takeovers,
+            "epoch_seq": epoch_seq,
         }
         if self.watcher is not None:
             doc["peers"] = self.watcher.states()
@@ -421,6 +483,16 @@ class MembershipPlane:
         if self.watcher is None:
             return {}
         return self.watcher.summaries()
+
+    def epochs(self) -> dict[str, int]:
+        """target -> ownership epoch for this shard's owned targets."""
+        with self._lock:
+            return dict(self._target_epochs)
+
+    def epoch_seq(self) -> int:
+        """The highest ownership epoch this shard has minted."""
+        with self._lock:
+            return self._epoch_seq
 
 
 __all__ = ["MembershipPlane", "PeerWatcher", "PROBE_ERRORS", "parse_peers"]

@@ -386,6 +386,11 @@ class NodeFeed:
         #: compact frame rather than a parsed text page (evidence that
         #: the negotiated encoding is actually in use).
         self.snapshot_decoded = False  # guarded-by: self._lock
+        #: True while the served snapshot came from the warm-restart
+        #: spool (or a peer warm-seed) rather than a live fetch — a
+        #: trust input for the actuation plane (spool-restore warmth);
+        #: the first live store clears it.
+        self.restored = False  # guarded-by: self._lock
         self._inflight = False  # guarded-by: self._lock
         #: Persistent poll connection; touched only inside poll()
         #: (serialized by _inflight), never concurrently.
@@ -587,6 +592,7 @@ class NodeFeed:
             self._fetched_at = data_ts
             self._last_error = ""
             self.snapshot_decoded = decoded
+            self.restored = False
             if self._content_cmp != cmp:
                 self._content_cmp = cmp
                 self.content_seq += 1
@@ -625,6 +631,7 @@ class NodeFeed:
                 return
             self._snap = snap
             self._fetched_at = fetched_at
+            self.restored = True
             self._content_cmp = {
                 k: v for k, v in snap.items() if k != "last_poll_ts"
             }

@@ -299,6 +299,13 @@ class FleetAggregator:
         #: (membership thread only).
         self._peer_seeded_count = 0
         spool_universe: list[str] = []
+        #: Journaled actuation state ({"bands", "epoch_seq",
+        #: "target_epochs"}) from the spool — seeds the membership
+        #: plane's ownership epochs (a restart re-claims targets at a
+        #: HIGHER epoch than it ever held, which is what makes
+        #: newest-epoch-wins resolve split brain toward the restart)
+        #: and the hint hysteresis (warm restarts resume held bands).
+        spool_actuate: dict = {}
         if cfg.spool_dir:
             from tpumon.fleet.spool import SnapshotSpool
 
@@ -308,6 +315,7 @@ class FleetAggregator:
             loaded = self.spool.load()
             self._spool_nodes = loaded["nodes"]
             spool_universe = loaded["universe"]
+            spool_actuate = loaded.get("actuate") or {}
             if self.spool.last_load_error is not None:
                 self.telemetry.spool_errors.labels(op="load").inc()
 
@@ -361,6 +369,18 @@ class FleetAggregator:
 
         from tpumon.fleet.failover import MembershipPlane
 
+        #: Set before the membership plane exists: _apply_membership
+        #: runs synchronously during its construction and consults
+        #: self.actuate for peer band seeding.
+        self.actuate = None
+
+        initial_epochs = None
+        if spool_actuate:
+            initial_epochs = (
+                spool_actuate.get("epoch_seq") or 0,
+                spool_actuate.get("target_epochs") or {},
+            )
+
         #: The membership-and-failover plane: discovery (static / file /
         #: k8s Endpoints), churn debounce, peer liveness, and rendezvous
         #: ownership over the SURVIVING shards. Constructing it applies
@@ -371,6 +391,7 @@ class FleetAggregator:
             on_membership=self._apply_membership,
             observe_event=observe_event,
             initial_universe=spool_universe,
+            initial_epochs=initial_epochs,
         )
         if self.spool is not None:
             self.telemetry.spool_restored.set(float(self._restored_count))
@@ -430,10 +451,11 @@ class FleetAggregator:
         #: rollups + placement hints + the External Metrics adapter,
         #: riding the same rollup doc and feed entries the ledger gets.
         #: Every query it serves reads the pre-computed model — no raw
-        #: per-node series on any actuation path.
-        self.actuate = None
+        #: per-node series on any actuation path. (self.actuate was
+        #: initialized to None before membership construction above.)
         if cfg.actuate:
             from tpumon.actuate import ActuatePlane
+            from tpumon.actuate.trust import min_trust_from_env
 
             self.actuate = ActuatePlane(
                 hint_prefer=cfg.hint_prefer,
@@ -442,6 +464,11 @@ class FleetAggregator:
                 # Values older than the staleness budget are served
                 # flagged, same clock the rollup's own stale class uses.
                 stale_after_s=max(cfg.stale_s, 3.0 * cfg.interval),
+                # TPUMON_ACTUATE_MIN_TRUST (literal) wins over the
+                # FleetConfig field — the trust floor is an operator
+                # knob first.
+                min_trust=min_trust_from_env(cfg.actuate_min_trust),
+                hint_decay_s=cfg.hint_decay_s,
                 # Pool-scope tpumon_days_to_saturation answers off the
                 # ledger's capacity forecast; without a ledger the
                 # metric serves an empty item list (absent-not-zero).
@@ -449,6 +476,13 @@ class FleetAggregator:
                     self.ledger.forecast_snapshot if self.ledger else None
                 ),
             )
+            bands = spool_actuate.get("bands")
+            if bands:
+                # Warm-restart band resume: journaled published bands
+                # queue into the hysteresis (drained at the first
+                # cycle), so a restart holds its bands instead of
+                # re-deriving them band-by-band through the hold window.
+                self.actuate.seed_bands(bands)
 
         from tpumon.exporter.server import _SelfTelemetryPage
 
@@ -588,12 +622,27 @@ class FleetAggregator:
         peer_seeds: dict[str, dict] = {}
         if not info.get("first"):
             current_feeds = self.feeds
+            new_targets = [t for t in owned if t not in current_feeds]
             adopted = [
-                t for t in owned
-                if t not in current_feeds and t not in self._spool_nodes
+                t for t in new_targets if t not in self._spool_nodes
             ]
             if adopted:
                 peer_seeds = self._peer_seed(adopted)
+            if new_targets and self.actuate is not None:
+                # Band adoption, same idea as the snapshot warm-seed:
+                # the peers that were just publishing hints for these
+                # targets' scopes advertise their bands on
+                # /fleet/summary — seeding them means a takeover holds
+                # the previous owner's bands instead of re-deriving
+                # them through the hysteresis hold window. seed() only
+                # fills MISSING keys, so our own live bands never
+                # regress.
+                bands: list[list] = []
+                for summary in self.membership.peer_summaries().values():
+                    peer_bands = summary.get("hint_bands")
+                    if isinstance(peer_bands, list):
+                        bands.extend(peer_bands)
+                self.actuate.seed_bands(bands)
         with self._apply_lock:
             current = self.feeds
             next_feeds: dict[str, NodeFeed] = {}
@@ -696,6 +745,9 @@ class FleetAggregator:
                 # math (now - age_s) stays exact.
                 now = time.time()
                 doc = {**doc, "now": now, "nodes": self._node_entries(now)}
+                if self.actuate is not None:
+                    # The smi --aggregator trust line reads this.
+                    doc["actuate"] = self.actuate.debug_block()
                 body = _json_dump(doc)
             elif path == "/fleet/summary":
                 body = _json_dump(self._summary_doc())
@@ -763,7 +815,7 @@ class FleetAggregator:
         with self._doc_lock:
             doc = self._fleet_doc
             cycles = self._cycles
-        return {
+        out = {
             "shard": doc.get("shard", {
                 "index": self.cfg.shard_index,
                 "count": self.cfg.shard_count,
@@ -773,7 +825,20 @@ class FleetAggregator:
             "cycles": cycles,
             "fleet": doc.get("fleet", {}),
             "universe": len(self.membership.universe()),
+            # Lamport fold input for peers minting ownership epochs: a
+            # peer re-claiming targets mints above the highest epoch_seq
+            # any alive shard advertises.
+            "epoch_seq": self.membership.epoch_seq(),
         }
+        if self.actuate is not None:
+            scope_epochs: dict[str, dict[str, int]] = {}
+            for (pool, slc), epoch in self.actuate.scope_epochs().items():
+                scope_epochs.setdefault(pool, {})[slc] = epoch
+            # Per-scope ownership claims (split-brain detection) and
+            # published hint bands (peers seed adopted scopes warm).
+            out["scope_epochs"] = scope_epochs
+            out["hint_bands"] = self.actuate.published_bands()
+        return out
 
     def _health(self) -> tuple[bool, str]:
         with self._doc_lock:
@@ -996,6 +1061,14 @@ class FleetAggregator:
                             if self.ledger is not None
                             else None
                         ),
+                        target_epochs=self.membership.epochs(),
+                        peer_scope_epochs=self._peer_scope_epochs(),
+                        restored_targets={
+                            t for t, f in self.feeds.items() if f.restored
+                        },
+                        contested=bool(
+                            (doc.get("global") or {}).get("contested")
+                        ),
                     )
                 except Exception:
                     # Same stance as the ledger: actuation must never
@@ -1059,6 +1132,28 @@ class FleetAggregator:
         self._selfpage.refresh()
         return fleet_doc
 
+    def _peer_scope_epochs(self) -> dict[tuple[str, str], int]:
+        """(pool, slice) -> highest ownership epoch any ALIVE peer
+        advertises for the scope (off the cached /fleet/summary docs —
+        no extra probes). The actuation plane withholds scopes a peer
+        claims at a NEWER epoch than ours: newest-epoch-wins."""
+        out: dict[tuple[str, str], int] = {}
+        if self.membership.watcher is None:
+            return out
+        for summary in self.membership.peer_summaries().values():
+            scopes = summary.get("scope_epochs")
+            if not isinstance(scopes, dict):
+                continue
+            for pool, slices in scopes.items():
+                if not isinstance(slices, dict):
+                    continue
+                for slc, epoch in slices.items():
+                    if not isinstance(epoch, (int, float)):
+                        continue
+                    key = (str(pool), str(slc))
+                    out[key] = max(out.get(key, 0), int(epoch))
+        return out
+
     def _merge_peers(self, doc: dict, membership: dict) -> None:
         """Attach the cross-shard ``scope="global"`` bucket: this
         shard's fleet totals merged with every ALIVE peer's last
@@ -1115,10 +1210,16 @@ class FleetAggregator:
             snap, fetched_at, _error = feed.current()
             if snap is not None and fetched_at > 0.0:
                 entries[target] = {"snap": snap, "fetched_at": fetched_at}
+        # Actuation state captured HERE, on the collect thread (the
+        # band state reads the collect-thread-only hysteresis), before
+        # the save hands off to the executor.
+        actuate_state = self._actuate_spool_state()
 
         def save() -> None:
             try:
-                if not self.spool.save(universe, entries):
+                if not self.spool.save(
+                    universe, entries, actuate=actuate_state
+                ):
                     self.telemetry.spool_errors.labels(op="write").inc()
             except Exception:
                 log.exception("fleet spool save failed")
@@ -1127,6 +1228,19 @@ class FleetAggregator:
                 self._spool_saving = False
 
         self._executor.submit(save)
+
+    def _actuate_spool_state(self) -> dict | None:
+        """The spool's "actuate" section: published hint bands plus the
+        ownership-epoch state a restart re-claims ABOVE. Collect thread
+        (or post-shutdown close) only — band_state reads the
+        hysteresis."""
+        if self.actuate is None:
+            return None
+        return {
+            "bands": self.actuate.band_state(),
+            "epoch_seq": self.membership.epoch_seq(),
+            "target_epochs": self.membership.epochs(),
+        }
 
     def _run(self) -> None:
         interval = self.cfg.interval
@@ -1199,7 +1313,10 @@ class FleetAggregator:
                         "snap": snap, "fetched_at": fetched_at,
                     }
             try:
-                self.spool.save(self.membership.universe(), entries)
+                self.spool.save(
+                    self.membership.universe(), entries,
+                    actuate=self._actuate_spool_state(),
+                )
             except Exception:
                 log.exception("final fleet spool save failed")
         if self.ledger is not None:

@@ -62,16 +62,27 @@ class SnapshotSpool:
 
     # -- write -------------------------------------------------------------
 
-    def save(self, universe: list[str], nodes: dict[str, dict]) -> bool:
+    def save(
+        self,
+        universe: list[str],
+        nodes: dict[str, dict],
+        actuate: dict | None = None,
+    ) -> bool:
         """Journal ``{target: {"snap":..., "fetched_at":...}}`` plus the
-        universe. Returns False (and logs) on any failure — a full disk
-        degrades warm restart, never the aggregator."""
+        universe and, when given, the actuation plane's warm-restart
+        state (published hint bands + ownership epochs). Returns False
+        (and logs) on any failure — a full disk degrades warm restart,
+        never the aggregator."""
         doc = {
             "version": SPOOL_VERSION,
             "saved_at": self._clock(),
             "universe": list(universe),
             "nodes": dict(nodes),
         }
+        if actuate:
+            # Optional section, same version: an older reader ignores
+            # the key; an older spool simply lacks it (tolerant load).
+            doc["actuate"] = dict(actuate)
         try:
             body, self.dropped_last_save = self._bounded(doc)
             os.makedirs(self.directory, exist_ok=True)
@@ -122,9 +133,11 @@ class SnapshotSpool:
 
     def load(self) -> dict:
         """The journaled state: ``{"universe": [...], "nodes": {target:
-        {"snap":..., "fetched_at":...}}, "saved_at": ts}`` — empty on
-        absence, corruption, or version mismatch (quarantined aside)."""
-        empty = {"universe": [], "nodes": {}, "saved_at": 0.0}
+        {"snap":..., "fetched_at":...}}, "actuate": {...}, "saved_at":
+        ts}`` — empty on absence, corruption, or version mismatch
+        (quarantined aside). ``actuate`` is ``{}`` for spools written
+        before the section existed."""
+        empty = {"universe": [], "nodes": {}, "actuate": {}, "saved_at": 0.0}
         self.last_load_error = None
         try:
             with open(self.path, "rb") as fh:
@@ -160,9 +173,11 @@ class SnapshotSpool:
                     and isinstance(entry.get("fetched_at"), (int, float))
                 ):
                     out_nodes[target] = entry
+            actuate = doc.get("actuate")
             return {
                 "universe": [t for t in universe if isinstance(t, str)],
                 "nodes": out_nodes,
+                "actuate": actuate if isinstance(actuate, dict) else {},
                 "saved_at": float(doc.get("saved_at") or 0.0),
             }
         except (ValueError, UnicodeDecodeError) as exc:

@@ -848,6 +848,31 @@ def render_aggregator(snap: dict, out=None) -> None:
                 if gvis is not None and gvis < 1.0
                 else ""
             )
+            + (
+                f", {glob['contested']} CONTESTED"
+                if glob.get("contested")
+                else ""
+            )
+        )
+    actuate = doc.get("actuate")
+    if actuate:
+        flags = []
+        if actuate.get("withheld_slices"):
+            flags.append(f"{actuate['withheld_slices']} WITHHELD")
+        if actuate.get("frozen_slices"):
+            flags.append(f"{actuate['frozen_slices']} hints frozen")
+        if actuate.get("epoch_conflicts_total"):
+            flags.append(
+                f"{actuate['epoch_conflicts_total']} epoch conflicts"
+            )
+        if actuate.get("contested"):
+            flags.append("contested")
+        p(
+            f"  actuate [trust floor "
+            f"{actuate.get('min_trust', 0.0):.2f}]: "
+            f"{actuate.get('scored_slices', 0)} scored / "
+            f"{actuate.get('slices', 0)} slices"
+            + (", " + ", ".join(flags) if flags else ", all trusted")
         )
     for row in doc.get("slices", ()):
         parts = [f"{row.get('chips', 0)} chips"]

@@ -3312,6 +3312,578 @@ def serve_burst_soak(
     return record
 
 
+def actuate_chaos_soak(
+    duration_s: float,
+    nodes: int = 12,
+    topology: str = "v4-8",
+    interval: float = 0.5,
+    scrape_every_s: float = 0.5,
+    takeover_s: float | None = None,
+) -> dict:
+    """Do-no-harm actuation drill (ISSUE 18 acceptance evidence): a
+    scripted HPA simulator consumes the External Metrics adapter off
+    two peer-probing aggregator shards while fleetsim walks the fleet
+    through partition → full-fleet staleness → shard kill → warm
+    restart. The hard invariant, checked per decision: the simulated
+    replica count and the published hint bands change ONLY on real
+    load (the scripted serving-profile steps), never because telemetry
+    degraded.
+
+    - **trust gate**: every degraded scope must answer ABSENT (a
+      withheld row yields no item), never a stale or fabricated value;
+      the deliberately naive HPA sim holds on absent or partial
+      answers, so any replica change inside a degraded window convicts
+      the telemetry layer, not the sim.
+    - **split brain**: killing shard 1 makes shard 0 adopt its targets
+      under a fresh ownership epoch; restarting shard 1 from its spool
+      re-claims them strictly newer — the contested double-answer
+      window must tick ``tpu_actuate_epoch_conflicts_total`` and
+      resolve newest-epoch-wins (the older claim withholds, the newer
+      serves).
+    - **recovery**: after the full-fleet staleness heals, trusted
+      complete answers must return within ~2 rollup intervals of
+      visibility returning (recorded, not asserted here — CI gates on
+      the violation counters).
+    """
+    import tempfile
+    import urllib.parse
+
+    from tpumon.fleet.config import FleetConfig
+    from tpumon.fleet.server import build_aggregator
+
+    if duration_s <= 0:
+        raise ValueError(f"duration must be > 0 seconds, got {duration_s}")
+    if duration_s < 60 * interval:
+        raise ValueError(
+            f"--duration {duration_s:g} is too short for the actuate-chaos "
+            f"script at --interval {interval:g} (need > 60*interval: the "
+            "burst/partition/stale/kill/restart windows each span several "
+            "collect cycles)"
+        )
+    if takeover_s is None:
+        takeover_s = max(2.0, 4 * interval)
+
+    ports = [_free_port(), _free_port()]
+    peers = ",".join(f"http://127.0.0.1:{p}" for p in ports)
+    spools = [
+        tempfile.mkdtemp(prefix="tpumon-actuate-spool-0-"),
+        tempfile.mkdtemp(prefix="tpumon-actuate-spool-1-"),
+    ]
+
+    def shard_cfg(index: int, urls: list[str]) -> "FleetConfig":
+        return FleetConfig(
+            port=ports[index], addr="127.0.0.1",
+            targets=",".join(urls),
+            shard_index=index, shard_count=2,
+            interval=interval,
+            stale_s=max(2.0, 3.0 * interval),
+            evict_s=max(duration_s * 2, 120.0),
+            peers=peers,
+            probe_interval=max(0.25, takeover_s / 4.0),
+            takeover_s=takeover_s,
+            spool_dir=spools[index],
+            spool_every_s=interval,
+            poll_backoff_max_s=2.0,  # mass return inside the drill
+            # Freeze-decay must not fire inside the drill: a frozen
+            # band decaying to neutral is designed behavior, and the
+            # band do-no-harm check would misread it as a violation.
+            hint_decay_s=max(duration_s * 2, 300.0),
+            history_window=0.0,
+        )
+
+    sim_proc = None
+    shards: list = [None, None]
+    conns: dict[int, http.client.HTTPConnection] = {}
+    lat_ms: list[float] = []
+    failed_scrapes = 0
+    honesty_violations = 0
+    queue_threshold = 4.0 * nodes
+    metric = "tpumon_serve_queue_depth"
+    selector = ""
+    record: dict = {
+        "mode": "actuate-chaos",
+        "nodes": nodes,
+        "shards": 2,
+        "topology": topology,
+        "interval_s": interval,
+        "takeover_s": takeover_s,
+        "queue_threshold": queue_threshold,
+    }
+    sim_log: list[str] = []
+    prev_switch = sys.getswitchinterval()
+
+    def sim_cmd(command: str, expect_lines: int) -> None:
+        sim_proc.stdin.write(command + "\n")
+        sim_proc.stdin.flush()
+        for _ in range(expect_lines):
+            line = sim_proc.stdout.readline()  # deadline: fleetsim acks every command immediately or died (outer CI timeout bounds the run)
+            if not line:
+                sim_log.append(f"{command}: sim died mid-ack")
+                return
+            sim_log.append(line.strip())
+
+    def get(index: int, path: str) -> bytes | None:
+        nonlocal failed_scrapes
+        if shards[index] is None:
+            return None
+        conn = conns.get(index)
+        if conn is None:
+            conn = conns[index] = http.client.HTTPConnection(
+                "127.0.0.1", ports[index], timeout=10
+            )
+        start = time.perf_counter()
+        try:
+            conn.request("GET", path)
+            body = conn.getresponse().read()
+        except (OSError, http.client.HTTPException):
+            failed_scrapes += 1
+            conn.close()
+            conns.pop(index, None)
+            return None
+        lat_ms.append((time.perf_counter() - start) * 1e3)
+        return body
+
+    def _json_or_none(body: bytes | None):
+        if body is None:
+            return None
+        try:
+            return json.loads(body)
+        except ValueError:
+            return None
+
+    def _quantity(raw: str) -> float:
+        return (
+            float(raw[:-1]) / 1e3 if raw.endswith("m") else float(raw)
+        )
+
+    def em_items(index: int) -> list | None:
+        """One shard's External Metrics answer: the item list, or None
+        when the shard is down/unreachable (≠ an empty answer)."""
+        doc = _json_or_none(get(
+            index,
+            "/apis/external.metrics.k8s.io/v1beta1/namespaces/default/"
+            f"{metric}?labelSelector={urllib.parse.quote(selector)}",
+        ))
+        if doc is None:
+            return None
+        items = doc.get("items")
+        return items if isinstance(items, list) else []
+
+    def fleet_doc(index: int) -> dict | None:
+        return _json_or_none(get(index, "/fleet"))
+
+    def covered(index: int) -> float | None:
+        doc = fleet_doc(index)
+        if doc is None:
+            return None
+        hosts = doc.get("fleet", {}).get("hosts", {})
+        return hosts.get("up", 0) + hosts.get("stale", 0)
+
+    def counter_total(body: bytes, name: str) -> float:
+        pat = re.compile(
+            rb"^" + name.encode() + rb"(?:\{[^}]*\})? (\S+)", re.M
+        )
+        return sum(float(v) for v in pat.findall(body))
+
+    #: Per-process-life running maxima of the monotonic actuation
+    #: counters: a shard restart zeroes its registry, so each life is
+    #: harvested separately and summed at the end.
+    counter_lives: dict[str, dict[str, float]] = {}
+
+    def note_counters(life: str, body: bytes) -> None:
+        d = counter_lives.setdefault(life, {})
+        for name in (
+            "tpu_actuate_epoch_conflicts_total",
+            "tpu_actuate_withheld_total",
+            "tpu_fleet_takeovers_total",
+        ):
+            total = counter_total(body, name)
+            if total > d.get(name, 0.0):
+                d[name] = total
+
+    # HPA simulator + do-no-harm ledgers.
+    replicas = 1
+    expected_items = 0
+    replica_changes: list[dict] = []
+    replica_violations = 0
+    band_violations = 0
+    withheld_served_violations = 0
+    polls = acted = hold_absent = hold_partial = 0
+    withheld_observations = 0
+    frozen_observations = 0
+    withheld_reasons: dict[str, int] = {}
+    #: (shard, pool, slice) -> (band, withheld) from the last /hints
+    #: snapshot; a band changing while the row is (or just was)
+    #: withheld is degraded telemetry moving a hint — the violation.
+    last_bands: dict[tuple, tuple] = {}
+    prev_withheld: dict[int, set] = {0: set(), 1: set()}
+
+    try:
+        if not os.environ.get("TPUMON_KEEP_SWITCH_INTERVAL"):
+            sys.setswitchinterval(min(prev_switch, 0.0005))
+        sim_proc, urls = _spawn_fleetsim(nodes, topology, interval)
+        sim_cmd("serve 8 1 120 1.0", 1)  # calm baseline profile
+        shards[0] = build_aggregator(shard_cfg(0, urls))
+        shards[1] = build_aggregator(shard_cfg(1, urls))
+        shards[0].start()
+        shards[1].start()
+        record["shard_targets"] = [len(s.targets) for s in shards]
+
+        # Warm-up gate: both shards fully fed, serving pool discovered.
+        pool = None
+        warm_deadline = time.time() + max(60.0, 2.0 * nodes)
+        while time.time() < warm_deadline:
+            docs = [fleet_doc(i) for i in range(2)]
+            if all(
+                d is not None
+                and d.get("fleet", {}).get("hosts", {}).get("up", 0)
+                >= len(shards[i].targets)
+                for i, d in enumerate(docs)
+            ):
+                rows = [
+                    row for row in docs[0].get("pools") or []
+                    if isinstance(row, dict)
+                    and row.get("pool") not in (None, "", "unknown")
+                    and row.get("hosts", {}).get("up", 0) > 0
+                ]
+                if rows:
+                    pool = max(
+                        rows, key=lambda r: r["hosts"].get("up", 0)
+                    )["pool"]
+                    break
+            time.sleep(0.25)
+        record["pool"] = pool
+        selector = f"pool={pool}" if pool else ""
+
+        # The sim's completeness baseline: the stable item count of a
+        # fully-trusted clean answer summed over both shards. Anything
+        # smaller later is a partial answer — hold, never scale.
+        settle_deadline = time.time() + max(15.0, 20 * interval)
+        prev_count = None
+        while time.time() < settle_deadline:
+            per_shard = [em_items(i) for i in range(2)]
+            if all(items is not None for items in per_shard):
+                count = sum(len(items) for items in per_shard)
+                if count and count == prev_count:
+                    expected_items = count
+                    break
+                prev_count = count
+            time.sleep(max(0.2, interval / 2.0))
+        record["expected_items"] = expected_items
+
+        t0 = time.time()
+        partitioned = max(2, nodes // 4)
+        script = {
+            "burst_on_at": 0.10 * duration_s,
+            "partition_at": 0.26 * duration_s,
+            "heal_partition_at": 0.36 * duration_s,
+            "stale_at": 0.46 * duration_s,
+            "heal_stale_at": 0.55 * duration_s,
+            "kill_at": 0.64 * duration_s,
+            "restart_at": 0.78 * duration_s,
+            "burst_off_at": 0.90 * duration_s,
+        }
+        record["script"] = {k: round(v, 1) for k, v in script.items()}
+        done: set[str] = set()
+        #: Replica changes are legitimate only in the grace window
+        #: after a REAL load step (the serve-profile changes). The
+        #: profile is constant through every degraded window, so any
+        #: change outside these windows is harm.
+        grace = max(6.0, 12 * interval)
+        allowed_until = -1.0
+        takeover = None
+        kill_t = None
+        recovery: dict = {
+            "heal_t_s": None, "visibility_restored_s": None,
+            "trusted_s": None, "intervals_after_visibility": None,
+        }
+        heal2_t = None
+        vis_restored_t = None
+        signal_latency_s = None
+        burst_on_t = None
+        next_at = t0
+
+        while time.time() - t0 < duration_s:
+            t = time.time() - t0
+            if t >= script["burst_on_at"] and "burst_on" not in done:
+                done.add("burst_on")
+                sim_cmd("serve 80 16 900 0.55", 1)
+                burst_on_t = time.time()
+                allowed_until = t + grace
+            if t >= script["partition_at"] and "partition" not in done:
+                done.add("partition")
+                sim_cmd(f"partition {partitioned}", partitioned)
+            if (
+                t >= script["heal_partition_at"]
+                and "heal_partition" not in done
+            ):
+                done.add("heal_partition")
+                sim_cmd("heal", 1)
+            if t >= script["stale_at"] and "stale" not in done:
+                done.add("stale")
+                sim_cmd(f"partition {nodes}", nodes)
+            if t >= script["heal_stale_at"] and "heal_stale" not in done:
+                done.add("heal_stale")
+                sim_cmd("heal", 1)
+                heal2_t = time.time()
+                recovery["heal_t_s"] = round(t, 2)
+            if t >= script["kill_at"] and "kill" not in done:
+                done.add("kill")
+                # Harvest the victim's monotonic counters first — they
+                # die with the process.
+                body = get(1, "/metrics")
+                if body is not None:
+                    note_counters("shard1", body)
+                counter_lives["shard1_prekill"] = counter_lives.pop(
+                    "shard1", {}
+                )
+                kill_t = time.time()
+                shards[1].close()
+                shards[1] = None
+                conns.pop(1, None)
+                heal2_t = None  # recovery window closed by the kill
+            if t >= script["restart_at"] and "restart" not in done:
+                done.add("restart")
+                if takeover is None:
+                    takeover = {"latency_s": None, "windows": None}
+                shards[1] = build_aggregator(shard_cfg(1, urls))
+                shards[1].start()
+                conns.pop(1, None)
+            if t >= script["burst_off_at"] and "burst_off" not in done:
+                done.add("burst_off")
+                sim_cmd("serve 8 1 120 1.0", 1)
+                allowed_until = t + grace
+
+            # Takeover progress: after the kill, watch shard 0 adopt.
+            if "kill" in done and takeover is None:
+                cover = covered(0)
+                if cover is not None and cover >= nodes - 0.5:
+                    latency = time.time() - kill_t
+                    takeover = {
+                        "latency_s": round(latency, 2),
+                        "windows": round(latency / takeover_s, 2),
+                    }
+
+            # Page scan: honesty + monotonic counter harvest.
+            for i in range(2):
+                body = get(i, "/metrics")
+                if body is None:
+                    continue
+                note_counters(f"shard{i}", body)
+                stats = _page_stats(body)
+                if (
+                    stats["up"] is not None
+                    and stats["targets"] is not None
+                    and stats["up"] < stats["targets"]
+                    and stats["stale_flag"] == 0.0
+                    and (
+                        stats["visibility"] is None
+                        or stats["visibility"] >= 1.0
+                    )
+                ):
+                    honesty_violations += 1
+
+            # Hint-band do-no-harm scan + withheld bookkeeping.
+            withheld_now: dict[int, set] = {0: set(), 1: set()}
+            any_withheld_row = False
+            for i in range(2):
+                doc = _json_or_none(get(i, "/hints"))
+                if doc is None:
+                    continue
+                for row in doc.get("slices") or []:
+                    key = (i, row.get("pool"), row.get("slice"))
+                    band = row.get("band")
+                    wh = bool(row.get("withheld"))
+                    if wh:
+                        any_withheld_row = True
+                        withheld_observations += 1
+                        withheld_now[i].add(
+                            (row.get("pool"), row.get("slice"))
+                        )
+                        reason = row.get("withheld_reason") or "untrusted"
+                        withheld_reasons[reason] = (
+                            withheld_reasons.get(reason, 0) + 1
+                        )
+                    if row.get("frozen"):
+                        frozen_observations += 1
+                    prev = last_bands.get(key)
+                    if (
+                        prev is not None
+                        and band != prev[0]
+                        and (wh or prev[1])
+                    ):
+                        band_violations += 1
+                    last_bands[key] = (band, wh)
+
+            # The HPA decision: sum the answer over both shards; hold
+            # on absent or partial — the trust gate is what makes
+            # degraded scopes LOOK partial instead of feeding stale
+            # values into a complete-looking answer.
+            polls += 1
+            n_items = 0
+            total = 0.0
+            partial = False
+            for i in range(2):
+                items = em_items(i)
+                if items is None:
+                    partial = True
+                    continue
+                n_items += len(items)
+                for item in items:
+                    total += _quantity(item["value"])
+                    labels = item.get("metricLabels") or {}
+                    scope = (labels.get("pool"), labels.get("slice"))
+                    # A scope withheld across two consecutive /hints
+                    # snapshots must not appear as an item: withheld
+                    # means ABSENT, never a value.
+                    if (
+                        scope in withheld_now[i]
+                        and scope in prev_withheld[i]
+                    ):
+                        withheld_served_violations += 1
+            prev_withheld = withheld_now
+            if n_items == 0:
+                hold_absent += 1
+            elif partial or n_items < expected_items:
+                hold_partial += 1
+            else:
+                acted += 1
+                desired = 2 if total > queue_threshold else 1
+                if desired != replicas:
+                    in_allowed = t <= allowed_until
+                    replica_changes.append({
+                        "t_s": round(t, 2),
+                        "from": replicas,
+                        "to": desired,
+                        "value": round(total, 1),
+                        "allowed": in_allowed,
+                    })
+                    if not in_allowed:
+                        replica_violations += 1
+                    if (
+                        desired > replicas
+                        and burst_on_t is not None
+                        and signal_latency_s is None
+                    ):
+                        signal_latency_s = round(
+                            time.time() - burst_on_t, 2
+                        )
+                    replicas = desired
+
+            # Post-heal recovery: visibility back first, then the
+            # first fully-trusted complete answer.
+            if heal2_t is not None:
+                if vis_restored_t is None:
+                    views = [
+                        _page_stats(b) for b in
+                        (get(i, "/metrics") for i in range(2))
+                        if b is not None
+                    ]
+                    if views and all(
+                        v["visibility"] is not None
+                        and v["visibility"] >= 1.0
+                        for v in views
+                    ):
+                        vis_restored_t = time.time()
+                        recovery["visibility_restored_s"] = round(
+                            vis_restored_t - heal2_t, 2
+                        )
+                elif (
+                    recovery["trusted_s"] is None
+                    and not any_withheld_row
+                    and not partial
+                    and n_items >= expected_items
+                ):
+                    recovery["trusted_s"] = round(
+                        time.time() - heal2_t, 2
+                    )
+                    recovery["intervals_after_visibility"] = round(
+                        (time.time() - vis_restored_t) / interval, 2
+                    )
+
+            next_at += scrape_every_s
+            time.sleep(max(0.0, next_at - time.time()))
+
+        # Final harvest: counters, takeovers, debug views.
+        final_debug: dict = {}
+        for i in range(2):
+            body = get(i, "/metrics")
+            if body is not None:
+                note_counters(f"shard{i}", body)
+            debug = _json_or_none(get(i, "/debug/vars")) or {}
+            final_debug[f"shard{i}"] = debug.get("actuate")
+    finally:
+        for conn in conns.values():
+            conn.close()
+        for shard in shards:
+            if shard is not None:
+                shard.close()
+        if sim_proc is not None:
+            sim_proc.terminate()
+            try:
+                sim_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                sim_proc.kill()
+        for spool_dir in spools:
+            shutil.rmtree(spool_dir, ignore_errors=True)
+        sys.setswitchinterval(prev_switch)
+
+    lat_ms.sort()
+
+    def _q(p: float):
+        return round(quantile(lat_ms, p), 3) if lat_ms else None
+
+    def _life_total(name: str) -> float:
+        return sum(d.get(name, 0.0) for d in counter_lives.values())
+
+    record.update(
+        {
+            "duration_s": round(duration_s, 1),
+            "requests": len(lat_ms),
+            "failed_requests": failed_scrapes,
+            "p50_ms": _q(0.5),
+            "p99_ms": _q(0.99),
+            "hpa": {
+                "polls": polls,
+                "acted": acted,
+                "hold_absent": hold_absent,
+                "hold_partial": hold_partial,
+                "final_replicas": replicas,
+                "replica_changes": replica_changes,
+                "signal_latency_s": signal_latency_s,
+            },
+            "do_no_harm": {
+                "replica_violations": replica_violations,
+                "band_violations": band_violations,
+                "withheld_served_violations": withheld_served_violations,
+                "grace_s": grace,
+            },
+            "trust": {
+                "withheld_observations": withheld_observations,
+                "frozen_observations": frozen_observations,
+                "withheld_reasons": withheld_reasons,
+                "withheld_total_counter": _life_total(
+                    "tpu_actuate_withheld_total"
+                ),
+            },
+            "epoch_conflicts_total": _life_total(
+                "tpu_actuate_epoch_conflicts_total"
+            ),
+            "epoch_conflicts_by_life": {
+                life: d.get("tpu_actuate_epoch_conflicts_total", 0.0)
+                for life, d in counter_lives.items()
+            },
+            "takeover": takeover or {"latency_s": None, "windows": None},
+            "takeovers_total": _life_total("tpu_fleet_takeovers_total"),
+            "recovery": recovery,
+            "honesty_violations": honesty_violations,
+            "final_actuate_debug": final_debug,
+            "sim_log": sim_log,
+        }
+    )
+    return record
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="tpumon-soak")
     parser.add_argument("--duration", type=float, default=2700.0,
@@ -3451,6 +4023,17 @@ def main(argv=None) -> int:
     parser.add_argument("--serve-scale-out", type=int, default=4,
                         help="extra capacity nodes that join mid-burst "
                         "for --serve-burst")
+    parser.add_argument("--actuate-chaos", action="store_true",
+                        help="fail-safe actuation drill (ISSUE 18): a "
+                        "scripted HPA simulator consumes the External "
+                        "Metrics adapter off two peer-probing shards "
+                        "while fleetsim runs partition → full-fleet "
+                        "staleness → shard kill → warm restart; "
+                        "reports do-no-harm violation counts (replica/"
+                        "band changes caused by degraded telemetry), "
+                        "withheld-scope absence, epoch-conflict "
+                        "resolution (newest wins), takeover windows, "
+                        "and post-heal recovery latency")
     parser.add_argument("--fleet-churn", type=float, default=0.02,
                         help="steady-state content churn fraction for "
                         "--fleet-delta's idle phases")
@@ -3522,6 +4105,12 @@ def main(argv=None) -> int:
             churn=args.fleet_churn, churn_high=args.fleet_churn_high,
             kill=args.fleet_kill, node_interval=args.fleet_node_interval,
             controls=False, check_leaks=True, mode="fleet-scale",
+        )
+    elif args.actuate_chaos:
+        record = actuate_chaos_soak(
+            args.duration, nodes=args.fleet_nodes, topology=args.topology,
+            interval=args.interval, scrape_every_s=args.scrape_every,
+            takeover_s=args.fleet_takeover_s,
         )
     elif args.serve_burst:
         record = serve_burst_soak(

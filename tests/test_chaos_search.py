@@ -1,0 +1,360 @@
+"""Property-based chaos search units (tpumon/chaos, ISSUE 19).
+
+The fast tier pins the pieces in isolation — schedule grammar
+determinism and round-trip, each invariant predicate against synthetic
+surface samples, ddmin convergence against a fake experiment — and the
+slow tier runs one real seeded schedule against a live two-shard fleet
+plus the mutation-canary catch-and-minimize loop CI depends on.
+"""
+
+import json
+
+import pytest
+
+from tpumon.chaos.invariants import (
+    INVARIANT_CATALOG,
+    VISIBILITY_DEBOUNCE,
+    InvariantChecker,
+    SurfaceSample,
+    page_stats,
+)
+from tpumon.chaos.minimize import minimize
+from tpumon.chaos.schedule import ALL_OPS, FaultSchedule, FaultStep
+
+
+# -- schedule grammar --------------------------------------------------------
+
+
+def test_generate_is_deterministic_in_seed():
+    a = FaultSchedule.generate(1234, nodes=16, duration_s=20.0)
+    b = FaultSchedule.generate(1234, nodes=16, duration_s=20.0)
+    assert a == b
+    assert a != FaultSchedule.generate(1235, nodes=16, duration_s=20.0)
+
+
+def test_generate_json_round_trip_exact():
+    for seed in range(40):
+        s = FaultSchedule.generate(seed, nodes=16, duration_s=20.0)
+        assert FaultSchedule.from_json(s.to_json()) == s
+
+
+def test_generated_steps_are_legal():
+    """The stateful generator only emits ops that make sense: revive
+    never before a kill left someone dead, times inside the observable
+    window, all ops in the vocabulary."""
+    for seed in range(60):
+        s = FaultSchedule.generate(seed, nodes=16, duration_s=20.0)
+        dead = 0
+        for step in s.steps:
+            assert step.op in ALL_OPS
+            assert 0.0 < step.at < s.duration_s
+            if step.op == "kill":
+                dead += step.args["n"]
+            elif step.op == "revive":
+                assert dead > 0, s.describe()
+                dead -= step.args["n"]
+        assert len(s.steps) >= 3
+
+
+def test_subset_keeps_provenance():
+    s = FaultSchedule.generate(7, nodes=8, duration_s=10.0)
+    sub = s.subset([0, 2])
+    assert sub.parent_steps == (0, 2)
+    assert sub.steps == (s.steps[0], s.steps[2])
+    # A subset of a subset maps back to the ORIGINAL indices.
+    assert sub.subset([1]).parent_steps == (2,)
+    # Provenance survives the JSON round trip.
+    assert FaultSchedule.from_json(sub.to_json()).parent_steps == (0, 2)
+
+
+def test_from_doc_rejects_unknown_op_and_version():
+    doc = FaultSchedule.generate(1).to_doc()
+    doc["steps"][0]["op"] = "meteor_strike"
+    with pytest.raises(ValueError):
+        FaultSchedule.from_doc(doc)
+    doc2 = FaultSchedule.generate(1).to_doc()
+    doc2["version"] = 99
+    with pytest.raises(ValueError):
+        FaultSchedule.from_doc(doc2)
+
+
+# -- invariant predicates ----------------------------------------------------
+
+
+def _page(up=2, stale=0, dark=0, stale_flag=0.0, visibility=None,
+          targets=None, extra=b""):
+    total = up + stale + dark
+    if visibility is None:
+        visibility = (up + stale) / total if total else 1.0
+    if targets is None:
+        targets = total
+    fleet = 'pool="",scope="fleet",slice=""'
+    body = (
+        f'tpu_fleet_hosts{{{fleet},state="up"}} {up}\n'
+        f'tpu_fleet_hosts{{{fleet},state="stale"}} {stale}\n'
+        f'tpu_fleet_hosts{{{fleet},state="dark"}} {dark}\n'
+        f'tpu_fleet_stale_rollup{{{fleet}}} {stale_flag}\n'
+        f'tpu_fleet_visibility_ratio{{{fleet}}} {visibility}\n'
+        f'tpu_fleet_shard_targets {targets}\n'
+    ).encode()
+    return body + extra
+
+
+def _sample(**kw):
+    defaults = dict(
+        t=1.0, shard=0, metrics=None, fleet=None, hints=None,
+        em_items=None, goodput=None, ledger_queries=(),
+    )
+    defaults.update(kw)
+    return SurfaceSample(**defaults)
+
+
+def test_page_stats_parses_fleet_scope():
+    stats = page_stats(_page(up=3, stale=1, dark=2, stale_flag=1.0))
+    assert stats["up"] == 3 and stats["stale"] == 1 and stats["dark"] == 2
+    assert stats["stale_flag"] == 1.0
+    assert stats["targets"] == 6
+
+
+def test_missing_host_unflagged_fires():
+    checker = InvariantChecker()
+    # 1 of 2 targets missing, but the page claims clean + full vis.
+    body = _page(up=1, stale=0, dark=0, stale_flag=0.0,
+                 visibility=1.0, targets=2)
+    checker.observe(_sample(metrics=body))
+    assert [v.invariant for v in checker.violations] == [
+        "missing_host_unflagged"
+    ]
+
+
+def test_missing_host_flagged_passes():
+    checker = InvariantChecker()
+    body = _page(up=1, stale=1, dark=0, stale_flag=1.0,
+                 visibility=1.0, targets=2)
+    checker.observe(_sample(metrics=body))
+    assert checker.violations == []
+
+
+def test_per_node_series_leak_fires():
+    checker = InvariantChecker()
+    leak = b'accelerator_duty_cycle_percent{chip="0"} 50\n'
+    checker.observe(_sample(metrics=_page(extra=leak)))
+    assert [v.invariant for v in checker.violations] == [
+        "per_node_series_leak"
+    ]
+    checker2 = InvariantChecker()
+    checker2.observe(_sample(metrics=_page(extra=b"tpu_serve_qps 1\n")))
+    assert [v.invariant for v in checker2.violations] == [
+        "per_node_series_leak"
+    ]
+
+
+def test_visibility_consistency_debounced():
+    """A one-sample /metrics-vs-/fleet disagreement is a render race,
+    not a bug: conviction needs the SAME disagreeing pair stable for
+    VISIBILITY_DEBOUNCE consecutive samples."""
+    checker = InvariantChecker()
+    body = _page(up=2, visibility=1.0)
+    fleet = {"fleet": {"visibility": 0.5, "hosts": {}}}
+    for i in range(VISIBILITY_DEBOUNCE - 1):
+        checker.observe(_sample(t=float(i), metrics=body, fleet=fleet))
+    assert checker.violations == []
+    checker.observe(_sample(t=9.0, metrics=body, fleet=fleet))
+    assert [v.invariant for v in checker.violations] == [
+        "visibility_consistency"
+    ]
+    # A changing pair (converging surfaces) never convicts.
+    checker2 = InvariantChecker()
+    for i, vis in enumerate((0.5, 0.6, 0.7, 0.8, 0.9, 1.0)):
+        checker2.observe(_sample(
+            t=float(i), metrics=body,
+            fleet={"fleet": {"visibility": vis, "hosts": {}}},
+        ))
+    assert checker2.violations == []
+
+
+def test_epoch_monotonic_and_reset():
+    checker = InvariantChecker()
+    row = {"pool": "v5p", "slice": "s1", "epoch": 4}
+    checker.observe(_sample(hints={"slices": [row]}))
+    checker.observe(_sample(hints={"slices": [dict(row, epoch=5)]}))
+    assert checker.violations == []
+    checker.observe(_sample(hints={"slices": [dict(row, epoch=3)]}))
+    assert [v.invariant for v in checker.violations] == ["epoch_monotonic"]
+    # A restarted shard legitimately re-claims from its spool: the
+    # high-water mark must reset with the shard life.
+    checker.reset_shard(0)
+    checker.observe(_sample(hints={"slices": [dict(row, epoch=1)]}))
+    assert len(checker.violations) == 1  # no new conviction
+
+
+def test_epoch_decrease_forgiven_inside_settling_window():
+    """A shard kill/restart churns ownership: the SURVIVOR's per-scope
+    epoch (max over its owned members) legitimately drops when the
+    hand-back removes adopted members — inside the announced settling
+    window a decrease rebases; outside it, conviction resumes."""
+    checker = InvariantChecker()
+    row = {"pool": "v5p", "slice": "s1", "epoch": 2}
+    checker.observe(_sample(t=1.0, hints={"slices": [row]}))
+    # The engine announces the disruption at the shard_restart step.
+    checker.note_ownership_disruption(2.0, settle_s=5.0)
+    checker.observe(_sample(t=3.0, hints={"slices": [dict(row, epoch=1)]}))
+    assert checker.violations == []
+    # The rebase re-arms monotonicity from the LOWER value: a later
+    # decrease outside the window convicts against epoch 1's successor.
+    checker.observe(_sample(t=8.0, hints={"slices": [dict(row, epoch=3)]}))
+    checker.observe(_sample(t=9.0, hints={"slices": [dict(row, epoch=2)]}))
+    assert [v.invariant for v in checker.violations] == ["epoch_monotonic"]
+
+
+def test_em_absent_below_trust_floor_needs_two_samples():
+    checker = InvariantChecker()
+    withheld = {"slices": [
+        {"pool": "v5p", "slice": "s1", "withheld": True,
+         "withheld_reason": "untrusted"},
+    ]}
+    served = [{"metricName": "tpumon_serve_queue_depth",
+               "metricLabels": {"pool": "v5p", "slice": "s1"}}]
+    # First withheld sample: adapter may race one render behind.
+    checker.observe(_sample(hints=withheld, em_items=served))
+    assert checker.violations == []
+    # Second consecutive withheld sample still serving: conviction.
+    checker.observe(_sample(t=2.0, hints=withheld, em_items=served))
+    assert [v.invariant for v in checker.violations] == [
+        "em_absent_below_trust_floor"
+    ]
+
+
+def test_goodput_conservation():
+    checker = InvariantChecker()
+    ok = {"jobs": [{
+        "job": "v5p/s1", "chip_seconds": 10.0,
+        "buckets": {"productive": 6.0, "idle": 4.0},
+    }]}
+    checker.observe(_sample(goodput=ok))
+    assert checker.violations == []
+    bad = {"jobs": [{
+        "job": "v5p/s1", "chip_seconds": 10.0,
+        "buckets": {"productive": 6.0, "idle": 3.0},
+    }]}
+    checker.observe(_sample(t=2.0, goodput=bad))
+    assert [v.invariant for v in checker.violations] == [
+        "goodput_conservation"
+    ]
+
+
+def test_ledger_query_never_5xx():
+    checker = InvariantChecker()
+    checker.observe(_sample(ledger_queries=[
+        ("goodput", 200), ("range", 200), ("malformed", 400),
+    ]))
+    assert checker.violations == []
+    checker.observe(_sample(t=2.0, ledger_queries=[("range", 500)]))
+    assert [v.invariant for v in checker.violations] == ["ledger_query_5xx"]
+
+
+def test_checker_summary_counts_every_catalog_predicate():
+    checker = InvariantChecker()
+    checker.observe(_sample(
+        metrics=_page(), fleet={"fleet": {"visibility": 1.0, "hosts": {}}},
+        hints={"slices": []}, em_items=[],
+        goodput={"jobs": []}, ledger_queries=[("goodput", 200)],
+    ))
+    summary = checker.summary()
+    assert summary["samples_checked"] == 1
+    assert set(summary["evaluated"]) == set(INVARIANT_CATALOG)
+    assert summary["violations"] == 0
+
+
+# -- ddmin -------------------------------------------------------------------
+
+
+def _fake_schedule(n):
+    return FaultSchedule(
+        seed=0, nodes=4, duration_s=10.0,
+        steps=tuple(
+            FaultStep(at=float(i + 1), op="kill", args={"n": 1})
+            for i in range(n)
+        ),
+    )
+
+
+def test_minimize_finds_the_two_step_core():
+    schedule = _fake_schedule(8)
+    runs = []
+
+    def still_fails(candidate):
+        kept = set(candidate.parent_steps)
+        runs.append(kept)
+        return {2, 5} <= kept
+
+    minimized, stats = minimize(schedule, still_fails)
+    assert minimized.parent_steps == (2, 5)
+    assert stats["minimized_steps"] == 2
+    assert stats["reduced"] is True
+    assert stats["minimal"] is True
+    assert stats["probes"] == len(runs) <= 24
+
+
+def test_minimize_single_culprit_and_budget():
+    minimized, stats = minimize(
+        _fake_schedule(8), lambda c: 4 in set(c.parent_steps),
+    )
+    assert minimized.parent_steps == (4,)
+    assert stats["minimal"] is True
+
+    # Probe budget respected even when nothing reproduces.
+    calls = []
+    minimized2, stats2 = minimize(
+        _fake_schedule(8),
+        lambda c: calls.append(1) is None and False,
+        max_probes=5,
+    )
+    assert len(calls) == 5
+    assert stats2["reduced"] is False
+    assert len(minimized2.steps) == 8  # unchanged: nothing proved removable
+
+
+# -- live fleet (slow tier) --------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_search_one_clean_seed_live():
+    """One real seeded schedule over a live 2-shard fleet: every
+    catalog predicate evaluated, zero violations (seed 1 is in the CI
+    fixed-seed smoke set — a regression here is a real honesty bug)."""
+    from tpumon.chaos.search import run_trial
+
+    record = run_trial(
+        FaultSchedule.generate(1, nodes=8, duration_s=10.0)
+    )
+    assert record["failed"] is False, record["violations"]
+    assert record["checker"]["samples_checked"] > 10
+    assert set(record["checker"]["evaluated"]) == set(INVARIANT_CATALOG)
+    assert all(
+        count > 0 for count in record["checker"]["evaluated"].values()
+    )
+
+
+@pytest.mark.slow
+def test_mutation_canary_is_caught_and_minimized(monkeypatch, tmp_path):
+    """The CI canary loop end to end: with the planted honesty bug the
+    search must fail under the right invariant, shrink to a tiny
+    reproducer, and that reproducer must replay deterministically."""
+    from tpumon.chaos.search import chaos_search
+
+    monkeypatch.setenv("TPUMON_CHAOS_MUTATE", "missing_host_unflagged")
+    record = chaos_search(
+        schedules=1, seed0=2, nodes=8, duration_s=10.0,
+        out_dir=str(tmp_path),
+    )
+    assert record["ok"] is False
+    assert record["mutation"] == "missing_host_unflagged"
+    assert "missing_host_unflagged" in record["violations_by_invariant"]
+    (failure,) = record["failures"]
+    assert len(failure["minimized"]["steps"]) <= 5
+    assert failure["replay_failed"] is True
+    artifact = tmp_path / "failing-schedule-seed2.json"
+    doc = json.loads(artifact.read_text())
+    replayed = FaultSchedule.from_doc(doc["minimized"])
+    assert replayed.seed == 2 and replayed.parent_steps is not None

@@ -105,3 +105,28 @@ def test_every_registered_family_is_documented():
     documented = set(re.findall(r"`([a-z][a-z0-9_]+)`", doc))
     missing = {n for n in all_family_names() if n not in documented}
     assert not missing, f"families missing from docs/METRICS.md: {missing}"
+
+
+def test_runtime_invariant_catalog_matches_docs():
+    """docs/INVARIANTS.md's runtime-invariant table and the
+    machine-readable INVARIANT_CATALOG must name the same predicates —
+    the reproducer JSON vocabulary cannot drift from the doc."""
+    import os
+    import re
+
+    from tpumon.chaos.invariants import INVARIANT_CATALOG
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(__file__)), "docs", "INVARIANTS.md"
+    )
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    section = text.split("## Runtime honesty invariants", 1)
+    assert len(section) == 2, "INVARIANTS.md lost the runtime section"
+    documented = set(re.findall(r"^\| `([a-z0-9_]+)` \|", section[1], re.M))
+    assert documented == set(INVARIANT_CATALOG), (
+        f"doc/table drift: only-doc={documented - set(INVARIANT_CATALOG)} "
+        f"only-catalog={set(INVARIANT_CATALOG) - documented}"
+    )
+    # The mutation-canary knob is documented next to the catalog.
+    assert "TPUMON_CHAOS_MUTATE" in section[1]

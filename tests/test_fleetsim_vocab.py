@@ -1,0 +1,115 @@
+"""fleetsim's extended fault vocabulary (skew / creep / revive / faults).
+
+The chaos grammar (tpumon/chaos/schedule.py) renders these as stdin
+commands against a fleetsim subprocess; these tests pin the in-process
+semantics the grammar relies on: ``skew`` lies about the DATA timestamp
+only (transport stays honest), ``revive`` brings a killed node's
+listener back on its original port, ``creep`` ramps latency instead of
+stepping it, and ``faults`` wraps/unwraps the shared backend without
+breaking the page.
+"""
+
+import http.client
+import re
+import time
+
+import pytest
+
+from tpumon.tools.fleetsim import FleetSim
+
+
+@pytest.fixture
+def sim():
+    s = FleetSim(2, node_interval=0.1, churn=0.0)
+    yield s
+    s.close()
+
+
+def _get(port: int, timeout: float = 3.0) -> bytes:
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        return resp.read()
+    finally:
+        conn.close()
+
+
+def _last_poll_ts(body: bytes) -> float:
+    m = re.search(rb"^collector_last_poll_timestamp_seconds (\S+)", body, re.M)
+    assert m, body[:300]
+    return float(m.group(1))
+
+
+def _wait_tick(sim, extra: float = 0.05) -> None:
+    time.sleep(2 * sim.node_interval + extra)
+
+
+def test_skew_lies_about_data_timestamp_only(sim):
+    _wait_tick(sim)
+    sim.skew(1, 7200.0)
+    _wait_tick(sim)
+    now = time.time()
+    skewed = _last_poll_ts(_get(sim.ports[0]))
+    honest = _last_poll_ts(_get(sim.ports[1]))
+    # Node 0's heartbeat reads two hours in the future; node 1 (and the
+    # transport for both — the 200s above) stays honest.
+    assert skewed - now == pytest.approx(7200.0, abs=5.0)
+    assert honest - now == pytest.approx(0.0, abs=5.0)
+    sim.heal()
+    _wait_tick(sim)
+    healed = _last_poll_ts(_get(sim.ports[0]))
+    assert healed - time.time() == pytest.approx(0.0, abs=5.0)
+
+
+def test_negative_skew(sim):
+    sim.skew(1, -86400.0)
+    _wait_tick(sim)
+    assert _last_poll_ts(_get(sim.ports[0])) - time.time() == pytest.approx(
+        -86400.0, abs=5.0
+    )
+
+
+def test_kill_then_revive_restores_listener(sim):
+    _wait_tick(sim)
+    out = sim.kill(1)
+    assert out  # one ack per victim
+    # Victim 0 is an even index: page frozen (serves, never advances).
+    t1 = _last_poll_ts(_get(sim.ports[0]))
+    _wait_tick(sim)
+    assert _last_poll_ts(_get(sim.ports[0])) == t1
+    assert sim.revive(1) == ["revived node-0 (page thaws)"]
+    _wait_tick(sim)
+    assert _last_poll_ts(_get(sim.ports[0])) > t1
+    # Nothing left dead: revive says so instead of lying.
+    assert sim.revive(1) == ["no dead nodes to revive"]
+
+
+def test_creep_ramps_latency(sim):
+    t0 = time.time()
+    _get(sim.ports[0])
+    baseline = time.time() - t0
+    sim.creep(1, max_delay_s=0.4, ramp_s=0.6)
+    time.sleep(0.7)  # past the ramp: full delay
+    t0 = time.time()
+    _get(sim.ports[0])
+    assert time.time() - t0 >= baseline + 0.3
+    sim.heal()
+    t0 = time.time()
+    _get(sim.ports[0])
+    assert time.time() - t0 < 0.3
+
+
+def test_faults_wraps_and_heals_backend(sim):
+    _wait_tick(sim)
+    assert sim.faults("latency_ms=1,seed=7")
+    _wait_tick(sim)
+    body = _get(sim.ports[0])  # still a servable page under faults
+    assert b"collector_last_poll_timestamp_seconds" in body
+    assert sim.faults("off")
+    sim.heal()
+    _wait_tick(sim)
+    t1 = _last_poll_ts(_get(sim.ports[1]))
+    _wait_tick(sim)
+    assert _last_poll_ts(_get(sim.ports[1])) >= t1

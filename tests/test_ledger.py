@@ -620,6 +620,7 @@ def test_ledger_families_subset_of_registry_and_docs():
     class _FakeSpool:
         path = "/tmp/x"
         last_write_ts = 0.0
+        degraded = False
     plane.spool = _FakeSpool()
     snap = {
         "identity": {"accelerator": "v5p-16", "slice": "s0"},

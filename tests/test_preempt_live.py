@@ -1,0 +1,127 @@
+"""Live preemption drill: SIGTERM against a REAL jax training process.
+
+The lifecycle plane's preemption signature (`tpu_step_terminating 1`
+inside the grace window) was only ever asserted against the
+ScriptedWorkload fixture — this closes the ROADMAP item-3 remnant by
+driving the real thing: a genuine ``tpumon.workload.harness`` process
+(real jax init, real train steps, real signal handler) is preempted the
+way Kubernetes does it (SIGTERM → grace → SIGKILL), and the drill
+asserts the whole grace choreography end to end off the live /metrics
+page: flag 0 while training, flag 1 within the grace window, process
+exit with the conventional 143 before the would-be SIGKILL.
+
+Slow-marked (jax init + compile), and skips cleanly where jax cannot
+initialize a CPU backend at all.
+"""
+
+import http.client
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GRACE_S = 3.0
+
+
+def _jax_can_init() -> bool:
+    try:
+        import jax
+
+        return len(jax.devices("cpu")) > 0
+    except Exception:
+        return False
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _metrics(port: int) -> str | None:
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+    try:
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        return resp.read().decode() if resp.status == 200 else None
+    except OSError:
+        return None
+    finally:
+        conn.close()
+
+
+def _gauge(page: str, name: str) -> float | None:
+    m = re.search(rf"^{name} (\S+)", page, re.M)
+    return float(m.group(1)) if m else None
+
+
+def test_live_sigterm_grace_signature():
+    if not _jax_can_init():
+        pytest.skip("jax cannot initialize a CPU backend here")
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TPUMON_STEP_TERM_GRACE_S"] = str(GRACE_S)
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "tpumon.workload.harness",
+            "--steps", "1000000", "--preset", "tiny", "--batch", "2",
+            "--platform", "cpu", "--metrics-port", str(port),
+        ],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        # Wait for the live page: the flag must read 0 while training.
+        deadline = time.monotonic() + 120.0
+        page = None
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                out, _ = proc.communicate(timeout=10)
+                pytest.fail(f"harness died before serving: {out[-2000:]}")
+            page = _metrics(port)
+            if page and _gauge(page, "tpu_step_terminating") is not None:
+                break
+            time.sleep(0.25)
+        assert page is not None, "harness /metrics never came up"
+        assert _gauge(page, "tpu_step_terminating") == 0.0
+
+        # The preemption: one SIGTERM, Kubernetes-style.
+        t_term = time.monotonic()
+        proc.send_signal(signal.SIGTERM)
+
+        # The page must flag the grace window BEFORE the process exits
+        # — that ordering is the whole point of the signature.
+        flagged_at = None
+        while time.monotonic() - t_term < GRACE_S + 5.0:
+            page = _metrics(port)
+            if page is None:
+                break  # process gone
+            if _gauge(page, "tpu_step_terminating") == 1.0:
+                flagged_at = time.monotonic() - t_term
+                break
+            time.sleep(0.1)
+        assert flagged_at is not None, (
+            "tpu_step_terminating never read 1 during the grace window"
+        )
+        assert flagged_at < GRACE_S, (
+            f"flag observed only {flagged_at:.1f}s after SIGTERM — a 1 Hz "
+            "lifecycle prober inside the grace window would miss it"
+        )
+
+        # After the grace window the process exits 143 on its own —
+        # the deferred exit, not the SIGKILL fallback.
+        rc = proc.wait(timeout=GRACE_S + 20.0)
+        assert rc == 143, f"expected exit 143 after grace, got {rc}"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)

@@ -1,0 +1,97 @@
+"""Clock-skew honesty: the 1 h skew clamp in fan-in timestamping.
+
+A node whose wall clock lies (fleetsim ``skew N ±S``) publishes a
+``last_poll_ts`` that disagrees with the aggregator's clock. The clamp
+in ``NodeFeed.store_snapshot`` (tpumon/fleet/ingest.py) pins two
+promises these tests make regression-proof:
+
+- **never time-travels**: a FUTURE-skewed heartbeat reads as exactly
+  fetch-fresh (age 0), never fresher — the effective data timestamp
+  is ``now - min(max(0, now - last_poll), 3600)``, so a negative
+  apparent age floors at zero;
+- **stale-flags**: a PAST-skewed heartbeat ages the node toward
+  stale/dark like a zombie exporter, clamped at one hour — far enough
+  to flag (rollup) and to bucket the window unaccounted (ledger), near
+  enough that operators see a broken clock, not an evicted node.
+"""
+
+import pytest
+
+from tpumon.fleet.ingest import NodeFeed
+from tpumon.fleet.rollup import classify, rollup
+from tpumon.ledger.goodput import GoodputLedger
+
+NOW = 1_000_000.0
+
+
+def _feed_with_skew(skew_s: float) -> NodeFeed:
+    feed = NodeFeed("http://n0:9100", clock=lambda: NOW)
+    feed.store_snapshot(
+        {"chips": {}, "last_poll_ts": NOW + skew_s}, mode="poll"
+    )
+    return feed
+
+
+@pytest.mark.parametrize("skew_s", (120.0, 3600.0, 86400.0, 1e9))
+def test_future_skew_never_time_travels(skew_s):
+    """Any future-dated heartbeat reads fetch-fresh, never fresher:
+    age exactly 0, classified up — not negative, not evicted."""
+    feed = _feed_with_skew(+skew_s)
+    _snap, data_ts, _err = feed.current()
+    assert data_ts == NOW
+    assert feed.age(NOW) == 0.0
+    assert classify(feed.age(NOW), 5.0, 60.0) == "up"
+
+
+@pytest.mark.parametrize(
+    "skew_s,expect_age",
+    [(120.0, 120.0), (3600.0, 3600.0), (7200.0, 3600.0), (86400.0, 3600.0)],
+)
+def test_past_skew_ages_clamped_at_one_hour(skew_s, expect_age):
+    feed = _feed_with_skew(-skew_s)
+    assert feed.age(NOW) == pytest.approx(expect_age)
+    # Any skew beyond the staleness thresholds flags, clamp included.
+    assert classify(feed.age(NOW), 5.0, 60.0) == "dark"
+    # The clamp keeps a broken clock INSIDE a 2 h eviction horizon:
+    # stale-flagged and visible, never silently evicted as ancient.
+    assert classify(feed.age(NOW), 5.0, 7200.0) in ("stale", "dark")
+
+
+def test_rollup_stale_flags_skewed_node():
+    """A past-skewed node rides the rollup as a stale host with the
+    slice stale-flagged — degraded visibility, never a clean page."""
+    snap = {
+        "identity": {"slice": "s1", "accelerator": "v5p", "host": "h0"},
+        "chips": {"0": {"duty_pct": 50.0}},
+    }
+    doc = rollup([
+        {"snap": snap, "state": "stale"},
+        {"snap": dict(snap, chips={"1": {"duty_pct": 60.0}}),
+         "state": "up"},
+    ])
+    s1 = doc["slices"][("v5p", "s1")]
+    assert s1["hosts"]["stale"] == 1
+    assert s1["stale"] is True
+    assert doc["fleet"]["stale"] is True
+
+
+def test_ledger_buckets_skewed_window_unaccounted():
+    """Goodput bucketing inherits the clamp through the state string:
+    a skewed (hence stale/dark) feed's window is charged unaccounted —
+    a lying clock never mints productive chip-seconds."""
+    ledger = GoodputLedger()
+    snap = {
+        "identity": {"slice": "s1", "accelerator": "v5p", "host": "h0"},
+        "chips": {str(i): {"duty_pct": 90.0} for i in range(4)},
+        "step_rate": 2.0,
+    }
+    t = NOW
+    ledger.account([("n0", snap, "up")], t)          # anchor watermark
+    ledger.account([("n0", snap, "up")], t + 10.0)   # healthy window
+    ledger.account([("n0", snap, "dark")], t + 20.0)  # skew-clamped life
+    jobs = ledger.jobs()
+    (buckets,) = jobs.values()
+    assert buckets["productive"] == pytest.approx(10.0 * 4)
+    assert buckets["unaccounted"] == pytest.approx(10.0 * 4)
+    # Conservation holds across the skewed window too.
+    assert sum(buckets.values()) == pytest.approx(20.0 * 4)

@@ -1,0 +1,116 @@
+"""ENOSPC/EROFS spool degradation: memory-only, counted once.
+
+A full shared emptyDir used to cost one raised-and-logged OSError per
+save cadence, forever. The degradation contract (fleet SnapshotSpool
+and LedgerSpool alike): a volume-level errno (ENOSPC/EROFS/EDQUOT)
+flips the spool to memory-only — subsequent saves SKIP the filesystem
+entirely until a retry probe every DEGRADED_RETRY_S — while the caller
+counts the transition exactly once (``op="enospc"``) and gauges
+``tpu_*_spool_degraded`` for the TPUMonSpoolDegraded alert. A
+non-volume errno (EIO) stays a plain per-attempt write failure.
+"""
+
+import errno
+
+import pytest
+
+from tpumon.fleet.spool import DEGRADE_ERRNOS, DEGRADED_RETRY_S, SnapshotSpool
+from tpumon.ledger.spool import LedgerSpool
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def clock():
+    return _Clock()
+
+
+def test_degrade_errnos_are_volume_level():
+    assert DEGRADE_ERRNOS == {errno.ENOSPC, errno.EROFS, errno.EDQUOT}
+    assert errno.EIO not in DEGRADE_ERRNOS
+
+
+def test_fleet_spool_degrades_and_skips(tmp_path, clock):
+    spool = SnapshotSpool(str(tmp_path), clock=clock)
+    spool.inject_errno = errno.ENOSPC
+    assert spool.save(["u"], {}) is False
+    assert spool.degraded and spool.degraded_reason == "ENOSPC"
+    # Inside the retry backoff the save is SKIPPED, not attempted:
+    # clearing the injector must not matter yet.
+    spool.inject_errno = None
+    clock.t += DEGRADED_RETRY_S / 2
+    assert spool.save(["u"], {}) is False
+    assert spool.degraded
+    assert not (tmp_path / "fleet-spool.json").exists()
+
+
+def test_fleet_spool_retry_probe_recovers(tmp_path, clock):
+    spool = SnapshotSpool(str(tmp_path), clock=clock)
+    spool.inject_errno = errno.EROFS
+    assert spool.save(["u"], {"n": {"snap": {}, "fetched_at": 1.0}}) is False
+    # A failing retry probe stays degraded without re-transitioning.
+    clock.t += DEGRADED_RETRY_S
+    assert spool.save(["u"], {}) is False
+    assert spool.degraded and spool.degraded_reason == "EROFS"
+    # A clean probe recovers and journals.
+    spool.inject_errno = None
+    clock.t += DEGRADED_RETRY_S
+    assert spool.save(["u"], {"n": {"snap": {}, "fetched_at": 1.0}}) is True
+    assert not spool.degraded and spool.degraded_reason is None
+    assert spool.load()["nodes"]
+
+
+def test_fleet_spool_eio_does_not_degrade(tmp_path, clock):
+    spool = SnapshotSpool(str(tmp_path), clock=clock)
+    spool.inject_errno = errno.EIO
+    assert spool.save(["u"], {}) is False
+    assert not spool.degraded
+    # Every attempt really hits the (injected) filesystem — no skip.
+    spool.inject_errno = None
+    assert spool.save(["u"], {}) is True
+
+
+def test_ledger_spool_same_contract(tmp_path, clock):
+    spool = LedgerSpool(str(tmp_path), clock=clock)
+    spool.inject_errno = errno.ENOSPC
+    assert spool.save({}, {}) is False
+    assert spool.degraded and spool.degraded_reason == "ENOSPC"
+    clock.t += 1.0
+    spool.inject_errno = None
+    assert spool.save({}, {}) is False  # still inside the backoff
+    clock.t += DEGRADED_RETRY_S
+    assert spool.save({"a": 1}, {}) is True
+    assert not spool.degraded
+    assert spool.load()["store"] == {"a": 1}
+
+
+def test_ledger_plane_counts_transition_once(tmp_path, clock):
+    """The plane's save closure counts op="enospc" exactly once per
+    False->True transition, suppresses op="write" while memory-only,
+    and renders the tpu_ledger_spool_degraded gauge."""
+    from tpumon.ledger.plane import LedgerPlane
+
+    plane = LedgerPlane(
+        spool_dir=str(tmp_path), spool_every_s=0.0, clock=clock
+    )
+    plane.spool.inject_errno = errno.ENOSPC
+    for _ in range(5):  # five cadence ticks inside one degraded spell
+        clock.t += 1.0
+        plane._maybe_spool(clock.t)
+    assert plane.spool_errors["enospc"] == 1
+    assert plane.spool_errors["write"] == 0
+    fams = {f.name: f for f in plane.families()}
+    assert fams["tpu_ledger_spool_degraded"].samples[0].value == 1.0
+    # Recovery: gauge drops, counters untouched.
+    plane.spool.inject_errno = None
+    clock.t += DEGRADED_RETRY_S + 1.0
+    plane._maybe_spool(clock.t)
+    assert plane.spool_errors["enospc"] == 1
+    fams = {f.name: f for f in plane.families()}
+    assert fams["tpu_ledger_spool_degraded"].samples[0].value == 0.0

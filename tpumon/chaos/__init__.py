@@ -1,0 +1,38 @@
+"""Property-based chaos search: seeded fault schedules, first-class
+invariants, minimized reproducers.
+
+Every robustness claim the repo makes used to come from a hand-scripted
+drill exercising ONE fault interleaving its author thought of; the
+honesty checks backing the claim were re-implemented ad hoc inside each
+soak. This package turns both into first-class objects:
+
+- :mod:`tpumon.chaos.schedule` — the full fault vocabulary (fleetsim
+  transport faults, FaultSpec content faults, clock skew, shard
+  kill/warm-restart, spool ENOSPC/EIO, query bursts) as one declarative
+  seeded :class:`FaultSchedule` grammar with a JSON round-trip, so any
+  fault interleaving is a value: generatable from a seed, replayable
+  from a file, shrinkable by a minimizer.
+- :mod:`tpumon.chaos.invariants` — the honesty predicates the paper
+  stakes the system on (absent-not-zero, stale-flagged-never-silent,
+  goodput conservation, trust-gated actuation, ...) as a checker
+  evaluated continuously against every surface during any run.
+- :mod:`tpumon.chaos.engine` — a live 2-shard aggregator fleet over
+  fleetsim that applies a schedule and samples every surface through
+  the checker.
+- :mod:`tpumon.chaos.minimize` — delta-debugging over schedule steps:
+  a failing schedule shrinks to a minimal reproducer worth reading.
+
+``tools/soak.py --chaos-search`` drives the loop: generate N seeded
+random schedules, run each, shrink the failures, persist reproducers.
+"""
+
+from tpumon.chaos.invariants import INVARIANT_CATALOG, InvariantChecker, Violation
+from tpumon.chaos.schedule import FaultSchedule, FaultStep
+
+__all__ = [
+    "FaultSchedule",
+    "FaultStep",
+    "INVARIANT_CATALOG",
+    "InvariantChecker",
+    "Violation",
+]

@@ -1,0 +1,502 @@
+"""The chaos fleet: one live 2-shard aggregator pair over fleetsim,
+driven by a :class:`~tpumon.chaos.schedule.FaultSchedule`, observed by
+an :class:`~tpumon.chaos.invariants.InvariantChecker`.
+
+One :func:`run_schedule` call is one experiment: spawn a fleetsim
+subprocess (N node identities, one process), build two peer-probing
+aggregator shards in-process (spool + ledger + actuation enabled, so
+every surface the invariants cover exists), warm up until both shards
+see their full target set, then walk wall-clock time applying schedule
+steps at their offsets while sampling every surface (/metrics, /fleet,
+/hints, the External Metrics adapter, /ledger) through the checker.
+
+The engine maintains a small mirror of fleetsim's node state (live /
+dead counts) because the control protocol acks one line per victim —
+the mirror predicts exactly how many ack lines each command produces,
+which is what makes arbitrary generated or minimized schedules safe to
+drive over the same stdin protocol the hand-written soaks use.
+
+Kills are no longer absorbing (fleetsim ``revive``), shard 1 can die
+and warm-restart from its spool, and ENOSPC/EIO inject into the spools
+via their ``inject_errno`` test hook — the full fault surface of the
+grammar, against entirely real tiers.
+"""
+
+from __future__ import annotations
+
+import errno as errno_mod
+import http.client
+import json
+import logging
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.parse
+
+from tpumon.chaos.invariants import InvariantChecker, SurfaceSample
+from tpumon.chaos.schedule import SERVE_PROFILES, SIM_OPS, FaultSchedule
+
+log = logging.getLogger(__name__)
+
+#: Every Nth sample tick also queries the ledger surfaces (goodput
+#: view, a range query, and a deliberately malformed query) — they cost
+#: a JSON encode of the whole store, so not every 300 ms.
+LEDGER_SAMPLE_EVERY = 3
+
+#: Post-schedule settle before the final sample round: recovery-shaped
+#: state (heals, restarts) gets at least this long to land.
+SETTLE_S = 1.5
+
+EM_PATH = (
+    "/apis/external.metrics.k8s.io/v1beta1/namespaces/default/"
+    "tpumon_serve_queue_depth"
+)
+
+
+class ChaosRunError(RuntimeError):
+    """The experiment itself failed (warmup, sim death) — distinct from
+    an invariant violation, which is a RESULT."""
+
+
+#: Ports handed out this process-life. Concurrent trials (chaos-search
+#: --chaos-jobs > 1) each probe for free ports BEFORE binding their
+#: shards; without the claim set two trials can race to the same port
+#: and one dies on EADDRINUSE at fleet.start().
+_CLAIMED_PORTS: set[int] = set()
+_CLAIMED_LOCK = threading.Lock()
+
+
+def _free_port() -> int:
+    for _ in range(64):
+        with socket.socket() as sock:
+            sock.bind(("127.0.0.1", 0))
+            port = sock.getsockname()[1]
+        with _CLAIMED_LOCK:
+            if port not in _CLAIMED_PORTS:
+                _CLAIMED_PORTS.add(port)
+                return port
+    raise ChaosRunError("could not claim a free port in 64 probes")
+
+
+def _spawn_fleetsim(nodes: int, node_interval: float):
+    """A fleetsim subprocess (own GIL — simulation work never shares
+    the shards' interpreter). Returns (proc, urls)."""
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "tpumon.tools.fleetsim",
+            "--nodes", str(nodes), "--node-interval", str(node_interval),
+        ],
+        stdin=subprocess.PIPE,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    line = proc.stdout.readline()  # deadline: fleetsim prints PORTS immediately on startup or dies (the driver's outer timeout bounds the run)
+    if not line.startswith("PORTS "):
+        proc.kill()
+        raise ChaosRunError(f"fleetsim failed to start: {line!r}")
+    ports = [int(p) for p in line.split()[1:]]
+    return proc, [f"http://127.0.0.1:{port}" for port in ports]
+
+
+class _Fleet:
+    """The live experiment: sim subprocess + two shards + HTTP plumbing."""
+
+    def __init__(
+        self, schedule: FaultSchedule, interval: float,
+        node_interval: float,
+    ) -> None:
+        from tpumon.fleet.config import FleetConfig
+
+        self.schedule = schedule
+        self.interval = interval
+        self.node_interval = node_interval
+        self.ports = [_free_port(), _free_port()]
+        self.peers = ",".join(f"http://127.0.0.1:{p}" for p in self.ports)
+        self.spools = [
+            tempfile.mkdtemp(prefix=f"tpumon-chaos-spool-{i}-")
+            for i in range(2)
+        ]
+        self.ledger_spools = [
+            tempfile.mkdtemp(prefix=f"tpumon-chaos-ledger-{i}-")
+            for i in range(2)
+        ]
+        self.takeover_s = max(2.0, 4 * interval)
+        #: How long ownership may churn (takeover then hand-back) after
+        #: a shard-lifecycle fault: detection deadline + a few
+        #: membership/collect cycles for the epoch rebase to publish.
+        self.epoch_settle_s = self.takeover_s + 4 * interval + 2.0
+        self.sim_proc = None
+        self.urls: list[str] = []
+        self.shards: list = [None, None]
+        self.conns: dict[int, http.client.HTTPConnection] = {}
+        self.sim_log: list[str] = []
+        #: Engine-side mirror of fleetsim's node state: predicts the
+        #: per-command ack line count (one line per victim).
+        self.live = schedule.nodes
+        self.dead = 0
+        self._cfg_cls = FleetConfig
+
+    def shard_cfg(self, index: int):
+        return self._cfg_cls(
+            port=self.ports[index], addr="127.0.0.1",
+            targets=",".join(self.urls),
+            shard_index=index, shard_count=2,
+            interval=self.interval,
+            stale_s=max(2.0, 3.0 * self.interval),
+            evict_s=max(self.schedule.duration_s * 4, 120.0),
+            peers=self.peers,
+            probe_interval=max(0.25, self.takeover_s / 4.0),
+            takeover_s=self.takeover_s,
+            spool_dir=self.spools[index],
+            spool_every_s=self.interval,
+            ledger_spool_dir=self.ledger_spools[index],
+            ledger_spool_every_s=self.interval,
+            poll_backoff_max_s=2.0,
+            # Hint-band decay is designed behavior that the do-no-harm
+            # style checks would misread mid-run (same stance as the
+            # actuate-chaos soak).
+            hint_decay_s=max(self.schedule.duration_s * 4, 300.0),
+            history_window=0.0,
+        )
+
+    def start(self) -> None:
+        from tpumon.fleet.server import build_aggregator
+
+        self.sim_proc, self.urls = _spawn_fleetsim(
+            self.schedule.nodes, self.node_interval
+        )
+        self.sim_cmd("serve " + SERVE_PROFILES["calm"], 1)
+        for i in range(2):
+            self.shards[i] = build_aggregator(self.shard_cfg(i))
+            self.shards[i].start()
+        self._build_aggregator = build_aggregator
+
+    def warmup(self) -> None:
+        deadline = time.time() + max(30.0, 2.0 * self.schedule.nodes)
+        while time.time() < deadline:
+            docs = [self.get_json(i, "/fleet")[1] for i in range(2)]
+            if all(
+                d is not None
+                and d.get("fleet", {}).get("hosts", {}).get("up", 0)
+                >= len(self.shards[i].targets)
+                for i, d in enumerate(docs)
+            ):
+                return
+            time.sleep(0.25)
+        raise ChaosRunError(
+            "chaos fleet warmup timed out: shards never saw their full "
+            "target set"
+        )
+
+    # -- fault application -------------------------------------------------
+
+    def sim_cmd(self, command: str, expect_lines: int) -> None:
+        self.sim_proc.stdin.write(command + "\n")
+        self.sim_proc.stdin.flush()
+        for _ in range(expect_lines):
+            line = self.sim_proc.stdout.readline()  # deadline: fleetsim acks every command immediately or died (the driver's outer timeout bounds the run)
+            if not line:
+                self.sim_log.append(f"{command}: sim died mid-ack")
+                return
+            self.sim_log.append(line.strip())
+
+    def _sim_step(self, op: str, args: dict) -> None:
+        n = int(args.get("n", 0))
+        if op == "kill":
+            victims = min(n, self.live)
+            self.sim_cmd(f"kill {n}", victims)
+            self.live -= victims
+            self.dead += victims
+        elif op == "revive":
+            revived = min(n, self.dead)
+            self.sim_cmd(f"revive {n}", max(1, revived))
+            self.dead -= revived
+            self.live += revived
+        elif op in ("partition", "corrupt", "flap"):
+            self.sim_cmd(f"{op} {n}", min(n, self.live))
+        elif op == "slow":
+            self.sim_cmd(
+                f"slow {n} {args['ms']:g}", min(n, self.live)
+            )
+        elif op == "creep":
+            self.sim_cmd(
+                f"creep {n} {args['ms']:g} {args.get('ramp_s', 10.0):g}",
+                min(n, self.live),
+            )
+        elif op == "skew":
+            self.sim_cmd(f"skew {n} {args['s']:g}", min(n, self.live))
+        elif op == "churn":
+            self.sim_cmd(f"churn {args['f']:g}", 1)
+        elif op == "serve":
+            self.sim_cmd(
+                "serve " + SERVE_PROFILES[args.get("profile", "calm")], 1
+            )
+        elif op == "faults":
+            self.sim_cmd(f"faults {args['spec']}", 1)
+        elif op == "heal":
+            self.sim_cmd("heal", 1)
+        else:
+            raise ChaosRunError(f"unknown sim op {op!r}")
+
+    def apply(
+        self, op: str, args: dict, checker: InvariantChecker,
+        t: float = 0.0,
+    ) -> None:
+        if op in SIM_OPS:
+            self._sim_step(op, args)
+            return
+        if op == "shard_kill":
+            if self.shards[1] is not None:
+                self.shards[1].close()
+                self.shards[1] = None
+                self.conns.pop(1, None)
+                checker.reset_shard(1)
+                checker.note_ownership_disruption(t, self.epoch_settle_s)
+            return
+        if op == "shard_restart":
+            if self.shards[1] is None:
+                self.shards[1] = self._build_aggregator(self.shard_cfg(1))
+                self.shards[1].start()
+                self.conns.pop(1, None)
+                # The hand-back that follows legitimately LOWERS the
+                # survivor's per-scope epoch maxima (adopted members
+                # leave its claim) — give the checker the churn window.
+                checker.note_ownership_disruption(t, self.epoch_settle_s)
+            return
+        if op in ("spool_enospc", "spool_eio"):
+            code = (
+                errno_mod.ENOSPC if op == "spool_enospc" else errno_mod.EIO
+            )
+            shard = self.shards[int(args.get("shard", 0)) % 2]
+            if shard is not None:
+                if shard.spool is not None:
+                    shard.spool.inject_errno = code
+                if shard.ledger is not None and shard.ledger.spool is not None:
+                    shard.ledger.spool.inject_errno = code
+            return
+        if op == "spool_heal":
+            for shard in self.shards:
+                if shard is None:
+                    continue
+                if shard.spool is not None:
+                    shard.spool.inject_errno = None
+                if shard.ledger is not None and shard.ledger.spool is not None:
+                    shard.ledger.spool.inject_errno = None
+            return
+        if op == "query_burst":
+            # Sampling already queries every surface; the burst exists
+            # to hammer the ledger with a spread of valid and malformed
+            # queries back to back (the 200-or-400-never-5xx predicate
+            # gets its evidence from the recorded statuses).
+            return
+        raise ChaosRunError(f"unknown op {op!r}")
+
+    # -- surface access ----------------------------------------------------
+
+    def get(self, index: int, path: str) -> tuple[int | None, bytes | None]:
+        if self.shards[index] is None:
+            return None, None
+        conn = self.conns.get(index)
+        if conn is None:
+            conn = self.conns[index] = http.client.HTTPConnection(
+                "127.0.0.1", self.ports[index], timeout=10
+            )
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        except (OSError, http.client.HTTPException):
+            conn.close()
+            self.conns.pop(index, None)
+            return None, None
+
+    def get_json(self, index: int, path: str) -> tuple[int | None, dict | None]:
+        status, body = self.get(index, path)
+        if body is None:
+            return status, None
+        try:
+            return status, json.loads(body)
+        except ValueError:
+            return status, None
+
+    def em_items(self, index: int, selector: str = "") -> list | None:
+        path = EM_PATH
+        if selector:
+            path += "?labelSelector=" + urllib.parse.quote(selector)
+        _status, doc = self.get_json(index, path)
+        if doc is None:
+            return None
+        items = doc.get("items")
+        return items if isinstance(items, list) else []
+
+    def ledger_queries(
+        self, index: int, t0: float, burst: int = 0
+    ) -> tuple[list, dict | None]:
+        """(recorded (desc, status) pairs, goodput doc) for one shard:
+        the standing valid queries, the standing malformed one, plus
+        ``burst`` extra alternating valid/hostile queries."""
+        queries = [
+            (
+                "goodput view",
+                "/ledger?view=goodput",
+            ),
+            (
+                "range query",
+                "/ledger?family=tpu_fleet_duty_cycle_percent&scope=fleet"
+                f"&start={t0:.3f}&end={time.time():.3f}",
+            ),
+            (
+                "malformed range (400 expected)",
+                "/ledger?family=tpu_fleet_duty_cycle_percent&start=never",
+            ),
+        ]
+        for k in range(burst):
+            if k % 2 == 0:
+                queries.append((
+                    f"burst valid {k}",
+                    "/ledger?family=tpu_fleet_chips&scope=fleet"
+                    f"&start={t0:.3f}&end={time.time():.3f}",
+                ))
+            else:
+                queries.append((
+                    f"burst malformed {k} (400 expected)",
+                    f"/ledger?view=bogus-{k}",
+                ))
+        recorded: list = []
+        goodput_doc = None
+        for desc, path in queries:
+            status, body = self.get(index, path)
+            if status is None:
+                continue  # dead shard: absence, not an answer
+            recorded.append((desc, status))
+            if desc == "goodput view" and status == 200 and body:
+                try:
+                    goodput_doc = json.loads(body)
+                except ValueError:
+                    goodput_doc = None
+        return recorded, goodput_doc
+
+    def close(self) -> None:
+        for conn in self.conns.values():
+            conn.close()
+        self.conns.clear()
+        for i, shard in enumerate(self.shards):
+            if shard is not None:
+                try:
+                    shard.close()
+                except Exception:
+                    log.exception("chaos shard %d close failed", i)
+                self.shards[i] = None
+        if self.sim_proc is not None:
+            try:
+                self.sim_proc.terminate()
+                self.sim_proc.wait(timeout=5)
+            except (OSError, subprocess.TimeoutExpired):
+                self.sim_proc.kill()
+        for d in self.spools + self.ledger_spools:
+            shutil.rmtree(d, ignore_errors=True)
+
+
+def run_schedule(
+    schedule: FaultSchedule,
+    interval: float = 0.5,
+    node_interval: float | None = None,
+    sample_every: float = 0.35,
+    checker: InvariantChecker | None = None,
+) -> dict:
+    """One experiment: the schedule against a live fleet, every surface
+    through the checker. Returns the run record (violations included);
+    raises :class:`ChaosRunError` only when the experiment itself could
+    not run."""
+    checker = checker if checker is not None else InvariantChecker()
+    fleet = _Fleet(
+        schedule, interval,
+        node_interval if node_interval is not None else interval,
+    )
+    applied: list[dict] = []
+    pending_burst = 0
+    sample_no = 0
+    try:
+        fleet.start()
+        fleet.warmup()
+        t0 = time.time()
+        step_iter = iter(sorted(schedule.steps, key=lambda s: s.at))
+        next_step = next(step_iter, None)
+        deadline = t0 + schedule.duration_s
+        next_sample = t0
+        while True:
+            now = time.time()
+            if now >= deadline and next_step is None:
+                break
+            t = now - t0
+            while next_step is not None and t >= next_step.at:
+                fleet.apply(next_step.op, next_step.args, checker, t)
+                if next_step.op == "query_burst":
+                    pending_burst = int(next_step.args.get("n", 10))
+                applied.append(
+                    {"t_s": round(t, 2), **next_step.to_doc()}
+                )
+                next_step = next(step_iter, None)
+            if now >= deadline:
+                break
+            sample_no += 1
+            _sample_round(
+                fleet, checker, t, t0, sample_no, pending_burst
+            )
+            pending_burst = 0
+            next_sample += sample_every
+            time.sleep(max(0.0, next_sample - time.time()))
+        # Settle, then one final full round including the ledger.
+        time.sleep(SETTLE_S)
+        _sample_round(
+            fleet, checker, time.time() - t0, t0,
+            LEDGER_SAMPLE_EVERY, 0,
+        )
+    finally:
+        fleet.close()
+    summary = checker.summary()
+    return {
+        "schedule": schedule.to_doc(),
+        "interval_s": interval,
+        "applied": applied,
+        "checker": summary,
+        "violations": [v.to_doc() for v in checker.violations],
+        "sim_log_tail": fleet.sim_log[-20:],
+        "failed": bool(checker.violations),
+    }
+
+
+def _sample_round(
+    fleet: _Fleet,
+    checker: InvariantChecker,
+    t: float,
+    t0: float,
+    sample_no: int,
+    burst: int,
+) -> None:
+    for i in range(2):
+        if fleet.shards[i] is None:
+            continue
+        _status, metrics = fleet.get(i, "/metrics")
+        _status, fleet_doc = fleet.get_json(i, "/fleet")
+        _status, hints = fleet.get_json(i, "/hints")
+        em = fleet.em_items(i)
+        ledger_q: list = []
+        goodput = None
+        if sample_no % LEDGER_SAMPLE_EVERY == 0 or burst:
+            ledger_q, goodput = fleet.ledger_queries(i, t0, burst)
+        checker.observe(
+            SurfaceSample(
+                t=t, shard=i, metrics=metrics, fleet=fleet_doc,
+                hints=hints, em_items=em, goodput=goodput,
+                ledger_queries=ledger_q,
+            )
+        )
+
+
+__all__ = ["ChaosRunError", "run_schedule"]

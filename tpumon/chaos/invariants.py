@@ -1,0 +1,381 @@
+"""First-class honesty invariants: the checks the soaks kept re-typing.
+
+Every predicate here was previously an inline assertion in one soak
+driver (``fleet_chaos_soak``, ``ledger_soak``, ``actuate_chaos_soak``)
+— meaning every OTHER drill silently skipped it. This module lifts
+them into one :class:`InvariantChecker` evaluated continuously against
+every surface sample during any chaos run, and names them in a
+machine-readable :data:`INVARIANT_CATALOG` (mirrored in
+docs/INVARIANTS.md) so CI, docs, and reproducer JSON all speak the
+same vocabulary.
+
+Design stance on flakiness: a chaos search runs hundreds of schedules
+and the acceptance bar is ZERO violations on a healthy tree, so every
+predicate is either **same-snapshot** (evaluated inside one atomic
+page/doc — race-free by construction) or **debounced** (cross-surface
+comparisons only convict when the disagreement is STABLE across
+consecutive samples — a value changing between two fetches 50 ms apart
+is a race, the same two different values three samples in a row is a
+lie).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+#: invariant name -> what it asserts (the machine-readable catalog;
+#: docs/INVARIANTS.md documents the same names, tests pin the match).
+INVARIANT_CATALOG = {
+    "missing_host_unflagged": (
+        "A shard seeing fewer fresh hosts than targets must say so: "
+        "up < targets on one /metrics page requires the stale-rollup "
+        "flag set or visibility < 1.0 — degradation is never silent."
+    ),
+    "per_node_series_leak": (
+        "Per-node exporter series (accelerator_*, tpu_serve_*) must "
+        "not re-export through the aggregator page — the tier exposes "
+        "rollups, not N nodes' cardinality."
+    ),
+    "goodput_conservation": (
+        "Per job in /ledger?view=goodput, the accounting buckets sum "
+        "exactly to the reported chip-seconds — classification moves "
+        "time between buckets, never creates or destroys it."
+    ),
+    "ledger_query_5xx": (
+        "Ledger queries answer 200 (data) or 400 (malformed request), "
+        "never 5xx — hostile or unlucky queries cost an error body, "
+        "not the plane."
+    ),
+    "em_absent_below_trust_floor": (
+        "A scope the trust gate withholds (per /hints, two consecutive "
+        "snapshots) must be ABSENT from the External Metrics answer — "
+        "degraded telemetry looks partial, never complete-but-stale."
+    ),
+    "epoch_monotonic": (
+        "A scope's ownership epoch on /hints never decreases within "
+        "one shard process life, outside an ownership-churn settling "
+        "window after a shard kill/restart (a hand-back legitimately "
+        "lowers the survivor's member-max) — claims are re-minted "
+        "strictly newer, so the split-brain double-answer window "
+        "resolves newest-epoch-wins."
+    ),
+    "visibility_consistency": (
+        "The fleet-scope visibility ratio agrees between /fleet and "
+        "/metrics: a STABLE disagreement across consecutive samples "
+        "means one surface renormalized what the other flags."
+    ),
+}
+
+#: Per-node family prefixes that must never appear on an aggregator
+#: page (the series-leak scan, lifted from fleet_soak/serve_burst).
+_LEAK_PATTERNS = (
+    re.compile(rb"^accelerator_duty_cycle_percent", re.M),
+    re.compile(rb"^tpu_serve_", re.M),
+)
+
+#: Consecutive stable samples a cross-surface disagreement must survive
+#: before it convicts (the race-vs-lie debounce).
+VISIBILITY_DEBOUNCE = 3
+
+#: Exact-identity tolerance for goodput bucket conservation (float
+#: accumulation across buckets; the ledger's own soak pins ~1e-9).
+GOODPUT_TOLERANCE = 1e-6
+
+
+def page_stats(body: bytes) -> dict:
+    """Fleet-scope honesty numbers off one aggregator /metrics page
+    (the ``_page_stats`` idiom from tools/soak.py, re-homed where every
+    driver can reach it)."""
+    def g(name: str, labels: bytes) -> float | None:
+        m = re.search(
+            rb"^" + name.encode() + rb"\{" + labels + rb"\} (\S+)",
+            body, re.M,
+        )
+        return float(m.group(1)) if m else None
+
+    fleet = rb'pool="",scope="fleet",slice=""'
+    out = {
+        "up": g("tpu_fleet_hosts", fleet + rb',state="up"'),
+        "stale": g("tpu_fleet_hosts", fleet + rb',state="stale"'),
+        "dark": g("tpu_fleet_hosts", fleet + rb',state="dark"'),
+        "visibility": g("tpu_fleet_visibility_ratio", fleet),
+        "stale_flag": g("tpu_fleet_stale_rollup", fleet),
+    }
+    m = re.search(rb"^tpu_fleet_shard_targets (\S+)", body, re.M)
+    out["targets"] = float(m.group(1)) if m else None
+    return out
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One invariant breach at one sampling instant."""
+
+    invariant: str
+    t: float
+    shard: int
+    detail: str
+
+    def to_doc(self) -> dict:
+        return {
+            "invariant": self.invariant,
+            "t_s": round(self.t, 2),
+            "shard": self.shard,
+            "detail": self.detail,
+        }
+
+
+@dataclass
+class SurfaceSample:
+    """Everything the engine scraped from ONE shard at one instant.
+    ``None`` fields mean the surface was unreachable (a dead shard is
+    absence, not evidence) or not sampled this tick."""
+
+    t: float
+    shard: int
+    metrics: bytes | None = None
+    fleet: dict | None = None
+    hints: dict | None = None
+    #: External Metrics item list; None = adapter unreachable.
+    em_items: list | None = None
+    #: /ledger?view=goodput document, when sampled this tick.
+    goodput: dict | None = None
+    #: (query description, HTTP status) for every ledger query fired
+    #: this tick; status None = transport failure, not an answer.
+    ledger_queries: list = field(default_factory=list)
+
+
+class InvariantChecker:
+    """Evaluates the catalog against a stream of surface samples.
+
+    Single-threaded by contract: the engine's sampling loop feeds it in
+    order. Cross-sample state (epoch high-water marks, withheld-scope
+    history, visibility debounce) is keyed by shard; a shard RESTART
+    must be announced via :meth:`reset_shard` — a fresh process mints
+    fresh epochs and the withheld history of the old life is void.
+    """
+
+    def __init__(self) -> None:
+        self.violations: list[Violation] = []
+        self.samples_checked = 0
+        #: Per-invariant evaluation counts: proof each predicate ran
+        #: (a checker that silently never fired is worse than none).
+        self.evaluated: dict[str, int] = {k: 0 for k in INVARIANT_CATALOG}
+        #: (shard, pool, slice) -> highest ownership epoch observed.
+        self._epoch_high: dict[tuple, int] = {}
+        #: Rel-time horizon of the ownership-churn settling window: a
+        #: scope epoch on /hints is the max over the shard's OWNED
+        #: member targets, so a hand-back (shard restart reclaiming its
+        #: half) legitimately LOWERS the survivor's published max. Epoch
+        #: decreases inside the window rebase; outside it they convict.
+        self._epoch_settle_until = float("-inf")
+        #: shard -> scopes withheld in the previous /hints snapshot.
+        self._prev_withheld: dict[int, set] = {}
+        #: shard -> run of consecutive identical (page, fleet)
+        #: visibility pairs that disagree with each other.
+        self._vis_run: dict[int, tuple[tuple, int]] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def reset_shard(self, shard: int) -> None:
+        """Forget cross-sample state for a killed/restarted shard."""
+        self._epoch_high = {
+            k: v for k, v in self._epoch_high.items() if k[0] != shard
+        }
+        self._prev_withheld.pop(shard, None)
+        self._vis_run.pop(shard, None)
+
+    def note_ownership_disruption(self, t: float, settle_s: float) -> None:
+        """A shard-lifecycle fault (kill/restart) at rel-time ``t``:
+        target ownership will churn — takeover, then hand-back — for up
+        to ``settle_s`` seconds, during which EVERY shard's per-scope
+        epoch maxima may legitimately rebase downward as adopted
+        members leave. Monotonicity stays enforced outside the window."""
+        self._epoch_settle_until = max(
+            self._epoch_settle_until, t + settle_s
+        )
+
+    # -- the checks --------------------------------------------------------
+
+    def observe(self, sample: SurfaceSample) -> list[Violation]:
+        """Run every applicable predicate; returns (and records) the
+        violations this sample produced."""
+        found: list[Violation] = []
+        self.samples_checked += 1
+        if sample.metrics is not None:
+            self._check_page(sample, found)
+        if sample.metrics is not None and sample.fleet is not None:
+            self._check_visibility_consistency(sample, found)
+        if sample.hints is not None:
+            self._check_epochs(sample, found)
+            self._check_em_vs_withheld(sample, found)
+        if sample.goodput is not None:
+            self._check_goodput(sample, found)
+        if sample.ledger_queries:
+            self._check_ledger_statuses(sample, found)
+        self.violations.extend(found)
+        return found
+
+    def _emit(
+        self, found: list, name: str, sample: SurfaceSample, detail: str
+    ) -> None:
+        found.append(
+            Violation(
+                invariant=name, t=sample.t, shard=sample.shard,
+                detail=detail,
+            )
+        )
+
+    def _check_page(self, sample: SurfaceSample, found: list) -> None:
+        stats = page_stats(sample.metrics)
+        self.evaluated["missing_host_unflagged"] += 1
+        if (
+            stats["up"] is not None
+            and stats["targets"] is not None
+            and stats["up"] < stats["targets"]
+            and stats["stale_flag"] == 0.0
+            and (stats["visibility"] is None or stats["visibility"] >= 1.0)
+        ):
+            self._emit(
+                found, "missing_host_unflagged", sample,
+                f"up={stats['up']:g} < targets={stats['targets']:g} with "
+                f"stale_flag=0 and visibility="
+                f"{stats['visibility'] if stats['visibility'] is not None else 'absent'}",
+            )
+        self.evaluated["per_node_series_leak"] += 1
+        for pat in _LEAK_PATTERNS:
+            m = pat.search(sample.metrics)
+            if m:
+                self._emit(
+                    found, "per_node_series_leak", sample,
+                    f"per-node series {m.group(0).decode()!r} on the "
+                    "aggregator page",
+                )
+                break
+        self._last_page_stats = stats
+
+    def _check_visibility_consistency(
+        self, sample: SurfaceSample, found: list
+    ) -> None:
+        self.evaluated["visibility_consistency"] += 1
+        page_vis = page_stats(sample.metrics)["visibility"]
+        fleet_vis = (sample.fleet.get("fleet") or {}).get("visibility")
+        if page_vis is None or not isinstance(fleet_vis, (int, float)):
+            self._vis_run.pop(sample.shard, None)
+            return
+        pair = (round(page_vis, 6), round(float(fleet_vis), 6))
+        if pair[0] == pair[1]:
+            self._vis_run.pop(sample.shard, None)
+            return
+        last, run = self._vis_run.get(sample.shard, (None, 0))
+        run = run + 1 if pair == last else 1
+        self._vis_run[sample.shard] = (pair, run)
+        if run >= VISIBILITY_DEBOUNCE:
+            self._emit(
+                found, "visibility_consistency", sample,
+                f"/metrics visibility {pair[0]} vs /fleet {pair[1]}, "
+                f"stable for {run} consecutive samples",
+            )
+
+    def _hints_rows(self, sample: SurfaceSample) -> list:
+        rows = sample.hints.get("slices")
+        return rows if isinstance(rows, list) else []
+
+    def _check_epochs(self, sample: SurfaceSample, found: list) -> None:
+        self.evaluated["epoch_monotonic"] += 1
+        for row in self._hints_rows(sample):
+            epoch = row.get("epoch")
+            if not isinstance(epoch, (int, float)) or epoch <= 0:
+                continue
+            key = (sample.shard, row.get("pool"), row.get("slice"))
+            high = self._epoch_high.get(key, 0)
+            if epoch < high and sample.t > self._epoch_settle_until:
+                self._emit(
+                    found, "epoch_monotonic", sample,
+                    f"scope {key[1]}/{key[2]} epoch regressed "
+                    f"{high} -> {int(epoch)}",
+                )
+            else:
+                # Inside the settling window a decrease REBASES the
+                # high-water mark (hand-back shrank the member set);
+                # monotonicity re-arms from the rebased value.
+                self._epoch_high[key] = int(epoch)
+
+    def _check_em_vs_withheld(
+        self, sample: SurfaceSample, found: list
+    ) -> None:
+        self.evaluated["em_absent_below_trust_floor"] += 1
+        withheld_now = {
+            (row.get("pool"), row.get("slice"))
+            for row in self._hints_rows(sample)
+            if row.get("withheld")
+        }
+        if sample.em_items is not None:
+            prev = self._prev_withheld.get(sample.shard, set())
+            for item in sample.em_items:
+                labels = item.get("metricLabels") or {}
+                scope = (labels.get("pool"), labels.get("slice"))
+                # Withheld across two consecutive /hints snapshots and
+                # still served as an item: the trust gate leaked a
+                # value it was withholding (one-snapshot overlap is the
+                # fetch race between the two surfaces).
+                if scope in withheld_now and scope in prev:
+                    self._emit(
+                        found, "em_absent_below_trust_floor", sample,
+                        f"scope {scope[0]}/{scope[1]} served by the EM "
+                        "adapter while withheld on /hints",
+                    )
+        self._prev_withheld[sample.shard] = withheld_now
+
+    def _check_goodput(self, sample: SurfaceSample, found: list) -> None:
+        self.evaluated["goodput_conservation"] += 1
+        for job in sample.goodput.get("jobs") or []:
+            buckets = job.get("buckets")
+            total = job.get("chip_seconds")
+            if not isinstance(buckets, dict) or not isinstance(
+                total, (int, float)
+            ):
+                continue
+            drift = abs(sum(buckets.values()) - total)
+            if drift > GOODPUT_TOLERANCE:
+                self._emit(
+                    found, "goodput_conservation", sample,
+                    f"job {job.get('job')!r} buckets sum to "
+                    f"{sum(buckets.values()):.6f} but chip_seconds="
+                    f"{total:.6f} (drift {drift:.2e})",
+                )
+
+    def _check_ledger_statuses(
+        self, sample: SurfaceSample, found: list
+    ) -> None:
+        self.evaluated["ledger_query_5xx"] += 1
+        for desc, status in sample.ledger_queries:
+            if status is not None and int(status) >= 500:
+                self._emit(
+                    found, "ledger_query_5xx", sample,
+                    f"{desc} answered {status}",
+                )
+
+    # -- reporting ---------------------------------------------------------
+
+    def summary(self) -> dict:
+        by_invariant: dict[str, int] = {}
+        for v in self.violations:
+            by_invariant[v.invariant] = by_invariant.get(v.invariant, 0) + 1
+        return {
+            "samples_checked": self.samples_checked,
+            "evaluated": dict(self.evaluated),
+            "violations": len(self.violations),
+            "by_invariant": by_invariant,
+        }
+
+
+__all__ = [
+    "GOODPUT_TOLERANCE",
+    "INVARIANT_CATALOG",
+    "InvariantChecker",
+    "SurfaceSample",
+    "VISIBILITY_DEBOUNCE",
+    "Violation",
+    "page_stats",
+]

@@ -1,0 +1,96 @@
+"""Failing-schedule minimization: delta debugging over fault steps.
+
+A random schedule that breaks an invariant usually breaks it with most
+of its steps irrelevant — the reproducer worth committing is the 1-2
+step core. :func:`minimize` is classic ddmin (Zeller) over the step
+list: try removing chunks at increasing granularity, keep any removal
+that still fails, stop when no single step can be removed. Each probe
+re-runs the candidate subset against a FRESH fleet (the test function
+is an experiment, not a lookup), so the probe budget is explicit and
+capped — minimization must never cost more than the search that found
+the failure.
+
+The result keeps the parent schedule's seed and per-step provenance
+(:attr:`FaultSchedule.parent_steps`), so a minimized reproducer names
+exactly which generated steps survived and replays deterministically:
+same seed, same steps, same fleet shape.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+from tpumon.chaos.schedule import FaultSchedule
+
+log = logging.getLogger(__name__)
+
+
+def minimize(
+    schedule: FaultSchedule,
+    still_fails: Callable[[FaultSchedule], bool],
+    max_probes: int = 24,
+) -> tuple[FaultSchedule, dict]:
+    """Shrink ``schedule`` to a 1-minimal failing subset of its steps.
+
+    ``still_fails(candidate)`` re-runs the candidate and returns True
+    when the failure reproduces. Returns ``(minimized, stats)``;
+    ``minimized`` is the original schedule when nothing could be
+    removed (or the probe budget ran out before anything reproduced).
+    The result is 1-minimal when ``stats["minimal"]`` is True: removing
+    any single remaining step no longer fails.
+    """
+    indices = list(range(len(schedule.steps)))
+    probes = 0
+    reduced = False
+
+    def probe(keep: list[int]) -> bool:
+        nonlocal probes
+        probes += 1
+        candidate = schedule.subset(keep)
+        failed = still_fails(candidate)
+        log.info(
+            "ddmin probe %d: %d/%d steps -> %s",
+            probes, len(keep), len(schedule.steps),
+            "fails (keep)" if failed else "passes (revert)",
+        )
+        return failed
+
+    granularity = 2
+    minimal = False
+    while len(indices) >= 2 and probes < max_probes:
+        chunk = max(1, len(indices) // granularity)
+        removed_any = False
+        start = 0
+        while start < len(indices) and probes < max_probes:
+            keep = indices[:start] + indices[start + chunk:]
+            if not keep:
+                start += chunk
+                continue
+            if probe(keep):
+                indices = keep
+                reduced = True
+                removed_any = True
+                granularity = max(2, granularity - 1)
+                # Restart the sweep over the shrunk list.
+                start = 0
+                chunk = max(1, len(indices) // granularity)
+            else:
+                start += chunk
+        if not removed_any:
+            if chunk == 1:
+                minimal = True
+                break
+            granularity = min(len(indices), granularity * 2)
+
+    stats = {
+        "probes": probes,
+        "original_steps": len(schedule.steps),
+        "minimized_steps": len(indices),
+        "minimal": minimal or len(indices) == 1,
+        "reduced": reduced,
+    }
+    return schedule.subset(indices), stats
+
+
+__all__ = ["minimize"]

@@ -1,0 +1,305 @@
+"""The fault-schedule grammar: every fault the repo knows, as data.
+
+A :class:`FaultSchedule` is a seed plus a sorted list of
+:class:`FaultStep` (at-time, op, args) covering the whole fault
+vocabulary the hand-written drills exercise piecemeal:
+
+- **transport** (fleetsim): ``kill`` / ``revive`` / ``partition`` /
+  ``slow`` / ``creep`` / ``corrupt`` / ``flap`` / ``heal``;
+- **content** (FaultSpec via fleetsim ``faults``): error / latency /
+  garbage / partial degradation of what every node republishes;
+- **clock** (fleetsim ``skew``): wall-clock skew and step, future and
+  past — the data timestamp lies, the transport doesn't;
+- **load** (fleetsim ``serve``): the serving-burst dial the actuation
+  tier reacts to (the one LEGITIMATE cause of hint movement);
+- **shard** (engine): aggregator shard kill and warm restart from its
+  spool — the split-brain / ownership-epoch axis;
+- **spool** (engine): ENOSPC and EIO injected into the warm-restart
+  journals — a full or dying emptyDir mid-run;
+- **client** (engine): query bursts against /ledger and the External
+  Metrics adapter, valid and deliberately malformed.
+
+Schedules are plain data: :meth:`FaultSchedule.generate` derives one
+deterministically from a seed (``random.Random(seed)`` — same seed,
+same schedule, forever), :meth:`to_doc`/:meth:`from_doc` round-trip
+through JSON so a failing schedule is a replayable artifact, and
+:meth:`subset` supports the minimizer's delta-debugging over steps.
+
+Generation is STATEFUL so random schedules stay meaningful: ``revive``
+is only emitted when nodes are dead, ``shard_restart`` only when the
+shard is down, kills are capped below the whole fleet, and step times
+land inside the observable window (after warmup, before the final
+settle) — the grammar encodes the same legality rules a human drill
+author applies by hand.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import dataclass, field
+
+#: Ops applied by rendering a fleetsim stdin command.
+SIM_OPS = frozenset({
+    "kill", "revive", "partition", "heal", "slow", "creep", "skew",
+    "corrupt", "flap", "churn", "serve", "faults",
+})
+#: Ops applied by the engine against its aggregator shards.
+SHARD_OPS = frozenset({"shard_kill", "shard_restart"})
+#: Ops applied to a shard's warm-restart spools.
+SPOOL_OPS = frozenset({"spool_enospc", "spool_eio", "spool_heal"})
+#: Client-side ops (the engine is the client).
+CLIENT_OPS = frozenset({"query_burst"})
+
+ALL_OPS = SIM_OPS | SHARD_OPS | SPOOL_OPS | CLIENT_OPS
+
+#: Serving-profile presets (the fleetsim ``serve`` arguments): the calm
+#: baseline and the burst the actuation drills use.
+SERVE_PROFILES = {
+    "calm": "8 1 120 1.0",
+    "burst": "80 16 900 0.55",
+    "off": "off",
+}
+
+#: FaultSpec presets for the ``faults`` op. Bounded on purpose: no
+#: ``hang_every`` (a hang stalls the sim's shared ticker — full-fleet
+#: staleness is already covered by ``partition`` of everything) and
+#: latency small enough that page fetches still complete inside the
+#: aggregator's deadline budget.
+FAULT_SPECS = (
+    "error_rate=0.4",
+    "garbage_rate=0.5",
+    "partial_rate=0.5",
+    "latency_ms=60",
+    "error_rate=0.2,garbage_rate=0.3",
+)
+
+#: Clock-skew magnitudes (seconds): inside the 1 h clamp, at its edge,
+#: and far beyond it — both signs are drawn at generation time.
+SKEW_STEPS_S = (120.0, 900.0, 3600.0, 7200.0, 86400.0)
+
+
+@dataclass(frozen=True)
+class FaultStep:
+    """One scheduled fault: apply ``op(**args)`` at ``at`` seconds."""
+
+    at: float
+    op: str
+    args: dict = field(default_factory=dict)
+
+    def to_doc(self) -> dict:
+        return {"at": round(self.at, 3), "op": self.op, "args": dict(self.args)}
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "FaultStep":
+        op = str(doc["op"])
+        if op not in ALL_OPS:
+            raise ValueError(f"unknown fault op {op!r}")
+        args = doc.get("args") or {}
+        if not isinstance(args, dict):
+            raise ValueError(f"step args must be an object, got {args!r}")
+        return cls(at=float(doc["at"]), op=op, args=dict(args))
+
+    def describe(self) -> str:
+        inner = ",".join(f"{k}={v}" for k, v in sorted(self.args.items()))
+        return f"t+{self.at:.1f}s {self.op}({inner})"
+
+
+@dataclass(frozen=True)
+class FaultSchedule:
+    """A seeded, replayable fault interleaving over one chaos fleet."""
+
+    seed: int
+    nodes: int
+    duration_s: float
+    steps: tuple[FaultStep, ...]
+    #: Set on minimized reproducers: which generated step indices
+    #: survived shrinking (provenance back to the parent schedule).
+    parent_steps: tuple[int, ...] | None = None
+
+    # -- round trip --------------------------------------------------------
+
+    def to_doc(self) -> dict:
+        doc = {
+            "version": 1,
+            "seed": self.seed,
+            "nodes": self.nodes,
+            "duration_s": round(self.duration_s, 3),
+            "steps": [s.to_doc() for s in self.steps],
+        }
+        if self.parent_steps is not None:
+            doc["parent_steps"] = list(self.parent_steps)
+        return doc
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "FaultSchedule":
+        if doc.get("version") != 1:
+            raise ValueError(f"unknown schedule version {doc.get('version')!r}")
+        parent = doc.get("parent_steps")
+        return cls(
+            seed=int(doc["seed"]),
+            nodes=int(doc["nodes"]),
+            duration_s=float(doc["duration_s"]),
+            steps=tuple(FaultStep.from_doc(s) for s in doc["steps"]),
+            parent_steps=tuple(int(i) for i in parent) if parent else None,
+        )
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_doc(), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultSchedule":
+        return cls.from_doc(json.loads(text))
+
+    # -- minimizer support -------------------------------------------------
+
+    def subset(self, indices: list[int] | tuple[int, ...]) -> "FaultSchedule":
+        """The schedule keeping only ``indices`` of :attr:`steps`
+        (sorted; duplicates dropped) — the minimizer's shrink move."""
+        keep = sorted(set(indices))
+        return FaultSchedule(
+            seed=self.seed,
+            nodes=self.nodes,
+            duration_s=self.duration_s,
+            steps=tuple(self.steps[i] for i in keep),
+            parent_steps=tuple(
+                (self.parent_steps[i] if self.parent_steps else i)
+                for i in keep
+            ),
+        )
+
+    def describe(self) -> str:
+        head = f"seed={self.seed} nodes={self.nodes} {self.duration_s:g}s"
+        return head + ": " + "; ".join(s.describe() for s in self.steps)
+
+    # -- generation --------------------------------------------------------
+
+    @classmethod
+    def generate(
+        cls,
+        seed: int,
+        nodes: int = 16,
+        duration_s: float = 20.0,
+        max_steps: int = 8,
+        min_steps: int = 3,
+    ) -> "FaultSchedule":
+        """A random legal schedule, deterministic in ``seed``."""
+        rng = random.Random(seed)
+        n_steps = rng.randint(min_steps, max(min_steps, max_steps))
+        # Step times inside the observable window: the engine samples
+        # from warmup to duration, and the tail 15% is the settle the
+        # recovery-shaped invariants need to see.
+        # Rounded to the serialization precision so a generated
+        # schedule and its JSON round trip are the SAME value.
+        times = sorted(
+            round(rng.uniform(0.05 * duration_s, 0.85 * duration_s), 3)
+            for _ in range(n_steps)
+        )
+        state = {
+            "dead": 0,          # fleetsim nodes currently killed
+            "shard1_down": False,
+            "spool_faulted": False,
+        }
+        steps = [
+            cls._random_step(rng, at, nodes, state) for at in times
+        ]
+        return cls(
+            seed=seed, nodes=nodes, duration_s=duration_s,
+            steps=tuple(steps),
+        )
+
+    @staticmethod
+    def _random_step(
+        rng: random.Random, at: float, nodes: int, state: dict
+    ) -> FaultStep:
+        """One legal random step given the generation state."""
+        ops = [
+            ("kill", 3), ("partition", 4), ("slow", 2), ("creep", 2),
+            ("skew", 3), ("corrupt", 2), ("flap", 2), ("faults", 2),
+            ("heal", 3), ("serve", 2), ("churn", 1), ("query_burst", 2),
+            ("spool_enospc", 2), ("spool_eio", 1),
+        ]
+        if state["dead"]:
+            ops.append(("revive", 4))
+        if state["shard1_down"]:
+            # A down shard strongly prefers coming back: the restart
+            # path (spool restore, epoch re-claim) is where the bugs
+            # live, not in staying down.
+            ops.append(("shard_restart", 8))
+        else:
+            ops.append(("shard_kill", 2))
+        if state["spool_faulted"]:
+            ops.append(("spool_heal", 4))
+        total = sum(w for _, w in ops)
+        pick = rng.uniform(0.0, total)
+        acc = 0.0
+        op = ops[-1][0]
+        for name, w in ops:
+            acc += w
+            if pick <= acc:
+                op = name
+                break
+
+        args: dict = {}
+        if op == "kill":
+            n = rng.randint(1, max(1, nodes // 3))
+            state["dead"] = min(nodes, state["dead"] + n)
+            args = {"n": n}
+        elif op == "revive":
+            n = rng.randint(1, max(1, state["dead"]))
+            state["dead"] = max(0, state["dead"] - n)
+            args = {"n": n}
+        elif op == "partition":
+            args = {"n": rng.randint(1, max(1, nodes // 2))}
+        elif op == "slow":
+            args = {
+                "n": rng.randint(1, max(1, nodes // 3)),
+                "ms": rng.choice((50, 150, 300)),
+            }
+        elif op == "creep":
+            args = {
+                "n": rng.randint(1, max(1, nodes // 3)),
+                "ms": rng.choice((150, 300, 500)),
+                "ramp_s": rng.choice((2.0, 5.0, 8.0)),
+            }
+        elif op == "skew":
+            args = {
+                "n": rng.randint(1, max(1, nodes // 3)),
+                "s": rng.choice(SKEW_STEPS_S) * rng.choice((-1.0, 1.0)),
+            }
+        elif op == "corrupt":
+            args = {"n": rng.randint(1, max(1, nodes // 4))}
+        elif op == "flap":
+            args = {"n": rng.randint(1, max(1, nodes // 4))}
+        elif op == "faults":
+            args = {"spec": rng.choice(FAULT_SPECS) + f",seed={rng.randint(1, 1 << 30)}"}
+        elif op == "serve":
+            args = {"profile": rng.choice(("calm", "burst", "off"))}
+        elif op == "churn":
+            args = {"f": rng.choice((0.1, 0.5, 1.0))}
+        elif op == "shard_kill":
+            state["shard1_down"] = True
+        elif op == "shard_restart":
+            state["shard1_down"] = False
+        elif op in ("spool_enospc", "spool_eio"):
+            state["spool_faulted"] = True
+            args = {"shard": rng.randint(0, 1)}
+        elif op == "spool_heal":
+            state["spool_faulted"] = False
+        elif op == "query_burst":
+            args = {"n": rng.choice((5, 10, 20))}
+        return FaultStep(at=at, op=op, args=args)
+
+
+__all__ = [
+    "ALL_OPS",
+    "CLIENT_OPS",
+    "FAULT_SPECS",
+    "FaultSchedule",
+    "FaultStep",
+    "SERVE_PROFILES",
+    "SHARD_OPS",
+    "SIM_OPS",
+    "SKEW_STEPS_S",
+    "SPOOL_OPS",
+]

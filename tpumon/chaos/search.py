@@ -1,0 +1,266 @@
+"""The chaos search driver: N seeded schedules, check, shrink, persist.
+
+This is the property-based loop the hand-written drills approximate one
+scenario at a time: *for all fault interleavings, the honesty
+invariants hold*. Each trial generates a random legal
+:class:`FaultSchedule` from its seed, runs it against a FRESH two-shard
+fleet (:func:`tpumon.chaos.engine.run_schedule`) under the
+:class:`InvariantChecker`, and on failure shrinks the schedule with
+:func:`tpumon.chaos.minimize.minimize` to a 1-minimal reproducer,
+persisted as replayable JSON (same seed + surviving steps = same run).
+
+The driver is the CI surface: ``python -m tpumon.tools.soak
+--chaos-search`` runs a bounded seeded search, and the mutation canary
+job sets ``TPUMON_CHAOS_MUTATE`` to plant a known honesty bug — the
+search MUST then fail, catch it under the right invariant name, and
+minimize it, or CI fails. The record carries the active mutation so
+evidence can't silently conflate canary runs with clean ones.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from tpumon.chaos.engine import ChaosRunError, run_schedule
+from tpumon.chaos.invariants import InvariantChecker
+from tpumon.chaos.minimize import minimize
+from tpumon.chaos.schedule import FaultSchedule
+
+log = logging.getLogger(__name__)
+
+#: Per-trial generation shape: enough steps that interleavings get
+#: interesting, few enough that ddmin stays cheap.
+MAX_STEPS = 8
+MIN_STEPS = 3
+
+
+def _progress(msg: str) -> None:
+    """Progress to stderr — stdout is the JSON record, nothing else."""
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _quiet_fleet_logs() -> None:
+    """The search spins up dozens of aggregators; their INFO startup
+    chatter would swamp the trial-per-line progress stream."""
+    for name in ("tpumon.fleet", "tpumon.ledger", "tpumon.actuate",
+                 "tpumon.history", "tpumon.guard"):
+        logging.getLogger(name).setLevel(logging.WARNING)
+
+
+def run_trial(
+    schedule: FaultSchedule,
+    interval: float = 0.5,
+    node_interval: float | None = None,
+) -> dict:
+    """One schedule against one fresh fleet; the engine record.
+
+    A bind-race loss (two concurrent trials probing the same port, or
+    an unrelated process grabbing it first) retries with fresh ports —
+    it says nothing about the schedule — then aborts as a
+    :class:`ChaosRunError`, never an unhandled crash of the search.
+    """
+    last: OSError | None = None
+    for _attempt in range(3):
+        checker = InvariantChecker()
+        try:
+            return run_schedule(
+                schedule, interval=interval, node_interval=node_interval,
+                checker=checker,
+            )
+        except OSError as exc:
+            last = exc
+            log.warning(
+                "trial seed=%d infra error (retrying): %s",
+                schedule.seed, exc,
+            )
+    raise ChaosRunError(
+        f"trial seed={schedule.seed} could not start a fleet: {last}"
+    )
+
+
+def shrink_failure(
+    schedule: FaultSchedule,
+    record: dict,
+    interval: float = 0.5,
+    node_interval: float | None = None,
+    max_probes: int = 24,
+) -> dict:
+    """Minimize a failing schedule and verify the reproducer replays.
+
+    Returns the failure document persisted as the replayable artifact:
+    the original schedule + violations, the minimized schedule + ddmin
+    stats, and whether the minimized schedule still fails when replayed
+    from scratch (``replay_failed`` — the determinism proof).
+    """
+
+    def still_fails(candidate: FaultSchedule) -> bool:
+        try:
+            probe = run_trial(
+                candidate, interval=interval, node_interval=node_interval
+            )
+        except ChaosRunError as exc:
+            # A fleet that can't even warm up under the candidate is
+            # a failure of the harness, not of the invariants — treat
+            # as non-reproducing so ddmin keeps the step that allows
+            # warmup.
+            log.warning("ddmin probe aborted: %s", exc)
+            return False
+        return bool(probe["failed"])
+
+    minimized, stats = minimize(schedule, still_fails, max_probes=max_probes)
+    replay = run_trial(
+        minimized, interval=interval, node_interval=node_interval
+    )
+    return {
+        "schedule": schedule.to_doc(),
+        "violations": record["violations"],
+        "checker": record["checker"],
+        "minimized": minimized.to_doc(),
+        "minimized_describe": minimized.describe(),
+        "ddmin": stats,
+        "replay_failed": bool(replay["failed"]),
+        "replay_violations": replay["violations"],
+    }
+
+
+def chaos_search(
+    schedules: int = 20,
+    seed0: int = 1,
+    nodes: int = 16,
+    duration_s: float = 20.0,
+    interval: float = 0.5,
+    node_interval: float | None = None,
+    jobs: int = 1,
+    out_dir: str | None = None,
+    max_probes: int = 24,
+    stop_after_failures: int = 3,
+) -> dict:
+    """Search seeds ``[seed0, seed0+schedules)``; shrink what fails.
+
+    Failing schedules (original + 1-minimal reproducer + replay proof)
+    are written to ``out_dir`` as ``failing-schedule-seed<seed>.json``
+    when given. The search stops early after ``stop_after_failures``
+    distinct failing seeds — minimization is the expensive part, and
+    one planted bug does not need twenty reproducers.
+    """
+    _quiet_fleet_logs()
+    t0 = time.monotonic()
+    mutation = os.environ.get("TPUMON_CHAOS_MUTATE") or None
+    seeds = list(range(seed0, seed0 + schedules))
+    results: dict[int, dict] = {}
+    aborted: dict[int, str] = {}
+
+    def trial(seed: int) -> None:
+        schedule = FaultSchedule.generate(
+            seed, nodes=nodes, duration_s=duration_s,
+            max_steps=MAX_STEPS, min_steps=MIN_STEPS,
+        )
+        try:
+            record = run_trial(
+                schedule, interval=interval, node_interval=node_interval
+            )
+        except ChaosRunError as exc:
+            # Harness abort (fleet never warmed up): recorded apart
+            # from invariant verdicts — an aborted trial proves
+            # nothing either way and must not count as "passed".
+            aborted[seed] = str(exc)
+            _progress(f"chaos-search seed={seed} ABORTED: {exc}")
+            return
+        results[seed] = record
+        verdict = "FAIL" if record["failed"] else "ok"
+        _progress(
+            f"chaos-search seed={seed} {verdict} "
+            f"steps={len(schedule.steps)} "
+            f"violations={len(record['violations'])} "
+            f"samples={record['checker']['samples_checked']}"
+        )
+
+    if jobs > 1:
+        # Each trial owns its fleetsim subprocess, its aggregator
+        # ports, and its tempdir spools — trials share nothing but the
+        # machine, so a small pool is safe and shortens wall clock.
+        with ThreadPoolExecutor(max_workers=jobs) as pool:
+            list(pool.map(trial, seeds))
+    else:
+        for seed in seeds:
+            trial(seed)
+
+    failing = sorted(s for s, r in results.items() if r["failed"])
+    failures = []
+    for seed in failing[:stop_after_failures]:
+        _progress(f"chaos-search minimizing seed={seed} ...")
+        doc = shrink_failure(
+            FaultSchedule.from_doc(results[seed]["schedule"]),
+            results[seed], interval=interval,
+            node_interval=node_interval, max_probes=max_probes,
+        )
+        doc["seed"] = seed
+        failures.append(doc)
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+            path = os.path.join(out_dir, f"failing-schedule-seed{seed}.json")
+            with open(path, "w") as fh:
+                json.dump(doc, fh, indent=2, sort_keys=True)
+            _progress(f"chaos-search wrote {path}")
+
+    by_invariant: dict[str, int] = {}
+    op_coverage: dict[str, int] = {}
+    for record in results.values():
+        for violation in record["violations"]:
+            name = violation["invariant"]
+            by_invariant[name] = by_invariant.get(name, 0) + 1
+        for step in record["schedule"]["steps"]:
+            op_coverage[step["op"]] = op_coverage.get(step["op"], 0) + 1
+
+    return {
+        "mode": "chaos-search",
+        "schedules": schedules,
+        "seed0": seed0,
+        "nodes": nodes,
+        "duration_s": duration_s,
+        "interval_s": interval,
+        "jobs": jobs,
+        "mutation": mutation,
+        "ran": len(results),
+        "aborted": {str(s): e for s, e in sorted(aborted.items())},
+        "passed": len(results) - len(failing),
+        "failed": len(failing),
+        "failing_seeds": failing,
+        "violations_by_invariant": dict(sorted(by_invariant.items())),
+        "op_coverage": dict(sorted(op_coverage.items())),
+        "samples_checked": sum(
+            r["checker"]["samples_checked"] for r in results.values()
+        ),
+        "failures": failures,
+        "elapsed_s": round(time.monotonic() - t0, 1),
+        "ok": not failing and not aborted,
+    }
+
+
+def chaos_replay(
+    path: str, interval: float = 0.5, node_interval: float | None = None
+) -> dict:
+    """Replay a persisted failing-schedule artifact (or a bare schedule
+    JSON) once and report — the game-day / bug-triage entry point."""
+    _quiet_fleet_logs()
+    with open(path) as fh:
+        doc = json.load(fh)
+    # Accept either a bare schedule or a shrink_failure artifact; the
+    # artifact replays its MINIMIZED schedule (that is the reproducer).
+    sched_doc = doc.get("minimized") or doc.get("schedule") or doc
+    schedule = FaultSchedule.from_doc(sched_doc)
+    _progress(f"chaos-replay {schedule.describe()}")
+    record = run_trial(
+        schedule, interval=interval, node_interval=node_interval
+    )
+    record["mode"] = "chaos-replay"
+    record["source"] = path
+    return record
+
+
+__all__ = ["chaos_replay", "chaos_search", "run_trial", "shrink_failure"]

@@ -540,9 +540,17 @@ FLEET_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
     ),
     "tpu_fleet_spool_errors_total": (
         "counter",
-        "Warm-restart spool failures by op (load/write); the "
+        "Warm-restart spool failures by op (load/write, plus enospc "
+        "counted once per memory-only degradation transition); the "
         "aggregator runs on, cold",
         ("op",),
+    ),
+    "tpu_fleet_spool_degraded": (
+        "gauge",
+        "1 while the warm-restart spool runs memory-only because the "
+        "volume is full / read-only (ENOSPC/EROFS/EDQUOT); clears on "
+        "the first retry probe that writes clean",
+        (),
     ),
     "tpu_fleet_scrape_duration_seconds": (
         "histogram",
@@ -715,9 +723,18 @@ LEDGER_FAMILIES: dict[str, tuple[str, str, tuple[str, ...]]] = {
     ),
     "tpu_ledger_spool_errors_total": (
         "counter",
-        "Ledger spool failures by op (load / write); the plane runs "
-        "on, memory-only (absent unless the spool is configured)",
+        "Ledger spool failures by op (load / write, plus enospc "
+        "counted once per memory-only degradation transition); the "
+        "plane runs on, memory-only (absent unless the spool is "
+        "configured)",
         ("op",),
+    ),
+    "tpu_ledger_spool_degraded": (
+        "gauge",
+        "1 while the ledger spool runs memory-only because the volume "
+        "is full / read-only (ENOSPC/EROFS/EDQUOT); absent unless the "
+        "spool is configured",
+        (),
     ),
     "tpu_ledger_remote_write_total": (
         "counter",

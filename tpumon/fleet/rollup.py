@@ -23,6 +23,7 @@ rollup math is unit-testable sample-for-sample (tests/test_fleet.py).
 from __future__ import annotations
 
 import logging
+import os
 
 from prometheus_client.core import GaugeMetricFamily
 
@@ -785,6 +786,16 @@ def fleet_families(doc: dict) -> list:
         labels=_SCOPED,
     )
 
+    # Mutation canary (docs/INVARIANTS.md, CI chaos-search job): with
+    # TPUMON_CHAOS_MUTATE=missing_host_unflagged set, the render lies —
+    # stale flag forced 0, visibility forced 1.0 — deliberately
+    # re-introducing the missing-host-unflagged bug the invariant
+    # checker exists to catch. CI fails unless the chaos search catches
+    # and minimizes it; the flag is never set in production manifests.
+    mutate_unflagged = "missing_host_unflagged" in os.environ.get(
+        "TPUMON_CHAOS_MUTATE", ""
+    )
+
     for labels, bucket in _rows(doc):
         for state, n in sorted(bucket["hosts"].items()):
             hosts.add_metric(labels + (state,), float(n))
@@ -832,9 +843,15 @@ def fleet_families(doc: dict) -> list:
                 labels, bucket["straggler_step_skew_max_ratio"]
             )
         degraded.add_metric(labels, float(bucket["degraded_hosts"]))
-        stale_flag.add_metric(labels, 1.0 if bucket["stale"] else 0.0)
+        stale_flag.add_metric(
+            labels,
+            0.0 if mutate_unflagged else (1.0 if bucket["stale"] else 0.0),
+        )
         visibility.add_metric(
-            labels, float(bucket.get("visibility", visibility_of(bucket["hosts"])))
+            labels,
+            1.0 if mutate_unflagged else float(
+                bucket.get("visibility", visibility_of(bucket["hosts"]))
+            ),
         )
 
     return [

@@ -232,9 +232,17 @@ class FleetTelemetry:
         )
         self.spool_errors = Counter(
             "tpu_fleet_spool_errors",
-            "Warm-restart spool failures by op (load / write); the "
+            "Warm-restart spool failures by op (load / write, plus "
+            "enospc counted once per degradation transition); the "
             "aggregator runs on, cold.",
             labelnames=("op",),
+            registry=registry,
+        )
+        self.spool_degraded = Gauge(
+            "tpu_fleet_spool_degraded",
+            "1 while the warm-restart spool runs memory-only because "
+            "the volume is full / read-only (ENOSPC/EROFS/EDQUOT); "
+            "clears on the first retry probe that writes clean.",
             registry=registry,
         )
         self.peer_seeded = Counter(
@@ -697,7 +705,12 @@ class FleetAggregator:
                     removed.append(feed)
             self.feeds = next_feeds
             self.targets = list(owned)
-            self.telemetry.shard_targets.set(float(len(owned)))
+            # tpu_fleet_shard_targets is deliberately NOT set here: the
+            # gauge updates at collect-publish from the entries the
+            # published rollup covers, so one /metrics page never claims
+            # more targets than its host counts account for (a takeover
+            # adopting N targets here, a cycle before the rollup folds
+            # them as dark, read as "N hosts missing, unflagged").
             if self.spool is not None:
                 self.telemetry.spool_restored.set(
                     float(self._restored_count)
@@ -1105,6 +1118,12 @@ class FleetAggregator:
         t = self.telemetry
         t.collect_duration.observe(time.monotonic() - t0)
         t.up.set(1.0)
+        # Page-atomic with the rollup just published (and set AFTER the
+        # publish, so an interleaved scrape can only read the honest
+        # direction: new host counts against the old, smaller target
+        # count). Membership changes reach the gauge one cycle later,
+        # when the rollup covers the adopted targets too.
+        t.shard_targets.set(float(len(entries)))
         t.rollup_dirty_nodes.set(float(self._rollup.last_dirty_nodes))
         t.rollup_dirty_buckets.set(float(self._rollup.last_dirty_buckets))
         t.rollup_shards.set(float(self.stripes.stripe_count))
@@ -1217,10 +1236,18 @@ class FleetAggregator:
 
         def save() -> None:
             try:
-                if not self.spool.save(
-                    universe, entries, actuate=actuate_state
-                ):
+                was_degraded = self.spool.degraded
+                ok = self.spool.save(universe, entries, actuate=actuate_state)
+                if self.spool.degraded and not was_degraded:
+                    # Degradation transition counts ONCE — while
+                    # memory-only the skipped saves are policy, not
+                    # per-tick write failures.
+                    self.telemetry.spool_errors.labels(op="enospc").inc()
+                elif not ok and not self.spool.degraded:
                     self.telemetry.spool_errors.labels(op="write").inc()
+                self.telemetry.spool_degraded.set(
+                    1.0 if self.spool.degraded else 0.0
+                )
             except Exception:
                 log.exception("fleet spool save failed")
                 self.telemetry.spool_errors.labels(op="write").inc()

@@ -24,10 +24,19 @@ Write discipline (the journald/prometheus-WAL genre, scaled way down):
 - **corrupt-tolerant** — any load failure (truncation, garbage, bad
   JSON shapes) quarantines the file aside as ``.corrupt`` and returns
   empty: a bad spool costs the warm start, never the process.
+- **degrades on a full disk** — a write failing with ENOSPC / EROFS /
+  EDQUOT flips the spool to MEMORY-ONLY (:attr:`degraded`): saves are
+  skipped (not attempted-and-failed every cadence tick, which is what
+  a full shared emptyDir used to cost) until a retry probe every
+  :data:`DEGRADED_RETRY_S` finds the disk writable again. The caller
+  counts the TRANSITION (``tpu_fleet_spool_errors_total{op="enospc"}``
+  once, not per tick) and exposes :attr:`degraded` as a gauge the
+  TPUMonSpoolDegraded alert watches.
 """
 
 from __future__ import annotations
 
+import errno
 import json
 import logging
 import os
@@ -38,6 +47,16 @@ log = logging.getLogger(__name__)
 
 SPOOL_VERSION = 1
 SPOOL_NAME = "fleet-spool.json"
+
+#: While degraded (disk full / read-only), attempt a real write again
+#: this often — cheap enough to notice recovery, rare enough that a
+#: persistently full volume costs one failed syscall a minute, not one
+#: per save cadence.
+DEGRADED_RETRY_S = 30.0
+
+#: Errnos that mean "the volume, not this write": degrade to
+#: memory-only instead of re-raising the same failure every cadence.
+DEGRADE_ERRNOS = frozenset({errno.ENOSPC, errno.EROFS, errno.EDQUOT})
 
 
 class SnapshotSpool:
@@ -59,6 +78,17 @@ class SnapshotSpool:
         #: error counter keys off THIS, never off quarantine files left
         #: on disk by earlier incarnations.
         self.last_load_error: str | None = None
+        #: True while the spool runs memory-only because the volume is
+        #: full / read-only (DEGRADE_ERRNOS). Callers count the
+        #: False->True transition and gauge the state; the spool clears
+        #: it on the first retry probe that writes clean.
+        self.degraded = False
+        self.degraded_reason: str | None = None
+        self._next_retry_ts = 0.0
+        #: Test/chaos hook: when set, every save attempt fails with
+        #: this errno before touching the filesystem (the chaos
+        #: engine's spool_enospc / spool_eio faults).
+        self.inject_errno: int | None = None
 
     # -- write -------------------------------------------------------------
 
@@ -72,10 +102,15 @@ class SnapshotSpool:
         universe and, when given, the actuation plane's warm-restart
         state (published hint bands + ownership epochs). Returns False
         (and logs) on any failure — a full disk degrades warm restart,
-        never the aggregator."""
+        never the aggregator. While :attr:`degraded`, saves are
+        SKIPPED memory-only (returning False without a syscall) except
+        for a retry probe every DEGRADED_RETRY_S."""
+        now = self._clock()
+        if self.degraded and now < self._next_retry_ts:
+            return False  # memory-only: skipped, not attempted
         doc = {
             "version": SPOOL_VERSION,
-            "saved_at": self._clock(),
+            "saved_at": now,
             "universe": list(universe),
             "nodes": dict(nodes),
         }
@@ -86,6 +121,10 @@ class SnapshotSpool:
         try:
             body, self.dropped_last_save = self._bounded(doc)
             os.makedirs(self.directory, exist_ok=True)
+            if self.inject_errno is not None:
+                raise OSError(
+                    self.inject_errno, os.strerror(self.inject_errno)
+                )
             fd, tmp = tempfile.mkstemp(
                 dir=self.directory, prefix=".spool-", suffix=".tmp"
             )
@@ -100,10 +139,34 @@ class SnapshotSpool:
                     log.debug("spool temp cleanup failed", exc_info=True)
                 raise
             self.last_write_ts = doc["saved_at"]
+            if self.degraded:
+                log.info(
+                    "fleet spool recovered from %s; journaling resumed",
+                    self.degraded_reason,
+                )
+                self.degraded = False
+                self.degraded_reason = None
             return True
         except (OSError, TypeError, ValueError) as exc:
-            log.warning("fleet spool write failed: %s", exc)
+            self._note_write_failure(exc, now)
             return False
+
+    def _note_write_failure(self, exc: Exception, now: float) -> None:
+        """Classify a failed save: volume-level errnos flip the spool
+        to memory-only with a retry backoff; anything else stays a
+        plain per-attempt failure (the next cadence tick retries)."""
+        code = getattr(exc, "errno", None)
+        if code in DEGRADE_ERRNOS:
+            self._next_retry_ts = now + DEGRADED_RETRY_S
+            if not self.degraded:
+                self.degraded = True
+                self.degraded_reason = errno.errorcode.get(code, str(code))
+                log.warning(
+                    "fleet spool degraded to memory-only (%s): %s",
+                    self.degraded_reason, exc,
+                )
+            return
+        log.warning("fleet spool write failed: %s", exc)
 
     def _bounded(self, doc: dict) -> tuple[bytes, int]:
         """Serialize under ``max_bytes``, dropping oldest nodes first."""
@@ -194,4 +257,10 @@ class SnapshotSpool:
             return empty
 
 
-__all__ = ["SnapshotSpool", "SPOOL_NAME", "SPOOL_VERSION"]
+__all__ = [
+    "DEGRADE_ERRNOS",
+    "DEGRADED_RETRY_S",
+    "SnapshotSpool",
+    "SPOOL_NAME",
+    "SPOOL_VERSION",
+]

@@ -89,7 +89,7 @@ class LedgerPlane:
         #: executor worker clears — same one-bool discipline as the
         #: aggregator's snapshot spool).
         self._spool_saving = False
-        self.spool_errors = {"load": 0, "write": 0}
+        self.spool_errors = {"load": 0, "write": 0, "enospc": 0}
         self.restored = False
         now = clock()
         if spool_dir:
@@ -249,7 +249,13 @@ class LedgerPlane:
 
         def save() -> None:
             try:
-                if not self.spool.save(store_doc, goodput_doc):
+                was_degraded = self.spool.degraded
+                ok = self.spool.save(store_doc, goodput_doc)
+                if self.spool.degraded and not was_degraded:
+                    # Once per degradation transition, not per skipped
+                    # memory-only tick (mirrors the snapshot spool).
+                    self.spool_errors["enospc"] += 1
+                elif not ok and not self.spool.degraded:
                     self.spool_errors["write"] += 1
             except Exception:
                 log.exception("ledger spool save failed")
@@ -315,9 +321,11 @@ class LedgerPlane:
         if self.spool is None:
             return
         try:
-            if not self.spool.save(
-                self.store.to_doc(), self.goodput.to_doc()
-            ):
+            was_degraded = self.spool.degraded
+            ok = self.spool.save(self.store.to_doc(), self.goodput.to_doc())
+            if self.spool.degraded and not was_degraded:
+                self.spool_errors["enospc"] += 1
+            elif not ok and not self.spool.degraded:
                 self.spool_errors["write"] += 1
         except Exception:
             log.exception("final ledger spool save failed")
@@ -535,13 +543,21 @@ class LedgerPlane:
         if self.spool is not None:
             spool_errors = CounterMetricFamily(
                 "tpu_ledger_spool_errors",
-                "Ledger spool failures by op (load / write); the plane "
-                "runs on, memory-only.",
+                "Ledger spool failures by op (load / write, plus "
+                "enospc counted once per degradation transition); the "
+                "plane runs on, memory-only.",
                 labels=("op",),
             )
             for op, count in sorted(self.spool_errors.items()):
                 spool_errors.add_metric((op,), float(count))
             out.append(spool_errors)
+            degraded = GaugeMetricFamily(
+                "tpu_ledger_spool_degraded",
+                "1 while the ledger spool runs memory-only because the "
+                "volume is full / read-only (ENOSPC/EROFS/EDQUOT).",
+            )
+            degraded.add_metric((), 1.0 if self.spool.degraded else 0.0)
+            out.append(degraded)
         if self.remote_write_url:
             rw = CounterMetricFamily(
                 "tpu_ledger_remote_write",

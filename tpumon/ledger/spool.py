@@ -7,7 +7,10 @@ exploding on a downgrade), **bounded** (the serialized document is
 refused over ``max_bytes`` — the store's own tier budgets are what keep
 it under), and **corrupt-tolerant** (any load failure quarantines the
 file as ``.corrupt`` and returns empty: a bad spool costs the warm
-start, never the aggregator).
+start, never the aggregator), and **degrading on a full disk** (ENOSPC
+/ EROFS / EDQUOT flips the spool memory-only until a retry probe every
+``DEGRADED_RETRY_S`` writes clean — the caller counts the transition
+as ``tpu_ledger_spool_errors_total{op="enospc"}`` once).
 
 Payload: one JSON document ``{"store": <TieredSeriesStore.to_doc>,
 "goodput": <GoodputLedger.to_doc>, "saved_at": ts}`` — sealed chunks
@@ -18,11 +21,14 @@ chip-seconds and missing samples, never interpolated ones.
 
 from __future__ import annotations
 
+import errno
 import json
 import logging
 import os
 import tempfile
 import time
+
+from tpumon.fleet.spool import DEGRADE_ERRNOS, DEGRADED_RETRY_S
 
 log = logging.getLogger(__name__)
 
@@ -44,11 +50,24 @@ class LedgerSpool:
         self._clock = clock
         self.last_write_ts = 0.0
         self.last_load_error: str | None = None
+        #: True while the spool runs memory-only because the volume is
+        #: full / read-only (DEGRADE_ERRNOS) — same discipline as the
+        #: fleet SnapshotSpool: callers count the False->True
+        #: transition once and gauge the state.
+        self.degraded = False
+        self.degraded_reason: str | None = None
+        self._next_retry_ts = 0.0
+        #: Test/chaos hook: when set, every save attempt fails with
+        #: this errno before touching the filesystem.
+        self.inject_errno: int | None = None
 
     def save(self, store_doc: dict, goodput_doc: dict) -> bool:
+        now = self._clock()
+        if self.degraded and now < self._next_retry_ts:
+            return False  # memory-only: skipped, not attempted
         doc = {
             "version": LEDGER_SPOOL_VERSION,
-            "saved_at": self._clock(),
+            "saved_at": now,
             "store": store_doc,
             "goodput": goodput_doc,
         }
@@ -64,6 +83,10 @@ class LedgerSpool:
                 )
                 return False
             os.makedirs(self.directory, exist_ok=True)
+            if self.inject_errno is not None:
+                raise OSError(
+                    self.inject_errno, os.strerror(self.inject_errno)
+                )
             fd, tmp = tempfile.mkstemp(
                 dir=self.directory, prefix=".ledger-", suffix=".tmp"
             )
@@ -80,10 +103,33 @@ class LedgerSpool:
                     )
                 raise
             self.last_write_ts = doc["saved_at"]
+            if self.degraded:
+                log.info(
+                    "ledger spool recovered from %s; journaling resumed",
+                    self.degraded_reason,
+                )
+                self.degraded = False
+                self.degraded_reason = None
             return True
         except (OSError, TypeError, ValueError) as exc:
-            log.warning("ledger spool write failed: %s", exc)
+            self._note_write_failure(exc, now)
             return False
+
+    def _note_write_failure(self, exc: Exception, now: float) -> None:
+        """Volume-level errnos flip the spool to memory-only with a
+        retry backoff; anything else stays a per-attempt failure."""
+        code = getattr(exc, "errno", None)
+        if code in DEGRADE_ERRNOS:
+            self._next_retry_ts = now + DEGRADED_RETRY_S
+            if not self.degraded:
+                self.degraded = True
+                self.degraded_reason = errno.errorcode.get(code, str(code))
+                log.warning(
+                    "ledger spool degraded to memory-only (%s): %s",
+                    self.degraded_reason, exc,
+                )
+            return
+        log.warning("ledger spool write failed: %s", exc)
 
     def load(self) -> dict:
         """``{"store": {...}, "goodput": {...}, "saved_at": ts}`` —

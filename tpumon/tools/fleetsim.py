@@ -41,7 +41,26 @@ Fault scripting over stdin (the fleet-chaos vocabulary,
   (``serve off`` clears) — the inference-scenario dial the actuation
   tier's External Metrics adapter is drilled against
   (``soak.py --serve-burst``).
-- ``heal`` — clear partition/slow/corrupt/flap (killed nodes stay dead).
+- ``skew N S`` — wall-clock skew: the first N live nodes stamp their
+  poll timestamp S seconds off true (S may be negative). Future skew
+  exercises the aggregator's never-fresher-than-fetch clamp; past skew
+  the 1 h staleness cap — either way the node must read STALE-FLAGGED,
+  never time-travel (``soak.py --chaos-search``).
+- ``creep N MS [RAMP_S]`` — slow-creep latency ramp: the first N live
+  nodes' response delay ramps linearly from 0 to MS milliseconds over
+  RAMP_S seconds (default 10) — the gradually-congesting-path shape
+  that a fixed ``slow`` threshold drill never exercises.
+- ``revive N`` — undo ``kill`` for the first N dead nodes: frozen
+  pages resume advancing and closed listeners rebind on their original
+  port — the node-replacement / reboot shape, and what makes long
+  random fault schedules searchable (kills stop being absorbing).
+- ``faults SPEC`` — wrap the shared fake backend in the resilience
+  plane's :class:`FaultInjectingBackend` (``faults off`` unwraps):
+  FaultSpec ``error_rate``/``latency_ms``/``hang_every``/``garbage_rate``
+  degrade the CONTENT every node republishes — the whole-fleet
+  telemetry-quality fault axis, orthogonal to transport faults.
+- ``heal`` — clear partition/slow/creep/corrupt/flap/skew/faults
+  (killed nodes stay dead; ``revive`` is the explicit undo).
 
 Exposition: each node serves text (default), the compact snapshot
 frame, or sequence-numbered delta frames (conditional GET via the
@@ -105,7 +124,10 @@ class FleetSim:
         self.nodes = nodes
         self.node_interval = node_interval
         self._backend = FakeTpuBackend.preset(topology)
+        #: The unwrapped backend, kept so ``faults off`` can restore it.
+        self._base_backend = self._backend
         self._cfg = Config()
+        self._addr = addr
         base = self._backend.topology().base_labels()
         self._orig_slice = f'slice="{base.get("slice", "")}"'
         self._orig_host = f'host="{base.get("host", "")}"'
@@ -114,6 +136,12 @@ class FleetSim:
         self._frozen: set[int] = set()  # guarded-by: self._lock
         self._partitioned: set[int] = set()  # guarded-by: self._lock
         self._slow: dict[int, float] = {}  # guarded-by: self._lock
+        #: node -> (ramp start time, ramp seconds, max delay seconds):
+        #: the slow-creep latency ramp (``creep``).
+        self._creep: dict[int, tuple[float, float, float]] = {}  # guarded-by: self._lock
+        #: node -> wall-clock skew seconds applied to the node's poll
+        #: timestamp on BOTH encodings (``skew``; negative = the past).
+        self._skew: dict[int, float] = {}  # guarded-by: self._lock
         self._corrupt: set[int] = set()  # guarded-by: self._lock
         self._flap: set[int] = set()  # guarded-by: self._lock
         self._flap_phase = False  # guarded-by: self._lock
@@ -157,10 +185,18 @@ class FleetSim:
                     body = sim._pages[i]
                     partitioned = i in sim._partitioned
                     delay = sim._slow.get(i, 0.0)
+                    creep = sim._creep.get(i)
                     corrupt = i in sim._corrupt
                     if corrupt:
                         sim._corrupt_serial += 1
                         serial = sim._corrupt_serial
+                if creep is not None:
+                    t0, ramp_s, max_s = creep
+                    frac = (
+                        min(1.0, (time.time() - t0) / ramp_s)
+                        if ramp_s > 0 else 1.0
+                    )
+                    delay = max(delay, frac * max_s)
                 if partitioned:
                     # Accepted, then dropped without a byte: the client
                     # sees a torn read, not a refused connect — the
@@ -200,12 +236,16 @@ class FleetSim:
                 pass
 
         self._servers: list[ThreadingHTTPServer] = []
+        #: Per-node handler classes, kept so ``revive`` can rebind a
+        #: closed listener on its original port.
+        self._handlers: list[type] = []
         self.ports: list[int] = []
         for i in range(nodes):
             handler = type("_H%d" % i, (_Handler,), {"node_index": i})
             server = ThreadingHTTPServer((addr, 0), handler)
             server.daemon_threads = True
             self._servers.append(server)
+            self._handlers.append(handler)
             self.ports.append(server.server_address[1])
             threading.Thread(
                 target=server.serve_forever, kwargs={"poll_interval": 0.5},
@@ -236,20 +276,18 @@ class FleetSim:
         families, _stats = build_families(self._backend, self._cfg)
         template = render_families(tuple(families)).decode()
         now = time.time()
-        stamp = (
-            "# TYPE collector_last_poll_timestamp_seconds gauge\n"
-            f"collector_last_poll_timestamp_seconds {now}\n"
-        )
         with self._lock:
             frozen = set(self._frozen)
             churn = self._churn
             serve = dict(self._serve) if self._serve else None
+            skew = dict(self._skew)
+        serve_lines = ""
         if serve is not None:
             # The serving join rides the stamp (per-tick, every live
             # node) on BOTH encodings: text lines the ingest parser
             # lifts into snap["serve"], and the snapshot/delta path's
             # snap["serve"] below.
-            stamp += "".join(
+            serve_lines = "".join(
                 f"# TYPE tpu_lifecycle_serve_{key} gauge\n"
                 f"tpu_lifecycle_serve_{key} {value:g}\n"
                 for key, value in (
@@ -260,6 +298,15 @@ class FleetSim:
                     ("batch_size", serve["batch_size"]),
                 )
             )
+
+        def _stamp(ts: float) -> str:
+            return (
+                "# TYPE collector_last_poll_timestamp_seconds gauge\n"
+                f"collector_last_poll_timestamp_seconds {ts}\n"
+                + serve_lines
+            )
+
+        stamp = _stamp(now)
         self._tick_no += 1
         live = [i for i in range(self.nodes) if i not in frozen]
         churners: set[int] = set()
@@ -289,8 +336,14 @@ class FleetSim:
                     "host": f"node-{i}",
                 }
                 self._contents[i] = content
-            pages[i] = (self._templates[i] + stamp).encode()
-            snap = {**self._contents[i], "last_poll_ts": now}
+            # Skewed nodes stamp their own clock on BOTH encodings —
+            # the skew rides the data timestamp, never the transport.
+            node_now = now + skew.get(i, 0.0)
+            pages[i] = (
+                self._templates[i]
+                + (stamp if i not in skew else _stamp(node_now))
+            ).encode()
+            snap = {**self._contents[i], "last_poll_ts": node_now}
             if serve is not None:
                 snap["serve"] = serve
             self._delta[i].record(
@@ -435,6 +488,81 @@ class FleetSim:
                 self._slow[i] = delay_s
         return [f"slowed node-{i} to {delay_s:g}s" for i in victims]
 
+    def skew(self, n: int, skew_s: float) -> list[str]:
+        """The first ``n`` live nodes stamp their poll timestamp
+        ``skew_s`` seconds off true from the next tick (negative =
+        stuck in the past). The transport stays healthy: only the DATA
+        clock lies — the NTP-drift / stepped-clock shape the
+        aggregator's skew clamp must stale-flag, never trust."""
+        victims = self._live()[:n]
+        with self._lock:
+            for i in victims:
+                self._skew[i] = skew_s
+        return [f"skewed node-{i} by {skew_s:+g}s" for i in victims]
+
+    def creep(
+        self, n: int, max_delay_s: float, ramp_s: float = 10.0
+    ) -> list[str]:
+        """The first ``n`` live nodes' response delay ramps linearly
+        from 0 to ``max_delay_s`` over ``ramp_s`` seconds."""
+        victims = self._live()[:n]
+        t0 = time.time()
+        with self._lock:
+            for i in victims:
+                self._creep[i] = (t0, max(0.0, ramp_s), max_delay_s)
+        return [
+            f"creeping node-{i} to {max_delay_s:g}s over {ramp_s:g}s"
+            for i in victims
+        ]
+
+    def revive(self, n: int) -> list[str]:
+        """Undo ``kill`` for the first ``n`` dead nodes: the page
+        resumes advancing at the next tick and a closed listener
+        rebinds on its ORIGINAL port (the aggregator's target list
+        never changes — a replaced node comes back at the same
+        address, like a restarted pod behind a stable service)."""
+        with self._lock:
+            dead = sorted(self._frozen)[:n]
+            self._frozen.difference_update(dead)
+        out = []
+        for i in dead:
+            if self._servers[i] is not None:
+                out.append(f"revived node-{i} (page thaws)")
+                continue
+            try:
+                server = ThreadingHTTPServer(
+                    (self._addr, self.ports[i]), self._handlers[i]
+                )
+            except OSError as exc:
+                # Port still in TIME_WAIT against us or stolen: the
+                # node stays connection-refused but its page thaws —
+                # report honestly so schedules can tell the difference.
+                out.append(f"revive node-{i} rebind failed: {exc}")
+                continue
+            server.daemon_threads = True
+            self._servers[i] = server
+            threading.Thread(
+                target=server.serve_forever, kwargs={"poll_interval": 0.5},
+                name=f"fleetsim-{i}", daemon=True,
+            ).start()
+            out.append(f"revived node-{i} (listener rebound)")
+        return out or ["no dead nodes to revive"]
+
+    def faults(self, spec: str) -> list[str]:
+        """Wrap the shared fake backend in FaultInjectingBackend with
+        the given spec (``off`` restores the clean backend). Content
+        degradation is fleet-wide: every node republishes whatever the
+        faulted backend produced that tick. ``hang_every`` stalls the
+        ticker itself — full-fleet staleness, by design."""
+        from tpumon.resilience.faults import FaultInjectingBackend, FaultSpec
+
+        if spec.strip() == "off":
+            self._backend = self._base_backend
+            return ["faults off"]
+        parsed = FaultSpec.parse(spec)
+        self._backend = FaultInjectingBackend(self._base_backend, parsed)
+        return [f"faults {parsed.describe()}"]
+
     def corrupt(self, n: int) -> list[str]:
         """The LAST ``n`` live nodes serve hostile payloads (from the
         tail so a script composing partition+corrupt hits disjoint
@@ -454,16 +582,23 @@ class FleetSim:
         return [f"flapping node-{i}" for i in victims]
 
     def heal(self) -> list[str]:
-        """Clear every recoverable fault (killed nodes stay dead)."""
+        """Clear every recoverable fault (killed nodes stay dead;
+        ``revive`` is their explicit undo)."""
         with self._lock:
             cleared = (
                 len(self._partitioned) + len(self._slow)
-                + len(self._corrupt) + len(self._flap)
+                + len(self._creep) + len(self._corrupt)
+                + len(self._flap) + len(self._skew)
             )
             self._partitioned.clear()
             self._slow.clear()
+            self._creep.clear()
             self._corrupt.clear()
             self._flap.clear()
+            self._skew.clear()
+        if self._backend is not self._base_backend:
+            self._backend = self._base_backend
+            cleared += 1
         return [f"healed {cleared} fault(s)"]
 
     def close(self) -> None:
@@ -493,9 +628,10 @@ def main(argv=None) -> int:
     )
     print("PORTS " + " ".join(str(p) for p in sim.ports), flush=True)
     try:
-        # Control protocol: "kill N" / "partition N" / "slow N MS" /
-        # "corrupt N" / "flap N" / "churn F" / "serve ..." / "heal" /
-        # "quit".
+        # Control protocol: "kill N" / "revive N" / "partition N" /
+        # "slow N MS" / "creep N MS [RAMP_S]" / "skew N S" /
+        # "corrupt N" / "flap N" / "churn F" / "serve ..." /
+        # "faults SPEC" / "heal" / "quit".
         for line in sys.stdin:
             parts = line.split()
             if not parts:
@@ -506,10 +642,19 @@ def main(argv=None) -> int:
             try:
                 if cmd == "kill" and len(parts) == 2:
                     out = sim.kill(int(parts[1]))
+                elif cmd == "revive" and len(parts) == 2:
+                    out = sim.revive(int(parts[1]))
                 elif cmd == "partition" and len(parts) == 2:
                     out = sim.partition(int(parts[1]))
                 elif cmd == "slow" and len(parts) == 3:
                     out = sim.slow(int(parts[1]), float(parts[2]) / 1e3)
+                elif cmd == "creep" and len(parts) in (3, 4):
+                    out = sim.creep(
+                        int(parts[1]), float(parts[2]) / 1e3,
+                        float(parts[3]) if len(parts) == 4 else 10.0,
+                    )
+                elif cmd == "skew" and len(parts) == 3:
+                    out = sim.skew(int(parts[1]), float(parts[2]))
                 elif cmd == "corrupt" and len(parts) == 2:
                     out = sim.corrupt(int(parts[1]))
                 elif cmd == "flap" and len(parts) == 2:
@@ -518,6 +663,8 @@ def main(argv=None) -> int:
                     out = sim.set_churn(float(parts[1]))
                 elif cmd == "serve" and len(parts) >= 2:
                     out = sim.serve_profile(" ".join(parts[1:]))
+                elif cmd == "faults" and len(parts) == 2:
+                    out = sim.faults(parts[1])
                 elif cmd == "heal" and len(parts) == 1:
                     out = sim.heal()
                 else:

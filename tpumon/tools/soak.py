@@ -4051,9 +4051,53 @@ def main(argv=None) -> int:
                         help="page-advance cadence of the simulated "
                         "node endpoints (tools/fleetsim.py); default: "
                         "--interval")
+    parser.add_argument("--chaos-search", action="store_true",
+                        help="property-based chaos search (ISSUE 19, "
+                        "tpumon/chaos): generate --chaos-schedules "
+                        "seeded random fault schedules, run each "
+                        "against a fresh 2-shard fleet under the "
+                        "invariant checker, and shrink any failure to "
+                        "a 1-minimal replayable reproducer "
+                        "(--chaos-out). TPUMON_CHAOS_MUTATE plants the "
+                        "CI mutation canary the search must catch")
+    parser.add_argument("--chaos-replay", default=None, metavar="FILE",
+                        help="replay one persisted failing-schedule "
+                        "artifact (or bare schedule JSON) against a "
+                        "fresh fleet and report")
+    parser.add_argument("--chaos-schedules", type=int, default=20,
+                        help="seeded schedules to search")
+    parser.add_argument("--chaos-seed0", type=int, default=1,
+                        help="first seed (seeds are contiguous)")
+    parser.add_argument("--chaos-duration", type=float, default=20.0,
+                        help="per-schedule fleet runtime in seconds")
+    parser.add_argument("--chaos-jobs", type=int, default=1,
+                        help="concurrent trials (each owns its own "
+                        "fleetsim + shards + spools)")
+    parser.add_argument("--chaos-out", default=None, metavar="DIR",
+                        help="directory for failing-schedule JSON "
+                        "artifacts (CI uploads these)")
     args = parser.parse_args(argv)
     if args.duration <= 0:
         parser.error("--duration must be > 0")
+    if args.chaos_search:
+        from tpumon.chaos.search import chaos_search
+
+        record = chaos_search(
+            schedules=args.chaos_schedules, seed0=args.chaos_seed0,
+            nodes=args.fleet_nodes, duration_s=args.chaos_duration,
+            node_interval=args.fleet_node_interval,
+            jobs=args.chaos_jobs, out_dir=args.chaos_out,
+        )
+        print(json.dumps(record))
+        return 0 if record["ok"] else 1
+    if args.chaos_replay:
+        from tpumon.chaos.search import chaos_replay
+
+        record = chaos_replay(
+            args.chaos_replay, node_interval=args.fleet_node_interval
+        )
+        print(json.dumps(record))
+        return 0 if not record["failed"] else 1
     if args.preempt:
         record = preempt_soak(
             args.duration, topology=args.topology,

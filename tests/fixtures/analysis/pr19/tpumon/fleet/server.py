@@ -1,0 +1,49 @@
+"""Planted pre-PR-19 bug: the ``tpu_fleet_shard_targets`` gauge stamped
+from the MEMBERSHIP thread, against a rollup that has not adopted the
+new targets yet — the page/rollup skew PR 19's chaos search needed 200
+seeded fault schedules to reproduce. This fixture is the analyzer's
+mutation canary: ``publish-discipline`` must catch it statically, by
+name, or the CI lint job fails (tests/test_analysis.py and the
+``lint-invariants`` workflow both assert on it). It lives under
+tests/fixtures/ so the repo's own invariant run never sees it.
+"""
+
+import threading
+
+from prometheus_client import Gauge
+
+
+class FleetTelemetry:
+    def __init__(self, registry) -> None:
+        self.shard_targets = Gauge(
+            "tpu_fleet_shard_targets",
+            "Upstream exporter targets owned by this shard.",
+            registry=registry,
+        )  # publish-on: collect
+
+
+class FleetServer:
+    def __init__(self, telemetry, cache, membership) -> None:
+        self.telemetry = telemetry
+        self.cache = cache
+        self._cycles = 0
+        self._thread = threading.Thread(
+            target=self._run, name="tpumon-fleet-collect", daemon=True
+        )  # thread: collect
+        membership.on_change = self._apply_membership
+
+    def _apply_membership(self, owned: list) -> None:  # thread: membership
+        # THE BUG: the gauge moves here, on the membership thread, while
+        # the published page still carries the pre-adoption rollup.
+        self.telemetry.shard_targets.set(float(len(owned)))
+        # Unguarded cross-thread store: races with _collect_cycle.
+        self._cycles = 0
+
+    def _run(self) -> None:
+        while True:
+            self._collect_cycle()
+
+    def _collect_cycle(self) -> None:
+        families: list = []
+        self.cache.publish(families)
+        self._cycles += 1

@@ -6,7 +6,14 @@ correctness resting on cross-file invariants nothing enforced: every
 family must be registered and documented, shared state must stay under
 its lock, blocking calls on the serving/poll paths must carry deadlines,
 and ``except Exception`` in the poll pipeline must never swallow
-silently. This package proves those invariants mechanically:
+silently. Since 1.1.0 the discipline is interprocedural: a whole-package
+call graph (callgraph.py) propagates thread roles from every spawn site,
+executor submit, WSGI/gRPC entry point, and ``# thread:`` annotation
+(threads.py), and two concurrency rules (races.py) convict unlocked
+cross-role stores and off-role mutations of page-feeding
+``# publish-on:`` state — the PR 19 ``tpu_fleet_shard_targets`` skew
+class, caught in the AST instead of 200 chaos schedules. This package
+proves those invariants mechanically:
 
 - ``python -m tpumon.tools.check`` — the CLI (``--strict`` gates CI);
 - ``tests/test_analysis.py`` — per-rule fixture proofs + a repo
@@ -17,8 +24,8 @@ silently. This package proves those invariants mechanically:
 Everything here is stdlib-only (ast + tokenize + json + re): the
 analyzer must run on a bare checkout with no dependencies installed.
 See docs/INVARIANTS.md for the rule catalog and annotation conventions
-(``# guarded-by:``, ``# holds:``, ``# deadline:``,
-``# tpumon-invariants: disable=<rule>``).
+(``# guarded-by:``, ``# holds:``, ``# deadline:``, ``# thread:``,
+``# publish-on:``, ``# tpumon-invariants: disable=<rule>``).
 """
 
 from __future__ import annotations

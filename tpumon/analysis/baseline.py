@@ -65,7 +65,13 @@ def default_stamp_path(root: str) -> str:
 
 
 def write_stamp(
-    root: str, *, new: int, baselined: int, stale: int, version: str
+    root: str,
+    *,
+    new: int,
+    baselined: int,
+    stale: int,
+    version: str,
+    new_by_rule: dict[str, int] | None = None,
 ) -> str:
     path = default_stamp_path(root)
     doc = {
@@ -76,6 +82,8 @@ def write_stamp(
         "stale_baseline_entries": stale,
         "ok": new == 0 and stale == 0,
     }
+    if new_by_rule:
+        doc["new_by_rule"] = dict(sorted(new_by_rule.items()))
     tmp = path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as fh:
         json.dump(doc, fh, sort_keys=True)

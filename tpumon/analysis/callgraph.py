@@ -1,0 +1,390 @@
+"""Whole-package call graph for the interprocedural concurrency rules.
+
+The lock rules are lexical: they see one class at a time and cannot know
+which *threads* reach a method. This module builds the missing layer — a
+best-effort static call graph over every analyzed module:
+
+- **module-level resolution**: ``foo()`` binds to the module's own
+  ``def foo``, a ``from tpumon.x import foo`` target, or an imported
+  ``mod.foo``;
+- **method dispatch via self-type inference**: ``self.stripes.put()``
+  resolves through ``self.stripes = StripeSet(...)`` in ``__init__`` to
+  ``StripeSet.put``; plain ``self._collect_cycle()`` binds inside the
+  enclosing class (base classes included); local variables typed by
+  construction (``feed = NodeFeed(...)``) resolve the same way;
+- **callable references**: ``functools.partial(fn, ...)`` peels to
+  ``fn``; a ``lambda`` resolves to the targets its body calls — the two
+  forms thread spawn sites actually use.
+
+The graph is deliberately an under-approximation where it cannot prove a
+binding (an unresolvable call contributes no edge) and an
+over-approximation across same-named classes only when the name is
+globally unique — both are the right polarity for the race rules, which
+must not convict on guessed edges.
+
+Qualnames are ``<path>::<dotted scope>`` (``tpumon/fleet/server.py::
+FleetServer._collect_cycle``); nested defs chain through their owners
+(``...::FleetServer._with_fleet_endpoint.app``).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tpumon.analysis.core import Project, call_name
+
+_MAX_RESOLVE_DEPTH = 6
+
+
+def _module_path(project: Project, dotted_mod: str) -> str | None:
+    """``tpumon.fleet.server`` -> ``tpumon/fleet/server.py`` when the
+    module is part of the analyzed tree."""
+    base = dotted_mod.replace(".", "/")
+    for cand in (base + ".py", base + "/__init__.py"):
+        if cand in project.python:
+            return cand
+    return None
+
+
+@dataclass
+class ClassInfo:
+    """One class definition: its methods, base names, and the inferred
+    types of ``self.<attr>`` instance attributes."""
+
+    name: str
+    path: str
+    qual: str  # qualname prefix for methods: "<path>::<Outer.Cls>"
+    node: ast.ClassDef
+    methods: dict[str, str] = field(default_factory=dict)
+    bases: list[str] = field(default_factory=list)
+    #: self.attr -> ClassInfo candidates (from `self.attr = Cls(...)`).
+    attr_types: dict[str, list["ClassInfo"]] = field(default_factory=dict)
+
+
+@dataclass
+class FuncInfo:
+    """One (possibly nested) function definition."""
+
+    qualname: str
+    path: str
+    name: str
+    node: ast.AST
+    cls: ClassInfo | None = None  # nearest enclosing class (for `self`)
+
+
+class CallGraph:
+    """functions + direct-call edges over the whole project."""
+
+    def __init__(self, project: Project) -> None:
+        self.project = project
+        self.functions: dict[str, FuncInfo] = {}
+        #: caller qualname -> callee qualnames (direct calls only).
+        self.edges: dict[str, set[str]] = {}
+        #: id(ast def node) -> FuncInfo (rules look functions up by node).
+        self.by_node: dict[int, FuncInfo] = {}
+        #: path -> top-level function name -> qualname.
+        self._module_funcs: dict[str, dict[str, str]] = {}
+        #: path -> class name (dotted for nested) -> ClassInfo.
+        self._module_classes: dict[str, dict[str, ClassInfo]] = {}
+        #: class name -> every ClassInfo with that name (global fallback).
+        self._classes_by_name: dict[str, list[ClassInfo]] = {}
+        #: path -> local name -> (module_path, attr-or-None).
+        self._imports: dict[str, dict[str, tuple[str, str | None]]] = {}
+        self._local_types_cache: dict[int, dict[str, list[ClassInfo]]] = {}
+        self._build()
+
+    # -- indexing ----------------------------------------------------------
+
+    def _build(self) -> None:
+        for path, src in sorted(self.project.python.items()):
+            self._index_imports(path, src)
+            self._index_scopes(path, src.tree, chain=[], cls=None)
+        # Second pass: attr types need every class registered first.
+        for classes in self._module_classes.values():
+            for ci in classes.values():
+                self._infer_attr_types(ci)
+        # Third pass: edges need attr types.
+        for path, src in sorted(self.project.python.items()):
+            self._index_edges(path, src)
+
+    def _index_imports(self, path: str, src) -> None:
+        imp: dict[str, tuple[str, str | None]] = {}
+        pkg_parts = path.split("/")[:-1]
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    mp = _module_path(self.project, alias.name)
+                    if mp is None:
+                        continue
+                    if alias.asname:
+                        imp[alias.asname] = (mp, None)
+                    # `import a.b.c` binds `a`; attribute-chain walks
+                    # through packages are resolved lazily in _resolve.
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if node.level:
+                    base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    mod = ".".join(base + ([mod] if mod else []))
+                if not mod:
+                    continue
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    sub = _module_path(self.project, mod + "." + alias.name)
+                    if sub is not None:
+                        imp[local] = (sub, None)
+                        continue
+                    mp = _module_path(self.project, mod)
+                    if mp is not None:
+                        imp[local] = (mp, alias.name)
+        self._imports[path] = imp
+
+    def _index_scopes(
+        self, path: str, node: ast.AST, chain: list[str], cls: ClassInfo | None
+    ) -> None:
+        in_class_body = isinstance(node, ast.ClassDef)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                name = ".".join(chain + [child.name])
+                ci = ClassInfo(
+                    name=child.name,
+                    path=path,
+                    qual=f"{path}::{name}",
+                    node=child,
+                    bases=[
+                        b.id if isinstance(b, ast.Name) else getattr(b, "attr", "")
+                        for b in child.bases
+                    ],
+                )
+                self._module_classes.setdefault(path, {})[name] = ci
+                self._classes_by_name.setdefault(child.name, []).append(ci)
+                self._index_scopes(path, child, chain + [child.name], ci)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{path}::{'.'.join(chain + [child.name])}"
+                fi = FuncInfo(qn, path, child.name, child, cls)
+                self.functions[qn] = fi
+                self.by_node[id(child)] = fi
+                if in_class_body and cls is not None:
+                    cls.methods.setdefault(child.name, qn)
+                if not chain:
+                    self._module_funcs.setdefault(path, {})[child.name] = qn
+                self._index_scopes(path, child, chain + [child.name], cls)
+            else:
+                self._index_scopes(path, child, chain, cls)
+
+    def _infer_attr_types(self, ci: ClassInfo) -> None:
+        for node in ast.walk(ci.node):
+            if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call
+            ):
+                continue
+            ctor = self._class_for_expr(ci.path, node.value.func)
+            if ctor is None:
+                continue
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    ci.attr_types.setdefault(tgt.attr, []).append(ctor)
+
+    def _index_edges(self, path: str, src) -> None:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            fi = self.by_node.get(id(node))
+            if fi is None:
+                continue
+            out = self.edges.setdefault(fi.qualname, set())
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                owner = self._owning_function(src, call)
+                if owner is not node:
+                    continue
+                out |= self.resolve(path, fi, call.func)
+
+    @staticmethod
+    def _owning_function(src, node: ast.AST) -> ast.AST | None:
+        for anc in src.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    # -- lookups -----------------------------------------------------------
+
+    def _lookup_class(self, path: str, name: str) -> ClassInfo | None:
+        local = self._module_classes.get(path, {}).get(name)
+        if local is not None:
+            return local
+        imp = self._imports.get(path, {}).get(name)
+        if imp is not None and imp[1] is not None:
+            target = self._module_classes.get(imp[0], {}).get(imp[1])
+            if target is not None:
+                return target
+        # Globally-unique name: safe enough for ctor typing.
+        cands = self._classes_by_name.get(name, [])
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    def _class_for_expr(self, path: str, expr: ast.AST) -> ClassInfo | None:
+        if isinstance(expr, ast.Name):
+            return self._lookup_class(path, expr.id)
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+            imp = self._imports.get(path, {}).get(expr.value.id)
+            if imp is not None and imp[1] is None:
+                return self._module_classes.get(imp[0], {}).get(expr.attr)
+        return None
+
+    def _methods_on(self, ci: ClassInfo, name: str, depth: int = 0) -> set[str]:
+        if depth > _MAX_RESOLVE_DEPTH:
+            return set()
+        qn = ci.methods.get(name)
+        if qn is not None:
+            return {qn}
+        out: set[str] = set()
+        for base in ci.bases:
+            bi = self._lookup_class(ci.path, base)
+            if bi is not None and bi is not ci:
+                out |= self._methods_on(bi, name, depth + 1)
+        return out
+
+    def _class_init(self, ci: ClassInfo) -> set[str]:
+        return self._methods_on(ci, "__init__")
+
+    def local_types(self, fi: FuncInfo) -> dict[str, list[ClassInfo]]:
+        """Local-variable construction types: ``feed = NodeFeed(...)``."""
+        cached = self._local_types_cache.get(id(fi.node))
+        if cached is not None:
+            return cached
+        out: dict[str, list[ClassInfo]] = {}
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call
+            ):
+                continue
+            ctor = self._class_for_expr(fi.path, node.value.func)
+            if ctor is None:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.setdefault(tgt.id, []).append(ctor)
+        self._local_types_cache[id(fi.node)] = out
+        return out
+
+    # -- resolution --------------------------------------------------------
+
+    def resolve(
+        self, path: str, fi: FuncInfo | None, expr: ast.AST, depth: int = 0
+    ) -> set[str]:
+        """Qualnames a callable expression can bind to (possibly empty)."""
+        if depth > _MAX_RESOLVE_DEPTH:
+            return set()
+        if isinstance(expr, ast.Lambda):
+            # A lambda runs its body: resolve the calls it makes.
+            out: set[str] = set()
+            for node in ast.walk(expr.body):
+                if isinstance(node, ast.Call):
+                    out |= self.resolve(path, fi, node.func, depth + 1)
+            return out
+        if isinstance(expr, ast.Call):
+            # functools.partial(fn, ...) as a callable reference.
+            if call_name(expr) == "partial" and expr.args:
+                return self.resolve(path, fi, expr.args[0], depth + 1)
+            return set()
+        if isinstance(expr, ast.Name):
+            if fi is not None:
+                # A nested `def` in the same function shadows the module
+                # scope (`self._executor.submit(save)` after `def save`).
+                for node in ast.walk(fi.node):
+                    if (
+                        isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and node.name == expr.id
+                        and id(node) in self.by_node
+                    ):
+                        return {self.by_node[id(node)].qualname}
+            return self._resolve_name(path, expr.id)
+        if isinstance(expr, ast.Attribute):
+            return self._resolve_attribute(path, fi, expr, depth)
+        return set()
+
+    def _resolve_name(self, path: str, name: str) -> set[str]:
+        qn = self._module_funcs.get(path, {}).get(name)
+        if qn is not None:
+            return {qn}
+        ci = self._module_classes.get(path, {}).get(name)
+        if ci is not None:
+            return self._class_init(ci)
+        imp = self._imports.get(path, {}).get(name)
+        if imp is not None:
+            mp, attr = imp
+            if attr is None:
+                return set()  # bare module reference is not callable
+            qn = self._module_funcs.get(mp, {}).get(attr)
+            if qn is not None:
+                return {qn}
+            ci = self._module_classes.get(mp, {}).get(attr)
+            if ci is not None:
+                return self._class_init(ci)
+        return set()
+
+    def _resolve_attribute(
+        self, path: str, fi: FuncInfo | None, expr: ast.Attribute, depth: int
+    ) -> set[str]:
+        base, meth = expr.value, expr.attr
+        cls = fi.cls if fi is not None else None
+        if isinstance(base, ast.Name):
+            if base.id == "self" and cls is not None:
+                got = self._methods_on(cls, meth)
+                if got:
+                    return got
+                # self.attr as a callable: a constructed attribute whose
+                # class defines __call__ would land here; out of scope.
+                return set()
+            if fi is not None:
+                for ci in self.local_types(fi).get(base.id, []):
+                    got = self._methods_on(ci, meth)
+                    if got:
+                        return got
+            imp = self._imports.get(path, {}).get(base.id)
+            if imp is not None and imp[1] is None:
+                mp = imp[0]
+                qn = self._module_funcs.get(mp, {}).get(meth)
+                if qn is not None:
+                    return {qn}
+                ci = self._module_classes.get(mp, {}).get(meth)
+                if ci is not None:
+                    return self._class_init(ci)
+            ci = self._lookup_class(path, base.id)
+            if ci is not None:
+                return self._methods_on(ci, meth)
+            return set()
+        if (
+            isinstance(base, ast.Attribute)
+            and isinstance(base.value, ast.Name)
+            and base.value.id == "self"
+            and cls is not None
+        ):
+            out: set[str] = set()
+            for ci in cls.attr_types.get(base.attr, []):
+                out |= self._methods_on(ci, meth)
+            return out
+        if (
+            isinstance(base, ast.Call)
+            and isinstance(base.func, ast.Name)
+            and base.func.id == "super"
+            and cls is not None
+        ):
+            out = set()
+            for bname in cls.bases:
+                bi = self._lookup_class(cls.path, bname)
+                if bi is not None:
+                    out |= self._methods_on(bi, meth, depth + 1)
+            return out
+        return set()
+
+
+def build(project: Project) -> CallGraph:
+    return CallGraph(project)

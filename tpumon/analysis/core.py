@@ -22,7 +22,7 @@ import tokenize
 from dataclasses import dataclass, field
 
 
-ANALYZER_VERSION = "1.0.0"
+ANALYZER_VERSION = "1.1.0"
 
 #: Source trees the analyzer never parses (generated / vendored).
 _EXCLUDED_PARTS = ("_native/build",)
@@ -172,7 +172,14 @@ def all_rules() -> dict:
     """name -> rule callable. Imported lazily so ``tpumon.analysis`` stays
     importable (for /debug/vars' baseline count) without pulling every
     rule module."""
-    from tpumon.analysis import deadlines, exceptions, families_rule, knobs, locks
+    from tpumon.analysis import (
+        deadlines,
+        exceptions,
+        families_rule,
+        knobs,
+        locks,
+        races,
+    )
 
     return {
         "knob-drift": knobs.check,
@@ -181,6 +188,8 @@ def all_rules() -> dict:
         "lock-order": locks.check_order,
         "deadline": deadlines.check,
         "except-hygiene": exceptions.check,
+        "race": races.check_races,
+        "publish-discipline": races.check_publish,
     }
 
 
@@ -226,6 +235,7 @@ PIPELINE_PREFIXES = (
     "tpumon/energy/",
     "tpumon/ledger/",
     "tpumon/actuate/",
+    "tpumon/chaos/",
     "tpumon/history.py",
 )
 

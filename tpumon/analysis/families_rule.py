@@ -64,7 +64,7 @@ _REGISTRY_DICTS = {
 _METRIC_RE = re.compile(
     r"\b(?:(?:accelerator|exporter|collector|workload|host|tpu_anomaly"
     r"|tpu_hostcorr|tpu_straggler|tpu_lifecycle|tpu_step|tpu_serve"
-    r"|tpu_energy|tpu_pod_energy|tpu_ledger|tpu_actuate"
+    r"|tpu_energy|tpu_pod_energy|tpu_ledger|tpu_actuate|tpu_chaos"
     r"|tpu_fleet|tpumon_trace|tpumon_poll|tpumon_family|tpumon_breaker"
     r"|tpumon_retries|tpumon_watchdog|tpumon_guard|tpumon_shed"
     r"|tpumon_cardinality|tpumon_render|tpumon_exposition)_[a-z0-9_]+"
@@ -88,6 +88,7 @@ _EMIT_PREFIXES = (
     "tpumon/ledger/",
     "tpumon/workload/",
     "tpumon/actuate/",
+    "tpumon/chaos/",
 )
 
 

@@ -1,0 +1,312 @@
+"""Rules ``race`` and ``publish-discipline``: interprocedural
+concurrency analysis over the thread-role propagation (threads.py).
+
+``race`` — an unguarded cross-thread store. For every ``self.attr``
+store outside ``__init__`` in the pipeline modules, the rule computes
+the set of thread roles that reach the storing method over the call
+graph. When the stores of one attribute are reachable from **two or
+more roles** and no common lock is held lexically at every store site,
+two threads can interleave the writes — the bug class PR 19's chaos
+search needed 200 seeded schedules to hit, visible here in the AST.
+The rule *composes* with the lock annotations instead of replacing
+them: an attribute declared ``# guarded-by: self._lock`` is
+lock-discipline's jurisdiction (that rule already flags unheld
+accesses), and a store set that shares a lexical ``with self.<lock>:``
+is accepted as guarded even without an annotation.
+
+``publish-discipline`` — state that feeds a published page mutates only
+on its publishing thread, after the page publish. Declaration rides the
+attribute's construction, like ``guarded-by``:
+
+    self.shard_targets = Gauge("tpu_fleet_shard_targets", ...)
+    # publish-on: collect
+
+Any mutation of that attribute (``.set()``/``.inc()``/``.dec()``/
+``.observe()`` on it, or rebinding it) reachable from a role outside
+the declared set is a violation naming the gauge and both roles — the
+exact PR 19 ``tpu_fleet_shard_targets`` bug class, where the membership
+thread stamped a gauge against a rollup that had not adopted its
+targets yet. Inside the publishing role, a mutation that precedes the
+``.publish(...)`` call in the same function breaks page-atomicity the
+other way (the fresh value rides the *previous* page) and is flagged as
+``<name>:before-publish:<method>``.
+
+Violation keys: ``Class.attr`` (race), ``<gauge-or-attr>:<method>`` and
+``<gauge-or-attr>:before-publish:<method>`` (publish-discipline).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpumon.analysis.core import (
+    PIPELINE_PREFIXES,
+    Project,
+    Violation,
+    call_name,
+    str_const,
+)
+from tpumon.analysis.locks import (
+    _guarded_attrs,
+    _held_locks,
+    _methods,
+    _parse_marked_names,
+    _self_attr,
+    _stmt_comment,
+)
+from tpumon.analysis.threads import analyze
+
+RACE_RULE = "race"
+PUBLISH_RULE = "publish-discipline"
+
+_PUBLISH_MARK = "publish-on:"
+
+#: Metric-object methods that move a published value.
+_MUTATORS = {"set", "inc", "dec", "observe"}
+
+#: Metric constructors whose first literal argument names the family —
+#: used to report the gauge by its exposition name, not its attribute.
+_METRIC_CTORS = {
+    "Gauge", "Counter", "Histogram", "Summary", "Info",
+    "GaugeMetricFamily", "CounterMetricFamily", "HistogramMetricFamily",
+}
+
+#: The race rules run on the serving/poll pipeline (like deadline and
+#: except-hygiene): driver-side tooling (workload harness, bench, smi)
+#: spawns throwaway threads whose state never outlives a run.
+SCOPE_PREFIXES = PIPELINE_PREFIXES
+
+
+def _store_targets(node: ast.AST) -> list[str]:
+    """self-attribute names stored by an assignment statement."""
+    targets: list[ast.AST] = []
+    if isinstance(node, ast.Assign):
+        targets = list(node.targets)
+    elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+        targets = [node.target]
+    out = []
+    for tgt in targets:
+        if isinstance(tgt, ast.Tuple):
+            for el in tgt.elts:
+                attr = _self_attr(el)
+                if attr:
+                    out.append(attr)
+        else:
+            attr = _self_attr(tgt)
+            if attr:
+                out.append(attr)
+    return out
+
+
+def check_races(project: Project) -> list[Violation]:
+    analysis = analyze(project)
+    out: list[Violation] = []
+    for path, src in sorted(project.python.items()):
+        if not path.startswith(SCOPE_PREFIXES):
+            continue
+        for cls in ast.walk(src.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            guarded = _guarded_attrs(cls, src)
+            # attr -> list of (method, node, roles, held-locks).
+            stores: dict[str, list] = {}
+            for fn in _methods(cls):
+                if fn.name == "__init__":
+                    continue
+                roles = analysis.roles_of(fn)
+                for node in ast.walk(fn):
+                    for attr in _store_targets(node):
+                        if attr in guarded:
+                            continue  # lock-discipline's jurisdiction
+                        held = _held_locks(node, src, fn)
+                        stores.setdefault(attr, []).append(
+                            (fn.name, node, roles, held)
+                        )
+            for attr, sites in sorted(stores.items()):
+                all_roles: set[str] = set()
+                for _, _, roles, _ in sites:
+                    all_roles |= roles
+                if len(all_roles) < 2:
+                    continue
+                common = sites[0][3].copy()
+                for _, _, _, held in sites[1:]:
+                    common &= held
+                if common:
+                    continue  # every store shares a lexical lock
+                first = min(sites, key=lambda s: s[1].lineno)
+                methods = sorted({name for name, _, _, _ in sites})
+                out.append(
+                    Violation(
+                        RACE_RULE,
+                        f"{cls.name}.{attr}",
+                        path,
+                        first[1].lineno,
+                        f"{cls.name}.{attr} is stored from thread roles "
+                        f"{{{', '.join(sorted(all_roles))}}} (in "
+                        f"{', '.join(methods)}) with no common lock held "
+                        "and no `# guarded-by:` annotation — interleaved "
+                        "writes race; lock it, confine it to one role, "
+                        "or annotate the guard",
+                    )
+                )
+    return out
+
+
+# -- publish-discipline ----------------------------------------------------
+
+
+def _declared_publish_attrs(project: Project):
+    """attr declarations carrying ``# publish-on: <role,...>``:
+    name -> (display name, declared roles, class, path, line)."""
+    out: dict[str, tuple[str, set[str], str, str, int]] = {}
+    for path, src in sorted(project.python.items()):
+        for cls in ast.walk(src.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for node in ast.walk(cls):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                roles = _parse_marked_names(
+                    _stmt_comment(src, node), _PUBLISH_MARK
+                )
+                if not roles:
+                    continue
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for tgt in targets:
+                    attr = _self_attr(tgt)
+                    if attr is None:
+                        continue
+                    display = f"{cls.name}.{attr}"
+                    value = node.value
+                    if (
+                        isinstance(value, ast.Call)
+                        and call_name(value) in _METRIC_CTORS
+                        and value.args
+                    ):
+                        fam = str_const(value.args[0])
+                        if fam:
+                            display = fam
+                    out[attr] = (display, roles, cls.name, path, node.lineno)
+    return out
+
+
+def _mutated_attr(node: ast.Call) -> str | None:
+    """``<recv>.X.set(...)`` -> ``X`` for the mutator methods."""
+    func = node.func
+    if not isinstance(func, ast.Attribute) or func.attr not in _MUTATORS:
+        return None
+    value = func.value
+    # Peel `.labels(...)`: `<recv>.X.labels(a=b).set(v)` mutates X too.
+    if (
+        isinstance(value, ast.Call)
+        and isinstance(value.func, ast.Attribute)
+        and value.func.attr == "labels"
+    ):
+        value = value.func.value
+    if isinstance(value, ast.Attribute):
+        return value.attr
+    return None
+
+
+def _is_decl_site(node: ast.AST, src) -> bool:
+    return _PUBLISH_MARK in _stmt_comment(src, node)
+
+
+def check_publish(project: Project) -> list[Violation]:
+    declared = _declared_publish_attrs(project)
+    if not declared:
+        return []
+    analysis = analyze(project)
+    out: list[Violation] = []
+    for path, src in sorted(project.python.items()):
+        if not path.startswith(SCOPE_PREFIXES):
+            continue
+        for fn in ast.walk(src.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name == "__init__":
+                continue  # seeding an initial value happens-before
+            roles = analysis.roles_of(fn)
+            # Mutation sites owned by this function.
+            sites: list[tuple[str, int]] = []
+            publish_line: int | None = None
+            for node in ast.walk(fn):
+                owner = next(
+                    (
+                        a
+                        for a in src.ancestors(node)
+                        if isinstance(
+                            a, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        )
+                    ),
+                    None,
+                )
+                if owner is not fn:
+                    continue
+                if isinstance(node, ast.Call):
+                    if call_name(node) == "publish":
+                        if publish_line is None or node.lineno < publish_line:
+                            publish_line = node.lineno
+                    attr = _mutated_attr(node)
+                    if attr in declared:
+                        sites.append((attr, node.lineno))
+                elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for tgt in targets:
+                        if (
+                            isinstance(tgt, ast.Attribute)
+                            and tgt.attr in declared
+                            and not _is_decl_site(node, src)
+                        ):
+                            sites.append((tgt.attr, node.lineno))
+            for attr, line in sites:
+                display, decl_roles, cls_name, dpath, dline = declared[attr]
+                offending = roles - decl_roles
+                if offending:
+                    out.append(
+                        Violation(
+                            PUBLISH_RULE,
+                            f"{display}:{fn.name}",
+                            path,
+                            line,
+                            f"{display} (publish-on: "
+                            f"{', '.join(sorted(decl_roles))} — declared "
+                            f"at {dpath}:{dline}) is mutated in {fn.name}, "
+                            "reachable from thread role(s) "
+                            f"{{{', '.join(sorted(offending))}}}: the "
+                            "published page can disagree with the rollup "
+                            "it rides (the PR 19 "
+                            "tpu_fleet_shard_targets class); move the "
+                            "mutation to the publishing role's "
+                            "post-publish step",
+                        )
+                    )
+                elif (
+                    roles
+                    and publish_line is not None
+                    and line < publish_line
+                ):
+                    out.append(
+                        Violation(
+                            PUBLISH_RULE,
+                            f"{display}:before-publish:{fn.name}",
+                            path,
+                            line,
+                            f"{display} (publish-on: "
+                            f"{', '.join(sorted(decl_roles))}) is mutated "
+                            f"in {fn.name} BEFORE the page publish on "
+                            f"line {publish_line}: an interleaved scrape "
+                            "reads the new value against the old page — "
+                            "mutate after .publish() so the only "
+                            "observable skew is the honest direction",
+                        )
+                    )
+    return out

@@ -1,0 +1,106 @@
+"""SARIF 2.1.0 serialization of an analyzer run.
+
+One ``run`` per invocation; every violation becomes a ``result`` with a
+``partialFingerprints.tpumonFingerprint`` equal to the baseline
+fingerprint (``<rule> <key>``), so code-scanning UIs track findings
+across commits exactly the way the baseline file does — by identity,
+not position. Baselined violations are emitted as *suppressed* results
+(kind ``external``) carrying their written justification: the burn-down
+list stays visible in the scanning UI instead of vanishing.
+"""
+
+from __future__ import annotations
+
+from tpumon.analysis.core import Violation
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+#: Rule id -> short description, mirrored from docs/INVARIANTS.md.
+RULE_DESCRIPTIONS = {
+    "knob-drift": "Every env knob is documented, charted, and defaulted",
+    "family-drift": "Emitted ⊆ registered ⊆ documented metric families",
+    "lock-discipline": "Annotated guarded-by attrs accessed under lock",
+    "lock-order": "Lock acquisition order is acyclic",
+    "deadline": "Blocking calls in the pipeline carry timeouts",
+    "except-hygiene": "No blind excepts in the serving pipeline",
+    "race": "Cross-thread stores share a lock (thread-role propagation)",
+    "publish-discipline": (
+        "Page-feeding state mutates on its publishing role, post-publish"
+    ),
+}
+
+
+def _result(v: Violation, reason: str | None) -> dict:
+    out = {
+        "ruleId": v.rule,
+        "level": "note" if reason is not None else "error",
+        "message": {"text": v.message},
+        "locations": [
+            {
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": v.path,
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {"startLine": max(v.line, 1)},
+                }
+            }
+        ],
+        "partialFingerprints": {"tpumonFingerprint": v.fingerprint},
+    }
+    if reason is not None:
+        out["suppressions"] = [
+            {
+                "kind": "external",
+                "justification": reason
+                or "baselined without a written reason",
+            }
+        ]
+    return out
+
+
+def to_sarif(
+    violations: list[Violation],
+    baseline: dict[str, str],
+    version: str,
+) -> dict:
+    """The SARIF log document (a plain dict; caller serializes)."""
+    rules = sorted({v.rule for v in violations} | set(RULE_DESCRIPTIONS))
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "tpumon-invariants",
+                        "version": version,
+                        "informationUri": (
+                            "https://github.com/tpumon/tpumon"
+                            "/blob/main/docs/INVARIANTS.md"
+                        ),
+                        "rules": [
+                            {
+                                "id": rule,
+                                "shortDescription": {
+                                    "text": RULE_DESCRIPTIONS.get(
+                                        rule, rule
+                                    )
+                                },
+                            }
+                            for rule in rules
+                        ],
+                    }
+                },
+                "results": [
+                    _result(v, baseline.get(v.fingerprint))
+                    for v in violations
+                ],
+                "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            }
+        ],
+    }

@@ -1,0 +1,227 @@
+"""Thread-root discovery and role propagation over the call graph.
+
+A *thread root* is a function some thread enters from the top:
+
+- ``threading.Thread(target=X)`` / ``threading.Timer(t, X)`` spawn X;
+- ``<executor>.submit(X, ...)`` runs X on a pool thread;
+- a WSGI entry point (``def app(environ, start_response)``) runs on a
+  serving thread per request;
+- a ``*Servicer`` method runs on a gRPC server pool thread;
+- ``def f(...):  # thread: <role>`` declares a root the AST cannot see
+  (a callback invoked by a framework, a handler wired dynamically).
+
+Each root carries a **role** — the stable name of the thread population
+that enters it. At a spawn site the role comes from, in order: a
+``# thread: <role>`` comment on the spawning statement, the ``name=``
+literal (its ``tpumon-`` prefix stripped), or the target function's own
+name. Roles then propagate over the call graph: a function's role set is
+the union of roles of every root that (transitively) calls it. The race
+rules convict on role sets, so an unresolvable call (no edge) can only
+under-report — never fabricate a cross-thread access.
+
+``__init__`` bodies get no roles from construction: object construction
+happens-before sharing, matching the lock rules' exemption.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+from dataclasses import dataclass
+
+from tpumon.analysis.callgraph import CallGraph, FuncInfo, build
+from tpumon.analysis.core import Project, call_name, dotted, str_const
+
+ROLE_MARK = "thread:"
+
+#: Spawn callables: callee name -> (positional index of the target,
+#: keyword name of the target, default role when nothing names one).
+_SPAWN_SHAPES = {
+    "Thread": (None, "target", None),
+    "Timer": (1, "function", "timer"),
+    "submit": (0, None, "executor"),
+}
+
+_WSGI_PARAMS = ("environ", "start_response")
+
+
+@dataclass(frozen=True)
+class ThreadRoot:
+    qualname: str
+    role: str
+    path: str
+    line: int
+    via: str  # "spawn" | "annotation" | "wsgi" | "servicer"
+
+
+@dataclass
+class ThreadAnalysis:
+    graph: CallGraph
+    roots: list[ThreadRoot]
+    #: qualname -> roles of every thread population reaching it.
+    roles: dict[str, set[str]]
+
+    def roles_of(self, node: ast.AST) -> set[str]:
+        """Roles reaching a function *definition* node (empty when the
+        function is unreachable from any discovered root)."""
+        fi = self.graph.by_node.get(id(node))
+        if fi is None:
+            return set()
+        return self.roles.get(fi.qualname, set())
+
+
+def _parse_role(comment: str) -> str | None:
+    """``# thread: collect — why`` -> ``collect``."""
+    if ROLE_MARK not in comment:
+        return None
+    spec = comment.split(ROLE_MARK, 1)[1]
+    for stop in ("—", ";", " - "):
+        spec = spec.split(stop, 1)[0]
+    spec = spec.strip()
+    return spec.split()[0].rstrip(",") if spec else None
+
+
+def _stmt_comment(src, node: ast.AST) -> str:
+    """Comments across the statement's own lines ONLY (no spill onto the
+    next line: an annotation must not leak onto a neighboring spawn)."""
+    end = getattr(node, "end_lineno", node.lineno) or node.lineno
+    return " ".join(
+        src.comments[ln]
+        for ln in range(node.lineno, end + 1)
+        if ln in src.comments
+    )
+
+
+def _spawn_role(src, call: ast.Call, targets: set[str], default: str | None) -> str:
+    role = _parse_role(_stmt_comment(src, call))
+    if role:
+        return role
+    for kw in call.keywords:
+        if kw.arg == "name":
+            lit = str_const(kw.value)
+            if lit:
+                return lit.removeprefix("tpumon-")
+    if default is not None:
+        return default
+    if targets:
+        # Short name of the (sorted-first) target function.
+        return sorted(targets)[0].rsplit(".", 1)[-1].lstrip("_") or "thread"
+    return "thread"
+
+
+def _spawn_target_expr(call: ast.Call, pos: int | None, kwname: str | None):
+    if kwname is not None:
+        for kw in call.keywords:
+            if kw.arg == kwname:
+                return kw.value
+    if pos is not None and len(call.args) > pos:
+        return call.args[pos]
+    # Thread(target=...) is keyword-only in practice, but accept
+    # positional Timer/submit shapes too.
+    return None
+
+
+def _is_spawn(call: ast.Call) -> tuple[int | None, str | None, str | None] | None:
+    name = call_name(call)
+    shape = _SPAWN_SHAPES.get(name)
+    if shape is None:
+        return None
+    if name in ("Thread", "Timer"):
+        full = dotted(call.func)
+        # `threading.Thread(...)`, bare `Thread(...)` (from-import), or a
+        # vendor alias ending in .Thread — but not `x.submit` lookalikes.
+        if full not in (name, f"threading.{name}") and not full.endswith(
+            f"threading.{name}"
+        ):
+            return None
+    return shape
+
+
+def discover_roots(project: Project, graph: CallGraph) -> list[ThreadRoot]:
+    roots: list[ThreadRoot] = []
+    for path, src in sorted(project.python.items()):
+        # Declared + structural roots on the definitions themselves.
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            fi = graph.by_node.get(id(node))
+            if fi is None:
+                continue
+            role = _parse_role(src.comments.get(node.lineno, ""))
+            if role:
+                roots.append(
+                    ThreadRoot(fi.qualname, role, path, node.lineno, "annotation")
+                )
+            params = [a.arg for a in node.args.args]
+            if fi.cls is not None and params[:1] == ["self"]:
+                params = params[1:]
+            if tuple(params[:2]) == _WSGI_PARAMS:
+                roots.append(
+                    ThreadRoot(fi.qualname, "serve", path, node.lineno, "wsgi")
+                )
+            if (
+                fi.cls is not None
+                and fi.cls.name.endswith("Servicer")
+                and not node.name.startswith("_")
+            ):
+                roots.append(
+                    ThreadRoot(fi.qualname, "serve", path, node.lineno, "servicer")
+                )
+        # Spawn sites.
+        for call in ast.walk(src.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            shape = _is_spawn(call)
+            if shape is None:
+                continue
+            pos, kwname, default = shape
+            expr = _spawn_target_expr(call, pos, kwname)
+            if expr is None:
+                continue
+            owner_node = CallGraph._owning_function(src, call)
+            fi = graph.by_node.get(id(owner_node)) if owner_node else None
+            targets = graph.resolve(path, fi, expr)
+            if not targets:
+                continue
+            role = _spawn_role(src, call, targets, default)
+            for qn in sorted(targets):
+                roots.append(ThreadRoot(qn, role, path, call.lineno, "spawn"))
+    return roots
+
+
+def propagate(graph: CallGraph, roots: list[ThreadRoot]) -> dict[str, set[str]]:
+    roles: dict[str, set[str]] = {}
+    work: deque[str] = deque()
+    for root in roots:
+        got = roles.setdefault(root.qualname, set())
+        if root.role not in got:
+            got.add(root.role)
+            work.append(root.qualname)
+    while work:
+        qn = work.popleft()
+        mine = roles.get(qn, set())
+        for callee in graph.edges.get(qn, ()):
+            fi = graph.functions.get(callee)
+            if fi is not None and fi.name == "__init__":
+                # Construction happens-before sharing: __init__ bodies
+                # run before the object is visible to other threads.
+                continue
+            got = roles.setdefault(callee, set())
+            missing = mine - got
+            if missing:
+                got |= missing
+                work.append(callee)
+    return roles
+
+
+def analyze(project: Project) -> ThreadAnalysis:
+    """Build (and cache on the project) the thread-role analysis."""
+    cached = getattr(project, "_thread_analysis", None)
+    if cached is not None:
+        return cached
+    graph = build(project)
+    roots = discover_roots(project, graph)
+    roles = propagate(graph, roots)
+    analysis = ThreadAnalysis(graph, roots, roles)
+    project._thread_analysis = analysis  # type: ignore[attr-defined]
+    return analysis

@@ -361,7 +361,7 @@ class _MetricWatch:
             self._thread = threading.Thread(
                 target=self._run,
                 args=(call,),
-                name=f"tpumon-watch-{self.metric}",
+                name=f"tpumon-watch-{self.metric}",  # thread: grpc-watch — per-metric f-string name, one stable role
                 daemon=True,
             )
             self._thread.start()

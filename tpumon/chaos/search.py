@@ -155,7 +155,7 @@ def chaos_search(
     results: dict[int, dict] = {}
     aborted: dict[int, str] = {}
 
-    def trial(seed: int) -> None:
+    def trial(seed: int) -> None:  # thread: chaos-trial — pool.map target; map() is not a spawn shape the analyzer resolves
         schedule = FaultSchedule.generate(
             seed, nodes=nodes, duration_s=duration_s,
             max_steps=MAX_STEPS, min_steps=MIN_STEPS,

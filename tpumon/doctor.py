@@ -415,8 +415,14 @@ def _invariants_line(now: float | None = None) -> str:
         age_s = f"{age / 60:.0f}m ago"
     else:
         age_s = f"{age / 3600:.1f}h ago"
+    by_rule = stamp.get("new_by_rule") or {}
+    per_rule = (
+        " [" + ", ".join(f"{r}: {n}" for r, n in sorted(by_rule.items())) + "]"
+        if by_rule
+        else ""
+    )
     verdict = "ok" if stamp.get("ok") else (
-        f"{stamp.get('new_violations', '?')} NEW violations"
+        f"{stamp.get('new_violations', '?')} NEW violations{per_rule}"
         + (
             f", {stamp['stale_baseline_entries']} stale baseline entries"
             if stamp.get("stale_baseline_entries")

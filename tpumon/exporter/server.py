@@ -1256,10 +1256,12 @@ def _invariants_vars() -> dict:
     global _invariants_cache
     if _invariants_cache is None:
         from tpumon.analysis import ANALYZER_VERSION, baseline_count
+        from tpumon.analysis.core import all_rules
 
         _invariants_cache = {
             "analyzer_version": ANALYZER_VERSION,
             "baseline_violations": baseline_count(),
+            "rules": sorted(all_rules()),
         }
     doc = dict(_invariants_cache)
     from tpumon.analysis import stamp_info

@@ -141,7 +141,7 @@ class PeerWatcher:
                 thread_name_prefix="tpumon-fleet-peer-probe",
             )
         futures = {
-            self._executor.submit(self._probe_one, index, url)
+            self._executor.submit(self._probe_one, index, url)  # thread: fleet-peer-probe
             for index, url in self.peers.items()
         }
         wait(futures, timeout=self.timeout + 0.5)

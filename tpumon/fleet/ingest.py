@@ -795,7 +795,7 @@ class NodeFeed:
                 self._conn = None
             raise
 
-    def poll(self) -> None:
+    def poll(self) -> None:  # thread: fleet-fetch — submitted as `feed.poll`, untyped at the spawn site
         """One bounded HTTP /metrics fetch (runs on the fetch executor).
         Breaker-gated: while open, the fetch is refused locally."""
         with self._lock:

@@ -90,7 +90,7 @@ class FleetTelemetry:
             "Upstream exporter targets owned by this shard after "
             "rendezvous-hash assignment (tpumon/fleet/shard.py).",
             registry=registry,
-        )
+        )  # publish-on: fleet-collect — page-atomic, set after cache.publish
         self.watch_streams = Gauge(
             "tpu_fleet_watch_streams",
             "Upstream gRPC Watch fan-in streams by state (streaming / "
@@ -616,7 +616,7 @@ class FleetAggregator:
                         }
         return out
 
-    def _apply_membership(self, owned: list[str], info: dict) -> None:
+    def _apply_membership(self, owned: list[str], info: dict) -> None:  # thread: fleet-membership — on_membership callback, invisible to the call graph
         """Apply one ownership change from the membership plane: build
         feeds for adopted targets (seeded from the spool when we have
         their last-good data, else warm-seeded from an alive peer's
@@ -992,7 +992,7 @@ class FleetAggregator:
                     feed.watch_state_now() != "streaming"
                     or feed.age() > self.cfg.stale_s
                 ):
-                    self._executor.submit(feed.poll)
+                    self._executor.submit(feed.poll)  # thread: fleet-fetch
                     next_at = now + feed.next_poll_delay(interval)
                 else:
                     # Streaming and fresh: check back next interval.
@@ -1254,7 +1254,7 @@ class FleetAggregator:
             finally:
                 self._spool_saving = False
 
-        self._executor.submit(save)
+        self._executor.submit(save)  # thread: fleet-spool
 
     def _actuate_spool_state(self) -> dict | None:
         """The spool's "actuate" section: published hint bands plus the

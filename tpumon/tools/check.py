@@ -13,6 +13,16 @@ checked-in baseline (tpumon/analysis/baseline.txt):
 adopting a rule, then burn entries down. A stamp
 (``.tpumon-invariants.json``) records the verdict for ``tpumon doctor``
 and ``/debug/vars``.
+
+``--format {text,json,sarif}`` picks the report encoding (``--json`` is
+the legacy spelling of ``--format json``); ``--output FILE`` writes it
+somewhere other than stdout (CI uploads the SARIF as an artifact).
+``--changed-files A B ...`` is the incremental pre-commit mode: the
+WHOLE project is still loaded and analyzed — thread-role propagation is
+interprocedural, a diff-scoped parse would silently lose roots — but
+only violations located in the named files are reported, and the stale
+check and stamp are skipped (a partial view must not overwrite the
+full-run verdict).
 """
 
 from __future__ import annotations
@@ -30,6 +40,7 @@ from tpumon.analysis import (
 )
 from tpumon.analysis.baseline import baseline_path, write_stamp
 from tpumon.analysis.core import all_rules
+from tpumon.analysis.sarif import to_sarif
 
 
 def _default_root() -> str:
@@ -67,13 +78,29 @@ def main(argv: list[str] | None = None) -> int:
     )
     parser.add_argument(
         "--json", action="store_true", dest="as_json",
-        help="machine-readable report on stdout",
+        help="machine-readable report on stdout "
+        "(legacy spelling of --format json)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json", "sarif"), default=None,
+        dest="fmt", help="report encoding (default: text)",
+    )
+    parser.add_argument(
+        "--output", default=None, metavar="FILE",
+        help="write the report to FILE instead of stdout",
+    )
+    parser.add_argument(
+        "--changed-files", nargs="*", default=None, metavar="PATH",
+        help="incremental mode: analyze the whole project but report "
+        "only violations located in these files (skips stale check "
+        "and stamp)",
     )
     parser.add_argument(
         "--no-stamp", action="store_true",
         help="do not write the .tpumon-invariants.json stamp",
     )
     args = parser.parse_args(argv)
+    fmt = args.fmt or ("json" if args.as_json else "text")
     if args.update_baseline and args.rules:
         # A partial run must never rewrite the whole baseline: every
         # other rule's accepted entries (and their curated reasons)
@@ -84,14 +111,20 @@ def main(argv: list[str] | None = None) -> int:
     project = load_project(root)
     violations = run_rules(project, args.rules)
 
+    if args.changed_files is not None:
+        changed = {_normalize_path(p, root) for p in args.changed_files}
+        violations = [v for v in violations if v.path in changed]
+
     bl_path = args.baseline or baseline_path(root)
     baseline = load_baseline(bl_path)
     current = {v.fingerprint for v in violations}
     new = [v for v in violations if v.fingerprint not in baseline]
     suppressed = [v for v in violations if v.fingerprint in baseline]
-    # Stale entries only assessable when every rule ran.
+    # Stale entries only assessable when every rule ran on every file.
     stale = (
-        sorted(set(baseline) - current) if not args.rules else []
+        sorted(set(baseline) - current)
+        if not args.rules and args.changed_files is None
+        else []
     )
 
     if args.update_baseline:
@@ -110,40 +143,56 @@ def main(argv: list[str] | None = None) -> int:
         print(f"baseline rewritten: {bl_path} ({len(violations)} entries)")
         return 0
 
-    if args.as_json:
-        print(
-            json.dumps(
-                {
-                    "analyzer_version": ANALYZER_VERSION,
-                    "new": [v.__dict__ for v in new],
-                    "baselined": [v.fingerprint for v in suppressed],
-                    "stale": stale,
-                },
-                indent=2,
-                sort_keys=True,
-            )
+    if fmt == "json":
+        report = json.dumps(
+            {
+                "analyzer_version": ANALYZER_VERSION,
+                "new": [v.__dict__ for v in new],
+                "baselined": [v.fingerprint for v in suppressed],
+                "stale": stale,
+            },
+            indent=2,
+            sort_keys=True,
         )
+        _emit(report, args.output)
+    elif fmt == "sarif":
+        report = json.dumps(
+            to_sarif(violations, baseline, ANALYZER_VERSION),
+            indent=2,
+            sort_keys=True,
+        )
+        _emit(report, args.output)
     else:
+        lines = []
         for v in new:
             loc = f"{v.path}:{v.line}" if v.line else v.path
-            print(f"{v.rule}: {loc}: {v.message}")
-            print(f"    fingerprint: {v.fingerprint}")
+            lines.append(f"{v.rule}: {loc}: {v.message}")
+            lines.append(f"    fingerprint: {v.fingerprint}")
         for fp in stale:
-            print(
+            lines.append(
                 f"stale-baseline: {fp!r} no longer matches anything — "
                 f"delete it from {os.path.relpath(bl_path, root)}"
             )
         verdict = "OK" if not new else "FAIL"
         if stale and args.strict:
             verdict = "FAIL"
-        print(
+        scope = (
+            f"{len(args.changed_files)} changed files"
+            if args.changed_files is not None
+            else f"{len(project.python)} py / "
+            f"{len(project.texts)} text files"
+        )
+        lines.append(
             f"invariants {verdict}: {len(new)} new, "
             f"{len(suppressed)} baselined, {len(stale)} stale "
-            f"(analyzer {ANALYZER_VERSION}, "
-            f"{len(project.python)} py / {len(project.texts)} text files)"
+            f"(analyzer {ANALYZER_VERSION}, {scope})"
         )
+        _emit("\n".join(lines), args.output)
 
-    if not args.no_stamp and not args.rules:
+    if not args.no_stamp and not args.rules and args.changed_files is None:
+        by_rule: dict[str, int] = {}
+        for v in new:
+            by_rule[v.rule] = by_rule.get(v.rule, 0) + 1
         try:
             write_stamp(
                 root,
@@ -151,6 +200,7 @@ def main(argv: list[str] | None = None) -> int:
                 baselined=len(suppressed),
                 stale=len(stale),
                 version=ANALYZER_VERSION,
+                new_by_rule=by_rule,
             )
         except OSError as exc:
             print(f"warning: could not write stamp: {exc}", file=sys.stderr)
@@ -160,6 +210,22 @@ def main(argv: list[str] | None = None) -> int:
     if stale and args.strict:
         return 1
     return 0
+
+
+def _normalize_path(path: str, root: str) -> str:
+    """A --changed-files operand (absolute, or relative to the CWD or
+    the root — whatever the CI diff produced) -> project-relative form."""
+    if os.path.isabs(path):
+        return os.path.relpath(path, root).replace(os.sep, "/")
+    return path.replace(os.sep, "/")
+
+
+def _emit(report: str, output: str | None) -> None:
+    if output is None:
+        print(report)
+        return
+    with open(output, "w", encoding="utf-8") as fh:
+        fh.write(report + "\n")
 
 
 if __name__ == "__main__":
